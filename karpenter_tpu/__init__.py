@@ -1,0 +1,13 @@
+"""karpenter_tpu: a TPU-native, metrics-driven node-autoscaling framework.
+
+Capabilities-equivalent rebuild of early Karpenter (awslabs/karpenter v0.1.x,
+reference at /root/reference): MetricsProducers emit scaling signals, an
+HPA-compatible HorizontalAutoscaler turns signals into desired replicas, and
+ScalableNodeGroups actuate replicas through a pluggable cloud-provider
+boundary. Unlike the reference's one-scalar-decision-per-object-per-tick Go
+control plane, the decision path here is a batched JAX/XLA array program: all
+autoscalers, pending pods, and node groups are evaluated as one vectorized
+constraint problem on TPU.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,43 @@
+"""API group autoscaling.karpenter.sh/v1alpha1, TPU-native build.
+
+reference: pkg/apis/autoscaling/v1alpha1/doc.go:28-58, pkg/apis/apis.go:27-33.
+"""
+
+from karpenter_tpu.api import conditions
+from karpenter_tpu.api.core import (
+    Node,
+    ObjectMeta,
+    Pod,
+    is_ready_and_schedulable,
+    matches_selector,
+    resource_list,
+)
+from karpenter_tpu.api.horizontalautoscaler import HorizontalAutoscaler
+from karpenter_tpu.api.metricsproducer import MetricsProducer
+from karpenter_tpu.api.scalablenodegroup import ScalableNodeGroup
+
+GROUP = "autoscaling.karpenter.sh"
+VERSION = "v1alpha1"
+
+# Kinds registered in the scheme (reference: pkg/apis/autoscaling/v1alpha1/doc.go:54-58)
+KINDS = {
+    HorizontalAutoscaler.KIND: HorizontalAutoscaler,
+    MetricsProducer.KIND: MetricsProducer,
+    ScalableNodeGroup.KIND: ScalableNodeGroup,
+}
+
+__all__ = [
+    "GROUP",
+    "VERSION",
+    "KINDS",
+    "conditions",
+    "HorizontalAutoscaler",
+    "MetricsProducer",
+    "ScalableNodeGroup",
+    "Node",
+    "Pod",
+    "ObjectMeta",
+    "resource_list",
+    "is_ready_and_schedulable",
+    "matches_selector",
+]

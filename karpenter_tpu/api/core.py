@@ -1,0 +1,145 @@
+"""Core object model: metadata, Nodes, Pods.
+
+These mirror the slices of the k8s core/v1 API the reference consumes — Node
+readiness/allocatable (reference: pkg/utils/node/predicates.go:18-25,
+pkg/metrics/producers/reservedcapacity/reservations.go:45-56) and Pod
+nodeName/requests, extended with the scheduling-constraint fields
+(tolerations, nodeSelector, affinity) that the pending-capacity bin-pack
+solver consumes (reference design: docs/designs/DESIGN.md "Pending Pods").
+"""
+
+from __future__ import annotations
+
+import itertools
+import time as _time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from karpenter_tpu.utils.quantity import Quantity, parse_quantity
+
+_uid_counter = itertools.count(1)
+
+RESOURCE_CPU = "cpu"
+RESOURCE_MEMORY = "memory"
+RESOURCE_PODS = "pods"
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    uid: str = ""
+    resource_version: int = 0
+    creation_timestamp: float = 0.0
+
+    def ensure_identity(self):
+        if not self.uid:
+            self.uid = f"uid-{next(_uid_counter)}"
+        if not self.creation_timestamp:
+            self.creation_timestamp = _time.time()
+
+
+def resource_list(**kwargs) -> Dict[str, Quantity]:
+    """Build a {resource: Quantity} map from keyword strings, e.g.
+    resource_list(cpu="1100m", memory="1Gi")."""
+    return {k: parse_quantity(v) for k, v in kwargs.items()}
+
+
+@dataclass
+class Toleration:
+    key: str = ""
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: str = ""  # "" tolerates all effects
+
+    def tolerates(self, taint: "Taint") -> bool:
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.operator == "Exists":
+            return self.key == "" or self.key == taint.key
+        return self.key == taint.key and self.value == taint.value
+
+
+@dataclass
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = "NoSchedule"  # NoSchedule | PreferNoSchedule | NoExecute
+
+
+@dataclass
+class Container:
+    name: str = "main"
+    requests: Dict[str, Quantity] = field(default_factory=dict)
+
+
+@dataclass
+class PodSpec:
+    node_name: str = ""
+    containers: List[Container] = field(default_factory=list)
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    tolerations: List[Toleration] = field(default_factory=list)
+
+
+@dataclass
+class PodStatus:
+    phase: str = "Pending"
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    KIND = "Pod"
+
+    def requests(self) -> Dict[str, Quantity]:
+        """Sum of container resource requests (container-level only, matching
+        reference reservations.go:45-56 — no init containers or overhead)."""
+        totals: Dict[str, Quantity] = {}
+        for container in self.spec.containers:
+            for name, quantity in container.requests.items():
+                totals[name] = totals.get(name, Quantity()).add(quantity)
+        return totals
+
+
+@dataclass
+class NodeSpec:
+    unschedulable: bool = False
+    taints: List[Taint] = field(default_factory=list)
+
+
+@dataclass
+class NodeCondition:
+    type: str
+    status: str
+
+
+@dataclass
+class NodeStatus:
+    allocatable: Dict[str, Quantity] = field(default_factory=dict)
+    conditions: List[NodeCondition] = field(default_factory=list)
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+    KIND = "Node"
+
+
+def is_ready_and_schedulable(node: Node) -> bool:
+    """reference: pkg/utils/node/predicates.go:18-25"""
+    for condition in node.status.conditions:
+        if condition.type == "Ready":
+            return condition.status == "True" and not node.spec.unschedulable
+    return False
+
+
+def matches_selector(labels: Dict[str, str], selector: Dict[str, str]) -> bool:
+    return all(labels.get(k) == v for k, v in selector.items())

@@ -1,0 +1,222 @@
+"""HorizontalAutoscaler resource: HPA-v2beta2-shaped spec, status, behavior.
+
+reference: pkg/apis/autoscaling/v1alpha1/horizontalautoscaler.go:33-275 and
+horizontalautoscaler_status.go:22-103. The behavior helpers here are the
+host-side scalar semantics (defaults via merge, select policy, scaling rules,
+stabilization window); they double as the golden oracle for the batched
+device decision kernel.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from karpenter_tpu.api.conditions import (
+    ABLE_TO_SCALE,
+    ACTIVE,
+    SCALING_UNBOUNDED,
+    Condition,
+    ConditionManager,
+)
+from karpenter_tpu.api.core import ObjectMeta
+from karpenter_tpu.utils.functional import merge_into
+from karpenter_tpu.utils.log import invariant_violated, logger
+
+# Metric target types (reference: horizontalautoscaler.go:190-197)
+UTILIZATION = "Utilization"
+VALUE = "Value"
+AVERAGE_VALUE = "AverageValue"
+
+# Select policies (reference: horizontalautoscaler.go:118-127)
+MAX_POLICY_SELECT = "Max"
+MIN_POLICY_SELECT = "Min"
+DISABLED_POLICY_SELECT = "Disabled"
+
+# Scaling policy types (reference: horizontalautoscaler.go:131-138)
+COUNT_SCALING_POLICY = "Count"
+PERCENT_SCALING_POLICY = "Percent"
+
+
+@dataclass
+class CrossVersionObjectReference:
+    kind: str = ""
+    name: str = ""
+    api_version: str = ""
+
+
+@dataclass
+class MetricTarget:
+    type: str = VALUE
+    value: Optional[float] = None
+    average_value: Optional[float] = None
+    average_utilization: Optional[int] = None
+
+    def target_value(self) -> float:
+        for v in (self.value, self.average_value, self.average_utilization):
+            if v is not None:
+                return float(v)
+        return 0.0
+
+
+@dataclass
+class PrometheusMetricSource:
+    query: str = ""
+    target: MetricTarget = field(default_factory=MetricTarget)
+
+
+@dataclass
+class Metric:
+    """One-of metric source (reference: horizontalautoscaler.go:158-163)."""
+
+    prometheus: Optional[PrometheusMetricSource] = None
+
+    def get_target(self) -> MetricTarget:
+        """reference: horizontalautoscaler.go:204-210"""
+        if self.prometheus is not None:
+            return self.prometheus.target
+        invariant_violated(
+            f"Unrecognized metric type while retrieving target for {self}"
+        )
+
+
+@dataclass
+class ScalingPolicy:
+    type: str = COUNT_SCALING_POLICY
+    value: int = 0
+    period_seconds: int = 0
+
+
+@dataclass
+class ScalingRules:
+    stabilization_window_seconds: Optional[int] = None
+    select_policy: Optional[str] = None
+    policies: Optional[List[ScalingPolicy]] = None
+
+    def within_stabilization_window(
+        self, last_scale_time: Optional[float], now: Optional[float] = None
+    ) -> bool:
+        """reference: horizontalautoscaler.go:267-275"""
+        if last_scale_time is None or self.stabilization_window_seconds is None:
+            return False
+        now = _time.time() if now is None else now
+        return (now - last_scale_time) < float(self.stabilization_window_seconds)
+
+
+@dataclass
+class Behavior:
+    scale_up: Optional[ScalingRules] = None
+    scale_down: Optional[ScalingRules] = None
+
+    def scale_up_rules(self) -> ScalingRules:
+        """Defaults: no stabilization, Max select (reference:
+        horizontalautoscaler.go:249-256)."""
+        rules = ScalingRules(
+            stabilization_window_seconds=0, select_policy=MAX_POLICY_SELECT
+        )
+        return merge_into(rules, self.scale_up)
+
+    def scale_down_rules(self) -> ScalingRules:
+        """Defaults: 300s stabilization, Max select (reference:
+        horizontalautoscaler.go:258-265)."""
+        rules = ScalingRules(
+            stabilization_window_seconds=300, select_policy=MAX_POLICY_SELECT
+        )
+        return merge_into(rules, self.scale_down)
+
+    def get_scaling_rules(
+        self, replicas: int, recommendations: List[int]
+    ) -> ScalingRules:
+        """Pick up/down/disabled rules from the recommendation direction
+        (reference: horizontalautoscaler.go:240-247)."""
+        if any(r > replicas for r in recommendations):
+            return self.scale_up_rules()
+        if any(r < replicas for r in recommendations):
+            return self.scale_down_rules()
+        return ScalingRules(select_policy=DISABLED_POLICY_SELECT)
+
+    def apply_select_policy(self, replicas: int, recommendations: List[int]) -> int:
+        """reference: horizontalautoscaler.go:226-238"""
+        policy = self.get_scaling_rules(replicas, recommendations).select_policy
+        if policy == MAX_POLICY_SELECT:
+            return max(recommendations)
+        if policy == MIN_POLICY_SELECT:
+            return min(recommendations)
+        if policy != DISABLED_POLICY_SELECT:
+            # unknown policy: log loudly but keep current replicas, matching
+            # the reference's non-fatal handling (horizontalautoscaler.go:236-237)
+            logger().error("unknown select policy: %s", policy)
+        return replicas
+
+
+@dataclass
+class MetricValueStatus:
+    value: Optional[float] = None
+    average_value: Optional[float] = None
+    average_utilization: Optional[int] = None
+
+
+@dataclass
+class PrometheusMetricStatus:
+    query: str = ""
+    current: MetricValueStatus = field(default_factory=MetricValueStatus)
+
+
+@dataclass
+class MetricStatus:
+    prometheus: Optional[PrometheusMetricStatus] = None
+
+
+@dataclass
+class HorizontalAutoscalerSpec:
+    scale_target_ref: CrossVersionObjectReference = field(
+        default_factory=CrossVersionObjectReference
+    )
+    min_replicas: int = 0
+    max_replicas: int = 0
+    metrics: List[Metric] = field(default_factory=list)
+    behavior: Behavior = field(default_factory=Behavior)
+
+
+@dataclass
+class HorizontalAutoscalerStatus:
+    last_scale_time: Optional[float] = None
+    current_replicas: Optional[int] = None
+    desired_replicas: Optional[int] = None
+    current_metrics: List[MetricStatus] = field(default_factory=list)
+    conditions: List[Condition] = field(default_factory=list)
+
+
+@dataclass
+class HorizontalAutoscaler:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: HorizontalAutoscalerSpec = field(default_factory=HorizontalAutoscalerSpec)
+    status: HorizontalAutoscalerStatus = field(
+        default_factory=HorizontalAutoscalerStatus
+    )
+
+    KIND = "HorizontalAutoscaler"
+
+    def status_conditions(self) -> ConditionManager:
+        return ConditionManager(
+            [ACTIVE, ABLE_TO_SCALE, SCALING_UNBOUNDED], self.status.conditions
+        )
+
+    def validate(self) -> None:
+        if self.spec.max_replicas < self.spec.min_replicas:
+            raise ValueError(
+                "maxReplicas cannot be less than minReplicas "
+                f"({self.spec.max_replicas} < {self.spec.min_replicas})"
+            )
+        for rules in (self.spec.behavior.scale_up, self.spec.behavior.scale_down):
+            if rules is None or rules.stabilization_window_seconds is None:
+                continue
+            if not 0 <= rules.stabilization_window_seconds <= 3600:
+                raise ValueError(
+                    "stabilizationWindowSeconds must be in [0, 3600], got "
+                    f"{rules.stabilization_window_seconds}"
+                )
+
+    def default(self) -> None:
+        """reference: horizontalautoscaler_defaults.go (no-op)."""

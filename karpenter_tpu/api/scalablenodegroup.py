@@ -1,0 +1,71 @@
+"""ScalableNodeGroup resource: scale-subresource shim over cloud node groups.
+
+reference: pkg/apis/autoscaling/v1alpha1/scalablenodegroup.go:24-66,
+scalablenodegroup_status.go:21-63, scalablenodegroup_validation.go:39-56.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from karpenter_tpu.api.conditions import (
+    ABLE_TO_SCALE,
+    ACTIVE,
+    STABILIZED,
+    Condition,
+    ConditionManager,
+)
+from karpenter_tpu.api.core import ObjectMeta
+
+# Provider node-group types. AWS types kept for spec parity with the
+# reference; the TPU-native deployment uses the tpu pod-slice pool type.
+AWS_EC2_AUTO_SCALING_GROUP = "AWSEC2AutoScalingGroup"
+AWS_EKS_NODE_GROUP = "AWSEKSNodeGroup"
+TPU_POD_SLICE_POOL = "TPUPodSlicePool"
+FAKE_NODE_GROUP = "FakeNodeGroup"
+
+
+@dataclass
+class ScalableNodeGroupSpec:
+    replicas: Optional[int] = None
+    type: str = ""
+    id: str = ""
+
+
+@dataclass
+class ScalableNodeGroupStatus:
+    replicas: Optional[int] = None
+    conditions: List[Condition] = field(default_factory=list)
+
+
+@dataclass
+class ScalableNodeGroup:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ScalableNodeGroupSpec = field(default_factory=ScalableNodeGroupSpec)
+    status: ScalableNodeGroupStatus = field(default_factory=ScalableNodeGroupStatus)
+
+    KIND = "ScalableNodeGroup"
+
+    def status_conditions(self) -> ConditionManager:
+        return ConditionManager(
+            [ACTIVE, ABLE_TO_SCALE, STABILIZED], self.status.conditions
+        )
+
+    def validate(self) -> None:
+        validator = _validators.get(self.spec.type)
+        if validator is None:
+            raise ValueError(f"Unexpected type {self.spec.type}")
+        validator(self.spec)
+
+    def default(self) -> None:
+        pass
+
+
+# Pluggable per-provider validators
+# (reference: scalablenodegroup_validation.go:39-56)
+_validators = {}
+
+
+def register_scalable_node_group_validator(node_group_type: str, validator) -> None:
+    _validators[node_group_type] = validator

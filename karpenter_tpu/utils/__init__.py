@@ -1,0 +1,4 @@
+from karpenter_tpu.utils.quantity import Quantity, parse_quantity
+from karpenter_tpu.utils.functional import merge_into
+
+__all__ = ["Quantity", "parse_quantity", "merge_into"]

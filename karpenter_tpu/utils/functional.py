@@ -1,0 +1,22 @@
+"""Small functional helpers (reference: pkg/utils/functional/functional.go:24-91).
+
+merge_into reproduces the JSON-merge defaulting trick the reference uses for
+scaling-rule defaults (horizontalautoscaler.go:249-265): fields that are set
+(non-None) on src overlay the corresponding fields on dest.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+def merge_into(dest, *srcs):
+    """Overlay non-None dataclass fields of each src onto dest, in order."""
+    for src in srcs:
+        if src is None:
+            continue
+        for field in dataclasses.fields(src):
+            value = getattr(src, field.name)
+            if value is not None:
+                setattr(dest, field.name, value)
+    return dest

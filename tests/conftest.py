@@ -1,0 +1,18 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+
+Real-TPU runs (bench.py, the driver) use the real backend; tests exercise
+multi-chip sharding logic on virtual CPU devices per the build environment's
+contract.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,214 @@
+"""API-layer behavior: behavior defaults/merge, select policy, stabilization,
+validation rules, condition management."""
+
+import pytest
+
+from karpenter_tpu.api import conditions
+from karpenter_tpu.api.core import (
+    Container,
+    Node,
+    NodeCondition,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    is_ready_and_schedulable,
+    resource_list,
+)
+from karpenter_tpu.api.horizontalautoscaler import (
+    Behavior,
+    DISABLED_POLICY_SELECT,
+    HorizontalAutoscaler,
+    MAX_POLICY_SELECT,
+    MIN_POLICY_SELECT,
+    ScalingRules,
+)
+from karpenter_tpu.api.metricsproducer import (
+    MetricsProducer,
+    MetricsProducerSpec,
+    Pattern,
+    ReservedCapacitySpec,
+    ScheduleSpec,
+    ScheduledBehavior,
+)
+from karpenter_tpu.api.scalablenodegroup import (
+    ScalableNodeGroup,
+    ScalableNodeGroupSpec,
+    register_scalable_node_group_validator,
+)
+
+
+class TestBehavior:
+    """reference: horizontalautoscaler.go:226-275"""
+
+    def test_default_up_rules(self):
+        rules = Behavior().scale_up_rules()
+        assert rules.stabilization_window_seconds == 0
+        assert rules.select_policy == MAX_POLICY_SELECT
+
+    def test_default_down_rules(self):
+        rules = Behavior().scale_down_rules()
+        assert rules.stabilization_window_seconds == 300
+        assert rules.select_policy == MAX_POLICY_SELECT
+
+    def test_user_rules_merge_over_defaults(self):
+        b = Behavior(scale_down=ScalingRules(stabilization_window_seconds=60))
+        rules = b.scale_down_rules()
+        assert rules.stabilization_window_seconds == 60
+        assert rules.select_policy == MAX_POLICY_SELECT  # default survives
+
+    def test_direction_picks_rules(self):
+        b = Behavior()
+        assert b.get_scaling_rules(5, [8]).stabilization_window_seconds == 0
+        assert b.get_scaling_rules(5, [3]).stabilization_window_seconds == 300
+        assert b.get_scaling_rules(5, [5]).select_policy == DISABLED_POLICY_SELECT
+
+    def test_select_policy_max_min_disabled(self):
+        assert Behavior().apply_select_policy(5, [3, 8]) == 8
+        b_min = Behavior(scale_up=ScalingRules(select_policy=MIN_POLICY_SELECT))
+        assert b_min.apply_select_policy(5, [6, 8]) == 6
+        b_off = Behavior(scale_up=ScalingRules(select_policy=DISABLED_POLICY_SELECT))
+        assert b_off.apply_select_policy(5, [6, 8]) == 5
+
+    def test_stabilization_window(self):
+        rules = ScalingRules(stabilization_window_seconds=300)
+        assert rules.within_stabilization_window(1000.0, now=1100.0)
+        assert not rules.within_stabilization_window(1000.0, now=1301.0)
+        assert not rules.within_stabilization_window(None, now=1100.0)
+        assert not ScalingRules().within_stabilization_window(1000.0, now=1001.0)
+
+
+class TestValidation:
+    def test_ha_max_lt_min_rejected(self):
+        ha = HorizontalAutoscaler()
+        ha.spec.min_replicas, ha.spec.max_replicas = 5, 3
+        with pytest.raises(ValueError):
+            ha.validate()
+
+    def test_reserved_capacity_selector_cardinality(self):
+        """reference: metricsproducer_validation.go:90-95"""
+        with pytest.raises(ValueError):
+            ReservedCapacitySpec(node_selector={}).validate()
+        with pytest.raises(ValueError):
+            ReservedCapacitySpec(node_selector={"a": "1", "b": "2"}).validate()
+        ReservedCapacitySpec(node_selector={"a": "1"}).validate()
+
+    @pytest.mark.parametrize(
+        "pattern",
+        [
+            Pattern(weekdays="fri", hours="17"),
+            Pattern(weekdays="Sunday,mon"),
+            Pattern(months="jan,February,3"),
+            Pattern(days="1,15", minutes="30"),
+        ],
+    )
+    def test_valid_patterns(self, pattern):
+        pattern.validate()
+
+    @pytest.mark.parametrize(
+        "pattern",
+        [
+            Pattern(weekdays="blursday"),
+            Pattern(months="13"),
+            Pattern(hours="noon"),
+            Pattern(minutes="-5"),
+            # out-of-range numerics must fail admission, not reconcile
+            Pattern(hours="25"),
+            Pattern(days="0"),
+            Pattern(days="32"),
+            Pattern(minutes="75"),
+        ],
+    )
+    def test_invalid_patterns(self, pattern):
+        with pytest.raises(ValueError):
+            pattern.validate()
+
+    def test_validated_pattern_always_compiles_to_cron(self):
+        p = Pattern(weekdays="Sunday,mon", months="jan,February,3", hours="23")
+        p.validate()
+        p.to_cron()  # must not raise: admission and engine agree
+
+    def test_schedule_spec_validation(self):
+        """reference: metricsproducer_validation.go:61-82"""
+        good = ScheduleSpec(
+            behaviors=[
+                ScheduledBehavior(
+                    replicas=2,
+                    start=Pattern(weekdays="fri"),
+                    end=Pattern(weekdays="mon"),
+                )
+            ],
+            timezone="America/Los_Angeles",
+            default_replicas=1,
+        )
+        good.validate()
+        bad_tz = ScheduleSpec(timezone="Mars/Olympus", default_replicas=1)
+        with pytest.raises(ValueError, match="timezone"):
+            bad_tz.validate()
+        bad_replicas = ScheduleSpec(default_replicas=-1)
+        with pytest.raises(ValueError, match="defaultReplicas"):
+            bad_replicas.validate()
+
+    def test_sng_validator_registry(self):
+        """reference: scalablenodegroup_validation.go:39-56"""
+        sng = ScalableNodeGroup(
+            spec=ScalableNodeGroupSpec(type="TestGroupKind", id="x")
+        )
+        with pytest.raises(ValueError, match="Unexpected type"):
+            sng.validate()
+        register_scalable_node_group_validator("TestGroupKind", lambda spec: None)
+        sng.validate()
+
+
+class TestConditions:
+    def test_living_set_ready_rollup(self):
+        ha = HorizontalAutoscaler()
+        mgr = ha.status_conditions()
+        mgr.initialize()
+        assert not mgr.is_happy()
+        for t in (conditions.ACTIVE, conditions.ABLE_TO_SCALE, conditions.SCALING_UNBOUNDED):
+            mgr.mark_true(t)
+        assert mgr.is_happy()
+        assert mgr.get(conditions.READY).status == conditions.TRUE
+
+        mgr.mark_false(conditions.ABLE_TO_SCALE, "", "within stabilization window")
+        assert not mgr.is_happy()
+        assert mgr.get(conditions.READY).status == conditions.FALSE
+        assert "stabilization" in mgr.get(conditions.READY).message
+
+    def test_conditions_persist_on_resource(self):
+        mp = MetricsProducer()
+        mp.status_conditions().mark_true(conditions.ACTIVE)
+        assert mp.status_conditions().is_happy()
+
+
+class TestCoreObjects:
+    def test_node_readiness_predicate(self):
+        """reference: pkg/utils/node/predicates.go:18-25"""
+        ready = Node(status=NodeStatus(conditions=[NodeCondition("Ready", "True")]))
+        assert is_ready_and_schedulable(ready)
+        not_ready = Node(
+            status=NodeStatus(conditions=[NodeCondition("Ready", "False")])
+        )
+        assert not is_ready_and_schedulable(not_ready)
+        cordoned = Node(
+            spec=NodeSpec(unschedulable=True),
+            status=NodeStatus(conditions=[NodeCondition("Ready", "True")]),
+        )
+        assert not is_ready_and_schedulable(cordoned)
+        no_conditions = Node()
+        assert not is_ready_and_schedulable(no_conditions)
+
+    def test_pod_request_totals(self):
+        pod = Pod(
+            spec=PodSpec(
+                containers=[
+                    Container(requests=resource_list(cpu="500m", memory="1Gi")),
+                    Container(requests=resource_list(cpu="250m")),
+                ]
+            )
+        )
+        totals = pod.requests()
+        assert str(totals["cpu"]) == "750m"
+        assert str(totals["memory"]) == "1Gi"

@@ -1,0 +1,90 @@
+"""Cron next-match behavior (replaces robfig/cron in the reference's
+scheduledcapacity producer, crontabs.go:33-73)."""
+
+from datetime import datetime
+from zoneinfo import ZoneInfo
+
+import pytest
+
+from karpenter_tpu.utils.cron import Cron, CronParseError
+
+UTC = ZoneInfo("UTC")
+
+
+def dt(*args, tz=UTC):
+    return datetime(*args, tzinfo=tz)
+
+
+class TestDefaults:
+    def test_omitted_minutes_hours_mean_zero(self):
+        # Pattern docs: omitted minutes/hours match 0; omitted date fields are
+        # wildcards (reference: metricsproducer.go:70-83).
+        c = Cron(weekdays="fri", hours="17")
+        nxt = c.next_after(dt(2026, 7, 29, 12, 0))  # Wednesday
+        assert nxt == dt(2026, 7, 31, 17, 0)  # Friday 17:00
+
+    def test_all_defaults_daily_midnight(self):
+        c = Cron()
+        assert c.next_after(dt(2026, 7, 29, 0, 0)) == dt(2026, 7, 30, 0, 0)
+        assert c.next_after(dt(2026, 7, 28, 23, 59)) == dt(2026, 7, 29, 0, 0)
+
+
+class TestFields:
+    def test_minute_list(self):
+        c = Cron(minutes="15,45", hours="*")
+        assert c.next_after(dt(2026, 1, 1, 10, 20)) == dt(2026, 1, 1, 10, 45)
+        assert c.next_after(dt(2026, 1, 1, 10, 45)) == dt(2026, 1, 1, 11, 15)
+
+    def test_weekday_names(self):
+        c = Cron(weekdays="mon", hours="9")
+        # 2026-07-29 is a Wednesday; next Monday is 2026-08-03
+        assert c.next_after(dt(2026, 7, 29, 12, 0)) == dt(2026, 8, 3, 9, 0)
+
+    def test_full_weekday_names_accepted(self):
+        c = Cron(weekdays="monday", hours="9")
+        assert c.next_after(dt(2026, 7, 29, 12, 0)) == dt(2026, 8, 3, 9, 0)
+
+    def test_sunday_as_seven(self):
+        c = Cron(weekdays="7")
+        assert c.next_after(dt(2026, 7, 29, 1, 0)) == dt(2026, 8, 2, 0, 0)
+
+    def test_month_names(self):
+        c = Cron(months="dec", days="25", hours="8")
+        assert c.next_after(dt(2026, 7, 29, 0, 0)) == dt(2026, 12, 25, 8, 0)
+
+    def test_dom_and_dow_or_rule(self):
+        # standard cron: both restricted -> match either
+        c = Cron(days="15", weekdays="mon")
+        nxt = c.next_after(dt(2026, 7, 29, 1, 0))  # Wed Jul 29
+        assert nxt == dt(2026, 8, 3, 0, 0)  # Monday Aug 3 beats Aug 15
+
+    def test_timezone(self):
+        la = ZoneInfo("America/Los_Angeles")
+        c = Cron(weekdays="fri", hours="17")
+        now = dt(2026, 7, 31, 16, 0, tz=la)  # Friday 4pm PT
+        assert c.next_after(now) == dt(2026, 7, 31, 17, 0, tz=la)
+
+    def test_strictly_after(self):
+        c = Cron(minutes="0", hours="12")
+        assert c.next_after(dt(2026, 3, 1, 12, 0)) == dt(2026, 3, 2, 12, 0)
+
+
+class TestErrors:
+    def test_bad_element(self):
+        with pytest.raises(CronParseError):
+            Cron(weekdays="blursday")
+
+    def test_garbage_after_valid_prefix_rejected(self):
+        with pytest.raises(CronParseError):
+            Cron(months="janet")
+        with pytest.raises(CronParseError):
+            Cron(weekdays="friyay")
+
+    def test_out_of_range(self):
+        with pytest.raises(CronParseError):
+            Cron(hours="25")
+
+    def test_unsatisfiable(self):
+        c = Cron(days="30", months="feb")
+        with pytest.raises(CronParseError):
+            c.next_after(dt(2026, 1, 1, 0, 0))

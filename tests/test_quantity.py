@@ -1,0 +1,102 @@
+"""Quantity parse/format fidelity against k8s resource.Quantity behavior the
+reference's status strings depend on (reservedcapacity/producer.go:63-86)."""
+
+import pytest
+
+from karpenter_tpu.utils.quantity import Quantity, parse_quantity
+
+
+class TestParse:
+    @pytest.mark.parametrize(
+        "text,expected_float",
+        [
+            ("1100m", 1.1),
+            ("2100m", 2.1),
+            ("99", 99.0),
+            ("1Gi", 1024.0**3),
+            ("25Gi", 25 * 1024.0**3),
+            ("128500Mi", 128500 * 1024.0**2),
+            ("50", 50.0),
+            ("16300m", 16.3),
+            ("1.5", 1.5),
+            ("100k", 100_000.0),
+            ("2M", 2_000_000.0),
+            ("1e3", 1000.0),
+            ("500u", 0.0005),
+            ("-2", -2.0),
+        ],
+    )
+    def test_values(self, text, expected_float):
+        assert parse_quantity(text).to_float() == pytest.approx(expected_float)
+
+    def test_rejects_garbage(self):
+        for bad in ["", "abc", "1..2", "1Qi", "--1"]:
+            with pytest.raises(ValueError):
+                parse_quantity(bad)
+
+
+class TestCanonicalFormat:
+    """Golden strings from the reference MP suite
+    (pkg/controllers/metricsproducer/v1alpha1/suite_test.go:101-105)."""
+
+    def test_cpu_millis_sum(self):
+        total = Quantity()
+        for q in ["1100m", "2100m", "3300m", "1100m"]:
+            total = total.add(parse_quantity(q))
+        assert str(total) == "7600m"
+
+    def test_cpu_capacity_sum(self):
+        total = Quantity()
+        for _ in range(3):
+            total = total.add(parse_quantity("16300m"))
+        assert str(total) == "48900m"
+
+    def test_memory_binary_sum(self):
+        total = Quantity()
+        for q in ["1Gi", "25Gi", "50Gi", "1Gi"]:
+            total = total.add(parse_quantity(q))
+        assert str(total) == "77Gi"
+
+    def test_memory_capacity_stays_mi(self):
+        total = Quantity()
+        for _ in range(3):
+            total = total.add(parse_quantity("128500Mi"))
+        assert str(total) == "385500Mi"
+
+    def test_pods_plain(self):
+        total = Quantity()
+        for _ in range(4):
+            total = total.add(parse_quantity("1"))
+        assert str(total) == "4"
+
+    def test_zero(self):
+        assert str(Quantity()) == "0"
+
+    def test_integer_millis_collapse(self):
+        # 2000m == 2: canonical form drops to the base unit
+        assert str(parse_quantity("2000m")) == "2"
+
+    def test_binary_promotes(self):
+        total = parse_quantity("512Mi").add(parse_quantity("512Mi"))
+        assert str(total) == "1Gi"
+
+    def test_zero_adopts_format_of_first_operand(self):
+        assert str(Quantity().add(parse_quantity("1Gi"))) == "1Gi"
+        assert str(Quantity().add(parse_quantity("1100m"))) == "1100m"
+
+    def test_nonzero_keeps_own_format(self):
+        # a decimal accumulator that already has value keeps decimal format
+        total = parse_quantity("1").add(parse_quantity("1Gi"))
+        assert str(total) == "1073741825"
+
+
+class TestArithmetic:
+    def test_milli_rounding(self):
+        assert parse_quantity("1100m").milli() == 1100
+        assert parse_quantity("1").milli() == 1000
+        assert parse_quantity("1n").milli() == 1  # rounds up
+
+    def test_comparison(self):
+        assert parse_quantity("500m") < parse_quantity("1")
+        assert parse_quantity("1Gi") <= parse_quantity("1024Mi")
+        assert parse_quantity("1Gi") == parse_quantity("1024Mi")
