@@ -1,0 +1,31 @@
+"""Autoscaling algorithms (reference: pkg/autoscaler/algorithms/algorithm.go:24-40).
+
+The Algorithm seam is where the reference intended pluggable decision
+backends; in the TPU build the default backend is the batched device kernel
+(karpenter_tpu.ops.decision) and the scalar Proportional here serves as the
+per-object fallback and the golden oracle for kernel tests.
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+from karpenter_tpu.autoscaler.algorithms.proportional import Proportional
+
+
+@dataclass
+class Metric:
+    """Observed value + target (reference: algorithm.go:29-34)."""
+
+    value: float = 0.0
+    target_type: str = ""
+    target_value: float = 0.0
+    name: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+
+
+def for_spec(spec) -> Proportional:
+    """reference: algorithm.go:36-40 (hardcoded Proportional for now)."""
+    return Proportional()
+
+
+__all__ = ["Metric", "Proportional", "for_spec"]

@@ -1,0 +1,7 @@
+"""Device kernels: the compute-heavy paths of the framework, as XLA programs.
+
+The reference has no native/CUDA components (SURVEY.md §2) — its hot math is
+scalar Go. The TPU build's obligation is that every hot path (HPA decision
+math, reserved-capacity aggregation, pending-pods bin-packing) runs as
+batched, jitted array programs instead of host loops.
+"""

@@ -1,0 +1,191 @@
+"""Batched HorizontalAutoscaler decision kernel.
+
+The reference computes one scalar decision per autoscaler per 10s tick
+(reference: pkg/autoscaler/autoscaler.go:144-194 calling
+pkg/autoscaler/algorithms/proportional.go:30-47 and the behavior logic in
+pkg/apis/autoscaling/v1alpha1/horizontalautoscaler.go:226-275). Here the
+same semantics run as ONE jitted array program over all N autoscalers ×
+M metrics at once:
+
+    recommendation -> select policy (Max/Min/Disabled by direction)
+                   -> stabilization window mask
+                   -> [min, max] clamp + condition flags
+
+Design notes (TPU):
+- everything is fixed-shape f32/i32 tensors; ragged metric lists are padded
+  and masked with metric_valid, so one compiled program serves any fleet
+  size up to the padded bucket (no per-object recompiles, no host loop).
+- time stays on the host: last_scale_time/now enter as f32 seconds relative
+  to a host-chosen epoch (SURVEY.md §7 hard part (e)).
+- ceil() is computed with a 1e-5 guard band so f32 rounding cannot round an
+  exactly-representable f64 quotient across an integer boundary (the Go
+  implementation computes in f64).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# target types (order matters: encoded into int arrays)
+TYPE_VALUE = 0
+TYPE_AVERAGE_VALUE = 1
+TYPE_UTILIZATION = 2
+TYPE_UNKNOWN = 3
+
+# select policies
+POLICY_MAX = 0
+POLICY_MIN = 1
+POLICY_DISABLED = 2
+
+_CEIL_GUARD = 1e-5
+
+# f32 saturation bounds for the final int32 cast. 2**31-1 is NOT exactly
+# representable in f32 (rounds up to 2**31, which fptosi wraps to INT32_MIN),
+# so saturate at 2**31-128 = 2^7*(2^24-1), the largest f32-exact value below
+# the int32 ceiling.
+_I32_SAFE_MAX = float(2**31 - 128)
+_I32_SAFE_MIN = float(-(2**31))  # exact power of two, representable
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class DecisionInputs:
+    """Structure-of-arrays snapshot of every HorizontalAutoscaler."""
+
+    metric_value: jax.Array  # f32[N, M]
+    target_value: jax.Array  # f32[N, M]
+    target_type: jax.Array  # i32[N, M]
+    metric_valid: jax.Array  # bool[N, M]
+    spec_replicas: jax.Array  # i32[N]  scale target .spec.replicas
+    status_replicas: jax.Array  # i32[N]  scale target .status.replicas
+    min_replicas: jax.Array  # i32[N]
+    max_replicas: jax.Array  # i32[N]
+    up_window: jax.Array  # i32[N] stabilization seconds (default 0)
+    down_window: jax.Array  # i32[N] stabilization seconds (default 300)
+    up_policy: jax.Array  # i32[N]
+    down_policy: jax.Array  # i32[N]
+    last_scale_time: jax.Array  # f32[N] seconds since epoch0
+    has_last_scale: jax.Array  # bool[N]
+    now: jax.Array  # f32 scalar, seconds since epoch0
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class DecisionOutputs:
+    desired: jax.Array  # i32[N] final bounded decision
+    recommendation: jax.Array  # i32[N] post-select, pre-limit
+    able_to_scale: jax.Array  # bool[N] False iff within stabilization window
+    scaling_unbounded: jax.Array  # bool[N] False iff clamped by [min, max]
+    able_at: jax.Array  # f32[N] window end time (valid when !able_to_scale)
+
+
+def _ceil_guarded(x: jax.Array) -> jax.Array:
+    return jnp.ceil(x - _CEIL_GUARD)
+
+
+def _recommendations(inputs: DecisionInputs) -> jax.Array:
+    """Per-metric desired replicas, f32[N, M] (reference: proportional.go:30-47)."""
+    # zero target: ratio collapses to 0, matching the scalar oracle
+    # (algorithms/proportional.py) — float division by zero never reaches XLA
+    safe_target = jnp.where(inputs.target_value != 0, inputs.target_value, 1.0)
+    ratio = jnp.where(
+        inputs.target_value != 0, inputs.metric_value / safe_target, 0.0
+    )
+    status = inputs.status_replicas[:, None].astype(jnp.float32)
+    proportional = status * ratio
+
+    by_value = jnp.maximum(1.0, _ceil_guarded(proportional))
+    by_average = _ceil_guarded(ratio)
+    by_utilization = jnp.maximum(1.0, _ceil_guarded(proportional * 100.0))
+    fallback = status  # unknown target type keeps current replicas
+
+    rec = jnp.select(
+        [
+            inputs.target_type == TYPE_VALUE,
+            inputs.target_type == TYPE_AVERAGE_VALUE,
+            inputs.target_type == TYPE_UTILIZATION,
+        ],
+        [by_value, by_average, by_utilization],
+        fallback,
+    )
+    return rec
+
+
+def decide(inputs: DecisionInputs) -> DecisionOutputs:
+    """The full decision pipeline (reference: autoscaler.go:144-194)."""
+    rec = _recommendations(inputs)  # f32[N, M]
+    valid = inputs.metric_valid
+    spec = inputs.spec_replicas.astype(jnp.float32)  # [N]
+
+    # --- select policy (reference: horizontalautoscaler.go:226-247) -------
+    any_valid = jnp.any(valid, axis=1)
+    any_up = jnp.any(valid & (rec > spec[:, None]), axis=1)
+    any_down = jnp.any(valid & (rec < spec[:, None]), axis=1)
+    # direction picks which rules apply; no movement (or no metrics) disables
+    policy = jnp.where(
+        any_up,
+        inputs.up_policy,
+        jnp.where(any_down, inputs.down_policy, POLICY_DISABLED),
+    )
+    neg_inf = jnp.float32(np.finfo(np.float32).min)
+    pos_inf = jnp.float32(np.finfo(np.float32).max)
+    rec_max = jnp.max(jnp.where(valid, rec, neg_inf), axis=1)
+    rec_min = jnp.min(jnp.where(valid, rec, pos_inf), axis=1)
+    selected = jnp.select(
+        [policy == POLICY_MAX, policy == POLICY_MIN],
+        [rec_max, rec_min],
+        spec,
+    )
+    selected = jnp.where(any_valid, selected, spec)
+
+    # --- transient limits: stabilization window (autoscaler.go:172-194) ---
+    going_up = selected > spec
+    going_down = selected < spec
+    window = jnp.where(
+        going_up,
+        inputs.up_window,
+        jnp.where(going_down, inputs.down_window, 0),
+    ).astype(jnp.float32)
+    elapsed = inputs.now - inputs.last_scale_time
+    moving = going_up | going_down
+    within = (
+        moving & inputs.has_last_scale & (elapsed < window)
+    )
+    able_to_scale = ~within
+    able_at = inputs.last_scale_time + window
+    limited = jnp.where(within, spec, selected)
+
+    # --- bounded limits: [min, max] clamp (autoscaler.go:155-170) ---------
+    bounded = jnp.clip(
+        limited,
+        inputs.min_replicas.astype(jnp.float32),
+        inputs.max_replicas.astype(jnp.float32),
+    )
+    scaling_unbounded = bounded == limited
+
+    to_i32 = lambda x: jnp.clip(
+        x, jnp.float32(_I32_SAFE_MIN), jnp.float32(_I32_SAFE_MAX)
+    ).astype(jnp.int32)
+    return DecisionOutputs(
+        desired=to_i32(bounded),
+        recommendation=to_i32(selected),
+        able_to_scale=able_to_scale,
+        scaling_unbounded=scaling_unbounded,
+        able_at=able_at,
+    )
+
+
+decide_jit = jax.jit(decide)
+
+
+def pad_to(n: int, bucket: int = 64) -> int:
+    """Round a fleet size up to a compile bucket so recompiles only happen on
+    bucket crossings, not every added autoscaler."""
+    if n <= 0:
+        return bucket
+    return ((n + bucket - 1) // bucket) * bucket

@@ -1,0 +1,9 @@
+from karpenter_tpu.store.store import (
+    ConflictError,
+    NotFoundError,
+    Scale,
+    Store,
+    register_scale_kind,
+)
+
+__all__ = ["Store", "Scale", "NotFoundError", "ConflictError", "register_scale_kind"]

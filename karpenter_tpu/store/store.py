@@ -1,0 +1,263 @@
+"""In-memory watchable object store — the coordination bus.
+
+The reference's controllers never talk to each other in memory: all
+cross-controller communication rides kube-apiserver CRD spec/status and the
+scale subresource (reference: SURVEY.md §2.2; pkg/autoscaler/autoscaler.go:196-221).
+This store is the TPU build's equivalent bus: namespaced objects keyed by
+(kind, namespace, name) with resourceVersions, deep-copy isolation on every
+read/write (nothing shares mutable state through the store), watch callbacks,
+a pod spec.nodeName index (reference: pkg/controllers/manager.go:73-79), and
+a pluggable scale subresource so any HorizontalAutoscaler can target any
+registered scalable kind (reference: scalablenodegroup.go:51).
+
+Durability mirrors the reference's checkpoint/resume story (SURVEY.md §5):
+ALL durable state lives in object spec/status here; controllers and the
+device solver are stateless and resume by re-listing.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+ADDED = "Added"
+MODIFIED = "Modified"
+DELETED = "Deleted"
+
+
+class NotFoundError(KeyError):
+    pass
+
+
+class ConflictError(RuntimeError):
+    pass
+
+
+@dataclass
+class Scale:
+    """The scale-subresource view (k8s autoscaling/v1 Scale analog)."""
+
+    namespace: str
+    name: str
+    spec_replicas: Optional[int]
+    status_replicas: int
+
+
+@dataclass
+class _ScaleHooks:
+    get_spec: Callable
+    set_spec: Callable
+    get_status: Callable
+
+
+_scale_kinds: Dict[str, _ScaleHooks] = {}
+
+
+def register_scale_kind(kind: str, get_spec, set_spec, get_status) -> None:
+    """Register a kind as implementing the scale subresource."""
+    _scale_kinds[kind] = _ScaleHooks(get_spec, set_spec, get_status)
+
+
+def _kind_of(obj) -> str:
+    return getattr(obj, "KIND", type(obj).__name__)
+
+
+def _key(obj) -> Tuple[str, str, str]:
+    return (_kind_of(obj), obj.metadata.namespace, obj.metadata.name)
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._objects: Dict[Tuple[str, str, str], object] = {}
+        self._rv = 0
+        self._watchers: List[Tuple[Optional[str], Callable]] = []
+        # spec.nodeName index for Pods
+        self._pods_by_node: Dict[str, set] = {}
+
+    # -- watch ------------------------------------------------------------
+
+    def watch(self, kind: Optional[str], callback: Callable) -> None:
+        """Subscribe to mutation events. kind=None watches everything.
+        callback(event_type, obj_copy) is invoked synchronously."""
+        with self._lock:
+            self._watchers.append((kind, callback))
+
+    def _notify(self, event: str, obj) -> None:
+        kind = _kind_of(obj)
+        for want_kind, callback in list(self._watchers):
+            if want_kind is None or want_kind == kind:
+                callback(event, copy.deepcopy(obj))
+
+    # -- index maintenance ------------------------------------------------
+
+    def _index_add(self, obj) -> None:
+        if _kind_of(obj) == "Pod" and obj.spec.node_name:
+            self._pods_by_node.setdefault(obj.spec.node_name, set()).add(_key(obj))
+
+    def _index_remove(self, obj) -> None:
+        if _kind_of(obj) == "Pod" and obj.spec.node_name:
+            keys = self._pods_by_node.get(obj.spec.node_name)
+            if keys is not None:
+                keys.discard(_key(obj))
+                if not keys:
+                    del self._pods_by_node[obj.spec.node_name]
+
+    # -- CRUD -------------------------------------------------------------
+
+    def create(self, obj):
+        with self._lock:
+            key = _key(obj)
+            if key in self._objects:
+                raise ConflictError(f"{key} already exists")
+            obj = copy.deepcopy(obj)
+            obj.metadata.ensure_identity()
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            self._objects[key] = obj
+            self._index_add(obj)
+            self._notify(ADDED, obj)
+            return copy.deepcopy(obj)
+
+    def get(self, kind: str, namespace: str, name: str):
+        with self._lock:
+            obj = self._objects.get((kind, namespace, name))
+            if obj is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            return copy.deepcopy(obj)
+
+    def try_get(self, kind: str, namespace: str, name: str):
+        try:
+            return self.get(kind, namespace, name)
+        except NotFoundError:
+            return None
+
+    def update(self, obj):
+        """Replace spec+metadata+status wholesale (like an apiserver UPDATE)."""
+        with self._lock:
+            key = _key(obj)
+            stored = self._objects.get(key)
+            if stored is None:
+                raise NotFoundError(f"{key} not found")
+            self._index_remove(stored)
+            obj = copy.deepcopy(obj)
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            obj.metadata.uid = stored.metadata.uid
+            obj.metadata.creation_timestamp = stored.metadata.creation_timestamp
+            self._objects[key] = obj
+            self._index_add(obj)
+            self._notify(MODIFIED, obj)
+            return copy.deepcopy(obj)
+
+    def patch_status(self, obj):
+        """Merge-patch ONLY the status subtree onto the stored object,
+        mirroring the reference's Status().Patch(MergeFrom(persisted))
+        (reference: pkg/controllers/controller.go:93) — concurrent spec
+        writes are never clobbered by a status update."""
+        with self._lock:
+            key = _key(obj)
+            stored = self._objects.get(key)
+            if stored is None:
+                raise NotFoundError(f"{key} not found")
+            stored.status = copy.deepcopy(obj.status)
+            self._rv += 1
+            stored.metadata.resource_version = self._rv
+            self._notify(MODIFIED, stored)
+            return copy.deepcopy(stored)
+
+    def delete(self, obj_or_kind, namespace: Optional[str] = None, name=None):
+        with self._lock:
+            if isinstance(obj_or_kind, str):
+                key = (obj_or_kind, namespace, name)
+            else:
+                key = _key(obj_or_kind)
+            stored = self._objects.pop(key, None)
+            if stored is None:
+                raise NotFoundError(f"{key} not found")
+            self._index_remove(stored)
+            self._notify(DELETED, stored)
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> list:
+        with self._lock:
+            out = []
+            for (k, ns, _), obj in self._objects.items():
+                if k != kind:
+                    continue
+                if namespace is not None and ns != namespace:
+                    continue
+                if label_selector is not None and not all(
+                    obj.metadata.labels.get(lk) == lv
+                    for lk, lv in label_selector.items()
+                ):
+                    continue
+                out.append(copy.deepcopy(obj))
+            return out
+
+    def pods_on_node(self, node_name: str) -> list:
+        """Pods indexed by spec.nodeName (reference: manager.go:54-55,73-79)."""
+        with self._lock:
+            return [
+                copy.deepcopy(self._objects[key])
+                for key in sorted(self._pods_by_node.get(node_name, set()))
+                if key in self._objects
+            ]
+
+    # -- scale subresource -------------------------------------------------
+
+    def get_scale(self, kind: str, namespace: str, name: str) -> Scale:
+        hooks = _scale_kinds.get(kind)
+        if hooks is None:
+            raise NotFoundError(f"kind {kind} does not implement scale")
+        with self._lock:
+            obj = self._objects.get((kind, namespace, name))
+            if obj is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            status = hooks.get_status(obj)
+            return Scale(
+                namespace=namespace,
+                name=name,
+                spec_replicas=hooks.get_spec(obj),
+                status_replicas=int(status) if status is not None else 0,
+            )
+
+    def update_scale(self, kind: str, scale: Scale) -> None:
+        hooks = _scale_kinds.get(kind)
+        if hooks is None:
+            raise NotFoundError(f"kind {kind} does not implement scale")
+        with self._lock:
+            obj = self._objects.get((kind, scale.namespace, scale.name))
+            if obj is None:
+                raise NotFoundError(
+                    f"{kind} {scale.namespace}/{scale.name} not found"
+                )
+            hooks.set_spec(obj, scale.spec_replicas)
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            self._notify(MODIFIED, obj)
+
+
+def _register_builtin_scale_kinds():
+    """ScalableNodeGroup implements scale at .spec.replicas/.status.replicas
+    (reference: scalablenodegroup.go:51 kubebuilder scale marker)."""
+
+    def get_spec(sng):
+        return sng.spec.replicas
+
+    def set_spec(sng, replicas):
+        sng.spec.replicas = replicas
+
+    def get_status(sng):
+        return sng.status.replicas
+
+    register_scale_kind("ScalableNodeGroup", get_spec, set_spec, get_status)
+
+
+_register_builtin_scale_kinds()

@@ -1,18 +1,29 @@
-"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+"""Test harness: force an 8-device virtual CPU mesh before any backend init.
 
 Real-TPU runs (bench.py, the driver) use the real backend; tests exercise
 multi-chip sharding logic on virtual CPU devices per the build environment's
 contract.
+
+Environment gotcha: this container's sitecustomize (axon) imports jax at
+interpreter startup with JAX_PLATFORMS=axon, so mutating os.environ here is
+too late for backend selection — and initializing the axon PJRT client from
+a test process hangs. jax.config.update('jax_platforms', ...) before the
+first backend init is the reliable switch; XLA_FLAGS is still read lazily at
+CPU client creation, so setting it here works.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,359 @@
+"""Decision kernel golden + property tests.
+
+Golden cases come from the reference's table tests
+(pkg/autoscaler/algorithms/proportional_test.go:26-140) and suite
+expectations (horizontalautoscaler/v1alpha1/suite_test.go:94-118). The
+property test runs the full batched kernel against the scalar host pipeline
+(api.Behavior + algorithms.Proportional), which mirrors
+pkg/autoscaler/autoscaler.go:144-194 step by step.
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api.horizontalautoscaler import (
+    AVERAGE_VALUE,
+    Behavior,
+    ScalingRules,
+    UTILIZATION,
+    VALUE,
+)
+from karpenter_tpu.autoscaler.algorithms import Metric, Proportional
+from karpenter_tpu.ops import decision as D
+
+
+def make_inputs(
+    metric_value,
+    target_value,
+    target_type,
+    metric_valid,
+    spec_replicas,
+    status_replicas,
+    min_replicas,
+    max_replicas,
+    up_window=None,
+    down_window=None,
+    up_policy=None,
+    down_policy=None,
+    last_scale_time=None,
+    has_last_scale=None,
+    now=0.0,
+):
+    import jax.numpy as jnp
+
+    n = len(spec_replicas)
+    default = lambda v, fill: np.asarray(v if v is not None else [fill] * n)
+    return D.DecisionInputs(
+        metric_value=jnp.asarray(np.asarray(metric_value, np.float32)),
+        target_value=jnp.asarray(np.asarray(target_value, np.float32)),
+        target_type=jnp.asarray(np.asarray(target_type, np.int32)),
+        metric_valid=jnp.asarray(np.asarray(metric_valid, bool)),
+        spec_replicas=jnp.asarray(np.asarray(spec_replicas, np.int32)),
+        status_replicas=jnp.asarray(np.asarray(status_replicas, np.int32)),
+        min_replicas=jnp.asarray(np.asarray(min_replicas, np.int32)),
+        max_replicas=jnp.asarray(np.asarray(max_replicas, np.int32)),
+        up_window=jnp.asarray(default(up_window, 0).astype(np.int32)),
+        down_window=jnp.asarray(default(down_window, 300).astype(np.int32)),
+        up_policy=jnp.asarray(default(up_policy, D.POLICY_MAX).astype(np.int32)),
+        down_policy=jnp.asarray(default(down_policy, D.POLICY_MAX).astype(np.int32)),
+        last_scale_time=jnp.asarray(default(last_scale_time, 0.0).astype(np.float32)),
+        has_last_scale=jnp.asarray(default(has_last_scale, False).astype(bool)),
+        now=jnp.float32(now),
+    )
+
+
+def single(metric_value, target_value, target_type, status_replicas, **kw):
+    """One autoscaler, one metric, unbounded, no stabilization history."""
+    defaults = dict(
+        spec_replicas=[kw.pop("spec_replicas", status_replicas)],
+        status_replicas=[status_replicas],
+        min_replicas=[kw.pop("min_replicas", -(2**31))],
+        max_replicas=[kw.pop("max_replicas", 2**31 - 1)],
+    )
+    return make_inputs(
+        metric_value=[[metric_value]],
+        target_value=[[target_value]],
+        target_type=[[target_type]],
+        metric_valid=[[True]],
+        **defaults,
+        **kw,
+    )
+
+
+class TestProportionalGolden:
+    """reference: proportional_test.go:26-140 — both the scalar oracle and
+    the device kernel must reproduce all seven cases."""
+
+    CASES = [
+        # (target_type_str, type_code, target, value, replicas, want)
+        (VALUE, D.TYPE_VALUE, 3, 50, 8, 134),
+        (VALUE, D.TYPE_VALUE, 3, 50, 0, 1),
+        (AVERAGE_VALUE, D.TYPE_AVERAGE_VALUE, 50, 304, 1, 7),
+        (AVERAGE_VALUE, D.TYPE_AVERAGE_VALUE, 50, 304, 0, 7),
+        (UTILIZATION, D.TYPE_UTILIZATION, 50, 0.6, 2, 3),
+        (UTILIZATION, D.TYPE_UTILIZATION, 50, 0.6, 0, 1),
+        ("", D.TYPE_UNKNOWN, 0, 0, 50, 50),
+    ]
+
+    @pytest.mark.parametrize("type_str,code,target,value,replicas,want", CASES)
+    def test_scalar_oracle(self, type_str, code, target, value, replicas, want):
+        got = Proportional().get_desired_replicas(
+            Metric(value=value, target_type=type_str, target_value=target), replicas
+        )
+        assert got == want
+
+    @pytest.mark.parametrize("type_str,code,target,value,replicas,want", CASES)
+    def test_device_kernel(self, type_str, code, target, value, replicas, want):
+        out = D.decide_jit(single(value, target, code, replicas))
+        assert int(out.recommendation[0]) == want
+
+
+class TestSuiteGolden:
+    """reference: horizontalautoscaler/v1alpha1/suite_test.go:94-118"""
+
+    def test_utilization_85_over_60_with_5_replicas_wants_8(self):
+        out = D.decide_jit(
+            single(0.85, 60, D.TYPE_UTILIZATION, 5, min_replicas=3, max_replicas=23)
+        )
+        assert int(out.desired[0]) == 8
+        assert bool(out.able_to_scale[0])
+        assert bool(out.scaling_unbounded[0])
+
+    def test_queue_41_target_4_average_value_wants_11(self):
+        out = D.decide_jit(
+            single(41, 4, D.TYPE_AVERAGE_VALUE, 1, min_replicas=0, max_replicas=1000)
+        )
+        assert int(out.desired[0]) == 11
+
+
+class TestLimits:
+    def test_max_clamp_marks_bounded(self):
+        out = D.decide_jit(
+            single(10, 1, D.TYPE_AVERAGE_VALUE, 1, min_replicas=0, max_replicas=5)
+        )
+        assert int(out.desired[0]) == 5
+        assert not bool(out.scaling_unbounded[0])
+
+    def test_min_clamp(self):
+        out = D.decide_jit(
+            single(0, 4, D.TYPE_AVERAGE_VALUE, 5, min_replicas=2, max_replicas=10)
+        )
+        assert int(out.desired[0]) == 2
+        assert not bool(out.scaling_unbounded[0])
+
+    def test_stabilization_window_blocks_scale_down(self):
+        out = D.decide_jit(
+            single(
+                1,
+                4,
+                D.TYPE_AVERAGE_VALUE,
+                5,
+                min_replicas=0,
+                max_replicas=10,
+                last_scale_time=[100.0],
+                has_last_scale=[True],
+                now=200.0,  # 100s since last scale < 300s window
+            )
+        )
+        assert int(out.desired[0]) == 5  # held at current
+        assert not bool(out.able_to_scale[0])
+        assert float(out.able_at[0]) == 400.0
+
+    def test_scale_up_not_blocked_by_down_window(self):
+        out = D.decide_jit(
+            single(
+                10,
+                1,
+                D.TYPE_AVERAGE_VALUE,
+                5,
+                min_replicas=0,
+                max_replicas=100,
+                last_scale_time=[100.0],
+                has_last_scale=[True],
+                now=101.0,
+            )
+        )
+        assert int(out.desired[0]) == 10
+        assert bool(out.able_to_scale[0])
+
+    def test_expired_window_allows_scale_down(self):
+        out = D.decide_jit(
+            single(
+                1,
+                4,
+                D.TYPE_AVERAGE_VALUE,
+                5,
+                min_replicas=0,
+                max_replicas=10,
+                last_scale_time=[100.0],
+                has_last_scale=[True],
+                now=401.0,
+            )
+        )
+        assert int(out.desired[0]) == 1
+        assert bool(out.able_to_scale[0])
+
+    def test_no_metrics_disabled(self):
+        inputs = make_inputs(
+            metric_value=[[0.0]],
+            target_value=[[0.0]],
+            target_type=[[D.TYPE_VALUE]],
+            metric_valid=[[False]],
+            spec_replicas=[7],
+            status_replicas=[7],
+            min_replicas=[0],
+            max_replicas=[100],
+        )
+        out = D.decide_jit(inputs)
+        assert int(out.desired[0]) == 7
+
+    def test_min_policy_select(self):
+        inputs = make_inputs(
+            metric_value=[[10.0, 20.0]],
+            target_value=[[1.0, 1.0]],
+            target_type=[[D.TYPE_AVERAGE_VALUE, D.TYPE_AVERAGE_VALUE]],
+            metric_valid=[[True, True]],
+            spec_replicas=[5],
+            status_replicas=[5],
+            min_replicas=[0],
+            max_replicas=[100],
+            up_policy=[D.POLICY_MIN],
+        )
+        out = D.decide_jit(inputs)
+        assert int(out.desired[0]) == 10
+
+    def test_zero_target_matches_scalar_oracle(self):
+        # oracle: ratio collapses to 0 -> Value type floors at 1
+        out = D.decide_jit(
+            single(50, 0, D.TYPE_VALUE, 8, min_replicas=0, max_replicas=1000)
+        )
+        want = Proportional().get_desired_replicas(
+            Metric(value=50, target_type=VALUE, target_value=0), 8
+        )
+        assert int(out.recommendation[0]) == want == 1
+
+    def test_huge_recommendation_saturates_not_wraps(self):
+        out = D.decide_jit(
+            single(3e9, 1, D.TYPE_AVERAGE_VALUE, 1, min_replicas=0, max_replicas=2**31 - 1)
+        )
+        assert int(out.desired[0]) > 0  # must not wrap to INT32_MIN
+        assert int(out.recommendation[0]) > 0
+
+    def test_disabled_policy_keeps_replicas(self):
+        inputs = make_inputs(
+            metric_value=[[10.0]],
+            target_value=[[1.0]],
+            target_type=[[D.TYPE_AVERAGE_VALUE]],
+            metric_valid=[[True]],
+            spec_replicas=[5],
+            status_replicas=[5],
+            min_replicas=[0],
+            max_replicas=[100],
+            up_policy=[D.POLICY_DISABLED],
+        )
+        out = D.decide_jit(inputs)
+        assert int(out.desired[0]) == 5
+
+
+def scalar_pipeline(
+    values,
+    targets,
+    types,
+    spec_replicas,
+    status_replicas,
+    min_replicas,
+    max_replicas,
+    behavior,
+    last_scale_time,
+    now,
+):
+    """Host mirror of autoscaler.go:144-194 used as the oracle."""
+    algorithm = Proportional()
+    recs = [
+        algorithm.get_desired_replicas(
+            Metric(value=v, target_type=t, target_value=tv), status_replicas
+        )
+        for v, tv, t in zip(values, targets, types)
+    ]
+    if recs:
+        recommendation = behavior.apply_select_policy(spec_replicas, recs)
+    else:
+        recommendation = spec_replicas
+    rules = behavior.get_scaling_rules(spec_replicas, [recommendation])
+    if rules.within_stabilization_window(last_scale_time, now=now):
+        limited = spec_replicas
+    else:
+        limited = recommendation
+    return int(min(max(limited, min_replicas), max_replicas))
+
+
+class TestPropertyVsOracle:
+    def test_random_fleet_matches_scalar_pipeline(self):
+        rng = np.random.default_rng(42)
+        n, m = 256, 3
+        type_strs = np.array([VALUE, AVERAGE_VALUE, UTILIZATION, ""])
+        type_codes = {
+            VALUE: D.TYPE_VALUE,
+            AVERAGE_VALUE: D.TYPE_AVERAGE_VALUE,
+            UTILIZATION: D.TYPE_UTILIZATION,
+            "": D.TYPE_UNKNOWN,
+        }
+        values = rng.choice([0.0, 0.25, 0.85, 1.0, 3.0, 41.0, 304.0, 1000.0], (n, m))
+        targets = rng.choice([0.0, 1.0, 3.0, 4.0, 50.0, 60.0, 100.0], (n, m))
+        types = rng.choice(type_strs, (n, m))
+        valid = rng.random((n, m)) > 0.25
+        spec = rng.integers(0, 50, n)
+        status = rng.integers(0, 50, n)
+        mins = rng.integers(0, 10, n)
+        maxs = mins + rng.integers(0, 100, n)
+        has_last = rng.random(n) > 0.5
+        last = rng.uniform(0, 1000, n).astype(np.float32)
+        now = np.float32(1000.0)
+        down_window = rng.choice([0, 60, 300], n)
+        up_window = rng.choice([0, 60], n)
+
+        inputs = make_inputs(
+            metric_value=values,
+            target_value=targets,
+            target_type=np.vectorize(type_codes.get)(types),
+            metric_valid=valid,
+            spec_replicas=spec,
+            status_replicas=status,
+            min_replicas=mins,
+            max_replicas=maxs,
+            up_window=up_window,
+            down_window=down_window,
+            last_scale_time=last,
+            has_last_scale=has_last,
+            now=now,
+        )
+        out = D.decide_jit(inputs)
+
+        for i in range(n):
+            behavior = Behavior(
+                scale_up=ScalingRules(stabilization_window_seconds=int(up_window[i])),
+                scale_down=ScalingRules(
+                    stabilization_window_seconds=int(down_window[i])
+                ),
+            )
+            vals = [values[i][j] for j in range(m) if valid[i][j]]
+            tgts = [targets[i][j] for j in range(m) if valid[i][j]]
+            tps = [types[i][j] for j in range(m) if valid[i][j]]
+            want = scalar_pipeline(
+                vals,
+                tgts,
+                tps,
+                int(spec[i]),
+                int(status[i]),
+                int(mins[i]),
+                int(maxs[i]),
+                behavior,
+                float(last[i]) if has_last[i] else None,
+                float(now),
+            )
+            assert int(out.desired[i]) == want, (
+                f"row {i}: kernel={int(out.desired[i])} oracle={want} "
+                f"vals={vals} tgts={tgts} tps={tps} spec={spec[i]} "
+                f"status={status[i]} bounds=[{mins[i]},{maxs[i]}] "
+                f"last={last[i] if has_last[i] else None}"
+            )

@@ -1,0 +1,169 @@
+"""Object store semantics: CRUD isolation, watches, indexes, scale subresource."""
+
+import pytest
+
+from karpenter_tpu.api import HorizontalAutoscaler, Node, Pod, ScalableNodeGroup
+from karpenter_tpu.api.core import ObjectMeta, PodSpec
+from karpenter_tpu.api.scalablenodegroup import ScalableNodeGroupSpec
+from karpenter_tpu.store import ConflictError, NotFoundError, Scale, Store
+
+
+def sng(name="group", namespace="default", replicas=None):
+    return ScalableNodeGroup(
+        metadata=ObjectMeta(name=name, namespace=namespace),
+        spec=ScalableNodeGroupSpec(replicas=replicas, type="FakeNodeGroup", id=name),
+    )
+
+
+class TestCrud:
+    def test_create_get_roundtrip(self):
+        store = Store()
+        created = store.create(sng(replicas=3))
+        assert created.metadata.resource_version == 1
+        assert created.metadata.uid
+        got = store.get("ScalableNodeGroup", "default", "group")
+        assert got.spec.replicas == 3
+
+    def test_deepcopy_isolation(self):
+        store = Store()
+        obj = sng(replicas=3)
+        store.create(obj)
+        obj.spec.replicas = 99  # caller's mutation must not leak in
+        assert store.get("ScalableNodeGroup", "default", "group").spec.replicas == 3
+        got = store.get("ScalableNodeGroup", "default", "group")
+        got.spec.replicas = 77  # reader's mutation must not leak in
+        assert store.get("ScalableNodeGroup", "default", "group").spec.replicas == 3
+
+    def test_create_conflict(self):
+        store = Store()
+        store.create(sng())
+        with pytest.raises(ConflictError):
+            store.create(sng())
+
+    def test_get_missing(self):
+        store = Store()
+        with pytest.raises(NotFoundError):
+            store.get("ScalableNodeGroup", "default", "nope")
+        assert store.try_get("ScalableNodeGroup", "default", "nope") is None
+
+    def test_update_bumps_rv_preserves_identity(self):
+        store = Store()
+        created = store.create(sng(replicas=1))
+        created.spec.replicas = 5
+        updated = store.update(created)
+        assert updated.spec.replicas == 5
+        assert updated.metadata.uid == created.metadata.uid
+        assert updated.metadata.resource_version > created.metadata.resource_version
+
+    def test_patch_status_does_not_clobber_spec(self):
+        store = Store()
+        stale = store.create(sng(replicas=1))
+        # another actor updates spec.replicas to 7
+        fresh = store.get("ScalableNodeGroup", "default", "group")
+        fresh.spec.replicas = 7
+        store.update(fresh)
+        # status patch from the stale copy must keep the new spec
+        stale.status.replicas = 1
+        store.patch_status(stale)
+        after = store.get("ScalableNodeGroup", "default", "group")
+        assert after.spec.replicas == 7
+        assert after.status.replicas == 1
+
+    def test_delete(self):
+        store = Store()
+        store.create(sng())
+        store.delete("ScalableNodeGroup", "default", "group")
+        with pytest.raises(NotFoundError):
+            store.get("ScalableNodeGroup", "default", "group")
+
+    def test_list_filters(self):
+        store = Store()
+        n1 = Node(metadata=ObjectMeta(name="a", labels={"group": "x"}))
+        n2 = Node(metadata=ObjectMeta(name="b", labels={"group": "y"}))
+        store.create(n1)
+        store.create(n2)
+        assert len(store.list("Node")) == 2
+        assert [n.metadata.name for n in store.list("Node", label_selector={"group": "x"})] == ["a"]
+
+
+class TestPodIndex:
+    def test_pods_on_node(self):
+        store = Store()
+        store.create(Pod(metadata=ObjectMeta(name="p1"), spec=PodSpec(node_name="n1")))
+        store.create(Pod(metadata=ObjectMeta(name="p2"), spec=PodSpec(node_name="n1")))
+        store.create(Pod(metadata=ObjectMeta(name="p3"), spec=PodSpec(node_name="n2")))
+        store.create(Pod(metadata=ObjectMeta(name="pending"), spec=PodSpec()))
+        assert {p.metadata.name for p in store.pods_on_node("n1")} == {"p1", "p2"}
+        assert len(store.pods_on_node("n2")) == 1
+        store.delete("Pod", "default", "p1")
+        assert {p.metadata.name for p in store.pods_on_node("n1")} == {"p2"}
+
+    def test_index_entries_freed_on_node_drain(self):
+        store = Store()
+        pod = store.create(
+            Pod(metadata=ObjectMeta(name="p"), spec=PodSpec(node_name="n1"))
+        )
+        store.delete(pod)
+        assert "n1" not in store._pods_by_node  # no unbounded growth on churn
+
+    def test_index_follows_node_reassignment(self):
+        store = Store()
+        pod = store.create(
+            Pod(metadata=ObjectMeta(name="p"), spec=PodSpec(node_name="n1"))
+        )
+        pod.spec.node_name = "n2"
+        store.update(pod)
+        assert store.pods_on_node("n1") == []
+        assert len(store.pods_on_node("n2")) == 1
+
+
+class TestWatch:
+    def test_watch_events(self):
+        store = Store()
+        events = []
+        store.watch("ScalableNodeGroup", lambda e, o: events.append((e, o.metadata.name)))
+        obj = store.create(sng())
+        obj.spec.replicas = 2
+        store.update(obj)
+        store.delete(obj)
+        assert events == [
+            ("Added", "group"),
+            ("Modified", "group"),
+            ("Deleted", "group"),
+        ]
+
+    def test_watch_kind_filter(self):
+        store = Store()
+        events = []
+        store.watch("Node", lambda e, o: events.append(e))
+        store.create(sng())
+        assert events == []
+
+
+class TestScaleSubresource:
+    """reference: scalablenodegroup.go:51 + autoscaler.go:196-221"""
+
+    def test_get_scale(self):
+        store = Store()
+        obj = sng(replicas=4)
+        obj.status.replicas = 2
+        store.create(obj)
+        scale = store.get_scale("ScalableNodeGroup", "default", "group")
+        assert scale.spec_replicas == 4
+        assert scale.status_replicas == 2
+
+    def test_update_scale_fires_watch(self):
+        store = Store()
+        store.create(sng(replicas=1))
+        events = []
+        store.watch("ScalableNodeGroup", lambda e, o: events.append(o.spec.replicas))
+        scale = store.get_scale("ScalableNodeGroup", "default", "group")
+        scale.spec_replicas = 9
+        store.update_scale("ScalableNodeGroup", scale)
+        assert store.get("ScalableNodeGroup", "default", "group").spec.replicas == 9
+        assert events == [9]  # watch-driven actuation path
+
+    def test_unregistered_kind(self):
+        store = Store()
+        with pytest.raises(NotFoundError):
+            store.get_scale("HorizontalAutoscaler", "default", "x")
