@@ -1,0 +1,3 @@
+from karpenter_tpu.autoscaler.autoscaler import AutoscalerFactory, BatchAutoscaler
+
+__all__ = ["AutoscalerFactory", "BatchAutoscaler"]

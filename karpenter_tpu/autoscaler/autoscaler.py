@@ -1,0 +1,274 @@
+"""Host-side autoscaler orchestration around the batched decision kernel.
+
+reference: pkg/autoscaler/autoscaler.go:81-237 — per autoscaler: fetch
+metrics, fetch scale target, compute desired replicas, apply transient and
+bounded limits, update the scale target, set conditions.
+
+The TPU redesign: instead of one scalar pipeline per object per tick, the
+BatchAutoscaler snapshots EVERY HorizontalAutoscaler into structure-of-arrays
+(padded to a compile bucket) and evaluates them in ONE device call
+(ops/decision.decide_jit). Host code does only I/O: metric reads, scale
+reads/writes, condition messages. Per-object failures (bad metric, missing
+scale target) exclude that row from the batch without failing the others.
+"""
+
+from __future__ import annotations
+
+import datetime
+import time as _time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from karpenter_tpu.api import conditions as cond
+from karpenter_tpu.api.horizontalautoscaler import (
+    AVERAGE_VALUE,
+    DISABLED_POLICY_SELECT,
+    HorizontalAutoscaler,
+    MIN_POLICY_SELECT,
+    UTILIZATION,
+    VALUE,
+)
+from karpenter_tpu.ops import decision as D
+from karpenter_tpu.store import NotFoundError, Store
+
+_TYPE_CODES = {
+    VALUE: D.TYPE_VALUE,
+    AVERAGE_VALUE: D.TYPE_AVERAGE_VALUE,
+    UTILIZATION: D.TYPE_UTILIZATION,
+}
+_POLICY_CODES = {
+    None: D.POLICY_MAX,
+    "Max": D.POLICY_MAX,
+    "Min": D.POLICY_MIN,
+    "Disabled": D.POLICY_DISABLED,
+}
+
+
+@dataclass
+class _Row:
+    ha: HorizontalAutoscaler
+    scale: object
+    values: List[float]
+    targets: List[float]
+    types: List[int]
+    error: Optional[Exception] = None
+
+
+class BatchAutoscaler:
+    """Evaluates all HorizontalAutoscalers as one device call per tick."""
+
+    def __init__(self, metrics_client_factory, store: Store, clock=_time.time):
+        self.metrics = metrics_client_factory
+        self.store = store
+        self.clock = clock
+        # Times enter the kernel as f32 seconds relative to this epoch so a
+        # long-lived process never loses sub-second precision to f32.
+        self.epoch = clock()
+
+    # -- snapshot ---------------------------------------------------------
+
+    def _snapshot_row(self, ha: HorizontalAutoscaler) -> _Row:
+        row = _Row(ha=ha, scale=None, values=[], targets=[], types=[])
+        try:
+            for metric_spec in ha.spec.metrics:
+                observed = self.metrics.for_metric(metric_spec).get_current_value(
+                    metric_spec
+                )
+                target = metric_spec.get_target()
+                row.values.append(observed.value)
+                row.targets.append(target.target_value())
+                row.types.append(
+                    _TYPE_CODES.get(target.type, D.TYPE_UNKNOWN)
+                )
+            ref = ha.spec.scale_target_ref
+            row.scale = self.store.get_scale(
+                ref.kind, ha.metadata.namespace, ref.name
+            )
+        except Exception as e:  # noqa: BLE001 - row-isolated failure
+            row.error = e
+        return row
+
+    # -- batch reconcile --------------------------------------------------
+
+    def reconcile_batch(
+        self, has: List[HorizontalAutoscaler]
+    ) -> Dict[tuple, Optional[Exception]]:
+        """Returns {(namespace, name): error or None}; mutates each HA's status."""
+        key = lambda ha: (ha.metadata.namespace, ha.metadata.name)
+        results: Dict[tuple, Optional[Exception]] = {}
+        rows = [self._snapshot_row(ha) for ha in has]
+        live = [r for r in rows if r.error is None]
+        for row in rows:
+            if row.error is not None:
+                results[key(row.ha)] = row.error
+
+        if live:
+            outputs = self._decide(live)
+            now = self.clock()
+            for i, row in enumerate(live):
+                self._apply(row, outputs, i, now)
+                results[key(row.ha)] = None
+        return results
+
+    def _decide(self, rows: List[_Row]) -> D.DecisionOutputs:
+        import jax.numpy as jnp
+
+        n = D.pad_to(len(rows))
+        m = max(1, max(len(r.values) for r in rows))
+
+        def pad2(getter, fill, dtype):
+            arr = np.full((n, m), fill, dtype)
+            for i, r in enumerate(rows):
+                vals = getter(r)
+                arr[i, : len(vals)] = vals
+            return arr
+
+        valid = np.zeros((n, m), bool)
+        for i, r in enumerate(rows):
+            valid[i, : len(r.values)] = True
+
+        def col(fn, fill, dtype):
+            arr = np.full(n, fill, dtype)
+            for i, r in enumerate(rows):
+                arr[i] = fn(i, r)
+            return arr
+
+        # one (up, down) rules resolution per row, reused by all four columns
+        resolved_rules = [
+            (
+                r.ha.spec.behavior.scale_up_rules(),
+                r.ha.spec.behavior.scale_down_rules(),
+            )
+            for r in rows
+        ]
+
+        now = np.float32(self.clock() - self.epoch)
+        inputs = D.DecisionInputs(
+            metric_value=jnp.asarray(pad2(lambda r: r.values, 0.0, np.float32)),
+            target_value=jnp.asarray(pad2(lambda r: r.targets, 0.0, np.float32)),
+            target_type=jnp.asarray(
+                pad2(lambda r: r.types, D.TYPE_UNKNOWN, np.int32)
+            ),
+            metric_valid=jnp.asarray(valid),
+            spec_replicas=jnp.asarray(
+                col(lambda i, r: r.scale.spec_replicas or 0, 0, np.int32)
+            ),
+            status_replicas=jnp.asarray(
+                col(lambda i, r: r.scale.status_replicas, 0, np.int32)
+            ),
+            min_replicas=jnp.asarray(
+                col(lambda i, r: r.ha.spec.min_replicas, 0, np.int32)
+            ),
+            max_replicas=jnp.asarray(
+                col(lambda i, r: r.ha.spec.max_replicas, 0, np.int32)
+            ),
+            up_window=jnp.asarray(
+                col(
+                    lambda i, r: resolved_rules[i][0].stabilization_window_seconds,
+                    0,
+                    np.int32,
+                )
+            ),
+            down_window=jnp.asarray(
+                col(
+                    lambda i, r: resolved_rules[i][1].stabilization_window_seconds,
+                    0,
+                    np.int32,
+                )
+            ),
+            up_policy=jnp.asarray(
+                col(
+                    lambda i, r: _POLICY_CODES.get(
+                        resolved_rules[i][0].select_policy, D.POLICY_MAX
+                    ),
+                    D.POLICY_MAX,
+                    np.int32,
+                )
+            ),
+            down_policy=jnp.asarray(
+                col(
+                    lambda i, r: _POLICY_CODES.get(
+                        resolved_rules[i][1].select_policy, D.POLICY_MAX
+                    ),
+                    D.POLICY_MAX,
+                    np.int32,
+                )
+            ),
+            last_scale_time=jnp.asarray(
+                col(
+                    lambda i, r: (r.ha.status.last_scale_time or 0.0) - self.epoch,
+                    0.0,
+                    np.float32,
+                )
+            ),
+            has_last_scale=jnp.asarray(
+                col(
+                    lambda i, r: r.ha.status.last_scale_time is not None,
+                    False,
+                    bool,
+                )
+            ),
+            now=jnp.float32(now),
+        )
+        return D.decide_jit(inputs)
+
+    def _apply(self, row: _Row, out: D.DecisionOutputs, i: int, now: float):
+        """Write back one row's decision (reference: autoscaler.go:81-113,
+        155-194 for the condition semantics)."""
+        ha, scale = row.ha, row.scale
+        mgr = ha.status_conditions()
+        desired = int(out.desired[i])
+        recommendation = int(out.recommendation[i])
+        able = bool(out.able_to_scale[i])
+        unbounded = bool(out.scaling_unbounded[i])
+
+        ha.status.current_replicas = scale.status_replicas
+
+        if able:
+            mgr.mark_true(cond.ABLE_TO_SCALE)
+        else:
+            able_at = self.epoch + float(out.able_at[i])
+            stamp = datetime.datetime.fromtimestamp(
+                able_at, datetime.timezone.utc
+            ).strftime("%Y-%m-%dT%H:%M:%SZ")
+            mgr.mark_false(
+                cond.ABLE_TO_SCALE,
+                "",
+                f"within stabilization window, able to scale at {stamp}",
+            )
+
+        if unbounded:
+            mgr.mark_true(cond.SCALING_UNBOUNDED)
+        else:
+            # pre-clamp value: recommendation unless held by the window
+            limited = recommendation if able else (scale.spec_replicas or 0)
+            mgr.mark_false(
+                cond.SCALING_UNBOUNDED,
+                "",
+                f"recommendation {limited} limited by bounds "
+                f"[{ha.spec.min_replicas}, {ha.spec.max_replicas}]",
+            )
+
+        if scale.spec_replicas is not None and desired == scale.spec_replicas:
+            return
+        scale.spec_replicas = desired
+        self.store.update_scale(ha.spec.scale_target_ref.kind, scale)
+        ha.status.desired_replicas = desired
+        ha.status.last_scale_time = now
+
+
+class AutoscalerFactory:
+    """reference: autoscaler.go:38-69 — kept for per-object call sites; the
+    controller uses the batch path."""
+
+    def __init__(self, metrics_client_factory, store: Store, clock=_time.time):
+        self.batch = BatchAutoscaler(metrics_client_factory, store, clock)
+
+    def reconcile(self, ha: HorizontalAutoscaler) -> None:
+        error = self.batch.reconcile_batch([ha])[
+            (ha.metadata.namespace, ha.metadata.name)
+        ]
+        if error is not None:
+            raise error

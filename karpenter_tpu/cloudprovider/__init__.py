@@ -1,0 +1,44 @@
+"""Cloud provider SPI (reference: pkg/cloudprovider/types.go:23-55).
+
+Providers plug in NodeGroup (get/set replicas, stabilization) and Queue
+(length, oldest message age) implementations. Provider selection is runtime
+(registry.py) rather than compile-time build tags.
+"""
+
+from dataclasses import dataclass
+from typing import Optional, Protocol, Tuple
+
+
+class Queue(Protocol):
+    def name(self) -> str: ...
+
+    def length(self) -> int: ...
+
+    def oldest_message_age_seconds(self) -> int: ...
+
+
+class NodeGroup(Protocol):
+    def set_replicas(self, count: int) -> None: ...
+
+    def get_replicas(self) -> int: ...
+
+    def stabilized(self) -> Tuple[bool, str]:
+        """(stable, message); message explains instability."""
+        ...
+
+
+class CloudProviderFactory(Protocol):
+    def node_group_for(self, spec) -> NodeGroup:
+        """NodeGroup for a ScalableNodeGroupSpec."""
+        ...
+
+    def queue_for(self, spec) -> Queue:
+        """Queue for a QueueSpec."""
+        ...
+
+
+@dataclass
+class Options:
+    """Injected into provider factories (reference: types.go:52-55)."""
+
+    store: Optional[object] = None

@@ -1,0 +1,24 @@
+from karpenter_tpu.controllers.engine import Controller, Manager
+from karpenter_tpu.controllers.errors import (
+    RetryableError,
+    error_code,
+    is_retryable,
+)
+from karpenter_tpu.controllers.horizontalautoscaler import (
+    HorizontalAutoscalerController,
+)
+from karpenter_tpu.controllers.metricsproducer import MetricsProducerController
+from karpenter_tpu.controllers.scalablenodegroup import (
+    ScalableNodeGroupController,
+)
+
+__all__ = [
+    "Controller",
+    "Manager",
+    "RetryableError",
+    "error_code",
+    "is_retryable",
+    "HorizontalAutoscalerController",
+    "MetricsProducerController",
+    "ScalableNodeGroupController",
+]

@@ -1,0 +1,39 @@
+"""HorizontalAutoscaler controller (reference:
+pkg/controllers/horizontalautoscaler/v1alpha1/controller.go:40-50).
+
+Unlike the reference's one-object-at-a-time Reconcile, the batch path hands
+the whole fleet to the BatchAutoscaler for a single device evaluation — this
+is the singleton-architecture note at controller.go:45-46 resolved the TPU
+way: no sharded controllers, one array program.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from karpenter_tpu.api.horizontalautoscaler import HorizontalAutoscaler
+from karpenter_tpu.autoscaler import BatchAutoscaler
+
+
+class HorizontalAutoscalerController:
+    def __init__(self, batch_autoscaler: BatchAutoscaler):
+        self.autoscaler = batch_autoscaler
+
+    def kind(self) -> str:
+        return HorizontalAutoscaler.KIND
+
+    def interval(self) -> float:
+        return 10.0
+
+    def reconcile(self, ha) -> None:
+        error = self.autoscaler.reconcile_batch([ha]).get(
+            (ha.metadata.namespace, ha.metadata.name)
+        )
+        if error is not None:
+            raise error
+
+    def reconcile_batch(
+        self, has: List[HorizontalAutoscaler]
+    ) -> Dict[tuple, Optional[Exception]]:
+        """Keyed by (namespace, name)."""
+        return self.autoscaler.reconcile_batch(has)

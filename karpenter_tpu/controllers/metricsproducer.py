@@ -1,0 +1,20 @@
+"""MetricsProducer controller (reference:
+pkg/controllers/metricsproducer/v1alpha1/controller.go:40-47)."""
+
+from __future__ import annotations
+
+from karpenter_tpu.api.metricsproducer import MetricsProducer
+
+
+class MetricsProducerController:
+    def __init__(self, producer_factory):
+        self.factory = producer_factory
+
+    def kind(self) -> str:
+        return MetricsProducer.KIND
+
+    def interval(self) -> float:
+        return 5.0
+
+    def reconcile(self, mp) -> None:
+        self.factory.for_producer(mp).reconcile()

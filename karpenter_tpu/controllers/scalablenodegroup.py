@@ -1,0 +1,64 @@
+"""ScalableNodeGroup controller: actuate replicas against the cloud provider.
+
+reference: pkg/controllers/scalablenodegroup/v1alpha1/controller.go:48-95 —
+stabilization check, observe replicas into status, set replicas when spec
+diverges; retryable provider errors mark AbleToScale false WITHOUT
+deactivating the resource (the next loop will likely succeed).
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.api import conditions as cond
+from karpenter_tpu.api.scalablenodegroup import ScalableNodeGroup
+from karpenter_tpu.controllers.errors import error_code, is_retryable
+from karpenter_tpu.utils.log import logger
+
+
+class ScalableNodeGroupController:
+    def __init__(self, cloud_provider_factory):
+        self.cloud_provider = cloud_provider_factory
+
+    def kind(self) -> str:
+        return ScalableNodeGroup.KIND
+
+    def interval(self) -> float:
+        return 60.0
+
+    def _reconcile(self, resource) -> None:
+        node_group = self.cloud_provider.node_group_for(resource.spec)
+        mgr = resource.status_conditions()
+
+        # 1. stabilization state -> condition
+        stable, message = node_group.stabilized()
+        if stable:
+            mgr.mark_true(cond.STABILIZED)
+        else:
+            mgr.mark_false(cond.STABILIZED, "", message)
+
+        # 2. observe replicas
+        observed = node_group.get_replicas()
+        resource.status.replicas = observed
+
+        # 3. actuate when spec diverges from observation
+        if resource.spec.replicas is None or resource.spec.replicas == observed:
+            return
+        node_group.set_replicas(resource.spec.replicas)
+        logger().debug(
+            "ScalableNodeGroup %s updated nodes %d -> %d",
+            resource.spec.id,
+            observed,
+            resource.spec.replicas,
+        )
+
+    def reconcile(self, resource) -> None:
+        mgr = resource.status_conditions()
+        try:
+            self._reconcile(resource)
+        except Exception as e:  # noqa: BLE001
+            if is_retryable(e):
+                # stay Active; just flag the transient inability to scale
+                # (reference: controller.go:83-95)
+                mgr.mark_false(cond.ABLE_TO_SCALE, "", error_code(e) or str(e))
+                return
+            raise
+        mgr.mark_true(cond.ABLE_TO_SCALE)

@@ -1,0 +1,20 @@
+"""Metrics layer: gauge registry, producers, clients.
+
+reference: pkg/metrics/ (gauge.go, types.go, producers/, clients/).
+"""
+
+from karpenter_tpu.metrics.registry import (
+    GaugeRegistry,
+    default_registry,
+    reset_default_registry,
+)
+from karpenter_tpu.metrics.types import Metric, MetricsClient, Producer
+
+__all__ = [
+    "GaugeRegistry",
+    "default_registry",
+    "reset_default_registry",
+    "Metric",
+    "MetricsClient",
+    "Producer",
+]

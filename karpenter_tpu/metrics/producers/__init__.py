@@ -1,0 +1,44 @@
+"""MetricsProducer implementations + one-of factory dispatch.
+
+reference: pkg/metrics/producers/factory.go:36-62.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from karpenter_tpu.metrics.producers.fake import FakeProducer, NOT_IMPLEMENTED_ERROR
+from karpenter_tpu.metrics.producers.pendingcapacity import PendingCapacityProducer
+from karpenter_tpu.metrics.producers.queue import QueueProducer
+from karpenter_tpu.metrics.producers.reservedcapacity import ReservedCapacityProducer
+from karpenter_tpu.metrics.producers.scheduledcapacity import (
+    ScheduledCapacityProducer,
+)
+from karpenter_tpu.utils.log import logger
+
+
+class ProducerFactory:
+    def __init__(self, store, cloud_provider_factory, registry=None):
+        self.store = store
+        self.cloud_provider_factory = cloud_provider_factory
+        self.registry = registry
+
+    def for_producer(self, mp):
+        spec = mp.spec
+        if spec.pending_capacity is not None:
+            return PendingCapacityProducer(mp, self.store, registry=self.registry)
+        if spec.queue is not None:
+            return QueueProducer(
+                mp,
+                self.cloud_provider_factory.queue_for(spec.queue),
+                registry=self.registry,
+            )
+        if spec.reserved_capacity is not None:
+            return ReservedCapacityProducer(mp, self.store, registry=self.registry)
+        if spec.schedule is not None:
+            return ScheduledCapacityProducer(mp, registry=self.registry)
+        logger().error(
+            "Failed to instantiate metrics producer, no spec defined for %s",
+            mp.metadata.name,
+        )
+        return FakeProducer(want_err=NOT_IMPLEMENTED_ERROR)

@@ -1,0 +1,39 @@
+"""PendingCapacity producer: would a scale-up let pending pods schedule?
+
+reference: pkg/metrics/producers/pendingcapacity/producer.go:29-31 is a STUB
+in the reference; the design intent (docs/designs/DESIGN.md "Pending Pods")
+is a per-node-group signal derived from global bin-packing of unschedulable
+pods. This is the north-star workload the TPU build vectorizes: the solver
+in karpenter_tpu/ops/binpack.py evaluates the pods × node-groups constraint
+matrix on device; this producer feeds it from the store and publishes the
+per-group signal.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from karpenter_tpu.metrics.registry import GaugeRegistry, default_registry
+
+SUBSYSTEM = "pending_capacity"
+PENDING_PODS = "pending_pods"
+SCHEDULABLE_NOW = "schedulable_now"
+ADDITIONAL_NODES_NEEDED = "additional_nodes_needed"
+
+
+def register_gauges(registry: GaugeRegistry) -> None:
+    for name in (PENDING_PODS, SCHEDULABLE_NOW, ADDITIONAL_NODES_NEEDED):
+        registry.register(SUBSYSTEM, name)
+
+
+class PendingCapacityProducer:
+    def __init__(self, mp, store, registry: Optional[GaugeRegistry] = None):
+        self.mp = mp
+        self.store = store
+        self.registry = registry if registry is not None else default_registry()
+        register_gauges(self.registry)
+
+    def reconcile(self) -> None:
+        # Solver wiring lands with ops/binpack; the reference's producer is a
+        # no-op stub at this point in its history too (producer.go:29-31).
+        return None

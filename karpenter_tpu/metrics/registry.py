@@ -1,0 +1,123 @@
+"""Gauge registry with Prometheus naming and text exposition.
+
+reference: pkg/metrics/gauge.go:22-50 — gauges named
+karpenter_<subsystem>_<name>, labeled {name, namespace}, registered into the
+controller-runtime /metrics endpoint and scraped by Prometheus. Here the
+registry doubles as the metrics STORE: the in-process metrics client reads
+gauge values directly (no scrape hop), while the /metrics text exposition
+(karpenter_tpu.observability) keeps drop-in Prometheus compatibility for
+external scrapers.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+METRIC_NAMESPACE = "karpenter"
+LABEL_NAME = "name"
+LABEL_NAMESPACE = "namespace"
+
+
+@dataclass
+class GaugeSample:
+    labels: Dict[str, str]
+    value: float
+
+
+class GaugeVec:
+    """A named gauge parameterized by {name, namespace} labels."""
+
+    def __init__(self, full_name: str, help_text: str):
+        self.full_name = full_name
+        self.help = help_text
+        self._samples: Dict[Tuple[str, str], float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, name: str, namespace: str, value: float) -> None:
+        with self._lock:
+            self._samples[(name, namespace)] = float(value)
+
+    def get(self, name: str, namespace: str) -> Optional[float]:
+        with self._lock:
+            return self._samples.get((name, namespace))
+
+    def remove(self, name: str, namespace: str) -> None:
+        with self._lock:
+            self._samples.pop((name, namespace), None)
+
+    def samples(self):
+        with self._lock:
+            return [
+                GaugeSample({LABEL_NAME: n, LABEL_NAMESPACE: ns}, v)
+                for (n, ns), v in sorted(self._samples.items())
+            ]
+
+
+class GaugeRegistry:
+    def __init__(self):
+        self._gauges: Dict[str, Dict[str, GaugeVec]] = {}
+        self._lock = threading.Lock()
+
+    def register(self, subsystem: str, name: str) -> GaugeVec:
+        """reference: gauge.go:35-50 (RegisterNewGauge)."""
+        full = f"{METRIC_NAMESPACE}_{subsystem}_{name}"
+        with self._lock:
+            sub = self._gauges.setdefault(subsystem, {})
+            if name not in sub:
+                sub[name] = GaugeVec(
+                    full,
+                    "Metric computed by a karpenter metrics producer "
+                    "corresponding to name and namespace labels",
+                )
+            return sub[name]
+
+    def gauge(self, subsystem: str, name: str) -> GaugeVec:
+        with self._lock:
+            return self._gauges[subsystem][name]
+
+    def lookup_by_full_name(self, full_name: str) -> Optional[GaugeVec]:
+        with self._lock:
+            for sub in self._gauges.values():
+                for vec in sub.values():
+                    if vec.full_name == full_name:
+                        return vec
+        return None
+
+    def expose_text(self) -> str:
+        """Prometheus text exposition format of all samples."""
+        lines = []
+        with self._lock:
+            vecs = [v for sub in self._gauges.values() for v in sub.values()]
+        for vec in sorted(vecs, key=lambda v: v.full_name):
+            lines.append(f"# HELP {vec.full_name} {vec.help}")
+            lines.append(f"# TYPE {vec.full_name} gauge")
+            for sample in vec.samples():
+                labels = ",".join(
+                    f'{k}="{v}"' for k, v in sorted(sample.labels.items())
+                )
+                value = sample.value
+                if math.isnan(value):
+                    rendered = "NaN"
+                elif math.isinf(value):
+                    rendered = "+Inf" if value > 0 else "-Inf"
+                else:
+                    rendered = repr(value)
+                lines.append(f"{vec.full_name}{{{labels}}} {rendered}")
+        return "\n".join(lines) + "\n"
+
+
+_default = GaugeRegistry()
+
+
+def default_registry() -> GaugeRegistry:
+    return _default
+
+
+def reset_default_registry() -> GaugeRegistry:
+    """Swap in a fresh default registry (test isolation)."""
+    global _default
+    _default = GaugeRegistry()
+    return _default

@@ -1,0 +1,84 @@
+"""Process wiring: the factory graph + manager, and a test/dev environment.
+
+reference: cmd/controller/main.go:40-77 — flags, scheme, manager, cloud
+provider registry, producer/metrics-client/autoscaler factories, controller
+registration. KarpenterRuntime is that wiring; Environment adds the
+envtest-analog conveniences the reference's pkg/test/environment provides
+(isolated store+registry, converge helper).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from karpenter_tpu.autoscaler import BatchAutoscaler
+from karpenter_tpu.cloudprovider import Options as CloudOptions
+from karpenter_tpu.cloudprovider import registry as provider_registry
+from karpenter_tpu.controllers import (
+    HorizontalAutoscalerController,
+    Manager,
+    MetricsProducerController,
+    ScalableNodeGroupController,
+)
+from karpenter_tpu.metrics.clients import MetricsClientFactory
+from karpenter_tpu.metrics.producers import ProducerFactory
+from karpenter_tpu.metrics.registry import GaugeRegistry
+from karpenter_tpu.store import Store
+
+
+@dataclass
+class Options:
+    """reference: main.go:40-46 (minus ports, which live in observability)."""
+
+    prometheus_uri: Optional[str] = None  # None = in-process registry client
+    cloud_provider: Optional[str] = None  # None = env/default (not-implemented)
+    verbose: bool = False
+
+
+class KarpenterRuntime:
+    def __init__(
+        self,
+        options: Optional[Options] = None,
+        store: Optional[Store] = None,
+        registry: Optional[GaugeRegistry] = None,
+        cloud_provider_factory=None,
+        clock=None,
+    ):
+        import time as _time
+
+        options = options or Options()
+        self.options = options
+        self.clock = clock or _time.time
+        self.store = store if store is not None else Store()
+        self.registry = registry if registry is not None else GaugeRegistry()
+
+        self.cloud_provider = (
+            cloud_provider_factory
+            if cloud_provider_factory is not None
+            else provider_registry.new_factory(
+                CloudOptions(store=self.store), provider=options.cloud_provider
+            )
+        )
+        self.producer_factory = ProducerFactory(
+            self.store, self.cloud_provider, registry=self.registry
+        )
+        self.metrics_clients = MetricsClientFactory(
+            registry=self.registry, prometheus_uri=options.prometheus_uri
+        )
+        self.batch_autoscaler = BatchAutoscaler(
+            self.metrics_clients, self.store, clock=self.clock
+        )
+        # Registration order = in-tick evaluation order. Producers run first
+        # so signals are fresh, then node groups observe, then the batched
+        # autoscaler decides — one tick moves a signal end to end (the
+        # reference's produce→scrape→poll chain costs up to 20s of interval
+        # latency; SURVEY.md §6).
+        self.manager = Manager(self.store, clock=self.clock).register(
+            MetricsProducerController(self.producer_factory),
+            ScalableNodeGroupController(self.cloud_provider),
+            HorizontalAutoscalerController(self.batch_autoscaler),
+        )
+
+    def run(self, duration: float) -> None:
+        self.manager.run(duration)

@@ -135,12 +135,24 @@ class Store:
             return None
 
     def update(self, obj):
-        """Replace spec+metadata+status wholesale (like an apiserver UPDATE)."""
+        """Replace spec+metadata+status wholesale (like an apiserver UPDATE).
+        Optimistic concurrency: a stale resource_version is rejected so a
+        slow writer cannot silently clobber a concurrent change (e.g. the
+        autoscaler's scale write)."""
         with self._lock:
             key = _key(obj)
             stored = self._objects.get(key)
             if stored is None:
                 raise NotFoundError(f"{key} not found")
+            if (
+                obj.metadata.resource_version
+                and obj.metadata.resource_version != stored.metadata.resource_version
+            ):
+                raise ConflictError(
+                    f"{key}: stale resourceVersion "
+                    f"{obj.metadata.resource_version} != "
+                    f"{stored.metadata.resource_version}"
+                )
             self._index_remove(stored)
             obj = copy.deepcopy(obj)
             self._rv += 1
@@ -179,6 +191,11 @@ class Store:
                 raise NotFoundError(f"{key} not found")
             self._index_remove(stored)
             self._notify(DELETED, stored)
+
+    def keys(self, kind: str) -> list:
+        """(kind, namespace, name) keys of a kind, without copying objects."""
+        with self._lock:
+            return [k for k in self._objects if k[0] == kind]
 
     def list(
         self,

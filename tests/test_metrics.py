@@ -1,0 +1,105 @@
+"""Metrics layer: registry naming/exposition and client query semantics."""
+
+import math
+
+import pytest
+
+from karpenter_tpu.api.horizontalautoscaler import (
+    Metric,
+    MetricTarget,
+    PrometheusMetricSource,
+)
+from karpenter_tpu.metrics.clients import (
+    MetricQueryError,
+    MetricsClientFactory,
+    RegistryMetricsClient,
+    parse_instant_selector,
+)
+from karpenter_tpu.metrics.registry import GaugeRegistry
+
+
+def metric_for(query):
+    return Metric(
+        prometheus=PrometheusMetricSource(
+            query=query, target=MetricTarget(type="AverageValue", value=1)
+        )
+    )
+
+
+class TestSelectorParsing:
+    def test_bare_name(self):
+        assert parse_instant_selector("karpenter_queue_length") == (
+            "karpenter_queue_length",
+            {},
+        )
+
+    def test_labels(self):
+        name, labels = parse_instant_selector(
+            'karpenter_queue_length{name="q", namespace="default"}'
+        )
+        assert name == "karpenter_queue_length"
+        assert labels == {"name": "q", "namespace": "default"}
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            "sum(rate(foo[5m]))",  # full PromQL unsupported
+            'foo{name="a" other="b"}',  # missing comma: must error, not drop
+            'foo{name=}',
+            "foo{,}",
+            "",
+        ],
+    )
+    def test_bad_syntax_raises(self, bad):
+        with pytest.raises(MetricQueryError):
+            parse_instant_selector(bad)
+
+
+class TestRegistryClient:
+    def test_reads_gauge(self):
+        registry = GaugeRegistry()
+        registry.register("queue", "length").set("q", "default", 41.0)
+        client = RegistryMetricsClient(registry)
+        got = client.get_current_value(
+            metric_for('karpenter_queue_length{name="q"}')
+        )
+        assert got.value == 41.0
+
+    def test_instant_vector_of_one_enforced(self):
+        """reference: prometheus.go:46-55"""
+        registry = GaugeRegistry()
+        vec = registry.register("queue", "length")
+        client = RegistryMetricsClient(registry)
+        spec = metric_for("karpenter_queue_length")
+        with pytest.raises(MetricQueryError, match="got 0 series"):
+            client.get_current_value(spec)
+        vec.set("a", "default", 1.0)
+        vec.set("b", "default", 2.0)
+        with pytest.raises(MetricQueryError, match="got 2 series"):
+            client.get_current_value(spec)
+
+    def test_unknown_metric_name(self):
+        client = RegistryMetricsClient(GaugeRegistry())
+        with pytest.raises(MetricQueryError, match="no metric named"):
+            client.get_current_value(metric_for('nope{name="q"}'))
+
+
+class TestFactory:
+    def test_prometheus_source_dispatch(self):
+        factory = MetricsClientFactory(registry=GaugeRegistry())
+        client = factory.for_metric(metric_for("foo"))
+        assert isinstance(client, RegistryMetricsClient)
+
+
+class TestExposition:
+    def test_text_format_with_nan(self):
+        registry = GaugeRegistry()
+        registry.register("reserved_capacity", "cpu_utilization").set(
+            "g", "default", math.nan
+        )
+        text = registry.expose_text()
+        assert "# TYPE karpenter_reserved_capacity_cpu_utilization gauge" in text
+        assert (
+            'karpenter_reserved_capacity_cpu_utilization{name="g",namespace="default"} NaN'
+            in text
+        )

@@ -46,6 +46,17 @@ class TestCrud:
             store.get("ScalableNodeGroup", "default", "nope")
         assert store.try_get("ScalableNodeGroup", "default", "nope") is None
 
+    def test_stale_update_rejected(self):
+        store = Store()
+        stale = store.create(sng(replicas=1))
+        fresh = store.get("ScalableNodeGroup", "default", "group")
+        fresh.spec.replicas = 7
+        store.update(fresh)
+        stale.spec.replicas = 99
+        with pytest.raises(ConflictError):
+            store.update(stale)  # must not clobber the concurrent write
+        assert store.get("ScalableNodeGroup", "default", "group").spec.replicas == 7
+
     def test_update_bumps_rv_preserves_identity(self):
         store = Store()
         created = store.create(sng(replicas=1))
