@@ -186,9 +186,10 @@ class PendingCapacityStatus:
     """Per-node-group pending-pods signal. The reference's status struct is
     empty (metricsproducer_status.go:44-45); we surface the solver outputs."""
 
-    pending_pods: int = 0
-    schedulable_now: int = 0
-    additional_nodes_needed: int = 0
+    pending_pods: int = 0  # pending pods this group would absorb
+    additional_nodes_needed: int = 0  # shelf-BFD node count for those pods
+    lp_lower_bound: int = 0  # LP-relaxation lower bound (diagnostic)
+    unschedulable_pods: int = 0  # cluster-wide: pods no group can take
 
 
 @dataclass

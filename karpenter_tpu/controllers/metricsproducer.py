@@ -1,9 +1,17 @@
 """MetricsProducer controller (reference:
-pkg/controllers/metricsproducer/v1alpha1/controller.go:40-47)."""
+pkg/controllers/metricsproducer/v1alpha1/controller.go:40-47).
+
+Batch hook: all pendingCapacity producers due in a tick are solved in ONE
+device bin-pack call (the reference reconciles each producer independently;
+pending-pods is inherently a global problem — DESIGN.md "Pending Pods").
+"""
 
 from __future__ import annotations
 
+from typing import Dict, List, Optional
+
 from karpenter_tpu.api.metricsproducer import MetricsProducer
+from karpenter_tpu.metrics.producers.pendingcapacity import solve_pending
 
 
 class MetricsProducerController:
@@ -18,3 +26,30 @@ class MetricsProducerController:
 
     def reconcile(self, mp) -> None:
         self.factory.for_producer(mp).reconcile()
+
+    def reconcile_batch(
+        self, mps: List[MetricsProducer]
+    ) -> Dict[tuple, Optional[Exception]]:
+        key = lambda mp: (mp.metadata.namespace, mp.metadata.name)
+        results: Dict[tuple, Optional[Exception]] = {}
+        pending = [mp for mp in mps if mp.spec.pending_capacity is not None]
+        others = [mp for mp in mps if mp.spec.pending_capacity is None]
+
+        if pending:
+            try:
+                solve_pending(
+                    self.factory.store, pending, self.factory.registry
+                )
+                for mp in pending:
+                    results[key(mp)] = None
+            except Exception as e:  # noqa: BLE001
+                for mp in pending:
+                    results[key(mp)] = e
+
+        for mp in others:
+            try:
+                self.factory.for_producer(mp).reconcile()
+                results[key(mp)] = None
+            except Exception as e:  # noqa: BLE001
+                results[key(mp)] = e
+        return results

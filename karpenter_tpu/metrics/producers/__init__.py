@@ -19,9 +19,11 @@ from karpenter_tpu.utils.log import logger
 
 class ProducerFactory:
     def __init__(self, store, cloud_provider_factory, registry=None):
+        from karpenter_tpu.metrics.registry import default_registry
+
         self.store = store
         self.cloud_provider_factory = cloud_provider_factory
-        self.registry = registry
+        self.registry = registry if registry is not None else default_registry()
 
     def for_producer(self, mp):
         spec = mp.spec

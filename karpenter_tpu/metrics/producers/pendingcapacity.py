@@ -3,30 +3,228 @@
 reference: pkg/metrics/producers/pendingcapacity/producer.go:29-31 is a STUB
 in the reference; the design intent (docs/designs/DESIGN.md "Pending Pods")
 is a per-node-group signal derived from global bin-packing of unschedulable
-pods. This is the north-star workload the TPU build vectorizes: the solver
-in karpenter_tpu/ops/binpack.py evaluates the pods × node-groups constraint
-matrix on device; this producer feeds it from the store and publishes the
-per-group signal.
+pods, with the rule that each pod drives at most ONE group's scale-up.
+
+This implementation is the TPU build's north star: ALL pendingCapacity
+producers are solved together in one device call (ops/binpack) — the
+controller's batch hook collects them per tick. The host side only encodes
+the store snapshot into fixed-shape arrays:
+
+- pending pods = Pods with no nodeName (the unschedulable set)
+- each producer's node group contributes one row of the type matrix: its
+  per-node shape is the elementwise max allocatable over ready+schedulable
+  nodes (labels: intersection; taints: union — conservative on both sides)
+- taint and label universes are encoded into padded bitsets so the device
+  feasibility math is two boolean matmuls (see ops/binpack.py)
+
+Gauges: karpenter_pending_capacity_{pending_pods,additional_nodes_needed,
+lp_lower_bound,unschedulable_pods}{name,namespace}.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
+from karpenter_tpu.api.core import Taint, is_ready_and_schedulable
+from karpenter_tpu.api.metricsproducer import PendingCapacityStatus
 from karpenter_tpu.metrics.registry import GaugeRegistry, default_registry
+from karpenter_tpu.ops import binpack as B
 
 SUBSYSTEM = "pending_capacity"
 PENDING_PODS = "pending_pods"
-SCHEDULABLE_NOW = "schedulable_now"
 ADDITIONAL_NODES_NEEDED = "additional_nodes_needed"
+LP_LOWER_BOUND = "lp_lower_bound"
+UNSCHEDULABLE_PODS = "unschedulable_pods"
+
+RESOURCES = ("cpu", "memory", "pods")
+
+# pad buckets for stable compiled shapes; universes GROW in these steps
+# rather than truncating (silent constraint drops = false feasibility)
+TAINT_PAD = 32
+LABEL_PAD = 64
+POD_PAD = 256  # pods padded to a multiple of this
+GROUP_PAD = 8
+
+# kubernetes' default max-pods when a node doesn't report a 'pods' allocatable
+DEFAULT_PODS_PER_NODE = 110.0
 
 
 def register_gauges(registry: GaugeRegistry) -> None:
-    for name in (PENDING_PODS, SCHEDULABLE_NOW, ADDITIONAL_NODES_NEEDED):
+    for name in (
+        PENDING_PODS,
+        ADDITIONAL_NODES_NEEDED,
+        LP_LOWER_BOUND,
+        UNSCHEDULABLE_PODS,
+    ):
         registry.register(SUBSYSTEM, name)
 
 
+def _pad(n: int, bucket: int) -> int:
+    return max(bucket, ((n + bucket - 1) // bucket) * bucket)
+
+
+def _group_profile(store, selector) -> Tuple[np.ndarray, set, set]:
+    """(allocatable[R], labels set, taints set) for one node group.
+
+    Ready+schedulable nodes define the group's shape; when the group is empty
+    we fall back to any node matching the selector (a group scaled to zero
+    still needs a shape to reason about — a limitation shared with every
+    pending-pods autoscaler that lacks instance-type metadata).
+    """
+    nodes = store.list("Node", label_selector=selector)
+    ready = [n for n in nodes if is_ready_and_schedulable(n)]
+    candidates = ready or nodes
+    alloc = np.zeros(len(RESOURCES), np.float32)
+    labels: set = set()
+    taints: set = set()
+    for i, node in enumerate(candidates):
+        for r, resource in enumerate(RESOURCES):
+            q = node.status.allocatable.get(resource)
+            if q is not None:
+                alloc[r] = max(alloc[r], q.to_float())
+        node_labels = set(node.metadata.labels.items())
+        labels = node_labels if i == 0 else (labels & node_labels)
+        # only hard taints exclude pods; PreferNoSchedule is a preference
+        # in the kube scheduler, never a constraint
+        taints |= {
+            (t.key, t.value, t.effect)
+            for t in node.spec.taints
+            if t.effect in ("NoSchedule", "NoExecute")
+        }
+    if candidates and alloc[RESOURCES.index("pods")] <= 0:
+        alloc[RESOURCES.index("pods")] = DEFAULT_PODS_PER_NODE
+    return alloc, labels, taints
+
+
+def solve_pending(store, due_producers: List, registry: GaugeRegistry) -> None:
+    """One device call over ALL pendingCapacity producers in the store.
+
+    Solving the full set — not just the due subset — is what upholds the
+    DESIGN.md single-scale-up rule: assignment is only exclusive when every
+    candidate group is in the same solve. Status objects are mutated on the
+    due producers (the engine persists those); gauges are refreshed for every
+    group since they are global registry state.
+    """
+    import jax.numpy as jnp
+
+    due_keys = {
+        (mp.metadata.namespace, mp.metadata.name): mp for mp in due_producers
+    }
+    producers = []
+    for mp in sorted(
+        store.list("MetricsProducer"),
+        key=lambda m: (m.metadata.namespace, m.metadata.name),
+    ):
+        if mp.spec.pending_capacity is None:
+            continue
+        # use the caller's object for due producers so status lands on the
+        # instance the engine will persist
+        producers.append(
+            due_keys.get((mp.metadata.namespace, mp.metadata.name), mp)
+        )
+    if not producers:
+        return
+
+    pods = [
+        p
+        for p in store.list("Pod")
+        if not p.spec.node_name and p.status.phase in ("", "Pending")
+    ]
+
+    profiles = [
+        _group_profile(store, mp.spec.pending_capacity.node_selector)
+        for mp in producers
+    ]
+
+    # encode universes; sized to the data (padded), never truncated
+    taint_universe: Dict[tuple, int] = {}
+    for _, _, taints in profiles:
+        for taint in sorted(taints):
+            if taint not in taint_universe:
+                taint_universe[taint] = len(taint_universe)
+    label_universe: Dict[tuple, int] = {}
+    for pod in pods:
+        for item in sorted(pod.spec.node_selector.items()):
+            if item not in label_universe:
+                label_universe[item] = len(label_universe)
+
+    n_pods = _pad(len(pods), POD_PAD)
+    n_groups = _pad(len(producers), GROUP_PAD)
+    n_taints = _pad(len(taint_universe), TAINT_PAD)
+    n_labels = _pad(len(label_universe), LABEL_PAD)
+
+    # one Taint object per universe entry, reused across all pods
+    taint_objects = {
+        k: Taint(key=taint[0], value=taint[1], effect=taint[2])
+        for taint, k in taint_universe.items()
+    }
+
+    pod_requests = np.zeros((n_pods, len(RESOURCES)), np.float32)
+    pod_valid = np.zeros(n_pods, bool)
+    pod_intolerant = np.zeros((n_pods, n_taints), bool)
+    pod_required = np.zeros((n_pods, n_labels), bool)
+    for i, pod in enumerate(pods):
+        requests = pod.requests()
+        for r, resource in enumerate(RESOURCES[:-1]):
+            q = requests.get(resource)
+            pod_requests[i, r] = q.to_float() if q is not None else 0.0
+        pod_requests[i, len(RESOURCES) - 1] = 1.0  # each pod occupies 1 slot
+        pod_valid[i] = True
+        for k, taint in taint_objects.items():
+            pod_intolerant[i, k] = not any(
+                tol.tolerates(taint) for tol in pod.spec.tolerations
+            )
+        for item, l in label_universe.items():
+            pod_required[i, l] = pod.spec.node_selector.get(item[0]) == item[1]
+
+    group_allocatable = np.zeros((n_groups, len(RESOURCES)), np.float32)
+    group_taints = np.zeros((n_groups, n_taints), bool)
+    group_labels = np.zeros((n_groups, n_labels), bool)
+    for t, (alloc, labels, taints) in enumerate(profiles):
+        group_allocatable[t] = alloc
+        for taint, k in taint_universe.items():
+            group_taints[t, k] = taint in taints
+        for item, l in label_universe.items():
+            group_labels[t, l] = item in labels
+
+    out = B.binpack(
+        B.BinPackInputs(
+            pod_requests=jnp.asarray(pod_requests),
+            pod_valid=jnp.asarray(pod_valid),
+            pod_intolerant=jnp.asarray(pod_intolerant),
+            pod_required=jnp.asarray(pod_required),
+            group_allocatable=jnp.asarray(group_allocatable),
+            group_taints=jnp.asarray(group_taints),
+            group_labels=jnp.asarray(group_labels),
+        )
+    )
+
+    assigned_count = np.asarray(out.assigned_count)
+    nodes_needed = np.asarray(out.nodes_needed)
+    lp_bound = np.asarray(out.lp_bound)
+    unschedulable = int(out.unschedulable)
+
+    register_gauges(registry)
+    for t, mp in enumerate(producers):
+        mp.status.pending_capacity = PendingCapacityStatus(
+            pending_pods=int(assigned_count[t]),
+            additional_nodes_needed=int(nodes_needed[t]),
+            lp_lower_bound=int(lp_bound[t]),
+            unschedulable_pods=unschedulable,
+        )
+        name, namespace = mp.metadata.name, mp.metadata.namespace
+        gauge = lambda g: registry.gauge(SUBSYSTEM, g)
+        gauge(PENDING_PODS).set(name, namespace, float(assigned_count[t]))
+        gauge(ADDITIONAL_NODES_NEEDED).set(name, namespace, float(nodes_needed[t]))
+        gauge(LP_LOWER_BOUND).set(name, namespace, float(lp_bound[t]))
+        gauge(UNSCHEDULABLE_PODS).set(name, namespace, float(unschedulable))
+
+
 class PendingCapacityProducer:
+    """Single-producer path; the controller batches when it can."""
+
     def __init__(self, mp, store, registry: Optional[GaugeRegistry] = None):
         self.mp = mp
         self.store = store
@@ -34,6 +232,4 @@ class PendingCapacityProducer:
         register_gauges(self.registry)
 
     def reconcile(self) -> None:
-        # Solver wiring lands with ops/binpack; the reference's producer is a
-        # no-op stub at this point in its history too (producer.go:29-31).
-        return None
+        solve_pending(self.store, [self.mp], self.registry)

@@ -1,0 +1,281 @@
+"""PendingCapacity producer e2e: the signal the reference stubbed
+(pendingcapacity/producer.go:29-31), implemented per DESIGN.md "Pending
+Pods" — pending pods drive exactly one node group's scale-up, through the
+full pipeline: solver -> gauge -> autoscaler -> provider."""
+
+import pytest
+
+from karpenter_tpu.api.core import (
+    Container,
+    Node,
+    NodeCondition,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    Taint,
+    Toleration,
+    resource_list,
+)
+from karpenter_tpu.api.horizontalautoscaler import (
+    CrossVersionObjectReference,
+    HorizontalAutoscaler,
+    HorizontalAutoscalerSpec,
+    Metric,
+    MetricTarget,
+    PrometheusMetricSource,
+)
+from karpenter_tpu.api.metricsproducer import (
+    MetricsProducer,
+    MetricsProducerSpec,
+    PendingCapacitySpec,
+)
+from karpenter_tpu.api.scalablenodegroup import (
+    ScalableNodeGroup,
+    ScalableNodeGroupSpec,
+)
+from karpenter_tpu.cloudprovider.fake import FakeFactory
+from karpenter_tpu.runtime import KarpenterRuntime
+
+
+class FakeClock:
+    def __init__(self, start=1000.0):
+        self.now = start
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, seconds):
+        self.now += seconds
+
+
+@pytest.fixture
+def env():
+    clock = FakeClock()
+    provider = FakeFactory()
+    runtime = KarpenterRuntime(cloud_provider_factory=provider, clock=clock)
+    return runtime, provider, clock
+
+
+def ready_node(name, labels, cpu="4", memory="8Gi", pods="16", taints=()):
+    return Node(
+        metadata=ObjectMeta(name=name, labels=dict(labels)),
+        spec=NodeSpec(taints=list(taints)),
+        status=NodeStatus(
+            allocatable=resource_list(cpu=cpu, memory=memory, pods=pods),
+            conditions=[NodeCondition("Ready", "True")],
+        ),
+    )
+
+
+def pending_pod(name, cpu="1", memory="1Gi", node_selector=None, tolerations=()):
+    return Pod(
+        metadata=ObjectMeta(name=name),
+        spec=PodSpec(
+            node_name="",  # unschedulable
+            containers=[Container(requests=resource_list(cpu=cpu, memory=memory))],
+            node_selector=dict(node_selector or {}),
+            tolerations=list(tolerations),
+        ),
+    )
+
+
+def pending_mp(name, selector):
+    return MetricsProducer(
+        metadata=ObjectMeta(name=name),
+        spec=MetricsProducerSpec(
+            pending_capacity=PendingCapacitySpec(node_selector=dict(selector))
+        ),
+    )
+
+
+class TestPendingCapacitySignal:
+    def test_nodes_needed_for_pending_pods(self, env):
+        runtime, provider, clock = env
+        selector = {"group": "a"}
+        runtime.store.create(ready_node("n1", selector, cpu="4", memory="8Gi"))
+        # 8 pods of 2 cpu each -> 2 per node -> 4 nodes
+        for i in range(8):
+            runtime.store.create(pending_pod(f"p{i}", cpu="2", memory="1Gi"))
+        runtime.store.create(pending_mp("group-a", selector))
+
+        runtime.manager.reconcile_all()
+        mp = runtime.store.get("MetricsProducer", "default", "group-a")
+        assert mp.status.pending_capacity.pending_pods == 8
+        assert mp.status.pending_capacity.additional_nodes_needed == 4
+        assert mp.status.pending_capacity.lp_lower_bound == 4
+        assert mp.status.pending_capacity.unschedulable_pods == 0
+        assert mp.status_conditions().is_happy()
+        assert (
+            runtime.registry.gauge(
+                "pending_capacity", "additional_nodes_needed"
+            ).get("group-a", "default")
+            == 4.0
+        )
+
+    def test_each_pod_drives_one_group(self, env):
+        """DESIGN.md: only a single node group scales up per pod."""
+        runtime, provider, clock = env
+        runtime.store.create(ready_node("n1", {"group": "a"}))
+        runtime.store.create(ready_node("n2", {"group": "b"}))
+        runtime.store.create(pending_pod("p0", cpu="1"))
+        runtime.store.create(pending_mp("group-a", {"group": "a"}))
+        runtime.store.create(pending_mp("group-b", {"group": "b"}))
+
+        runtime.manager.reconcile_all()
+        a = runtime.store.get("MetricsProducer", "default", "group-a")
+        b = runtime.store.get("MetricsProducer", "default", "group-b")
+        total = (
+            a.status.pending_capacity.pending_pods
+            + b.status.pending_capacity.pending_pods
+        )
+        assert total == 1  # not double-counted
+
+    def test_node_selector_routes_pods(self, env):
+        runtime, provider, clock = env
+        runtime.store.create(ready_node("n1", {"group": "a", "disk": "ssd"}))
+        runtime.store.create(ready_node("n2", {"group": "b"}))
+        runtime.store.create(
+            pending_pod("needs-ssd", node_selector={"disk": "ssd"})
+        )
+        runtime.store.create(pending_mp("group-a", {"group": "a"}))
+        runtime.store.create(pending_mp("group-b", {"group": "b"}))
+
+        runtime.manager.reconcile_all()
+        a = runtime.store.get("MetricsProducer", "default", "group-a")
+        b = runtime.store.get("MetricsProducer", "default", "group-b")
+        assert a.status.pending_capacity.pending_pods == 1
+        assert b.status.pending_capacity.pending_pods == 0
+
+    def test_taints_respected(self, env):
+        runtime, provider, clock = env
+        taint = Taint(key="dedicated", value="ml", effect="NoSchedule")
+        runtime.store.create(
+            ready_node("n1", {"group": "a"}, taints=[taint])
+        )
+        runtime.store.create(ready_node("n2", {"group": "b"}))
+        runtime.store.create(pending_pod("intolerant"))
+        runtime.store.create(
+            pending_pod(
+                "tolerant",
+                tolerations=[
+                    Toleration(key="dedicated", value="ml", effect="NoSchedule")
+                ],
+            )
+        )
+        runtime.store.create(pending_mp("group-a", {"group": "a"}))
+        runtime.store.create(pending_mp("group-b", {"group": "b"}))
+
+        runtime.manager.reconcile_all()
+        a = runtime.store.get("MetricsProducer", "default", "group-a")
+        b = runtime.store.get("MetricsProducer", "default", "group-b")
+        # tolerant pod -> first feasible group (a); intolerant pod -> b
+        assert a.status.pending_capacity.pending_pods == 1
+        assert b.status.pending_capacity.pending_pods == 1
+
+    def test_partial_batch_still_sees_all_groups(self, env):
+        """Single-scale-up must hold even when only ONE producer is due:
+        the solve always spans every pendingCapacity MP in the store."""
+        runtime, provider, clock = env
+        runtime.store.create(ready_node("n1", {"group": "a"}))
+        runtime.store.create(ready_node("n2", {"group": "b"}))
+        runtime.store.create(pending_pod("p0"))
+        runtime.store.create(pending_mp("group-a", {"group": "a"}))
+        runtime.store.create(pending_mp("group-b", {"group": "b"}))
+        runtime.manager.reconcile_all()
+
+        # only group-b becomes due (watch event via touch); group-a is not
+        b = runtime.store.get("MetricsProducer", "default", "group-b")
+        runtime.store.update(b)  # touch -> watch -> due
+        runtime.manager.reconcile_all()
+        b = runtime.store.get("MetricsProducer", "default", "group-b")
+        # the pod is already absorbed by group-a; a partial solve over only
+        # group-b must NOT claim it
+        assert b.status.pending_capacity.pending_pods == 0
+        assert b.status.pending_capacity.unschedulable_pods == 0
+
+    def test_prefer_no_schedule_taint_is_soft(self, env):
+        runtime, provider, clock = env
+        soft = Taint(key="flaky", value="", effect="PreferNoSchedule")
+        runtime.store.create(ready_node("n1", {"group": "a"}, taints=[soft]))
+        runtime.store.create(pending_pod("p0"))
+        runtime.store.create(pending_mp("group-a", {"group": "a"}))
+        runtime.manager.reconcile_all()
+        mp = runtime.store.get("MetricsProducer", "default", "group-a")
+        assert mp.status.pending_capacity.pending_pods == 1  # soft ≠ blocked
+
+    def test_missing_pods_allocatable_defaults(self, env):
+        runtime, provider, clock = env
+        node = Node(
+            metadata=ObjectMeta(name="n1", labels={"group": "a"}),
+            status=NodeStatus(
+                allocatable=resource_list(cpu="4", memory="8Gi"),  # no 'pods'
+                conditions=[NodeCondition("Ready", "True")],
+            ),
+        )
+        runtime.store.create(node)
+        runtime.store.create(pending_pod("p0"))
+        runtime.store.create(pending_mp("group-a", {"group": "a"}))
+        runtime.manager.reconcile_all()
+        mp = runtime.store.get("MetricsProducer", "default", "group-a")
+        assert mp.status.pending_capacity.pending_pods == 1
+
+    def test_unschedulable_pod_reported(self, env):
+        runtime, provider, clock = env
+        runtime.store.create(ready_node("n1", {"group": "a"}, cpu="2"))
+        runtime.store.create(pending_pod("huge", cpu="64"))
+        runtime.store.create(pending_mp("group-a", {"group": "a"}))
+        runtime.manager.reconcile_all()
+        mp = runtime.store.get("MetricsProducer", "default", "group-a")
+        assert mp.status.pending_capacity.pending_pods == 0
+        assert mp.status.pending_capacity.unschedulable_pods == 1
+
+
+class TestPendingCapacityDrivesAutoscaling:
+    def test_full_loop_scale_up(self, env):
+        """pending pods -> solver -> gauge -> HA (Value target) -> SNG."""
+        runtime, provider, clock = env
+        selector = {"group": "a"}
+        provider.node_replicas["group-a"] = 1
+        runtime.store.create(ready_node("n1", selector, cpu="4", memory="8Gi"))
+        for i in range(6):
+            runtime.store.create(pending_pod(f"p{i}", cpu="2"))
+        runtime.store.create(pending_mp("group-a", selector))
+        runtime.store.create(
+            ScalableNodeGroup(
+                metadata=ObjectMeta(name="group-a"),
+                spec=ScalableNodeGroupSpec(
+                    replicas=1, type="FakeNodeGroup", id="group-a"
+                ),
+            )
+        )
+        # current + additional nodes, expressed with an AverageValue target
+        # of 1 on the additional-nodes signal plus min bound at current size
+        runtime.store.create(
+            HorizontalAutoscaler(
+                metadata=ObjectMeta(name="group-a"),
+                spec=HorizontalAutoscalerSpec(
+                    scale_target_ref=CrossVersionObjectReference(
+                        kind="ScalableNodeGroup", name="group-a"
+                    ),
+                    min_replicas=1,
+                    max_replicas=100,
+                    metrics=[
+                        Metric(
+                            prometheus=PrometheusMetricSource(
+                                query='karpenter_pending_capacity_additional_nodes_needed{name="group-a"}',
+                                target=MetricTarget(type="AverageValue", value=1),
+                            )
+                        )
+                    ],
+                ),
+            )
+        )
+
+        runtime.manager.reconcile_all()
+        runtime.manager.reconcile_all()
+        # 6 pods x 2cpu on 4cpu nodes -> 3 additional nodes -> desired 3
+        ha = runtime.store.get("HorizontalAutoscaler", "default", "group-a")
+        assert ha.status.desired_replicas == 3
+        assert provider.node_replicas["group-a"] == 3
