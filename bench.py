@@ -1,0 +1,124 @@
+"""Headline benchmark: the north-star bin-pack (BASELINE.json).
+
+100k pending pods × 300 instance types — resource fit + taint/toleration +
+required-label feasibility, first-feasible assignment, shelf-BFD node counts
+— as one device call. The reference STUBS this signal entirely
+(pkg/metrics/producers/pendingcapacity/producer.go:29-31) and its design doc
+warns the naive host-side form "scales linearly with node groups and
+unschedulable pods" (docs/designs/DESIGN.md); the baseline BUDGET here is
+the north-star target of 200 ms p50 on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline > 1 means faster than the 200 ms budget.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_MS = 200.0
+
+
+def build_inputs(pods: int, types: int, taints: int, labels: int, seed: int):
+    import jax.numpy as jnp
+
+    from karpenter_tpu.ops.binpack import BinPackInputs
+
+    rng = np.random.default_rng(seed)
+    R = 3  # cpu, memory, pods
+    # pod requests: cpu in cores, memory in GiB, 1 pod slot
+    req = np.stack(
+        [
+            rng.uniform(0.05, 8.0, pods),
+            rng.uniform(0.1, 32.0, pods),
+            np.ones(pods),
+        ],
+        axis=1,
+    ).astype(np.float32)
+    # instance types: cpu 2..128 cores, proportional memory, 110 pod slots
+    cpu = rng.choice([2, 4, 8, 16, 32, 64, 96, 128], types).astype(np.float32)
+    mem = cpu * rng.choice([2.0, 4.0, 8.0], types).astype(np.float32)
+    alloc = np.stack([cpu, mem, np.full(types, 110.0, np.float32)], axis=1)
+    intol = rng.random((pods, taints)) < 0.05
+    group_taints = rng.random((types, taints)) < 0.1
+    required = rng.random((pods, labels)) < 0.03
+    group_labels = rng.random((types, labels)) < 0.8
+    return BinPackInputs(
+        pod_requests=jnp.asarray(req),
+        pod_valid=jnp.ones((pods,), bool),
+        pod_intolerant=jnp.asarray(intol),
+        pod_required=jnp.asarray(required),
+        group_allocatable=jnp.asarray(alloc),
+        group_taints=jnp.asarray(group_taints),
+        group_labels=jnp.asarray(group_labels),
+    )
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pods", type=int, default=100_000)
+    ap.add_argument("--types", type=int, default=300)
+    ap.add_argument("--taints", type=int, default=64)
+    ap.add_argument("--labels", type=int, default=64)
+    ap.add_argument("--buckets", type=int, default=32)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    import jax
+
+    from karpenter_tpu.ops.binpack import binpack
+
+    print(
+        f"backend={jax.default_backend()} devices={jax.devices()}",
+        file=sys.stderr,
+    )
+    inputs = build_inputs(
+        args.pods, args.types, args.taints, args.labels, args.seed
+    )
+    inputs = jax.device_put(inputs)
+    jax.block_until_ready(inputs)
+
+    t0 = time.perf_counter()
+    out = binpack(inputs, buckets=args.buckets)
+    jax.block_until_ready(out)
+    compile_ms = (time.perf_counter() - t0) * 1e3
+    print(f"first call (compile+run): {compile_ms:.1f} ms", file=sys.stderr)
+
+    times = []
+    for _ in range(args.iters):
+        t0 = time.perf_counter()
+        out = binpack(inputs, buckets=args.buckets)
+        jax.block_until_ready(out)
+        times.append((time.perf_counter() - t0) * 1e3)
+    p50 = float(np.percentile(times, 50))
+    p95 = float(np.percentile(times, 95))
+    scheduled = int(np.sum(np.asarray(out.assigned) >= 0))
+    print(
+        f"p50={p50:.2f}ms p95={p95:.2f}ms scheduled={scheduled}/{args.pods} "
+        f"unschedulable={int(out.unschedulable)} "
+        f"nodes={int(np.sum(np.asarray(out.nodes_needed)))}",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"pending-pods bin-pack p50 latency, "
+                    f"{args.pods} pods x {args.types} instance types"
+                ),
+                "value": round(p50, 3),
+                "unit": "ms",
+                "vs_baseline": round(BASELINE_MS / p50, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

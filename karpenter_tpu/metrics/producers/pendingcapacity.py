@@ -12,8 +12,15 @@ the store snapshot into fixed-shape arrays:
 
 - pending pods = Pods with no nodeName (the unschedulable set)
 - each producer's node group contributes one row of the type matrix: its
-  per-node shape is the elementwise max allocatable over ready+schedulable
-  nodes (labels: intersection; taints: union — conservative on both sides)
+  per-node shape is the elementwise MIN allocatable over ready+schedulable
+  nodes (labels: intersection; taints: union — conservative on all three
+  axes: a scale-up signal must never claim feasibility that no real node
+  shape of the group can satisfy)
+- the resource universe is dynamic: cpu/memory/pods plus every extended
+  resource (GPUs, TPUs, ephemeral-storage, ...) appearing in pending-pod
+  requests or node allocatables, padded for compile stability; a pod
+  requesting a resource a group doesn't provide fails fit there, and a pod
+  requesting a resource no group provides counts as unschedulable
 - taint and label universes are encoded into padded bitsets so the device
   feasibility math is two boolean matmuls (see ops/binpack.py)
 
@@ -25,12 +32,18 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
+import jax.numpy as jnp
 import numpy as np
 
-from karpenter_tpu.api.core import Taint, is_ready_and_schedulable
+from karpenter_tpu.api.core import (
+    Taint,
+    is_ready_and_schedulable,
+    matches_selector,
+)
 from karpenter_tpu.api.metricsproducer import PendingCapacityStatus
 from karpenter_tpu.metrics.registry import GaugeRegistry, default_registry
 from karpenter_tpu.ops import binpack as B
+from karpenter_tpu.utils.functional import pad_to_multiple
 
 SUBSYSTEM = "pending_capacity"
 PENDING_PODS = "pending_pods"
@@ -38,7 +51,11 @@ ADDITIONAL_NODES_NEEDED = "additional_nodes_needed"
 LP_LOWER_BOUND = "lp_lower_bound"
 UNSCHEDULABLE_PODS = "unschedulable_pods"
 
-RESOURCES = ("cpu", "memory", "pods")
+# base resources always present; the per-solve universe adds any extended
+# resources (GPUs/TPUs/ephemeral-storage/...) seen in requests or allocatable,
+# with the 'pods' slot axis always LAST (each pod occupies exactly 1)
+RESOURCES_BASE = ("cpu", "memory")
+RESOURCE_PODS = "pods"
 
 # pad buckets for stable compiled shapes; universes GROW in these steps
 # rather than truncating (silent constraint drops = false feasibility)
@@ -46,6 +63,7 @@ TAINT_PAD = 32
 LABEL_PAD = 64
 POD_PAD = 256  # pods padded to a multiple of this
 GROUP_PAD = 8
+RESOURCE_PAD = 4
 
 # kubernetes' default max-pods when a node doesn't report a 'pods' allocatable
 DEFAULT_PODS_PER_NODE = 110.0
@@ -61,29 +79,48 @@ def register_gauges(registry: GaugeRegistry) -> None:
         registry.register(SUBSYSTEM, name)
 
 
-def _pad(n: int, bucket: int) -> int:
-    return max(bucket, ((n + bucket - 1) // bucket) * bucket)
+_pad = pad_to_multiple
 
 
-def _group_profile(store, selector) -> Tuple[np.ndarray, set, set]:
-    """(allocatable[R], labels set, taints set) for one node group.
+def _group_profile(
+    nodes: List, selector: Dict[str, str]
+) -> Tuple[Dict[str, float], set, set]:
+    """(allocatable by resource name, labels set, taints set) for one group.
 
     Ready+schedulable nodes define the group's shape; when the group is empty
     we fall back to any node matching the selector (a group scaled to zero
     still needs a shape to reason about — a limitation shared with every
     pending-pods autoscaler that lacks instance-type metadata).
+
+    The shape is the elementwise MIN over candidate nodes (a resource a node
+    lacks counts as 0): in a heterogeneous group, claiming the max across
+    nodes would invent a phantom node shape no real scale-up can deliver,
+    and the signal would demand nodes forever without ever scheduling the
+    pod. Min keeps the promise: any node the group adds can host what we
+    report feasible.
+
+    `nodes` is the full node list (listed ONCE per solve by the caller);
+    selector filtering happens here to avoid O(groups) store scans.
     """
-    nodes = store.list("Node", label_selector=selector)
-    ready = [n for n in nodes if is_ready_and_schedulable(n)]
-    candidates = ready or nodes
-    alloc = np.zeros(len(RESOURCES), np.float32)
+    matching = [
+        n for n in nodes if matches_selector(n.metadata.labels, selector)
+    ]
+    ready = [n for n in matching if is_ready_and_schedulable(n)]
+    candidates = ready or matching
+    alloc: Dict[str, float] = {}
     labels: set = set()
     taints: set = set()
     for i, node in enumerate(candidates):
-        for r, resource in enumerate(RESOURCES):
-            q = node.status.allocatable.get(resource)
-            if q is not None:
-                alloc[r] = max(alloc[r], q.to_float())
+        node_alloc = {
+            r: q.to_float() for r, q in node.status.allocatable.items()
+        }
+        if i == 0:
+            alloc = node_alloc
+        else:
+            alloc = {
+                r: min(alloc.get(r, 0.0), node_alloc.get(r, 0.0))
+                for r in set(alloc) | set(node_alloc)
+            }
         node_labels = set(node.metadata.labels.items())
         labels = node_labels if i == 0 else (labels & node_labels)
         # only hard taints exclude pods; PreferNoSchedule is a preference
@@ -93,8 +130,8 @@ def _group_profile(store, selector) -> Tuple[np.ndarray, set, set]:
             for t in node.spec.taints
             if t.effect in ("NoSchedule", "NoExecute")
         }
-    if candidates and alloc[RESOURCES.index("pods")] <= 0:
-        alloc[RESOURCES.index("pods")] = DEFAULT_PODS_PER_NODE
+    if candidates and alloc.get(RESOURCE_PODS, 0.0) <= 0:
+        alloc[RESOURCE_PODS] = DEFAULT_PODS_PER_NODE
     return alloc, labels, taints
 
 
@@ -107,8 +144,6 @@ def solve_pending(store, due_producers: List, registry: GaugeRegistry) -> None:
     due producers (the engine persists those); gauges are refreshed for every
     group since they are global registry state.
     """
-    import jax.numpy as jnp
-
     due_keys = {
         (mp.metadata.namespace, mp.metadata.name): mp for mp in due_producers
     }
@@ -133,10 +168,35 @@ def solve_pending(store, due_producers: List, registry: GaugeRegistry) -> None:
         if not p.spec.node_name and p.status.phase in ("", "Pending")
     ]
 
+    nodes = store.list("Node")  # listed ONCE; profiles filter in-memory
     profiles = [
-        _group_profile(store, mp.spec.pending_capacity.node_selector)
+        _group_profile(nodes, mp.spec.pending_capacity.node_selector)
         for mp in producers
     ]
+
+    # resource universe: base + every extended resource seen in pending-pod
+    # requests or group shapes; 'pods' slot last, padded for compile
+    # stability. A pod requesting a resource absent from a group's shape
+    # fails fit there (req > alloc=0) — extended resources are constraints,
+    # never silently dropped.
+    pod_request_dicts = [
+        {r: q.to_float() for r, q in pod.requests().items()} for pod in pods
+    ]
+    extended: set = set()
+    for req in pod_request_dicts:
+        extended |= {
+            r
+            for r, v in req.items()
+            if r not in RESOURCES_BASE and r != RESOURCE_PODS and v > 0
+        }
+    for alloc, _, _ in profiles:
+        extended |= {
+            r
+            for r in alloc
+            if r not in RESOURCES_BASE and r != RESOURCE_PODS
+        }
+    resources = [*RESOURCES_BASE, *sorted(extended), RESOURCE_PODS]
+    n_resources = _pad(len(resources), RESOURCE_PAD)
 
     # encode universes; sized to the data (padded), never truncated
     taint_universe: Dict[tuple, int] = {}
@@ -161,29 +221,50 @@ def solve_pending(store, due_producers: List, registry: GaugeRegistry) -> None:
         for taint, k in taint_universe.items()
     }
 
-    pod_requests = np.zeros((n_pods, len(RESOURCES)), np.float32)
+    # Host-side encode is the feeding path (SURVEY.md §7 hard part (d)): it
+    # iterates each pod's SPARSE items (its own requests/selector entries,
+    # which are guaranteed universe keys), not the full K/L/R universes, and
+    # dedupes the toleration→intolerance row by distinct toleration sets —
+    # fleets share a handful of toleration shapes, so the O(K·tolerations)
+    # check runs once per shape, not once per pod.
+    pod_requests = np.zeros((n_pods, n_resources), np.float32)
     pod_valid = np.zeros(n_pods, bool)
     pod_intolerant = np.zeros((n_pods, n_taints), bool)
     pod_required = np.zeros((n_pods, n_labels), bool)
+    pod_slot = resources.index(RESOURCE_PODS)
+    resource_index = {r: idx for idx, r in enumerate(resources)}
+    intolerance_rows: Dict[tuple, np.ndarray] = {}
     for i, pod in enumerate(pods):
-        requests = pod.requests()
-        for r, resource in enumerate(RESOURCES[:-1]):
-            q = requests.get(resource)
-            pod_requests[i, r] = q.to_float() if q is not None else 0.0
-        pod_requests[i, len(RESOURCES) - 1] = 1.0  # each pod occupies 1 slot
+        for r, v in pod_request_dicts[i].items():
+            idx = resource_index.get(r)
+            if idx is not None and idx != pod_slot:
+                pod_requests[i, idx] = v
+        pod_requests[i, pod_slot] = 1.0  # each pod occupies 1 slot
         pod_valid[i] = True
-        for k, taint in taint_objects.items():
-            pod_intolerant[i, k] = not any(
-                tol.tolerates(taint) for tol in pod.spec.tolerations
+        shape = tuple(
+            sorted(
+                (t.key, t.operator, t.value, t.effect)
+                for t in pod.spec.tolerations
             )
-        for item, l in label_universe.items():
-            pod_required[i, l] = pod.spec.node_selector.get(item[0]) == item[1]
+        )
+        row = intolerance_rows.get(shape)
+        if row is None:
+            row = np.zeros(n_taints, bool)
+            for k, taint in taint_objects.items():
+                row[k] = not any(
+                    tol.tolerates(taint) for tol in pod.spec.tolerations
+                )
+            intolerance_rows[shape] = row
+        pod_intolerant[i] = row
+        for item in pod.spec.node_selector.items():
+            pod_required[i, label_universe[item]] = True
 
-    group_allocatable = np.zeros((n_groups, len(RESOURCES)), np.float32)
+    group_allocatable = np.zeros((n_groups, n_resources), np.float32)
     group_taints = np.zeros((n_groups, n_taints), bool)
     group_labels = np.zeros((n_groups, n_labels), bool)
     for t, (alloc, labels, taints) in enumerate(profiles):
-        group_allocatable[t] = alloc
+        for r, resource in enumerate(resources):
+            group_allocatable[t, r] = alloc.get(resource, 0.0)
         for taint, k in taint_universe.items():
             group_taints[t, k] = taint in taints
         for item, l in label_universe.items():

@@ -20,3 +20,13 @@ def merge_into(dest, *srcs):
             if value is not None:
                 setattr(dest, field.name, value)
     return dest
+
+
+def pad_to_multiple(n: int, bucket: int) -> int:
+    """Round n up to a multiple of bucket, with a floor of one bucket.
+
+    The shared padding policy for compiled shapes (solver universes, mesh
+    divisibility): sizes GROW to the next bucket so recompiles happen only on
+    bucket crossings, and padded slots are masked, never truncated.
+    """
+    return max(bucket, ((n + bucket - 1) // bucket) * bucket)

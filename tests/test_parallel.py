@@ -1,0 +1,120 @@
+"""Multi-chip sharding: the sharded solver must agree exactly with the
+single-device solver (bitwise on int outputs), on every mesh shape the
+8-device CPU harness can express."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from karpenter_tpu.ops.binpack import binpack
+from karpenter_tpu.ops.decision import decide_jit
+from karpenter_tpu.parallel import (
+    AXIS_GROUPS,
+    AXIS_PODS,
+    build_mesh,
+    dryrun_fleet_step,
+    factorize,
+    fleet_step,
+    pad_binpack_inputs_for_mesh,
+    shard_binpack_inputs,
+    shard_decision_inputs,
+    sharded_binpack,
+    sharded_decide,
+)
+from karpenter_tpu.parallel.mesh import (
+    example_binpack_inputs,
+    example_decision_inputs,
+)
+
+
+def test_factorize_pods_major():
+    assert factorize(8) == (4, 2)
+    assert factorize(4) == (2, 2)
+    assert factorize(2) == (2, 1)
+    assert factorize(1) == (1, 1)
+    assert factorize(6) == (3, 2)
+
+
+def test_build_mesh_shapes():
+    mesh = build_mesh(n_devices=8)
+    assert mesh.shape[AXIS_PODS] == 4
+    assert mesh.shape[AXIS_GROUPS] == 2
+
+
+@pytest.mark.parametrize("n_devices", [1, 2, 4, 8])
+def test_sharded_binpack_matches_single_device(n_devices):
+    inputs = example_binpack_inputs(P_=64, T=8, K=8, L=8, seed=3)
+    ref = binpack(inputs, buckets=8)
+    mesh = build_mesh(n_devices=n_devices)
+    out = sharded_binpack(mesh, inputs, buckets=8)
+    np.testing.assert_array_equal(
+        np.asarray(out.assigned), np.asarray(ref.assigned)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out.assigned_count), np.asarray(ref.assigned_count)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out.nodes_needed), np.asarray(ref.nodes_needed)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out.lp_bound), np.asarray(ref.lp_bound)
+    )
+    assert int(out.unschedulable) == int(ref.unschedulable)
+
+
+@pytest.mark.parametrize("n_devices", [2, 8])
+def test_sharded_decide_matches_single_device(n_devices):
+    inputs = example_decision_inputs(N=32, M=4, seed=7)
+    ref = decide_jit(inputs)
+    mesh = build_mesh(n_devices=n_devices)
+    out = sharded_decide(mesh, inputs)
+    np.testing.assert_array_equal(
+        np.asarray(out.desired), np.asarray(ref.desired)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out.able_to_scale), np.asarray(ref.able_to_scale)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out.scaling_unbounded), np.asarray(ref.scaling_unbounded)
+    )
+
+
+def test_padding_masks_not_truncates():
+    # P=33, T=5 on a 4x2 mesh: P pads to 36, T to 6; results for the real
+    # rows/columns must be unchanged
+    inputs = example_binpack_inputs(P_=33, T=5, K=8, L=8, seed=11)
+    ref = binpack(inputs, buckets=8)
+    mesh = build_mesh(n_devices=8)
+    padded = pad_binpack_inputs_for_mesh(inputs, mesh)
+    assert padded.pod_requests.shape[0] % 4 == 0
+    assert padded.group_allocatable.shape[0] % 2 == 0
+    out = sharded_binpack(mesh, inputs, buckets=8)
+    np.testing.assert_array_equal(
+        np.asarray(out.assigned)[:33], np.asarray(ref.assigned)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out.nodes_needed)[:5], np.asarray(ref.nodes_needed)
+    )
+    # padding columns got no pods
+    assert np.all(np.asarray(out.assigned_count)[5:] == 0)
+    assert int(out.unschedulable) == int(ref.unschedulable)
+
+
+def test_fleet_step_combined():
+    mesh = build_mesh(n_devices=8)
+    d_in = shard_decision_inputs(mesh, example_decision_inputs(N=16))
+    b_in = shard_binpack_inputs(mesh, example_binpack_inputs(P_=32, T=8))
+    d_out, b_out = fleet_step(d_in, b_in, buckets=8)
+    jax.block_until_ready((d_out, b_out))
+    ref_d = decide_jit(example_decision_inputs(N=16))
+    np.testing.assert_array_equal(
+        np.asarray(d_out.desired)[:16], np.asarray(ref_d.desired)
+    )
+    total = int(jnp.sum(b_out.assigned_count)) + int(b_out.unschedulable)
+    assert total == 32
+
+
+@pytest.mark.parametrize("n_devices", [1, 4, 8])
+def test_dryrun_fleet_step(n_devices):
+    dryrun_fleet_step(n_devices)

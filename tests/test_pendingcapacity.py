@@ -279,3 +279,75 @@ class TestPendingCapacityDrivesAutoscaling:
         ha = runtime.store.get("HorizontalAutoscaler", "default", "group-a")
         assert ha.status.desired_replicas == 3
         assert provider.node_replicas["group-a"] == 3
+
+
+class TestConservativeGroupShape:
+    def test_heterogeneous_group_uses_min_shape(self, env):
+        """A pod that only fits the elementwise-MAX phantom of two real node
+        shapes must NOT be reported schedulable (max would loop scale-ups
+        forever without ever placing the pod)."""
+        runtime, provider, clock = env
+        selector = {"group": "het"}
+        runtime.store.create(
+            ready_node("big-cpu", selector, cpu="4", memory="2Gi")
+        )
+        runtime.store.create(
+            ready_node("big-mem", selector, cpu="2", memory="8Gi")
+        )
+        # needs cpu=4 AND mem=8Gi: no real node shape can host it
+        runtime.store.create(pending_pod("phantom", cpu="4", memory="8Gi"))
+        # fits the min shape (cpu<=2, mem<=2Gi): genuinely schedulable
+        runtime.store.create(pending_pod("real", cpu="1", memory="1Gi"))
+        runtime.store.create(pending_mp("het", selector))
+
+        runtime.manager.reconcile_all()
+        mp = runtime.store.get("MetricsProducer", "default", "het")
+        assert mp.status.pending_capacity.pending_pods == 1
+        assert mp.status.pending_capacity.unschedulable_pods == 1
+        assert mp.status.pending_capacity.additional_nodes_needed == 1
+
+
+class TestExtendedResources:
+    def test_extended_resource_is_a_constraint(self, env):
+        """A pod requesting an extended resource (tpu chips) must not be
+        packed onto a group whose nodes lack it — and must be packed onto a
+        group that has it."""
+        runtime, provider, clock = env
+        cpu_only = ready_node("cpu-node", {"group": "cpu"})
+        tpu_node = ready_node("tpu-node", {"group": "tpu"})
+        tpu_node.status.allocatable["google.com/tpu"] = (
+            cpu_only.status.allocatable["cpu"].__class__.parse("4")
+        )
+        runtime.store.create(cpu_only)
+        runtime.store.create(tpu_node)
+
+        accel = pending_pod("accel", cpu="1", memory="1Gi")
+        accel.spec.containers[0].requests["google.com/tpu"] = (
+            cpu_only.status.allocatable["cpu"].__class__.parse("2")
+        )
+        runtime.store.create(accel)
+        runtime.store.create(pending_mp("cpu-group", {"group": "cpu"}))
+        runtime.store.create(pending_mp("tpu-group", {"group": "tpu"}))
+
+        runtime.manager.reconcile_all()
+        cpu_mp = runtime.store.get("MetricsProducer", "default", "cpu-group")
+        tpu_mp = runtime.store.get("MetricsProducer", "default", "tpu-group")
+        assert cpu_mp.status.pending_capacity.pending_pods == 0
+        assert tpu_mp.status.pending_capacity.pending_pods == 1
+        assert tpu_mp.status.pending_capacity.additional_nodes_needed == 1
+        assert tpu_mp.status.pending_capacity.unschedulable_pods == 0
+
+    def test_unprovided_extended_resource_is_unschedulable(self, env):
+        runtime, provider, clock = env
+        runtime.store.create(ready_node("n", {"group": "cpu"}))
+        gpu = pending_pod("gpu", cpu="1", memory="1Gi")
+        gpu.spec.containers[0].requests["nvidia.com/gpu"] = (
+            gpu.spec.containers[0].requests["cpu"].__class__.parse("1")
+        )
+        runtime.store.create(gpu)
+        runtime.store.create(pending_mp("cpu-group", {"group": "cpu"}))
+
+        runtime.manager.reconcile_all()
+        mp = runtime.store.get("MetricsProducer", "default", "cpu-group")
+        assert mp.status.pending_capacity.pending_pods == 0
+        assert mp.status.pending_capacity.unschedulable_pods == 1
