@@ -1,0 +1,102 @@
+"""Process entry point: `python -m karpenter_tpu`.
+
+reference: cmd/controller/main.go:40-77 — flag parsing, logging, a
+leader-elected manager serving /metrics on :8080, cloud-provider registry,
+factory graph, controller registration, run-until-signalled. Same wiring
+here, with the reference's admission webhooks replaced by in-process
+admission (store-side validation) so there is no webhook port.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from karpenter_tpu.leaderelection import LeaderElector
+from karpenter_tpu.observability import MetricsServer, start_profiler_server
+from karpenter_tpu.runtime import KarpenterRuntime, Options
+from karpenter_tpu.utils.log import setup as log_setup
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(
+        prog="karpenter_tpu",
+        description="TPU-native metrics-driven autoscaling control plane",
+    )
+    parser.add_argument("--verbose", action="store_true")
+    parser.add_argument(
+        "--prometheus-uri",
+        default=None,
+        help="Prometheus HTTP API base URI; omit to read the in-process "
+        "gauge registry directly",
+    )
+    parser.add_argument("--metrics-port", type=int, default=8080)
+    parser.add_argument(
+        "--cloud-provider",
+        default=None,
+        help="provider name from the registry (fake, aws, ...); defaults to "
+        "KARPENTER_CLOUD_PROVIDER or the not-implemented fake",
+    )
+    parser.add_argument(
+        "--leader-elect",
+        action=argparse.BooleanOptionalAction,
+        default=True,
+    )
+    parser.add_argument(
+        "--profiler-port",
+        type=int,
+        default=0,
+        help="start the JAX profiler server on this port (0 = off)",
+    )
+    parser.add_argument(
+        "--duration",
+        type=float,
+        default=float("inf"),
+        help="seconds to run before exiting (default: forever)",
+    )
+    parser.add_argument("--tick", type=float, default=0.1)
+    return parser.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    log_setup(verbose=args.verbose)
+
+    runtime = KarpenterRuntime(
+        Options(
+            prometheus_uri=args.prometheus_uri,
+            cloud_provider=args.cloud_provider,
+            verbose=args.verbose,
+        )
+    )
+    metrics_server = MetricsServer(runtime.registry, port=args.metrics_port)
+    port = metrics_server.start()
+    print(f"serving /metrics and /healthz on :{port}", file=sys.stderr)
+    if args.profiler_port:
+        if start_profiler_server(args.profiler_port):
+            print(
+                f"jax profiler listening on :{args.profiler_port}",
+                file=sys.stderr,
+            )
+
+    elector = (
+        LeaderElector(runtime.store, clock=runtime.clock)
+        if args.leader_elect
+        else None
+    )
+    deadline = runtime.clock() + args.duration
+    try:
+        while runtime.clock() < deadline:
+            if elector is None or elector.try_acquire():
+                runtime.manager.reconcile_all()
+            time.sleep(args.tick)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        metrics_server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

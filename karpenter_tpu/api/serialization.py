@@ -1,0 +1,189 @@
+"""YAML/dict codec for the API types — kubectl-manifest fidelity.
+
+reference: the CRD YAML shapes in docs/examples/*.yaml and the kubebuilder
+JSON tags on the Go structs (e.g. pkg/apis/autoscaling/v1alpha1/
+horizontalautoscaler.go:33-58 `json:"scaleTargetRef"`, metricsproducer.go:
+22-44 `json:"scheduleSpec"`). The reference gets (de)serialization from the
+apiserver + controller-gen; here a reflective codec hydrates the Python
+dataclasses from the SAME manifests, so the reference's docs/examples drive
+this framework's tests unchanged (the envtest pattern,
+pkg/test/environment/namespace.go:57-83).
+
+Key mapping is mechanical camelCase<->snake_case with per-field overrides
+for the places the reference's JSON tag differs from the Go field
+(`scheduleSpec` -> ScheduleSpec field `schedule`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import typing
+from typing import Any, Dict, List, Optional, Type
+
+import yaml
+
+from karpenter_tpu.api.core import Node, ObjectMeta, Pod
+from karpenter_tpu.api.horizontalautoscaler import HorizontalAutoscaler
+from karpenter_tpu.api.metricsproducer import MetricsProducer
+from karpenter_tpu.api.scalablenodegroup import ScalableNodeGroup
+from karpenter_tpu.utils.quantity import Quantity
+
+API_VERSION = "autoscaling.karpenter.sh/v1alpha1"
+
+KINDS: Dict[str, type] = {
+    "HorizontalAutoscaler": HorizontalAutoscaler,
+    "MetricsProducer": MetricsProducer,
+    "ScalableNodeGroup": ScalableNodeGroup,
+    # core kinds so test fixtures can be manifests too
+    "Node": Node,
+    "Pod": Pod,
+}
+
+# YAML key -> dataclass field, where mechanical mapping doesn't hold
+# (reference JSON tags vs field names)
+_KEY_TO_FIELD = {
+    "scheduleSpec": "schedule",
+    "apiVersion": "api_version",
+}
+_FIELD_TO_KEY = {v: k for k, v in _KEY_TO_FIELD.items()}
+
+_CAMEL_RE = re.compile(r"(?<!^)(?=[A-Z])")
+
+
+def camel_to_snake(name: str) -> str:
+    return _CAMEL_RE.sub("_", name).lower()
+
+
+def snake_to_camel(name: str) -> str:
+    head, *rest = name.split("_")
+    return head + "".join(part.capitalize() for part in rest)
+
+
+def _field_types(cls: type) -> Dict[str, Any]:
+    return typing.get_type_hints(cls)
+
+
+def _unwrap_optional(tp: Any) -> Any:
+    if typing.get_origin(tp) is typing.Union:
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def _coerce(value: Any, tp: Any) -> Any:
+    tp = _unwrap_optional(tp)
+    if value is None:
+        return None
+    origin = typing.get_origin(tp)
+    if origin in (list, List):
+        (item_tp,) = typing.get_args(tp) or (Any,)
+        return [_coerce(v, item_tp) for v in value]
+    if origin in (dict, Dict):
+        args = typing.get_args(tp)
+        val_tp = args[1] if len(args) == 2 else Any
+        return {k: _coerce(v, val_tp) for k, v in value.items()}
+    if tp is Quantity:
+        return Quantity.parse(str(value))
+    if dataclasses.is_dataclass(tp):
+        return from_dict(tp, value)
+    if tp is float:
+        return float(value)
+    if tp is int:
+        return int(value)
+    if tp is str:
+        return str(value)
+    if tp is bool:
+        return bool(value)
+    return value
+
+
+def from_dict(cls: Type, data: Dict[str, Any]):
+    """Hydrate dataclass `cls` from a manifest-shaped dict (camelCase keys).
+    Unknown keys are an error — same posture as apiserver structural schemas
+    (silently dropped config is misconfig that 'works')."""
+    if data is None:
+        data = {}
+    types = _field_types(cls)
+    field_names = {f.name for f in dataclasses.fields(cls)}
+    kwargs = {}
+    for key, value in data.items():
+        if key in ("apiVersion", "kind") and "api_version" not in field_names:
+            continue  # envelope keys on top-level kinds
+        field = _KEY_TO_FIELD.get(key, camel_to_snake(key))
+        if field not in field_names:
+            raise ValueError(
+                f"unknown field {key!r} for {cls.__name__} "
+                f"(known: {sorted(field_names)})"
+            )
+        kwargs[field] = _coerce(value, types[field])
+    return cls(**kwargs)
+
+
+_META_INTERNAL = ("uid", "resource_version", "creation_timestamp")
+
+
+def to_dict(obj, top_level: bool = True) -> Dict[str, Any]:
+    """Manifest-shaped dict (camelCase, defaults and None dropped)."""
+    assert dataclasses.is_dataclass(obj)
+    out: Dict[str, Any] = {}
+    if top_level and type(obj).__name__ in KINDS:
+        out["apiVersion"] = API_VERSION
+        out["kind"] = type(obj).__name__
+    for f in dataclasses.fields(obj):
+        value = getattr(obj, f.name)
+        if isinstance(obj, ObjectMeta) and f.name in _META_INTERNAL:
+            continue
+        if value is None or value == {} or value == []:
+            continue
+        key = _FIELD_TO_KEY.get(f.name, snake_to_camel(f.name))
+        out[key] = _value_to_plain(value)
+    return out
+
+
+def _value_to_plain(value: Any) -> Any:
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        return to_dict(value, top_level=False)
+    if isinstance(value, Quantity):
+        return str(value)
+    if isinstance(value, list):
+        return [_value_to_plain(v) for v in value]
+    if isinstance(value, dict):
+        return {k: _value_to_plain(v) for k, v in value.items()}
+    return value
+
+
+def from_manifest(doc: Dict[str, Any]):
+    """One YAML document (with apiVersion/kind envelope) -> API object."""
+    kind = doc.get("kind")
+    if kind not in KINDS:
+        raise ValueError(f"unknown kind {kind!r} (known: {sorted(KINDS)})")
+    api_version = doc.get("apiVersion", "")
+    if kind in ("HorizontalAutoscaler", "MetricsProducer", "ScalableNodeGroup"):
+        if api_version != API_VERSION:
+            raise ValueError(
+                f"unsupported apiVersion {api_version!r} for {kind}"
+            )
+    body = {k: v for k, v in doc.items() if k not in ("apiVersion", "kind")}
+    return from_dict(KINDS[kind], body)
+
+
+def load_yaml(text: str) -> List[Any]:
+    """All documents in a (possibly multi-doc) YAML string -> API objects."""
+    return [
+        from_manifest(doc)
+        for doc in yaml.safe_load_all(text)
+        if doc is not None
+    ]
+
+
+def load_yaml_file(path: str) -> List[Any]:
+    with open(path) as f:
+        return load_yaml(f.read())
+
+
+def dump_yaml(*objects) -> str:
+    return yaml.safe_dump_all(
+        [to_dict(o) for o in objects], sort_keys=False, default_flow_style=False
+    )

@@ -34,4 +34,18 @@ def new_factory(options: Optional[Options] = None, provider: Optional[str] = Non
     return factory_fn(options)
 
 
+def _aws_factory(options: Options):
+    from karpenter_tpu.cloudprovider.aws import AWSFactory
+
+    return AWSFactory(options)
+
+
+def _tpu_factory(options: Options):
+    from karpenter_tpu.cloudprovider.tpu import TPUFactory
+
+    return TPUFactory(options)
+
+
 register_provider("fake", lambda options: FakeFactory(options))
+register_provider("aws", _aws_factory)
+register_provider("tpu", _tpu_factory)

@@ -1,0 +1,191 @@
+"""TPU pod-slice pool provider: the TPU-native ScalableNodeGroup.
+
+The reference's "real" providers are AWS ASG/EKS node groups
+(pkg/cloudprovider/aws/{autoscalinggroup,managednodegroup}.go). The TPU
+deployment's replica unit is a GKE node pool of TPU pod slices: scaling the
+pool by one adds one whole slice (a topology like 2x4), so replicas count
+SLICES, not chips. Same SPI, same observation posture as the reference's
+ManagedNodeGroup: observed replicas come from ready+schedulable nodes in
+the store (the apiserver analog, managednodegroup.go:86-98), actuation goes
+through an injected duck-typed container API (the UpdateNodegroupConfig
+analog, managednodegroup.go:100-110).
+
+Unlike the reference's TODO-true Stabilized (autoscalinggroup.go:110-112),
+pools report unstable while a resize operation is in flight — the SNG
+controller then holds actuation, which matters for TPU slices where a
+partial slice is unusable.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Protocol, Tuple
+
+from karpenter_tpu.api.core import is_ready_and_schedulable
+from karpenter_tpu.api.scalablenodegroup import (
+    TPU_POD_SLICE_POOL,
+    register_scalable_node_group_validator,
+)
+from karpenter_tpu.cloudprovider import Options
+from karpenter_tpu.cloudprovider.fake import FakeFactory
+from karpenter_tpu.controllers.errors import RetryableError
+
+# GKE labels node-pool members with the pool name
+NODE_POOL_LABEL = "cloud.google.com/gke-nodepool"
+# TPU nodes additionally carry accelerator/topology labels
+TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+
+_POOL_ID_RE = re.compile(
+    r"^projects/(?P<project>[^/]+)/locations/(?P<location>[^/]+)"
+    r"(?:/clusters/(?P<cluster>[^/]+))?/nodePools/(?P<pool>[^/]+)$"
+)
+
+
+def parse_pool_id(id_: str) -> Tuple[str, str, str, str]:
+    """(project, location, cluster, pool) from a GKE-style resource name.
+    Cluster is optional in the short form."""
+    m = _POOL_ID_RE.match(id_)
+    if m is None:
+        raise ValueError(
+            f"invalid node pool id {id_!r}; want "
+            "projects/<p>/locations/<l>[/clusters/<c>]/nodePools/<name>"
+        )
+    return (
+        m.group("project"),
+        m.group("location"),
+        m.group("cluster") or "",
+        m.group("pool"),
+    )
+
+
+class ContainerAPI(Protocol):
+    """Duck-typed GKE container API seam (bind a google-cloud client or a
+    fake here)."""
+
+    def set_node_pool_size(
+        self, project: str, location: str, cluster: str, pool: str, size: int
+    ) -> None: ...
+
+    def pending_operations(
+        self, project: str, location: str, cluster: str, pool: str
+    ) -> List[str]:
+        """Names of in-flight resize/repair operations for the pool."""
+        ...
+
+
+class _NotImplementedContainerAPI:
+    def set_node_pool_size(self, project, location, cluster, pool, size):
+        raise RuntimeError(
+            "no container API client bound; inject one into TPUFactory to "
+            "actuate node pools"
+        )
+
+    def pending_operations(self, project, location, cluster, pool):
+        return []
+
+
+class TPUPodSlicePool:
+    def __init__(self, id_: str, api: ContainerAPI, store):
+        self.project, self.location, self.cluster, self.pool = parse_pool_id(
+            id_
+        )
+        self.api = api
+        self.store = store
+
+    def get_replicas(self) -> int:
+        """Ready slices = ready+schedulable nodes labeled with the pool name.
+        For multi-host slices every host-node carries the pool label; ready
+        hosts are divided by hosts-per-slice (conservative floor: a
+        partially-ready slice is not a replica). Hosts-per-slice is derived
+        from each node's OWN google.com/tpu allocatable (chips actually on
+        that host) against the slice topology — hardware generations differ
+        (4 chips/host on v4/v5p, 8 on single-host v5e/v6e shapes), so a
+        constant would halve or double the count."""
+        nodes = self.store.list(
+            "Node", label_selector={NODE_POOL_LABEL: self.pool}
+        )
+        ready = [n for n in nodes if is_ready_and_schedulable(n)]
+        if not ready:
+            return 0
+        hosts_per_slice = max(
+            (_hosts_per_slice(n) for n in ready), default=1
+        )
+        return len(ready) // max(hosts_per_slice, 1)
+
+    def set_replicas(self, count: int) -> None:
+        try:
+            self.api.set_node_pool_size(
+                self.project, self.location, self.cluster, self.pool, count
+            )
+        except RetryableError:
+            raise
+        except Exception as e:  # noqa: BLE001 — resize races are transient
+            wrapped = RetryableError(str(e), code="ResizeFailed")
+            wrapped.__cause__ = e
+            raise wrapped from e
+
+    def stabilized(self) -> Tuple[bool, str]:
+        pending = self.api.pending_operations(
+            self.project, self.location, self.cluster, self.pool
+        )
+        if pending:
+            return False, f"operations in flight: {', '.join(pending)}"
+        return True, ""
+
+
+# fallback when a node doesn't report google.com/tpu allocatable
+_DEFAULT_CHIPS_PER_HOST = 4
+# node allocatable resource name for TPU chips on GKE
+TPU_CHIP_RESOURCE = "google.com/tpu"
+
+
+def _hosts_per_slice(node) -> int:
+    """Hosts spanned by the slice this node belongs to: topology chip count
+    divided by the chips this host itself exposes (ceil — a remainder still
+    needs a host)."""
+    topology = node.metadata.labels.get(TPU_TOPOLOGY_LABEL)
+    if not topology:
+        return 1
+    try:
+        chips = 1
+        for dim in topology.lower().split("x"):
+            chips *= int(dim)
+    except ValueError:
+        return 1
+    chip_quantity = node.status.allocatable.get(TPU_CHIP_RESOURCE)
+    chips_per_host = (
+        int(chip_quantity.to_float())
+        if chip_quantity is not None and chip_quantity.to_float() > 0
+        else _DEFAULT_CHIPS_PER_HOST
+    )
+    return max(1, -(-chips // chips_per_host))
+
+
+class TPUFactory:
+    """Provider factory for TPU pod-slice pools; queues fall back to
+    not-implemented (pair with another provider for queue signals)."""
+
+    def __init__(
+        self,
+        options: Optional[Options] = None,
+        container_api: Optional[ContainerAPI] = None,
+    ):
+        options = options or Options()
+        self.store = options.store
+        self.container_api = container_api or _NotImplementedContainerAPI()
+        self._fallback = FakeFactory.not_implemented()
+
+    def node_group_for(self, spec):
+        if spec.type == TPU_POD_SLICE_POOL:
+            return TPUPodSlicePool(spec.id, self.container_api, self.store)
+        return self._fallback.node_group_for(spec)
+
+    def queue_for(self, spec):
+        return self._fallback.queue_for(spec)
+
+
+def _validate_pool(spec) -> None:
+    parse_pool_id(spec.id)
+
+
+register_scalable_node_group_validator(TPU_POD_SLICE_POOL, _validate_pool)

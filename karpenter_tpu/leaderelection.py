@@ -1,0 +1,96 @@
+"""Leader election over the object store's optimistic concurrency.
+
+reference: cmd/controller/main.go:58-59 enables controller-runtime's
+lease-based leader election (lease RBAC at config/rbac/role.yaml:62-71) so
+exactly one controller replica reconciles at a time. Here the lease is an
+object in the store (the apiserver-bus analog), acquired and renewed with
+compare-and-swap semantics: a stale resourceVersion loses the race, so two
+candidates can never both hold the lease — same invariant, same transport
+as all other cross-controller coordination.
+"""
+
+from __future__ import annotations
+
+import time as _time
+import uuid
+from dataclasses import dataclass, field
+from typing import Optional
+
+from karpenter_tpu.api.core import ObjectMeta
+from karpenter_tpu.store.store import ConflictError, Store
+
+DEFAULT_LEASE_NAME = "karpenter-leader"
+DEFAULT_LEASE_NAMESPACE = "kube-system"
+DEFAULT_LEASE_DURATION = 15.0
+
+
+@dataclass
+class Lease:
+    """coordination.k8s.io/v1 Lease analog."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    holder: str = ""
+    renew_time: float = 0.0
+    lease_duration: float = DEFAULT_LEASE_DURATION
+
+
+class LeaderElector:
+    """Acquire-or-renew on every tick; leadership is only ever held for one
+    lease_duration past the last successful renew."""
+
+    def __init__(
+        self,
+        store: Store,
+        identity: Optional[str] = None,
+        name: str = DEFAULT_LEASE_NAME,
+        namespace: str = DEFAULT_LEASE_NAMESPACE,
+        lease_duration: float = DEFAULT_LEASE_DURATION,
+        clock=_time.time,
+    ):
+        self.store = store
+        self.identity = identity or f"karpenter-{uuid.uuid4().hex[:8]}"
+        self.name = name
+        self.namespace = namespace
+        self.lease_duration = lease_duration
+        self.clock = clock
+
+    def try_acquire(self) -> bool:
+        """One election round: returns True iff this identity holds the
+        lease after the round. Safe to call every tick."""
+        now = self.clock()
+        lease = self.store.try_get("Lease", self.namespace, self.name)
+        if lease is None:
+            try:
+                self.store.create(
+                    Lease(
+                        metadata=ObjectMeta(
+                            name=self.name, namespace=self.namespace
+                        ),
+                        holder=self.identity,
+                        renew_time=now,
+                        lease_duration=self.lease_duration,
+                    )
+                )
+                return True
+            except ConflictError:
+                return False  # another candidate created it first
+        held_by_other = lease.holder != self.identity
+        expired = now > lease.renew_time + lease.lease_duration
+        if held_by_other and not expired:
+            return False
+        # renew (ours) or take over (expired): CAS via resourceVersion
+        lease.holder = self.identity
+        lease.renew_time = now
+        try:
+            self.store.update(lease)
+            return True
+        except ConflictError:
+            return False  # lost the race this round
+
+    def is_leader(self) -> bool:
+        lease = self.store.try_get("Lease", self.namespace, self.name)
+        return (
+            lease is not None
+            and lease.holder == self.identity
+            and self.clock() <= lease.renew_time + lease.lease_duration
+        )

@@ -1,0 +1,110 @@
+"""Observability: /metrics + /healthz HTTP endpoints and solver tracing.
+
+reference: the manager serves controller metrics on :8080
+(cmd/controller/main.go:52,61) scraped by a dedicated Prometheus via a 5s
+ServiceMonitor (config/prometheus/monitor.yaml:10-14); health/readiness come
+from the manager. The reference has NO tracing/profiling (OTel is future
+work, docs/designs/DESIGN.md) — the solver trace hooks here are an addition
+the TPU build needs: device-side timelines via the JAX profiler (xprof), so
+a 200 ms budget regression is attributable to feed vs compile vs compute.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import urlsplit
+
+from karpenter_tpu.metrics.registry import GaugeRegistry
+
+
+class MetricsServer:
+    """Serves the gauge registry in Prometheus text exposition format.
+
+    port=0 binds an ephemeral port (tests); `port` attribute holds the bound
+    port after start().
+    """
+
+    def __init__(self, registry: GaugeRegistry, port: int = 8080,
+                 host: str = "0.0.0.0"):
+        self.registry = registry
+        self.host = host
+        self.port = port
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        registry = self.registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                path = urlsplit(self.path).path.rstrip("/")
+                if path in ("", "/healthz", "/readyz"):
+                    body = b"ok"
+                    content_type = "text/plain"
+                elif path == "/metrics":
+                    body = registry.expose_text().encode()
+                    content_type = "text/plain; version=0.0.4"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # quiet: scrapes every 5s
+                pass
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+@contextlib.contextmanager
+def solver_trace(name: str):
+    """Annotate a host span so it shows up on the device timeline. No-op
+    when the profiler is unavailable. Only annotation SETUP is guarded —
+    exceptions from the traced block itself must propagate unchanged."""
+    annotation = None
+    try:
+        import jax.profiler
+
+        annotation = jax.profiler.TraceAnnotation(name)
+        annotation.__enter__()
+    except Exception:  # noqa: BLE001 — tracing must never break the solve
+        annotation = None
+    try:
+        yield
+    finally:
+        if annotation is not None:
+            try:
+                annotation.__exit__(None, None, None)
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def start_profiler_server(port: int = 9999) -> bool:
+    """Expose the JAX profiler so xprof/tensorboard can attach and capture
+    device traces of the solver. Returns False if unavailable."""
+    try:
+        import jax.profiler
+
+        jax.profiler.start_server(port)
+        return True
+    except Exception:  # noqa: BLE001
+        return False

@@ -207,14 +207,28 @@ def sharded_binpack(
 ) -> BinPackOutputs:
     """Run the bin-pack solver partitioned over the mesh. Inputs are
     device_put with NamedShardings; `binpack` is already jitted, so GSPMD
-    propagates the input shardings through the whole program."""
-    return binpack(shard_binpack_inputs(mesh, inputs), buckets=buckets)
+    propagates the input shardings through the whole program. Outputs are
+    sliced back to the caller's P/T — mesh padding is an implementation
+    detail, and padded rows (assigned=-1) must not leak into consumers that
+    count unschedulable pods."""
+    n_pods = inputs.pod_requests.shape[0]
+    n_groups = inputs.group_allocatable.shape[0]
+    out = binpack(shard_binpack_inputs(mesh, inputs), buckets=buckets)
+    return BinPackOutputs(
+        assigned=out.assigned[:n_pods],
+        assigned_count=out.assigned_count[:n_groups],
+        nodes_needed=out.nodes_needed[:n_groups],
+        lp_bound=out.lp_bound[:n_groups],
+        unschedulable=out.unschedulable,  # padding rows are ~pod_valid
+    )
 
 
 def sharded_decide(mesh: Mesh, inputs: DecisionInputs) -> DecisionOutputs:
     from karpenter_tpu.ops.decision import decide_jit
 
-    return decide_jit(shard_decision_inputs(mesh, inputs))
+    n = inputs.spec_replicas.shape[0]
+    out = decide_jit(shard_decision_inputs(mesh, inputs))
+    return jax.tree_util.tree_map(lambda x: x[:n] if x.ndim else x, out)
 
 
 @partial(jax.jit, static_argnames=("buckets",))

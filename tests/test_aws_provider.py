@@ -1,0 +1,357 @@
+"""AWS provider parity tests.
+
+Table cases mirror the reference's unit suites: ASG ARN normalization
+(pkg/cloudprovider/aws/autoscalinggroup_test.go:20-47), SQS queue length
+happy/error (sqsqueue_test.go:27-64), MNG ready-node counting
+(suite_test.go:45-62), plus transient-error classification (error.go:28-55)
+flowing through the ScalableNodeGroup controller.
+"""
+
+import pytest
+
+from karpenter_tpu.api.core import (
+    Node,
+    NodeCondition,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    resource_list,
+)
+from karpenter_tpu.api.metricsproducer import (
+    AWS_SQS_QUEUE_TYPE,
+    QueueSpec,
+    validate_queue,
+)
+from karpenter_tpu.api.scalablenodegroup import (
+    AWS_EC2_AUTO_SCALING_GROUP,
+    AWS_EKS_NODE_GROUP,
+    ScalableNodeGroup,
+    ScalableNodeGroupSpec,
+)
+from karpenter_tpu.cloudprovider import Options
+from karpenter_tpu.cloudprovider.aws import (
+    AWSAPIError,
+    AWSFactory,
+    AutoScalingGroup,
+    ManagedNodeGroup,
+    SQSQueue,
+    normalize_asg_id,
+    parse_arn,
+    parse_mng_id,
+    transient_error,
+)
+from karpenter_tpu.controllers.errors import error_code, is_retryable
+from karpenter_tpu.runtime import KarpenterRuntime
+from karpenter_tpu.store import Store
+
+ASG_ARN = (
+    "arn:aws:autoscaling:region:123456789012:"
+    "autoScalingGroup:uuid:autoScalingGroupName/asg-name"
+)
+MNG_ARN = (
+    "arn:aws:eks:us-west-2:741206201142:"
+    "nodegroup/ridiculous-sculpture-1594766004/ng-0b663e8a/aeb9a7fe"
+)
+SQS_ARN = "arn:aws:iam:us-west-2:112358132134:fibonacci"
+
+
+# --- fakes mirroring pkg/cloudprovider/aws/fake/ ---------------------------
+
+
+class FakeAutoscalingAPI:
+    def __init__(self, instances=(), want_err=None):
+        self.instances = list(instances)
+        self.want_err = want_err
+        self.updated = None
+
+    def describe_auto_scaling_groups(self, names, max_records):
+        if self.want_err:
+            raise self.want_err
+        return [{"instances": self.instances}]
+
+    def update_auto_scaling_group(self, name, desired_capacity):
+        if self.want_err:
+            raise self.want_err
+        self.updated = (name, desired_capacity)
+
+
+class FakeEKSAPI:
+    def __init__(self, want_err=None):
+        self.want_err = want_err
+        self.updated = None
+
+    def update_nodegroup_config(
+        self, cluster_name, nodegroup_name, desired_size
+    ):
+        if self.want_err:
+            raise self.want_err
+        self.updated = (cluster_name, nodegroup_name, desired_size)
+
+
+class FakeSQSAPI:
+    def __init__(self, url="oopsydaisy", attributes=None, want_err=None):
+        self.url = url
+        self.attributes = attributes or {}
+        self.want_err = want_err
+
+    def get_queue_url(self, queue_name, account_id):
+        if self.want_err:
+            raise self.want_err
+        return self.url
+
+    def get_queue_attributes(self, queue_url, attribute_names):
+        if self.want_err:
+            raise self.want_err
+        return self.attributes
+
+
+# --- ARN tables (reference: autoscalinggroup_test.go:20-47) ----------------
+
+
+class TestNormalizeASGID:
+    @pytest.mark.parametrize(
+        "value,expected",
+        [
+            ("", ""),
+            ("foo", "foo"),
+            (ASG_ARN, "asg-name"),
+            (
+                "arn:aws:autoscaling:region:123456789012:"
+                "autoScalingGroup:uuid:autoScalingGroupName/",
+                "",
+            ),
+        ],
+    )
+    def test_ok(self, value, expected):
+        assert normalize_asg_id(value) == expected
+
+    @pytest.mark.parametrize(
+        "value",
+        [
+            # missing the name specifier entirely
+            "arn:aws:autoscaling:region:123456789012:"
+            "autoScalingGroup:uuid:autoScalingGroupName",
+            # misspelled specifier
+            "arn:aws:autoscaling:region:123456789012:"
+            "autoScalingGroup:uuid:utoScalingGroupName/asg-name",
+            "arn:aws:autoscalin:region:123456789012:"
+            "autoScalingGroup:uuid:utoScalingGroupName/asg-name",
+        ],
+    )
+    def test_invalid(self, value):
+        with pytest.raises(ValueError):
+            normalize_asg_id(value)
+
+
+class TestParseMNGID:
+    def test_extracts_cluster_and_nodegroup(self):
+        assert parse_mng_id(MNG_ARN) == (
+            "ridiculous-sculpture-1594766004",
+            "ng-0b663e8a",
+        )
+
+    @pytest.mark.parametrize("value", ["not-an-arn", "arn:aws:eks:r:a:flat"])
+    def test_invalid(self, value):
+        with pytest.raises(ValueError):
+            parse_mng_id(value)
+
+
+class TestParseArn:
+    def test_resource_keeps_colons(self):
+        assert (
+            parse_arn(ASG_ARN).resource
+            == "autoScalingGroup:uuid:autoScalingGroupName/asg-name"
+        )
+
+    def test_fields(self):
+        arn = parse_arn(SQS_ARN)
+        assert arn.account_id == "112358132134"
+        assert arn.resource == "fibonacci"
+
+
+# --- ASG replica semantics (reference: autoscalinggroup.go:79-108) ---------
+
+
+class TestAutoScalingGroup:
+    def test_counts_only_healthy_in_service(self):
+        api = FakeAutoscalingAPI(
+            instances=[
+                {"health_status": "Healthy", "lifecycle_state": "InService"},
+                {"health_status": "Healthy", "lifecycle_state": "Pending"},
+                {"health_status": "Unhealthy", "lifecycle_state": "InService"},
+                {"health_status": "Healthy", "lifecycle_state": "InService"},
+            ]
+        )
+        assert AutoScalingGroup(ASG_ARN, api).get_replicas() == 2
+
+    def test_set_replicas_uses_normalized_name(self):
+        api = FakeAutoscalingAPI()
+        AutoScalingGroup(ASG_ARN, api).set_replicas(7)
+        assert api.updated == ("asg-name", 7)
+
+    def test_api_error_is_transient(self):
+        api = FakeAutoscalingAPI(
+            want_err=AWSAPIError("throttled", code="ThrottlingException")
+        )
+        asg = AutoScalingGroup("my-asg", api)
+        with pytest.raises(Exception) as e:
+            asg.get_replicas()
+        assert is_retryable(e.value)
+        assert error_code(e.value) == "ThrottlingException"
+
+    def test_non_retryable_code(self):
+        api = FakeAutoscalingAPI(
+            want_err=AWSAPIError("denied", code="AccessDenied")
+        )
+        with pytest.raises(Exception) as e:
+            AutoScalingGroup("my-asg", api).get_replicas()
+        assert not is_retryable(e.value)
+        assert error_code(e.value) == "AccessDenied"
+
+
+# --- MNG: store-observed replicas (reference: managednodegroup.go:86-110) --
+
+
+def eks_node(name, nodegroup, ready=True, schedulable=True):
+    return Node(
+        metadata=ObjectMeta(
+            name=name, labels={"eks.amazonaws.com/nodegroup": nodegroup}
+        ),
+        spec=NodeSpec(unschedulable=not schedulable),
+        status=NodeStatus(
+            allocatable=resource_list(cpu="4", memory="8Gi", pods="16"),
+            conditions=[NodeCondition("Ready", "True" if ready else "False")],
+        ),
+    )
+
+
+class TestManagedNodeGroup:
+    def test_counts_ready_schedulable_labeled_nodes(self):
+        store = Store()
+        store.create(eks_node("n1", "ng-0b663e8a"))
+        store.create(eks_node("n2", "ng-0b663e8a", ready=False))
+        store.create(eks_node("n3", "ng-0b663e8a", schedulable=False))
+        store.create(eks_node("n4", "other-group"))
+        mng = ManagedNodeGroup(MNG_ARN, FakeEKSAPI(), store)
+        assert mng.get_replicas() == 1
+
+    def test_set_replicas_targets_cluster_and_group(self):
+        api = FakeEKSAPI()
+        ManagedNodeGroup(MNG_ARN, api, Store()).set_replicas(3)
+        assert api.updated == (
+            "ridiculous-sculpture-1594766004",
+            "ng-0b663e8a",
+            3,
+        )
+
+
+# --- SQS (reference: sqsqueue_test.go:27-64) -------------------------------
+
+
+class TestSQSQueue:
+    def test_length(self):
+        api = FakeSQSAPI(
+            attributes={"ApproximateNumberOfMessages": "42"}
+        )
+        assert SQSQueue(SQS_ARN, api).length() == 42
+
+    def test_length_error(self):
+        api = FakeSQSAPI(want_err=RuntimeError("didn't work"))
+        with pytest.raises(RuntimeError):
+            SQSQueue(SQS_ARN, api).length()
+
+    def test_oldest_age_stub(self):
+        assert SQSQueue(SQS_ARN, FakeSQSAPI()).oldest_message_age_seconds() == 0
+
+
+# --- admission validators + factory dispatch -------------------------------
+
+
+class TestValidatorsAndFactory:
+    def test_asg_spec_validation(self):
+        ScalableNodeGroup(
+            metadata=ObjectMeta(name="ok"),
+            spec=ScalableNodeGroupSpec(
+                type=AWS_EC2_AUTO_SCALING_GROUP, id=ASG_ARN
+            ),
+        ).validate()
+
+    def test_mng_spec_validation_rejects_bad_arn(self):
+        sng = ScalableNodeGroup(
+            metadata=ObjectMeta(name="bad"),
+            spec=ScalableNodeGroupSpec(type=AWS_EKS_NODE_GROUP, id="nope"),
+        )
+        with pytest.raises(Exception):
+            sng.validate()
+
+    def test_sqs_queue_validation(self):
+        validate_queue(QueueSpec(type=AWS_SQS_QUEUE_TYPE, id=SQS_ARN))
+        with pytest.raises(Exception):
+            validate_queue(QueueSpec(type=AWS_SQS_QUEUE_TYPE, id="not-arn"))
+
+    def test_factory_dispatch(self):
+        store = Store()
+        factory = AWSFactory(
+            Options(store=store),
+            autoscaling_client=FakeAutoscalingAPI(),
+            eks_client=FakeEKSAPI(),
+            sqs_client=FakeSQSAPI(),
+        )
+        asg = factory.node_group_for(
+            ScalableNodeGroupSpec(type=AWS_EC2_AUTO_SCALING_GROUP, id="x")
+        )
+        mng = factory.node_group_for(
+            ScalableNodeGroupSpec(type=AWS_EKS_NODE_GROUP, id=MNG_ARN)
+        )
+        q = factory.queue_for(QueueSpec(type=AWS_SQS_QUEUE_TYPE, id=SQS_ARN))
+        assert isinstance(asg, AutoScalingGroup)
+        assert isinstance(mng, ManagedNodeGroup)
+        assert isinstance(q, SQSQueue)
+
+    def test_unbound_client_fails_with_guidance(self):
+        factory = AWSFactory(Options(store=Store()))
+        asg = factory.node_group_for(
+            ScalableNodeGroupSpec(type=AWS_EC2_AUTO_SCALING_GROUP, id="x")
+        )
+        with pytest.raises(Exception) as e:
+            asg.get_replicas()
+        assert "API client bound" in str(e.value.__cause__ or e.value)
+
+    def test_registry_selects_aws(self):
+        from karpenter_tpu.cloudprovider.registry import new_factory
+
+        factory = new_factory(Options(store=Store()), provider="aws")
+        assert isinstance(factory, AWSFactory)
+
+
+# --- transient errors keep the resource Active (controller.go:83-95) -------
+
+
+class TestRetryableThroughController:
+    def test_throttle_keeps_sng_active(self):
+        store = Store()
+        api = FakeAutoscalingAPI(
+            want_err=AWSAPIError("throttled", code="ThrottlingException")
+        )
+        provider = AWSFactory(Options(store=store), autoscaling_client=api)
+        runtime = KarpenterRuntime(
+            store=store, cloud_provider_factory=provider
+        )
+        store.create(
+            ScalableNodeGroup(
+                metadata=ObjectMeta(name="asg"),
+                spec=ScalableNodeGroupSpec(
+                    type=AWS_EC2_AUTO_SCALING_GROUP, id="my-asg", replicas=3
+                ),
+            )
+        )
+        runtime.manager.reconcile_all()
+        sng = store.get("ScalableNodeGroup", "default", "asg")
+        conditions = sng.status_conditions()
+        active = conditions.get("Active")
+        assert active is not None and active.status == "True"
+        able = conditions.get("AbleToScale")
+        assert able is not None and able.status == "False"
+
+    def test_transient_error_none_passthrough(self):
+        assert transient_error(None) is None

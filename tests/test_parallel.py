@@ -118,3 +118,20 @@ def test_fleet_step_combined():
 @pytest.mark.parametrize("n_devices", [1, 4, 8])
 def test_dryrun_fleet_step(n_devices):
     dryrun_fleet_step(n_devices)
+
+
+def test_sharded_outputs_sliced_to_input_sizes():
+    """Mesh padding must not leak: output shapes equal input P/T even when
+    padding occurred (P=33->36, T=5->6 on a 4x2 mesh)."""
+    inputs = example_binpack_inputs(P_=33, T=5, K=8, L=8, seed=13)
+    mesh = build_mesh(n_devices=8)
+    out = sharded_binpack(mesh, inputs, buckets=8)
+    assert out.assigned.shape == (33,)
+    assert out.nodes_needed.shape == (5,)
+    ref = binpack(inputs, buckets=8)
+    assert int(np.sum(np.asarray(out.assigned) == -1)) == int(
+        np.sum(np.asarray(ref.assigned) == -1)
+    )
+    d_in = example_decision_inputs(N=13, M=3, seed=17)
+    d_out = sharded_decide(mesh, d_in)
+    assert d_out.desired.shape == (13,)

@@ -1,0 +1,185 @@
+"""TPU pod-slice pool provider: the TPU-native node-group analog of the
+reference's AWS providers (managednodegroup.go observation posture, plus a
+real Stabilized instead of the reference's TODO-true)."""
+
+import pytest
+
+from karpenter_tpu.api.core import (
+    Node,
+    NodeCondition,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    resource_list,
+)
+from karpenter_tpu.api.scalablenodegroup import (
+    TPU_POD_SLICE_POOL,
+    ScalableNodeGroup,
+    ScalableNodeGroupSpec,
+)
+from karpenter_tpu.cloudprovider import Options
+from karpenter_tpu.cloudprovider.tpu import (
+    NODE_POOL_LABEL,
+    TPU_TOPOLOGY_LABEL,
+    TPUFactory,
+    TPUPodSlicePool,
+    parse_pool_id,
+)
+from karpenter_tpu.runtime import KarpenterRuntime
+from karpenter_tpu.store import Store
+
+POOL_ID = "projects/p/locations/us-central2-b/clusters/c/nodePools/train"
+POOL_ID_SHORT = "projects/p/locations/us-central2-b/nodePools/train"
+
+
+class FakeContainerAPI:
+    def __init__(self):
+        self.sizes = {}
+        self.operations = []
+        self.want_err = None
+
+    def set_node_pool_size(self, project, location, cluster, pool, size):
+        if self.want_err:
+            raise self.want_err
+        self.sizes[(project, location, cluster, pool)] = size
+
+    def pending_operations(self, project, location, cluster, pool):
+        return list(self.operations)
+
+
+def pool_node(name, pool="train", ready=True, topology=None):
+    labels = {NODE_POOL_LABEL: pool}
+    if topology:
+        labels[TPU_TOPOLOGY_LABEL] = topology
+    return Node(
+        metadata=ObjectMeta(name=name, labels=labels),
+        spec=NodeSpec(),
+        status=NodeStatus(
+            allocatable=resource_list(cpu="4", memory="8Gi", pods="16"),
+            conditions=[NodeCondition("Ready", "True" if ready else "False")],
+        ),
+    )
+
+
+class TestParsePoolID:
+    def test_full_form(self):
+        assert parse_pool_id(POOL_ID) == ("p", "us-central2-b", "c", "train")
+
+    def test_short_form(self):
+        assert parse_pool_id(POOL_ID_SHORT) == (
+            "p",
+            "us-central2-b",
+            "",
+            "train",
+        )
+
+    @pytest.mark.parametrize(
+        "bad", ["train", "projects/p/nodePools/x", "projects//locations/l/nodePools/x"]
+    )
+    def test_invalid(self, bad):
+        with pytest.raises(ValueError):
+            parse_pool_id(bad)
+
+
+class TestReplicas:
+    def test_counts_ready_single_host_slices(self):
+        store = Store()
+        store.create(pool_node("n1"))
+        store.create(pool_node("n2"))
+        store.create(pool_node("n3", ready=False))
+        store.create(pool_node("other", pool="serve"))
+        pool = TPUPodSlicePool(POOL_ID, FakeContainerAPI(), store)
+        assert pool.get_replicas() == 2
+
+    def test_multi_host_slices_count_whole_slices(self):
+        store = Store()
+        # 2x4 topology = 8 chips = 2 hosts per slice; 3 ready hosts = 1 slice
+        for i in range(3):
+            store.create(pool_node(f"n{i}", topology="2x4"))
+        pool = TPUPodSlicePool(POOL_ID, FakeContainerAPI(), store)
+        assert pool.get_replicas() == 1
+
+    def test_set_replicas_actuates_api(self):
+        api = FakeContainerAPI()
+        TPUPodSlicePool(POOL_ID, api, Store()).set_replicas(4)
+        assert api.sizes[("p", "us-central2-b", "c", "train")] == 4
+
+    def test_resize_error_is_retryable(self):
+        from karpenter_tpu.controllers.errors import is_retryable
+
+        api = FakeContainerAPI()
+        api.want_err = RuntimeError("stockout")
+        with pytest.raises(Exception) as e:
+            TPUPodSlicePool(POOL_ID, api, Store()).set_replicas(4)
+        assert is_retryable(e.value)
+
+
+class TestStabilized:
+    def test_stable_when_no_operations(self):
+        pool = TPUPodSlicePool(POOL_ID, FakeContainerAPI(), Store())
+        assert pool.stabilized() == (True, "")
+
+    def test_unstable_during_resize(self):
+        api = FakeContainerAPI()
+        api.operations = ["resize-op-1"]
+        stable, message = TPUPodSlicePool(POOL_ID, api, Store()).stabilized()
+        assert not stable
+        assert "resize-op-1" in message
+
+
+class TestThroughController:
+    def test_scale_up_via_controller(self):
+        store = Store()
+        api = FakeContainerAPI()
+        provider = TPUFactory(Options(store=store), container_api=api)
+        runtime = KarpenterRuntime(store=store, cloud_provider_factory=provider)
+        store.create(pool_node("n1"))
+        store.create(
+            ScalableNodeGroup(
+                metadata=ObjectMeta(name="train"),
+                spec=ScalableNodeGroupSpec(
+                    type=TPU_POD_SLICE_POOL, id=POOL_ID, replicas=3
+                ),
+            )
+        )
+        runtime.manager.reconcile_all()
+        sng = store.get("ScalableNodeGroup", "default", "train")
+        assert sng.status.replicas == 1  # observed from store
+        assert api.sizes[("p", "us-central2-b", "c", "train")] == 3
+        assert sng.status_conditions().is_happy()
+
+    def test_validation_rejects_bad_pool_id(self):
+        sng = ScalableNodeGroup(
+            metadata=ObjectMeta(name="bad"),
+            spec=ScalableNodeGroupSpec(type=TPU_POD_SLICE_POOL, id="nope"),
+        )
+        with pytest.raises(Exception):
+            sng.validate()
+
+
+class TestChipsPerHostDerivation:
+    def test_v5e_single_host_8_chip_slice(self):
+        """A 2x4 v5e slice on ONE 8-chip host must count 1 slice per host,
+        not 1 per 2 hosts."""
+        from karpenter_tpu.utils.quantity import Quantity
+
+        store = Store()
+        for i in range(3):
+            n = pool_node(f"n{i}", topology="2x4")
+            n.status.allocatable["google.com/tpu"] = Quantity.parse("8")
+            store.create(n)
+        pool = TPUPodSlicePool(POOL_ID, FakeContainerAPI(), store)
+        assert pool.get_replicas() == 3
+
+    def test_v4_multi_host_slice(self):
+        from karpenter_tpu.utils.quantity import Quantity
+
+        store = Store()
+        # 2x2x4 = 16 chips, 4 chips/host -> 4 hosts per slice; 8 ready
+        # hosts -> 2 slices
+        for i in range(8):
+            n = pool_node(f"n{i}", topology="2x2x4")
+            n.status.allocatable["google.com/tpu"] = Quantity.parse("4")
+            store.create(n)
+        pool = TPUPodSlicePool(POOL_ID, FakeContainerAPI(), store)
+        assert pool.get_replicas() == 2

@@ -1,0 +1,152 @@
+"""L7 wiring: leader election, /metrics endpoint, CLI entry point.
+
+reference: cmd/controller/main.go:40-77 (leader-elected manager, metrics
+:8080) and the lease RBAC in config/rbac/role.yaml:62-71.
+"""
+
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.__main__ import main as cli_main
+from karpenter_tpu.__main__ import parse_args
+from karpenter_tpu.leaderelection import LeaderElector
+from karpenter_tpu.metrics.registry import GaugeRegistry
+from karpenter_tpu.observability import MetricsServer, solver_trace
+from karpenter_tpu.store import Store
+
+
+class FakeClock:
+    def __init__(self, start=1000.0):
+        self.now = start
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, seconds):
+        self.now += seconds
+
+
+class TestLeaderElection:
+    def test_first_candidate_acquires(self):
+        store, clock = Store(), FakeClock()
+        a = LeaderElector(store, identity="a", clock=clock)
+        assert a.try_acquire()
+        assert a.is_leader()
+
+    def test_second_candidate_blocked_until_expiry(self):
+        store, clock = Store(), FakeClock()
+        a = LeaderElector(store, identity="a", clock=clock, lease_duration=15)
+        b = LeaderElector(store, identity="b", clock=clock, lease_duration=15)
+        assert a.try_acquire()
+        assert not b.try_acquire()
+        assert not b.is_leader()
+        # a keeps renewing: b stays out
+        clock.advance(10)
+        assert a.try_acquire()
+        clock.advance(10)
+        assert not b.try_acquire()
+        # a dies (stops renewing): b takes over after expiry
+        clock.advance(16)
+        assert b.try_acquire()
+        assert b.is_leader()
+        assert not a.is_leader()
+        # and a cannot renew its way back in while b holds
+        assert not a.try_acquire()
+
+    def test_leadership_lapses_without_renewal(self):
+        store, clock = Store(), FakeClock()
+        a = LeaderElector(store, identity="a", clock=clock, lease_duration=15)
+        assert a.try_acquire()
+        clock.advance(16)
+        assert not a.is_leader()
+
+
+class TestMetricsEndpoint:
+    def test_serves_prometheus_text_and_health(self):
+        registry = GaugeRegistry()
+        registry.register("queue", "length").set(
+            name="q", namespace="default", value=41.0
+        )
+        server = MetricsServer(registry, port=0, host="127.0.0.1")
+        port = server.start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            ).read().decode()
+            assert "karpenter_queue_length" in body
+            assert 'name="q"' in body
+            assert "41" in body
+            health = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5
+            ).read()
+            assert health == b"ok"
+            with pytest.raises(Exception):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/nope", timeout=5
+                )
+        finally:
+            server.stop()
+
+
+class TestSolverTrace:
+    def test_trace_is_transparent(self):
+        with solver_trace("binpack"):
+            x = 2 + 2
+        assert x == 4
+
+
+class TestCLI:
+    def test_flag_defaults_match_reference(self):
+        args = parse_args([])
+        assert args.metrics_port == 8080
+        assert args.prometheus_uri is None
+        assert args.leader_elect is True
+        assert not args.verbose
+
+    def test_main_runs_and_exits(self, capsys):
+        rc = cli_main(
+            [
+                "--duration",
+                "0.3",
+                "--tick",
+                "0.05",
+                "--metrics-port",
+                "0",
+                "--no-leader-elect",
+            ]
+        )
+        assert rc == 0
+
+    def test_main_with_leader_election(self):
+        rc = cli_main(
+            ["--duration", "0.2", "--tick", "0.05", "--metrics-port", "0"]
+        )
+        assert rc == 0
+
+
+class TestObservabilityFixes:
+    def test_solver_trace_propagates_exceptions(self):
+        with pytest.raises(ValueError, match="the real error"):
+            with solver_trace("x"):
+                raise ValueError("the real error")
+
+    def test_metrics_path_with_query_string(self):
+        registry = GaugeRegistry()
+        registry.register("queue", "length").set(
+            name="q", namespace="default", value=1.0
+        )
+        server = MetricsServer(registry, port=0, host="127.0.0.1")
+        port = server.start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics?format=prometheus",
+                timeout=5,
+            ).read().decode()
+            assert "karpenter_queue_length" in body
+            ok = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz?ready=1", timeout=5
+            ).read()
+            assert ok == b"ok"
+        finally:
+            server.stop()
