@@ -68,11 +68,17 @@ def main() -> None:
     ap.add_argument("--buckets", type=int, default=32)
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--backend",
+        choices=("auto", "xla", "pallas"),
+        default="auto",
+        help="auto = fused Pallas kernel on TPU, XLA elsewhere",
+    )
     args = ap.parse_args()
 
     import jax
 
-    from karpenter_tpu.ops.binpack import binpack
+    from karpenter_tpu.ops.binpack import solve
 
     print(
         f"backend={jax.default_backend()} devices={jax.devices()}",
@@ -85,7 +91,7 @@ def main() -> None:
     jax.block_until_ready(inputs)
 
     t0 = time.perf_counter()
-    out = binpack(inputs, buckets=args.buckets)
+    out = solve(inputs, buckets=args.buckets, backend=args.backend)
     jax.block_until_ready(out)
     compile_ms = (time.perf_counter() - t0) * 1e3
     print(f"first call (compile+run): {compile_ms:.1f} ms", file=sys.stderr)
@@ -93,7 +99,7 @@ def main() -> None:
     times = []
     for _ in range(args.iters):
         t0 = time.perf_counter()
-        out = binpack(inputs, buckets=args.buckets)
+        out = solve(inputs, buckets=args.buckets, backend=args.backend)
         jax.block_until_ready(out)
         times.append((time.perf_counter() - t0) * 1e3)
     p50 = float(np.percentile(times, 50))

@@ -270,7 +270,7 @@ def solve_pending(store, due_producers: List, registry: GaugeRegistry) -> None:
         for item, l in label_universe.items():
             group_labels[t, l] = item in labels
 
-    out = B.binpack(
+    out = B.solve(
         B.BinPackInputs(
             pod_requests=jnp.asarray(pod_requests),
             pod_valid=jnp.asarray(pod_valid),

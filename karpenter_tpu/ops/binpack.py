@@ -235,6 +235,31 @@ def binpack(inputs: BinPackInputs, buckets: int = DEFAULT_BUCKETS) -> BinPackOut
     )
 
 
+def solve(
+    inputs: BinPackInputs,
+    buckets: int = DEFAULT_BUCKETS,
+    backend: str = "auto",
+) -> BinPackOutputs:
+    """Backend dispatcher: 'xla' (this module), 'pallas' (the fused Mosaic
+    kernel, ops/pallas_binpack.py), or 'auto' — pallas on TPU, xla
+    elsewhere. The two backends are pinned element-for-element equal by
+    tests/test_pallas_binpack.py."""
+    if backend == "auto":
+        backend = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if backend == "xla":
+        return binpack(inputs, buckets=buckets)
+    if backend == "pallas":
+        from karpenter_tpu.ops.pallas_binpack import (
+            binpack_pallas,
+            default_interpret,
+        )
+
+        return binpack_pallas(
+            inputs, buckets=buckets, interpret=default_interpret()
+        )
+    raise ValueError(f"unknown binpack backend {backend!r}")
+
+
 # ---------------------------------------------------------------------------
 # Scalar oracle (NumPy): the same shelf-BFD algorithm, item by item, used by
 # property tests to pin the kernel exactly, plus a classic full-precision FFD

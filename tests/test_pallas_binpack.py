@@ -1,0 +1,119 @@
+"""Pallas fused bin-pack kernel == XLA reference path, element for element.
+
+The Pallas kernel (ops/pallas_binpack.py) runs compiled Mosaic on TPU; on
+the CPU test mesh it runs in interpreter mode, which executes the same
+kernel logic (tiling, grid accumulation, padding) without the TPU compiler.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from karpenter_tpu.ops import binpack as B
+from karpenter_tpu.ops import pallas_binpack as PB
+
+from test_binpack import make_inputs
+
+
+def random_inputs(rng, pods, types, taints=8, labels=8, n_resources=3):
+    req = rng.uniform(0.05, 8.0, (pods, n_resources)).astype(np.float32)
+    alloc = rng.uniform(1.0, 64.0, (types, n_resources)).astype(np.float32)
+    # a few empty groups exercise the zero-allocatable rule
+    empty = rng.random(types) < 0.1
+    alloc[empty] = 0.0
+    return B.BinPackInputs(
+        pod_requests=jnp.asarray(req),
+        pod_valid=jnp.asarray(rng.random(pods) > 0.05),
+        pod_intolerant=jnp.asarray(rng.random((pods, taints)) < 0.1),
+        pod_required=jnp.asarray(rng.random((pods, labels)) < 0.05),
+        group_allocatable=jnp.asarray(alloc),
+        group_taints=jnp.asarray(rng.random((types, taints)) < 0.15),
+        group_labels=jnp.asarray(rng.random((types, labels)) < 0.8),
+    )
+
+
+def assert_outputs_equal(a, b):
+    np.testing.assert_array_equal(np.asarray(a.assigned), np.asarray(b.assigned))
+    np.testing.assert_array_equal(
+        np.asarray(a.assigned_count), np.asarray(b.assigned_count)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a.nodes_needed), np.asarray(b.nodes_needed)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a.lp_bound), np.asarray(b.lp_bound)
+    )
+    assert int(a.unschedulable) == int(b.unschedulable)
+
+
+class TestPallasMatchesXLA:
+    @pytest.mark.parametrize("seed", range(5))
+    def test_random_parity(self, seed):
+        rng = np.random.default_rng(seed)
+        inputs = random_inputs(rng, pods=203, types=37)
+        xla = B.binpack(inputs, buckets=16)
+        pallas = PB.binpack_pallas(
+            inputs, buckets=16, tile_p=64, interpret=True
+        )
+        assert_outputs_equal(xla, pallas)
+
+    def test_padding_invisible(self):
+        """P not a multiple of tile_p, T/K/L far from the 128 lane."""
+        rng = np.random.default_rng(99)
+        inputs = random_inputs(rng, pods=65, types=5, taints=3, labels=2)
+        xla = B.binpack(inputs, buckets=8)
+        pallas = PB.binpack_pallas(inputs, buckets=8, tile_p=64, interpret=True)
+        assert_outputs_equal(xla, pallas)
+
+    def test_semantics_taints_and_labels(self):
+        # group 0 tainted (pod 0 intolerant); group 1 lacks pod 1's label
+        inputs = make_inputs(
+            pod_requests=[[1, 1], [1, 1]],
+            group_allocatable=[[4, 4], [4, 4]],
+            pod_intolerant=[[True, False], [False, False]],
+            group_taints=[[True, False], [False, False]],
+            pod_required=[[False, False], [False, True]],
+            group_labels=[[True, True], [True, False]],
+            n_taints=2,
+            n_labels=2,
+        )
+        out = PB.binpack_pallas(inputs, buckets=8, tile_p=8, interpret=True)
+        assert out.assigned.tolist() == [1, 0]
+
+    def test_all_unschedulable(self):
+        inputs = make_inputs(
+            pod_requests=[[9, 9]], group_allocatable=[[1, 1]]
+        )
+        out = PB.binpack_pallas(inputs, buckets=8, tile_p=8, interpret=True)
+        assert out.assigned.tolist() == [-1]
+        assert int(out.unschedulable) == 1
+        assert out.nodes_needed.tolist() == [0]
+
+    def test_fused_stage_outputs(self):
+        """Histogram and demand from the kernel match a NumPy recomputation."""
+        rng = np.random.default_rng(7)
+        inputs = random_inputs(rng, pods=130, types=9)
+        buckets = 12
+        assigned, hist, demand = PB.fused_assign(
+            inputs, buckets=buckets, tile_p=64, interpret=True
+        )
+        assigned = np.asarray(assigned)
+        req = np.asarray(inputs.pod_requests)
+        alloc = np.asarray(inputs.group_allocatable)
+        want_hist = np.zeros((alloc.shape[0], buckets), np.int64)
+        want_demand = np.zeros_like(alloc, dtype=np.float64)
+        for p, t in enumerate(assigned):
+            if t < 0:
+                continue
+            shares = [
+                (req[p, r] / alloc[t, r]) if alloc[t, r] > 0 else
+                (0.0 if req[p, r] <= 0 else np.inf)
+                for r in range(req.shape[1])
+            ]
+            b = int(np.clip(np.ceil(max(shares) * buckets), 1, buckets))
+            want_hist[t, b - 1] += 1
+            want_demand[t] += req[p]
+        np.testing.assert_array_equal(np.asarray(hist), want_hist)
+        np.testing.assert_allclose(
+            np.asarray(demand), want_demand, rtol=1e-5, atol=1e-4
+        )
