@@ -30,6 +30,12 @@ from karpenter_tpu.api.scalablenodegroup import ScalableNodeGroup
 from karpenter_tpu.utils.quantity import Quantity
 
 API_VERSION = "autoscaling.karpenter.sh/v1alpha1"
+CORE_API_VERSION = "v1"  # Node/Pod are core/v1 kinds
+AUTOSCALING_KINDS = (
+    "HorizontalAutoscaler",
+    "MetricsProducer",
+    "ScalableNodeGroup",
+)
 
 KINDS: Dict[str, type] = {
     "HorizontalAutoscaler": HorizontalAutoscaler,
@@ -129,8 +135,11 @@ def to_dict(obj, top_level: bool = True) -> Dict[str, Any]:
     assert dataclasses.is_dataclass(obj)
     out: Dict[str, Any] = {}
     if top_level and type(obj).__name__ in KINDS:
-        out["apiVersion"] = API_VERSION
-        out["kind"] = type(obj).__name__
+        kind = type(obj).__name__
+        out["apiVersion"] = (
+            API_VERSION if kind in AUTOSCALING_KINDS else CORE_API_VERSION
+        )
+        out["kind"] = kind
     for f in dataclasses.fields(obj):
         value = getattr(obj, f.name)
         if isinstance(obj, ObjectMeta) and f.name in _META_INTERNAL:
@@ -160,11 +169,15 @@ def from_manifest(doc: Dict[str, Any]):
     if kind not in KINDS:
         raise ValueError(f"unknown kind {kind!r} (known: {sorted(KINDS)})")
     api_version = doc.get("apiVersion", "")
-    if kind in ("HorizontalAutoscaler", "MetricsProducer", "ScalableNodeGroup"):
+    if kind in AUTOSCALING_KINDS:
         if api_version != API_VERSION:
             raise ValueError(
                 f"unsupported apiVersion {api_version!r} for {kind}"
             )
+    elif api_version not in ("", CORE_API_VERSION):
+        # core kinds: absent is tolerated (test fixtures), wrong rejected —
+        # same symmetry as the v1 stamp to_dict emits
+        raise ValueError(f"unsupported apiVersion {api_version!r} for {kind}")
     body = {k: v for k, v in doc.items() if k not in ("apiVersion", "kind")}
     return from_dict(KINDS[kind], body)
 

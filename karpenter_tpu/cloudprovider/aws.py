@@ -282,6 +282,7 @@ class SQSQueue:
     def __init__(self, arn: str, client: SQSAPI):
         self.arn = arn
         self.client = client
+        self._cached_url: Optional[str] = None
 
     def name(self) -> str:
         return self.arn
@@ -309,13 +310,18 @@ class SQSQueue:
         return 0  # reference stub (sqsqueue.go:78-80)
 
     def _url(self) -> str:
+        # the ARN->URL mapping is immutable for this queue's lifetime;
+        # resolve once instead of one extra SQS round-trip per poll
+        if self._cached_url is not None:
+            return self._cached_url
         arn = parse_arn(self.arn)
         try:
-            return self.client.get_queue_url(
+            self._cached_url = self.client.get_queue_url(
                 queue_name=arn.resource, account_id=arn.account_id
             )
         except Exception as e:  # noqa: BLE001
             raise RuntimeError(f"could not get SQS queue URL {e}") from e
+        return self._cached_url
 
 
 # ---------------------------------------------------------------------------
@@ -343,6 +349,9 @@ class AWSFactory:
         self.eks_client = eks_client or _NotImplementedClient("eks")
         self.sqs_client = sqs_client or _NotImplementedClient("sqs")
         self._fallback = FakeFactory.not_implemented()
+        # queue objects are cached per ARN so the SQSQueue URL cache
+        # actually spans polls (producers resolve queue_for every tick)
+        self._queues: Dict[str, SQSQueue] = {}
 
     def node_group_for(self, spec):
         if spec.type == AWS_EC2_AUTO_SCALING_GROUP:
@@ -353,7 +362,12 @@ class AWSFactory:
 
     def queue_for(self, spec):
         if spec.type == AWS_SQS_QUEUE_TYPE:
-            return SQSQueue(spec.id, self.sqs_client)
+            queue = self._queues.get(spec.id)
+            if queue is None:
+                queue = self._queues[spec.id] = SQSQueue(
+                    spec.id, self.sqs_client
+                )
+            return queue
         return self._fallback.queue_for(spec)
 
 

@@ -125,9 +125,16 @@ class TPUPodSlicePool:
             raise wrapped from e
 
     def stabilized(self) -> Tuple[bool, str]:
-        pending = self.api.pending_operations(
-            self.project, self.location, self.cluster, self.pool
-        )
+        try:
+            pending = self.api.pending_operations(
+                self.project, self.location, self.cluster, self.pool
+            )
+        except RetryableError:
+            raise
+        except Exception as e:  # noqa: BLE001 — API blips are transient:
+            # keep the resource Active (AbleToScale=false) like set_replicas
+            wrapped = RetryableError(str(e), code="OperationPollFailed")
+            raise wrapped from e
         if pending:
             return False, f"operations in flight: {', '.join(pending)}"
         return True, ""

@@ -39,7 +39,12 @@ class ScalableNodeGroupController:
         observed = node_group.get_replicas()
         resource.status.replicas = observed
 
-        # 3. actuate when spec diverges from observation
+        # 3. actuate when spec diverges from observation — but never while
+        # the group is mid-change: overlapping resizes against a pool whose
+        # previous resize is in flight can strand partial TPU slices
+        # (tpu.py module doc); the next loop actuates once stable
+        if not stable:
+            return
         if resource.spec.replicas is None or resource.spec.replicas == observed:
             return
         node_group.set_replicas(resource.spec.replicas)

@@ -78,6 +78,11 @@ class LeaderElector:
         expired = now > lease.renew_time + lease.lease_duration
         if held_by_other and not expired:
             return False
+        # already ours and fresh: skip the write until a third of the lease
+        # has elapsed (k8s renewDeadline posture) — renewing every tick
+        # churns the store bus with resourceVersion bumps + watch events
+        if not held_by_other and now < lease.renew_time + lease.lease_duration / 3:
+            return True
         # renew (ours) or take over (expired): CAS via resourceVersion
         lease.holder = self.identity
         lease.renew_time = now

@@ -95,6 +95,7 @@ class FakeSQSAPI:
         self.want_err = want_err
 
     def get_queue_url(self, queue_name, account_id):
+        self.url_calls = getattr(self, "url_calls", 0) + 1
         if self.want_err:
             raise self.want_err
         return self.url
@@ -262,6 +263,25 @@ class TestSQSQueue:
 
     def test_oldest_age_stub(self):
         assert SQSQueue(SQS_ARN, FakeSQSAPI()).oldest_message_age_seconds() == 0
+
+    def test_queue_url_resolved_once(self):
+        """The ARN->URL mapping is immutable: polling length repeatedly
+        must not re-issue GetQueueUrl each time."""
+        api = FakeSQSAPI(attributes={"ApproximateNumberOfMessages": "1"})
+        queue = SQSQueue(SQS_ARN, api)
+        for _ in range(3):
+            assert queue.length() == 1
+        assert api.url_calls == 1
+
+    def test_queue_url_cache_spans_polls_via_factory(self):
+        """Producers resolve queue_for every tick; the factory must hand
+        back the same queue object so the URL cache actually helps."""
+        api = FakeSQSAPI(attributes={"ApproximateNumberOfMessages": "1"})
+        factory = AWSFactory(Options(store=Store()), sqs_client=api)
+        spec = QueueSpec(type=AWS_SQS_QUEUE_TYPE, id=SQS_ARN)
+        for _ in range(3):
+            assert factory.queue_for(spec).length() == 1
+        assert api.url_calls == 1
 
 
 # --- admission validators + factory dispatch -------------------------------

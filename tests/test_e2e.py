@@ -439,6 +439,21 @@ class TestScalableNodeGroupE2E:
         # still Active: instability is not an error
         assert sng.status_conditions().get(cond.ACTIVE).status == cond.TRUE
 
+    def test_unstabilized_holds_actuation(self, env):
+        """No resize is issued while the group is mid-change; the next loop
+        actuates once the group stabilizes (partial TPU slices are unusable,
+        so overlapping resizes must never be in flight)."""
+        runtime, provider, clock = env
+        provider.node_replicas["g"] = 1
+        provider.node_group_stable = False
+        runtime.store.create(sng_of("g", replicas=5))
+        runtime.manager.reconcile_all()
+        assert provider.node_replicas["g"] == 1  # held
+        provider.node_group_stable = True
+        clock.advance(61)
+        runtime.manager.reconcile_all()
+        assert provider.node_replicas["g"] == 5  # actuated once stable
+
     def test_retryable_error_keeps_active_flags_able_to_scale(self, env):
         runtime, provider, clock = env
         provider.node_replicas["g"] = 1

@@ -102,6 +102,42 @@ class TestCodecPosture:
         with pytest.raises(ValueError):
             from_manifest({"kind": "Widget"})
 
+    def test_core_kind_wrong_api_version_rejected(self):
+        with pytest.raises(ValueError):
+            from_manifest(
+                {
+                    "apiVersion": "apps/v1",
+                    "kind": "Node",
+                    "metadata": {"name": "n"},
+                }
+            )
+
+    def test_core_kinds_dump_core_api_version(self):
+        """Node/Pod are core/v1 kinds: stamping the autoscaling group on
+        them would make the manifests invalid for kubectl-shaped tooling."""
+        from karpenter_tpu.api.core import Node, ObjectMeta
+        from karpenter_tpu.api.serialization import to_dict
+
+        doc = to_dict(Node(metadata=ObjectMeta(name="n")))
+        assert doc["apiVersion"] == "v1"
+        assert doc["kind"] == "Node"
+
+    def test_autoscaling_kinds_dump_group_api_version(self):
+        from karpenter_tpu.api.scalablenodegroup import (
+            ScalableNodeGroup,
+            ScalableNodeGroupSpec,
+        )
+        from karpenter_tpu.api.core import ObjectMeta
+        from karpenter_tpu.api.serialization import to_dict
+
+        doc = to_dict(
+            ScalableNodeGroup(
+                metadata=ObjectMeta(name="g"),
+                spec=ScalableNodeGroupSpec(type="AWSEC2AutoScalingGroup", id="x"),
+            )
+        )
+        assert doc["apiVersion"] == "autoscaling.karpenter.sh/v1alpha1"
+
     def test_wrong_api_version_rejected(self):
         with pytest.raises(ValueError):
             from_manifest(

@@ -126,6 +126,19 @@ class TestStabilized:
         assert not stable
         assert "resize-op-1" in message
 
+    def test_pending_operations_error_is_retryable(self):
+        """A GKE API blip polling operations must not deactivate the SNG —
+        same transient posture as set_replicas resize errors."""
+        from karpenter_tpu.controllers.errors import is_retryable
+
+        class ThrowingAPI(FakeContainerAPI):
+            def pending_operations(self, project, location, cluster, pool):
+                raise RuntimeError("throttled")
+
+        with pytest.raises(Exception) as e:
+            TPUPodSlicePool(POOL_ID, ThrowingAPI(), Store()).stabilized()
+        assert is_retryable(e.value)
+
 
 class TestThroughController:
     def test_scale_up_via_controller(self):

@@ -54,6 +54,26 @@ class TestLeaderElection:
         # and a cannot renew its way back in while b holds
         assert not a.try_acquire()
 
+    def test_renew_is_throttled_while_fresh(self):
+        """Holding the lease must not rewrite it every tick — writes churn
+        the store bus; renew only after a third of the lease elapses."""
+        store, clock = Store(), FakeClock()
+        a = LeaderElector(store, identity="a", clock=clock, lease_duration=15)
+        assert a.try_acquire()
+        rv = store.get("Lease", a.namespace, a.name).metadata.resource_version
+        clock.advance(1)
+        assert a.try_acquire()  # fresh: no write
+        assert (
+            store.get("Lease", a.namespace, a.name).metadata.resource_version
+            == rv
+        )
+        clock.advance(5)  # past lease_duration/3 since renew_time
+        assert a.try_acquire()  # stale enough: renews
+        assert (
+            store.get("Lease", a.namespace, a.name).metadata.resource_version
+            != rv
+        )
+
     def test_leadership_lapses_without_renewal(self):
         store, clock = Store(), FakeClock()
         a = LeaderElector(store, identity="a", clock=clock, lease_duration=15)
