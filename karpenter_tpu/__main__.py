@@ -39,6 +39,12 @@ def parse_args(argv=None) -> argparse.Namespace:
         "KARPENTER_CLOUD_PROVIDER or the not-implemented fake",
     )
     parser.add_argument(
+        "--solver-uri",
+        default=None,
+        help="host:port of a solver sidecar (python -m karpenter_tpu.sidecar);"
+        " omit to solve in-process",
+    )
+    parser.add_argument(
         "--leader-elect",
         action=argparse.BooleanOptionalAction,
         default=True,
@@ -67,6 +73,7 @@ def main(argv=None) -> int:
         Options(
             prometheus_uri=args.prometheus_uri,
             cloud_provider=args.cloud_provider,
+            solver_uri=args.solver_uri,
             verbose=args.verbose,
         )
     )
@@ -95,6 +102,7 @@ def main(argv=None) -> int:
         pass
     finally:
         metrics_server.stop()
+        runtime.close()
     return 0
 
 

@@ -38,7 +38,10 @@ class MetricsProducerController:
         if pending:
             try:
                 solve_pending(
-                    self.factory.store, pending, self.factory.registry
+                    self.factory.store,
+                    pending,
+                    self.factory.registry,
+                    solver=self.factory.solver,
                 )
                 for mp in pending:
                     results[key(mp)] = None

@@ -18,17 +18,22 @@ from karpenter_tpu.utils.log import logger
 
 
 class ProducerFactory:
-    def __init__(self, store, cloud_provider_factory, registry=None):
+    def __init__(self, store, cloud_provider_factory, registry=None, solver=None):
         from karpenter_tpu.metrics.registry import default_registry
 
         self.store = store
         self.cloud_provider_factory = cloud_provider_factory
         self.registry = registry if registry is not None else default_registry()
+        # optional remote bin-pack (sidecar SolverClient.solve); None =
+        # in-process device call
+        self.solver = solver
 
     def for_producer(self, mp):
         spec = mp.spec
         if spec.pending_capacity is not None:
-            return PendingCapacityProducer(mp, self.store, registry=self.registry)
+            return PendingCapacityProducer(
+                mp, self.store, registry=self.registry, solver=self.solver
+            )
         if spec.queue is not None:
             return QueueProducer(
                 mp,

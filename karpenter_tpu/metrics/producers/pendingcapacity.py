@@ -32,7 +32,6 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
-import jax.numpy as jnp
 import numpy as np
 
 from karpenter_tpu.api.core import (
@@ -135,7 +134,9 @@ def _group_profile(
     return alloc, labels, taints
 
 
-def solve_pending(store, due_producers: List, registry: GaugeRegistry) -> None:
+def solve_pending(
+    store, due_producers: List, registry: GaugeRegistry, solver=None
+) -> None:
     """One device call over ALL pendingCapacity producers in the store.
 
     Solving the full set — not just the due subset — is what upholds the
@@ -143,6 +144,10 @@ def solve_pending(store, due_producers: List, registry: GaugeRegistry) -> None:
     candidate group is in the same solve. Status objects are mutated on the
     due producers (the engine persists those); gauges are refreshed for every
     group since they are global registry state.
+
+    `solver` is the Algorithm seam: any (inputs, buckets=...) ->
+    BinPackOutputs callable — in-process ops/binpack.solve (default) or a
+    sidecar SolverClient.solve (gRPC process split).
     """
     due_keys = {
         (mp.metadata.namespace, mp.metadata.name): mp for mp in due_producers
@@ -270,15 +275,21 @@ def solve_pending(store, due_producers: List, registry: GaugeRegistry) -> None:
         for item, l in label_universe.items():
             group_labels[t, l] = item in labels
 
-    out = B.solve(
+    if solver is None:
+        solver = B.solve
+    # numpy arrays go straight through: the in-process jitted solve
+    # device-puts them itself, and a remote solver serializes host bytes —
+    # wrapping in jnp here would force a device round-trip (and JAX init)
+    # in the control-plane process the sidecar split exists to relieve
+    out = solver(
         B.BinPackInputs(
-            pod_requests=jnp.asarray(pod_requests),
-            pod_valid=jnp.asarray(pod_valid),
-            pod_intolerant=jnp.asarray(pod_intolerant),
-            pod_required=jnp.asarray(pod_required),
-            group_allocatable=jnp.asarray(group_allocatable),
-            group_taints=jnp.asarray(group_taints),
-            group_labels=jnp.asarray(group_labels),
+            pod_requests=pod_requests,
+            pod_valid=pod_valid,
+            pod_intolerant=pod_intolerant,
+            pod_required=pod_required,
+            group_allocatable=group_allocatable,
+            group_taints=group_taints,
+            group_labels=group_labels,
         )
     )
 
@@ -306,11 +317,18 @@ def solve_pending(store, due_producers: List, registry: GaugeRegistry) -> None:
 class PendingCapacityProducer:
     """Single-producer path; the controller batches when it can."""
 
-    def __init__(self, mp, store, registry: Optional[GaugeRegistry] = None):
+    def __init__(
+        self,
+        mp,
+        store,
+        registry: Optional[GaugeRegistry] = None,
+        solver=None,
+    ):
         self.mp = mp
         self.store = store
         self.registry = registry if registry is not None else default_registry()
+        self.solver = solver
         register_gauges(self.registry)
 
     def reconcile(self) -> None:
-        solve_pending(self.store, [self.mp], self.registry)
+        solve_pending(self.store, [self.mp], self.registry, solver=self.solver)

@@ -33,6 +33,8 @@ class Options:
 
     prometheus_uri: Optional[str] = None  # None = in-process registry client
     cloud_provider: Optional[str] = None  # None = env/default (not-implemented)
+    solver_uri: Optional[str] = None  # host:port of a solver sidecar
+    # (sidecar/client.py); None = in-process device solve
     verbose: bool = False
 
 
@@ -60,8 +62,16 @@ class KarpenterRuntime:
                 CloudOptions(store=self.store), provider=options.cloud_provider
             )
         )
+        self.solver_client = None
+        solver = None
+        if options.solver_uri:
+            from karpenter_tpu.sidecar.client import SolverClient
+
+            self.solver_client = SolverClient(options.solver_uri)
+            solver = self.solver_client.solve
         self.producer_factory = ProducerFactory(
-            self.store, self.cloud_provider, registry=self.registry
+            self.store, self.cloud_provider, registry=self.registry,
+            solver=solver,
         )
         self.metrics_clients = MetricsClientFactory(
             registry=self.registry, prometheus_uri=options.prometheus_uri
@@ -82,3 +92,8 @@ class KarpenterRuntime:
 
     def run(self, duration: float) -> None:
         self.manager.run(duration)
+
+    def close(self) -> None:
+        if self.solver_client is not None:
+            self.solver_client.close()
+            self.solver_client = None

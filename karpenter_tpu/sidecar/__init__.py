@@ -1,0 +1,22 @@
+"""Solver sidecar: the control-plane / device-solver process split.
+
+BASELINE.json's north star names the shape: the control plane "ships the
+batch to a [control-plane]→gRPC→JAX sidecar" — the reference's analogous
+process boundaries are the Prometheus HTTP hop (pkg/metrics/clients/
+prometheus.go:35-55) and the scale-subresource RPC
+(pkg/autoscaler/autoscaler.go:196-221). Running the solver out of process
+keeps TPU ownership in exactly one place (one process holds the chip; N
+control-plane replicas can share it) and makes the solver independently
+restartable — the stateless-resume posture of SURVEY.md §5.
+
+The wire contract is documented in proto/solver.proto; messages are a
+self-describing array framing (codec.py) rather than generated protobuf
+classes, because this environment has no grpc codegen plugin — the gRPC
+transport, service/method names, and semantics match the proto exactly, so
+swapping in generated stubs later changes no behavior.
+"""
+
+from karpenter_tpu.sidecar.client import SolverClient
+from karpenter_tpu.sidecar.server import SolverServer
+
+__all__ = ["SolverClient", "SolverServer"]

@@ -1,0 +1,5 @@
+"""`python -m karpenter_tpu.sidecar` — run the solver sidecar."""
+
+from karpenter_tpu.sidecar.server import main
+
+main()

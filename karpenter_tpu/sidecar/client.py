@@ -1,0 +1,64 @@
+"""gRPC solver client: the control-plane side of the sidecar split.
+
+Drop-in for the in-process solver at the Algorithm seam (the reference's
+pluggable-Algorithm boundary, pkg/autoscaler/algorithms/algorithm.go:24-40):
+`SolverClient.solve` has the same (inputs, buckets) -> BinPackOutputs
+contract as ops/binpack.solve, so metrics/producers/pendingcapacity.py
+routes through it when the runtime is configured with a solver URI.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+from karpenter_tpu.sidecar import codec
+from karpenter_tpu.sidecar.server import SERVICE
+
+
+class SolverClient:
+    def __init__(self, target: str, timeout_seconds: float = 30.0):
+        import grpc
+
+        self.target = target
+        self.timeout = timeout_seconds
+        self._channel = grpc.insecure_channel(target)
+        self._solve = self._channel.unary_unary(f"/{SERVICE}/Solve")
+        self._decide = self._channel.unary_unary(f"/{SERVICE}/Decide")
+        self._health = self._channel.unary_unary(f"/{SERVICE}/Health")
+
+    def solve(self, inputs, buckets: int = 32, backend: str = "auto"):
+        """BinPackInputs -> BinPackOutputs via the sidecar (numpy-backed)."""
+        from karpenter_tpu.ops.binpack import BinPackOutputs
+
+        request = codec.pack_dataclass(
+            inputs, meta={"buckets": buckets, "backend": backend}
+        )
+        response = self._solve(request, timeout=self.timeout)
+        out, _ = codec.unpack_dataclass(BinPackOutputs, response)
+        return out
+
+    def decide(self, inputs):
+        """DecisionInputs -> DecisionOutputs via the sidecar."""
+        from karpenter_tpu.ops.decision import DecisionOutputs
+
+        response = self._decide(
+            codec.pack_dataclass(inputs), timeout=self.timeout
+        )
+        out, _ = codec.unpack_dataclass(DecisionOutputs, response)
+        return out
+
+    def health(self) -> Tuple[bool, Dict[str, Any]]:
+        arrays, meta = codec.unpack(
+            self._health(codec.pack({}), timeout=self.timeout)
+        )
+        return bool(arrays["ok"]), meta
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def __enter__(self) -> "SolverClient":
+        return self
+
+    def __exit__(self, *exc) -> Optional[bool]:
+        self.close()
+        return None

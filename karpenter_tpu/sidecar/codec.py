@@ -1,0 +1,79 @@
+"""Self-describing array framing for the solver sidecar wire format.
+
+Layout: 8-byte little-endian header length, JSON header, then the raw
+C-order little-endian array buffers concatenated in header order. The
+header is a list of [name, dtype, shape] triples plus an optional "meta"
+dict (backend info, error strings). Arrays round-trip zero-copy on decode
+(numpy views over the message buffer).
+
+This is the byte-level stand-in for proto/solver.proto's TensorBatch (see
+sidecar/__init__.py for why no generated stubs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import struct
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+_LEN = struct.Struct("<Q")
+
+
+def pack(
+    arrays: Dict[str, np.ndarray], meta: Optional[Dict[str, Any]] = None
+) -> bytes:
+    entries = []
+    buffers = []
+    for name, arr in arrays.items():
+        arr = np.asarray(arr)
+        shape = list(arr.shape)  # before ascontiguousarray: it promotes 0-d to 1-d
+        arr = np.ascontiguousarray(arr)
+        if arr.dtype.byteorder == ">":  # wire format is little-endian
+            arr = arr.astype(arr.dtype.newbyteorder("<"))
+        entries.append([name, arr.dtype.str, shape])
+        buffers.append(arr.tobytes())
+    header = json.dumps({"tensors": entries, "meta": meta or {}}).encode()
+    return b"".join([_LEN.pack(len(header)), header] + buffers)
+
+
+def unpack(data: bytes) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    (header_len,) = _LEN.unpack_from(data, 0)
+    header = json.loads(data[8 : 8 + header_len])
+    offset = 8 + header_len
+    arrays: Dict[str, np.ndarray] = {}
+    for name, dtype_str, shape in header["tensors"]:
+        dtype = np.dtype(dtype_str)
+        count = int(np.prod(shape)) if shape else 1
+        nbytes = dtype.itemsize * count
+        arrays[name] = np.frombuffer(
+            data, dtype=dtype, count=count, offset=offset
+        ).reshape(tuple(shape))
+        offset += nbytes
+    return arrays, header.get("meta", {})
+
+
+def pack_dataclass(obj, meta: Optional[Dict[str, Any]] = None) -> bytes:
+    """Any registered array-dataclass (BinPackInputs, DecisionInputs, ...)
+    -> wire bytes, one tensor per field."""
+    arrays = {
+        f.name: np.asarray(getattr(obj, f.name))
+        for f in dataclasses.fields(obj)
+    }
+    return pack(arrays, meta)
+
+
+def unpack_dataclass(cls, data: bytes):
+    """Wire bytes -> cls hydrated with numpy arrays (field-name match is
+    exact; missing or extra tensors are an error, same strictness as the
+    YAML codec)."""
+    arrays, meta = unpack(data)
+    names = {f.name for f in dataclasses.fields(cls)}
+    if set(arrays) != names:
+        raise ValueError(
+            f"tensor set mismatch for {cls.__name__}: "
+            f"got {sorted(arrays)}, want {sorted(names)}"
+        )
+    return cls(**arrays), meta

@@ -1,0 +1,173 @@
+"""gRPC solver sidecar server: owns the TPU, serves Solve/Decide/Health.
+
+The service/method names and message semantics are proto/solver.proto;
+handlers are registered generically (no generated stubs, see
+sidecar/__init__.py). Solve runs the pending-pods bin-pack
+(ops/binpack.solve, Pallas backend on TPU), Decide the batched HPA
+decision kernel (ops/decision.decide_jit). Both are stateless: all inputs
+arrive in the request, matching the reference's checkpoint/resume posture
+(all durable state in the store; SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import json
+from concurrent import futures
+from typing import Optional
+
+import numpy as np
+
+from karpenter_tpu.observability import solver_trace
+from karpenter_tpu.sidecar import codec
+
+SERVICE = "karpenter.solver.v1.Solver"
+
+
+def _solve(request: bytes) -> bytes:
+    import jax
+
+    from karpenter_tpu.ops.binpack import BinPackInputs, solve
+
+    arrays, meta = codec.unpack(request)
+    buckets = int(meta.get("buckets", 32))
+    backend = meta.get("backend", "auto")
+    inputs = BinPackInputs(
+        **{
+            name: arrays[name]
+            for name in (
+                "pod_requests",
+                "pod_valid",
+                "pod_intolerant",
+                "pod_required",
+                "group_allocatable",
+                "group_taints",
+                "group_labels",
+            )
+        }
+    )
+    with solver_trace("sidecar.solve"):
+        out = solve(jax.device_put(inputs), buckets=buckets, backend=backend)
+        jax.block_until_ready(out)
+    return codec.pack_dataclass(out)
+
+
+def _decide(request: bytes) -> bytes:
+    import jax
+
+    from karpenter_tpu.ops.decision import DecisionInputs, decide_jit
+
+    inputs, _ = codec.unpack_dataclass(DecisionInputs, request)
+    with solver_trace("sidecar.decide"):
+        out = decide_jit(jax.device_put(inputs))
+        jax.block_until_ready(out)
+    return codec.pack_dataclass(out)
+
+
+def _health(request: bytes) -> bytes:
+    import jax
+
+    return codec.pack(
+        {"ok": np.asarray(True)},
+        meta={
+            "backend": jax.default_backend(),
+            "device_count": jax.device_count(),
+        },
+    )
+
+
+class SolverServer:
+    """port=0 binds an ephemeral port; `port` holds the bound port after
+    start()."""
+
+    def __init__(self, port: int = 9090, host: str = "0.0.0.0",
+                 max_workers: int = 4):
+        self.host = host
+        self.port = port
+        self.max_workers = max_workers
+        self._server = None
+
+    def start(self) -> int:
+        import grpc
+
+        def wrap(fn):
+            def handler(request: bytes, context) -> bytes:
+                try:
+                    return fn(request)
+                except Exception as e:  # noqa: BLE001 — errors go to the
+                    # client as INTERNAL with the message, not a dead channel
+                    context.abort(
+                        grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}"
+                    )
+
+            return grpc.unary_unary_rpc_method_handler(
+                handler,
+                request_deserializer=None,  # raw bytes both ways
+                response_serializer=None,
+            )
+
+        handlers = grpc.method_handlers_generic_handler(
+            SERVICE,
+            {
+                "Solve": wrap(_solve),
+                "Decide": wrap(_decide),
+                "Health": wrap(_health),
+            },
+        )
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=self.max_workers)
+        )
+        self._server.add_generic_rpc_handlers((handlers,))
+        self.port = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        if self.port == 0:
+            raise RuntimeError(f"could not bind {self.host}")
+        self._server.start()
+        return self.port
+
+    def wait(self) -> None:
+        self._server.wait_for_termination()
+
+    def stop(self, grace: Optional[float] = 1.0) -> None:
+        if self._server is not None:
+            self._server.stop(grace).wait()
+            self._server = None
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="karpenter-tpu solver sidecar")
+    ap.add_argument("--port", type=int, default=9090)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument(
+        "--warmup-pods",
+        type=int,
+        default=0,
+        help="pre-compile the bin-pack at this pod count before serving",
+    )
+    args = ap.parse_args(argv)
+
+    if args.warmup_pods:
+        import jax
+
+        from karpenter_tpu.ops.binpack import BinPackInputs, solve
+
+        p = args.warmup_pods
+        inputs = BinPackInputs(
+            pod_requests=np.ones((p, 3), np.float32),
+            pod_valid=np.ones((p,), bool),
+            pod_intolerant=np.zeros((p, 64), bool),
+            pod_required=np.zeros((p, 64), bool),
+            group_allocatable=np.ones((300, 3), np.float32),
+            group_taints=np.zeros((300, 64), bool),
+            group_labels=np.ones((300, 64), bool),
+        )
+        jax.block_until_ready(solve(jax.device_put(inputs)))
+
+    server = SolverServer(port=args.port, host=args.host)
+    port = server.start()
+    print(json.dumps({"serving": f"{args.host}:{port}", "service": SERVICE}))
+    server.wait()
+
+
+if __name__ == "__main__":
+    main()
