@@ -45,6 +45,12 @@ def parse_args(argv=None) -> argparse.Namespace:
         " omit to solve in-process",
     )
     parser.add_argument(
+        "--data-dir",
+        default=None,
+        help="directory for the durable store (WAL + snapshots); omit for "
+        "in-memory only",
+    )
+    parser.add_argument(
         "--leader-elect",
         action=argparse.BooleanOptionalAction,
         default=True,
@@ -74,6 +80,7 @@ def main(argv=None) -> int:
             prometheus_uri=args.prometheus_uri,
             cloud_provider=args.cloud_provider,
             solver_uri=args.solver_uri,
+            data_dir=args.data_dir,
             verbose=args.verbose,
         )
     )

@@ -12,12 +12,14 @@ from __future__ import annotations
 
 import itertools
 import time as _time
+import uuid
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from karpenter_tpu.utils.quantity import Quantity, parse_quantity
 
 _uid_counter = itertools.count(1)
+_process_id = uuid.uuid4().hex[:8]
 
 RESOURCE_CPU = "cpu"
 RESOURCE_MEMORY = "memory"
@@ -36,7 +38,11 @@ class ObjectMeta:
 
     def ensure_identity(self):
         if not self.uid:
-            self.uid = f"uid-{next(_uid_counter)}"
+            # process-unique prefix: a restarted control plane resuming a
+            # durable store must never mint a uid already held by a
+            # recovered object (the k8s uid contract distinguishes object
+            # incarnations)
+            self.uid = f"uid-{_process_id}-{next(_uid_counter)}"
         if not self.creation_timestamp:
             self.creation_timestamp = _time.time()
 

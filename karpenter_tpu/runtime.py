@@ -35,6 +35,7 @@ class Options:
     cloud_provider: Optional[str] = None  # None = env/default (not-implemented)
     solver_uri: Optional[str] = None  # host:port of a solver sidecar
     # (sidecar/client.py); None = in-process device solve
+    data_dir: Optional[str] = None  # WAL+snapshot dir; None = in-memory only
     verbose: bool = False
 
 
@@ -52,7 +53,13 @@ class KarpenterRuntime:
         options = options or Options()
         self.options = options
         self.clock = clock or _time.time
-        self.store = store if store is not None else Store()
+        self._owns_store = store is None
+        if store is not None:
+            self.store = store
+        else:
+            from karpenter_tpu.store.persistence import open_store
+
+            self.store = open_store(options.data_dir)
         self.registry = registry if registry is not None else GaugeRegistry()
 
         self.cloud_provider = (
@@ -97,3 +104,5 @@ class KarpenterRuntime:
         if self.solver_client is not None:
             self.solver_client.close()
             self.solver_client = None
+        if self._owns_store and hasattr(self.store, "close"):
+            self.store.close()

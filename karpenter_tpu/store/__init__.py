@@ -6,4 +6,24 @@ from karpenter_tpu.store.store import (
     register_scale_kind,
 )
 
-__all__ = ["Store", "Scale", "NotFoundError", "ConflictError", "register_scale_kind"]
+
+def __getattr__(name):
+    # lazy: persistence pulls in the serialization codec; keep plain Store
+    # imports light
+    if name in ("DurableStore", "open_store", "register_persistent_kind"):
+        from karpenter_tpu.store import persistence
+
+        return getattr(persistence, name)
+    raise AttributeError(name)
+
+
+__all__ = [
+    "Store",
+    "Scale",
+    "NotFoundError",
+    "ConflictError",
+    "register_scale_kind",
+    "DurableStore",
+    "open_store",
+    "register_persistent_kind",
+]
