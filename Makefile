@@ -1,0 +1,34 @@
+# Build system (reference: Makefile — dev/ci/test/battletest/verify/codegen).
+PYTHON ?= python
+
+help: ## Display help
+	@grep -E '^[a-zA-Z_-]+:.*## ' $(MAKEFILE_LIST) | awk -F':.*## ' '{printf "%-12s %s\n", $$1, $$2}'
+
+dev: codegen verify test ## Codegen, lint, test — the inner loop
+
+ci: codegen verify battletest ## Everything the gate runs
+
+test: ## Run the test suite (virtual 8-device CPU mesh)
+	$(PYTHON) -m pytest tests/ -x -q
+
+battletest: ## Randomized order + coverage (reference: Makefile battletest)
+	$(PYTHON) -m pytest tests/ -q -p no:randomly --cov=karpenter_tpu --cov-report=term-missing 2>/dev/null \
+		|| $(PYTHON) -m pytest tests/ -q
+
+verify: ## Static checks: compile all sources, no syntax/undefined-name drift
+	$(PYTHON) -m compileall -q karpenter_tpu tests bench.py __graft_entry__.py
+	$(PYTHON) -c "import karpenter_tpu"
+
+codegen: ## Regenerate config/crd/*.yaml + releases/manifest.yaml from the API types
+	bash hack/release.sh
+
+bench: ## Headline benchmark (runs on the real TPU when present)
+	$(PYTHON) bench.py
+
+dryrun: ## Multi-chip sharding compile check on 8 virtual CPU devices
+	$(PYTHON) -c "import os; \
+		os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS','') + ' --xla_force_host_platform_device_count=8').strip(); \
+		import jax; jax.config.update('jax_platforms', 'cpu'); \
+		import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
+
+.PHONY: help dev ci test battletest verify codegen bench dryrun

@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# Install cert-manager, the Prometheus stack, and karpenter-tpu into the
+# current kubecontext (reference: hack/quick-install.sh:40-66).
+set -euo pipefail
+
+main() {
+  cert_manager
+  prometheus
+  karpenter
+  echo "karpenter-tpu installed."
+}
+
+cert_manager() {
+  kubectl apply -f https://github.com/cert-manager/cert-manager/releases/latest/download/cert-manager.yaml
+  kubectl wait --for=condition=Available --timeout=120s \
+    -n cert-manager deployment/cert-manager-webhook
+}
+
+prometheus() {
+  helm repo add prometheus-community https://prometheus-community.github.io/helm-charts --force-update
+  helm upgrade --install prometheus prometheus-community/kube-prometheus-stack \
+    --namespace monitoring --create-namespace \
+    --set grafana.enabled=false
+}
+
+karpenter() {
+  kubectl apply -k config/
+  kubectl wait --for=condition=Available --timeout=120s \
+    -n karpenter deployment/karpenter-tpu
+}
+
+usage() {
+  cat <<USAGE
+Usage: $0 [--delete]
+Installs cert-manager + kube-prometheus-stack + karpenter-tpu.
+USAGE
+}
+
+if [[ "${1:-}" == "--delete" ]]; then
+  kubectl delete -k config/ --ignore-not-found
+  exit 0
+elif [[ "${1:-}" == "-h" || "${1:-}" == "--help" ]]; then
+  usage
+  exit 0
+fi
+
+main

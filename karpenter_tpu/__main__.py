@@ -3,8 +3,9 @@
 reference: cmd/controller/main.go:40-77 — flag parsing, logging, a
 leader-elected manager serving /metrics on :8080, cloud-provider registry,
 factory graph, controller registration, run-until-signalled. Same wiring
-here, with the reference's admission webhooks replaced by in-process
-admission (store-side validation) so there is no webhook port.
+here. Admission runs in-process (store-side validation) when the store is
+the bus; --webhook-port additionally serves the same rules as k8s
+AdmissionReview webhooks for real-cluster mode (reference port 9443).
 """
 
 from __future__ import annotations
@@ -32,6 +33,19 @@ def parse_args(argv=None) -> argparse.Namespace:
         "gauge registry directly",
     )
     parser.add_argument("--metrics-port", type=int, default=8080)
+    parser.add_argument(
+        "--webhook-port",
+        type=int,
+        default=0,
+        help="serve AdmissionReview validate/mutate webhooks on this port "
+        "(0 = off; real-cluster mode uses 9443 like the reference)",
+    )
+    parser.add_argument(
+        "--webhook-cert-dir",
+        default=None,
+        help="directory holding tls.crt/tls.key for the webhook server "
+        "(plain HTTP when omitted)",
+    )
     parser.add_argument(
         "--cloud-provider",
         default=None,
@@ -87,6 +101,21 @@ def main(argv=None) -> int:
     metrics_server = MetricsServer(runtime.registry, port=args.metrics_port)
     port = metrics_server.start()
     print(f"serving /metrics and /healthz on :{port}", file=sys.stderr)
+    webhook_server = None
+    if args.webhook_port:
+        import os.path
+
+        from karpenter_tpu.webhook import WebhookServer
+
+        cert = key = None
+        if args.webhook_cert_dir:
+            cert = os.path.join(args.webhook_cert_dir, "tls.crt")
+            key = os.path.join(args.webhook_cert_dir, "tls.key")
+        webhook_server = WebhookServer(
+            port=args.webhook_port, cert_file=cert, key_file=key
+        )
+        wport = webhook_server.start()
+        print(f"serving admission webhooks on :{wport}", file=sys.stderr)
     if args.profiler_port:
         if start_profiler_server(args.profiler_port):
             print(
@@ -109,6 +138,8 @@ def main(argv=None) -> int:
         pass
     finally:
         metrics_server.stop()
+        if webhook_server is not None:
+            webhook_server.stop()
         runtime.close()
     return 0
 

@@ -1,0 +1,234 @@
+"""CRD + deployment manifest generation from the API dataclasses.
+
+The reference generates its CRD YAML with controller-gen from kubebuilder
+markers on the Go structs (reference: Makefile:37-53 codegen target,
+config/crd/*.yaml output, the scale-subresource marker at
+pkg/apis/autoscaling/v1alpha1/scalablenodegroup.go:51). Here the Python
+dataclasses ARE the schema source: this module reflects them into OpenAPI
+v3 structural schemas so `config/crd/` can never drift from the types the
+control plane actually validates — the same single-source-of-truth property
+controller-gen gives the reference.
+
+Run `python -m karpenter_tpu.codegen config/` (the Makefile's codegen
+target) to regenerate; tests assert committed YAML == regenerated.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import typing
+from typing import Any, Dict
+
+import yaml
+
+from karpenter_tpu.api.horizontalautoscaler import HorizontalAutoscaler
+from karpenter_tpu.api.metricsproducer import MetricsProducer
+from karpenter_tpu.api.scalablenodegroup import ScalableNodeGroup
+from karpenter_tpu.api.serialization import _FIELD_TO_KEY, snake_to_camel
+from karpenter_tpu.utils.quantity import Quantity
+
+GROUP = "autoscaling.karpenter.sh"
+VERSION = "v1alpha1"
+
+CRD_KINDS = {
+    "HorizontalAutoscaler": {
+        "cls": HorizontalAutoscaler,
+        "plural": "horizontalautoscalers",
+        "shortNames": ["ha"],
+        "printcolumns": [
+            # reference: kubectl printcolumn markers,
+            # horizontalautoscaler.go:192-200
+            {
+                "name": "Min",
+                "type": "integer",
+                "jsonPath": ".spec.minReplicas",
+            },
+            {
+                "name": "Desired",
+                "type": "integer",
+                "jsonPath": ".status.desiredReplicas",
+            },
+            {
+                "name": "Max",
+                "type": "integer",
+                "jsonPath": ".spec.maxReplicas",
+            },
+            {
+                "name": "Ready",
+                "type": "string",
+                "jsonPath": '.status.conditions[?(@.type=="Ready")].status',
+            },
+        ],
+    },
+    "MetricsProducer": {
+        "cls": MetricsProducer,
+        "plural": "metricsproducers",
+        "shortNames": ["mp"],
+        "printcolumns": [
+            {
+                "name": "Ready",
+                "type": "string",
+                "jsonPath": '.status.conditions[?(@.type=="Ready")].status',
+            },
+        ],
+    },
+    "ScalableNodeGroup": {
+        "cls": ScalableNodeGroup,
+        "plural": "scalablenodegroups",
+        "shortNames": ["sng"],
+        # reference: scale-subresource kubebuilder marker,
+        # scalablenodegroup.go:51
+        "scale": {
+            "specReplicasPath": ".spec.replicas",
+            "statusReplicasPath": ".status.replicas",
+        },
+        "printcolumns": [
+            {
+                "name": "Replicas",
+                "type": "integer",
+                "jsonPath": ".status.replicas",
+            },
+            {
+                "name": "Type",
+                "type": "string",
+                "jsonPath": ".spec.type",
+            },
+        ],
+    },
+}
+
+
+def _unwrap_optional(tp: Any) -> Any:
+    if typing.get_origin(tp) is typing.Union:
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def schema_for_type(tp: Any) -> Dict[str, Any]:
+    """Python type -> OpenAPI v3 structural schema node."""
+    tp = _unwrap_optional(tp)
+    origin = typing.get_origin(tp)
+    if origin in (list, typing.List):
+        (item,) = typing.get_args(tp) or (Any,)
+        return {"type": "array", "items": schema_for_type(item)}
+    if origin in (dict, typing.Dict):
+        args = typing.get_args(tp)
+        val = args[1] if len(args) == 2 else Any
+        return {
+            "type": "object",
+            "additionalProperties": schema_for_type(val),
+        }
+    if tp is Quantity:
+        # apimachinery resource.Quantity serializes as a string
+        return {"type": "string"}
+    if dataclasses.is_dataclass(tp):
+        return schema_for_dataclass(tp)
+    if tp is int:
+        return {"type": "integer"}
+    if tp is float:
+        return {"type": "number"}
+    if tp is bool:
+        return {"type": "boolean"}
+    if tp is str:
+        return {"type": "string"}
+    # Any / unknown: accept arbitrary structure
+    return {"x-kubernetes-preserve-unknown-fields": True}
+
+
+def schema_for_dataclass(cls: type) -> Dict[str, Any]:
+    hints = typing.get_type_hints(cls)
+    props = {}
+    for f in dataclasses.fields(cls):
+        key = _FIELD_TO_KEY.get(f.name, snake_to_camel(f.name))
+        props[key] = schema_for_type(hints[f.name])
+    return {"type": "object", "properties": props}
+
+
+def crd_manifest(kind: str) -> Dict[str, Any]:
+    info = CRD_KINDS[kind]
+    cls = info["cls"]
+    hints = typing.get_type_hints(cls)
+    spec_schema = schema_for_type(hints["spec"])
+    status_schema = schema_for_type(hints["status"])
+    version: Dict[str, Any] = {
+        "name": VERSION,
+        "served": True,
+        "storage": True,
+        "schema": {
+            "openAPIV3Schema": {
+                "type": "object",
+                "properties": {
+                    "apiVersion": {"type": "string"},
+                    "kind": {"type": "string"},
+                    "metadata": {"type": "object"},
+                    "spec": spec_schema,
+                    "status": status_schema,
+                },
+            }
+        },
+        "subresources": {"status": {}},
+        "additionalPrinterColumns": info["printcolumns"],
+    }
+    if "scale" in info:
+        version["subresources"]["scale"] = info["scale"]
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {
+            "name": f"{info['plural']}.{GROUP}",
+            "annotations": {
+                # cert-manager CA injection for conversion/admission,
+                # reference: config/crd kustomize patches
+                "cert-manager.io/inject-ca-from": (
+                    "karpenter/karpenter-serving-cert"
+                ),
+            },
+        },
+        "spec": {
+            "group": GROUP,
+            "names": {
+                "kind": kind,
+                "listKind": f"{kind}List",
+                "plural": info["plural"],
+                "singular": kind.lower(),
+                "shortNames": info["shortNames"],
+            },
+            "scope": "Namespaced",
+            "versions": [version],
+        },
+    }
+
+
+def crd_yaml(kind: str) -> str:
+    return yaml.safe_dump(
+        crd_manifest(kind), sort_keys=False, default_flow_style=False
+    )
+
+
+def write_crds(config_dir: str) -> list:
+    import os
+
+    crd_dir = os.path.join(config_dir, "crd")
+    os.makedirs(crd_dir, exist_ok=True)
+    written = []
+    for kind, info in CRD_KINDS.items():
+        path = os.path.join(crd_dir, f"{GROUP}_{info['plural']}.yaml")
+        with open(path, "w") as f:
+            f.write(crd_yaml(kind))
+        written.append(path)
+    return written
+
+
+def main(argv=None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    config_dir = args[0] if args else "config"
+    for path in write_crds(config_dir):
+        print(f"wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,6 +22,7 @@ them back because they are real ObjectMeta fields.
 
 from __future__ import annotations
 
+import fcntl
 import json
 import os
 import threading
@@ -104,8 +105,21 @@ class DurableStore(Store):
         self.compact_every = max(1, int(compact_every))
         self._wal_count = 0
         self._wal_file = None
+        self._wal_dirty = False  # an append failed; WAL has a gap
         self._io_lock = threading.Lock()
         os.makedirs(data_dir, exist_ok=True)
+        # exclusive data-dir lock: two processes appending to one WAL would
+        # interleave records and corrupt the journal (leader election does
+        # NOT protect against this — each process's lease lives in its own
+        # store); fail fast like etcd does on a locked member dir
+        self._lock_file = open(os.path.join(data_dir, "LOCK"), "w")
+        try:
+            fcntl.flock(self._lock_file, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            self._lock_file.close()
+            raise RuntimeError(
+                f"data dir {data_dir} is locked by another process"
+            ) from None
         self._recovering = True
         try:
             self._recover()
@@ -220,14 +234,35 @@ class DurableStore(Store):
         super()._notify(event, obj)
 
     def _append(self, record: dict) -> None:
+        """Journal one record. Never raises: memory is authoritative and
+        watchers must observe exactly what memory holds, so an I/O failure
+        degrades durability (loudly) instead of leaving the caller with a
+        mutation that is half-acknowledged. A failed append leaves a gap in
+        the WAL, so the store marks itself dirty and self-heals by writing
+        a FULL snapshot (which supersedes the gappy WAL) as soon as I/O
+        succeeds again."""
         with self._io_lock:
-            self._wal_file.write(json.dumps(record, sort_keys=True) + "\n")
-            self._wal_file.flush()
-            if self.fsync:
-                os.fsync(self._wal_file.fileno())
-            self._wal_count += 1
-            if self._wal_count >= self.compact_every:
-                self._compact_locked()
+            try:
+                if self._wal_dirty:
+                    self._compact_locked()  # snapshot == full current state
+                    self._wal_dirty = False
+                    log.warning("wal: journal healed via full snapshot")
+                    return
+                self._wal_file.write(
+                    json.dumps(record, sort_keys=True) + "\n"
+                )
+                self._wal_file.flush()
+                if self.fsync:
+                    os.fsync(self._wal_file.fileno())
+                self._wal_count += 1
+                if self._wal_count >= self.compact_every:
+                    self._compact_locked()
+            except OSError:
+                self._wal_dirty = True
+                log.exception(
+                    "wal: append failed — durability degraded until the "
+                    "next successful snapshot"
+                )
 
     def _compact_locked(self) -> None:
         """Write a full snapshot atomically, then truncate the WAL.
@@ -243,6 +278,15 @@ class DurableStore(Store):
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._snapshot_path)
+        # make the rename durable BEFORE truncating the WAL: if power is
+        # lost with the truncation on disk but the rename not, recovery
+        # would pair the OLD snapshot with an empty WAL and lose every
+        # record since the previous compaction
+        dir_fd = os.open(self.data_dir, os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
         self._wal_file.close()
         self._wal_file = open(self._wal_path, "w", encoding="utf-8")
         if self.fsync:
@@ -259,6 +303,8 @@ class DurableStore(Store):
             if self._wal_file is not None and not self._wal_file.closed:
                 self._wal_file.flush()
                 self._wal_file.close()
+            if not self._lock_file.closed:
+                self._lock_file.close()  # releases the flock
 
 
 def open_store(data_dir: Optional[str], **kwargs) -> Store:

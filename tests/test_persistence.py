@@ -258,6 +258,56 @@ class TestCrashTolerance:
         assert rvs == sorted(rvs) and len(rvs) == 2
 
 
+class TestDataDirLock:
+    def test_second_process_rejected(self, tmp_path):
+        """Two processes on one --data-dir would interleave WAL appends;
+        fail fast like etcd on a locked member dir."""
+        d = str(tmp_path)
+        s1 = DurableStore(d)
+        with pytest.raises(RuntimeError, match="locked"):
+            DurableStore(d)
+        s1.close()
+        s2 = DurableStore(d)  # released on close
+        s2.close()
+
+
+class TestAppendFailure:
+    class _BrokenFile:
+        closed = False
+
+        def write(self, *_):
+            raise OSError(28, "No space left on device")
+
+        def flush(self):
+            pass
+
+        def close(self):
+            self.closed = True
+
+        def fileno(self):
+            raise OSError(9, "Bad file descriptor")
+
+    def test_memory_stays_authoritative_and_journal_self_heals(self, tmp_path):
+        d = str(tmp_path)
+        s = DurableStore(d)
+        events = []
+        s.watch(None, lambda ev, obj: events.append(obj.metadata.name))
+        s.create(sng("a"))
+        real_wal = s._wal_file
+        s._wal_file = self._BrokenFile()  # disk "fills"
+        s.create(sng("b"))  # must NOT raise; watchers must still fire
+        assert events == ["a", "b"]
+        assert s._wal_dirty
+        real_wal.close()
+        s.create(sng("c"))  # first success -> full snapshot heals the gap
+        assert not s._wal_dirty
+        s.close()
+        s2 = DurableStore(d)
+        names = sorted(o.metadata.name for o in s2.list("ScalableNodeGroup"))
+        assert names == ["a", "b", "c"]  # nothing acknowledged was lost
+        s2.close()
+
+
 class TestFactory:
     def test_open_store_dispatch(self, tmp_path):
         durable = open_store(str(tmp_path))
