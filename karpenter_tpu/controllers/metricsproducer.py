@@ -42,6 +42,7 @@ class MetricsProducerController:
                     pending,
                     self.factory.registry,
                     solver=self.factory.solver,
+                    pod_cache=self.factory.pod_cache(),
                 )
                 for mp in pending:
                     results[key(mp)] = None

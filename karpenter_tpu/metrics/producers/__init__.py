@@ -27,12 +27,25 @@ class ProducerFactory:
         # optional remote bin-pack (sidecar SolverClient.solve); None =
         # in-process device call
         self.solver = solver
+        self._pod_cache = None
+
+    def pod_cache(self):
+        """Incremental columnar feed for the pending-pods solve: O(changed
+        pods) per tick instead of O(all pods) (store/columnar.py). Created
+        on FIRST pendingCapacity use so deployments without that producer
+        never pay the per-Pod-mutation watch cost."""
+        if self._pod_cache is None:
+            from karpenter_tpu.store.columnar import PendingPodCache
+
+            self._pod_cache = PendingPodCache(self.store)
+        return self._pod_cache
 
     def for_producer(self, mp):
         spec = mp.spec
         if spec.pending_capacity is not None:
             return PendingCapacityProducer(
-                mp, self.store, registry=self.registry, solver=self.solver
+                mp, self.store, registry=self.registry, solver=self.solver,
+                pod_cache=self.pod_cache(),
             )
         if spec.queue is not None:
             return QueueProducer(

@@ -42,6 +42,11 @@ from karpenter_tpu.api.core import (
 from karpenter_tpu.api.metricsproducer import PendingCapacityStatus
 from karpenter_tpu.metrics.registry import GaugeRegistry, default_registry
 from karpenter_tpu.ops import binpack as B
+from karpenter_tpu.store.columnar import (
+    BASE_RESOURCES,
+    RESOURCE_PODS,
+    snapshot_from_pods,
+)
 from karpenter_tpu.utils.functional import pad_to_multiple
 
 SUBSYSTEM = "pending_capacity"
@@ -52,9 +57,9 @@ UNSCHEDULABLE_PODS = "unschedulable_pods"
 
 # base resources always present; the per-solve universe adds any extended
 # resources (GPUs/TPUs/ephemeral-storage/...) seen in requests or allocatable,
-# with the 'pods' slot axis always LAST (each pod occupies exactly 1)
-RESOURCES_BASE = ("cpu", "memory")
-RESOURCE_PODS = "pods"
+# with the 'pods' slot axis always LAST (each pod occupies exactly 1).
+# Single definition lives with the encoder (store/columnar.py).
+RESOURCES_BASE = BASE_RESOURCES
 
 # pad buckets for stable compiled shapes; universes GROW in these steps
 # rather than truncating (silent constraint drops = false feasibility)
@@ -135,7 +140,8 @@ def _group_profile(
 
 
 def solve_pending(
-    store, due_producers: List, registry: GaugeRegistry, solver=None
+    store, due_producers: List, registry: GaugeRegistry, solver=None,
+    pod_cache=None,
 ) -> None:
     """One device call over ALL pendingCapacity producers in the store.
 
@@ -148,6 +154,12 @@ def solve_pending(
     `solver` is the Algorithm seam: any (inputs, buckets=...) ->
     BinPackOutputs callable — in-process ops/binpack.solve (default) or a
     sidecar SolverClient.solve (gRPC process split).
+
+    `pod_cache` (store/columnar.PendingPodCache) replaces the O(all pods)
+    list+encode feed with an O(changed pods) incremental one; outputs are
+    identical (the solver is permutation-invariant over pods: per-pod
+    first-feasible assignment + bucket histograms). Without it the original
+    list path runs — the oracle the property tests compare against.
     """
     due_keys = {
         (mp.metadata.namespace, mp.metadata.name): mp for mp in due_producers
@@ -167,103 +179,25 @@ def solve_pending(
     if not producers:
         return
 
-    pods = [
-        p
-        for p in store.list("Pod")
-        if not p.spec.node_name and p.status.phase in ("", "Pending")
-    ]
-
     nodes = store.list("Node")  # listed ONCE; profiles filter in-memory
     profiles = [
         _group_profile(nodes, mp.spec.pending_capacity.node_selector)
         for mp in producers
     ]
 
-    # resource universe: base + every extended resource seen in pending-pod
-    # requests or group shapes; 'pods' slot last, padded for compile
-    # stability. A pod requesting a resource absent from a group's shape
-    # fails fit there (req > alloc=0) — extended resources are constraints,
-    # never silently dropped.
-    pod_request_dicts = [
-        {r: q.to_float() for r, q in pod.requests().items()} for pod in pods
-    ]
-    extended: set = set()
-    for req in pod_request_dicts:
-        extended |= {
-            r
-            for r, v in req.items()
-            if r not in RESOURCES_BASE and r != RESOURCE_PODS and v > 0
-        }
-    for alloc, _, _ in profiles:
-        extended |= {
-            r
-            for r in alloc
-            if r not in RESOURCES_BASE and r != RESOURCE_PODS
-        }
-    resources = [*RESOURCES_BASE, *sorted(extended), RESOURCE_PODS]
-    n_resources = _pad(len(resources), RESOURCE_PAD)
+    # ONE encode implementation for both paths (store/columnar.py): the
+    # cache snapshots its watch-maintained arena; the oracle path runs the
+    # same detached encoder over a fresh store.list — so they cannot drift
+    if pod_cache is not None:
+        snap = pod_cache.snapshot()
+    else:
+        snap = snapshot_from_pods(store.list("Pod"))
+    inputs = _encode_from_cache(snap, profiles)
+    _dispatch_and_record(inputs, producers, registry, solver)
 
-    # encode universes; sized to the data (padded), never truncated
-    taint_universe: Dict[tuple, int] = {}
-    for _, _, taints in profiles:
-        for taint in sorted(taints):
-            if taint not in taint_universe:
-                taint_universe[taint] = len(taint_universe)
-    label_universe: Dict[tuple, int] = {}
-    for pod in pods:
-        for item in sorted(pod.spec.node_selector.items()):
-            if item not in label_universe:
-                label_universe[item] = len(label_universe)
 
-    n_pods = _pad(len(pods), POD_PAD)
-    n_groups = _pad(len(producers), GROUP_PAD)
-    n_taints = _pad(len(taint_universe), TAINT_PAD)
-    n_labels = _pad(len(label_universe), LABEL_PAD)
-
-    # one Taint object per universe entry, reused across all pods
-    taint_objects = {
-        k: Taint(key=taint[0], value=taint[1], effect=taint[2])
-        for taint, k in taint_universe.items()
-    }
-
-    # Host-side encode is the feeding path (SURVEY.md §7 hard part (d)): it
-    # iterates each pod's SPARSE items (its own requests/selector entries,
-    # which are guaranteed universe keys), not the full K/L/R universes, and
-    # dedupes the toleration→intolerance row by distinct toleration sets —
-    # fleets share a handful of toleration shapes, so the O(K·tolerations)
-    # check runs once per shape, not once per pod.
-    pod_requests = np.zeros((n_pods, n_resources), np.float32)
-    pod_valid = np.zeros(n_pods, bool)
-    pod_intolerant = np.zeros((n_pods, n_taints), bool)
-    pod_required = np.zeros((n_pods, n_labels), bool)
-    pod_slot = resources.index(RESOURCE_PODS)
-    resource_index = {r: idx for idx, r in enumerate(resources)}
-    intolerance_rows: Dict[tuple, np.ndarray] = {}
-    for i, pod in enumerate(pods):
-        for r, v in pod_request_dicts[i].items():
-            idx = resource_index.get(r)
-            if idx is not None and idx != pod_slot:
-                pod_requests[i, idx] = v
-        pod_requests[i, pod_slot] = 1.0  # each pod occupies 1 slot
-        pod_valid[i] = True
-        shape = tuple(
-            sorted(
-                (t.key, t.operator, t.value, t.effect)
-                for t in pod.spec.tolerations
-            )
-        )
-        row = intolerance_rows.get(shape)
-        if row is None:
-            row = np.zeros(n_taints, bool)
-            for k, taint in taint_objects.items():
-                row[k] = not any(
-                    tol.tolerates(taint) for tol in pod.spec.tolerations
-                )
-            intolerance_rows[shape] = row
-        pod_intolerant[i] = row
-        for item in pod.spec.node_selector.items():
-            pod_required[i, label_universe[item]] = True
-
+def _group_arrays(profiles, resources, taint_universe, label_universe,
+                  n_groups, n_resources, n_taints, n_labels):
     group_allocatable = np.zeros((n_groups, n_resources), np.float32)
     group_taints = np.zeros((n_groups, n_taints), bool)
     group_labels = np.zeros((n_groups, n_labels), bool)
@@ -274,24 +208,94 @@ def solve_pending(
             group_taints[t, k] = taint in taints
         for item, l in label_universe.items():
             group_labels[t, l] = item in labels
+    return group_allocatable, group_taints, group_labels
 
+
+def _encode_from_cache(snap, profiles) -> "B.BinPackInputs":
+    """Snapshot (store/columnar.PendingSnapshot) -> solver inputs.
+
+    All per-pod work here is bulk numpy (column gathers, row gathers by
+    toleration-shape id); the only Python loops left are over universes —
+    resources, group profiles, taints, distinct toleration shapes — whose
+    cardinalities are fleet-scale constants, not pod counts.
+    """
+    hi = snap.requests.shape[0]
+
+    extended = {
+        r for r in snap.resources
+        if r not in RESOURCES_BASE and r != RESOURCE_PODS
+    }
+    for alloc, _, _ in profiles:
+        extended |= {
+            r for r in alloc
+            if r not in RESOURCES_BASE and r != RESOURCE_PODS
+        }
+    resources = [*RESOURCES_BASE, *sorted(extended), RESOURCE_PODS]
+    n_resources = _pad(len(resources), RESOURCE_PAD)
+    resource_index = {r: idx for idx, r in enumerate(resources)}
+    pod_slot = resources.index(RESOURCE_PODS)
+
+    taint_universe: Dict[tuple, int] = {}
+    for _, _, taints in profiles:
+        for taint in sorted(taints):
+            if taint not in taint_universe:
+                taint_universe[taint] = len(taint_universe)
+    label_universe = {item: l for l, item in enumerate(snap.labels)}
+
+    n_pods = _pad(hi, POD_PAD)
+    n_groups = _pad(len(profiles), GROUP_PAD)
+    n_taints = _pad(len(taint_universe), TAINT_PAD)
+    n_labels = _pad(len(label_universe), LABEL_PAD)
+
+    pod_requests = np.zeros((n_pods, n_resources), np.float32)
+    pod_valid = np.zeros(n_pods, bool)
+    pod_required = np.zeros((n_pods, n_labels), bool)
+    pod_intolerant = np.zeros((n_pods, n_taints), bool)
+    if hi:
+        cols = np.array(
+            [resource_index[r] for r in snap.resources], np.intp
+        )
+        pod_requests[:hi, cols] = snap.requests
+        pod_requests[:hi, pod_slot] = snap.valid.astype(np.float32)
+        pod_valid[:hi] = snap.valid
+        if snap.labels:
+            pod_required[:hi, : len(snap.labels)] = snap.required
+        if snap.shape_tolerations:
+            taint_objects = {
+                k: Taint(key=taint[0], value=taint[1], effect=taint[2])
+                for taint, k in taint_universe.items()
+            }
+            rows = np.zeros((len(snap.shape_tolerations), n_taints), bool)
+            for s, tolerations in enumerate(snap.shape_tolerations):
+                for k, taint in taint_objects.items():
+                    rows[s, k] = not any(
+                        tol.tolerates(taint) for tol in tolerations
+                    )
+            pod_intolerant[:hi] = rows[snap.shape_id]
+
+    group_allocatable, group_taints, group_labels = _group_arrays(
+        profiles, resources, taint_universe, label_universe,
+        n_groups, n_resources, n_taints, n_labels,
+    )
+    return B.BinPackInputs(
+        pod_requests=pod_requests,
+        pod_valid=pod_valid,
+        pod_intolerant=pod_intolerant,
+        pod_required=pod_required,
+        group_allocatable=group_allocatable,
+        group_taints=group_taints,
+        group_labels=group_labels,
+    )
+
+
+def _dispatch_and_record(inputs, producers, registry, solver) -> None:
     if solver is None:
         solver = B.solve
     # numpy arrays go straight through: the in-process jitted solve
     # device-puts them itself, and a remote solver serializes host bytes —
     # wrapping in jnp here would force a device round-trip (and JAX init)
     # in the control-plane process the sidecar split exists to relieve
-    out = solver(
-        B.BinPackInputs(
-            pod_requests=pod_requests,
-            pod_valid=pod_valid,
-            pod_intolerant=pod_intolerant,
-            pod_required=pod_required,
-            group_allocatable=group_allocatable,
-            group_taints=group_taints,
-            group_labels=group_labels,
-        )
-    )
+    out = solver(inputs)
 
     assigned_count = np.asarray(out.assigned_count)
     nodes_needed = np.asarray(out.nodes_needed)
@@ -323,12 +327,17 @@ class PendingCapacityProducer:
         store,
         registry: Optional[GaugeRegistry] = None,
         solver=None,
+        pod_cache=None,
     ):
         self.mp = mp
         self.store = store
         self.registry = registry if registry is not None else default_registry()
         self.solver = solver
+        self.pod_cache = pod_cache
         register_gauges(self.registry)
 
     def reconcile(self) -> None:
-        solve_pending(self.store, [self.mp], self.registry, solver=self.solver)
+        solve_pending(
+            self.store, [self.mp], self.registry, solver=self.solver,
+            pod_cache=self.pod_cache,
+        )

@@ -1,0 +1,339 @@
+"""Columnar pending-pod cache: incremental maintenance + solver-input
+equivalence with the store.list oracle path under churn.
+
+The cache (store/columnar.py) must produce EXACTLY the outputs of the
+original list+encode path for any store history — adds, request changes,
+binding (pod gets a nodeName), deletion, slot reuse, universe growth —
+because the solver is permutation-invariant over pods.
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api.core import (
+    Container,
+    Node,
+    NodeCondition,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    Toleration,
+)
+from karpenter_tpu.api.metricsproducer import (
+    MetricsProducer,
+    MetricsProducerSpec,
+    PendingCapacitySpec,
+)
+from karpenter_tpu.metrics.producers.pendingcapacity import solve_pending
+from karpenter_tpu.metrics.registry import GaugeRegistry
+from karpenter_tpu.store import Store
+from karpenter_tpu.store.columnar import PendingPodCache
+from karpenter_tpu.utils.quantity import Quantity
+
+
+def pod(name, cpu="100m", mem="128Mi", node=None, selector=None,
+        tolerations=None, extra=None, phase="Pending"):
+    from karpenter_tpu.api.core import PodStatus
+
+    requests = {"cpu": Quantity.parse(cpu), "memory": Quantity.parse(mem)}
+    for r, v in (extra or {}).items():
+        requests[r] = Quantity.parse(v)
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=PodSpec(
+            node_name=node,
+            containers=[Container(requests=requests)],
+            node_selector=dict(selector or {}),
+            tolerations=list(tolerations or []),
+        ),
+        status=PodStatus(phase=phase),
+    )
+
+
+def node(name, labels, cpu="32", mem="128Gi", taints=None):
+    from karpenter_tpu.api.core import Taint
+
+    return Node(
+        metadata=ObjectMeta(name=name, labels=dict(labels)),
+        spec=__import__(
+            "karpenter_tpu.api.core", fromlist=["NodeSpec"]
+        ).NodeSpec(taints=[Taint(**t) for t in (taints or [])]),
+        status=NodeStatus(
+            allocatable={
+                "cpu": Quantity.parse(cpu),
+                "memory": Quantity.parse(mem),
+            },
+            conditions=[NodeCondition(type="Ready", status="True")],
+        ),
+    )
+
+
+def producer(name, selector):
+    return MetricsProducer(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=MetricsProducerSpec(
+            pending_capacity=PendingCapacitySpec(node_selector=dict(selector))
+        ),
+    )
+
+
+def statuses(store):
+    out = {}
+    for mp in store.list("MetricsProducer"):
+        s = mp.status.pending_capacity
+        out[mp.metadata.name] = None if s is None else (
+            s.pending_pods,
+            s.additional_nodes_needed,
+            s.lp_lower_bound,
+            s.unschedulable_pods,
+        )
+    return out
+
+
+def solve_both(store, cache):
+    """Run the oracle (list) path and the cache path; return both status
+    maps. Producers are re-fetched fresh so statuses don't leak across."""
+    results = []
+    for pod_cache in (None, cache):
+        mps = [
+            mp for mp in store.list("MetricsProducer")
+            if mp.spec.pending_capacity is not None
+        ]
+        solve_pending(store, mps, GaugeRegistry(), pod_cache=pod_cache)
+        results.append(
+            {
+                mp.metadata.name: (
+                    mp.status.pending_capacity.pending_pods,
+                    mp.status.pending_capacity.additional_nodes_needed,
+                    mp.status.pending_capacity.lp_lower_bound,
+                    mp.status.pending_capacity.unschedulable_pods,
+                )
+                for mp in mps
+            }
+        )
+    return results
+
+
+class TestMaintenance:
+    def test_add_bind_delete(self):
+        store = Store()
+        cache = PendingPodCache(store)
+        created = store.create(pod("a"))
+        store.create(pod("b"))
+        assert len(cache) == 2
+        created.spec.node_name = "n1"  # scheduled -> no longer pending
+        store.update(created)
+        assert len(cache) == 1
+        store.delete("Pod", "default", "b")
+        assert len(cache) == 0
+
+    def test_adopts_preexisting_pods(self):
+        store = Store()
+        store.create(pod("a"))
+        store.create(pod("b", node="n1"))  # bound: not pending
+        cache = PendingPodCache(store)
+        assert len(cache) == 1
+
+    def test_non_pending_phase_excluded(self):
+        store = Store()
+        cache = PendingPodCache(store)
+        store.create(pod("done", phase="Succeeded"))
+        assert len(cache) == 0
+
+    def test_slot_reuse_and_growth(self):
+        store = Store()
+        cache = PendingPodCache(store, capacity=16)
+        for i in range(40):  # forces arena growth
+            store.create(pod(f"p{i}"))
+        for i in range(0, 40, 2):
+            store.delete("Pod", "default", f"p{i}")
+        for i in range(40, 60):  # reuses freed slots
+            store.create(pod(f"p{i}"))
+        assert len(cache) == 40
+        snap = cache.snapshot()
+        assert int(snap.valid.sum()) == 40
+
+    def test_universe_growth_new_resource_and_label(self):
+        store = Store()
+        cache = PendingPodCache(store, capacity=16)
+        store.create(pod("a"))
+        for i in range(20):  # outgrow both column arenas
+            store.create(
+                pod(
+                    f"x{i}",
+                    extra={f"vendor.io/res{i}": "1"},
+                    selector={f"zone{i}": "z"},
+                )
+            )
+        snap = cache.snapshot()
+        assert "vendor.io/res7" in snap.resources
+        assert ("zone7", "z") in snap.labels
+        row = snap.requests[:, snap.resources.index("vendor.io/res7")]
+        assert row.sum() == pytest.approx(1.0)
+
+    def test_snapshot_isolation(self):
+        store = Store()
+        cache = PendingPodCache(store)
+        store.create(pod("a"))
+        snap = cache.snapshot()
+        before = snap.requests.copy()
+        store.create(pod("b", cpu="4"))
+        np.testing.assert_array_equal(snap.requests, before)
+
+    def test_modify_requests_reencodes(self):
+        store = Store()
+        cache = PendingPodCache(store)
+        created = store.create(pod("a", cpu="1"))
+        created.spec.containers[0].requests["cpu"] = Quantity.parse("2")
+        store.update(created)
+        snap = cache.snapshot()
+        cpu = snap.resources.index("cpu")
+        assert snap.requests[:, cpu].max() == pytest.approx(2.0)
+        assert len(cache) == 1
+
+
+class TestCompaction:
+    def test_peak_drain_restores_live_cost(self):
+        """After an incident peak drains, snapshot size must track the LIVE
+        pending set, not the historical high-water mark."""
+        store = Store()
+        cache = PendingPodCache(store, capacity=16)
+        for i in range(600):
+            store.create(pod(f"p{i}"))
+        assert cache.snapshot().requests.shape[0] >= 600
+        for i in range(590):
+            store.delete("Pod", "default", f"p{i}")
+        snap = cache.snapshot()  # triggers compaction (peak >> live)
+        assert snap.requests.shape[0] < 64
+        assert int(snap.valid.sum()) == 10
+
+    def test_universe_churn_compacts(self):
+        """Per-job selector labels must not accumulate forever."""
+        store = Store()
+        cache = PendingPodCache(store, capacity=16)
+        for i in range(600):  # each adds a unique label, then leaves
+            store.create(pod(f"p{i}", selector={f"job{i}": "x"}))
+            store.delete("Pod", "default", f"p{i}")
+        store.create(pod("steady", selector={"zone": "z"}))
+        snap = cache.snapshot()
+        assert len(snap.labels) < 16
+        assert ("zone", "z") in snap.labels
+
+    def test_compaction_preserves_solver_outputs(self):
+        store = Store()
+        cache = PendingPodCache(store, capacity=16)
+        store.create(node("n0", {"group": "small"}, cpu="8"))
+        store.create(producer("small", {"group": "small"}))
+        for i in range(400):
+            store.create(pod(f"p{i}", cpu="1"))
+        for i in range(380):
+            store.delete("Pod", "default", f"p{i}")
+        oracle, cached = solve_both(store, cache)
+        assert oracle == cached
+        assert cached["small"][0] == 20
+
+
+class TestLazyFactoryCache:
+    def test_not_created_without_pending_producer(self):
+        from karpenter_tpu.cloudprovider.fake import FakeFactory
+        from karpenter_tpu.metrics.producers import ProducerFactory
+
+        store = Store()
+        factory = ProducerFactory(store, FakeFactory(), registry=GaugeRegistry())
+        assert factory._pod_cache is None
+        assert factory.pod_cache() is factory.pod_cache()  # memoized
+
+
+class TestEquivalence:
+    def _cluster(self, store):
+        store.create(node("n0", {"group": "small"}, cpu="8", mem="32Gi"))
+        store.create(
+            node(
+                "n1",
+                {"group": "big"},
+                cpu="64",
+                mem="256Gi",
+                taints=[
+                    {"key": "accel", "value": "tpu", "effect": "NoSchedule"}
+                ],
+            )
+        )
+        store.create(producer("small", {"group": "small"}))
+        store.create(producer("big", {"group": "big"}))
+
+    def test_simple_equivalence(self):
+        store = Store()
+        cache = PendingPodCache(store)
+        self._cluster(store)
+        for i in range(10):
+            store.create(pod(f"p{i}", cpu="2"))
+        oracle, cached = solve_both(store, cache)
+        assert oracle == cached
+        assert oracle["small"][0] > 0
+
+    def test_equivalence_with_tolerations_and_selectors(self):
+        store = Store()
+        cache = PendingPodCache(store)
+        self._cluster(store)
+        tol = [
+            Toleration(
+                key="accel", operator="Equal", value="tpu",
+                effect="NoSchedule",
+            )
+        ]
+        for i in range(6):
+            store.create(
+                pod(f"t{i}", cpu="16", tolerations=tol,
+                    selector={"group": "big"})
+            )
+        for i in range(6):
+            store.create(pod(f"u{i}", cpu="16"))  # intolerant of big's taint
+        oracle, cached = solve_both(store, cache)
+        assert oracle == cached
+        assert cached["big"][0] == 6  # tolerant+selected pods land on big
+
+    def test_equivalence_under_random_churn(self):
+        rng = np.random.default_rng(7)
+        store = Store()
+        cache = PendingPodCache(store, capacity=16)
+        self._cluster(store)
+        live = {}
+        serial = 0
+        for _ in range(300):
+            action = rng.choice(["add", "bind", "delete", "resize"])
+            if action == "add" or not live:
+                name = f"p{serial}"
+                serial += 1
+                extra = (
+                    {"vendor.io/widget": "2"} if rng.random() < 0.2 else None
+                )
+                selector = {"group": "big"} if rng.random() < 0.3 else None
+                obj = store.create(
+                    pod(
+                        name,
+                        cpu=f"{rng.integers(1, 9)}",
+                        selector=selector,
+                        extra=extra,
+                    )
+                )
+                live[name] = obj
+            elif action == "bind":
+                name = rng.choice(list(live))
+                obj = store.get("Pod", "default", name)
+                obj.spec.node_name = "n0"
+                store.update(obj)
+                del live[name]
+            elif action == "delete":
+                name = rng.choice(list(live))
+                store.delete("Pod", "default", name)
+                del live[name]
+            else:  # resize
+                name = rng.choice(list(live))
+                obj = store.get("Pod", "default", name)
+                obj.spec.containers[0].requests["cpu"] = Quantity.parse(
+                    f"{rng.integers(1, 17)}"
+                )
+                store.update(obj)
+        oracle, cached = solve_both(store, cache)
+        assert oracle == cached
