@@ -182,23 +182,25 @@ class PendingPodCache:
     # -- compaction --------------------------------------------------------
 
     def _needs_compaction(self) -> bool:
+        """O(1) unless a cheap precondition trips: the O(live) live-set
+        scans below only run when a universe has already crossed the
+        absolute floor — snapshot() on a healthy cache stays a bulk copy."""
         live = len(self._slot)
-        dead_rows = (
-            self._hi >= _COMPACT_FLOOR and self._hi > _COMPACT_FACTOR * live
-        )
-        live_shapes = {int(self._shape_id[s]) for s in self._slot.values()}
-        live_labels = set()
-        for sparse in self._sparse.values():
-            live_labels.update(sparse.selector)
-        dead_shapes = (
-            len(self._shapes) >= _COMPACT_FLOOR
-            and len(self._shapes) > _COMPACT_FACTOR * max(1, len(live_shapes))
-        )
-        dead_labels = (
-            len(self._labels) >= _COMPACT_FLOOR
-            and len(self._labels) > _COMPACT_FACTOR * max(1, len(live_labels))
-        )
-        return dead_rows or dead_shapes or dead_labels
+        if self._hi >= _COMPACT_FLOOR and self._hi > _COMPACT_FACTOR * live:
+            return True
+        if len(self._shapes) >= _COMPACT_FLOOR:
+            live_shapes = len(
+                {int(self._shape_id[s]) for s in self._slot.values()}
+            )
+            if len(self._shapes) > _COMPACT_FACTOR * max(1, live_shapes):
+                return True
+        if len(self._labels) >= _COMPACT_FLOOR:
+            live_labels: set = set()
+            for sparse in self._sparse.values():
+                live_labels.update(sparse.selector)
+            if len(self._labels) > _COMPACT_FACTOR * max(1, len(live_labels)):
+                return True
+        return False
 
     def _compact(self) -> None:
         """Rebuild arenas + universes from live sparse records: O(live),
@@ -299,31 +301,12 @@ def snapshot_from_pods(pods) -> "PendingSnapshot":
     return cache.snapshot()
 
 
+@dataclass(slots=True)
 class PendingSnapshot:
-    __slots__ = (
-        "requests",
-        "required",
-        "shape_id",
-        "valid",
-        "resources",
-        "labels",
-        "shape_tolerations",
-    )
-
-    def __init__(
-        self,
-        requests: np.ndarray,
-        required: np.ndarray,
-        shape_id: np.ndarray,
-        valid: np.ndarray,
-        resources: List[str],
-        labels: List[Tuple[str, str]],
-        shape_tolerations: List[list],
-    ):
-        self.requests = requests
-        self.required = required
-        self.shape_id = shape_id
-        self.valid = valid
-        self.resources = resources
-        self.labels = labels
-        self.shape_tolerations = shape_tolerations
+    requests: np.ndarray
+    required: np.ndarray
+    shape_id: np.ndarray
+    valid: np.ndarray
+    resources: List[str]
+    labels: List[Tuple[str, str]]
+    shape_tolerations: List[list]
