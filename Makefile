@@ -22,6 +22,10 @@ verify: ## Static checks: compile all sources, no syntax/undefined-name drift
 codegen: ## Regenerate config/crd/*.yaml + releases/manifest.yaml from the API types
 	bash hack/release.sh
 
+native: ## Pre-build the C accelerators (otherwise built lazily in background)
+	$(PYTHON) -c "from karpenter_tpu.native import load_kquantity; \
+		assert load_kquantity() is not None, 'native build failed'; print('native ok')"
+
 bench: ## Headline benchmark (runs on the real TPU when present)
 	$(PYTHON) bench.py
 
@@ -31,4 +35,4 @@ dryrun: ## Multi-chip sharding compile check on 8 virtual CPU devices
 		import jax; jax.config.update('jax_platforms', 'cpu'); \
 		import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
 
-.PHONY: help dev ci test battletest verify codegen bench dryrun
+.PHONY: help dev ci test battletest verify codegen native bench dryrun

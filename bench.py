@@ -137,10 +137,12 @@ def main() -> None:
 
 
 def run_e2e(args) -> None:
-    """Full control-plane tick at scale: store -> columnar cache snapshot ->
-    encode -> device bin-pack, the path one reconcile actually runs
-    (BASELINE.json 'p50 reconcile latency'). Store population cost is
-    excluded: pods arrive via watch events over the fleet's lifetime."""
+    """Full control-plane tick at scale: one solve_pending call — node
+    listing, group profiling, columnar cache snapshot, encode, transfer,
+    device bin-pack, status + gauge writes — exactly the path a
+    MetricsProducer reconcile runs (BASELINE.json 'p50 reconcile
+    latency'). Store population cost is excluded: pods arrive via watch
+    events over the fleet's lifetime."""
     import jax
 
     from karpenter_tpu.api.core import (
@@ -152,13 +154,24 @@ def run_e2e(args) -> None:
         Pod,
         PodSpec,
     )
+    from karpenter_tpu.api.metricsproducer import (
+        MetricsProducer,
+        MetricsProducerSpec,
+        PendingCapacitySpec,
+    )
+    import functools
+
     from karpenter_tpu.metrics.producers.pendingcapacity import (
-        _encode_from_cache,
-        _group_profile,
+        register_gauges,
+        solve_pending,
     )
     from karpenter_tpu.ops.binpack import solve
+    from karpenter_tpu.metrics.registry import GaugeRegistry
+    from karpenter_tpu.metrics.producers.pendingcapacity import (
+        _group_profile,
+    )
     from karpenter_tpu.store import Store
-    from karpenter_tpu.store.columnar import PendingPodCache
+    from karpenter_tpu.store.columnar import PendingFeed
     from karpenter_tpu.utils.quantity import Quantity
 
     print(
@@ -167,7 +180,7 @@ def run_e2e(args) -> None:
     )
     rng = np.random.default_rng(args.seed)
     store = Store()
-    cache = PendingPodCache(store)
+    feed = PendingFeed(store, _group_profile)
     cpu_choices = [Quantity.parse(q) for q in ("100m", "250m", "500m", "1", "2", "4")]
     mem_choices = [Quantity.parse(q) for q in ("128Mi", "512Mi", "1Gi", "4Gi")]
     for i in range(args.pods):
@@ -201,15 +214,32 @@ def run_e2e(args) -> None:
         )
         store.create(node)
         nodes.append(node)
-    profiles = [
-        _group_profile(nodes, {"group": f"g{g}"}) for g in range(args.types)
+    producers = [
+        store.create(
+            MetricsProducer(
+                metadata=ObjectMeta(name=f"mp{g}"),
+                spec=MetricsProducerSpec(
+                    pending_capacity=PendingCapacitySpec(
+                        node_selector={"group": f"g{g}"}
+                    )
+                ),
+            )
+        )
+        for g in range(args.types)
     ]
+    registry = GaugeRegistry()
+    register_gauges(registry)
 
     def tick():
-        inputs = _encode_from_cache(cache.snapshot(), profiles)
-        out = solve(inputs, buckets=args.buckets, backend=args.backend)
-        jax.block_until_ready(out.assigned_count)
-        return out
+        # the REAL production path, nothing hoisted: node listing + group
+        # profiling + cache snapshot + encode + device solve + status and
+        # gauge writes for every producer
+        solve_pending(
+            store, producers, registry, feed=feed,
+            solver=functools.partial(
+                solve, buckets=args.buckets, backend=args.backend
+            ),
+        )
 
     t0 = time.perf_counter()
     tick()
@@ -230,8 +260,8 @@ def run_e2e(args) -> None:
             {
                 "metric": (
                     f"end-to-end reconcile tick p50, {args.pods} pods x "
-                    f"{args.types} node groups (cache snapshot + encode + "
-                    f"transfer + device bin-pack)"
+                    f"{args.types} node groups (full solve_pending: profile"
+                    f" + snapshot + encode + transfer + solve + status)"
                 ),
                 "value": round(p50, 3),
                 "unit": "ms",

@@ -42,7 +42,7 @@ class MetricsProducerController:
                     pending,
                     self.factory.registry,
                     solver=self.factory.solver,
-                    pod_cache=self.factory.pod_cache(),
+                    feed=self.factory.pending_feed(),
                 )
                 for mp in pending:
                     results[key(mp)] = None

@@ -27,25 +27,29 @@ class ProducerFactory:
         # optional remote bin-pack (sidecar SolverClient.solve); None =
         # in-process device call
         self.solver = solver
-        self._pod_cache = None
+        self._pending_feed = None
 
-    def pod_cache(self):
-        """Incremental columnar feed for the pending-pods solve: O(changed
-        pods) per tick instead of O(all pods) (store/columnar.py). Created
-        on FIRST pendingCapacity use so deployments without that producer
-        never pay the per-Pod-mutation watch cost."""
-        if self._pod_cache is None:
-            from karpenter_tpu.store.columnar import PendingPodCache
+    def pending_feed(self):
+        """Incremental feed for the pending-pods solve — pod arena, node
+        profiles, producer selectors, all watch-maintained
+        (store/columnar.py). Created on FIRST pendingCapacity use so
+        deployments without that producer never pay the per-mutation watch
+        cost."""
+        if self._pending_feed is None:
+            from karpenter_tpu.metrics.producers.pendingcapacity import (
+                _group_profile,
+            )
+            from karpenter_tpu.store.columnar import PendingFeed
 
-            self._pod_cache = PendingPodCache(self.store)
-        return self._pod_cache
+            self._pending_feed = PendingFeed(self.store, _group_profile)
+        return self._pending_feed
 
     def for_producer(self, mp):
         spec = mp.spec
         if spec.pending_capacity is not None:
             return PendingCapacityProducer(
                 mp, self.store, registry=self.registry, solver=self.solver,
-                pod_cache=self.pod_cache(),
+                feed=self.pending_feed(),
             )
         if spec.queue is not None:
             return QueueProducer(

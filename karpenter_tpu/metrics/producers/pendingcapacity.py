@@ -141,7 +141,7 @@ def _group_profile(
 
 def solve_pending(
     store, due_producers: List, registry: GaugeRegistry, solver=None,
-    pod_cache=None,
+    pod_cache=None, feed=None,
 ) -> None:
     """One device call over ALL pendingCapacity producers in the store.
 
@@ -149,51 +149,71 @@ def solve_pending(
     DESIGN.md single-scale-up rule: assignment is only exclusive when every
     candidate group is in the same solve. Status objects are mutated on the
     due producers (the engine persists those); gauges are refreshed for every
-    group since they are global registry state.
+    group since they are global registry state (non-due status writes would
+    land on discarded copies, so only their selectors matter).
 
     `solver` is the Algorithm seam: any (inputs, buckets=...) ->
     BinPackOutputs callable — in-process ops/binpack.solve (default) or a
     sidecar SolverClient.solve (gRPC process split).
 
-    `pod_cache` (store/columnar.PendingPodCache) replaces the O(all pods)
-    list+encode feed with an O(changed pods) incremental one; outputs are
-    identical (the solver is permutation-invariant over pods: per-pod
-    first-feasible assignment + bucket histograms). Without it the original
-    list path runs — the oracle the property tests compare against.
+    `feed` (store/columnar.PendingFeed) makes the whole host side
+    incremental: pod arena (O(changed pods)), memoized node profiles
+    (recomputed only on node churn), and a producer-selector index (no
+    per-tick store listing). `pod_cache` alone caches just the pod arena.
+    With neither, the oracle path lists + encodes everything from the
+    store — the reference the property tests compare the caches against.
+    Outputs are identical on every path (the solver is permutation-
+    invariant over pods: per-pod first-feasible assignment + bucket
+    histograms).
     """
     due_keys = {
         (mp.metadata.namespace, mp.metadata.name): mp for mp in due_producers
     }
-    producers = []
-    for mp in sorted(
-        store.list("MetricsProducer"),
-        key=lambda m: (m.metadata.namespace, m.metadata.name),
-    ):
-        if mp.spec.pending_capacity is None:
-            continue
-        # use the caller's object for due producers so status lands on the
-        # instance the engine will persist
-        producers.append(
-            due_keys.get((mp.metadata.namespace, mp.metadata.name), mp)
-        )
-    if not producers:
+
+    # group axis: (namespace, name, due-object-or-None, selector) in
+    # deterministic key order
+    if feed is not None:
+        targets = [
+            (key[0], key[1], due_keys.get(key), selector)
+            for key, selector in feed.producers.items()
+        ]
+    else:
+        targets = []
+        for mp in sorted(
+            store.list("MetricsProducer"),
+            key=lambda m: (m.metadata.namespace, m.metadata.name),
+        ):
+            if mp.spec.pending_capacity is None:
+                continue
+            key = (mp.metadata.namespace, mp.metadata.name)
+            # use the caller's object for due producers so status lands on
+            # the instance the engine will persist
+            targets.append(
+                (key[0], key[1], due_keys.get(key, mp),
+                 mp.spec.pending_capacity.node_selector)
+            )
+    if not targets:
         return
 
-    nodes = store.list("Node")  # listed ONCE; profiles filter in-memory
-    profiles = [
-        _group_profile(nodes, mp.spec.pending_capacity.node_selector)
-        for mp in producers
-    ]
+    if feed is not None:
+        profiles = [feed.nodes.profile(sel) for _, _, _, sel in targets]
+    else:
+        nodes = store.list("Node")  # listed ONCE; profiles filter in-memory
+        profiles = [
+            _group_profile(nodes, sel) for _, _, _, sel in targets
+        ]
 
-    # ONE encode implementation for both paths (store/columnar.py): the
-    # cache snapshots its watch-maintained arena; the oracle path runs the
-    # same detached encoder over a fresh store.list — so they cannot drift
-    if pod_cache is not None:
+    # ONE encode implementation for every path (store/columnar.py): the
+    # caches snapshot their watch-maintained arenas; the oracle path runs
+    # the same detached encoder over a fresh store.list — no drift possible
+    if feed is not None:
+        snap = feed.pods.snapshot()
+    elif pod_cache is not None:
         snap = pod_cache.snapshot()
     else:
         snap = snapshot_from_pods(store.list("Pod"))
     inputs = _encode_from_cache(snap, profiles)
-    _dispatch_and_record(inputs, producers, registry, solver)
+    _dispatch_and_record(inputs, targets, registry, solver)
 
 
 def _group_arrays(profiles, resources, taint_universe, label_universe,
@@ -288,7 +308,7 @@ def _encode_from_cache(snap, profiles) -> "B.BinPackInputs":
     )
 
 
-def _dispatch_and_record(inputs, producers, registry, solver) -> None:
+def _dispatch_and_record(inputs, targets, registry, solver) -> None:
     if solver is None:
         solver = B.solve
     # numpy arrays go straight through: the in-process jitted solve
@@ -297,21 +317,28 @@ def _dispatch_and_record(inputs, producers, registry, solver) -> None:
     # in the control-plane process the sidecar split exists to relieve
     out = solver(inputs)
 
-    assigned_count = np.asarray(out.assigned_count)
-    nodes_needed = np.asarray(out.nodes_needed)
-    lp_bound = np.asarray(out.lp_bound)
-    unschedulable = int(out.unschedulable)
+    # ONE device->host fetch for all four outputs: each np.asarray on a
+    # device array is its own synchronous round-trip (expensive when the
+    # chip sits behind a network tunnel); device_get batches them and
+    # passes plain numpy (sidecar path) through untouched
+    import jax
+
+    assigned_count, nodes_needed, lp_bound, unschedulable = jax.device_get(
+        (out.assigned_count, out.nodes_needed, out.lp_bound,
+         out.unschedulable)
+    )
+    unschedulable = int(unschedulable)
 
     register_gauges(registry)
-    for t, mp in enumerate(producers):
-        mp.status.pending_capacity = PendingCapacityStatus(
-            pending_pods=int(assigned_count[t]),
-            additional_nodes_needed=int(nodes_needed[t]),
-            lp_lower_bound=int(lp_bound[t]),
-            unschedulable_pods=unschedulable,
-        )
-        name, namespace = mp.metadata.name, mp.metadata.namespace
-        gauge = lambda g: registry.gauge(SUBSYSTEM, g)
+    gauge = lambda g: registry.gauge(SUBSYSTEM, g)
+    for t, (namespace, name, mp, _) in enumerate(targets):
+        if mp is not None:  # due: status lands on the persisted instance
+            mp.status.pending_capacity = PendingCapacityStatus(
+                pending_pods=int(assigned_count[t]),
+                additional_nodes_needed=int(nodes_needed[t]),
+                lp_lower_bound=int(lp_bound[t]),
+                unschedulable_pods=unschedulable,
+            )
         gauge(PENDING_PODS).set(name, namespace, float(assigned_count[t]))
         gauge(ADDITIONAL_NODES_NEEDED).set(name, namespace, float(nodes_needed[t]))
         gauge(LP_LOWER_BOUND).set(name, namespace, float(lp_bound[t]))
@@ -327,17 +354,17 @@ class PendingCapacityProducer:
         store,
         registry: Optional[GaugeRegistry] = None,
         solver=None,
-        pod_cache=None,
+        feed=None,
     ):
         self.mp = mp
         self.store = store
         self.registry = registry if registry is not None else default_registry()
         self.solver = solver
-        self.pod_cache = pod_cache
+        self.feed = feed
         register_gauges(self.registry)
 
     def reconcile(self) -> None:
         solve_pending(
             self.store, [self.mp], self.registry, solver=self.solver,
-            pod_cache=self.pod_cache,
+            feed=self.feed,
         )

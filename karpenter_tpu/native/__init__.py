@@ -1,0 +1,109 @@
+"""Native (C) accelerators with build-on-demand and pure-Python fallback.
+
+The reference is pure Go with no native components (SURVEY.md §2), so
+nothing here is a parity obligation — these are host-feed accelerations
+for paths the TPU build made hot (quantity parsing on manifest ingest and
+pod watch-event re-encode). Every native entry point has a Python oracle
+(utils/quantity.py) and parity is fuzz-tested; absence of a C toolchain
+degrades to the oracle silently.
+
+Build: compiled once into native/_build/ with the running interpreter's
+sysconfig flags; rebuilt when the .c source is newer than the .so.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_HERE, "_build")
+_lock = threading.Lock()
+_kquantity = None
+_tried = False
+
+
+def _compile(src: str, out: str) -> bool:
+    include = sysconfig.get_path("include")
+    cc = sysconfig.get_config_var("CC") or "cc"
+    # compile to a private temp path, then atomically publish: a concurrent
+    # or killed compile must never leave a torn .so at the final path (it
+    # would carry a fresh mtime and silently disable the accelerator
+    # forever after)
+    tmp = f"{out}.{os.getpid()}.tmp"
+    cmd = [
+        *cc.split(),
+        "-O2",
+        "-fPIC",
+        "-shared",
+        f"-I{include}",
+        src,
+        "-o",
+        tmp,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, timeout=120)
+        if proc.returncode != 0 or not os.path.exists(tmp):
+            return False
+        os.replace(tmp, out)
+        return True
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+def load_kquantity() -> Optional[object]:
+    """The _kquantity extension module, building it if needed; None when
+    no toolchain is available (callers use the Python path)."""
+    global _kquantity, _tried
+    with _lock:
+        if _kquantity is not None or _tried:
+            return _kquantity
+        _tried = True
+        src = os.path.join(_HERE, "quantity.c")
+        so = os.path.join(_BUILD_DIR, "_kquantity.so")
+        try:
+            os.makedirs(_BUILD_DIR, exist_ok=True)
+            stale = (
+                not os.path.exists(so)
+                or os.path.getmtime(so) < os.path.getmtime(src)
+            )
+            if stale and not _compile(src, so):
+                return None
+            import importlib.util
+
+            spec = importlib.util.spec_from_file_location("_kquantity", so)
+            module = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(module)
+            _kquantity = module
+        except Exception:
+            _kquantity = None
+        return _kquantity
+
+
+_async_started = False
+
+
+def peek_kquantity() -> Optional[object]:
+    """The extension if it has finished loading, else None. Never blocks."""
+    return _kquantity
+
+
+def ensure_kquantity_async() -> None:
+    """Kick off the build/load in a daemon thread. Callers use the Python
+    path until peek_kquantity() turns non-None, so a cold compile never
+    blocks a latency-sensitive first request (e.g. an admission webhook)."""
+    global _async_started
+    with _lock:
+        if _async_started or _kquantity is not None:
+            return
+        _async_started = True
+    threading.Thread(target=load_kquantity, daemon=True).start()

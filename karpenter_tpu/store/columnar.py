@@ -61,6 +61,16 @@ def is_pending(pod) -> bool:
     return not pod.spec.node_name and pod.status.phase in ("", "Pending")
 
 
+def _adopt_and_watch(store: Store, kind: str, on_event) -> None:
+    """Seed from the store's current objects, then subscribe — both under
+    the store lock so no event lands in the gap. The single definition of
+    the watch-mirror init contract for every cache in this module."""
+    with store._lock:
+        for obj in store.list(kind):
+            on_event("Added", obj)
+        store.watch(kind, on_event)
+
+
 @dataclass
 class _SparsePod:
     """Per-slot retained encoding — enough to rebuild arenas on compaction
@@ -85,12 +95,7 @@ class PendingPodCache:
         self._reset_arena(max(16, capacity))
 
         if store is not None:
-            # adopt pods already in the store, then stay current via watch;
-            # both under the store lock so no event is missed in between
-            with store._lock:
-                for pod in store.list("Pod"):
-                    self._on_event("Added", pod)
-                store.watch("Pod", self._on_event)
+            _adopt_and_watch(store, "Pod", self._on_event)
 
     def _reset_arena(self, capacity: int) -> None:
         self._resources: List[str] = list(BASE_RESOURCES)
@@ -289,6 +294,100 @@ class PendingPodCache:
             return len(self._slot)
 
 
+class NodeMirror:
+    """Watch-maintained mirror of Node objects with memoized group
+    profiles.
+
+    _group_profile (pendingcapacity.py) is O(nodes) per selector with
+    Python-level label matching; recomputing it for every producer every
+    5 s tick costs O(producers × nodes) even when no node changed. The
+    mirror holds the store's Node set current via watch events and
+    memoizes profile(selector) until ANY node event invalidates (node
+    churn is orders slower than the reconcile tick). Lock order is
+    strictly store → mirror: events only touch mirror state, profile
+    computation never touches the store.
+    """
+
+    def __init__(self, store: Store, profile_fn):
+        self._lock = threading.Lock()
+        self._profile_fn = profile_fn  # (nodes, selector) -> profile
+        self._nodes: Dict[Tuple[str, str], object] = {}
+        self._memo: Dict[tuple, object] = {}
+        self._version = 0
+        _adopt_and_watch(store, "Node", self._on_event)
+
+    def _on_event(self, event: str, node) -> None:
+        key = (node.metadata.namespace, node.metadata.name)
+        with self._lock:
+            if event == DELETED:
+                self._nodes.pop(key, None)
+            else:
+                self._nodes[key] = node
+            self._memo.clear()
+            self._version += 1
+
+    def profile(self, selector: Dict[str, str]):
+        key = tuple(sorted(selector.items()))
+        # the O(nodes) profile pass runs OUTSIDE the mirror lock: watch
+        # callbacks (which run under the store lock) must never wait on a
+        # profile recomputation, or every store operation stalls behind it.
+        # Event-delivered node copies are never mutated in place, so
+        # computing over a snapshot of the refs is safe.
+        with self._lock:
+            cached = self._memo.get(key)
+            if cached is not None:
+                return cached
+            nodes = list(self._nodes.values())
+            version = self._version
+        profile = self._profile_fn(nodes, selector)
+        with self._lock:
+            if self._version == version:
+                self._memo[key] = profile
+            # stale (a node event landed mid-compute): return this tick's
+            # consistent-at-read value uncached; the next tick recomputes
+        return profile
+
+
+class ProducerSelectorIndex:
+    """Watch-maintained {key: node_selector} of every pendingCapacity
+    MetricsProducer — the solve needs ONLY the selector of non-due
+    producers (their status writes land on discarded copies anyway;
+    gauges are keyed by name/namespace), so listing + deep-copying every
+    producer object per tick is avoidable."""
+
+    def __init__(self, store: Store):
+        self._lock = threading.Lock()
+        self._selectors: Dict[Tuple[str, str], Dict[str, str]] = {}
+        _adopt_and_watch(store, "MetricsProducer", self._on_event)
+
+    def _on_event(self, event: str, mp) -> None:
+        key = (mp.metadata.namespace, mp.metadata.name)
+        with self._lock:
+            if event == DELETED or mp.spec.pending_capacity is None:
+                self._selectors.pop(key, None)
+            else:
+                self._selectors[key] = dict(
+                    mp.spec.pending_capacity.node_selector
+                )
+
+    def items(self) -> List[Tuple[Tuple[str, str], Dict[str, str]]]:
+        """(key, selector) pairs in deterministic (namespace, name) order —
+        the group-axis order of the solve."""
+        with self._lock:
+            return sorted(self._selectors.items())
+
+
+class PendingFeed:
+    """The full incremental feed for the pending-pods solve: pod arena +
+    node profiles + producer selectors, all watch-maintained. One object
+    so the factory wires one thing and solve_pending takes one seam."""
+
+    def __init__(self, store: Store, profile_fn):
+        self.pods = PendingPodCache(store)
+        self.nodes = NodeMirror(store, profile_fn)
+        self.producers = ProducerSelectorIndex(store)
+
+
 def snapshot_from_pods(pods) -> "PendingSnapshot":
     """Oracle path: one-shot encode of a pod list through the SAME encoder
     the watch-maintained cache uses (detached mode — no store, no watch)."""
@@ -301,8 +400,8 @@ def snapshot_from_pods(pods) -> "PendingSnapshot":
     return cache.snapshot()
 
 
-@dataclass(slots=True)
-class PendingSnapshot:
+@dataclass(slots=True, eq=False, repr=False)  # ndarray fields: identity eq,
+class PendingSnapshot:                        # no 100k-row reprs in logs
     requests: np.ndarray
     required: np.ndarray
     shape_id: np.ndarray

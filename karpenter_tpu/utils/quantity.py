@@ -52,6 +52,30 @@ _QUANTITY_RE = re.compile(
     r"(?:(?P<suffix>Ki|Mi|Gi|Ti|Pi|Ei|[numkMGTPE])|(?P<exp>[eE][+-]?\d+))?$"
 )
 
+# format codes returned by the native parser (karpenter_tpu/native)
+_NATIVE_FORMATS = (DECIMAL_SI, BINARY_SI, DECIMAL_EXPONENT)
+_native_kicked = False
+
+
+def _native_parser():
+    """The C parser once its background build/load completes, else None
+    (pure-Python oracle runs). The first call only KICKS OFF the build in a
+    daemon thread — a cold compile never blocks a parse, so e.g. the first
+    AdmissionReview a webhook validates is served at Python speed instead
+    of waiting on cc."""
+    global _native_kicked
+    try:
+        from karpenter_tpu import native
+    except Exception:
+        return None
+    if not _native_kicked:
+        _native_kicked = True
+        try:
+            native.ensure_kquantity_async()
+        except Exception:
+            pass
+    return native.peek_kquantity()
+
 
 class Quantity:
     """Exact-arithmetic quantity with a preferred display format."""
@@ -68,6 +92,17 @@ class Quantity:
             return Quantity(s.value, s.format)
         if isinstance(s, (int, float)):
             return Quantity(Fraction(s), DECIMAL_SI)
+        native = _native_parser()
+        if native is not None:
+            try:
+                num, den, fmt = native.parse(s)
+            except ValueError:
+                pass  # overflow or unrecognized: the regex path decides
+            else:
+                q = cls.__new__(cls)
+                q.value = Fraction(num, den)
+                q.format = _NATIVE_FORMATS[fmt]
+                return q
         m = _QUANTITY_RE.match(s.strip())
         if m is None:
             raise ValueError(f"unable to parse quantity {s!r}")

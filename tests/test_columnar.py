@@ -91,16 +91,20 @@ def statuses(store):
     return out
 
 
-def solve_both(store, cache):
-    """Run the oracle (list) path and the cache path; return both status
-    maps. Producers are re-fetched fresh so statuses don't leak across."""
+def solve_both(store, cache, feed=None):
+    """Run the oracle (list) path, the pod-cache path, and (when given)
+    the full-feed path; return all status maps. Producers are re-fetched
+    fresh so statuses don't leak across."""
     results = []
-    for pod_cache in (None, cache):
+    variants = [{"pod_cache": None}, {"pod_cache": cache}]
+    if feed is not None:
+        variants.append({"feed": feed})
+    for kwargs in variants:
         mps = [
             mp for mp in store.list("MetricsProducer")
             if mp.spec.pending_capacity is not None
         ]
-        solve_pending(store, mps, GaugeRegistry(), pod_cache=pod_cache)
+        solve_pending(store, mps, GaugeRegistry(), **kwargs)
         results.append(
             {
                 mp.metadata.name: (
@@ -241,8 +245,8 @@ class TestLazyFactoryCache:
 
         store = Store()
         factory = ProducerFactory(store, FakeFactory(), registry=GaugeRegistry())
-        assert factory._pod_cache is None
-        assert factory.pod_cache() is factory.pod_cache()  # memoized
+        assert factory._pending_feed is None
+        assert factory.pending_feed() is factory.pending_feed()  # memoized
 
 
 class TestEquivalence:
@@ -293,10 +297,41 @@ class TestEquivalence:
         assert oracle == cached
         assert cached["big"][0] == 6  # tolerant+selected pods land on big
 
+    def test_feed_equivalence_with_node_and_producer_churn(self):
+        """The full feed (pod arena + node-profile memo + producer index)
+        must match the oracle after nodes and producers change too."""
+        from karpenter_tpu.metrics.producers.pendingcapacity import (
+            _group_profile,
+        )
+        from karpenter_tpu.store.columnar import PendingFeed
+
+        store = Store()
+        feed = PendingFeed(store, _group_profile)
+        cache = PendingPodCache(store)
+        self._cluster(store)
+        for i in range(12):
+            store.create(pod(f"p{i}", cpu="2"))
+        # node churn: grow the small group with a bigger node, cordon none
+        store.create(node("n2", {"group": "small"}, cpu="16", mem="64Gi"))
+        # producer churn: add a group after the feed exists, remove later
+        store.create(producer("late", {"group": "big"}))
+        oracle, cached, fed = solve_both(store, cache, feed)
+        assert oracle == cached == fed
+        store.delete("MetricsProducer", "default", "late")
+        oracle, cached, fed = solve_both(store, cache, feed)
+        assert oracle == cached == fed
+        assert "late" not in fed
+
     def test_equivalence_under_random_churn(self):
+        from karpenter_tpu.metrics.producers.pendingcapacity import (
+            _group_profile,
+        )
+        from karpenter_tpu.store.columnar import PendingFeed
+
         rng = np.random.default_rng(7)
         store = Store()
         cache = PendingPodCache(store, capacity=16)
+        feed = PendingFeed(store, _group_profile)
         self._cluster(store)
         live = {}
         serial = 0
@@ -335,5 +370,5 @@ class TestEquivalence:
                     f"{rng.integers(1, 17)}"
                 )
                 store.update(obj)
-        oracle, cached = solve_both(store, cache)
-        assert oracle == cached
+        oracle, cached, fed = solve_both(store, cache, feed)
+        assert oracle == cached == fed
