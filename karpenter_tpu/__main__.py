@@ -65,6 +65,29 @@ def parse_args(argv=None) -> argparse.Namespace:
         "in-memory only",
     )
     parser.add_argument(
+        "--apiserver",
+        default=None,
+        help="kube-apiserver base URL for real-cluster mode (e.g. "
+        "https://kubernetes.default.svc); in-cluster token/CA are picked "
+        "up automatically. Omit to run on the in-process store.",
+    )
+    parser.add_argument(
+        "--kube-token-file",
+        default=None,
+        help="bearer-token file for --apiserver (default: the in-cluster "
+        "serviceaccount token)",
+    )
+    parser.add_argument(
+        "--kube-ca",
+        default=None,
+        help="CA bundle for --apiserver (default: the in-cluster CA)",
+    )
+    parser.add_argument(
+        "--kube-insecure",
+        action="store_true",
+        help="skip TLS verification for --apiserver (dev only)",
+    )
+    parser.add_argument(
         "--leader-elect",
         action=argparse.BooleanOptionalAction,
         default=True,
@@ -89,6 +112,18 @@ def main(argv=None) -> int:
     args = parse_args(argv)
     log_setup(verbose=args.verbose)
 
+    store = None
+    if args.apiserver:
+        from karpenter_tpu.store.kube import KubeClient, KubeStore
+
+        store = KubeStore(
+            KubeClient(
+                base_url=args.apiserver,
+                token_file=args.kube_token_file,
+                ca_file=args.kube_ca,
+                insecure=args.kube_insecure,
+            )
+        )
     runtime = KarpenterRuntime(
         Options(
             prometheus_uri=args.prometheus_uri,
@@ -96,7 +131,8 @@ def main(argv=None) -> int:
             solver_uri=args.solver_uri,
             data_dir=args.data_dir,
             verbose=args.verbose,
-        )
+        ),
+        store=store,
     )
     metrics_server = MetricsServer(runtime.registry, port=args.metrics_port)
     port = metrics_server.start()
@@ -141,6 +177,8 @@ def main(argv=None) -> int:
         if webhook_server is not None:
             webhook_server.stop()
         runtime.close()
+        if store is not None:
+            store.close()  # CLI-owned KubeStore: stop the watch threads
     return 0
 
 

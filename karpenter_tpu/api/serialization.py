@@ -23,7 +23,7 @@ from typing import Any, Dict, List, Optional, Type
 
 import yaml
 
-from karpenter_tpu.api.core import Node, ObjectMeta, Pod
+from karpenter_tpu.api.core import Container, Node, ObjectMeta, Pod
 from karpenter_tpu.api.horizontalautoscaler import HorizontalAutoscaler
 from karpenter_tpu.api.metricsproducer import MetricsProducer
 from karpenter_tpu.api.scalablenodegroup import ScalableNodeGroup
@@ -78,23 +78,29 @@ def _unwrap_optional(tp: Any) -> Any:
     return tp
 
 
-def _coerce(value: Any, tp: Any) -> Any:
+def _coerce(value: Any, tp: Any, lenient: bool = False) -> Any:
     tp = _unwrap_optional(tp)
     if value is None:
         return None
     origin = typing.get_origin(tp)
     if origin in (list, List):
         (item_tp,) = typing.get_args(tp) or (Any,)
-        return [_coerce(v, item_tp) for v in value]
+        return [_coerce(v, item_tp, lenient=lenient) for v in value]
     if origin in (dict, Dict):
         args = typing.get_args(tp)
         val_tp = args[1] if len(args) == 2 else Any
-        return {k: _coerce(v, val_tp) for k, v in value.items()}
+        return {
+            k: _coerce(v, val_tp, lenient=lenient) for k, v in value.items()
+        }
     if tp is Quantity:
         return Quantity.parse(str(value))
     if dataclasses.is_dataclass(tp):
-        return from_dict(tp, value)
+        return from_dict(tp, value, lenient=lenient)
     if tp is float:
+        if lenient and isinstance(value, str):
+            # apiserver timestamps are RFC3339 strings; our model keeps
+            # epoch floats
+            return _rfc3339_to_epoch(value)
         return float(value)
     if tp is int:
         return int(value)
@@ -105,12 +111,34 @@ def _coerce(value: Any, tp: Any) -> Any:
     return value
 
 
-def from_dict(cls: Type, data: Dict[str, Any]):
+def _rfc3339_to_epoch(value: str) -> float:
+    import datetime as _dt
+
+    text = value.replace("Z", "+00:00")
+    return _dt.datetime.fromisoformat(text).timestamp()
+
+
+def from_dict(cls: Type, data: Dict[str, Any], lenient: bool = False):
     """Hydrate dataclass `cls` from a manifest-shaped dict (camelCase keys).
     Unknown keys are an error — same posture as apiserver structural schemas
-    (silently dropped config is misconfig that 'works')."""
+    (silently dropped config is misconfig that 'works').
+
+    lenient=True skips unknown keys instead: the decode posture for objects
+    COMING FROM a real apiserver, which carry dozens of standard fields
+    (managedFields, generation, pod volumes, ...) this model deliberately
+    doesn't track. User manifests stay strict."""
     if data is None:
         data = {}
+    if lenient and cls is Container and "resources" in data:
+        # real-apiserver dialect: requests/limits nest under `resources`
+        # (core/v1 ResourceRequirements); our manifest dialect flattens to
+        # `requests`. Lenient (apiserver-read) decode accepts both; strict
+        # user manifests still hard-error on `resources` so misconfig
+        # never silently drops limits/requests.
+        nested = data.get("resources") or {}
+        data = {k: v for k, v in data.items() if k != "resources"}
+        if "requests" not in data and "requests" in nested:
+            data["requests"] = nested["requests"]
     types = _field_types(cls)
     field_names = {f.name for f in dataclasses.fields(cls)}
     kwargs = {}
@@ -119,11 +147,13 @@ def from_dict(cls: Type, data: Dict[str, Any]):
             continue  # envelope keys on top-level kinds
         field = _KEY_TO_FIELD.get(key, camel_to_snake(key))
         if field not in field_names:
+            if lenient:
+                continue
             raise ValueError(
                 f"unknown field {key!r} for {cls.__name__} "
                 f"(known: {sorted(field_names)})"
             )
-        kwargs[field] = _coerce(value, types[field])
+        kwargs[field] = _coerce(value, types[field], lenient=lenient)
     return cls(**kwargs)
 
 
@@ -163,7 +193,7 @@ def _value_to_plain(value: Any) -> Any:
     return value
 
 
-def from_manifest(doc: Dict[str, Any]):
+def from_manifest(doc: Dict[str, Any], lenient: bool = False):
     """One YAML document (with apiVersion/kind envelope) -> API object."""
     kind = doc.get("kind")
     if kind not in KINDS:
@@ -179,7 +209,7 @@ def from_manifest(doc: Dict[str, Any]):
         # same symmetry as the v1 stamp to_dict emits
         raise ValueError(f"unsupported apiVersion {api_version!r} for {kind}")
     body = {k: v for k, v in doc.items() if k not in ("apiVersion", "kind")}
-    return from_dict(KINDS[kind], body)
+    return from_dict(KINDS[kind], body, lenient=lenient)
 
 
 def load_yaml(text: str) -> List[Any]:

@@ -1,0 +1,554 @@
+"""Real-cluster mode: the store backed by a kube-apiserver.
+
+The reference's coordination bus IS the apiserver (client-go informers +
+writes; reference: SURVEY.md §2.2, pkg/controllers/manager.go). This module
+gives the TPU build the same mode without any kubernetes client library:
+
+- `KubeClient` — a minimal typed REST client over urllib (bearer token +
+  CA, in-cluster defaults): list/watch streams, create/update/delete,
+  merge-patch status, and the scale subresource.
+- `KubeStore` — the Store facade the rest of the framework already
+  programs against. Reads and watch callbacks ride an in-memory mirror
+  kept current by apiserver watch streams (the informer pattern, which is
+  also what makes PendingFeed/DurableStore-free operation correct here);
+  writes go straight to the apiserver, whose echo updates the mirror.
+  Write-then-read may briefly see the pre-write state — level-triggered
+  reconciles recompute from scratch, so staleness only delays, never
+  corrupts (the exact consistency model the reference runs under).
+
+Lease operations (leader election) bypass the mirror: they are
+read-modify-write against coordination.k8s.io directly, since a stale
+lease read must lose the conflict, not win it.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import os
+import socket as _socket
+import ssl
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, List, Optional, Tuple
+
+from karpenter_tpu.api.serialization import (
+    _rfc3339_to_epoch,
+    from_manifest,
+    to_dict,
+)
+
+_socket_timeout = _socket.timeout
+from karpenter_tpu.leaderelection import Lease
+from karpenter_tpu.store.store import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    ConflictError,
+    NotFoundError,
+    Scale,
+    Store,
+)
+from karpenter_tpu.utils.log import logger
+
+log = logger()
+
+_SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+# kind -> (api prefix, plural, namespaced)
+RESOURCES: Dict[str, Tuple[str, str, bool]] = {
+    "HorizontalAutoscaler": (
+        "apis/autoscaling.karpenter.sh/v1alpha1",
+        "horizontalautoscalers",
+        True,
+    ),
+    "MetricsProducer": (
+        "apis/autoscaling.karpenter.sh/v1alpha1",
+        "metricsproducers",
+        True,
+    ),
+    "ScalableNodeGroup": (
+        "apis/autoscaling.karpenter.sh/v1alpha1",
+        "scalablenodegroups",
+        True,
+    ),
+    "Pod": ("api/v1", "pods", True),
+    "Node": ("api/v1", "nodes", False),
+}
+
+WATCHED_KINDS = tuple(RESOURCES)
+
+_LEASE_API = "apis/coordination.k8s.io/v1"
+
+
+def _epoch_to_rfc3339(ts: float) -> str:
+    return (
+        _dt.datetime.fromtimestamp(ts, _dt.timezone.utc)
+        .isoformat(timespec="microseconds")
+        .replace("+00:00", "Z")
+    )
+
+
+def encode_for_write(obj) -> dict:
+    """Manifest for POST/PUT: user-facing codec + the concurrency token."""
+    doc = to_dict(obj)
+    meta = doc.setdefault("metadata", {})
+    if obj.metadata.resource_version:
+        meta["resourceVersion"] = str(obj.metadata.resource_version)
+    return doc
+
+
+def decode_from_read(doc: dict):
+    """Apiserver object -> API object (lenient: unknown fields dropped,
+    RFC3339 timestamps to epoch)."""
+    obj = from_manifest(doc, lenient=True)
+    meta = doc.get("metadata", {})
+    rv = meta.get("resourceVersion")
+    if rv is not None:
+        obj.metadata.resource_version = int(rv)
+    uid = meta.get("uid")
+    if uid:
+        obj.metadata.uid = uid
+    return obj
+
+
+class KubeClient:
+    """Minimal apiserver REST client; no client library, just urllib."""
+
+    def __init__(
+        self,
+        base_url: Optional[str] = None,
+        token: Optional[str] = None,
+        token_file: Optional[str] = None,
+        ca_file: Optional[str] = None,
+        insecure: bool = False,
+        timeout: float = 30.0,
+    ):
+        self.base_url = (
+            base_url
+            or os.environ.get("KUBERNETES_SERVICE_HOST")
+            and (
+                "https://"
+                + os.environ["KUBERNETES_SERVICE_HOST"]
+                + ":"
+                + os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            )
+            or "https://kubernetes.default.svc"
+        ).rstrip("/")
+        self._token = token
+        self._token_file = token_file or (
+            os.path.join(_SA_DIR, "token")
+            if token is None and os.path.exists(os.path.join(_SA_DIR, "token"))
+            else None
+        )
+        self.timeout = timeout
+        if self.base_url.startswith("https"):
+            if insecure:
+                self._ssl = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+                self._ssl.check_hostname = False
+                self._ssl.verify_mode = ssl.CERT_NONE
+            else:
+                ca = ca_file or (
+                    os.path.join(_SA_DIR, "ca.crt")
+                    if os.path.exists(os.path.join(_SA_DIR, "ca.crt"))
+                    else None
+                )
+                self._ssl = ssl.create_default_context(cafile=ca)
+        else:
+            self._ssl = None
+
+    def _headers(self, content_type: Optional[str] = None) -> dict:
+        headers = {"Accept": "application/json"}
+        token = self._token
+        if token is None and self._token_file:
+            with open(self._token_file) as f:  # rotated by kubelet
+                token = f.read().strip()
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
+        if content_type:
+            headers["Content-Type"] = content_type
+        return headers
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[dict] = None,
+        content_type: str = "application/json",
+        timeout: Optional[float] = None,
+    ) -> dict:
+        url = f"{self.base_url}/{path}"
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            url,
+            data=data,
+            method=method,
+            headers=self._headers(content_type if data is not None else None),
+        )
+        try:
+            with urllib.request.urlopen(
+                req, timeout=timeout or self.timeout, context=self._ssl
+            ) as resp:
+                payload = resp.read()
+        except urllib.error.HTTPError as err:
+            detail = err.read().decode(errors="replace")[:300]
+            if err.code == 404:
+                raise NotFoundError(f"{method} {path}: {detail}") from None
+            if err.code == 409:
+                raise ConflictError(f"{method} {path}: {detail}") from None
+            raise RuntimeError(
+                f"apiserver {method} {path} -> {err.code}: {detail}"
+            ) from None
+        return json.loads(payload) if payload else {}
+
+    # -- collection paths --------------------------------------------------
+
+    def _collection(self, kind: str, namespace: Optional[str]) -> str:
+        api, plural, namespaced = RESOURCES[kind]
+        if namespaced and namespace is not None:
+            return f"{api}/namespaces/{namespace}/{plural}"
+        return f"{api}/{plural}"  # all-namespaces (or cluster-scoped)
+
+    def _object_path(self, kind: str, namespace: str, name: str) -> str:
+        return f"{self._collection(kind, namespace)}/{name}"
+
+    # -- typed operations --------------------------------------------------
+
+    def list(self, kind: str) -> Tuple[list, str]:
+        payload = self._request("GET", self._collection(kind, None))
+        objs = []
+        for item in payload.get("items", []):
+            item.setdefault("kind", kind)
+            objs.append(decode_from_read(item))
+        rv = payload.get("metadata", {}).get("resourceVersion", "0")
+        return objs, rv
+
+    def watch(
+        self,
+        kind: str,
+        resource_version: str,
+        handler: Callable[[str, object], None],
+        stopped: threading.Event,
+    ) -> str:
+        """Stream one watch connection; returns the last-seen
+        resourceVersion on EOF/stop so the caller can RESUME from it
+        without a relist (clean EOFs are routine — real apiservers close
+        watches every few minutes). Raises ConflictError on 410 Gone /
+        ERROR events (caller must relist)."""
+        path = (
+            f"{self._collection(kind, None)}?watch=1"
+            f"&resourceVersion={resource_version}"
+        )
+        url = f"{self.base_url}/{path}"
+        req = urllib.request.Request(url, headers=self._headers())
+        last_rv = resource_version
+        try:
+            with urllib.request.urlopen(
+                req, timeout=self.timeout, context=self._ssl
+            ) as resp:
+                for line in resp:
+                    if stopped.is_set():
+                        return last_rv
+                    line = line.strip()
+                    if not line:
+                        continue
+                    event = json.loads(line)
+                    etype = event.get("type")
+                    if etype == "ERROR":
+                        raise ConflictError(
+                            f"watch {kind}: {event['object']}"
+                        )
+                    if etype not in ("ADDED", "MODIFIED", "DELETED"):
+                        continue  # BOOKMARK etc.
+                    doc = event["object"]
+                    doc.setdefault("kind", kind)
+                    rv = doc.get("metadata", {}).get("resourceVersion")
+                    if rv is not None:
+                        last_rv = rv
+                    handler(
+                        {
+                            "ADDED": ADDED,
+                            "MODIFIED": MODIFIED,
+                            "DELETED": DELETED,
+                        }[etype],
+                        decode_from_read(doc),
+                    )
+        except (TimeoutError, _socket_timeout):
+            # idle stream: resume from the last event, no relist needed
+            return last_rv
+        return last_rv
+
+    def create(self, obj):
+        kind = type(obj).__name__
+        payload = self._request(
+            "POST",
+            self._collection(kind, obj.metadata.namespace),
+            encode_for_write(obj),
+        )
+        payload.setdefault("kind", kind)
+        return decode_from_read(payload)
+
+    def update(self, obj):
+        kind = type(obj).__name__
+        payload = self._request(
+            "PUT",
+            self._object_path(
+                kind, obj.metadata.namespace, obj.metadata.name
+            ),
+            encode_for_write(obj),
+        )
+        payload.setdefault("kind", kind)
+        return decode_from_read(payload)
+
+    def get(self, kind: str, namespace: str, name: str):
+        payload = self._request(
+            "GET", self._object_path(kind, namespace, name)
+        )
+        payload.setdefault("kind", kind)
+        return decode_from_read(payload)
+
+    def patch_status(self, obj):
+        kind = type(obj).__name__
+        status = to_dict(obj).get("status", {})
+        payload = self._request(
+            "PATCH",
+            self._object_path(
+                kind, obj.metadata.namespace, obj.metadata.name
+            )
+            + "/status",
+            {"status": status},
+            content_type="application/merge-patch+json",
+        )
+        payload.setdefault("kind", kind)
+        return decode_from_read(payload)
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        self._request(
+            "DELETE", self._object_path(kind, namespace, name)
+        )
+
+    def get_scale(self, kind: str, namespace: str, name: str) -> Scale:
+        payload = self._request(
+            "GET", self._object_path(kind, namespace, name) + "/scale"
+        )
+        return Scale(
+            namespace=namespace,
+            name=name,
+            spec_replicas=payload.get("spec", {}).get("replicas"),
+            status_replicas=payload.get("status", {}).get("replicas", 0) or 0,
+        )
+
+    def update_scale(self, kind: str, scale: Scale) -> None:
+        self._request(
+            "PUT",
+            self._object_path(kind, scale.namespace, scale.name) + "/scale",
+            {
+                "apiVersion": "autoscaling/v1",
+                "kind": "Scale",
+                "metadata": {
+                    "name": scale.name,
+                    "namespace": scale.namespace,
+                },
+                "spec": {"replicas": scale.spec_replicas},
+            },
+        )
+
+    # -- leases (coordination.k8s.io) --------------------------------------
+
+    def _lease_path(self, namespace: str, name: Optional[str] = None) -> str:
+        path = f"{_LEASE_API}/namespaces/{namespace}/leases"
+        return f"{path}/{name}" if name else path
+
+    def get_lease(self, namespace: str, name: str) -> Lease:
+        return self._decode_lease(
+            self._request("GET", self._lease_path(namespace, name))
+        )
+
+    def create_lease(self, lease: Lease) -> Lease:
+        return self._decode_lease(
+            self._request(
+                "POST",
+                self._lease_path(lease.metadata.namespace),
+                self._encode_lease(lease),
+            )
+        )
+
+    def update_lease(self, lease: Lease) -> Lease:
+        return self._decode_lease(
+            self._request(
+                "PUT",
+                self._lease_path(
+                    lease.metadata.namespace, lease.metadata.name
+                ),
+                self._encode_lease(lease),
+            )
+        )
+
+    @staticmethod
+    def _encode_lease(lease: Lease) -> dict:
+        meta = {
+            "name": lease.metadata.name,
+            "namespace": lease.metadata.namespace,
+        }
+        if lease.metadata.resource_version:
+            meta["resourceVersion"] = str(lease.metadata.resource_version)
+        return {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": meta,
+            "spec": {
+                "holderIdentity": lease.holder,
+                "renewTime": _epoch_to_rfc3339(lease.renew_time),
+                "leaseDurationSeconds": int(lease.lease_duration),
+            },
+        }
+
+    @staticmethod
+    def _decode_lease(doc: dict) -> Lease:
+        from karpenter_tpu.api.core import ObjectMeta
+
+        meta = doc.get("metadata", {})
+        spec = doc.get("spec", {})
+        renew = spec.get("renewTime")
+        return Lease(
+            metadata=ObjectMeta(
+                name=meta.get("name", ""),
+                namespace=meta.get("namespace", "default"),
+                uid=meta.get("uid", ""),
+                resource_version=int(meta.get("resourceVersion", 0) or 0),
+            ),
+            holder=spec.get("holderIdentity", "") or "",
+            renew_time=_rfc3339_to_epoch(renew) if renew else 0.0,
+            lease_duration=float(
+                spec.get("leaseDurationSeconds", 15) or 15
+            ),
+        )
+
+
+class KubeStore:
+    """Store facade over a kube-apiserver: informer mirror for reads and
+    watches, REST for writes. Drop-in for Store across the framework."""
+
+    def __init__(
+        self,
+        client: KubeClient,
+        watch_kinds: Tuple[str, ...] = WATCHED_KINDS,
+        resync_backoff: float = 2.0,
+    ):
+        self.client = client
+        self._mirror = Store()
+        self._lock = self._mirror._lock  # caches adopt under the same lock
+        self._stopped = threading.Event()
+        self._resync_backoff = resync_backoff
+        self._threads: List[threading.Thread] = []
+        for kind in watch_kinds:
+            rv = self._resync(kind)
+            thread = threading.Thread(
+                target=self._watch_loop, args=(kind, rv), daemon=True
+            )
+            thread.start()
+            self._threads.append(thread)
+
+    # -- informer machinery ------------------------------------------------
+
+    def _resync(self, kind: str) -> str:
+        """Full relist: reconcile the mirror to the apiserver's current
+        truth (apply changes, delete vanished objects)."""
+        objs, rv = self.client.list(kind)
+        seen = set()
+        for obj in objs:
+            seen.add((kind, obj.metadata.namespace, obj.metadata.name))
+            self._mirror.apply_event(MODIFIED, obj)
+        for key in self._mirror.keys(kind):
+            if key not in seen:
+                vanished = self._mirror.try_get(*key)
+                if vanished is not None:
+                    self._mirror.apply_event(DELETED, vanished)
+        return rv
+
+    def _watch_loop(self, kind: str, rv: str) -> None:
+        """Keep one informer stream alive forever. Clean EOF / idle
+        timeout resumes from the last-seen resourceVersion with NO relist
+        (relists notify every object and would defeat the incremental
+        feed); only a 410 Gone window expiry or a transport error forces
+        a full resync — and a failed resync retries with backoff rather
+        than ever letting the thread die on a stale mirror."""
+        while not self._stopped.is_set():
+            needs_resync = False
+            try:
+                rv = self.client.watch(
+                    kind, rv, self._mirror.apply_event, self._stopped
+                )
+            except ConflictError:
+                needs_resync = True  # 410 Gone: watch window expired
+            except Exception as err:  # noqa: BLE001 — keep the informer up
+                if self._stopped.is_set():
+                    return
+                log.warning("watch %s: %s; resyncing", kind, err)
+                needs_resync = True
+            while needs_resync and not self._stopped.is_set():
+                try:
+                    rv = self._resync(kind)
+                    needs_resync = False
+                except Exception:  # noqa: BLE001
+                    time.sleep(self._resync_backoff)
+
+    def close(self) -> None:
+        self._stopped.set()
+
+    # -- reads: the mirror --------------------------------------------------
+
+    def get(self, kind: str, namespace: str, name: str):
+        if kind == "Lease":
+            return self.client.get_lease(namespace, name)
+        return self._mirror.get(kind, namespace, name)
+
+    def try_get(self, kind: str, namespace: str, name: str):
+        try:
+            return self.get(kind, namespace, name)
+        except NotFoundError:
+            return None
+
+    def list(self, kind: str, namespace=None, label_selector=None) -> list:
+        return self._mirror.list(kind, namespace, label_selector)
+
+    def keys(self, kind: str) -> list:
+        return self._mirror.keys(kind)
+
+    def pods_on_node(self, node_name: str) -> list:
+        return self._mirror.pods_on_node(node_name)
+
+    def watch(self, kind: Optional[str], callback: Callable) -> None:
+        self._mirror.watch(kind, callback)
+
+    # -- writes: the apiserver ----------------------------------------------
+
+    def create(self, obj):
+        if isinstance(obj, Lease):
+            return self.client.create_lease(obj)
+        return self.client.create(obj)
+
+    def update(self, obj):
+        if isinstance(obj, Lease):
+            return self.client.update_lease(obj)
+        return self.client.update(obj)
+
+    def patch_status(self, obj):
+        return self.client.patch_status(obj)
+
+    def delete(self, obj_or_kind, namespace=None, name=None) -> None:
+        if isinstance(obj_or_kind, str):
+            kind = obj_or_kind
+        else:
+            kind = type(obj_or_kind).__name__
+            namespace = obj_or_kind.metadata.namespace
+            name = obj_or_kind.metadata.name
+        self.client.delete(kind, namespace, name)
+
+    def get_scale(self, kind: str, namespace: str, name: str) -> Scale:
+        return self.client.get_scale(kind, namespace, name)
+
+    def update_scale(self, kind: str, scale: Scale) -> None:
+        self.client.update_scale(kind, scale)

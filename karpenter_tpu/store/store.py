@@ -227,6 +227,35 @@ class Store:
                 if key in self._objects
             ]
 
+    def apply_event(self, event: str, obj) -> None:
+        """Apply an event from an EXTERNAL source of truth (an apiserver
+        watch stream) verbatim: no identity minting, no resourceVersion
+        bump or conflict check — the upstream's metadata IS the truth.
+        Watchers observe it exactly like a local mutation."""
+        with self._lock:
+            key = _key(obj)
+            stored = self._objects.get(key)
+            if event == DELETED:
+                if stored is None:
+                    return
+                del self._objects[key]
+                self._index_remove(stored)
+                self._notify(DELETED, stored)
+                return
+            if (
+                stored is not None
+                and stored.metadata.resource_version
+                == obj.metadata.resource_version
+            ):
+                return  # relist echo of an unchanged object: no watcher spam
+            if stored is not None:
+                self._index_remove(stored)
+            obj = copy.deepcopy(obj)
+            self._objects[key] = obj
+            self._index_add(obj)
+            self._rv = max(self._rv, obj.metadata.resource_version)
+            self._notify(MODIFIED if stored is not None else ADDED, obj)
+
     # -- scale subresource -------------------------------------------------
 
     def get_scale(self, kind: str, namespace: str, name: str) -> Scale:

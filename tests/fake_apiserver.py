@@ -1,0 +1,312 @@
+"""Minimal in-memory kube-apiserver for KubeStore tests.
+
+Implements the REST surface KubeClient exercises — typed collections
+(list/watch with streaming chunked events), namespaced CRUD, merge-patch
+/status, the /scale subresource, coordination.k8s.io leases with
+resourceVersion conflict checks — the envtest role (reference:
+pkg/test/environment/local.go boots a REAL apiserver; this double speaks
+just enough of the same protocol).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
+
+# plural -> kind (everything the client speaks, incl. leases)
+PLURALS = {
+    "horizontalautoscalers": "HorizontalAutoscaler",
+    "metricsproducers": "MetricsProducer",
+    "scalablenodegroups": "ScalableNodeGroup",
+    "pods": "Pod",
+    "nodes": "Node",
+    "leases": "Lease",
+}
+
+_PATH_RE = re.compile(
+    r"^/(?:api/v1|apis/[^/]+/[^/]+)"
+    r"(?:/namespaces/(?P<ns>[^/]+))?"
+    r"/(?P<plural>[^/?]+)"
+    r"(?:/(?P<name>[^/?]+))?"
+    r"(?:/(?P<sub>status|scale))?$"
+)
+
+
+class FakeApiServer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._objects: Dict[Tuple[str, str, str], dict] = {}
+        self._rv = 0
+        self._watchers: List[Tuple[str, "queue.Queue"]] = []
+        self._server: Optional[ThreadingHTTPServer] = None
+        self.port = 0
+
+    # -- state helpers -----------------------------------------------------
+
+    def put_object(self, plural: str, doc: dict, event: str = "ADDED") -> dict:
+        """Test-side direct mutation (simulates another client)."""
+        with self._lock:
+            return self._store(plural, doc, event)
+
+    def _store(self, plural: str, doc: dict, event: str) -> dict:
+        meta = doc.setdefault("metadata", {})
+        ns = meta.setdefault("namespace", "default")
+        name = meta["name"]
+        self._rv += 1
+        meta["resourceVersion"] = str(self._rv)
+        meta.setdefault("uid", f"uid-fake-{self._rv}")
+        doc.setdefault("kind", PLURALS[plural])
+        self._objects[(plural, ns, name)] = doc
+        self._broadcast(plural, event, doc)
+        return doc
+
+    def delete_object(self, plural: str, ns: str, name: str) -> Optional[dict]:
+        with self._lock:
+            doc = self._objects.pop((plural, ns, name), None)
+            if doc is not None:
+                self._rv += 1
+                self._broadcast(plural, "DELETED", doc)
+            return doc
+
+    def _broadcast(self, plural: str, event: str, doc: dict) -> None:
+        for want, q in list(self._watchers):
+            if want == plural:
+                q.put({"type": event, "object": doc})
+
+    def objects(self, plural: str) -> List[dict]:
+        with self._lock:
+            return [
+                json.loads(json.dumps(d))
+                for (p, _, _), d in self._objects.items()
+                if p == plural
+            ]
+
+    # -- server ------------------------------------------------------------
+
+    def start(self) -> int:
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _send_json(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> dict:
+                length = int(self.headers.get("Content-Length", "0"))
+                return json.loads(self.rfile.read(length)) if length else {}
+
+            def _match(self):
+                parts = urlsplit(self.path)
+                m = _PATH_RE.match(parts.path)
+                if m is None or m.group("plural") not in PLURALS:
+                    self._send_json(404, {"message": "not found"})
+                    return None
+                return m, parse_qs(parts.query)
+
+            def do_GET(self):  # noqa: N802
+                matched = self._match()
+                if matched is None:
+                    return
+                m, query = matched
+                plural, ns, name = m.group("plural"), m.group("ns"), m.group("name")
+                if name is None:
+                    if query.get("watch"):
+                        since = int(
+                            (query.get("resourceVersion") or ["0"])[0]
+                        )
+                        return self._serve_watch(plural, since)
+                    with fake._lock:
+                        items = [
+                            json.loads(json.dumps(d))
+                            for (p, _, _), d in fake._objects.items()
+                            if p == plural
+                        ]
+                        rv = str(fake._rv)
+                    return self._send_json(
+                        200,
+                        {
+                            "kind": f"{PLURALS[plural]}List",
+                            "metadata": {"resourceVersion": rv},
+                            "items": items,
+                        },
+                    )
+                with fake._lock:
+                    doc = fake._objects.get((plural, ns or "default", name))
+                if doc is None:
+                    return self._send_json(404, {"message": "not found"})
+                if m.group("sub") == "scale":
+                    return self._send_json(
+                        200,
+                        {
+                            "apiVersion": "autoscaling/v1",
+                            "kind": "Scale",
+                            "spec": {
+                                "replicas": doc.get("spec", {}).get("replicas")
+                            },
+                            "status": {
+                                "replicas": doc.get("status", {}).get(
+                                    "replicas", 0
+                                )
+                                or 0
+                            },
+                        },
+                    )
+                return self._send_json(200, doc)
+
+            def _serve_watch(self, plural: str, since: int):
+                q: "queue.Queue" = queue.Queue()
+                with fake._lock:
+                    # replay objects the caller hasn't seen — a real
+                    # apiserver replays events after the requested
+                    # resourceVersion, closing the list→watch gap
+                    for (p, _, _), doc in fake._objects.items():
+                        if p == plural and int(
+                            doc["metadata"]["resourceVersion"]
+                        ) > since:
+                            q.put({"type": "ADDED", "object": doc})
+                    fake._watchers.append((plural, q))
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    while not getattr(fake, "_closing", False):
+                        try:
+                            event = q.get(timeout=0.2)
+                        except queue.Empty:
+                            continue
+                        line = (json.dumps(event) + "\n").encode()
+                        self.wfile.write(
+                            f"{len(line):x}\r\n".encode() + line + b"\r\n"
+                        )
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                finally:
+                    with fake._lock:
+                        if (plural, q) in fake._watchers:
+                            fake._watchers.remove((plural, q))
+
+            def do_POST(self):  # noqa: N802
+                matched = self._match()
+                if matched is None:
+                    return
+                m, _ = matched
+                plural, ns = m.group("plural"), m.group("ns") or "default"
+                doc = self._body()
+                doc.setdefault("metadata", {}).setdefault("namespace", ns)
+                name = doc["metadata"]["name"]
+                with fake._lock:
+                    if (plural, ns, name) in fake._objects:
+                        return self._send_json(
+                            409, {"message": "already exists"}
+                        )
+                    stored = fake._store(plural, doc, "ADDED")
+                return self._send_json(201, stored)
+
+            def do_PUT(self):  # noqa: N802
+                matched = self._match()
+                if matched is None:
+                    return
+                m, _ = matched
+                plural, ns, name = (
+                    m.group("plural"),
+                    m.group("ns") or "default",
+                    m.group("name"),
+                )
+                doc = self._body()
+                with fake._lock:
+                    stored = fake._objects.get((plural, ns, name))
+                    if stored is None:
+                        return self._send_json(404, {"message": "not found"})
+                    if m.group("sub") == "scale":
+                        stored = json.loads(json.dumps(stored))
+                        stored.setdefault("spec", {})["replicas"] = doc.get(
+                            "spec", {}
+                        ).get("replicas")
+                        updated = fake._store(plural, stored, "MODIFIED")
+                        return self._send_json(200, updated)
+                    incoming_rv = doc.get("metadata", {}).get(
+                        "resourceVersion"
+                    )
+                    if incoming_rv and incoming_rv != stored["metadata"][
+                        "resourceVersion"
+                    ]:
+                        return self._send_json(
+                            409, {"message": "resourceVersion conflict"}
+                        )
+                    doc.setdefault("metadata", {})["namespace"] = ns
+                    doc["metadata"]["name"] = name
+                    updated = fake._store(plural, doc, "MODIFIED")
+                return self._send_json(200, updated)
+
+            def do_PATCH(self):  # noqa: N802
+                matched = self._match()
+                if matched is None:
+                    return
+                m, _ = matched
+                plural, ns, name = (
+                    m.group("plural"),
+                    m.group("ns") or "default",
+                    m.group("name"),
+                )
+                patch = self._body()
+                with fake._lock:
+                    stored = fake._objects.get((plural, ns, name))
+                    if stored is None:
+                        return self._send_json(404, {"message": "not found"})
+                    stored = json.loads(json.dumps(stored))
+                    if m.group("sub") == "status":
+                        stored["status"] = patch.get("status", {})
+                    else:
+                        stored.update(patch)
+                    updated = fake._store(plural, stored, "MODIFIED")
+                return self._send_json(200, updated)
+
+            def do_DELETE(self):  # noqa: N802
+                matched = self._match()
+                if matched is None:
+                    return
+                m, _ = matched
+                plural, ns, name = (
+                    m.group("plural"),
+                    m.group("ns") or "default",
+                    m.group("name"),
+                )
+                doc = fake.delete_object(plural, ns, name)
+                if doc is None:
+                    return self._send_json(404, {"message": "not found"})
+                return self._send_json(200, {"status": "Success"})
+
+            def log_message(self, *args):
+                pass
+
+        self._closing = False
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        ).start()
+        return self.port
+
+    def stop(self) -> None:
+        self._closing = True
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
