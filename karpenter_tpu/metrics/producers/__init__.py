@@ -28,6 +28,29 @@ class ProducerFactory:
         # in-process device call
         self.solver = solver
         self._pending_feed = None
+        self._node_mirror = None
+        self._reservations = None
+
+    def node_mirror(self):
+        """Shared watch-maintained Node mirror (store/columnar.NodeMirror),
+        lazy like the feeds that use it."""
+        if self._node_mirror is None:
+            from karpenter_tpu.metrics.producers.pendingcapacity import (
+                _group_profile,
+            )
+            from karpenter_tpu.store.columnar import NodeMirror
+
+            self._node_mirror = NodeMirror(self.store, _group_profile)
+        return self._node_mirror
+
+    def reservations(self):
+        """Incremental per-node reserved-resource sums for the
+        reservedCapacity producer (store/columnar.ReservationsCache)."""
+        if self._reservations is None:
+            from karpenter_tpu.store.columnar import ReservationsCache
+
+            self._reservations = ReservationsCache(self.store)
+        return self._reservations
 
     def pending_feed(self):
         """Incremental feed for the pending-pods solve — pod arena, node
@@ -41,7 +64,9 @@ class ProducerFactory:
             )
             from karpenter_tpu.store.columnar import PendingFeed
 
-            self._pending_feed = PendingFeed(self.store, _group_profile)
+            self._pending_feed = PendingFeed(
+                self.store, _group_profile, node_mirror=self.node_mirror()
+            )
         return self._pending_feed
 
     def for_producer(self, mp):
@@ -58,7 +83,11 @@ class ProducerFactory:
                 registry=self.registry,
             )
         if spec.reserved_capacity is not None:
-            return ReservedCapacityProducer(mp, self.store, registry=self.registry)
+            return ReservedCapacityProducer(
+                mp, self.store, registry=self.registry,
+                reservations=self.reservations(),
+                node_mirror=self.node_mirror(),
+            )
         if spec.schedule is not None:
             return ScheduledCapacityProducer(mp, registry=self.registry)
         logger().error(

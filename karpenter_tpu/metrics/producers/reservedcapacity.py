@@ -65,21 +65,47 @@ def register_gauges(registry: GaugeRegistry) -> None:
 
 
 class ReservedCapacityProducer:
-    def __init__(self, mp, store, registry: Optional[GaugeRegistry] = None):
+    def __init__(
+        self,
+        mp,
+        store,
+        registry: Optional[GaugeRegistry] = None,
+        reservations=None,
+        node_mirror=None,
+    ):
         self.mp = mp
         self.store = store
         self.registry = registry if registry is not None else default_registry()
+        # incremental feed (store/columnar.ReservationsCache + NodeMirror):
+        # O(nodes-in-group) per tick instead of O(nodes + pods); None runs
+        # the oracle list path the property tests compare against
+        self.reservations = reservations
+        self.node_mirror = node_mirror
         register_gauges(self.registry)
 
     def reconcile(self) -> None:
         selector = self.mp.spec.reserved_capacity.node_selector
-        nodes = self.store.list("Node", label_selector=selector)
+        if self.node_mirror is not None:
+            nodes = self.node_mirror.nodes(selector)
+        else:
+            nodes = self.store.list("Node", label_selector=selector)
+        # Only ready+schedulable nodes count, to avoid diluting the
+        # denominator and triggering premature scale-down
+        # (reference: producer.go:46-48).
+        ready = [n for n in nodes if is_ready_and_schedulable(n)]
         reservations = Reservations()
-        for node in nodes:
-            # Only ready+schedulable nodes count, to avoid diluting the
-            # denominator and triggering premature scale-down
-            # (reference: producer.go:46-48).
-            if is_ready_and_schedulable(node):
+        if self.reservations is not None:
+            totals = self.reservations.reserved_on(
+                node.metadata.name for node in ready
+            )
+            for resource in RESOURCES:
+                cached = totals.get(resource)
+                if cached is not None:
+                    reservations.reserved[resource] = cached
+            for node in ready:
+                reservations.add(node, ())  # capacity side only
+        else:
+            for node in ready:
                 pods = self.store.pods_on_node(node.metadata.name)
                 reservations.add(node, pods)
         self._record(reservations)

@@ -326,6 +326,20 @@ class NodeMirror:
             self._memo.clear()
             self._version += 1
 
+    def nodes(self, selector: Optional[Dict[str, str]] = None) -> list:
+        """Current node objects, optionally filtered by label selector.
+        Returned refs are safe to read: event-delivered copies are never
+        mutated in place."""
+        from karpenter_tpu.api.core import matches_selector
+
+        with self._lock:
+            values = list(self._nodes.values())
+        if selector is None:
+            return values
+        return [
+            n for n in values if matches_selector(n.metadata.labels, selector)
+        ]
+
     def profile(self, selector: Dict[str, str]):
         key = tuple(sorted(selector.items()))
         # the O(nodes) profile pass runs OUTSIDE the mirror lock: watch
@@ -346,6 +360,88 @@ class NodeMirror:
             # stale (a node event landed mid-compute): return this tick's
             # consistent-at-read value uncached; the next tick recomputes
         return profile
+
+
+class ReservationsCache:
+    """Watch-maintained per-node reserved-resource sums — the incremental
+    feed for the ReservedCapacity producer (reference hot loop #2,
+    SURVEY.md §3.5: O(nodes + pods) exact Quantity additions per 5 s tick).
+
+    Every BOUND pod's container requests (and its 1 'pods' slot) are added
+    to its node's running total exactly once, at its lifecycle event;
+    rebinding/resize/delete applies the exact inverse (Fraction arithmetic
+    is exact, so incremental add/subtract equals a fresh sum). A tick then
+    reads O(nodes-in-group) cached sums instead of iterating every pod.
+
+    Display-format caveat: Quantity.add adopts the FIRST non-zero
+    operand's format, so in a fleet mixing formats for one resource
+    (e.g. "1Gi" and "1000M" memory) the rendered status string may pick a
+    different (value-equal) canonical form than a fresh sum would.
+    """
+
+    def __init__(self, store: Store):
+        from karpenter_tpu.api.core import RESOURCE_PODS as _PODS
+        from karpenter_tpu.utils.quantity import Quantity
+
+        self._lock = threading.Lock()
+        self._quantity = Quantity
+        self._pods_resource = _PODS
+        # pod key -> (node_name, {resource: Quantity incl. the pods slot})
+        self._pod_records: Dict[Tuple[str, str], Tuple[str, dict]] = {}
+        # node name -> {resource: Quantity}
+        self._node_sums: Dict[str, dict] = {}
+        _adopt_and_watch(store, "Pod", self._on_event)
+
+    def _record_for(self, pod) -> Optional[Tuple[str, dict]]:
+        if not pod.spec.node_name:
+            return None
+        # Pod.requests() is THE accumulation semantics (container-level
+        # only, reference reservations.go); the cache must never drift
+        requests = pod.requests()
+        requests[self._pods_resource] = self._quantity.parse("1")
+        return (pod.spec.node_name, requests)
+
+    def _on_event(self, event: str, pod) -> None:
+        key = (pod.metadata.namespace, pod.metadata.name)
+        new = None if event == DELETED else self._record_for(pod)
+        with self._lock:
+            old = self._pod_records.pop(key, None)
+            if old is not None:
+                node, requests = old
+                sums = self._node_sums.get(node)
+                if sums is not None:
+                    for resource, quantity in requests.items():
+                        sums[resource] = sums[resource].sub(quantity)
+                    if all(q.value == 0 for q in sums.values()):
+                        # node drained (or deleted): drop the entry, or a
+                        # node-churning fleet leaks one dict per node name
+                        # ever seen
+                        del self._node_sums[node]
+            if new is not None:
+                self._pod_records[key] = new
+                node, requests = new
+                sums = self._node_sums.setdefault(node, {})
+                for resource, quantity in requests.items():
+                    current = sums.get(resource)
+                    sums[resource] = (
+                        quantity if current is None else current.add(quantity)
+                    )
+
+    def reserved_on(self, node_names) -> dict:
+        """{resource: Quantity} summed over the given nodes (exact)."""
+        with self._lock:
+            totals: dict = {}
+            for name in node_names:
+                for resource, quantity in self._node_sums.get(
+                    name, {}
+                ).items():
+                    current = totals.get(resource)
+                    totals[resource] = (
+                        quantity
+                        if current is None
+                        else current.add(quantity)
+                    )
+            return totals
 
 
 class ProducerSelectorIndex:
@@ -382,9 +478,13 @@ class PendingFeed:
     node profiles + producer selectors, all watch-maintained. One object
     so the factory wires one thing and solve_pending takes one seam."""
 
-    def __init__(self, store: Store, profile_fn):
+    def __init__(self, store: Store, profile_fn, node_mirror=None):
         self.pods = PendingPodCache(store)
-        self.nodes = NodeMirror(store, profile_fn)
+        self.nodes = (
+            node_mirror
+            if node_mirror is not None
+            else NodeMirror(store, profile_fn)
+        )
         self.producers = ProducerSelectorIndex(store)
 
 

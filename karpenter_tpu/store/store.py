@@ -81,15 +81,20 @@ class Store:
 
     def watch(self, kind: Optional[str], callback: Callable) -> None:
         """Subscribe to mutation events. kind=None watches everything.
-        callback(event_type, obj_copy) is invoked synchronously."""
+        callback(event_type, obj_copy) is invoked synchronously. The copy
+        is SHARED between all watchers of the event (one deepcopy per
+        mutation, not per watcher) — treat it as read-only."""
         with self._lock:
             self._watchers.append((kind, callback))
 
     def _notify(self, event: str, obj) -> None:
         kind = _kind_of(obj)
+        shared = None  # one deepcopy per event, made only if anyone listens
         for want_kind, callback in list(self._watchers):
             if want_kind is None or want_kind == kind:
-                callback(event, copy.deepcopy(obj))
+                if shared is None:
+                    shared = copy.deepcopy(obj)
+                callback(event, shared)
 
     # -- index maintenance ------------------------------------------------
 

@@ -238,6 +238,102 @@ class TestCompaction:
         assert cached["small"][0] == 20
 
 
+class TestReservationsCache:
+    def _reserved(self, store, cache=None, mirror=None):
+        from karpenter_tpu.api.metricsproducer import ReservedCapacitySpec
+        from karpenter_tpu.metrics.producers.reservedcapacity import (
+            ReservedCapacityProducer,
+        )
+
+        mp = MetricsProducer(
+            metadata=ObjectMeta(name="rc", namespace="default"),
+            spec=MetricsProducerSpec(
+                reserved_capacity=ReservedCapacitySpec(
+                    node_selector={"group": "small"}
+                )
+            ),
+        )
+        ReservedCapacityProducer(
+            mp, store, registry=GaugeRegistry(),
+            reservations=cache, node_mirror=mirror,
+        ).reconcile()
+        return dict(mp.status.reserved_capacity)
+
+    def test_matches_oracle_under_churn(self):
+        from karpenter_tpu.metrics.producers.pendingcapacity import (
+            _group_profile,
+        )
+        from karpenter_tpu.store.columnar import (
+            NodeMirror,
+            ReservationsCache,
+        )
+
+        rng = np.random.default_rng(3)
+        store = Store()
+        cache = ReservationsCache(store)
+        mirror = NodeMirror(store, _group_profile)
+        store.create(node("n0", {"group": "small"}, cpu="16", mem="64Gi"))
+        store.create(node("n1", {"group": "small"}, cpu="8", mem="32Gi"))
+        live = {}
+        serial = 0
+        for _ in range(200):
+            action = rng.choice(["add", "rebind", "delete", "resize"])
+            if action == "add" or not live:
+                name = f"p{serial}"
+                serial += 1
+                target = rng.choice(["n0", "n1", None])
+                store.create(
+                    pod(name, cpu=f"{rng.integers(1, 5) * 250}m",
+                        mem=f"{rng.integers(1, 9) * 256}Mi", node=target)
+                )
+                live[name] = True
+            elif action == "rebind":
+                name = rng.choice(list(live))
+                obj = store.get("Pod", "default", name)
+                obj.spec.node_name = rng.choice(["n0", "n1"])
+                store.update(obj)
+            elif action == "delete":
+                name = rng.choice(list(live))
+                store.delete("Pod", "default", name)
+                del live[name]
+            else:
+                name = rng.choice(list(live))
+                obj = store.get("Pod", "default", name)
+                obj.spec.containers[0].requests["cpu"] = Quantity.parse(
+                    f"{rng.integers(1, 9) * 125}m"
+                )
+                store.update(obj)
+        oracle = self._reserved(store)
+        cached = self._reserved(store, cache=cache, mirror=mirror)
+        assert oracle == cached  # exact strings, incl. formats
+
+    def test_unready_nodes_excluded(self):
+        from karpenter_tpu.metrics.producers.pendingcapacity import (
+            _group_profile,
+        )
+        from karpenter_tpu.store.columnar import (
+            NodeMirror,
+            ReservationsCache,
+        )
+        from karpenter_tpu.api.core import NodeCondition
+
+        store = Store()
+        cache = ReservationsCache(store)
+        mirror = NodeMirror(store, _group_profile)
+        store.create(node("ready", {"group": "small"}, cpu="8"))
+        broken = node("broken", {"group": "small"}, cpu="8")
+        broken.status.conditions = [
+            NodeCondition(type="Ready", status="False")
+        ]
+        store.create(broken)
+        store.create(pod("a", cpu="1", node="ready"))
+        store.create(pod("b", cpu="1", node="broken"))  # must not count
+        oracle = self._reserved(store)
+        cached = self._reserved(store, cache=cache, mirror=mirror)
+        assert oracle == cached
+        assert oracle["cpu"].startswith("12.50%")  # 1 of 8, broken excluded
+
+
 class TestLazyFactoryCache:
     def test_not_created_without_pending_producer(self):
         from karpenter_tpu.cloudprovider.fake import FakeFactory
