@@ -16,12 +16,85 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
 BASELINE_MS = 200.0
+
+
+def ensure_backend(probe_timeout: float = 120.0, retries: int = 2) -> str:
+    """Make SOME backend usable before the first in-process jax call.
+
+    Round 1's bench artifact was erased by a single transient TPU
+    unavailability (BENCH_r01.json rc=1: axon init raised UNAVAILABLE at
+    jax.default_backend()), and the axon client can also HANG instead of
+    raising — so the probe runs in a subprocess with a hard timeout, where
+    both failure modes are recoverable. On persistent failure, force the
+    CPU backend via jax.config (env mutation is too late — the axon
+    sitecustomize imports jax at interpreter startup; same gotcha as
+    tests/conftest.py). Returns '' if the default backend is healthy, else
+    a human-readable reason for the CPU fallback.
+    """
+    last = ""
+    probes = 0
+    for attempt in range(retries + 1):
+        if attempt:
+            delay = 5.0 * (2 ** (attempt - 1))
+            print(
+                f"backend probe retry {attempt}/{retries} in {delay:.0f}s: "
+                f"{last}",
+                file=sys.stderr,
+            )
+            time.sleep(delay)
+        probes += 1
+        try:
+            proc = subprocess.run(
+                [
+                    sys.executable,
+                    "-c",
+                    "import jax; print(jax.default_backend(), len(jax.devices()))",
+                ],
+                capture_output=True,
+                text=True,
+                timeout=probe_timeout,
+            )
+        except subprocess.TimeoutExpired:
+            # A hang (unlike a raised UNAVAILABLE) has never been observed
+            # to clear on its own; retrying would burn the driver's budget
+            # and risk it killing us before emit() runs.
+            last = f"backend init hung (> {probe_timeout:.0f}s)"
+            break
+        if proc.returncode == 0:
+            return ""
+        tail = (proc.stderr or "").strip().splitlines()
+        last = tail[-1][:200] if tail else f"probe rc={proc.returncode}"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return f"default backend unavailable after {probes} probe(s) ({last}); cpu fallback"
+
+
+def emit(metric: str, value, note: str = "", error: str = "") -> None:
+    """The ONE JSON line the driver records. Every exit path goes through
+    here so a transient failure can never erase the round's evidence
+    again."""
+    rec = {
+        "metric": metric,
+        "value": (round(value, 3) if value is not None else None),
+        "unit": "ms",
+        "vs_baseline": (
+            round(BASELINE_MS / value, 3) if value else None
+        ),
+    }
+    if note:
+        rec["note"] = note
+    if error:
+        rec["error"] = error
+    print(json.dumps(rec))
 
 
 def build_inputs(pods: int, types: int, taints: int, labels: int, seed: int):
@@ -74,6 +147,8 @@ def main() -> None:
         default="auto",
         help="auto = fused Pallas kernel on TPU, XLA elsewhere",
     )
+    ap.add_argument("--probe-timeout", type=float, default=120.0)
+    ap.add_argument("--probe-retries", type=int, default=2)
     ap.add_argument(
         "--e2e",
         action="store_true",
@@ -82,10 +157,36 @@ def main() -> None:
     )
     args = ap.parse_args()
 
+    if args.e2e:
+        metric = (
+            f"end-to-end reconcile tick p50, {args.pods} pods x "
+            f"{args.types} node groups (full solve_pending: profile"
+            f" + snapshot + encode + transfer + solve + status)"
+        )
+    else:
+        metric = (
+            f"pending-pods bin-pack p50 latency, "
+            f"{args.pods} pods x {args.types} instance types"
+        )
+    try:
+        note = ensure_backend(args.probe_timeout, args.probe_retries)
+        if note:
+            # CPU fallback: keep wall clock bounded at the 100k scale
+            args.iters = min(args.iters, 5)
+        run(args, metric, note)
+    except Exception as e:  # noqa: BLE001 — one JSON line, never a traceback
+        import traceback
+
+        traceback.print_exc()
+        emit(metric, None, error=f"{type(e).__name__}: {e}"[:300])
+        sys.exit(0)
+
+
+def run(args, metric: str, note: str) -> None:
     import jax
 
     if args.e2e:
-        run_e2e(args)
+        run_e2e(args, metric, note)
         return
 
     from karpenter_tpu.ops.binpack import solve
@@ -121,22 +222,10 @@ def main() -> None:
         f"nodes={int(np.sum(np.asarray(out.nodes_needed)))}",
         file=sys.stderr,
     )
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    f"pending-pods bin-pack p50 latency, "
-                    f"{args.pods} pods x {args.types} instance types"
-                ),
-                "value": round(p50, 3),
-                "unit": "ms",
-                "vs_baseline": round(BASELINE_MS / p50, 3),
-            }
-        )
-    )
+    emit(f"{metric} ({jax.default_backend()})", p50, note=note)
 
 
-def run_e2e(args) -> None:
+def run_e2e(args, metric: str, note: str = "") -> None:
     """Full control-plane tick at scale: one solve_pending call — node
     listing, group profiling, columnar cache snapshot, encode, transfer,
     device bin-pack, status + gauge writes — exactly the path a
@@ -255,20 +344,7 @@ def run_e2e(args) -> None:
     p50 = float(np.percentile(times, 50))
     p95 = float(np.percentile(times, 95))
     print(f"e2e tick p50={p50:.1f}ms p95={p95:.1f}ms", file=sys.stderr)
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    f"end-to-end reconcile tick p50, {args.pods} pods x "
-                    f"{args.types} node groups (full solve_pending: profile"
-                    f" + snapshot + encode + transfer + solve + status)"
-                ),
-                "value": round(p50, 3),
-                "unit": "ms",
-                "vs_baseline": round(BASELINE_MS / p50, 3),
-            }
-        )
-    )
+    emit(f"{metric} ({jax.default_backend()})", p50, note=note)
 
 
 if __name__ == "__main__":

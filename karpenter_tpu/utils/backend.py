@@ -1,0 +1,48 @@
+"""Backend selection helpers for non-driver processes.
+
+This container's sitecustomize (axon) imports jax at interpreter startup
+with JAX_PLATFORMS=axon, and initializing the axon TPU client from a
+non-driver process can hang or raise UNAVAILABLE. Tests and the multi-chip
+dryrun therefore run on a virtual multi-device CPU backend. The sequence is
+subtle enough that it lives here once, shared by tests/conftest.py and
+__graft_entry__.dryrun_multichip:
+
+- mutating os.environ["JAX_PLATFORMS"] is too late (jax already imported);
+  platform selection must go through jax.config;
+- XLA_FLAGS *is* read lazily at CPU client creation, so the env var works
+  for the device count — but only if set before the first backend init.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_virtual_cpu(n_devices: int) -> None:
+    """Make the CPU backend the jax default with n_devices virtual devices.
+
+    Must run before the first backend init (do NOT call jax.devices() or
+    run any computation first — on this container that triggers the hanging
+    axon init). Safe to call repeatedly; an existing device-count flag with
+    a smaller count is replaced so a later caller asking for more devices
+    is not silently truncated (which would fail mesh construction).
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    match = re.search(rf"{_COUNT_FLAG}=(\d+)", flags)
+    if match is None:
+        flags = f"{flags} {_COUNT_FLAG}={n_devices}".strip()
+    elif int(match.group(1)) < n_devices:
+        flags = (
+            flags[: match.start()]
+            + f"{_COUNT_FLAG}={n_devices}"
+            + flags[match.end() :]
+        )
+    os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"  # for subprocesses we spawn
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
