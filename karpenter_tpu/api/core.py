@@ -14,7 +14,7 @@ import itertools
 import time as _time
 import uuid
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Union
 
 from karpenter_tpu.utils.quantity import Quantity, parse_quantity
 
@@ -33,7 +33,10 @@ class ObjectMeta:
     labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
     uid: str = ""
-    resource_version: int = 0
+    # int when minted by the local store; may be an opaque string when
+    # sourced from a real apiserver (k8s API conventions) — compare only
+    # for equality
+    resource_version: Union[int, str] = 0
     creation_timestamp: float = 0.0
 
     def ensure_identity(self):

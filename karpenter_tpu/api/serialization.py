@@ -83,6 +83,13 @@ def _coerce(value: Any, tp: Any, lenient: bool = False) -> Any:
     if value is None:
         return None
     origin = typing.get_origin(tp)
+    if origin is typing.Union:
+        # Union[int, str] (resourceVersion): numeric when locally minted,
+        # opaque string from a real apiserver — prefer int, keep strings
+        try:
+            return int(value)
+        except (TypeError, ValueError):
+            return value
     if origin in (list, List):
         (item_tp,) = typing.get_args(tp) or (Any,)
         return [_coerce(v, item_tp, lenient=lenient) for v in value]

@@ -111,10 +111,21 @@ class ReservedCapacityProducer:
         self._record(reservations)
 
     def _record(self, reservations: Reservations) -> None:
-        """reference: producer.go:63-86"""
+        """reference: producer.go:63-86
+
+        Display canonicalization: Quantity.add adopts the first non-zero
+        operand's format, so the reserved sum's format depends on pod
+        event/iteration order — which differs between the incremental
+        ReservationsCache path and the oracle list path. The capacity sum
+        is order-stable (same ready-node list either way), so reserved is
+        re-rendered in capacity's format: both paths emit bit-identical
+        status strings, this module's stated goal.
+        """
         for resource in RESOURCES:
             reserved_q = reservations.reserved[resource]
             capacity_q = reservations.capacity[resource]
+            if reserved_q.value != 0 and capacity_q.value != 0:
+                reserved_q = Quantity(reserved_q.value, capacity_q.format)
             reserved = reserved_q.to_float()
             capacity = capacity_q.to_float()
             utilization = reserved / capacity if capacity != 0 else math.nan

@@ -107,11 +107,30 @@ def decode_from_read(doc: dict):
     meta = doc.get("metadata", {})
     rv = meta.get("resourceVersion")
     if rv is not None:
-        obj.metadata.resource_version = int(rv)
+        # resourceVersions are opaque strings per the k8s API conventions
+        # (etcd's happen to be numeric, but nothing guarantees it); keep
+        # non-numeric ones as strings — the mirror only needs equality.
+        try:
+            obj.metadata.resource_version = int(rv)
+        except ValueError:
+            obj.metadata.resource_version = rv
     uid = meta.get("uid")
     if uid:
         obj.metadata.uid = uid
     return obj
+
+
+def _null_vanished(old: dict, new: dict) -> dict:
+    """JSON merge-patch body that also DELETES keys present in `old` but
+    absent from `new` (RFC 7386: null means remove). Recurses into maps so
+    nested deletions (condition fields, per-resource entries) propagate."""
+    out = dict(new)
+    for key, old_value in old.items():
+        if key not in new:
+            out[key] = None
+        elif isinstance(old_value, dict) and isinstance(new[key], dict):
+            out[key] = _null_vanished(old_value, new[key])
+    return out
 
 
 class KubeClient:
@@ -309,9 +328,16 @@ class KubeClient:
         payload.setdefault("kind", kind)
         return decode_from_read(payload)
 
-    def patch_status(self, obj):
+    def patch_status(self, obj, previous_status: Optional[dict] = None):
+        """Merge-patch the status subresource. merge-patch only *sets* keys,
+        so map entries removed locally (e.g. a reservedCapacity resource that
+        disappeared) would otherwise linger upstream forever — pass the
+        last-known upstream status to have vanished keys patched to null
+        (JSON merge-patch's deletion marker, RFC 7386)."""
         kind = type(obj).__name__
         status = to_dict(obj).get("status", {})
+        if previous_status:
+            status = _null_vanished(previous_status, status)
         payload = self._request(
             "PATCH",
             self._object_path(
@@ -412,12 +438,17 @@ class KubeClient:
         meta = doc.get("metadata", {})
         spec = doc.get("spec", {})
         renew = spec.get("renewTime")
+        rv = meta.get("resourceVersion", 0) or 0
+        try:
+            rv = int(rv)
+        except ValueError:  # opaque string rv — equality is all leases need
+            pass
         return Lease(
             metadata=ObjectMeta(
                 name=meta.get("name", ""),
                 namespace=meta.get("namespace", "default"),
                 uid=meta.get("uid", ""),
-                resource_version=int(meta.get("resourceVersion", 0) or 0),
+                resource_version=rv,
             ),
             holder=spec.get("holderIdentity", "") or "",
             renew_time=_rfc3339_to_epoch(renew) if renew else 0.0,
@@ -536,7 +567,15 @@ class KubeStore:
         return self.client.update(obj)
 
     def patch_status(self, obj):
-        return self.client.patch_status(obj)
+        # the mirror holds the last-known upstream status: keys it has that
+        # the local object dropped get explicit nulls so merge-patch deletes
+        # them. A stale mirror at worst delays a deletion one tick —
+        # level-triggered reconciles recompute the full status every time.
+        mirrored = self._mirror.try_get(
+            type(obj).__name__, obj.metadata.namespace, obj.metadata.name
+        )
+        previous = to_dict(mirrored).get("status") if mirrored else None
+        return self.client.patch_status(obj, previous_status=previous)
 
     def delete(self, obj_or_kind, namespace=None, name=None) -> None:
         if isinstance(obj_or_kind, str):

@@ -212,7 +212,8 @@ class DurableStore(Store):
                 self._index_remove(stored)
         else:
             self._restore(obj)
-        self._rv = max(self._rv, obj.metadata.resource_version)
+        if isinstance(obj.metadata.resource_version, int):
+            self._rv = max(self._rv, obj.metadata.resource_version)
 
     def _restore(self, obj) -> None:
         key = _key(obj)
@@ -221,7 +222,8 @@ class DurableStore(Store):
             self._index_remove(stored)
         self._objects[key] = obj
         self._index_add(obj)
-        self._rv = max(self._rv, obj.metadata.resource_version)
+        if isinstance(obj.metadata.resource_version, int):
+            self._rv = max(self._rv, obj.metadata.resource_version)
 
     # -- journaling --------------------------------------------------------
 

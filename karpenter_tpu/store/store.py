@@ -258,7 +258,11 @@ class Store:
             obj = copy.deepcopy(obj)
             self._objects[key] = obj
             self._index_add(obj)
-            self._rv = max(self._rv, obj.metadata.resource_version)
+            if isinstance(obj.metadata.resource_version, int):
+                # externally-sourced rvs may be opaque non-numeric strings
+                # (k8s API conventions); only numeric ones can advance the
+                # local minting counter, and equality above never needs more
+                self._rv = max(self._rv, obj.metadata.resource_version)
             self._notify(MODIFIED if stored is not None else ADDED, obj)
 
     # -- scale subresource -------------------------------------------------

@@ -56,12 +56,35 @@ def json_patch(before: dict, after: dict, path: str = "") -> List[dict]:
     return ops
 
 
+# metadata keys the user writes; everything else in metadata is populated
+# by the apiserver (generation, managedFields, uid, creationTimestamp,
+# resourceVersion, ownerReferences, ...) and must not trip strict decode —
+# but the SPEC stays strict: silently dropping a typo'd spec key is
+# misconfig that "works" (see serialization.from_dict docstring).
+_USER_METADATA_KEYS = ("name", "namespace", "labels", "annotations")
+
+
+def admission_decode(manifest: dict):
+    """Decode a .request.object for admission: strip the server-populated
+    parts (metadata bookkeeping, status — which carries RFC3339 condition
+    timestamps on UPDATE), then decode the user-authored remainder
+    STRICTLY so unknown spec fields are still denied, not dropped."""
+    doc = dict(manifest)
+    meta = doc.get("metadata")
+    if isinstance(meta, dict):
+        doc["metadata"] = {
+            k: v for k, v in meta.items() if k in _USER_METADATA_KEYS
+        }
+    doc.pop("status", None)  # status writes don't go through admission
+    return from_manifest(doc)
+
+
 def review_validate(review: dict) -> dict:
     """AdmissionReview request -> AdmissionReview response (validation)."""
     request = review.get("request") or {}
     uid = request.get("uid", "")
     try:
-        obj = from_manifest(request.get("object") or {})
+        obj = admission_decode(request.get("object") or {})
         obj.validate()
     except Exception as err:  # any admission failure -> denied, message out
         return _response(uid, allowed=False, message=str(err))
@@ -74,7 +97,10 @@ def review_mutate(review: dict) -> dict:
     uid = request.get("uid", "")
     manifest = request.get("object") or {}
     try:
-        obj = from_manifest(manifest)
+        # the patch is computed between two admission_decode round-trips,
+        # so server-populated fields absent from both sides never appear
+        # in the JSONPatch.
+        obj = admission_decode(manifest)
         before = to_dict(obj)
         obj.default()
         after = to_dict(obj)

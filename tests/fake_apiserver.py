@@ -37,6 +37,22 @@ _PATH_RE = re.compile(
 )
 
 
+def _merge_patch(target, patch):
+    """RFC 7386 JSON merge-patch: null deletes a key, maps merge
+    recursively, everything else replaces — the semantics a real apiserver
+    applies to application/merge-patch+json (so stale-key deletion via
+    explicit nulls is actually exercised here)."""
+    if not isinstance(patch, dict):
+        return patch
+    out = dict(target) if isinstance(target, dict) else {}
+    for key, value in patch.items():
+        if value is None:
+            out.pop(key, None)
+        else:
+            out[key] = _merge_patch(out.get(key), value)
+    return out
+
+
 class FakeApiServer:
     def __init__(self):
         self._lock = threading.Lock()
@@ -268,9 +284,11 @@ class FakeApiServer:
                         return self._send_json(404, {"message": "not found"})
                     stored = json.loads(json.dumps(stored))
                     if m.group("sub") == "status":
-                        stored["status"] = patch.get("status", {})
+                        stored["status"] = _merge_patch(
+                            stored.get("status", {}), patch.get("status", {})
+                        )
                     else:
-                        stored.update(patch)
+                        stored = _merge_patch(stored, patch)
                     updated = fake._store(plural, stored, "MODIFIED")
                 return self._send_json(200, updated)
 

@@ -307,6 +307,38 @@ class TestReservationsCache:
         cached = self._reserved(store, cache=cache, mirror=mirror)
         assert oracle == cached  # exact strings, incl. formats
 
+    def test_mixed_format_sums_render_identically(self):
+        """Quantity.add adopts the first non-zero operand's format, and the
+        cache path accumulates in pod-creation order while the oracle path
+        accumulates node-by-node — so mixed-format memory requests used to
+        render value-equal but differently-formatted status strings.
+        512Mi (binary) + 536870912 (decimal) = 1Gi exactly: binary-first
+        renders "1Gi", decimal-first "1073741824". The producer now
+        canonicalizes to the capacity side's format (order-stable), so both
+        paths must render the SAME string."""
+        from karpenter_tpu.metrics.producers.pendingcapacity import (
+            _group_profile,
+        )
+        from karpenter_tpu.store.columnar import (
+            NodeMirror,
+            ReservationsCache,
+        )
+
+        store = Store()
+        cache = ReservationsCache(store)
+        mirror = NodeMirror(store, _group_profile)
+        store.create(node("n0", {"group": "small"}, cpu="16", mem="96Gi"))
+        # same node, creation order ("z" first, decimal) opposite to the
+        # oracle's sorted-key order ("a" first, binary): the cache's
+        # per-node sum adopts decimal, the oracle's adopts binary
+        store.create(pod("z", cpu="1", mem="536870912", node="n0"))
+        store.create(pod("a", cpu="1", mem="512Mi", node="n0"))
+        oracle = self._reserved(store)
+        cached = self._reserved(store, cache=cache, mirror=mirror)
+        assert oracle == cached
+        # capacity is 96Gi (binary), so the canonical rendering is binary
+        assert oracle["memory"].endswith(", 1Gi/96Gi")
+
     def test_unready_nodes_excluded(self):
         from karpenter_tpu.metrics.producers.pendingcapacity import (
             _group_profile,

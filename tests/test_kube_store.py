@@ -87,6 +87,82 @@ class TestCrud:
         assert doc["status"]["replicas"] == 2
         assert doc["spec"]["replicas"] == 2  # spec untouched by status patch
 
+    def test_patch_status_deletes_vanished_map_keys(self, api, kube):
+        """merge-patch only sets keys, so a reservedCapacity resource entry
+        removed locally used to linger upstream forever; the store now
+        nulls keys the mirror saw upstream but the local object dropped
+        (RFC 7386 deletion)."""
+        from karpenter_tpu.api.metricsproducer import (
+            MetricsProducer,
+            MetricsProducerSpec,
+            ReservedCapacitySpec,
+        )
+
+        kube.create(
+            MetricsProducer(
+                metadata=ObjectMeta(name="mp", namespace="default"),
+                spec=MetricsProducerSpec(
+                    reserved_capacity=ReservedCapacitySpec(
+                        node_selector={"group": "a"}
+                    )
+                ),
+            )
+        )
+        obj = kube.client.get("MetricsProducer", "default", "mp")
+        obj.status.reserved_capacity = {
+            "cpu": "10.00%, 1/10",
+            "memory": "5.00%, 1Gi/20Gi",
+        }
+        kube.patch_status(obj)
+        assert wait_for(
+            lambda: "memory"
+            in (
+                (m := kube.try_get("MetricsProducer", "default", "mp"))
+                and m.status.reserved_capacity
+                or {}
+            )
+        )
+        obj = kube.client.get("MetricsProducer", "default", "mp")
+        obj.status.reserved_capacity = {"cpu": "20.00%, 2/10"}
+        kube.patch_status(obj)
+        doc = next(
+            d
+            for d in api.objects("metricsproducers")
+            if d["metadata"]["name"] == "mp"
+        )
+        assert doc["status"]["reservedCapacity"] == {"cpu": "20.00%, 2/10"}
+
+    def test_opaque_string_resource_version_survives_decode(self):
+        """k8s resourceVersions are opaque strings per the API conventions;
+        a non-numeric rv must decode (mirror only needs equality), not
+        kill the informer path with int()."""
+        from karpenter_tpu.store.kube import decode_from_read
+        from karpenter_tpu.store.store import ADDED, MODIFIED
+        from karpenter_tpu.store.store import Store as LocalStore
+
+        doc = {
+            "apiVersion": "autoscaling.karpenter.sh/v1alpha1",
+            "kind": "ScalableNodeGroup",
+            "metadata": {
+                "name": "g",
+                "namespace": "default",
+                "resourceVersion": "0x1f-opaque",
+            },
+            "spec": {"type": "FakeNodeGroup", "id": "g"},
+        }
+        obj = decode_from_read(doc)
+        assert obj.metadata.resource_version == "0x1f-opaque"
+        mirror = LocalStore()
+        mirror.apply_event(ADDED, obj)  # must not raise on max()
+        echo = decode_from_read(doc)
+        mirror.apply_event(MODIFIED, echo)  # equality dedup still works
+        assert (
+            mirror.get(
+                "ScalableNodeGroup", "default", "g"
+            ).metadata.resource_version
+            == "0x1f-opaque"
+        )
+
     def test_delete_and_watch_removal(self, kube):
         kube.create(sng())
         assert wait_for(

@@ -102,6 +102,86 @@ class TestReviewHandlers:
         assert out["response"]["allowed"] is False
 
 
+def with_apiserver_metadata(manifest):
+    """What .request.object actually looks like on a real cluster: the
+    apiserver has already populated metadata the model doesn't track
+    (generation, managedFields, uid, RFC3339 creationTimestamp). A strict
+    decode denies every CREATE/UPDATE of the CRDs once the webhook is
+    installed — the round-1 advisor's high-severity finding."""
+    manifest["metadata"].update(
+        {
+            "uid": "0b1e5e2e-3f74-4a1c-9d8f-2b8a4c7d6e5f",
+            "resourceVersion": "8675309",
+            "generation": 1,
+            "creationTimestamp": "2026-07-29T12:00:00Z",
+            "managedFields": [
+                {
+                    "manager": "kubectl-client-side-apply",
+                    "operation": "Update",
+                    "apiVersion": "autoscaling.karpenter.sh/v1alpha1",
+                    "time": "2026-07-29T12:00:00Z",
+                    "fieldsType": "FieldsV1",
+                    "fieldsV1": {"f:spec": {}},
+                }
+            ],
+        }
+    )
+    return manifest
+
+
+class TestApiserverPopulatedObjects:
+    def test_validate_allows_server_populated_metadata(self):
+        out = review_validate(review(with_apiserver_metadata(ha_manifest())))
+        assert out["response"]["allowed"] is True, out["response"]
+
+    def test_validate_still_enforces_rules_on_server_objects(self):
+        out = review_validate(
+            review(
+                with_apiserver_metadata(
+                    ha_manifest(min_replicas=9, max_replicas=2)
+                )
+            )
+        )
+        assert out["response"]["allowed"] is False
+
+    def test_validate_still_denies_typoed_spec_key(self):
+        """Leniency is scoped to server-populated metadata/status — a
+        typo'd SPEC key must stay a hard deny, not silently-dropped
+        misconfig that 'works'."""
+        manifest = with_apiserver_metadata(ha_manifest())
+        manifest["spec"]["minReplica"] = manifest["spec"].pop("minReplicas")
+        out = review_validate(review(manifest))
+        assert out["response"]["allowed"] is False
+        assert "minReplica" in out["response"]["status"]["message"]
+
+    def test_validate_allows_status_with_server_timestamps(self):
+        """UPDATE admission objects carry status whose condition timestamps
+        are RFC3339 strings; status is dropped before decode (status writes
+        don't go through admission)."""
+        manifest = with_apiserver_metadata(ha_manifest())
+        manifest["status"] = {
+            "currentReplicas": 3,
+            "conditions": [
+                {
+                    "type": "Active",
+                    "status": "True",
+                    "lastTransitionTime": "2026-07-29T12:00:00Z",
+                }
+            ],
+        }
+        out = review_validate(review(manifest))
+        assert out["response"]["allowed"] is True, out["response"]
+
+    def test_mutate_allows_and_never_patches_server_metadata(self):
+        out = review_mutate(review(with_apiserver_metadata(ha_manifest())))
+        assert out["response"]["allowed"] is True, out["response"]
+        if "patch" in out["response"]:
+            ops = json.loads(base64.b64decode(out["response"]["patch"]))
+            # server-populated fields are absent from both round-trips, so
+            # the defaulting patch must never add/remove/replace them
+            assert not any(op["path"].startswith("/metadata") for op in ops)
+
+
 class TestJsonPatch:
     def test_add_replace_remove(self):
         before = {"a": 1, "b": {"c": 2, "gone": 3}}
