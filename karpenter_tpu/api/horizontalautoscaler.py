@@ -9,6 +9,7 @@ device decision kernel.
 
 from __future__ import annotations
 
+import math
 import time as _time
 from dataclasses import dataclass, field
 from typing import List, Optional
@@ -87,6 +88,27 @@ class ScalingPolicy:
     value: int = 0
     period_seconds: int = 0
 
+    def validate(self) -> None:
+        """reference: horizontalautoscaler.go:137-146 — value must be
+        greater than zero; periodSeconds greater than zero and at most
+        1800 (30 min). The reference documents these bounds but never
+        enforces or applies them (autoscaler.go:186-189 TODO)."""
+        if self.type not in (COUNT_SCALING_POLICY, PERCENT_SCALING_POLICY):
+            raise ValueError(
+                f"scaling policy type must be Count or Percent, got "
+                f"{self.type!r}"
+            )
+        if self.value <= 0:
+            raise ValueError(
+                f"scaling policy value must be greater than zero, got "
+                f"{self.value}"
+            )
+        if not 0 < self.period_seconds <= 1800:
+            raise ValueError(
+                "scaling policy periodSeconds must be in (0, 1800], got "
+                f"{self.period_seconds}"
+            )
+
 
 @dataclass
 class ScalingRules:
@@ -102,6 +124,50 @@ class ScalingRules:
             return False
         now = _time.time() if now is None else now
         return (now - last_scale_time) < float(self.stabilization_window_seconds)
+
+    def allowed_change(
+        self,
+        current_replicas: int,
+        last_scale_time: Optional[float],
+        now: Optional[float] = None,
+    ) -> Optional[int]:
+        """Replica-change budget this direction's policies currently permit;
+        None means unlimited. The scalar oracle for the device kernel's
+        policy clamp (ops/decision.py) — the reference models these
+        policies (horizontalautoscaler.go:111-146) but leaves application
+        a TODO (autoscaler.go:186-189).
+
+        Semantics with the state the CRD carries (LastScaleTime only): a
+        policy budgets `value` replicas (Count) or
+        ceil(max(current,1)*value/100) (Percent — floored at one replica's
+        worth so a Percent-only policy can still escape zero replicas) per
+        periodSeconds window; a scale event within the trailing period is
+        conservatively assumed to have spent the budget, so the policy
+        contributes 0 until its period elapses. Multiple policies combine
+        under this direction's select policy (Max = most permissive, Min =
+        most restrictive). No policies or no scale history = unlimited.
+        """
+        if not self.policies or last_scale_time is None:
+            return None
+        now = _time.time() if now is None else now
+        elapsed = now - last_scale_time
+        budgets = []
+        for policy in self.policies:
+            if elapsed < policy.period_seconds:
+                budgets.append(0)
+            elif policy.type == PERCENT_SCALING_POLICY:
+                budgets.append(
+                    int(
+                        math.ceil(
+                            max(current_replicas, 1) * policy.value / 100.0
+                        )
+                    )
+                )
+            else:
+                budgets.append(policy.value)
+        if self.select_policy == MIN_POLICY_SELECT:
+            return min(budgets)
+        return max(budgets)
 
 
 @dataclass
@@ -210,13 +276,17 @@ class HorizontalAutoscaler:
                 f"({self.spec.max_replicas} < {self.spec.min_replicas})"
             )
         for rules in (self.spec.behavior.scale_up, self.spec.behavior.scale_down):
-            if rules is None or rules.stabilization_window_seconds is None:
+            if rules is None:
                 continue
-            if not 0 <= rules.stabilization_window_seconds <= 3600:
+            if rules.stabilization_window_seconds is not None and not (
+                0 <= rules.stabilization_window_seconds <= 3600
+            ):
                 raise ValueError(
                     "stabilizationWindowSeconds must be in [0, 3600], got "
                     f"{rules.stabilization_window_seconds}"
                 )
+            for policy in rules.policies or []:
+                policy.validate()
 
     def default(self) -> None:
         """reference: horizontalautoscaler_defaults.go (no-op)."""

@@ -27,6 +27,7 @@ from karpenter_tpu.api.horizontalautoscaler import (
     DISABLED_POLICY_SELECT,
     HorizontalAutoscaler,
     MIN_POLICY_SELECT,
+    PERCENT_SCALING_POLICY,
     UTILIZATION,
     VALUE,
 )
@@ -144,6 +145,43 @@ class BatchAutoscaler:
             for r in rows
         ]
 
+        # Count/Percent policy slots: K = widest policy list in the batch
+        k = max(
+            [1]
+            + [
+                len(rules.policies or [])
+                for pair in resolved_rules
+                for rules in pair
+            ]
+        )
+
+        def policy_slots(direction: int):
+            ptype = np.zeros((n, k), np.int32)
+            pvalue = np.zeros((n, k), np.int32)
+            pperiod = np.ones((n, k), np.int32)
+            pvalid = np.zeros((n, k), bool)
+            for i in range(len(rows)):
+                for j, policy in enumerate(
+                    resolved_rules[i][direction].policies or []
+                ):
+                    ptype[i, j] = (
+                        D.POLICY_TYPE_PERCENT
+                        if policy.type == PERCENT_SCALING_POLICY
+                        else D.POLICY_TYPE_COUNT
+                    )
+                    pvalue[i, j] = policy.value
+                    pperiod[i, j] = policy.period_seconds
+                    pvalid[i, j] = True
+            return (
+                jnp.asarray(ptype),
+                jnp.asarray(pvalue),
+                jnp.asarray(pperiod),
+                jnp.asarray(pvalid),
+            )
+
+        up_ptype, up_pvalue, up_pperiod, up_pvalid = policy_slots(0)
+        down_ptype, down_pvalue, down_pperiod, down_pvalid = policy_slots(1)
+
         now = np.float32(self.clock() - self.epoch)
         inputs = D.DecisionInputs(
             metric_value=jnp.asarray(pad2(lambda r: r.values, 0.0, np.float32)),
@@ -211,6 +249,14 @@ class BatchAutoscaler:
                 )
             ),
             now=jnp.float32(now),
+            up_ptype=up_ptype,
+            up_pvalue=up_pvalue,
+            up_pperiod=up_pperiod,
+            up_pvalid=up_pvalid,
+            down_ptype=down_ptype,
+            down_pvalue=down_pvalue,
+            down_pperiod=down_pperiod,
+            down_pvalid=down_pvalid,
         )
         return D.decide_jit(inputs)
 
@@ -223,27 +269,38 @@ class BatchAutoscaler:
         recommendation = int(out.recommendation[i])
         able = bool(out.able_to_scale[i])
         unbounded = bool(out.scaling_unbounded[i])
+        rate_limited = bool(out.rate_limited[i])
 
         ha.status.current_replicas = scale.status_replicas
 
         if able:
+            # a partial policy clamp still scales (just by less than
+            # recommended), so AbleToScale stays true; the clamp itself is
+            # visible through desired < recommendation in status
             mgr.mark_true(cond.ABLE_TO_SCALE)
         else:
             able_at = self.epoch + float(out.able_at[i])
             stamp = datetime.datetime.fromtimestamp(
                 able_at, datetime.timezone.utc
             ).strftime("%Y-%m-%dT%H:%M:%SZ")
+            held_by = (
+                "scaling policy budget spent"
+                if rate_limited
+                else "within stabilization window"
+            )
             mgr.mark_false(
                 cond.ABLE_TO_SCALE,
                 "",
-                f"within stabilization window, able to scale at {stamp}",
+                f"{held_by}, able to scale at {stamp}",
             )
 
         if unbounded:
             mgr.mark_true(cond.SCALING_UNBOUNDED)
         else:
-            # pre-clamp value: recommendation unless held by the window
-            limited = recommendation if able else (scale.spec_replicas or 0)
+            # the kernel's post-window/policy pre-[min,max] value: exactly
+            # what the bounds clamp saw (NOT the raw recommendation, which
+            # a partial policy clamp may already have reduced)
+            limited = int(out.limited[i])
             mgr.mark_false(
                 cond.SCALING_UNBOUNDED,
                 "",

@@ -42,6 +42,10 @@ POLICY_MAX = 0
 POLICY_MIN = 1
 POLICY_DISABLED = 2
 
+# scaling-policy types (reference: horizontalautoscaler.go:131-138)
+POLICY_TYPE_COUNT = 0
+POLICY_TYPE_PERCENT = 1
+
 _CEIL_GUARD = 1e-5
 
 # f32 saturation bounds for the final int32 cast. 2**31-1 is NOT exactly
@@ -72,6 +76,17 @@ class DecisionInputs:
     last_scale_time: jax.Array  # f32[N] seconds since epoch0
     has_last_scale: jax.Array  # bool[N]
     now: jax.Array  # f32 scalar, seconds since epoch0
+    # Count/Percent scaling policies, K fixed slots per direction
+    # (reference MODELS these, horizontalautoscaler.go:111-146, but leaves
+    # application a TODO at autoscaler.go:186-189 — applied here)
+    up_ptype: jax.Array  # i32[N, K] POLICY_TYPE_*
+    up_pvalue: jax.Array  # i32[N, K] permitted change (count or percent)
+    up_pperiod: jax.Array  # i32[N, K] periodSeconds
+    up_pvalid: jax.Array  # bool[N, K]
+    down_ptype: jax.Array  # i32[N, K]
+    down_pvalue: jax.Array  # i32[N, K]
+    down_pperiod: jax.Array  # i32[N, K]
+    down_pvalid: jax.Array  # bool[N, K]
 
 
 @jax.tree_util.register_dataclass
@@ -79,9 +94,11 @@ class DecisionInputs:
 class DecisionOutputs:
     desired: jax.Array  # i32[N] final bounded decision
     recommendation: jax.Array  # i32[N] post-select, pre-limit
-    able_to_scale: jax.Array  # bool[N] False iff within stabilization window
+    limited: jax.Array  # i32[N] post-window/policy, pre-[min,max] value
+    able_to_scale: jax.Array  # bool[N] False iff held by window or policy
     scaling_unbounded: jax.Array  # bool[N] False iff clamped by [min, max]
-    able_at: jax.Array  # f32[N] window end time (valid when !able_to_scale)
+    able_at: jax.Array  # f32[N] hold end time (valid when !able_to_scale)
+    rate_limited: jax.Array  # bool[N] True iff a scaling policy clamped
 
 
 def _ceil_guarded(x: jax.Array) -> jax.Array:
@@ -156,9 +173,75 @@ def decide(inputs: DecisionInputs) -> DecisionOutputs:
     within = (
         moving & inputs.has_last_scale & (elapsed < window)
     )
-    able_to_scale = ~within
-    able_at = inputs.last_scale_time + window
+    window_end = inputs.last_scale_time + window
     limited = jnp.where(within, spec, selected)
+
+    # --- scaling policies: per-direction allowed-delta clamp --------------
+    # The reference models Count/Percent policies with periodSeconds
+    # (horizontalautoscaler.go:111-146) and leaves application a TODO
+    # (autoscaler.go:186-189). Semantics here, with the state the CRD
+    # actually carries (LastScaleTime only — no replica-change history):
+    # a policy's budget is `value` (Count) or ceil(max(spec,1)*value/100)
+    # (Percent — floored at one replica's worth so a Percent-only policy
+    # can still escape zero replicas; percent-of-zero would deadlock the
+    # autoscaler at 0 forever) per periodSeconds; a scale event inside the
+    # trailing period is conservatively assumed to have spent the budget,
+    # so the policy contributes 0 until the period elapses. The
+    # direction's select policy combines multiple policies (Max = most
+    # permissive, Min = most restrictive); no policies, or no scale
+    # history, means unlimited (matching the reference's policy-free
+    # default rules, horizontalautoscaler.go:249-265).
+    def _allowed(ptype, pvalue, pperiod, pvalid, select):
+        base = jnp.maximum(spec[:, None], 1.0)
+        budget = jnp.where(
+            ptype == POLICY_TYPE_PERCENT,
+            _ceil_guarded(base * pvalue.astype(jnp.float32) / 100.0),
+            pvalue.astype(jnp.float32),
+        )
+        spent = inputs.has_last_scale[:, None] & (
+            elapsed[:, None] < pperiod.astype(jnp.float32)
+        )
+        per_policy = jnp.where(spent, 0.0, budget)
+        a_max = jnp.max(jnp.where(pvalid, per_policy, neg_inf), axis=1)
+        a_min = jnp.min(jnp.where(pvalid, per_policy, pos_inf), axis=1)
+        allowed = jnp.where(select == POLICY_MIN, a_min, a_max)
+        unlimited = ~jnp.any(pvalid, axis=1) | ~inputs.has_last_scale
+        # soonest the binding budget frees: Max select frees when ANY
+        # period elapses (min), Min select when ALL do (max)
+        p_f32 = pperiod.astype(jnp.float32)
+        p_min = jnp.min(jnp.where(pvalid, p_f32, pos_inf), axis=1)
+        p_max = jnp.max(jnp.where(pvalid, p_f32, neg_inf), axis=1)
+        frees = jnp.where(select == POLICY_MIN, p_max, p_min)
+        return jnp.where(unlimited, pos_inf, allowed), frees
+
+    allowed_up, up_frees = _allowed(
+        inputs.up_ptype,
+        inputs.up_pvalue,
+        inputs.up_pperiod,
+        inputs.up_pvalid,
+        inputs.up_policy,
+    )
+    allowed_down, down_frees = _allowed(
+        inputs.down_ptype,
+        inputs.down_pvalue,
+        inputs.down_pperiod,
+        inputs.down_pvalid,
+        inputs.down_policy,
+    )
+    rate_clamped = jnp.clip(limited, spec - allowed_down, spec + allowed_up)
+    rate_limited = rate_clamped != limited
+    # budget exhausted entirely (no movement possible despite a desired
+    # move): a transient hold exactly like the stabilization window
+    fully_held = rate_limited & (rate_clamped == spec)
+    rate_end = inputs.last_scale_time + jnp.where(
+        limited > spec, up_frees, down_frees
+    )
+    limited = rate_clamped
+
+    # within => limited==spec => the rate clamp is a no-op, so the two
+    # holds are mutually exclusive and able_at needs no combining
+    able_to_scale = ~within & ~fully_held
+    able_at = jnp.where(fully_held, rate_end, window_end)
 
     # --- bounded limits: [min, max] clamp (autoscaler.go:155-170) ---------
     bounded = jnp.clip(
@@ -174,9 +257,11 @@ def decide(inputs: DecisionInputs) -> DecisionOutputs:
     return DecisionOutputs(
         desired=to_i32(bounded),
         recommendation=to_i32(selected),
+        limited=to_i32(limited),
         able_to_scale=able_to_scale,
         scaling_unbounded=scaling_unbounded,
         able_at=able_at,
+        rate_limited=rate_limited,
     )
 
 

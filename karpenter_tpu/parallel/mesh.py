@@ -125,6 +125,14 @@ def decision_shardings(mesh: Mesh) -> DecisionInputs:
         last_scale_time=row,
         has_last_scale=row,
         now=s(),
+        up_ptype=mat,
+        up_pvalue=mat,
+        up_pperiod=mat,
+        up_pvalid=mat,
+        down_ptype=mat,
+        down_pvalue=mat,
+        down_pperiod=mat,
+        down_pvalid=mat,
     )
 
 
@@ -301,6 +309,20 @@ def example_decision_inputs(N: int = 16, M: int = 4, seed: int = 1) -> DecisionI
         ),
         has_last_scale=jnp.asarray(rng.random((N,)) < 0.5),
         now=jnp.float32(250.0),
+        # K=2 policy slots, mixed Count/Percent, some invalid — so the
+        # sharded program exercises the policy clamp too
+        up_ptype=jnp.asarray(rng.integers(0, 2, (N, 2), dtype=np.int32)),
+        up_pvalue=jnp.asarray(rng.integers(1, 10, (N, 2), dtype=np.int32)),
+        up_pperiod=jnp.asarray(
+            rng.integers(30, 300, (N, 2), dtype=np.int32)
+        ),
+        up_pvalid=jnp.asarray(rng.random((N, 2)) < 0.5),
+        down_ptype=jnp.asarray(rng.integers(0, 2, (N, 2), dtype=np.int32)),
+        down_pvalue=jnp.asarray(rng.integers(1, 10, (N, 2), dtype=np.int32)),
+        down_pperiod=jnp.asarray(
+            rng.integers(30, 300, (N, 2), dtype=np.int32)
+        ),
+        down_pvalid=jnp.asarray(rng.random((N, 2)) < 0.5),
     )
 
 

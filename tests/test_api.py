@@ -86,6 +86,38 @@ class TestValidation:
         with pytest.raises(ValueError):
             ha.validate()
 
+    def test_scaling_policy_bounds(self):
+        """reference: horizontalautoscaler.go:137-146 — documented bounds
+        the reference never enforces (value > 0, 0 < periodSeconds <= 1800)."""
+        from karpenter_tpu.api.horizontalautoscaler import ScalingPolicy
+
+        ScalingPolicy(type="Count", value=4, period_seconds=60).validate()
+        ScalingPolicy(type="Percent", value=100, period_seconds=1800).validate()
+        for bad in (
+            ScalingPolicy(type="Pods", value=4, period_seconds=60),
+            ScalingPolicy(type="Count", value=0, period_seconds=60),
+            ScalingPolicy(type="Count", value=-1, period_seconds=60),
+            ScalingPolicy(type="Count", value=4, period_seconds=0),
+            ScalingPolicy(type="Count", value=4, period_seconds=1801),
+        ):
+            with pytest.raises(ValueError):
+                bad.validate()
+
+    def test_ha_validates_nested_policies(self):
+        from karpenter_tpu.api.horizontalautoscaler import ScalingPolicy
+
+        ha = HorizontalAutoscaler()
+        ha.spec.max_replicas = 10
+        ha.spec.behavior = Behavior(
+            scale_up=ScalingRules(
+                policies=[
+                    ScalingPolicy(type="Count", value=4, period_seconds=2000)
+                ]
+            )
+        )
+        with pytest.raises(ValueError, match="periodSeconds"):
+            ha.validate()
+
     def test_reserved_capacity_selector_cardinality(self):
         """reference: metricsproducer_validation.go:90-95"""
         with pytest.raises(ValueError):

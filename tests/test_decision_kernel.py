@@ -38,11 +38,34 @@ def make_inputs(
     last_scale_time=None,
     has_last_scale=None,
     now=0.0,
+    up_policies=None,
+    down_policies=None,
 ):
+    """up_policies/down_policies: per-row lists of (type, value, period)."""
     import jax.numpy as jnp
 
     n = len(spec_replicas)
     default = lambda v, fill: np.asarray(v if v is not None else [fill] * n)
+
+    def slots(policy_lists):
+        k = max([1] + [len(p or []) for p in (policy_lists or [])])
+        ptype = np.zeros((n, k), np.int32)
+        pvalue = np.zeros((n, k), np.int32)
+        pperiod = np.ones((n, k), np.int32)
+        pvalid = np.zeros((n, k), bool)
+        for i, policies in enumerate(policy_lists or [[]] * n):
+            for j, (t, v, p) in enumerate(policies or []):
+                ptype[i, j], pvalue[i, j], pperiod[i, j] = t, v, p
+                pvalid[i, j] = True
+        return (
+            jnp.asarray(ptype),
+            jnp.asarray(pvalue),
+            jnp.asarray(pperiod),
+            jnp.asarray(pvalid),
+        )
+
+    up_ptype, up_pvalue, up_pperiod, up_pvalid = slots(up_policies)
+    down_ptype, down_pvalue, down_pperiod, down_pvalid = slots(down_policies)
     return D.DecisionInputs(
         metric_value=jnp.asarray(np.asarray(metric_value, np.float32)),
         target_value=jnp.asarray(np.asarray(target_value, np.float32)),
@@ -59,6 +82,14 @@ def make_inputs(
         last_scale_time=jnp.asarray(default(last_scale_time, 0.0).astype(np.float32)),
         has_last_scale=jnp.asarray(default(has_last_scale, False).astype(bool)),
         now=jnp.float32(now),
+        up_ptype=up_ptype,
+        up_pvalue=up_pvalue,
+        up_pperiod=up_pperiod,
+        up_pvalid=up_pvalid,
+        down_ptype=down_ptype,
+        down_pvalue=down_pvalue,
+        down_pperiod=down_pperiod,
+        down_pvalid=down_pvalid,
     )
 
 
@@ -255,6 +286,125 @@ class TestLimits:
         assert int(out.desired[0]) == 5
 
 
+class TestScalingPolicies:
+    """Count/Percent policies with periodSeconds — the reference MODELS
+    these (horizontalautoscaler.go:111-146) but leaves application a TODO
+    (autoscaler.go:186-189); the kernel applies them."""
+
+    def up(self, policies, *, spec=5, want_value=100.0, last=None, now=500.0,
+           select=None, max_replicas=1000):
+        kw = dict(
+            spec_replicas=[spec],
+            status_replicas=[spec],
+            min_replicas=[0],
+            max_replicas=[max_replicas],
+            up_policies=[policies],
+            now=now,
+        )
+        if last is not None:
+            kw["last_scale_time"] = [last]
+            kw["has_last_scale"] = [True]
+        if select is not None:
+            kw["up_policy"] = [select]
+        return D.decide_jit(
+            make_inputs(
+                metric_value=[[want_value]],
+                target_value=[[1.0]],
+                target_type=[[D.TYPE_AVERAGE_VALUE]],
+                metric_valid=[[True]],
+                **kw,
+            )
+        )
+
+    def test_count_policy_caps_scale_up(self):
+        # wants 100, budget 4 per 60s, last scale 120s ago -> 5+4=9
+        out = self.up([(D.POLICY_TYPE_COUNT, 4, 60)], last=380.0)
+        assert int(out.desired[0]) == 9
+        assert bool(out.rate_limited[0])
+        assert bool(out.able_to_scale[0])  # partial clamp still scales
+
+    def test_percent_policy_caps_scale_up(self):
+        # ceil(5 * 50%) = 3 -> 5+3=8
+        out = self.up([(D.POLICY_TYPE_PERCENT, 50, 60)], last=380.0)
+        assert int(out.desired[0]) == 8
+        assert bool(out.rate_limited[0])
+
+    def test_budget_spent_within_period_holds_entirely(self):
+        # last scale 30s ago < 60s period: conservative 0 budget, full hold
+        out = self.up([(D.POLICY_TYPE_COUNT, 4, 60)], last=470.0)
+        assert int(out.desired[0]) == 5
+        assert not bool(out.able_to_scale[0])
+        assert bool(out.rate_limited[0])
+        assert float(out.able_at[0]) == 470.0 + 60.0  # budget frees then
+
+    def test_percent_policy_escapes_zero_replicas(self):
+        # percent-of-zero would deadlock at 0 forever; the budget floors
+        # current at 1 so at least ceil(value/100) movement is permitted
+        out = self.up(
+            [(D.POLICY_TYPE_PERCENT, 50, 60)], spec=0, last=380.0
+        )
+        assert int(out.desired[0]) == 1  # 0 + ceil(1*50%)=1
+        assert bool(out.able_to_scale[0])
+
+    def test_no_scale_history_is_unlimited(self):
+        out = self.up([(D.POLICY_TYPE_COUNT, 4, 60)])  # has_last_scale=False
+        assert int(out.desired[0]) == 100
+        assert not bool(out.rate_limited[0])
+
+    def test_max_select_takes_most_permissive(self):
+        out = self.up(
+            [(D.POLICY_TYPE_COUNT, 2, 60), (D.POLICY_TYPE_PERCENT, 100, 60)],
+            last=380.0,
+        )  # max(2, ceil(5*100%)=5) = 5 -> 10
+        assert int(out.desired[0]) == 10
+
+    def test_min_select_takes_most_restrictive(self):
+        out = self.up(
+            [(D.POLICY_TYPE_COUNT, 2, 60), (D.POLICY_TYPE_PERCENT, 100, 60)],
+            last=380.0,
+            select=D.POLICY_MIN,
+        )  # min(2, 5) = 2 -> 7
+        assert int(out.desired[0]) == 7
+
+    def test_down_policy_caps_scale_down(self):
+        out = D.decide_jit(
+            make_inputs(
+                metric_value=[[1.0]],
+                target_value=[[1.0]],
+                target_type=[[D.TYPE_AVERAGE_VALUE]],
+                metric_valid=[[True]],
+                spec_replicas=[50],
+                status_replicas=[50],
+                min_replicas=[0],
+                max_replicas=[100],
+                down_window=[0],
+                down_policies=[[(D.POLICY_TYPE_PERCENT, 10, 60)]],
+                last_scale_time=[100.0],
+                has_last_scale=[True],
+                now=500.0,
+            )
+        )
+        # wants 1, allowed down ceil(50*10%)=5 -> 45
+        assert int(out.desired[0]) == 45
+        assert bool(out.rate_limited[0])
+
+    def test_scalar_oracle_agrees(self):
+        from karpenter_tpu.api.horizontalautoscaler import ScalingPolicy
+
+        rules = ScalingRules(
+            policies=[
+                ScalingPolicy(type="Count", value=2, period_seconds=60),
+                ScalingPolicy(type="Percent", value=100, period_seconds=60),
+            ]
+        )
+        assert rules.allowed_change(5, last_scale_time=380.0, now=500.0) == 5
+        rules.select_policy = "Min"
+        assert rules.allowed_change(5, last_scale_time=380.0, now=500.0) == 2
+        assert rules.allowed_change(5, last_scale_time=470.0, now=500.0) == 0
+        assert rules.allowed_change(5, None, now=500.0) is None
+        assert ScalingRules().allowed_change(5, 380.0, now=500.0) is None
+
+
 def scalar_pipeline(
     values,
     targets,
@@ -284,6 +434,11 @@ def scalar_pipeline(
         limited = spec_replicas
     else:
         limited = recommendation
+    allowed = rules.allowed_change(spec_replicas, last_scale_time, now=now)
+    if allowed is not None:
+        limited = min(
+            max(limited, spec_replicas - allowed), spec_replicas + allowed
+        )
     return int(min(max(limited, min_replicas), max_replicas))
 
 
@@ -312,6 +467,27 @@ class TestPropertyVsOracle:
         down_window = rng.choice([0, 60, 300], n)
         up_window = rng.choice([0, 60], n)
 
+        def random_policies():
+            out = []
+            for _ in range(n):
+                if rng.random() < 0.5:
+                    out.append([])
+                else:
+                    out.append(
+                        [
+                            (
+                                int(rng.integers(0, 2)),
+                                int(rng.integers(1, 11)),
+                                int(rng.choice([30, 60, 300, 900])),
+                            )
+                            for _ in range(rng.integers(1, 3))
+                        ]
+                    )
+            return out
+
+        up_policies = random_policies()
+        down_policies = random_policies()
+
         inputs = make_inputs(
             metric_value=values,
             target_value=targets,
@@ -326,14 +502,31 @@ class TestPropertyVsOracle:
             last_scale_time=last,
             has_last_scale=has_last,
             now=now,
+            up_policies=up_policies,
+            down_policies=down_policies,
         )
         out = D.decide_jit(inputs)
 
+        from karpenter_tpu.api.horizontalautoscaler import ScalingPolicy
+
+        to_api = lambda triples: [
+            ScalingPolicy(
+                type="Percent" if t == D.POLICY_TYPE_PERCENT else "Count",
+                value=v,
+                period_seconds=p,
+            )
+            for t, v, p in triples
+        ] or None
+
         for i in range(n):
             behavior = Behavior(
-                scale_up=ScalingRules(stabilization_window_seconds=int(up_window[i])),
+                scale_up=ScalingRules(
+                    stabilization_window_seconds=int(up_window[i]),
+                    policies=to_api(up_policies[i]),
+                ),
                 scale_down=ScalingRules(
-                    stabilization_window_seconds=int(down_window[i])
+                    stabilization_window_seconds=int(down_window[i]),
+                    policies=to_api(down_policies[i]),
                 ),
             )
             vals = [values[i][j] for j in range(m) if valid[i][j]]

@@ -239,6 +239,78 @@ class TestHorizontalAutoscalerE2E:
         ha = runtime.store.get("HorizontalAutoscaler", "default", name)
         assert ha.status_conditions().get(cond.ABLE_TO_SCALE).status == cond.TRUE
 
+    def test_scaling_policy_rate_limits_scale_up(self, env):
+        """Count policy with periodSeconds applied end-to-end — the
+        reference models these (horizontalautoscaler.go:111-146) but never
+        applies them (autoscaler.go:186-189 TODO)."""
+        from karpenter_tpu.api.horizontalautoscaler import (
+            Behavior,
+            ScalingPolicy,
+            ScalingRules,
+        )
+
+        runtime, provider, clock = env
+        name = "burst"
+        gauge = runtime.registry.register("queue", "length")
+        gauge.set("q", "default", 400.0)
+        provider.node_replicas[name] = 1
+        runtime.store.create(sng_of(name))
+        runtime.store.create(
+            HorizontalAutoscaler(
+                metadata=ObjectMeta(name=name),
+                spec=HorizontalAutoscalerSpec(
+                    scale_target_ref=CrossVersionObjectReference(
+                        kind="ScalableNodeGroup", name=name
+                    ),
+                    min_replicas=0,
+                    max_replicas=1000,
+                    metrics=[
+                        Metric(
+                            prometheus=PrometheusMetricSource(
+                                query='karpenter_queue_length{name="q"}',
+                                target=MetricTarget(
+                                    type="AverageValue", value=4
+                                ),
+                            )
+                        )
+                    ],
+                    behavior=Behavior(
+                        scale_up=ScalingRules(
+                            policies=[
+                                ScalingPolicy(
+                                    type="Count", value=4, period_seconds=60
+                                )
+                            ]
+                        )
+                    ),
+                ),
+            )
+        )
+        # first scale: no LastScaleTime -> no history to rate-limit against
+        runtime.manager.reconcile_all()
+        scale = runtime.store.get_scale("ScalableNodeGroup", "default", name)
+        assert scale.spec_replicas == 100  # 400/4
+
+        # demand doubles 10s later: inside the 60s period the budget is
+        # conservatively spent -> full hold, AbleToScale false
+        gauge.set("q", "default", 800.0)
+        clock.advance(10)
+        runtime.manager.reconcile_all()
+        scale = runtime.store.get_scale("ScalableNodeGroup", "default", name)
+        assert scale.spec_replicas == 100
+        ha = runtime.store.get("HorizontalAutoscaler", "default", name)
+        able = ha.status_conditions().get(cond.ABLE_TO_SCALE)
+        assert able.status == cond.FALSE
+        assert "scaling policy budget spent" in able.message
+
+        # period elapses: 4 replicas allowed, not the full jump to 200
+        clock.advance(61)
+        runtime.manager.reconcile_all()
+        scale = runtime.store.get_scale("ScalableNodeGroup", "default", name)
+        assert scale.spec_replicas == 104
+        ha = runtime.store.get("HorizontalAutoscaler", "default", name)
+        assert ha.status_conditions().get(cond.ABLE_TO_SCALE).status == cond.TRUE
+
     def test_bounds_clamp_marks_scaling_bounded(self, env):
         runtime, provider, clock = env
         name = "svc"

@@ -104,6 +104,14 @@ class TestSolverRPC:
             last_scale_time=np.zeros(n, np.float32),
             has_last_scale=np.zeros(n, bool),
             now=np.asarray(1000.0, np.float32),
+            up_ptype=np.zeros((n, 1), np.int32),
+            up_pvalue=np.asarray([[4]] * n, np.int32),
+            up_pperiod=np.full((n, 1), 60, np.int32),
+            up_pvalid=np.asarray([[True], [False], [False], [False]]),
+            down_ptype=np.zeros((n, 1), np.int32),
+            down_pvalue=np.ones((n, 1), np.int32),
+            down_pperiod=np.full((n, 1), 60, np.int32),
+            down_pvalid=np.zeros((n, 1), bool),
         )
         local = decide_jit(inputs)
         remote = client.decide(inputs)
