@@ -11,9 +11,9 @@ ci: codegen verify battletest ## Everything the gate runs
 test: ## Run the test suite (virtual 8-device CPU mesh)
 	$(PYTHON) -m pytest tests/ -x -q
 
-battletest: ## Randomized order + coverage (reference: Makefile battletest)
-	$(PYTHON) -m pytest tests/ -q -p no:randomly --cov=karpenter_tpu --cov-report=term-missing 2>/dev/null \
-		|| $(PYTHON) -m pytest tests/ -q
+battletest: ## Randomized order + scale + stress + coverage (reference: Makefile battletest)
+	KARPENTER_TEST_SHUFFLE=random KARPENTER_SCALE_TESTS=1 $(PYTHON) -m pytest tests/ -q --cov=karpenter_tpu --cov-report=term-missing 2>/dev/null \
+		|| KARPENTER_TEST_SHUFFLE=random KARPENTER_SCALE_TESTS=1 $(PYTHON) -m pytest tests/ -q
 
 verify: ## Static checks: compile all sources, no syntax/undefined-name drift
 	$(PYTHON) -m compileall -q karpenter_tpu tests bench.py __graft_entry__.py
@@ -21,6 +21,9 @@ verify: ## Static checks: compile all sources, no syntax/undefined-name drift
 
 codegen: ## Regenerate config/crd/*.yaml + releases/manifest.yaml from the API types
 	bash hack/release.sh
+
+docs: ## Generate docs/API.md from the API types (reference: Makefile docs target)
+	$(PYTHON) -m karpenter_tpu.codegen --docs docs/API.md
 
 native: ## Pre-build the C accelerators (otherwise built lazily in background)
 	$(PYTHON) -c "from karpenter_tpu.native import load_kquantity; \
@@ -35,4 +38,4 @@ dryrun: ## Multi-chip sharding compile check on 8 virtual CPU devices
 		import jax; jax.config.update('jax_platforms', 'cpu'); \
 		import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
 
-.PHONY: help dev ci test battletest verify codegen native bench dryrun
+.PHONY: help dev ci test battletest verify codegen docs native bench dryrun

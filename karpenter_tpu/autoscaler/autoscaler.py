@@ -145,8 +145,11 @@ class BatchAutoscaler:
             for r in rows
         ]
 
-        # Count/Percent policy slots: K = widest policy list in the batch
-        k = max(
+        # Count/Percent policy slots: K padded to a power of two — the row
+        # axis is already padded (pad_to above) to keep decide_jit's
+        # compiled shape stable, and the K axis must not undo that by
+        # retracing when one autoscaler gains a second policy
+        widest = max(
             [1]
             + [
                 len(rules.policies or [])
@@ -154,6 +157,7 @@ class BatchAutoscaler:
                 for rules in pair
             ]
         )
+        k = 1 << (widest - 1).bit_length() if widest > 1 else 1
 
         def policy_slots(direction: int):
             ptype = np.zeros((n, k), np.int32)

@@ -13,6 +13,7 @@ else) at the edge. The reference's compile-time `-tags=aws` selection
 
 from __future__ import annotations
 
+import time as _time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Protocol, Tuple
 
@@ -183,6 +184,14 @@ class SQSAPI(Protocol):
         self, queue_url: str, attribute_names: List[str]
     ) -> Dict[str, str]: ...
 
+    def receive_message(
+        self,
+        queue_url: str,
+        attribute_names: List[str],
+        max_number_of_messages: int,
+        visibility_timeout: int,
+    ) -> List[Dict]: ...
+
 
 class _NotImplementedClient:
     """Default when no client is bound: every call fails with guidance —
@@ -279,10 +288,20 @@ class ManagedNodeGroup:
 class SQSQueue:
     """reference: sqsqueue.go:36-98."""
 
-    def __init__(self, arn: str, client: SQSAPI):
+    def __init__(
+        self,
+        arn: str,
+        client: SQSAPI,
+        age_sample_interval: float = 60.0,
+        clock=_time.time,
+    ):
         self.arn = arn
         self.client = client
+        self.age_sample_interval = age_sample_interval
+        self.clock = clock
         self._cached_url: Optional[str] = None
+        self._age_sampled_at: float = float("-inf")
+        self._age_sample: int = 0
 
     def name(self) -> str:
         return self.arn
@@ -307,7 +326,58 @@ class SQSQueue:
             ) from e
 
     def oldest_message_age_seconds(self) -> int:
-        return 0  # reference stub (sqsqueue.go:78-80)
+        """The reference stubs this at 0 (sqsqueue.go:78-80) because SQS
+        surfaces oldest-message age only as a CloudWatch metric.
+        Implemented here by message-attribute sampling: peek a batch with
+        visibility_timeout=0 and age the oldest SentTimestamp. A head
+        sample is an approximation (SQS ordering is best-effort), but it
+        turns a dead gauge into a usable scaling signal.
+
+        Side-effect caveat: every ReceiveMessage increments the sampled
+        messages' ApproximateReceiveCount even at visibility_timeout=0,
+        which counts toward a redrive policy's maxReceiveCount. The
+        sample is therefore cached for age_sample_interval (default 60 s
+        vs the 5 s producer tick); on queues with an aggressive redrive
+        policy, raise the interval or prefer the CloudWatch
+        ApproximateAgeOfOldestMessage metric via the Prometheus path.
+        The cached age is extrapolated by elapsed time between samples,
+        so the gauge still climbs between refreshes."""
+        now = self.clock()
+        since = now - self._age_sampled_at
+        if since < self.age_sample_interval:
+            return (
+                max(0, self._age_sample + int(since))
+                if self._age_sample
+                else 0
+            )
+        url = self._url()
+        try:
+            messages = self.client.receive_message(
+                queue_url=url,
+                attribute_names=["SentTimestamp"],
+                max_number_of_messages=10,
+                visibility_timeout=0,
+            )
+        except Exception as e:  # noqa: BLE001
+            raise RuntimeError(
+                f"could not sample SQS messages for age: {e}"
+            ) from e
+        oldest_ms: Optional[int] = None
+        for message in messages or []:
+            raw = (message.get("Attributes") or {}).get("SentTimestamp")
+            if raw is None:
+                continue
+            try:
+                sent = int(raw)
+            except ValueError:
+                continue
+            if oldest_ms is None or sent < oldest_ms:
+                oldest_ms = sent
+        self._age_sampled_at = now
+        self._age_sample = (
+            0 if oldest_ms is None else max(0, int(now - oldest_ms / 1000.0))
+        )
+        return self._age_sample
 
     def _url(self) -> str:
         # the ARN->URL mapping is immutable for this queue's lifetime;

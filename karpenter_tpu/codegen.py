@@ -222,8 +222,87 @@ def write_crds(config_dir: str) -> list:
     return written
 
 
+# ---------------------------------------------------------------------------
+# API reference docs (the reference generates docs/README.md with
+# gen-crd-api-reference-docs, Makefile:72-77; here the same reference is
+# rendered straight from the dataclasses that ARE the schema)
+# ---------------------------------------------------------------------------
+
+
+def _type_label(tp: Any) -> str:
+    tp = _unwrap_optional(tp)
+    origin = typing.get_origin(tp)
+    if origin in (list, typing.List):
+        (item,) = typing.get_args(tp) or (Any,)
+        return f"[]{_type_label(item)}"
+    if origin in (dict, typing.Dict):
+        args = typing.get_args(tp)
+        val = _type_label(args[1]) if len(args) == 2 else "object"
+        return f"map[string]{val}"
+    if dataclasses.is_dataclass(tp):
+        return f"[{tp.__name__}](#{tp.__name__.lower()})"
+    return getattr(tp, "__name__", str(tp))
+
+
+def api_docs_markdown() -> str:
+    """One markdown API reference for the three CRDs, generated from the
+    API dataclasses (single source of truth with the CRD schemas above)."""
+    lines = [
+        "# API reference",
+        "",
+        f"Group `{GROUP}`, version `{VERSION}`. Generated by "
+        "`make docs` from `karpenter_tpu/api/` — do not edit by hand.",
+        "",
+    ]
+    rendered = set()
+    queue = [CRD_KINDS[kind]["cls"] for kind in CRD_KINDS]
+    while queue:
+        cls = queue.pop(0)
+        if cls.__name__ in rendered:
+            continue
+        rendered.add(cls.__name__)
+        lines.append(f"## {cls.__name__}")
+        lines.append("")
+        doc = (cls.__doc__ or "").strip()
+        if doc and not doc.startswith(f"{cls.__name__}("):
+            # real docstring (the auto-generated dataclass signature is noise)
+            lines.append(doc.split("\n\n")[0])
+            lines.append("")
+        lines.append("| Field | Type | Default |")
+        lines.append("|---|---|---|")
+        hints = typing.get_type_hints(cls)
+        for f in dataclasses.fields(cls):
+            key = _FIELD_TO_KEY.get(f.name, snake_to_camel(f.name))
+            tp = _unwrap_optional(hints[f.name])
+            if dataclasses.is_dataclass(tp):
+                queue.append(tp)
+            else:
+                for arg in typing.get_args(tp):
+                    arg = _unwrap_optional(arg)
+                    if dataclasses.is_dataclass(arg):
+                        queue.append(arg)
+            if f.default is not dataclasses.MISSING:
+                default = repr(f.default)
+            elif f.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
+                default = f.default_factory.__name__ + "()"
+            else:
+                default = ""
+            lines.append(f"| `{key}` | {_type_label(hints[f.name])} | {default} |")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def write_api_docs(path: str = "docs/API.md") -> str:
+    with open(path, "w") as f:
+        f.write(api_docs_markdown())
+    return path
+
+
 def main(argv=None) -> int:
     args = argv if argv is not None else sys.argv[1:]
+    if args and args[0] == "--docs":
+        print(f"wrote {write_api_docs(args[1] if len(args) > 1 else 'docs/API.md')}")
+        return 0
     config_dir = args[0] if args else "config"
     for path in write_crds(config_dir):
         print(f"wrote {path}")

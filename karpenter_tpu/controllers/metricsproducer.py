@@ -37,7 +37,7 @@ class MetricsProducerController:
 
         if pending:
             try:
-                solve_pending(
+                outcomes = solve_pending(
                     self.factory.store,
                     pending,
                     self.factory.registry,
@@ -45,8 +45,9 @@ class MetricsProducerController:
                     feed=self.factory.pending_feed(),
                 )
                 for mp in pending:
-                    results[key(mp)] = None
-            except Exception as e:  # noqa: BLE001
+                    # per-ROW outcome: a poisoned spec fails only itself
+                    results[key(mp)] = outcomes.get(key(mp))
+            except Exception as e:  # noqa: BLE001 — global failure
                 for mp in pending:
                     results[key(mp)] = e
 

@@ -142,7 +142,7 @@ def _group_profile(
 def solve_pending(
     store, due_producers: List, registry: GaugeRegistry, solver=None,
     pod_cache=None, feed=None,
-) -> None:
+) -> Dict[tuple, Optional[Exception]]:
     """One device call over ALL pendingCapacity producers in the store.
 
     Solving the full set — not just the due subset — is what upholds the
@@ -165,6 +165,16 @@ def solve_pending(
     Outputs are identical on every path (the solver is permutation-
     invariant over pods: per-pod first-feasible assignment + bucket
     histograms).
+
+    Returns {(namespace, name): error or None} for every target. Failure
+    isolation is per ROW: one producer with a poisoned spec (e.g. a
+    selector that blows up profile computation) fails only its own row —
+    its group encodes as an empty (all-infeasible) shape and its status/
+    gauges are left untouched — while every healthy producer still solves
+    (mirrors the reference's per-object failure containment,
+    pkg/controllers/controller.go:85-91). Only genuinely global failures
+    (the pod snapshot, the device solve itself) fail the whole batch, by
+    raising.
     """
     due_keys = {
         (mp.metadata.namespace, mp.metadata.name): mp for mp in due_producers
@@ -193,15 +203,24 @@ def solve_pending(
                  mp.spec.pending_capacity.node_selector)
             )
     if not targets:
-        return
+        return {}
 
-    if feed is not None:
-        profiles = [feed.nodes.profile(sel) for _, _, _, sel in targets]
-    else:
+    if feed is None:
         nodes = store.list("Node")  # listed ONCE; profiles filter in-memory
-        profiles = [
-            _group_profile(nodes, sel) for _, _, _, sel in targets
-        ]
+    errors: Dict[tuple, Optional[Exception]] = {}
+    profiles = []
+    for namespace, name, _, sel in targets:
+        try:
+            profiles.append(
+                feed.nodes.profile(sel)
+                if feed is not None
+                else _group_profile(nodes, sel)
+            )
+        except Exception as e:  # noqa: BLE001 — row-isolated failure
+            errors[(namespace, name)] = e
+            # empty shape: zero allocatable everywhere, which _feasibility
+            # already rejects — the row solves as "nothing fits here"
+            profiles.append(({}, set(), set()))
 
     # ONE encode implementation for every path (store/columnar.py): the
     # caches snapshot their watch-maintained arenas; the oracle path runs
@@ -213,7 +232,11 @@ def solve_pending(
     else:
         snap = snapshot_from_pods(store.list("Pod"))
     inputs = _encode_from_cache(snap, profiles)
-    _dispatch_and_record(inputs, targets, registry, solver)
+    _dispatch_and_record(inputs, targets, registry, solver, errors)
+    return {
+        (namespace, name): errors.get((namespace, name))
+        for namespace, name, _, _ in targets
+    }
 
 
 def _group_arrays(profiles, resources, taint_universe, label_universe,
@@ -308,7 +331,7 @@ def _encode_from_cache(snap, profiles) -> "B.BinPackInputs":
     )
 
 
-def _dispatch_and_record(inputs, targets, registry, solver) -> None:
+def _dispatch_and_record(inputs, targets, registry, solver, errors=None) -> None:
     if solver is None:
         solver = B.solve
     # numpy arrays go straight through: the in-process jitted solve
@@ -332,6 +355,10 @@ def _dispatch_and_record(inputs, targets, registry, solver) -> None:
     register_gauges(registry)
     gauge = lambda g: registry.gauge(SUBSYSTEM, g)
     for t, (namespace, name, mp, _) in enumerate(targets):
+        if errors and (namespace, name) in errors:
+            # poisoned row: keep its last-good status/gauges rather than
+            # publishing the placeholder all-infeasible solve
+            continue
         if mp is not None:  # due: status lands on the persisted instance
             mp.status.pending_capacity = PendingCapacityStatus(
                 pending_pods=int(assigned_count[t]),
@@ -364,7 +391,12 @@ class PendingCapacityProducer:
         register_gauges(self.registry)
 
     def reconcile(self) -> None:
-        solve_pending(
+        outcomes = solve_pending(
             self.store, [self.mp], self.registry, solver=self.solver,
             feed=self.feed,
         )
+        error = outcomes.get(
+            (self.mp.metadata.namespace, self.mp.metadata.name)
+        )
+        if error is not None:
+            raise error

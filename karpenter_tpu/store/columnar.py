@@ -458,13 +458,23 @@ class ProducerSelectorIndex:
 
     def _on_event(self, event: str, mp) -> None:
         key = (mp.metadata.namespace, mp.metadata.name)
+        selector = None
+        if event != DELETED and mp.spec.pending_capacity is not None:
+            selector = mp.spec.pending_capacity.node_selector
+            try:
+                selector = dict(selector)
+            except TypeError:
+                # poisoned spec (e.g. null selector): index it verbatim —
+                # a watch callback must NEVER raise (it runs under the
+                # store's notify path, shared by every watcher), and the
+                # per-row guard in solve_pending contains the blast radius
+                # to this one producer at solve time
+                pass
         with self._lock:
             if event == DELETED or mp.spec.pending_capacity is None:
                 self._selectors.pop(key, None)
             else:
-                self._selectors[key] = dict(
-                    mp.spec.pending_capacity.node_selector
-                )
+                self._selectors[key] = selector
 
     def items(self) -> List[Tuple[Tuple[str, str], Dict[str, str]]]:
         """(key, selector) pairs in deterministic (namespace, name) order —

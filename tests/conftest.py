@@ -20,3 +20,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from karpenter_tpu.utils.backend import force_virtual_cpu  # noqa: E402
 
 force_virtual_cpu(8)
+
+
+def pytest_collection_modifyitems(config, items):
+    """Randomize test order (the reference's battletest runs randomized,
+    Makefile:25-31; pytest-randomly is not in this image, so the shuffle
+    lives here). Opt-in via KARPENTER_TEST_SHUFFLE=<seed> ('random' picks
+    one); the seed is printed so any ordering failure is reproducible."""
+    seed = os.environ.get("KARPENTER_TEST_SHUFFLE")
+    if not seed:
+        return
+    import random
+
+    if seed == "random":
+        seed = str(random.SystemRandom().randrange(2**31))
+    print(f"\n[conftest] shuffling test order with seed {seed}")
+    random.Random(int(seed)).shuffle(items)

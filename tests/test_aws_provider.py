@@ -89,10 +89,13 @@ class FakeEKSAPI:
 
 
 class FakeSQSAPI:
-    def __init__(self, url="oopsydaisy", attributes=None, want_err=None):
+    def __init__(self, url="oopsydaisy", attributes=None, want_err=None,
+                 messages=None):
         self.url = url
         self.attributes = attributes or {}
         self.want_err = want_err
+        self.messages = messages or []
+        self.receive_calls = []
 
     def get_queue_url(self, queue_name, account_id):
         self.url_calls = getattr(self, "url_calls", 0) + 1
@@ -104,6 +107,16 @@ class FakeSQSAPI:
         if self.want_err:
             raise self.want_err
         return self.attributes
+
+    def receive_message(self, queue_url, attribute_names,
+                        max_number_of_messages, visibility_timeout):
+        if self.want_err:
+            raise self.want_err
+        self.receive_calls.append(
+            (queue_url, tuple(attribute_names), max_number_of_messages,
+             visibility_timeout)
+        )
+        return self.messages[:max_number_of_messages]
 
 
 # --- ARN tables (reference: autoscalinggroup_test.go:20-47) ----------------
@@ -261,8 +274,89 @@ class TestSQSQueue:
         with pytest.raises(RuntimeError):
             SQSQueue(SQS_ARN, api).length()
 
-    def test_oldest_age_stub(self):
+    def test_oldest_age_empty_queue_is_zero(self):
         assert SQSQueue(SQS_ARN, FakeSQSAPI()).oldest_message_age_seconds() == 0
+
+    def test_oldest_age_from_sent_timestamp_sampling(self):
+        """Beyond the reference (sqsqueue.go:78-80 stubs this at 0): the
+        age comes from peeking SentTimestamp with visibility_timeout=0 so
+        sampling never consumes or hides messages from real consumers."""
+        import time
+
+        now_ms = int(time.time() * 1000)
+        api = FakeSQSAPI(
+            messages=[
+                {"Attributes": {"SentTimestamp": str(now_ms - 90_000)}},
+                {"Attributes": {"SentTimestamp": str(now_ms - 240_000)}},
+                {"Attributes": {}},  # missing timestamp: skipped
+            ]
+        )
+        age = SQSQueue(SQS_ARN, api).oldest_message_age_seconds()
+        assert 239 <= age <= 242  # the OLDEST of the sample, ~240s
+        (call,) = api.receive_calls
+        assert call[1] == ("SentTimestamp",)
+        assert call[3] == 0  # visibility_timeout: a peek, not a consume
+
+    def test_oldest_age_sampling_is_rate_limited(self):
+        """ReceiveMessage bumps ApproximateReceiveCount (redrive-policy
+        fuel) even at visibility_timeout=0, so the 5s producer tick must
+        NOT sample every time: one sample per age_sample_interval, with
+        the cached age extrapolated by elapsed time in between."""
+        clock = {"now": 1000.0}
+        base_ms = int((clock["now"] - 100) * 1000)  # sent 100s ago
+        api = FakeSQSAPI(
+            messages=[{"Attributes": {"SentTimestamp": str(base_ms)}}]
+        )
+        queue = SQSQueue(
+            SQS_ARN, api, age_sample_interval=60.0,
+            clock=lambda: clock["now"],
+        )
+        assert queue.oldest_message_age_seconds() == 100
+        clock["now"] += 30  # inside the interval: no new ReceiveMessage
+        assert queue.oldest_message_age_seconds() == 130  # extrapolated
+        assert len(api.receive_calls) == 1
+        clock["now"] += 31  # past the interval: resample
+        assert queue.oldest_message_age_seconds() == 161
+        assert len(api.receive_calls) == 2
+
+    def test_oldest_age_error_is_wrapped(self):
+        api = FakeSQSAPI()
+        queue = SQSQueue(SQS_ARN, api)
+        queue._url()  # resolve first so the sampling call is what fails
+        api.want_err = RuntimeError("throttled")
+        with pytest.raises(RuntimeError, match="could not sample"):
+            queue.oldest_message_age_seconds()
+
+    def test_oldest_age_flows_to_gauge_and_status(self):
+        """End-to-end through the queue producer: status + the
+        karpenter_queue_oldest_message_age_seconds gauge."""
+        import time
+
+        from karpenter_tpu.api.core import ObjectMeta
+        from karpenter_tpu.api.metricsproducer import (
+            MetricsProducer,
+            MetricsProducerSpec,
+        )
+        from karpenter_tpu.metrics.producers.queue import QueueProducer
+        from karpenter_tpu.metrics.registry import GaugeRegistry
+
+        now_ms = int(time.time() * 1000)
+        api = FakeSQSAPI(
+            attributes={"ApproximateNumberOfMessages": "7"},
+            messages=[{"Attributes": {"SentTimestamp": str(now_ms - 60_000)}}],
+        )
+        mp = MetricsProducer(
+            metadata=ObjectMeta(name="q", namespace="default"),
+            spec=MetricsProducerSpec(
+                queue=QueueSpec(type=AWS_SQS_QUEUE_TYPE, id=SQS_ARN)
+            ),
+        )
+        registry = GaugeRegistry()
+        QueueProducer(mp, SQSQueue(SQS_ARN, api), registry).reconcile()
+        assert mp.status.queue.length == 7
+        assert 59 <= mp.status.queue.oldest_message_age_seconds <= 62
+        gauge = registry.gauge("queue", "oldest_message_age_seconds")
+        assert 59 <= gauge.get("q", "default") <= 62
 
     def test_queue_url_resolved_once(self):
         """The ARN->URL mapping is immutable: polling length repeatedly

@@ -213,6 +213,74 @@ class TestPackingQuality:
         # quantization (1/32 ceil) + shelf placement keep us near true FFD
         assert nodes <= ffd * 1.15 + 2, (nodes, ffd, lp)
 
+    # size distributions spanning the regimes that stress bucketized
+    # packing differently: quantization inflation (small), near-full nodes
+    # (large), shelf reuse (bimodal/harmonic)
+    DISTRIBUTIONS = {
+        "uniform": lambda rng, p: rng.uniform(0.02, 1.0, p),
+        "small": lambda rng, p: rng.uniform(0.01, 0.12, p),
+        "large": lambda rng, p: rng.uniform(0.45, 0.95, p),
+        "bimodal": lambda rng, p: np.where(
+            rng.random(p) < 0.5,
+            rng.uniform(0.05, 0.15, p),
+            rng.uniform(0.55, 0.8, p),
+        ),
+        "harmonic": lambda rng, p: 1.0 / rng.integers(1, 20, p),
+    }
+    # empirical ratchet: grand-total nodes over every (distribution,
+    # buckets, seed) case below, measured at the time this test was
+    # written. 1% headroom absorbs float-rounding drift across jax
+    # versions; a systematic packing-quality regression trips it.
+    RATCHET_TOTAL = 22221
+
+    def _fleet_cases(self):
+        for buckets in (8, 16, 32, 64):
+            for name, gen in self.DISTRIBUTIONS.items():
+                for seed in range(6):
+                    rng = np.random.default_rng(seed)
+                    yield buckets, name, gen(rng, 400).astype(np.float32)
+
+    def test_quality_bounds_over_randomized_fleets(self):
+        """Pins the bucketized shelf-BFD's packing quality three ways:
+
+        1. ANALYTIC soundness per fleet: lp <= nodes <= 2*ffd + 2*ceil(P/B)
+           + 1. Derivation: any-fit packings never leave two bins that
+           would fit together, so nodes <= 2*sum(q) + 1; quantizing up
+           adds < 1/B per item, sum(q) <= sum(s) + P/B; and
+           ffd >= sum(s). Never flaky, catches catastrophic regressions
+           (e.g. one-item-per-bin placement).
+        2. FIDELITY per fleet: nodes <= FFD run on the SAME quantized
+           sizes — the device shelf algorithm (best-fit by remaining
+           capacity) must never pack worse than canonical first-fit-
+           decreasing at equal granularity. Holds with equality-or-better
+           on every case today.
+        3. RATCHET in aggregate: total nodes across all cases within 1%
+           of the recorded measurement, so a broad quality drift fails CI
+           even if each fleet stays under the loose analytic bound.
+        """
+        total = 0
+        for buckets, name, sizes in self._fleet_cases():
+            p = len(sizes)
+            req = np.stack([sizes * 4, sizes * 4], axis=1)
+            out = B.binpack(make_inputs(req, [[4, 4]]), buckets=buckets)
+            nodes = int(out.nodes_needed[0])
+            lp = int(out.lp_bound[0])
+            ffd = B.oracle_ffd(sizes)
+            label = (name, buckets, nodes, ffd, lp)
+            assert lp <= nodes, label
+            assert nodes <= 2 * ffd + 2 * int(np.ceil(p / buckets)) + 1, label
+            quantized = (
+                np.clip(
+                    np.ceil(sizes.astype(np.float64) * buckets - 1e-6),
+                    1,
+                    buckets,
+                )
+                / buckets
+            )
+            assert nodes <= B.oracle_ffd(quantized), label
+            total += nodes
+        assert total <= int(self.RATCHET_TOTAL * 1.01), total
+
     def test_result_is_sufficient_capacity(self):
         """The count must be a VALID packing bound: verify by re-packing the
         true sizes into that many nodes greedily."""

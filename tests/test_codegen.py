@@ -29,6 +29,18 @@ class TestCrdGeneration:
                 f"{path} is stale — run `make codegen`"
             )
 
+    def test_committed_api_docs_match_codegen(self):
+        """docs/API.md is generated (make docs); committed == regenerated,
+        same freshness contract as the CRDs."""
+        import os
+
+        from karpenter_tpu.codegen import api_docs_markdown
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(root, "docs", "API.md")) as f:
+            committed = f.read()
+        assert committed == api_docs_markdown()
+
     def test_scale_subresource_on_scalablenodegroup(self):
         # reference: the kubebuilder scale marker, scalablenodegroup.go:51 —
         # this is what lets any HorizontalAutoscaler target the group

@@ -221,6 +221,39 @@ class TestPendingCapacitySignal:
         mp = runtime.store.get("MetricsProducer", "default", "group-a")
         assert mp.status.pending_capacity.pending_pods == 1
 
+    def test_poisoned_producer_fails_only_its_own_row(self, env):
+        """Blast-radius isolation in the batched solve: one producer whose
+        spec blows up during encoding (node_selector=None — validation is
+        a no-op for pendingCapacity, matching the reference's
+        metricsproducer_validation.go:85-87, and real-cluster informers
+        deliver whatever the apiserver holds) must fail ONLY itself; every
+        healthy producer still solves and updates (mirrors the
+        reference's per-object containment, controller.go:85-91)."""
+        runtime, provider, clock = env
+        runtime.store.create(ready_node("n1", {"group": "a"}, cpu="4"))
+        for i in range(4):
+            runtime.store.create(pending_pod(f"p{i}", cpu="2", memory="1Gi"))
+        runtime.store.create(pending_mp("healthy", {"group": "a"}))
+        poisoned = MetricsProducer(
+            metadata=ObjectMeta(name="poisoned"),
+            spec=MetricsProducerSpec(
+                pending_capacity=PendingCapacitySpec(node_selector=None)
+            ),
+        )
+        runtime.store.create(poisoned)
+
+        runtime.manager.reconcile_all()
+
+        healthy = runtime.store.get("MetricsProducer", "default", "healthy")
+        assert healthy.status.pending_capacity is not None
+        assert healthy.status.pending_capacity.pending_pods == 4
+        assert healthy.status.pending_capacity.additional_nodes_needed == 2
+        assert healthy.status_conditions().is_happy()
+
+        bad = runtime.store.get("MetricsProducer", "default", "poisoned")
+        assert not bad.status_conditions().is_happy()
+        assert bad.status.pending_capacity is None  # no placeholder solve
+
     def test_unschedulable_pod_reported(self, env):
         runtime, provider, clock = env
         runtime.store.create(ready_node("n1", {"group": "a"}, cpu="2"))
