@@ -26,18 +26,15 @@ import numpy as np
 BASELINE_MS = 200.0
 
 
-def ensure_backend(probe_timeout: float = 120.0, retries: int = 2) -> str:
-    """Make SOME backend usable before the first in-process jax call.
+def probe_real_devices(probe_timeout: float = 120.0, retries: int = 2):
+    """Subprocess probe of the default backend, with retry+backoff.
 
     Round 1's bench artifact was erased by a single transient TPU
     unavailability (BENCH_r01.json rc=1: axon init raised UNAVAILABLE at
     jax.default_backend()), and the axon client can also HANG instead of
     raising — so the probe runs in a subprocess with a hard timeout, where
-    both failure modes are recoverable. On persistent failure, force the
-    CPU backend via jax.config (env mutation is too late — the axon
-    sitecustomize imports jax at interpreter startup; same gotcha as
-    tests/conftest.py). Returns '' if the default backend is healthy, else
-    a human-readable reason for the CPU fallback.
+    both failure modes are recoverable. Returns (device_count, "") when
+    the default backend is healthy, else (0, reason).
     """
     last = ""
     probes = 0
@@ -69,13 +66,29 @@ def ensure_backend(probe_timeout: float = 120.0, retries: int = 2) -> str:
             last = f"backend init hung (> {probe_timeout:.0f}s)"
             break
         if proc.returncode == 0:
-            return ""
+            try:
+                return int(proc.stdout.split()[-1]), ""
+            except (ValueError, IndexError):
+                return 1, ""  # healthy but unparsable: count conservatively
         tail = (proc.stderr or "").strip().splitlines()
         last = tail[-1][:200] if tail else f"probe rc={proc.returncode}"
+    return 0, f"{last} after {probes} probe(s)"
+
+
+def ensure_backend(probe_timeout: float = 120.0, retries: int = 2) -> str:
+    """Make SOME backend usable before the first in-process jax call: on
+    persistent probe failure, force the CPU backend via jax.config (env
+    mutation is too late — the axon sitecustomize imports jax at
+    interpreter startup; same gotcha as tests/conftest.py). Returns '' if
+    the default backend is healthy, else the reason for the CPU fallback.
+    """
+    count, reason = probe_real_devices(probe_timeout, retries)
+    if count:
+        return ""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    return f"default backend unavailable after {probes} probe(s) ({last}); cpu fallback"
+    return f"default backend unavailable ({reason}); cpu fallback"
 
 
 def emit(metric: str, value, note: str = "", error: str = "") -> None:
@@ -146,6 +159,14 @@ def main() -> None:
         choices=("auto", "xla", "pallas"),
         default="auto",
         help="auto = fused Pallas kernel on TPU, XLA elsewhere",
+    )
+    ap.add_argument(
+        "--churn",
+        type=int,
+        default=-1,
+        help="pods replaced per e2e tick through the store watch path "
+        "(-1 = 1%% of --pods); keeps the e2e number honest: every tick "
+        "pays incremental feed maintenance + re-encode + re-transfer",
     )
     ap.add_argument("--probe-timeout", type=float, default=120.0)
     ap.add_argument("--probe-retries", type=int, default=2)
@@ -253,32 +274,18 @@ def run_mesh(args, metric: str) -> None:
     compiles) and the number is scale EVIDENCE for the sharded program,
     not a TPU perf claim. Outputs are asserted element-for-element equal
     to the single-device solve before timing."""
-    # probe the real backend in a subprocess (it can hang, not just
-    # raise); fall back to a virtual CPU mesh if it is unusable or too
-    # small for the requested mesh
-    real_ok = False
-    try:
-        proc = subprocess.run(
-            [
-                sys.executable,
-                "-c",
-                "import jax; print(len(jax.devices()))",
-            ],
-            capture_output=True,
-            text=True,
-            timeout=args.probe_timeout,
-        )
-        real_ok = (
-            proc.returncode == 0 and int(proc.stdout.strip()) >= args.mesh
-        )
-    except (subprocess.TimeoutExpired, ValueError):
-        pass
-    if not real_ok:
+    # shared probe (retry+backoff, hang-safe): fall back to a virtual
+    # CPU mesh if the real backend is unusable or smaller than the mesh
+    count, reason = probe_real_devices(
+        args.probe_timeout, args.probe_retries
+    )
+    if count < args.mesh:
         from karpenter_tpu.utils.backend import force_virtual_cpu
 
         print(
-            f"real backend unusable or < {args.mesh} devices; "
-            f"using virtual CPU mesh",
+            f"real backend has {count} device(s)"
+            + (f" ({reason})" if reason else "")
+            + f", need {args.mesh}: using virtual CPU mesh",
             file=sys.stderr,
         )
         force_virtual_cpu(args.mesh)
@@ -433,15 +440,64 @@ def run_e2e(args, metric: str, note: str = "") -> None:
         f"first tick (compile+run): {(time.perf_counter() - t0) * 1e3:.1f} ms",
         file=sys.stderr,
     )
-    times = []
+
+    # steady state: nothing changed between ticks, so the encode memo +
+    # device-residency cache collapse the tick to (dispatch + one packed
+    # output fetch)
+    steady = []
     for _ in range(args.iters):
         t0 = time.perf_counter()
+        tick()
+        steady.append((time.perf_counter() - t0) * 1e3)
+    s50 = float(np.percentile(steady, 50))
+    print(
+        f"steady-state tick p50={s50:.1f}ms "
+        f"p95={float(np.percentile(steady, 95)):.1f}ms",
+        file=sys.stderr,
+    )
+
+    # churned: replace pods through the store each tick (watch events feed
+    # the incremental caches), so every measured tick includes cache
+    # maintenance, full re-encode, and full input re-transfer — the honest
+    # production number, reported as THE metric
+    churn = args.churn if args.churn >= 0 else max(1, args.pods // 100)
+    next_id = args.pods
+    times = []
+    for it in range(args.iters):
+        t0 = time.perf_counter()
+        for j in range(churn):
+            victim = f"p{next_id - args.pods + j}"  # oldest pods first
+            store.delete("Pod", "default", victim)
+            store.create(
+                Pod(
+                    metadata=ObjectMeta(name=f"p{next_id + j}"),
+                    spec=PodSpec(
+                        containers=[
+                            Container(
+                                requests={
+                                    "cpu": rng.choice(cpu_choices),
+                                    "memory": rng.choice(mem_choices),
+                                }
+                            )
+                        ]
+                    ),
+                )
+            )
+        next_id += churn
         tick()
         times.append((time.perf_counter() - t0) * 1e3)
     p50 = float(np.percentile(times, 50))
     p95 = float(np.percentile(times, 95))
-    print(f"e2e tick p50={p50:.1f}ms p95={p95:.1f}ms", file=sys.stderr)
-    emit(f"{metric} ({jax.default_backend()})", p50, note=note)
+    print(
+        f"e2e tick (churn={churn} pods/tick) p50={p50:.1f}ms p95={p95:.1f}ms",
+        file=sys.stderr,
+    )
+    extra = f"churn={churn}/tick; steady-state p50={s50:.1f}ms"
+    emit(
+        f"{metric} ({jax.default_backend()})",
+        p50,
+        note=f"{note}; {extra}" if note else extra,
+    )
 
 
 if __name__ == "__main__":

@@ -302,6 +302,7 @@ class SQSQueue:
         self._cached_url: Optional[str] = None
         self._age_sampled_at: float = float("-inf")
         self._age_sample: int = 0
+        self._age_saw_message: bool = False
 
     def name(self) -> str:
         return self.arn
@@ -345,11 +346,12 @@ class SQSQueue:
         now = self.clock()
         since = now - self._age_sampled_at
         if since < self.age_sample_interval:
-            return (
-                max(0, self._age_sample + int(since))
-                if self._age_sample
-                else 0
-            )
+            # a sampled EMPTY queue stays 0 between refreshes; a sampled
+            # head climbs by elapsed time even when its age rounded to 0
+            # at sample time (a fresh-but-stuck message must still age)
+            if not self._age_saw_message:
+                return 0
+            return max(0, self._age_sample + int(since))
         url = self._url()
         try:
             messages = self.client.receive_message(
@@ -374,6 +376,7 @@ class SQSQueue:
             if oldest_ms is None or sent < oldest_ms:
                 oldest_ms = sent
         self._age_sampled_at = now
+        self._age_saw_message = oldest_ms is not None
         self._age_sample = (
             0 if oldest_ms is None else max(0, int(now - oldest_ms / 1000.0))
         )

@@ -232,6 +232,13 @@ def write_crds(config_dir: str) -> list:
 def _type_label(tp: Any) -> str:
     tp = _unwrap_optional(tp)
     origin = typing.get_origin(tp)
+    if origin is typing.Union:  # non-Optional unions, e.g. int | str
+        # \| keeps the label inside one markdown table cell
+        return " \\| ".join(
+            _type_label(arg)
+            for arg in typing.get_args(tp)
+            if arg is not type(None)
+        )
     if origin in (list, typing.List):
         (item,) = typing.get_args(tp) or (Any,)
         return f"[]{_type_label(item)}"

@@ -231,7 +231,39 @@ def solve_pending(
         snap = pod_cache.snapshot()
     else:
         snap = snapshot_from_pods(store.list("Pod"))
-    inputs = _encode_from_cache(snap, profiles)
+
+    # Encode memo (feed path only): inputs are a pure function of
+    # (pod arena generation, node set, producer selectors). When none of
+    # those moved since the last solve, reuse the previous BinPackInputs
+    # OBJECT — the solver's identity-keyed device cache (ops/binpack.solve)
+    # then skips the host->device transfer entirely, which dominates the
+    # tick when the chip sits behind a network tunnel.
+    fingerprint = None
+    if feed is not None:
+        fingerprint = (
+            snap.generation,
+            feed.nodes.version,
+            tuple(
+                (
+                    namespace,
+                    name,
+                    # poisoned specs (e.g. selector=None) must stay
+                    # row-isolated: never assume dict shape here
+                    tuple(sorted(sel.items()))
+                    if isinstance(sel, dict)
+                    else repr(sel),
+                )
+                for namespace, name, _, sel in targets
+            ),
+        )
+        memo = feed.encode_memo
+        if memo is not None and memo[0] == fingerprint:
+            inputs = memo[1]
+        else:
+            inputs = _encode_from_cache(snap, profiles)
+            feed.encode_memo = (fingerprint, inputs)
+    else:
+        inputs = _encode_from_cache(snap, profiles)
     _dispatch_and_record(inputs, targets, registry, solver, errors)
     return {
         (namespace, name): errors.get((namespace, name))
@@ -331,6 +363,27 @@ def _encode_from_cache(snap, profiles) -> "B.BinPackInputs":
     )
 
 
+_pack_outputs_jit = None
+
+
+def _pack_outputs(assigned_count, nodes_needed, lp_bound, unschedulable):
+    """Jitted on first use: concat the per-group outputs + the scalar into
+    one vector so the host fetch is a single device round-trip."""
+    global _pack_outputs_jit
+    if _pack_outputs_jit is None:
+        import jax
+        import jax.numpy as jnp
+
+        _pack_outputs_jit = jax.jit(
+            lambda a, n, l, u: jnp.concatenate(
+                [a, n, l, u.astype(a.dtype)[None]]
+            )
+        )
+    return _pack_outputs_jit(
+        assigned_count, nodes_needed, lp_bound, unschedulable
+    )
+
+
 def _dispatch_and_record(inputs, targets, registry, solver, errors=None) -> None:
     if solver is None:
         solver = B.solve
@@ -340,17 +393,30 @@ def _dispatch_and_record(inputs, targets, registry, solver, errors=None) -> None
     # in the control-plane process the sidecar split exists to relieve
     out = solver(inputs)
 
-    # ONE device->host fetch for all four outputs: each np.asarray on a
-    # device array is its own synchronous round-trip (expensive when the
-    # chip sits behind a network tunnel); device_get batches them and
-    # passes plain numpy (sidecar path) through untouched
+    # ONE device->host fetch for all four outputs: device_get still issues
+    # a round-trip PER leaf (measured ~35 ms each through the network
+    # tunnel), so the four outputs are first concatenated ON DEVICE into a
+    # single i32[3T+1] vector — one transfer total. Plain numpy outputs
+    # (sidecar path) pass through untouched.
     import jax
 
-    assigned_count, nodes_needed, lp_bound, unschedulable = jax.device_get(
-        (out.assigned_count, out.nodes_needed, out.lp_bound,
-         out.unschedulable)
-    )
-    unschedulable = int(unschedulable)
+    if isinstance(out.assigned_count, jax.Array):
+        packed = np.asarray(
+            _pack_outputs(
+                out.assigned_count, out.nodes_needed, out.lp_bound,
+                out.unschedulable,
+            )
+        )
+        n = out.assigned_count.shape[0]
+        assigned_count = packed[:n]
+        nodes_needed = packed[n : 2 * n]
+        lp_bound = packed[2 * n : 3 * n]
+        unschedulable = int(packed[3 * n])
+    else:
+        assigned_count, nodes_needed, lp_bound = (
+            out.assigned_count, out.nodes_needed, out.lp_bound,
+        )
+        unschedulable = int(out.unschedulable)
 
     register_gauges(registry)
     gauge = lambda g: registry.gauge(SUBSYSTEM, g)

@@ -235,6 +235,25 @@ def binpack(inputs: BinPackInputs, buckets: int = DEFAULT_BUCKETS) -> BinPackOut
     )
 
 
+# one-slot identity-keyed device residency cache: callers that pass the SAME
+# BinPackInputs object again (the encode memo in producers/pendingcapacity.py
+# does exactly that when no pod/node/producer changed) skip the host->device
+# transfer of the full ~10 MB input set — the dominant tick cost when the
+# chip sits behind a network tunnel. Contract: inputs must be treated as
+# immutable once passed to solve(); every encode path builds fresh arrays.
+_put_memo = None
+
+
+def _device_resident(inputs: BinPackInputs) -> BinPackInputs:
+    global _put_memo
+    memo = _put_memo
+    if memo is not None and memo[0] is inputs:
+        return memo[1]
+    resident = jax.device_put(inputs)
+    _put_memo = (inputs, resident)
+    return resident
+
+
 def solve(
     inputs: BinPackInputs,
     buckets: int = DEFAULT_BUCKETS,
@@ -243,9 +262,11 @@ def solve(
     """Backend dispatcher: 'xla' (this module), 'pallas' (the fused Mosaic
     kernel, ops/pallas_binpack.py), or 'auto' — pallas on TPU, xla
     elsewhere. The two backends are pinned element-for-element equal by
-    tests/test_pallas_binpack.py."""
+    tests/test_pallas_binpack.py. Inputs are device-cached by object
+    identity (see _device_resident): treat them as immutable."""
     if backend == "auto":
         backend = "pallas" if jax.default_backend() == "tpu" else "xla"
+    inputs = _device_resident(inputs)
     if backend == "xla":
         return binpack(inputs, buckets=buckets)
     if backend == "pallas":

@@ -92,6 +92,11 @@ class PendingPodCache:
 
     def __init__(self, store: Optional[Store] = None, capacity: int = 1024):
         self._lock = threading.Lock()
+        # generation counts MUTATIONS (upsert/remove/compact), not resets:
+        # snapshot() memoizes on it, and downstream encode/device caches key
+        # on it to skip re-encoding + re-transferring an unchanged fleet
+        self._generation = 0
+        self._snap_memo: Optional[Tuple[int, "PendingSnapshot"]] = None
         self._reset_arena(max(16, capacity))
 
         if store is not None:
@@ -134,6 +139,7 @@ class PendingPodCache:
         slot = self._slot.pop(key, None)
         if slot is None:
             return
+        self._generation += 1
         self._valid[slot] = False
         self._requests[slot, :] = 0.0
         self._required[slot, :] = False
@@ -161,6 +167,7 @@ class PendingPodCache:
         if slot is None:
             slot = self._alloc()
             self._slot[key] = slot
+        self._generation += 1
         self._encode(slot, sparse)
 
     def _encode(self, slot: int, sparse: _SparsePod) -> None:
@@ -217,6 +224,7 @@ class PendingPodCache:
         capacity = 16
         while capacity < 2 * max(1, len(records)):
             capacity *= _GROW
+        self._generation += 1  # row order / universes may change
         self._reset_arena(capacity)
         for key, sparse in records:
             slot = self._alloc()
@@ -274,12 +282,22 @@ class PendingPodCache:
 
     def snapshot(self) -> "PendingSnapshot":
         """Bulk-copy the live region; O(pending pods) numpy memcpy, no
-        Python-per-pod work. Compacts first when peak >> live."""
+        Python-per-pod work. Compacts first when peak >> live.
+
+        Memoized per generation: an unchanged arena returns the SAME
+        snapshot object, so callers can key their own derived caches
+        (encoded solver inputs, device-resident buffers) on identity or
+        on `snapshot.generation`."""
         with self._lock:
             if self._needs_compaction():
                 self._compact()
+            if (
+                self._snap_memo is not None
+                and self._snap_memo[0] == self._generation
+            ):
+                return self._snap_memo[1]
             hi = self._hi
-            return PendingSnapshot(
+            snap = PendingSnapshot(
                 requests=self._requests[:hi, : len(self._resources)].copy(),
                 required=self._required[:hi, : len(self._labels)].copy(),
                 shape_id=self._shape_id[:hi].copy(),
@@ -287,7 +305,10 @@ class PendingPodCache:
                 resources=list(self._resources),
                 labels=list(self._labels),
                 shape_tolerations=[list(t) for t in self._shape_tolerations],
+                generation=self._generation,
             )
+            self._snap_memo = (self._generation, snap)
+            return snap
 
     def __len__(self) -> int:
         with self._lock:
@@ -325,6 +346,13 @@ class NodeMirror:
                 self._nodes[key] = node
             self._memo.clear()
             self._version += 1
+
+    @property
+    def version(self) -> int:
+        """Node-event counter; bumps on any node churn. Lets callers key
+        profile-derived caches (encoded group arrays) on it."""
+        with self._lock:
+            return self._version
 
     def nodes(self, selector: Optional[Dict[str, str]] = None) -> list:
         """Current node objects, optionally filtered by label selector.
@@ -496,6 +524,15 @@ class PendingFeed:
             else NodeMirror(store, profile_fn)
         )
         self.producers = ProducerSelectorIndex(store)
+        # owned by the feed, WRITTEN by the solve path
+        # (metrics/producers/pendingcapacity.solve_pending): memoizes the
+        # last (fingerprint, BinPackInputs) so an unchanged fleet reuses
+        # the same inputs OBJECT and the solver's identity-keyed device
+        # cache skips the host->device transfer. The fingerprint covers
+        # pods.snapshot().generation, nodes.version, and the producer
+        # selector set, so any reset/replacement of those caches
+        # invalidates it naturally.
+        self.encode_memo: Optional[tuple] = None
 
 
 def snapshot_from_pods(pods) -> "PendingSnapshot":
@@ -519,3 +556,4 @@ class PendingSnapshot:                        # no 100k-row reprs in logs
     resources: List[str]
     labels: List[Tuple[str, str]]
     shape_tolerations: List[list]
+    generation: int = 0  # arena mutation counter at snapshot time

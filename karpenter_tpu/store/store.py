@@ -10,6 +10,14 @@ a pod spec.nodeName index (reference: pkg/controllers/manager.go:73-79), and
 a pluggable scale subresource so any HorizontalAutoscaler can target any
 registered scalable kind (reference: scalablenodegroup.go:51).
 
+Copy discipline (the hottest host path at fleet scale): objects are cloned
+with utils/clone.fast_clone on every intake and every read-out, and the
+store is COPY-ON-WRITE internally — no stored object is ever mutated after
+insertion (patch_status/update_scale replace the stored instance). That
+lets watch callbacks receive the stored instance itself with NO copy; the
+documented watcher contract (read-only) is what makes a 1%-churn tick over
+100k pods affordable.
+
 Durability mirrors the reference's checkpoint/resume story (SURVEY.md §5):
 ALL durable state lives in object spec/status here; controllers and the
 device solver are stateless and resume by re-listing.
@@ -17,8 +25,9 @@ device solver are stateless and resume by re-listing.
 
 from __future__ import annotations
 
-import copy
 import threading
+
+from karpenter_tpu.utils.clone import fast_clone
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -81,20 +90,19 @@ class Store:
 
     def watch(self, kind: Optional[str], callback: Callable) -> None:
         """Subscribe to mutation events. kind=None watches everything.
-        callback(event_type, obj_copy) is invoked synchronously. The copy
-        is SHARED between all watchers of the event (one deepcopy per
-        mutation, not per watcher) — treat it as read-only."""
+        callback(event_type, obj) is invoked synchronously with the STORED
+        object itself (zero copies: the store is copy-on-write, so the
+        instance can never change after delivery) — treat it as strictly
+        read-only."""
         with self._lock:
             self._watchers.append((kind, callback))
 
     def _notify(self, event: str, obj) -> None:
+        # obj is the stored (immutable-after-insert) instance: no copy
         kind = _kind_of(obj)
-        shared = None  # one deepcopy per event, made only if anyone listens
         for want_kind, callback in list(self._watchers):
             if want_kind is None or want_kind == kind:
-                if shared is None:
-                    shared = copy.deepcopy(obj)
-                callback(event, shared)
+                callback(event, obj)
 
     # -- index maintenance ------------------------------------------------
 
@@ -117,21 +125,21 @@ class Store:
             key = _key(obj)
             if key in self._objects:
                 raise ConflictError(f"{key} already exists")
-            obj = copy.deepcopy(obj)
+            obj = fast_clone(obj)
             obj.metadata.ensure_identity()
             self._rv += 1
             obj.metadata.resource_version = self._rv
             self._objects[key] = obj
             self._index_add(obj)
             self._notify(ADDED, obj)
-            return copy.deepcopy(obj)
+            return fast_clone(obj)
 
     def get(self, kind: str, namespace: str, name: str):
         with self._lock:
             obj = self._objects.get((kind, namespace, name))
             if obj is None:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
-            return copy.deepcopy(obj)
+            return fast_clone(obj)
 
     def try_get(self, kind: str, namespace: str, name: str):
         try:
@@ -159,7 +167,7 @@ class Store:
                     f"{stored.metadata.resource_version}"
                 )
             self._index_remove(stored)
-            obj = copy.deepcopy(obj)
+            obj = fast_clone(obj)
             self._rv += 1
             obj.metadata.resource_version = self._rv
             obj.metadata.uid = stored.metadata.uid
@@ -167,7 +175,7 @@ class Store:
             self._objects[key] = obj
             self._index_add(obj)
             self._notify(MODIFIED, obj)
-            return copy.deepcopy(obj)
+            return fast_clone(obj)
 
     def patch_status(self, obj):
         """Merge-patch ONLY the status subtree onto the stored object,
@@ -179,11 +187,15 @@ class Store:
             stored = self._objects.get(key)
             if stored is None:
                 raise NotFoundError(f"{key} not found")
-            stored.status = copy.deepcopy(obj.status)
+            # copy-on-write: watchers hold references to the previous
+            # instance, which must never change after delivery
+            new = fast_clone(stored)
+            new.status = fast_clone(obj.status)
             self._rv += 1
-            stored.metadata.resource_version = self._rv
-            self._notify(MODIFIED, stored)
-            return copy.deepcopy(stored)
+            new.metadata.resource_version = self._rv
+            self._objects[key] = new
+            self._notify(MODIFIED, new)
+            return fast_clone(new)
 
     def delete(self, obj_or_kind, namespace: Optional[str] = None, name=None):
         with self._lock:
@@ -220,14 +232,14 @@ class Store:
                     for lk, lv in label_selector.items()
                 ):
                     continue
-                out.append(copy.deepcopy(obj))
+                out.append(fast_clone(obj))
             return out
 
     def pods_on_node(self, node_name: str) -> list:
         """Pods indexed by spec.nodeName (reference: manager.go:54-55,73-79)."""
         with self._lock:
             return [
-                copy.deepcopy(self._objects[key])
+                fast_clone(self._objects[key])
                 for key in sorted(self._pods_by_node.get(node_name, set()))
                 if key in self._objects
             ]
@@ -255,7 +267,7 @@ class Store:
                 return  # relist echo of an unchanged object: no watcher spam
             if stored is not None:
                 self._index_remove(stored)
-            obj = copy.deepcopy(obj)
+            obj = fast_clone(obj)
             self._objects[key] = obj
             self._index_add(obj)
             if isinstance(obj.metadata.resource_version, int):
@@ -293,10 +305,13 @@ class Store:
                 raise NotFoundError(
                     f"{kind} {scale.namespace}/{scale.name} not found"
                 )
-            hooks.set_spec(obj, scale.spec_replicas)
+            # copy-on-write (same contract as patch_status)
+            new = fast_clone(obj)
+            hooks.set_spec(new, scale.spec_replicas)
             self._rv += 1
-            obj.metadata.resource_version = self._rv
-            self._notify(MODIFIED, obj)
+            new.metadata.resource_version = self._rv
+            self._objects[(kind, scale.namespace, scale.name)] = new
+            self._notify(MODIFIED, new)
 
 
 def _register_builtin_scale_kinds():

@@ -1,0 +1,56 @@
+"""Fast structural clone for API objects — the store's copy primitive.
+
+The store isolates every read/write with a deep copy (store/store.py); at
+fleet scale that copy IS the control plane's hottest host path (a 1%-churn
+tick over 100k pods performs thousands of pod copies). copy.deepcopy pays
+for generality it doesn't need here — memo dicts, reduce/reconstruct
+protocol, cycle detection. API objects are trees of dataclasses, builtin
+containers, scalars, and immutable leaves, so a direct recursive rebuild
+with a per-class field cache is ~10x faster.
+
+Semantics vs copy.deepcopy, by design:
+- Quantity instances are SHARED, not copied: Quantity is immutable by
+  contract (utils/quantity.py — all arithmetic returns new instances).
+- Aliasing inside one object tree is not preserved (each reference is
+  cloned independently). API objects are plain trees; nothing relies on
+  internal sharing.
+- Unknown types fall back to copy.deepcopy, so correctness never depends
+  on this module knowing every type.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import fields, is_dataclass
+from typing import Any, Dict, Tuple
+
+from karpenter_tpu.utils.quantity import Quantity
+
+_ATOMIC = (str, int, float, bool, type(None), bytes, Quantity)
+
+# per-dataclass field-name cache: (names tuple, uses __dict__)
+_FIELD_CACHE: Dict[type, Tuple[str, ...]] = {}
+
+
+def fast_clone(x: Any) -> Any:
+    t = x.__class__
+    if t in (str, int, float, bool, type(None), bytes, Quantity):
+        return x
+    if t is dict:
+        return {k: fast_clone(v) for k, v in x.items()}
+    if t is list:
+        return [fast_clone(v) for v in x]
+    if t is tuple:
+        return tuple(fast_clone(v) for v in x)
+    if t is set:
+        return {fast_clone(v) for v in x}
+    names = _FIELD_CACHE.get(t)
+    if names is None:
+        if not is_dataclass(x):
+            return copy.deepcopy(x)  # unknown type: full generality
+        names = tuple(f.name for f in fields(t))
+        _FIELD_CACHE[t] = names
+    new = object.__new__(t)
+    for name in names:
+        object.__setattr__(new, name, fast_clone(getattr(x, name)))
+    return new

@@ -319,6 +319,33 @@ class TestSQSQueue:
         assert queue.oldest_message_age_seconds() == 161
         assert len(api.receive_calls) == 2
 
+    def test_oldest_age_fresh_head_still_climbs(self):
+        """A message whose sampled age rounds to 0 must still age between
+        refreshes — only a sampled EMPTY queue stays pinned at 0 (a
+        fresh-but-stuck message is exactly what the signal exists for)."""
+        clock = {"now": 1000.0}
+        api = FakeSQSAPI(
+            messages=[
+                {"Attributes": {"SentTimestamp": str(int(1000.0 * 1000))}}
+            ]
+        )
+        queue = SQSQueue(
+            SQS_ARN, api, age_sample_interval=60.0,
+            clock=lambda: clock["now"],
+        )
+        assert queue.oldest_message_age_seconds() == 0  # fresh at sample
+        clock["now"] += 45  # stuck unconsumed inside the interval
+        assert queue.oldest_message_age_seconds() == 45
+        assert len(api.receive_calls) == 1
+
+        # sampled-empty stays 0 between refreshes
+        clock["now"] += 30  # past the interval: resample, now empty
+        api.messages = []
+        assert queue.oldest_message_age_seconds() == 0
+        clock["now"] += 45
+        assert queue.oldest_message_age_seconds() == 0
+        assert len(api.receive_calls) == 2
+
     def test_oldest_age_error_is_wrapped(self):
         api = FakeSQSAPI()
         queue = SQSQueue(SQS_ARN, api)

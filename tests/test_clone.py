@@ -1,0 +1,206 @@
+"""utils/clone.fast_clone: the store's copy primitive must be
+indistinguishable from copy.deepcopy for API object trees (modulo the
+documented Quantity sharing), and the store's copy-on-write discipline
+must keep watcher-delivered objects frozen forever."""
+
+import copy
+
+from karpenter_tpu.api.core import (
+    Container,
+    Node,
+    NodeCondition,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    Taint,
+    Toleration,
+)
+from karpenter_tpu.api.horizontalautoscaler import (
+    Behavior,
+    CrossVersionObjectReference,
+    HorizontalAutoscaler,
+    HorizontalAutoscalerSpec,
+    Metric,
+    MetricTarget,
+    PrometheusMetricSource,
+    ScalingPolicy,
+    ScalingRules,
+)
+from karpenter_tpu.api.scalablenodegroup import (
+    ScalableNodeGroup,
+    ScalableNodeGroupSpec,
+)
+from karpenter_tpu.api.serialization import to_dict
+from karpenter_tpu.store import Store
+from karpenter_tpu.utils.clone import fast_clone
+from karpenter_tpu.utils.quantity import Quantity
+
+
+def rich_pod():
+    return Pod(
+        metadata=ObjectMeta(
+            name="p", namespace="ns", labels={"a": "b"},
+            annotations={"k": "v"},
+        ),
+        spec=PodSpec(
+            node_selector={"zone": "z1"},
+            tolerations=[
+                Toleration(
+                    key="t", operator="Equal", value="v",
+                    effect="NoSchedule",
+                )
+            ],
+            containers=[
+                Container(
+                    requests={
+                        "cpu": Quantity.parse("250m"),
+                        "memory": Quantity.parse("1Gi"),
+                    }
+                )
+            ],
+        ),
+    )
+
+
+def rich_ha():
+    return HorizontalAutoscaler(
+        metadata=ObjectMeta(name="ha"),
+        spec=HorizontalAutoscalerSpec(
+            scale_target_ref=CrossVersionObjectReference(
+                kind="ScalableNodeGroup", name="sng"
+            ),
+            min_replicas=1,
+            max_replicas=10,
+            metrics=[
+                Metric(
+                    prometheus=PrometheusMetricSource(
+                        query="q",
+                        target=MetricTarget(type="Value", value=4.0),
+                    )
+                )
+            ],
+            behavior=Behavior(
+                scale_up=ScalingRules(
+                    stabilization_window_seconds=0,
+                    policies=[
+                        ScalingPolicy(
+                            type="Count", value=4, period_seconds=60
+                        )
+                    ],
+                )
+            ),
+        ),
+    )
+
+
+class TestFastClone:
+    def test_equivalent_to_deepcopy_for_api_trees(self):
+        for obj in (
+            rich_pod(),
+            rich_ha(),
+            Node(
+                metadata=ObjectMeta(name="n", labels={"g": "a"}),
+                spec=NodeSpec(
+                    taints=[Taint(key="k", value="v", effect="NoSchedule")]
+                ),
+                status=NodeStatus(
+                    allocatable={"cpu": Quantity.parse("8")},
+                    conditions=[NodeCondition(type="Ready", status="True")],
+                ),
+            ),
+            ScalableNodeGroup(
+                metadata=ObjectMeta(name="s"),
+                spec=ScalableNodeGroupSpec(
+                    replicas=3, type="AWSEC2AutoScalingGroup", id="arn:x"
+                ),
+            ),
+        ):
+            assert to_dict(fast_clone(obj)) == to_dict(copy.deepcopy(obj))
+
+    def test_clone_is_independent(self):
+        pod = rich_pod()
+        clone = fast_clone(pod)
+        clone.metadata.labels["a"] = "MUTATED"
+        clone.spec.containers[0].requests["cpu"] = Quantity.parse("9")
+        clone.spec.tolerations.append("x")
+        assert pod.metadata.labels["a"] == "b"
+        assert str(pod.spec.containers[0].requests["cpu"]) == "250m"
+        assert len(pod.spec.tolerations) == 1
+
+    def test_quantity_instances_shared(self):
+        """Documented divergence from deepcopy: Quantity is immutable by
+        contract and shared, which is what makes pod clones cheap."""
+        pod = rich_pod()
+        clone = fast_clone(pod)
+        assert (
+            clone.spec.containers[0].requests["cpu"]
+            is pod.spec.containers[0].requests["cpu"]
+        )
+
+    def test_unknown_types_fall_back_to_deepcopy(self):
+        class Odd:
+            def __init__(self):
+                self.payload = [1, 2]
+
+        odd = Odd()
+        clone = fast_clone(odd)
+        assert clone is not odd and clone.payload == [1, 2]
+        clone.payload.append(3)
+        assert odd.payload == [1, 2]
+
+
+class TestStoreCopyOnWrite:
+    def test_watcher_view_frozen_across_status_patch(self):
+        """_notify hands out the stored instance with no copy; the store
+        must therefore never mutate it afterward — a status patch has to
+        REPLACE the stored object (copy-on-write)."""
+        store = Store()
+        delivered = []
+        store.watch("ScalableNodeGroup", lambda e, o: delivered.append(o))
+        created = store.create(
+            ScalableNodeGroup(
+                metadata=ObjectMeta(name="s"),
+                spec=ScalableNodeGroupSpec(
+                    replicas=1, type="AWSEC2AutoScalingGroup", id="arn:x"
+                ),
+            )
+        )
+        first = delivered[-1]
+        rv_at_delivery = first.metadata.resource_version
+        created.status.replicas = 7
+        store.patch_status(created)
+        # the originally-delivered instance did not change...
+        assert first.metadata.resource_version == rv_at_delivery
+        assert first.status.replicas != 7
+        # ...the new event carries a DIFFERENT instance with the patch
+        second = delivered[-1]
+        assert second is not first
+        assert second.status.replicas == 7
+
+    def test_watcher_view_frozen_across_scale_update(self):
+        from karpenter_tpu.store.store import Scale
+
+        store = Store()
+        delivered = []
+        store.watch("ScalableNodeGroup", lambda e, o: delivered.append(o))
+        store.create(
+            ScalableNodeGroup(
+                metadata=ObjectMeta(name="s", namespace="default"),
+                spec=ScalableNodeGroupSpec(
+                    replicas=1, type="AWSEC2AutoScalingGroup", id="arn:x"
+                ),
+            )
+        )
+        first = delivered[-1]
+        store.update_scale(
+            "ScalableNodeGroup",
+            Scale(
+                namespace="default", name="s",
+                spec_replicas=5, status_replicas=1,
+            ),
+        )
+        assert first.spec.replicas == 1  # frozen
+        assert delivered[-1].spec.replicas == 5
+        assert store.get("ScalableNodeGroup", "default", "s").spec.replicas == 5

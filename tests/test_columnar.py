@@ -500,3 +500,71 @@ class TestEquivalence:
                 store.update(obj)
         oracle, cached, fed = solve_both(store, cache, feed)
         assert oracle == cached == fed
+
+
+class TestSolveCaching:
+    """The tick-collapse caches: snapshot memo (same object per unchanged
+    generation), encode memo (same BinPackInputs object per unchanged
+    fleet), and their invalidation on pod/node/producer churn. These are
+    what turn an unchanged 100k-pod tick into a single device round-trip
+    (see _dispatch_and_record's packed fetch + ops/binpack._device_resident)."""
+
+    def test_snapshot_identity_stable_until_mutation(self):
+        store = Store()
+        cache = PendingPodCache(store)
+        store.create(pod("p0"))
+        s1 = cache.snapshot()
+        assert cache.snapshot() is s1
+        store.create(pod("p1"))
+        s2 = cache.snapshot()
+        assert s2 is not s1
+        assert s2.generation > s1.generation
+        store.delete("Pod", "default", "p1")
+        s3 = cache.snapshot()
+        assert s3 is not s2
+        # non-mutating churn (delete of an unknown pod) keeps the memo
+        assert cache.snapshot() is s3
+
+    def test_encode_memo_reuse_and_invalidation(self, monkeypatch):
+        import karpenter_tpu.metrics.producers.pendingcapacity as PC
+        from karpenter_tpu.store.columnar import PendingFeed
+
+        store = Store()
+        feed = PendingFeed(store, PC._group_profile)
+        store.create(node("n0", {"group": "g"}, cpu="8", mem="32Gi"))
+        store.create(producer("mp", {"group": "g"}))
+        for i in range(3):
+            store.create(pod(f"p{i}"))
+
+        calls = []
+        real = PC._encode_from_cache
+
+        def counting(*args, **kwargs):
+            calls.append(1)
+            return real(*args, **kwargs)
+
+        monkeypatch.setattr(PC, "_encode_from_cache", counting)
+        registry = GaugeRegistry()
+
+        def tick():
+            mps = store.list("MetricsProducer")
+            PC.solve_pending(store, mps, registry, feed=feed)
+            return registry.gauge(
+                PC.SUBSYSTEM, PC.ADDITIONAL_NODES_NEEDED
+            ).get("mp", "default")
+
+        first = tick()
+        assert len(calls) == 1
+        assert tick() == first  # memo hit: same outputs, no re-encode
+        assert len(calls) == 1
+        store.create(pod("p9"))  # pod churn invalidates
+        tick()
+        assert len(calls) == 2
+        tick()
+        assert len(calls) == 2
+        store.create(node("n1", {"group": "g"}, cpu="4", mem="16Gi"))
+        tick()  # node churn invalidates (profile shape changed)
+        assert len(calls) == 3
+        store.create(producer("mp2", {"group": "g"}))
+        tick()  # producer-set churn invalidates (group axis changed)
+        assert len(calls) == 4

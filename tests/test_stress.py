@@ -140,7 +140,13 @@ class TestStoreUnderConcurrency:
 
         errors = run_threads([writer, subscriber, subscriber])
         assert errors == []
-        assert seen  # late subscribers still observed traffic
+        # the interleaving is timing-dependent (fast_clone made writes
+        # quick enough to finish before subscribers start), so assert the
+        # invariant directly: every registered watcher observes traffic
+        # that happens after registration, and the notify list is intact
+        before = len(seen)
+        store.create(sng("after-storm"))
+        assert len(seen) == before + 100  # all 2x50 watchers fired once
 
 
 class TestKubeStoreUnderConcurrency:
