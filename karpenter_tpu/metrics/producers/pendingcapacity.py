@@ -286,15 +286,50 @@ def _group_arrays(profiles, resources, taint_universe, label_universe,
     return group_allocatable, group_taints, group_labels
 
 
+def _dedup_rows(snap):
+    """Collapse identical pod rows into (row indices, multiplicities).
+
+    Two pods with the same (requests vector, required labels, toleration
+    shape, validity) are interchangeable to every solver stage — same
+    feasibility row, same first-feasible group, same size bucket — so the
+    solve is exact over distinct shapes weighted by count. This is what
+    makes the device upload O(distinct shapes), not O(pods): fleets are
+    dominated by replicated workloads (Deployments/Jobs stamp identical
+    pod templates).
+
+    Raw-byte uniqueness on the concatenated row bytes: float bit-equality
+    only (never merges distinct values; -0.0 vs 0.0 over-splits, which is
+    merely suboptimal, never wrong).
+    """
+    hi = snap.requests.shape[0]
+    if hi == 0:
+        return np.zeros(0, np.intp), np.zeros(0, np.int32)
+    parts = [
+        np.ascontiguousarray(snap.requests).view(np.uint8).reshape(hi, -1),
+        np.ascontiguousarray(snap.required).view(np.uint8).reshape(hi, -1),
+        np.ascontiguousarray(snap.shape_id).view(np.uint8).reshape(hi, -1),
+        snap.valid.astype(np.uint8).reshape(hi, 1),
+    ]
+    rows = np.ascontiguousarray(np.concatenate(parts, axis=1))
+    keys = rows.view([("k", np.void, rows.shape[1])]).ravel()
+    _, idx, counts = np.unique(keys, return_index=True, return_counts=True)
+    return idx, counts.astype(np.int32)
+
+
 def _encode_from_cache(snap, profiles) -> "B.BinPackInputs":
-    """Snapshot (store/columnar.PendingSnapshot) -> solver inputs.
+    """Snapshot (store/columnar.PendingSnapshot) -> solver inputs, with
+    rows DEDUPLICATED into distinct pod shapes + multiplicities
+    (pod_weight) — see _dedup_rows. Every solve path (feed, pod_cache,
+    oracle store.list) flows through here, so outputs stay identical
+    across paths by construction.
 
     All per-pod work here is bulk numpy (column gathers, row gathers by
     toleration-shape id); the only Python loops left are over universes —
     resources, group profiles, taints, distinct toleration shapes — whose
     cardinalities are fleet-scale constants, not pod counts.
     """
-    hi = snap.requests.shape[0]
+    row_idx, row_weight = _dedup_rows(snap)
+    hi = len(row_idx)
 
     extended = {
         r for r in snap.resources
@@ -326,15 +361,18 @@ def _encode_from_cache(snap, profiles) -> "B.BinPackInputs":
     pod_valid = np.zeros(n_pods, bool)
     pod_required = np.zeros((n_pods, n_labels), bool)
     pod_intolerant = np.zeros((n_pods, n_taints), bool)
+    pod_weight = np.zeros(n_pods, np.int32)  # padding rows weigh nothing
     if hi:
+        valid = snap.valid[row_idx]
         cols = np.array(
             [resource_index[r] for r in snap.resources], np.intp
         )
-        pod_requests[:hi, cols] = snap.requests
-        pod_requests[:hi, pod_slot] = snap.valid.astype(np.float32)
-        pod_valid[:hi] = snap.valid
+        pod_requests[:hi, cols] = snap.requests[row_idx]
+        pod_requests[:hi, pod_slot] = valid.astype(np.float32)
+        pod_valid[:hi] = valid
+        pod_weight[:hi] = row_weight
         if snap.labels:
-            pod_required[:hi, : len(snap.labels)] = snap.required
+            pod_required[:hi, : len(snap.labels)] = snap.required[row_idx]
         if snap.shape_tolerations:
             taint_objects = {
                 k: Taint(key=taint[0], value=taint[1], effect=taint[2])
@@ -346,7 +384,7 @@ def _encode_from_cache(snap, profiles) -> "B.BinPackInputs":
                     rows[s, k] = not any(
                         tol.tolerates(taint) for tol in tolerations
                     )
-            pod_intolerant[:hi] = rows[snap.shape_id]
+            pod_intolerant[:hi] = rows[snap.shape_id[row_idx]]
 
     group_allocatable, group_taints, group_labels = _group_arrays(
         profiles, resources, taint_universe, label_universe,
@@ -360,6 +398,7 @@ def _encode_from_cache(snap, profiles) -> "B.BinPackInputs":
         group_allocatable=group_allocatable,
         group_taints=group_taints,
         group_labels=group_labels,
+        pod_weight=pod_weight,
     )
 
 

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -47,6 +48,14 @@ class BinPackInputs:
 
     K = taint-universe size (distinct taints across groups), L = label-
     constraint universe (distinct pod-required labels). Both are padded.
+
+    Rows are pod SHAPES, not necessarily pods: two pods with identical
+    (requests, required labels, tolerations) are interchangeable to every
+    stage of the solve — same feasibility row, same first-feasible group,
+    same bucket — so the encoder collapses them into one row with
+    `pod_weight` = multiplicity (producers/pendingcapacity.py
+    _encode_from_cache). That is what turns the 100k-pod snapshot into a
+    few-hundred-row upload. pod_weight=None means every row counts once.
     """
 
     pod_requests: jax.Array  # f32[P, R] resource requests
@@ -56,13 +65,14 @@ class BinPackInputs:
     group_allocatable: jax.Array  # f32[T, R] per-node allocatable
     group_taints: jax.Array  # bool[T, K] group nodes carry taint k
     group_labels: jax.Array  # bool[T, L] group nodes carry label l
+    pod_weight: Optional[jax.Array] = None  # i32[P] row multiplicity
 
 
 @jax.tree_util.register_dataclass
 @dataclass
 class BinPackOutputs:
-    assigned: jax.Array  # i32[P] group index, -1 if unschedulable
-    assigned_count: jax.Array  # i32[T] pods routed to each group
+    assigned: jax.Array  # i32[P] group index per input ROW, -1 if unschedulable
+    assigned_count: jax.Array  # i32[T] pods (weighted rows) routed to each group
     nodes_needed: jax.Array  # i32[T] shelf-BFD node count (valid upper bound)
     lp_bound: jax.Array  # i32[T] LP-relaxation lower bound
     unschedulable: jax.Array  # i32 scalar: pods with no feasible group
@@ -192,7 +202,16 @@ def binpack(inputs: BinPackInputs, buckets: int = DEFAULT_BUCKETS) -> BinPackOut
         & any_feasible[:, None]
     )  # [P, T]
 
-    assigned_count = jnp.sum(member.astype(jnp.int32), axis=0)  # [T]
+    # weighted membership: every aggregate below counts each row
+    # `pod_weight` times (rows are deduplicated pod shapes)
+    w = inputs.pod_weight
+    member_w = (
+        member.astype(jnp.int32)
+        if w is None
+        else member.astype(jnp.int32) * w[:, None]
+    )  # i32[P, T]
+
+    assigned_count = jnp.sum(member_w, axis=0)  # [T]
 
     # quantize UP into B integer sizes; clip to [1, B]
     bucket_of = jnp.clip(
@@ -202,7 +221,11 @@ def binpack(inputs: BinPackInputs, buckets: int = DEFAULT_BUCKETS) -> BinPackOut
     # would be ~1 GB at the 100k x 300 bench scale)
     histogram = jnp.stack(
         [
-            jnp.sum(member & (bucket_of == b), axis=0, dtype=jnp.int32)
+            jnp.sum(
+                jnp.where(bucket_of == b, member_w, 0),
+                axis=0,
+                dtype=jnp.int32,
+            )
             for b in range(1, buckets + 1)
         ],
         axis=1,
@@ -213,7 +236,7 @@ def binpack(inputs: BinPackInputs, buckets: int = DEFAULT_BUCKETS) -> BinPackOut
     # LP lower bound: per resource, total assigned demand / per-node
     # allocatable, ceil; max across resources
     demand = jnp.einsum(
-        "pt,pr->tr", member.astype(jnp.float32), inputs.pod_requests
+        "pt,pr->tr", member_w.astype(jnp.float32), inputs.pod_requests
     )  # [T, R]
     alloc = inputs.group_allocatable
     per_resource = jnp.where(
@@ -223,8 +246,9 @@ def binpack(inputs: BinPackInputs, buckets: int = DEFAULT_BUCKETS) -> BinPackOut
     )
     lp_bound = jnp.max(per_resource, axis=1).astype(jnp.int32)
 
+    unsched_mask = ((~any_feasible) & inputs.pod_valid).astype(jnp.int32)
     unschedulable = jnp.sum(
-        (~any_feasible) & inputs.pod_valid, dtype=jnp.int32
+        unsched_mask if w is None else unsched_mask * w, dtype=jnp.int32
     )
     return BinPackOutputs(
         assigned=assigned,

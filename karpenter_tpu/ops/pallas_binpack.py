@@ -48,6 +48,7 @@ def _kernel(
     valid_ref,  # f32[TILE_P, 1]  (bool as f32: VMEM-friendly layout)
     intol_ref,  # f32[TILE_P, K]
     required_ref,  # f32[TILE_P, L]
+    weight_ref,  # f32[TILE_P, 1] row multiplicity (1.0 when undeduplicated)
     alloc_t_ref,  # f32[R_pad, T] — transposed so resource rows are slices
     taints_ref,  # f32[T, K]
     labels_ref,  # f32[T, L]
@@ -106,6 +107,9 @@ def _kernel(
 
     member = (col == first) & has  # one-hot [TILE_P, T]
     member_f = member.astype(jnp.float32)
+    # weighted membership: the hist/demand accumulators count each row
+    # `weight` times (rows are deduplicated pod shapes)
+    member_w = member_f * weight_ref[:]  # [TILE_P, 1] broadcast
 
     # --- dominant share of the assigned group -> bucket one-hot --------
     share = jnp.zeros((tile_p, n_groups), jnp.float32)
@@ -128,13 +132,13 @@ def _kernel(
 
     # --- accumulate [T, B] histogram + [T, R] demand (MXU transposes) ---
     hist_update = jax.lax.dot_general(
-        member_f,
+        member_w,
         bucket_onehot,
         dimension_numbers=(((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )  # [T, B]
     demand_update = jax.lax.dot_general(
-        member_f,
+        member_w,
         req,
         dimension_numbers=(((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -190,6 +194,11 @@ def fused_assign(
     valid = pad(inputs.pod_valid[:, None], pad_p, 1)
     intol = pad(inputs.pod_intolerant, pad_p, pad_k)
     required = pad(inputs.pod_required, pad_p, pad_l)
+    weight = (
+        jnp.ones((pad_p, 1), jnp.float32)
+        if inputs.pod_weight is None
+        else pad(inputs.pod_weight[:, None], pad_p, 1)
+    )
     alloc_t = pad(inputs.group_allocatable.T, pad_r, pad_t)
     taints = pad(inputs.group_taints, pad_t, pad_k)
     labels = pad(inputs.group_labels, pad_t, pad_l)
@@ -213,6 +222,9 @@ def fused_assign(
             ),
             pl.BlockSpec(
                 (tile_p, pad_l), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (tile_p, 1), lambda i: (i, 0), memory_space=pltpu.VMEM
             ),
             pl.BlockSpec(
                 (pad_r, pad_t), lambda i: (0, 0), memory_space=pltpu.VMEM
@@ -251,7 +263,7 @@ def fused_assign(
             transcendentals=0,
         ),
         interpret=interpret,
-    )(req, valid, intol, required, alloc_t, taints, labels)
+    )(req, valid, intol, required, weight, alloc_t, taints, labels)
 
     assigned = assigned2d.reshape(-1)[:n_pods]
     # padded groups are index >= n_groups and never win the min-index
@@ -287,8 +299,12 @@ def binpack_pallas(
         0.0,
     )
     lp_bound = jnp.max(per_resource, axis=1).astype(jnp.int32)
+    unsched_mask = ((assigned < 0) & inputs.pod_valid).astype(jnp.int32)
     unschedulable = jnp.sum(
-        (assigned < 0) & inputs.pod_valid, dtype=jnp.int32
+        unsched_mask
+        if inputs.pod_weight is None
+        else unsched_mask * inputs.pod_weight,
+        dtype=jnp.int32,
     )
     return BinPackOutputs(
         assigned=assigned,

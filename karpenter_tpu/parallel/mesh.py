@@ -83,12 +83,13 @@ def build_mesh(
 # ---------------------------------------------------------------------------
 
 
-def binpack_shardings(mesh: Mesh) -> BinPackInputs:
+def binpack_shardings(mesh: Mesh, with_weight: bool = False) -> BinPackInputs:
     """A BinPackInputs-shaped pytree of NamedShardings.
 
     Pod-major arrays shard their leading dim over "pods"; group-major arrays
     over "groups". Constraint-universe axes (R, K, L) are small and
-    replicated.
+    replicated. pod_weight (present only for deduplicated inputs) rides the
+    pods axis like every other row-major array.
     """
     s = lambda *spec: NamedSharding(mesh, P(*spec))
     return BinPackInputs(
@@ -99,6 +100,7 @@ def binpack_shardings(mesh: Mesh) -> BinPackInputs:
         group_allocatable=s(AXIS_GROUPS, None),
         group_taints=s(AXIS_GROUPS, None),
         group_labels=s(AXIS_GROUPS, None),
+        pod_weight=s(AXIS_PODS) if with_weight else None,
     )
 
 
@@ -169,6 +171,11 @@ def pad_binpack_inputs_for_mesh(
         group_allocatable=pad0(inputs.group_allocatable, T1),
         group_taints=pad0(inputs.group_taints, T1),
         group_labels=pad0(inputs.group_labels, T1),
+        pod_weight=(
+            None
+            if inputs.pod_weight is None
+            else pad0(inputs.pod_weight, P1)  # zero weight: inert rows
+        ),
     )
 
 
@@ -195,7 +202,10 @@ def pad_decision_inputs_for_mesh(
 
 def shard_binpack_inputs(mesh: Mesh, inputs: BinPackInputs) -> BinPackInputs:
     inputs = pad_binpack_inputs_for_mesh(inputs, mesh)
-    return jax.device_put(inputs, binpack_shardings(mesh))
+    return jax.device_put(
+        inputs,
+        binpack_shardings(mesh, with_weight=inputs.pod_weight is not None),
+    )
 
 
 def shard_decision_inputs(

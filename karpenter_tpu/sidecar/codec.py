@@ -57,23 +57,34 @@ def unpack(data: bytes) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
 
 def pack_dataclass(obj, meta: Optional[Dict[str, Any]] = None) -> bytes:
     """Any registered array-dataclass (BinPackInputs, DecisionInputs, ...)
-    -> wire bytes, one tensor per field."""
+    -> wire bytes, one tensor per field. None-valued optional fields (e.g.
+    BinPackInputs.pod_weight) are simply absent from the wire."""
     arrays = {
         f.name: np.asarray(getattr(obj, f.name))
         for f in dataclasses.fields(obj)
+        if getattr(obj, f.name) is not None
     }
     return pack(arrays, meta)
 
 
 def unpack_dataclass(cls, data: bytes):
-    """Wire bytes -> cls hydrated with numpy arrays (field-name match is
-    exact; missing or extra tensors are an error, same strictness as the
-    YAML codec)."""
+    """Wire bytes -> cls hydrated with numpy arrays. Field-name match is
+    exact for required fields; fields with a dataclass default may be
+    absent (they take the default — how optional tensors like pod_weight
+    stay wire-compatible across versions). Extra tensors are an error,
+    same strictness as the YAML codec."""
     arrays, meta = unpack(data)
+    required = {
+        f.name
+        for f in dataclasses.fields(cls)
+        if f.default is dataclasses.MISSING
+        and f.default_factory is dataclasses.MISSING
+    }
     names = {f.name for f in dataclasses.fields(cls)}
-    if set(arrays) != names:
+    if not (required <= set(arrays) <= names):
         raise ValueError(
             f"tensor set mismatch for {cls.__name__}: "
-            f"got {sorted(arrays)}, want {sorted(names)}"
+            f"got {sorted(arrays)}, want {sorted(required)} <= got <= "
+            f"{sorted(names)}"
         )
     return cls(**arrays), meta

@@ -28,23 +28,11 @@ def _solve(request: bytes) -> bytes:
 
     from karpenter_tpu.ops.binpack import BinPackInputs, solve
 
-    arrays, meta = codec.unpack(request)
+    # optional tensors (pod_weight) may be absent from the wire; the codec
+    # fills dataclass defaults and rejects missing-required/extra tensors
+    inputs, meta = codec.unpack_dataclass(BinPackInputs, request)
     buckets = int(meta.get("buckets", 32))
     backend = meta.get("backend", "auto")
-    inputs = BinPackInputs(
-        **{
-            name: arrays[name]
-            for name in (
-                "pod_requests",
-                "pod_valid",
-                "pod_intolerant",
-                "pod_required",
-                "group_allocatable",
-                "group_taints",
-                "group_labels",
-            )
-        }
-    )
     with solver_trace("sidecar.solve"):
         out = solve(jax.device_put(inputs), buckets=buckets, backend=backend)
         jax.block_until_ready(out)

@@ -80,11 +80,12 @@ def _native_parser():
 class Quantity:
     """Exact-arithmetic quantity with a preferred display format."""
 
-    __slots__ = ("value", "format")
+    __slots__ = ("value", "format", "_float")
 
     def __init__(self, value: Fraction | int = 0, format: str = DECIMAL_SI):
         self.value = Fraction(value)
         self.format = format
+        self._float: float | None = None  # to_float memo (hot watch path)
 
     @classmethod
     def parse(cls, s: str) -> "Quantity":
@@ -102,6 +103,7 @@ class Quantity:
                 q = cls.__new__(cls)
                 q.value = Fraction(num, den)
                 q.format = _NATIVE_FORMATS[fmt]
+                q._float = None
                 return q
         m = _QUANTITY_RE.match(s.strip())
         if m is None:
@@ -131,7 +133,14 @@ class Quantity:
         return Quantity(self.value - other.value, fmt)
 
     def to_float(self) -> float:
-        return float(self.value)
+        # memoized: Quantity is immutable by contract, and the columnar
+        # feed calls this for every request of every watch-delivered pod
+        # (Fraction->float division is the costly part)
+        # getattr default: __new__/deepcopy paths can leave the slot unset
+        f = getattr(self, "_float", None)
+        if f is None:
+            f = self._float = float(self.value)
+        return f
 
     def milli(self) -> int:
         """Value in thousandths, rounded up (k8s MilliValue semantics)."""

@@ -324,3 +324,72 @@ class TestDeviceResidencyCache:
         big = make_inputs(req, [[8, 8]])
         out_big = B.solve(big)  # different object: must not reuse cache
         assert int(out_small.nodes_needed[0]) > int(out_big.nodes_needed[0])
+
+
+class TestWeightedDedup:
+    """pod_weight semantics: solving W duplicate rows as one row with
+    weight W must produce identical aggregates — the exactness claim the
+    encoder's shape-dedup (_dedup_rows) rests on."""
+
+    def _random_dup_inputs(self, rng, shapes=12, dup_max=40, types=6):
+        base_req = rng.uniform(0.05, 4.0, (shapes, 2)).astype(np.float32)
+        counts = rng.integers(1, dup_max, shapes)
+        full_req = np.repeat(base_req, counts, axis=0)
+        alloc = rng.uniform(4.0, 16.0, (types, 2)).astype(np.float32)
+        intol_base = rng.random((shapes, 4)) < 0.3
+        required_base = rng.random((shapes, 4)) < 0.2
+        taints = rng.random((types, 4)) < 0.3
+        labels = rng.random((types, 4)) < 0.7
+        full = make_inputs(
+            full_req, alloc,
+            pod_intolerant=np.repeat(intol_base, counts, axis=0),
+            pod_required=np.repeat(required_base, counts, axis=0),
+            group_taints=taints, group_labels=labels,
+        )
+        dedup = make_inputs(
+            base_req, alloc,
+            pod_intolerant=intol_base, pod_required=required_base,
+            group_taints=taints, group_labels=labels,
+        )
+        import dataclasses
+
+        dedup = dataclasses.replace(
+            dedup, pod_weight=jnp.asarray(counts.astype(np.int32))
+        )
+        return full, dedup, counts
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_weighted_equals_expanded(self, seed):
+        rng = np.random.default_rng(seed)
+        full, dedup, counts = self._random_dup_inputs(rng)
+        a = B.binpack(full, buckets=16)
+        b = B.binpack(dedup, buckets=16)
+        np.testing.assert_array_equal(
+            np.asarray(a.assigned_count), np.asarray(b.assigned_count)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(a.nodes_needed), np.asarray(b.nodes_needed)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(a.lp_bound), np.asarray(b.lp_bound)
+        )
+        assert int(a.unschedulable) == int(b.unschedulable)
+        assert int(np.sum(np.asarray(b.assigned_count))) + int(
+            b.unschedulable
+        ) == int(np.sum(counts))
+
+    def test_zero_weight_rows_are_inert(self):
+        req = np.full((4, 2), 0.5, np.float32)
+        inputs = B.BinPackInputs(
+            pod_requests=jnp.asarray(req),
+            pod_valid=jnp.ones(4, bool),
+            pod_intolerant=jnp.zeros((4, 4), bool),
+            pod_required=jnp.zeros((4, 4), bool),
+            group_allocatable=jnp.asarray([[4.0, 4.0]], np.float32),
+            group_taints=jnp.zeros((1, 4), bool),
+            group_labels=jnp.zeros((1, 4), bool),
+            pod_weight=jnp.asarray([3, 0, 0, 5], np.int32),
+        )
+        out = B.binpack(inputs, buckets=8)
+        assert out.assigned_count.tolist() == [8]
+        assert out.nodes_needed.tolist() == [1]

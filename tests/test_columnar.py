@@ -568,3 +568,59 @@ class TestSolveCaching:
         store.create(producer("mp2", {"group": "g"}))
         tick()  # producer-set churn invalidates (group axis changed)
         assert len(calls) == 4
+
+
+class TestShapeDedup:
+    """_dedup_rows + pod_weight: the encoder collapses identical pods into
+    weighted shape rows (what turns the 100k-pod upload into KBs)."""
+
+    def test_duplicate_pods_collapse_with_counts(self):
+        import karpenter_tpu.metrics.producers.pendingcapacity as PC
+
+        store = Store()
+        cache = PendingPodCache(store)
+        for i in range(50):
+            store.create(pod(f"a{i}", cpu="2"))      # 50 x shape A
+        for i in range(30):
+            store.create(pod(f"b{i}", cpu="500m"))   # 30 x shape B
+        store.create(pod("c0", cpu="2", selector={"zone": "z"}))  # 1 x C
+        snap = cache.snapshot()
+        profiles = [({"cpu": 8.0, "memory": 64.0, "pods": 110.0},
+                     set(), set())]
+        inputs = PC._encode_from_cache(snap, profiles)
+        weights = np.asarray(inputs.pod_weight)
+        live = sorted(int(w) for w in weights[weights > 0])
+        assert live == [1, 30, 50]  # 81 pods -> 3 weighted shape rows
+        # aggregates over the weighted solve equal the pod count
+        from karpenter_tpu.ops import binpack as B
+
+        out = B.binpack(inputs, buckets=16)
+        assert int(np.sum(np.asarray(out.assigned_count))) + int(
+            out.unschedulable
+        ) == 81
+
+    def test_dedup_statuses_equal_across_paths(self):
+        """The dedup must be output-invisible: feed path, pod-cache path,
+        and oracle path still agree after heavy duplication + churn."""
+        from karpenter_tpu.metrics.producers.pendingcapacity import (
+            _group_profile,
+        )
+        from karpenter_tpu.store.columnar import PendingFeed
+
+        store = Store()
+        feed = PendingFeed(store, _group_profile)
+        cache = PendingPodCache(store)
+        store.create(node("n0", {"group": "small"}, cpu="8", mem="32Gi"))
+        store.create(node("n1", {"group": "big"}, cpu="64", mem="256Gi"))
+        store.create(producer("small", {"group": "small"}))
+        store.create(producer("big", {"group": "big"}))
+        for i in range(40):
+            store.create(pod(f"p{i}", cpu="2"))
+        for i in range(20):
+            store.create(pod(f"q{i}", cpu="16"))  # only fits big
+        oracle, cached, fed = solve_both(store, cache, feed)
+        assert oracle == cached == fed
+        for i in range(10):
+            store.delete("Pod", "default", f"p{i}")
+        oracle, cached, fed = solve_both(store, cache, feed)
+        assert oracle == cached == fed

@@ -139,3 +139,38 @@ class TestCompiledMosaic:
             inputs, buckets=16, tile_p=128, interpret=False
         )
         assert_outputs_equal(xla, pallas)
+
+    def test_compiled_weighted_equals_xla_on_tpu(self):
+        """The encoder always emits pod_weight now, so the WEIGHTED path
+        is the production Mosaic path — pin it compiled too."""
+        import dataclasses
+
+        rng = np.random.default_rng(6)
+        weighted = dataclasses.replace(
+            random_inputs(rng, pods=512, types=24),
+            pod_weight=jnp.asarray(
+                rng.integers(0, 50, 512).astype(np.int32)
+            ),
+        )
+        xla = B.binpack(weighted, buckets=16)
+        pallas = PB.binpack_pallas(
+            weighted, buckets=16, tile_p=128, interpret=False
+        )
+        assert_outputs_equal(xla, pallas)
+
+
+class TestWeightedPallas:
+    def test_weighted_matches_xla(self):
+        import dataclasses
+
+        rng = np.random.default_rng(9)
+        inputs = random_inputs(rng, pods=90, types=7)
+        weighted = dataclasses.replace(
+            inputs,
+            pod_weight=jnp.asarray(rng.integers(0, 9, 90).astype(np.int32)),
+        )
+        xla = B.binpack(weighted, buckets=12)
+        pallas = PB.binpack_pallas(
+            weighted, buckets=12, tile_p=64, interpret=True
+        )
+        assert_outputs_equal(xla, pallas)

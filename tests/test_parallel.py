@@ -158,3 +158,28 @@ def test_sharded_outputs_sliced_to_input_sizes():
     d_in = example_decision_inputs(N=13, M=3, seed=17)
     d_out = sharded_decide(mesh, d_in)
     assert d_out.desired.shape == (13,)
+
+
+@pytest.mark.parametrize("n_devices", [2, 8])
+def test_sharded_weighted_binpack_matches_single_device(n_devices):
+    """pod_weight (deduplicated shape rows) must ride the pods mesh axis
+    like every other row-major array: sharded == single-device on a
+    weighted problem, and padding rows (weight 0) stay inert."""
+    import jax.numpy as jnp
+    from karpenter_tpu.ops.binpack import BinPackInputs
+
+    import dataclasses
+
+    rng = np.random.default_rng(21)
+    weighted = dataclasses.replace(
+        example_binpack_inputs(P_=37, T=5, K=8, L=8, seed=21),
+        pod_weight=jnp.asarray(rng.integers(1, 50, 37).astype(np.int32)),
+    )
+    ref = jax.device_get(binpack(weighted, buckets=8))
+    mesh = build_mesh(n_devices=n_devices)
+    out = jax.device_get(sharded_binpack(mesh, weighted, buckets=8))
+    np.testing.assert_array_equal(out.assigned, ref.assigned)
+    np.testing.assert_array_equal(out.assigned_count, ref.assigned_count)
+    np.testing.assert_array_equal(out.nodes_needed, ref.nodes_needed)
+    np.testing.assert_array_equal(out.lp_bound, ref.lp_bound)
+    assert int(out.unschedulable) == int(ref.unschedulable)
