@@ -17,7 +17,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -27,68 +26,19 @@ BASELINE_MS = 200.0
 
 
 def probe_real_devices(probe_timeout: float = 120.0, retries: int = 2):
-    """Subprocess probe of the default backend, with retry+backoff.
+    """Shared probe (utils/backend.py): (device_count, reason-if-failed)."""
+    from karpenter_tpu.utils.backend import probe_default_backend
 
-    Round 1's bench artifact was erased by a single transient TPU
-    unavailability (BENCH_r01.json rc=1: axon init raised UNAVAILABLE at
-    jax.default_backend()), and the axon client can also HANG instead of
-    raising — so the probe runs in a subprocess with a hard timeout, where
-    both failure modes are recoverable. Returns (device_count, "") when
-    the default backend is healthy, else (0, reason).
-    """
-    last = ""
-    probes = 0
-    for attempt in range(retries + 1):
-        if attempt:
-            delay = 5.0 * (2 ** (attempt - 1))
-            print(
-                f"backend probe retry {attempt}/{retries} in {delay:.0f}s: "
-                f"{last}",
-                file=sys.stderr,
-            )
-            time.sleep(delay)
-        probes += 1
-        try:
-            proc = subprocess.run(
-                [
-                    sys.executable,
-                    "-c",
-                    "import jax; print(jax.default_backend(), len(jax.devices()))",
-                ],
-                capture_output=True,
-                text=True,
-                timeout=probe_timeout,
-            )
-        except subprocess.TimeoutExpired:
-            # A hang (unlike a raised UNAVAILABLE) has never been observed
-            # to clear on its own; retrying would burn the driver's budget
-            # and risk it killing us before emit() runs.
-            last = f"backend init hung (> {probe_timeout:.0f}s)"
-            break
-        if proc.returncode == 0:
-            try:
-                return int(proc.stdout.split()[-1]), ""
-            except (ValueError, IndexError):
-                return 1, ""  # healthy but unparsable: count conservatively
-        tail = (proc.stderr or "").strip().splitlines()
-        last = tail[-1][:200] if tail else f"probe rc={proc.returncode}"
-    return 0, f"{last} after {probes} probe(s)"
+    return probe_default_backend(probe_timeout, retries)
 
 
 def ensure_backend(probe_timeout: float = 120.0, retries: int = 2) -> str:
-    """Make SOME backend usable before the first in-process jax call: on
-    persistent probe failure, force the CPU backend via jax.config (env
-    mutation is too late — the axon sitecustomize imports jax at
-    interpreter startup; same gotcha as tests/conftest.py). Returns '' if
-    the default backend is healthy, else the reason for the CPU fallback.
-    """
-    count, reason = probe_real_devices(probe_timeout, retries)
-    if count:
-        return ""
-    import jax
+    """Make SOME backend usable before the first in-process jax call
+    (utils/backend.py has the rationale). Returns '' when the default
+    backend is healthy, else the reason for the CPU fallback."""
+    from karpenter_tpu.utils.backend import ensure_usable_backend
 
-    jax.config.update("jax_platforms", "cpu")
-    return f"default backend unavailable ({reason}); cpu fallback"
+    return ensure_usable_backend(probe_timeout, retries)
 
 
 def emit(metric: str, value, note: str = "", error: str = "") -> None:
@@ -171,6 +121,14 @@ def main() -> None:
     ap.add_argument("--probe-timeout", type=float, default=120.0)
     ap.add_argument("--probe-retries", type=int, default=2)
     ap.add_argument(
+        "--decide",
+        type=int,
+        default=0,
+        metavar="N",
+        help="benchmark the batched HPA decision kernel over a fleet of "
+        "N autoscalers x 4 metrics instead of the bin-pack",
+    )
+    ap.add_argument(
         "--e2e",
         action="store_true",
         help="headline the full reconcile tick (columnar-cache snapshot + "
@@ -187,7 +145,13 @@ def main() -> None:
     )
     args = ap.parse_args()
 
-    if args.mesh:
+    if args.decide:
+        metric = (
+            f"batched HPA decision kernel p50 latency, fleet of "
+            f"{args.decide} autoscalers x 4 metrics (recommendation + "
+            f"select policy + stabilization + rate-limit policies + bounds)"
+        )
+    elif args.mesh:
         metric = (
             f"sharded bin-pack p50 latency over a {args.mesh}-device "
             f"pods x groups mesh, {args.pods} pods x {args.types} "
@@ -224,6 +188,9 @@ def main() -> None:
 def run(args, metric: str, note: str) -> None:
     import jax
 
+    if args.decide:
+        run_decide(args, metric, note)
+        return
     if args.e2e:
         run_e2e(args, metric, note)
         return
@@ -255,13 +222,67 @@ def run(args, metric: str, note: str) -> None:
     p50 = float(np.percentile(times, 50))
     p95 = float(np.percentile(times, 95))
     scheduled = int(np.sum(np.asarray(out.assigned) >= 0))
+    # BASELINE.json's other axis: full-fleet bin-pack DECISIONS per
+    # second, i.e. back-to-back solves of the whole problem
+    dps = 1000.0 / p50 if p50 else 0.0
     print(
         f"p50={p50:.2f}ms p95={p95:.2f}ms scheduled={scheduled}/{args.pods} "
         f"unschedulable={int(out.unschedulable)} "
-        f"nodes={int(np.sum(np.asarray(out.nodes_needed)))}",
+        f"nodes={int(np.sum(np.asarray(out.nodes_needed)))} "
+        f"decisions/sec={dps:.0f}",
         file=sys.stderr,
     )
-    emit(f"{metric} ({jax.default_backend()})", p50, note=note)
+    extra = f"{dps:.0f} full-fleet decisions/sec"
+    emit(
+        f"{metric} ({jax.default_backend()})",
+        p50,
+        note=f"{note}; {extra}" if note else extra,
+    )
+
+
+def run_decide(args, metric: str, note: str) -> None:
+    """The reference computes ONE scalar HPA decision per object per 10 s
+    tick (pkg/autoscaler/autoscaler.go:81-113). Here the whole fleet's
+    decisions — per-metric recommendation, select policy, stabilization
+    window, Count/Percent rate-limit policies, min/max bounds — run as one
+    device call (ops/decision.decide_jit)."""
+    import jax
+
+    from karpenter_tpu.ops.decision import decide_jit
+    from karpenter_tpu.parallel.mesh import example_decision_inputs
+
+    print(
+        f"backend={jax.default_backend()} devices={jax.devices()}",
+        file=sys.stderr,
+    )
+    inputs = jax.device_put(
+        example_decision_inputs(N=args.decide, M=4, seed=args.seed)
+    )
+    jax.block_until_ready(inputs)
+    t0 = time.perf_counter()
+    jax.block_until_ready(decide_jit(inputs))
+    print(
+        f"first call (compile+run): {(time.perf_counter() - t0) * 1e3:.1f} ms",
+        file=sys.stderr,
+    )
+    times = []
+    for _ in range(args.iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(decide_jit(inputs))
+        times.append((time.perf_counter() - t0) * 1e3)
+    p50 = float(np.percentile(times, 50))
+    dps = args.decide * 1000.0 / p50 if p50 else 0.0
+    print(
+        f"p50={p50:.2f}ms p95={float(np.percentile(times, 95)):.2f}ms "
+        f"autoscaler decisions/sec={dps:.0f}",
+        file=sys.stderr,
+    )
+    extra = f"{dps:.0f} autoscaler decisions/sec"
+    emit(
+        f"{metric} ({jax.default_backend()})",
+        p50,
+        note=f"{note}; {extra}" if note else extra,
+    )
 
 
 def run_mesh(args, metric: str) -> None:

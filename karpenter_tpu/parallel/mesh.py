@@ -340,15 +340,26 @@ def dryrun_fleet_step(n_devices: int) -> None:
     """Compile + execute one full sharded tick on an n-device mesh.
 
     Used by __graft_entry__.dryrun_multichip: proves the pods×groups
-    shardings compile and run without n real chips.
+    shardings compile and run without n real chips. Inputs carry
+    pod_weight (deduplicated shape rows) because that is what the
+    production encoder always emits — the artifact must cover the
+    weighted sharded program.
     """
+    import dataclasses
+
     mesh = build_mesh(n_devices=n_devices)
     d_in = shard_decision_inputs(mesh, example_decision_inputs(N=16, M=4))
+    weights = np.ones(32, np.int32)
+    weights[:4] = 5  # a few multiplied shape rows: 48 pods in 32 rows
     b_in = shard_binpack_inputs(
-        mesh, example_binpack_inputs(P_=32, T=8, K=8, L=8)
+        mesh,
+        dataclasses.replace(
+            example_binpack_inputs(P_=32, T=8, K=8, L=8),
+            pod_weight=jnp.asarray(weights),
+        ),
     )
     d_out, b_out = fleet_step(d_in, b_in, buckets=8)
     jax.block_until_ready((d_out, b_out))
     # sanity: padding rows decided nothing, real rows produced finite output
-    assert int(jnp.sum(b_out.assigned_count)) + int(b_out.unschedulable) == 32
+    assert int(jnp.sum(b_out.assigned_count)) + int(b_out.unschedulable) == 48
     assert d_out.desired.shape[0] == 16

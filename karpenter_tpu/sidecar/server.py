@@ -134,6 +134,16 @@ def main(argv=None) -> None:
     )
     args = ap.parse_args(argv)
 
+    # the sidecar exists to own the TPU, but a hung accelerator tunnel must
+    # degrade to CPU service (logged loudly), not a frozen gRPC server
+    from karpenter_tpu.utils.backend import ensure_usable_backend
+
+    note = ensure_usable_backend()
+    if note:
+        import sys
+
+        print(f"sidecar backend: {note}", file=sys.stderr)
+
     if args.warmup_pods:
         import jax
 

@@ -121,18 +121,29 @@ class Store:
     # -- CRUD -------------------------------------------------------------
 
     def create(self, obj):
+        """Persist a copy of obj. Like controller-runtime's Create, the
+        CALLER's object is stamped in place with the minted identity
+        (uid, creationTimestamp, resourceVersion) and returned — one
+        clone per create, on the watch-fan-out hot path."""
         with self._lock:
             key = _key(obj)
             if key in self._objects:
                 raise ConflictError(f"{key} already exists")
-            obj = fast_clone(obj)
+            # ALWAYS mint a fresh incarnation (apiserver semantics: the
+            # server assigns uid/creationTimestamp on create, whatever the
+            # request carried) — a caller re-creating with an object from a
+            # previous incarnation must not resurrect its uid. Recovered
+            # objects keep theirs via the WAL restore path, never create().
+            obj.metadata.uid = ""
+            obj.metadata.creation_timestamp = 0.0
             obj.metadata.ensure_identity()
             self._rv += 1
             obj.metadata.resource_version = self._rv
-            self._objects[key] = obj
-            self._index_add(obj)
-            self._notify(ADDED, obj)
-            return fast_clone(obj)
+            stored = fast_clone(obj)
+            self._objects[key] = stored
+            self._index_add(stored)
+            self._notify(ADDED, stored)
+            return obj
 
     def get(self, kind: str, namespace: str, name: str):
         with self._lock:
@@ -167,15 +178,15 @@ class Store:
                     f"{stored.metadata.resource_version}"
                 )
             self._index_remove(stored)
-            obj = fast_clone(obj)
             self._rv += 1
             obj.metadata.resource_version = self._rv
             obj.metadata.uid = stored.metadata.uid
             obj.metadata.creation_timestamp = stored.metadata.creation_timestamp
-            self._objects[key] = obj
-            self._index_add(obj)
-            self._notify(MODIFIED, obj)
-            return fast_clone(obj)
+            new = fast_clone(obj)
+            self._objects[key] = new
+            self._index_add(new)
+            self._notify(MODIFIED, new)
+            return obj
 
     def patch_status(self, obj):
         """Merge-patch ONLY the status subtree onto the stored object,

@@ -60,11 +60,20 @@ class TestCrud:
     def test_update_bumps_rv_preserves_identity(self):
         store = Store()
         created = store.create(sng(replicas=1))
+        # create/update stamp identity on the CALLER's object (like
+        # controller-runtime), so capture the pre-update rv for comparison
+        uid, rv0 = created.metadata.uid, created.metadata.resource_version
         created.spec.replicas = 5
         updated = store.update(created)
         assert updated.spec.replicas == 5
-        assert updated.metadata.uid == created.metadata.uid
-        assert updated.metadata.resource_version > created.metadata.resource_version
+        assert updated.metadata.uid == uid
+        assert updated.metadata.resource_version > rv0
+        # the caller's mutations after update never reach the store
+        updated.spec.replicas = 99
+        assert (
+            store.get("ScalableNodeGroup", "default", "group").spec.replicas
+            == 5
+        )
 
     def test_patch_status_does_not_clobber_spec(self):
         store = Store()
@@ -178,3 +187,21 @@ class TestScaleSubresource:
         store = Store()
         with pytest.raises(NotFoundError):
             store.get_scale("HorizontalAutoscaler", "default", "x")
+
+
+class TestIncarnationIdentity:
+    def test_recreate_mints_fresh_uid(self):
+        """create() stamps identity on the caller's object; re-creating
+        with a retained (already-stamped) object after a delete must mint
+        a NEW incarnation — uid distinguishes delete+recreate from update
+        (the k8s uid contract)."""
+        store = Store()
+        obj = store.create(sng(replicas=1))
+        first_uid = obj.metadata.uid
+        first_created = obj.metadata.creation_timestamp
+        assert first_uid
+        store.delete("ScalableNodeGroup", "default", "group")
+        recreated = store.create(obj)  # same retained instance
+        assert recreated.metadata.uid
+        assert recreated.metadata.uid != first_uid
+        assert recreated.metadata.creation_timestamp >= first_created
