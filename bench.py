@@ -95,6 +95,48 @@ def build_inputs(pods: int, types: int, taints: int, labels: int, seed: int):
     )
 
 
+def build_multicluster_inputs(
+    pods: int, clusters: int, types_per_cluster: int,
+    taints: int, labels: int, seed: int, flex_fraction: float = 0.3,
+):
+    """BASELINE.json config 5: spot-interruption re-pack across clusters.
+
+    K clusters each contribute types_per_cluster node groups carrying a
+    cluster-identity label (first K slots of the label universe). Pods are
+    spot-interruption refugees: 70% must stay in their home cluster
+    (required cluster label — the nodeSelector a real multi-cluster
+    scheduler would stamp), 30% are flexible and may re-pack anywhere.
+    Same solver, same encoding — the cluster boundary IS a label
+    constraint, so multi-cluster costs nothing extra on device.
+    """
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    types = clusters * types_per_cluster
+    base = build_inputs(pods, types, taints, labels, seed)
+    rng = np.random.default_rng(seed + 1)
+
+    group_labels = np.asarray(base.group_labels).copy()
+    group_labels[:, :clusters] = False
+    for c in range(clusters):
+        group_labels[
+            c * types_per_cluster : (c + 1) * types_per_cluster, c
+        ] = True
+
+    pod_required = np.asarray(base.pod_required).copy()
+    pod_required[:, :clusters] = False
+    home = rng.integers(0, clusters, pods)
+    pinned = rng.random(pods) >= flex_fraction
+    pod_required[np.arange(pods)[pinned], home[pinned]] = True
+
+    return dataclasses.replace(
+        base,
+        group_labels=jnp.asarray(group_labels),
+        pod_required=jnp.asarray(pod_required),
+    )
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--pods", type=int, default=100_000)
@@ -120,6 +162,15 @@ def main() -> None:
     )
     ap.add_argument("--probe-timeout", type=float, default=120.0)
     ap.add_argument("--probe-retries", type=int, default=2)
+    ap.add_argument(
+        "--clusters",
+        type=int,
+        default=0,
+        metavar="K",
+        help="multi-cluster re-pack (BASELINE config 5): K clusters of "
+        "--types node groups each; 70%% of pods pinned to their home "
+        "cluster via required labels, 30%% free to re-pack across",
+    )
     ap.add_argument(
         "--decide",
         type=int,
@@ -163,6 +214,12 @@ def main() -> None:
             f"{args.types} node groups (full solve_pending: profile"
             f" + snapshot + encode + transfer + solve + status)"
         )
+    elif args.clusters:
+        metric = (
+            f"multi-cluster re-pack p50 latency, {args.pods} pods across "
+            f"{args.clusters} clusters x {args.types} instance types each "
+            f"(70% cluster-pinned, 30% flexible)"
+        )
     else:
         metric = (
             f"pending-pods bin-pack p50 latency, "
@@ -201,9 +258,16 @@ def run(args, metric: str, note: str) -> None:
         f"backend={jax.default_backend()} devices={jax.devices()}",
         file=sys.stderr,
     )
-    inputs = build_inputs(
-        args.pods, args.types, args.taints, args.labels, args.seed
-    )
+    if args.clusters:
+        inputs = build_multicluster_inputs(
+            args.pods, args.clusters, args.types,
+            max(args.taints, 8), max(args.labels, args.clusters + 8),
+            args.seed,
+        )
+    else:
+        inputs = build_inputs(
+            args.pods, args.types, args.taints, args.labels, args.seed
+        )
     inputs = jax.device_put(inputs)
     jax.block_until_ready(inputs)
 

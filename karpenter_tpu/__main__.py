@@ -112,15 +112,15 @@ def main(argv=None) -> int:
     args = parse_args(argv)
     log_setup(verbose=args.verbose)
 
-    if not args.solver_uri:
-        # the in-process solver will jit on this process's default backend;
-        # an unreachable TPU must degrade to CPU decisions, not freeze the
-        # control plane at its first solve (utils/backend.py rationale)
-        from karpenter_tpu.utils.backend import ensure_usable_backend
+    # the batched HPA decision kernel ALWAYS runs in-process (only the
+    # bin-pack is optionally routed to a sidecar), so an unreachable TPU
+    # must degrade to CPU decisions unconditionally — not freeze the
+    # control plane at its first jit (utils/backend.py rationale)
+    from karpenter_tpu.utils.backend import ensure_usable_backend
 
-        note = ensure_usable_backend()
-        if note:
-            print(f"solver backend: {note}", file=sys.stderr)
+    note = ensure_usable_backend()
+    if note:
+        print(f"decision backend: {note}", file=sys.stderr)
 
     store = None
     if args.apiserver:

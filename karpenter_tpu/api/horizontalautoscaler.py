@@ -270,6 +270,11 @@ class HorizontalAutoscaler:
         )
 
     def validate(self) -> None:
+        # spec-driven algorithm selection (annotation; the registry lives
+        # with the algorithms) — unknown names rejected at admission
+        from karpenter_tpu.autoscaler.algorithms import validate_algorithm
+
+        validate_algorithm(self)
         if self.spec.max_replicas < self.spec.min_replicas:
             raise ValueError(
                 "maxReplicas cannot be less than minReplicas "

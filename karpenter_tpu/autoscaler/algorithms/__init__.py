@@ -1,15 +1,34 @@
 """Autoscaling algorithms (reference: pkg/autoscaler/algorithms/algorithm.go:24-40).
 
-The Algorithm seam is where the reference intended pluggable decision
-backends; in the TPU build the default backend is the batched device kernel
-(karpenter_tpu.ops.decision) and the scalar Proportional here serves as the
-per-object fallback and the golden oracle for kernel tests.
+The reference hardcodes Proportional and leaves spec-driven selection as a
+TODO (algorithm.go:37-39). Here the seam is REAL: algorithms register by
+name, a HorizontalAutoscaler selects one with the
+`autoscaling.karpenter.sh/algorithm` annotation (annotation, not a spec
+field, so the CRD schema stays reference-compatible), and unknown names are
+rejected at admission.
+
+TPU-first composition: the batched device kernel (karpenter_tpu.ops.decision)
+natively implements Proportional's HPA semantics for the whole fleet in one
+call. A row that selects a CUSTOM algorithm still rides the same batch —
+the algorithm computes per-metric replica recommendations on host, and the
+snapshot encodes them as AverageValue metrics with target 1 (the kernel's
+AverageValue rule is ceil(value/target), so the recommendation passes
+through exactly) — select policy, stabilization windows, Count/Percent
+rate-limit policies, and min/max bounds then apply uniformly ON DEVICE for
+default and custom rows alike.
+
+The scalar Proportional here also serves as the golden oracle for kernel
+tests.
 """
 
 from dataclasses import dataclass, field
-from typing import Dict
+from typing import Callable, Dict
 
 from karpenter_tpu.autoscaler.algorithms.proportional import Proportional
+
+# annotation on the HorizontalAutoscaler selecting the algorithm by name
+ALGORITHM_ANNOTATION = "autoscaling.karpenter.sh/algorithm"
+DEFAULT_ALGORITHM = "proportional"
 
 
 @dataclass
@@ -23,9 +42,69 @@ class Metric:
     labels: Dict[str, str] = field(default_factory=dict)
 
 
-def for_spec(spec) -> Proportional:
-    """reference: algorithm.go:36-40 (hardcoded Proportional for now)."""
-    return Proportional()
+_registry: Dict[str, Callable[[], object]] = {}
 
 
-__all__ = ["Metric", "Proportional", "for_spec"]
+def register_algorithm(name: str, factory: Callable[[], object]) -> None:
+    """Register an Algorithm factory; instances must provide
+    get_desired_replicas(metric, replicas) -> int (algorithm.go:24-26)."""
+    _registry[name] = factory
+
+
+def known_algorithms() -> list:
+    return sorted(_registry)
+
+
+def algorithm_name(ha) -> str:
+    """The algorithm a HorizontalAutoscaler selects (default proportional)."""
+    return (
+        ha.metadata.annotations.get(ALGORITHM_ANNOTATION, DEFAULT_ALGORITHM)
+        if getattr(ha, "metadata", None) is not None
+        else DEFAULT_ALGORITHM
+    )
+
+
+def _resolve(name: str) -> Callable[[], object]:
+    """ONE unknown-name error for both admission and reconcile paths."""
+    factory = _registry.get(name)
+    if factory is None:
+        raise ValueError(
+            f"unknown algorithm {name!r} in annotation "
+            f"{ALGORITHM_ANNOTATION}; known: {', '.join(known_algorithms())}"
+        )
+    return factory
+
+
+def validate_algorithm(ha) -> None:
+    """Admission-time check: an unknown algorithm name must be rejected
+    when the object is written, not discovered at reconcile time."""
+    _resolve(algorithm_name(ha))
+
+
+def for_spec(ha_or_none=None):
+    """Resolve the Algorithm instance for a HorizontalAutoscaler.
+
+    reference: algorithm.go:36-40 hardcodes Proportional "until we
+    implement a means to select via the spec"; this implements it.
+    """
+    name = (
+        algorithm_name(ha_or_none)
+        if ha_or_none is not None
+        else DEFAULT_ALGORITHM
+    )
+    return _resolve(name)()
+
+
+register_algorithm(DEFAULT_ALGORITHM, Proportional)
+
+__all__ = [
+    "ALGORITHM_ANNOTATION",
+    "DEFAULT_ALGORITHM",
+    "Metric",
+    "Proportional",
+    "algorithm_name",
+    "for_spec",
+    "known_algorithms",
+    "register_algorithm",
+    "validate_algorithm",
+]

@@ -71,22 +71,54 @@ class BatchAutoscaler:
     # -- snapshot ---------------------------------------------------------
 
     def _snapshot_row(self, ha: HorizontalAutoscaler) -> _Row:
+        from karpenter_tpu.autoscaler import algorithms
+
         row = _Row(ha=ha, scale=None, values=[], targets=[], types=[])
         try:
+            ref = ha.spec.scale_target_ref
+            row.scale = self.store.get_scale(
+                ref.kind, ha.metadata.namespace, ref.name
+            )
+            # spec-driven algorithm selection (the seam the reference left
+            # as a TODO, algorithm.go:37-39): default rows encode raw
+            # metrics for the kernel's native Proportional math; a custom
+            # algorithm computes per-metric recommendations on host, which
+            # enter the batch as AverageValue/target-1 metrics (the kernel
+            # passes them through exactly) so select policy, stabilization,
+            # rate-limit policies, and bounds still apply ON DEVICE
+            name = algorithms.algorithm_name(ha)
+            custom = (
+                algorithms.for_spec(ha)
+                if name != algorithms.DEFAULT_ALGORITHM
+                else None
+            )
             for metric_spec in ha.spec.metrics:
                 observed = self.metrics.for_metric(metric_spec).get_current_value(
                     metric_spec
                 )
                 target = metric_spec.get_target()
-                row.values.append(observed.value)
-                row.targets.append(target.target_value())
-                row.types.append(
-                    _TYPE_CODES.get(target.type, D.TYPE_UNKNOWN)
-                )
-            ref = ha.spec.scale_target_ref
-            row.scale = self.store.get_scale(
-                ref.kind, ha.metadata.namespace, ref.name
-            )
+                if custom is not None:
+                    metric = algorithms.Metric(
+                        value=observed.value,
+                        target_type=target.type,
+                        target_value=target.target_value(),
+                        name=getattr(observed, "name", ""),
+                    )
+                    row.values.append(
+                        float(
+                            custom.get_desired_replicas(
+                                metric, row.scale.status_replicas
+                            )
+                        )
+                    )
+                    row.targets.append(1.0)
+                    row.types.append(D.TYPE_AVERAGE_VALUE)
+                else:
+                    row.values.append(observed.value)
+                    row.targets.append(target.target_value())
+                    row.types.append(
+                        _TYPE_CODES.get(target.type, D.TYPE_UNKNOWN)
+                    )
         except Exception as e:  # noqa: BLE001 - row-isolated failure
             row.error = e
         return row

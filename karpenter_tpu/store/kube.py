@@ -556,15 +556,26 @@ class KubeStore:
 
     # -- writes: the apiserver ----------------------------------------------
 
+    @staticmethod
+    def _stamp(obj, written):
+        """Mirror the local Store's contract (store.py create/update): the
+        CALLER's object is stamped with the server-assigned identity, so
+        code that ignores the return value behaves identically against the
+        in-memory store and a real apiserver."""
+        obj.metadata.uid = written.metadata.uid
+        obj.metadata.resource_version = written.metadata.resource_version
+        obj.metadata.creation_timestamp = written.metadata.creation_timestamp
+        return written
+
     def create(self, obj):
         if isinstance(obj, Lease):
             return self.client.create_lease(obj)
-        return self.client.create(obj)
+        return self._stamp(obj, self.client.create(obj))
 
     def update(self, obj):
         if isinstance(obj, Lease):
             return self.client.update_lease(obj)
-        return self.client.update(obj)
+        return self._stamp(obj, self.client.update(obj))
 
     def patch_status(self, obj):
         # the mirror holds the last-known upstream status: keys it has that

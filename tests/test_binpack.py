@@ -393,3 +393,33 @@ class TestWeightedDedup:
         out = B.binpack(inputs, buckets=8)
         assert out.assigned_count.tolist() == [8]
         assert out.nodes_needed.tolist() == [1]
+
+
+class TestMultiClusterRepack:
+    def test_pinned_pods_stay_home_flexible_cross(self):
+        """BASELINE config 5 (bench.py --clusters): the cluster boundary
+        is a required-label constraint — pinned pods must land on their
+        home cluster's groups; flexible pods may re-pack anywhere."""
+        import bench
+
+        pods, clusters, tpc = 600, 4, 5
+        inputs = bench.build_multicluster_inputs(
+            pods, clusters, tpc, taints=8, labels=12, seed=3
+        )
+        out = B.binpack(inputs, buckets=16)
+        assigned = np.asarray(out.assigned)
+        required = np.asarray(inputs.pod_required)
+        crossed = 0
+        for p in range(pods):
+            t = int(assigned[p])
+            if t < 0:
+                continue
+            cluster_of_group = t // tpc
+            pinned_to = np.flatnonzero(required[p, :clusters])
+            if len(pinned_to):
+                assert cluster_of_group == int(pinned_to[0]), (
+                    p, t, pinned_to
+                )
+            elif cluster_of_group != 0:
+                crossed += 1
+        assert crossed > 0  # flexible pods actually used other clusters

@@ -574,3 +574,102 @@ class TestValidationGate:
         active = mp.status_conditions().get(cond.ACTIVE)
         assert active.status == cond.FALSE
         assert "exactly one node selector" in active.message
+
+
+class TestAlgorithmSelection:
+    """Spec-driven algorithm selection — the seam the reference leaves as
+    a TODO (algorithm.go:37-39). Custom algorithms compute per-metric
+    recommendations on host; the batched kernel still applies select
+    policy, stabilization, rate-limit policies, and bounds on device."""
+
+    def test_custom_algorithm_rides_the_batch(self, env):
+        from karpenter_tpu.autoscaler import algorithms
+
+        class Fixed17:
+            def get_desired_replicas(self, metric, replicas):
+                return 17
+
+        algorithms.register_algorithm("fixed17", Fixed17)
+        try:
+            runtime, provider, clock = env
+            name = "custom-algo"
+            gauge = runtime.registry.register("reserved_capacity",
+                                              "cpu_utilization")
+            gauge.set(name, "default", 0.85)
+            provider.node_replicas[name] = 5
+            runtime.store.create(sng_of(name, replicas=5))
+            ha_obj = utilization_ha(name, queries=(
+                "karpenter_reserved_capacity_cpu_utilization",))
+            ha_obj.metadata.annotations[
+                algorithms.ALGORITHM_ANNOTATION
+            ] = "fixed17"
+            runtime.store.create(ha_obj)
+
+            runtime.manager.reconcile_all()
+            _, ha = all_happy(runtime.store, ha_obj)
+            # proportional would say 8 (0.85/0.60 * 5); fixed17 says 17,
+            # and the kernel's bounds clamp [3, 23] passes it through
+            assert ha.status.desired_replicas == 17
+        finally:
+            algorithms._registry.pop("fixed17", None)
+
+    def test_custom_algorithm_still_bounded_by_kernel(self, env):
+        from karpenter_tpu.autoscaler import algorithms
+
+        class Huge:
+            def get_desired_replicas(self, metric, replicas):
+                return 1000
+
+        algorithms.register_algorithm("huge", Huge)
+        try:
+            runtime, provider, clock = env
+            name = "bounded-algo"
+            gauge = runtime.registry.register("reserved_capacity",
+                                              "cpu_utilization")
+            gauge.set(name, "default", 0.5)
+            provider.node_replicas[name] = 5
+            runtime.store.create(sng_of(name, replicas=5))
+            ha_obj = utilization_ha(name, queries=(
+                "karpenter_reserved_capacity_cpu_utilization",))
+            ha_obj.metadata.annotations[
+                algorithms.ALGORITHM_ANNOTATION
+            ] = "huge"
+            runtime.store.create(ha_obj)
+
+            runtime.manager.reconcile_all()
+            _, ha = all_happy(runtime.store, ha_obj)
+            assert ha.status.desired_replicas == 23  # max_replicas clamp
+            unbounded = [
+                c for c in ha.status.conditions
+                if c.type == "ScalingUnbounded"
+            ]
+            assert unbounded and unbounded[0].status == "False"
+        finally:
+            algorithms._registry.pop("huge", None)
+
+    def test_unknown_algorithm_rejected_at_admission(self, env):
+        from karpenter_tpu.autoscaler import algorithms
+
+        runtime, provider, clock = env
+        ha_obj = utilization_ha("bad-algo")
+        ha_obj.metadata.annotations[
+            algorithms.ALGORITHM_ANNOTATION
+        ] = "does-not-exist"
+        with pytest.raises(ValueError, match="unknown algorithm"):
+            ha_obj.validate()
+
+    def test_default_rows_unchanged(self, env):
+        """No annotation -> the kernel's native Proportional math; the
+        canonical 85%/60%/5 -> 8 case must be untouched by the seam."""
+        runtime, provider, clock = env
+        name = "default-algo"
+        gauge = runtime.registry.register("reserved_capacity",
+                                          "cpu_utilization")
+        gauge.set(name, "default", 0.85)
+        provider.node_replicas[name] = 5
+        runtime.store.create(sng_of(name, replicas=5))
+        runtime.store.create(utilization_ha(name, queries=(
+            "karpenter_reserved_capacity_cpu_utilization",)))
+        runtime.manager.reconcile_all()
+        _, ha = all_happy(runtime.store, utilization_ha(name))
+        assert ha.status.desired_replicas == 8

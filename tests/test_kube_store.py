@@ -56,6 +56,17 @@ def wait_for(predicate, timeout=5.0):
 
 
 class TestCrud:
+    def test_create_stamps_callers_object(self, kube):
+        """Same contract as the local Store (store.py create/update): the
+        caller's object gets the server-assigned identity in place, so
+        return-value-ignoring code behaves identically on both stores."""
+        obj = sng(replicas=3, name="stamped")
+        kube.create(obj)
+        assert obj.metadata.uid
+        assert obj.metadata.resource_version
+        obj.spec.replicas = 4
+        kube.update(obj)  # carries the stamped rv: no conflict
+
     def test_create_echoes_into_mirror(self, kube):
         created = kube.create(sng(replicas=3))
         assert created.metadata.resource_version > 0
