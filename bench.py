@@ -163,6 +163,15 @@ def main() -> None:
     ap.add_argument("--probe-timeout", type=float, default=120.0)
     ap.add_argument("--probe-retries", type=int, default=2)
     ap.add_argument(
+        "--slices",
+        type=int,
+        default=1,
+        metavar="S",
+        help="with --mesh: model S TPU slices (3D slice x pods x groups "
+        "mesh; pod rows shard across slices, the one histogram reduction "
+        "rides DCN)",
+    )
+    ap.add_argument(
         "--clusters",
         type=int,
         default=0,
@@ -196,6 +205,14 @@ def main() -> None:
     )
     args = ap.parse_args()
 
+    if args.clusters and (args.mesh or args.e2e or args.decide):
+        ap.error(
+            "--clusters models its own workload (BASELINE config 5) and "
+            "cannot combine with --mesh/--e2e/--decide; run it standalone"
+        )
+    if args.slices > 1 and not args.mesh:
+        ap.error("--slices requires --mesh")
+
     if args.decide:
         metric = (
             f"batched HPA decision kernel p50 latency, fleet of "
@@ -203,9 +220,14 @@ def main() -> None:
             f"select policy + stabilization + rate-limit policies + bounds)"
         )
     elif args.mesh:
+        shape = (
+            f"{args.slices}-slice x pods x groups"
+            if args.slices > 1
+            else "pods x groups"
+        )
         metric = (
             f"sharded bin-pack p50 latency over a {args.mesh}-device "
-            f"pods x groups mesh, {args.pods} pods x {args.types} "
+            f"{shape} mesh, {args.pods} pods x {args.types} "
             f"instance types (outputs == single-device)"
         )
     elif args.e2e:
@@ -387,7 +409,7 @@ def run_mesh(args, metric: str) -> None:
             error=f"only {len(jax.devices())} devices available",
         )
         return
-    mesh = build_mesh(n_devices=args.mesh)
+    mesh = build_mesh(n_devices=args.mesh, slices=args.slices)
     print(f"mesh: {dict(mesh.shape)} on {jax.default_backend()}", file=sys.stderr)
     inputs = build_inputs(
         args.pods, args.types, args.taints, args.labels, args.seed

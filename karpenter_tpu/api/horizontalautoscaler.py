@@ -254,6 +254,19 @@ class HorizontalAutoscalerStatus:
     conditions: List[Condition] = field(default_factory=list)
 
 
+# Pluggable validation hooks (same pattern as the queue-validator registry,
+# api/metricsproducer.py): upper layers register checks the API layer cannot
+# know about — e.g. the autoscaler's algorithm registry validates the
+# `autoscaling.karpenter.sh/algorithm` annotation at admission. Keeps the
+# api package dependency-free.
+_validation_hooks = []
+
+
+def register_validation_hook(hook) -> None:
+    """hook(ha) raises ValueError to reject the object at admission."""
+    _validation_hooks.append(hook)
+
+
 @dataclass
 class HorizontalAutoscaler:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
@@ -270,11 +283,8 @@ class HorizontalAutoscaler:
         )
 
     def validate(self) -> None:
-        # spec-driven algorithm selection (annotation; the registry lives
-        # with the algorithms) — unknown names rejected at admission
-        from karpenter_tpu.autoscaler.algorithms import validate_algorithm
-
-        validate_algorithm(self)
+        for hook in _validation_hooks:
+            hook(self)
         if self.spec.max_replicas < self.spec.min_replicas:
             raise ValueError(
                 "maxReplicas cannot be less than minReplicas "

@@ -97,6 +97,16 @@ def for_spec(ha_or_none=None):
 
 register_algorithm(DEFAULT_ALGORITHM, Proportional)
 
+# admission wiring: the api layer exposes a hook registry (it cannot import
+# this package — that would invert the layering); importing the algorithms
+# package is what arms the annotation check, and every control-plane entry
+# point does (runtime -> autoscaler -> algorithms)
+from karpenter_tpu.api.horizontalautoscaler import (  # noqa: E402
+    register_validation_hook,
+)
+
+register_validation_hook(validate_algorithm)
+
 __all__ = [
     "ALGORITHM_ANNOTATION",
     "DEFAULT_ALGORITHM",

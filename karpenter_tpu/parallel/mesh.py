@@ -48,6 +48,7 @@ from karpenter_tpu.utils.functional import pad_to_multiple as _pad_dim
 
 AXIS_PODS = "pods"
 AXIS_GROUPS = "groups"
+AXIS_SLICE = "slice"  # cross-slice (DCN) axis in multi-host deployments
 
 
 def factorize(n: int) -> Tuple[int, int]:
@@ -64,8 +65,21 @@ def factorize(n: int) -> Tuple[int, int]:
 
 
 def build_mesh(
-    n_devices: Optional[int] = None, devices: Optional[Sequence] = None
+    n_devices: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+    slices: int = 1,
 ) -> Mesh:
+    """2D pods×groups mesh, or 3D slice×pods×groups when slices > 1.
+
+    The slice axis models multi-host scale-out across TPU slices: pod
+    rows shard over (slice, pods) — the per-tick histogram reduction is
+    the ONE collective that crosses slices, so it rides DCN exactly once
+    per solve, while the groups axis (feasibility matmul partners) stays
+    inside a slice on ICI. On a single slice, pass slices=1 (default;
+    identical to the 2D mesh). jax.distributed deployments hand the
+    flattened global device list here; virtual CPU devices stand in for
+    tests and the driver dryrun.
+    """
     devices = list(devices if devices is not None else jax.devices())
     if n_devices is not None:
         if len(devices) < n_devices:
@@ -73,9 +87,25 @@ def build_mesh(
                 f"need {n_devices} devices, have {len(devices)}"
             )
         devices = devices[:n_devices]
-    pods, groups = factorize(len(devices))
+    n = len(devices)
+    if slices > 1:
+        if n % slices:
+            raise ValueError(f"{n} devices not divisible into {slices} slices")
+        pods, groups = factorize(n // slices)
+        dev_array = np.array(devices).reshape(slices, pods, groups)
+        return Mesh(dev_array, (AXIS_SLICE, AXIS_PODS, AXIS_GROUPS))
+    pods, groups = factorize(n)
     dev_array = np.array(devices).reshape(pods, groups)
     return Mesh(dev_array, (AXIS_PODS, AXIS_GROUPS))
+
+
+def _row_axes(mesh: Mesh):
+    """The mesh axes the row (pods / fleet) dimension shards over."""
+    return (
+        (AXIS_SLICE, AXIS_PODS)
+        if AXIS_SLICE in mesh.shape
+        else AXIS_PODS
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -92,15 +122,16 @@ def binpack_shardings(mesh: Mesh, with_weight: bool = False) -> BinPackInputs:
     pods axis like every other row-major array.
     """
     s = lambda *spec: NamedSharding(mesh, P(*spec))
+    rows = _row_axes(mesh)  # (slice, pods) on a 3D multi-host mesh
     return BinPackInputs(
-        pod_requests=s(AXIS_PODS, None),
-        pod_valid=s(AXIS_PODS),
-        pod_intolerant=s(AXIS_PODS, None),
-        pod_required=s(AXIS_PODS, None),
+        pod_requests=s(rows, None),
+        pod_valid=s(rows),
+        pod_intolerant=s(rows, None),
+        pod_required=s(rows, None),
         group_allocatable=s(AXIS_GROUPS, None),
         group_taints=s(AXIS_GROUPS, None),
         group_labels=s(AXIS_GROUPS, None),
-        pod_weight=s(AXIS_PODS) if with_weight else None,
+        pod_weight=s(rows) if with_weight else None,
     )
 
 
@@ -109,8 +140,9 @@ def decision_shardings(mesh: Mesh) -> DecisionInputs:
     axis N rides the "pods" mesh axis (the fleet is row-parallel; M metric
     columns are small and replicated)."""
     s = lambda *spec: NamedSharding(mesh, P(*spec))
-    row = s(AXIS_PODS)
-    mat = s(AXIS_PODS, None)
+    rows = _row_axes(mesh)
+    row = s(rows)
+    mat = s(rows, None)
     return DecisionInputs(
         metric_value=mat,
         target_value=mat,
@@ -149,7 +181,7 @@ def pad_binpack_inputs_for_mesh(
     allocatable, which `_feasibility` already rejects — masked, never
     truncated.
     """
-    p_extent = mesh.shape[AXIS_PODS]
+    p_extent = mesh.shape[AXIS_PODS] * mesh.shape.get(AXIS_SLICE, 1)
     g_extent = mesh.shape[AXIS_GROUPS]
     P0 = inputs.pod_requests.shape[0]
     T0 = inputs.group_allocatable.shape[0]
@@ -185,7 +217,7 @@ def pad_decision_inputs_for_mesh(
     """Grow the fleet axis N to a multiple of the pods mesh axis. Padding
     rows have no valid metrics, so they decide spec_replicas (a no-op) and
     max_replicas=0 keeps every derived flag benign."""
-    extent = mesh.shape[AXIS_PODS]
+    extent = mesh.shape[AXIS_PODS] * mesh.shape.get(AXIS_SLICE, 1)
     N0 = inputs.spec_replicas.shape[0]
     N1 = _pad_dim(N0, extent)
     if N1 == N0:

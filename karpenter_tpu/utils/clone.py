@@ -6,7 +6,9 @@ tick over 100k pods performs thousands of pod copies). copy.deepcopy pays
 for generality it doesn't need here — memo dicts, reduce/reconstruct
 protocol, cycle detection. API objects are trees of dataclasses, builtin
 containers, scalars, and immutable leaves, so a direct recursive rebuild
-with a per-class field cache is ~10x faster.
+is ~10x faster, and a COMPILED per-dataclass cloner (straight-line field
+assignments generated on first use) removes the per-field loop overhead
+on top of that.
 
 Semantics vs copy.deepcopy, by design:
 - Quantity instances are SHARED, not copied: Quantity is immutable by
@@ -22,35 +24,79 @@ from __future__ import annotations
 
 import copy
 from dataclasses import fields, is_dataclass
-from typing import Any, Dict, Tuple
+from typing import Any, Callable, Dict
 
 from karpenter_tpu.utils.quantity import Quantity
 
-_ATOMIC = (str, int, float, bool, type(None), bytes, Quantity)
 
-# per-dataclass field-name cache: (names tuple, uses __dict__)
-_FIELD_CACHE: Dict[type, Tuple[str, ...]] = {}
+def _identity(x: Any) -> Any:
+    return x
 
 
 def fast_clone(x: Any) -> Any:
-    t = x.__class__
-    if t in (str, int, float, bool, type(None), bytes, Quantity):
-        return x
-    if t is dict:
-        return {k: fast_clone(v) for k, v in x.items()}
-    if t is list:
-        return [fast_clone(v) for v in x]
-    if t is tuple:
-        return tuple(fast_clone(v) for v in x)
-    if t is set:
-        return {fast_clone(v) for v in x}
-    names = _FIELD_CACHE.get(t)
-    if names is None:
-        if not is_dataclass(x):
-            return copy.deepcopy(x)  # unknown type: full generality
-        names = tuple(f.name for f in fields(t))
-        _FIELD_CACHE[t] = names
-    new = object.__new__(t)
-    for name in names:
-        object.__setattr__(new, name, fast_clone(getattr(x, name)))
-    return new
+    cloner = _CLONERS.get(x.__class__)
+    if cloner is None:
+        cloner = _register_cloner(x.__class__)
+    return cloner(x)
+
+
+def _clone_dict(x: dict) -> dict:
+    return {k: fast_clone(v) for k, v in x.items()}
+
+
+def _clone_list(x: list) -> list:
+    return [fast_clone(v) for v in x]
+
+
+def _clone_tuple(x: tuple) -> tuple:
+    return tuple(fast_clone(v) for v in x)
+
+
+def _clone_set(x: set) -> set:
+    return {fast_clone(v) for v in x}
+
+
+# exact-class dispatch (subclasses take the registration path, so e.g. a
+# dict subclass is NOT silently flattened to a plain dict)
+_CLONERS: Dict[type, Callable[[Any], Any]] = {
+    str: _identity,
+    int: _identity,
+    float: _identity,
+    bool: _identity,
+    type(None): _identity,
+    bytes: _identity,
+    Quantity: _identity,  # immutable by contract: shared
+    dict: _clone_dict,
+    list: _clone_list,
+    tuple: _clone_tuple,
+    set: _clone_set,
+}
+
+
+def _register_cloner(cls: type) -> Callable[[Any], Any]:
+    """First encounter of a class: compile a straight-line cloner for
+    dataclasses (frozen ones assign via object.__setattr__, same trick
+    dataclasses' own __init__ uses), fall back to copy.deepcopy for
+    anything else."""
+    if is_dataclass(cls):
+        names = tuple(f.name for f in fields(cls))
+        frozen = cls.__dataclass_params__.frozen
+        assign = (
+            (lambda n: f"    _set(n, {n!r}, _c(x.{n}))")
+            if frozen
+            else (lambda n: f"    n.{n} = _c(x.{n})")
+        )
+        lines = [
+            "def _cloner(x, _new=object.__new__, _cls=_CLS, _c=fast_clone,"
+            " _set=object.__setattr__):",
+            "    n = _new(_cls)",
+            *[assign(name) for name in names],
+            "    return n",
+        ]
+        namespace = {"_CLS": cls, "fast_clone": fast_clone, "object": object}
+        exec("\n".join(lines), namespace)  # noqa: S102 — own class metadata
+        cloner = namespace["_cloner"]
+    else:
+        cloner = copy.deepcopy
+    _CLONERS[cls] = cloner
+    return cloner

@@ -26,6 +26,11 @@ from typing import List, Optional
 from urllib.parse import urlsplit
 
 from karpenter_tpu.api.serialization import from_manifest, to_dict
+
+# arm upper-layer validation hooks (e.g. the algorithm-annotation check the
+# autoscaler's registry contributes): an admission server must enforce the
+# same rules regardless of which process hosts it
+import karpenter_tpu.autoscaler.algorithms  # noqa: F401
 from karpenter_tpu.utils.log import logger
 
 log = logger()

@@ -204,3 +204,34 @@ class TestStoreCopyOnWrite:
         assert first.spec.replicas == 1  # frozen
         assert delivered[-1].spec.replicas == 5
         assert store.get("ScalableNodeGroup", "default", "s").spec.replicas == 5
+
+
+class TestDispatchEdges:
+    def test_container_subclasses_not_flattened(self):
+        """Exact-class dispatch: a dict subclass must keep its type (falls
+        back to deepcopy), not silently become a plain dict."""
+
+        class Labeled(dict):
+            pass
+
+        x = Labeled(a=[1, 2])
+        clone = fast_clone(x)
+        assert type(clone) is Labeled
+        clone["a"].append(3)
+        assert x["a"] == [1, 2]
+
+    def test_frozen_dataclass_on_fast_path(self):
+        """Frozen dataclasses clone via object.__setattr__ (no deepcopy
+        demotion): Quantity leaves inside them stay shared."""
+        import dataclasses
+
+        @dataclasses.dataclass(frozen=True)
+        class Frozen:
+            xs: list
+            q: Quantity
+
+        q = Quantity.parse("2")
+        f = Frozen(xs=[1], q=q)
+        clone = fast_clone(f)
+        assert clone.xs == [1] and clone.xs is not f.xs
+        assert clone.q is q

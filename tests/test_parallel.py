@@ -183,3 +183,40 @@ def test_sharded_weighted_binpack_matches_single_device(n_devices):
     np.testing.assert_array_equal(out.nodes_needed, ref.nodes_needed)
     np.testing.assert_array_equal(out.lp_bound, ref.lp_bound)
     assert int(out.unschedulable) == int(ref.unschedulable)
+
+
+def test_sliced_mesh_matches_single_device():
+    """3D slice×pods×groups mesh (multi-host DCN model): pod rows shard
+    over (slice, pods); outputs must equal the single-device solve, and
+    the decision kernel must shard its fleet axis the same way."""
+    import dataclasses
+
+    import jax.numpy as jnp
+    from karpenter_tpu.parallel.mesh import (
+        example_decision_inputs,
+        sharded_decide,
+    )
+    from karpenter_tpu.ops.decision import decide_jit
+
+    mesh = build_mesh(n_devices=8, slices=2)
+    assert dict(mesh.shape) == {"slice": 2, "pods": 2, "groups": 2}
+
+    rng = np.random.default_rng(33)
+    weighted = dataclasses.replace(
+        example_binpack_inputs(P_=45, T=6, K=8, L=8, seed=33),
+        pod_weight=jnp.asarray(rng.integers(1, 20, 45).astype(np.int32)),
+    )
+    ref = jax.device_get(binpack(weighted, buckets=8))
+    out = jax.device_get(sharded_binpack(mesh, weighted, buckets=8))
+    np.testing.assert_array_equal(out.assigned, ref.assigned)
+    np.testing.assert_array_equal(out.nodes_needed, ref.nodes_needed)
+    np.testing.assert_array_equal(out.lp_bound, ref.lp_bound)
+    assert int(out.unschedulable) == int(ref.unschedulable)
+
+    d_in = example_decision_inputs(N=19, M=3, seed=5)
+    d_ref = jax.device_get(decide_jit(d_in))
+    d_out = jax.device_get(sharded_decide(mesh, d_in))
+    np.testing.assert_array_equal(d_out.desired, d_ref.desired)
+    np.testing.assert_array_equal(
+        d_out.able_to_scale, d_ref.able_to_scale
+    )
