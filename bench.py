@@ -210,8 +210,14 @@ def main() -> None:
             "--clusters models its own workload (BASELINE config 5) and "
             "cannot combine with --mesh/--e2e/--decide; run it standalone"
         )
+    if args.slices < 1:
+        ap.error("--slices must be >= 1")
     if args.slices > 1 and not args.mesh:
         ap.error("--slices requires --mesh")
+    if args.slices > 1 and args.mesh % args.slices:
+        ap.error(
+            f"--mesh {args.mesh} not divisible into --slices {args.slices}"
+        )
 
     if args.decide:
         metric = (

@@ -75,6 +75,13 @@ def probe_default_backend(timeout: float = 120.0, retries: int = 2):
             )
             time.sleep(delay)
         probes += 1
+        env = dict(os.environ)
+        if env.get("JAX_PLATFORMS") == "cpu":
+            # this container's axon sitecustomize hangs a CPU-platform
+            # process unless the pool IPs are cleared (the documented
+            # env gotcha); when probing the real TPU the variable must
+            # stay — it is how the tunnel is reached
+            env["PALLAS_AXON_POOL_IPS"] = ""
         try:
             proc = subprocess.run(
                 [
@@ -86,6 +93,7 @@ def probe_default_backend(timeout: float = 120.0, retries: int = 2):
                 capture_output=True,
                 text=True,
                 timeout=timeout,
+                env=env,
             )
         except subprocess.TimeoutExpired:
             # a hang (unlike a raised UNAVAILABLE) has never been observed

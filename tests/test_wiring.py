@@ -170,3 +170,71 @@ class TestObservabilityFixes:
             assert ok == b"ok"
         finally:
             server.stop()
+
+
+class TestRealClusterModeCLI:
+    def test_main_against_fake_apiserver_converges(self):
+        """The FULL binary in real-cluster mode: `--apiserver` against the
+        protocol-faithful fake apiserver — CRDs written upstream are
+        mirrored in, reconciled, and their status/scale written back
+        through the REST path (the deployment mode config/ ships)."""
+        import json
+        import urllib.request
+
+        from fake_apiserver import FakeApiServer
+
+        server = FakeApiServer()
+        server.start()
+        try:
+            base = server.url
+
+            def post(kind_path, manifest):
+                req = urllib.request.Request(
+                    f"{base}{kind_path}",
+                    data=json.dumps(manifest).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req) as resp:
+                    return json.loads(resp.read())
+
+            post(
+                "/apis/autoscaling.karpenter.sh/v1alpha1/namespaces/"
+                "default/scalablenodegroups",
+                {
+                    "apiVersion": "autoscaling.karpenter.sh/v1alpha1",
+                    "kind": "ScalableNodeGroup",
+                    "metadata": {"name": "g", "namespace": "default"},
+                    "spec": {"replicas": 2, "type": "FakeNodeGroup",
+                             "id": "g"},
+                },
+            )
+            rc = cli_main(
+                [
+                    "--apiserver", base,
+                    "--kube-insecure",
+                    "--cloud-provider", "fake",
+                    "--duration", "2.0",
+                    "--tick", "0.05",
+                    "--metrics-port", "0",
+                    "--no-leader-elect",
+                ]
+            )
+            assert rc == 0
+            with urllib.request.urlopen(
+                f"{base}/apis/autoscaling.karpenter.sh/v1alpha1/"
+                "namespaces/default/scalablenodegroups/g"
+            ) as resp:
+                obj = json.loads(resp.read())
+            # the integration contract under test is the REST round trip:
+            # the CLI mirrored the upstream CRD in, reconciled it, and
+            # PATCHed status back. (Active is legitimately False here —
+            # the CLI's own fake provider has no replicas seeded for this
+            # group — so assert the loop, not provider configuration.)
+            conditions = {
+                c["type"]: c["status"]
+                for c in obj.get("status", {}).get("conditions", [])
+            }
+            assert conditions, obj  # status written upstream
+            assert "Active" in conditions and "Stabilized" in conditions
+        finally:
+            server.stop()
