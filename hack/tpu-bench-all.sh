@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Capture the full TPU measurement suite in one run (docs/BENCHMARKS.md
+# quotes these): solve on both backends, honest e2e, fleet decisions,
+# multi-cluster re-pack, and the 1M-pod configuration. Each line is one
+# JSON record on stdout; everything else goes to stderr.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+for args in \
+    "--backend pallas" \
+    "--backend xla" \
+    "--e2e" \
+    "--decide 100000" \
+    "--clusters 10 --types 30 --pods 100000" \
+    "--pods 1000000 --iters 5" \
+    ; do
+  echo "=== bench.py $args ===" >&2
+  # shellcheck disable=SC2086
+  python bench.py $args || echo "{\"error\": \"bench.py $args failed\"}"
+done
