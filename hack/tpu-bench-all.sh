@@ -1,10 +1,12 @@
 #!/usr/bin/env bash
-# Capture the full TPU measurement suite in one run (docs/BENCHMARKS.md
-# quotes these): solve on both backends, honest e2e, fleet decisions,
-# multi-cluster re-pack, and the 1M-pod configuration. Each line is one
-# JSON record on stdout; everything else goes to stderr.
+# Capture the full TPU measurement suite in one run, as input for
+# updating docs/BENCHMARKS.md: solve on both backends, honest e2e, fleet
+# decisions, multi-cluster re-pack, and the 1M-pod configuration. Each
+# line is one JSON record on stdout; everything else goes to stderr.
+# Exits nonzero if ANY configuration failed.
 set -uo pipefail
 cd "$(dirname "$0")/.."
+failures=0
 for args in \
     "--backend pallas" \
     "--backend xla" \
@@ -15,5 +17,9 @@ for args in \
     ; do
   echo "=== bench.py $args ===" >&2
   # shellcheck disable=SC2086
-  python bench.py $args || echo "{\"error\": \"bench.py $args failed\"}"
+  python bench.py $args || {
+    echo "{\"error\": \"bench.py $args failed\"}"
+    failures=$((failures + 1))
+  }
 done
+exit "$((failures > 0))"
