@@ -15,6 +15,7 @@ scale target) exclude that row from the batch without failing the others.
 from __future__ import annotations
 
 import datetime
+import math
 import time as _time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -27,7 +28,10 @@ from karpenter_tpu.api.horizontalautoscaler import (
     DISABLED_POLICY_SELECT,
     HorizontalAutoscaler,
     MIN_POLICY_SELECT,
+    MetricStatus,
+    MetricValueStatus,
     PERCENT_SCALING_POLICY,
+    PrometheusMetricStatus,
     UTILIZATION,
     VALUE,
 )
@@ -54,6 +58,9 @@ class _Row:
     values: List[float]
     targets: List[float]
     types: List[int]
+    # raw observations + spec target types, kept for status.currentMetrics
+    # even when a custom algorithm replaces `values` with recommendations
+    observed: List = field(default_factory=list)
     error: Optional[Exception] = None
 
 
@@ -97,6 +104,7 @@ class BatchAutoscaler:
                     metric_spec
                 )
                 target = metric_spec.get_target()
+                row.observed.append((metric_spec, target, observed.value))
                 if custom is not None:
                     metric = algorithms.Metric(
                         value=observed.value,
@@ -309,6 +317,15 @@ class BatchAutoscaler:
 
         ha.status.current_replicas = scale.status_replicas
 
+        # last-read state of every configured metric: the reference MODELS
+        # status.currentMetrics (horizontalautoscaler_status.go:36-39) but
+        # never populates it — here every reconcile records what it saw,
+        # slotted by the spec's own target type
+        ha.status.current_metrics = [
+            _metric_status(metric_spec, target, value)
+            for metric_spec, target, value in row.observed
+        ]
+
         if able:
             # a partial policy clamp still scales (just by less than
             # recommended), so AbleToScale stays true; the clamp itself is
@@ -350,6 +367,31 @@ class BatchAutoscaler:
         self.store.update_scale(ha.spec.scale_target_ref.kind, scale)
         ha.status.desired_replicas = desired
         ha.status.last_scale_time = now
+
+
+def _metric_status(metric_spec, target, value: float):
+    current = MetricValueStatus()
+    # a NaN/inf observation is legitimate (e.g. reserved-capacity over an
+    # empty node group, the reference's NaN case) — record NO value rather
+    # than poisoning the status document (json.dumps emits the non-standard
+    # NaN literal, which a real apiserver rejects, killing the whole
+    # status patch)
+    if not math.isfinite(value):
+        pass
+    elif target.type == UTILIZATION:
+        current.average_utilization = int(round(value * 100))
+    elif target.type == AVERAGE_VALUE:
+        current.average_value = value
+    else:
+        current.value = value
+    query = (
+        metric_spec.prometheus.query
+        if metric_spec.prometheus is not None
+        else ""
+    )
+    return MetricStatus(
+        prometheus=PrometheusMetricStatus(query=query, current=current)
+    )
 
 
 class AutoscalerFactory:

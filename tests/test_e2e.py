@@ -673,3 +673,28 @@ class TestAlgorithmSelection:
         runtime.manager.reconcile_all()
         _, ha = all_happy(runtime.store, utilization_ha(name))
         assert ha.status.desired_replicas == 8
+
+
+class TestCurrentMetricsStatus:
+    def test_status_records_last_read_metrics(self, env):
+        """The reference MODELS status.currentMetrics
+        (horizontalautoscaler_status.go:36-39) but never populates it;
+        here every reconcile records the observed value slotted by the
+        spec's target type."""
+        runtime, provider, clock = env
+        name = "metrics-status"
+        gauge = runtime.registry.register("reserved_capacity",
+                                          "cpu_utilization")
+        gauge.set(name, "default", 0.85)
+        provider.node_replicas[name] = 5
+        runtime.store.create(sng_of(name, replicas=5))
+        runtime.store.create(utilization_ha(name, queries=(
+            "karpenter_reserved_capacity_cpu_utilization",)))
+        runtime.manager.reconcile_all()
+        _, ha = all_happy(runtime.store, utilization_ha(name))
+        (status,) = ha.status.current_metrics
+        assert status.prometheus.query == (
+            f'karpenter_reserved_capacity_cpu_utilization{{name="{name}"}}'
+        )
+        assert status.prometheus.current.average_utilization == 85
+        assert status.prometheus.current.value is None
