@@ -35,6 +35,7 @@ from karpenter_tpu.api.horizontalautoscaler import (
     UTILIZATION,
     VALUE,
 )
+from karpenter_tpu.observability import solver_trace
 from karpenter_tpu.ops import decision as D
 from karpenter_tpu.store import NotFoundError, Store
 
@@ -302,7 +303,8 @@ class BatchAutoscaler:
             down_pperiod=down_pperiod,
             down_pvalid=down_pvalid,
         )
-        return D.decide_jit(inputs)
+        with solver_trace("autoscaler.decide"):
+            return D.decide_jit(inputs)
 
     def _apply(self, row: _Row, out: D.DecisionOutputs, i: int, now: float):
         """Write back one row's decision (reference: autoscaler.go:81-113,

@@ -41,6 +41,7 @@ from karpenter_tpu.api.core import (
 )
 from karpenter_tpu.api.metricsproducer import PendingCapacityStatus
 from karpenter_tpu.metrics.registry import GaugeRegistry, default_registry
+from karpenter_tpu.observability import solver_trace
 from karpenter_tpu.ops import binpack as B
 from karpenter_tpu.store.columnar import (
     BASE_RESOURCES,
@@ -430,7 +431,8 @@ def _dispatch_and_record(inputs, targets, registry, solver, errors=None) -> None
     # device-puts them itself, and a remote solver serializes host bytes —
     # wrapping in jnp here would force a device round-trip (and JAX init)
     # in the control-plane process the sidecar split exists to relieve
-    out = solver(inputs)
+    with solver_trace("pendingcapacity.solve"):
+        out = solver(inputs)
 
     # ONE device->host fetch for all four outputs: device_get still issues
     # a round-trip PER leaf (measured ~35 ms each through the network
