@@ -41,12 +41,31 @@ class Controller(Protocol):
 
 
 class Manager:
-    def __init__(self, store: Store, clock=_time.time):
+    def __init__(self, store: Store, clock=_time.time, registry=None):
         self.store = store
         self.clock = clock
         self._controllers: List[Controller] = []
         # (kind, namespace, name) -> next due time; 0 = due now
         self._due: Dict[tuple, float] = {}
+        # self-observability (the reference gets controller-runtime's
+        # metrics for free; here the manager publishes its own):
+        # karpenter_runtime_{tick_seconds,reconciles_total,
+        # reconcile_errors_total}{name=<kind>|manager}
+        self._tick_gauge = self._count_gauge = self._error_gauge = None
+        if registry is not None:
+            self._tick_gauge = registry.register("runtime", "tick_seconds")
+            self._count_gauge = registry.register(
+                "runtime", "reconciles_total", kind="counter"
+            )
+            self._error_gauge = registry.register(
+                "runtime", "reconcile_errors_total", kind="counter"
+            )
+
+    def _count(self, gauge, name: str, delta: float = 1.0) -> None:
+        # process-level series: namespace "-" keeps them distinct from
+        # object-namespace-labeled producer gauges on dashboards
+        if gauge is not None:
+            gauge.inc(name, "-", delta)
 
     def register(self, *controllers: Controller) -> "Manager":
         """reference: manager.go:59-71"""
@@ -79,6 +98,9 @@ class Manager:
             )
         else:
             mgr.mark_true(cond.ACTIVE)
+        self._count(self._count_gauge, obj.KIND)
+        if error is not None:
+            self._count(self._error_gauge, obj.KIND)
         try:
             self.store.patch_status(obj)
         except KeyError:
@@ -95,6 +117,7 @@ class Manager:
 
     def reconcile_all(self) -> None:
         """One manager tick: every due object of every controller."""
+        start = _time.perf_counter()
         now = self.clock()
         for controller in self._controllers:
             kind = controller.kind()
@@ -134,6 +157,11 @@ class Manager:
                     except Exception as e:  # noqa: BLE001
                         error = e
                     self._finish(controller, obj, error)
+
+        if self._tick_gauge is not None:
+            self._tick_gauge.set(
+                "manager", "-", _time.perf_counter() - start
+            )
 
     def run(self, duration: float, tick: float = 0.1) -> None:
         """Drive reconcile_all on a wall-clock loop for `duration` seconds."""

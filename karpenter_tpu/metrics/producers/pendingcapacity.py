@@ -260,9 +260,11 @@ def solve_pending(
         memo = feed.encode_memo
         if memo is not None and memo[0] == fingerprint:
             inputs = memo[1]
+            _count_cache(registry, "hit")
         else:
             inputs = _encode_from_cache(snap, profiles)
             feed.encode_memo = (fingerprint, inputs)
+            _count_cache(registry, "miss")
     else:
         inputs = _encode_from_cache(snap, profiles)
     _dispatch_and_record(inputs, targets, registry, solver, errors)
@@ -400,6 +402,14 @@ def _encode_from_cache(snap, profiles) -> "B.BinPackInputs":
         group_taints=group_taints,
         group_labels=group_labels,
         pod_weight=pod_weight,
+    )
+
+
+def _count_cache(registry: GaugeRegistry, outcome: str) -> None:
+    """karpenter_runtime_encode_cache_total{name=hit|miss}: how often the
+    tick-collapse encode memo spares a re-encode + device re-upload."""
+    registry.register("runtime", "encode_cache_total", kind="counter").inc(
+        outcome, "-"
     )
 
 

@@ -28,17 +28,26 @@ class GaugeSample:
 
 
 class GaugeVec:
-    """A named gauge parameterized by {name, namespace} labels."""
+    """A named gauge (or counter: kind="counter") parameterized by
+    {name, namespace} labels."""
 
-    def __init__(self, full_name: str, help_text: str):
+    def __init__(self, full_name: str, help_text: str, kind: str = "gauge"):
         self.full_name = full_name
         self.help = help_text
+        self.kind = kind  # Prometheus TYPE line: "gauge" or "counter"
         self._samples: Dict[Tuple[str, str], float] = {}
         self._lock = threading.Lock()
 
     def set(self, name: str, namespace: str, value: float) -> None:
         with self._lock:
             self._samples[(name, namespace)] = float(value)
+
+    def inc(self, name: str, namespace: str, delta: float = 1.0) -> None:
+        """Atomic increment under the vec lock (counters must never lose
+        increments to concurrent read-modify-write)."""
+        with self._lock:
+            key = (name, namespace)
+            self._samples[key] = self._samples.get(key, 0.0) + delta
 
     def get(self, name: str, namespace: str) -> Optional[float]:
         with self._lock:
@@ -61,7 +70,9 @@ class GaugeRegistry:
         self._gauges: Dict[str, Dict[str, GaugeVec]] = {}
         self._lock = threading.Lock()
 
-    def register(self, subsystem: str, name: str) -> GaugeVec:
+    def register(
+        self, subsystem: str, name: str, kind: str = "gauge"
+    ) -> GaugeVec:
         """reference: gauge.go:35-50 (RegisterNewGauge)."""
         full = f"{METRIC_NAMESPACE}_{subsystem}_{name}"
         with self._lock:
@@ -71,6 +82,7 @@ class GaugeRegistry:
                     full,
                     "Metric computed by a karpenter metrics producer "
                     "corresponding to name and namespace labels",
+                    kind=kind,
                 )
             return sub[name]
 
@@ -93,7 +105,7 @@ class GaugeRegistry:
             vecs = [v for sub in self._gauges.values() for v in sub.values()]
         for vec in sorted(vecs, key=lambda v: v.full_name):
             lines.append(f"# HELP {vec.full_name} {vec.help}")
-            lines.append(f"# TYPE {vec.full_name} gauge")
+            lines.append(f"# TYPE {vec.full_name} {vec.kind}")
             for sample in vec.samples():
                 labels = ",".join(
                     f'{k}="{v}"' for k, v in sorted(sample.labels.items())

@@ -91,7 +91,9 @@ class KarpenterRuntime:
         # autoscaler decides — one tick moves a signal end to end (the
         # reference's produce→scrape→poll chain costs up to 20s of interval
         # latency; SURVEY.md §6).
-        self.manager = Manager(self.store, clock=self.clock).register(
+        self.manager = Manager(
+            self.store, clock=self.clock, registry=self.registry
+        ).register(
             MetricsProducerController(self.producer_factory),
             ScalableNodeGroupController(self.cloud_provider),
             HorizontalAutoscalerController(self.batch_autoscaler),

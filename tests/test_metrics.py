@@ -103,3 +103,101 @@ class TestExposition:
             'karpenter_reserved_capacity_cpu_utilization{name="g",namespace="default"} NaN'
             in text
         )
+
+
+class TestRuntimeSelfMetrics:
+    def test_manager_publishes_tick_and_reconcile_counts(self):
+        from karpenter_tpu.api.core import ObjectMeta
+        from karpenter_tpu.api.scalablenodegroup import (
+            ScalableNodeGroup,
+            ScalableNodeGroupSpec,
+        )
+        from karpenter_tpu.cloudprovider.fake import FakeFactory
+        from karpenter_tpu.runtime import KarpenterRuntime
+
+        provider = FakeFactory()
+        provider.node_replicas["g"] = 1
+        rt = KarpenterRuntime(cloud_provider_factory=provider)
+        rt.store.create(
+            ScalableNodeGroup(
+                metadata=ObjectMeta(name="g"),
+                spec=ScalableNodeGroupSpec(
+                    replicas=1, type="FakeNodeGroup", id="g"
+                ),
+            )
+        )
+        rt.manager.reconcile_all()
+        reg = rt.registry
+        assert reg.gauge("runtime", "tick_seconds").get(
+            "manager", "-"
+        ) is not None
+        assert reg.gauge("runtime", "reconciles_total").get(
+            "ScalableNodeGroup", "-"
+        ) == 1.0
+        assert reg.gauge("runtime", "reconcile_errors_total").get(
+            "ScalableNodeGroup", "-"
+        ) in (None, 0.0)
+        # counters expose the Prometheus counter TYPE, not gauge
+        text = reg.expose_text()
+        assert "# TYPE karpenter_runtime_reconciles_total counter" in text
+
+    def test_encode_cache_counters(self):
+        from karpenter_tpu.api.core import (
+            Container,
+            Node,
+            NodeCondition,
+            NodeStatus,
+            ObjectMeta,
+            Pod,
+            PodSpec,
+        )
+        from karpenter_tpu.api.metricsproducer import (
+            MetricsProducer,
+            MetricsProducerSpec,
+            PendingCapacitySpec,
+        )
+        from karpenter_tpu.metrics.producers.pendingcapacity import (
+            _group_profile,
+            solve_pending,
+        )
+        from karpenter_tpu.store import Store
+        from karpenter_tpu.store.columnar import PendingFeed
+        from karpenter_tpu.utils.quantity import Quantity
+
+        store = Store()
+        feed = PendingFeed(store, _group_profile)
+        store.create(
+            Node(
+                metadata=ObjectMeta(name="n", labels={"g": "a"}),
+                status=NodeStatus(
+                    allocatable={"cpu": Quantity.parse("8")},
+                    conditions=[NodeCondition(type="Ready", status="True")],
+                ),
+            )
+        )
+        store.create(
+            Pod(
+                metadata=ObjectMeta(name="p"),
+                spec=PodSpec(
+                    containers=[
+                        Container(requests={"cpu": Quantity.parse("1")})
+                    ]
+                ),
+            )
+        )
+        mp = store.create(
+            MetricsProducer(
+                metadata=ObjectMeta(name="mp"),
+                spec=MetricsProducerSpec(
+                    pending_capacity=PendingCapacitySpec(
+                        node_selector={"g": "a"}
+                    )
+                ),
+            )
+        )
+        registry = GaugeRegistry()
+        solve_pending(store, [mp], registry, feed=feed)
+        solve_pending(store, [mp], registry, feed=feed)
+        gauge = registry.gauge("runtime", "encode_cache_total")
+        assert gauge.get("miss", "-") == 1.0
+        assert gauge.get("hit", "-") == 1.0
