@@ -174,15 +174,38 @@ def main(argv=None) -> int:
         if args.leader_elect
         else None
     )
+
+    # clean shutdown on SIGTERM (what kubernetes sends on pod
+    # termination): finish the current tick, then run the same teardown
+    # as normal exit — the reference's manager stops on SIGTERM/SIGINT
+    # via controller-runtime's signal handler (main.go run-until-signalled)
+    import signal
+
+    stopping = {"flag": False}
+
+    def _stop(signum, frame):
+        stopping["flag"] = True
+
+    previous_handler = None
+    try:
+        previous_handler = signal.signal(signal.SIGTERM, _stop)
+    except ValueError:
+        pass  # non-main thread (tests): rely on duration/interrupt
+
     deadline = runtime.clock() + args.duration
     try:
-        while runtime.clock() < deadline:
+        while runtime.clock() < deadline and not stopping["flag"]:
             if elector is None or elector.try_acquire():
                 runtime.manager.reconcile_all()
             time.sleep(args.tick)
     except KeyboardInterrupt:
         pass
     finally:
+        if previous_handler is not None:
+            # restore: after main() returns, SIGTERM must regain its
+            # previous disposition (a stale handler flipping a dead flag
+            # would make the process unkillable by TERM)
+            signal.signal(signal.SIGTERM, previous_handler)
         metrics_server.stop()
         if webhook_server is not None:
             webhook_server.stop()

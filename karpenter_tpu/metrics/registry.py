@@ -77,14 +77,22 @@ class GaugeRegistry:
         full = f"{METRIC_NAMESPACE}_{subsystem}_{name}"
         with self._lock:
             sub = self._gauges.setdefault(subsystem, {})
-            if name not in sub:
-                sub[name] = GaugeVec(
+            vec = sub.get(name)
+            if vec is None:
+                vec = sub[name] = GaugeVec(
                     full,
                     "Metric computed by a karpenter metrics producer "
                     "corresponding to name and namespace labels",
                     kind=kind,
                 )
-            return sub[name]
+            elif vec.kind != kind:
+                # the TYPE line is decided at first registration; a silent
+                # mismatch would expose a counter as a gauge (or vice
+                # versa) and corrupt rate()/increase() semantics
+                raise ValueError(
+                    f"{full} already registered as {vec.kind}, not {kind}"
+                )
+            return vec
 
     def gauge(self, subsystem: str, name: str) -> GaugeVec:
         with self._lock:
