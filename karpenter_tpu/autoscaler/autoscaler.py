@@ -66,12 +66,25 @@ class _Row:
 
 
 class BatchAutoscaler:
-    """Evaluates all HorizontalAutoscalers as one device call per tick."""
+    """Evaluates all HorizontalAutoscalers as one device call per tick.
 
-    def __init__(self, metrics_client_factory, store: Store, clock=_time.time):
+    `decider` is the decision half of the Algorithm seam: any
+    (DecisionInputs) -> DecisionOutputs callable — the in-process jitted
+    kernel (default) or a sidecar SolverClient.decide, making the control
+    plane DEVICE-free under the gRPC process split (jax stays imported —
+    ops/decision builds the jitted kernel at import — but no backend is
+    initialized and no device math runs here; the bin-pack half is the
+    `solver=` seam in producers/pendingcapacity.py).
+    """
+
+    def __init__(
+        self, metrics_client_factory, store: Store, clock=_time.time,
+        decider=None,
+    ):
         self.metrics = metrics_client_factory
         self.store = store
         self.clock = clock
+        self.decider = decider if decider is not None else D.decide_jit
         # Times enter the kernel as f32 seconds relative to this epoch so a
         # long-lived process never loses sub-second precision to f32.
         self.epoch = clock()
@@ -155,8 +168,6 @@ class BatchAutoscaler:
         return results
 
     def _decide(self, rows: List[_Row]) -> D.DecisionOutputs:
-        import jax.numpy as jnp
-
         n = D.pad_to(len(rows))
         m = max(1, max(len(r.values) for r in rows))
 
@@ -217,83 +228,58 @@ class BatchAutoscaler:
                     pvalue[i, j] = policy.value
                     pperiod[i, j] = policy.period_seconds
                     pvalid[i, j] = True
-            return (
-                jnp.asarray(ptype),
-                jnp.asarray(pvalue),
-                jnp.asarray(pperiod),
-                jnp.asarray(pvalid),
-            )
+            # plain numpy: the local jitted kernel converts on entry; the
+            # remote decider serializes host bytes (no device work here)
+            return (ptype, pvalue, pperiod, pvalid)
 
         up_ptype, up_pvalue, up_pperiod, up_pvalid = policy_slots(0)
         down_ptype, down_pvalue, down_pperiod, down_pvalid = policy_slots(1)
 
         now = np.float32(self.clock() - self.epoch)
         inputs = D.DecisionInputs(
-            metric_value=jnp.asarray(pad2(lambda r: r.values, 0.0, np.float32)),
-            target_value=jnp.asarray(pad2(lambda r: r.targets, 0.0, np.float32)),
-            target_type=jnp.asarray(
-                pad2(lambda r: r.types, D.TYPE_UNKNOWN, np.int32)
+            metric_value=pad2(lambda r: r.values, 0.0, np.float32),
+            target_value=pad2(lambda r: r.targets, 0.0, np.float32),
+            target_type=pad2(lambda r: r.types, D.TYPE_UNKNOWN, np.int32),
+            metric_valid=valid,
+            spec_replicas=col(lambda i, r: r.scale.spec_replicas or 0, 0, np.int32),
+            status_replicas=col(lambda i, r: r.scale.status_replicas, 0, np.int32),
+            min_replicas=col(lambda i, r: r.ha.spec.min_replicas, 0, np.int32),
+            max_replicas=col(lambda i, r: r.ha.spec.max_replicas, 0, np.int32),
+            up_window=col(
+                lambda i, r: resolved_rules[i][0].stabilization_window_seconds,
+                0,
+                np.int32,
             ),
-            metric_valid=jnp.asarray(valid),
-            spec_replicas=jnp.asarray(
-                col(lambda i, r: r.scale.spec_replicas or 0, 0, np.int32)
+            down_window=col(
+                lambda i, r: resolved_rules[i][1].stabilization_window_seconds,
+                0,
+                np.int32,
             ),
-            status_replicas=jnp.asarray(
-                col(lambda i, r: r.scale.status_replicas, 0, np.int32)
+            up_policy=col(
+                lambda i, r: _POLICY_CODES.get(
+                    resolved_rules[i][0].select_policy, D.POLICY_MAX
+                ),
+                D.POLICY_MAX,
+                np.int32,
             ),
-            min_replicas=jnp.asarray(
-                col(lambda i, r: r.ha.spec.min_replicas, 0, np.int32)
+            down_policy=col(
+                lambda i, r: _POLICY_CODES.get(
+                    resolved_rules[i][1].select_policy, D.POLICY_MAX
+                ),
+                D.POLICY_MAX,
+                np.int32,
             ),
-            max_replicas=jnp.asarray(
-                col(lambda i, r: r.ha.spec.max_replicas, 0, np.int32)
+            last_scale_time=col(
+                lambda i, r: (r.ha.status.last_scale_time or 0.0) - self.epoch,
+                0.0,
+                np.float32,
             ),
-            up_window=jnp.asarray(
-                col(
-                    lambda i, r: resolved_rules[i][0].stabilization_window_seconds,
-                    0,
-                    np.int32,
-                )
+            has_last_scale=col(
+                lambda i, r: r.ha.status.last_scale_time is not None,
+                False,
+                bool,
             ),
-            down_window=jnp.asarray(
-                col(
-                    lambda i, r: resolved_rules[i][1].stabilization_window_seconds,
-                    0,
-                    np.int32,
-                )
-            ),
-            up_policy=jnp.asarray(
-                col(
-                    lambda i, r: _POLICY_CODES.get(
-                        resolved_rules[i][0].select_policy, D.POLICY_MAX
-                    ),
-                    D.POLICY_MAX,
-                    np.int32,
-                )
-            ),
-            down_policy=jnp.asarray(
-                col(
-                    lambda i, r: _POLICY_CODES.get(
-                        resolved_rules[i][1].select_policy, D.POLICY_MAX
-                    ),
-                    D.POLICY_MAX,
-                    np.int32,
-                )
-            ),
-            last_scale_time=jnp.asarray(
-                col(
-                    lambda i, r: (r.ha.status.last_scale_time or 0.0) - self.epoch,
-                    0.0,
-                    np.float32,
-                )
-            ),
-            has_last_scale=jnp.asarray(
-                col(
-                    lambda i, r: r.ha.status.last_scale_time is not None,
-                    False,
-                    bool,
-                )
-            ),
-            now=jnp.float32(now),
+            now=np.float32(now),
             up_ptype=up_ptype,
             up_pvalue=up_pvalue,
             up_pperiod=up_pperiod,
@@ -304,7 +290,7 @@ class BatchAutoscaler:
             down_pvalid=down_pvalid,
         )
         with solver_trace("autoscaler.decide"):
-            return D.decide_jit(inputs)
+            return self.decider(inputs)
 
     def _apply(self, row: _Row, out: D.DecisionOutputs, i: int, now: float):
         """Write back one row's decision (reference: autoscaler.go:81-113,
@@ -400,8 +386,13 @@ class AutoscalerFactory:
     """reference: autoscaler.go:38-69 — kept for per-object call sites; the
     controller uses the batch path."""
 
-    def __init__(self, metrics_client_factory, store: Store, clock=_time.time):
-        self.batch = BatchAutoscaler(metrics_client_factory, store, clock)
+    def __init__(
+        self, metrics_client_factory, store: Store, clock=_time.time,
+        decider=None,
+    ):
+        self.batch = BatchAutoscaler(
+            metrics_client_factory, store, clock, decider=decider
+        )
 
     def reconcile(self, ha: HorizontalAutoscaler) -> None:
         error = self.batch.reconcile_batch([ha])[

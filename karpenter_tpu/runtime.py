@@ -70,12 +70,15 @@ class KarpenterRuntime:
             )
         )
         self.solver_client = None
-        solver = None
+        solver = decider = None
         if options.solver_uri:
             from karpenter_tpu.sidecar.client import SolverClient
 
             self.solver_client = SolverClient(options.solver_uri)
             solver = self.solver_client.solve
+            # the decision kernel rides the same split: with a sidecar
+            # configured the control-plane process runs NO device math
+            decider = self.solver_client.decide
         self.producer_factory = ProducerFactory(
             self.store, self.cloud_provider, registry=self.registry,
             solver=solver,
@@ -84,7 +87,8 @@ class KarpenterRuntime:
             registry=self.registry, prometheus_uri=options.prometheus_uri
         )
         self.batch_autoscaler = BatchAutoscaler(
-            self.metrics_clients, self.store, clock=self.clock
+            self.metrics_clients, self.store, clock=self.clock,
+            decider=decider,
         )
         # Registration order = in-tick evaluation order. Producers run first
         # so signals are fresh, then node groups observe, then the batched

@@ -191,3 +191,63 @@ class TestProducerThroughSidecar:
         assert status is not None
         assert status.pending_pods == 1
         assert status.additional_nodes_needed >= 1
+
+
+class TestDecideSplit:
+    def test_control_plane_decides_through_sidecar(self):
+        """With --solver-uri the decision kernel rides the gRPC split too:
+        the full HA pipeline (metric read -> remote decide -> scale write)
+        must produce the canonical 85%/60%/5 -> 8 result with the device
+        math in the sidecar process."""
+        from karpenter_tpu.api.core import ObjectMeta
+        from karpenter_tpu.api.horizontalautoscaler import (
+            CrossVersionObjectReference,
+            HorizontalAutoscaler,
+            HorizontalAutoscalerSpec,
+            Metric,
+            MetricTarget,
+            PrometheusMetricSource,
+        )
+        from karpenter_tpu.api.scalablenodegroup import (
+            ScalableNodeGroup,
+            ScalableNodeGroupSpec,
+        )
+        from karpenter_tpu.cloudprovider.fake import FakeFactory
+        from karpenter_tpu.runtime import KarpenterRuntime, Options
+        from karpenter_tpu.sidecar.server import SolverServer
+
+        server = SolverServer(port=0)
+        port = server.start()
+        try:
+            provider = FakeFactory()
+            provider.node_replicas["g"] = 5
+            rt = KarpenterRuntime(
+                Options(
+                    cloud_provider="fake",
+                    solver_uri=f"127.0.0.1:{port}",
+                ),
+                cloud_provider_factory=provider,
+            )
+            assert rt.batch_autoscaler.decider == rt.solver_client.decide
+            gauge = rt.registry.register("reserved_capacity",
+                                         "cpu_utilization")
+            gauge.set("g", "default", 0.85)
+            rt.store.create(ScalableNodeGroup(
+                metadata=ObjectMeta(name="g"),
+                spec=ScalableNodeGroupSpec(
+                    replicas=5, type="FakeNodeGroup", id="g")))
+            rt.store.create(HorizontalAutoscaler(
+                metadata=ObjectMeta(name="ha"),
+                spec=HorizontalAutoscalerSpec(
+                    scale_target_ref=CrossVersionObjectReference(
+                        kind="ScalableNodeGroup", name="g"),
+                    min_replicas=3, max_replicas=23,
+                    metrics=[Metric(prometheus=PrometheusMetricSource(
+                        query='karpenter_reserved_capacity_cpu_utilization{name="g"}',
+                        target=MetricTarget(type="Utilization", value=60)))])))
+            rt.manager.reconcile_all()
+            ha = rt.store.get("HorizontalAutoscaler", "default", "ha")
+            assert ha.status.desired_replicas == 8
+            rt.close()  # release the gRPC channel before the server stops
+        finally:
+            server.stop()
