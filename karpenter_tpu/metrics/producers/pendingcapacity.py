@@ -258,16 +258,26 @@ def solve_pending(
             ),
         )
         memo = feed.encode_memo
+        cached_outputs = None
         if memo is not None and memo[0] == fingerprint:
             inputs = memo[1]
+            # the solve is a pure function of inputs: identical inputs
+            # reuse the PREVIOUS host outputs and skip the device call
+            # entirely — an unchanged tick costs no round-trip at all
+            cached_outputs = memo[2]
             _count_cache(registry, "hit")
         else:
             inputs = _encode_from_cache(snap, profiles)
-            feed.encode_memo = (fingerprint, inputs)
+            feed.encode_memo = (fingerprint, inputs, None)
             _count_cache(registry, "miss")
+        host = _dispatch_and_record(
+            inputs, targets, registry, solver, errors,
+            cached_outputs=cached_outputs,
+        )
+        feed.encode_memo = (fingerprint, inputs, host)
     else:
         inputs = _encode_from_cache(snap, profiles)
-    _dispatch_and_record(inputs, targets, registry, solver, errors)
+        _dispatch_and_record(inputs, targets, registry, solver, errors)
     return {
         (namespace, name): errors.get((namespace, name))
         for namespace, name, _, _ in targets
@@ -434,40 +444,52 @@ def _pack_outputs(assigned_count, nodes_needed, lp_bound, unschedulable):
     )
 
 
-def _dispatch_and_record(inputs, targets, registry, solver, errors=None) -> None:
-    if solver is None:
-        solver = B.solve
-    # numpy arrays go straight through: the in-process jitted solve
-    # device-puts them itself, and a remote solver serializes host bytes —
-    # wrapping in jnp here would force a device round-trip (and JAX init)
-    # in the control-plane process the sidecar split exists to relieve
-    with solver_trace("pendingcapacity.solve"):
-        out = solver(inputs)
-
-    # ONE device->host fetch for all four outputs: device_get still issues
-    # a round-trip PER leaf (measured ~35 ms each through the network
-    # tunnel), so the four outputs are first concatenated ON DEVICE into a
-    # single i32[3T+1] vector — one transfer total. Plain numpy outputs
-    # (sidecar path) pass through untouched.
-    import jax
-
-    if isinstance(out.assigned_count, jax.Array):
-        packed = np.asarray(
-            _pack_outputs(
-                out.assigned_count, out.nodes_needed, out.lp_bound,
-                out.unschedulable,
-            )
-        )
-        n = out.assigned_count.shape[0]
-        assigned_count = packed[:n]
-        nodes_needed = packed[n : 2 * n]
-        lp_bound = packed[2 * n : 3 * n]
-        unschedulable = int(packed[3 * n])
+def _dispatch_and_record(
+    inputs, targets, registry, solver, errors=None, cached_outputs=None
+):
+    """Solve + one host fetch + status/gauge writes. Returns the host
+    output tuple (assigned_count, nodes_needed, lp_bound, unschedulable)
+    so callers can memoize it; `cached_outputs` short-circuits the solve
+    for identical inputs (the memo-hit path)."""
+    if cached_outputs is not None:
+        assigned_count, nodes_needed, lp_bound, unschedulable = cached_outputs
     else:
-        assigned_count, nodes_needed, lp_bound = (
-            out.assigned_count, out.nodes_needed, out.lp_bound,
-        )
-        unschedulable = int(out.unschedulable)
+        if solver is None:
+            solver = B.solve
+        # numpy arrays go straight through: the in-process jitted solve
+        # device-puts them itself, and a remote solver serializes host
+        # bytes — wrapping in jnp here would force a device round-trip
+        # (and JAX init) in the control-plane process the sidecar split
+        # exists to relieve
+        with solver_trace("pendingcapacity.solve"):
+            out = solver(inputs)
+
+        # ONE device->host fetch for all four outputs: device_get still
+        # issues a round-trip PER leaf (measured ~35 ms each through the
+        # network tunnel), so the four outputs are first concatenated ON
+        # DEVICE into a single i32[3T+1] vector — one transfer total.
+        # Plain numpy outputs (sidecar path) pass through untouched.
+        import jax
+
+        if isinstance(out.assigned_count, jax.Array):
+            packed = np.asarray(
+                _pack_outputs(
+                    out.assigned_count, out.nodes_needed, out.lp_bound,
+                    out.unschedulable,
+                )
+            )
+            n = out.assigned_count.shape[0]
+            assigned_count = packed[:n]
+            nodes_needed = packed[n : 2 * n]
+            lp_bound = packed[2 * n : 3 * n]
+            unschedulable = int(packed[3 * n])
+        else:
+            assigned_count, nodes_needed, lp_bound = (
+                np.asarray(out.assigned_count),
+                np.asarray(out.nodes_needed),
+                np.asarray(out.lp_bound),
+            )
+            unschedulable = int(out.unschedulable)
 
     register_gauges(registry)
     gauge = lambda g: registry.gauge(SUBSYSTEM, g)
@@ -487,6 +509,7 @@ def _dispatch_and_record(inputs, targets, registry, solver, errors=None) -> None
         gauge(ADDITIONAL_NODES_NEEDED).set(name, namespace, float(nodes_needed[t]))
         gauge(LP_LOWER_BOUND).set(name, namespace, float(lp_bound[t]))
         gauge(UNSCHEDULABLE_PODS).set(name, namespace, float(unschedulable))
+    return (assigned_count, nodes_needed, lp_bound, unschedulable)
 
 
 class PendingCapacityProducer:

@@ -544,11 +544,20 @@ class TestSolveCaching:
             return real(*args, **kwargs)
 
         monkeypatch.setattr(PC, "_encode_from_cache", counting)
+        solves = []
+        from karpenter_tpu.ops import binpack as B
+
+        def counting_solver(inputs, **kwargs):
+            solves.append(1)
+            return B.solve(inputs, **kwargs)
+
         registry = GaugeRegistry()
 
         def tick():
             mps = store.list("MetricsProducer")
-            PC.solve_pending(store, mps, registry, feed=feed)
+            PC.solve_pending(
+                store, mps, registry, feed=feed, solver=counting_solver
+            )
             return registry.gauge(
                 PC.SUBSYSTEM, PC.ADDITIONAL_NODES_NEEDED
             ).get("mp", "default")
@@ -557,11 +566,16 @@ class TestSolveCaching:
         assert len(calls) == 1
         assert tick() == first  # memo hit: same outputs, no re-encode
         assert len(calls) == 1
+        # an unchanged tick skips the DEVICE too: the memoized host
+        # outputs are republished without a solve
+        assert len(solves) == 1
         store.create(pod("p9"))  # pod churn invalidates
         tick()
         assert len(calls) == 2
+        assert len(solves) == 2  # fresh inputs MUST re-solve (no stale outputs)
         tick()
         assert len(calls) == 2
+        assert len(solves) == 2  # and the new outputs are memoized again
         store.create(node("n1", {"group": "g"}, cpu="4", mem="16Gi"))
         tick()  # node churn invalidates (profile shape changed)
         assert len(calls) == 3
