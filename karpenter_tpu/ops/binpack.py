@@ -235,8 +235,14 @@ def binpack(inputs: BinPackInputs, buckets: int = DEFAULT_BUCKETS) -> BinPackOut
 
     # LP lower bound: per resource, total assigned demand / per-node
     # allocatable, ceil; max across resources
+    # HIGHEST precision: the TPU MXU rounds f32 operands to bf16 by default,
+    # which drifts the demand sum ~1e-4 relative and can flip the ceil at a
+    # fit boundary; the matmul is tiny ([T, R] output) so exactness is free
     demand = jnp.einsum(
-        "pt,pr->tr", member_w.astype(jnp.float32), inputs.pod_requests
+        "pt,pr->tr",
+        member_w.astype(jnp.float32),
+        inputs.pod_requests,
+        precision=lax.Precision.HIGHEST,
     )  # [T, R]
     alloc = inputs.group_allocatable
     per_resource = jnp.where(

@@ -131,17 +131,24 @@ def _kernel(
     )  # [TILE_P, B]
 
     # --- accumulate [T, B] histogram + [T, R] demand (MXU transposes) ---
+    # Both accumulators pin precision=HIGHEST: Mosaic's default MXU path
+    # rounds f32 operands to bf16, and member_w carries pod multiplicities
+    # (dedup weights reach ~1e4 at bench scale — past bf16's 8-bit
+    # mantissa), so the default would miscount the histogram and drift the
+    # demand sum. ops/binpack.py's einsum is pinned the same way.
     hist_update = jax.lax.dot_general(
         member_w,
         bucket_onehot,
         dimension_numbers=(((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
     )  # [T, B]
     demand_update = jax.lax.dot_general(
         member_w,
         req,
         dimension_numbers=(((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
     )  # [T, R]
 
     @pl.when(step == 0)

@@ -19,7 +19,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from karpenter_tpu.utils.backend import force_virtual_cpu  # noqa: E402
 
-force_virtual_cpu(8)
+if os.environ.get("KARPENTER_TEST_REAL_BACKEND"):
+    # Opt-in escape hatch for TPU hosts: leave the real backend in place so
+    # the @skipUnless(tpu) cases (e.g. tests/test_pallas_binpack.py's
+    # compiled-Mosaic equality tests) actually run. Only use with a narrow
+    # test selection — the full suite assumes the 8-device CPU mesh.
+    pass
+else:
+    force_virtual_cpu(8)
 
 
 def pytest_collection_modifyitems(config, items):

@@ -142,14 +142,20 @@ class TestCompiledMosaic:
 
     def test_compiled_weighted_equals_xla_on_tpu(self):
         """The encoder always emits pod_weight now, so the WEIGHTED path
-        is the production Mosaic path — pin it compiled too."""
+        is the production Mosaic path — pin it compiled too.
+
+        Weights are drawn from [1000, 5000): past bf16's 8-bit mantissa,
+        so this FAILS if the hist/demand accumulators drop to the MXU's
+        default bf16 operand rounding (small weights would round
+        losslessly and mask it) — production dedup multiplicities at
+        bench scale are ~4000/row."""
         import dataclasses
 
         rng = np.random.default_rng(6)
         weighted = dataclasses.replace(
             random_inputs(rng, pods=512, types=24),
             pod_weight=jnp.asarray(
-                rng.integers(0, 50, 512).astype(np.int32)
+                rng.integers(1000, 5000, 512).astype(np.int32)
             ),
         )
         xla = B.binpack(weighted, buckets=16)
