@@ -313,19 +313,51 @@ def _dedup_rows(snap):
     Raw-byte uniqueness on the concatenated row bytes: float bit-equality
     only (never merges distinct values; -0.0 vs 0.0 over-splits, which is
     merely suboptimal, never wrong).
+
+    Fast path: cache-produced snapshots carry the INCREMENTALLY-maintained
+    dedup (store/columnar.PendingPodCache._dedup_slots) — one rep row +
+    count per distinct live shape, maintained at watch-event time. Only
+    the S rep rows (distinct shapes, fleet-scale constant) are byte-sorted
+    here for deterministic row order; the np.unique-over-all-rows below is
+    the fallback for hand-built snapshots, and was ~60 ms/tick of argsort
+    at 100k pods. The incremental dedup indexes live slots only; free
+    (valid=False, zeroed) rows are dropped rather than collapsed into a
+    zero row — output-equal, since invalid rows never contribute to any
+    solver aggregate.
     """
     hi = snap.requests.shape[0]
-    if hi == 0:
+    if hi == 0 or (snap.dedup_idx is not None and len(snap.dedup_idx) == 0):
+        # hi > 0 with an empty dedup is the pending set draining to zero
+        # while freed arena rows remain — the normal all-pods-scheduled
+        # state, not an error
         return np.zeros(0, np.intp), np.zeros(0, np.int32)
-    parts = [
-        np.ascontiguousarray(snap.requests).view(np.uint8).reshape(hi, -1),
-        np.ascontiguousarray(snap.required).view(np.uint8).reshape(hi, -1),
-        np.ascontiguousarray(snap.shape_id).view(np.uint8).reshape(hi, -1),
-        snap.valid.astype(np.uint8).reshape(hi, 1),
-    ]
-    rows = np.ascontiguousarray(np.concatenate(parts, axis=1))
-    keys = rows.view([("k", np.void, rows.shape[1])]).ravel()
-    _, idx, counts = np.unique(keys, return_index=True, return_counts=True)
+
+    def row_bytes(idx):
+        # idx=slice(None) gives zero-copy views (the arrays are already
+        # contiguous); index arrays (the fast path's rep rows) gather
+        n = hi if isinstance(idx, slice) else len(idx)
+        parts = [
+            np.ascontiguousarray(snap.requests[idx])
+            .view(np.uint8)
+            .reshape(n, -1),
+            np.ascontiguousarray(snap.required[idx])
+            .view(np.uint8)
+            .reshape(n, -1),
+            np.ascontiguousarray(snap.shape_id[idx])
+            .view(np.uint8)
+            .reshape(n, -1),
+            snap.valid[idx].astype(np.uint8).reshape(n, 1),
+        ]
+        rows = np.ascontiguousarray(np.concatenate(parts, axis=1))
+        return rows.view([("k", np.void, rows.shape[1])]).ravel()
+
+    if snap.dedup_idx is not None:
+        order = np.argsort(row_bytes(snap.dedup_idx))  # O(S log S), S tiny
+        return snap.dedup_idx[order], snap.dedup_weight[order]
+
+    _, idx, counts = np.unique(
+        row_bytes(slice(None)), return_index=True, return_counts=True
+    )
     return idx, counts.astype(np.int32)
 
 

@@ -613,6 +613,87 @@ class TestShapeDedup:
             out.unschedulable
         ) == 81
 
+    def test_incremental_dedup_equals_full_unique_under_churn(self):
+        """The watch-maintained dedup (PendingPodCache._dedup_slots) must
+        agree with the np.unique-over-all-rows fallback for any history:
+        adds, mutations that change a pod's shape, deletes, slot reuse,
+        and compaction. Weights are compared as multisets keyed by row
+        content (row ORDER is canonicalized by byte-sort either way)."""
+        import dataclasses
+
+        import karpenter_tpu.metrics.producers.pendingcapacity as PC
+
+        rng = np.random.default_rng(11)
+        store = Store()
+        cache = PendingPodCache(store)
+        cpus = ["100m", "250m", "2", "4"]
+        live = {}
+        for step in range(600):
+            op = rng.random()
+            if op < 0.55 or not live:
+                name = f"p{step}"
+                cpu = str(rng.choice(cpus))
+                sel = {"zone": "z"} if rng.random() < 0.3 else None
+                store.create(pod(name, cpu=cpu, selector=sel))
+                live[name] = True
+            elif op < 0.8:
+                victim = str(rng.choice(list(live)))
+                store.delete("Pod", "default", victim)
+                del live[victim]
+            else:
+                victim = str(rng.choice(list(live)))
+                store.update(pod(victim, cpu=str(rng.choice(cpus))))
+        snap = cache.snapshot()
+        assert snap.dedup_idx is not None
+        inc_idx, inc_w = PC._dedup_rows(snap)
+        # force the np.unique fallback on the same snapshot content
+        full = dataclasses.replace(snap, dedup_idx=None, dedup_weight=None)
+        uni_idx, uni_w = PC._dedup_rows(full)
+
+        def keyed(idx, weights, include_invalid):
+            out = {}
+            for i, w in zip(idx, weights):
+                if not snap.valid[i] and not include_invalid:
+                    continue
+                key = (
+                    snap.requests[i].tobytes(),
+                    snap.required[i].tobytes(),
+                    int(snap.shape_id[i]),
+                    bool(snap.valid[i]),
+                )
+                out[key] = out.get(key, 0) + int(w)
+            return out
+
+        # the fallback also emits the collapsed free-slot (invalid) row;
+        # the incremental path drops it — output-equal, filtered here
+        assert keyed(inc_idx, inc_w, True) == keyed(uni_idx, uni_w, False)
+        assert sum(keyed(inc_idx, inc_w, True).values()) == len(live)
+
+    def test_dedup_survives_pending_set_draining_to_zero(self):
+        """All pods scheduling away (the success state) leaves hi > 0
+        freed arena rows with an EMPTY incremental dedup — the encode
+        must yield the empty solve, not crash on a 0-row gather."""
+        import karpenter_tpu.metrics.producers.pendingcapacity as PC
+
+        store = Store()
+        cache = PendingPodCache(store)
+        for i in range(5):
+            store.create(pod(f"p{i}", cpu="1"))
+        for i in range(5):
+            store.delete("Pod", "default", f"p{i}")
+        snap = cache.snapshot()
+        assert snap.requests.shape[0] > 0 and len(snap.dedup_idx) == 0
+        idx, weights = PC._dedup_rows(snap)
+        assert len(idx) == 0 and len(weights) == 0
+        profiles = [({"cpu": 8.0, "memory": 64.0, "pods": 110.0},
+                     set(), set())]
+        inputs = PC._encode_from_cache(snap, profiles)
+        from karpenter_tpu.ops import binpack as B
+
+        out = B.binpack(inputs, buckets=16)
+        assert int(np.sum(np.asarray(out.assigned_count))) == 0
+        assert int(out.unschedulable) == 0
+
     def test_dedup_statuses_equal_across_paths(self):
         """The dedup must be output-invisible: feed path, pod-cache path,
         and oracle path still agree after heavy duplication + churn."""
