@@ -88,6 +88,10 @@ class Container:
 class PodSpec:
     node_name: str = ""
     containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    # RuntimeClass pod overhead (core/v1 PodSpec.overhead): added on top of
+    # the container maximum by the scheduler's fit check
+    overhead: Dict[str, Quantity] = field(default_factory=dict)
     node_selector: Dict[str, str] = field(default_factory=dict)
     tolerations: List[Toleration] = field(default_factory=list)
 
@@ -107,11 +111,36 @@ class Pod:
 
     def requests(self) -> Dict[str, Quantity]:
         """Sum of container resource requests (container-level only, matching
-        reference reservations.go:45-56 — no init containers or overhead)."""
+        reference reservations.go:45-56 — no init containers or overhead).
+        This is the RESERVED-CAPACITY accounting semantics; the scheduler's
+        fit-check semantics is effective_requests()."""
         totals: Dict[str, Quantity] = {}
         for container in self.spec.containers:
             for name, quantity in container.requests.items():
                 totals[name] = totals.get(name, Quantity()).add(quantity)
+        return totals
+
+    def effective_requests(self) -> Dict[str, Quantity]:
+        """The Kubernetes scheduler's effective resource request, per
+        resource: max(sum over containers, max over init containers) +
+        pod overhead. Init containers run sequentially BEFORE the main
+        containers, so the pod needs the larger of the two phases; the
+        RuntimeClass overhead rides on top unconditionally (upstream
+        k8s.io/kubernetes resource helpers' PodRequests semantics,
+        restartable-sidecar cases excluded — init restartPolicy isn't
+        modeled). Used by the pending-pods bin-pack (OUR signal — the
+        reference stubs it, pendingcapacity/producer.go:29-31 — so
+        fidelity here follows the real scheduler, not reservations.go).
+        """
+        totals = self.requests()
+        for container in self.spec.init_containers:
+            for name, quantity in container.requests.items():
+                current = totals.get(name)
+                if current is None or quantity.value > current.value:
+                    totals[name] = quantity
+        for name, quantity in self.spec.overhead.items():
+            current = totals.get(name)
+            totals[name] = quantity if current is None else current.add(quantity)
         return totals
 
 

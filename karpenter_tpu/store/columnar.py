@@ -167,9 +167,13 @@ class PendingPodCache:
 
     def _upsert(self, key, pod) -> None:
         sparse = _SparsePod(
+            # effective_requests: the SCHEDULER's fit semantics (init
+            # containers max'd against the container sum, overhead added) —
+            # the bin-pack must see what a real kube-scheduler would fit,
+            # or the scale-up signal undersizes pods with heavy init phases
             requests=[
                 (resource, quantity.to_float())
-                for resource, quantity in pod.requests().items()
+                for resource, quantity in pod.effective_requests().items()
                 if quantity.to_float() > 0 and resource != RESOURCE_PODS
             ],
             selector=sorted(pod.spec.node_selector.items()),

@@ -244,3 +244,47 @@ class TestCoreObjects:
         totals = pod.requests()
         assert str(totals["cpu"]) == "750m"
         assert str(totals["memory"]) == "1Gi"
+
+    def test_pod_effective_requests(self):
+        """Scheduler fit semantics: per resource
+        max(container sum, init-container max) + overhead; requests()
+        stays container-sum (the reference's reserved-capacity
+        accounting, reservations.go:45-56)."""
+        pod = Pod(
+            spec=PodSpec(
+                containers=[
+                    Container(requests=resource_list(cpu="500m", memory="1Gi")),
+                    Container(requests=resource_list(cpu="250m")),
+                ],
+                init_containers=[
+                    # cpu below the main-phase sum: main phase wins
+                    Container(requests=resource_list(cpu="600m")),
+                    # memory above it: init phase wins for memory
+                    Container(requests=resource_list(memory="4Gi")),
+                    # a resource only the init phase requests
+                    Container(requests=resource_list(**{"ephemeral-storage": "2Gi"})),
+                ],
+                overhead=resource_list(cpu="100m", memory="64Mi"),
+            )
+        )
+        eff = pod.effective_requests()
+        assert str(eff["cpu"]) == "850m"  # max(750m, 600m) + 100m
+        assert eff["memory"].to_float() == pytest.approx(
+            4 * 1024**3 + 64 * 1024**2
+        )  # max(1Gi, 4Gi) + 64Mi
+        assert str(eff["ephemeral-storage"]) == "2Gi"
+        # the reference-parity accounting is untouched by init/overhead
+        totals = pod.requests()
+        assert str(totals["cpu"]) == "750m"
+        assert str(totals["memory"]) == "1Gi"
+        assert "ephemeral-storage" not in totals
+
+    def test_pod_effective_requests_no_init_no_overhead(self):
+        pod = Pod(
+            spec=PodSpec(
+                containers=[Container(requests=resource_list(cpu="1"))]
+            )
+        )
+        assert {k: str(v) for k, v in pod.effective_requests().items()} == {
+            k: str(v) for k, v in pod.requests().items()
+        }

@@ -669,6 +669,34 @@ class TestShapeDedup:
         assert keyed(inc_idx, inc_w, True) == keyed(uni_idx, uni_w, False)
         assert sum(keyed(inc_idx, inc_w, True).values()) == len(live)
 
+    def test_effective_requests_drive_the_solve(self):
+        """A pod whose init phase dwarfs its main phase must be packed by
+        the init size (k8s scheduler fit semantics), on BOTH the feed and
+        the oracle path."""
+        from karpenter_tpu.metrics.producers.pendingcapacity import (
+            _group_profile,
+        )
+        from karpenter_tpu.store.columnar import PendingFeed
+
+        store = Store()
+        feed = PendingFeed(store, _group_profile)
+        cache = PendingPodCache(store)
+        store.create(node("n0", {"group": "g"}, cpu="8", mem="32Gi"))
+        store.create(producer("mp", {"group": "g"}))
+        # main phase 100m, init phase 4 cpu: 8-cpu nodes hold 2 each (by
+        # init size), NOT 80 (by main size)
+        for i in range(10):
+            p = pod(f"p{i}", cpu="100m")
+            p.spec.init_containers = [
+                Container(requests={"cpu": Quantity.parse("4")})
+            ]
+            store.create(p)
+        oracle, cached, fed = solve_both(store, cache, feed)
+        assert oracle == cached == fed
+        pending, nodes_needed, lp, unsched = oracle["mp"]
+        assert pending == 10 and unsched == 0
+        assert nodes_needed == 5  # 10 pods x 4 cpu / 8 cpu per node
+
     def test_dedup_survives_pending_set_draining_to_zero(self):
         """All pods scheduling away (the success state) leaves hi > 0
         freed arena rows with an EMPTY incremental dedup — the encode

@@ -102,6 +102,33 @@ class TestCodecPosture:
         with pytest.raises(ValueError):
             from_manifest({"kind": "Widget"})
 
+    def test_pod_init_containers_and_overhead_roundtrip(self):
+        """core/v1 manifest dialect: initContainers + overhead hydrate and
+        dump, and effective_requests reflects them."""
+        pod = from_manifest(
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {"name": "p"},
+                "spec": {
+                    "containers": [
+                        {"name": "main", "requests": {"cpu": "500m"}}
+                    ],
+                    "initContainers": [
+                        {"name": "init", "requests": {"cpu": "2"}}
+                    ],
+                    "overhead": {"memory": "64Mi"},
+                },
+            }
+        )
+        assert str(pod.effective_requests()["cpu"]) == "2"
+        assert str(pod.effective_requests()["memory"]) == "64Mi"
+        from karpenter_tpu.api.serialization import to_dict
+
+        doc = to_dict(pod)
+        assert doc["spec"]["initContainers"][0]["requests"]["cpu"] == "2"
+        assert doc["spec"]["overhead"]["memory"] == "64Mi"
+
     def test_core_kind_wrong_api_version_rejected(self):
         with pytest.raises(ValueError):
             from_manifest(
