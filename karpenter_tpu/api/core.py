@@ -79,6 +79,106 @@ class Taint:
 
 
 @dataclass
+class NodeSelectorRequirement:
+    """One matchExpression (core/v1): key OPERATOR values. Operators are
+    the scheduler's set: In, NotIn, Exists, DoesNotExist, Gt, Lt (Gt/Lt
+    compare the label value and values[0] as integers)."""
+
+    key: str = ""
+    operator: str = "In"
+    values: List[str] = field(default_factory=list)
+
+
+@dataclass
+class NodeSelectorTerm:
+    # matchFields (metadata.name selection) is not modeled: node groups,
+    # not individual nodes, are the scale-up unit here
+    match_expressions: List[NodeSelectorRequirement] = field(
+        default_factory=list
+    )
+
+
+@dataclass
+class NodeSelector:
+    node_selector_terms: List[NodeSelectorTerm] = field(default_factory=list)
+
+
+@dataclass
+class NodeAffinity:
+    # preferredDuringScheduling is a soft ordering hint, invisible to
+    # fit feasibility — not modeled
+    required_during_scheduling_ignored_during_execution: Optional[
+        NodeSelector
+    ] = None
+
+
+@dataclass
+class Affinity:
+    node_affinity: Optional[NodeAffinity] = None
+
+
+def affinity_shape(affinity: Optional[Affinity]) -> tuple:
+    """Canonical hashable form of a pod's REQUIRED node affinity: a tuple
+    of terms, each a sorted tuple of (key, operator, sorted values). () =
+    unconstrained. The dedup/encode layers key on this (two pods with the
+    same shape are interchangeable to the solver)."""
+    if affinity is None or affinity.node_affinity is None:
+        return ()
+    required = (
+        affinity.node_affinity.required_during_scheduling_ignored_during_execution
+    )
+    if required is None or not required.node_selector_terms:
+        return ()
+    return tuple(
+        tuple(
+            sorted(
+                (e.key, e.operator, tuple(sorted(e.values)))
+                for e in term.match_expressions
+            )
+        )
+        for term in required.node_selector_terms
+    )
+
+
+def _requirement_matches(labels: Dict[str, str], key, operator, values) -> bool:
+    present = key in labels
+    if operator == "In":
+        return present and labels[key] in values
+    if operator == "NotIn":
+        # k8s semantics: a missing key satisfies NotIn
+        return not present or labels[key] not in values
+    if operator == "Exists":
+        return present
+    if operator == "DoesNotExist":
+        return not present
+    if operator in ("Gt", "Lt"):
+        if not present or not values:
+            return False
+        try:
+            have, want = int(labels[key]), int(values[0])
+        except ValueError:
+            return False
+        return have > want if operator == "Gt" else have < want
+    return False  # unknown operator: never matches (validation's job)
+
+
+def matches_affinity_shape(labels: Dict[str, str], shape: tuple) -> bool:
+    """Scheduler semantics over a label assignment: terms are ORed; the
+    matchExpressions within a term are ANDed; an empty term matches
+    nothing (upstream nodeaffinity helpers). () = no constraint."""
+    if not shape:
+        return True
+    return any(
+        term
+        and all(
+            _requirement_matches(labels, key, operator, values)
+            for key, operator, values in term
+        )
+        for term in shape
+    )
+
+
+@dataclass
 class Container:
     name: str = "main"
     requests: Dict[str, Quantity] = field(default_factory=dict)
@@ -94,6 +194,9 @@ class PodSpec:
     overhead: Dict[str, Quantity] = field(default_factory=dict)
     node_selector: Dict[str, str] = field(default_factory=dict)
     tolerations: List[Toleration] = field(default_factory=list)
+    # required node affinity (matchExpressions); ANDs with node_selector,
+    # exactly as the kube-scheduler treats the two fields
+    affinity: Optional[Affinity] = None
 
 
 @dataclass

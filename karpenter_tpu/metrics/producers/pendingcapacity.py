@@ -37,6 +37,7 @@ import numpy as np
 from karpenter_tpu.api.core import (
     Taint,
     is_ready_and_schedulable,
+    matches_affinity_shape,
     matches_selector,
 )
 from karpenter_tpu.api.metricsproducer import PendingCapacityStatus
@@ -348,6 +349,12 @@ def _dedup_rows(snap):
             .reshape(n, -1),
             snap.valid[idx].astype(np.uint8).reshape(n, 1),
         ]
+        if snap.affinity_id is not None:
+            parts.append(
+                np.ascontiguousarray(snap.affinity_id[idx])
+                .view(np.uint8)
+                .reshape(n, -1)
+            )
         rows = np.ascontiguousarray(np.concatenate(parts, axis=1))
         return rows.view([("k", np.void, rows.shape[1])]).ravel()
 
@@ -435,6 +442,38 @@ def _encode_from_cache(snap, profiles) -> "B.BinPackInputs":
         profiles, resources, taint_universe, label_universe,
         n_groups, n_resources, n_taints, n_labels,
     )
+
+    # Required node affinity: matchExpression semantics (In/NotIn/Exists/
+    # DoesNotExist/Gt/Lt, OR'd terms) don't factor into the conjunctive
+    # required-label bitset, so each DISTINCT affinity shape is evaluated
+    # host-side against each group's label assignment (the profile label
+    # set — the INTERSECTION of node labels, i.e. the same conservative
+    # single-node shape the min-allocatable uses; heterogeneous groups may
+    # over-admit negative operators, the caveat _group_profile documents
+    # for resources) and the S_a x T verdicts gather to rows. None when no
+    # pod constrains affinity — the common fleet pays nothing.
+    pod_group_forbidden = None
+    shapes = snap.affinity_shapes
+    live_affinity_ids = (
+        snap.affinity_id[row_idx]
+        if hi and snap.affinity_id is not None and shapes is not None
+        else None
+    )
+    # gate on LIVE rows (shape id 0 = unconstrained): the shape registry
+    # retains entries until compaction, and a long-gone affinity Job must
+    # not keep the whole fleet on the masked (extra-operand) kernel path
+    if live_affinity_ids is not None and (live_affinity_ids != 0).any():
+        allowed = np.ones((len(shapes), n_groups), bool)
+        label_dicts = [dict(labels) for _, labels, _ in profiles]
+        for s in np.unique(live_affinity_ids):  # only shapes in live use
+            shape = shapes[s]
+            if not shape:
+                continue
+            for t, labels in enumerate(label_dicts):
+                allowed[s, t] = matches_affinity_shape(labels, shape)
+        pod_group_forbidden = np.zeros((n_pods, n_groups), bool)
+        pod_group_forbidden[:hi] = ~allowed[live_affinity_ids]
+
     return B.BinPackInputs(
         pod_requests=pod_requests,
         pod_valid=pod_valid,
@@ -444,6 +483,7 @@ def _encode_from_cache(snap, profiles) -> "B.BinPackInputs":
         group_taints=group_taints,
         group_labels=group_labels,
         pod_weight=pod_weight,
+        pod_group_forbidden=pod_group_forbidden,
     )
 
 

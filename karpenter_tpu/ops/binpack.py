@@ -66,6 +66,15 @@ class BinPackInputs:
     group_taints: jax.Array  # bool[T, K] group nodes carry taint k
     group_labels: jax.Array  # bool[T, L] group nodes carry label l
     pod_weight: Optional[jax.Array] = None  # i32[P] row multiplicity
+    # bool[P, T]: pod p's REQUIRED node affinity (matchExpressions with
+    # In/NotIn/Exists/DoesNotExist/Gt/Lt, OR'd terms) rules out group t.
+    # Arbitrary boolean structure doesn't factor into the conjunctive
+    # required-label bitset, so the host evaluates each DISTINCT affinity
+    # shape against each group profile (S_a x T, both tiny) and gathers to
+    # rows (producers/pendingcapacity._encode_from_cache); rows are
+    # deduplicated shapes, so this stays KB-scale. None = no pod
+    # constrains affinity (the common case costs nothing).
+    pod_group_forbidden: Optional[jax.Array] = None
 
 
 @jax.tree_util.register_dataclass
@@ -109,6 +118,8 @@ def _feasibility(inputs: BinPackInputs) -> jax.Array:
     )
     fits &= taint_violations < 0.5
     fits &= label_violations < 0.5
+    if inputs.pod_group_forbidden is not None:
+        fits &= ~inputs.pod_group_forbidden
     fits &= inputs.pod_valid[:, None]
     return fits
 

@@ -52,13 +52,18 @@ def _kernel(
     alloc_t_ref,  # f32[R_pad, T] — transposed so resource rows are slices
     taints_ref,  # f32[T, K]
     labels_ref,  # f32[T, L]
-    assigned_ref,  # i32[TILE_P, 1] out (per-tile column block)
-    hist_ref,  # f32[T, B] out (accumulated across grid)
-    demand_ref,  # f32[T, R] out (accumulated across grid)
-    *,
+    *rest,  # [forbidden_ref f32[TILE_P, T] when has_forbidden,]
+    #         assigned_ref i32[TILE_P, 1], hist_ref f32[T, B],
+    #         demand_ref f32[T, R]
     buckets: int,
     n_resources: int,
+    has_forbidden: bool = False,
 ):
+    if has_forbidden:
+        forbidden_ref, assigned_ref, hist_ref, demand_ref = rest
+    else:
+        forbidden_ref = None
+        assigned_ref, hist_ref, demand_ref = rest
     # Everything stays 2D: Mosaic lowers static row/column slices and 2D
     # broadcasts, but not the gathers that 1D intermediates / fancy
     # indexing produce.
@@ -93,6 +98,8 @@ def _kernel(
         preferred_element_type=jnp.float32,
     )  # [TILE_P, T]
     fits = fits * (taint_violations < 0.5) * (label_violations < 0.5)
+    if forbidden_ref is not None:  # required node affinity (host-evaluated)
+        fits = fits * (1.0 - forbidden_ref[:])
     fits = fits * valid_ref[:]  # [TILE_P, 1] broadcast
 
     feasible = fits > 0.5  # bool[TILE_P, T]
@@ -210,39 +217,55 @@ def fused_assign(
     taints = pad(inputs.group_taints, pad_t, pad_k)
     labels = pad(inputs.group_labels, pad_t, pad_l)
 
+    has_forbidden = inputs.pod_group_forbidden is not None
+    operands = [req, valid, intol, required, weight, alloc_t, taints, labels]
+    in_specs = [
+        pl.BlockSpec(
+            (tile_p, n_resources), lambda i: (i, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec(
+            (tile_p, 1), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        pl.BlockSpec(
+            (tile_p, pad_k), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        pl.BlockSpec(
+            (tile_p, pad_l), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        pl.BlockSpec(
+            (tile_p, 1), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        pl.BlockSpec(
+            (pad_r, pad_t), lambda i: (0, 0), memory_space=pltpu.VMEM
+        ),
+        pl.BlockSpec(
+            (pad_t, pad_k), lambda i: (0, 0), memory_space=pltpu.VMEM
+        ),
+        pl.BlockSpec(
+            (pad_t, pad_l), lambda i: (0, 0), memory_space=pltpu.VMEM
+        ),
+    ]
+    if has_forbidden:
+        operands.append(pad(inputs.pod_group_forbidden, pad_p, pad_t))
+        in_specs.append(
+            pl.BlockSpec(
+                (tile_p, pad_t), lambda i: (i, 0), memory_space=pltpu.VMEM
+            )
+        )
+
     n_tiles = pad_p // tile_p
     grid = (n_tiles,)
 
     assigned2d, hist, demand = pl.pallas_call(
-        partial(_kernel, buckets=buckets, n_resources=n_resources),
+        partial(
+            _kernel,
+            buckets=buckets,
+            n_resources=n_resources,
+            has_forbidden=has_forbidden,
+        ),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec(
-                (tile_p, n_resources), lambda i: (i, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (tile_p, 1), lambda i: (i, 0), memory_space=pltpu.VMEM
-            ),
-            pl.BlockSpec(
-                (tile_p, pad_k), lambda i: (i, 0), memory_space=pltpu.VMEM
-            ),
-            pl.BlockSpec(
-                (tile_p, pad_l), lambda i: (i, 0), memory_space=pltpu.VMEM
-            ),
-            pl.BlockSpec(
-                (tile_p, 1), lambda i: (i, 0), memory_space=pltpu.VMEM
-            ),
-            pl.BlockSpec(
-                (pad_r, pad_t), lambda i: (0, 0), memory_space=pltpu.VMEM
-            ),
-            pl.BlockSpec(
-                (pad_t, pad_k), lambda i: (0, 0), memory_space=pltpu.VMEM
-            ),
-            pl.BlockSpec(
-                (pad_t, pad_l), lambda i: (0, 0), memory_space=pltpu.VMEM
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec(
                 (tile_p, 1), lambda i: (i, 0), memory_space=pltpu.VMEM
@@ -270,7 +293,7 @@ def fused_assign(
             transcendentals=0,
         ),
         interpret=interpret,
-    )(req, valid, intol, required, weight, alloc_t, taints, labels)
+    )(*operands)
 
     assigned = assigned2d.reshape(-1)[:n_pods]
     # padded groups are index >= n_groups and never win the min-index

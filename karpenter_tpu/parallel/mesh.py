@@ -113,13 +113,17 @@ def _row_axes(mesh: Mesh):
 # ---------------------------------------------------------------------------
 
 
-def binpack_shardings(mesh: Mesh, with_weight: bool = False) -> BinPackInputs:
+def binpack_shardings(
+    mesh: Mesh, with_weight: bool = False, with_forbidden: bool = False
+) -> BinPackInputs:
     """A BinPackInputs-shaped pytree of NamedShardings.
 
     Pod-major arrays shard their leading dim over "pods"; group-major arrays
     over "groups". Constraint-universe axes (R, K, L) are small and
     replicated. pod_weight (present only for deduplicated inputs) rides the
-    pods axis like every other row-major array.
+    pods axis like every other row-major array; pod_group_forbidden is the
+    one 2D pods x groups array and shards over BOTH mesh axes — the same
+    tiling the feasibility matrix it masks gets from GSPMD.
     """
     s = lambda *spec: NamedSharding(mesh, P(*spec))
     rows = _row_axes(mesh)  # (slice, pods) on a 3D multi-host mesh
@@ -132,6 +136,7 @@ def binpack_shardings(mesh: Mesh, with_weight: bool = False) -> BinPackInputs:
         group_taints=s(AXIS_GROUPS, None),
         group_labels=s(AXIS_GROUPS, None),
         pod_weight=s(rows) if with_weight else None,
+        pod_group_forbidden=s(rows, AXIS_GROUPS) if with_forbidden else None,
     )
 
 
@@ -208,6 +213,19 @@ def pad_binpack_inputs_for_mesh(
             if inputs.pod_weight is None
             else pad0(inputs.pod_weight, P1)  # zero weight: inert rows
         ),
+        pod_group_forbidden=(
+            None
+            if inputs.pod_group_forbidden is None
+            # padding rows are invalid and padding columns zero-alloc, so
+            # False (= not forbidden) padding stays inert on both axes
+            else jnp.pad(
+                inputs.pod_group_forbidden,
+                [
+                    (0, P1 - inputs.pod_group_forbidden.shape[0]),
+                    (0, T1 - inputs.pod_group_forbidden.shape[1]),
+                ],
+            )
+        ),
     )
 
 
@@ -236,7 +254,11 @@ def shard_binpack_inputs(mesh: Mesh, inputs: BinPackInputs) -> BinPackInputs:
     inputs = pad_binpack_inputs_for_mesh(inputs, mesh)
     return jax.device_put(
         inputs,
-        binpack_shardings(mesh, with_weight=inputs.pod_weight is not None),
+        binpack_shardings(
+            mesh,
+            with_weight=inputs.pod_weight is not None,
+            with_forbidden=inputs.pod_group_forbidden is not None,
+        ),
     )
 
 

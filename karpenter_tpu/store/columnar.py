@@ -43,6 +43,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from karpenter_tpu.api.core import affinity_shape as _affinity_shape
 from karpenter_tpu.store.store import DELETED, Store
 
 # seed columns; extended resources append after in arrival order.
@@ -81,6 +82,7 @@ class _SparsePod:
     selector: List[Tuple[str, str]]
     shape: tuple
     tolerations: list
+    affinity: tuple = ()  # canonical required-node-affinity shape
 
 
 class PendingPodCache:
@@ -112,6 +114,10 @@ class PendingPodCache:
         self._shapes: List[tuple] = []
         self._shape_index: Dict[tuple, int] = {}
         self._shape_tolerations: List[list] = []
+        # required-node-affinity shapes (api/core.affinity_shape tuples);
+        # id 0 is the unconstrained shape so zeroed slots stay neutral
+        self._affinity_shapes: List[tuple] = [()]
+        self._affinity_index: Dict[tuple, int] = {(): 0}
         # incremental shape-dedup: canonical pod key -> live slots with that
         # key. Maintained at event time so snapshot() emits (rep row,
         # multiplicity) pairs in O(distinct shapes) — the per-tick
@@ -125,6 +131,7 @@ class PendingPodCache:
         )
         self._required = np.zeros((capacity, 8), bool)
         self._shape_id = np.zeros(capacity, np.int32)
+        self._affinity_id = np.zeros(capacity, np.int32)
         self._valid = np.zeros(capacity, bool)
 
         self._slot: Dict[Tuple[str, str], int] = {}
@@ -151,6 +158,7 @@ class PendingPodCache:
         self._requests[slot, :] = 0.0
         self._required[slot, :] = False
         self._shape_id[slot] = 0
+        self._affinity_id[slot] = 0
         self._sparse.pop(slot, None)
         self._dedup_discard(slot)
         self._free.append(slot)
@@ -184,6 +192,7 @@ class PendingPodCache:
                 )
             ),
             tolerations=list(pod.spec.tolerations),
+            affinity=_affinity_shape(pod.spec.affinity),
         )
         slot = self._slot.get(key)
         if slot is None:
@@ -210,6 +219,12 @@ class PendingPodCache:
             self._shapes.append(sparse.shape)
             self._shape_tolerations.append(sparse.tolerations)
         self._shape_id[slot] = shape_id
+        affinity_id = self._affinity_index.get(sparse.affinity)
+        if affinity_id is None:
+            affinity_id = len(self._affinity_shapes)
+            self._affinity_index[sparse.affinity] = affinity_id
+            self._affinity_shapes.append(sparse.affinity)
+        self._affinity_id[slot] = affinity_id
         self._valid[slot] = True
         self._sparse[slot] = sparse
         # dedup maintenance: two slots share a key iff their canonical
@@ -221,6 +236,7 @@ class PendingPodCache:
             tuple(sorted(sparse.requests)),
             tuple(sparse.selector),
             sparse.shape,
+            sparse.affinity,
         )
         if self._slot_key.get(slot) != dedup_key:
             self._dedup_discard(slot)
@@ -276,6 +292,7 @@ class PendingPodCache:
             self._requests = self._grow_rows(self._requests)
             self._required = self._grow_rows(self._required)
             self._shape_id = self._grow_rows(self._shape_id)
+            self._affinity_id = self._grow_rows(self._affinity_id)
             self._valid = self._grow_rows(self._valid)
         slot = self._hi
         self._hi += 1
@@ -354,6 +371,8 @@ class PendingPodCache:
                 generation=self._generation,
                 dedup_idx=reps,
                 dedup_weight=weights,
+                affinity_id=self._affinity_id[:hi].copy(),
+                affinity_shapes=list(self._affinity_shapes),
             )
             self._snap_memo = (self._generation, snap)
             return snap
@@ -611,3 +630,8 @@ class PendingSnapshot:                        # no 100k-row reprs in logs
     # canonicalizes order by row bytes
     dedup_idx: Optional[np.ndarray] = None
     dedup_weight: Optional[np.ndarray] = None
+    # required node affinity: per-row shape id into affinity_shapes
+    # (canonical api/core.affinity_shape tuples; id 0 = unconstrained).
+    # None on hand-built snapshots = no pod constrains affinity.
+    affinity_id: Optional[np.ndarray] = None
+    affinity_shapes: Optional[List[tuple]] = None

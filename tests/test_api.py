@@ -279,6 +279,87 @@ class TestCoreObjects:
         assert str(totals["memory"]) == "1Gi"
         assert "ephemeral-storage" not in totals
 
+    def test_affinity_requirement_operators(self):
+        from karpenter_tpu.api.core import _requirement_matches as m
+
+        labels = {"zone": "us-east1-a", "tier": "3", "arch": "arm64"}
+        assert m(labels, "zone", "In", ("us-east1-a", "us-east1-b"))
+        assert not m(labels, "zone", "In", ("us-west1-a",))
+        assert not m(labels, "missing", "In", ("x",))
+        assert m(labels, "zone", "NotIn", ("us-west1-a",))
+        assert not m(labels, "zone", "NotIn", ("us-east1-a",))
+        assert m(labels, "missing", "NotIn", ("x",))  # absent satisfies NotIn
+        assert m(labels, "arch", "Exists", ())
+        assert not m(labels, "missing", "Exists", ())
+        assert m(labels, "missing", "DoesNotExist", ())
+        assert not m(labels, "arch", "DoesNotExist", ())
+        assert m(labels, "tier", "Gt", ("2",))
+        assert not m(labels, "tier", "Gt", ("3",))
+        assert m(labels, "tier", "Lt", ("4",))
+        assert not m(labels, "missing", "Gt", ("1",))
+        assert not m(labels, "arch", "Gt", ("1",))  # non-integer value
+        assert not m(labels, "tier", "Bogus", ("1",))  # unknown operator
+
+    def test_affinity_shape_and_matching(self):
+        from karpenter_tpu.api.core import (
+            Affinity,
+            NodeAffinity,
+            NodeSelector,
+            NodeSelectorRequirement,
+            NodeSelectorTerm,
+            affinity_shape,
+            matches_affinity_shape,
+        )
+
+        affinity = Affinity(
+            node_affinity=NodeAffinity(
+                required_during_scheduling_ignored_during_execution=NodeSelector(
+                    node_selector_terms=[
+                        NodeSelectorTerm(
+                            match_expressions=[
+                                NodeSelectorRequirement(
+                                    key="zone", operator="In",
+                                    values=["a", "b"],
+                                ),
+                                NodeSelectorRequirement(
+                                    key="gpu", operator="DoesNotExist",
+                                ),
+                            ]
+                        ),
+                        NodeSelectorTerm(
+                            match_expressions=[
+                                NodeSelectorRequirement(
+                                    key="tier", operator="Exists",
+                                )
+                            ]
+                        ),
+                    ]
+                )
+            )
+        )
+        shape = affinity_shape(affinity)
+        # term 1: zone in {a,b} AND no gpu label; term 2 (OR): tier exists
+        assert matches_affinity_shape({"zone": "a"}, shape)
+        assert not matches_affinity_shape({"zone": "a", "gpu": "1"}, shape)
+        assert matches_affinity_shape({"gpu": "1", "tier": "x"}, shape)
+        assert not matches_affinity_shape({"zone": "c"}, shape)
+        # empty/None affinity is unconstrained
+        assert affinity_shape(None) == ()
+        assert affinity_shape(Affinity()) == ()
+        assert matches_affinity_shape({}, ())
+        # an empty term matches nothing (k8s nodeaffinity helpers), so an
+        # affinity of ONLY empty terms matches nothing
+        empty_term = affinity_shape(
+            Affinity(
+                node_affinity=NodeAffinity(
+                    required_during_scheduling_ignored_during_execution=(
+                        NodeSelector(node_selector_terms=[NodeSelectorTerm()])
+                    )
+                )
+            )
+        )
+        assert not matches_affinity_shape({"zone": "a"}, empty_term)
+
     def test_pod_effective_requests_no_init_no_overhead(self):
         pod = Pod(
             spec=PodSpec(

@@ -669,6 +669,82 @@ class TestShapeDedup:
         assert keyed(inc_idx, inc_w, True) == keyed(uni_idx, uni_w, False)
         assert sum(keyed(inc_idx, inc_w, True).values()) == len(live)
 
+    def test_node_affinity_constrains_the_solve(self):
+        """Required node affinity (NotIn) steers pods off a group on every
+        encode path, and pods differing ONLY by affinity dedup apart."""
+        from karpenter_tpu.api.core import (
+            Affinity,
+            NodeAffinity,
+            NodeSelector,
+            NodeSelectorRequirement,
+            NodeSelectorTerm,
+        )
+        from karpenter_tpu.metrics.producers.pendingcapacity import (
+            _group_profile,
+        )
+        from karpenter_tpu.store.columnar import PendingFeed
+
+        store = Store()
+        feed = PendingFeed(store, _group_profile)
+        cache = PendingPodCache(store)
+        store.create(
+            node("n0", {"group": "a", "disk": "hdd"}, cpu="8", mem="32Gi")
+        )
+        store.create(
+            node("n1", {"group": "b", "disk": "ssd"}, cpu="8", mem="32Gi")
+        )
+        store.create(producer("mpa", {"group": "a"}))
+        store.create(producer("mpb", {"group": "b"}))
+        not_hdd = Affinity(
+            node_affinity=NodeAffinity(
+                required_during_scheduling_ignored_during_execution=(
+                    NodeSelector(
+                        node_selector_terms=[
+                            NodeSelectorTerm(
+                                match_expressions=[
+                                    NodeSelectorRequirement(
+                                        key="disk",
+                                        operator="NotIn",
+                                        values=["hdd"],
+                                    )
+                                ]
+                            )
+                        ]
+                    )
+                )
+            )
+        )
+        # 4 unconstrained pods (first-feasible: group a) + 4 identical
+        # pods that refuse hdd (must go to group b)
+        for i in range(4):
+            store.create(pod(f"free{i}", cpu="2"))
+        for i in range(4):
+            p = pod(f"ssd{i}", cpu="2")
+            p.spec.affinity = not_hdd
+            store.create(p)
+        oracle, cached, fed = solve_both(store, cache, feed)
+        assert oracle == cached == fed
+        assert oracle["mpa"][0] == 4 and oracle["mpb"][0] == 4
+        assert oracle["mpa"][3] == 0 and oracle["mpb"][3] == 0  # none unsched
+        snap = cache.snapshot()
+        assert len(snap.dedup_idx) == 2  # same size/labels, split by affinity
+
+        # once every affinity pod is gone, the encode drops back to the
+        # maskless (no pod_group_forbidden) path even though the shape
+        # registry still remembers the affinity shape
+        import karpenter_tpu.metrics.producers.pendingcapacity as PC
+
+        for i in range(4):
+            store.delete("Pod", "default", f"ssd{i}")
+        snap = cache.snapshot()
+        assert any(s for s in snap.affinity_shapes)  # registry not pruned
+        profiles = [
+            ({"cpu": 8.0, "memory": 32.0 * 1024**3, "pods": 110.0},
+             {("group", "a"), ("disk", "hdd")}, set()),
+        ]
+        inputs = PC._encode_from_cache(snap, profiles)
+        assert inputs.pod_group_forbidden is None
+
     def test_effective_requests_drive_the_solve(self):
         """A pod whose init phase dwarfs its main phase must be packed by
         the init size (k8s scheduler fit semantics), on BOTH the feed and

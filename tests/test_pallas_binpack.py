@@ -66,6 +66,37 @@ class TestPallasMatchesXLA:
         pallas = PB.binpack_pallas(inputs, buckets=8, tile_p=64, interpret=True)
         assert_outputs_equal(xla, pallas)
 
+    @pytest.mark.parametrize("seed", range(3))
+    def test_forbidden_parity(self, seed):
+        """pod_group_forbidden (required node affinity, host-evaluated)
+        masks feasibility identically in both backends, weighted rows
+        included, and the constraint is actually enforced."""
+        import dataclasses
+
+        rng = np.random.default_rng(100 + seed)
+        base = random_inputs(rng, pods=203, types=37)
+        inputs = dataclasses.replace(
+            base,
+            pod_group_forbidden=jnp.asarray(rng.random((203, 37)) < 0.4),
+            pod_weight=jnp.asarray(
+                rng.integers(1, 2000, 203).astype(np.int32)
+            ),
+        )
+        xla = B.binpack(inputs, buckets=16)
+        pallas = PB.binpack_pallas(
+            inputs, buckets=16, tile_p=64, interpret=True
+        )
+        assert_outputs_equal(xla, pallas)
+        assigned = np.asarray(xla.assigned)
+        forbidden = np.asarray(inputs.pod_group_forbidden)
+        rows = np.arange(len(assigned))[assigned >= 0]
+        assert not forbidden[rows, assigned[assigned >= 0]].any()
+        # and the mask changes the outcome vs the unconstrained solve
+        free = B.binpack(
+            dataclasses.replace(inputs, pod_group_forbidden=None), buckets=16
+        )
+        assert not np.array_equal(np.asarray(free.assigned), assigned)
+
     def test_semantics_taints_and_labels(self):
         # group 0 tainted (pod 0 intolerant); group 1 lacks pod 1's label
         inputs = make_inputs(
@@ -161,6 +192,25 @@ class TestCompiledMosaic:
         xla = B.binpack(weighted, buckets=16)
         pallas = PB.binpack_pallas(
             weighted, buckets=16, tile_p=128, interpret=False
+        )
+        assert_outputs_equal(xla, pallas)
+
+    def test_compiled_forbidden_equals_xla_on_tpu(self):
+        """The affinity mask input compiles through Mosaic (one more
+        [TILE_P, T] VMEM operand) and matches XLA on hardware."""
+        import dataclasses
+
+        rng = np.random.default_rng(8)
+        inputs = dataclasses.replace(
+            random_inputs(rng, pods=512, types=24),
+            pod_group_forbidden=jnp.asarray(rng.random((512, 24)) < 0.3),
+            pod_weight=jnp.asarray(
+                rng.integers(1000, 5000, 512).astype(np.int32)
+            ),
+        )
+        xla = B.binpack(inputs, buckets=16)
+        pallas = PB.binpack_pallas(
+            inputs, buckets=16, tile_p=128, interpret=False
         )
         assert_outputs_equal(xla, pallas)
 

@@ -185,6 +185,31 @@ def test_sharded_weighted_binpack_matches_single_device(n_devices):
     assert int(out.unschedulable) == int(ref.unschedulable)
 
 
+@pytest.mark.parametrize("n_devices", [2, 8])
+def test_sharded_forbidden_binpack_matches_single_device(n_devices):
+    """pod_group_forbidden (required node affinity) is the one 2D
+    pods x groups input: it shards over BOTH mesh axes and must leave
+    sharded == single-device, with padding on both dims inert."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(27)
+    inputs = dataclasses.replace(
+        example_binpack_inputs(P_=37, T=5, K=8, L=8, seed=27),
+        pod_weight=jnp.asarray(rng.integers(1, 50, 37).astype(np.int32)),
+        pod_group_forbidden=jnp.asarray(rng.random((37, 5)) < 0.4),
+    )
+    ref = jax.device_get(binpack(inputs, buckets=8))
+    mesh = build_mesh(n_devices=n_devices)
+    out = jax.device_get(sharded_binpack(mesh, inputs, buckets=8))
+    np.testing.assert_array_equal(out.assigned, ref.assigned)
+    np.testing.assert_array_equal(out.assigned_count, ref.assigned_count)
+    np.testing.assert_array_equal(out.nodes_needed, ref.nodes_needed)
+    np.testing.assert_array_equal(out.lp_bound, ref.lp_bound)
+    assert int(out.unschedulable) == int(ref.unschedulable)
+
+
 def test_sliced_mesh_matches_single_device():
     """3D slice×pods×groups mesh (multi-host DCN model): pod rows shard
     over (slice, pods); outputs must equal the single-device solve, and

@@ -102,6 +102,53 @@ class TestCodecPosture:
         with pytest.raises(ValueError):
             from_manifest({"kind": "Widget"})
 
+    def test_pod_affinity_roundtrip(self):
+        """core/v1 nodeAffinity manifest dialect hydrates reflectively
+        (requiredDuringSchedulingIgnoredDuringExecution and all)."""
+        pod = from_manifest(
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {"name": "p"},
+                "spec": {
+                    "containers": [{"requests": {"cpu": "1"}}],
+                    "affinity": {
+                        "nodeAffinity": {
+                            "requiredDuringSchedulingIgnoredDuringExecution": {
+                                "nodeSelectorTerms": [
+                                    {
+                                        "matchExpressions": [
+                                            {
+                                                "key": "zone",
+                                                "operator": "NotIn",
+                                                "values": ["z1", "z2"],
+                                            }
+                                        ]
+                                    }
+                                ]
+                            }
+                        }
+                    },
+                },
+            }
+        )
+        from karpenter_tpu.api.core import (
+            affinity_shape,
+            matches_affinity_shape,
+        )
+
+        shape = affinity_shape(pod.spec.affinity)
+        assert shape == ((("zone", "NotIn", ("z1", "z2")),),)
+        assert matches_affinity_shape({"zone": "z3"}, shape)
+        assert not matches_affinity_shape({"zone": "z1"}, shape)
+        from karpenter_tpu.api.serialization import to_dict
+
+        doc = to_dict(pod)
+        terms = doc["spec"]["affinity"]["nodeAffinity"][
+            "requiredDuringSchedulingIgnoredDuringExecution"
+        ]["nodeSelectorTerms"]
+        assert terms[0]["matchExpressions"][0]["operator"] == "NotIn"
+
     def test_pod_init_containers_and_overhead_roundtrip(self):
         """core/v1 manifest dialect: initContainers + overhead hydrate and
         dump, and effective_requests reflects them."""
