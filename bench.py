@@ -60,7 +60,10 @@ def emit(metric: str, value, note: str = "", error: str = "") -> None:
     print(json.dumps(rec))
 
 
-def build_inputs(pods: int, types: int, taints: int, labels: int, seed: int):
+def build_inputs(
+    pods: int, types: int, taints: int, labels: int, seed: int,
+    affinity: float = 0.0,
+):
     import jax.numpy as jnp
 
     from karpenter_tpu.ops.binpack import BinPackInputs
@@ -84,6 +87,15 @@ def build_inputs(pods: int, types: int, taints: int, labels: int, seed: int):
     group_taints = rng.random((types, taints)) < 0.1
     required = rng.random((pods, labels)) < 0.03
     group_labels = rng.random((types, labels)) < 0.8
+    forbidden = None
+    if affinity > 0:
+        # fraction `affinity` of pods carry required node affinity; as in
+        # production, pods share a handful of distinct affinity shapes —
+        # each shape is a prototype forbidden row over the groups (the
+        # host-evaluated matchExpression verdicts)
+        prototypes = rng.random((4, types)) < 0.3
+        which = rng.integers(0, prototypes.shape[0], pods)
+        forbidden = prototypes[which] & (rng.random((pods, 1)) < affinity)
     return BinPackInputs(
         pod_requests=jnp.asarray(req),
         pod_valid=jnp.ones((pods,), bool),
@@ -92,6 +104,9 @@ def build_inputs(pods: int, types: int, taints: int, labels: int, seed: int):
         group_allocatable=jnp.asarray(alloc),
         group_taints=jnp.asarray(group_taints),
         group_labels=jnp.asarray(group_labels),
+        pod_group_forbidden=(
+            None if forbidden is None else jnp.asarray(forbidden)
+        ),
     )
 
 
@@ -146,6 +161,11 @@ def main() -> None:
     ap.add_argument("--buckets", type=int, default=32)
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--affinity", type=float, default=0.0,
+        help="fraction of pods carrying required node affinity (adds the "
+        "pod_group_forbidden [P, T] mask operand to the solve)",
+    )
     ap.add_argument(
         "--backend",
         choices=("auto", "xla", "pallas"),
@@ -210,6 +230,13 @@ def main() -> None:
             "--clusters models its own workload (BASELINE config 5) and "
             "cannot combine with --mesh/--e2e/--decide; run it standalone"
         )
+    if args.affinity and (args.clusters or args.e2e or args.decide):
+        ap.error(
+            "--affinity applies to the direct solver bench (and --mesh) "
+            "only; --clusters/--e2e/--decide build their own workloads"
+        )
+    if not 0.0 <= args.affinity <= 1.0:
+        ap.error("--affinity must be a fraction in [0, 1]")
     if args.slices < 1:
         ap.error("--slices must be >= 1")
     if args.slices > 1 and not args.mesh:
@@ -294,7 +321,8 @@ def run(args, metric: str, note: str) -> None:
         )
     else:
         inputs = build_inputs(
-            args.pods, args.types, args.taints, args.labels, args.seed
+            args.pods, args.types, args.taints, args.labels, args.seed,
+            affinity=args.affinity,
         )
     inputs = jax.device_put(inputs)
     jax.block_until_ready(inputs)
@@ -418,7 +446,8 @@ def run_mesh(args, metric: str) -> None:
     mesh = build_mesh(n_devices=args.mesh, slices=args.slices)
     print(f"mesh: {dict(mesh.shape)} on {jax.default_backend()}", file=sys.stderr)
     inputs = build_inputs(
-        args.pods, args.types, args.taints, args.labels, args.seed
+        args.pods, args.types, args.taints, args.labels, args.seed,
+        affinity=args.affinity,
     )
 
     single = jax.device_get(binpack(inputs, buckets=args.buckets))

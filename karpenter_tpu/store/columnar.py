@@ -258,6 +258,14 @@ class PendingPodCache:
             )
             if len(self._shapes) > _COMPACT_FACTOR * max(1, live_shapes):
                 return True
+        if len(self._affinity_shapes) >= _COMPACT_FLOOR:
+            live_affinity = len(
+                {int(self._affinity_id[s]) for s in self._slot.values()}
+            )
+            if len(self._affinity_shapes) > _COMPACT_FACTOR * max(
+                1, live_affinity
+            ):
+                return True
         if len(self._labels) >= _COMPACT_FLOOR:
             live_labels: set = set()
             for sparse in self._sparse.values():

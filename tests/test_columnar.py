@@ -745,6 +745,54 @@ class TestShapeDedup:
         inputs = PC._encode_from_cache(snap, profiles)
         assert inputs.pod_group_forbidden is None
 
+    def test_affinity_shape_registry_compacts_after_churn(self):
+        """A stream of Jobs each pinning a DISTINCT affinity must not grow
+        the shape registry unboundedly: _needs_compaction watches
+        _affinity_shapes like the toleration-shape universe."""
+        from karpenter_tpu.api.core import (
+            Affinity,
+            NodeAffinity,
+            NodeSelector,
+            NodeSelectorRequirement,
+            NodeSelectorTerm,
+        )
+
+        def pin(zone):
+            return Affinity(
+                node_affinity=NodeAffinity(
+                    required_during_scheduling_ignored_during_execution=(
+                        NodeSelector(
+                            node_selector_terms=[
+                                NodeSelectorTerm(
+                                    match_expressions=[
+                                        NodeSelectorRequirement(
+                                            key="zone",
+                                            operator="In",
+                                            values=[zone],
+                                        )
+                                    ]
+                                )
+                            ]
+                        )
+                    )
+                )
+            )
+
+        store = Store()
+        cache = PendingPodCache(store)
+        for i in range(300):  # distinct shapes, all churned away
+            p = pod(f"job{i}", cpu="1")
+            p.spec.affinity = pin(f"z{i}")
+            store.create(p)
+            store.delete("Pod", "default", f"job{i}")
+        for i in range(5):  # small live set
+            p = pod(f"live{i}", cpu="1")
+            p.spec.affinity = pin("keep")
+            store.create(p)
+        snap = cache.snapshot()  # snapshot() compacts when peak >> live
+        assert len(snap.affinity_shapes) < 300 // 4
+        assert len(cache) == 5
+
     def test_effective_requests_drive_the_solve(self):
         """A pod whose init phase dwarfs its main phase must be packed by
         the init size (k8s scheduler fit semantics), on BOTH the feed and

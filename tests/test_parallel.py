@@ -230,6 +230,9 @@ def test_sliced_mesh_matches_single_device():
     weighted = dataclasses.replace(
         example_binpack_inputs(P_=45, T=6, K=8, L=8, seed=33),
         pod_weight=jnp.asarray(rng.integers(1, 20, 45).astype(np.int32)),
+        # forbidden is the one (slice, pods) x groups sharded operand:
+        # cover its two-axis row spec on the 3D mesh too
+        pod_group_forbidden=jnp.asarray(rng.random((45, 6)) < 0.3),
     )
     ref = jax.device_get(binpack(weighted, buckets=8))
     out = jax.device_get(sharded_binpack(mesh, weighted, buckets=8))
