@@ -104,12 +104,23 @@ class NodeSelector:
 
 
 @dataclass
+class PreferredSchedulingTerm:
+    weight: int = 1  # 1-100 (core/v1)
+    preference: NodeSelectorTerm = field(default_factory=NodeSelectorTerm)
+
+
+@dataclass
 class NodeAffinity:
-    # preferredDuringScheduling is a soft ordering hint, invisible to
-    # fit feasibility — not modeled
     required_during_scheduling_ignored_during_execution: Optional[
         NodeSelector
     ] = None
+    # soft ordering: never makes an infeasible group feasible, but among
+    # feasible groups the solver assigns each pod to its highest-scoring
+    # group (weight-sum of matching preferences), index tie-break — the
+    # kube-scheduler's NodeAffinity scoring plugin semantics
+    preferred_during_scheduling_ignored_during_execution: List[
+        PreferredSchedulingTerm
+    ] = field(default_factory=list)
 
 
 @dataclass
@@ -137,6 +148,45 @@ def affinity_shape(affinity: Optional[Affinity]) -> tuple:
             )
         )
         for term in required.node_selector_terms
+    )
+
+
+def preferred_shape(affinity: Optional[Affinity]) -> tuple:
+    """Canonical hashable form of a pod's PREFERRED node affinity: sorted
+    (weight, term) pairs where term is the same canonical tuple
+    affinity_shape uses. () = no preferences. Terms with no expressions
+    are dropped (they can never match, k8s empty-term semantics)."""
+    if affinity is None or affinity.node_affinity is None:
+        return ()
+    preferred = (
+        affinity.node_affinity.preferred_during_scheduling_ignored_during_execution
+    )
+    if not preferred:
+        return ()
+    shape = []
+    for p in preferred:
+        term = tuple(
+            sorted(
+                (e.key, e.operator, tuple(sorted(e.values)))
+                for e in p.preference.match_expressions
+            )
+        )
+        if term:
+            shape.append((int(p.weight), term))
+    return tuple(sorted(shape))
+
+
+def preference_score(labels: Dict[str, str], shape: tuple) -> int:
+    """Weight-sum of matching preference terms (the NodeAffinity scoring
+    plugin's per-node sum, before normalization — ordering is all the
+    solver needs)."""
+    return sum(
+        weight
+        for weight, term in shape
+        if all(
+            _requirement_matches(labels, key, operator, values)
+            for key, operator, values in term
+        )
     )
 
 

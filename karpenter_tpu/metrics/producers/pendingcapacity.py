@@ -39,6 +39,7 @@ from karpenter_tpu.api.core import (
     is_ready_and_schedulable,
     matches_affinity_shape,
     matches_selector,
+    preference_score,
 )
 from karpenter_tpu.api.metricsproducer import PendingCapacityStatus
 from karpenter_tpu.metrics.registry import GaugeRegistry, default_registry
@@ -355,6 +356,12 @@ def _dedup_rows(snap):
                 .view(np.uint8)
                 .reshape(n, -1)
             )
+        if snap.preferred_id is not None:
+            parts.append(
+                np.ascontiguousarray(snap.preferred_id[idx])
+                .view(np.uint8)
+                .reshape(n, -1)
+            )
         rows = np.ascontiguousarray(np.concatenate(parts, axis=1))
         return rows.view([("k", np.void, rows.shape[1])]).ravel()
 
@@ -459,20 +466,49 @@ def _encode_from_cache(snap, profiles) -> "B.BinPackInputs":
         if hi and snap.affinity_id is not None and shapes is not None
         else None
     )
+    label_dicts = None  # built once, shared by both affinity blocks
+
+    def group_label_dicts():
+        nonlocal label_dicts
+        if label_dicts is None:
+            label_dicts = [dict(labels) for _, labels, _ in profiles]
+        return label_dicts
+
     # gate on LIVE rows (shape id 0 = unconstrained): the shape registry
     # retains entries until compaction, and a long-gone affinity Job must
     # not keep the whole fleet on the masked (extra-operand) kernel path
     if live_affinity_ids is not None and (live_affinity_ids != 0).any():
         allowed = np.ones((len(shapes), n_groups), bool)
-        label_dicts = [dict(labels) for _, labels, _ in profiles]
         for s in np.unique(live_affinity_ids):  # only shapes in live use
             shape = shapes[s]
             if not shape:
                 continue
-            for t, labels in enumerate(label_dicts):
+            for t, labels in enumerate(group_label_dicts()):
                 allowed[s, t] = matches_affinity_shape(labels, shape)
         pod_group_forbidden = np.zeros((n_pods, n_groups), bool)
         pod_group_forbidden[:hi] = ~allowed[live_affinity_ids]
+
+    # Preferred node affinity: same distinct-shape host evaluation, but
+    # the verdicts are weight-sums steering assignment among feasible
+    # groups (ops/binpack.py pod_group_score) — absent unless some live
+    # pod actually prefers
+    pod_group_score = None
+    pref_shapes = snap.preferred_shapes
+    live_preferred_ids = (
+        snap.preferred_id[row_idx]
+        if hi and snap.preferred_id is not None and pref_shapes is not None
+        else None
+    )
+    if live_preferred_ids is not None and (live_preferred_ids != 0).any():
+        scores = np.zeros((len(pref_shapes), n_groups), np.float32)
+        for s in np.unique(live_preferred_ids):
+            shape = pref_shapes[s]
+            if not shape:
+                continue
+            for t, labels in enumerate(group_label_dicts()):
+                scores[s, t] = preference_score(labels, shape)
+        pod_group_score = np.zeros((n_pods, n_groups), np.float32)
+        pod_group_score[:hi] = scores[live_preferred_ids]
 
     return B.BinPackInputs(
         pod_requests=pod_requests,
@@ -484,6 +520,7 @@ def _encode_from_cache(snap, profiles) -> "B.BinPackInputs":
         group_labels=group_labels,
         pod_weight=pod_weight,
         pod_group_forbidden=pod_group_forbidden,
+        pod_group_score=pod_group_score,
     )
 
 

@@ -75,6 +75,14 @@ class BinPackInputs:
     # deduplicated shapes, so this stays KB-scale. None = no pod
     # constrains affinity (the common case costs nothing).
     pod_group_forbidden: Optional[jax.Array] = None
+    # f32[P, T]: pod p's PREFERRED node affinity score for group t
+    # (weight-sum of matching preference terms, host-evaluated per
+    # distinct shape like the mask above). Never affects feasibility;
+    # among feasible groups the pod assigns to its max-score group with
+    # lowest-index tie-break — score None or all-equal degenerates to
+    # exactly the first-feasible rule. Integer-valued (weight sums
+    # <= 100 x terms), so f32 comparison is exact.
+    pod_group_score: Optional[jax.Array] = None
 
 
 @jax.tree_util.register_dataclass
@@ -202,11 +210,18 @@ def binpack(inputs: BinPackInputs, buckets: int = DEFAULT_BUCKETS) -> BinPackOut
     feasible = _feasibility(inputs)  # [P, T]
     share = _dominant_share(inputs)  # [P, T]
 
-    # first feasible group wins (argmax returns the first True)
+    # first feasible group wins (argmax returns the first True); with
+    # preference scores, highest score among feasible wins and argmax's
+    # first-max rule provides the lowest-index tie-break — identical to
+    # first-feasible when scores are absent or uniform
     any_feasible = jnp.any(feasible, axis=1)
-    assigned = jnp.where(
-        any_feasible, jnp.argmax(feasible, axis=1).astype(jnp.int32), -1
-    )
+    if inputs.pod_group_score is None:
+        choice = jnp.argmax(feasible, axis=1)
+    else:
+        choice = jnp.argmax(
+            jnp.where(feasible, inputs.pod_group_score, -jnp.inf), axis=1
+        )
+    assigned = jnp.where(any_feasible, choice.astype(jnp.int32), -1)
     n_groups = inputs.group_allocatable.shape[0]
     member = (
         (assigned[:, None] == jnp.arange(n_groups, dtype=jnp.int32)[None, :])

@@ -53,17 +53,18 @@ def _kernel(
     taints_ref,  # f32[T, K]
     labels_ref,  # f32[T, L]
     *rest,  # [forbidden_ref f32[TILE_P, T] when has_forbidden,]
+    #         [score_ref f32[TILE_P, T] when has_score,]
     #         assigned_ref i32[TILE_P, 1], hist_ref f32[T, B],
     #         demand_ref f32[T, R]
     buckets: int,
     n_resources: int,
     has_forbidden: bool = False,
+    has_score: bool = False,
 ):
-    if has_forbidden:
-        forbidden_ref, assigned_ref, hist_ref, demand_ref = rest
-    else:
-        forbidden_ref = None
-        assigned_ref, hist_ref, demand_ref = rest
+    rest = list(rest)
+    forbidden_ref = rest.pop(0) if has_forbidden else None
+    score_ref = rest.pop(0) if has_score else None
+    assigned_ref, hist_ref, demand_ref = rest
     # Everything stays 2D: Mosaic lowers static row/column slices and 2D
     # broadcasts, but not the gathers that 1D intermediates / fancy
     # indexing produce.
@@ -104,10 +105,19 @@ def _kernel(
 
     feasible = fits > 0.5  # bool[TILE_P, T]
 
-    # --- first-feasible assignment: min feasible column index ----------
+    # --- assignment: min feasible column index, or (with preference
+    # scores) the min index among max-score feasible groups — f32 score
+    # equality is exact because scores are integer weight sums ----------
     col = jax.lax.broadcasted_iota(jnp.int32, (tile_p, n_groups), 1)
+    if score_ref is not None:
+        big = jnp.float32(3.4e38)
+        masked = jnp.where(feasible, score_ref[:], -big)
+        best = jnp.max(masked, axis=1, keepdims=True)  # [TILE_P, 1]
+        candidate = feasible & (masked == best)
+    else:
+        candidate = feasible
     first = jnp.min(
-        jnp.where(feasible, col, n_groups), axis=1, keepdims=True
+        jnp.where(candidate, col, n_groups), axis=1, keepdims=True
     )  # [TILE_P, 1], == n_groups when none
     has = first < n_groups  # [TILE_P, 1]
     assigned_ref[:] = jnp.where(has, first, -1)
@@ -218,6 +228,7 @@ def fused_assign(
     labels = pad(inputs.group_labels, pad_t, pad_l)
 
     has_forbidden = inputs.pod_group_forbidden is not None
+    has_score = inputs.pod_group_score is not None
     operands = [req, valid, intol, required, weight, alloc_t, taints, labels]
     in_specs = [
         pl.BlockSpec(
@@ -253,6 +264,15 @@ def fused_assign(
                 (tile_p, pad_t), lambda i: (i, 0), memory_space=pltpu.VMEM
             )
         )
+    if has_score:
+        # score padding is 0 on padded group columns; they are infeasible
+        # (zero allocatable), so the -big mask keeps them out regardless
+        operands.append(pad(inputs.pod_group_score, pad_p, pad_t))
+        in_specs.append(
+            pl.BlockSpec(
+                (tile_p, pad_t), lambda i: (i, 0), memory_space=pltpu.VMEM
+            )
+        )
 
     n_tiles = pad_p // tile_p
     grid = (n_tiles,)
@@ -263,6 +283,7 @@ def fused_assign(
             buckets=buckets,
             n_resources=n_resources,
             has_forbidden=has_forbidden,
+            has_score=has_score,
         ),
         grid=grid,
         in_specs=in_specs,

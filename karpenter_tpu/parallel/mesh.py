@@ -114,7 +114,10 @@ def _row_axes(mesh: Mesh):
 
 
 def binpack_shardings(
-    mesh: Mesh, with_weight: bool = False, with_forbidden: bool = False
+    mesh: Mesh,
+    with_weight: bool = False,
+    with_forbidden: bool = False,
+    with_score: bool = False,
 ) -> BinPackInputs:
     """A BinPackInputs-shaped pytree of NamedShardings.
 
@@ -137,6 +140,7 @@ def binpack_shardings(
         group_labels=s(AXIS_GROUPS, None),
         pod_weight=s(rows) if with_weight else None,
         pod_group_forbidden=s(rows, AXIS_GROUPS) if with_forbidden else None,
+        pod_group_score=s(rows, AXIS_GROUPS) if with_score else None,
     )
 
 
@@ -226,6 +230,18 @@ def pad_binpack_inputs_for_mesh(
                 ],
             )
         ),
+        pod_group_score=(
+            None
+            if inputs.pod_group_score is None
+            # zero-score padding: padded columns are infeasible anyway
+            else jnp.pad(
+                inputs.pod_group_score,
+                [
+                    (0, P1 - inputs.pod_group_score.shape[0]),
+                    (0, T1 - inputs.pod_group_score.shape[1]),
+                ],
+            )
+        ),
     )
 
 
@@ -258,6 +274,7 @@ def shard_binpack_inputs(mesh: Mesh, inputs: BinPackInputs) -> BinPackInputs:
             mesh,
             with_weight=inputs.pod_weight is not None,
             with_forbidden=inputs.pod_group_forbidden is not None,
+            with_score=inputs.pod_group_score is not None,
         ),
     )
 

@@ -44,6 +44,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from karpenter_tpu.api.core import affinity_shape as _affinity_shape
+from karpenter_tpu.api.core import preferred_shape as _preferred_shape
 from karpenter_tpu.store.store import DELETED, Store
 
 # seed columns; extended resources append after in arrival order.
@@ -83,6 +84,7 @@ class _SparsePod:
     shape: tuple
     tolerations: list
     affinity: tuple = ()  # canonical required-node-affinity shape
+    preferred: tuple = ()  # canonical preferred-node-affinity shape
 
 
 class PendingPodCache:
@@ -118,6 +120,9 @@ class PendingPodCache:
         # id 0 is the unconstrained shape so zeroed slots stay neutral
         self._affinity_shapes: List[tuple] = [()]
         self._affinity_index: Dict[tuple, int] = {(): 0}
+        # preferred-node-affinity shapes (api/core.preferred_shape)
+        self._preferred_shapes: List[tuple] = [()]
+        self._preferred_index: Dict[tuple, int] = {(): 0}
         # incremental shape-dedup: canonical pod key -> live slots with that
         # key. Maintained at event time so snapshot() emits (rep row,
         # multiplicity) pairs in O(distinct shapes) — the per-tick
@@ -132,6 +137,7 @@ class PendingPodCache:
         self._required = np.zeros((capacity, 8), bool)
         self._shape_id = np.zeros(capacity, np.int32)
         self._affinity_id = np.zeros(capacity, np.int32)
+        self._preferred_id = np.zeros(capacity, np.int32)
         self._valid = np.zeros(capacity, bool)
 
         self._slot: Dict[Tuple[str, str], int] = {}
@@ -159,6 +165,7 @@ class PendingPodCache:
         self._required[slot, :] = False
         self._shape_id[slot] = 0
         self._affinity_id[slot] = 0
+        self._preferred_id[slot] = 0
         self._sparse.pop(slot, None)
         self._dedup_discard(slot)
         self._free.append(slot)
@@ -193,6 +200,7 @@ class PendingPodCache:
             ),
             tolerations=list(pod.spec.tolerations),
             affinity=_affinity_shape(pod.spec.affinity),
+            preferred=_preferred_shape(pod.spec.affinity),
         )
         slot = self._slot.get(key)
         if slot is None:
@@ -225,6 +233,12 @@ class PendingPodCache:
             self._affinity_index[sparse.affinity] = affinity_id
             self._affinity_shapes.append(sparse.affinity)
         self._affinity_id[slot] = affinity_id
+        preferred_id = self._preferred_index.get(sparse.preferred)
+        if preferred_id is None:
+            preferred_id = len(self._preferred_shapes)
+            self._preferred_index[sparse.preferred] = preferred_id
+            self._preferred_shapes.append(sparse.preferred)
+        self._preferred_id[slot] = preferred_id
         self._valid[slot] = True
         self._sparse[slot] = sparse
         # dedup maintenance: two slots share a key iff their canonical
@@ -237,6 +251,7 @@ class PendingPodCache:
             tuple(sparse.selector),
             sparse.shape,
             sparse.affinity,
+            sparse.preferred,
         )
         if self._slot_key.get(slot) != dedup_key:
             self._dedup_discard(slot)
@@ -264,6 +279,14 @@ class PendingPodCache:
             )
             if len(self._affinity_shapes) > _COMPACT_FACTOR * max(
                 1, live_affinity
+            ):
+                return True
+        if len(self._preferred_shapes) >= _COMPACT_FLOOR:
+            live_preferred = len(
+                {int(self._preferred_id[s]) for s in self._slot.values()}
+            )
+            if len(self._preferred_shapes) > _COMPACT_FACTOR * max(
+                1, live_preferred
             ):
                 return True
         if len(self._labels) >= _COMPACT_FLOOR:
@@ -301,6 +324,7 @@ class PendingPodCache:
             self._required = self._grow_rows(self._required)
             self._shape_id = self._grow_rows(self._shape_id)
             self._affinity_id = self._grow_rows(self._affinity_id)
+            self._preferred_id = self._grow_rows(self._preferred_id)
             self._valid = self._grow_rows(self._valid)
         slot = self._hi
         self._hi += 1
@@ -381,6 +405,8 @@ class PendingPodCache:
                 dedup_weight=weights,
                 affinity_id=self._affinity_id[:hi].copy(),
                 affinity_shapes=list(self._affinity_shapes),
+                preferred_id=self._preferred_id[:hi].copy(),
+                preferred_shapes=list(self._preferred_shapes),
             )
             self._snap_memo = (self._generation, snap)
             return snap
@@ -643,3 +669,6 @@ class PendingSnapshot:                        # no 100k-row reprs in logs
     # None on hand-built snapshots = no pod constrains affinity.
     affinity_id: Optional[np.ndarray] = None
     affinity_shapes: Optional[List[tuple]] = None
+    # preferred node affinity (api/core.preferred_shape; id 0 = none)
+    preferred_id: Optional[np.ndarray] = None
+    preferred_shapes: Optional[List[tuple]] = None

@@ -360,6 +360,54 @@ class TestCoreObjects:
         )
         assert not matches_affinity_shape({"zone": "a"}, empty_term)
 
+    def test_preferred_shape_and_scoring(self):
+        from karpenter_tpu.api.core import (
+            Affinity,
+            NodeAffinity,
+            NodeSelectorRequirement,
+            NodeSelectorTerm,
+            PreferredSchedulingTerm,
+            preference_score,
+            preferred_shape,
+        )
+
+        affinity = Affinity(
+            node_affinity=NodeAffinity(
+                preferred_during_scheduling_ignored_during_execution=[
+                    PreferredSchedulingTerm(
+                        weight=80,
+                        preference=NodeSelectorTerm(
+                            match_expressions=[
+                                NodeSelectorRequirement(
+                                    key="disk", operator="In", values=["ssd"]
+                                )
+                            ]
+                        ),
+                    ),
+                    PreferredSchedulingTerm(
+                        weight=20,
+                        preference=NodeSelectorTerm(
+                            match_expressions=[
+                                NodeSelectorRequirement(
+                                    key="zone", operator="In", values=["a"]
+                                )
+                            ]
+                        ),
+                    ),
+                    # empty preference term: can never match, dropped
+                    PreferredSchedulingTerm(weight=100),
+                ]
+            )
+        )
+        shape = preferred_shape(affinity)
+        assert len(shape) == 2
+        assert preference_score({"disk": "ssd", "zone": "a"}, shape) == 100
+        assert preference_score({"disk": "ssd"}, shape) == 80
+        assert preference_score({"zone": "a"}, shape) == 20
+        assert preference_score({}, shape) == 0
+        assert preferred_shape(None) == ()
+        assert preferred_shape(Affinity()) == ()
+
     def test_pod_effective_requests_no_init_no_overhead(self):
         pod = Pod(
             spec=PodSpec(

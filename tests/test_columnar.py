@@ -745,6 +745,69 @@ class TestShapeDedup:
         inputs = PC._encode_from_cache(snap, profiles)
         assert inputs.pod_group_forbidden is None
 
+    def test_preferred_affinity_steers_assignment(self):
+        """A pod preferring ssd (weight 80) goes to the ssd group even
+        though the hdd group comes first in producer order; identical on
+        every encode path; preferences never rescue infeasibility."""
+        from karpenter_tpu.api.core import (
+            Affinity,
+            NodeAffinity,
+            NodeSelectorRequirement,
+            NodeSelectorTerm,
+            PreferredSchedulingTerm,
+        )
+        from karpenter_tpu.metrics.producers.pendingcapacity import (
+            _group_profile,
+        )
+        from karpenter_tpu.store.columnar import PendingFeed
+
+        store = Store()
+        feed = PendingFeed(store, _group_profile)
+        cache = PendingPodCache(store)
+        store.create(
+            node("n0", {"group": "a", "disk": "hdd"}, cpu="8", mem="32Gi")
+        )
+        store.create(
+            node("n1", {"group": "b", "disk": "ssd"}, cpu="8", mem="32Gi")
+        )
+        store.create(producer("mpa", {"group": "a"}))
+        store.create(producer("mpb", {"group": "b"}))
+        prefer_ssd = Affinity(
+            node_affinity=NodeAffinity(
+                preferred_during_scheduling_ignored_during_execution=[
+                    PreferredSchedulingTerm(
+                        weight=80,
+                        preference=NodeSelectorTerm(
+                            match_expressions=[
+                                NodeSelectorRequirement(
+                                    key="disk", operator="In", values=["ssd"]
+                                )
+                            ]
+                        ),
+                    )
+                ]
+            )
+        )
+        for i in range(3):
+            store.create(pod(f"free{i}", cpu="2"))  # first-feasible -> a
+        for i in range(3):
+            p = pod(f"pref{i}", cpu="2")
+            p.spec.affinity = prefer_ssd
+            store.create(p)
+        oracle, cached, fed = solve_both(store, cache, feed)
+        assert oracle == cached == fed
+        assert oracle["mpa"][0] == 3  # unpreferring pods: first feasible
+        assert oracle["mpb"][0] == 3  # preferring pods steered to ssd
+        assert oracle["mpa"][3] == 0 and oracle["mpb"][3] == 0
+        # a preference for a group that can't fit the pod does NOT make it
+        # feasible: a 32-cpu pod preferring ssd is simply unschedulable
+        big = pod("big", cpu="32")
+        big.spec.affinity = prefer_ssd
+        store.create(big)
+        oracle, cached, fed = solve_both(store, cache, feed)
+        assert oracle == cached == fed
+        assert oracle["mpa"][3] == 1 and oracle["mpb"][3] == 1  # global count
+
     def test_affinity_shape_registry_compacts_after_churn(self):
         """A stream of Jobs each pinning a DISTINCT affinity must not grow
         the shape registry unboundedly: _needs_compaction watches

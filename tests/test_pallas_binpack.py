@@ -67,6 +67,61 @@ class TestPallasMatchesXLA:
         assert_outputs_equal(xla, pallas)
 
     @pytest.mark.parametrize("seed", range(3))
+    def test_score_parity(self, seed):
+        """pod_group_score (preferred node affinity) steers assignment
+        identically in both backends: max score among feasible, lowest
+        index tie-break."""
+        import dataclasses
+
+        rng = np.random.default_rng(200 + seed)
+        inputs = dataclasses.replace(
+            random_inputs(rng, pods=203, types=37),
+            pod_group_score=jnp.asarray(
+                rng.integers(0, 100, (203, 37)).astype(np.float32)
+            ),
+            pod_weight=jnp.asarray(
+                rng.integers(1, 2000, 203).astype(np.int32)
+            ),
+        )
+        xla = B.binpack(inputs, buckets=16)
+        pallas = PB.binpack_pallas(
+            inputs, buckets=16, tile_p=64, interpret=True
+        )
+        assert_outputs_equal(xla, pallas)
+        # scoring changed the assignment vs first-feasible
+        free = B.binpack(
+            dataclasses.replace(inputs, pod_group_score=None), buckets=16
+        )
+        assert not np.array_equal(
+            np.asarray(free.assigned), np.asarray(xla.assigned)
+        )
+        # and never assigned an infeasible group: pod counts conserved
+        # over the VALID rows (invalid rows never enter any aggregate)
+        total = int(np.sum(np.asarray(xla.assigned_count))) + int(
+            xla.unschedulable
+        )
+        valid = np.asarray(inputs.pod_valid)
+        assert total == int(np.sum(np.asarray(inputs.pod_weight)[valid]))
+
+    def test_score_tiebreak_is_lowest_index(self):
+        """Uniform scores must reproduce first-feasible exactly."""
+        import dataclasses
+
+        rng = np.random.default_rng(42)
+        base = random_inputs(rng, pods=90, types=11)
+        uniform = dataclasses.replace(
+            base,
+            pod_group_score=jnp.full((90, 11), 7.0, jnp.float32),
+        )
+        assert_outputs_equal(
+            B.binpack(base, buckets=8), B.binpack(uniform, buckets=8)
+        )
+        assert_outputs_equal(
+            B.binpack(base, buckets=8),
+            PB.binpack_pallas(uniform, buckets=8, tile_p=64, interpret=True),
+        )
+
+    @pytest.mark.parametrize("seed", range(3))
     def test_forbidden_parity(self, seed):
         """pod_group_forbidden (required node affinity, host-evaluated)
         masks feasibility identically in both backends, weighted rows
@@ -192,6 +247,27 @@ class TestCompiledMosaic:
         xla = B.binpack(weighted, buckets=16)
         pallas = PB.binpack_pallas(
             weighted, buckets=16, tile_p=128, interpret=False
+        )
+        assert_outputs_equal(xla, pallas)
+
+    def test_compiled_score_equals_xla_on_tpu(self):
+        """The preference-score operand compiles through Mosaic and
+        matches XLA on hardware (max-score + min-index selection)."""
+        import dataclasses
+
+        rng = np.random.default_rng(9)
+        inputs = dataclasses.replace(
+            random_inputs(rng, pods=512, types=24),
+            pod_group_score=jnp.asarray(
+                rng.integers(0, 100, (512, 24)).astype(np.float32)
+            ),
+            pod_weight=jnp.asarray(
+                rng.integers(1000, 5000, 512).astype(np.int32)
+            ),
+        )
+        xla = B.binpack(inputs, buckets=16)
+        pallas = PB.binpack_pallas(
+            inputs, buckets=16, tile_p=128, interpret=False
         )
         assert_outputs_equal(xla, pallas)
 

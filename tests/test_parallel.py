@@ -186,6 +186,32 @@ def test_sharded_weighted_binpack_matches_single_device(n_devices):
 
 
 @pytest.mark.parametrize("n_devices", [2, 8])
+def test_sharded_score_binpack_matches_single_device(n_devices):
+    """pod_group_score shards over both mesh axes like the forbidden
+    mask; the cross-shard argmax (max-score + min-index) must equal the
+    single-device assignment exactly."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(29)
+    inputs = dataclasses.replace(
+        example_binpack_inputs(P_=37, T=5, K=8, L=8, seed=29),
+        pod_weight=jnp.asarray(rng.integers(1, 50, 37).astype(np.int32)),
+        pod_group_score=jnp.asarray(
+            rng.integers(0, 100, (37, 5)).astype(np.float32)
+        ),
+    )
+    ref = jax.device_get(binpack(inputs, buckets=8))
+    mesh = build_mesh(n_devices=n_devices)
+    out = jax.device_get(sharded_binpack(mesh, inputs, buckets=8))
+    np.testing.assert_array_equal(out.assigned, ref.assigned)
+    np.testing.assert_array_equal(out.assigned_count, ref.assigned_count)
+    np.testing.assert_array_equal(out.nodes_needed, ref.nodes_needed)
+    assert int(out.unschedulable) == int(ref.unschedulable)
+
+
+@pytest.mark.parametrize("n_devices", [2, 8])
 def test_sharded_forbidden_binpack_matches_single_device(n_devices):
     """pod_group_forbidden (required node affinity) is the one 2D
     pods x groups input: it shards over BOTH mesh axes and must leave

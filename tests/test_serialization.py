@@ -149,6 +149,48 @@ class TestCodecPosture:
         ]["nodeSelectorTerms"]
         assert terms[0]["matchExpressions"][0]["operator"] == "NotIn"
 
+    def test_pod_preferred_affinity_roundtrip(self):
+        pod = from_manifest(
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {"name": "p"},
+                "spec": {
+                    "containers": [{"requests": {"cpu": "1"}}],
+                    "affinity": {
+                        "nodeAffinity": {
+                            "preferredDuringSchedulingIgnoredDuringExecution": [
+                                {
+                                    "weight": 80,
+                                    "preference": {
+                                        "matchExpressions": [
+                                            {
+                                                "key": "disk",
+                                                "operator": "In",
+                                                "values": ["ssd"],
+                                            }
+                                        ]
+                                    },
+                                }
+                            ]
+                        }
+                    },
+                },
+            }
+        )
+        from karpenter_tpu.api.core import preference_score, preferred_shape
+
+        shape = preferred_shape(pod.spec.affinity)
+        assert preference_score({"disk": "ssd"}, shape) == 80
+        assert preference_score({"disk": "hdd"}, shape) == 0
+        from karpenter_tpu.api.serialization import to_dict
+
+        doc = to_dict(pod)
+        pref = doc["spec"]["affinity"]["nodeAffinity"][
+            "preferredDuringSchedulingIgnoredDuringExecution"
+        ]
+        assert pref[0]["weight"] == 80
+
     def test_pod_init_containers_and_overhead_roundtrip(self):
         """core/v1 manifest dialect: initContainers + overhead hydrate and
         dump, and effective_requests reflects them."""
