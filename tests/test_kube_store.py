@@ -253,6 +253,86 @@ class TestCrud:
         assert pod.requests()["cpu"].to_float() == pytest.approx(0.25)
         assert pod.metadata.creation_timestamp > 1.7e9
 
+    def test_real_apiserver_pod_scheduling_fields_decode(self, api, kube):
+        """Affinity (required + preferred), init containers and overhead
+        survive the lenient apiserver decode — real scheduler-shaped pods
+        feed the solver with full constraint fidelity."""
+        api.put_object(
+            "pods",
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {"name": "constrained"},
+                "spec": {
+                    "schedulerName": "default-scheduler",
+                    "containers": [
+                        {
+                            "name": "app",
+                            "resources": {"requests": {"cpu": "250m"}},
+                        }
+                    ],
+                    "initContainers": [
+                        {
+                            "name": "init",
+                            "resources": {"requests": {"cpu": "2"}},
+                        }
+                    ],
+                    "overhead": {"memory": "64Mi"},
+                    "affinity": {
+                        "nodeAffinity": {
+                            "requiredDuringSchedulingIgnoredDuringExecution": {
+                                "nodeSelectorTerms": [
+                                    {
+                                        "matchExpressions": [
+                                            {
+                                                "key": "zone",
+                                                "operator": "NotIn",
+                                                "values": ["z9"],
+                                            }
+                                        ]
+                                    }
+                                ]
+                            },
+                            "preferredDuringSchedulingIgnoredDuringExecution": [
+                                {
+                                    "weight": 50,
+                                    "preference": {
+                                        "matchExpressions": [
+                                            {
+                                                "key": "disk",
+                                                "operator": "Exists",
+                                            }
+                                        ]
+                                    },
+                                }
+                            ],
+                        }
+                    },
+                },
+                "status": {"phase": "Pending"},
+            },
+        )
+        assert wait_for(
+            lambda: kube.try_get("Pod", "default", "constrained") is not None
+        )
+        pod = kube.get("Pod", "default", "constrained")
+        from karpenter_tpu.api.core import (
+            affinity_shape,
+            preference_score,
+            preferred_shape,
+        )
+
+        assert pod.effective_requests()["cpu"].to_float() == pytest.approx(2)
+        assert affinity_shape(pod.spec.affinity) == (
+            (("zone", "NotIn", ("z9",)),),
+        )
+        assert (
+            preference_score(
+                {"disk": "ssd"}, preferred_shape(pod.spec.affinity)
+            )
+            == 50
+        )
+
 
 class TestDialect:
     def test_strict_manifests_still_reject_resources_nesting(self):
