@@ -230,10 +230,10 @@ def main() -> None:
             "--clusters models its own workload (BASELINE config 5) and "
             "cannot combine with --mesh/--e2e/--decide; run it standalone"
         )
-    if args.affinity and (args.clusters or args.e2e or args.decide):
+    if args.affinity and (args.clusters or args.decide):
         ap.error(
-            "--affinity applies to the direct solver bench (and --mesh) "
-            "only; --clusters/--e2e/--decide build their own workloads"
+            "--affinity applies to the solver bench, --mesh, and --e2e; "
+            "--clusters/--decide build their own workloads"
         )
     if not 0.0 <= args.affinity <= 1.0:
         ap.error("--affinity must be a fraction in [0, 1]")
@@ -280,6 +280,10 @@ def main() -> None:
             f"pending-pods bin-pack p50 latency, "
             f"{args.pods} pods x {args.types} instance types"
         )
+    if args.affinity:
+        # distinct metric key: affinity-constrained runs must never mix
+        # into the unconstrained series when aggregated by metric name
+        metric += f", {args.affinity:.0%} pods with node affinity"
     try:
         if args.mesh:
             run_mesh(args, metric)
@@ -471,6 +475,63 @@ def run_mesh(args, metric: str) -> None:
     emit(f"{metric} ({jax.default_backend()})", p50)
 
 
+
+def _e2e_affinity_shapes():
+    """A few realistic affinity variants for --e2e --affinity: require
+    ssd, forbid hdd, prefer ssd (weight 80)."""
+    from karpenter_tpu.api.core import (
+        Affinity,
+        NodeAffinity,
+        NodeSelector,
+        NodeSelectorRequirement,
+        NodeSelectorTerm,
+        PreferredSchedulingTerm,
+    )
+
+    def required(operator, values):
+        return Affinity(
+            node_affinity=NodeAffinity(
+                required_during_scheduling_ignored_during_execution=(
+                    NodeSelector(
+                        node_selector_terms=[
+                            NodeSelectorTerm(
+                                match_expressions=[
+                                    NodeSelectorRequirement(
+                                        key="disk",
+                                        operator=operator,
+                                        values=values,
+                                    )
+                                ]
+                            )
+                        ]
+                    )
+                )
+            )
+        )
+
+    prefer_ssd = Affinity(
+        node_affinity=NodeAffinity(
+            preferred_during_scheduling_ignored_during_execution=[
+                PreferredSchedulingTerm(
+                    weight=80,
+                    preference=NodeSelectorTerm(
+                        match_expressions=[
+                            NodeSelectorRequirement(
+                                key="disk", operator="In", values=["ssd"]
+                            )
+                        ]
+                    ),
+                )
+            ]
+        )
+    )
+    return [
+        required("In", ["ssd"]),
+        required("NotIn", ["hdd"]),
+        prefer_ssd,
+    ]
+
+
 def run_e2e(args, metric: str, note: str = "") -> None:
     """Full control-plane tick at scale: one solve_pending call — node
     listing, group profiling, columnar cache snapshot, encode, transfer,
@@ -518,27 +579,46 @@ def run_e2e(args, metric: str, note: str = "") -> None:
     feed = PendingFeed(store, _group_profile)
     cpu_choices = [Quantity.parse(q) for q in ("100m", "250m", "500m", "1", "2", "4")]
     mem_choices = [Quantity.parse(q) for q in ("128Mi", "512Mi", "1Gi", "4Gi")]
-    for i in range(args.pods):
-        store.create(
-            Pod(
-                metadata=ObjectMeta(name=f"p{i}"),
-                spec=PodSpec(
-                    containers=[
-                        Container(
-                            requests={
-                                "cpu": rng.choice(cpu_choices),
-                                "memory": rng.choice(mem_choices),
-                            }
-                        )
-                    ]
-                ),
-            )
+
+    # --affinity F: fraction F of pods carry required OR preferred node
+    # affinity over the disk label the nodes alternate — exercising the
+    # host shape evaluation + the mask/score device operands in the tick
+    affinity_shapes = _e2e_affinity_shapes() if args.affinity else []
+
+    def make_pod(name):
+        affinity = None
+        if affinity_shapes and rng.random() < args.affinity:
+            affinity = affinity_shapes[
+                int(rng.integers(0, len(affinity_shapes)))
+            ]
+        return Pod(
+            metadata=ObjectMeta(name=name),
+            spec=PodSpec(
+                containers=[
+                    Container(
+                        requests={
+                            "cpu": rng.choice(cpu_choices),
+                            "memory": rng.choice(mem_choices),
+                        }
+                    )
+                ],
+                affinity=affinity,
+            ),
         )
+
+    for i in range(args.pods):
+        store.create(make_pod(f"p{i}"))
     nodes = []
     for g in range(args.types):
         cores = int(rng.choice([8, 16, 32, 64, 96]))
         node = Node(
-            metadata=ObjectMeta(name=f"n{g}", labels={"group": f"g{g}"}),
+            metadata=ObjectMeta(
+                name=f"n{g}",
+                labels={
+                    "group": f"g{g}",
+                    "disk": "ssd" if g % 2 else "hdd",
+                },
+            ),
             status=NodeStatus(
                 allocatable={
                     "cpu": Quantity.parse(str(cores)),
@@ -609,22 +689,7 @@ def run_e2e(args, metric: str, note: str = "") -> None:
     next_id = args.pods
     times = []
     for it in range(args.iters):
-        fresh = [
-            Pod(
-                metadata=ObjectMeta(name=f"p{next_id + j}"),
-                spec=PodSpec(
-                    containers=[
-                        Container(
-                            requests={
-                                "cpu": rng.choice(cpu_choices),
-                                "memory": rng.choice(mem_choices),
-                            }
-                        )
-                    ]
-                ),
-            )
-            for j in range(churn)
-        ]
+        fresh = [make_pod(f"p{next_id + j}") for j in range(churn)]
         victims = [f"p{next_id - args.pods + j}" for j in range(churn)]
         next_id += churn
         t0 = time.perf_counter()
