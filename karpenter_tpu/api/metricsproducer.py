@@ -35,6 +35,11 @@ class ReservedCapacitySpec:
 @dataclass
 class PendingCapacitySpec:
     node_selector: Dict[str, str] = field(default_factory=dict)
+    # scale-from-zero: when node_selector matches NO nodes, profile the
+    # group from the cloud provider's NodeTemplate for this
+    # ScalableNodeGroup (same namespace). Live nodes always win —
+    # observed truth over declared shape.
+    node_group_ref: str = ""
 
     def validate(self) -> None:
         """reference: metricsproducer_validation.go:85-87 (no-op)."""

@@ -5,8 +5,8 @@ Providers plug in NodeGroup (get/set replicas, stabilization) and Queue
 (registry.py) rather than compile-time build tags.
 """
 
-from dataclasses import dataclass
-from typing import Optional, Protocol, Tuple
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Protocol, Tuple
 
 
 class Queue(Protocol):
@@ -17,6 +17,21 @@ class Queue(Protocol):
     def oldest_message_age_seconds(self) -> int: ...
 
 
+@dataclass
+class NodeTemplate:
+    """Shape of the node a group would add — what the provider knows about
+    the instance type even when the group is scaled to ZERO. The pending-
+    pods producer falls back to this for empty groups (spec.pendingCapacity
+    .nodeGroupRef), fixing scale-from-zero: with no live node to profile,
+    the bin-pack would otherwise see an empty shape and never signal.
+    allocatable values are Quantities (e.g. {"cpu": 8, "google.com/tpu": 8});
+    labels/taints as on the nodes the group stamps."""
+
+    allocatable: Dict[str, object] = field(default_factory=dict)
+    labels: Dict[str, str] = field(default_factory=dict)
+    taints: List[object] = field(default_factory=list)  # api.core.Taint
+
+
 class NodeGroup(Protocol):
     def set_replicas(self, count: int) -> None: ...
 
@@ -25,6 +40,11 @@ class NodeGroup(Protocol):
     def stabilized(self) -> Tuple[bool, str]:
         """(stable, message); message explains instability."""
         ...
+
+    # OPTIONAL (resolved via getattr — older/simpler providers need not
+    # implement it): the instance shape this group would add, or None when
+    # the provider can't know (then scale-from-zero needs a live node).
+    # def template(self) -> Optional[NodeTemplate]: ...
 
 
 class CloudProviderFactory(Protocol):

@@ -56,6 +56,14 @@ class FakeNodeGroup:
             return True, ""
         return False, NODE_GROUP_MESSAGE
 
+    def template(self):
+        """Injectable node shape (cloudprovider.NodeTemplate) for
+        scale-from-zero tests; None when unset, like a provider that
+        can't know its instance shape."""
+        if self._factory.want_err is not None:
+            raise self._factory.want_err
+        return self._factory.node_templates.get(self._id)
+
 
 class FakeQueue:
     def __init__(self, queue_id: str, want_err: Optional[Exception], length: int = 0,
@@ -85,6 +93,7 @@ class FakeFactory:
     def __init__(self, options: Optional[Options] = None):
         self.want_err: Optional[Exception] = None
         self.node_replicas: Dict[str, int] = {}
+        self.node_templates: Dict[str, object] = {}  # id -> NodeTemplate
         self.node_group_stable = True
         self.queue_lengths: Dict[str, int] = {}
         self.queue_oldest_ages: Dict[str, int] = {}

@@ -124,6 +124,52 @@ class TPUPodSlicePool:
             wrapped.__cause__ = e
             raise wrapped from e
 
+    def template(self):
+        """NodeTemplate for scale-from-zero (cloudprovider.NodeTemplate):
+        the host shape a pool resize would add, sourced from the bound
+        container API's node-pool config when it exposes one
+        (`node_pool_template` is an OPTIONAL api method — google's
+        nodePools.get returns the machine config this models). None when
+        the API can't say; live nodes always take precedence anyway."""
+        template_fn = getattr(self.api, "node_pool_template", None)
+        if template_fn is None:
+            return None
+        raw = template_fn(self.project, self.location, self.cluster, self.pool)
+        if raw is None:
+            return None
+        from karpenter_tpu.api.core import Taint
+        from karpenter_tpu.cloudprovider import NodeTemplate
+        from karpenter_tpu.utils.quantity import parse_quantity
+
+        labels = dict(raw.get("labels", {}))
+        labels.setdefault(NODE_POOL_LABEL, self.pool)
+        # taints arrive as nodePools.get-style dicts; NodeTemplate's
+        # contract is api.core.Taint, and GKE spells effects as enums
+        # (NO_SCHEDULE) where core/v1 uses NoSchedule — accept both
+        effect_map = {
+            "NO_SCHEDULE": "NoSchedule",
+            "NO_EXECUTE": "NoExecute",
+            "PREFER_NO_SCHEDULE": "PreferNoSchedule",
+        }
+        taints = [
+            Taint(
+                key=t.get("key", ""),
+                value=t.get("value", ""),
+                effect=effect_map.get(
+                    t.get("effect", ""), t.get("effect", "")
+                ),
+            )
+            for t in raw.get("taints", [])
+        ]
+        return NodeTemplate(
+            allocatable={
+                r: parse_quantity(str(v))
+                for r, v in raw.get("allocatable", {}).items()
+            },
+            labels=labels,
+            taints=taints,
+        )
+
     def stabilized(self) -> Tuple[bool, str]:
         try:
             pending = self.api.pending_operations(

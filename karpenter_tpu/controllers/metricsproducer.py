@@ -43,6 +43,7 @@ class MetricsProducerController:
                     self.factory.registry,
                     solver=self.factory.solver,
                     feed=self.factory.pending_feed(),
+                    template_resolver=self.factory.template_resolver(),
                 )
                 for mp in pending:
                     # per-ROW outcome: a poisoned spec fails only itself

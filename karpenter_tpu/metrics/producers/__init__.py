@@ -69,12 +69,80 @@ class ProducerFactory:
             )
         return self._pending_feed
 
+    # scale-from-zero templates change on pool reconfiguration, not per
+    # tick; cache resolutions so an idle tick never pays a cloud-API
+    # round trip (the memoized-tick cost model in OPERATIONS.md). A
+    # changed template is picked up within TTL + one producer interval.
+    template_cache_ttl = 60.0
+
+    def template_resolver(self):
+        """(namespace, node_group_ref) -> Optional[(alloc floats, labels
+        set, taints set)] — the scale-from-zero seam for the pending-pods
+        solve. Resolves the referenced ScalableNodeGroup from the store,
+        asks the cloud provider for its NodeTemplate (optional protocol
+        method; providers that can't know their instance shape return
+        None / don't implement it), and converts to the profile tuple
+        _group_profile produces from live nodes. Results are TTL-cached
+        (template_cache_ttl) so the per-tick profile loop never blocks on
+        the provider API."""
+        import time as _time
+
+        if not hasattr(self, "_template_cache"):
+            self._template_cache = {}
+
+        def resolve(namespace: str, ref: str):
+            from karpenter_tpu.metrics.producers.pendingcapacity import (
+                DEFAULT_PODS_PER_NODE,
+                RESOURCE_PODS,
+            )
+
+            now = _time.monotonic()
+            cached = self._template_cache.get((namespace, ref))
+            if cached is not None and cached[0] > now:
+                return cached[1]
+
+            def uncached():
+                sng = self.store.try_get(
+                    "ScalableNodeGroup", namespace, ref
+                )
+                if sng is None:
+                    return None
+                group = self.cloud_provider_factory.node_group_for(sng.spec)
+                template_fn = getattr(group, "template", None)
+                template = (
+                    template_fn() if template_fn is not None else None
+                )
+                if template is None:
+                    return None
+                alloc = {
+                    r: q.to_float() for r, q in template.allocatable.items()
+                }
+                if alloc and alloc.get(RESOURCE_PODS, 0.0) <= 0:
+                    alloc[RESOURCE_PODS] = DEFAULT_PODS_PER_NODE
+                labels = set(template.labels.items())
+                taints = {
+                    (t.key, t.value, t.effect)
+                    for t in template.taints
+                    if t.effect in ("NoSchedule", "NoExecute")
+                }
+                return alloc, labels, taints
+
+            result = uncached()
+            self._template_cache[(namespace, ref)] = (
+                now + self.template_cache_ttl,
+                result,
+            )
+            return result
+
+        return resolve
+
     def for_producer(self, mp):
         spec = mp.spec
         if spec.pending_capacity is not None:
             return PendingCapacityProducer(
                 mp, self.store, registry=self.registry, solver=self.solver,
                 feed=self.pending_feed(),
+                template_resolver=self.template_resolver(),
             )
         if spec.queue is not None:
             return QueueProducer(

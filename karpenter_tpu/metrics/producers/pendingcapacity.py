@@ -144,7 +144,7 @@ def _group_profile(
 
 def solve_pending(
     store, due_producers: List, registry: GaugeRegistry, solver=None,
-    pod_cache=None, feed=None,
+    pod_cache=None, feed=None, template_resolver=None,
 ) -> Dict[tuple, Optional[Exception]]:
     """One device call over ALL pendingCapacity producers in the store.
 
@@ -178,17 +178,24 @@ def solve_pending(
     pkg/controllers/controller.go:85-91). Only genuinely global failures
     (the pod snapshot, the device solve itself) fail the whole batch, by
     raising.
+
+    `template_resolver` (producers.Factory.template_resolver) enables
+    SCALE-FROM-ZERO: a callable (namespace, node_group_ref) ->
+    Optional[(alloc floats, labels set, taints set)] consulted only when
+    a producer's selector matches no nodes and its spec names a
+    nodeGroupRef — the provider's declared instance shape stands in for
+    the missing live node. Live nodes always win.
     """
     due_keys = {
         (mp.metadata.namespace, mp.metadata.name): mp for mp in due_producers
     }
 
-    # group axis: (namespace, name, due-object-or-None, selector) in
+    # group axis: (namespace, name, due-object-or-None, selector, ref) in
     # deterministic key order
     if feed is not None:
         targets = [
-            (key[0], key[1], due_keys.get(key), selector)
-            for key, selector in feed.producers.items()
+            (key[0], key[1], due_keys.get(key), selector, ref)
+            for key, (selector, ref) in feed.producers.items()
         ]
     else:
         targets = []
@@ -203,7 +210,8 @@ def solve_pending(
             # the instance the engine will persist
             targets.append(
                 (key[0], key[1], due_keys.get(key, mp),
-                 mp.spec.pending_capacity.node_selector)
+                 mp.spec.pending_capacity.node_selector,
+                 getattr(mp.spec.pending_capacity, "node_group_ref", ""))
             )
     if not targets:
         return {}
@@ -212,13 +220,28 @@ def solve_pending(
         nodes = store.list("Node")  # listed ONCE; profiles filter in-memory
     errors: Dict[tuple, Optional[Exception]] = {}
     profiles = []
-    for namespace, name, _, sel in targets:
+    # template-derived rows participate in the encode-memo fingerprint:
+    # templates live OUTSIDE the watch-versioned store state the
+    # fingerprint otherwise covers
+    template_rows = []
+    for namespace, name, _, sel, ref in targets:
         try:
-            profiles.append(
+            profile = (
                 feed.nodes.profile(sel)
                 if feed is not None
                 else _group_profile(nodes, sel)
             )
+            if not profile[0] and ref and template_resolver is not None:
+                resolved = template_resolver(namespace, ref)
+                if resolved is not None:
+                    profile = resolved
+                    template_rows.append(
+                        (namespace, name,
+                         tuple(sorted(profile[0].items())),
+                         tuple(sorted(profile[1])),
+                         tuple(sorted(profile[2])))
+                    )
+            profiles.append(profile)
         except Exception as e:  # noqa: BLE001 — row-isolated failure
             errors[(namespace, name)] = e
             # empty shape: zero allocatable everywhere, which _feasibility
@@ -255,9 +278,11 @@ def solve_pending(
                     tuple(sorted(sel.items()))
                     if isinstance(sel, dict)
                     else repr(sel),
+                    ref,
                 )
-                for namespace, name, _, sel in targets
+                for namespace, name, _, sel, ref in targets
             ),
+            tuple(template_rows),
         )
         memo = feed.encode_memo
         cached_outputs = None
@@ -282,7 +307,7 @@ def solve_pending(
         _dispatch_and_record(inputs, targets, registry, solver, errors)
     return {
         (namespace, name): errors.get((namespace, name))
-        for namespace, name, _, _ in targets
+        for namespace, name, _, _, _ in targets
     }
 
 
@@ -602,7 +627,7 @@ def _dispatch_and_record(
 
     register_gauges(registry)
     gauge = lambda g: registry.gauge(SUBSYSTEM, g)
-    for t, (namespace, name, mp, _) in enumerate(targets):
+    for t, (namespace, name, mp, *_rest) in enumerate(targets):
         if errors and (namespace, name) in errors:
             # poisoned row: keep its last-good status/gauges rather than
             # publishing the placeholder all-infeasible solve
@@ -631,18 +656,20 @@ class PendingCapacityProducer:
         registry: Optional[GaugeRegistry] = None,
         solver=None,
         feed=None,
+        template_resolver=None,
     ):
         self.mp = mp
         self.store = store
         self.registry = registry if registry is not None else default_registry()
         self.solver = solver
         self.feed = feed
+        self.template_resolver = template_resolver
         register_gauges(self.registry)
 
     def reconcile(self) -> None:
         outcomes = solve_pending(
             self.store, [self.mp], self.registry, solver=self.solver,
-            feed=self.feed,
+            feed=self.feed, template_resolver=self.template_resolver,
         )
         error = outcomes.get(
             (self.mp.metadata.namespace, self.mp.metadata.name)

@@ -574,22 +574,26 @@ class ReservationsCache:
 
 
 class ProducerSelectorIndex:
-    """Watch-maintained {key: node_selector} of every pendingCapacity
-    MetricsProducer — the solve needs ONLY the selector of non-due
-    producers (their status writes land on discarded copies anyway;
-    gauges are keyed by name/namespace), so listing + deep-copying every
-    producer object per tick is avoidable."""
+    """Watch-maintained {key: (node_selector, node_group_ref)} of every
+    pendingCapacity MetricsProducer — the solve needs ONLY the selector
+    and scale-from-zero ref of non-due producers (their status writes
+    land on discarded copies anyway; gauges are keyed by name/namespace),
+    so listing + deep-copying every producer object per tick is
+    avoidable."""
 
     def __init__(self, store: Store):
         self._lock = threading.Lock()
-        self._selectors: Dict[Tuple[str, str], Dict[str, str]] = {}
+        self._specs: Dict[
+            Tuple[str, str], Tuple[Dict[str, str], str]
+        ] = {}
         _adopt_and_watch(store, "MetricsProducer", self._on_event)
 
     def _on_event(self, event: str, mp) -> None:
         key = (mp.metadata.namespace, mp.metadata.name)
-        selector = None
+        selector, ref = None, ""
         if event != DELETED and mp.spec.pending_capacity is not None:
             selector = mp.spec.pending_capacity.node_selector
+            ref = getattr(mp.spec.pending_capacity, "node_group_ref", "")
             try:
                 selector = dict(selector)
             except TypeError:
@@ -601,15 +605,17 @@ class ProducerSelectorIndex:
                 pass
         with self._lock:
             if event == DELETED or mp.spec.pending_capacity is None:
-                self._selectors.pop(key, None)
+                self._specs.pop(key, None)
             else:
-                self._selectors[key] = selector
+                self._specs[key] = (selector, ref)
 
-    def items(self) -> List[Tuple[Tuple[str, str], Dict[str, str]]]:
-        """(key, selector) pairs in deterministic (namespace, name) order —
-        the group-axis order of the solve."""
+    def items(
+        self,
+    ) -> List[Tuple[Tuple[str, str], Tuple[Dict[str, str], str]]]:
+        """(key, (selector, node_group_ref)) pairs in deterministic
+        (namespace, name) order — the group-axis order of the solve."""
         with self._lock:
-            return sorted(self._selectors.items())
+            return sorted(self._specs.items())
 
 
 class PendingFeed:
@@ -630,8 +636,10 @@ class PendingFeed:
         # last (fingerprint, BinPackInputs) so an unchanged fleet reuses
         # the same inputs OBJECT and the solver's identity-keyed device
         # cache skips the host->device transfer. The fingerprint covers
-        # pods.snapshot().generation, nodes.version, and the producer
-        # selector set, so any reset/replacement of those caches
+        # pods.snapshot().generation, nodes.version, the producer
+        # (selector, nodeGroupRef) set, and the RESOLVED scale-from-zero
+        # template profiles — so any reset/replacement of those caches,
+        # and any provider-template change (within the resolver's TTL),
         # invalidates it naturally.
         self.encode_memo: Optional[tuple] = None
 

@@ -265,6 +265,172 @@ class TestPendingCapacitySignal:
         assert mp.status.pending_capacity.unschedulable_pods == 1
 
 
+class TestScaleFromZero:
+    """nodeGroupRef + provider NodeTemplate: a pool with NO live nodes
+    still gets a correct additional-nodes signal — the gap every
+    pending-pods autoscaler without instance metadata has (the profile
+    docstring's admitted limitation, now closed)."""
+
+    def _sng(self, name):
+        from karpenter_tpu.api.scalablenodegroup import (
+            ScalableNodeGroup,
+            ScalableNodeGroupSpec,
+        )
+
+        return ScalableNodeGroup(
+            metadata=ObjectMeta(name=name),
+            spec=ScalableNodeGroupSpec(
+                type="AWSEC2AutoScalingGroup", id=f"asg-{name}"
+            ),
+        )
+
+    def _template(self, cpu="4", memory="8Gi", labels=None, taints=()):
+        from karpenter_tpu.cloudprovider import NodeTemplate
+
+        return NodeTemplate(
+            allocatable=resource_list(cpu=cpu, memory=memory),
+            labels=dict(labels or {}),
+            taints=list(taints),
+        )
+
+    def _mp_with_ref(self, name, selector, ref):
+        return MetricsProducer(
+            metadata=ObjectMeta(name=name),
+            spec=MetricsProducerSpec(
+                pending_capacity=PendingCapacitySpec(
+                    node_selector=dict(selector), node_group_ref=ref
+                )
+            ),
+        )
+
+    def test_empty_group_profiles_from_template(self, env):
+        runtime, provider, clock = env
+        runtime.store.create(self._sng("pool-a"))
+        provider.node_templates["asg-pool-a"] = self._template(
+            cpu="4", memory="8Gi"
+        )
+        # NO nodes exist; 6 pods of 2cpu -> 2 per 4-cpu template node
+        for i in range(6):
+            runtime.store.create(pending_pod(f"p{i}", cpu="2", memory="1Gi"))
+        runtime.store.create(
+            self._mp_with_ref("zero", {"group": "a"}, "pool-a")
+        )
+        runtime.manager.reconcile_all()
+        mp = runtime.store.get("MetricsProducer", "default", "zero")
+        assert mp.status.pending_capacity.pending_pods == 6
+        assert mp.status.pending_capacity.additional_nodes_needed == 3
+        assert mp.status.pending_capacity.unschedulable_pods == 0
+
+    def test_live_nodes_win_over_template(self, env):
+        runtime, provider, clock = env
+        runtime.store.create(self._sng("pool-a"))
+        # template says 64 cpu, but the LIVE node is 4 cpu: observed truth
+        provider.node_templates["asg-pool-a"] = self._template(cpu="64")
+        runtime.store.create(
+            ready_node("n1", {"group": "a"}, cpu="4", memory="8Gi")
+        )
+        for i in range(4):
+            runtime.store.create(pending_pod(f"p{i}", cpu="2", memory="1Gi"))
+        runtime.store.create(
+            self._mp_with_ref("live", {"group": "a"}, "pool-a")
+        )
+        runtime.manager.reconcile_all()
+        mp = runtime.store.get("MetricsProducer", "default", "live")
+        # 2 per live 4-cpu node, NOT 32 per template node
+        assert mp.status.pending_capacity.additional_nodes_needed == 2
+
+    def test_template_taints_and_labels_respected(self, env):
+        from karpenter_tpu.api.core import Taint, Toleration
+
+        runtime, provider, clock = env
+        runtime.store.create(self._sng("pool-t"))
+        provider.node_templates["asg-pool-t"] = self._template(
+            cpu="8",
+            labels={"disk": "ssd"},
+            taints=[Taint(key="tpu", value="true", effect="NoSchedule")],
+        )
+        # intolerant pod: unschedulable even though cpu fits
+        runtime.store.create(pending_pod("blocked", cpu="1"))
+        # tolerating pod with a selector the template labels satisfy
+        tolerating = pending_pod(
+            "ok",
+            cpu="1",
+            node_selector={"disk": "ssd"},
+            tolerations=[
+                Toleration(key="tpu", operator="Equal", value="true")
+            ],
+        )
+        runtime.store.create(tolerating)
+        runtime.store.create(self._mp_with_ref("t", {"group": "t"}, "pool-t"))
+        runtime.manager.reconcile_all()
+        mp = runtime.store.get("MetricsProducer", "default", "t")
+        assert mp.status.pending_capacity.pending_pods == 1  # only 'ok'
+        assert mp.status.pending_capacity.unschedulable_pods == 1
+        assert mp.status.pending_capacity.additional_nodes_needed == 1
+
+    def test_template_resolution_is_ttl_cached(self, env):
+        """Idle ticks must not pay a provider call per empty group: the
+        resolver caches by (namespace, ref) within template_cache_ttl."""
+        runtime, provider, clock = env
+        calls = []
+        real = provider.node_group_for
+
+        def counting(spec):
+            calls.append(spec.id)
+            return real(spec)
+
+        provider.node_group_for = counting
+        runtime.store.create(self._sng("pool-a"))
+        provider.node_templates["asg-pool-a"] = self._template(cpu="4")
+        runtime.store.create(pending_pod("p0", cpu="2"))
+        runtime.store.create(
+            self._mp_with_ref("cached", {"group": "a"}, "pool-a")
+        )
+        runtime.manager.reconcile_all()
+        first = len(calls)
+        assert first >= 1
+        clock.advance(6)
+        runtime.manager.reconcile_all()  # within TTL: no new provider call
+        assert len(calls) == first
+
+    def test_missing_ref_or_template_stays_empty(self, env):
+        runtime, provider, clock = env
+        # ref to a nonexistent SNG: row solves as nothing-fits, no error
+        runtime.store.create(
+            self._mp_with_ref("dangling", {"group": "x"}, "nope")
+        )
+        # no ref at all: the pre-existing empty-group behavior
+        runtime.store.create(pending_mp("plain", {"group": "y"}))
+        runtime.store.create(pending_pod("p0", cpu="1"))
+        runtime.manager.reconcile_all()
+        for name in ("dangling", "plain"):
+            mp = runtime.store.get("MetricsProducer", "default", name)
+            assert mp.status.pending_capacity.additional_nodes_needed == 0
+            assert mp.status.pending_capacity.unschedulable_pods == 1
+
+    def test_template_change_invalidates_encode_memo(self, env):
+        runtime, provider, clock = env
+        # resolutions are TTL-cached (no cloud API call on idle ticks);
+        # zero the TTL so this test observes the change immediately
+        runtime.producer_factory.template_cache_ttl = 0.0
+        runtime.store.create(self._sng("pool-a"))
+        provider.node_templates["asg-pool-a"] = self._template(cpu="4")
+        for i in range(4):
+            runtime.store.create(pending_pod(f"p{i}", cpu="2"))
+        runtime.store.create(
+            self._mp_with_ref("memo", {"group": "a"}, "pool-a")
+        )
+        runtime.manager.reconcile_all()
+        mp = runtime.store.get("MetricsProducer", "default", "memo")
+        assert mp.status.pending_capacity.additional_nodes_needed == 2
+        # template doubles -> fingerprint must change -> fresh solve
+        provider.node_templates["asg-pool-a"] = self._template(cpu="8")
+        clock.advance(6)  # past the 5 s producer interval
+        runtime.manager.reconcile_all()
+        mp = runtime.store.get("MetricsProducer", "default", "memo")
+        assert mp.status.pending_capacity.additional_nodes_needed == 1
+
+
 class TestPendingCapacityDrivesAutoscaling:
     def test_full_loop_scale_up(self, env):
         """pending pods -> solver -> gauge -> HA (Value target) -> SNG."""

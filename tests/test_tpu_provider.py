@@ -196,3 +196,73 @@ class TestChipsPerHostDerivation:
             store.create(n)
         pool = TPUPodSlicePool(POOL_ID, FakeContainerAPI(), store)
         assert pool.get_replicas() == 2
+
+
+class TestNodeTemplate:
+    """Scale-from-zero seam: template() surfaces the pool's declared host
+    shape when the bound API exposes node_pool_template; absent that, None
+    (live nodes are then the only shape source)."""
+
+    def test_no_template_hook_returns_none(self):
+        pool = TPUPodSlicePool(POOL_ID, FakeContainerAPI(), Store())
+        assert pool.template() is None
+
+    def test_template_from_api(self):
+        class TemplateAPI(FakeContainerAPI):
+            def node_pool_template(self, project, location, cluster, pool):
+                assert (project, location, cluster, pool) == (
+                    "p", "us-central2-b", "c", "train",
+                )
+                return {
+                    "allocatable": {
+                        "cpu": "240",
+                        "memory": "400Gi",
+                        "google.com/tpu": "4",
+                    },
+                    "labels": {TPU_TOPOLOGY_LABEL: "2x2x4"},
+                }
+
+        pool = TPUPodSlicePool(POOL_ID, TemplateAPI(), Store())
+        template = pool.template()
+        assert template.allocatable["google.com/tpu"].to_float() == 4
+        assert template.allocatable["cpu"].to_float() == 240
+        # pool label is stamped so selectors over the pool match
+        assert template.labels[NODE_POOL_LABEL] == "train"
+        assert template.labels[TPU_TOPOLOGY_LABEL] == "2x2x4"
+
+    def test_template_taints_convert_to_core_taints(self):
+        """GKE returns taints as dicts with NO_SCHEDULE-style enum
+        effects; template() must yield api.core.Taint with core/v1
+        effects, or the resolver's attribute access / effect filter
+        breaks on exactly the tainted pools TPU pools are."""
+        class TaintedAPI(FakeContainerAPI):
+            def node_pool_template(self, project, location, cluster, pool):
+                return {
+                    "allocatable": {"cpu": "240", "google.com/tpu": "4"},
+                    "taints": [
+                        {
+                            "key": "google.com/tpu",
+                            "value": "present",
+                            "effect": "NO_SCHEDULE",
+                        },
+                        {
+                            "key": "already-core",
+                            "effect": "NoExecute",
+                        },
+                    ],
+                }
+
+        pool = TPUPodSlicePool(POOL_ID, TaintedAPI(), Store())
+        taints = pool.template().taints
+        assert [(t.key, t.value, t.effect) for t in taints] == [
+            ("google.com/tpu", "present", "NoSchedule"),
+            ("already-core", "", "NoExecute"),
+        ]
+
+    def test_template_api_returning_none(self):
+        class NoneAPI(FakeContainerAPI):
+            def node_pool_template(self, project, location, cluster, pool):
+                return None
+
+        pool = TPUPodSlicePool(POOL_ID, NoneAPI(), Store())
+        assert pool.template() is None
