@@ -32,6 +32,51 @@ class NodeTemplate:
     taints: List[object] = field(default_factory=list)  # api.core.Taint
 
 
+_EFFECT_DIALECT = {
+    # cloud APIs (GKE nodePools.get, EKS describeNodegroup) spell taint
+    # effects as enums where core/v1 uses camelCase
+    "NO_SCHEDULE": "NoSchedule",
+    "NO_EXECUTE": "NoExecute",
+    "PREFER_NO_SCHEDULE": "PreferNoSchedule",
+}
+
+
+def node_template_from_raw(
+    raw: Optional[dict], extra_labels: Optional[Dict[str, str]] = None
+) -> Optional["NodeTemplate"]:
+    """Cloud-API-shaped dict -> NodeTemplate: allocatable strings parse to
+    Quantities, taint dicts become api.core.Taint with core/v1 effect
+    spelling (enum dialects accepted). The one conversion every provider's
+    template() shares. extra_labels fill in defaults (e.g. the pool/group
+    label its nodes would carry) without overriding the API's."""
+    if raw is None:
+        return None
+    from karpenter_tpu.api.core import Taint
+    from karpenter_tpu.utils.quantity import parse_quantity
+
+    labels = dict(raw.get("labels", {}))
+    for key, value in (extra_labels or {}).items():
+        labels.setdefault(key, value)
+    taints = [
+        Taint(
+            key=t.get("key", ""),
+            value=t.get("value", ""),
+            effect=_EFFECT_DIALECT.get(
+                t.get("effect", ""), t.get("effect", "")
+            ),
+        )
+        for t in raw.get("taints", [])
+    ]
+    return NodeTemplate(
+        allocatable={
+            r: parse_quantity(str(v))
+            for r, v in raw.get("allocatable", {}).items()
+        },
+        labels=labels,
+        taints=taints,
+    )
+
+
 class NodeGroup(Protocol):
     def set_replicas(self, count: int) -> None: ...
 

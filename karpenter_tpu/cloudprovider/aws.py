@@ -27,7 +27,7 @@ from karpenter_tpu.api.scalablenodegroup import (
     AWS_EKS_NODE_GROUP,
     register_scalable_node_group_validator,
 )
-from karpenter_tpu.cloudprovider import Options
+from karpenter_tpu.cloudprovider import Options, node_template_from_raw
 from karpenter_tpu.cloudprovider.fake import FakeFactory
 from karpenter_tpu.controllers.errors import RetryableError
 
@@ -197,6 +197,12 @@ class _NotImplementedClient:
     """Default when no client is bound: every call fails with guidance —
     the analog of running the !aws build against AWS resources."""
 
+    # the OPTIONAL template hook must read as absent (None), not as a
+    # failing stub: the catch-all __getattr__ below would otherwise
+    # defeat the getattr sentinel and turn "no declared shape" into a
+    # per-tick error for every empty group
+    describe_node_template = None
+
     def __init__(self, service: str):
         self._service = service
 
@@ -249,6 +255,22 @@ class AutoScalingGroup:
     def stabilized(self) -> Tuple[bool, str]:
         return True, ""  # reference leaves this TODO (autoscalinggroup.go:110)
 
+    def template(self):
+        """Scale-from-zero NodeTemplate. The injected autoscaling client
+        may implement the OPTIONAL `describe_node_template(name)` —
+        a boto3 binding would combine the ASG's launch template instance
+        type with DescribeInstanceTypes into {allocatable, labels,
+        taints}. None (or no hook) = no declared shape; a live node is
+        then required to profile the group."""
+        template_fn = getattr(self.client, "describe_node_template", None)
+        if template_fn is None:
+            return None
+        try:
+            raw = template_fn(self.id)
+        except Exception as e:  # noqa: BLE001 — same posture as reads
+            raise transient_error(e) from e
+        return node_template_from_raw(raw)
+
 
 class ManagedNodeGroup:
     """reference: managednodegroup.go:86-114. Replica observation counts
@@ -283,6 +305,26 @@ class ManagedNodeGroup:
 
     def stabilized(self) -> Tuple[bool, str]:
         return True, ""  # reference leaves this TODO (managednodegroup.go:112)
+
+    def template(self):
+        """Scale-from-zero NodeTemplate via the OPTIONAL
+        `describe_node_template(cluster, nodegroup)` hook on the injected
+        EKS client (EKS describeNodegroup returns instanceTypes + labels
+        + taints — with NO_SCHEDULE-style effect enums, converted here).
+        The EKS node-group label is stamped so selectors over the group
+        match the template."""
+        template_fn = getattr(
+            self.eks_client, "describe_node_template", None
+        )
+        if template_fn is None:
+            return None
+        try:
+            raw = template_fn(self.cluster, self.node_group)
+        except Exception as e:  # noqa: BLE001 — same posture as reads
+            raise transient_error(e) from e
+        return node_template_from_raw(
+            raw, extra_labels={NODE_GROUP_LABEL: self.node_group}
+        )
 
 
 class SQSQueue:

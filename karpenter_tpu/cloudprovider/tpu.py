@@ -22,6 +22,7 @@ import re
 from typing import List, Optional, Protocol, Tuple
 
 from karpenter_tpu.api.core import is_ready_and_schedulable
+from karpenter_tpu.cloudprovider import node_template_from_raw
 from karpenter_tpu.api.scalablenodegroup import (
     TPU_POD_SLICE_POOL,
     register_scalable_node_group_validator,
@@ -134,40 +135,18 @@ class TPUPodSlicePool:
         template_fn = getattr(self.api, "node_pool_template", None)
         if template_fn is None:
             return None
-        raw = template_fn(self.project, self.location, self.cluster, self.pool)
-        if raw is None:
-            return None
-        from karpenter_tpu.api.core import Taint
-        from karpenter_tpu.cloudprovider import NodeTemplate
-        from karpenter_tpu.utils.quantity import parse_quantity
-
-        labels = dict(raw.get("labels", {}))
-        labels.setdefault(NODE_POOL_LABEL, self.pool)
-        # taints arrive as nodePools.get-style dicts; NodeTemplate's
-        # contract is api.core.Taint, and GKE spells effects as enums
-        # (NO_SCHEDULE) where core/v1 uses NoSchedule — accept both
-        effect_map = {
-            "NO_SCHEDULE": "NoSchedule",
-            "NO_EXECUTE": "NoExecute",
-            "PREFER_NO_SCHEDULE": "PreferNoSchedule",
-        }
-        taints = [
-            Taint(
-                key=t.get("key", ""),
-                value=t.get("value", ""),
-                effect=effect_map.get(
-                    t.get("effect", ""), t.get("effect", "")
-                ),
+        try:
+            raw = template_fn(
+                self.project, self.location, self.cluster, self.pool
             )
-            for t in raw.get("taints", [])
-        ]
-        return NodeTemplate(
-            allocatable={
-                r: parse_quantity(str(v))
-                for r, v in raw.get("allocatable", {}).items()
-            },
-            labels=labels,
-            taints=taints,
+        except RetryableError:
+            raise
+        except Exception as e:  # noqa: BLE001 — API blips are transient,
+            # same posture as stabilized/set_replicas
+            wrapped = RetryableError(str(e), code="TemplateReadFailed")
+            raise wrapped from e
+        return node_template_from_raw(
+            raw, extra_labels={NODE_POOL_LABEL: self.pool}
         )
 
     def stabilized(self) -> Tuple[bool, str]:

@@ -34,6 +34,7 @@ from karpenter_tpu.cloudprovider.aws import (
     AWSFactory,
     AutoScalingGroup,
     ManagedNodeGroup,
+    NODE_GROUP_LABEL,
     SQSQueue,
     normalize_asg_id,
     parse_arn,
@@ -496,3 +497,84 @@ class TestRetryableThroughController:
 
     def test_transient_error_none_passthrough(self):
         assert transient_error(None) is None
+
+
+class TestNodeTemplates:
+    """Scale-from-zero: both AWS node-group kinds surface a NodeTemplate
+    when the injected client implements the optional describe hook, with
+    EKS-dialect taint enums converted to core/v1."""
+
+    def test_asg_without_hook_returns_none(self):
+        group = AutoScalingGroup("my-asg", FakeAutoscalingAPI())
+        assert group.template() is None
+
+    def test_unbound_client_reads_as_no_template(self):
+        """The no-client-bound default (_NotImplementedClient) has a
+        catch-all __getattr__; the optional template hook must still
+        read as ABSENT — 'no declared shape', not a per-tick error."""
+        from karpenter_tpu.cloudprovider.aws import AWSFactory
+
+        factory = AWSFactory()  # no clients injected
+        group = factory.node_group_for(
+            type(
+                "Spec", (), {"type": "AWSEC2AutoScalingGroup", "id": "asg"}
+            )()
+        )
+        assert group.template() is None
+
+    def test_asg_template_from_hook(self):
+        class TemplateAPI(FakeAutoscalingAPI):
+            def describe_node_template(self, name):
+                assert name == "my-asg"
+                return {
+                    "allocatable": {"cpu": "8", "memory": "32Gi"},
+                    "labels": {"node.kubernetes.io/instance-type": "m5.2xlarge"},
+                }
+
+        template = AutoScalingGroup("my-asg", TemplateAPI()).template()
+        assert template.allocatable["cpu"].to_float() == 8
+        assert (
+            template.labels["node.kubernetes.io/instance-type"]
+            == "m5.2xlarge"
+        )
+
+    def test_mng_template_stamps_group_label_and_converts_taints(self):
+        class TemplateAPI(FakeEKSAPI):
+            def describe_node_template(self, cluster, nodegroup):
+                assert (cluster, nodegroup) == ("cluster", "group")
+                return {
+                    "allocatable": {"cpu": "4"},
+                    "taints": [
+                        {"key": "gpu", "value": "true", "effect": "NO_SCHEDULE"}
+                    ],
+                }
+
+        group = ManagedNodeGroup(
+            "arn:aws:eks:us-east-1:1234:nodegroup/cluster/group/uuid",
+            TemplateAPI(),
+            Store(),
+        )
+        template = group.template()
+        assert template.labels[NODE_GROUP_LABEL] == "group"
+        assert [(t.key, t.effect) for t in template.taints] == [
+            ("gpu", "NoSchedule")
+        ]
+
+    def test_asg_hook_error_classified_like_reads(self):
+        """Hook failures flow through transient_error, so an SDK-shaped
+        throttle is retryable and keeps the resource Active."""
+        from karpenter_tpu.controllers.errors import is_retryable
+
+        class SDKError(RuntimeError):
+            code = "Throttling"
+
+        class ThrowingAPI(FakeAutoscalingAPI):
+            def describe_node_template(self, name):
+                raise SDKError("throttled")
+
+        try:
+            AutoScalingGroup("my-asg", ThrowingAPI()).template()
+        except Exception as e:  # noqa: BLE001
+            assert is_retryable(e)
+        else:
+            raise AssertionError("expected transient error")
