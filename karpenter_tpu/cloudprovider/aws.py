@@ -227,22 +227,36 @@ class AutoScalingGroup:
     def __init__(self, id_: str, client: AutoscalingAPI):
         self.id = normalize_asg_id(id_)
         self.client = client
+        # one describe per reconcile: the controller calls stabilized()
+        # then get_replicas() on the same short-lived instance (a fresh
+        # one per reconcile), so memoizing the first describe halves the
+        # DescribeAutoScalingGroups volume without staleness
+        self._describe_memo = None
 
-    def get_replicas(self) -> int:
-        try:
-            groups = self.client.describe_auto_scaling_groups(
-                names=[self.id], max_records=1
-            )
-        except Exception as e:  # noqa: BLE001 — classified, not swallowed
-            raise transient_error(e) from e
-        if len(groups) != 1:
-            raise RuntimeError(f"autoscaling group has no instances: {self.id}")
+    def _describe(self) -> List[dict]:
+        if self._describe_memo is None:
+            try:
+                self._describe_memo = self.client.describe_auto_scaling_groups(
+                    names=[self.id], max_records=1
+                )
+            except Exception as e:  # noqa: BLE001 — classified, not swallowed
+                raise transient_error(e) from e
+        return self._describe_memo
+
+    @staticmethod
+    def _count_healthy(group: dict) -> int:
         return sum(
             1
-            for instance in groups[0].get("instances", [])
+            for instance in group.get("instances", [])
             if instance.get("health_status") == "Healthy"
             and instance.get("lifecycle_state") == "InService"
         )
+
+    def get_replicas(self) -> int:
+        groups = self._describe()
+        if len(groups) != 1:
+            raise RuntimeError(f"autoscaling group has no instances: {self.id}")
+        return self._count_healthy(groups[0])
 
     def set_replicas(self, count: int) -> None:
         try:
@@ -253,7 +267,24 @@ class AutoScalingGroup:
             raise transient_error(e) from e
 
     def stabilized(self) -> Tuple[bool, str]:
-        return True, ""  # reference leaves this TODO (autoscalinggroup.go:110)
+        """Stable iff every desired instance is Healthy+InService — the
+        check the reference leaves TODO-true (autoscalinggroup.go:110).
+        Clients that don't report desired_capacity (older fakes/bindings)
+        keep the reference's always-stable behavior. (The SNG controller
+        still actuates scale-DOWNS while unstable, so a group capped
+        below desired by a capacity shortage can be shrunk out of it.)"""
+        groups = self._describe()
+        if len(groups) != 1:
+            return True, ""  # unknown group surfaces via get_replicas
+        desired = groups[0].get("desired_capacity")
+        if desired is None:
+            return True, ""
+        healthy = self._count_healthy(groups[0])
+        if healthy == desired:
+            return True, ""
+        return False, (
+            f"{healthy}/{desired} instances healthy and in service"
+        )
 
     def template(self):
         """Scale-from-zero NodeTemplate. The injected autoscaling client

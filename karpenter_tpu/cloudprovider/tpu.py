@@ -22,12 +22,12 @@ import re
 from typing import List, Optional, Protocol, Tuple
 
 from karpenter_tpu.api.core import is_ready_and_schedulable
-from karpenter_tpu.cloudprovider import node_template_from_raw
+from karpenter_tpu.api.metricsproducer import register_queue_validator
 from karpenter_tpu.api.scalablenodegroup import (
     TPU_POD_SLICE_POOL,
     register_scalable_node_group_validator,
 )
-from karpenter_tpu.cloudprovider import Options
+from karpenter_tpu.cloudprovider import Options, node_template_from_raw
 from karpenter_tpu.cloudprovider.fake import FakeFactory
 from karpenter_tpu.controllers.errors import RetryableError
 
@@ -193,18 +193,111 @@ def _hosts_per_slice(node) -> int:
     return max(1, -(-chips // chips_per_host))
 
 
+# ---------------------------------------------------------------------------
+# Pub/Sub subscription queue — the GCP analog of the reference's SQS queue
+# (reference: pkg/cloudprovider/aws/sqsqueue.go). Depth and age come from
+# Cloud Monitoring's subscription/num_undelivered_messages and
+# subscription/oldest_unacked_message_age metrics, read through a
+# duck-typed seam like every other cloud API here.
+# ---------------------------------------------------------------------------
+
+GCP_PUBSUB_SUBSCRIPTION = "GCPPubSubSubscription"
+
+_SUBSCRIPTION_ID_RE = re.compile(
+    r"^projects/(?P<project>[^/]+)/subscriptions/(?P<name>[^/]+)$"
+)
+
+
+def parse_subscription_id(id_: str) -> Tuple[str, str]:
+    m = _SUBSCRIPTION_ID_RE.match(id_)
+    if m is None:
+        raise ValueError(
+            f"invalid subscription id {id_!r}; want "
+            "projects/<project>/subscriptions/<name>"
+        )
+    return m.group("project"), m.group("name")
+
+
+class PubSubMetricsAPI(Protocol):
+    """Bind a Cloud Monitoring client (or a fake) here."""
+
+    def num_undelivered_messages(
+        self, project: str, subscription: str
+    ) -> int: ...
+
+    def oldest_unacked_message_age_seconds(
+        self, project: str, subscription: str
+    ) -> int: ...
+
+
+class PubSubSubscriptionQueue:
+    """Queue SPI over a Pub/Sub subscription. The reference's SQS stub
+    never implemented message age (sqsqueue.go:78-80); Monitoring exposes
+    it directly, so both gauges are real here."""
+
+    def __init__(self, id_: str, api: PubSubMetricsAPI):
+        self.project, self.subscription = parse_subscription_id(id_)
+        self.api = api
+
+    def name(self) -> str:
+        return self.subscription
+
+    def length(self) -> int:
+        try:
+            return int(
+                self.api.num_undelivered_messages(
+                    self.project, self.subscription
+                )
+            )
+        except RetryableError:
+            raise
+        except Exception as e:  # noqa: BLE001 — monitoring blips are
+            # transient, same posture as the pool API reads
+            wrapped = RetryableError(str(e), code="QueueReadFailed")
+            raise wrapped from e
+
+    def oldest_message_age_seconds(self) -> int:
+        try:
+            return int(
+                self.api.oldest_unacked_message_age_seconds(
+                    self.project, self.subscription
+                )
+            )
+        except RetryableError:
+            raise
+        except Exception as e:  # noqa: BLE001
+            wrapped = RetryableError(str(e), code="QueueReadFailed")
+            raise wrapped from e
+
+
+class _NotImplementedPubSubAPI:
+    def num_undelivered_messages(self, project, subscription):
+        raise RuntimeError(
+            "no Pub/Sub metrics client bound; inject one into TPUFactory "
+            "to read subscription queues"
+        )
+
+    def oldest_unacked_message_age_seconds(self, project, subscription):
+        raise RuntimeError(
+            "no Pub/Sub metrics client bound; inject one into TPUFactory "
+            "to read subscription queues"
+        )
+
+
 class TPUFactory:
-    """Provider factory for TPU pod-slice pools; queues fall back to
-    not-implemented (pair with another provider for queue signals)."""
+    """Provider factory for TPU pod-slice pools + Pub/Sub subscription
+    queues; anything else falls back to not-implemented."""
 
     def __init__(
         self,
         options: Optional[Options] = None,
         container_api: Optional[ContainerAPI] = None,
+        pubsub_api: Optional[PubSubMetricsAPI] = None,
     ):
         options = options or Options()
         self.store = options.store
         self.container_api = container_api or _NotImplementedContainerAPI()
+        self.pubsub_api = pubsub_api or _NotImplementedPubSubAPI()
         self._fallback = FakeFactory.not_implemented()
 
     def node_group_for(self, spec):
@@ -213,6 +306,8 @@ class TPUFactory:
         return self._fallback.node_group_for(spec)
 
     def queue_for(self, spec):
+        if spec.type == GCP_PUBSUB_SUBSCRIPTION:
+            return PubSubSubscriptionQueue(spec.id, self.pubsub_api)
         return self._fallback.queue_for(spec)
 
 
@@ -220,4 +315,9 @@ def _validate_pool(spec) -> None:
     parse_pool_id(spec.id)
 
 
+def _validate_subscription(spec) -> None:
+    parse_subscription_id(spec.id)
+
+
 register_scalable_node_group_validator(TPU_POD_SLICE_POOL, _validate_pool)
+register_queue_validator(GCP_PUBSUB_SUBSCRIPTION, _validate_subscription)

@@ -39,13 +39,18 @@ class ScalableNodeGroupController:
         observed = node_group.get_replicas()
         resource.status.replicas = observed
 
-        # 3. actuate when spec diverges from observation — but never while
-        # the group is mid-change: overlapping resizes against a pool whose
-        # previous resize is in flight can strand partial TPU slices
-        # (tpu.py module doc); the next loop actuates once stable
-        if not stable:
-            return
+        # 3. actuate when spec diverges from observation. Scale-UPS never
+        # pile onto a group mid-change: overlapping grow resizes against a
+        # pool whose previous resize is in flight can strand partial TPU
+        # slices (tpu.py module doc); the next loop grows once stable.
+        # Scale-DOWNS actuate even while unstable — when a group is stuck
+        # converging (e.g. an ASG capped below desired by a capacity
+        # shortage, permanently un-stable under the healthy==desired
+        # check), the corrective shrink is exactly the action that
+        # unsticks it, and blocking it would deadlock the resource.
         if resource.spec.replicas is None or resource.spec.replicas == observed:
+            return
+        if not stable and resource.spec.replicas > observed:
             return
         node_group.set_replicas(resource.spec.replicas)
         logger().debug(

@@ -188,6 +188,53 @@ class TestParseArn:
 
 
 class TestAutoScalingGroup:
+    def test_stabilized_from_desired_capacity(self):
+        """Beats the reference's TODO-true Stabilized: unstable while the
+        ASG converges toward desired, stable once every desired instance
+        is Healthy+InService; clients without desired_capacity keep the
+        reference behavior."""
+
+        class DescribeAPI(FakeAutoscalingAPI):
+            def __init__(self, instances, desired=None):
+                super().__init__(instances)
+                self.desired = desired
+
+            def describe_auto_scaling_groups(self, names, max_records):
+                group = {"instances": self.instances}
+                if self.desired is not None:
+                    group["desired_capacity"] = self.desired
+                return [group]
+
+        healthy = {"health_status": "Healthy", "lifecycle_state": "InService"}
+        pending = {"health_status": "Healthy", "lifecycle_state": "Pending"}
+        converging = AutoScalingGroup(
+            "asg", DescribeAPI([healthy, pending], desired=2)
+        )
+        stable, message = converging.stabilized()
+        assert not stable and "1/2" in message
+        settled = AutoScalingGroup(
+            "asg", DescribeAPI([healthy, healthy], desired=2)
+        )
+        assert settled.stabilized() == (True, "")
+        legacy = AutoScalingGroup("asg", DescribeAPI([pending]))
+        assert legacy.stabilized() == (True, "")
+
+    def test_one_describe_per_reconcile_instance(self):
+        """stabilized() + get_replicas() on one (per-reconcile) instance
+        must cost ONE DescribeAutoScalingGroups call, not two."""
+
+        class CountingAPI(FakeAutoscalingAPI):
+            calls = 0
+
+            def describe_auto_scaling_groups(self, names, max_records):
+                CountingAPI.calls += 1
+                return [{"instances": self.instances, "desired_capacity": 0}]
+
+        group = AutoScalingGroup("asg", CountingAPI())
+        group.stabilized()
+        group.get_replicas()
+        assert CountingAPI.calls == 1
+
     def test_counts_only_healthy_in_service(self):
         api = FakeAutoscalingAPI(
             instances=[

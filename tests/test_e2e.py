@@ -526,6 +526,18 @@ class TestScalableNodeGroupE2E:
         runtime.manager.reconcile_all()
         assert provider.node_replicas["g"] == 5  # actuated once stable
 
+    def test_unstabilized_still_allows_scale_down(self, env):
+        """Only scale-UPS wait for stability. A group stuck converging
+        (e.g. an ASG capped below desired by a capacity shortage would
+        NEVER stabilize) must accept the corrective shrink, or the
+        resource deadlocks."""
+        runtime, provider, clock = env
+        provider.node_replicas["g"] = 5
+        provider.node_group_stable = False
+        runtime.store.create(sng_of("g", replicas=2))
+        runtime.manager.reconcile_all()
+        assert provider.node_replicas["g"] == 2  # shrink went through
+
     def test_retryable_error_keeps_active_flags_able_to_scale(self, env):
         runtime, provider, clock = env
         provider.node_replicas["g"] = 1

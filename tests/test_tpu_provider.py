@@ -266,3 +266,114 @@ class TestNodeTemplate:
 
         pool = TPUPodSlicePool(POOL_ID, NoneAPI(), Store())
         assert pool.template() is None
+
+
+class TestPubSubQueue:
+    """The GCP analog of the reference's SQS queue (sqsqueue.go) — both
+    gauges real (the reference stubs message age, sqsqueue.go:78-80)."""
+
+    SUB_ID = "projects/p/subscriptions/work"
+
+    class MetricsAPI:
+        def __init__(self, undelivered=0, age=0, err=None):
+            self.undelivered, self.age, self.err = undelivered, age, err
+
+        def num_undelivered_messages(self, project, subscription):
+            if self.err:
+                raise self.err
+            assert (project, subscription) == ("p", "work")
+            return self.undelivered
+
+        def oldest_unacked_message_age_seconds(self, project, subscription):
+            if self.err:
+                raise self.err
+            return self.age
+
+    def test_reads_depth_and_age(self):
+        from karpenter_tpu.cloudprovider.tpu import PubSubSubscriptionQueue
+
+        queue = PubSubSubscriptionQueue(
+            self.SUB_ID, self.MetricsAPI(undelivered=41, age=17)
+        )
+        assert queue.name() == "work"
+        assert queue.length() == 41
+        assert queue.oldest_message_age_seconds() == 17
+
+    def test_monitoring_blip_is_retryable(self):
+        from karpenter_tpu.cloudprovider.tpu import PubSubSubscriptionQueue
+        from karpenter_tpu.controllers.errors import is_retryable
+
+        queue = PubSubSubscriptionQueue(
+            self.SUB_ID, self.MetricsAPI(err=RuntimeError("deadline"))
+        )
+        with pytest.raises(Exception) as excinfo:
+            queue.length()
+        assert is_retryable(excinfo.value)
+
+    def test_invalid_subscription_id_rejected(self):
+        from karpenter_tpu.cloudprovider.tpu import (
+            PubSubSubscriptionQueue,
+            parse_subscription_id,
+        )
+
+        with pytest.raises(ValueError):
+            parse_subscription_id("not-a-subscription")
+        with pytest.raises(ValueError):
+            PubSubSubscriptionQueue("projects/p/topics/t", self.MetricsAPI())
+
+    def test_factory_dispatch_and_validator(self):
+        from karpenter_tpu.api.metricsproducer import (
+            QueueSpec,
+            validate_queue,
+        )
+        from karpenter_tpu.cloudprovider.tpu import (
+            GCP_PUBSUB_SUBSCRIPTION,
+            PubSubSubscriptionQueue,
+            TPUFactory,
+        )
+
+        factory = TPUFactory(pubsub_api=self.MetricsAPI(undelivered=3))
+        spec = QueueSpec(type=GCP_PUBSUB_SUBSCRIPTION, id=self.SUB_ID)
+        queue = factory.queue_for(spec)
+        assert isinstance(queue, PubSubSubscriptionQueue)
+        assert queue.length() == 3
+        validate_queue(spec)  # registered validator accepts
+        with pytest.raises(ValueError):
+            validate_queue(
+                QueueSpec(type=GCP_PUBSUB_SUBSCRIPTION, id="bogus")
+            )
+
+    def test_queue_producer_end_to_end(self):
+        """A queue MetricsProducer over a Pub/Sub subscription updates
+        status + both gauges through the runtime — the reference's SQS
+        suite flow (queue/producer.go:30-57) on the GCP provider."""
+        from karpenter_tpu.api.core import ObjectMeta
+        from karpenter_tpu.api.metricsproducer import (
+            MetricsProducer,
+            MetricsProducerSpec,
+            QueueSpec,
+        )
+        from karpenter_tpu.cloudprovider.tpu import (
+            GCP_PUBSUB_SUBSCRIPTION,
+            TPUFactory,
+        )
+        from karpenter_tpu.runtime import KarpenterRuntime
+
+        factory = TPUFactory(
+            pubsub_api=self.MetricsAPI(undelivered=41, age=99)
+        )
+        runtime = KarpenterRuntime(cloud_provider_factory=factory)
+        runtime.store.create(
+            MetricsProducer(
+                metadata=ObjectMeta(name="work"),
+                spec=MetricsProducerSpec(
+                    queue=QueueSpec(
+                        type=GCP_PUBSUB_SUBSCRIPTION, id=self.SUB_ID
+                    )
+                ),
+            )
+        )
+        runtime.manager.reconcile_all()
+        mp = runtime.store.get("MetricsProducer", "default", "work")
+        assert mp.status.queue.length == 41
+        assert mp.status.queue.oldest_message_age_seconds == 99
