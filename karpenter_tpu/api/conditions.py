@@ -28,7 +28,7 @@ SCALING_UNBOUNDED = "ScalingUnbounded"
 STABILIZED = "Stabilized"
 
 
-@dataclass
+@dataclass(slots=True)
 class Condition:
     type: str
     status: str = UNKNOWN

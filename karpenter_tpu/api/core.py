@@ -26,7 +26,7 @@ RESOURCE_MEMORY = "memory"
 RESOURCE_PODS = "pods"
 
 
-@dataclass
+@dataclass(slots=True)
 class ObjectMeta:
     name: str = ""
     namespace: str = "default"
@@ -56,7 +56,7 @@ def resource_list(**kwargs) -> Dict[str, Quantity]:
     return {k: parse_quantity(v) for k, v in kwargs.items()}
 
 
-@dataclass
+@dataclass(slots=True)
 class Toleration:
     key: str = ""
     operator: str = "Equal"  # Equal | Exists
@@ -71,14 +71,14 @@ class Toleration:
         return self.key == taint.key and self.value == taint.value
 
 
-@dataclass
+@dataclass(slots=True)
 class Taint:
     key: str
     value: str = ""
     effect: str = "NoSchedule"  # NoSchedule | PreferNoSchedule | NoExecute
 
 
-@dataclass
+@dataclass(slots=True)
 class NodeSelectorRequirement:
     """One matchExpression (core/v1): key OPERATOR values. Operators are
     the scheduler's set: In, NotIn, Exists, DoesNotExist, Gt, Lt (Gt/Lt
@@ -89,7 +89,7 @@ class NodeSelectorRequirement:
     values: List[str] = field(default_factory=list)
 
 
-@dataclass
+@dataclass(slots=True)
 class NodeSelectorTerm:
     # matchFields (metadata.name selection) is not modeled: node groups,
     # not individual nodes, are the scale-up unit here
@@ -98,18 +98,18 @@ class NodeSelectorTerm:
     )
 
 
-@dataclass
+@dataclass(slots=True)
 class NodeSelector:
     node_selector_terms: List[NodeSelectorTerm] = field(default_factory=list)
 
 
-@dataclass
+@dataclass(slots=True)
 class PreferredSchedulingTerm:
     weight: int = 1  # 1-100 (core/v1)
     preference: NodeSelectorTerm = field(default_factory=NodeSelectorTerm)
 
 
-@dataclass
+@dataclass(slots=True)
 class NodeAffinity:
     required_during_scheduling_ignored_during_execution: Optional[
         NodeSelector
@@ -123,7 +123,7 @@ class NodeAffinity:
     ] = field(default_factory=list)
 
 
-@dataclass
+@dataclass(slots=True)
 class Affinity:
     node_affinity: Optional[NodeAffinity] = None
 
@@ -228,13 +228,13 @@ def matches_affinity_shape(labels: Dict[str, str], shape: tuple) -> bool:
     )
 
 
-@dataclass
+@dataclass(slots=True)
 class Container:
     name: str = "main"
     requests: Dict[str, Quantity] = field(default_factory=dict)
 
 
-@dataclass
+@dataclass(slots=True)
 class PodSpec:
     node_name: str = ""
     containers: List[Container] = field(default_factory=list)
@@ -249,12 +249,12 @@ class PodSpec:
     affinity: Optional[Affinity] = None
 
 
-@dataclass
+@dataclass(slots=True)
 class PodStatus:
     phase: str = "Pending"
 
 
-@dataclass
+@dataclass(slots=True)
 class Pod:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: PodSpec = field(default_factory=PodSpec)
@@ -297,25 +297,25 @@ class Pod:
         return totals
 
 
-@dataclass
+@dataclass(slots=True)
 class NodeSpec:
     unschedulable: bool = False
     taints: List[Taint] = field(default_factory=list)
 
 
-@dataclass
+@dataclass(slots=True)
 class NodeCondition:
     type: str
     status: str
 
 
-@dataclass
+@dataclass(slots=True)
 class NodeStatus:
     allocatable: Dict[str, Quantity] = field(default_factory=dict)
     conditions: List[NodeCondition] = field(default_factory=list)
 
 
-@dataclass
+@dataclass(slots=True)
 class Node:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: NodeSpec = field(default_factory=NodeSpec)

@@ -40,14 +40,14 @@ COUNT_SCALING_POLICY = "Count"
 PERCENT_SCALING_POLICY = "Percent"
 
 
-@dataclass
+@dataclass(slots=True)
 class CrossVersionObjectReference:
     kind: str = ""
     name: str = ""
     api_version: str = ""
 
 
-@dataclass
+@dataclass(slots=True)
 class MetricTarget:
     type: str = VALUE
     value: Optional[float] = None
@@ -61,13 +61,13 @@ class MetricTarget:
         return 0.0
 
 
-@dataclass
+@dataclass(slots=True)
 class PrometheusMetricSource:
     query: str = ""
     target: MetricTarget = field(default_factory=MetricTarget)
 
 
-@dataclass
+@dataclass(slots=True)
 class Metric:
     """One-of metric source (reference: horizontalautoscaler.go:158-163)."""
 
@@ -82,7 +82,7 @@ class Metric:
         )
 
 
-@dataclass
+@dataclass(slots=True)
 class ScalingPolicy:
     type: str = COUNT_SCALING_POLICY
     value: int = 0
@@ -110,7 +110,7 @@ class ScalingPolicy:
             )
 
 
-@dataclass
+@dataclass(slots=True)
 class ScalingRules:
     stabilization_window_seconds: Optional[int] = None
     select_policy: Optional[str] = None
@@ -170,7 +170,7 @@ class ScalingRules:
         return max(budgets)
 
 
-@dataclass
+@dataclass(slots=True)
 class Behavior:
     scale_up: Optional[ScalingRules] = None
     scale_down: Optional[ScalingRules] = None
@@ -216,25 +216,25 @@ class Behavior:
         return replicas
 
 
-@dataclass
+@dataclass(slots=True)
 class MetricValueStatus:
     value: Optional[float] = None
     average_value: Optional[float] = None
     average_utilization: Optional[int] = None
 
 
-@dataclass
+@dataclass(slots=True)
 class PrometheusMetricStatus:
     query: str = ""
     current: MetricValueStatus = field(default_factory=MetricValueStatus)
 
 
-@dataclass
+@dataclass(slots=True)
 class MetricStatus:
     prometheus: Optional[PrometheusMetricStatus] = None
 
 
-@dataclass
+@dataclass(slots=True)
 class HorizontalAutoscalerSpec:
     scale_target_ref: CrossVersionObjectReference = field(
         default_factory=CrossVersionObjectReference
@@ -245,7 +245,7 @@ class HorizontalAutoscalerSpec:
     behavior: Behavior = field(default_factory=Behavior)
 
 
-@dataclass
+@dataclass(slots=True)
 class HorizontalAutoscalerStatus:
     last_scale_time: Optional[float] = None
     current_replicas: Optional[int] = None
@@ -267,7 +267,7 @@ def register_validation_hook(hook) -> None:
     _validation_hooks.append(hook)
 
 
-@dataclass
+@dataclass(slots=True)
 class HorizontalAutoscaler:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: HorizontalAutoscalerSpec = field(default_factory=HorizontalAutoscalerSpec)

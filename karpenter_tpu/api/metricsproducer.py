@@ -20,7 +20,7 @@ AWS_SQS_QUEUE_TYPE = "AWSSQSQueue"
 FAKE_QUEUE_TYPE = "FakeQueue"
 
 
-@dataclass
+@dataclass(slots=True)
 class ReservedCapacitySpec:
     node_selector: Dict[str, str] = field(default_factory=dict)
 
@@ -32,7 +32,7 @@ class ReservedCapacitySpec:
             )
 
 
-@dataclass
+@dataclass(slots=True)
 class PendingCapacitySpec:
     node_selector: Dict[str, str] = field(default_factory=dict)
     # scale-from-zero: when node_selector matches NO nodes, profile the
@@ -45,7 +45,7 @@ class PendingCapacitySpec:
         """reference: metricsproducer_validation.go:85-87 (no-op)."""
 
 
-@dataclass
+@dataclass(slots=True)
 class QueueSpec:
     type: str = ""
     id: str = ""
@@ -85,7 +85,7 @@ def _validate_field(value: Optional[str], pattern: re.Pattern, name: str) -> Non
                 )
 
 
-@dataclass
+@dataclass(slots=True)
 class Pattern:
     """Strongly-typed crontab (reference: metricsproducer.go:70-83)."""
 
@@ -115,14 +115,14 @@ class Pattern:
         )
 
 
-@dataclass
+@dataclass(slots=True)
 class ScheduledBehavior:
     replicas: int = 0
     start: Optional[Pattern] = None
     end: Optional[Pattern] = None
 
 
-@dataclass
+@dataclass(slots=True)
 class ScheduleSpec:
     behaviors: List[ScheduledBehavior] = field(default_factory=list)
     timezone: Optional[str] = None
@@ -149,7 +149,7 @@ class ScheduleSpec:
                 raise ValueError("timezone region could not be parsed")
 
 
-@dataclass
+@dataclass(slots=True)
 class MetricsProducerSpec:
     pending_capacity: Optional[PendingCapacitySpec] = None
     queue: Optional[QueueSpec] = None
@@ -173,20 +173,20 @@ def validate_queue(spec: QueueSpec) -> None:
     validator(spec)
 
 
-@dataclass
+@dataclass(slots=True)
 class QueueStatus:
     length: int = 0
     oldest_message_age_seconds: int = 0
 
 
-@dataclass
+@dataclass(slots=True)
 class ScheduledCapacityStatus:
     current_value: Optional[int] = None
     next_value_time: Optional[float] = None
     next_value: Optional[int] = None
 
 
-@dataclass
+@dataclass(slots=True)
 class PendingCapacityStatus:
     """Per-node-group pending-pods signal. The reference's status struct is
     empty (metricsproducer_status.go:44-45); we surface the solver outputs."""
@@ -197,7 +197,7 @@ class PendingCapacityStatus:
     unschedulable_pods: int = 0  # cluster-wide: pods no group can take
 
 
-@dataclass
+@dataclass(slots=True)
 class MetricsProducerStatus:
     pending_capacity: Optional[PendingCapacityStatus] = None
     queue: Optional[QueueStatus] = None
@@ -206,7 +206,7 @@ class MetricsProducerStatus:
     conditions: List[Condition] = field(default_factory=list)
 
 
-@dataclass
+@dataclass(slots=True)
 class MetricsProducer:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: MetricsProducerSpec = field(default_factory=MetricsProducerSpec)

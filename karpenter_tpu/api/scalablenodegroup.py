@@ -26,20 +26,20 @@ TPU_POD_SLICE_POOL = "TPUPodSlicePool"
 FAKE_NODE_GROUP = "FakeNodeGroup"
 
 
-@dataclass
+@dataclass(slots=True)
 class ScalableNodeGroupSpec:
     replicas: Optional[int] = None
     type: str = ""
     id: str = ""
 
 
-@dataclass
+@dataclass(slots=True)
 class ScalableNodeGroupStatus:
     replicas: Optional[int] = None
     conditions: List[Condition] = field(default_factory=list)
 
 
-@dataclass
+@dataclass(slots=True)
 class ScalableNodeGroup:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: ScalableNodeGroupSpec = field(default_factory=ScalableNodeGroupSpec)
