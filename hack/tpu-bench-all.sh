@@ -10,7 +10,10 @@ failures=0
 for args in \
     "--backend pallas" \
     "--backend xla" \
+    "--affinity 0.5 --iters 10" \
     "--e2e" \
+    "--e2e --affinity 0.3" \
+    "--e2e --pods 1000000 --churn 1000 --iters 5" \
     "--decide 100000" \
     "--clusters 10 --types 30 --pods 100000" \
     "--pods 1000000 --iters 5" \
