@@ -293,3 +293,139 @@ class TestLeaderElectionChurn:
         # leaders across two kills) and a lease object exists
         assert len(ever_led) >= 3, ever_led
         assert store.try_get("Lease", "kube-system", "karpenter-leader")
+
+
+class TestPendingFeedUnderConcurrency:
+    def test_pod_churn_races_with_snapshot_and_dedup(self):
+        """N writers churn pending pods (create/update/delete, shared +
+        distinct shapes, some with affinity) while a reader continuously
+        snapshots and dedups. Invariants at quiesce: no exceptions, the
+        incremental dedup's weights sum to the live pending count, and
+        the cache's snapshot solves identically to a fresh detached
+        encode over store.list (the oracle)."""
+        import numpy as np
+
+        import karpenter_tpu.metrics.producers.pendingcapacity as PC
+        from karpenter_tpu.api.core import (
+            Affinity,
+            Container,
+            NodeAffinity,
+            NodeSelector,
+            NodeSelectorRequirement,
+            NodeSelectorTerm,
+            Pod,
+            PodSpec,
+            resource_list,
+        )
+        from karpenter_tpu.store.columnar import (
+            PendingPodCache,
+            snapshot_from_pods,
+        )
+
+        store = Store()
+        cache = PendingPodCache(store)
+
+        def pin(zone):
+            return Affinity(
+                node_affinity=NodeAffinity(
+                    required_during_scheduling_ignored_during_execution=(
+                        NodeSelector(
+                            node_selector_terms=[
+                                NodeSelectorTerm(
+                                    match_expressions=[
+                                        NodeSelectorRequirement(
+                                            key="zone",
+                                            operator="In",
+                                            values=[zone],
+                                        )
+                                    ]
+                                )
+                            ]
+                        )
+                    )
+                )
+            )
+
+        cpus = ["100m", "250m", "1", "2"]
+
+        def make_pod(name, i):
+            return Pod(
+                metadata=ObjectMeta(name=name, namespace="default"),
+                spec=PodSpec(
+                    containers=[
+                        Container(
+                            requests=resource_list(cpu=cpus[i % len(cpus)])
+                        )
+                    ],
+                    affinity=pin(f"z{i % 3}") if i % 5 == 0 else None,
+                ),
+            )
+
+        def writer(wid):
+            def run():
+                for i in range(OPS_PER_WRITER):
+                    name = f"p{wid}-{i % 20}"  # per-writer keys, reused
+                    op = i % 3
+                    try:
+                        if op == 0:
+                            store.create(make_pod(name, i))
+                        elif op == 1:
+                            obj = store.try_get("Pod", "default", name)
+                            if obj is not None:
+                                store.update(make_pod(name, i + 1))
+                        else:
+                            store.delete("Pod", "default", name)
+                    except (ConflictError, NotFoundError):
+                        pass
+
+            return run
+
+        stop = threading.Event()
+
+        def reader():
+            while not stop.is_set():
+                snap = cache.snapshot()
+                idx, weights = PC._dedup_rows(snap)
+                # internal coherence mid-race: weights positive, indices
+                # inside the snapshot
+                assert (weights > 0).all()
+                if len(idx):
+                    assert int(idx.max()) < snap.requests.shape[0]
+
+        reader_thread = threading.Thread(target=reader)
+        reader_thread.start()
+        try:
+            errors = run_threads([writer(w) for w in range(N_WRITERS)])
+        finally:
+            stop.set()
+            reader_thread.join(timeout=60)
+        assert errors == [], errors
+        assert not reader_thread.is_alive()
+
+        live = store.list("Pod")
+        snap = cache.snapshot()
+        _, weights = PC._dedup_rows(snap)
+        assert int(np.sum(weights)) == len(live) == len(cache)
+
+        # the watch-maintained cache must solve exactly like a fresh
+        # detached encode of the store's current pods
+        profiles = [
+            ({"cpu": 8.0, "memory": 64.0 * 1024**3, "pods": 110.0},
+             {("zone", "z0")}, set()),
+            ({"cpu": 8.0, "memory": 64.0 * 1024**3, "pods": 110.0},
+             {("zone", "z1")}, set()),
+        ]
+        from karpenter_tpu.ops import binpack as B
+
+        got = B.binpack(PC._encode_from_cache(snap, profiles), buckets=8)
+        want = B.binpack(
+            PC._encode_from_cache(snapshot_from_pods(live), profiles),
+            buckets=8,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.assigned_count), np.asarray(want.assigned_count)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.nodes_needed), np.asarray(want.nodes_needed)
+        )
+        assert int(got.unschedulable) == int(want.unschedulable)
