@@ -31,6 +31,7 @@ import ssl
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -235,14 +236,43 @@ class KubeClient:
 
     # -- typed operations --------------------------------------------------
 
+    # relist chunk size: at 100k+ pods a single unchunked LIST makes the
+    # apiserver serialize the whole collection into one response (memory
+    # spike on both ends, APF penalty); chunked LISTs stream pages via
+    # the k8s continue-token protocol instead
+    list_chunk_size = 5000
+
     def list(self, kind: str) -> Tuple[list, str]:
-        payload = self._request("GET", self._collection(kind, None))
+        """Chunked LIST (limit + continue tokens). The FIRST page's
+        resourceVersion is the collection version the informer resumes
+        its watch from — the continue protocol serves all pages at that
+        same version, so the (list, rv) pair stays coherent."""
+        base = self._collection(kind, None)
         objs = []
-        for item in payload.get("items", []):
-            item.setdefault("kind", kind)
-            objs.append(decode_from_read(item))
-        rv = payload.get("metadata", {}).get("resourceVersion", "0")
-        return objs, rv
+        rv = "0"
+        token = None
+        while True:
+            path = f"{base}?limit={self.list_chunk_size}"
+            if token:
+                path += f"&continue={urllib.parse.quote(token)}"
+            payload = self._request("GET", path)
+            for item in payload.get("items", []):
+                item.setdefault("kind", kind)
+                objs.append(decode_from_read(item))
+            meta = payload.get("metadata", {})
+            if token is None:
+                rv = meta.get("resourceVersion", "0")
+            next_token = meta.get("continue")
+            if next_token and next_token == token:
+                # a misbehaving endpoint echoing the same token forever
+                # would otherwise loop unbounded inside the informer's
+                # resync; raising routes into its retry-with-backoff path
+                raise RuntimeError(
+                    f"list {kind}: continue token did not advance"
+                )
+            token = next_token
+            if not token:
+                return objs, rv
 
     def watch(
         self,

@@ -56,6 +56,13 @@ def _merge_patch(target, patch):
 class FakeApiServer:
     def __init__(self):
         self._lock = threading.Lock()
+        self.list_pages_served = 0  # chunked-list pages (tests assert)
+        # chunked-list snapshots: like the real apiserver, every page of
+        # one paginated LIST serves from the FIRST page's snapshot (same
+        # items, same collection rv), or concurrent writes would skip /
+        # duplicate objects across pages
+        self._list_snapshots: Dict[str, Tuple[list, str]] = {}
+        self._snapshot_seq = 0
         self._objects: Dict[Tuple[str, str, str], dict] = {}
         self._rv = 0
         self._watchers: List[Tuple[str, "queue.Queue"]] = []
@@ -142,18 +149,44 @@ class FakeApiServer:
                             (query.get("resourceVersion") or ["0"])[0]
                         )
                         return self._serve_watch(plural, since)
+                    limit = int((query.get("limit") or ["0"])[0])
+                    token = (query.get("continue") or [""])[0]
                     with fake._lock:
-                        items = [
-                            json.loads(json.dumps(d))
-                            for (p, _, _), d in fake._objects.items()
-                            if p == plural
-                        ]
-                        rv = str(fake._rv)
+                        if limit > 0 and token:
+                            # later page: serve from the FIRST page's
+                            # snapshot (real-apiserver semantics)
+                            snap_id, _, start_s = token.partition(":")
+                            items, rv = fake._list_snapshots[snap_id]
+                            start = int(start_s)
+                        else:
+                            items = [
+                                json.loads(json.dumps(d))
+                                for (p, _, _), d in sorted(
+                                    fake._objects.items()
+                                )
+                                if p == plural
+                            ]
+                            rv = str(fake._rv)
+                            start = 0
+                            if limit > 0:
+                                fake._snapshot_seq += 1
+                                snap_id = f"s{fake._snapshot_seq}"
+                                fake._list_snapshots[snap_id] = (items, rv)
+                    meta = {"resourceVersion": rv}
+                    if limit > 0:
+                        fake.list_pages_served += 1
+                        chunk = items[start : start + limit]
+                        if start + limit < len(items):
+                            meta["continue"] = f"{snap_id}:{start + limit}"
+                        else:
+                            with fake._lock:
+                                fake._list_snapshots.pop(snap_id, None)
+                        items = chunk
                     return self._send_json(
                         200,
                         {
                             "kind": f"{PLURALS[plural]}List",
-                            "metadata": {"resourceVersion": rv},
+                            "metadata": meta,
                             "items": items,
                         },
                     )

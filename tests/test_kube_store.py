@@ -430,3 +430,32 @@ class TestControlPlaneOnKube:
         runtime.manager.reconcile_all()
         assert wait_for(happy), api.objects("scalablenodegroups")
         runtime.close()
+
+
+class TestChunkedList:
+    def test_relist_pages_through_continue_tokens(self, api):
+        """The mirror's relist uses limit+continue chunking (one giant
+        LIST at 100k pods would spike memory on both ends); all pages
+        must be gathered and the first page's collection rv kept."""
+        from karpenter_tpu.store.kube import KubeClient
+
+        for i in range(23):
+            api.put_object(
+                "pods",
+                {
+                    "apiVersion": "v1",
+                    "kind": "Pod",
+                    "metadata": {"name": f"p{i:02}"},
+                    "spec": {"containers": [{"requests": {"cpu": "1"}}]},
+                },
+            )
+        client = KubeClient(base_url=api.url)
+        client.list_chunk_size = 10
+        before = api.list_pages_served
+        objs, rv = client.list("Pod")
+        assert len(objs) == 23
+        assert sorted(o.metadata.name for o in objs) == [
+            f"p{i:02}" for i in range(23)
+        ]
+        assert api.list_pages_served - before == 3  # 10 + 10 + 3
+        assert rv and rv != "0"
