@@ -213,12 +213,33 @@ def write_crds(config_dir: str) -> list:
 
     crd_dir = os.path.join(config_dir, "crd")
     os.makedirs(crd_dir, exist_ok=True)
+    # the Helm chart installs CRDs via the crds/ convention (applied
+    # before templates, never templated); write the SAME content there so
+    # the chart can't drift from the types — both copies are codegen
+    # outputs, pinned equal by tests/test_codegen.py
+    chart_crds = os.path.join(
+        os.path.dirname(os.path.abspath(os.path.normpath(config_dir))),
+        "charts",
+        "karpenter-tpu",
+        "crds",
+    )
+    chart_present = os.path.isdir(os.path.dirname(chart_crds))
+    if chart_present:
+        os.makedirs(chart_crds, exist_ok=True)
     written = []
     for kind, info in CRD_KINDS.items():
+        content = crd_yaml(kind)
         path = os.path.join(crd_dir, f"{GROUP}_{info['plural']}.yaml")
         with open(path, "w") as f:
-            f.write(crd_yaml(kind))
+            f.write(content)
         written.append(path)
+        if chart_present:
+            chart_path = os.path.join(
+                chart_crds, f"{GROUP}_{info['plural']}.yaml"
+            )
+            with open(chart_path, "w") as f:
+                f.write(content)
+            written.append(chart_path)
     return written
 
 

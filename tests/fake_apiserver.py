@@ -156,7 +156,21 @@ class FakeApiServer:
                             # later page: serve from the FIRST page's
                             # snapshot (real-apiserver semantics)
                             snap_id, _, start_s = token.partition(":")
-                            items, rv = fake._list_snapshots[snap_id]
+                            snapshot = fake._list_snapshots.get(snap_id)
+                            if snapshot is None or not start_s.isdigit():
+                                # expired/unknown token: the real
+                                # apiserver's 410 Expired, not a crashed
+                                # handler thread
+                                return self._send_json(
+                                    410,
+                                    {
+                                        "kind": "Status",
+                                        "code": 410,
+                                        "reason": "Expired",
+                                        "message": "continue token expired",
+                                    },
+                                )
+                            items, rv = snapshot
                             start = int(start_s)
                         else:
                             items = [
@@ -172,16 +186,23 @@ class FakeApiServer:
                                 fake._snapshot_seq += 1
                                 snap_id = f"s{fake._snapshot_seq}"
                                 fake._list_snapshots[snap_id] = (items, rv)
-                    meta = {"resourceVersion": rv}
-                    if limit > 0:
-                        fake.list_pages_served += 1
-                        chunk = items[start : start + limit]
-                        if start + limit < len(items):
-                            meta["continue"] = f"{snap_id}:{start + limit}"
-                        else:
-                            with fake._lock:
+                                # abandoned paginations must not leak:
+                                # keep only the most recent snapshots
+                                while len(fake._list_snapshots) > 8:
+                                    fake._list_snapshots.pop(
+                                        next(iter(fake._list_snapshots))
+                                    )
+                        meta = {"resourceVersion": rv}
+                        if limit > 0:
+                            fake.list_pages_served += 1
+                            chunk = items[start : start + limit]
+                            if start + limit < len(items):
+                                meta["continue"] = (
+                                    f"{snap_id}:{start + limit}"
+                                )
+                            else:
                                 fake._list_snapshots.pop(snap_id, None)
-                        items = chunk
+                            items = chunk
                     return self._send_json(
                         200,
                         {

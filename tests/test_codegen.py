@@ -29,6 +29,25 @@ class TestCrdGeneration:
                 f"{path} is stale — run `make codegen`"
             )
 
+    def test_chart_crds_match_codegen(self):
+        """The Helm chart installs CRDs via the crds/ convention; its
+        copies are codegen outputs and must equal config/crd's (a chart
+        that claims 'installs the three CRDs' but drifts — or lacks them
+        entirely, the bug this pins — ships a controller with no API)."""
+        for kind, info in CRD_KINDS.items():
+            path = os.path.join(
+                REPO,
+                "charts",
+                "karpenter-tpu",
+                "crds",
+                f"{GROUP}_{info['plural']}.yaml",
+            )
+            with open(path) as f:
+                committed = f.read()
+            assert committed == crd_yaml(kind), (
+                f"{path} is stale — run `make codegen`"
+            )
+
     def test_committed_api_docs_match_codegen(self):
         """docs/API.md is generated (make docs); committed == regenerated,
         same freshness contract as the CRDs."""
