@@ -7,6 +7,11 @@
 set -uo pipefail
 cd "$(dirname "$0")/.."
 failures=0
+# The suite is run right after a successful probe (hack/tpu-watch-capture.sh
+# or an operator who just checked the chip), so a mid-suite hang means the
+# tunnel dropped — fall back fast rather than letting all nine configs wait
+# out the default 21-minute hang schedule independently (~3h of nothing).
+HANG_SCHEDULE="${PROBE_HANG_SCHEDULE:-}"
 for args in \
     "--backend pallas" \
     "--backend xla" \
@@ -20,7 +25,7 @@ for args in \
     ; do
   echo "=== bench.py $args ===" >&2
   # shellcheck disable=SC2086
-  python bench.py $args || {
+  python bench.py $args --probe-hang-schedule "$HANG_SCHEDULE" || {
     echo "{\"error\": \"bench.py $args failed\"}"
     failures=$((failures + 1))
   }

@@ -48,7 +48,11 @@ def force_virtual_cpu(n_devices: int) -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
-def probe_default_backend(timeout: float = 120.0, retries: int = 2):
+def probe_default_backend(
+    timeout: float = 120.0,
+    retries: int = 2,
+    hang_schedule: tuple = (),
+):
     """Probe the DEFAULT jax backend in a subprocess, with retry+backoff.
 
     The axon TPU client can raise UNAVAILABLE or HANG at init (the round-1
@@ -56,6 +60,17 @@ def probe_default_backend(timeout: float = 120.0, retries: int = 2):
     process under a hard timeout, where both failure modes are
     recoverable. Returns (device_count, "") on a healthy backend, else
     (0, reason). Never initializes a backend in THIS process.
+
+    A raised UNAVAILABLE often clears within seconds, so those retry on
+    the short exponential-backoff schedule. A HANG means the tunnel is
+    down and has never been observed to clear quickly — by default it
+    aborts the remaining short retries so control-plane entry points fall
+    back to CPU fast. Callers that would rather wait out a tunnel outage
+    (the benchmark: a CPU number is near-worthless evidence) pass
+    ``hang_schedule``, extra delays in seconds slept before re-probing
+    after each hang (on top of the ``timeout`` seconds the hang itself
+    burned — ``(300, 600)`` with a 120 s timeout re-probes at ~t+7m and
+    ~t+19m).
     """
     import subprocess
     import sys
@@ -63,7 +78,9 @@ def probe_default_backend(timeout: float = 120.0, retries: int = 2):
 
     last = ""
     probes = 0
-    for attempt in range(retries + 1):
+    hangs = 0
+    attempt = 0
+    while attempt <= retries:
         if attempt:
             delay = 5.0 * (2 ** (attempt - 1))
             # progress line: a probe cycle can take minutes; an operator
@@ -74,6 +91,7 @@ def probe_default_backend(timeout: float = 120.0, retries: int = 2):
                 file=sys.stderr,
             )
             time.sleep(delay)
+        attempt += 1
         probes += 1
         env = dict(os.environ)
         if env.get("JAX_PLATFORMS") == "cpu":
@@ -96,9 +114,22 @@ def probe_default_backend(timeout: float = 120.0, retries: int = 2):
                 env=env,
             )
         except subprocess.TimeoutExpired:
-            # a hang (unlike a raised UNAVAILABLE) has never been observed
-            # to clear on its own; don't burn the remaining retries on it
             last = f"backend init hung (> {timeout:.0f}s)"
+            if hangs < len(hang_schedule):
+                # the caller asked to wait out a tunnel outage: sleep the
+                # long delay, then re-enter the probe loop from the top
+                delay = float(hang_schedule[hangs])
+                hangs += 1
+                print(
+                    f"backend init hung; long retry "
+                    f"{hangs}/{len(hang_schedule)} in {delay:.0f}s",
+                    file=sys.stderr,
+                )
+                time.sleep(delay)
+                attempt = 0
+                continue
+            # a hang (unlike a raised UNAVAILABLE) has never been observed
+            # to clear quickly; don't burn the remaining short retries
             break
         if proc.returncode == 0:
             try:
@@ -110,7 +141,11 @@ def probe_default_backend(timeout: float = 120.0, retries: int = 2):
     return 0, f"{last} after {probes} probe(s)"
 
 
-def ensure_usable_backend(timeout: float = 120.0, retries: int = 2) -> str:
+def ensure_usable_backend(
+    timeout: float = 120.0,
+    retries: int = 2,
+    hang_schedule: tuple = (),
+) -> str:
     """Guarantee the first in-process jax call cannot hang: probe the
     default backend and force the CPU backend if it is unusable.
 
@@ -119,7 +154,7 @@ def ensure_usable_backend(timeout: float = 120.0, retries: int = 2) -> str:
     mode a control plane wants during an accelerator outage: decisions
     keep flowing on CPU instead of the process freezing at first jit.
     """
-    count, reason = probe_default_backend(timeout, retries)
+    count, reason = probe_default_backend(timeout, retries, hang_schedule)
     if count:
         return ""
     import jax

@@ -1,0 +1,136 @@
+"""Probe/fallback behavior of utils.backend under a hung or flaky tunnel.
+
+The axon TPU tunnel's observed failure modes are (a) raised UNAVAILABLE,
+which clears within seconds, and (b) a hard hang at client init, which can
+last hours (it erased the round-1 and round-2 driver bench captures).
+Control-plane entry points must fall back to CPU fast on (b); the
+benchmark must instead wait out the outage on a long schedule. Both
+policies live in probe_default_backend's hang_schedule parameter.
+
+Reference behavior anchor: the reference trusts its accelerator runtime to
+be present and has no analog — this subsystem exists because decisions
+must keep flowing through an accelerator outage.
+"""
+
+from __future__ import annotations
+
+import subprocess
+
+import pytest
+
+from karpenter_tpu.utils import backend
+
+
+class _Hang:
+    """subprocess.run stand-in that hangs N times, then succeeds."""
+
+    def __init__(self, hangs: int, then: str = "tpu 1"):
+        self.hangs = hangs
+        self.then = then
+        self.calls = 0
+
+    def __call__(self, *a, timeout=None, **k):
+        self.calls += 1
+        if self.calls <= self.hangs:
+            raise subprocess.TimeoutExpired(cmd="probe", timeout=timeout)
+        out = lambda: None  # noqa: E731
+        out.returncode = 0
+        out.stdout = self.then
+        out.stderr = ""
+        return out
+
+
+@pytest.fixture()
+def no_sleep(monkeypatch):
+    slept = []
+    monkeypatch.setattr(
+        "time.sleep", lambda s: slept.append(s), raising=True
+    )
+    return slept
+
+
+def test_hang_aborts_short_retries_by_default(monkeypatch, no_sleep):
+    """Entry-point policy: one hang => immediate CPU-fallback signal,
+    without burning the remaining short retries (each costs timeout s)."""
+    probe = _Hang(hangs=99)
+    monkeypatch.setattr(subprocess, "run", probe)
+    count, reason = backend.probe_default_backend(timeout=7.0, retries=2)
+    assert count == 0
+    assert "hung" in reason and "1 probe(s)" in reason
+    assert probe.calls == 1
+    assert no_sleep == []
+
+
+def test_hang_schedule_waits_out_outage(monkeypatch, no_sleep):
+    """Bench policy: a hang sleeps the next long delay and re-probes; the
+    tunnel recovering on the final long retry yields a healthy result."""
+    probe = _Hang(hangs=2)
+    monkeypatch.setattr(subprocess, "run", probe)
+    count, reason = backend.probe_default_backend(
+        timeout=7.0, retries=2, hang_schedule=(300, 600)
+    )
+    assert (count, reason) == (1, "")
+    assert probe.calls == 3
+    assert no_sleep == [300.0, 600.0]
+
+
+def test_hang_schedule_exhausted_fails_loud(monkeypatch, no_sleep):
+    """All long retries hung too: the reason must say so, with the true
+    probe count, so the driver JSON note is honest evidence."""
+    probe = _Hang(hangs=99)
+    monkeypatch.setattr(subprocess, "run", probe)
+    count, reason = backend.probe_default_backend(
+        timeout=7.0, retries=2, hang_schedule=(300,)
+    )
+    assert count == 0
+    assert "hung" in reason and "2 probe(s)" in reason
+    assert no_sleep == [300.0]
+
+
+def test_raise_still_uses_short_backoff(monkeypatch, no_sleep):
+    """A raised init error (not a hang) keeps the short exponential
+    backoff; hang_schedule is not consumed."""
+
+    calls = {"n": 0}
+
+    def raises(*a, timeout=None, **k):
+        calls["n"] += 1
+        out = lambda: None  # noqa: E731
+        out.returncode = 1
+        out.stdout = ""
+        out.stderr = "RuntimeError: UNAVAILABLE: tunnel reset"
+        return out
+
+    monkeypatch.setattr(subprocess, "run", raises)
+    count, reason = backend.probe_default_backend(
+        timeout=7.0, retries=2, hang_schedule=(300, 600)
+    )
+    assert count == 0
+    assert "UNAVAILABLE" in reason and "3 probe(s)" in reason
+    assert calls["n"] == 3
+    assert no_sleep == [5.0, 10.0]  # short backoff only, no long delays
+
+
+def test_hang_then_raise_then_recover(monkeypatch, no_sleep):
+    """After a long hang-retry the short-retry budget is fresh: hang,
+    long sleep, raise, short sleep, success."""
+
+    seq = ["hang", "raise", "ok"]
+
+    def flaky(*a, timeout=None, **k):
+        step = seq.pop(0)
+        if step == "hang":
+            raise subprocess.TimeoutExpired(cmd="probe", timeout=timeout)
+        out = lambda: None  # noqa: E731
+        out.returncode = 0 if step == "ok" else 1
+        out.stdout = "tpu 1" if step == "ok" else ""
+        out.stderr = "" if step == "ok" else "UNAVAILABLE"
+        return out
+
+    monkeypatch.setattr(subprocess, "run", flaky)
+    count, reason = backend.probe_default_backend(
+        timeout=7.0, retries=2, hang_schedule=(120,)
+    )
+    assert (count, reason) == (1, "")
+    assert seq == []
+    assert no_sleep == [120.0, 5.0]
