@@ -476,9 +476,14 @@ class SQSQueue:
 
 
 class AWSFactory:
-    """reference: factory.go:41-76. Clients are injected; unset clients get
-    a fail-with-guidance stub rather than a session (no EC2 metadata
-    service in a TPU deployment)."""
+    """reference: factory.go:41-76. Client resolution order per seam:
+    explicit injection, then — only when constructed through the registry
+    (the operator explicitly selected KARPENTER_CLOUD_PROVIDER=aws, so a
+    live session is wanted, like the reference's factory) — the boto3
+    binding (aws_sdk.bind), then the fail-with-guidance stub. Direct
+    construction defaults to injection-or-stub so tests and embedders
+    never build live cloud clients (or do IMDS network I/O) as a side
+    effect of an ambient SDK install."""
 
     def __init__(
         self,
@@ -486,9 +491,18 @@ class AWSFactory:
         autoscaling_client: Optional[AutoscalingAPI] = None,
         eks_client: Optional[EKSAPI] = None,
         sqs_client: Optional[SQSAPI] = None,
+        sdk_autobind: bool = False,
     ):
         options = options or Options()
         self.store = options.store
+        if sdk_autobind:
+            from karpenter_tpu.cloudprovider import aws_sdk
+
+            autoscaling_client = autoscaling_client or aws_sdk.bind(
+                "autoscaling"
+            )
+            eks_client = eks_client or aws_sdk.bind("eks")
+            sqs_client = sqs_client or aws_sdk.bind("sqs")
         self.autoscaling_client = autoscaling_client or _NotImplementedClient(
             "autoscaling"
         )

@@ -37,13 +37,15 @@ def new_factory(options: Optional[Options] = None, provider: Optional[str] = Non
 def _aws_factory(options: Options):
     from karpenter_tpu.cloudprovider.aws import AWSFactory
 
-    return AWSFactory(options)
+    # registry selection = the operator explicitly chose this provider, so
+    # live SDK clients are wanted (reference: factory.go builds a session)
+    return AWSFactory(options, sdk_autobind=True)
 
 
 def _tpu_factory(options: Options):
     from karpenter_tpu.cloudprovider.tpu import TPUFactory
 
-    return TPUFactory(options)
+    return TPUFactory(options, sdk_autobind=True)
 
 
 register_provider("fake", lambda options: FakeFactory(options))

@@ -293,9 +293,20 @@ class TPUFactory:
         options: Optional[Options] = None,
         container_api: Optional[ContainerAPI] = None,
         pubsub_api: Optional[PubSubMetricsAPI] = None,
+        sdk_autobind: bool = False,
     ):
+        # resolution per seam: explicit injection, then — only via the
+        # registry (operator selected the provider, a live client is
+        # wanted) — the google-cloud binding (gke_sdk), then the
+        # fail-with-guidance stub; direct construction never builds live
+        # cloud clients as a side effect of an ambient SDK install
         options = options or Options()
         self.store = options.store
+        if sdk_autobind:
+            from karpenter_tpu.cloudprovider import gke_sdk
+
+            container_api = container_api or gke_sdk.bind_container()
+            pubsub_api = pubsub_api or gke_sdk.bind_pubsub_metrics()
         self.container_api = container_api or _NotImplementedContainerAPI()
         self.pubsub_api = pubsub_api or _NotImplementedPubSubAPI()
         self._fallback = FakeFactory.not_implemented()

@@ -1,0 +1,755 @@
+"""SDK binding tests: boto3 / google-cloud adapters against recorded
+call/response shapes, with the SDK modules stubbed into sys.modules.
+
+reference: pkg/cloudprovider/aws/factory.go:41-76 — the reference builds a
+live session at factory construction; its tests run against the fake
+factory instead. Here the binding layer itself is under test: call-shape
+translation, error taxonomy mapping, region discovery, and automatic
+selection (KARPENTER_CLOUD_PROVIDER=aws constructs a bound factory with no
+injection when the SDK is importable).
+"""
+
+from __future__ import annotations
+
+import importlib.machinery
+import sys
+import types
+
+import pytest
+
+from karpenter_tpu.cloudprovider import Options, node_template_from_raw
+from karpenter_tpu.cloudprovider.aws import (
+    AWSAPIError,
+    AWSFactory,
+    transient_error,
+)
+from karpenter_tpu.controllers.errors import RetryableError
+
+
+# ---------------------------------------------------------------------------
+# boto3 / botocore stubs
+# ---------------------------------------------------------------------------
+
+
+class _ClientError(Exception):
+    def __init__(self, code, message="boom"):
+        super().__init__(message)
+        self.response = {"Error": {"Code": code, "Message": message}}
+
+
+# mirror botocore's hierarchy: leaf connection errors subclass
+# ConnectionError / HTTPClientError, which is what _translate_call catches
+class _ConnectionError(Exception):
+    pass
+
+
+class _HTTPClientError(Exception):
+    pass
+
+
+class _EndpointConnectionError(_ConnectionError):
+    pass
+
+
+class _ConnectionClosedError(_ConnectionError):
+    pass
+
+
+class _ConnectTimeoutError(_ConnectionError):
+    pass
+
+
+class _ReadTimeoutError(_HTTPClientError):
+    pass
+
+
+class _RecordedClient:
+    """Duck-typed boto3 service client: canned responses, recorded calls."""
+
+    def __init__(self, responses=None):
+        self.responses = responses or {}
+        self.calls = []
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        def call(**kwargs):
+            self.calls.append((name, kwargs))
+            result = self.responses.get(name, {})
+            if isinstance(result, Exception):
+                raise result
+            return result
+
+        return call
+
+
+class _FakeSession:
+    def __init__(self, clients, region_name=None):
+        self._clients = clients
+        self.region_name = region_name
+        self.client_calls = []
+
+    def client(self, service, region_name=None):
+        self.client_calls.append((service, region_name))
+        return self._clients.get(service, _RecordedClient())
+
+
+@pytest.fixture()
+def boto3_stub(monkeypatch):
+    """Install fake boto3/botocore into sys.modules and reset the binding
+    cache around the test. Yields a dict the test fills with per-service
+    _RecordedClients before the first bind."""
+    from karpenter_tpu.cloudprovider import aws_sdk
+
+    clients = {}
+    boto3_mod = types.ModuleType("boto3")
+    boto3_mod.__spec__ = importlib.machinery.ModuleSpec("boto3", None)
+    session_mod = types.ModuleType("boto3.session")
+    session_mod.Session = lambda: _FakeSession(clients)
+    boto3_mod.session = session_mod
+    botocore_mod = types.ModuleType("botocore")
+    botocore_mod.__spec__ = importlib.machinery.ModuleSpec("botocore", None)
+    exceptions_mod = types.ModuleType("botocore.exceptions")
+    exceptions_mod.ClientError = _ClientError
+    exceptions_mod.ConnectionError = _ConnectionError
+    exceptions_mod.HTTPClientError = _HTTPClientError
+    exceptions_mod.EndpointConnectionError = _EndpointConnectionError
+    exceptions_mod.ConnectionClosedError = _ConnectionClosedError
+    exceptions_mod.ConnectTimeoutError = _ConnectTimeoutError
+    exceptions_mod.ReadTimeoutError = _ReadTimeoutError
+    botocore_mod.exceptions = exceptions_mod
+    monkeypatch.setitem(sys.modules, "boto3", boto3_mod)
+    monkeypatch.setitem(sys.modules, "boto3.session", session_mod)
+    monkeypatch.setitem(sys.modules, "botocore", botocore_mod)
+    monkeypatch.setitem(sys.modules, "botocore.exceptions", exceptions_mod)
+    monkeypatch.setenv("AWS_REGION", "us-west-2")
+    aws_sdk.reset_binding_cache()
+    yield clients
+    aws_sdk.reset_binding_cache()
+
+
+# ---------------------------------------------------------------------------
+# Binding selection
+# ---------------------------------------------------------------------------
+
+
+def test_no_sdk_binds_nothing_and_factory_guides(monkeypatch):
+    """With boto3 unavailable (stubbed — don't assert host properties),
+    bind degrades to None; and DIRECT factory construction keeps the
+    guidance stubs regardless of SDK presence (autobind is registry-only,
+    so unit tests never build live cloud clients)."""
+    from karpenter_tpu.cloudprovider import aws_sdk
+
+    monkeypatch.setattr(aws_sdk, "sdk_available", lambda: False)
+    aws_sdk.reset_binding_cache()
+    assert aws_sdk.bind("autoscaling") is None
+    factory = AWSFactory(Options())
+    with pytest.raises(RuntimeError, match="no autoscaling API client"):
+        factory.autoscaling_client.update_auto_scaling_group(name="x")
+
+
+def test_direct_construction_never_autobinds(boto3_stub):
+    """Even with a bindable SDK ambient, AWSFactory() without
+    sdk_autobind must keep the guidance stubs."""
+    factory = AWSFactory(Options())
+    with pytest.raises(RuntimeError, match="no autoscaling API client"):
+        factory.autoscaling_client.update_auto_scaling_group(name="x")
+
+
+def test_env_selected_aws_factory_binds_sdk_without_injection(
+    boto3_stub, monkeypatch
+):
+    """VERDICT r2 done-criterion: KARPENTER_CLOUD_PROVIDER=aws constructs
+    a working (SDK-bound) factory with no injected clients."""
+    from karpenter_tpu.cloudprovider import aws_sdk, registry
+
+    monkeypatch.setenv("KARPENTER_CLOUD_PROVIDER", "aws")
+    factory = registry.new_factory(Options())
+    assert isinstance(
+        factory.autoscaling_client, aws_sdk.Boto3AutoscalingClient
+    )
+    assert isinstance(factory.eks_client, aws_sdk.Boto3EKSClient)
+    assert isinstance(factory.sqs_client, aws_sdk.Boto3SQSClient)
+
+
+def test_region_resolution_order(boto3_stub, monkeypatch):
+    from karpenter_tpu.cloudprovider import aws_sdk
+
+    # env wins
+    assert aws_sdk.resolve_region() == "us-west-2"
+    # session config is next
+    monkeypatch.delenv("AWS_REGION")
+    session = _FakeSession({}, region_name="eu-central-1")
+    assert aws_sdk.resolve_region(session) == "eu-central-1"
+    # IMDS is last; stubbed unreachable (a real EC2 host would answer)
+    monkeypatch.setattr(aws_sdk, "_imds_region", lambda: None)
+    assert aws_sdk.resolve_region(_FakeSession({})) is None
+
+
+def test_unresolvable_region_leaves_clients_unbound(boto3_stub, monkeypatch):
+    from karpenter_tpu.cloudprovider import aws_sdk
+
+    monkeypatch.delenv("AWS_REGION")
+    monkeypatch.setattr(aws_sdk, "_imds_region", lambda: None)
+    aws_sdk.reset_binding_cache()
+    assert aws_sdk.bind("autoscaling") is None
+
+
+# ---------------------------------------------------------------------------
+# Call-shape translation
+# ---------------------------------------------------------------------------
+
+
+def test_asg_describe_shape_translation(boto3_stub):
+    from karpenter_tpu.cloudprovider import aws_sdk
+
+    asg = _RecordedClient(
+        {
+            "describe_auto_scaling_groups": {
+                "AutoScalingGroups": [
+                    {
+                        "AutoScalingGroupName": "web",
+                        "DesiredCapacity": 3,
+                        "Instances": [
+                            {
+                                "HealthStatus": "Healthy",
+                                "LifecycleState": "InService",
+                            },
+                            {
+                                "HealthStatus": "Unhealthy",
+                                "LifecycleState": "Terminating",
+                            },
+                        ],
+                        "Tags": [{"Key": "team", "Value": "infra"}],
+                    }
+                ]
+            }
+        }
+    )
+    boto3_stub["autoscaling"] = asg
+    client = aws_sdk.bind("autoscaling")
+    groups = client.describe_auto_scaling_groups(["web"], 1)
+    assert asg.calls[0] == (
+        "describe_auto_scaling_groups",
+        {"AutoScalingGroupNames": ["web"], "MaxRecords": 1},
+    )
+    assert groups[0]["desired_capacity"] == 3
+    assert groups[0]["instances"] == [
+        {"health_status": "Healthy", "lifecycle_state": "InService"},
+        {"health_status": "Unhealthy", "lifecycle_state": "Terminating"},
+    ]
+
+    client.update_auto_scaling_group(name="web", desired_capacity=5)
+    assert asg.calls[-1] == (
+        "update_auto_scaling_group",
+        {"AutoScalingGroupName": "web", "DesiredCapacity": 5},
+    )
+
+
+def test_asg_node_template_from_tags_and_instance_type(boto3_stub):
+    """Scale-from-zero: mixed-policy override type sized via
+    DescribeInstanceTypes; labels/taints from the cluster-autoscaler
+    node-template tag convention; parses through node_template_from_raw."""
+    from karpenter_tpu.cloudprovider import aws_sdk
+
+    boto3_stub["autoscaling"] = _RecordedClient(
+        {
+            "describe_auto_scaling_groups": {
+                "AutoScalingGroups": [
+                    {
+                        "AutoScalingGroupName": "gpu",
+                        "MixedInstancesPolicy": {
+                            "LaunchTemplate": {
+                                "Overrides": [{"InstanceType": "m5.xlarge"}]
+                            }
+                        },
+                        "Tags": [
+                            {
+                                "Key": "k8s.io/cluster-autoscaler/"
+                                "node-template/label/pool",
+                                "Value": "batch",
+                            },
+                            {
+                                "Key": "k8s.io/cluster-autoscaler/"
+                                "node-template/taint/dedicated",
+                                "Value": "batch:NoSchedule",
+                            },
+                        ],
+                    }
+                ]
+            }
+        }
+    )
+    boto3_stub["ec2"] = _RecordedClient(
+        {
+            "describe_instance_types": {
+                "InstanceTypes": [
+                    {
+                        "VCpuInfo": {"DefaultVCpus": 4},
+                        "MemoryInfo": {"SizeInMiB": 16384},
+                    }
+                ]
+            }
+        }
+    )
+    client = aws_sdk.bind("autoscaling")
+    raw = client.describe_node_template("gpu")
+    template = node_template_from_raw(raw)
+    assert str(template.allocatable["cpu"]) == "4"
+    assert template.allocatable["memory"].to_float() == 16384 * 1024 * 1024
+    assert template.labels["pool"] == "batch"
+    assert template.labels["node.kubernetes.io/instance-type"] == "m5.xlarge"
+    assert template.taints[0].key == "dedicated"
+    assert template.taints[0].value == "batch"
+    assert template.taints[0].effect == "NoSchedule"
+
+
+def test_eks_adapter_shapes(boto3_stub):
+    from karpenter_tpu.cloudprovider import aws_sdk
+
+    eks = _RecordedClient(
+        {
+            "describe_nodegroup": {
+                "nodegroup": {
+                    "instanceTypes": ["c5.large"],
+                    "labels": {"role": "worker"},
+                    "taints": [
+                        {
+                            "key": "gpu",
+                            "value": "true",
+                            "effect": "NO_SCHEDULE",
+                        }
+                    ],
+                }
+            }
+        }
+    )
+    boto3_stub["eks"] = eks
+    boto3_stub["ec2"] = _RecordedClient(
+        {
+            "describe_instance_types": {
+                "InstanceTypes": [
+                    {
+                        "VCpuInfo": {"DefaultVCpus": 2},
+                        "MemoryInfo": {"SizeInMiB": 4096},
+                    }
+                ]
+            }
+        }
+    )
+    client = aws_sdk.bind("eks")
+    client.update_nodegroup_config(
+        cluster_name="prod", nodegroup_name="pool-a", desired_size=7
+    )
+    assert eks.calls[0] == (
+        "update_nodegroup_config",
+        {
+            "clusterName": "prod",
+            "nodegroupName": "pool-a",
+            "scalingConfig": {"desiredSize": 7},
+        },
+    )
+    template = node_template_from_raw(
+        client.describe_node_template("prod", "pool-a")
+    )
+    assert str(template.allocatable["cpu"]) == "2"
+    assert template.labels["role"] == "worker"
+    # EKS enum dialect translated to core/v1 spelling
+    assert template.taints[0].effect == "NoSchedule"
+
+
+def test_sqs_adapter_shapes(boto3_stub):
+    from karpenter_tpu.cloudprovider import aws_sdk
+
+    sqs = _RecordedClient(
+        {
+            "get_queue_url": {"QueueUrl": "https://sqs/q"},
+            "get_queue_attributes": {
+                "Attributes": {"ApproximateNumberOfMessages": "12"}
+            },
+            "receive_message": {
+                "Messages": [{"Attributes": {"SentTimestamp": "123"}}]
+            },
+        }
+    )
+    boto3_stub["sqs"] = sqs
+    client = aws_sdk.bind("sqs")
+    assert client.get_queue_url("q", "123456789012") == "https://sqs/q"
+    assert sqs.calls[0] == (
+        "get_queue_url",
+        {"QueueName": "q", "QueueOwnerAWSAccountId": "123456789012"},
+    )
+    attributes = client.get_queue_attributes(
+        "https://sqs/q", ["ApproximateNumberOfMessages"]
+    )
+    assert attributes == {"ApproximateNumberOfMessages": "12"}
+    messages = client.receive_message(
+        queue_url="https://sqs/q",
+        attribute_names=["SentTimestamp"],
+        max_number_of_messages=10,
+        visibility_timeout=0,
+    )
+    assert messages[0]["Attributes"]["SentTimestamp"] == "123"
+
+
+# ---------------------------------------------------------------------------
+# Error taxonomy translation
+# ---------------------------------------------------------------------------
+
+
+def test_botocore_error_translation(boto3_stub):
+    from karpenter_tpu.cloudprovider import aws_sdk
+
+    # throttling: code-classified retryable
+    boto3_stub["autoscaling"] = _RecordedClient(
+        {"update_auto_scaling_group": _ClientError("Throttling")}
+    )
+    client = aws_sdk.bind("autoscaling")
+    with pytest.raises(AWSAPIError) as excinfo:
+        client.update_auto_scaling_group(name="x", desired_capacity=1)
+    assert excinfo.value.code == "Throttling"
+    assert excinfo.value.retryable
+    wrapped = transient_error(excinfo.value)
+    assert isinstance(wrapped, RetryableError) and wrapped.retryable
+
+    # validation: terminal
+    aws_sdk.reset_binding_cache()
+    boto3_stub["autoscaling"] = _RecordedClient(
+        {"update_auto_scaling_group": _ClientError("ValidationError")}
+    )
+    client = aws_sdk.bind("autoscaling")
+    with pytest.raises(AWSAPIError) as excinfo:
+        client.update_auto_scaling_group(name="x", desired_capacity=1)
+    assert not excinfo.value.retryable
+    assert not transient_error(excinfo.value).retryable
+
+    # connection-level failures: no code, forced retryable — including
+    # leaf classes only reachable via the ConnectionError/HTTPClientError
+    # base classes (ConnectionClosedError was classified terminal before)
+    for failure in (
+        _EndpointConnectionError("no route"),
+        _ConnectionClosedError("reset by peer"),
+        _ReadTimeoutError("read timed out"),
+    ):
+        aws_sdk.reset_binding_cache()
+        boto3_stub["autoscaling"] = _RecordedClient(
+            {"describe_auto_scaling_groups": failure}
+        )
+        client = aws_sdk.bind("autoscaling")
+        with pytest.raises(AWSAPIError) as excinfo:
+            client.describe_auto_scaling_groups(["x"], 1)
+        assert excinfo.value.retryable and excinfo.value.code == ""
+
+
+def test_unknown_seam_raises_but_bad_region_degrades(boto3_stub, monkeypatch):
+    """bind('bogus') is a programming error (raises); a ValueError from
+    INSIDE the SDK (botocore InvalidRegionError subclasses ValueError)
+    must degrade to None, not crash factory construction."""
+    from karpenter_tpu.cloudprovider import aws_sdk
+
+    with pytest.raises(ValueError, match="unknown AWS service seam"):
+        aws_sdk.bind("bogus")
+
+    class _InvalidRegionSession:
+        region_name = "bad region!"
+
+        def client(self, service, region_name=None):
+            raise ValueError(f"Provided region_name '{region_name}' doesn't "
+                             "match a supported format.")
+
+    sys.modules["boto3"].session.Session = _InvalidRegionSession
+    aws_sdk.reset_binding_cache()
+    assert aws_sdk.bind("autoscaling") is None
+
+
+def test_asg_template_launch_template_name_fallback(boto3_stub):
+    """Name-only LaunchTemplateSpecification (a shape AWS returns) must
+    query by LaunchTemplateName, never pass LaunchTemplateId=None."""
+    from karpenter_tpu.cloudprovider import aws_sdk
+
+    boto3_stub["autoscaling"] = _RecordedClient(
+        {
+            "describe_auto_scaling_groups": {
+                "AutoScalingGroups": [
+                    {
+                        "AutoScalingGroupName": "named",
+                        "LaunchTemplate": {
+                            "LaunchTemplateName": "web-lt",
+                            "Version": "3",
+                        },
+                    }
+                ]
+            }
+        }
+    )
+    ec2 = _RecordedClient(
+        {
+            "describe_launch_template_versions": {
+                "LaunchTemplateVersions": [
+                    {"LaunchTemplateData": {"InstanceType": "t3.large"}}
+                ]
+            },
+            "describe_instance_types": {
+                "InstanceTypes": [{"VCpuInfo": {"DefaultVCpus": 2}}]
+            },
+        }
+    )
+    boto3_stub["ec2"] = ec2
+    raw = aws_sdk.bind("autoscaling").describe_node_template("named")
+    assert ec2.calls[0] == (
+        "describe_launch_template_versions",
+        {"LaunchTemplateName": "web-lt", "Versions": ["3"]},
+    )
+    assert raw["labels"]["node.kubernetes.io/instance-type"] == "t3.large"
+
+    # spec with neither id nor name: no declared shape, not a crash
+    aws_sdk.reset_binding_cache()
+    boto3_stub["autoscaling"] = _RecordedClient(
+        {
+            "describe_auto_scaling_groups": {
+                "AutoScalingGroups": [
+                    {"AutoScalingGroupName": "bare", "LaunchTemplate": {}}
+                ]
+            }
+        }
+    )
+    assert aws_sdk.bind("autoscaling").describe_node_template("bare") is None
+
+
+# ---------------------------------------------------------------------------
+# GKE container binding (google.api_core is baked in; container_v1 is not,
+# so the adapter is tested against fake transport clients raising REAL
+# google.api_core exceptions)
+# ---------------------------------------------------------------------------
+
+
+class _FakeOperation:
+    def __init__(self, name, status_name, target_link):
+        self.name = name
+        self.status = types.SimpleNamespace(name=status_name)
+        self.target_link = target_link
+
+
+class _FakeGKEClient:
+    def __init__(self, operations=(), node_pool=None, fail=None):
+        self.operations = list(operations)
+        self.node_pool = node_pool
+        self.fail = fail
+        self.calls = []
+
+    def set_node_pool_size(self, request):
+        self.calls.append(("set_node_pool_size", request))
+        if self.fail:
+            raise self.fail
+
+    def list_operations(self, request):
+        self.calls.append(("list_operations", request))
+        if self.fail:
+            raise self.fail
+        return types.SimpleNamespace(operations=self.operations)
+
+    def get_node_pool(self, request):
+        self.calls.append(("get_node_pool", request))
+        return self.node_pool
+
+
+def test_gke_set_node_pool_size_shape():
+    from karpenter_tpu.cloudprovider.gke_sdk import GKEContainerClient
+
+    fake = _FakeGKEClient()
+    GKEContainerClient(fake).set_node_pool_size(
+        "proj", "us-central2-b", "tpu-cluster", "v5e-pool", 4
+    )
+    assert fake.calls == [
+        (
+            "set_node_pool_size",
+            {
+                "name": "projects/proj/locations/us-central2-b"
+                "/clusters/tpu-cluster/nodePools/v5e-pool",
+                "node_count": 4,
+            },
+        )
+    ]
+
+
+def test_gke_pending_operations_filters_target_and_status():
+    from karpenter_tpu.cloudprovider.gke_sdk import GKEContainerClient
+
+    pool_link = (
+        "https://container.googleapis.com/v1/projects/proj/locations/l"
+        "/clusters/c/nodePools/p"
+    )
+    other_pool = pool_link.replace("nodePools/p", "nodePools/other")
+    cluster_link = pool_link.rsplit("/nodePools", 1)[0]
+    fake = _FakeGKEClient(
+        operations=[
+            _FakeOperation("op-resize", "RUNNING", pool_link),
+            _FakeOperation("op-done", "DONE", pool_link),
+            _FakeOperation("op-other", "RUNNING", other_pool),
+            _FakeOperation("op-cluster", "RUNNING", cluster_link),
+        ]
+    )
+    pending = GKEContainerClient(fake).pending_operations(
+        "proj", "l", "c", "p"
+    )
+    # the pool's own op + the cluster-scoped op (GKE's per-cluster
+    # operation lock blocks our resize too); done + other-pool excluded
+    assert pending == ["op-resize", "op-cluster"]
+
+
+def test_gke_pending_operations_sibling_prefix_pool_excluded():
+    """Suffix matching, not substring: a resize on pool 'v5e-large' must
+    not report pool 'v5e' unstable."""
+    from karpenter_tpu.cloudprovider.gke_sdk import GKEContainerClient
+
+    sibling_link = (
+        "https://container.googleapis.com/v1/projects/proj/locations/l"
+        "/clusters/c/nodePools/v5e-large"
+    )
+    fake = _FakeGKEClient(
+        operations=[_FakeOperation("op-sibling", "RUNNING", sibling_link)]
+    )
+    assert (
+        GKEContainerClient(fake).pending_operations("proj", "l", "c", "v5e")
+        == []
+    )
+
+
+def test_gke_retry_error_classified_retryable():
+    """google.api_core RetryError subclasses GoogleAPIError only (not
+    GoogleAPICallError) and must still be classified retryable."""
+    import google.api_core.exceptions as gex
+
+    from karpenter_tpu.cloudprovider.gke_sdk import GKEContainerClient
+
+    client = GKEContainerClient(
+        _FakeGKEClient(fail=gex.RetryError("deadline", cause=None))
+    )
+    with pytest.raises(RetryableError) as excinfo:
+        client.set_node_pool_size("p", "l", "c", "pool", 1)
+    assert excinfo.value.retryable
+    assert excinfo.value.code == "RetryError"
+
+
+def test_gke_non_tpu_pool_template_is_none():
+    """A pool whose capacity can't be declared (non-TPU machine type)
+    yields None — an empty-allocatable template would read as a
+    zero-capacity node and block scale-from-zero entirely."""
+    from karpenter_tpu.cloudprovider.gke_sdk import GKEContainerClient
+
+    config = types.SimpleNamespace(
+        machine_type="n2-standard-8", labels={"tier": "web"}, taints=[]
+    )
+    fake = _FakeGKEClient(node_pool=types.SimpleNamespace(config=config))
+    assert (
+        GKEContainerClient(fake).node_pool_template("p", "l", "c", "pool")
+        is None
+    )
+
+
+def test_gke_error_translation_preserves_terminality():
+    import google.api_core.exceptions as gex
+
+    from karpenter_tpu.cloudprovider.gke_sdk import GKEContainerClient
+
+    client = GKEContainerClient(
+        _FakeGKEClient(fail=gex.ServiceUnavailable("backend down"))
+    )
+    with pytest.raises(RetryableError) as excinfo:
+        client.set_node_pool_size("p", "l", "c", "pool", 1)
+    assert excinfo.value.retryable
+    assert excinfo.value.code == "ServiceUnavailable"
+
+    client = GKEContainerClient(
+        _FakeGKEClient(fail=gex.PermissionDenied("nope"))
+    )
+    with pytest.raises(RetryableError) as excinfo:
+        client.set_node_pool_size("p", "l", "c", "pool", 1)
+    assert not excinfo.value.retryable
+    assert excinfo.value.code == "PermissionDenied"
+
+
+def test_gke_node_pool_template_tpu_machine_type():
+    from karpenter_tpu.cloudprovider.gke_sdk import GKEContainerClient
+
+    config = types.SimpleNamespace(
+        machine_type="ct5lp-hightpu-4t",
+        labels={"pool-tier": "batch"},
+        taints=[
+            types.SimpleNamespace(
+                key="google.com/tpu",
+                value="present",
+                effect=types.SimpleNamespace(name="NO_SCHEDULE"),
+            )
+        ],
+    )
+    fake = _FakeGKEClient(node_pool=types.SimpleNamespace(config=config))
+    raw = GKEContainerClient(fake).node_pool_template("p", "l", "c", "pool")
+    template = node_template_from_raw(raw)
+    assert str(template.allocatable["google.com/tpu"]) == "4"
+    assert (
+        template.labels["node.kubernetes.io/instance-type"]
+        == "ct5lp-hightpu-4t"
+    )
+    assert template.taints[0].effect == "NoSchedule"
+
+
+def test_tpu_chips_per_host_parsing():
+    from karpenter_tpu.cloudprovider.gke_sdk import _tpu_chips_per_host
+
+    assert _tpu_chips_per_host("ct5lp-hightpu-4t") == 4
+    assert _tpu_chips_per_host("ct6e-standard-8t") == 8
+    assert _tpu_chips_per_host("n2-standard-8") is None
+    assert _tpu_chips_per_host("e2-micro") is None
+
+
+def test_monitoring_pubsub_latest_point(monkeypatch):
+    """MonitoringPubSubClient against a stubbed monitoring_v3 module."""
+    from karpenter_tpu.cloudprovider.gke_sdk import MonitoringPubSubClient
+
+    monitoring_mod = types.ModuleType("google.cloud.monitoring_v3")
+    monitoring_mod.TimeInterval = lambda d: d
+    monitoring_mod.ListTimeSeriesRequest = types.SimpleNamespace(
+        TimeSeriesView=types.SimpleNamespace(FULL="FULL")
+    )
+    monkeypatch.setitem(
+        sys.modules, "google.cloud.monitoring_v3", monitoring_mod
+    )
+
+    requests = []
+
+    class _Metrics:
+        def list_time_series(self, request):
+            requests.append(request)
+            point = types.SimpleNamespace(
+                value=types.SimpleNamespace(int64_value=42)
+            )
+            return [types.SimpleNamespace(points=[point])]
+
+    client = MonitoringPubSubClient(_Metrics(), clock=lambda: 1000.0)
+    assert client.num_undelivered_messages("proj", "work-queue") == 42
+    assert "num_undelivered_messages" in requests[0]["filter"]
+    assert 'subscription_id = "work-queue"' in requests[0]["filter"]
+    assert client.oldest_unacked_message_age_seconds("proj", "wq") == 42
+    assert "oldest_unacked_message_age" in requests[1]["filter"]
+
+
+def test_tpu_factory_binds_gke_sdk_when_available(monkeypatch):
+    """TPUFactory auto-binds the container client when container_v1 is
+    importable (stubbed here), mirroring the AWS selection rule."""
+    from karpenter_tpu.cloudprovider import gke_sdk
+    from karpenter_tpu.cloudprovider.tpu import TPUFactory
+
+    container_mod = types.ModuleType("google.cloud.container_v1")
+    container_mod.ClusterManagerClient = _FakeGKEClient
+    monkeypatch.setitem(
+        sys.modules, "google.cloud.container_v1", container_mod
+    )
+    monkeypatch.setattr(gke_sdk, "container_sdk_available", lambda: True)
+    factory = TPUFactory(Options(), sdk_autobind=True)
+    assert isinstance(factory.container_api, gke_sdk.GKEContainerClient)
+    # direct construction without the flag keeps the guidance stub
+    unbound = TPUFactory(Options())
+    with pytest.raises(RuntimeError, match="no container API client"):
+        unbound.container_api.set_node_pool_size("p", "l", "c", "pool", 1)
