@@ -408,29 +408,53 @@ def example_decision_inputs(N: int = 16, M: int = 4, seed: int = 1) -> DecisionI
 
 
 def dryrun_fleet_step(n_devices: int) -> None:
-    """Compile + execute one full sharded tick on an n-device mesh.
+    """Compile + execute one full sharded tick on an n-device mesh, and
+    prove it EQUALS the single-device program element for element.
 
     Used by __graft_entry__.dryrun_multichip: proves the pods×groups
-    shardings compile and run without n real chips. Inputs carry
-    pod_weight (deduplicated shape rows) because that is what the
-    production encoder always emits — the artifact must cover the
-    weighted sharded program.
+    shardings compile and run without n real chips. The inputs carry the
+    WIDEST operand set the production encoder can emit — pod_weight
+    (deduplicated shape rows), pod_group_forbidden (required node
+    affinity), pod_group_score (preferred node affinity) — because the
+    artifact must certify the program that actually ships: the affinity
+    masks shard over BOTH mesh axes, exactly the case worth proving
+    (VERDICT r2 item 3). When the device count allows, the same program
+    is re-certified on a 3D slice×pods×groups mesh (the multi-slice
+    deployment shape, one cross-slice reduction on DCN).
     """
     import dataclasses
 
-    mesh = build_mesh(n_devices=n_devices)
-    d_in = shard_decision_inputs(mesh, example_decision_inputs(N=16, M=4))
+    rng = np.random.default_rng(7)
     weights = np.ones(32, np.int32)
     weights[:4] = 5  # a few multiplied shape rows: 48 pods in 32 rows
-    b_in = shard_binpack_inputs(
-        mesh,
-        dataclasses.replace(
-            example_binpack_inputs(P_=32, T=8, K=8, L=8),
-            pod_weight=jnp.asarray(weights),
+    d_ref_in = example_decision_inputs(N=16, M=4)
+    b_ref_in = dataclasses.replace(
+        example_binpack_inputs(P_=32, T=8, K=8, L=8),
+        pod_weight=jnp.asarray(weights),
+        pod_group_forbidden=jnp.asarray(rng.random((32, 8)) < 0.3),
+        pod_group_score=jnp.asarray(
+            rng.integers(0, 100, (32, 8)).astype(np.float32)
         ),
     )
-    d_out, b_out = fleet_step(d_in, b_in, buckets=8)
-    jax.block_until_ready((d_out, b_out))
-    # sanity: padding rows decided nothing, real rows produced finite output
-    assert int(jnp.sum(b_out.assigned_count)) + int(b_out.unschedulable) == 48
-    assert d_out.desired.shape[0] == 16
+    # single-device reference: same jitted program, no mesh
+    d_ref, b_ref = jax.device_get(fleet_step(d_ref_in, b_ref_in, buckets=8))
+    assert int(np.sum(b_ref.assigned_count)) + int(b_ref.unschedulable) == 48
+    assert d_ref.desired.shape[0] == 16
+
+    meshes = [build_mesh(n_devices=n_devices)]
+    if n_devices % 2 == 0 and n_devices >= 4:
+        meshes.append(build_mesh(n_devices=n_devices, slices=2))
+    for mesh in meshes:
+        d_in = shard_decision_inputs(mesh, d_ref_in)
+        b_in = shard_binpack_inputs(mesh, b_ref_in)
+        d_out, b_out = jax.device_get(fleet_step(d_in, b_in, buckets=8))
+        # sharded == single-device, bitwise, after stripping mesh padding
+        np.testing.assert_array_equal(b_out.assigned[:32], b_ref.assigned)
+        np.testing.assert_array_equal(
+            b_out.assigned_count[:8], b_ref.assigned_count
+        )
+        np.testing.assert_array_equal(
+            b_out.nodes_needed[:8], b_ref.nodes_needed
+        )
+        assert int(b_out.unschedulable) == int(b_ref.unschedulable)
+        np.testing.assert_array_equal(d_out.desired[:16], d_ref.desired)
