@@ -14,7 +14,7 @@ import itertools
 import time as _time
 import uuid
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Union
+from typing import Dict, List, Optional, Tuple, Union
 
 from karpenter_tpu.utils.quantity import Quantity, parse_quantity
 
@@ -126,6 +126,68 @@ class NodeAffinity:
 @dataclass(slots=True)
 class Affinity:
     node_affinity: Optional[NodeAffinity] = None
+
+
+@dataclass(slots=True)
+class TopologySpreadConstraint:
+    """core/v1 TopologySpreadConstraint. The solver honors DoNotSchedule
+    constraints via balanced domain splitting (producers/pendingcapacity);
+    ScheduleAnyway is a scheduler preference and is decoded but not
+    constrained. labelSelector / matchLabelKeys count EXISTING pods per
+    domain, which needs pairwise pod state — decoded for fidelity, not
+    modeled (docs/OPERATIONS.md 'Scheduling fidelity')."""
+
+    max_skew: int = 1
+    topology_key: str = ""
+    when_unsatisfiable: str = "DoNotSchedule"
+    label_selector: Optional[dict] = None
+    min_domains: Optional[int] = None
+    node_affinity_policy: str = ""
+    node_taints_policy: str = ""
+    match_label_keys: List[str] = field(default_factory=list)
+
+
+# hostname spread = at most maxSkew more pods than the emptiest node; a
+# fresh scale-up places balanced across the nodes it adds, so the
+# constraint is satisfiable at any node count the pack chooses (see
+# spread_shape below) — it neither splits nor excludes groups
+HOSTNAME_TOPOLOGY_KEY = "kubernetes.io/hostname"
+
+
+def spread_shape(constraints: Optional[list]) -> tuple:
+    """Canonical hashable form of a pod's HARD topology spread: sorted
+    (topologyKey, maxSkew, minDomains) triples for DoNotSchedule
+    constraints on non-hostname keys (per key: smallest skew and largest
+    minDomains win — the most restrictive combination). () =
+    unconstrained. maxSkew matters only through the minDomains rule
+    (producers/pendingcapacity._expand_spread_rows): with at least
+    minDomains eligible domains, balanced chunks satisfy any skew >= 1.
+
+    hostname-keyed constraints are dropped here by design: domains are
+    individual nodes, and balanced placement across the nodes a scale-up
+    adds satisfies any maxSkew >= 1 — whereas zone/region-like keys bind
+    the GROUP choice, which is what the bin-pack decides. ScheduleAnyway
+    is soft (scheduler preference), never a constraint."""
+    if not constraints:
+        return ()
+    binding: Dict[str, Tuple[int, int]] = {}
+    for c in constraints:
+        if (
+            c.when_unsatisfiable == "DoNotSchedule"
+            and c.topology_key
+            and c.topology_key != HOSTNAME_TOPOLOGY_KEY
+        ):
+            skew = max(1, int(c.max_skew))
+            min_domains = max(0, int(c.min_domains or 0))
+            prev = binding.get(c.topology_key)
+            if prev is not None:
+                skew = min(prev[0], skew)
+                min_domains = max(prev[1], min_domains)
+            binding[c.topology_key] = (skew, min_domains)
+    return tuple(
+        (key, skew, min_domains)
+        for key, (skew, min_domains) in sorted(binding.items())
+    )
 
 
 def affinity_shape(affinity: Optional[Affinity]) -> tuple:
@@ -247,6 +309,12 @@ class PodSpec:
     # required node affinity (matchExpressions); ANDs with node_selector,
     # exactly as the kube-scheduler treats the two fields
     affinity: Optional[Affinity] = None
+    # hard spread constraints partition the pending weight across topology
+    # domains (producers/pendingcapacity balanced split); soft ones are
+    # decoded but not constrained
+    topology_spread_constraints: List[TopologySpreadConstraint] = field(
+        default_factory=list
+    )
 
 
 @dataclass(slots=True)

@@ -387,6 +387,12 @@ def _dedup_rows(snap):
                 .view(np.uint8)
                 .reshape(n, -1)
             )
+        if snap.spread_id is not None:
+            parts.append(
+                np.ascontiguousarray(snap.spread_id[idx])
+                .view(np.uint8)
+                .reshape(n, -1)
+            )
         rows = np.ascontiguousarray(np.concatenate(parts, axis=1))
         return rows.view([("k", np.void, rows.shape[1])]).ravel()
 
@@ -398,6 +404,118 @@ def _dedup_rows(snap):
         row_bytes(slice(None)), return_index=True, return_counts=True
     )
     return idx, counts.astype(np.int32)
+
+
+def _expand_spread_rows(snap, profiles, row_idx, row_weight, label_dicts_fn):
+    """Topology spread (DoNotSchedule, non-hostname keys): partition each
+    constrained row's weight into BALANCED per-domain sub-rows.
+
+    The solver assigns a whole weighted row to one group, so skew is
+    enforced where it binds — in the GROUP choice: a domain is a distinct
+    value of the topologyKey among the group-label INTERSECTIONS (a group
+    spanning zones has no single domain value and is excluded, like a node
+    missing the key is excluded by the kube-scheduler's PodTopologySpread
+    filter). Balanced chunks (sizes differing by <= 1) satisfy any
+    maxSkew >= 1 by construction; when minDomains exceeds the eligible
+    domain count, the scheduler's global-minimum-0 rule applies — at most
+    maxSkew pods per domain, the excess unschedulable.
+    Approximations, both conservative for a
+    scale-up signal: maxSkew slack beyond 1 is not exploited (the signal
+    may spread wider / mark more unschedulable than a lopsided-but-legal
+    placement), and with multiple constrained keys the split runs on the
+    FIRST key while the others contribute key-presence exclusion only.
+    EXISTING pods per domain (labelSelector counts) need pairwise pod
+    state and stay out of scope (docs/OPERATIONS.md).
+
+    Returns (row_idx, row_weight, spread_forbidden[rows, T]-or-None);
+    unconstrained snapshots pass through untouched.
+    """
+    shapes = snap.spread_shapes
+    if (
+        len(row_idx) == 0
+        or snap.spread_id is None
+        or shapes is None
+        or not (snap.spread_id[row_idx] != 0).any()
+    ):
+        return row_idx, row_weight, None
+
+    n_groups = len(profiles)
+    label_dicts = label_dicts_fn()
+    live_ids = snap.spread_id[row_idx]
+
+    # per live shape: (ordered domain group-lists, maxSkew, minDomains)
+    plan: Dict[int, tuple] = {}
+    for s in np.unique(live_ids):
+        shape = shapes[s]
+        if not shape:
+            continue
+        keys = [key for key, _, _ in shape]
+        split_key, split_skew, split_min_domains = shape[0]
+        domains: Dict[str, list] = {}
+        for t, labels in enumerate(label_dicts):
+            if all(key in labels for key in keys):
+                domains.setdefault(labels[split_key], []).append(t)
+        plan[int(s)] = (
+            [domains[value] for value in sorted(domains)],
+            split_skew,
+            split_min_domains,
+        )
+
+    out_idx, out_weight, out_forbidden = [], [], []
+    for i, sid in enumerate(live_ids):
+        entry = plan.get(int(sid))
+        if entry is None:
+            out_idx.append(row_idx[i])
+            out_weight.append(row_weight[i])
+            out_forbidden.append(np.zeros(n_groups, bool))
+            continue
+        domains, skew, min_domains = entry
+        weight = int(row_weight[i])
+        if not domains or weight == 0:
+            # no group exposes the key(s): unschedulable by spread —
+            # keep the row, forbid everything, so the pods are COUNTED
+            out_idx.append(row_idx[i])
+            out_weight.append(row_weight[i])
+            out_forbidden.append(np.ones(n_groups, bool))
+            continue
+        d = len(domains)
+        schedulable = weight
+        if min_domains and d < min_domains:
+            # the scheduler's minDomains rule: too few eligible domains
+            # treats the global minimum as 0, so each domain holds at
+            # most maxSkew matching pods; the rest stay unschedulable
+            schedulable = min(weight, d * skew)
+        base, extra = divmod(schedulable, d)
+        # rotate which domains take the +1 remainder, keyed on row
+        # CONTENT (request bytes + weight): a fixed rank order would
+        # systematically overweight the lexicographically first domain
+        # across many constrained shapes, while a position-keyed offset
+        # would depend on arena-local shape numbering and break the
+        # outputs-identical-on-every-encode-path invariant
+        seed = weight + int(
+            np.ascontiguousarray(snap.requests[row_idx[i]])
+            .view(np.uint8)
+            .sum()
+        )
+        offset = seed % d
+        for rank, groups in enumerate(domains):
+            chunk = base + (1 if (rank - offset) % d < extra else 0)
+            if chunk == 0:
+                continue
+            forbidden = np.ones(n_groups, bool)
+            forbidden[groups] = False
+            out_idx.append(row_idx[i])
+            out_weight.append(np.int32(chunk))
+            out_forbidden.append(forbidden)
+        if schedulable < weight:
+            out_idx.append(row_idx[i])
+            out_weight.append(np.int32(weight - schedulable))
+            out_forbidden.append(np.ones(n_groups, bool))
+    return (
+        np.asarray(out_idx, np.intp),
+        np.asarray(out_weight, np.int32),
+        np.stack(out_forbidden) if out_forbidden else None,
+    )
 
 
 def _encode_from_cache(snap, profiles) -> "B.BinPackInputs":
@@ -412,7 +530,25 @@ def _encode_from_cache(snap, profiles) -> "B.BinPackInputs":
     resources, group profiles, taints, distinct toleration shapes — whose
     cardinalities are fleet-scale constants, not pod counts.
     """
+    # group label dicts: built at most once, shared by the spread
+    # expansion and the affinity/preferred evaluation blocks below
+    label_dicts_box: list = []
+
+    def group_label_dicts():
+        if not label_dicts_box:
+            label_dicts_box.append(
+                [dict(labels) for _, labels, _ in profiles]
+            )
+        return label_dicts_box[0]
+
     row_idx, row_weight = _dedup_rows(snap)
+    # hard topology spread: constrained rows split into balanced
+    # per-domain sub-rows (same source row gathered more than once, each
+    # chunk masked to its domain's groups) — the device program is
+    # unchanged, spread rides the existing forbidden-mask operand
+    row_idx, row_weight, spread_forbidden = _expand_spread_rows(
+        snap, profiles, row_idx, row_weight, group_label_dicts
+    )
     hi = len(row_idx)
 
     extended = {
@@ -491,14 +627,6 @@ def _encode_from_cache(snap, profiles) -> "B.BinPackInputs":
         if hi and snap.affinity_id is not None and shapes is not None
         else None
     )
-    label_dicts = None  # built once, shared by both affinity blocks
-
-    def group_label_dicts():
-        nonlocal label_dicts
-        if label_dicts is None:
-            label_dicts = [dict(labels) for _, labels, _ in profiles]
-        return label_dicts
-
     # gate on LIVE rows (shape id 0 = unconstrained): the shape registry
     # retains entries until compaction, and a long-gone affinity Job must
     # not keep the whole fleet on the masked (extra-operand) kernel path
@@ -512,6 +640,15 @@ def _encode_from_cache(snap, profiles) -> "B.BinPackInputs":
                 allowed[s, t] = matches_affinity_shape(labels, shape)
         pod_group_forbidden = np.zeros((n_pods, n_groups), bool)
         pod_group_forbidden[:hi] = ~allowed[live_affinity_ids]
+
+    # Topology spread: OR the per-sub-row domain masks into the same
+    # forbidden operand the affinity path uses (padding groups are
+    # all-zero allocatable and already infeasible, so mask width T_real
+    # suffices)
+    if spread_forbidden is not None:
+        if pod_group_forbidden is None:
+            pod_group_forbidden = np.zeros((n_pods, n_groups), bool)
+        pod_group_forbidden[:hi, : len(profiles)] |= spread_forbidden
 
     # Preferred node affinity: same distinct-shape host evaluation, but
     # the verdicts are weight-sums steering assignment among feasible

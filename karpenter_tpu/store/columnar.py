@@ -45,6 +45,7 @@ import numpy as np
 
 from karpenter_tpu.api.core import affinity_shape as _affinity_shape
 from karpenter_tpu.api.core import preferred_shape as _preferred_shape
+from karpenter_tpu.api.core import spread_shape as _spread_shape
 from karpenter_tpu.store.store import DELETED, Store
 
 # seed columns; extended resources append after in arrival order.
@@ -85,6 +86,7 @@ class _SparsePod:
     tolerations: list
     affinity: tuple = ()  # canonical required-node-affinity shape
     preferred: tuple = ()  # canonical preferred-node-affinity shape
+    spread: tuple = ()  # canonical hard topology-spread shape
 
 
 class PendingPodCache:
@@ -123,6 +125,9 @@ class PendingPodCache:
         # preferred-node-affinity shapes (api/core.preferred_shape)
         self._preferred_shapes: List[tuple] = [()]
         self._preferred_index: Dict[tuple, int] = {(): 0}
+        # hard topology-spread shapes (api/core.spread_shape)
+        self._spread_shapes: List[tuple] = [()]
+        self._spread_index: Dict[tuple, int] = {(): 0}
         # incremental shape-dedup: canonical pod key -> live slots with that
         # key. Maintained at event time so snapshot() emits (rep row,
         # multiplicity) pairs in O(distinct shapes) — the per-tick
@@ -138,6 +143,7 @@ class PendingPodCache:
         self._shape_id = np.zeros(capacity, np.int32)
         self._affinity_id = np.zeros(capacity, np.int32)
         self._preferred_id = np.zeros(capacity, np.int32)
+        self._spread_id = np.zeros(capacity, np.int32)
         self._valid = np.zeros(capacity, bool)
 
         self._slot: Dict[Tuple[str, str], int] = {}
@@ -166,6 +172,7 @@ class PendingPodCache:
         self._shape_id[slot] = 0
         self._affinity_id[slot] = 0
         self._preferred_id[slot] = 0
+        self._spread_id[slot] = 0
         self._sparse.pop(slot, None)
         self._dedup_discard(slot)
         self._free.append(slot)
@@ -201,6 +208,7 @@ class PendingPodCache:
             tolerations=list(pod.spec.tolerations),
             affinity=_affinity_shape(pod.spec.affinity),
             preferred=_preferred_shape(pod.spec.affinity),
+            spread=_spread_shape(pod.spec.topology_spread_constraints),
         )
         slot = self._slot.get(key)
         if slot is None:
@@ -239,6 +247,12 @@ class PendingPodCache:
             self._preferred_index[sparse.preferred] = preferred_id
             self._preferred_shapes.append(sparse.preferred)
         self._preferred_id[slot] = preferred_id
+        spread_id = self._spread_index.get(sparse.spread)
+        if spread_id is None:
+            spread_id = len(self._spread_shapes)
+            self._spread_index[sparse.spread] = spread_id
+            self._spread_shapes.append(sparse.spread)
+        self._spread_id[slot] = spread_id
         self._valid[slot] = True
         self._sparse[slot] = sparse
         # dedup maintenance: two slots share a key iff their canonical
@@ -252,6 +266,7 @@ class PendingPodCache:
             sparse.shape,
             sparse.affinity,
             sparse.preferred,
+            sparse.spread,
         )
         if self._slot_key.get(slot) != dedup_key:
             self._dedup_discard(slot)
@@ -267,28 +282,18 @@ class PendingPodCache:
         live = len(self._slot)
         if self._hi >= _COMPACT_FLOOR and self._hi > _COMPACT_FACTOR * live:
             return True
-        if len(self._shapes) >= _COMPACT_FLOOR:
-            live_shapes = len(
-                {int(self._shape_id[s]) for s in self._slot.values()}
-            )
-            if len(self._shapes) > _COMPACT_FACTOR * max(1, live_shapes):
-                return True
-        if len(self._affinity_shapes) >= _COMPACT_FLOOR:
-            live_affinity = len(
-                {int(self._affinity_id[s]) for s in self._slot.values()}
-            )
-            if len(self._affinity_shapes) > _COMPACT_FACTOR * max(
-                1, live_affinity
-            ):
-                return True
-        if len(self._preferred_shapes) >= _COMPACT_FLOOR:
-            live_preferred = len(
-                {int(self._preferred_id[s]) for s in self._slot.values()}
-            )
-            if len(self._preferred_shapes) > _COMPACT_FACTOR * max(
-                1, live_preferred
-            ):
-                return True
+        for registry, ids in (
+            (self._shapes, self._shape_id),
+            (self._affinity_shapes, self._affinity_id),
+            (self._preferred_shapes, self._preferred_id),
+            (self._spread_shapes, self._spread_id),
+        ):
+            if len(registry) >= _COMPACT_FLOOR:
+                live_ids = len(
+                    {int(ids[s]) for s in self._slot.values()}
+                )
+                if len(registry) > _COMPACT_FACTOR * max(1, live_ids):
+                    return True
         if len(self._labels) >= _COMPACT_FLOOR:
             live_labels: set = set()
             for sparse in self._sparse.values():
@@ -325,6 +330,7 @@ class PendingPodCache:
             self._shape_id = self._grow_rows(self._shape_id)
             self._affinity_id = self._grow_rows(self._affinity_id)
             self._preferred_id = self._grow_rows(self._preferred_id)
+            self._spread_id = self._grow_rows(self._spread_id)
             self._valid = self._grow_rows(self._valid)
         slot = self._hi
         self._hi += 1
@@ -407,6 +413,8 @@ class PendingPodCache:
                 affinity_shapes=list(self._affinity_shapes),
                 preferred_id=self._preferred_id[:hi].copy(),
                 preferred_shapes=list(self._preferred_shapes),
+                spread_id=self._spread_id[:hi].copy(),
+                spread_shapes=list(self._spread_shapes),
             )
             self._snap_memo = (self._generation, snap)
             return snap
@@ -680,3 +688,6 @@ class PendingSnapshot:                        # no 100k-row reprs in logs
     # preferred node affinity (api/core.preferred_shape; id 0 = none)
     preferred_id: Optional[np.ndarray] = None
     preferred_shapes: Optional[List[tuple]] = None
+    # hard topology spread (api/core.spread_shape; id 0 = unconstrained)
+    spread_id: Optional[np.ndarray] = None
+    spread_shapes: Optional[List[tuple]] = None
