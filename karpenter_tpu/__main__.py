@@ -105,7 +105,80 @@ def parse_args(argv=None) -> argparse.Namespace:
         help="seconds to run before exiting (default: forever)",
     )
     parser.add_argument("--tick", type=float, default=0.1)
+    parser.add_argument(
+        "--simulate",
+        action="store_true",
+        help="dry-run: solve the pending-pods problem once, print a JSON "
+        "report with per-pod-shape assignments, and exit without "
+        "mutating anything (docs/OPERATIONS.md 'What-if simulation')",
+    )
+    parser.add_argument(
+        "--what-if",
+        default=None,
+        metavar="FILE",
+        help="with --simulate: JSON/YAML file of hypothetical node groups "
+        "([{name, allocatable, labels, taints}]) appended to the solve; "
+        "the report then includes baseline vs what-if and the delta",
+    )
     return parser.parse_args(argv)
+
+
+def _run_simulation(args, store) -> int:
+    import json
+
+    from karpenter_tpu.simulate import simulate, simulate_delta
+
+    what_if = None
+    if args.what_if:
+        with open(args.what_if) as f:
+            text = f.read()
+        try:
+            what_if = json.loads(text)
+        except ValueError:
+            import yaml
+
+            what_if = yaml.safe_load(text)
+        if not isinstance(what_if, list):
+            print(
+                f"--what-if {args.what_if}: expected a LIST of group specs",
+                file=sys.stderr,
+            )
+            return 2
+
+    # a runtime only to materialize the store the flags describe (WAL dir
+    # or live apiserver) and the optional solver sidecar; no controllers
+    # tick, nothing is mutated
+    runtime = KarpenterRuntime(
+        Options(
+            data_dir=args.data_dir,
+            solver_uri=args.solver_uri,
+            cloud_provider=args.cloud_provider,
+            verbose=args.verbose,
+        ),
+        store=store,
+    )
+    solver = (
+        runtime.solver_client.solve
+        if runtime.solver_client is not None
+        else None
+    )
+    # the scale-from-zero seam the production solve uses: without it,
+    # empty groups with a nodeGroupRef would simulate as infeasible
+    resolver = runtime.producer_factory.template_resolver()
+    try:
+        if what_if is not None:
+            report = simulate_delta(
+                runtime.store, what_if, solver=solver,
+                template_resolver=resolver,
+            )
+        else:
+            report = simulate(
+                runtime.store, solver=solver, template_resolver=resolver
+            )
+        print(json.dumps(report, indent=2, sort_keys=True))
+    finally:
+        runtime.close()
+    return 0
 
 
 def main(argv=None) -> int:
@@ -134,6 +207,12 @@ def main(argv=None) -> int:
                 insecure=args.kube_insecure,
             )
         )
+    if args.simulate:
+        try:
+            return _run_simulation(args, store)
+        finally:
+            if store is not None:
+                store.close()
     runtime = KarpenterRuntime(
         Options(
             prometheus_uri=args.prometheus_uri,

@@ -17,6 +17,29 @@ from karpenter_tpu.metrics.producers.scheduledcapacity import (
 from karpenter_tpu.utils.log import logger
 
 
+def profile_from_template(template):
+    """cloudprovider.NodeTemplate -> the (alloc floats, labels set,
+    taints set) profile tuple _group_profile produces from live nodes —
+    the ONE conversion shared by the scale-from-zero resolver and the
+    what-if simulation. Mirrors _group_profile's conventions: the pods
+    resource defaults when undeclared, only hard taints constrain."""
+    from karpenter_tpu.metrics.producers.pendingcapacity import (
+        DEFAULT_PODS_PER_NODE,
+        RESOURCE_PODS,
+    )
+
+    alloc = {r: q.to_float() for r, q in template.allocatable.items()}
+    if alloc and alloc.get(RESOURCE_PODS, 0.0) <= 0:
+        alloc[RESOURCE_PODS] = DEFAULT_PODS_PER_NODE
+    labels = set(template.labels.items())
+    taints = {
+        (t.key, t.value, t.effect)
+        for t in template.taints
+        if t.effect in ("NoSchedule", "NoExecute")
+    }
+    return alloc, labels, taints
+
+
 class ProducerFactory:
     def __init__(self, store, cloud_provider_factory, registry=None, solver=None):
         from karpenter_tpu.metrics.registry import default_registry
@@ -91,11 +114,6 @@ class ProducerFactory:
             self._template_cache = {}
 
         def resolve(namespace: str, ref: str):
-            from karpenter_tpu.metrics.producers.pendingcapacity import (
-                DEFAULT_PODS_PER_NODE,
-                RESOURCE_PODS,
-            )
-
             now = _time.monotonic()
             cached = self._template_cache.get((namespace, ref))
             if cached is not None and cached[0] > now:
@@ -114,18 +132,7 @@ class ProducerFactory:
                 )
                 if template is None:
                     return None
-                alloc = {
-                    r: q.to_float() for r, q in template.allocatable.items()
-                }
-                if alloc and alloc.get(RESOURCE_PODS, 0.0) <= 0:
-                    alloc[RESOURCE_PODS] = DEFAULT_PODS_PER_NODE
-                labels = set(template.labels.items())
-                taints = {
-                    (t.key, t.value, t.effect)
-                    for t in template.taints
-                    if t.effect in ("NoSchedule", "NoExecute")
-                }
-                return alloc, labels, taints
+                return profile_from_template(template)
 
             result = uncached()
             self._template_cache[(namespace, ref)] = (

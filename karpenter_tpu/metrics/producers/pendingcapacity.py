@@ -518,7 +518,7 @@ def _expand_spread_rows(snap, profiles, row_idx, row_weight, label_dicts_fn):
     )
 
 
-def _encode_from_cache(snap, profiles) -> "B.BinPackInputs":
+def _encode_from_cache(snap, profiles, with_rows: bool = False):
     """Snapshot (store/columnar.PendingSnapshot) -> solver inputs, with
     rows DEDUPLICATED into distinct pod shapes + multiplicities
     (pod_weight) — see _dedup_rows. Every solve path (feed, pod_cache,
@@ -672,7 +672,7 @@ def _encode_from_cache(snap, profiles) -> "B.BinPackInputs":
         pod_group_score = np.zeros((n_pods, n_groups), np.float32)
         pod_group_score[:hi] = scores[live_preferred_ids]
 
-    return B.BinPackInputs(
+    inputs = B.BinPackInputs(
         pod_requests=pod_requests,
         pod_valid=pod_valid,
         pod_intolerant=pod_intolerant,
@@ -684,6 +684,12 @@ def _encode_from_cache(snap, profiles) -> "B.BinPackInputs":
         pod_group_forbidden=pod_group_forbidden,
         pod_group_score=pod_group_score,
     )
+    if with_rows:
+        # the simulation API maps per-row solver outputs back to pods:
+        # row i of `inputs` gathers snapshot row row_idx[i] (an arena
+        # slot) with multiplicity row_weight[i]
+        return inputs, row_idx, row_weight
+    return inputs
 
 
 def _count_cache(registry: GaugeRegistry, outcome: str) -> None:

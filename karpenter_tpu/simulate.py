@@ -1,0 +1,244 @@
+"""What-if simulation: a dry-run pending-pods solve with per-pod detail.
+
+The production tick computes per-row assignments on the device
+(ops/binpack.BinPackOutputs.assigned) but only publishes per-group
+aggregates through the MetricsProducer status. This module surfaces the
+rows: which pod shapes land where, what stays unschedulable and why the
+operator should care — and answers "what would ADDING node group X
+change?" by re-running the identical solve with hypothetical groups
+appended to the group axis.
+
+reference anchor: the reference has no simulation surface at all (its
+pending-capacity producer is a stub, pendingcapacity/producer.go:29-31);
+the intent served here is DESIGN.md "Pending Pods" — operators sizing a
+scale-up want to see the placement the signal is promising.
+
+Nothing here mutates the store or any status object: the solve runs on a
+detached snapshot, making it safe against a live cluster.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from karpenter_tpu.metrics.producers.pendingcapacity import (
+    _encode_from_cache,
+    _group_profile,
+)
+from karpenter_tpu.ops import binpack as B
+from karpenter_tpu.store.columnar import PendingPodCache, is_pending
+
+
+def _what_if_profile(spec: dict) -> Tuple[Dict[str, float], set, set]:
+    """A hypothetical group declared the same way provider node templates
+    are: the raw dict goes through cloudprovider.node_template_from_raw
+    (quantity parsing, cloud-API taint-effect dialect) and then the SAME
+    template->profile conversion the scale-from-zero resolver uses —
+    including the pods-resource default, so a spec that only declares
+    cpu/memory is not silently infeasible for every pod."""
+    from karpenter_tpu.cloudprovider import node_template_from_raw
+    from karpenter_tpu.metrics.producers import profile_from_template
+
+    template = node_template_from_raw(
+        {
+            "allocatable": spec.get("allocatable") or {},
+            "labels": spec.get("labels") or {},
+            "taints": spec.get("taints") or [],
+        }
+    )
+    return profile_from_template(template)
+
+
+def simulate(
+    store,
+    what_if_groups: Optional[List[dict]] = None,
+    solver=None,
+    template_resolver=None,
+) -> dict:
+    """One dry-run solve over the store's pendingCapacity producers plus
+    `what_if_groups` (each {"name", "allocatable", "labels", "taints"}).
+
+    Returns a JSON-shaped report:
+      groups: per group {pending_pods, additional_nodes_needed,
+              lp_lower_bound, what_if: bool, error?: str}
+      rows:   per distinct pod shape {pod (ns/name of a representative),
+              pods (count), assigned (group name or null)}
+      unschedulable_pods: total weight with no feasible group
+
+    `template_resolver` is the scale-from-zero seam solve_pending takes
+    (producers.Factory.template_resolver): without it, empty groups with
+    a nodeGroupRef encode as infeasible and the baseline drifts from the
+    production solve. Per-producer failures are row-isolated exactly
+    like the production path — a poisoned selector reports an `error` on
+    its own group, never crashes the report.
+
+    Hypothetical groups are appended AFTER the real ones, so first-
+    feasible assignment only routes pods to them when no real group
+    is feasible earlier in the order — the delta a what-if run shows is
+    capacity the existing fleet genuinely lacks."""
+    solver = solver or B.solve
+
+    producers = sorted(
+        (
+            mp
+            for mp in store.list("MetricsProducer")
+            if mp.spec.pending_capacity is not None
+        ),
+        key=lambda mp: (mp.metadata.namespace, mp.metadata.name),
+    )
+    nodes = store.list("Node")
+    names: List[str] = []
+    profiles = []
+    what_if_names = set()
+    group_errors: Dict[str, str] = {}
+    for mp in producers:
+        # namespace-qualified like the production solve's (ns, name) keys:
+        # same-named producers in different namespaces must not collapse
+        names.append(f"{mp.metadata.namespace}/{mp.metadata.name}")
+        try:
+            profile = _group_profile(
+                nodes, mp.spec.pending_capacity.node_selector
+            )
+            if not profile[0] and template_resolver is not None:
+                ref = getattr(
+                    mp.spec.pending_capacity, "node_group_ref", ""
+                )
+                if ref:
+                    resolved = template_resolver(
+                        mp.metadata.namespace, ref
+                    )
+                    if resolved is not None:
+                        profile = resolved
+        except Exception as e:  # noqa: BLE001 — row-isolated like
+            # solve_pending: the dry-run tool must not crash on the
+            # degraded clusters an operator most wants to inspect
+            group_errors[names[-1]] = f"{type(e).__name__}: {e}"
+            profile = ({}, set(), set())
+        profiles.append(profile)
+    for spec in what_if_groups or []:
+        name = spec.get("name") or f"what-if-{len(what_if_names)}"
+        n = 2
+        while name in names:  # a colliding spec must not overwrite a row
+            name = f"{spec.get('name') or 'what-if'}#{n}"
+            n += 1
+        names.append(name)
+        what_if_names.add(name)
+        profiles.append(_what_if_profile(spec))
+
+    # detached encode with a slot -> pod-name map for per-row reporting
+    # (snapshot rows are arena slots; snapshot_from_pods hides the map)
+    pods = [pod for pod in store.list("Pod") if is_pending(pod)]
+    cache = PendingPodCache(store=None, capacity=max(16, len(pods)))
+    slot_pod: Dict[int, str] = {}
+    for pod in pods:
+        key = (pod.metadata.namespace, pod.metadata.name)
+        cache._upsert(key, pod)
+        slot_pod[cache._slot[key]] = f"{key[0]}/{key[1]}"
+    snap = cache.snapshot()
+
+    inputs, row_idx, row_weight = _encode_from_cache(
+        snap, profiles, with_rows=True
+    )
+    if what_if_names and inputs.pod_group_score is not None:
+        # preferred node affinity must not STEER pods into hypothetical
+        # groups (the solver argmaxes score among feasible groups, which
+        # would let a what-if group steal pods a real group serves): zero
+        # their score columns, so they absorb only what no real group
+        # can take — the invariant the delta report documents
+        import dataclasses
+
+        score = np.array(inputs.pod_group_score)
+        score[:, len(profiles) - len(what_if_names): len(profiles)] = 0.0
+        inputs = dataclasses.replace(inputs, pod_group_score=score)
+    if len(row_idx) == 0:
+        return {
+            "groups": {
+                name: {
+                    "pending_pods": 0,
+                    "additional_nodes_needed": 0,
+                    "lp_lower_bound": 0,
+                    "what_if": name in what_if_names,
+                    **(
+                        {"error": group_errors[name]}
+                        if name in group_errors
+                        else {}
+                    ),
+                }
+                for name in names
+            },
+            "rows": [],
+            "unschedulable_pods": 0,
+        }
+    out = solver(inputs)
+    assigned = np.asarray(out.assigned)
+    assigned_count = np.asarray(out.assigned_count)
+    nodes_needed = np.asarray(out.nodes_needed)
+    lp_bound = np.asarray(out.lp_bound)
+
+    rows = []
+    for i in range(len(row_idx)):
+        group = int(assigned[i])
+        rows.append(
+            {
+                "pod": slot_pod.get(int(row_idx[i]), "<unknown>"),
+                "pods": int(row_weight[i]),
+                "assigned": (
+                    names[group] if 0 <= group < len(names) else None
+                ),
+            }
+        )
+    return {
+        "groups": {
+            name: {
+                "pending_pods": int(assigned_count[t]),
+                "additional_nodes_needed": int(nodes_needed[t]),
+                "lp_lower_bound": int(lp_bound[t]),
+                "what_if": name in what_if_names,
+                **(
+                    {"error": group_errors[name]}
+                    if name in group_errors
+                    else {}
+                ),
+            }
+            for t, name in enumerate(names)
+        },
+        "rows": rows,
+        "unschedulable_pods": int(out.unschedulable),
+    }
+
+
+def simulate_delta(
+    store, what_if_groups: List[dict], solver=None, template_resolver=None
+) -> dict:
+    """Baseline solve vs what-if solve, with the per-group delta: the
+    operator's 'what would adding node group X change?'."""
+    baseline = simulate(
+        store, solver=solver, template_resolver=template_resolver
+    )
+    with_groups = simulate(
+        store, what_if_groups, solver=solver,
+        template_resolver=template_resolver,
+    )
+    delta = {}
+    for name, after in with_groups["groups"].items():
+        before = baseline["groups"].get(
+            name,
+            {"pending_pods": 0, "additional_nodes_needed": 0},
+        )
+        delta[name] = {
+            "pending_pods": after["pending_pods"]
+            - before["pending_pods"],
+            "additional_nodes_needed": after["additional_nodes_needed"]
+            - before["additional_nodes_needed"],
+        }
+    return {
+        "baseline": baseline,
+        "what_if": with_groups,
+        "delta": {
+            "groups": delta,
+            "unschedulable_pods": with_groups["unschedulable_pods"]
+            - baseline["unschedulable_pods"],
+        },
+    }

@@ -1,0 +1,333 @@
+"""What-if simulation API: per-row assignments surfaced from the solve
+the production tick already runs, plus hypothetical-group deltas.
+
+reference anchor: no reference analog (the producer is stubbed there);
+intent is DESIGN.md 'Pending Pods' — show the placement the signal
+promises, without mutating anything.
+"""
+
+import json
+
+import pytest
+
+from karpenter_tpu.simulate import simulate, simulate_delta
+from karpenter_tpu.store.store import Store
+
+from tests.test_pendingcapacity import pending_mp, pending_pod, ready_node
+
+
+@pytest.fixture
+def cluster():
+    store = Store()
+    store.create(ready_node("n-a", {"group": "a"}, cpu="4", memory="8Gi"))
+    store.create(pending_mp("group-a", {"group": "a"}))
+    return store
+
+
+class TestSimulate:
+    def test_rows_map_back_to_pods(self, cluster):
+        for i in range(3):
+            cluster.create(pending_pod(f"small-{i}", cpu="1", memory="1Gi"))
+        cluster.create(pending_pod("huge", cpu="64", memory="1Gi"))
+        report = simulate(cluster)
+
+        assert report["groups"]["default/group-a"]["pending_pods"] == 3
+        assert not report["groups"]["default/group-a"]["what_if"]
+        assert report["unschedulable_pods"] == 1
+        by_pod = {row["pod"]: row for row in report["rows"]}
+        # the 3 identical pods dedup into one row under a representative
+        small_rows = [
+            r for r in report["rows"]
+            if r["pod"].startswith("default/small")
+        ]
+        assert len(small_rows) == 1 and small_rows[0]["pods"] == 3
+        assert small_rows[0]["assigned"] == "default/group-a"
+        assert by_pod["default/huge"]["assigned"] is None
+
+    def test_simulation_mutates_nothing(self, cluster):
+        cluster.create(pending_pod("p", cpu="1", memory="1Gi"))
+        before = cluster.get("MetricsProducer", "default", "group-a")
+        simulate(cluster)
+        after = cluster.get("MetricsProducer", "default", "group-a")
+        assert after.metadata.resource_version == before.metadata.resource_version
+        assert after.status.pending_capacity is None
+
+    def test_what_if_group_absorbs_only_unserved_pods(self, cluster):
+        """Hypothetical groups are appended last: first-feasible keeps
+        pods on real groups, the what-if group only shows the capacity
+        the fleet genuinely lacks."""
+        for i in range(2):
+            cluster.create(pending_pod(f"small-{i}", cpu="1", memory="1Gi"))
+        cluster.create(pending_pod("huge", cpu="64", memory="64Gi"))
+        report = simulate(
+            cluster,
+            what_if_groups=[
+                {
+                    "name": "metal",
+                    "allocatable": {
+                        "cpu": "96", "memory": "128Gi", "pods": "110",
+                    },
+                }
+            ],
+        )
+        assert report["groups"]["default/group-a"]["pending_pods"] == 2
+        assert report["groups"]["metal"]["what_if"]
+        assert report["groups"]["metal"]["pending_pods"] == 1
+        assert report["groups"]["metal"]["additional_nodes_needed"] == 1
+        assert report["unschedulable_pods"] == 0
+
+    def test_what_if_respects_taints_and_labels(self, cluster):
+        cluster.create(
+            pending_pod("picky", cpu="1", node_selector={"disk": "ssd"})
+        )
+        no_label = simulate(
+            cluster,
+            what_if_groups=[
+                {"name": "plain", "allocatable": {
+                    "cpu": "8", "memory": "16Gi", "pods": "64"}}
+            ],
+        )
+        assert no_label["unschedulable_pods"] == 1
+        labeled = simulate(
+            cluster,
+            what_if_groups=[
+                {
+                    "name": "ssd",
+                    "allocatable": {
+                        "cpu": "8", "memory": "16Gi", "pods": "64",
+                    },
+                    "labels": {"disk": "ssd"},
+                }
+            ],
+        )
+        assert labeled["groups"]["ssd"]["pending_pods"] == 1
+        tainted = simulate(
+            cluster,
+            what_if_groups=[
+                {
+                    "name": "ssd-tainted",
+                    "allocatable": {
+                        "cpu": "8", "memory": "16Gi", "pods": "64",
+                    },
+                    "labels": {"disk": "ssd"},
+                    "taints": [
+                        {"key": "d", "value": "x", "effect": "NoSchedule"}
+                    ],
+                }
+            ],
+        )
+        assert tainted["unschedulable_pods"] == 1
+
+    def test_delta_report(self, cluster):
+        cluster.create(pending_pod("huge", cpu="64", memory="64Gi"))
+        report = simulate_delta(
+            cluster,
+            [{"name": "metal", "allocatable": {
+                "cpu": "96", "memory": "128Gi", "pods": "110"}}],
+        )
+        assert report["baseline"]["unschedulable_pods"] == 1
+        assert report["what_if"]["unschedulable_pods"] == 0
+        assert report["delta"]["unschedulable_pods"] == -1
+        assert report["delta"]["groups"]["metal"] == {
+            "pending_pods": 1,
+            "additional_nodes_needed": 1,
+        }
+
+    def test_empty_pending_set(self, cluster):
+        report = simulate(cluster)
+        assert report["rows"] == []
+        assert report["unschedulable_pods"] == 0
+        assert report["groups"]["default/group-a"]["pending_pods"] == 0
+
+
+class TestSimulateCLI:
+    def test_cli_simulate_with_what_if(self, tmp_path, capsys):
+        """`python -m karpenter_tpu --simulate` end to end over a WAL
+        store, the documented operator workflow (OPERATIONS.md)."""
+        from karpenter_tpu.__main__ import main
+        from karpenter_tpu.runtime import KarpenterRuntime, Options
+
+        data_dir = str(tmp_path / "state")
+        seed = KarpenterRuntime(Options(data_dir=data_dir))
+        seed.store.create(ready_node("n-a", {"group": "a"}, cpu="4"))
+        seed.store.create(pending_mp("group-a", {"group": "a"}))
+        seed.store.create(pending_pod("huge", cpu="64", memory="64Gi"))
+        seed.close()
+
+        what_if = tmp_path / "what-if.json"
+        what_if.write_text(json.dumps([
+            {"name": "metal",
+             "allocatable": {"cpu": "96", "memory": "128Gi", "pods": "110"}}
+        ]))
+        rc = main([
+            "--simulate", "--what-if", str(what_if),
+            "--data-dir", data_dir, "--no-leader-elect",
+        ])
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["delta"]["unschedulable_pods"] == -1
+        assert report["what_if"]["groups"]["metal"]["what_if"]
+
+    def test_cli_rejects_non_list_what_if(self, tmp_path, capsys):
+        from karpenter_tpu.__main__ import main
+
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"name": "not-a-list"}))
+        rc = main([
+            "--simulate", "--what-if", str(bad),
+            "--data-dir", str(tmp_path / "s"), "--no-leader-elect",
+        ])
+        assert rc == 2
+
+
+class TestSimulateFidelity:
+    def test_pods_resource_defaults_like_live_profiles(self, cluster):
+        """A what-if spec declaring only cpu/memory must not be silently
+        infeasible: the pods resource defaults exactly as it does for
+        live-node profiles and provider templates."""
+        cluster.create(pending_pod("huge", cpu="64", memory="64Gi"))
+        report = simulate(
+            cluster,
+            what_if_groups=[
+                {"name": "metal",
+                 "allocatable": {"cpu": "96", "memory": "128Gi"}}
+            ],
+        )
+        assert report["groups"]["metal"]["pending_pods"] == 1
+        assert report["unschedulable_pods"] == 0
+
+    def test_cloud_api_taint_dialect_constrains(self, cluster):
+        """NO_SCHEDULE (the GKE/EKS enum spelling) must constrain like
+        NoSchedule — specs are declared like provider raw templates."""
+        cluster.create(pending_pod("huge", cpu="64", memory="64Gi"))
+        report = simulate(
+            cluster,
+            what_if_groups=[
+                {
+                    "name": "metal",
+                    "allocatable": {"cpu": "96", "memory": "128Gi"},
+                    "taints": [
+                        {"key": "d", "value": "x", "effect": "NO_SCHEDULE"}
+                    ],
+                }
+            ],
+        )
+        assert report["unschedulable_pods"] == 1
+
+    def test_scale_from_zero_groups_use_template_resolver(self):
+        """An empty group with a nodeGroupRef resolves its declared shape
+        through the same seam the production solve uses, keeping the
+        baseline honest."""
+        store = Store()
+        mp = pending_mp("empty-group", {"group": "zero"})
+        mp.spec.pending_capacity.node_group_ref = "pool"
+        store.create(mp)
+        store.create(pending_pod("p", cpu="1", memory="1Gi"))
+
+        def resolver(namespace, ref):
+            assert (namespace, ref) == ("default", "pool")
+            return (
+                {"cpu": 8.0, "memory": 2**34, "pods": 110.0},
+                {("group", "zero")},
+                set(),
+            )
+
+        report = simulate(store, template_resolver=resolver)
+        assert report["groups"]["default/empty-group"]["pending_pods"] == 1
+        assert report["unschedulable_pods"] == 0
+
+    def test_poisoned_producer_is_row_isolated(self, cluster):
+        """One producer with a selector that blows up profile computation
+        reports an error on its own group; the rest still solve."""
+        bad = pending_mp("poisoned", {"group": "x"})
+        bad.spec.pending_capacity.node_selector = None  # blows up matching
+        cluster.create(bad)
+        cluster.create(pending_pod("p", cpu="1", memory="1Gi"))
+        report = simulate(cluster)
+        assert "error" in report["groups"]["default/poisoned"]
+        assert report["groups"]["default/poisoned"]["pending_pods"] == 0
+        assert report["groups"]["default/group-a"]["pending_pods"] == 1
+
+    def test_rows_are_namespace_qualified(self, cluster):
+        cluster.create(pending_pod("p", cpu="1", memory="1Gi"))
+        report = simulate(cluster)
+        assert report["rows"][0]["pod"] == "default/p"
+
+    def test_empty_what_if_list_still_yields_delta_shape(
+        self, tmp_path, capsys
+    ):
+        """--what-if pointing at [] must produce the documented
+        baseline/what_if/delta report, not the plain one."""
+        from karpenter_tpu.__main__ import main
+        from karpenter_tpu.runtime import KarpenterRuntime, Options
+
+        data_dir = str(tmp_path / "state")
+        seed = KarpenterRuntime(Options(data_dir=data_dir))
+        seed.store.create(pending_mp("group-a", {"group": "a"}))
+        seed.close()
+        empty = tmp_path / "none.json"
+        empty.write_text("[]")
+        rc = main([
+            "--simulate", "--what-if", str(empty),
+            "--data-dir", data_dir, "--no-leader-elect",
+        ])
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out)
+        assert set(report) == {"baseline", "what_if", "delta"}
+
+    def test_preferred_affinity_cannot_steal_into_what_if(self, cluster):
+        """The solver steers by preference score among feasible groups; a
+        what-if group matching a pod's preference must NOT attract a pod
+        a real group serves — score columns of hypothetical groups are
+        zeroed, preserving the delta's 'genuinely lacking' meaning."""
+        from karpenter_tpu.api.core import (
+            Affinity,
+            NodeAffinity,
+            NodeSelectorRequirement,
+            NodeSelectorTerm,
+            PreferredSchedulingTerm,
+        )
+
+        pod = pending_pod("prefers-ssd", cpu="1", memory="1Gi")
+        pod.spec.affinity = Affinity(
+            node_affinity=NodeAffinity(
+                preferred_during_scheduling_ignored_during_execution=[
+                    PreferredSchedulingTerm(
+                        weight=100,
+                        preference=NodeSelectorTerm(
+                            match_expressions=[
+                                NodeSelectorRequirement(
+                                    key="disk", operator="In",
+                                    values=["ssd"],
+                                )
+                            ]
+                        ),
+                    )
+                ]
+            )
+        )
+        cluster.create(pod)
+        report = simulate(
+            cluster,
+            what_if_groups=[
+                {
+                    "name": "ssd-pool",
+                    "allocatable": {"cpu": "8", "memory": "16Gi"},
+                    "labels": {"disk": "ssd"},
+                }
+            ],
+        )
+        assert report["groups"]["default/group-a"]["pending_pods"] == 1
+        assert report["groups"]["ssd-pool"]["pending_pods"] == 0
+
+    def test_what_if_name_collision_is_uniquified(self, cluster):
+        cluster.create(pending_pod("p", cpu="1", memory="1Gi"))
+        report = simulate(
+            cluster,
+            what_if_groups=[
+                {"name": "metal", "allocatable": {"cpu": "8", "memory": "8Gi"}},
+                {"name": "metal", "allocatable": {"cpu": "8", "memory": "8Gi"}},
+            ],
+        )
+        assert "metal" in report["groups"]
+        assert "metal#2" in report["groups"]
