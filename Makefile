@@ -11,13 +11,21 @@ ci: codegen verify battletest ## Everything the gate runs
 test: ## Run the test suite (virtual 8-device CPU mesh)
 	$(PYTHON) -m pytest tests/ -x -q
 
-battletest: ## Randomized order + scale + stress + coverage (reference: Makefile battletest)
-	KARPENTER_TEST_SHUFFLE=random KARPENTER_SCALE_TESTS=1 $(PYTHON) -m pytest tests/ -q --cov=karpenter_tpu --cov-report=term-missing 2>/dev/null \
-		|| KARPENTER_TEST_SHUFFLE=random KARPENTER_SCALE_TESTS=1 $(PYTHON) -m pytest tests/ -q
+battletest: ## Randomized order + scale + stress + coverage when available (reference: Makefile battletest)
+	@# coverage is opportunistic but NEVER silent: the gate says which
+	@# mode it runs in, and a failing test fails it in either mode
+	@if $(PYTHON) -c "import pytest_cov" 2>/dev/null; then \
+		echo "battletest: with coverage"; \
+		KARPENTER_TEST_SHUFFLE=random KARPENTER_SCALE_TESTS=1 $(PYTHON) -m pytest tests/ -q --cov=karpenter_tpu --cov-report=term-missing; \
+	else \
+		echo "battletest: pytest-cov not installed, running WITHOUT coverage"; \
+		KARPENTER_TEST_SHUFFLE=random KARPENTER_SCALE_TESTS=1 $(PYTHON) -m pytest tests/ -q; \
+	fi
 
-verify: ## Static checks: compile all sources, no syntax/undefined-name drift
-	$(PYTHON) -m compileall -q karpenter_tpu tests bench.py __graft_entry__.py
+verify: ## Static checks: compile, import, AST lint (complexity bound + unused imports)
+	$(PYTHON) -m compileall -q karpenter_tpu tests hack bench.py __graft_entry__.py
 	$(PYTHON) -c "import karpenter_tpu"
+	$(PYTHON) hack/lint.py
 
 codegen: ## Regenerate config/crd/*.yaml + releases/manifest.yaml from the API types
 	bash hack/release.sh

@@ -181,7 +181,7 @@ def _run_simulation(args, store) -> int:
     return 0
 
 
-def main(argv=None) -> int:
+def main(argv=None) -> int:  # lint: allow-complexity — flag-to-subsystem wiring, one branch per optional server
     args = parse_args(argv)
     log_setup(verbose=args.verbose)
 

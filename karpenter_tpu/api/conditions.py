@@ -12,7 +12,7 @@ True. Tests converge on "happy" = all conditions True
 from __future__ import annotations
 
 import time as _time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 TRUE = "True"

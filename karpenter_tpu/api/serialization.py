@@ -19,7 +19,7 @@ from __future__ import annotations
 import dataclasses
 import re
 import typing
-from typing import Any, Dict, List, Optional, Type
+from typing import Any, Dict, List, Type
 
 import yaml
 
@@ -78,7 +78,7 @@ def _unwrap_optional(tp: Any) -> Any:
     return tp
 
 
-def _coerce(value: Any, tp: Any, lenient: bool = False) -> Any:
+def _coerce(value: Any, tp: Any, lenient: bool = False) -> Any:  # lint: allow-complexity — one isinstance arm per wire type, a dispatch table in if-form
     tp = _unwrap_optional(tp)
     if value is None:
         return None
@@ -125,7 +125,7 @@ def _rfc3339_to_epoch(value: str) -> float:
     return _dt.datetime.fromisoformat(text).timestamp()
 
 
-def from_dict(cls: Type, data: Dict[str, Any], lenient: bool = False):
+def from_dict(cls: Type, data: Dict[str, Any], lenient: bool = False):  # lint: allow-complexity — decode dialect handling, branches enumerated not nested
     """Hydrate dataclass `cls` from a manifest-shaped dict (camelCase keys).
     Unknown keys are an error — same posture as apiserver structural schemas
     (silently dropped config is misconfig that 'works').

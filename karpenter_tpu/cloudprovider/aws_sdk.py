@@ -182,7 +182,7 @@ class Boto3AutoscalingClient:
             DesiredCapacity=desired_capacity,
         )
 
-    def describe_node_template(self, name: str) -> Optional[dict]:
+    def describe_node_template(self, name: str) -> Optional[dict]:  # lint: allow-complexity — per-API-shape fallbacks (override/id/name), each a guard
         """Scale-from-zero template: instance type from the ASG's launch
         template (override first — mixed policies list the real types
         there), sized via DescribeInstanceTypes; labels/taints from the

@@ -115,7 +115,7 @@ class Manager:
         except Exception as e:  # noqa: BLE001
             return e
 
-    def reconcile_all(self) -> None:
+    def reconcile_all(self) -> None:  # lint: allow-complexity — error-taxonomy arms of the reconcile loop
         """One manager tick: every due object of every controller."""
         start = _time.perf_counter()
         now = self.clock()

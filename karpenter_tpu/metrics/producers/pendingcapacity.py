@@ -142,7 +142,7 @@ def _group_profile(
     return alloc, labels, taints
 
 
-def solve_pending(
+def solve_pending(  # lint: allow-complexity — the one batched solve: per-target row isolation + path select
     store, due_producers: List, registry: GaugeRegistry, solver=None,
     pod_cache=None, feed=None, template_resolver=None,
 ) -> Dict[tuple, Optional[Exception]]:
@@ -406,7 +406,7 @@ def _dedup_rows(snap):
     return idx, counts.astype(np.int32)
 
 
-def _expand_spread_rows(snap, profiles, row_idx, row_weight, label_dicts_fn):
+def _expand_spread_rows(snap, profiles, row_idx, row_weight, label_dicts_fn):  # lint: allow-complexity — per-domain chunking: each guard is a documented spread rule
     """Topology spread (DoNotSchedule, non-hostname keys): partition each
     constrained row's weight into BALANCED per-domain sub-rows.
 
@@ -518,7 +518,7 @@ def _expand_spread_rows(snap, profiles, row_idx, row_weight, label_dicts_fn):
     )
 
 
-def _encode_from_cache(snap, profiles, with_rows: bool = False):
+def _encode_from_cache(snap, profiles, with_rows: bool = False):  # lint: allow-complexity — THE single encoder; splitting would smear the output-equality invariant
     """Snapshot (store/columnar.PendingSnapshot) -> solver inputs, with
     rows DEDUPLICATED into distinct pod shapes + multiplicities
     (pod_weight) — see _dedup_rows. Every solve path (feed, pod_cache,

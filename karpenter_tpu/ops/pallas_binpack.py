@@ -25,7 +25,7 @@ oracle, and this kernel must match it bit-for-bit (tests/test_pallas_binpack).
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

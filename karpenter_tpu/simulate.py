@@ -51,7 +51,7 @@ def _what_if_profile(spec: dict) -> Tuple[Dict[str, float], set, set]:
     return profile_from_template(template)
 
 
-def simulate(
+def simulate(  # lint: allow-complexity — report assembly: one guard per optional report field
     store,
     what_if_groups: Optional[List[dict]] = None,
     solver=None,

@@ -10,7 +10,6 @@ from karpenter_tpu.api.core import (
     NodeCondition,
     NodeSpec,
     NodeStatus,
-    ObjectMeta,
     Pod,
     PodSpec,
     is_ready_and_schedulable,
@@ -26,7 +25,6 @@ from karpenter_tpu.api.horizontalautoscaler import (
 )
 from karpenter_tpu.api.metricsproducer import (
     MetricsProducer,
-    MetricsProducerSpec,
     Pattern,
     ReservedCapacitySpec,
     ScheduleSpec,

@@ -12,7 +12,7 @@ import time
 
 import pytest
 
-from karpenter_tpu.api import HorizontalAutoscaler, ScalableNodeGroup
+from karpenter_tpu.api import ScalableNodeGroup
 from karpenter_tpu.api.core import ObjectMeta
 from karpenter_tpu.api.scalablenodegroup import ScalableNodeGroupSpec
 from karpenter_tpu.leaderelection import LeaderElector

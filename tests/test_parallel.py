@@ -166,7 +166,6 @@ def test_sharded_weighted_binpack_matches_single_device(n_devices):
     like every other row-major array: sharded == single-device on a
     weighted problem, and padding rows (weight 0) stay inert."""
     import jax.numpy as jnp
-    from karpenter_tpu.ops.binpack import BinPackInputs
 
     import dataclasses
 

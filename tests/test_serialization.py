@@ -13,7 +13,6 @@ import pytest
 # register validators for the provider types the examples use
 import karpenter_tpu.cloudprovider.aws  # noqa: F401
 import karpenter_tpu.cloudprovider.tpu  # noqa: F401
-from karpenter_tpu.api.horizontalautoscaler import HorizontalAutoscaler
 from karpenter_tpu.api.metricsproducer import MetricsProducer
 from karpenter_tpu.api.scalablenodegroup import ScalableNodeGroup
 from karpenter_tpu.api.serialization import (

@@ -2,10 +2,10 @@
 
 import pytest
 
-from karpenter_tpu.api import HorizontalAutoscaler, Node, Pod, ScalableNodeGroup
+from karpenter_tpu.api import Node, Pod, ScalableNodeGroup
 from karpenter_tpu.api.core import ObjectMeta, PodSpec
 from karpenter_tpu.api.scalablenodegroup import ScalableNodeGroupSpec
-from karpenter_tpu.store import ConflictError, NotFoundError, Scale, Store
+from karpenter_tpu.store import ConflictError, NotFoundError, Store
 
 
 def sng(name="group", namespace="default", replicas=None):
