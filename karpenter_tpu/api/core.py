@@ -338,7 +338,15 @@ class Pod:
         totals: Dict[str, Quantity] = {}
         for container in self.spec.containers:
             for name, quantity in container.requests.items():
-                totals[name] = totals.get(name, Quantity()).add(quantity)
+                current = totals.get(name)
+                # first occurrence aliases the (immutable) quantity
+                # instead of Quantity(0).add(q): same value AND format
+                # (a zero receiver adopts the operand's format anyway),
+                # minus two Fraction constructions per pod resource —
+                # this runs for every watch-delivered pod
+                totals[name] = (
+                    quantity if current is None else current.add(quantity)
+                )
         return totals
 
     def effective_requests(self) -> Dict[str, Quantity]:

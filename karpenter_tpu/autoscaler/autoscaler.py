@@ -165,7 +165,7 @@ class BatchAutoscaler:
                 results[key(row.ha)] = None
         return results
 
-    def _decide(self, rows: List[_Row]) -> D.DecisionOutputs:  # lint: allow-complexity — batch assembly: one guard per optional CRD field
+    def _decide(self, rows: List[_Row]) -> D.DecisionOutputs:
         n = D.pad_to(len(rows))
         m = max(1, max(len(r.values) for r in rows))
 
