@@ -54,8 +54,23 @@ def _merge_patch(target, patch):
 
 
 class FakeApiServer:
-    def __init__(self):
+    # bounded watch-event history, the etcd watch window analog: events
+    # older than this fall off and a watch resuming from before the
+    # horizon gets the real apiserver's "too old resource version" 410
+    HISTORY_LIMIT = 1024
+
+    def __init__(self, history_limit: Optional[int] = None):
         self._lock = threading.Lock()
+        # explicit 0 means a zero-length window (every resume 410s)
+        self._history_limit = (
+            self.HISTORY_LIMIT if history_limit is None else history_limit
+        )
+        # (rv, plural, event dict) of every broadcast, newest last
+        self._history: List[Tuple[int, str, dict]] = []
+        # rv of the newest DISCARDED event: watches from at/below this
+        # cannot be replayed losslessly -> 410 (API concepts: "410 Gone:
+        # the requested resource version is no longer available")
+        self._compacted_rv = 0
         self.list_pages_served = 0  # chunked-list pages (tests assert)
         # chunked-list snapshots: like the real apiserver, every page of
         # one paginated LIST serves from the FIRST page's snapshot (same
@@ -93,10 +108,21 @@ class FakeApiServer:
             doc = self._objects.pop((plural, ns, name), None)
             if doc is not None:
                 self._rv += 1
+                # the DELETED event carries the final object state AT THE
+                # DELETION's resourceVersion (API concepts: a delete bumps
+                # rv like any write; clients advance their watch watermark
+                # from it)
+                doc["metadata"]["resourceVersion"] = str(self._rv)
                 self._broadcast(plural, "DELETED", doc)
             return doc
 
     def _broadcast(self, plural: str, event: str, doc: dict) -> None:
+        rv = int(doc["metadata"]["resourceVersion"])
+        self._history.append(
+            (rv, plural, {"type": event, "object": json.loads(json.dumps(doc))})
+        )
+        while len(self._history) > self._history_limit:
+            self._compacted_rv = self._history.pop(0)[0]
         for want, q in list(self._watchers):
             if want == plural:
                 q.put({"type": event, "object": doc})
@@ -237,15 +263,58 @@ class FakeApiServer:
             def _serve_watch(self, plural: str, since: int):
                 q: "queue.Queue" = queue.Queue()
                 with fake._lock:
-                    # replay objects the caller hasn't seen — a real
-                    # apiserver replays events after the requested
-                    # resourceVersion, closing the list→watch gap
-                    for (p, _, _), doc in fake._objects.items():
-                        if p == plural and int(
-                            doc["metadata"]["resourceVersion"]
-                        ) > since:
-                            q.put({"type": "ADDED", "object": doc})
-                    fake._watchers.append((plural, q))
+                    expired = since and since < fake._compacted_rv
+                    if not expired:
+                        if since:
+                            # replay the EVENT history after `since` —
+                            # including DELETED events, which an
+                            # object-state replay would silently lose
+                            # (the resumed client would keep deleted
+                            # objects in its mirror forever)
+                            for rv, p, event in fake._history:
+                                if p == plural and rv > since:
+                                    q.put(event)
+                        else:
+                            # rv=0: "any point is fine" — serve the
+                            # current state as synthetic ADDEDs
+                            for (p, _, _), doc in fake._objects.items():
+                                if p == plural:
+                                    q.put({"type": "ADDED", "object": doc})
+                        fake._watchers.append((plural, q))
+                if expired:
+                    # watch window expired: the real apiserver delivers
+                    # an IN-STREAM ERROR event carrying a 410 Status
+                    # ("too old resource version"), terminates the
+                    # chunked body, and closes — NOT an HTTP error
+                    # (API concepts: Efficient detection of changes)
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    line = (
+                        json.dumps(
+                            {
+                                "type": "ERROR",
+                                "object": {
+                                    "kind": "Status",
+                                    "code": 410,
+                                    "reason": "Expired",
+                                    "message": (
+                                        f"too old resource version: "
+                                        f"{since}"
+                                    ),
+                                },
+                            }
+                        )
+                        + "\n"
+                    ).encode()
+                    self.wfile.write(
+                        f"{len(line):x}\r\n".encode() + line + b"\r\n"
+                    )
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
+                    self.close_connection = True
+                    return
                 try:
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
