@@ -209,9 +209,10 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
     )
     ap.add_argument(
         "--backend",
-        choices=("auto", "xla", "pallas"),
+        choices=("auto", "xla", "pallas", "numpy"),
         default="auto",
-        help="auto = fused Pallas kernel on TPU, XLA elsewhere",
+        help="auto = fused Pallas kernel on TPU, the numpy degraded-mode "
+        "program on a CPU default backend, XLA elsewhere",
     )
     ap.add_argument(
         "--churn",

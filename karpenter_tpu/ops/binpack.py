@@ -316,12 +316,26 @@ def solve(
     backend: str = "auto",
 ) -> BinPackOutputs:
     """Backend dispatcher: 'xla' (this module), 'pallas' (the fused Mosaic
-    kernel, ops/pallas_binpack.py), or 'auto' — pallas on TPU, xla
-    elsewhere. The two backends are pinned element-for-element equal by
-    tests/test_pallas_binpack.py. Inputs are device-cached by object
-    identity (see _device_resident): treat them as immutable."""
+    kernel, ops/pallas_binpack.py), 'numpy' (the CPU-shaped degraded-mode
+    program, ops/numpy_binpack.py), or 'auto' — pallas on TPU, numpy on a
+    CPU default backend (the accelerator-outage fallback: the XLA
+    program's dense O(P*T*B) histogram layout is built for the MXU and
+    dominates a CPU solve, while the numpy program's sparse scatters are
+    O(P)). All backends are pinned element-for-element equal by
+    tests/test_pallas_binpack.py and tests/test_numpy_binpack.py. Inputs
+    are device-cached by object identity (see _device_resident): treat
+    them as immutable."""
     if backend == "auto":
-        backend = "pallas" if jax.default_backend() == "tpu" else "xla"
+        if jax.default_backend() == "tpu":
+            backend = "pallas"
+        elif jax.default_backend() == "cpu":
+            backend = "numpy"
+        else:
+            backend = "xla"
+    if backend == "numpy":
+        from karpenter_tpu.ops.numpy_binpack import binpack_numpy
+
+        return binpack_numpy(inputs, buckets=buckets)
     inputs = _device_resident(inputs)
     if backend == "xla":
         return binpack(inputs, buckets=buckets)

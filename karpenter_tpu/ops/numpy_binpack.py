@@ -1,0 +1,212 @@
+"""Degraded-mode bin-pack: the XLA program's semantics in plain numpy,
+shaped for CPUs.
+
+Why this exists: the XLA program (ops/binpack.py) is laid out for the
+TPU — its bucket histogram is a B-deep stack of [P, T] masked reductions,
+O(P*T*B) elementwise work that the MXU-fed vector units eat for free but
+that DOMINATES a CPU fallback solve (seconds at the 100k x 300 bench
+scale). A CPU doesn't want that layout; it wants the sparse one: each pod
+has exactly ONE assigned group, so every post-assignment aggregate is an
+O(P) scatter (np.bincount), not an O(P*T*B) dense reduction. Feasibility
+stays dense ([P, K] @ [K, T] bitset matmuls ride BLAS sgemm), assignment
+is one argmax, and the shelf-BFD histogram walk is O(B^2) over [T, B+1] —
+trivial.
+
+This backend is selected by ops/binpack.solve(backend="auto") whenever
+the default jax backend is CPU — i.e. exactly the accelerator-outage
+degraded mode (utils/backend.py) and CPU-only test environments. Outputs
+are pinned equal to the XLA program by tests/test_numpy_binpack.py
+property tests (same argmax tie-breaks, same f32 quantization
+arithmetic) — exactly for assigned/assigned_count/nodes_needed/
+unschedulable; lp_bound within +-1 at f32-resolution boundaries, where
+this path's f64 demand accumulation is strictly MORE accurate than the
+accelerator's f32 einsum and the shared -1e-5 ceil guard is smaller
+than one f32 ulp of the ratio (above ~84 nodes demanded per group).
+
+reference: the reference stubs this producer entirely
+(pkg/metrics/producers/pendingcapacity/producer.go:29-31); its design doc
+warns the naive host form "scales linearly with node groups and
+unschedulable pods" (docs/designs/DESIGN.md) — this is the non-naive
+host form for when the accelerator is away.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from karpenter_tpu.ops.binpack import BinPackInputs, BinPackOutputs
+
+
+def _as_np(x, dtype=None):
+    arr = np.asarray(x)
+    return arr if dtype is None else arr.astype(dtype, copy=False)
+
+
+def _feasibility_np(
+    requests, valid, intolerant, required, alloc, taints, labels, forbidden
+):
+    """bool[P, T], boolean-identical to ops/binpack._feasibility. The
+    taint/label violations stay f32 matmuls here too: measured on this
+    class of CPU, single-threaded BLAS sgemm beats a packed-uint64
+    broadcast formulation (which is memory-traffic-bound on the [P, T]
+    word temps) — and the small-integer counts are exact in f32."""
+    fits = np.ones((requests.shape[0], alloc.shape[0]), bool)
+    for r in range(requests.shape[1]):
+        fits &= requests[:, r : r + 1] <= alloc[None, :, r]
+    fits &= np.any(alloc > 0, axis=1)[None, :]
+    taint_violations = intolerant.astype(np.float32) @ taints.astype(
+        np.float32
+    ).T
+    label_violations = required.astype(np.float32) @ (~labels).astype(
+        np.float32
+    ).T
+    fits &= taint_violations < 0.5
+    fits &= label_violations < 0.5
+    if forbidden is not None:
+        fits &= ~forbidden
+    fits &= valid[:, None]
+    return fits
+
+
+def _shelf_bfd_np(histogram: np.ndarray, buckets: int) -> np.ndarray:
+    """i32[T, B] -> i32[T]; the vectorized shelf best-fit-decreasing of
+    ops/binpack._shelf_bfd, same pass structure, numpy state."""
+    n_groups = histogram.shape[0]
+    rem_index = np.arange(buckets + 1, dtype=np.int64)
+    bins = np.zeros((n_groups, buckets + 1), np.int64)
+    total = np.zeros(n_groups, np.int64)
+    for k in range(buckets, 0, -1):
+        c = histogram[:, k - 1].astype(np.int64)
+        for _ in range(buckets):
+            if not c.any():
+                break  # pure speedup: remaining passes are no-ops
+            avail = np.where(
+                (rem_index[None, :] >= k) & (rem_index[None, :] > 0),
+                bins,
+                0,
+            )
+            cum_before = np.cumsum(avail, axis=1) - avail
+            place = np.clip(c[:, None] - cum_before, 0, avail)
+            bins = bins - place + np.roll(place, -k, axis=1)
+            c = c - place.sum(axis=1)
+        per_bin = buckets // k
+        full_bins = c // per_bin
+        leftover = c - full_bins * per_bin
+        has_partial = (leftover > 0).astype(np.int64)
+        total += full_bins + has_partial
+        full_rem = buckets - per_bin * k
+        bins[:, full_rem] += full_bins
+        partial_rem = buckets - leftover * k
+        bins[np.arange(n_groups), partial_rem] += has_partial
+    return total.astype(np.int32)
+
+
+def binpack_numpy(
+    inputs: BinPackInputs, buckets: int = 32
+) -> BinPackOutputs:
+    requests = _as_np(inputs.pod_requests, np.float32)
+    valid = _as_np(inputs.pod_valid, bool)
+    intolerant = _as_np(inputs.pod_intolerant, bool)
+    required = _as_np(inputs.pod_required, bool)
+    alloc = _as_np(inputs.group_allocatable, np.float32)
+    taints = _as_np(inputs.group_taints, bool)
+    labels = _as_np(inputs.group_labels, bool)
+    forbidden = (
+        None
+        if inputs.pod_group_forbidden is None
+        else _as_np(inputs.pod_group_forbidden, bool)
+    )
+    score = (
+        None
+        if inputs.pod_group_score is None
+        else _as_np(inputs.pod_group_score, np.float32)
+    )
+    weight = (
+        None
+        if inputs.pod_weight is None
+        else _as_np(inputs.pod_weight, np.int64)
+    )
+    n_pods, n_resources = requests.shape
+    n_groups = alloc.shape[0]
+
+    feasible = _feasibility_np(
+        requests, valid, intolerant, required, alloc, taints, labels,
+        forbidden,
+    )
+    any_feasible = feasible.any(axis=1)
+    if score is None:
+        choice = np.argmax(feasible, axis=1)
+    else:
+        choice = np.argmax(
+            np.where(feasible, score, -np.inf), axis=1
+        )
+    assigned = np.where(any_feasible, choice, -1).astype(np.int32)
+
+    # the sparse layout: everything below scatters over the ONE assigned
+    # group per pod — O(P), where the dense XLA layout is O(P*T*(B|R))
+    rows = np.nonzero(any_feasible & valid)[0]
+    groups_of = choice[rows]
+    w_of = (
+        np.ones(len(rows), np.int64) if weight is None else weight[rows]
+    )
+
+    assigned_count = np.bincount(
+        groups_of, weights=w_of, minlength=n_groups
+    ).astype(np.int32)
+
+    # dominant share of each assigned pod ON ITS GROUP ONLY, f32 ops in
+    # the same order as _dominant_share so the quantized bucket matches
+    # the XLA program bit for bit
+    share = np.zeros(len(rows), np.float32)
+    row_alloc = alloc[groups_of]  # [n, R]
+    row_req = requests[rows]
+    for r in range(n_resources):
+        a = row_alloc[:, r]
+        s = np.where(
+            a > 0,
+            row_req[:, r] / np.maximum(a, np.float32(1e-30)),
+            np.float32(np.inf),
+        ).astype(np.float32)
+        s = np.where((a <= 0) & (row_req[:, r] <= 0), np.float32(0.0), s)
+        share = np.maximum(share, s)
+    bucket_of = np.clip(
+        np.ceil(share * np.float32(buckets)).astype(np.int64), 1, buckets
+    )
+    histogram = np.bincount(
+        groups_of.astype(np.int64) * buckets + (bucket_of - 1),
+        weights=w_of,
+        minlength=n_groups * buckets,
+    ).reshape(n_groups, buckets)
+
+    nodes_needed = _shelf_bfd_np(histogram, buckets)
+
+    # LP bound: weighted demand scattered per group. f64 accumulation —
+    # strictly more accurate than the XLA program's f32 einsum; at
+    # demand/allocatable ratios above ~84 one f32 ulp exceeds the shared
+    # -1e-5 ceil guard, so the two backends may legitimately differ by
+    # +-1 there (the documented lp_bound exception)
+    demand = np.zeros((n_groups, n_resources), np.float64)
+    np.add.at(demand, groups_of, row_req.astype(np.float64) * w_of[:, None])
+    demand = demand.astype(np.float32)
+    per_resource = np.where(
+        alloc > 0,
+        np.ceil(
+            demand / np.maximum(alloc, np.float32(1e-30))
+            - np.float32(1e-5)
+        ),
+        np.float32(0.0),
+    )
+    lp_bound = per_resource.max(axis=1).astype(np.int32)
+
+    unsched_mask = (~any_feasible) & valid
+    if weight is None:
+        unschedulable = int(unsched_mask.sum())
+    else:
+        unschedulable = int(weight[unsched_mask].sum())
+    return BinPackOutputs(
+        assigned=assigned,
+        assigned_count=assigned_count,
+        nodes_needed=nodes_needed,
+        lp_bound=lp_bound,
+        unschedulable=np.int32(unschedulable),
+    )
