@@ -383,6 +383,15 @@ def run(args, metric: str, note: str) -> None:
         f"backend={jax.default_backend()} devices={jax.devices()}",
         file=sys.stderr,
     )
+    if jax.default_backend() == "cpu" and args.backend in ("auto", "numpy"):
+        # block on the C kernel build HERE, outside the timed region —
+        # the async-build production path would otherwise leave the first
+        # measured iterations on the numpy fallback (like jit warmup,
+        # one-time setup is excluded from the measurement)
+        from karpenter_tpu.native import load_kbinpack
+
+        if load_kbinpack() is None:
+            print("native kernel unavailable: numpy stages", file=sys.stderr)
     if args.clusters:
         inputs = build_multicluster_inputs(
             args.pods, args.clusters, args.types,

@@ -60,6 +60,41 @@ def _compile(src: str, out: str) -> bool:
                 pass
 
 
+def _build_and_load(src_name: str, so_name: str, bind):
+    """The shared build-on-demand scaffold: staleness check, compile,
+    bind. Returns the bound handle or None; callers own the caching."""
+    src = os.path.join(_HERE, src_name)
+    so = os.path.join(_BUILD_DIR, so_name)
+    try:
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        stale = (
+            not os.path.exists(so)
+            or os.path.getmtime(so) < os.path.getmtime(src)
+        )
+        if stale and not _compile(src, so):
+            return None
+        return bind(so)
+    except Exception:
+        return None
+
+
+def _bind_extension(so: str):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("_kquantity", so)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def _bind_ctypes(so: str):
+    import ctypes
+
+    lib = ctypes.CDLL(so)
+    lib.karpenter_assign.restype = None
+    return lib
+
+
 def load_kquantity() -> Optional[object]:
     """The _kquantity extension module, building it if needed; None when
     no toolchain is available (callers use the Python path)."""
@@ -68,25 +103,49 @@ def load_kquantity() -> Optional[object]:
         if _kquantity is not None or _tried:
             return _kquantity
         _tried = True
-        src = os.path.join(_HERE, "quantity.c")
-        so = os.path.join(_BUILD_DIR, "_kquantity.so")
-        try:
-            os.makedirs(_BUILD_DIR, exist_ok=True)
-            stale = (
-                not os.path.exists(so)
-                or os.path.getmtime(so) < os.path.getmtime(src)
-            )
-            if stale and not _compile(src, so):
-                return None
-            import importlib.util
-
-            spec = importlib.util.spec_from_file_location("_kquantity", so)
-            module = importlib.util.module_from_spec(spec)
-            spec.loader.exec_module(module)
-            _kquantity = module
-        except Exception:
-            _kquantity = None
+        _kquantity = _build_and_load(
+            "quantity.c", "_kquantity.so", _bind_extension
+        )
         return _kquantity
+
+
+_kbinpack = None
+_kbinpack_tried = False
+_kbinpack_async_started = False
+
+
+def load_kbinpack() -> Optional[object]:
+    """ctypes handle to the fused assignment kernel (binpack_kernel.c),
+    building it on demand; None without a toolchain (callers use the
+    numpy path). Plain C, no CPython API — loaded with ctypes.CDLL, and
+    the call releases the GIL for its whole O(P*T) worst-case scan."""
+    global _kbinpack, _kbinpack_tried
+    with _lock:
+        if _kbinpack is not None or _kbinpack_tried:
+            return _kbinpack
+        _kbinpack_tried = True
+        _kbinpack = _build_and_load(
+            "binpack_kernel.c", "_kbinpack.so", _bind_ctypes
+        )
+        return _kbinpack
+
+
+def peek_kbinpack() -> Optional[object]:
+    """The kernel if it has finished loading, else None. Never blocks —
+    the degraded-mode solve must not spend its tick budget inside a cc
+    subprocess; it runs the numpy stages until the handle appears."""
+    return _kbinpack
+
+
+def ensure_kbinpack_async() -> None:
+    """Kick off the kernel build/load in a daemon thread (the
+    ensure_kquantity_async pattern)."""
+    global _kbinpack_async_started
+    with _lock:
+        if _kbinpack_async_started or _kbinpack is not None:
+            return
+        _kbinpack_async_started = True
+    threading.Thread(target=load_kbinpack, daemon=True).start()
 
 
 _async_started = False

@@ -1,0 +1,149 @@
+/* Fused assignment kernel for the degraded-mode CPU bin-pack.
+ *
+ * reference: none (the reference stubs the whole producer,
+ * pkg/metrics/producers/pendingcapacity/producer.go:29-31). This is the
+ * native half of ops/numpy_binpack.py: feasibility + first-feasible (or
+ * preference-argmax) assignment + dominant-share bucketing + all
+ * post-assignment aggregates, in ONE row-major pass with per-pod early
+ * exit. The dense formulations (XLA for the MXU, numpy BLAS for the CPU
+ * fallback) always touch every (pod, group) pair; a scalar scan stops at
+ * the first feasible group when no preference scores steer, which is the
+ * common case and makes the pass nearly O(P) on realistic inputs.
+ *
+ * Semantics contract (pinned by tests/test_numpy_binpack.py):
+ *  - feasibility: resource fit (req <= alloc, all R), group has any
+ *    allocatable, no intolerated taint (packed uint64 words), no missing
+ *    required label, not forbidden, pod valid — identical boolean
+ *    outcome to ops/binpack._feasibility;
+ *  - choice: first feasible group, or among feasible the highest score
+ *    with lowest-index tie-break (argmax semantics);
+ *  - share/bucket: float32 arithmetic in the same operation order as
+ *    _dominant_share, bucket = clamp(ceilf(share * B), 1, B);
+ *  - demand: float64 accumulation in pod order (bitwise-identical to the
+ *    numpy np.add.at path).
+ *
+ * Plain C + ctypes (no CPython API): the loader compiles it on demand
+ * and callers fall back to the numpy path when no toolchain exists.
+ */
+
+#include <math.h>
+#include <stddef.h>
+#include <stdint.h>
+
+void karpenter_assign(
+    long long n_pods,
+    long long n_groups,
+    long long n_resources,
+    long long taint_words,
+    long long label_words,
+    long long buckets,
+    const float *requests,          /* [P, R] */
+    const unsigned char *valid,     /* [P] */
+    const uint64_t *intolerant,     /* [P, KW] */
+    const uint64_t *required,       /* [P, LW] */
+    const float *alloc,             /* [T, R] */
+    const uint64_t *taints,         /* [T, KW] */
+    const uint64_t *missing,        /* [T, LW] (~labels, packed) */
+    const unsigned char *forbidden, /* [P, T] or NULL */
+    const float *score,             /* [P, T] or NULL */
+    const long long *weight,        /* [P] or NULL */
+    int32_t *assigned,              /* out [P] */
+    long long *assigned_count,      /* out [T], zeroed by caller */
+    long long *histogram,           /* out [T, B], zeroed by caller */
+    double *demand,                 /* out [T, R], zeroed by caller */
+    long long *unschedulable        /* out [1], zeroed by caller */
+) {
+    /* group usability precomputed once: any allocatable > 0 */
+    for (long long p = 0; p < n_pods; p++) {
+        assigned[p] = -1;
+        if (!valid[p]) {
+            continue;
+        }
+        const float *req = requests + p * n_resources;
+        const uint64_t *intol = intolerant + p * taint_words;
+        const uint64_t *need = required + p * label_words;
+        long long best = -1;
+        float best_score = 0.0f;
+        for (long long t = 0; t < n_groups; t++) {
+            if (forbidden && forbidden[p * n_groups + t]) {
+                continue;
+            }
+            const float *a = alloc + t * n_resources;
+            int ok = 0;
+            for (long long r = 0; r < n_resources; r++) {
+                if (req[r] > a[r]) {
+                    ok = -1;
+                    break;
+                }
+                if (a[r] > 0.0f) {
+                    ok = 1; /* group has SOME allocatable */
+                }
+            }
+            if (ok != 1) {
+                continue;
+            }
+            const uint64_t *tw = taints + t * taint_words;
+            int violated = 0;
+            for (long long w = 0; w < taint_words; w++) {
+                if (intol[w] & tw[w]) {
+                    violated = 1;
+                    break;
+                }
+            }
+            if (violated) {
+                continue;
+            }
+            const uint64_t *mw = missing + t * label_words;
+            for (long long w = 0; w < label_words; w++) {
+                if (need[w] & mw[w]) {
+                    violated = 1;
+                    break;
+                }
+            }
+            if (violated) {
+                continue;
+            }
+            if (score == NULL) {
+                best = t; /* first feasible wins */
+                break;
+            }
+            float s = score[p * n_groups + t];
+            if (best < 0 || s > best_score) {
+                best = t;
+                best_score = s;
+            }
+        }
+        if (best < 0) {
+            *unschedulable += (weight ? weight[p] : 1);
+            continue;
+        }
+        assigned[p] = (int32_t)best;
+        long long w_of = weight ? weight[p] : 1;
+        assigned_count[best] += w_of;
+        const float *a = alloc + best * n_resources;
+        float share = 0.0f;
+        for (long long r = 0; r < n_resources; r++) {
+            /* same f32 formula/order as _dominant_share; feasibility
+             * guarantees req <= alloc, so share stays in [0, 1] */
+            float s;
+            if (a[r] > 0.0f) {
+                float denom = a[r] > 1e-30f ? a[r] : 1e-30f;
+                s = req[r] / denom;
+            } else {
+                s = (req[r] <= 0.0f) ? 0.0f : INFINITY;
+            }
+            if (s > share) {
+                share = s;
+            }
+            demand[best * n_resources + r] += (double)req[r] * (double)w_of;
+        }
+        long long bucket = (long long)ceilf(share * (float)buckets);
+        if (bucket < 1) {
+            bucket = 1;
+        }
+        if (bucket > buckets) {
+            bucket = buckets;
+        }
+        histogram[best * buckets + (bucket - 1)] += w_of;
+    }
+}

@@ -42,6 +42,92 @@ def _as_np(x, dtype=None):
     return arr if dtype is None else arr.astype(dtype, copy=False)
 
 
+def _pack_bits(matrix: np.ndarray) -> np.ndarray:
+    """bool[N, K] -> uint64[N, W] little-endian bit words (the native
+    kernel's taint/label operand layout)."""
+    n, k = matrix.shape
+    words = max(1, -(-k // 64))
+    padded = np.zeros((n, words * 64), bool)
+    padded[:, :k] = matrix
+    return np.ascontiguousarray(
+        np.packbits(padded, axis=1, bitorder="little")
+    ).view(np.uint64)
+
+
+def _assign_native(
+    lib, requests, valid, intolerant, required, alloc, taints, labels,
+    forbidden, score, weight, buckets,
+):
+    """One fused native pass: (assigned, assigned_count, histogram,
+    demand, unschedulable). Same contract as the numpy stages it
+    replaces; parity pinned by tests/test_numpy_binpack.py."""
+    import ctypes
+
+    n_pods, n_resources = requests.shape
+    n_groups = alloc.shape[0]
+    intolerant_words = _pack_bits(intolerant)
+    taint_words = _pack_bits(taints)
+    required_words = _pack_bits(required)
+    missing_words = _pack_bits(~labels)
+
+    assigned = np.empty(n_pods, np.int32)
+    assigned_count = np.zeros(n_groups, np.int64)
+    histogram = np.zeros((n_groups, buckets), np.int64)
+    demand = np.zeros((n_groups, n_resources), np.float64)
+    unschedulable = np.zeros(1, np.int64)
+
+    def ptr(arr, ctype):
+        return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+    requests = np.ascontiguousarray(requests, np.float32)
+    alloc_c = np.ascontiguousarray(alloc, np.float32)
+    valid_c = np.ascontiguousarray(valid, np.uint8)
+    forbidden_c = (
+        None
+        if forbidden is None
+        else np.ascontiguousarray(forbidden, np.uint8)
+    )
+    score_c = (
+        None if score is None else np.ascontiguousarray(score, np.float32)
+    )
+    weight_c = (
+        None if weight is None else np.ascontiguousarray(weight, np.int64)
+    )
+    null = ctypes.POINTER(ctypes.c_float)()
+    lib.karpenter_assign(
+        ctypes.c_longlong(n_pods),
+        ctypes.c_longlong(n_groups),
+        ctypes.c_longlong(n_resources),
+        ctypes.c_longlong(intolerant_words.shape[1]),
+        ctypes.c_longlong(required_words.shape[1]),
+        ctypes.c_longlong(buckets),
+        ptr(requests, ctypes.c_float),
+        ptr(valid_c, ctypes.c_ubyte),
+        ptr(intolerant_words, ctypes.c_uint64),
+        ptr(required_words, ctypes.c_uint64),
+        ptr(alloc_c, ctypes.c_float),
+        ptr(taint_words, ctypes.c_uint64),
+        ptr(missing_words, ctypes.c_uint64),
+        (
+            ptr(forbidden_c, ctypes.c_ubyte)
+            if forbidden_c is not None
+            else ctypes.POINTER(ctypes.c_ubyte)()
+        ),
+        ptr(score_c, ctypes.c_float) if score_c is not None else null,
+        (
+            ptr(weight_c, ctypes.c_longlong)
+            if weight_c is not None
+            else ctypes.POINTER(ctypes.c_longlong)()
+        ),
+        ptr(assigned, ctypes.c_int32),
+        ptr(assigned_count, ctypes.c_longlong),
+        ptr(histogram, ctypes.c_longlong),
+        ptr(demand, ctypes.c_double),
+        ptr(unschedulable, ctypes.c_longlong),
+    )
+    return assigned, assigned_count, histogram, demand, int(unschedulable[0])
+
+
 def _feasibility_np(
     requests, valid, intolerant, required, alloc, taints, labels, forbidden
 ):
@@ -102,8 +188,14 @@ def _shelf_bfd_np(histogram: np.ndarray, buckets: int) -> np.ndarray:
 
 
 def binpack_numpy(
-    inputs: BinPackInputs, buckets: int = 32
+    inputs: BinPackInputs, buckets: int = 32, use_native: bool = True
 ) -> BinPackOutputs:
+    """use_native=True (default) routes the assignment pass through the
+    C kernel (native/binpack_kernel.c) when a toolchain has built it —
+    the scalar scan early-exits at the first feasible group, making the
+    pass nearly O(P) on realistic inputs where the dense numpy stages
+    are O(P*T). Falls back to the pure-numpy stages silently; both are
+    pinned equal to the XLA program by tests/test_numpy_binpack.py."""
     requests = _as_np(inputs.pod_requests, np.float32)
     valid = _as_np(inputs.pod_valid, bool)
     intolerant = _as_np(inputs.pod_intolerant, bool)
@@ -129,65 +221,106 @@ def binpack_numpy(
     n_pods, n_resources = requests.shape
     n_groups = alloc.shape[0]
 
-    feasible = _feasibility_np(
-        requests, valid, intolerant, required, alloc, taints, labels,
-        forbidden,
-    )
-    any_feasible = feasible.any(axis=1)
-    if score is None:
-        choice = np.argmax(feasible, axis=1)
-    else:
-        choice = np.argmax(
-            np.where(feasible, score, -np.inf), axis=1
+    lib = None
+    if use_native and n_pods:
+        # never block a degraded-mode tick inside a cc subprocess: use
+        # the kernel only once its background build has finished, and
+        # run the numpy stages meanwhile (peek/ensure-async pattern,
+        # native/__init__.py)
+        from karpenter_tpu.native import ensure_kbinpack_async, peek_kbinpack
+
+        lib = peek_kbinpack()
+        if lib is None:
+            ensure_kbinpack_async()
+    if lib is not None:
+        (
+            assigned,
+            assigned_count64,
+            histogram,
+            demand64,
+            unschedulable,
+        ) = _assign_native(
+            lib, requests, valid, intolerant, required, alloc, taints,
+            labels, forbidden, score, weight, buckets,
         )
-    assigned = np.where(any_feasible, choice, -1).astype(np.int32)
+        assigned_count = assigned_count64.astype(np.int32)
+    else:
+        feasible = _feasibility_np(
+            requests, valid, intolerant, required, alloc, taints, labels,
+            forbidden,
+        )
+        any_feasible = feasible.any(axis=1)
+        if score is None:
+            choice = np.argmax(feasible, axis=1)
+        else:
+            choice = np.argmax(
+                np.where(feasible, score, -np.inf), axis=1
+            )
+        assigned = np.where(any_feasible, choice, -1).astype(np.int32)
 
-    # the sparse layout: everything below scatters over the ONE assigned
-    # group per pod — O(P), where the dense XLA layout is O(P*T*(B|R))
-    rows = np.nonzero(any_feasible & valid)[0]
-    groups_of = choice[rows]
-    w_of = (
-        np.ones(len(rows), np.int64) if weight is None else weight[rows]
-    )
+        # the sparse layout: everything below scatters over the ONE
+        # assigned group per pod — O(P), where the dense XLA layout is
+        # O(P*T*(B|R))
+        rows = np.nonzero(any_feasible & valid)[0]
+        groups_of = choice[rows]
+        w_of = (
+            np.ones(len(rows), np.int64)
+            if weight is None
+            else weight[rows]
+        )
 
-    assigned_count = np.bincount(
-        groups_of, weights=w_of, minlength=n_groups
-    ).astype(np.int32)
+        assigned_count = np.bincount(
+            groups_of, weights=w_of, minlength=n_groups
+        ).astype(np.int32)
 
-    # dominant share of each assigned pod ON ITS GROUP ONLY, f32 ops in
-    # the same order as _dominant_share so the quantized bucket matches
-    # the XLA program bit for bit
-    share = np.zeros(len(rows), np.float32)
-    row_alloc = alloc[groups_of]  # [n, R]
-    row_req = requests[rows]
-    for r in range(n_resources):
-        a = row_alloc[:, r]
-        s = np.where(
-            a > 0,
-            row_req[:, r] / np.maximum(a, np.float32(1e-30)),
-            np.float32(np.inf),
-        ).astype(np.float32)
-        s = np.where((a <= 0) & (row_req[:, r] <= 0), np.float32(0.0), s)
-        share = np.maximum(share, s)
-    bucket_of = np.clip(
-        np.ceil(share * np.float32(buckets)).astype(np.int64), 1, buckets
-    )
-    histogram = np.bincount(
-        groups_of.astype(np.int64) * buckets + (bucket_of - 1),
-        weights=w_of,
-        minlength=n_groups * buckets,
-    ).reshape(n_groups, buckets)
+        # dominant share of each assigned pod ON ITS GROUP ONLY, f32 ops
+        # in the same order as _dominant_share so the quantized bucket
+        # matches the XLA program bit for bit
+        share = np.zeros(len(rows), np.float32)
+        row_alloc = alloc[groups_of]  # [n, R]
+        row_req = requests[rows]
+        for r in range(n_resources):
+            a = row_alloc[:, r]
+            s = np.where(
+                a > 0,
+                row_req[:, r] / np.maximum(a, np.float32(1e-30)),
+                np.float32(np.inf),
+            ).astype(np.float32)
+            s = np.where(
+                (a <= 0) & (row_req[:, r] <= 0), np.float32(0.0), s
+            )
+            share = np.maximum(share, s)
+        bucket_of = np.clip(
+            np.ceil(share * np.float32(buckets)).astype(np.int64),
+            1,
+            buckets,
+        )
+        histogram = np.bincount(
+            groups_of.astype(np.int64) * buckets + (bucket_of - 1),
+            weights=w_of,
+            minlength=n_groups * buckets,
+        ).reshape(n_groups, buckets)
+
+        # f64 demand accumulation in pod order — bitwise-identical to
+        # the native kernel's accumulation
+        demand64 = np.zeros((n_groups, n_resources), np.float64)
+        np.add.at(
+            demand64, groups_of, row_req.astype(np.float64) * w_of[:, None]
+        )
+        unsched_mask = (~any_feasible) & valid
+        if weight is None:
+            unschedulable = int(unsched_mask.sum())
+        else:
+            unschedulable = int(weight[unsched_mask].sum())
 
     nodes_needed = _shelf_bfd_np(histogram, buckets)
 
-    # LP bound: weighted demand scattered per group. f64 accumulation —
-    # strictly more accurate than the XLA program's f32 einsum; at
-    # demand/allocatable ratios above ~84 one f32 ulp exceeds the shared
-    # -1e-5 ceil guard, so the two backends may legitimately differ by
-    # +-1 there (the documented lp_bound exception)
-    demand = np.zeros((n_groups, n_resources), np.float64)
-    np.add.at(demand, groups_of, row_req.astype(np.float64) * w_of[:, None])
-    demand = demand.astype(np.float32)
+    # LP bound: f64-accumulated demand — strictly more accurate than the
+    # XLA program's f32 einsum; at demand/allocatable ratios above ~84
+    # one f32 ulp exceeds the shared -1e-5 ceil guard, so the two
+    # backends may legitimately differ by +-1 there (the documented
+    # lp_bound exception)
+    demand = demand64.astype(np.float32)
     per_resource = np.where(
         alloc > 0,
         np.ceil(
@@ -198,11 +331,6 @@ def binpack_numpy(
     )
     lp_bound = per_resource.max(axis=1).astype(np.int32)
 
-    unsched_mask = (~any_feasible) & valid
-    if weight is None:
-        unschedulable = int(unsched_mask.sum())
-    else:
-        unschedulable = int(weight[unsched_mask].sum())
     return BinPackOutputs(
         assigned=assigned,
         assigned_count=assigned_count,

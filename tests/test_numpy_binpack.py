@@ -267,3 +267,38 @@ class TestProducerFetchBranch:
             )
 
         assert run("xla") == run("numpy") == (5, 3, 0)
+
+
+class TestNativeKernel:
+    """The C kernel (native/binpack_kernel.c) and the pure-numpy stages
+    must be interchangeable: same outputs on every operand combination,
+    whichever one a host's toolchain situation selects."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_native_equals_fallback(self, seed):
+        from karpenter_tpu.native import load_kbinpack
+
+        if load_kbinpack() is None:
+            pytest.skip("no C toolchain")
+        inputs = random_inputs(
+            seed + 300, with_forbidden=(seed % 2 == 0),
+            with_score=(seed % 3 == 0),
+        )
+        assert_equal(
+            binpack_numpy(inputs, buckets=16, use_native=True),
+            binpack_numpy(inputs, buckets=16, use_native=False),
+        )
+
+    def test_native_equals_xla_with_all_operands(self):
+        from karpenter_tpu.native import load_kbinpack
+
+        if load_kbinpack() is None:
+            pytest.skip("no C toolchain")
+        inputs = random_inputs(
+            7, pods=997, taints=70, labels=70,  # >64: multi-word bitsets
+            with_forbidden=True, with_score=True,
+        )
+        assert_equal(
+            binpack_numpy(inputs, buckets=32, use_native=True),
+            binpack(inputs, buckets=32),
+        )
