@@ -370,6 +370,17 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
 def run(args, metric: str, note: str) -> None:
     import jax
 
+    if jax.default_backend() == "cpu" and args.backend in ("auto", "numpy"):
+        # block on the C kernel build HERE, before ANY dispatch (incl.
+        # --e2e/--decide) and outside every timed region — the async
+        # production path would otherwise leave early measured iterations
+        # on the numpy fallback (like jit warmup, one-time setup is
+        # excluded from the measurement)
+        from karpenter_tpu.native import load_kbinpack
+
+        if load_kbinpack() is None:
+            print("native kernel unavailable: numpy stages", file=sys.stderr)
+
     if args.decide:
         run_decide(args, metric, note)
         return
@@ -383,15 +394,6 @@ def run(args, metric: str, note: str) -> None:
         f"backend={jax.default_backend()} devices={jax.devices()}",
         file=sys.stderr,
     )
-    if jax.default_backend() == "cpu" and args.backend in ("auto", "numpy"):
-        # block on the C kernel build HERE, outside the timed region —
-        # the async-build production path would otherwise leave the first
-        # measured iterations on the numpy fallback (like jit warmup,
-        # one-time setup is excluded from the measurement)
-        from karpenter_tpu.native import load_kbinpack
-
-        if load_kbinpack() is None:
-            print("native kernel unavailable: numpy stages", file=sys.stderr)
     if args.clusters:
         inputs = build_multicluster_inputs(
             args.pods, args.clusters, args.types,

@@ -92,6 +92,7 @@ def _bind_ctypes(so: str):
 
     lib = ctypes.CDLL(so)
     lib.karpenter_assign.restype = None
+    lib.karpenter_shelf_bfd.restype = None
     return lib
 
 

@@ -30,6 +30,55 @@
 #include <stddef.h>
 #include <stdint.h>
 
+/* Shelf best-fit-decreasing over bucket histograms: the exact semantics
+ * of ops/binpack._shelf_bfd / oracle_shelf_bfd (repeated passes of
+ * "every open bin with sufficient remaining capacity takes one item,
+ * smallest remaining first"; leftovers open fresh bins). The data is
+ * tiny ([T, B+1] state) — this exists because the vectorized numpy form
+ * costs ~1000 array-op dispatches of pure interpreter overhead per
+ * solve, which dominates the degraded tick once assignment is native. */
+void karpenter_shelf_bfd(
+    long long n_groups,
+    long long buckets,
+    const long long *histogram, /* [T, B] */
+    long long *total            /* out [T], zeroed by caller */
+) {
+    for (long long t = 0; t < n_groups; t++) {
+        long long bins[buckets + 1]; /* count by remaining capacity */
+        for (long long i = 0; i <= buckets; i++) {
+            bins[i] = 0;
+        }
+        for (long long k = buckets; k >= 1; k--) {
+            long long c = histogram[t * buckets + (k - 1)];
+            while (c > 0) {
+                int placed = 0;
+                for (long long rem = k; rem <= buckets && c > 0; rem++) {
+                    long long m = bins[rem] < c ? bins[rem] : c;
+                    if (m > 0) {
+                        bins[rem] -= m;
+                        bins[rem - k] += m;
+                        c -= m;
+                        placed = 1;
+                    }
+                }
+                if (!placed) {
+                    break;
+                }
+            }
+            if (c > 0) {
+                long long per_bin = buckets / k;
+                long long full = c / per_bin;
+                long long leftover = c - full * per_bin;
+                total[t] += full + (leftover > 0 ? 1 : 0);
+                bins[buckets - per_bin * k] += full;
+                if (leftover > 0) {
+                    bins[buckets - leftover * k] += 1;
+                }
+            }
+        }
+    }
+}
+
 void karpenter_assign(
     long long n_pods,
     long long n_groups,

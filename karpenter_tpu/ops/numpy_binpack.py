@@ -154,6 +154,31 @@ def _feasibility_np(
     return fits
 
 
+# the C shelf pass keeps its per-group bin state in a stack VLA; cap the
+# bucket count it accepts so a pathological caller degrades to the numpy
+# path instead of overflowing the thread stack (production uses <= 64)
+_NATIVE_SHELF_MAX_BUCKETS = 4096
+
+
+def _shelf_bfd(histogram: np.ndarray, buckets: int, lib) -> np.ndarray:
+    """i32[T, B] -> i32[T]: the C pass when the kernel is loaded (the
+    [T, B+1] state is tiny — the numpy form pays ~1000 array-op
+    dispatches of interpreter overhead per solve), else numpy."""
+    if lib is not None and buckets <= _NATIVE_SHELF_MAX_BUCKETS:
+        import ctypes
+
+        hist = np.ascontiguousarray(histogram, np.int64)
+        total = np.zeros(histogram.shape[0], np.int64)
+        lib.karpenter_shelf_bfd(
+            ctypes.c_longlong(histogram.shape[0]),
+            ctypes.c_longlong(buckets),
+            hist.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+            total.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        )
+        return total.astype(np.int32)
+    return _shelf_bfd_np(histogram, buckets)
+
+
 def _shelf_bfd_np(histogram: np.ndarray, buckets: int) -> np.ndarray:
     """i32[T, B] -> i32[T]; the vectorized shelf best-fit-decreasing of
     ops/binpack._shelf_bfd, same pass structure, numpy state."""
@@ -313,7 +338,7 @@ def binpack_numpy(
         else:
             unschedulable = int(weight[unsched_mask].sum())
 
-    nodes_needed = _shelf_bfd_np(histogram, buckets)
+    nodes_needed = _shelf_bfd(histogram, buckets, lib)
 
     # LP bound: f64-accumulated demand — strictly more accurate than the
     # XLA program's f32 einsum; at demand/allocatable ratios above ~84
