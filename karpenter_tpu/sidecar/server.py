@@ -132,17 +132,47 @@ def main(argv=None) -> None:
         default=0,
         help="pre-compile the bin-pack at this pod count before serving",
     )
+    ap.add_argument(
+        "--multihost",
+        action="store_true",
+        help="join a multi-host jax.distributed deployment before serving "
+        "(topology from TPU pod metadata or JAX_COORDINATOR_ADDRESS/"
+        "JAX_NUM_PROCESSES/JAX_PROCESS_ID; see parallel/multihost.py)",
+    )
     args = ap.parse_args(argv)
 
-    # the sidecar exists to own the TPU, but a hung accelerator tunnel must
-    # degrade to CPU service (logged loudly), not a frozen gRPC server
-    from karpenter_tpu.utils.backend import ensure_usable_backend
+    joined = False
+    if args.multihost:
+        # the join must precede ANY backend touch (jax.distributed
+        # refuses after XLA initializes), so it runs before the probe.
+        # A configured-but-broken topology raises and kills the process
+        # — correct: N independent solvers would double-solve the fleet.
+        from karpenter_tpu.parallel.multihost import initialize_multihost
 
-    note = ensure_usable_backend()
-    if note:
-        import sys
+        joined = initialize_multihost()
+        if not joined:
+            import sys
 
-        print(f"sidecar backend: {note}", file=sys.stderr)
+            print(
+                "multihost: no topology configured; serving single-host",
+                file=sys.stderr,
+            )
+
+    if not joined:
+        # the sidecar exists to own the TPU, but a hung accelerator
+        # tunnel must degrade to CPU service (logged loudly), not a
+        # frozen gRPC server. A JOINED multihost member never takes this
+        # fallback: contributing CPU devices to a TPU fleet's global
+        # device set (or silently leaving the fleet) corrupts the mesh —
+        # a member whose accelerator is broken should crash and be
+        # rescheduled, not limp
+        from karpenter_tpu.utils.backend import ensure_usable_backend
+
+        note = ensure_usable_backend()
+        if note:
+            import sys
+
+            print(f"sidecar backend: {note}", file=sys.stderr)
 
     if args.warmup_pods:
         import jax

@@ -1,0 +1,102 @@
+"""Multi-host initialization seam (parallel/multihost.py).
+
+jax.distributed.initialize is once-per-process, so the live join runs in
+a SUBPROCESS: a 1-process CPU "fleet" joins itself as coordinator,
+builds the mesh over its global devices, and runs the certified sharded
+program — proving the deployment path (initialize -> build_mesh ->
+fleet step) composes, without multi-host hardware.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def test_single_process_fleet_joins_and_solves():
+    script = r"""
+from karpenter_tpu.utils.backend import force_virtual_cpu
+force_virtual_cpu(4)  # the one owner of the XLA_FLAGS/platform sequence
+import jax
+from karpenter_tpu.parallel.multihost import initialize_multihost
+joined = initialize_multihost(
+    coordinator_address="localhost:12399", num_processes=1, process_id=0
+)
+assert joined, "explicit 1-process topology must join"
+assert jax.process_count() == 1
+assert jax.device_count() >= 4
+# idempotent
+assert initialize_multihost() is True
+from karpenter_tpu.parallel.mesh import dryrun_fleet_step
+dryrun_fleet_step(jax.device_count())
+print("MULTIHOST-OK")
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env.pop("JAX_COORDINATOR_ADDRESS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "MULTIHOST-OK" in proc.stdout
+
+
+def test_no_topology_is_single_host_noop():
+    """Without a coordinator/env topology on a non-TPU host, the seam
+    reports False and the caller proceeds single-host. Runs in a fresh
+    subprocess: the join must precede backend initialization, and the
+    pytest process has long initialized its virtual mesh."""
+    script = r"""
+from karpenter_tpu.parallel.multihost import initialize_multihost
+assert initialize_multihost() is False
+print("NOOP-OK")
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                "JAX_PROCESS_ID"):
+        env.pop(var, None)
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "NOOP-OK" in proc.stdout
+
+
+def test_join_after_backend_init_raises_loudly():
+    """Calling the seam after XLA initialized (a caller ordering bug)
+    must raise, never be classified as 'no topology'."""
+    import jax
+    import pytest
+
+    from karpenter_tpu.parallel import multihost
+
+    jax.devices()  # deterministically initialize the in-process backend
+    multihost._initialized = False
+    with pytest.raises(RuntimeError, match="before"):
+        multihost.initialize_multihost()
+
+
+def test_partial_topology_raises(monkeypatch):
+    """A half-configured host must crash loudly, never serve single-host
+    while the rest of the fleet hangs waiting for it."""
+    import importlib
+
+    import pytest
+
+    from karpenter_tpu.parallel import multihost
+
+    importlib.reload(multihost)
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    monkeypatch.setenv("JAX_PROCESS_ID", "3")
+    with pytest.raises(ValueError, match="partial multihost topology"):
+        multihost.initialize_multihost()
