@@ -96,6 +96,7 @@ void karpenter_assign(
     const unsigned char *forbidden, /* [P, T] or NULL */
     const float *score,             /* [P, T] or NULL */
     const long long *weight,        /* [P] or NULL */
+    const unsigned char *exclusive, /* [P] or NULL: bucket forced to B */
     int32_t *assigned,              /* out [P] */
     long long *assigned_count,      /* out [T], zeroed by caller */
     long long *histogram,           /* out [T, B], zeroed by caller */
@@ -191,6 +192,10 @@ void karpenter_assign(
             bucket = 1;
         }
         if (bucket > buckets) {
+            bucket = buckets;
+        }
+        if (exclusive && exclusive[p]) {
+            /* hostname self-anti-affinity: the pod takes a whole node */
             bucket = buckets;
         }
         histogram[best * buckets + (bucket - 1)] += w_of;

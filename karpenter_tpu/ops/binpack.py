@@ -83,6 +83,16 @@ class BinPackInputs:
     # exactly the first-feasible rule. Integer-valued (weight sums
     # <= 100 x terms), so f32 comparison is exact.
     pod_group_score: Optional[jax.Array] = None
+    # bool[P]: the row's pods demand a node to themselves — required
+    # inter-pod SELF-anti-affinity on kubernetes.io/hostname ("one
+    # replica per node", the StatefulSet/daemon pattern). Encoded by
+    # forcing the row's quantized size to a FULL node (bucket = B), so
+    # shelf-BFD opens one node per weighted pod and shares it with
+    # nothing — conservative for a scale-up signal: the real scheduler
+    # could co-locate non-matching pods on those nodes, but the signal
+    # never under-counts. Feasibility/assignment are untouched. None =
+    # no exclusive rows (the common case costs nothing).
+    pod_exclusive: Optional[jax.Array] = None
 
 
 @jax.tree_util.register_dataclass
@@ -243,6 +253,11 @@ def binpack(inputs: BinPackInputs, buckets: int = DEFAULT_BUCKETS) -> BinPackOut
     bucket_of = jnp.clip(
         jnp.ceil(share * buckets).astype(jnp.int32), 1, buckets
     )  # [P, T]
+    if inputs.pod_exclusive is not None:
+        # hostname self-anti-affinity: the pod takes a whole node
+        bucket_of = jnp.where(
+            inputs.pod_exclusive[:, None], buckets, bucket_of
+        )
     # per-bucket reduction keeps peak memory at [P, T] (a [P, T, B] one-hot
     # would be ~1 GB at the 100k x 300 bench scale)
     histogram = jnp.stack(

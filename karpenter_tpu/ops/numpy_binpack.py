@@ -56,7 +56,7 @@ def _pack_bits(matrix: np.ndarray) -> np.ndarray:
 
 def _assign_native(
     lib, requests, valid, intolerant, required, alloc, taints, labels,
-    forbidden, score, weight, buckets,
+    forbidden, score, weight, exclusive, buckets,
 ):
     """One fused native pass: (assigned, assigned_count, histogram,
     demand, unschedulable). Same contract as the numpy stages it
@@ -93,6 +93,11 @@ def _assign_native(
     weight_c = (
         None if weight is None else np.ascontiguousarray(weight, np.int64)
     )
+    exclusive_c = (
+        None
+        if exclusive is None
+        else np.ascontiguousarray(exclusive, np.uint8)
+    )
     null = ctypes.POINTER(ctypes.c_float)()
     lib.karpenter_assign(
         ctypes.c_longlong(n_pods),
@@ -118,6 +123,11 @@ def _assign_native(
             ptr(weight_c, ctypes.c_longlong)
             if weight_c is not None
             else ctypes.POINTER(ctypes.c_longlong)()
+        ),
+        (
+            ptr(exclusive_c, ctypes.c_ubyte)
+            if exclusive_c is not None
+            else ctypes.POINTER(ctypes.c_ubyte)()
         ),
         ptr(assigned, ctypes.c_int32),
         ptr(assigned_count, ctypes.c_longlong),
@@ -243,6 +253,11 @@ def binpack_numpy(
         if inputs.pod_weight is None
         else _as_np(inputs.pod_weight, np.int64)
     )
+    exclusive = (
+        None
+        if inputs.pod_exclusive is None
+        else _as_np(inputs.pod_exclusive, bool)
+    )
     n_pods, n_resources = requests.shape
     n_groups = alloc.shape[0]
 
@@ -266,7 +281,7 @@ def binpack_numpy(
             unschedulable,
         ) = _assign_native(
             lib, requests, valid, intolerant, required, alloc, taints,
-            labels, forbidden, score, weight, buckets,
+            labels, forbidden, score, weight, exclusive, buckets,
         )
         assigned_count = assigned_count64.astype(np.int32)
     else:
@@ -320,6 +335,9 @@ def binpack_numpy(
             1,
             buckets,
         )
+        if exclusive is not None:
+            # hostname self-anti-affinity: the pod takes a whole node
+            bucket_of = np.where(exclusive[rows], buckets, bucket_of)
         histogram = np.bincount(
             groups_of.astype(np.int64) * buckets + (bucket_of - 1),
             weights=w_of,

@@ -54,16 +54,19 @@ def _kernel(
     labels_ref,  # f32[T, L]
     *rest,  # [forbidden_ref f32[TILE_P, T] when has_forbidden,]
     #         [score_ref f32[TILE_P, T] when has_score,]
+    #         [exclusive_ref f32[TILE_P, 1] when has_exclusive,]
     #         assigned_ref i32[TILE_P, 1], hist_ref f32[T, B],
     #         demand_ref f32[T, R]
     buckets: int,
     n_resources: int,
     has_forbidden: bool = False,
     has_score: bool = False,
+    has_exclusive: bool = False,
 ):
     rest = list(rest)
     forbidden_ref = rest.pop(0) if has_forbidden else None
     score_ref = rest.pop(0) if has_score else None
+    exclusive_ref = rest.pop(0) if has_exclusive else None
     assigned_ref, hist_ref, demand_ref = rest
     # Everything stays 2D: Mosaic lowers static row/column slices and 2D
     # broadcasts, but not the gathers that 1D intermediates / fancy
@@ -142,6 +145,9 @@ def _kernel(
     bucket = jnp.clip(
         jnp.ceil(share_assigned * buckets).astype(jnp.int32), 1, buckets
     )  # [TILE_P, 1]
+    if exclusive_ref is not None:
+        # hostname self-anti-affinity: the pod takes a whole node
+        bucket = jnp.where(exclusive_ref[:] > 0.5, buckets, bucket)
     bcol = jax.lax.broadcasted_iota(jnp.int32, (tile_p, buckets), 1)
     bucket_onehot = ((bcol == (bucket - 1)) & has).astype(
         jnp.float32
@@ -229,6 +235,7 @@ def fused_assign(
 
     has_forbidden = inputs.pod_group_forbidden is not None
     has_score = inputs.pod_group_score is not None
+    has_exclusive = inputs.pod_exclusive is not None
     operands = [req, valid, intol, required, weight, alloc_t, taints, labels]
     in_specs = [
         pl.BlockSpec(
@@ -273,6 +280,14 @@ def fused_assign(
                 (tile_p, pad_t), lambda i: (i, 0), memory_space=pltpu.VMEM
             )
         )
+    if has_exclusive:
+        # padded pod rows are 0.0 (non-exclusive) and invalid anyway
+        operands.append(pad(inputs.pod_exclusive[:, None], pad_p, 1))
+        in_specs.append(
+            pl.BlockSpec(
+                (tile_p, 1), lambda i: (i, 0), memory_space=pltpu.VMEM
+            )
+        )
 
     n_tiles = pad_p // tile_p
     grid = (n_tiles,)
@@ -284,6 +299,7 @@ def fused_assign(
             n_resources=n_resources,
             has_forbidden=has_forbidden,
             has_score=has_score,
+            has_exclusive=has_exclusive,
         ),
         grid=grid,
         in_specs=in_specs,

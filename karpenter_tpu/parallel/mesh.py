@@ -118,6 +118,7 @@ def binpack_shardings(
     with_weight: bool = False,
     with_forbidden: bool = False,
     with_score: bool = False,
+    with_exclusive: bool = False,
 ) -> BinPackInputs:
     """A BinPackInputs-shaped pytree of NamedShardings.
 
@@ -141,6 +142,7 @@ def binpack_shardings(
         pod_weight=s(rows) if with_weight else None,
         pod_group_forbidden=s(rows, AXIS_GROUPS) if with_forbidden else None,
         pod_group_score=s(rows, AXIS_GROUPS) if with_score else None,
+        pod_exclusive=s(rows) if with_exclusive else None,
     )
 
 
@@ -242,6 +244,12 @@ def pad_binpack_inputs_for_mesh(
                 ],
             )
         ),
+        pod_exclusive=(
+            None
+            if inputs.pod_exclusive is None
+            # False padding: padded rows are invalid, never bucketed
+            else pad0(inputs.pod_exclusive, P1)
+        ),
     )
 
 
@@ -275,6 +283,7 @@ def shard_binpack_inputs(mesh: Mesh, inputs: BinPackInputs) -> BinPackInputs:
             with_weight=inputs.pod_weight is not None,
             with_forbidden=inputs.pod_group_forbidden is not None,
             with_score=inputs.pod_group_score is not None,
+            with_exclusive=inputs.pod_exclusive is not None,
         ),
     )
 
@@ -418,27 +427,32 @@ def dryrun_fleet_step(n_devices: int) -> None:
     affinity), pod_group_score (preferred node affinity) — because the
     artifact must certify the program that actually ships: the affinity
     masks shard over BOTH mesh axes, exactly the case worth proving
-    (VERDICT r2 item 3). When the device count allows, the same program
-    is re-certified on a 3D slice×pods×groups mesh (the multi-slice
-    deployment shape, one cross-slice reduction on DCN).
+    (VERDICT r2 item 3) — plus pod_exclusive (hostname self-anti-
+    affinity). P=33 is deliberately NOT a multiple of any mesh row
+    extent, so pad_binpack_inputs_for_mesh runs and a padding path that
+    dropped an optional operand would break the equality below. When
+    the device count allows, the same program is re-certified on a 3D
+    slice×pods×groups mesh (the multi-slice deployment shape, one
+    cross-slice reduction on DCN).
     """
     import dataclasses
 
     rng = np.random.default_rng(7)
-    weights = np.ones(32, np.int32)
-    weights[:4] = 5  # a few multiplied shape rows: 48 pods in 32 rows
+    weights = np.ones(33, np.int32)
+    weights[:4] = 5  # a few multiplied shape rows: 49 pods in 33 rows
     d_ref_in = example_decision_inputs(N=16, M=4)
     b_ref_in = dataclasses.replace(
-        example_binpack_inputs(P_=32, T=8, K=8, L=8),
+        example_binpack_inputs(P_=33, T=8, K=8, L=8),
         pod_weight=jnp.asarray(weights),
-        pod_group_forbidden=jnp.asarray(rng.random((32, 8)) < 0.3),
+        pod_group_forbidden=jnp.asarray(rng.random((33, 8)) < 0.3),
         pod_group_score=jnp.asarray(
-            rng.integers(0, 100, (32, 8)).astype(np.float32)
+            rng.integers(0, 100, (33, 8)).astype(np.float32)
         ),
+        pod_exclusive=jnp.asarray(rng.random(33) < 0.25),
     )
     # single-device reference: same jitted program, no mesh
     d_ref, b_ref = jax.device_get(fleet_step(d_ref_in, b_ref_in, buckets=8))
-    assert int(np.sum(b_ref.assigned_count)) + int(b_ref.unschedulable) == 48
+    assert int(np.sum(b_ref.assigned_count)) + int(b_ref.unschedulable) == 49
     assert d_ref.desired.shape[0] == 16
 
     meshes = [build_mesh(n_devices=n_devices)]
@@ -449,7 +463,7 @@ def dryrun_fleet_step(n_devices: int) -> None:
         b_in = shard_binpack_inputs(mesh, b_ref_in)
         d_out, b_out = jax.device_get(fleet_step(d_in, b_in, buckets=8))
         # sharded == single-device, bitwise, after stripping mesh padding
-        np.testing.assert_array_equal(b_out.assigned[:32], b_ref.assigned)
+        np.testing.assert_array_equal(b_out.assigned[:33], b_ref.assigned)
         np.testing.assert_array_equal(
             b_out.assigned_count[:8], b_ref.assigned_count
         )

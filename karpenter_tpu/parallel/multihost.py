@@ -91,14 +91,21 @@ def initialize_multihost(
         try:
             jax.distributed.initialize()
         except Exception as e:  # noqa: BLE001 — classified below
-            message = str(e)
-            if "coordinator_address" in message or "auto" in message.lower():
-                # jax's "please provide a coordinator / no cluster
-                # detected" family: the normal single-host case
+            # EXACT sentinel only: jax's cluster auto-detection found no
+            # cluster and fell through to the bare-args validation
+            # (jax._src.distributed raises RuntimeError
+            # 'coordinator_address should be defined.'). Anything else —
+            # a detected-but-unreachable coordinator, a partial
+            # detection, 'must be called before any JAX calls' (an
+            # ordering bug in the caller) — is a REAL failure and
+            # raises: degrading a detected multi-host fleet to N
+            # independent solvers would double-solve the fleet while
+            # the other hosts hang in initialize. Substring matching
+            # here once misread real join failures (r3 code review).
+            if str(e).strip() == "coordinator_address should be defined.":
+                # the normal single-host case
                 logger().info("no multihost topology detected: %s", e)
                 return False
-            # anything else (incl. "must be called before any JAX
-            # calls": an ordering bug in the caller) is a real failure
             raise
         _initialized = True
     else:

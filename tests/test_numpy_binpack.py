@@ -29,6 +29,7 @@ def random_inputs(
     with_weight=True,
     with_forbidden=False,
     with_score=False,
+    with_exclusive=False,
 ):
     rng = np.random.default_rng(seed)
     inputs = BinPackInputs(
@@ -57,6 +58,9 @@ def random_inputs(
             rng.integers(0, 100, (pods, groups)).astype(np.float32)
             if with_score
             else None
+        ),
+        pod_exclusive=(
+            rng.random(pods) < 0.3 if with_exclusive else None
         ),
     )
     return inputs
@@ -95,6 +99,29 @@ class TestEquality:
         assert_equal(
             binpack_numpy(inputs, buckets=16), binpack(inputs, buckets=16)
         )
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_exclusive_rows(self, seed):
+        """pod_exclusive (hostname self-anti-affinity) forces bucket=B
+        identically in both backends, alone and with every other
+        operand."""
+        inputs = random_inputs(
+            seed + 400,
+            with_exclusive=True,
+            with_forbidden=(seed % 2 == 0),
+            with_score=(seed % 3 == 0),
+        )
+        assert_equal(
+            binpack_numpy(inputs, buckets=16), binpack(inputs, buckets=16)
+        )
+        # semantics: a group's node count covers its exclusive weight
+        out = binpack(inputs, buckets=16)
+        assigned = np.asarray(out.assigned)
+        excl = np.asarray(inputs.pod_exclusive)
+        w = np.asarray(inputs.pod_weight)
+        for t in range(inputs.group_allocatable.shape[0]):
+            rows = (assigned == t) & excl
+            assert int(out.nodes_needed[t]) >= int(w[rows].sum())
 
     @pytest.mark.parametrize("seed", range(6))
     def test_unweighted_and_forbidden_only(self, seed):
@@ -282,7 +309,7 @@ class TestNativeKernel:
             pytest.skip("no C toolchain")
         inputs = random_inputs(
             seed + 300, with_forbidden=(seed % 2 == 0),
-            with_score=(seed % 3 == 0),
+            with_score=(seed % 3 == 0), with_exclusive=(seed % 2 == 1),
         )
         assert_equal(
             binpack_numpy(inputs, buckets=16, use_native=True),
@@ -296,7 +323,7 @@ class TestNativeKernel:
             pytest.skip("no C toolchain")
         inputs = random_inputs(
             7, pods=997, taints=70, labels=70,  # >64: multi-word bitsets
-            with_forbidden=True, with_score=True,
+            with_forbidden=True, with_score=True, with_exclusive=True,
         )
         assert_equal(
             binpack_numpy(inputs, buckets=32, use_native=True),

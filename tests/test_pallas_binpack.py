@@ -152,6 +152,48 @@ class TestPallasMatchesXLA:
         )
         assert not np.array_equal(np.asarray(free.assigned), assigned)
 
+    @pytest.mark.parametrize("seed", range(3))
+    def test_exclusive_parity(self, seed):
+        """pod_exclusive (hostname self-anti-affinity: a pod takes a
+        whole node) forces bucket=B identically in both backends, and a
+        group's node count always covers its exclusive weight."""
+        import dataclasses
+
+        rng = np.random.default_rng(300 + seed)
+        inputs = dataclasses.replace(
+            random_inputs(rng, pods=203, types=37),
+            pod_exclusive=jnp.asarray(rng.random(203) < 0.3),
+            pod_weight=jnp.asarray(rng.integers(1, 40, 203).astype(np.int32)),
+        )
+        xla = B.binpack(inputs, buckets=16)
+        pallas = PB.binpack_pallas(
+            inputs, buckets=16, tile_p=64, interpret=True
+        )
+        assert_outputs_equal(xla, pallas)
+        assigned = np.asarray(xla.assigned)
+        excl = np.asarray(inputs.pod_exclusive)
+        w = np.asarray(inputs.pod_weight)
+        for t in range(37):
+            assert int(xla.nodes_needed[t]) >= int(
+                w[(assigned == t) & excl].sum()
+            )
+        # the flag changes packing (same assignment, more nodes) on at
+        # least one group vs the unconstrained solve
+        free = B.binpack(
+            dataclasses.replace(inputs, pod_exclusive=None), buckets=16
+        )
+        np.testing.assert_array_equal(
+            np.asarray(free.assigned), assigned
+        )  # feasibility/assignment untouched
+        assert (
+            np.asarray(xla.nodes_needed) >= np.asarray(free.nodes_needed)
+        ).all()
+        # and the flag is not a silent no-op: 30% exclusive of 203
+        # weighted rows must strictly raise some group's node count
+        assert (
+            np.asarray(xla.nodes_needed) > np.asarray(free.nodes_needed)
+        ).any()
+
     def test_semantics_taints_and_labels(self):
         # group 0 tainted (pod 0 intolerant); group 1 lacks pod 1's label
         inputs = make_inputs(
@@ -280,6 +322,25 @@ class TestCompiledMosaic:
         inputs = dataclasses.replace(
             random_inputs(rng, pods=512, types=24),
             pod_group_forbidden=jnp.asarray(rng.random((512, 24)) < 0.3),
+            pod_weight=jnp.asarray(
+                rng.integers(1000, 5000, 512).astype(np.int32)
+            ),
+        )
+        xla = B.binpack(inputs, buckets=16)
+        pallas = PB.binpack_pallas(
+            inputs, buckets=16, tile_p=128, interpret=False
+        )
+        assert_outputs_equal(xla, pallas)
+
+    def test_compiled_exclusive_equals_xla_on_tpu(self):
+        """The hostname self-anti-affinity flag compiles through Mosaic
+        (one [TILE_P, 1] VMEM operand) and matches XLA on hardware."""
+        import dataclasses
+
+        rng = np.random.default_rng(10)
+        inputs = dataclasses.replace(
+            random_inputs(rng, pods=512, types=24),
+            pod_exclusive=jnp.asarray(rng.random(512) < 0.3),
             pod_weight=jnp.asarray(
                 rng.integers(1000, 5000, 512).astype(np.int32)
             ),
