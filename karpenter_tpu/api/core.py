@@ -124,8 +124,80 @@ class NodeAffinity:
 
 
 @dataclass(slots=True)
+class LabelSelector:
+    """metav1.LabelSelector: matchLabels AND matchExpressions (In/NotIn/
+    Exists/DoesNotExist over LABEL values — no Gt/Lt here, matching the
+    k8s API). An empty selector matches everything; None (field absent)
+    matches nothing in the affinity contexts that use it."""
+
+    match_labels: Dict[str, str] = field(default_factory=dict)
+    match_expressions: List[NodeSelectorRequirement] = field(
+        default_factory=list
+    )
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        if any(labels.get(k) != v for k, v in self.match_labels.items()):
+            return False
+        for e in self.match_expressions:
+            if e.operator in ("Gt", "Lt"):
+                return False  # invalid in label selectors: never matches
+            if not _requirement_matches(labels, e.key, e.operator, e.values):
+                return False
+        return True
+
+
+@dataclass(slots=True)
+class PodAffinityTerm:
+    """core/v1 PodAffinityTerm: pods matching label_selector in the
+    namespace scope, co-/anti-located by topology_key. namespaces=[]
+    means the pod's own namespace (the k8s default); namespace_selector
+    is decoded for fidelity but only the own-namespace case can be
+    SELF-matching (see anti_affinity_shape)."""
+
+    label_selector: Optional[LabelSelector] = None
+    topology_key: str = ""
+    namespaces: List[str] = field(default_factory=list)
+    namespace_selector: Optional[LabelSelector] = None
+
+
+@dataclass(slots=True)
+class WeightedPodAffinityTerm:
+    weight: int = 1
+    pod_affinity_term: PodAffinityTerm = field(
+        default_factory=PodAffinityTerm
+    )
+
+
+@dataclass(slots=True)
+class PodAntiAffinity:
+    required_during_scheduling_ignored_during_execution: List[
+        PodAffinityTerm
+    ] = field(default_factory=list)
+    # soft anti-affinity is a scheduler preference, decoded not modeled
+    preferred_during_scheduling_ignored_during_execution: List[
+        WeightedPodAffinityTerm
+    ] = field(default_factory=list)
+
+
+@dataclass(slots=True)
+class PodAffinity:
+    required_during_scheduling_ignored_during_execution: List[
+        PodAffinityTerm
+    ] = field(default_factory=list)
+    preferred_during_scheduling_ignored_during_execution: List[
+        WeightedPodAffinityTerm
+    ] = field(default_factory=list)
+
+
+@dataclass(slots=True)
 class Affinity:
     node_affinity: Optional[NodeAffinity] = None
+    # inter-pod (anti-)affinity: the SELF-matching required slice is
+    # modeled by the solver (anti_affinity_shape below); selectors over
+    # OTHER pods' labels need pairwise pod state and are decoded for
+    # fidelity only (docs/OPERATIONS.md 'Scheduling fidelity')
+    pod_affinity: Optional[PodAffinity] = None
+    pod_anti_affinity: Optional[PodAntiAffinity] = None
 
 
 @dataclass(slots=True)
@@ -187,6 +259,134 @@ def spread_shape(constraints: Optional[list]) -> tuple:
     return tuple(
         (key, skew, min_domains)
         for key, (skew, min_domains) in sorted(binding.items())
+    )
+
+
+def _self_matching_terms(
+    terms: list, labels: Dict[str, str], namespace: str
+) -> list:
+    """The PodAffinityTerms whose selector matches the POD'S OWN labels
+    with the pod's own namespace in scope — the replica-spread /
+    replica-co-location pattern, the only inter-pod slice a group-level
+    scale-up signal can honor without pairwise pod state. A term with a
+    namespace_selector, or namespaces excluding the pod's own, can match
+    only OTHER pods and is out of model scope."""
+    out = []
+    for term in terms:
+        if term.label_selector is None or not term.topology_key:
+            continue
+        if term.namespace_selector is not None:
+            continue
+        if term.namespaces and namespace not in term.namespaces:
+            continue
+        if term.label_selector.matches(labels):
+            out.append(term)
+    return out
+
+
+def pod_affinity_shape(
+    affinity: Optional[Affinity],
+    labels: Dict[str, str],
+    namespace: str,
+) -> tuple:
+    """Canonical hashable form of a pod's REQUIRED inter-pod
+    (anti-)affinity, restricted to the SELF-matching slice the solver
+    models (docs/OPERATIONS.md 'Scheduling fidelity'):
+
+    - anti-affinity on kubernetes.io/hostname -> one replica per node
+      (the pod_exclusive solver operand);
+    - anti-affinity on zone/region-like keys -> at most one replica per
+      topology domain (per-domain cap-1 row expansion);
+    - affinity (co-location) on non-hostname keys -> all replicas in
+      ONE domain: groups must expose the key single-valued, and the
+      solver's whole-row-to-one-group assignment provides the rest.
+      hostname co-location (all replicas on one NODE) cannot be
+      promised by a group-level pack and stays out of scope.
+
+    Returns () when unconstrained, else
+    (hostname_exclusive, anti_keys, co_keys, ident) where ident is the
+    WORKLOAD IDENTITY: the pod's namespace plus the canonical forms of
+    the self-matching domain-relevant selectors. Two pods share an
+    anti-group iff they match each other's selectors; replicas of one
+    workload share the selector even when their LABELS differ per pod
+    (StatefulSets stamp statefulset.kubernetes.io/pod-name on each
+    replica — raw labels would fragment the group, r3 code review), and
+    two workloads whose pods all match one selector genuinely are one
+    mutual anti-group. Preferred (soft) terms and selectors over other
+    pods' labels are decoded, never constrained.
+    """
+    if affinity is None:
+        return ()
+    anti = affinity.pod_anti_affinity
+    co = affinity.pod_affinity
+    anti_terms = (
+        _self_matching_terms(
+            anti.required_during_scheduling_ignored_during_execution,
+            labels,
+            namespace,
+        )
+        if anti is not None
+        else []
+    )
+    co_terms = (
+        _self_matching_terms(
+            co.required_during_scheduling_ignored_during_execution,
+            labels,
+            namespace,
+        )
+        if co is not None
+        else []
+    )
+    hostname_exclusive = any(
+        t.topology_key == HOSTNAME_TOPOLOGY_KEY for t in anti_terms
+    )
+    anti_keys = _domain_keys(anti_terms)
+    co_keys = _domain_keys(co_terms)
+    if not hostname_exclusive and not anti_keys and not co_keys:
+        return ()
+    ident = (
+        (
+            namespace,
+            tuple(
+                sorted(
+                    {
+                        _selector_form(t.label_selector)
+                        for t in (*anti_terms, *co_terms)
+                        if t.topology_key != HOSTNAME_TOPOLOGY_KEY
+                    }
+                )
+            ),
+        )
+        if anti_keys or co_keys
+        else ()
+    )
+    return (int(hostname_exclusive), anti_keys, co_keys, ident)
+
+
+def _domain_keys(terms: list) -> tuple:
+    """Sorted distinct non-hostname topology keys of PodAffinityTerms."""
+    return tuple(
+        sorted(
+            {
+                t.topology_key
+                for t in terms
+                if t.topology_key != HOSTNAME_TOPOLOGY_KEY
+            }
+        )
+    )
+
+
+def _selector_form(sel: "LabelSelector") -> tuple:
+    """Canonical hashable form of a label selector — the workload
+    identity unit for pod_affinity_shape's ident."""
+    return (
+        tuple(sorted(sel.match_labels.items())),
+        tuple(
+            sorted(
+                (e.key, e.operator, tuple(sorted(e.values)))
+                for e in sel.match_expressions
+            )
+        ),
     )
 
 

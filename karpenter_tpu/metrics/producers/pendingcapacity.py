@@ -30,6 +30,7 @@ lp_lower_bound,unschedulable_pods}{name,namespace}.
 
 from __future__ import annotations
 
+import collections
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -393,6 +394,12 @@ def _dedup_rows(snap):
                 .view(np.uint8)
                 .reshape(n, -1)
             )
+        if snap.anti_id is not None:
+            parts.append(
+                np.ascontiguousarray(snap.anti_id[idx])
+                .view(np.uint8)
+                .reshape(n, -1)
+            )
         rows = np.ascontiguousarray(np.concatenate(parts, axis=1))
         return rows.view([("k", np.void, rows.shape[1])]).ravel()
 
@@ -441,7 +448,23 @@ def _expand_spread_rows(snap, profiles, row_idx, row_weight, label_dicts_fn):  #
 
     n_groups = len(profiles)
     label_dicts = label_dicts_fn()
-    live_ids = snap.spread_id[row_idx]
+    live_ids = snap.spread_id[row_idx].copy()
+    # rows whose self-anti-affinity carries a domain key are split
+    # 1-per-domain by _expand_anti_rows — the most balanced placement a
+    # topology key admits, so a second spread split would double-place
+    # the weight; the spread keys still contribute key-presence
+    # exclusion through the anti mask (docs/OPERATIONS.md)
+    if snap.anti_id is not None and snap.anti_shapes is not None:
+        anti_live = snap.anti_id[row_idx]
+        domain_capped = np.array(
+            [
+                bool(snap.anti_shapes[a]) and bool(snap.anti_shapes[a][1])
+                for a in anti_live
+            ]
+        )
+        live_ids[domain_capped] = 0
+        if not (live_ids != 0).any():
+            return row_idx, row_weight, None
 
     # per live shape: (ordered domain group-lists, maxSkew, minDomains)
     plan: Dict[int, tuple] = {}
@@ -518,6 +541,207 @@ def _expand_spread_rows(snap, profiles, row_idx, row_weight, label_dicts_fn):  #
     )
 
 
+def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each guard is a documented anti-affinity rule
+    snap, profiles, row_idx, row_weight, prior_forbidden, label_dicts_fn
+):
+    """Required inter-pod SELF-(anti-)affinity (api/core.pod_affinity_shape):
+
+    - hostname anti-affinity marks the row EXCLUSIVE (one pod per node,
+      the ops/binpack.py pod_exclusive operand);
+    - domain anti-affinity (zone/region keys) caps the workload at ONE
+      pod per topology domain OF EVERY KEY: eligible groups bucket by
+      combined key values and a greedy pass selects domains no two of
+      which share any key's value; the row splits into weight-1
+      sub-rows, each masked to one selected domain's groups, the
+      excess reported unschedulable. Rows sharing an anti shape (same
+      workload identity — the canonical self-matching selector, so
+      StatefulSet per-pod labels don't fragment it) draw from one
+      shared domain sequence, so a workload split across
+      request-distinct rows (e.g. mid-VPA-rollout) still never doubles
+      up a domain;
+    - co-location affinity keys exclude groups missing the key (group
+      profiles hold the label INTERSECTION, so a group spanning domain
+      values drops the key and is excluded). Combined with domain
+      anti-affinity, ALL the workload's sub-rows pin to the single co
+      bucket offering the most anti domains (independent per-domain
+      assignment could split replicas across co domains the scheduler
+      forces together). Co-location alone: the solver's whole-row-to-
+      one-group assignment keeps a single-row workload in one domain;
+      a workload split across request-distinct rows pins to one
+      deterministic co bucket.
+
+    A domain is a distinct topologyKey value among group-label
+    intersections, exactly the _expand_spread_rows rule; a row with both
+    hard spread and domain anti-affinity is split by the anti rule (the
+    most balanced placement possible — spread's split is skipped, see
+    _expand_spread_rows) while its spread keys contribute key-presence
+    exclusion here. Conservative throughout: the signal may report more
+    unschedulable or spread wider than a legal placement, never claim
+    feasibility the kube-scheduler would deny for the modeled slice
+    (docs/OPERATIONS.md 'Scheduling fidelity').
+
+    prior_forbidden (the spread expansion's per-row mask, aligned with
+    the INPUT rows) is carried through the re-expansion: every output
+    row inherits its source row's mask OR'd with the anti exclusions.
+
+    Returns (row_idx, row_weight, forbidden[rows, T]-or-None,
+    exclusive[rows]-or-None); unconstrained snapshots pass untouched.
+    """
+    shapes = snap.anti_shapes
+    if (
+        len(row_idx) == 0
+        or snap.anti_id is None
+        or shapes is None
+        or not (snap.anti_id[row_idx] != 0).any()
+    ):
+        return row_idx, row_weight, prior_forbidden, None
+
+    n_groups = len(profiles)
+    label_dicts = label_dicts_fn()
+    live_ids = snap.anti_id[row_idx]
+    spread_shapes = snap.spread_shapes
+    live_spread = (
+        snap.spread_id[row_idx] if snap.spread_id is not None else None
+    )
+
+    # per live anti shape: (ordered domain group-lists or None,
+    # key-exclusion mask, hostname_exclusive); the domain iterator is
+    # SHARED across rows with the same shape via next_domain
+    sid_rows = collections.Counter(int(s) for s in live_ids)
+    plan: Dict[int, tuple] = {}
+    next_domain: Dict[int, int] = {}
+    for s in np.unique(live_ids):
+        shape = shapes[s]
+        if not shape:
+            continue
+        hostname_excl, anti_keys, co_keys, _ident = shape
+        need_keys = [*anti_keys, *co_keys]
+        excluded = np.zeros(n_groups, bool)
+        for t, labels in enumerate(label_dicts):
+            if any(key not in labels for key in need_keys):
+                excluded[t] = True
+        domains = None
+        if anti_keys:
+            # Combined-value accounting so EVERY key's cap holds (a
+            # first-key-only split can put two replicas in one domain
+            # of a coarser key, r3 code review): eligible groups bucket
+            # by (co-key values, anti-key values); within each co
+            # bucket, greedily select anti domains such that no two
+            # share ANY key's value; the co bucket with the most
+            # selected domains wins — the workload's co-location keys
+            # pin ALL its replicas to that one bucket (a per-domain
+            # independent assignment could split replicas across co
+            # domains the scheduler forces together). Deterministic:
+            # sorted iteration, count-then-lexicographic choice.
+            buckets: Dict[tuple, Dict[tuple, list]] = {}
+            for t, labels in enumerate(label_dicts):
+                if excluded[t]:
+                    continue
+                co_vec = tuple(labels[k] for k in co_keys)
+                anti_vec = tuple(labels[k] for k in anti_keys)
+                buckets.setdefault(co_vec, {}).setdefault(
+                    anti_vec, []
+                ).append(t)
+            best: Optional[tuple] = None
+            for co_vec in sorted(buckets):
+                used: List[set] = [set() for _ in anti_keys]
+                selected = []
+                for anti_vec in sorted(buckets[co_vec]):
+                    if any(
+                        value in used[i]
+                        for i, value in enumerate(anti_vec)
+                    ):
+                        continue
+                    for i, value in enumerate(anti_vec):
+                        used[i].add(value)
+                    selected.append(buckets[co_vec][anti_vec])
+                if best is None or len(selected) > len(best[1]):
+                    best = (co_vec, selected)
+            domains = best[1] if best is not None else []
+        elif co_keys and sid_rows[int(s)] > 1:
+            # co-location-only workload split across request-distinct
+            # rows (mid-VPA): whole-row-to-one-group no longer pins ONE
+            # domain, so pin all the workload's rows to a single
+            # deterministic co bucket (lexicographically first among
+            # eligible); single-row workloads keep full group freedom
+            co_vecs: Dict[tuple, list] = {}
+            for t, labels in enumerate(label_dicts):
+                if not excluded[t]:
+                    co_vecs.setdefault(
+                        tuple(labels[k] for k in co_keys), []
+                    ).append(t)
+            if co_vecs:
+                chosen = set(co_vecs[min(co_vecs)])
+                excluded = excluded.copy()
+                for t in range(n_groups):
+                    if t not in chosen:
+                        excluded[t] = True
+        plan[int(s)] = (domains, excluded, bool(hostname_excl))
+        next_domain[int(s)] = 0
+
+    out_idx, out_weight, out_forbidden, out_exclusive = [], [], [], []
+    for i, sid in enumerate(live_ids):
+        prior = (
+            prior_forbidden[i]
+            if prior_forbidden is not None
+            else np.zeros(n_groups, bool)
+        )
+        entry = plan.get(int(sid))
+        if entry is None:
+            out_idx.append(row_idx[i])
+            out_weight.append(row_weight[i])
+            out_forbidden.append(prior)
+            out_exclusive.append(False)
+            continue
+        domains, excluded, hostname_excl = entry
+        excluded = excluded | prior
+        # spread keys of a domain-capped row: key-presence exclusion
+        # (the spread SPLIT was skipped in favor of the anti split)
+        if (
+            domains is not None
+            and live_spread is not None
+            and live_spread[i] != 0
+            and spread_shapes is not None
+        ):
+            # excluded is already a fresh per-row array (| prior above)
+            for key, _skew, _mind in spread_shapes[live_spread[i]]:
+                for t, labels in enumerate(label_dicts):
+                    if key not in labels:
+                        excluded[t] = True
+        weight = int(row_weight[i])
+        if domains is None:
+            # hostname/co-location only: no split, mask + flag ride along
+            out_idx.append(row_idx[i])
+            out_weight.append(row_weight[i])
+            out_forbidden.append(excluded)
+            out_exclusive.append(hostname_excl)
+            continue
+        start = next_domain[int(sid)]
+        take = min(weight, max(0, len(domains) - start))
+        next_domain[int(sid)] = start + take
+        for rank in range(start, start + take):
+            forbidden = np.ones(n_groups, bool)
+            forbidden[domains[rank]] = False
+            forbidden |= excluded
+            out_idx.append(row_idx[i])
+            out_weight.append(np.int32(1))
+            out_forbidden.append(forbidden)
+            out_exclusive.append(hostname_excl)
+        if weight > take:
+            # beyond the domain count: unschedulable by anti-affinity —
+            # keep the excess as a forbidden-everywhere row so it COUNTS
+            out_idx.append(row_idx[i])
+            out_weight.append(np.int32(weight - take))
+            out_forbidden.append(np.ones(n_groups, bool))
+            out_exclusive.append(hostname_excl)
+    return (
+        np.asarray(out_idx, np.intp),
+        np.asarray(out_weight, np.int32),
+        np.stack(out_forbidden) if out_forbidden else None,
+        np.asarray(out_exclusive, bool),
+    )
+
+
 def _encode_from_cache(snap, profiles, with_rows: bool = False):  # lint: allow-complexity — THE single encoder; splitting would smear the output-equality invariant
     """Snapshot (store/columnar.PendingSnapshot) -> solver inputs, with
     rows DEDUPLICATED into distinct pod shapes + multiplicities
@@ -548,6 +772,15 @@ def _encode_from_cache(snap, profiles, with_rows: bool = False):  # lint: allow-
     # unchanged, spread rides the existing forbidden-mask operand
     row_idx, row_weight, spread_forbidden = _expand_spread_rows(
         snap, profiles, row_idx, row_weight, group_label_dicts
+    )
+    # required self pod-(anti-)affinity: hostname rows flag the
+    # pod_exclusive operand, domain keys cap one replica per domain
+    # (further sub-row expansion; the spread mask rides through)
+    row_idx, row_weight, spread_forbidden, row_exclusive = (
+        _expand_anti_rows(
+            snap, profiles, row_idx, row_weight, spread_forbidden,
+            group_label_dicts,
+        )
     )
     hi = len(row_idx)
 
@@ -641,14 +874,21 @@ def _encode_from_cache(snap, profiles, with_rows: bool = False):  # lint: allow-
         pod_group_forbidden = np.zeros((n_pods, n_groups), bool)
         pod_group_forbidden[:hi] = ~allowed[live_affinity_ids]
 
-    # Topology spread: OR the per-sub-row domain masks into the same
-    # forbidden operand the affinity path uses (padding groups are
-    # all-zero allocatable and already infeasible, so mask width T_real
-    # suffices)
+    # Topology spread + self pod-(anti-)affinity: OR the per-sub-row
+    # masks into the same forbidden operand the affinity path uses
+    # (padding groups are all-zero allocatable and already infeasible,
+    # so mask width T_real suffices)
     if spread_forbidden is not None:
         if pod_group_forbidden is None:
             pod_group_forbidden = np.zeros((n_pods, n_groups), bool)
         pod_group_forbidden[:hi, : len(profiles)] |= spread_forbidden
+
+    # hostname self-anti-affinity rows take a whole node each — absent
+    # unless some live pod actually carries the constraint
+    pod_exclusive = None
+    if row_exclusive is not None and row_exclusive.any():
+        pod_exclusive = np.zeros(n_pods, bool)
+        pod_exclusive[:hi] = row_exclusive
 
     # Preferred node affinity: same distinct-shape host evaluation, but
     # the verdicts are weight-sums steering assignment among feasible
@@ -683,6 +923,7 @@ def _encode_from_cache(snap, profiles, with_rows: bool = False):  # lint: allow-
         pod_weight=pod_weight,
         pod_group_forbidden=pod_group_forbidden,
         pod_group_score=pod_group_score,
+        pod_exclusive=pod_exclusive,
     )
     if with_rows:
         # the simulation API maps per-row solver outputs back to pods:

@@ -44,6 +44,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from karpenter_tpu.api.core import affinity_shape as _affinity_shape
+from karpenter_tpu.api.core import pod_affinity_shape as _pod_affinity_shape
 from karpenter_tpu.api.core import preferred_shape as _preferred_shape
 from karpenter_tpu.api.core import spread_shape as _spread_shape
 from karpenter_tpu.store.store import DELETED, Store
@@ -87,6 +88,7 @@ class _SparsePod:
     affinity: tuple = ()  # canonical required-node-affinity shape
     preferred: tuple = ()  # canonical preferred-node-affinity shape
     spread: tuple = ()  # canonical hard topology-spread shape
+    anti: tuple = ()  # canonical self pod-(anti-)affinity shape
 
 
 class PendingPodCache:
@@ -128,6 +130,9 @@ class PendingPodCache:
         # hard topology-spread shapes (api/core.spread_shape)
         self._spread_shapes: List[tuple] = [()]
         self._spread_index: Dict[tuple, int] = {(): 0}
+        # self pod-(anti-)affinity shapes (api/core.pod_affinity_shape)
+        self._anti_shapes: List[tuple] = [()]
+        self._anti_index: Dict[tuple, int] = {(): 0}
         # incremental shape-dedup: canonical pod key -> live slots with that
         # key. Maintained at event time so snapshot() emits (rep row,
         # multiplicity) pairs in O(distinct shapes) — the per-tick
@@ -144,6 +149,7 @@ class PendingPodCache:
         self._affinity_id = np.zeros(capacity, np.int32)
         self._preferred_id = np.zeros(capacity, np.int32)
         self._spread_id = np.zeros(capacity, np.int32)
+        self._anti_id = np.zeros(capacity, np.int32)
         self._valid = np.zeros(capacity, bool)
 
         self._slot: Dict[Tuple[str, str], int] = {}
@@ -173,6 +179,7 @@ class PendingPodCache:
         self._affinity_id[slot] = 0
         self._preferred_id[slot] = 0
         self._spread_id[slot] = 0
+        self._anti_id[slot] = 0
         self._sparse.pop(slot, None)
         self._dedup_discard(slot)
         self._free.append(slot)
@@ -209,6 +216,11 @@ class PendingPodCache:
             affinity=_affinity_shape(pod.spec.affinity),
             preferred=_preferred_shape(pod.spec.affinity),
             spread=_spread_shape(pod.spec.topology_spread_constraints),
+            anti=_pod_affinity_shape(
+                pod.spec.affinity,
+                pod.metadata.labels,
+                pod.metadata.namespace,
+            ),
         )
         slot = self._slot.get(key)
         if slot is None:
@@ -253,6 +265,12 @@ class PendingPodCache:
             self._spread_index[sparse.spread] = spread_id
             self._spread_shapes.append(sparse.spread)
         self._spread_id[slot] = spread_id
+        anti_id = self._anti_index.get(sparse.anti)
+        if anti_id is None:
+            anti_id = len(self._anti_shapes)
+            self._anti_index[sparse.anti] = anti_id
+            self._anti_shapes.append(sparse.anti)
+        self._anti_id[slot] = anti_id
         self._valid[slot] = True
         self._sparse[slot] = sparse
         # dedup maintenance: two slots share a key iff their canonical
@@ -267,6 +285,7 @@ class PendingPodCache:
             sparse.affinity,
             sparse.preferred,
             sparse.spread,
+            sparse.anti,
         )
         if self._slot_key.get(slot) != dedup_key:
             self._dedup_discard(slot)
@@ -287,6 +306,7 @@ class PendingPodCache:
             (self._affinity_shapes, self._affinity_id),
             (self._preferred_shapes, self._preferred_id),
             (self._spread_shapes, self._spread_id),
+            (self._anti_shapes, self._anti_id),
         ):
             if len(registry) >= _COMPACT_FLOOR:
                 live_ids = len(
@@ -331,6 +351,7 @@ class PendingPodCache:
             self._affinity_id = self._grow_rows(self._affinity_id)
             self._preferred_id = self._grow_rows(self._preferred_id)
             self._spread_id = self._grow_rows(self._spread_id)
+            self._anti_id = self._grow_rows(self._anti_id)
             self._valid = self._grow_rows(self._valid)
         slot = self._hi
         self._hi += 1
@@ -415,6 +436,8 @@ class PendingPodCache:
                 preferred_shapes=list(self._preferred_shapes),
                 spread_id=self._spread_id[:hi].copy(),
                 spread_shapes=list(self._spread_shapes),
+                anti_id=self._anti_id[:hi].copy(),
+                anti_shapes=list(self._anti_shapes),
             )
             self._snap_memo = (self._generation, snap)
             return snap
@@ -691,3 +714,6 @@ class PendingSnapshot:                        # no 100k-row reprs in logs
     # hard topology spread (api/core.spread_shape; id 0 = unconstrained)
     spread_id: Optional[np.ndarray] = None
     spread_shapes: Optional[List[tuple]] = None
+    # self pod-(anti-)affinity (api/core.pod_affinity_shape; id 0 = none)
+    anti_id: Optional[np.ndarray] = None
+    anti_shapes: Optional[List[tuple]] = None

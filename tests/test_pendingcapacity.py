@@ -894,3 +894,400 @@ class TestTopologySpread:
                 }
             )
         assert results[0] == results[1]
+
+
+def anti_pod(name, keys=("kubernetes.io/hostname",), labels=None,
+             cpu="1", self_match=True, co_keys=(), selector_labels=None):
+    """A pod with required podAntiAffinity (and optionally podAffinity)
+    whose selector matches its own labels (self_match) or a foreign app.
+    selector_labels narrows the selector to a subset of the labels (the
+    StatefulSet shape: shared selector, per-pod extra labels)."""
+    from karpenter_tpu.api.core import (
+        Affinity,
+        LabelSelector,
+        PodAffinity,
+        PodAffinityTerm,
+        PodAntiAffinity,
+    )
+
+    labels = dict(labels or {"app": "db"})
+    pod = pending_pod(name, cpu=cpu, memory="1Gi")
+    pod.metadata.labels = labels
+    selector = LabelSelector(
+        match_labels=(
+            dict(selector_labels)
+            if selector_labels is not None
+            else dict(labels)
+        )
+        if self_match
+        else {"app": "somebody-else"}
+    )
+    pod.spec.affinity = Affinity(
+        pod_anti_affinity=PodAntiAffinity(
+            required_during_scheduling_ignored_during_execution=[
+                PodAffinityTerm(label_selector=selector, topology_key=key)
+                for key in keys
+            ]
+        ),
+        pod_affinity=(
+            PodAffinity(
+                required_during_scheduling_ignored_during_execution=[
+                    PodAffinityTerm(
+                        label_selector=LabelSelector(
+                            match_labels=dict(selector_labels or labels)
+                        ),
+                        topology_key=key,
+                    )
+                    for key in co_keys
+                ]
+            )
+            if co_keys
+            else None
+        ),
+    )
+    return pod
+
+
+class TestSelfAntiAffinity:
+    """Required inter-pod SELF-(anti-)affinity through the full signal:
+    hostname anti-affinity takes one node per replica (the pod_exclusive
+    solver operand), domain anti-affinity caps one replica per topology
+    domain, co-location affinity pins the workload to one domain. The
+    reference stubs the whole producer; the kube-scheduler's
+    InterPodAffinity plugin defines the semantics being approximated."""
+
+    def _zoned(self, runtime, zones=("a", "b", "c")):
+        for z in zones:
+            runtime.store.create(
+                ready_node(
+                    f"n-{z}", {"group": z, ZONE_KEY: f"us-{z}"},
+                    cpu="64", pods="110",
+                )
+            )
+            runtime.store.create(pending_mp(f"group-{z}", {"group": z}))
+
+    def _pods_per_group(self, runtime, names):
+        return {
+            n: runtime.store.get("MetricsProducer", "default", n)
+            .status.pending_capacity.pending_pods
+            for n in names
+        }
+
+    def test_hostname_anti_takes_one_node_per_replica(self, env):
+        """5 one-cpu replicas on 64-cpu nodes: an unconstrained workload
+        packs into ONE node; one-replica-per-node demands FIVE."""
+        runtime, provider, clock = env
+        selector = {"group": "a"}
+        runtime.store.create(ready_node("n1", selector, cpu="64", pods="110"))
+        runtime.store.create(pending_mp("group-a", selector))
+        for i in range(5):
+            runtime.store.create(anti_pod(f"p{i}"))
+        runtime.manager.reconcile_all()
+        mp = runtime.store.get("MetricsProducer", "default", "group-a")
+        assert mp.status.pending_capacity.pending_pods == 5
+        assert mp.status.pending_capacity.additional_nodes_needed == 5
+        assert mp.status.pending_capacity.unschedulable_pods == 0
+
+    def test_unconstrained_control_packs_one_node(self, env):
+        runtime, provider, clock = env
+        selector = {"group": "a"}
+        runtime.store.create(ready_node("n1", selector, cpu="64", pods="110"))
+        runtime.store.create(pending_mp("group-a", selector))
+        for i in range(5):
+            runtime.store.create(pending_pod(f"p{i}", memory="1Gi"))
+        runtime.manager.reconcile_all()
+        mp = runtime.store.get("MetricsProducer", "default", "group-a")
+        assert mp.status.pending_capacity.additional_nodes_needed == 1
+
+    def test_zone_anti_caps_one_per_domain(self, env):
+        """5 replicas, 3 zones: one per zone schedules, 2 are
+        unschedulable by anti-affinity (every domain taken)."""
+        runtime, provider, clock = env
+        self._zoned(runtime)
+        for i in range(5):
+            runtime.store.create(anti_pod(f"p{i}", keys=(ZONE_KEY,)))
+        runtime.manager.reconcile_all()
+        counts = self._pods_per_group(
+            runtime, ["group-a", "group-b", "group-c"]
+        )
+        assert sorted(counts.values()) == [1, 1, 1]
+        mp = runtime.store.get("MetricsProducer", "default", "group-a")
+        assert mp.status.pending_capacity.unschedulable_pods == 2
+
+    def test_zone_anti_within_domain_count_all_schedule(self, env):
+        runtime, provider, clock = env
+        self._zoned(runtime)
+        for i in range(3):
+            runtime.store.create(anti_pod(f"p{i}", keys=(ZONE_KEY,)))
+        runtime.manager.reconcile_all()
+        counts = self._pods_per_group(
+            runtime, ["group-a", "group-b", "group-c"]
+        )
+        assert sorted(counts.values()) == [1, 1, 1]
+        mp = runtime.store.get("MetricsProducer", "default", "group-a")
+        assert mp.status.pending_capacity.unschedulable_pods == 0
+
+    def test_foreign_selector_is_not_modeled(self, env):
+        """Anti-affinity against ANOTHER app's pods needs pairwise pod
+        state (documented out of scope): the pods behave unconstrained."""
+        runtime, provider, clock = env
+        self._zoned(runtime)
+        for i in range(6):
+            runtime.store.create(
+                anti_pod(f"p{i}", keys=(ZONE_KEY,), self_match=False)
+            )
+        runtime.manager.reconcile_all()
+        counts = self._pods_per_group(
+            runtime, ["group-a", "group-b", "group-c"]
+        )
+        assert sorted(counts.values(), reverse=True) == [6, 0, 0]
+
+    def test_two_workloads_each_get_their_own_domains(self, env):
+        """Different labels = different anti shapes: each workload caps
+        1/zone independently, so 2 workloads x 3 replicas fill each zone
+        with 2 pods."""
+        runtime, provider, clock = env
+        self._zoned(runtime)
+        for i in range(3):
+            runtime.store.create(
+                anti_pod(f"db{i}", keys=(ZONE_KEY,), labels={"app": "db"})
+            )
+            runtime.store.create(
+                anti_pod(
+                    f"web{i}", keys=(ZONE_KEY,), labels={"app": "web"}
+                )
+            )
+        runtime.manager.reconcile_all()
+        counts = self._pods_per_group(
+            runtime, ["group-a", "group-b", "group-c"]
+        )
+        assert sorted(counts.values()) == [2, 2, 2]
+        mp = runtime.store.get("MetricsProducer", "default", "group-a")
+        assert mp.status.pending_capacity.unschedulable_pods == 0
+
+    def test_hostname_and_zone_anti_compose(self, env):
+        """hostname + zone keys together: one per zone AND a whole node
+        each — nodes_needed equals the scheduled replica count even
+        though each zone's node could hold 64 of them."""
+        runtime, provider, clock = env
+        self._zoned(runtime)
+        for i in range(3):
+            runtime.store.create(
+                anti_pod(f"p{i}", keys=("kubernetes.io/hostname", ZONE_KEY))
+            )
+        runtime.manager.reconcile_all()
+        for g in ("group-a", "group-b", "group-c"):
+            mp = runtime.store.get("MetricsProducer", "default", g)
+            assert mp.status.pending_capacity.pending_pods == 1
+            assert mp.status.pending_capacity.additional_nodes_needed == 1
+
+    def test_co_location_pins_one_domain(self, env):
+        """Required self pod-AFFINITY on the zone key: groups missing the
+        key are excluded and the whole workload lands in ONE zone."""
+        runtime, provider, clock = env
+        self._zoned(runtime, zones=("a", "b"))
+        runtime.store.create(ready_node("n-bare", {"group": "bare"}, cpu="64"))
+        runtime.store.create(pending_mp("group-bare", {"group": "bare"}))
+        for i in range(4):
+            runtime.store.create(
+                anti_pod(f"p{i}", keys=(), co_keys=(ZONE_KEY,))
+            )
+        runtime.manager.reconcile_all()
+        counts = self._pods_per_group(
+            runtime, ["group-a", "group-b", "group-bare"]
+        )
+        assert counts["group-bare"] == 0
+        assert sorted(counts.values(), reverse=True) == [4, 0, 0]
+
+    def test_anti_governs_over_spread_split(self, env):
+        """A row with BOTH hard spread and zone anti-affinity: the anti
+        rule (1 per domain — the most balanced split possible) governs;
+        pods beyond the domain count are unschedulable."""
+        runtime, provider, clock = env
+        self._zoned(runtime, zones=("a", "b"))
+        for i in range(4):
+            pod = anti_pod(f"p{i}", keys=(ZONE_KEY,))
+            from karpenter_tpu.api.core import TopologySpreadConstraint
+
+            pod.spec.topology_spread_constraints = [
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=ZONE_KEY,
+                    when_unsatisfiable="DoNotSchedule",
+                )
+            ]
+            runtime.store.create(pod)
+        runtime.manager.reconcile_all()
+        counts = self._pods_per_group(runtime, ["group-a", "group-b"])
+        assert sorted(counts.values()) == [1, 1]
+        mp = runtime.store.get("MetricsProducer", "default", "group-a")
+        assert mp.status.pending_capacity.unschedulable_pods == 2
+
+    def test_distinct_anti_shapes_do_not_merge_in_dedup(self, env):
+        """Identical pods except the constraint dedup into separate rows:
+        the exclusive set takes a node each, the rest pack together."""
+        runtime, provider, clock = env
+        selector = {"group": "a"}
+        runtime.store.create(ready_node("n1", selector, cpu="64", pods="110"))
+        runtime.store.create(pending_mp("group-a", selector))
+        for i in range(3):
+            runtime.store.create(anti_pod(f"x{i}"))
+        for i in range(3):
+            runtime.store.create(pending_pod(f"u{i}", memory="1Gi"))
+        runtime.manager.reconcile_all()
+        mp = runtime.store.get("MetricsProducer", "default", "group-a")
+        assert mp.status.pending_capacity.pending_pods == 6
+        # 3 exclusive nodes + 1 shared node for the unconstrained trio
+        assert mp.status.pending_capacity.additional_nodes_needed == 4
+
+    def test_all_encode_paths_agree_with_anti(self):
+        """Oracle (store.list), pod-cache, and feed paths emit the same
+        statuses for anti-affinity fleets (the spread/columnar
+        invariant, extended to the new constraint)."""
+        from karpenter_tpu.metrics.producers.pendingcapacity import (
+            _group_profile,
+            solve_pending,
+        )
+        from karpenter_tpu.metrics.registry import GaugeRegistry
+        from karpenter_tpu.store.columnar import PendingFeed, PendingPodCache
+        from karpenter_tpu.store.store import Store
+
+        store = Store()
+        cache = PendingPodCache(store)
+        feed = PendingFeed(store, _group_profile)
+        for z in ("a", "b"):
+            store.create(
+                ready_node(f"n-{z}", {"group": z, ZONE_KEY: f"us-{z}"},
+                           cpu="64")
+            )
+            store.create(pending_mp(f"group-{z}", {"group": z}))
+        for i in range(3):
+            store.create(anti_pod(f"h{i}"))
+            store.create(anti_pod(f"z{i}", keys=(ZONE_KEY,)))
+
+        results = []
+        for kwargs in ({}, {"pod_cache": cache}, {"feed": feed}):
+            mps = [
+                mp for mp in store.list("MetricsProducer")
+                if mp.spec.pending_capacity is not None
+            ]
+            solve_pending(store, mps, GaugeRegistry(), **kwargs)
+            results.append(
+                {
+                    mp.metadata.name: (
+                        mp.status.pending_capacity.pending_pods,
+                        mp.status.pending_capacity.additional_nodes_needed,
+                        mp.status.pending_capacity.unschedulable_pods,
+                    )
+                    for mp in mps
+                }
+            )
+        assert results[0] == results[1] == results[2]
+        # 3 hostname pods -> 3 nodes in the first zone group; zone pods
+        # 1 per zone, third replica unschedulable (2 domains)
+        assert results[0]["group-a"][2] == 1
+
+    def test_statefulset_per_pod_labels_share_one_anti_group(self, env):
+        """StatefulSets stamp unique per-pod labels (pod-name/index) on
+        replicas; workload identity keys on the SELECTOR, so the
+        replicas still form one anti-group: 1 per zone, excess
+        unschedulable (r3 code review finding)."""
+        runtime, provider, clock = env
+        self._zoned(runtime)
+        for i in range(5):
+            runtime.store.create(
+                anti_pod(
+                    f"db-{i}",
+                    keys=(ZONE_KEY,),
+                    labels={
+                        "app": "db",
+                        "statefulset.kubernetes.io/pod-name": f"db-{i}",
+                    },
+                    selector_labels={"app": "db"},
+                )
+            )
+        runtime.manager.reconcile_all()
+        counts = self._pods_per_group(
+            runtime, ["group-a", "group-b", "group-c"]
+        )
+        assert sorted(counts.values()) == [1, 1, 1]
+        mp = runtime.store.get("MetricsProducer", "default", "group-a")
+        assert mp.status.pending_capacity.unschedulable_pods == 2
+
+    def test_multi_key_anti_caps_every_key(self, env):
+        """Anti-affinity on rack AND zone, 4 racks across 2 zones: only
+        2 replicas can place (one per zone), even though 4 racks exist
+        (r3 code review finding — a first-key-only split would claim 4)."""
+        runtime, provider, clock = env
+        rack = "example.com/rack"
+        layout = [("r1", "z1"), ("r2", "z1"), ("r3", "z2"), ("r4", "z2")]
+        for r, z in layout:
+            runtime.store.create(
+                ready_node(
+                    f"n-{r}",
+                    {"group": r, rack: r, ZONE_KEY: f"us-{z}"},
+                    cpu="64", pods="110",
+                )
+            )
+            runtime.store.create(pending_mp(f"group-{r}", {"group": r}))
+        for i in range(4):
+            runtime.store.create(
+                anti_pod(f"p{i}", keys=(rack, ZONE_KEY))
+            )
+        runtime.manager.reconcile_all()
+        counts = self._pods_per_group(
+            runtime, [f"group-{r}" for r, _ in layout]
+        )
+        assert sum(counts.values()) == 2  # one per ZONE, not per rack
+        mp = runtime.store.get("MetricsProducer", "default", "group-r1")
+        assert mp.status.pending_capacity.unschedulable_pods == 2
+        # and the two placed replicas sit in different zones
+        placed = [r for r, z in layout if counts[f"group-{r}"] == 1]
+        zones = {dict(layout)[r] for r in placed}
+        assert len(zones) == 2
+
+    def test_zone_anti_with_region_co_location_stays_in_one_region(
+        self, env
+    ):
+        """'Spread across zones within one region': zone anti + region
+        co-location. Two zones in region r1, one zone in region r2 —
+        all replicas must land in r1 (2 domains beat 1), the third
+        replica unschedulable (r3 code review finding — independent
+        per-zone assignment would claim all 3 across regions)."""
+        runtime, provider, clock = env
+        region = "topology.kubernetes.io/region"
+        layout = [("a", "r1"), ("b", "r1"), ("c", "r2")]
+        for z, r in layout:
+            runtime.store.create(
+                ready_node(
+                    f"n-{z}",
+                    {"group": z, ZONE_KEY: f"us-{z}", region: r},
+                    cpu="64", pods="110",
+                )
+            )
+            runtime.store.create(pending_mp(f"group-{z}", {"group": z}))
+        for i in range(3):
+            runtime.store.create(
+                anti_pod(f"p{i}", keys=(ZONE_KEY,), co_keys=(region,))
+            )
+        runtime.manager.reconcile_all()
+        counts = self._pods_per_group(
+            runtime, ["group-a", "group-b", "group-c"]
+        )
+        assert counts == {"group-a": 1, "group-b": 1, "group-c": 0}
+        mp = runtime.store.get("MetricsProducer", "default", "group-a")
+        assert mp.status.pending_capacity.unschedulable_pods == 1
+
+    def test_co_only_multi_row_workload_pins_one_domain(self, env):
+        """A co-location workload whose replicas differ in requests
+        (mid-VPA) dedups into separate rows; the rows must still pin to
+        ONE domain (r3 code review finding)."""
+        runtime, provider, clock = env
+        self._zoned(runtime, zones=("a", "b"))
+        for i, cpu in enumerate(["1", "1", "2", "2"]):
+            runtime.store.create(
+                anti_pod(f"p{i}", keys=(), co_keys=(ZONE_KEY,), cpu=cpu)
+            )
+        runtime.manager.reconcile_all()
+        counts = self._pods_per_group(runtime, ["group-a", "group-b"])
+        assert sorted(counts.values(), reverse=True) == [4, 0]

@@ -148,6 +148,104 @@ class TestCodecPosture:
         ]["nodeSelectorTerms"]
         assert terms[0]["matchExpressions"][0]["operator"] == "NotIn"
 
+    def test_pod_anti_affinity_roundtrip(self):
+        """core/v1 podAntiAffinity/podAffinity manifest dialect hydrates
+        reflectively, and the SELF-matching slice canonicalizes into
+        pod_affinity_shape (solver model scope; foreign selectors and
+        out-of-namespace terms fall out)."""
+        pod = from_manifest(
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {
+                    "name": "db-0",
+                    "namespace": "prod",
+                    "labels": {"app": "db"},
+                },
+                "spec": {
+                    "containers": [{"requests": {"cpu": "1"}}],
+                    "affinity": {
+                        "podAntiAffinity": {
+                            "requiredDuringSchedulingIgnoredDuringExecution": [
+                                {
+                                    "labelSelector": {
+                                        "matchLabels": {"app": "db"}
+                                    },
+                                    "topologyKey": "kubernetes.io/hostname",
+                                },
+                                {
+                                    "labelSelector": {
+                                        "matchExpressions": [
+                                            {
+                                                "key": "app",
+                                                "operator": "In",
+                                                "values": ["db"],
+                                            }
+                                        ]
+                                    },
+                                    "topologyKey": "topology.kubernetes.io/zone",
+                                },
+                                {
+                                    # matches OTHER pods only: out of scope
+                                    "labelSelector": {
+                                        "matchLabels": {"app": "web"}
+                                    },
+                                    "topologyKey": "kubernetes.io/hostname",
+                                },
+                                {
+                                    # own selector, FOREIGN namespace scope
+                                    "labelSelector": {
+                                        "matchLabels": {"app": "db"}
+                                    },
+                                    "topologyKey": "kubernetes.io/hostname",
+                                    "namespaces": ["elsewhere"],
+                                },
+                            ]
+                        },
+                        "podAffinity": {
+                            "requiredDuringSchedulingIgnoredDuringExecution": [
+                                {
+                                    "labelSelector": {
+                                        "matchLabels": {"app": "db"}
+                                    },
+                                    "topologyKey": "topology.kubernetes.io/region",
+                                }
+                            ]
+                        },
+                    },
+                },
+            }
+        )
+        from karpenter_tpu.api.core import pod_affinity_shape
+
+        shape = pod_affinity_shape(
+            pod.spec.affinity, pod.metadata.labels, pod.metadata.namespace
+        )
+        assert shape == (
+            1,  # hostname exclusive (self-matching term #1)
+            ("topology.kubernetes.io/zone",),  # domain cap (term #2)
+            ("topology.kubernetes.io/region",),  # co-location
+            # workload identity: namespace + the canonical SELECTOR
+            # forms of the domain-relevant terms (zone matchExpressions,
+            # region matchLabels) — selector-keyed so StatefulSet
+            # per-pod labels don't fragment the anti-group
+            (
+                "prod",
+                (
+                    ((), (("app", "In", ("db",)),)),
+                    ((("app", "db"),), ()),
+                ),
+            ),
+        )
+        from karpenter_tpu.api.serialization import to_dict
+
+        doc = to_dict(pod)
+        terms = doc["spec"]["affinity"]["podAntiAffinity"][
+            "requiredDuringSchedulingIgnoredDuringExecution"
+        ]
+        assert terms[0]["topologyKey"] == "kubernetes.io/hostname"
+        assert terms[3]["namespaces"] == ["elsewhere"]
+
     def test_pod_preferred_affinity_roundtrip(self):
         pod = from_manifest(
             {
