@@ -103,7 +103,7 @@ def emit(
 
 def build_inputs(
     pods: int, types: int, taints: int, labels: int, seed: int,
-    affinity: float = 0.0,
+    affinity: float = 0.0, anti: float = 0.0,
 ):
     import jax.numpy as jnp
 
@@ -147,6 +147,13 @@ def build_inputs(
         group_labels=jnp.asarray(group_labels),
         pod_group_forbidden=(
             None if forbidden is None else jnp.asarray(forbidden)
+        ),
+        pod_exclusive=(
+            # fraction `anti` of pods carry hostname self-anti-affinity
+            # (one replica per node): the encoder's pod_exclusive operand
+            None
+            if anti <= 0
+            else jnp.asarray(rng.random(pods) < anti)
         ),
     )
 
@@ -206,6 +213,12 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
         "--affinity", type=float, default=0.0,
         help="fraction of pods carrying required node affinity (adds the "
         "pod_group_forbidden [P, T] mask operand to the solve)",
+    )
+    ap.add_argument(
+        "--anti", type=float, default=0.0,
+        help="fraction of pods carrying hostname self-anti-affinity — "
+        "one replica per node (adds the pod_exclusive [P] operand to "
+        "the solve)",
     )
     ap.add_argument(
         "--backend",
@@ -299,6 +312,14 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
         )
     if not 0.0 <= args.affinity <= 1.0:
         ap.error("--affinity must be a fraction in [0, 1]")
+    if args.anti and (args.clusters or args.decide or args.mesh):
+        ap.error(
+            "--anti applies to the solver bench and --e2e (which builds "
+            "real podAntiAffinity specs); --clusters/--decide/--mesh "
+            "build their own workloads"
+        )
+    if not 0.0 <= args.anti <= 1.0:
+        ap.error("--anti must be a fraction in [0, 1]")
     if args.slices < 1:
         ap.error("--slices must be >= 1")
     if args.slices > 1 and not args.mesh:
@@ -348,6 +369,8 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
         # distinct metric key: affinity-constrained runs must never mix
         # into the unconstrained series when aggregated by metric name
         metric += f", {args.affinity:.0%} pods with node affinity"
+    if args.anti:
+        metric += f", {args.anti:.0%} pods one-per-node"
     try:
         if args.mesh:
             run_mesh(args, metric)
@@ -403,7 +426,7 @@ def run(args, metric: str, note: str) -> None:
     else:
         inputs = build_inputs(
             args.pods, args.types, args.taints, args.labels, args.seed,
-            affinity=args.affinity,
+            affinity=args.affinity, anti=args.anti,
         )
     inputs = jax.device_put(inputs)
     jax.block_until_ready(inputs)
@@ -553,6 +576,32 @@ def run_mesh(args, metric: str) -> None:
 
 
 
+def _e2e_anti_affinity(app: str):
+    """Required hostname self-anti-affinity for --e2e --anti: the
+    StatefulSet one-replica-per-node pattern, through the REAL spec
+    parse -> columnar anti-shape intern -> _expand_anti_rows ->
+    pod_exclusive operand path."""
+    from karpenter_tpu.api.core import (
+        Affinity,
+        LabelSelector,
+        PodAffinityTerm,
+        PodAntiAffinity,
+    )
+
+    return Affinity(
+        pod_anti_affinity=PodAntiAffinity(
+            required_during_scheduling_ignored_during_execution=[
+                PodAffinityTerm(
+                    label_selector=LabelSelector(
+                        match_labels={"app": app}
+                    ),
+                    topology_key="kubernetes.io/hostname",
+                )
+            ]
+        )
+    )
+
+
 def _e2e_affinity_shapes():
     """A few realistic affinity variants for --e2e --affinity: require
     ssd, forbid hdd, prefer ssd (weight 80)."""
@@ -663,13 +712,31 @@ def run_e2e(args, metric: str, note: str = "") -> None:  # lint: allow-complexit
     affinity_shapes = _e2e_affinity_shapes() if args.affinity else []
 
     def make_pod(name):
+        # independent draws: the metric label promises each fraction
+        # unconditionally, and a pod can legitimately carry BOTH node
+        # affinity and pod anti-affinity
         affinity = None
+        labels = {}
         if affinity_shapes and rng.random() < args.affinity:
             affinity = affinity_shapes[
                 int(rng.integers(0, len(affinity_shapes)))
             ]
+        if args.anti and rng.random() < args.anti:
+            # a handful of one-per-node workloads (distinct selectors =
+            # distinct anti shapes, like production StatefulSets)
+            app = f"svc{int(rng.integers(0, 8))}"
+            labels = {"app": app}
+            from karpenter_tpu.api.core import Affinity
+
+            anti = _e2e_anti_affinity(app)
+            affinity = Affinity(
+                node_affinity=(
+                    affinity.node_affinity if affinity else None
+                ),
+                pod_anti_affinity=anti.pod_anti_affinity,
+            )
         return Pod(
-            metadata=ObjectMeta(name=name),
+            metadata=ObjectMeta(name=name, labels=labels),
             spec=PodSpec(
                 containers=[
                     Container(

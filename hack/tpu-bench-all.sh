@@ -16,6 +16,7 @@ for args in \
     "--backend pallas" \
     "--backend xla" \
     "--affinity 0.5 --iters 10" \
+    "--anti 0.3 --iters 10" \
     "--e2e" \
     "--e2e --affinity 0.3" \
     "--e2e --pods 1000000 --churn 1000 --iters 5" \

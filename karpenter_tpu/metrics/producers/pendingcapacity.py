@@ -541,6 +541,51 @@ def _expand_spread_rows(snap, profiles, row_idx, row_weight, label_dicts_fn):  #
     )
 
 
+def _canonical_row_key(snap, slot: int) -> tuple:
+    """Arena-independent content key for a snapshot row: every component
+    is resolved through its universe REGISTRY (resource names, label
+    items, canonical shape tuples), so two arenas that numbered the same
+    pod shapes differently still produce the same key. Used to order
+    domain hand-out across a workload's rows (_expand_anti_rows)."""
+    requests = tuple(
+        sorted(
+            (snap.resources[r], float(snap.requests[slot, r]))
+            for r in range(len(snap.resources))
+            if snap.requests[slot, r] != 0
+        )
+    )
+    selector = tuple(
+        sorted(
+            snap.labels[c]
+            for c in range(len(snap.labels))
+            if snap.required[slot, c]
+        )
+    )
+    tolerations = tuple(
+        sorted(
+            (t.key, t.operator, t.value, t.effect)
+            for t in snap.shape_tolerations[snap.shape_id[slot]]
+        )
+    )
+    affinity = (
+        snap.affinity_shapes[snap.affinity_id[slot]]
+        if snap.affinity_shapes is not None and snap.affinity_id is not None
+        else ()
+    )
+    preferred = (
+        snap.preferred_shapes[snap.preferred_id[slot]]
+        if snap.preferred_shapes is not None
+        and snap.preferred_id is not None
+        else ()
+    )
+    spread = (
+        snap.spread_shapes[snap.spread_id[slot]]
+        if snap.spread_shapes is not None and snap.spread_id is not None
+        else ()
+    )
+    return (requests, selector, tolerations, affinity, preferred, spread)
+
+
 def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each guard is a documented anti-affinity rule
     snap, profiles, row_idx, row_weight, prior_forbidden, label_dicts_fn
 ):
@@ -584,6 +629,15 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
     the INPUT rows) is carried through the re-expansion: every output
     row inherits its source row's mask OR'd with the anti exclusions.
 
+    Domain hand-out across a workload's rows is ordered by CANONICAL
+    row content (_canonical_row_key), never by dedup-row position:
+    byte-sorted row order depends on arena-local id numbering, so a
+    position-ordered hand-out could give the oracle and feed paths
+    different row->domain assignments — and with per-domain taints,
+    different outputs — breaking the outputs-identical-on-every-
+    encode-path invariant (r3 code review; the spread expansion's
+    content-keyed rotation avoids the same trap).
+
     Returns (row_idx, row_weight, forbidden[rows, T]-or-None,
     exclusive[rows]-or-None); unconstrained snapshots pass untouched.
     """
@@ -605,11 +659,11 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
     )
 
     # per live anti shape: (ordered domain group-lists or None,
-    # key-exclusion mask, hostname_exclusive); the domain iterator is
-    # SHARED across rows with the same shape via next_domain
+    # key-exclusion mask, hostname_exclusive); the domain sequence is
+    # SHARED across rows with the same shape, handed out in canonical
+    # content order (path-stable — see docstring)
     sid_rows = collections.Counter(int(s) for s in live_ids)
     plan: Dict[int, tuple] = {}
-    next_domain: Dict[int, int] = {}
     for s in np.unique(live_ids):
         shape = shapes[s]
         if not shape:
@@ -677,7 +731,27 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
                     if t not in chosen:
                         excluded[t] = True
         plan[int(s)] = (domains, excluded, bool(hostname_excl))
-        next_domain[int(s)] = 0
+
+    # pre-allocate each row's domain range (start, take) in canonical
+    # content order within its workload
+    alloc: Dict[int, tuple] = {}
+    rows_by_sid: Dict[int, list] = {}
+    for i, sid in enumerate(live_ids):
+        entry = plan.get(int(sid))
+        if entry is not None and entry[0] is not None:
+            rows_by_sid.setdefault(int(sid), []).append(i)
+    for sid, rows_i in rows_by_sid.items():
+        n_domains = len(plan[sid][0])
+        if len(rows_i) > 1:
+            rows_i = sorted(
+                rows_i,
+                key=lambda i: _canonical_row_key(snap, row_idx[i]),
+            )
+        pos = 0
+        for i in rows_i:
+            take = min(int(row_weight[i]), max(0, n_domains - pos))
+            alloc[i] = (pos, take)
+            pos += take
 
     out_idx, out_weight, out_forbidden, out_exclusive = [], [], [], []
     for i, sid in enumerate(live_ids):
@@ -716,9 +790,7 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
             out_forbidden.append(excluded)
             out_exclusive.append(hostname_excl)
             continue
-        start = next_domain[int(sid)]
-        take = min(weight, max(0, len(domains) - start))
-        next_domain[int(sid)] = start + take
+        start, take = alloc[i]
         for rank in range(start, start + take):
             forbidden = np.ones(n_groups, bool)
             forbidden[domains[rank]] = False

@@ -1291,3 +1291,70 @@ class TestSelfAntiAffinity:
         runtime.manager.reconcile_all()
         counts = self._pods_per_group(runtime, ["group-a", "group-b"])
         assert sorted(counts.values(), reverse=True) == [4, 0]
+
+    def test_anti_domain_handout_is_path_stable(self):
+        """Regression (r3 code review): domain hand-out across a
+        workload's request-identical rows must key on canonical row
+        CONTENT, not dedup position. A long-lived cache numbers a
+        churned toleration shape differently from a fresh oracle
+        build, flipping byte-sorted row order; with a taint on one
+        zone, a position-ordered hand-out would give the two paths
+        different row->domain assignments and different outputs."""
+        from karpenter_tpu.api.core import Taint, Toleration
+        from karpenter_tpu.metrics.producers.pendingcapacity import (
+            solve_pending,
+        )
+        from karpenter_tpu.metrics.registry import GaugeRegistry
+        from karpenter_tpu.store.columnar import PendingPodCache
+        from karpenter_tpu.store.store import Store
+
+        store = Store()
+        cache = PendingPodCache(store)  # watches from the start
+        # zone-a tainted: only the tolerating row can use domain us-a
+        store.create(
+            ready_node(
+                "n-a", {"group": "a", ZONE_KEY: "us-a"}, cpu="64",
+                taints=[Taint(key="dedicated", value="db")],
+            )
+        )
+        store.create(
+            ready_node("n-b", {"group": "b", ZONE_KEY: "us-b"}, cpu="64")
+        )
+        for z in ("a", "b"):
+            store.create(pending_mp(f"group-{z}", {"group": z}))
+        # churner forces the cache to register a late toleration shape
+        # (renumbering arena ids between cache and oracle builds)
+        churner = pending_pod(
+            "u", memory="1Gi",
+            tolerations=[Toleration(key="x", operator="Exists")],
+        )
+        churner = store.create(churner)
+        # ONE workload (same selector/labels), zone anti-affinity, two
+        # request-identical rows differing only in tolerations
+        tol = anti_pod("db-tol", keys=(ZONE_KEY,))
+        tol.spec.tolerations = [
+            Toleration(key="dedicated", operator="Equal", value="db",
+                       effect="NoSchedule")
+        ]
+        store.create(tol)
+        store.create(anti_pod("db-plain", keys=(ZONE_KEY,)))
+        churner.spec.tolerations = [Toleration(key="z", operator="Exists")]
+        store.update(churner)
+
+        results = []
+        for kwargs in ({}, {"pod_cache": cache}):
+            mps = [
+                mp for mp in store.list("MetricsProducer")
+                if mp.spec.pending_capacity is not None
+            ]
+            solve_pending(store, mps, GaugeRegistry(), **kwargs)
+            results.append(
+                {
+                    mp.metadata.name: (
+                        mp.status.pending_capacity.pending_pods,
+                        mp.status.pending_capacity.unschedulable_pods,
+                    )
+                    for mp in mps
+                }
+            )
+        assert results[0] == results[1]
