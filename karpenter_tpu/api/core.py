@@ -203,11 +203,12 @@ class Affinity:
 @dataclass(slots=True)
 class TopologySpreadConstraint:
     """core/v1 TopologySpreadConstraint. The solver honors DoNotSchedule
-    constraints via balanced domain splitting (producers/pendingcapacity);
-    ScheduleAnyway is a scheduler preference and is decoded but not
-    constrained. labelSelector / matchLabelKeys count EXISTING pods per
-    domain, which needs pairwise pod state — decoded for fidelity, not
-    modeled (docs/OPERATIONS.md 'Scheduling fidelity')."""
+    constraints via water-filled domain splitting against the EXISTING
+    matching-pod counts per domain — labelSelector drives the census
+    (producers/pendingcapacity.DomainCensus) exactly as the scheduler's
+    skew check counts it. ScheduleAnyway is a scheduler preference and
+    matchLabelKeys a selector refinement: both decoded, not modeled
+    (docs/OPERATIONS.md 'Scheduling fidelity')."""
 
     max_skew: int = 1
     topology_key: str = ""
@@ -226,14 +227,66 @@ class TopologySpreadConstraint:
 HOSTNAME_TOPOLOGY_KEY = "kubernetes.io/hostname"
 
 
-def spread_shape(constraints: Optional[list]) -> tuple:
-    """Canonical hashable form of a pod's HARD topology spread: sorted
-    (topologyKey, maxSkew, minDomains) triples for DoNotSchedule
-    constraints on non-hostname keys (per key: smallest skew and largest
-    minDomains win — the most restrictive combination). () =
-    unconstrained. maxSkew matters only through the minDomains rule
-    (producers/pendingcapacity._expand_spread_rows): with at least
-    minDomains eligible domains, balanced chunks satisfy any skew >= 1.
+def raw_selector_form(raw: Optional[dict]) -> Optional[tuple]:
+    """Canonical hashable form of a RAW (manifest-shaped) LabelSelector
+    dict — the TopologySpreadConstraint.label_selector dialect
+    (matchLabels/matchExpressions, camelCase keys). Same form as
+    _selector_form so both dialects share selector_form_matches. None
+    when the field is absent: a spread constraint without a selector
+    counts no pods (metav1 semantics: nil selector selects nothing)."""
+    if raw is None or not isinstance(raw, dict):
+        return None
+    return (
+        tuple(sorted((raw.get("matchLabels") or {}).items())),
+        tuple(
+            sorted(
+                (
+                    e.get("key", ""),
+                    e.get("operator", ""),
+                    tuple(sorted(e.get("values") or ())),
+                )
+                for e in (raw.get("matchExpressions") or [])
+            )
+        ),
+    )
+
+
+def selector_form_matches(form: tuple, labels: Dict[str, str]) -> bool:
+    """Evaluate a canonical selector form (_selector_form /
+    raw_selector_form) against a label set — LabelSelector.matches
+    semantics: matchLabels AND matchExpressions, empty selector matches
+    everything, Gt/Lt invalid in label selectors (never match)."""
+    match_labels, expressions = form
+    if any(labels.get(k) != v for k, v in match_labels):
+        return False
+    for key, operator, values in expressions:
+        if operator in ("Gt", "Lt"):
+            return False
+        if not _requirement_matches(labels, key, operator, values):
+            return False
+    return True
+
+
+def spread_shape(
+    constraints: Optional[list],
+    namespace: str = "",
+    labels: Optional[Dict[str, str]] = None,
+) -> tuple:
+    """Canonical hashable form of a pod's HARD topology spread:
+    (namespace, entries) where entries are sorted (topologyKey, maxSkew,
+    minDomains, selectorForm, selfMatch, honorAffinity) tuples for
+    DoNotSchedule constraints on non-hostname keys (per (key, selector):
+    smallest skew, largest minDomains, and Ignore-over-Honor win — the
+    most restrictive combination). () = unconstrained. The namespace and
+    the constraint's labelSelector (raw_selector_form; None = counts
+    nothing) scope the EXISTING-pod domain counts
+    (producers/pendingcapacity.DomainCensus) that the split honors;
+    selfMatch records whether the POD ITSELF matches the selector (the
+    kube-scheduler's selfMatchNum): only then do placed replicas
+    accumulate into the skew the next placement sees. honorAffinity is
+    the constraint's nodeAffinityPolicy (default Honor): with Ignore,
+    ALL live nodes exposing the key define domains and counts, not just
+    the ones passing the pod's nodeSelector + required affinity.
 
     hostname-keyed constraints are dropped here by design: domains are
     individual nodes, and balanced placement across the nodes a scale-up
@@ -242,7 +295,7 @@ def spread_shape(constraints: Optional[list]) -> tuple:
     is soft (scheduler preference), never a constraint."""
     if not constraints:
         return ()
-    binding: Dict[str, Tuple[int, int]] = {}
+    binding: Dict[tuple, Tuple[int, int, bool]] = {}
     for c in constraints:
         if (
             c.when_unsatisfiable == "DoNotSchedule"
@@ -251,15 +304,34 @@ def spread_shape(constraints: Optional[list]) -> tuple:
         ):
             skew = max(1, int(c.max_skew))
             min_domains = max(0, int(c.min_domains or 0))
-            prev = binding.get(c.topology_key)
+            honor = c.node_affinity_policy != "Ignore"
+            sel = raw_selector_form(c.label_selector)
+            prev = binding.get((c.topology_key, sel))
             if prev is not None:
                 skew = min(prev[0], skew)
                 min_domains = max(prev[1], min_domains)
-            binding[c.topology_key] = (skew, min_domains)
-    return tuple(
-        (key, skew, min_domains)
-        for key, (skew, min_domains) in sorted(binding.items())
+                # Ignore wins: counting ALL nodes caps tighter in the
+                # scale-up model, the conservative merge
+                honor = prev[2] and honor
+            binding[(c.topology_key, sel)] = (skew, min_domains, honor)
+    if not binding:
+        return ()
+    entries = tuple(
+        (
+            key,
+            skew,
+            min_domains,
+            sel,
+            sel is not None and selector_form_matches(sel, labels or {}),
+            honor,
+        )
+        for (key, sel), (skew, min_domains, honor) in sorted(
+            binding.items(),
+            # None sorts apart from tuple selector forms
+            key=lambda kv: (kv[0][0], kv[0][1] is not None, kv[0][1] or ()),
+        )
     )
+    return (namespace, entries)
 
 
 def _self_matching_terms(

@@ -41,6 +41,7 @@ from karpenter_tpu.api.core import (
     matches_affinity_shape,
     matches_selector,
     preference_score,
+    selector_form_matches,
 )
 from karpenter_tpu.api.metricsproducer import PendingCapacityStatus
 from karpenter_tpu.metrics.registry import GaugeRegistry, default_registry
@@ -49,6 +50,7 @@ from karpenter_tpu.ops import binpack as B
 from karpenter_tpu.store.columnar import (
     BASE_RESOURCES,
     RESOURCE_PODS,
+    occupancy_from_pods,
     snapshot_from_pods,
 )
 from karpenter_tpu.utils.functional import pad_to_multiple
@@ -252,24 +254,55 @@ def solve_pending(  # lint: allow-complexity — the one batched solve: per-targ
     # ONE encode implementation for every path (store/columnar.py): the
     # caches snapshot their watch-maintained arenas; the oracle path runs
     # the same detached encoder over a fresh store.list — no drift possible
+    all_pods = None
     if feed is not None:
         snap = feed.pods.snapshot()
     elif pod_cache is not None:
         snap = pod_cache.snapshot()
     else:
-        snap = snapshot_from_pods(store.list("Pod"))
+        all_pods = store.list("Pod")
+        snap = snapshot_from_pods(all_pods)
+
+    # Existing-pod domain occupancy: only fleets with live spread/anti
+    # constraints pay for a census (freed arena slots are zeroed, so the
+    # id scan is exact); unconstrained fleets skip it entirely — and
+    # their encode memo stays insensitive to bound-pod churn
+    needs_census = (
+        snap.spread_id is not None and bool((snap.spread_id != 0).any())
+    ) or (snap.anti_id is not None and bool((snap.anti_id != 0).any()))
+    census = None
+    if needs_census:
+        if feed is not None:
+            if feed.census is None:
+                feed.census = DomainCensus(
+                    feed.occupancy,
+                    feed.nodes.nodes,
+                    lambda: feed.nodes.version,
+                )
+            census = feed.census
+        else:
+            if all_pods is None:
+                all_pods = store.list("Pod")
+            census = DomainCensus(
+                occupancy_from_pods(all_pods), lambda: nodes
+            )
 
     # Encode memo (feed path only): inputs are a pure function of
-    # (pod arena generation, node set, producer selectors). When none of
-    # those moved since the last solve, reuse the previous BinPackInputs
-    # OBJECT — the solver's identity-keyed device cache (ops/binpack.solve)
-    # then skips the host->device transfer entirely, which dominates the
-    # tick when the chip sits behind a network tunnel.
+    # (pod arena generation, node set, producer selectors, occupancy).
+    # When none of those moved since the last solve, reuse the previous
+    # BinPackInputs OBJECT — the solver's identity-keyed device cache
+    # (ops/binpack.solve) then skips the host->device transfer entirely,
+    # which dominates the tick when the chip sits behind a network
+    # tunnel.
     fingerprint = None
     if feed is not None:
         fingerprint = (
             snap.generation,
             feed.nodes.version,
+            # bound-pod churn moves spread/anti masks only when a
+            # constraint is live; otherwise pin the slot so the memo
+            # survives scheduled-pod events
+            feed.occupancy.generation if needs_census else -1,
             tuple(
                 (
                     namespace,
@@ -295,7 +328,7 @@ def solve_pending(  # lint: allow-complexity — the one batched solve: per-targ
             cached_outputs = memo[2]
             _count_cache(registry, "hit")
         else:
-            inputs = _encode_from_cache(snap, profiles)
+            inputs = _encode_from_cache(snap, profiles, census=census)
             feed.encode_memo = (fingerprint, inputs, None)
             _count_cache(registry, "miss")
         host = _dispatch_and_record(
@@ -304,7 +337,7 @@ def solve_pending(  # lint: allow-complexity — the one batched solve: per-targ
         )
         feed.encode_memo = (fingerprint, inputs, host)
     else:
-        inputs = _encode_from_cache(snap, profiles)
+        inputs = _encode_from_cache(snap, profiles, census=census)
         _dispatch_and_record(inputs, targets, registry, solver, errors)
     return {
         (namespace, name): errors.get((namespace, name))
@@ -413,26 +446,287 @@ def _dedup_rows(snap):
     return idx, counts.astype(np.int32)
 
 
-def _expand_spread_rows(snap, profiles, row_idx, row_weight, label_dicts_fn):  # lint: allow-complexity — per-domain chunking: each guard is a documented spread rule
+class DomainCensus:
+    """Existing-pod domain occupancy: the query layer between a
+    ScheduledOccupancy census (store/columnar) and the spread/anti row
+    expansions. The kube-scheduler evaluates topology spread skew and
+    inter-pod (anti-)affinity against the pods ALREADY PLACED; without
+    these counts the signal could promise a placement (e.g. a replica
+    into a zone that already holds one) the scheduler then refuses.
+
+    All queries are memoized per (occupancy generation, node version)
+    epoch, so steady-state ticks answer from the memo; the underlying
+    census and node mirror are incremental, so nothing here scans the
+    store. Node-side work (label extraction, per-row node filters) and
+    pod-side work (selector evaluation over distinct label sets) are
+    memoized independently.
+    """
+
+    def __init__(self, occupancy, nodes_fn, node_version_fn=None):
+        self._occupancy = occupancy
+        self._nodes_fn = nodes_fn  # () -> list of Node objects
+        self._node_version_fn = node_version_fn or (lambda: 0)
+        self._epoch: Optional[tuple] = None
+        self._memo: Dict[tuple, object] = {}
+        self._node_memo: Dict[tuple, object] = {}
+        self._named_labels: Optional[List[Tuple[str, dict]]] = None
+
+    def _fresh(self, generation: int) -> None:
+        epoch = (generation, self._node_version_fn())
+        if epoch != self._epoch:
+            self._epoch = epoch
+            self._memo.clear()
+            self._node_memo.clear()
+            self._named_labels = None
+
+    def _nodes(self) -> List[Tuple[str, dict]]:
+        if self._named_labels is None:
+            self._named_labels = [
+                (n.metadata.name, dict(n.metadata.labels))
+                for n in self._nodes_fn()
+            ]
+        return self._named_labels
+
+    def spread(
+        self, namespace, sel_form, split_key, filter_token, node_passes
+    ) -> Tuple[Dict[str, int], set]:
+        """(counts: {domain value: matching-pod count}, present: domain
+        values among filter-passing live nodes) for one spread
+        constraint. The node filter is the ROW's nodeSelector + required
+        node affinity (nodeAffinityPolicy=Honor, the k8s default; taints
+        are Ignored per the nodeTaintsPolicy default): only nodes the
+        incoming pod could land on define domains and contribute counts.
+        """
+        with self._occupancy.view() as (generation, spaces):
+            self._fresh(generation)
+            node_key = (split_key, filter_token)
+            node_side = self._node_memo.get(node_key)
+            if node_side is None:
+                passing: Dict[str, str] = {}
+                present: set = set()
+                for name, labels in self._nodes():
+                    value = labels.get(split_key)
+                    if value is None or not node_passes(labels):
+                        continue
+                    passing[name] = value
+                    present.add(value)
+                node_side = (passing, present)
+                self._node_memo[node_key] = node_side
+            passing, present = node_side
+            memo_key = ("spread", namespace, sel_form, split_key,
+                        filter_token)
+            got = self._memo.get(memo_key)
+            if got is None:
+                counts: Dict[str, int] = {}
+                if sel_form is not None:
+                    for labels_items, nodes in spaces.get(
+                        namespace, {}
+                    ).items():
+                        if not selector_form_matches(
+                            sel_form, dict(labels_items)
+                        ):
+                            continue
+                        for node, n in nodes.items():
+                            value = passing.get(node)
+                            if value is not None:
+                                counts[value] = counts.get(value, 0) + n
+                got = (counts, present)
+                self._memo[memo_key] = got
+            return got
+
+    def _workload_nodes(self, namespace, sel_forms) -> tuple:
+        """(any_nodes, all_nodes_or_None): node-name sets occupied by
+        pods matching ANY of the workload's selectors (the anti-blocking
+        set — over-blocking is conservative) and by pods matching EVERY
+        LIVE selector (the co-location set — under-allowing is
+        conservative); all_nodes is None when NO selector has a matching
+        scheduled pod anywhere in the namespace (the k8s first-replica
+        bootstrap: a required self-affinity term with no matching pod
+        cluster-wide imposes nothing)."""
+        memo_key = ("workload", namespace, sel_forms)
+        groups = []
+        with self._occupancy.view() as (generation, spaces):
+            # memo lookup only AFTER the epoch check: an entry cached
+            # under a previous occupancy generation (or node version)
+            # must never answer for this one — a replica bound since
+            # then has to spend its domain on the very next solve
+            self._fresh(generation)
+            got = self._memo.get(memo_key)
+            if got is not None:
+                return got
+            for labels_items, nodes in spaces.get(namespace, {}).items():
+                labels = dict(labels_items)
+                vec = tuple(
+                    selector_form_matches(form, labels)
+                    for form in sel_forms
+                )
+                if any(vec):
+                    groups.append((vec, set(nodes)))
+        live = [
+            i
+            for i in range(len(sel_forms))
+            if any(vec[i] for vec, _ in groups)
+        ]
+        any_nodes: set = set()
+        all_nodes: Optional[set] = set() if live else None
+        for vec, names in groups:
+            any_nodes |= names
+            if all_nodes is not None and all(vec[i] for i in live):
+                all_nodes |= names
+        got = (any_nodes, all_nodes)
+        self._memo[memo_key] = got
+        return got
+
+    def anti_domains(self, namespace, sel_forms, keys) -> Dict[str, set]:
+        """Per anti key: topology values already OCCUPIED by an existing
+        pod matching any of the workload's selectors — a self-anti
+        replica can never be placed there again. Unfiltered nodes: the
+        scheduler's inter-pod terms have no node-affinity gate."""
+        any_nodes, _ = self._workload_nodes(namespace, sel_forms)
+        blocked: Dict[str, set] = {key: set() for key in keys}
+        if any_nodes:
+            for name, labels in self._nodes():
+                if name not in any_nodes:
+                    continue
+                for key in keys:
+                    value = labels.get(key)
+                    if value is not None:
+                        blocked[key].add(value)
+        return blocked
+
+    def co_domains(
+        self, namespace, sel_forms, keys
+    ) -> Optional[Dict[str, set]]:
+        """Per co key: the topology values that HOLD a matching pod —
+        required self-affinity forces new replicas into one of them.
+        None = bootstrap (no matching scheduled pod anywhere): the
+        term imposes nothing and the whole-workload-in-one-domain rule
+        alone applies."""
+        _, all_nodes = self._workload_nodes(namespace, sel_forms)
+        if all_nodes is None:
+            return None
+        allowed: Dict[str, set] = {key: set() for key in keys}
+        for name, labels in self._nodes():
+            if name not in all_nodes:
+                continue
+            for key in keys:
+                value = labels.get(key)
+                if value is not None:
+                    allowed[key].add(value)
+        return allowed
+
+
+def _row_node_filter(snap, slot: int) -> tuple:
+    """(memo token, node_passes) for a snapshot row: the row's
+    nodeSelector + required-node-affinity filter, applied to census
+    nodes (nodeAffinityPolicy=Honor). Token is content-derived so census
+    memo entries are shared across rows with the same filter."""
+    sel_items = [
+        snap.labels[c] for c in np.flatnonzero(snap.required[slot])
+    ]
+    shape = (
+        snap.affinity_shapes[snap.affinity_id[slot]]
+        if snap.affinity_shapes is not None and snap.affinity_id is not None
+        else ()
+    )
+    token = (tuple(sorted(sel_items)), shape)
+
+    def node_passes(labels: dict) -> bool:
+        if any(labels.get(k) != v for k, v in sel_items):
+            return False
+        return not shape or matches_affinity_shape(labels, shape)
+
+    return token, node_passes
+
+
+def _water_fill(counts: List[int], caps: Optional[List[int]],
+                schedulable: int, seed: int) -> List[int]:
+    """Distribute `schedulable` new replicas over domains that already
+    hold `counts` matching pods, filling the least-loaded first (the
+    only incremental order the skew check always admits: each placement
+    lands on a current global minimum), capped per-domain by `caps`
+    (None = unbounded). Returns per-domain additions. The remainder at
+    the final water level rotates by content-keyed `seed`, so no domain
+    is systematically overweighted across shapes (and the choice never
+    depends on arena-local numbering)."""
+    d = len(counts)
+
+    def filled(level: int) -> int:
+        total = 0
+        for i in range(d):
+            add = max(0, level - counts[i])
+            if caps is not None:
+                add = min(add, caps[i])
+            total += add
+        return total
+
+    lo = min(counts)
+    hi = (
+        max(counts) + schedulable
+        if caps is None
+        else max(c + cap for c, cap in zip(counts, caps))
+    )
+    while lo < hi:  # greatest level with filled(level) <= schedulable
+        mid = (lo + hi + 1) // 2
+        if filled(mid) <= schedulable:
+            lo = mid
+        else:
+            hi = mid - 1
+    level = lo
+    out = []
+    for i in range(d):
+        add = max(0, level - counts[i])
+        if caps is not None:
+            add = min(add, caps[i])
+        out.append(add)
+    remainder = schedulable - sum(out)
+    candidates = [
+        i
+        for i in range(d)
+        if counts[i] + out[i] == level
+        and (caps is None or out[i] < caps[i])
+    ]
+    if remainder and candidates:
+        offset = seed % len(candidates)
+        for j, i in enumerate(candidates):
+            if (j - offset) % len(candidates) < remainder:
+                out[i] += 1
+    return out
+
+
+def _expand_spread_rows(  # lint: allow-complexity — per-domain chunking: each guard is a documented spread rule
+    snap, profiles, row_idx, row_weight, label_dicts_fn, census=None
+):
     """Topology spread (DoNotSchedule, non-hostname keys): partition each
-    constrained row's weight into BALANCED per-domain sub-rows.
+    constrained row's weight into per-domain sub-rows, WATER-FILLED
+    against the existing matching-pod counts per domain (DomainCensus).
 
     The solver assigns a whole weighted row to one group, so skew is
     enforced where it binds — in the GROUP choice: a domain is a distinct
     value of the topologyKey among the group-label INTERSECTIONS (a group
     spanning zones has no single domain value and is excluded, like a node
     missing the key is excluded by the kube-scheduler's PodTopologySpread
-    filter). Balanced chunks (sizes differing by <= 1) satisfy any
-    maxSkew >= 1 by construction; when minDomains exceeds the eligible
-    domain count, the scheduler's global-minimum-0 rule applies — at most
-    maxSkew pods per domain, the excess unschedulable.
-    Approximations, both conservative for a
-    scale-up signal: maxSkew slack beyond 1 is not exploited (the signal
-    may spread wider / mark more unschedulable than a lopsided-but-legal
-    placement), and with multiple constrained keys the split runs on the
-    FIRST key while the others contribute key-presence exclusion only.
-    EXISTING pods per domain (labelSelector counts) need pairwise pod
-    state and stay out of scope (docs/OPERATIONS.md).
+    filter). New replicas fill the least-loaded domains first — the only
+    incremental order the scheduler's skew check always admits — so final
+    totals are as balanced as the existing counts allow, satisfying any
+    maxSkew >= 1. Domains among FILTER-PASSING live nodes that no
+    candidate group serves freeze the global minimum: each eligible
+    domain is then capped at (outside minimum + maxSkew) total, exactly
+    the scheduler's skew bound against a domain a scale-up cannot fill.
+    When minDomains exceeds the eligible domain count, the scheduler's
+    global-minimum-0 rule applies — at most (maxSkew - existing) new
+    pods per domain, the excess unschedulable. A pod that does NOT match
+    its own constraint's selector (selfMatch false, incl. nil selector)
+    never moves the counts: domains whose existing skew already exceeds
+    the bound are excluded, the rest split balanced.
+
+    Approximations, all conservative for a scale-up signal (may spread
+    wider / mark more unschedulable than a lopsided-but-legal placement,
+    never the reverse): maxSkew slack beyond 1 is not exploited when
+    counts are level; with multiple constrained keys the split runs on
+    the FIRST (key, selector) entry while the others contribute
+    key-presence exclusion only; without a census (hand-built snapshot
+    paths) counts are zero and the split is plain balanced.
 
     Returns (row_idx, row_weight, spread_forbidden[rows, T]-or-None);
     unconstrained snapshots pass through untouched.
@@ -466,23 +760,21 @@ def _expand_spread_rows(snap, profiles, row_idx, row_weight, label_dicts_fn):  #
         if not (live_ids != 0).any():
             return row_idx, row_weight, None
 
-    # per live shape: (ordered domain group-lists, maxSkew, minDomains)
+    # per live shape: (namespace, split entry, ordered domain values,
+    # value -> group list)
     plan: Dict[int, tuple] = {}
     for s in np.unique(live_ids):
         shape = shapes[s]
         if not shape:
             continue
-        keys = [key for key, _, _ in shape]
-        split_key, split_skew, split_min_domains = shape[0]
+        namespace, entries = shape
+        keys = [entry[0] for entry in entries]
+        split_key = entries[0][0]
         domains: Dict[str, list] = {}
         for t, labels in enumerate(label_dicts):
             if all(key in labels for key in keys):
                 domains.setdefault(labels[split_key], []).append(t)
-        plan[int(s)] = (
-            [domains[value] for value in sorted(domains)],
-            split_skew,
-            split_min_domains,
-        )
+        plan[int(s)] = (namespace, entries[0], sorted(domains), domains)
 
     out_idx, out_weight, out_forbidden = [], [], []
     for i, sid in enumerate(live_ids):
@@ -492,41 +784,82 @@ def _expand_spread_rows(snap, profiles, row_idx, row_weight, label_dicts_fn):  #
             out_weight.append(row_weight[i])
             out_forbidden.append(np.zeros(n_groups, bool))
             continue
-        domains, skew, min_domains = entry
+        namespace, split, values, domains = entry
+        split_key, skew, min_domains, sel_form, self_match, honor = split
         weight = int(row_weight[i])
-        if not domains or weight == 0:
+        if not values or weight == 0:
             # no group exposes the key(s): unschedulable by spread —
             # keep the row, forbid everything, so the pods are COUNTED
             out_idx.append(row_idx[i])
             out_weight.append(row_weight[i])
             out_forbidden.append(np.ones(n_groups, bool))
             continue
-        d = len(domains)
-        schedulable = weight
-        if min_domains and d < min_domains:
-            # the scheduler's minDomains rule: too few eligible domains
-            # treats the global minimum as 0, so each domain holds at
-            # most maxSkew matching pods; the rest stay unschedulable
-            schedulable = min(weight, d * skew)
-        base, extra = divmod(schedulable, d)
-        # rotate which domains take the +1 remainder, keyed on row
-        # CONTENT (request bytes + weight): a fixed rank order would
-        # systematically overweight the lexicographically first domain
-        # across many constrained shapes, while a position-keyed offset
-        # would depend on arena-local shape numbering and break the
-        # outputs-identical-on-every-encode-path invariant
+        d = len(values)
+        counts: Dict[str, int] = {}
+        present: set = set()
+        if census is not None and sel_form is not None:
+            if honor:
+                token, node_passes = _row_node_filter(snap, row_idx[i])
+            else:
+                # nodeAffinityPolicy=Ignore: every live node exposing
+                # the key defines a domain and contributes counts
+                token, node_passes = ("ignore",), (lambda labels: True)
+            counts, present = census.spread(
+                namespace, sel_form, split_key, token, node_passes
+            )
+        c = [counts.get(value, 0) for value in values]
+        min_rule = bool(min_domains) and d < min_domains
+        # content-keyed remainder rotation (see _water_fill)
         seed = weight + int(
             np.ascontiguousarray(snap.requests[row_idx[i]])
             .view(np.uint8)
             .sum()
         )
-        offset = seed % d
-        for rank, groups in enumerate(domains):
-            chunk = base + (1 if (rank - offset) % d < extra else 0)
+        if not self_match:
+            # placements never accumulate into the counts: the skew
+            # check is static per domain — existing count must stay
+            # within maxSkew of the global minimum (0 under the
+            # minDomains rule); surviving domains split balanced
+            floor = 0 if min_rule else min(
+                [*c, *(counts.get(v, 0) for v in present - set(values))],
+                default=0,
+            )
+            keep = [j for j in range(d) if c[j] - floor <= skew]
+            additions = [0] * d
+            if keep:
+                chunks = _water_fill(
+                    [0] * len(keep), None, weight, seed
+                )
+                for j, k in enumerate(keep):
+                    additions[k] = chunks[j]
+            schedulable = sum(additions)
+        else:
+            if min_rule:
+                # the scheduler's minDomains rule: too few eligible
+                # domains treats the global minimum as 0, so each domain
+                # holds at most maxSkew matching pods INCLUDING the
+                # existing ones; the rest stay unschedulable
+                caps = [max(0, skew - cj) for cj in c]
+            else:
+                outside = present - set(values)
+                m_out = min(
+                    (counts.get(v, 0) for v in outside), default=None
+                )
+                caps = (
+                    None
+                    if m_out is None
+                    else [max(0, m_out + skew - cj) for cj in c]
+                )
+            schedulable = (
+                weight if caps is None else min(weight, sum(caps))
+            )
+            additions = _water_fill(c, caps, schedulable, seed)
+        for rank, value in enumerate(values):
+            chunk = additions[rank]
             if chunk == 0:
                 continue
             forbidden = np.ones(n_groups, bool)
-            forbidden[groups] = False
+            forbidden[domains[value]] = False
             out_idx.append(row_idx[i])
             out_weight.append(np.int32(chunk))
             out_forbidden.append(forbidden)
@@ -587,7 +920,8 @@ def _canonical_row_key(snap, slot: int) -> tuple:
 
 
 def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each guard is a documented anti-affinity rule
-    snap, profiles, row_idx, row_weight, prior_forbidden, label_dicts_fn
+    snap, profiles, row_idx, row_weight, prior_forbidden, label_dicts_fn,
+    census=None,
 ):
     """Required inter-pod SELF-(anti-)affinity (api/core.pod_affinity_shape):
 
@@ -620,7 +954,15 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
     hard spread and domain anti-affinity is split by the anti rule (the
     most balanced placement possible — spread's split is skipped, see
     _expand_spread_rows) while its spread keys contribute key-presence
-    exclusion here. Conservative throughout: the signal may report more
+    exclusion here.
+
+    EXISTING-pod occupancy (`census`, a DomainCensus): domains already
+    holding a scheduled pod matching the workload's selectors are spent
+    for anti-affinity (seeded into the greedy pass), and required
+    co-location pins new replicas to the domains that hold a matching
+    pod — unless NO matching pod exists anywhere (the k8s first-replica
+    bootstrap, which imposes nothing). census=None (hand-built
+    snapshots) means no occupancy: bootstrap semantics throughout. Conservative throughout: the signal may report more
     unschedulable or spread wider than a legal placement, never claim
     feasibility the kube-scheduler would deny for the modeled slice
     (docs/OPERATIONS.md 'Scheduling fidelity').
@@ -668,11 +1010,33 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
         shape = shapes[s]
         if not shape:
             continue
-        hostname_excl, anti_keys, co_keys, _ident = shape
+        hostname_excl, anti_keys, co_keys, ident = shape
         need_keys = [*anti_keys, *co_keys]
+        # existing-pod occupancy (DomainCensus): domains already holding
+        # a replica are spent for anti-affinity; domains holding the
+        # workload's pods are the ONLY ones required co-affinity admits
+        blocked: Dict[str, set] = {}
+        co_allowed = None
+        if census is not None and ident:
+            ident_ns, sel_forms = ident
+            if anti_keys:
+                blocked = census.anti_domains(
+                    ident_ns, sel_forms, anti_keys
+                )
+            if co_keys:
+                co_allowed = census.co_domains(
+                    ident_ns, sel_forms, co_keys
+                )
         excluded = np.zeros(n_groups, bool)
         for t, labels in enumerate(label_dicts):
             if any(key not in labels for key in need_keys):
+                excluded[t] = True
+            elif co_allowed is not None and any(
+                labels[key] not in co_allowed[key] for key in co_keys
+            ):
+                # the workload already runs somewhere: required
+                # self-affinity pins new replicas to domains that hold a
+                # matching pod — groups elsewhere are excluded
                 excluded[t] = True
         domains = None
         if anti_keys:
@@ -698,7 +1062,12 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
                 ).append(t)
             best: Optional[tuple] = None
             for co_vec in sorted(buckets):
-                used: List[set] = [set() for _ in anti_keys]
+                # domains an EXISTING replica occupies are spent: seed
+                # the per-key used sets so no new replica shares any
+                # key's value with a pod already placed
+                used: List[set] = [
+                    set(blocked.get(key, ())) for key in anti_keys
+                ]
                 selected = []
                 for anti_vec in sorted(buckets[co_vec]):
                     if any(
@@ -778,7 +1147,7 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
             and spread_shapes is not None
         ):
             # excluded is already a fresh per-row array (| prior above)
-            for key, _skew, _mind in spread_shapes[live_spread[i]]:
+            for key, *_rest in spread_shapes[live_spread[i]][1]:
                 for t, labels in enumerate(label_dicts):
                     if key not in labels:
                         excluded[t] = True
@@ -814,7 +1183,7 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
     )
 
 
-def _encode_from_cache(snap, profiles, with_rows: bool = False):  # lint: allow-complexity — THE single encoder; splitting would smear the output-equality invariant
+def _encode_from_cache(snap, profiles, with_rows: bool = False, census=None):  # lint: allow-complexity — THE single encoder; splitting would smear the output-equality invariant
     """Snapshot (store/columnar.PendingSnapshot) -> solver inputs, with
     rows DEDUPLICATED into distinct pod shapes + multiplicities
     (pod_weight) — see _dedup_rows. Every solve path (feed, pod_cache,
@@ -843,7 +1212,8 @@ def _encode_from_cache(snap, profiles, with_rows: bool = False):  # lint: allow-
     # chunk masked to its domain's groups) — the device program is
     # unchanged, spread rides the existing forbidden-mask operand
     row_idx, row_weight, spread_forbidden = _expand_spread_rows(
-        snap, profiles, row_idx, row_weight, group_label_dicts
+        snap, profiles, row_idx, row_weight, group_label_dicts,
+        census=census,
     )
     # required self pod-(anti-)affinity: hostname rows flag the
     # pod_exclusive operand, domain keys cap one replica per domain
@@ -851,7 +1221,7 @@ def _encode_from_cache(snap, profiles, with_rows: bool = False):  # lint: allow-
     row_idx, row_weight, spread_forbidden, row_exclusive = (
         _expand_anti_rows(
             snap, profiles, row_idx, row_weight, spread_forbidden,
-            group_label_dicts,
+            group_label_dicts, census=census,
         )
     )
     hi = len(row_idx)

@@ -24,11 +24,16 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from karpenter_tpu.metrics.producers.pendingcapacity import (
+    DomainCensus,
     _encode_from_cache,
     _group_profile,
 )
 from karpenter_tpu.ops import binpack as B
-from karpenter_tpu.store.columnar import PendingPodCache, is_pending
+from karpenter_tpu.store.columnar import (
+    PendingPodCache,
+    is_pending,
+    occupancy_from_pods,
+)
 
 
 def _what_if_profile(spec: dict) -> Tuple[Dict[str, float], set, set]:
@@ -129,7 +134,8 @@ def simulate(  # lint: allow-complexity — report assembly: one guard per optio
 
     # detached encode with a slot -> pod-name map for per-row reporting
     # (snapshot rows are arena slots; snapshot_from_pods hides the map)
-    pods = [pod for pod in store.list("Pod") if is_pending(pod)]
+    all_pods = store.list("Pod")
+    pods = [pod for pod in all_pods if is_pending(pod)]
     cache = PendingPodCache(store=None, capacity=max(16, len(pods)))
     slot_pod: Dict[int, str] = {}
     for pod in pods:
@@ -138,8 +144,12 @@ def simulate(  # lint: allow-complexity — report assembly: one guard per optio
         slot_pod[cache._slot[key]] = f"{key[0]}/{key[1]}"
     snap = cache.snapshot()
 
+    # existing-pod domain occupancy, exactly like the production solve:
+    # census nodes are the REAL ones (a what-if group's domains hold no
+    # existing pods by construction)
+    census = DomainCensus(occupancy_from_pods(all_pods), lambda: nodes)
     inputs, row_idx, row_weight = _encode_from_cache(
-        snap, profiles, with_rows=True
+        snap, profiles, with_rows=True, census=census
     )
     if what_if_names and inputs.pod_group_score is not None:
         # preferred node affinity must not STEER pods into hypothetical

@@ -37,6 +37,7 @@ drift from the documented list semantics.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
@@ -215,7 +216,11 @@ class PendingPodCache:
             tolerations=list(pod.spec.tolerations),
             affinity=_affinity_shape(pod.spec.affinity),
             preferred=_preferred_shape(pod.spec.affinity),
-            spread=_spread_shape(pod.spec.topology_spread_constraints),
+            spread=_spread_shape(
+                pod.spec.topology_spread_constraints,
+                pod.metadata.namespace,
+                pod.metadata.labels,
+            ),
             anti=_pod_affinity_shape(
                 pod.spec.affinity,
                 pod.metadata.labels,
@@ -604,6 +609,107 @@ class ReservationsCache:
             return totals
 
 
+def is_counted(pod) -> bool:
+    """Occupancy set: pods BOUND to a node and not terminal — the pods
+    the kube-scheduler counts when evaluating topology spread skew and
+    inter-pod (anti-)affinity domains against an incoming pod. Assigned-
+    but-still-Pending pods count (they hold their domain); Succeeded/
+    Failed pods don't block a domain the scheduler would reuse."""
+    return bool(pod.spec.node_name) and pod.status.phase not in (
+        "Succeeded",
+        "Failed",
+    )
+
+
+class ScheduledOccupancy:
+    """Watch-maintained census of SCHEDULED pods, grouped by
+    (namespace, exact label set) with per-node counts — the existing-pod
+    side of topology-spread skew and self-(anti-)affinity domain
+    occupancy (producers/pendingcapacity.DomainCensus).
+
+    Shape: {namespace: {labels_items_tuple: {node_name: count}}}.
+    Replicated workloads collapse to one label group per namespace
+    (plus one per pod for per-pod labels like the StatefulSet pod-name
+    label), so selector evaluation downstream is O(distinct label sets),
+    not O(pods). Event-time cost is O(1) per pod transition.
+
+    Readers MUST use view(): queries iterate the group dicts, and a
+    watch event mutating mid-iteration would throw — the context
+    manager holds the lock for the (short) duration of a census query.
+    store=None builds a detached census (occupancy_from_pods).
+    """
+
+    def __init__(self, store: Optional[Store] = None):
+        self._lock = threading.Lock()
+        self._generation = 0
+        self._spaces: Dict[str, Dict[tuple, Dict[str, int]]] = {}
+        # pod key -> (namespace, labels_items, node_name) for exact undo
+        self._pods: Dict[Tuple[str, str], Tuple[str, tuple, str]] = {}
+        if store is not None:
+            _adopt_and_watch(store, "Pod", self._on_event)
+
+    def _on_event(self, event: str, pod) -> None:
+        key = (pod.metadata.namespace, pod.metadata.name)
+        entry = None
+        if event != DELETED and is_counted(pod):
+            entry = (
+                pod.metadata.namespace,
+                tuple(sorted(pod.metadata.labels.items())),
+                pod.spec.node_name,
+            )
+        with self._lock:
+            prev = self._pods.get(key)
+            if prev == entry:
+                return
+            self._generation += 1
+            if prev is not None:
+                namespace, labels, node = prev
+                groups = self._spaces.get(namespace, {})
+                nodes = groups.get(labels)
+                if nodes is not None:
+                    count = nodes.get(node, 0) - 1
+                    if count > 0:
+                        nodes[node] = count
+                    else:
+                        nodes.pop(node, None)
+                        if not nodes:
+                            del groups[labels]
+                            if not groups:
+                                del self._spaces[namespace]
+            if entry is None:
+                self._pods.pop(key, None)
+            else:
+                self._pods[key] = entry
+                namespace, labels, node = entry
+                nodes = self._spaces.setdefault(namespace, {}).setdefault(
+                    labels, {}
+                )
+                nodes[node] = nodes.get(node, 0) + 1
+
+    @property
+    def generation(self) -> int:
+        """Mutation counter — downstream query memos key on it."""
+        with self._lock:
+            return self._generation
+
+    @contextlib.contextmanager
+    def view(self):
+        """(generation, {namespace: {labels_items: {node: count}}})
+        under the census lock — treat as read-only, don't retain past
+        the with-block."""
+        with self._lock:
+            yield self._generation, self._spaces
+
+
+def occupancy_from_pods(pods) -> ScheduledOccupancy:
+    """Oracle path: one-shot census of a pod list through the SAME
+    accounting the watch-maintained census uses (detached mode)."""
+    census = ScheduledOccupancy(store=None)
+    for pod in pods:
+        census._on_event("Added", pod)
+    return census
+
+
 class ProducerSelectorIndex:
     """Watch-maintained {key: (node_selector, node_group_ref)} of every
     pendingCapacity MetricsProducer — the solve needs ONLY the selector
@@ -662,6 +768,10 @@ class PendingFeed:
             else NodeMirror(store, profile_fn)
         )
         self.producers = ProducerSelectorIndex(store)
+        # existing-pod domain occupancy for spread/anti fidelity; the
+        # solve path lazily attaches its memoizing DomainCensus here
+        self.occupancy = ScheduledOccupancy(store)
+        self.census = None
         # owned by the feed, WRITTEN by the solve path
         # (metrics/producers/pendingcapacity.solve_pending): memoizes the
         # last (fingerprint, BinPackInputs) so an unchanged fleet reuses

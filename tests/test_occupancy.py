@@ -1,0 +1,700 @@
+"""Existing-pod domain occupancy: the pending-pods signal evaluated
+against the pods the cluster has ALREADY placed.
+
+The kube-scheduler counts existing matching pods per topology domain
+when it checks topologySpreadConstraints skew and required inter-pod
+(anti-)affinity; a signal that ignores them can promise a placement
+(e.g. a replica into a zone that already holds one) the scheduler then
+refuses. store/columnar.ScheduledOccupancy maintains the census
+incrementally; producers/pendingcapacity.DomainCensus answers the
+spread/anti expansions.
+
+reference anchor: the reference stubs the whole producer
+(pendingcapacity/producer.go:29-31); the fidelity bar here is the
+kube-scheduler's PodTopologySpread and InterPodAffinity filters.
+"""
+
+import pytest
+
+from karpenter_tpu.api.core import (
+    Affinity,
+    Container,
+    LabelSelector,
+    Node,
+    NodeCondition,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    PodSpec,
+    PodStatus,
+    TopologySpreadConstraint,
+    resource_list,
+)
+from karpenter_tpu.api.metricsproducer import (
+    MetricsProducer,
+    MetricsProducerSpec,
+    PendingCapacitySpec,
+)
+from karpenter_tpu.cloudprovider.fake import FakeFactory
+from karpenter_tpu.runtime import KarpenterRuntime
+
+ZONE_KEY = "topology.kubernetes.io/zone"
+
+
+class FakeClock:
+    def __init__(self, start=1000.0):
+        self.now = start
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, seconds):
+        self.now += seconds
+
+
+@pytest.fixture
+def env():
+    provider = FakeFactory()
+    clock = FakeClock()
+    runtime = KarpenterRuntime(cloud_provider_factory=provider, clock=clock)
+    runtime.clock = clock
+    return runtime, provider
+
+
+def ready_node(name, labels, cpu="64", memory="64Gi", pods="110"):
+    return Node(
+        metadata=ObjectMeta(name=name, labels=dict(labels)),
+        spec=NodeSpec(),
+        status=NodeStatus(
+            allocatable=resource_list(cpu=cpu, memory=memory, pods=pods),
+            conditions=[NodeCondition("Ready", "True")],
+        ),
+    )
+
+
+def pending_mp(name, selector):
+    return MetricsProducer(
+        metadata=ObjectMeta(name=name),
+        spec=MetricsProducerSpec(
+            pending_capacity=PendingCapacitySpec(node_selector=dict(selector))
+        ),
+    )
+
+
+def bound_pod(name, labels, node, phase="Running", namespace="default"):
+    """A pod the scheduler already placed — the occupancy the census
+    counts (assigned and not terminal)."""
+    return Pod(
+        metadata=ObjectMeta(
+            name=name, namespace=namespace, labels=dict(labels)
+        ),
+        spec=PodSpec(
+            node_name=node,
+            containers=[
+                Container(requests=resource_list(cpu="1", memory="1Gi"))
+            ],
+        ),
+        status=PodStatus(phase=phase),
+    )
+
+
+def spread_pod(name, labels, selector=None, max_skew=1, min_domains=None,
+               node_selector=None):
+    """A pending pod with one hard zone-spread constraint; selector
+    defaults to the pod's own labels (the realistic workload shape)."""
+    pod = Pod(
+        metadata=ObjectMeta(name=name, labels=dict(labels)),
+        spec=PodSpec(
+            node_name="",
+            containers=[
+                Container(requests=resource_list(cpu="1", memory="1Gi"))
+            ],
+            node_selector=dict(node_selector or {}),
+        ),
+    )
+    pod.spec.topology_spread_constraints = [
+        TopologySpreadConstraint(
+            max_skew=max_skew,
+            topology_key=ZONE_KEY,
+            when_unsatisfiable="DoNotSchedule",
+            label_selector={
+                "matchLabels": dict(selector if selector is not None
+                                    else labels)
+            },
+            min_domains=min_domains,
+        )
+    ]
+    return pod
+
+
+def anti_pod(name, labels=None, keys=(ZONE_KEY,), co_keys=(),
+             selector_labels=None):
+    """A pending pod with required self-anti-affinity on `keys` and
+    required self-affinity (co-location) on `co_keys`."""
+    labels = dict(labels or {"app": "db"})
+    pod = Pod(
+        metadata=ObjectMeta(name=name, labels=labels),
+        spec=PodSpec(
+            node_name="",
+            containers=[
+                Container(requests=resource_list(cpu="1", memory="1Gi"))
+            ],
+        ),
+    )
+    selector = LabelSelector(
+        match_labels=dict(selector_labels or labels)
+    )
+    pod.spec.affinity = Affinity(
+        pod_anti_affinity=(
+            PodAntiAffinity(
+                required_during_scheduling_ignored_during_execution=[
+                    PodAffinityTerm(label_selector=selector, topology_key=k)
+                    for k in keys
+                ]
+            )
+            if keys
+            else None
+        ),
+        pod_affinity=(
+            PodAffinity(
+                required_during_scheduling_ignored_during_execution=[
+                    PodAffinityTerm(label_selector=selector, topology_key=k)
+                    for k in co_keys
+                ]
+            )
+            if co_keys
+            else None
+        ),
+    )
+    return pod
+
+
+def zoned(runtime, zones=("a", "b"), extra_node_labels=None):
+    for z in zones:
+        labels = {"group": z, ZONE_KEY: f"us-{z}"}
+        labels.update(extra_node_labels or {})
+        runtime.store.create(ready_node(f"n-{z}", labels))
+        runtime.store.create(pending_mp(f"group-{z}", {"group": z}))
+
+
+def pods_per_group(runtime, names):
+    return {
+        n: runtime.store.get("MetricsProducer", "default", n)
+        .status.pending_capacity.pending_pods
+        for n in names
+    }
+
+
+def total_unschedulable(runtime, name):
+    return (
+        runtime.store.get("MetricsProducer", "default", name)
+        .status.pending_capacity.unschedulable_pods
+    )
+
+
+class TestScheduledOccupancy:
+    """The incremental census itself (store/columnar)."""
+
+    def _store(self):
+        from karpenter_tpu.store.store import Store
+
+        return Store()
+
+    def test_counts_bound_nonterminal_pods_only(self):
+        from karpenter_tpu.store.columnar import ScheduledOccupancy
+
+        store = self._store()
+        census = ScheduledOccupancy(store)
+        store.create(bound_pod("running", {"app": "web"}, "n1"))
+        store.create(bound_pod("done", {"app": "web"}, "n1",
+                               phase="Succeeded"))
+        store.create(bound_pod("crashed", {"app": "web"}, "n1",
+                               phase="Failed"))
+        store.create(
+            Pod(metadata=ObjectMeta(name="pending",
+                                    labels={"app": "web"}),
+                spec=PodSpec(node_name=""))
+        )
+        with census.view() as (_, spaces):
+            key = ("default", (("app", "web"),))
+            assert spaces["default"][key[1]] == {"n1": 1}
+
+    def test_rebind_and_delete_undo_exactly(self):
+        from karpenter_tpu.store.columnar import ScheduledOccupancy
+
+        store = self._store()
+        census = ScheduledOccupancy(store)
+        pod = bound_pod("p", {"app": "web"}, "n1")
+        store.create(pod)
+        g1 = census.generation
+        moved = bound_pod("p", {"app": "web"}, "n2")
+        moved.metadata.resource_version = pod.metadata.resource_version
+        store.update(moved)
+        with census.view() as (_, spaces):
+            assert spaces["default"][(("app", "web"),)] == {"n2": 1}
+        assert census.generation > g1
+        store.delete("Pod", "default", "p")
+        with census.view() as (_, spaces):
+            assert spaces == {}
+
+    def test_no_op_update_keeps_generation(self):
+        from karpenter_tpu.store.columnar import ScheduledOccupancy
+
+        store = self._store()
+        census = ScheduledOccupancy(store)
+        pod = bound_pod("p", {"app": "web"}, "n1")
+        store.create(pod)
+        g = census.generation
+        census._on_event("Modified", pod)  # same placement
+        assert census.generation == g
+
+    def test_detached_matches_watch_maintained(self):
+        from karpenter_tpu.store.columnar import (
+            ScheduledOccupancy,
+            occupancy_from_pods,
+        )
+
+        store = self._store()
+        census = ScheduledOccupancy(store)
+        for i in range(3):
+            store.create(bound_pod(f"p{i}", {"app": "web"}, f"n{i % 2}"))
+        oracle = occupancy_from_pods(store.list("Pod"))
+        with census.view() as (_, live):
+            with oracle.view() as (_, detached):
+                assert live == detached
+
+
+class TestSpreadOccupancy:
+    """Water-filled spread splits against existing per-domain counts."""
+
+    def test_new_replicas_fill_less_loaded_domains(self, env):
+        """2 existing replicas in zone a: 4 new ones go 1/3 so final
+        totals level at 3/3 — the scheduler's skew check admits exactly
+        the least-loaded-first order."""
+        runtime, _ = env
+        zoned(runtime)
+        for i in range(2):
+            runtime.store.create(
+                bound_pod(f"old{i}", {"app": "web"}, "n-a")
+            )
+        for i in range(4):
+            runtime.store.create(spread_pod(f"new{i}", {"app": "web"}))
+        runtime.manager.reconcile_all()
+        assert pods_per_group(runtime, ["group-a", "group-b"]) == {
+            "group-a": 1,
+            "group-b": 3,
+        }
+        assert total_unschedulable(runtime, "group-a") == 0
+
+    def test_unfillable_outside_domain_caps_by_skew(self, env):
+        """A zone among filter-passing live nodes that NO candidate
+        group serves freezes the global minimum (the well-known k8s
+        spread footgun): each eligible domain caps at outside-min +
+        maxSkew, the excess is unschedulable."""
+        runtime, _ = env
+        zoned(runtime)
+        runtime.store.create(
+            ready_node("unmanaged", {ZONE_KEY: "us-c"})
+        )
+        for i in range(5):
+            runtime.store.create(spread_pod(f"p{i}", {"app": "web"}))
+        runtime.manager.reconcile_all()
+        assert pods_per_group(runtime, ["group-a", "group-b"]) == {
+            "group-a": 1,
+            "group-b": 1,
+        }
+        assert total_unschedulable(runtime, "group-a") == 3
+
+    def test_node_filter_excludes_outside_domain(self, env):
+        """Same topology, but the pods' nodeSelector excludes the
+        unmanaged node (nodeAffinityPolicy=Honor): its zone defines no
+        domain for these pods and the split is plain balanced."""
+        runtime, _ = env
+        zoned(runtime, extra_node_labels={"tier": "app"})
+        runtime.store.create(
+            ready_node("unmanaged", {ZONE_KEY: "us-c"})
+        )
+        for i in range(5):
+            runtime.store.create(
+                spread_pod(f"p{i}", {"app": "web"},
+                           node_selector={"tier": "app"})
+            )
+        runtime.manager.reconcile_all()
+        counts = pods_per_group(runtime, ["group-a", "group-b"])
+        assert sorted(counts.values()) == [2, 3]
+        assert total_unschedulable(runtime, "group-a") == 0
+
+    def test_min_domains_cap_subtracts_existing(self, env):
+        """minDomains unsatisfied treats the global minimum as 0: each
+        domain holds at most maxSkew matching pods INCLUDING existing
+        ones."""
+        runtime, _ = env
+        zoned(runtime)
+        runtime.store.create(bound_pod("old", {"app": "web"}, "n-a"))
+        for i in range(6):
+            runtime.store.create(
+                spread_pod(f"p{i}", {"app": "web"}, max_skew=2,
+                           min_domains=3)
+            )
+        runtime.manager.reconcile_all()
+        # caps: zone a 2-1=1, zone b 2-0=2 -> 3 schedulable, 3 stuck
+        assert pods_per_group(runtime, ["group-a", "group-b"]) == {
+            "group-a": 1,
+            "group-b": 2,
+        }
+        assert total_unschedulable(runtime, "group-a") == 3
+
+    def test_non_self_matching_selector_is_static_exclusion(self, env):
+        """A pod that does not match its own constraint's selector never
+        moves the counts (selfMatchNum=0): domains whose existing skew
+        already exceeds maxSkew are excluded, the rest are unbounded."""
+        runtime, _ = env
+        zoned(runtime)
+        for i in range(2):
+            runtime.store.create(
+                bound_pod(f"other{i}", {"app": "other"}, "n-a")
+            )
+        for i in range(4):
+            runtime.store.create(
+                spread_pod(f"p{i}", {"app": "web"},
+                           selector={"app": "other"})
+            )
+        runtime.manager.reconcile_all()
+        # zone a holds skew 2 > maxSkew 1 over zone b's 0: excluded
+        assert pods_per_group(runtime, ["group-a", "group-b"]) == {
+            "group-a": 0,
+            "group-b": 4,
+        }
+
+    def test_node_affinity_policy_ignore_counts_all_nodes(self, env):
+        """nodeAffinityPolicy: Ignore — the unmanaged node's zone
+        defines a domain even though the pods' nodeSelector excludes
+        it, so the frozen-minimum cap applies (the inverse of
+        test_node_filter_excludes_outside_domain)."""
+        runtime, _ = env
+        zoned(runtime, extra_node_labels={"tier": "app"})
+        runtime.store.create(
+            ready_node("unmanaged", {ZONE_KEY: "us-c"})
+        )
+        for i in range(5):
+            pod = spread_pod(f"p{i}", {"app": "web"},
+                             node_selector={"tier": "app"})
+            pod.spec.topology_spread_constraints[0].node_affinity_policy = (
+                "Ignore"
+            )
+            runtime.store.create(pod)
+        runtime.manager.reconcile_all()
+        assert pods_per_group(runtime, ["group-a", "group-b"]) == {
+            "group-a": 1,
+            "group-b": 1,
+        }
+        assert total_unschedulable(runtime, "group-a") == 3
+
+    def test_anti_census_is_fresh_across_ticks(self, env):
+        """Regression (r3 code review): the census memo must be dropped
+        when occupancy changes — a replica bound between ticks spends
+        its domain on the very next solve, on the PERSISTENT feed-path
+        census."""
+        runtime, _ = env
+        zoned(runtime, zones=("a", "b"))
+        for i in range(2):
+            runtime.store.create(anti_pod(f"db-{i}"))
+        runtime.manager.reconcile_all()
+        counts = pods_per_group(runtime, ["group-a", "group-b"])
+        assert sorted(counts.values()) == [1, 1]
+        # one replica lands: bind it where the solver put it (zone a),
+        # and keep the OTHER one pending
+        runtime.store.delete("Pod", "default", "db-0")
+        runtime.store.create(
+            bound_pod(
+                "db-0",
+                {"app": "db"},
+                "n-a",
+            )
+        )
+        runtime.clock.advance(6)  # past the 5 s producer interval
+        runtime.manager.reconcile_all()
+        # zone a is now spent: the remaining replica must sit in b only
+        assert pods_per_group(runtime, ["group-a", "group-b"]) == {
+            "group-a": 0,
+            "group-b": 1,
+        }
+
+    def test_namespaces_do_not_share_counts(self, env):
+        """Occupancy is namespace-scoped like the scheduler's: another
+        namespace's identical pods don't skew this workload."""
+        runtime, _ = env
+        zoned(runtime)
+        for i in range(2):
+            runtime.store.create(
+                bound_pod(f"old{i}", {"app": "web"}, "n-a",
+                          namespace="elsewhere")
+            )
+        for i in range(4):
+            runtime.store.create(spread_pod(f"p{i}", {"app": "web"}))
+        runtime.manager.reconcile_all()
+        counts = pods_per_group(runtime, ["group-a", "group-b"])
+        assert sorted(counts.values()) == [2, 2]
+
+    def test_all_encode_paths_agree_with_occupancy(self):
+        """Oracle, pod-cache, and feed paths must emit identical
+        statuses when existing pods shape the split."""
+        from karpenter_tpu.metrics.producers.pendingcapacity import (
+            _group_profile,
+            solve_pending,
+        )
+        from karpenter_tpu.metrics.registry import GaugeRegistry
+        from karpenter_tpu.store.columnar import (
+            PendingFeed,
+            PendingPodCache,
+        )
+        from karpenter_tpu.store.store import Store
+
+        store = Store()
+        cache = PendingPodCache(store)
+        feed = PendingFeed(store, _group_profile)
+        for z in ("a", "b"):
+            store.create(
+                ready_node(f"n-{z}", {"group": z, ZONE_KEY: f"us-{z}"})
+            )
+            store.create(pending_mp(f"group-{z}", {"group": z}))
+        store.create(bound_pod("old", {"app": "web"}, "n-a"))
+        for i in range(3):
+            store.create(spread_pod(f"p{i}", {"app": "web"}))
+        store.create(anti_pod("db-0"))
+        store.create(bound_pod("db-live", {"app": "db"}, "n-b"))
+
+        results = []
+        for kwargs in ({}, {"pod_cache": cache}, {"feed": feed}):
+            mps = [
+                mp for mp in store.list("MetricsProducer")
+                if mp.spec.pending_capacity is not None
+            ]
+            solve_pending(store, mps, GaugeRegistry(), **kwargs)
+            results.append(
+                {
+                    mp.metadata.name: (
+                        mp.status.pending_capacity.pending_pods,
+                        mp.status.pending_capacity.unschedulable_pods,
+                    )
+                    for mp in mps
+                }
+            )
+        assert results[0] == results[1] == results[2]
+        # spread: a holds 1 -> water-fill sends 2 to b, 1 to a;
+        # anti: db-live occupies zone b -> the db replica lands in a
+        assert results[0]["group-a"] == (2, 0)
+        assert results[0]["group-b"] == (2, 0)
+
+
+class TestAntiAffinityOccupancy:
+    """Occupied domains are spent; co-location pins to existing pods."""
+
+    def test_occupied_zone_is_spent(self, env):
+        """An existing replica in zone a: 3 new zone-anti replicas have
+        only zones b and c left — one each, one unschedulable."""
+        runtime, _ = env
+        zoned(runtime, zones=("a", "b", "c"))
+        runtime.store.create(bound_pod("db-live", {"app": "db"}, "n-a"))
+        for i in range(3):
+            runtime.store.create(anti_pod(f"db-{i}"))
+        runtime.manager.reconcile_all()
+        assert pods_per_group(
+            runtime, ["group-a", "group-b", "group-c"]
+        ) == {"group-a": 0, "group-b": 1, "group-c": 1}
+        assert total_unschedulable(runtime, "group-a") == 1
+
+    def test_statefulset_labels_still_block(self, env):
+        """The existing replica carries per-pod labels (the StatefulSet
+        pod-name label); it matches the workload's SELECTOR and must
+        still spend its zone."""
+        runtime, _ = env
+        zoned(runtime, zones=("a", "b"))
+        runtime.store.create(
+            bound_pod(
+                "db-0",
+                {"app": "db",
+                 "statefulset.kubernetes.io/pod-name": "db-0"},
+                "n-a",
+            )
+        )
+        runtime.store.create(
+            anti_pod(
+                "db-1",
+                labels={"app": "db",
+                        "statefulset.kubernetes.io/pod-name": "db-1"},
+                selector_labels={"app": "db"},
+            )
+        )
+        runtime.manager.reconcile_all()
+        assert pods_per_group(runtime, ["group-a", "group-b"]) == {
+            "group-a": 0,
+            "group-b": 1,
+        }
+
+    def test_foreign_pods_do_not_block(self, env):
+        """Scheduled pods that don't match the workload selector leave
+        its domains free."""
+        runtime, _ = env
+        zoned(runtime, zones=("a", "b"))
+        runtime.store.create(bound_pod("web", {"app": "web"}, "n-a"))
+        for i in range(2):
+            runtime.store.create(anti_pod(f"db-{i}"))
+        runtime.manager.reconcile_all()
+        counts = pods_per_group(runtime, ["group-a", "group-b"])
+        assert sorted(counts.values()) == [1, 1]
+        assert total_unschedulable(runtime, "group-a") == 0
+
+    def test_co_location_pins_to_existing_domain(self, env):
+        """Required self-affinity with a live replica: new replicas must
+        join a domain that already holds a matching pod."""
+        runtime, _ = env
+        zoned(runtime, zones=("a", "b", "c"))
+        runtime.store.create(bound_pod("db-live", {"app": "db"}, "n-b"))
+        for i in range(3):
+            runtime.store.create(
+                anti_pod(f"db-{i}", keys=(), co_keys=(ZONE_KEY,))
+            )
+        runtime.manager.reconcile_all()
+        assert pods_per_group(
+            runtime, ["group-a", "group-b", "group-c"]
+        ) == {"group-a": 0, "group-b": 3, "group-c": 0}
+
+    def test_co_location_bootstrap_without_existing_pods(self, env):
+        """No matching pod anywhere: the k8s first-replica special case
+        — the term imposes nothing beyond one-domain co-location."""
+        runtime, _ = env
+        zoned(runtime, zones=("a", "b"))
+        for i in range(3):
+            runtime.store.create(
+                anti_pod(f"db-{i}", keys=(), co_keys=(ZONE_KEY,))
+            )
+        runtime.manager.reconcile_all()
+        counts = pods_per_group(runtime, ["group-a", "group-b"])
+        assert sorted(counts.values()) == [0, 3]
+        assert total_unschedulable(runtime, "group-a") == 0
+
+    def test_co_and_anti_with_existing_pods(self, env):
+        """Rack anti + zone co with a live replica: new replicas join
+        the live zone but must take fresh racks."""
+        runtime, _ = env
+        rack = "topology.kubernetes.io/rack"
+        for z, r in (("a", "r1"), ("b", "r2"), ("c", "r3")):
+            zone = "z1" if z in ("a", "b") else "z2"
+            runtime.store.create(
+                ready_node(
+                    f"n-{z}",
+                    {"group": z, ZONE_KEY: zone, rack: r},
+                )
+            )
+            runtime.store.create(pending_mp(f"group-{z}", {"group": z}))
+        # live replica in zone z1 / rack r1
+        runtime.store.create(bound_pod("db-live", {"app": "db"}, "n-a"))
+        for i in range(2):
+            runtime.store.create(
+                anti_pod(f"db-{i}", keys=(rack,), co_keys=(ZONE_KEY,))
+            )
+        runtime.manager.reconcile_all()
+        # zone pinned to z1 (groups a, b); rack r1 spent -> only b fits
+        # one replica; the second has no rack left in z1
+        assert pods_per_group(
+            runtime, ["group-a", "group-b", "group-c"]
+        ) == {"group-a": 0, "group-b": 1, "group-c": 0}
+        assert total_unschedulable(runtime, "group-a") == 1
+
+
+class TestEncodeMemoWithOccupancy:
+    """Bound-pod churn must not thrash the encode memo of fleets without
+    spread/anti constraints — and must invalidate it for fleets with."""
+
+    def _solve(self, store, feed, counter):
+        from karpenter_tpu.metrics.producers import pendingcapacity as PC
+        from karpenter_tpu.metrics.registry import GaugeRegistry
+
+        mps = [
+            mp for mp in store.list("MetricsProducer")
+            if mp.spec.pending_capacity is not None
+        ]
+        PC.solve_pending(store, mps, GaugeRegistry(), feed=feed)
+        return counter[0]
+
+    @pytest.fixture
+    def counting_encode(self, monkeypatch):
+        from karpenter_tpu.metrics.producers import pendingcapacity as PC
+
+        counter = [0]
+        real = PC._encode_from_cache
+
+        def counting(*args, **kwargs):
+            counter[0] += 1
+            return real(*args, **kwargs)
+
+        monkeypatch.setattr(PC, "_encode_from_cache", counting)
+        return counter
+
+    def test_unconstrained_fleet_ignores_bound_churn(self, counting_encode):
+        from karpenter_tpu.metrics.producers.pendingcapacity import (
+            _group_profile,
+        )
+        from karpenter_tpu.store.columnar import PendingFeed
+        from karpenter_tpu.store.store import Store
+
+        store = Store()
+        feed = PendingFeed(store, _group_profile)
+        store.create(ready_node("n1", {"group": "a"}))
+        store.create(pending_mp("group-a", {"group": "a"}))
+        store.create(
+            Pod(metadata=ObjectMeta(name="p0"),
+                spec=PodSpec(
+                    node_name="",
+                    containers=[Container(
+                        requests=resource_list(cpu="1", memory="1Gi"))],
+                ))
+        )
+        assert self._solve(store, feed, counting_encode) == 1
+        store.create(bound_pod("scheduled", {"app": "web"}, "n1"))
+        assert self._solve(store, feed, counting_encode) == 1  # memo hit
+
+    def test_constrained_fleet_reencodes_on_bound_churn(
+        self, counting_encode
+    ):
+        from karpenter_tpu.metrics.producers.pendingcapacity import (
+            _group_profile,
+        )
+        from karpenter_tpu.store.columnar import PendingFeed
+        from karpenter_tpu.store.store import Store
+
+        store = Store()
+        feed = PendingFeed(store, _group_profile)
+        store.create(ready_node("n1", {"group": "a", ZONE_KEY: "us-a"}))
+        store.create(pending_mp("group-a", {"group": "a"}))
+        store.create(spread_pod("p0", {"app": "web"}))
+        assert self._solve(store, feed, counting_encode) == 1
+        store.create(bound_pod("scheduled", {"app": "web"}, "n1"))
+        # the mask inputs depend on occupancy now: must re-encode
+        assert self._solve(store, feed, counting_encode) == 2
+
+
+class TestSimulateWithOccupancy:
+    def test_simulation_respects_existing_replicas(self):
+        """The dry-run solve sees the same census the production tick
+        does: an occupied zone never receives the simulated replica."""
+        from karpenter_tpu.simulate import simulate
+        from karpenter_tpu.store.store import Store
+
+        store = Store()
+        for z in ("a", "b"):
+            store.create(
+                ready_node(f"n-{z}", {"group": z, ZONE_KEY: f"us-{z}"})
+            )
+            store.create(pending_mp(f"group-{z}", {"group": z}))
+        store.create(bound_pod("db-live", {"app": "db"}, "n-a"))
+        store.create(anti_pod("db-1"))
+        report = simulate(store)
+        assert report["groups"]["default/group-a"]["pending_pods"] == 0
+        assert report["groups"]["default/group-b"]["pending_pods"] == 1
+        assert report["unschedulable_pods"] == 0

@@ -797,18 +797,23 @@ class TestTopologySpread:
     def test_min_domains_caps_per_domain_at_max_skew(self, env):
         """minDomains > eligible domains: the scheduler treats the global
         minimum as 0, so each domain holds at most maxSkew pods and the
-        excess is unschedulable (core/v1 minDomains semantics)."""
+        excess is unschedulable (core/v1 minDomains semantics). The
+        selector matches the pods' own labels — the realistic workload
+        shape; only then do placed replicas accumulate into the skew
+        (selfMatchNum), which is what the cap binds through."""
         from karpenter_tpu.api.core import TopologySpreadConstraint
 
         runtime, provider, clock = env
         self._zoned(runtime, zones=("a", "b"))
         for i in range(10):
             pod = pending_pod(f"p{i}", memory="1Gi")
+            pod.metadata.labels = {"app": "web"}
             pod.spec.topology_spread_constraints = [
                 TopologySpreadConstraint(
                     max_skew=2,
                     topology_key=ZONE_KEY,
                     when_unsatisfiable="DoNotSchedule",
+                    label_selector={"matchLabels": {"app": "web"}},
                     min_domains=3,
                 )
             ]
