@@ -204,11 +204,12 @@ class Affinity:
 class TopologySpreadConstraint:
     """core/v1 TopologySpreadConstraint. The solver honors DoNotSchedule
     constraints via water-filled domain splitting against the EXISTING
-    matching-pod counts per domain — labelSelector drives the census
+    matching-pod counts per domain — labelSelector (refined by
+    matchLabelKeys with the pod's own values) drives the census
     (producers/pendingcapacity.DomainCensus) exactly as the scheduler's
-    skew check counts it. ScheduleAnyway is a scheduler preference and
-    matchLabelKeys a selector refinement: both decoded, not modeled
-    (docs/OPERATIONS.md 'Scheduling fidelity')."""
+    skew check counts it. ScheduleAnyway is a scheduler preference,
+    decoded but not constrained (docs/OPERATIONS.md 'Scheduling
+    fidelity')."""
 
     max_skew: int = 1
     topology_key: str = ""
@@ -275,9 +276,10 @@ def spread_shape(
     """Canonical hashable form of a pod's HARD topology spread:
     (namespace, entries) where entries are sorted (topologyKey, maxSkew,
     minDomains, selectorForm, selfMatch, honorAffinity) tuples for
-    DoNotSchedule constraints on non-hostname keys (per (key, selector):
-    smallest skew, largest minDomains, and Ignore-over-Honor win — the
-    most restrictive combination). () = unconstrained. The namespace and
+    DoNotSchedule constraints on non-hostname keys (per (key, selector,
+    policy): smallest skew and largest minDomains win — the most
+    restrictive combination; differing policies stay separate entries
+    since each is enforced independently). () = unconstrained. The namespace and
     the constraint's labelSelector (raw_selector_form; None = counts
     nothing) scope the EXISTING-pod domain counts
     (producers/pendingcapacity.DomainCensus) that the split honors;
@@ -295,7 +297,12 @@ def spread_shape(
     is soft (scheduler preference), never a constraint."""
     if not constraints:
         return ()
-    binding: Dict[tuple, Tuple[int, int, bool]] = {}
+    # identity is (key, selector, affinityPolicy): constraints differing
+    # in ANY of those are enforced independently by the scheduler, so
+    # they must stay separate entries — merging a Honor and an Ignore
+    # view of the same selector could loosen the caps either view
+    # enforces alone (r3 code review)
+    binding: Dict[tuple, Tuple[int, int]] = {}
     for c in constraints:
         if (
             c.when_unsatisfiable == "DoNotSchedule"
@@ -305,15 +312,12 @@ def spread_shape(
             skew = max(1, int(c.max_skew))
             min_domains = max(0, int(c.min_domains or 0))
             honor = c.node_affinity_policy != "Ignore"
-            sel = raw_selector_form(c.label_selector)
-            prev = binding.get((c.topology_key, sel))
+            sel = _spread_selector(c, labels)
+            prev = binding.get((c.topology_key, sel, honor))
             if prev is not None:
                 skew = min(prev[0], skew)
                 min_domains = max(prev[1], min_domains)
-                # Ignore wins: counting ALL nodes caps tighter in the
-                # scale-up model, the conservative merge
-                honor = prev[2] and honor
-            binding[(c.topology_key, sel)] = (skew, min_domains, honor)
+            binding[(c.topology_key, sel, honor)] = (skew, min_domains)
     if not binding:
         return ()
     entries = tuple(
@@ -325,10 +329,15 @@ def spread_shape(
             sel is not None and selector_form_matches(sel, labels or {}),
             honor,
         )
-        for (key, sel), (skew, min_domains, honor) in sorted(
+        for (key, sel, honor), (skew, min_domains) in sorted(
             binding.items(),
             # None sorts apart from tuple selector forms
-            key=lambda kv: (kv[0][0], kv[0][1] is not None, kv[0][1] or ()),
+            key=lambda kv: (
+                kv[0][0],
+                kv[0][1] is not None,
+                kv[0][1] or (),
+                kv[0][2],
+            ),
         )
     )
     return (namespace, entries)
@@ -433,6 +442,24 @@ def pod_affinity_shape(
         else ()
     )
     return (int(hostname_exclusive), anti_keys, co_keys, ident)
+
+
+def _spread_selector(c, labels: Optional[Dict[str, str]]) -> Optional[tuple]:
+    """A spread constraint's canonical selector form, refined by
+    matchLabelKeys (k8s >= 1.27): the incoming pod's values for those
+    keys are ANDed into the selector (the pod-template-hash
+    per-revision-spread pattern). Keys the pod doesn't carry are
+    ignored, and the API forbids matchLabelKeys without labelSelector."""
+    sel = raw_selector_form(c.label_selector)
+    if c.match_label_keys and sel is not None and labels:
+        extra = tuple(
+            (k, labels[k])
+            for k in sorted(set(c.match_label_keys))
+            if k in labels
+        )
+        if extra:
+            sel = (tuple(sorted({*sel[0], *extra})), sel[1])
+    return sel
 
 
 def _domain_keys(terms: list) -> tuple:

@@ -460,6 +460,13 @@ class DomainCensus:
     store. Node-side work (label extraction, per-row node filters) and
     pod-side work (selector evaluation over distinct label sets) are
     memoized independently.
+
+    Locking discipline (the NodeMirror.profile rule): the occupancy
+    lock is held only to check freshness and COPY one namespace's
+    census slice — watch callbacks run under the store's notify path
+    and must never wait on an O(nodes + label sets) selector scan, or
+    every store mutation stalls behind the solve. All evaluation runs
+    on the copied slice, lock-free.
     """
 
     def __init__(self, occupancy, nodes_fn, node_version_fn=None):
@@ -479,6 +486,24 @@ class DomainCensus:
             self._node_memo.clear()
             self._named_labels = None
 
+    def _ns_groups(self, namespace) -> list:
+        """Epoch check + consistent copy of one namespace's census slice
+        [(labels_items, {node: count})], under the occupancy lock only
+        for the copy; memoized per epoch so one solve copies each
+        namespace at most once."""
+        with self._occupancy.view() as (generation, spaces):
+            self._fresh(generation)
+            got = self._memo.get(("ns", namespace))
+            if got is None:
+                got = [
+                    (labels_items, dict(nodes))
+                    for labels_items, nodes in spaces.get(
+                        namespace, {}
+                    ).items()
+                ]
+                self._memo[("ns", namespace)] = got
+            return got
+
     def _nodes(self) -> List[Tuple[str, dict]]:
         if self._named_labels is None:
             self._named_labels = [
@@ -497,42 +522,39 @@ class DomainCensus:
         are Ignored per the nodeTaintsPolicy default): only nodes the
         incoming pod could land on define domains and contribute counts.
         """
-        with self._occupancy.view() as (generation, spaces):
-            self._fresh(generation)
-            node_key = (split_key, filter_token)
-            node_side = self._node_memo.get(node_key)
-            if node_side is None:
-                passing: Dict[str, str] = {}
-                present: set = set()
-                for name, labels in self._nodes():
-                    value = labels.get(split_key)
-                    if value is None or not node_passes(labels):
+        groups = self._ns_groups(namespace)  # also the epoch check
+        node_key = (split_key, filter_token)
+        node_side = self._node_memo.get(node_key)
+        if node_side is None:
+            passing: Dict[str, str] = {}
+            present: set = set()
+            for name, labels in self._nodes():
+                value = labels.get(split_key)
+                if value is None or not node_passes(labels):
+                    continue
+                passing[name] = value
+                present.add(value)
+            node_side = (passing, present)
+            self._node_memo[node_key] = node_side
+        passing, present = node_side
+        memo_key = ("spread", namespace, sel_form, split_key,
+                    filter_token)
+        got = self._memo.get(memo_key)
+        if got is None:
+            counts: Dict[str, int] = {}
+            if sel_form is not None:
+                for labels_items, nodes in groups:
+                    if not selector_form_matches(
+                        sel_form, dict(labels_items)
+                    ):
                         continue
-                    passing[name] = value
-                    present.add(value)
-                node_side = (passing, present)
-                self._node_memo[node_key] = node_side
-            passing, present = node_side
-            memo_key = ("spread", namespace, sel_form, split_key,
-                        filter_token)
-            got = self._memo.get(memo_key)
-            if got is None:
-                counts: Dict[str, int] = {}
-                if sel_form is not None:
-                    for labels_items, nodes in spaces.get(
-                        namespace, {}
-                    ).items():
-                        if not selector_form_matches(
-                            sel_form, dict(labels_items)
-                        ):
-                            continue
-                        for node, n in nodes.items():
-                            value = passing.get(node)
-                            if value is not None:
-                                counts[value] = counts.get(value, 0) + n
-                got = (counts, present)
-                self._memo[memo_key] = got
-            return got
+                    for node, n in nodes.items():
+                        value = passing.get(node)
+                        if value is not None:
+                            counts[value] = counts.get(value, 0) + n
+            got = (counts, present)
+            self._memo[memo_key] = got
+        return got
 
     def _workload_nodes(self, namespace, sel_forms) -> tuple:
         """(any_nodes, all_nodes_or_None): node-name sets occupied by
@@ -543,25 +565,24 @@ class DomainCensus:
         scheduled pod anywhere in the namespace (the k8s first-replica
         bootstrap: a required self-affinity term with no matching pod
         cluster-wide imposes nothing)."""
+        # _ns_groups runs the epoch check first: an entry cached under a
+        # previous occupancy generation (or node version) must never
+        # answer for this one — a replica bound since then has to spend
+        # its domain on the very next solve
+        ns_groups = self._ns_groups(namespace)
         memo_key = ("workload", namespace, sel_forms)
+        got = self._memo.get(memo_key)
+        if got is not None:
+            return got
         groups = []
-        with self._occupancy.view() as (generation, spaces):
-            # memo lookup only AFTER the epoch check: an entry cached
-            # under a previous occupancy generation (or node version)
-            # must never answer for this one — a replica bound since
-            # then has to spend its domain on the very next solve
-            self._fresh(generation)
-            got = self._memo.get(memo_key)
-            if got is not None:
-                return got
-            for labels_items, nodes in spaces.get(namespace, {}).items():
-                labels = dict(labels_items)
-                vec = tuple(
-                    selector_form_matches(form, labels)
-                    for form in sel_forms
-                )
-                if any(vec):
-                    groups.append((vec, set(nodes)))
+        for labels_items, nodes in ns_groups:
+            labels = dict(labels_items)
+            vec = tuple(
+                selector_form_matches(form, labels)
+                for form in sel_forms
+            )
+            if any(vec):
+                groups.append((vec, set(nodes)))
         live = [
             i
             for i in range(len(sel_forms))

@@ -424,6 +424,94 @@ class TestSpreadOccupancy:
             "group-b": 1,
         }
 
+    def test_match_label_keys_refines_the_selector(self, env):
+        """matchLabelKeys (the pod-template-hash pattern): the pod's own
+        values for the listed keys AND into the selector, so a NEW
+        revision spreads independently of the old one's placement."""
+        runtime, _ = env
+        zoned(runtime)
+        for i in range(2):
+            runtime.store.create(
+                bound_pod(
+                    f"v1-{i}",
+                    {"app": "web", "pod-template-hash": "v1"},
+                    "n-a",
+                )
+            )
+        for i in range(4):
+            pod = spread_pod(
+                f"v2-{i}",
+                {"app": "web", "pod-template-hash": "v2"},
+                selector={"app": "web"},
+            )
+            pod.spec.topology_spread_constraints[0].match_label_keys = [
+                "pod-template-hash"
+            ]
+            runtime.store.create(pod)
+        runtime.manager.reconcile_all()
+        # v1's zone-a pods don't count against v2: plain balanced split
+        # (without matchLabelKeys the water-fill would send 3 to b)
+        counts = pods_per_group(runtime, ["group-a", "group-b"])
+        assert sorted(counts.values()) == [2, 2]
+
+    def test_match_label_keys_missing_on_pod_is_ignored(self):
+        from karpenter_tpu.api.core import (
+            TopologySpreadConstraint,
+            spread_shape,
+        )
+
+        constraint = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=ZONE_KEY,
+            when_unsatisfiable="DoNotSchedule",
+            label_selector={"matchLabels": {"app": "web"}},
+            match_label_keys=["pod-template-hash"],
+        )
+        with_key = spread_shape(
+            [constraint], "default",
+            {"app": "web", "pod-template-hash": "v2"},
+        )
+        without_key = spread_shape(
+            [constraint], "default", {"app": "web"}
+        )
+        sel_with = with_key[1][0][3]
+        sel_without = without_key[1][0][3]
+        assert ("pod-template-hash", "v2") in sel_with[0]
+        assert sel_without == ((("app", "web"),), ())
+
+    def test_differing_affinity_policies_stay_separate_entries(self):
+        """Regression (r3 code review): a Honor and an Ignore constraint
+        on the same (key, selector) are enforced independently by the
+        scheduler — merging them could loosen the caps either enforces
+        alone. They must canonicalize to two entries."""
+        from karpenter_tpu.api.core import (
+            TopologySpreadConstraint,
+            spread_shape,
+        )
+
+        def constraint(policy):
+            return TopologySpreadConstraint(
+                max_skew=1,
+                topology_key=ZONE_KEY,
+                when_unsatisfiable="DoNotSchedule",
+                label_selector={"matchLabels": {"app": "web"}},
+                node_affinity_policy=policy,
+            )
+
+        shape = spread_shape(
+            [constraint(""), constraint("Ignore")],
+            "default",
+            {"app": "web"},
+        )
+        entries = shape[1]
+        assert len(entries) == 2
+        assert {entry[5] for entry in entries} == {True, False}
+        # same policy twice still merges to the most restrictive
+        merged = spread_shape(
+            [constraint(""), constraint("")], "default", {"app": "web"}
+        )
+        assert len(merged[1]) == 1
+
     def test_namespaces_do_not_share_counts(self, env):
         """Occupancy is namespace-scoped like the scheduler's: another
         namespace's identical pods don't skew this workload."""
