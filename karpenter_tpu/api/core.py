@@ -444,6 +444,80 @@ def pod_affinity_shape(
     return (int(hostname_exclusive), anti_keys, co_keys, ident)
 
 
+def soft_spread_shape(
+    constraints: Optional[list],
+    namespace: str = "",
+    labels: Optional[Dict[str, str]] = None,
+) -> tuple:
+    """Canonical hashable form of a pod's SOFT topology spread
+    (whenUnsatisfiable=ScheduleAnyway, non-hostname keys): (namespace,
+    sorted (topologyKey, selectorForm) pairs). () = none. The kube-
+    scheduler SCORES these — domains with fewer matching pods rank
+    higher, nodes missing the key rank lowest — so the solver models
+    them as a pod_group_score contribution (PodTopologySpread scoring
+    plugin, default weight 2), never as a constraint. The selector is
+    refined by matchLabelKeys exactly like the hard shape."""
+    if not constraints:
+        return ()
+    pairs = {
+        (c.topology_key, _spread_selector(c, labels))
+        for c in constraints
+        if c.when_unsatisfiable == "ScheduleAnyway"
+        and c.topology_key
+        and c.topology_key != HOSTNAME_TOPOLOGY_KEY
+    }
+    if not pairs:
+        return ()
+    entries = tuple(
+        sorted(pairs, key=lambda p: (p[0], p[1] is not None, p[1] or ()))
+    )
+    return (namespace, entries)
+
+
+def soft_pod_affinity_shape(
+    affinity: Optional[Affinity],
+    labels: Dict[str, str],
+    namespace: str,
+) -> tuple:
+    """Canonical hashable form of a pod's PREFERRED inter-pod
+    (anti-)affinity, restricted to the SELF-matching slice (the
+    spread-replicas-apart / pack-replicas-together preferences):
+    (namespace, sorted (sign, weight, topologyKey, selectorForm)
+    entries), sign +1 for affinity, -1 for anti-affinity. () = none.
+    The kube-scheduler SCORES these (InterPodAffinity plugin, default
+    weight 1): each existing matching pod in a candidate's domain adds
+    sign x weight — the solver models the same sum over the census.
+    Hostname-keyed terms are dropped: a scale-up's new nodes are fresh
+    hostnames, so their domains hold no existing pods either way."""
+    if affinity is None:
+        return ()
+    entries = []
+    for sign, block in (
+        (1, affinity.pod_affinity),
+        (-1, affinity.pod_anti_affinity),
+    ):
+        if block is None:
+            continue
+        for wt in block.preferred_during_scheduling_ignored_during_execution:
+            term = wt.pod_affinity_term
+            if (
+                term.topology_key
+                and term.topology_key != HOSTNAME_TOPOLOGY_KEY
+                and _self_matching_terms([term], labels, namespace)
+            ):
+                entries.append(
+                    (
+                        sign,
+                        max(1, min(100, int(wt.weight))),
+                        term.topology_key,
+                        _selector_form(term.label_selector),
+                    )
+                )
+    if not entries:
+        return ()
+    return (namespace, tuple(sorted(entries)))
+
+
 def _spread_selector(c, labels: Optional[Dict[str, str]]) -> Optional[tuple]:
     """A spread constraint's canonical selector form, refined by
     matchLabelKeys (k8s >= 1.27): the incoming pod's values for those

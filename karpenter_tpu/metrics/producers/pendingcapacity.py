@@ -264,12 +264,19 @@ def solve_pending(  # lint: allow-complexity — the one batched solve: per-targ
         snap = snapshot_from_pods(all_pods)
 
     # Existing-pod domain occupancy: only fleets with live spread/anti
-    # constraints pay for a census (freed arena slots are zeroed, so the
-    # id scan is exact); unconstrained fleets skip it entirely — and
-    # their encode memo stays insensitive to bound-pod churn
-    needs_census = (
-        snap.spread_id is not None and bool((snap.spread_id != 0).any())
-    ) or (snap.anti_id is not None and bool((snap.anti_id != 0).any()))
+    # constraints or soft preferences pay for a census (freed arena
+    # slots are zeroed, so the id scan is exact); unconstrained fleets
+    # skip it entirely — and their encode memo stays insensitive to
+    # bound-pod churn
+    needs_census = any(
+        ids is not None and bool((ids != 0).any())
+        for ids in (
+            snap.spread_id,
+            snap.anti_id,
+            snap.soft_spread_id,
+            snap.soft_anti_id,
+        )
+    )
     census = None
     if needs_census:
         if feed is not None:
@@ -433,6 +440,18 @@ def _dedup_rows(snap):
                 .view(np.uint8)
                 .reshape(n, -1)
             )
+        if snap.soft_spread_id is not None:
+            parts.append(
+                np.ascontiguousarray(snap.soft_spread_id[idx])
+                .view(np.uint8)
+                .reshape(n, -1)
+            )
+        if snap.soft_anti_id is not None:
+            parts.append(
+                np.ascontiguousarray(snap.soft_anti_id[idx])
+                .view(np.uint8)
+                .reshape(n, -1)
+            )
         rows = np.ascontiguousarray(np.concatenate(parts, axis=1))
         return rows.view([("k", np.void, rows.shape[1])]).ravel()
 
@@ -555,6 +574,35 @@ class DomainCensus:
             got = (counts, present)
             self._memo[memo_key] = got
         return got
+
+    def domain_counts(self, namespace, sel_form, key) -> Dict[str, int]:
+        """{topology value: matching-pod count} over ALL live nodes —
+        the scoring-side census (soft spread / preferred inter-pod
+        affinity score existing placements; no node filter applies to
+        a preference)."""
+        groups = self._ns_groups(namespace)  # also the epoch check
+        memo_key = ("counts", namespace, sel_form, key)
+        got = self._memo.get(memo_key)
+        if got is not None:
+            return got
+        by_name = self._node_memo.get("byname")
+        if by_name is None:
+            by_name = dict(self._nodes())
+            self._node_memo["byname"] = by_name
+        counts: Dict[str, int] = {}
+        if sel_form is not None:
+            for labels_items, nodes in groups:
+                if not selector_form_matches(
+                    sel_form, dict(labels_items)
+                ):
+                    continue
+                for node, n in nodes.items():
+                    labels = by_name.get(node)
+                    value = labels.get(key) if labels else None
+                    if value is not None:
+                        counts[value] = counts.get(value, 0) + n
+        self._memo[memo_key] = counts
+        return counts
 
     def _workload_nodes(self, namespace, sel_forms) -> tuple:
         """(any_nodes, all_nodes_or_None): node-name sets occupied by
@@ -795,7 +843,7 @@ def _expand_spread_rows(  # lint: allow-complexity — per-domain chunking: each
         for t, labels in enumerate(label_dicts):
             if all(key in labels for key in keys):
                 domains.setdefault(labels[split_key], []).append(t)
-        plan[int(s)] = (namespace, entries[0], sorted(domains), domains)
+        plan[int(s)] = (namespace, entries, sorted(domains), domains)
 
     out_idx, out_weight, out_forbidden = [], [], []
     for i, sid in enumerate(live_ids):
@@ -805,8 +853,8 @@ def _expand_spread_rows(  # lint: allow-complexity — per-domain chunking: each
             out_weight.append(row_weight[i])
             out_forbidden.append(np.zeros(n_groups, bool))
             continue
-        namespace, split, values, domains = entry
-        split_key, skew, min_domains, sel_form, self_match, honor = split
+        namespace, entries, values, domains = entry
+        split_key = entries[0][0]
         weight = int(row_weight[i])
         if not values or weight == 0:
             # no group exposes the key(s): unschedulable by spread —
@@ -816,65 +864,85 @@ def _expand_spread_rows(  # lint: allow-complexity — per-domain chunking: each
             out_forbidden.append(np.ones(n_groups, bool))
             continue
         d = len(values)
-        counts: Dict[str, int] = {}
-        present: set = set()
-        if census is not None and sel_form is not None:
+
+        def entry_counts(e):
+            key, _skew, _mind, sel, _self, honor = e
+            if census is None or sel is None:
+                return {}, set()
             if honor:
                 token, node_passes = _row_node_filter(snap, row_idx[i])
             else:
                 # nodeAffinityPolicy=Ignore: every live node exposing
                 # the key defines a domain and contributes counts
                 token, node_passes = ("ignore",), (lambda labels: True)
-            counts, present = census.spread(
-                namespace, sel_form, split_key, token, node_passes
-            )
-        c = [counts.get(value, 0) for value in values]
-        min_rule = bool(min_domains) and d < min_domains
+            return census.spread(namespace, sel, key, token, node_passes)
+
+        # EVERY entry on the split key is enforced independently by the
+        # scheduler, so the per-domain cap is the MIN over all of them
+        # — each evaluated under its own selector/policy (a single
+        # "first entry" cap could silently drop a tighter same-key
+        # constraint, r3 code review). Entries on other keys contribute
+        # key-presence exclusion only (documented approximation).
+        caps = [weight] * d  # weight == effectively unbounded
+        for e in entries:
+            if e[0] != split_key:
+                continue
+            _key, skew, min_domains, _sel, self_match, _honor = e
+            counts_e, present_e = entry_counts(e)
+            c_e = [counts_e.get(v, 0) for v in values]
+            min_rule = bool(min_domains) and d < min_domains
+            if not self_match:
+                # placements never accumulate into this entry's counts:
+                # its skew check is static per domain — existing count
+                # must stay within maxSkew of the global minimum (0
+                # under the minDomains rule)
+                floor = 0 if min_rule else min(
+                    [
+                        *c_e,
+                        *(
+                            counts_e.get(v, 0)
+                            for v in present_e - set(values)
+                        ),
+                    ],
+                    default=0,
+                )
+                for j in range(d):
+                    if c_e[j] - floor > skew:
+                        caps[j] = 0
+            elif min_rule:
+                # the scheduler's minDomains rule: too few eligible
+                # domains treats the global minimum as 0, so each domain
+                # holds at most maxSkew matching pods INCLUDING the
+                # existing ones; the rest stay unschedulable
+                for j in range(d):
+                    caps[j] = min(caps[j], max(0, skew - c_e[j]))
+            else:
+                outside = present_e - set(values)
+                m_out = min(
+                    (counts_e.get(v, 0) for v in outside), default=None
+                )
+                if m_out is not None:
+                    for j in range(d):
+                        caps[j] = min(
+                            caps[j], max(0, m_out + skew - c_e[j])
+                        )
+        # the fill ORDER (least-loaded first) follows the FIRST entry's
+        # counts; a non-self-matching first entry never accumulates, so
+        # its fill is plain balanced within the caps
+        first_counts, _ = entry_counts(entries[0])
+        fill = (
+            [first_counts.get(v, 0) for v in values]
+            if entries[0][4]
+            else [0] * d
+        )
+        schedulable = min(weight, sum(caps))
         # content-keyed remainder rotation (see _water_fill)
         seed = weight + int(
             np.ascontiguousarray(snap.requests[row_idx[i]])
             .view(np.uint8)
             .sum()
         )
-        if not self_match:
-            # placements never accumulate into the counts: the skew
-            # check is static per domain — existing count must stay
-            # within maxSkew of the global minimum (0 under the
-            # minDomains rule); surviving domains split balanced
-            floor = 0 if min_rule else min(
-                [*c, *(counts.get(v, 0) for v in present - set(values))],
-                default=0,
-            )
-            keep = [j for j in range(d) if c[j] - floor <= skew]
-            additions = [0] * d
-            if keep:
-                chunks = _water_fill(
-                    [0] * len(keep), None, weight, seed
-                )
-                for j, k in enumerate(keep):
-                    additions[k] = chunks[j]
-            schedulable = sum(additions)
-        else:
-            if min_rule:
-                # the scheduler's minDomains rule: too few eligible
-                # domains treats the global minimum as 0, so each domain
-                # holds at most maxSkew matching pods INCLUDING the
-                # existing ones; the rest stay unschedulable
-                caps = [max(0, skew - cj) for cj in c]
-            else:
-                outside = present - set(values)
-                m_out = min(
-                    (counts.get(v, 0) for v in outside), default=None
-                )
-                caps = (
-                    None
-                    if m_out is None
-                    else [max(0, m_out + skew - cj) for cj in c]
-                )
-            schedulable = (
-                weight if caps is None else min(weight, sum(caps))
-            )
-            additions = _water_fill(c, caps, schedulable, seed)
+        additions = _water_fill(fill, caps, schedulable, seed)
         for rank, value in enumerate(values):
             chunk = additions[rank]
             if chunk == 0:
@@ -937,7 +1005,16 @@ def _canonical_row_key(snap, slot: int) -> tuple:
         if snap.spread_shapes is not None and snap.spread_id is not None
         else ()
     )
-    return (requests, selector, tolerations, affinity, preferred, spread)
+    soft = tuple(
+        shapes[ids[slot]]
+        for shapes, ids in (
+            (snap.soft_spread_shapes, snap.soft_spread_id),
+            (snap.soft_anti_shapes, snap.soft_anti_id),
+        )
+        if shapes is not None and ids is not None
+    )
+    return (requests, selector, tolerations, affinity, preferred, spread,
+            soft)
 
 
 def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each guard is a documented anti-affinity rule
@@ -1204,6 +1281,120 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
     )
 
 
+def _score_rows(  # lint: allow-complexity — one block per scoring plugin, the kube-scheduler's score composition in one place
+    snap, profiles, row_idx, label_dicts_fn, census, n_pods, n_groups
+):
+    """The kube-scheduler's scoring plugins over candidate groups ->
+    the solver's pod_group_score operand (argmax among feasible, index
+    tie-break). Three plugins, combined with the scheduler's default
+    weights after per-row min-max normalization to 0..100 (min-max is
+    monotone, so a fleet using only ONE plugin keeps exactly the raw
+    scores' argmax and tie-break order):
+
+    - NodeAffinity (weight 1): preferred-term weight sums
+      (api/core.preference_score).
+    - PodTopologySpread (weight 2): ScheduleAnyway constraints prefer
+      domains with FEWER existing matching pods (DomainCensus counts);
+      groups missing the key rank below every keyed group, matching
+      the scoring plugin's treatment of keyless nodes.
+    - InterPodAffinity (weight 1): preferred self-(anti-)affinity
+      terms add sign x weight per existing matching pod in the
+      group's domain.
+
+    Returns None when no live row carries any preference — the common
+    fleet skips the score operand entirely. census=None (hand-built
+    snapshots) scores with zero counts: spread still ranks keyless
+    groups last; inter-pod terms contribute nothing.
+    """
+    hi = len(row_idx)
+    if hi == 0:
+        return None
+    n_real = len(profiles)
+    pieces = []  # (plugin weight, raw[hi, n_real])
+
+    shapes = snap.preferred_shapes
+    live = (
+        snap.preferred_id[row_idx]
+        if snap.preferred_id is not None and shapes is not None
+        else None
+    )
+    if live is not None and (live != 0).any():
+        raw = np.zeros((len(shapes), n_real), np.float32)
+        for s in np.unique(live):
+            shape = shapes[s]
+            if not shape:
+                continue
+            for t, labels in enumerate(label_dicts_fn()):
+                raw[s, t] = preference_score(labels, shape)
+        pieces.append((1.0, raw[live]))
+
+    shapes = snap.soft_spread_shapes
+    live = (
+        snap.soft_spread_id[row_idx]
+        if snap.soft_spread_id is not None and shapes is not None
+        else None
+    )
+    if live is not None and (live != 0).any():
+        raw = np.zeros((len(shapes), n_real), np.float32)
+        for s in np.unique(live):
+            shape = shapes[s]
+            if not shape:
+                continue
+            namespace, entries = shape
+            for key, sel in entries:
+                counts = (
+                    census.domain_counts(namespace, sel, key)
+                    if census is not None and sel is not None
+                    else {}
+                )
+                # keyless groups rank strictly below every keyed one
+                worst = float(max(counts.values(), default=0)) + 1.0
+                for t, labels in enumerate(label_dicts_fn()):
+                    value = labels.get(key)
+                    raw[s, t] -= (
+                        float(counts.get(value, 0))
+                        if value is not None
+                        else worst
+                    )
+        pieces.append((2.0, raw[live]))
+
+    shapes = snap.soft_anti_shapes
+    live = (
+        snap.soft_anti_id[row_idx]
+        if snap.soft_anti_id is not None and shapes is not None
+        else None
+    )
+    if live is not None and (live != 0).any() and census is not None:
+        raw = np.zeros((len(shapes), n_real), np.float32)
+        for s in np.unique(live):
+            shape = shapes[s]
+            if not shape:
+                continue
+            namespace, entries = shape
+            for sign, weight, key, sel in entries:
+                counts = census.domain_counts(namespace, sel, key)
+                for t, labels in enumerate(label_dicts_fn()):
+                    value = labels.get(key)
+                    if value is not None:
+                        raw[s, t] += (
+                            sign * weight * float(counts.get(value, 0))
+                        )
+        if raw.any():
+            pieces.append((1.0, raw[live]))
+
+    if not pieces:
+        return None
+    acc = np.zeros((hi, n_real), np.float32)
+    for weight, raw in pieces:
+        lo = raw.min(axis=1, keepdims=True)
+        rng = raw.max(axis=1, keepdims=True) - lo
+        safe = np.where(rng > 0, rng, 1.0)
+        acc += weight * np.where(rng > 0, (raw - lo) / safe * 100.0, 0.0)
+    total = np.zeros((n_pods, n_groups), np.float32)
+    total[:hi, :n_real] = acc
+    return total
+
+
 def _encode_from_cache(snap, profiles, with_rows: bool = False, census=None):  # lint: allow-complexity — THE single encoder; splitting would smear the output-equality invariant
     """Snapshot (store/columnar.PendingSnapshot) -> solver inputs, with
     rows DEDUPLICATED into distinct pod shapes + multiplicities
@@ -1353,27 +1544,14 @@ def _encode_from_cache(snap, profiles, with_rows: bool = False, census=None):  #
         pod_exclusive = np.zeros(n_pods, bool)
         pod_exclusive[:hi] = row_exclusive
 
-    # Preferred node affinity: same distinct-shape host evaluation, but
-    # the verdicts are weight-sums steering assignment among feasible
-    # groups (ops/binpack.py pod_group_score) — absent unless some live
-    # pod actually prefers
-    pod_group_score = None
-    pref_shapes = snap.preferred_shapes
-    live_preferred_ids = (
-        snap.preferred_id[row_idx]
-        if hi and snap.preferred_id is not None and pref_shapes is not None
-        else None
+    # Scoring operand (ops/binpack.py pod_group_score): the kube-
+    # scheduler's scoring plugins modeled over groups — preferred node
+    # affinity, ScheduleAnyway spread, preferred self pod-(anti-)
+    # affinity — absent unless some live pod actually prefers
+    pod_group_score = _score_rows(
+        snap, profiles, row_idx, group_label_dicts, census,
+        n_pods, n_groups,
     )
-    if live_preferred_ids is not None and (live_preferred_ids != 0).any():
-        scores = np.zeros((len(pref_shapes), n_groups), np.float32)
-        for s in np.unique(live_preferred_ids):
-            shape = pref_shapes[s]
-            if not shape:
-                continue
-            for t, labels in enumerate(group_label_dicts()):
-                scores[s, t] = preference_score(labels, shape)
-        pod_group_score = np.zeros((n_pods, n_groups), np.float32)
-        pod_group_score[:hi] = scores[live_preferred_ids]
 
     inputs = B.BinPackInputs(
         pod_requests=pod_requests,

@@ -47,6 +47,10 @@ import numpy as np
 from karpenter_tpu.api.core import affinity_shape as _affinity_shape
 from karpenter_tpu.api.core import pod_affinity_shape as _pod_affinity_shape
 from karpenter_tpu.api.core import preferred_shape as _preferred_shape
+from karpenter_tpu.api.core import (
+    soft_pod_affinity_shape as _soft_pod_affinity_shape,
+)
+from karpenter_tpu.api.core import soft_spread_shape as _soft_spread_shape
 from karpenter_tpu.api.core import spread_shape as _spread_shape
 from karpenter_tpu.store.store import DELETED, Store
 
@@ -64,6 +68,17 @@ def is_pending(pod) -> bool:
     """Unschedulable set: unbound and not yet running/finished (the
     reference's pending-pods definition, DESIGN.md 'Pending Pods')."""
     return not pod.spec.node_name and pod.status.phase in ("", "Pending")
+
+
+def _intern(shapes: List[tuple], index: Dict[tuple, int], shape: tuple) -> int:
+    """Shape-registry intern: one id per distinct canonical tuple; id 0
+    is always the empty/unconstrained shape (seeded at arena reset)."""
+    sid = index.get(shape)
+    if sid is None:
+        sid = len(shapes)
+        index[shape] = sid
+        shapes.append(shape)
+    return sid
 
 
 def _adopt_and_watch(store: Store, kind: str, on_event) -> None:
@@ -90,6 +105,8 @@ class _SparsePod:
     preferred: tuple = ()  # canonical preferred-node-affinity shape
     spread: tuple = ()  # canonical hard topology-spread shape
     anti: tuple = ()  # canonical self pod-(anti-)affinity shape
+    soft_spread: tuple = ()  # canonical ScheduleAnyway spread shape
+    soft_anti: tuple = ()  # canonical preferred self pod-(anti-)affinity
 
 
 class PendingPodCache:
@@ -134,6 +151,12 @@ class PendingPodCache:
         # self pod-(anti-)affinity shapes (api/core.pod_affinity_shape)
         self._anti_shapes: List[tuple] = [()]
         self._anti_index: Dict[tuple, int] = {(): 0}
+        # SOFT (scored, never constraining) shapes: ScheduleAnyway
+        # spread + preferred self pod-(anti-)affinity
+        self._soft_spread_shapes: List[tuple] = [()]
+        self._soft_spread_index: Dict[tuple, int] = {(): 0}
+        self._soft_anti_shapes: List[tuple] = [()]
+        self._soft_anti_index: Dict[tuple, int] = {(): 0}
         # incremental shape-dedup: canonical pod key -> live slots with that
         # key. Maintained at event time so snapshot() emits (rep row,
         # multiplicity) pairs in O(distinct shapes) — the per-tick
@@ -151,6 +174,8 @@ class PendingPodCache:
         self._preferred_id = np.zeros(capacity, np.int32)
         self._spread_id = np.zeros(capacity, np.int32)
         self._anti_id = np.zeros(capacity, np.int32)
+        self._soft_spread_id = np.zeros(capacity, np.int32)
+        self._soft_anti_id = np.zeros(capacity, np.int32)
         self._valid = np.zeros(capacity, bool)
 
         self._slot: Dict[Tuple[str, str], int] = {}
@@ -181,6 +206,8 @@ class PendingPodCache:
         self._preferred_id[slot] = 0
         self._spread_id[slot] = 0
         self._anti_id[slot] = 0
+        self._soft_spread_id[slot] = 0
+        self._soft_anti_id[slot] = 0
         self._sparse.pop(slot, None)
         self._dedup_discard(slot)
         self._free.append(slot)
@@ -226,6 +253,16 @@ class PendingPodCache:
                 pod.metadata.labels,
                 pod.metadata.namespace,
             ),
+            soft_spread=_soft_spread_shape(
+                pod.spec.topology_spread_constraints,
+                pod.metadata.namespace,
+                pod.metadata.labels,
+            ),
+            soft_anti=_soft_pod_affinity_shape(
+                pod.spec.affinity,
+                pod.metadata.labels,
+                pod.metadata.namespace,
+            ),
         )
         slot = self._slot.get(key)
         if slot is None:
@@ -252,30 +289,28 @@ class PendingPodCache:
             self._shapes.append(sparse.shape)
             self._shape_tolerations.append(sparse.tolerations)
         self._shape_id[slot] = shape_id
-        affinity_id = self._affinity_index.get(sparse.affinity)
-        if affinity_id is None:
-            affinity_id = len(self._affinity_shapes)
-            self._affinity_index[sparse.affinity] = affinity_id
-            self._affinity_shapes.append(sparse.affinity)
-        self._affinity_id[slot] = affinity_id
-        preferred_id = self._preferred_index.get(sparse.preferred)
-        if preferred_id is None:
-            preferred_id = len(self._preferred_shapes)
-            self._preferred_index[sparse.preferred] = preferred_id
-            self._preferred_shapes.append(sparse.preferred)
-        self._preferred_id[slot] = preferred_id
-        spread_id = self._spread_index.get(sparse.spread)
-        if spread_id is None:
-            spread_id = len(self._spread_shapes)
-            self._spread_index[sparse.spread] = spread_id
-            self._spread_shapes.append(sparse.spread)
-        self._spread_id[slot] = spread_id
-        anti_id = self._anti_index.get(sparse.anti)
-        if anti_id is None:
-            anti_id = len(self._anti_shapes)
-            self._anti_index[sparse.anti] = anti_id
-            self._anti_shapes.append(sparse.anti)
-        self._anti_id[slot] = anti_id
+        self._affinity_id[slot] = _intern(
+            self._affinity_shapes, self._affinity_index, sparse.affinity
+        )
+        self._preferred_id[slot] = _intern(
+            self._preferred_shapes, self._preferred_index, sparse.preferred
+        )
+        self._spread_id[slot] = _intern(
+            self._spread_shapes, self._spread_index, sparse.spread
+        )
+        self._anti_id[slot] = _intern(
+            self._anti_shapes, self._anti_index, sparse.anti
+        )
+        self._soft_spread_id[slot] = _intern(
+            self._soft_spread_shapes,
+            self._soft_spread_index,
+            sparse.soft_spread,
+        )
+        self._soft_anti_id[slot] = _intern(
+            self._soft_anti_shapes,
+            self._soft_anti_index,
+            sparse.soft_anti,
+        )
         self._valid[slot] = True
         self._sparse[slot] = sparse
         # dedup maintenance: two slots share a key iff their canonical
@@ -291,6 +326,8 @@ class PendingPodCache:
             sparse.preferred,
             sparse.spread,
             sparse.anti,
+            sparse.soft_spread,
+            sparse.soft_anti,
         )
         if self._slot_key.get(slot) != dedup_key:
             self._dedup_discard(slot)
@@ -312,6 +349,8 @@ class PendingPodCache:
             (self._preferred_shapes, self._preferred_id),
             (self._spread_shapes, self._spread_id),
             (self._anti_shapes, self._anti_id),
+            (self._soft_spread_shapes, self._soft_spread_id),
+            (self._soft_anti_shapes, self._soft_anti_id),
         ):
             if len(registry) >= _COMPACT_FLOOR:
                 live_ids = len(
@@ -357,6 +396,8 @@ class PendingPodCache:
             self._preferred_id = self._grow_rows(self._preferred_id)
             self._spread_id = self._grow_rows(self._spread_id)
             self._anti_id = self._grow_rows(self._anti_id)
+            self._soft_spread_id = self._grow_rows(self._soft_spread_id)
+            self._soft_anti_id = self._grow_rows(self._soft_anti_id)
             self._valid = self._grow_rows(self._valid)
         slot = self._hi
         self._hi += 1
@@ -443,6 +484,10 @@ class PendingPodCache:
                 spread_shapes=list(self._spread_shapes),
                 anti_id=self._anti_id[:hi].copy(),
                 anti_shapes=list(self._anti_shapes),
+                soft_spread_id=self._soft_spread_id[:hi].copy(),
+                soft_spread_shapes=list(self._soft_spread_shapes),
+                soft_anti_id=self._soft_anti_id[:hi].copy(),
+                soft_anti_shapes=list(self._soft_anti_shapes),
             )
             self._snap_memo = (self._generation, snap)
             return snap
@@ -827,3 +872,9 @@ class PendingSnapshot:                        # no 100k-row reprs in logs
     # self pod-(anti-)affinity (api/core.pod_affinity_shape; id 0 = none)
     anti_id: Optional[np.ndarray] = None
     anti_shapes: Optional[List[tuple]] = None
+    # SOFT (scored) shapes: ScheduleAnyway spread + preferred self
+    # pod-(anti-)affinity (api/core.soft_{spread,pod_affinity}_shape)
+    soft_spread_id: Optional[np.ndarray] = None
+    soft_spread_shapes: Optional[List[tuple]] = None
+    soft_anti_id: Optional[np.ndarray] = None
+    soft_anti_shapes: Optional[List[tuple]] = None

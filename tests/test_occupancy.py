@@ -479,6 +479,40 @@ class TestSpreadOccupancy:
         assert ("pod-template-hash", "v2") in sel_with[0]
         assert sel_without == ((("app", "web"),), ())
 
+    def test_same_key_dual_policy_takes_the_tighter_cap(self, env):
+        """Regression (r3 code review): two same-key constraints with
+        different policies are BOTH enforced — the per-domain cap is
+        the min over every same-key entry, so a loose Ignore entry
+        can't mask a tight Honor one."""
+        runtime, _ = env
+        zoned(runtime)
+        runtime.store.create(
+            ready_node("unmanaged", {ZONE_KEY: "us-c"})
+        )
+        for i in range(5):
+            pod = spread_pod(f"p{i}", {"app": "web"}, max_skew=3)
+            pod.spec.topology_spread_constraints[0].node_affinity_policy = (
+                "Ignore"
+            )
+            pod.spec.topology_spread_constraints.append(
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=ZONE_KEY,
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector={"matchLabels": {"app": "web"}},
+                )
+            )
+            runtime.store.create(pod)
+        runtime.manager.reconcile_all()
+        # the skew-1 Honor entry caps each zone at 1 (empty zone c
+        # freezes the minimum); enforcing only the sorted-first Ignore
+        # skew-3 entry would have admitted all 5
+        assert pods_per_group(runtime, ["group-a", "group-b"]) == {
+            "group-a": 1,
+            "group-b": 1,
+        }
+        assert total_unschedulable(runtime, "group-a") == 3
+
     def test_differing_affinity_policies_stay_separate_entries(self):
         """Regression (r3 code review): a Honor and an Ignore constraint
         on the same (key, selector) are enforced independently by the
@@ -693,6 +727,226 @@ class TestAntiAffinityOccupancy:
             runtime, ["group-a", "group-b", "group-c"]
         ) == {"group-a": 0, "group-b": 1, "group-c": 0}
         assert total_unschedulable(runtime, "group-a") == 1
+
+
+def soft_spread_pod(name, labels, node_selector=None):
+    """A pending pod with a ScheduleAnyway zone-spread constraint —
+    scored, never constraining."""
+    pod = Pod(
+        metadata=ObjectMeta(name=name, labels=dict(labels)),
+        spec=PodSpec(
+            node_name="",
+            containers=[
+                Container(requests=resource_list(cpu="1", memory="1Gi"))
+            ],
+            node_selector=dict(node_selector or {}),
+        ),
+    )
+    pod.spec.topology_spread_constraints = [
+        TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=ZONE_KEY,
+            when_unsatisfiable="ScheduleAnyway",
+            label_selector={"matchLabels": dict(labels)},
+        )
+    ]
+    return pod
+
+
+def soft_anti_pod(name, labels=None, weight=100, sign="anti"):
+    """A pending pod with PREFERRED self-(anti-)affinity on the zone
+    key — the spread-replicas-apart (anti) / pack-replicas-together
+    (affinity) preference."""
+    from karpenter_tpu.api.core import WeightedPodAffinityTerm
+
+    labels = dict(labels or {"app": "db"})
+    pod = Pod(
+        metadata=ObjectMeta(name=name, labels=labels),
+        spec=PodSpec(
+            node_name="",
+            containers=[
+                Container(requests=resource_list(cpu="1", memory="1Gi"))
+            ],
+        ),
+    )
+    term = WeightedPodAffinityTerm(
+        weight=weight,
+        pod_affinity_term=PodAffinityTerm(
+            label_selector=LabelSelector(match_labels=dict(labels)),
+            topology_key=ZONE_KEY,
+        ),
+    )
+    pod.spec.affinity = Affinity(
+        pod_anti_affinity=(
+            PodAntiAffinity(
+                preferred_during_scheduling_ignored_during_execution=[term]
+            )
+            if sign == "anti"
+            else None
+        ),
+        pod_affinity=(
+            PodAffinity(
+                preferred_during_scheduling_ignored_during_execution=[term]
+            )
+            if sign == "co"
+            else None
+        ),
+    )
+    return pod
+
+
+class TestSoftConstraintScoring:
+    """ScheduleAnyway spread and preferred self-(anti-)affinity as
+    pod_group_score contributions — the kube-scheduler's scoring
+    plugins, steering but never constraining."""
+
+    def test_schedule_anyway_steers_to_emptier_domain(self, env):
+        runtime, _ = env
+        zoned(runtime)
+        for i in range(2):
+            runtime.store.create(
+                bound_pod(f"old{i}", {"app": "web"}, "n-a")
+            )
+        for i in range(4):
+            runtime.store.create(soft_spread_pod(f"p{i}", {"app": "web"}))
+        runtime.manager.reconcile_all()
+        # a preference steers the whole shape to the emptier zone; it
+        # must never mark anything unschedulable
+        assert pods_per_group(runtime, ["group-a", "group-b"]) == {
+            "group-a": 0,
+            "group-b": 4,
+        }
+        assert total_unschedulable(runtime, "group-a") == 0
+
+    def test_schedule_anyway_never_blocks(self, env):
+        """Only the loaded zone is feasible: ScheduleAnyway yields."""
+        runtime, _ = env
+        zoned(runtime, zones=("a",))
+        runtime.store.create(bound_pod("old", {"app": "web"}, "n-a"))
+        for i in range(3):
+            runtime.store.create(soft_spread_pod(f"p{i}", {"app": "web"}))
+        runtime.manager.reconcile_all()
+        assert pods_per_group(runtime, ["group-a"]) == {"group-a": 3}
+        assert total_unschedulable(runtime, "group-a") == 0
+
+    def test_schedule_anyway_ranks_keyless_groups_last(self, env):
+        runtime, _ = env
+        # group-a has NO zone label; group-b is keyed and empty
+        runtime.store.create(ready_node("n-a", {"group": "a"}))
+        runtime.store.create(pending_mp("group-a", {"group": "a"}))
+        runtime.store.create(
+            ready_node("n-b", {"group": "b", ZONE_KEY: "us-b"})
+        )
+        runtime.store.create(pending_mp("group-b", {"group": "b"}))
+        for i in range(2):
+            runtime.store.create(soft_spread_pod(f"p{i}", {"app": "web"}))
+        runtime.manager.reconcile_all()
+        assert pods_per_group(runtime, ["group-a", "group-b"]) == {
+            "group-a": 0,
+            "group-b": 2,
+        }
+
+    def test_preferred_anti_avoids_occupied_zone(self, env):
+        runtime, _ = env
+        zoned(runtime)
+        runtime.store.create(bound_pod("db-live", {"app": "db"}, "n-a"))
+        for i in range(2):
+            runtime.store.create(soft_anti_pod(f"db-{i}"))
+        runtime.manager.reconcile_all()
+        assert pods_per_group(runtime, ["group-a", "group-b"]) == {
+            "group-a": 0,
+            "group-b": 2,
+        }
+        assert total_unschedulable(runtime, "group-a") == 0
+
+    def test_preferred_anti_yields_when_only_occupied_zone_fits(self, env):
+        runtime, _ = env
+        zoned(runtime, zones=("a",))
+        runtime.store.create(bound_pod("db-live", {"app": "db"}, "n-a"))
+        runtime.store.create(soft_anti_pod("db-1"))
+        runtime.manager.reconcile_all()
+        assert pods_per_group(runtime, ["group-a"]) == {"group-a": 1}
+        assert total_unschedulable(runtime, "group-a") == 0
+
+    def test_preferred_co_packs_toward_existing_replicas(self, env):
+        runtime, _ = env
+        zoned(runtime)
+        runtime.store.create(bound_pod("db-live", {"app": "db"}, "n-b"))
+        for i in range(2):
+            runtime.store.create(soft_anti_pod(f"db-{i}", sign="co"))
+        runtime.manager.reconcile_all()
+        assert pods_per_group(runtime, ["group-a", "group-b"]) == {
+            "group-a": 0,
+            "group-b": 2,
+        }
+
+    def test_foreign_selector_preference_is_not_modeled(self, env):
+        """A preferred anti term over ANOTHER workload's labels is not
+        self-matching: decoded, no score contribution — the row stays
+        on plain first-feasible assignment."""
+        runtime, _ = env
+        zoned(runtime)
+        runtime.store.create(bound_pod("web", {"app": "web"}, "n-a"))
+        pod = soft_anti_pod("db-1", labels={"app": "db"})
+        term = (
+            pod.spec.affinity.pod_anti_affinity
+            .preferred_during_scheduling_ignored_during_execution[0]
+        )
+        term.pod_affinity_term.label_selector = LabelSelector(
+            match_labels={"app": "web"}
+        )
+        runtime.store.create(pod)
+        runtime.manager.reconcile_all()
+        # first feasible group wins (group-a), despite web's presence
+        assert pods_per_group(runtime, ["group-a", "group-b"]) == {
+            "group-a": 1,
+            "group-b": 0,
+        }
+
+    def test_all_encode_paths_agree_with_soft_scoring(self):
+        from karpenter_tpu.metrics.producers.pendingcapacity import (
+            _group_profile,
+            solve_pending,
+        )
+        from karpenter_tpu.metrics.registry import GaugeRegistry
+        from karpenter_tpu.store.columnar import (
+            PendingFeed,
+            PendingPodCache,
+        )
+        from karpenter_tpu.store.store import Store
+
+        store = Store()
+        cache = PendingPodCache(store)
+        feed = PendingFeed(store, _group_profile)
+        for z in ("a", "b"):
+            store.create(
+                ready_node(f"n-{z}", {"group": z, ZONE_KEY: f"us-{z}"})
+            )
+            store.create(pending_mp(f"group-{z}", {"group": z}))
+        store.create(bound_pod("old", {"app": "web"}, "n-a"))
+        for i in range(3):
+            store.create(soft_spread_pod(f"p{i}", {"app": "web"}))
+        store.create(soft_anti_pod("db-1"))
+        results = []
+        for kwargs in ({}, {"pod_cache": cache}, {"feed": feed}):
+            mps = [
+                mp for mp in store.list("MetricsProducer")
+                if mp.spec.pending_capacity is not None
+            ]
+            solve_pending(store, mps, GaugeRegistry(), **kwargs)
+            results.append(
+                {
+                    mp.metadata.name: (
+                        mp.status.pending_capacity.pending_pods,
+                        mp.status.pending_capacity.unschedulable_pods,
+                    )
+                    for mp in mps
+                }
+            )
+        assert results[0] == results[1] == results[2]
+        # web steers to the emptier zone b; db has no occupancy signal
+        # and stays first-feasible (a)
+        assert results[0]["group-b"][0] == 3
 
 
 class TestEncodeMemoWithOccupancy:
