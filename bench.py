@@ -221,6 +221,14 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
         "the solve)",
     )
     ap.add_argument(
+        "--spread", type=float, default=0.0,
+        help="with --e2e: fraction of pods carrying a hard zone "
+        "topologySpreadConstraint with a self-matching selector; nodes "
+        "gain zone labels and a slab of BOUND pods churns each tick, so "
+        "every measured tick pays the existing-pod occupancy census "
+        "(DomainCensus) recompute on top of the split expansion",
+    )
+    ap.add_argument(
         "--backend",
         choices=("auto", "xla", "pallas", "numpy"),
         default="auto",
@@ -320,6 +328,11 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
         )
     if not 0.0 <= args.anti <= 1.0:
         ap.error("--anti must be a fraction in [0, 1]")
+    if args.spread and not args.e2e:
+        ap.error("--spread applies to --e2e only (it builds real "
+                 "topologySpreadConstraint specs + bound-pod occupancy)")
+    if not 0.0 <= args.spread <= 1.0:
+        ap.error("--spread must be a fraction in [0, 1]")
     if args.slices < 1:
         ap.error("--slices must be >= 1")
     if args.slices > 1 and not args.mesh:
@@ -371,6 +384,10 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
         metric += f", {args.affinity:.0%} pods with node affinity"
     if args.anti:
         metric += f", {args.anti:.0%} pods one-per-node"
+    if args.spread:
+        metric += (
+            f", {args.spread:.0%} pods zone-spread w/ occupancy census"
+        )
     try:
         if args.mesh:
             run_mesh(args, metric)
@@ -717,6 +734,7 @@ def run_e2e(args, metric: str, note: str = "") -> None:  # lint: allow-complexit
         # affinity and pod anti-affinity
         affinity = None
         labels = {}
+        constraints = []
         if affinity_shapes and rng.random() < args.affinity:
             affinity = affinity_shapes[
                 int(rng.integers(0, len(affinity_shapes)))
@@ -735,6 +753,23 @@ def run_e2e(args, metric: str, note: str = "") -> None:  # lint: allow-complexit
                 ),
                 pod_anti_affinity=anti.pod_anti_affinity,
             )
+        if args.spread and rng.random() < args.spread:
+            # a handful of zone-spread Deployments (distinct selectors =
+            # distinct spread shapes + distinct census queries)
+            from karpenter_tpu.api.core import TopologySpreadConstraint
+
+            app = f"web{int(rng.integers(0, 8))}"
+            labels = {**labels, "spread-app": app}
+            constraints = [
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key="topology.kubernetes.io/zone",
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector={
+                        "matchLabels": {"spread-app": app}
+                    },
+                )
+            ]
         return Pod(
             metadata=ObjectMeta(name=name, labels=labels),
             spec=PodSpec(
@@ -747,6 +782,7 @@ def run_e2e(args, metric: str, note: str = "") -> None:  # lint: allow-complexit
                     )
                 ],
                 affinity=affinity,
+                topology_spread_constraints=constraints,
             ),
         )
 
@@ -755,13 +791,18 @@ def run_e2e(args, metric: str, note: str = "") -> None:  # lint: allow-complexit
     nodes = []
     for g in range(args.types):
         cores = int(rng.choice([8, 16, 32, 64, 96]))
+        node_labels = {
+            "group": f"g{g}",
+            "disk": "ssd" if g % 2 else "hdd",
+        }
+        if args.spread:
+            # 16 zones across the groups: domains for the split + the
+            # occupancy census
+            node_labels["topology.kubernetes.io/zone"] = f"z{g % 16}"
         node = Node(
             metadata=ObjectMeta(
                 name=f"n{g}",
-                labels={
-                    "group": f"g{g}",
-                    "disk": "ssd" if g % 2 else "hdd",
-                },
+                labels=node_labels,
             ),
             status=NodeStatus(
                 allocatable={
@@ -773,6 +814,28 @@ def run_e2e(args, metric: str, note: str = "") -> None:  # lint: allow-complexit
         )
         store.create(node)
         nodes.append(node)
+    # --spread: a slab of BOUND pods (10% of the fleet, capped) feeds the
+    # existing-pod occupancy census; a slice of it churns every measured
+    # tick so the census epoch invalidates and the recompute is IN the
+    # number, not amortized away by the memo
+    def make_bound(name):
+        app = f"web{int(rng.integers(0, 8))}"
+        return Pod(
+            metadata=ObjectMeta(name=name, labels={"spread-app": app}),
+            spec=PodSpec(
+                node_name=f"n{int(rng.integers(0, args.types))}",
+                containers=[
+                    Container(requests={"cpu": cpu_choices[0]})
+                ],
+            ),
+        )
+
+    bound_count = 0
+    if args.spread:
+        bound_count = min(max(args.pods // 10, 1), 10000)
+        for i in range(bound_count):
+            store.create(make_bound(f"b{i}"))
+
     producers = [
         store.create(
             MetricsProducer(
@@ -847,13 +910,26 @@ def run_e2e(args, metric: str, note: str = "") -> None:  # lint: allow-complexit
     # controller's work starts: the store mutation and its watch fan-out.
     churn = args.churn if args.churn >= 0 else max(1, args.pods // 100)
     next_id = args.pods
+    next_bound = bound_count
+    # honest labeling: --churn 0 must stay a genuinely churn-free tick
+    bound_churn = max(1, churn // 10) if (bound_count and churn) else 0
     times = []
     for it in range(args.iters):
         fresh = [make_pod(f"p{next_id + j}") for j in range(churn)]
         victims = [f"p{next_id - args.pods + j}" for j in range(churn)]
         next_id += churn
+        fresh_bound = [
+            make_bound(f"b{next_bound + j}") for j in range(bound_churn)
+        ]
+        bound_victims = [
+            f"b{next_bound - bound_count + j}" for j in range(bound_churn)
+        ]
+        next_bound += bound_churn
         t0 = time.perf_counter()
         for victim, pod in zip(victims, fresh):
+            store.delete("Pod", "default", victim)
+            store.create(pod)
+        for victim, pod in zip(bound_victims, fresh_bound):
             store.delete("Pod", "default", victim)
             store.create(pod)
         tick()

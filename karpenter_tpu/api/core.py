@@ -173,7 +173,9 @@ class PodAntiAffinity:
     required_during_scheduling_ignored_during_execution: List[
         PodAffinityTerm
     ] = field(default_factory=list)
-    # soft anti-affinity is a scheduler preference, decoded not modeled
+    # soft anti-affinity is a scheduler preference: the self-matching
+    # slice is SCORED (soft_pod_affinity_shape -> pod_group_score),
+    # never constrained
     preferred_during_scheduling_ignored_during_execution: List[
         WeightedPodAffinityTerm
     ] = field(default_factory=list)
@@ -193,8 +195,9 @@ class PodAffinity:
 class Affinity:
     node_affinity: Optional[NodeAffinity] = None
     # inter-pod (anti-)affinity: the SELF-matching required slice is
-    # modeled by the solver (anti_affinity_shape below); selectors over
-    # OTHER pods' labels need pairwise pod state and are decoded for
+    # constrained (pod_affinity_shape) and the self-matching preferred
+    # slice scored (soft_pod_affinity_shape); selectors over OTHER
+    # pods' labels need pairwise pod state and are decoded for
     # fidelity only (docs/OPERATIONS.md 'Scheduling fidelity')
     pod_affinity: Optional[PodAffinity] = None
     pod_anti_affinity: Optional[PodAntiAffinity] = None
@@ -207,9 +210,10 @@ class TopologySpreadConstraint:
     matching-pod counts per domain — labelSelector (refined by
     matchLabelKeys with the pod's own values) drives the census
     (producers/pendingcapacity.DomainCensus) exactly as the scheduler's
-    skew check counts it. ScheduleAnyway is a scheduler preference,
-    decoded but not constrained (docs/OPERATIONS.md 'Scheduling
-    fidelity')."""
+    skew check counts it. ScheduleAnyway is a scheduler preference:
+    scored against the same census (soft_spread_shape ->
+    pod_group_score), never constrained (docs/OPERATIONS.md
+    'Scheduling fidelity')."""
 
     max_skew: int = 1
     topology_key: str = ""

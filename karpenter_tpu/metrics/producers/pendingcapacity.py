@@ -579,29 +579,12 @@ class DomainCensus:
         """{topology value: matching-pod count} over ALL live nodes —
         the scoring-side census (soft spread / preferred inter-pod
         affinity score existing placements; no node filter applies to
-        a preference)."""
-        groups = self._ns_groups(namespace)  # also the epoch check
-        memo_key = ("counts", namespace, sel_form, key)
-        got = self._memo.get(memo_key)
-        if got is not None:
-            return got
-        by_name = self._node_memo.get("byname")
-        if by_name is None:
-            by_name = dict(self._nodes())
-            self._node_memo["byname"] = by_name
-        counts: Dict[str, int] = {}
-        if sel_form is not None:
-            for labels_items, nodes in groups:
-                if not selector_form_matches(
-                    sel_form, dict(labels_items)
-                ):
-                    continue
-                for node, n in nodes.items():
-                    labels = by_name.get(node)
-                    value = labels.get(key) if labels else None
-                    if value is not None:
-                        counts[value] = counts.get(value, 0) + n
-        self._memo[memo_key] = counts
+        a preference). One counting implementation: this is spread()
+        with the pass-all node filter, sharing its memos — the same
+        token the hard path's nodeAffinityPolicy=Ignore case uses."""
+        counts, _present = self.spread(
+            namespace, sel_form, key, ("ignore",), lambda labels: True
+        )
         return counts
 
     def _workload_nodes(self, namespace, sel_forms) -> tuple:
