@@ -911,8 +911,13 @@ def run_e2e(args, metric: str, note: str = "") -> None:  # lint: allow-complexit
     churn = args.churn if args.churn >= 0 else max(1, args.pods // 100)
     next_id = args.pods
     next_bound = bound_count
-    # honest labeling: --churn 0 must stay a genuinely churn-free tick
-    bound_churn = max(1, churn // 10) if (bound_count and churn) else 0
+    # honest labeling: --churn 0 must stay a genuinely churn-free tick;
+    # the window can never exceed the slab (victims must exist)
+    bound_churn = (
+        min(bound_count, max(1, churn // 10))
+        if (bound_count and churn)
+        else 0
+    )
     times = []
     for it in range(args.iters):
         fresh = [make_pod(f"p{next_id + j}") for j in range(churn)]

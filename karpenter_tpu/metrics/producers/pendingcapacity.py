@@ -691,8 +691,7 @@ def _row_node_filter(snap, slot: int) -> tuple:
     return token, node_passes
 
 
-def _water_fill(counts: List[int], caps: Optional[List[int]],
-                schedulable: int, seed: int) -> List[int]:
+def _water_fill(counts, caps, schedulable: int, seed: int) -> np.ndarray:
     """Distribute `schedulable` new replicas over domains that already
     hold `counts` matching pods, filling the least-loaded first (the
     only incremental order the skew check always admits: each placement
@@ -700,24 +699,24 @@ def _water_fill(counts: List[int], caps: Optional[List[int]],
     (None = unbounded). Returns per-domain additions. The remainder at
     the final water level rotates by content-keyed `seed`, so no domain
     is systematically overweighted across shapes (and the choice never
-    depends on arena-local numbering)."""
-    d = len(counts)
+    depends on arena-local numbering). All-numpy: runs per dedup row on
+    the churned-tick hot path."""
+    c = np.asarray(counts, np.int64)
+    cap = None if caps is None else np.asarray(caps, np.int64)
 
     def filled(level: int) -> int:
-        total = 0
-        for i in range(d):
-            add = max(0, level - counts[i])
-            if caps is not None:
-                add = min(add, caps[i])
-            total += add
-        return total
+        add = np.clip(level - c, 0, None)
+        if cap is not None:
+            add = np.minimum(add, cap)
+        return int(add.sum())
 
-    lo = min(counts)
+    lo = int(c.min())
     hi = (
-        max(counts) + schedulable
-        if caps is None
-        else max(c + cap for c, cap in zip(counts, caps))
+        int(c.max()) + schedulable
+        if cap is None
+        else int((c + cap).max())
     )
+    hi = max(lo, hi)
     while lo < hi:  # greatest level with filled(level) <= schedulable
         mid = (lo + hi + 1) // 2
         if filled(mid) <= schedulable:
@@ -725,25 +724,99 @@ def _water_fill(counts: List[int], caps: Optional[List[int]],
         else:
             hi = mid - 1
     level = lo
-    out = []
-    for i in range(d):
-        add = max(0, level - counts[i])
-        if caps is not None:
-            add = min(add, caps[i])
-        out.append(add)
-    remainder = schedulable - sum(out)
-    candidates = [
-        i
-        for i in range(d)
-        if counts[i] + out[i] == level
-        and (caps is None or out[i] < caps[i])
-    ]
-    if remainder and candidates:
-        offset = seed % len(candidates)
-        for j, i in enumerate(candidates):
-            if (j - offset) % len(candidates) < remainder:
-                out[i] += 1
+    out = np.clip(level - c, 0, None)
+    if cap is not None:
+        out = np.minimum(out, cap)
+    remainder = schedulable - int(out.sum())
+    if remainder:
+        at_level = c + out == level
+        can_grow = at_level if cap is None else at_level & (out < cap)
+        candidates = np.flatnonzero(can_grow)
+        if len(candidates):
+            offset = seed % len(candidates)
+            chosen = (
+                np.arange(len(candidates)) - offset
+            ) % len(candidates) < remainder
+            out[candidates[chosen]] += 1
     return out
+
+
+def _spread_caps(namespace, entries, values, census, row_filter):
+    """(caps[d] pre-weight-clamp, fill[d]) for one spread shape under
+    one row node filter: the per-domain new-replica caps — the MIN over
+    EVERY same-split-key entry, each evaluated under its own selector
+    and policy (a single "first entry" cap could silently drop a
+    tighter same-key constraint, r3 code review) — and the fill-order
+    counts of the first entry. Entries on other keys contribute
+    key-presence exclusion only (documented approximation). A pure
+    function of (shape, filter): every row of a replicated workload
+    shares the result through the caller's memo; only weight and the
+    rotation seed differ per row."""
+    split_key = entries[0][0]
+    d = len(values)
+    token, node_passes = row_filter
+    unbounded = np.iinfo(np.int64).max // 4
+    caps = np.full(d, unbounded, np.int64)
+
+    def entry_counts(e):
+        key, _skew, _mind, sel, _self, honor = e
+        if census is None or sel is None:
+            return {}, set()
+        if honor:
+            return census.spread(namespace, sel, key, token, node_passes)
+        # nodeAffinityPolicy=Ignore: every live node exposing the key
+        # defines a domain and contributes counts
+        return census.spread(
+            namespace, sel, key, ("ignore",), lambda labels: True
+        )
+
+    for e in entries:
+        if e[0] != split_key:
+            continue
+        _key, skew, min_domains, _sel, self_match, _honor = e
+        counts_e, present_e = entry_counts(e)
+        c_e = np.array([counts_e.get(v, 0) for v in values], np.int64)
+        min_rule = bool(min_domains) and d < min_domains
+        if not self_match:
+            # placements never accumulate into this entry's counts: its
+            # skew check is static per domain — existing count must stay
+            # within maxSkew of the global minimum (0 under the
+            # minDomains rule)
+            floor = 0 if min_rule else min(
+                [
+                    int(c_e.min()),
+                    *(
+                        counts_e.get(v, 0)
+                        for v in present_e - set(values)
+                    ),
+                ]
+            )
+            caps[c_e - floor > skew] = 0
+        elif min_rule:
+            # the scheduler's minDomains rule: too few eligible domains
+            # treats the global minimum as 0, so each domain holds at
+            # most maxSkew matching pods INCLUDING the existing ones;
+            # the rest stay unschedulable
+            caps = np.minimum(caps, np.clip(skew - c_e, 0, None))
+        else:
+            outside = present_e - set(values)
+            m_out = min(
+                (counts_e.get(v, 0) for v in outside), default=None
+            )
+            if m_out is not None:
+                caps = np.minimum(
+                    caps, np.clip(m_out + skew - c_e, 0, None)
+                )
+    # the fill ORDER (least-loaded first) follows the FIRST entry's
+    # counts; a non-self-matching first entry never accumulates, so its
+    # fill is plain balanced within the caps
+    first_counts, _ = entry_counts(entries[0])
+    fill = (
+        np.array([first_counts.get(v, 0) for v in values], np.int64)
+        if entries[0][4]
+        else np.zeros(d, np.int64)
+    )
+    return caps, fill
 
 
 def _expand_spread_rows(  # lint: allow-complexity — per-domain chunking: each guard is a documented spread rule
@@ -812,8 +885,9 @@ def _expand_spread_rows(  # lint: allow-complexity — per-domain chunking: each
         if not (live_ids != 0).any():
             return row_idx, row_weight, None
 
-    # per live shape: (namespace, split entry, ordered domain values,
-    # value -> group list)
+    # per live shape: (namespace, entries, ordered domain values,
+    # [D, T] per-domain forbidden-mask matrix — built ONCE per shape,
+    # rows are emitted by reference and only copied by the final stack)
     plan: Dict[int, tuple] = {}
     for s in np.unique(live_ids):
         shape = shapes[s]
@@ -826,99 +900,51 @@ def _expand_spread_rows(  # lint: allow-complexity — per-domain chunking: each
         for t, labels in enumerate(label_dicts):
             if all(key in labels for key in keys):
                 domains.setdefault(labels[split_key], []).append(t)
-        plan[int(s)] = (namespace, entries, sorted(domains), domains)
+        values = sorted(domains)
+        masks = np.ones((len(values), n_groups), bool)
+        for rank, value in enumerate(values):
+            masks[rank, domains[value]] = False
+        plan[int(s)] = (namespace, entries, values, masks)
 
+    all_forbidden = np.ones(n_groups, bool)
+    no_forbidden = np.zeros(n_groups, bool)
+    # caps (pre-weight-clamp) and fill counts are a pure function of
+    # (shape, row node filter): every row of a replicated workload
+    # shares them — only weight and the rotation seed differ per row
+    caps_memo: Dict[tuple, tuple] = {}
     out_idx, out_weight, out_forbidden = [], [], []
     for i, sid in enumerate(live_ids):
         entry = plan.get(int(sid))
         if entry is None:
             out_idx.append(row_idx[i])
             out_weight.append(row_weight[i])
-            out_forbidden.append(np.zeros(n_groups, bool))
+            out_forbidden.append(no_forbidden)
             continue
-        namespace, entries, values, domains = entry
-        split_key = entries[0][0]
+        namespace, entries, values, masks = entry
         weight = int(row_weight[i])
         if not values or weight == 0:
             # no group exposes the key(s): unschedulable by spread —
             # keep the row, forbid everything, so the pods are COUNTED
             out_idx.append(row_idx[i])
             out_weight.append(row_weight[i])
-            out_forbidden.append(np.ones(n_groups, bool))
+            out_forbidden.append(all_forbidden)
             continue
         d = len(values)
-
-        def entry_counts(e):
-            key, _skew, _mind, sel, _self, honor = e
-            if census is None or sel is None:
-                return {}, set()
-            if honor:
-                token, node_passes = _row_node_filter(snap, row_idx[i])
-            else:
-                # nodeAffinityPolicy=Ignore: every live node exposing
-                # the key defines a domain and contributes counts
-                token, node_passes = ("ignore",), (lambda labels: True)
-            return census.spread(namespace, sel, key, token, node_passes)
-
-        # EVERY entry on the split key is enforced independently by the
-        # scheduler, so the per-domain cap is the MIN over all of them
-        # — each evaluated under its own selector/policy (a single
-        # "first entry" cap could silently drop a tighter same-key
-        # constraint, r3 code review). Entries on other keys contribute
-        # key-presence exclusion only (documented approximation).
-        caps = [weight] * d  # weight == effectively unbounded
-        for e in entries:
-            if e[0] != split_key:
-                continue
-            _key, skew, min_domains, _sel, self_match, _honor = e
-            counts_e, present_e = entry_counts(e)
-            c_e = [counts_e.get(v, 0) for v in values]
-            min_rule = bool(min_domains) and d < min_domains
-            if not self_match:
-                # placements never accumulate into this entry's counts:
-                # its skew check is static per domain — existing count
-                # must stay within maxSkew of the global minimum (0
-                # under the minDomains rule)
-                floor = 0 if min_rule else min(
-                    [
-                        *c_e,
-                        *(
-                            counts_e.get(v, 0)
-                            for v in present_e - set(values)
-                        ),
-                    ],
-                    default=0,
-                )
-                for j in range(d):
-                    if c_e[j] - floor > skew:
-                        caps[j] = 0
-            elif min_rule:
-                # the scheduler's minDomains rule: too few eligible
-                # domains treats the global minimum as 0, so each domain
-                # holds at most maxSkew matching pods INCLUDING the
-                # existing ones; the rest stay unschedulable
-                for j in range(d):
-                    caps[j] = min(caps[j], max(0, skew - c_e[j]))
-            else:
-                outside = present_e - set(values)
-                m_out = min(
-                    (counts_e.get(v, 0) for v in outside), default=None
-                )
-                if m_out is not None:
-                    for j in range(d):
-                        caps[j] = min(
-                            caps[j], max(0, m_out + skew - c_e[j])
-                        )
-        # the fill ORDER (least-loaded first) follows the FIRST entry's
-        # counts; a non-self-matching first entry never accumulates, so
-        # its fill is plain balanced within the caps
-        first_counts, _ = entry_counts(entries[0])
-        fill = (
-            [first_counts.get(v, 0) for v in values]
-            if entries[0][4]
-            else [0] * d
+        row_filter = (
+            _row_node_filter(snap, row_idx[i])
+            if census is not None
+            else (None, None)
         )
-        schedulable = min(weight, sum(caps))
+        memo_key = (int(sid), row_filter[0])
+        memoized = caps_memo.get(memo_key)
+        if memoized is None:
+            memoized = _spread_caps(
+                namespace, entries, values, census, row_filter
+            )
+            caps_memo[memo_key] = memoized
+        raw_caps, fill = memoized
+        caps = np.minimum(raw_caps, weight)  # weight == unbounded
+        schedulable = min(weight, int(caps.sum()))
         # content-keyed remainder rotation (see _water_fill)
         seed = weight + int(
             np.ascontiguousarray(snap.requests[row_idx[i]])
@@ -926,19 +952,17 @@ def _expand_spread_rows(  # lint: allow-complexity — per-domain chunking: each
             .sum()
         )
         additions = _water_fill(fill, caps, schedulable, seed)
-        for rank, value in enumerate(values):
-            chunk = additions[rank]
+        for rank in range(d):
+            chunk = int(additions[rank])
             if chunk == 0:
                 continue
-            forbidden = np.ones(n_groups, bool)
-            forbidden[domains[value]] = False
             out_idx.append(row_idx[i])
             out_weight.append(np.int32(chunk))
-            out_forbidden.append(forbidden)
+            out_forbidden.append(masks[rank])
         if schedulable < weight:
             out_idx.append(row_idx[i])
             out_weight.append(np.int32(weight - schedulable))
-            out_forbidden.append(np.ones(n_groups, bool))
+            out_forbidden.append(all_forbidden)
     return (
         np.asarray(out_idx, np.intp),
         np.asarray(out_weight, np.int32),
