@@ -19,6 +19,8 @@ for args in \
     "--anti 0.3 --iters 10" \
     "--e2e" \
     "--e2e --affinity 0.3" \
+    "--e2e --anti 0.05" \
+    "--e2e --spread 0.1" \
     "--e2e --pods 1000000 --churn 1000 --iters 5" \
     "--decide 100000" \
     "--clusters 10 --types 30 --pods 100000" \

@@ -346,6 +346,7 @@ def solve_pending(  # lint: allow-complexity — the one batched solve: per-targ
     else:
         inputs = _encode_from_cache(snap, profiles, census=census)
         _dispatch_and_record(inputs, targets, registry, solver, errors)
+    _publish_census(registry, census)
     return {
         (namespace, name): errors.get((namespace, name))
         for namespace, name, _, _, _ in targets
@@ -496,6 +497,12 @@ class DomainCensus:
         self._memo: Dict[tuple, object] = {}
         self._node_memo: Dict[tuple, object] = {}
         self._named_labels: Optional[List[Tuple[str, dict]]] = None
+        # epoch invalidations (bound-pod or node churn between solves);
+        # published as karpenter_runtime_census_refresh_total so an
+        # operator can see how often constrained ticks pay a recompute.
+        # `published` is the _publish_census watermark.
+        self.refreshes = 0
+        self.published = 0
 
     def _fresh(self, generation: int) -> None:
         epoch = (generation, self._node_version_fn())
@@ -504,6 +511,7 @@ class DomainCensus:
             self._memo.clear()
             self._node_memo.clear()
             self._named_labels = None
+            self.refreshes += 1
 
     def _ns_groups(self, namespace) -> list:
         """Epoch check + consistent copy of one namespace's census slice
@@ -1579,6 +1587,21 @@ def _encode_from_cache(snap, profiles, with_rows: bool = False, census=None):  #
         # slot) with multiplicity row_weight[i]
         return inputs, row_idx, row_weight
     return inputs
+
+
+def _publish_census(registry: GaugeRegistry, census) -> None:
+    """karpenter_runtime_census_refresh_total: occupancy-census epoch
+    recomputes (bound-pod / node churn between constrained solves).
+    Delta-published so the persistent feed census and the per-solve
+    oracle census report the same way."""
+    if census is None:
+        return
+    delta = census.refreshes - census.published
+    if delta:
+        registry.register(
+            "runtime", "census_refresh_total", kind="counter"
+        ).inc("-", "-", delta)
+        census.published = census.refreshes
 
 
 def _count_cache(registry: GaugeRegistry, outcome: str) -> None:

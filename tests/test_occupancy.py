@@ -196,6 +196,95 @@ def total_unschedulable(runtime, name):
     )
 
 
+class TestWaterFill:
+    """Property tests of the water-fill against a scalar placement
+    oracle: place pods ONE AT A TIME into a current-minimum domain
+    (the only order the kube-scheduler skew check always admits) and
+    compare final totals."""
+
+    def _oracle(self, counts, caps, schedulable):
+        totals = list(counts)
+        placed = [0] * len(counts)
+        for _ in range(schedulable):
+            candidates = [
+                j
+                for j in range(len(totals))
+                if caps is None or placed[j] < caps[j]
+            ]
+            if not candidates:
+                break
+            j = min(candidates, key=lambda j: (totals[j], j))
+            totals[j] += 1
+            placed[j] += 1
+        return placed
+
+    def test_matches_scalar_oracle_totals(self):
+        import numpy as np
+
+        from karpenter_tpu.metrics.producers.pendingcapacity import (
+            _water_fill,
+        )
+
+        rng = np.random.default_rng(7)
+        for trial in range(300):
+            d = int(rng.integers(1, 9))
+            counts = rng.integers(0, 12, d).tolist()
+            caps = (
+                None
+                if rng.random() < 0.3
+                else rng.integers(0, 10, d).tolist()
+            )
+            capacity = (
+                10 ** 9 if caps is None else int(sum(caps))
+            )
+            schedulable = min(int(rng.integers(0, 40)), capacity)
+            got = _water_fill(counts, caps, schedulable, int(rng.integers(0, 97)))
+            assert int(got.sum()) == schedulable
+            if caps is not None:
+                assert (got <= np.asarray(caps)).all()
+            # water-filling and lowest-first placement agree on the
+            # FINAL LEVELS (multiset of totals); the remainder rotation
+            # may pick different equal-level domains than the oracle's
+            # index tie-break, so compare sorted totals
+            oracle = self._oracle(counts, caps, schedulable)
+            assert sorted(
+                c + int(g) for c, g in zip(counts, got)
+            ) == sorted(c + p for c, p in zip(counts, oracle))
+
+    def test_every_placement_is_skew_legal(self):
+        """Replaying the water-fill result lowest-first never places
+        into a domain more than maxSkew above the running minimum —
+        the incremental admissibility the split promises. Modeled with
+        caps = m_out + skew - c (the frozen-outside-minimum rule)."""
+        import numpy as np
+
+        from karpenter_tpu.metrics.producers.pendingcapacity import (
+            _water_fill,
+        )
+
+        rng = np.random.default_rng(11)
+        for trial in range(200):
+            d = int(rng.integers(1, 7))
+            skew = int(rng.integers(1, 4))
+            counts = rng.integers(0, 8, d).tolist()
+            m_out = int(rng.integers(0, 8))
+            caps = [max(0, m_out + skew - c) for c in counts]
+            schedulable = min(int(rng.integers(0, 30)), sum(caps))
+            got = _water_fill(counts, caps, schedulable, trial)
+            totals = list(counts)
+            remaining = [int(g) for g in got]
+            for _ in range(schedulable):
+                # place into the lowest destination domain still owed
+                j = min(
+                    (j for j in range(d) if remaining[j]),
+                    key=lambda j: (totals[j], j),
+                )
+                global_min = min([*totals, m_out])
+                assert totals[j] + 1 - global_min <= skew
+                totals[j] += 1
+                remaining[j] -= 1
+
+
 class TestScheduledOccupancy:
     """The incremental census itself (store/columnar)."""
 
@@ -1000,6 +1089,41 @@ class TestEncodeMemoWithOccupancy:
         assert self._solve(store, feed, counting_encode) == 1
         store.create(bound_pod("scheduled", {"app": "web"}, "n1"))
         assert self._solve(store, feed, counting_encode) == 1  # memo hit
+
+    def test_census_refresh_counter_published(self):
+        from karpenter_tpu.metrics.producers import pendingcapacity as PC
+        from karpenter_tpu.metrics.producers.pendingcapacity import (
+            _group_profile,
+        )
+        from karpenter_tpu.metrics.registry import GaugeRegistry
+        from karpenter_tpu.store.columnar import PendingFeed
+        from karpenter_tpu.store.store import Store
+
+        store = Store()
+        feed = PendingFeed(store, _group_profile)
+        registry = GaugeRegistry()
+        store.create(ready_node("n1", {"group": "a", ZONE_KEY: "us-a"}))
+        store.create(pending_mp("group-a", {"group": "a"}))
+        store.create(spread_pod("p0", {"app": "web"}))
+
+        def solve():
+            mps = [
+                mp for mp in store.list("MetricsProducer")
+                if mp.spec.pending_capacity is not None
+            ]
+            PC.solve_pending(store, mps, registry, feed=feed)
+
+        solve()
+        counter = registry.register(
+            "runtime", "census_refresh_total", kind="counter"
+        )
+        first = counter.get("-", "-") or 0
+        assert first >= 1  # the first constrained solve recomputed
+        solve()  # nothing churned: served from the census memo
+        assert (counter.get("-", "-") or 0) == first
+        store.create(bound_pod("scheduled", {"app": "web"}, "n1"))
+        solve()
+        assert (counter.get("-", "-") or 0) == first + 1
 
     def test_constrained_fleet_reencodes_on_bound_churn(
         self, counting_encode
