@@ -9,8 +9,9 @@ cd "$(dirname "$0")/.."
 failures=0
 # The suite is run right after a successful probe (hack/tpu-watch-capture.sh
 # or an operator who just checked the chip), so a mid-suite hang means the
-# tunnel dropped — fall back fast rather than letting all nine configs wait
-# out the default 21-minute hang schedule independently (~3h of nothing).
+# tunnel dropped — fall back fast rather than letting every config in the
+# list below wait out the default 21-minute hang schedule independently
+# (hours of nothing).
 HANG_SCHEDULE="${PROBE_HANG_SCHEDULE:-}"
 for args in \
     "--backend pallas" \

@@ -33,13 +33,20 @@ DEFAULT_ALGORITHM = "proportional"
 
 @dataclass
 class Metric:
-    """Observed value + target (reference: algorithm.go:29-34)."""
+    """Observed value + target (reference: algorithm.go:29-34).
+
+    `owner` (the observing autoscaler's (namespace, name)) and `at`
+    (observation time) extend the reference shape so STATEFUL
+    algorithms (trend windows) can key and order their history; both
+    default empty for plain stateless use."""
 
     value: float = 0.0
     target_type: str = ""
     target_value: float = 0.0
     name: str = ""
     labels: Dict[str, str] = field(default_factory=dict)
+    owner: tuple = ()
+    at: float = 0.0
 
 
 _registry: Dict[str, Callable[[], object]] = {}
@@ -96,6 +103,14 @@ def for_spec(ha_or_none=None):
 
 
 register_algorithm(DEFAULT_ALGORITHM, Proportional)
+
+# trend: the factory returns FRESH instances; the autoscaler engine
+# memoizes one per name (autoscaler.py _algorithm_instances), so trend
+# windows survive across reconciles without a process-wide global that
+# would leak history (and fake clocks) across runtimes
+from karpenter_tpu.autoscaler.algorithms.trend import Trend  # noqa: E402
+
+register_algorithm("trend", Trend)
 
 # admission wiring: the api layer exposes a hook registry (it cannot import
 # this package — that would invert the layering); importing the algorithms

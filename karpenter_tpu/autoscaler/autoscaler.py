@@ -86,6 +86,9 @@ class BatchAutoscaler:
         # Times enter the kernel as f32 seconds relative to this epoch so a
         # long-lived process never loses sub-second precision to f32.
         self.epoch = clock()
+        # per-engine memo of custom Algorithm instances (see
+        # _snapshot_row): stateful algorithms keep their windows here
+        self._algorithm_instances: Dict[str, object] = {}
 
     # -- snapshot ---------------------------------------------------------
 
@@ -106,11 +109,16 @@ class BatchAutoscaler:
             # passes them through exactly) so select policy, stabilization,
             # rate-limit policies, and bounds still apply ON DEVICE
             name = algorithms.algorithm_name(ha)
-            custom = (
-                algorithms.for_spec(ha)
-                if name != algorithms.DEFAULT_ALGORITHM
-                else None
-            )
+            custom = None
+            if name != algorithms.DEFAULT_ALGORITHM:
+                # instances are memoized PER ENGINE, not per process:
+                # stateful algorithms (trend windows) must survive
+                # across reconciles but never leak across runtimes or
+                # share clocks with another engine's fake time
+                custom = self._algorithm_instances.get(name)
+                if custom is None:
+                    custom = algorithms.for_spec(ha)
+                    self._algorithm_instances[name] = custom
             for metric_spec in ha.spec.metrics:
                 observed = self.metrics.for_metric(metric_spec).get_current_value(
                     metric_spec
@@ -123,6 +131,20 @@ class BatchAutoscaler:
                         target_type=target.type,
                         target_value=target.target_value(),
                         name=getattr(observed, "name", ""),
+                        # labels distinguish two specs over the same
+                        # metric name — stateful algorithms (trend) key
+                        # windows on them, or a sawtooth of interleaved
+                        # series would fit garbage slopes
+                        labels=dict(
+                            getattr(observed, "labels", {}) or {}
+                        ),
+                        # stateful algorithms key history on the OWNING
+                        # autoscaler and order it by this clock
+                        owner=(
+                            ha.metadata.namespace,
+                            ha.metadata.name,
+                        ),
+                        at=self.clock(),
                     )
                     row.values.append(
                         float(

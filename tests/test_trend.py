@@ -1,0 +1,206 @@
+"""Trend algorithm: predictive scaling through the reference's algorithm
+seam (algorithm.go:37-39 leaves selection a TODO with Proportional
+hardcoded; `autoscaling.karpenter.sh/algorithm: trend` selects this one).
+The reference has no predictive capability — a ramping signal is always
+chased from behind by poll-interval lag."""
+
+from karpenter_tpu.api.horizontalautoscaler import AVERAGE_VALUE, UTILIZATION
+from karpenter_tpu.autoscaler.algorithms import Metric
+from karpenter_tpu.autoscaler.algorithms.proportional import Proportional
+from karpenter_tpu.autoscaler.algorithms.trend import Trend
+
+
+def metric(value, at, owner=("default", "ha"), name="q",
+           target_type=AVERAGE_VALUE, target=10.0):
+    return Metric(
+        value=value,
+        target_type=target_type,
+        target_value=target,
+        name=name,
+        owner=owner,
+        at=at,
+    )
+
+
+class TestTrendUnit:
+    def test_rising_series_scales_ahead(self):
+        trend = Trend(window=300.0, horizon=60.0)
+        trend.get_desired_replicas(metric(10.0, at=0.0), 1)
+        trend.get_desired_replicas(metric(20.0, at=30.0), 1)
+        got = trend.get_desired_replicas(metric(30.0, at=60.0), 1)
+        # slope 1/3 per second; projection = 30 + 60/3 = 50 -> ceil(5)
+        assert got == 5
+        assert Proportional().get_desired_replicas(
+            metric(30.0, at=60.0), 1
+        ) == 3
+
+    def test_falling_series_is_plain_proportional(self):
+        """Never scale down ahead of the data: down-scaling stays
+        governed by stabilization windows, not projections."""
+        trend = Trend()
+        trend.get_desired_replicas(metric(30.0, at=0.0), 1)
+        trend.get_desired_replicas(metric(20.0, at=30.0), 1)
+        got = trend.get_desired_replicas(metric(10.0, at=60.0), 1)
+        assert got == Proportional().get_desired_replicas(
+            metric(10.0, at=60.0), 1
+        )
+
+    def test_single_sample_is_plain_proportional(self):
+        trend = Trend()
+        got = trend.get_desired_replicas(metric(25.0, at=0.0), 4)
+        assert got == Proportional().get_desired_replicas(
+            metric(25.0, at=0.0), 4
+        )
+
+    def test_narrow_window_never_extrapolates(self):
+        """Two samples within a second (reconcile retry burst) carry no
+        usable slope."""
+        trend = Trend()
+        trend.get_desired_replicas(metric(10.0, at=0.0), 1)
+        got = trend.get_desired_replicas(metric(30.0, at=0.5), 1)
+        assert got == 3  # plain ceil(30/10), no projection
+
+    def test_backwards_clock_clears_the_window(self):
+        trend = Trend()
+        trend.get_desired_replicas(metric(10.0, at=100.0), 1)
+        got = trend.get_desired_replicas(metric(30.0, at=50.0), 1)
+        assert got == 3  # window restarted: single sample, plain math
+        assert len(trend._series[trend._key(metric(0, 0))]) == 1
+
+    def test_window_prunes_by_age(self):
+        trend = Trend(window=60.0, horizon=60.0)
+        trend.get_desired_replicas(metric(1000.0, at=0.0), 1)
+        trend.get_desired_replicas(metric(10.0, at=100.0), 1)
+        series = trend._series[trend._key(metric(0, 0))]
+        assert [v for _, v in series] == [10.0]
+
+    def test_label_sets_do_not_share_history(self):
+        """Two specs over the same metric NAME with different label
+        matchers must keep separate windows — interleaving them would
+        fit a garbage sawtooth slope (r3 code review)."""
+        trend = Trend()
+        a = dict(owner=("default", "ha"), name="util")
+        trend.get_desired_replicas(
+            Metric(value=10.0, target_type=AVERAGE_VALUE,
+                   target_value=10.0, labels={"name": "a"},
+                   at=0.0, **a), 1)
+        trend.get_desired_replicas(
+            Metric(value=90.0, target_type=AVERAGE_VALUE,
+                   target_value=10.0, labels={"name": "b"},
+                   at=30.0, **a), 1)
+        got = trend.get_desired_replicas(
+            Metric(value=10.0, target_type=AVERAGE_VALUE,
+                   target_value=10.0, labels={"name": "a"},
+                   at=60.0, **a), 1)
+        assert got == 1  # a's series is flat; no slope bleed from b
+
+    def test_owners_do_not_share_history(self):
+        trend = Trend()
+        trend.get_desired_replicas(
+            metric(10.0, at=0.0, owner=("default", "a")), 1
+        )
+        trend.get_desired_replicas(
+            metric(99.0, at=30.0, owner=("default", "b")), 1
+        )
+        got = trend.get_desired_replicas(
+            metric(10.0, at=60.0, owner=("default", "a")), 1
+        )
+        # owner a's series is flat: plain proportional, no slope from b
+        assert got == 1
+
+    def test_utilization_projection(self):
+        trend = Trend(horizon=60.0)
+        kwargs = dict(target_type=UTILIZATION, target=60.0)
+        trend.get_desired_replicas(metric(0.60, at=0.0, **kwargs), 5)
+        got = trend.get_desired_replicas(
+            metric(0.708, at=60.0, **kwargs), 5
+        )
+        # slope 0.0018/s -> projection 0.816 -> ceil(5 * 81.6/60) = 7
+        assert got == 7
+
+    def test_stale_keys_prune_lazily(self):
+        import karpenter_tpu.autoscaler.algorithms.trend as T
+
+        trend = Trend(window=10.0)
+        threshold = T._PRUNE_THRESHOLD
+        for i in range(threshold + 1):
+            trend.get_desired_replicas(
+                metric(1.0, at=0.0, owner=("ns", f"ha{i}")), 1
+            )
+        assert len(trend._series) == threshold + 1
+        # a much-later observation prunes every aged-out window
+        trend.get_desired_replicas(
+            metric(1.0, at=1000.0, owner=("ns", "fresh")), 1
+        )
+        assert len(trend._series) == 1  # only the fresh window survives
+
+
+class TestTrendEndToEnd:
+    def test_trend_annotation_scales_ahead_of_plain(self):
+        """Two autoscalers watch the same ramping gauge; the trend one
+        scales ahead, the default one reacts — through the full batch
+        (host recommendation -> device select/stabilize/bound)."""
+        from test_e2e import sng_of, utilization_ha
+
+        from karpenter_tpu.autoscaler import algorithms
+        from karpenter_tpu.cloudprovider.fake import FakeFactory
+        from karpenter_tpu.runtime import KarpenterRuntime
+
+        class Clock:
+            def __init__(self):
+                self.now = 1000.0
+
+            def __call__(self):
+                return self.now
+
+        clock = Clock()
+        provider = FakeFactory()
+        runtime = KarpenterRuntime(
+            cloud_provider_factory=provider, clock=clock
+        )
+        for name, annotate in (("ride-trend", True), ("plain", False)):
+            gauge = runtime.registry.register(
+                "reserved_capacity", "cpu_utilization"
+            )
+            gauge.set(name, "default", 0.60)
+            provider.node_replicas[name] = 5
+            runtime.store.create(sng_of(name, replicas=5))
+            ha_obj = utilization_ha(
+                name,
+                queries=("karpenter_reserved_capacity_cpu_utilization",),
+            )
+            if annotate:
+                ha_obj.metadata.annotations[
+                    algorithms.ALGORITHM_ANNOTATION
+                ] = "trend"
+            runtime.store.create(ha_obj)
+
+        runtime.manager.reconcile_all()  # 0.60 / target 60%: steady, 5
+        clock.now += 60.0
+        for name in ("ride-trend", "plain"):
+            runtime.registry.gauge(
+                "reserved_capacity", "cpu_utilization"
+            ).set(name, "default", 0.708)
+        runtime.manager.reconcile_all()
+
+        trended = runtime.store.get(
+            "HorizontalAutoscaler", "default", "ride-trend"
+        )
+        plain = runtime.store.get(
+            "HorizontalAutoscaler", "default", "plain"
+        )
+        # ramp 0.60 -> 0.708 over 60 s: plain reacts to 70.8% (6 of 5);
+        # trend projects 81.6% one horizon ahead (7)
+        assert plain.status.desired_replicas == 6
+        assert trended.status.desired_replicas == 7
+
+    def test_trend_is_admitted(self):
+        from test_e2e import utilization_ha
+
+        from karpenter_tpu.autoscaler import algorithms
+
+        ha_obj = utilization_ha("ok")
+        ha_obj.metadata.annotations[
+            algorithms.ALGORITHM_ANNOTATION
+        ] = "trend"
+        ha_obj.validate()  # must not raise: trend is registered
