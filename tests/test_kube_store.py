@@ -334,6 +334,122 @@ class TestCrud:
         )
 
 
+class TestOccupancyOnKube:
+    def test_census_fed_by_http_watch(self, api, kube):
+        """Existing-pod occupancy over the REAL-cluster path: a bound
+        replica arriving through the apiserver watch spends its zone,
+        and the pending-pods solve routes the next replica elsewhere —
+        the ScheduledOccupancy adoption + watch contract certified
+        against HTTP, not just the in-memory store."""
+        from karpenter_tpu.metrics.producers.pendingcapacity import (
+            _group_profile,
+            solve_pending,
+        )
+        from karpenter_tpu.metrics.registry import GaugeRegistry
+        from karpenter_tpu.store.columnar import PendingFeed
+
+        zone = "topology.kubernetes.io/zone"
+        for z in ("a", "b"):
+            api.put_object(
+                "nodes",
+                {
+                    "apiVersion": "v1",
+                    "kind": "Node",
+                    "metadata": {
+                        "name": f"n-{z}",
+                        "labels": {"group": z, zone: f"us-{z}"},
+                    },
+                    "status": {
+                        "allocatable": {
+                            "cpu": "64",
+                            "memory": "64Gi",
+                            "pods": "110",
+                        },
+                        "conditions": [
+                            {"type": "Ready", "status": "True"}
+                        ],
+                    },
+                },
+            )
+            api.put_object(
+                "metricsproducers",
+                {
+                    "apiVersion": "autoscaling.karpenter.sh/v1alpha1",
+                    "kind": "MetricsProducer",
+                    "metadata": {"name": f"group-{z}"},
+                    "spec": {
+                        "pendingCapacity": {"nodeSelector": {"group": z}}
+                    },
+                },
+            )
+
+        def pod_doc(name, bound_to=None):
+            return {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {
+                    "name": name,
+                    "labels": {"app": "db"},
+                },
+                "spec": {
+                    **({"nodeName": bound_to} if bound_to else {}),
+                    "containers": [
+                        {
+                            "name": "c",
+                            "resources": {
+                                "requests": {
+                                    "cpu": "1",
+                                    "memory": "1Gi",
+                                }
+                            },
+                        }
+                    ],
+                    "affinity": {
+                        "podAntiAffinity": {
+                            "requiredDuringSchedulingIgnoredDuringExecution": [
+                                {
+                                    "labelSelector": {
+                                        "matchLabels": {"app": "db"}
+                                    },
+                                    "topologyKey": zone,
+                                }
+                            ]
+                        }
+                    },
+                },
+                "status": {
+                    "phase": "Running" if bound_to else "Pending"
+                },
+            }
+
+        api.put_object("pods", pod_doc("db-live", bound_to="n-a"))
+        api.put_object("pods", pod_doc("db-pending"))
+
+        feed = PendingFeed(kube, _group_profile)
+        assert wait_for(lambda: len(feed.pods) == 1)
+        assert wait_for(lambda: feed.occupancy.generation >= 1)
+        # each kind rides its own watch stream: synchronize on ALL the
+        # mirrors the solve reads, not just the pod arena
+        assert wait_for(lambda: len(kube.list("MetricsProducer")) == 2)
+        assert wait_for(lambda: len(feed.nodes.nodes()) == 2)
+
+        mps = [
+            mp
+            for mp in kube.list("MetricsProducer")
+            if mp.spec.pending_capacity is not None
+        ]
+        assert len(mps) == 2
+        solve_pending(kube, mps, GaugeRegistry(), feed=feed)
+        by_name = {
+            mp.metadata.name: mp.status.pending_capacity for mp in mps
+        }
+        # zone a is spent by db-live (seen over HTTP): the pending
+        # replica lands in b
+        assert by_name["group-a"].pending_pods == 0
+        assert by_name["group-b"].pending_pods == 1
+        assert by_name["group-b"].unschedulable_pods == 0
+
+
 class TestDialect:
     def test_strict_manifests_still_reject_resources_nesting(self):
         """Only the apiserver-read (lenient) path accepts the core/v1
