@@ -41,7 +41,6 @@ from karpenter_tpu.api.core import (
     matches_affinity_shape,
     matches_selector,
     preference_score,
-    selector_form_matches,
 )
 from karpenter_tpu.api.metricsproducer import PendingCapacityStatus
 from karpenter_tpu.metrics.registry import GaugeRegistry, default_registry
@@ -481,12 +480,12 @@ class DomainCensus:
     pod-side work (selector evaluation over distinct label sets) are
     memoized independently.
 
-    Locking discipline (the NodeMirror.profile rule): the occupancy
-    lock is held only to check freshness and COPY one namespace's
-    census slice — watch callbacks run under the store's notify path
-    and must never wait on an O(nodes + label sets) selector scan, or
-    every store mutation stalls behind the solve. All evaluation runs
-    on the copied slice, lock-free.
+    Pod-side reads go through the census's MATERIALIZED VIEWS
+    (ScheduledOccupancy.view_counts): per-pod-unique labels fragment a
+    100k-replica StatefulSet into 100k label groups, and a per-epoch
+    group scan costs ~600 ms — over the tick budget by itself. A
+    selector's view is built once and maintained at event time, so a
+    churned tick's recompute here is O(nodes with matching pods).
     """
 
     def __init__(self, occupancy, nodes_fn, node_version_fn=None):
@@ -500,9 +499,11 @@ class DomainCensus:
         # epoch invalidations (bound-pod or node churn between solves);
         # published as karpenter_runtime_census_refresh_total so an
         # operator can see how often constrained ticks pay a recompute.
-        # `published` is the _publish_census watermark.
+        # `published`/`evictions_published` are _publish_census
+        # watermarks.
         self.refreshes = 0
         self.published = 0
+        self.evictions_published = 0
 
     def _fresh(self, generation: int) -> None:
         epoch = (generation, self._node_version_fn())
@@ -513,23 +514,20 @@ class DomainCensus:
             self._named_labels = None
             self.refreshes += 1
 
-    def _ns_groups(self, namespace) -> list:
-        """Epoch check + consistent copy of one namespace's census slice
-        [(labels_items, {node: count})], under the occupancy lock only
-        for the copy; memoized per epoch so one solve copies each
-        namespace at most once."""
-        with self._occupancy.view() as (generation, spaces):
-            self._fresh(generation)
-            got = self._memo.get(("ns", namespace))
-            if got is None:
-                got = [
-                    (labels_items, dict(nodes))
-                    for labels_items, nodes in spaces.get(
-                        namespace, {}
-                    ).items()
-                ]
-                self._memo[("ns", namespace)] = got
-            return got
+    def _node_counts(self, namespace, sel_form) -> Dict[str, int]:
+        """Epoch check + {node: matching-pod count} for one selector,
+        through the census's materialized view. Unmemoized on purpose:
+        the view read is O(matching nodes) and the epoch check must run
+        BEFORE any memo is consulted (a cached answer from a previous
+        occupancy generation must never serve this one)."""
+        generation, counts = self._occupancy.view_counts(
+            namespace, sel_form
+        )
+        self._fresh(generation)
+        return counts
+
+    def _fresh_now(self) -> None:
+        self._fresh(self._occupancy.generation)
 
     def _nodes(self) -> List[Tuple[str, dict]]:
         if self._named_labels is None:
@@ -549,7 +547,18 @@ class DomainCensus:
         are Ignored per the nodeTaintsPolicy default): only nodes the
         incoming pod could land on define domains and contribute counts.
         """
-        groups = self._ns_groups(namespace)  # also the epoch check
+        # O(1) epoch check BEFORE any memo lookup (a cached answer from
+        # a previous occupancy generation must never serve this one);
+        # the view is only copied on memo miss
+        self._fresh_now()
+        memo_hit = self._memo.get(
+            ("spread", namespace, sel_form, split_key, filter_token)
+        )
+        by_node = (
+            self._node_counts(namespace, sel_form)
+            if memo_hit is None and sel_form is not None
+            else {}
+        )
         node_key = (split_key, filter_token)
         node_side = self._node_memo.get(node_key)
         if node_side is None:
@@ -569,16 +578,10 @@ class DomainCensus:
         got = self._memo.get(memo_key)
         if got is None:
             counts: Dict[str, int] = {}
-            if sel_form is not None:
-                for labels_items, nodes in groups:
-                    if not selector_form_matches(
-                        sel_form, dict(labels_items)
-                    ):
-                        continue
-                    for node, n in nodes.items():
-                        value = passing.get(node)
-                        if value is not None:
-                            counts[value] = counts.get(value, 0) + n
+            for node, n in by_node.items():
+                value = passing.get(node)
+                if value is not None:
+                    counts[value] = counts.get(value, 0) + n
             got = (counts, present)
             self._memo[memo_key] = got
         return got
@@ -598,41 +601,37 @@ class DomainCensus:
     def _workload_nodes(self, namespace, sel_forms) -> tuple:
         """(any_nodes, all_nodes_or_None): node-name sets occupied by
         pods matching ANY of the workload's selectors (the anti-blocking
-        set — over-blocking is conservative) and by pods matching EVERY
-        LIVE selector (the co-location set — under-allowing is
-        conservative); all_nodes is None when NO selector has a matching
-        scheduled pod anywhere in the namespace (the k8s first-replica
-        bootstrap: a required self-affinity term with no matching pod
-        cluster-wide imposes nothing)."""
-        # _ns_groups runs the epoch check first: an entry cached under a
-        # previous occupancy generation (or node version) must never
-        # answer for this one — a replica bound since then has to spend
-        # its domain on the very next solve
-        ns_groups = self._ns_groups(namespace)
+        set — over-blocking is conservative) and, for co-location, the
+        nodes hosting a matching pod for EVERY live selector — the
+        scheduler's per-term rule: each required term is satisfied by a
+        domain holding a pod matching THAT term's selector (they need
+        not be the same pod). all_nodes is None when NO selector has a
+        matching scheduled pod anywhere in the namespace (the k8s
+        first-replica bootstrap: a required self-affinity term with no
+        matching pod cluster-wide imposes nothing). All forms are read
+        under ONE census lock hold (view_counts_many) so the set is
+        generation-consistent — a replica moving nodes between
+        per-form reads could otherwise appear on neither."""
+        # O(1) epoch check before the memo (stale answers must never
+        # cross occupancy generations)
+        self._fresh_now()
         memo_key = ("workload", namespace, sel_forms)
         got = self._memo.get(memo_key)
         if got is not None:
             return got
-        groups = []
-        for labels_items, nodes in ns_groups:
-            labels = dict(labels_items)
-            vec = tuple(
-                selector_form_matches(form, labels)
-                for form in sel_forms
-            )
-            if any(vec):
-                groups.append((vec, set(nodes)))
-        live = [
-            i
-            for i in range(len(sel_forms))
-            if any(vec[i] for vec, _ in groups)
-        ]
+        generation, per_form = self._occupancy.view_counts_many(
+            namespace, sel_forms
+        )
+        self._fresh(generation)
         any_nodes: set = set()
-        all_nodes: Optional[set] = set() if live else None
-        for vec, names in groups:
-            any_nodes |= names
-            if all_nodes is not None and all(vec[i] for i in live):
-                all_nodes |= names
+        for counts in per_form:
+            any_nodes |= counts.keys()
+        live = [counts for counts in per_form if counts]
+        all_nodes: Optional[set] = None
+        if live:
+            all_nodes = set(live[0])
+            for counts in live[1:]:
+                all_nodes &= counts.keys()
         got = (any_nodes, all_nodes)
         self._memo[memo_key] = got
         return got
@@ -1592,6 +1591,10 @@ def _encode_from_cache(snap, profiles, with_rows: bool = False, census=None):  #
 def _publish_census(registry: GaugeRegistry, census) -> None:
     """karpenter_runtime_census_refresh_total: occupancy-census epoch
     recomputes (bound-pod / node churn between constrained solves).
+    karpenter_runtime_census_view_evictions_total: materialized-view
+    LRU evictions — a rising rate means more live (namespace, selector)
+    pairs than ScheduledOccupancy.VIEW_CAP, and each re-build is a
+    group scan (the silent-thrash signal, r3 code review).
     Delta-published so the persistent feed census and the per-solve
     oracle census report the same way."""
     if census is None:
@@ -1602,6 +1605,13 @@ def _publish_census(registry: GaugeRegistry, census) -> None:
             "runtime", "census_refresh_total", kind="counter"
         ).inc("-", "-", delta)
         census.published = census.refreshes
+    evictions = getattr(census._occupancy, "view_evictions", 0)
+    delta = evictions - census.evictions_published
+    if delta:
+        registry.register(
+            "runtime", "census_view_evictions_total", kind="counter"
+        ).inc("-", "-", delta)
+        census.evictions_published = evictions
 
 
 def _count_cache(registry: GaugeRegistry, outcome: str) -> None:

@@ -52,6 +52,7 @@ from karpenter_tpu.api.core import (
 )
 from karpenter_tpu.api.core import soft_spread_shape as _soft_spread_shape
 from karpenter_tpu.api.core import spread_shape as _spread_shape
+from karpenter_tpu.api.core import selector_form_matches
 from karpenter_tpu.store.store import DELETED, Store
 
 # seed columns; extended resources append after in arrival order.
@@ -675,14 +676,32 @@ class ScheduledOccupancy:
     Shape: {namespace: {labels_items_tuple: {node_name: count}}}.
     Replicated workloads collapse to one label group per namespace
     (plus one per pod for per-pod labels like the StatefulSet pod-name
-    label), so selector evaluation downstream is O(distinct label sets),
-    not O(pods). Event-time cost is O(1) per pod transition.
+    label). Event-time cost is O(1 + registered views) per pod
+    transition.
 
-    Readers MUST use view(): queries iterate the group dicts, and a
-    watch event mutating mid-iteration would throw — the context
-    manager holds the lock for the (short) duration of a census query.
-    store=None builds a detached census (occupancy_from_pods).
+    MATERIALIZED VIEWS (`view_counts`): per-pod-unique labels fragment
+    a 100k-replica StatefulSet into 100k label groups, so answering a
+    selector by scanning groups costs ~600 ms per occupancy epoch —
+    over the tick budget by itself. Instead, each distinct query
+    selector registers a view {node: matching-pod count}, built ONCE by
+    a scan and then maintained incrementally at event time (each bound
+    pod transition evaluates the pod's labels against the registered
+    selector forms — a fleet-scale-constant set, LRU-capped). Queries
+    read the view: O(nodes with matching pods), never O(label groups).
+
+    Readers MUST use view() (raw groups) or view_counts(); the lock is
+    held for the (short) duration of either. store=None builds a
+    detached census (occupancy_from_pods).
     """
+
+    # registered selector views are LRU-capped: every event updates the
+    # views of ITS namespace, so a leak of stale selectors would tax
+    # the event path. Above the cap (more distinct live (namespace,
+    # selector) pairs than this, queried every solve) eviction thrashes
+    # and each rebuild is a group scan under the lock — view_evictions
+    # (published as karpenter_runtime_census_view_evictions_total)
+    # makes that visible instead of silent.
+    VIEW_CAP = 1024
 
     def __init__(self, store: Optional[Store] = None):
         self._lock = threading.Lock()
@@ -690,8 +709,29 @@ class ScheduledOccupancy:
         self._spaces: Dict[str, Dict[tuple, Dict[str, int]]] = {}
         # pod key -> (namespace, labels_items, node_name) for exact undo
         self._pods: Dict[Tuple[str, str], Tuple[str, tuple, str]] = {}
+        # (namespace, selector form) -> {node: matching pod count}
+        self._views: Dict[tuple, Dict[str, int]] = {}
+        self._views_by_ns: Dict[str, Dict[tuple, Dict[str, int]]] = {}
+        self._view_clock = 0
+        self._view_used: Dict[tuple, int] = {}
+        # cumulative LRU evictions — cap-thrash observability
+        self.view_evictions = 0
         if store is not None:
             _adopt_and_watch(store, "Pod", self._on_event)
+
+    def _view_delta(self, namespace, labels_items, node, delta) -> None:
+        forms = self._views_by_ns.get(namespace)
+        if not forms:
+            return
+        labels = dict(labels_items)
+        for form, view in forms.items():
+            if not selector_form_matches(form, labels):
+                continue
+            count = view.get(node, 0) + delta
+            if count > 0:
+                view[node] = count
+            else:
+                view.pop(node, None)
 
     def _on_event(self, event: str, pod) -> None:
         key = (pod.metadata.namespace, pod.metadata.name)
@@ -721,6 +761,7 @@ class ScheduledOccupancy:
                             del groups[labels]
                             if not groups:
                                 del self._spaces[namespace]
+                self._view_delta(namespace, labels, node, -1)
             if entry is None:
                 self._pods.pop(key, None)
             else:
@@ -730,6 +771,7 @@ class ScheduledOccupancy:
                     labels, {}
                 )
                 nodes[node] = nodes.get(node, 0) + 1
+                self._view_delta(namespace, labels, node, +1)
 
     @property
     def generation(self) -> int:
@@ -744,6 +786,59 @@ class ScheduledOccupancy:
         the with-block."""
         with self._lock:
             yield self._generation, self._spaces
+
+    def _view_locked(self, namespace: str, sel_form: tuple) -> dict:
+        """Resolve-or-build one view; caller holds the lock."""
+        key = (namespace, sel_form)
+        self._view_clock += 1
+        view = self._views.get(key)
+        if view is None:
+            view = {}
+            for labels_items, nodes in self._spaces.get(
+                namespace, {}
+            ).items():
+                if selector_form_matches(sel_form, dict(labels_items)):
+                    for node, n in nodes.items():
+                        view[node] = view.get(node, 0) + n
+            self._views[key] = view
+            self._views_by_ns.setdefault(namespace, {})[sel_form] = view
+            if len(self._views) > self.VIEW_CAP:
+                evict = min(
+                    (k for k in self._views if k != key),
+                    key=lambda k: self._view_used.get(k, 0),
+                )
+                del self._views[evict]
+                self._views_by_ns.get(evict[0], {}).pop(evict[1], None)
+                self._view_used.pop(evict, None)
+                self.view_evictions += 1
+        self._view_used[key] = self._view_clock
+        return view
+
+    def view_counts(
+        self, namespace: str, sel_form: tuple
+    ) -> Tuple[int, Dict[str, int]]:
+        """(generation, {node: count of scheduled pods matching the
+        canonical selector form}) — the materialized-view read. First
+        use of a selector builds its view by one scan (under the lock:
+        consistency beats a one-time stall); every later read is a
+        small copy kept current by the event path."""
+        with self._lock:
+            return self._generation, dict(
+                self._view_locked(namespace, sel_form)
+            )
+
+    def view_counts_many(
+        self, namespace: str, sel_forms
+    ) -> Tuple[int, List[Dict[str, int]]]:
+        """view_counts for several selectors under ONE lock hold — the
+        results are a single-generation-consistent set (a pod event
+        landing between per-form reads could otherwise show a moved
+        replica on neither node, r3 code review)."""
+        with self._lock:
+            return self._generation, [
+                dict(self._view_locked(namespace, form))
+                for form in sel_forms
+            ]
 
 
 def occupancy_from_pods(pods) -> ScheduledOccupancy:

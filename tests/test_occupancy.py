@@ -341,6 +341,39 @@ class TestScheduledOccupancy:
         census._on_event("Modified", pod)  # same placement
         assert census.generation == g
 
+    def test_view_cap_evicts_lru_and_counts(self):
+        from karpenter_tpu.store.columnar import ScheduledOccupancy
+
+        store = self._store()
+        census = ScheduledOccupancy(store)
+        store.create(bound_pod("p", {"app": "a0"}, "n1"))
+        cap = ScheduledOccupancy.VIEW_CAP
+        for i in range(cap + 3):
+            census.view_counts(
+                "default", ((("app", f"a{i}"),), ())
+            )
+        assert census.view_evictions == 3
+        # the oldest views were evicted; the newest still resolve from
+        # the live set and stay maintained by the event path
+        _, counts = census.view_counts("default", ((("app", "a0"),), ()))
+        assert counts == {"n1": 1}
+
+    def test_view_counts_many_is_single_generation(self):
+        """Multi-form reads share one lock hold: the returned set is
+        generation-consistent by construction (a replica moving nodes
+        between reads can't appear on neither)."""
+        from karpenter_tpu.store.columnar import ScheduledOccupancy
+
+        store = self._store()
+        census = ScheduledOccupancy(store)
+        store.create(bound_pod("p", {"app": "x", "tier": "db"}, "n1"))
+        generation, per_form = census.view_counts_many(
+            "default",
+            (((("app", "x"),), ()), ((("tier", "db"),), ())),
+        )
+        assert per_form == [{"n1": 1}, {"n1": 1}]
+        assert generation == census.generation
+
     def test_detached_matches_watch_maintained(self):
         from karpenter_tpu.store.columnar import (
             ScheduledOccupancy,
