@@ -821,7 +821,17 @@ def run_e2e(args, metric: str, note: str = "") -> None:  # lint: allow-complexit
     def make_bound(name):
         app = f"web{int(rng.integers(0, 8))}"
         return Pod(
-            metadata=ObjectMeta(name=name, labels={"spread-app": app}),
+            metadata=ObjectMeta(
+                name=name,
+                labels={
+                    "spread-app": app,
+                    # per-pod-unique label (the StatefulSet pod-name
+                    # pattern): fragments the census into one label
+                    # group per pod, so the measured tick exercises the
+                    # materialized-view path, not a shared-group lookup
+                    "statefulset.kubernetes.io/pod-name": name,
+                },
+            ),
             spec=PodSpec(
                 node_name=f"n{int(rng.integers(0, args.types))}",
                 containers=[

@@ -1178,6 +1178,59 @@ class TestEncodeMemoWithOccupancy:
         assert self._solve(store, feed, counting_encode) == 2
 
 
+class TestLongRunBoundedState:
+    @pytest.mark.skipif(
+        not __import__("os").environ.get("KARPENTER_SCALE_TESTS"),
+        reason="soak loop; battletest sets KARPENTER_SCALE_TESTS=1",
+    )
+    def test_churning_workloads_keep_caches_bounded(self, env):
+        """Soak: 300 ticks of constrained workloads appearing, binding,
+        and vanishing (enough churn to cross the compaction floor).
+        Every watch-maintained structure must track the LIVE state, not
+        the history: pending arena and shape registries compact, census
+        groups drain, views stay under the cap."""
+        from karpenter_tpu.store.columnar import ScheduledOccupancy
+
+        runtime, _ = env
+        zoned(runtime, zones=("a", "b"))
+        for tick in range(300):
+            workload = f"w{tick}"
+            for i in range(4):
+                runtime.store.create(
+                    spread_pod(f"{workload}-p{i}", {"app": workload})
+                )
+            runtime.store.create(
+                bound_pod(f"{workload}-live", {"app": workload}, "n-a")
+            )
+            runtime.clock.advance(6)
+            runtime.manager.reconcile_all()
+            # the previous workload schedules and vanishes entirely
+            if tick:
+                old = f"w{tick - 1}"
+                for i in range(4):
+                    runtime.store.delete("Pod", "default", f"{old}-p{i}")
+                runtime.store.delete("Pod", "default", f"{old}-live")
+        feed = runtime.producer_factory._pending_feed
+        occupancy = feed.occupancy
+        with occupancy.view() as (_, spaces):
+            live_groups = sum(len(g) for g in spaces.values())
+        assert live_groups <= 2  # only the newest workload's pods
+        # one view per distinct selector ever queried, still under the
+        # cap here — no spurious per-tick registrations (cap ENFORCEMENT
+        # is exercised by test_view_cap_evicts_lru_and_counts, which
+        # crosses it)
+        assert len(occupancy._views) <= 301
+        assert occupancy.view_evictions == 0
+        assert ScheduledOccupancy.VIEW_CAP >= 301  # soak stays below
+        # pending arena compacted: slot peak tracks the handful of live
+        # pods plus growth since the last compaction, not the 1500
+        # churned through
+        assert feed.pods._hi < 600
+        snap = feed.pods.snapshot()
+        # registry compaction dropped the dead workloads' shapes
+        assert len(snap.spread_shapes) < 100
+
+
 class TestSimulateWithOccupancy:
     def test_simulation_respects_existing_replicas(self):
         """The dry-run solve sees the same census the production tick
