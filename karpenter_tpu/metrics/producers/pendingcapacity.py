@@ -748,22 +748,75 @@ def _water_fill(counts, caps, schedulable: int, seed: int) -> np.ndarray:
     return out
 
 
-def _spread_caps(namespace, entries, values, census, row_filter):
-    """(caps[d] pre-weight-clamp, fill[d]) for one spread shape under
-    one row node filter: the per-domain new-replica caps — the MIN over
-    EVERY same-split-key entry, each evaluated under its own selector
-    and policy (a single "first entry" cap could silently drop a
-    tighter same-key constraint, r3 code review) — and the fill-order
-    counts of the first entry. Entries on other keys contribute
-    key-presence exclusion only (documented approximation). A pure
-    function of (shape, filter): every row of a replicated workload
-    shares the result through the caller's memo; only weight and the
-    rotation seed differ per row."""
-    split_key = entries[0][0]
+_UNBOUNDED = np.iinfo(np.int64).max // 4
+
+
+def _entry_caps(skew, min_domains, self_match, values, counts_e,
+                present_e) -> np.ndarray:
+    """Per-value new-replica caps imposed by ONE spread constraint
+    entry over the `values` domain list (_UNBOUNDED where it imposes
+    nothing). The three regimes the scheduler's skew check induces:
+
+    - selfMatch false: placements never accumulate into the counts, so
+      the check is static per domain — existing count must stay within
+      maxSkew of the global minimum (0 under the minDomains rule);
+      violating domains cap at 0, the rest are unbounded.
+    - minDomains unsatisfied: global minimum treated as 0 — each domain
+      holds at most maxSkew matching pods INCLUDING existing ones.
+    - otherwise: domains among filter-passing live nodes that the
+      candidate groups can't fill freeze the global minimum, capping
+      each value at outside-minimum + maxSkew.
+    """
     d = len(values)
+    c_e = np.array([counts_e.get(v, 0) for v in values], np.int64)
+    caps = np.full(d, _UNBOUNDED, np.int64)
+    min_rule = bool(min_domains) and d < min_domains
+    if not self_match:
+        floor = 0 if min_rule else min(
+            [
+                int(c_e.min()),
+                *(counts_e.get(v, 0) for v in present_e - set(values)),
+            ]
+        )
+        caps[c_e - floor > skew] = 0
+    elif min_rule:
+        caps = np.clip(skew - c_e, 0, None)
+    else:
+        outside = present_e - set(values)
+        m_out = min(
+            (counts_e.get(v, 0) for v in outside), default=None
+        )
+        if m_out is not None:
+            caps = np.clip(m_out + skew - c_e, 0, None)
+    return caps, c_e, min_rule
+
+
+def _spread_state(namespace, entries, values, census, row_filter,  # lint: allow-complexity — one guard per budget regime (split/static/other-key/dead), the whole shape contract in one place
+                  label_dicts, eligible):
+    """Mutable placement-budget STATE for one spread shape under one
+    row node filter, SHARED by every row of the workload through the
+    caller's memo — a workload split across request-distinct rows
+    (mid-VPA) draws from one budget, so two rows never spend the same
+    domain capacity twice (r3 code review):
+
+    - `static`[d]: split-key caps from non-selfMatch entries (0 or
+      unbounded — placements never consume them);
+    - `budget`[d]: split-key caps from selfMatch entries, the MIN over
+      every same-key entry (a single "first entry" cap could silently
+      drop a tighter same-key constraint); DEPLETED as rows place;
+    - `counts`[d]: the running fill-order counts (first entry's census
+      counts plus placements when the first entry self-matches);
+    - `dead`: groups excluded outright by a non-split entry's
+      zero-capacity domains;
+    - `others`: per non-split selfMatch entry with finite caps,
+      (value_groups, remaining budget) — consumed by the caller's
+      DESIGNATION pass, which pins each chunk to one of that key's
+      domains and masks the sub-row to it, so a chunk can never land
+      in a domain whose budget another chunk spent (the per-domain
+      distribution soundness a bare total bound cannot give, r3 code
+      review)."""
+    split_key = entries[0][0]
     token, node_passes = row_filter
-    unbounded = np.iinfo(np.int64).max // 4
-    caps = np.full(d, unbounded, np.int64)
 
     def entry_counts(e):
         key, _skew, _mind, sel, _self, honor = e
@@ -777,53 +830,161 @@ def _spread_caps(namespace, entries, values, census, row_filter):
             namespace, sel, key, ("ignore",), lambda labels: True
         )
 
+    d = len(values)
+    static = np.full(d, _UNBOUNDED, np.int64)
+    budget = np.full(d, _UNBOUNDED, np.int64)
+    dead = None
+    others = []
+    # NON-SPLIT entries first: their zero-capacity domains (dead
+    # groups) can leave a split domain with no live group at all, and
+    # such a domain must then FREEZE the split-key global minimum like
+    # an unfillable outside domain — otherwise the surviving domains
+    # are over-promised capacity the scheduler's skew check denies
+    # against the frozen one (r3 code review)
+    for e in entries:
+        if e[0] == split_key:
+            continue
+        _key, skew, min_domains, _sel, self_match, _honor = e
+        counts_e, present_e = entry_counts(e)
+        vals2: Dict[str, list] = {}
+        for t in eligible:
+            value = label_dicts[t].get(e[0])
+            if value is not None:
+                vals2.setdefault(value, []).append(t)
+        if not vals2:
+            continue
+        values2 = sorted(vals2)
+        caps2, _, _ = _entry_caps(skew, min_domains, self_match,
+                                  values2, counts_e, present_e)
+        if (caps2 <= 0).any():
+            if dead is None:
+                dead = np.zeros(len(label_dicts), bool)
+            for j, value in enumerate(values2):
+                if caps2[j] <= 0:
+                    dead[vals2[value]] = True
+        if self_match and (caps2 < _UNBOUNDED).any():
+            others.append(
+                (
+                    {v: vals2[v] for v in values2},
+                    {
+                        v: int(caps2[j])
+                        for j, v in enumerate(values2)
+                        if caps2[j] < _UNBOUNDED
+                    },
+                )
+            )
+    # split values every live group of which is dead: unfillable
+    frozen = np.zeros(d, bool)
+    if dead is not None:
+        value_groups_split: Dict[str, list] = {}
+        for t in eligible:
+            value_groups_split.setdefault(
+                label_dicts[t][split_key], []
+            ).append(t)
+        for j, v in enumerate(values):
+            if all(dead[t] for t in value_groups_split[v]):
+                frozen[j] = True
     for e in entries:
         if e[0] != split_key:
             continue
         _key, skew, min_domains, _sel, self_match, _honor = e
         counts_e, present_e = entry_counts(e)
-        c_e = np.array([counts_e.get(v, 0) for v in values], np.int64)
-        min_rule = bool(min_domains) and d < min_domains
-        if not self_match:
-            # placements never accumulate into this entry's counts: its
-            # skew check is static per domain — existing count must stay
-            # within maxSkew of the global minimum (0 under the
-            # minDomains rule)
-            floor = 0 if min_rule else min(
-                [
-                    int(c_e.min()),
-                    *(
-                        counts_e.get(v, 0)
-                        for v in present_e - set(values)
-                    ),
-                ]
-            )
-            caps[c_e - floor > skew] = 0
-        elif min_rule:
-            # the scheduler's minDomains rule: too few eligible domains
-            # treats the global minimum as 0, so each domain holds at
-            # most maxSkew matching pods INCLUDING the existing ones;
-            # the rest stay unschedulable
-            caps = np.minimum(caps, np.clip(skew - c_e, 0, None))
-        else:
-            outside = present_e - set(values)
-            m_out = min(
-                (counts_e.get(v, 0) for v in outside), default=None
-            )
-            if m_out is not None:
-                caps = np.minimum(
-                    caps, np.clip(m_out + skew - c_e, 0, None)
+        caps_e, c_e, min_rule = _entry_caps(
+            skew, min_domains, self_match, values, counts_e, present_e
+        )
+        if frozen.any():
+            if self_match and not min_rule:
+                # the frozen domains' counts cap everything else at
+                # frozen-min + maxSkew, the outside-minimum rule
+                m_frozen = int(c_e[frozen].min())
+                caps_e = np.minimum(
+                    caps_e, np.clip(m_frozen + skew - c_e, 0, None)
                 )
-    # the fill ORDER (least-loaded first) follows the FIRST entry's
-    # counts; a non-self-matching first entry never accumulates, so its
-    # fill is plain balanced within the caps
+            caps_e = caps_e.copy()
+            caps_e[frozen] = 0  # nothing can actually land there
+        if self_match:
+            budget = np.minimum(budget, caps_e)
+        else:
+            static = np.minimum(static, caps_e)
     first_counts, _ = entry_counts(entries[0])
-    fill = (
+    counts = (
         np.array([first_counts.get(v, 0) for v in values], np.int64)
         if entries[0][4]
         else np.zeros(d, np.int64)
     )
-    return caps, fill
+    return {
+        "static": static,
+        "budget": budget,
+        "counts": counts,
+        "first_selfmatch": bool(entries[0][4]),
+        "dead": dead,
+        "others": others,
+    }
+
+
+def _designate_chunks(additions, masks, state, n_groups):  # lint: allow-complexity — the joint designation walk: choose, narrow, min-take, charge, in one auditable pass
+    """For every non-split selfMatch entry with finite domain budgets:
+    pin each split-domain chunk to ONE of that key's domains (greedy:
+    most remaining budget, deterministic tie-break), shrink the chunk
+    to what EVERY designated domain still admits, then charge each
+    ledger by that FINAL take — charging at choice time would leak
+    budget a later entry's shrink never uses, starving later rows of
+    the shared state (r3 code review). Sound by construction: every
+    promised replica lands in domains with budget reserved for it —
+    concentration can't overdraw a domain another chunk already spent.
+    Conservative: a placement spanning several of a key's domains
+    within one split domain is not attempted. Returns per-rank extra
+    masks (None = no restriction); mutates `additions` and the state's
+    budgets."""
+    extra = [None] * len(additions)
+    if not state["others"]:
+        return extra
+    inverses = []
+    for value_groups, remaining in state["others"]:
+        group_value = {}
+        for value, groups in value_groups.items():
+            for t in groups:
+                group_value[t] = value
+        inverses.append((group_value, value_groups, remaining))
+    for rank in range(len(additions)):
+        chunk = int(additions[rank])
+        if chunk == 0:
+            continue
+        allowed = ~masks[rank]
+        charges = []  # (remaining ledger, chosen value)
+        for group_value, value_groups, remaining in inverses:
+            candidates = sorted(
+                {
+                    group_value[t]
+                    for t in np.flatnonzero(allowed)
+                    if t in group_value
+                }
+            )
+            if not candidates:
+                allowed = None
+                break
+            best = max(
+                candidates,
+                key=lambda v: (remaining.get(v, _UNBOUNDED), v),
+            )
+            if best in remaining:
+                charges.append((remaining, best))
+            # narrow for the NEXT entry: designation is joint — later
+            # entries choose among groups the earlier picks allow
+            keep = np.zeros(n_groups, bool)
+            keep[value_groups[best]] = True
+            allowed = allowed & keep
+        if allowed is None or not allowed.any():
+            additions[rank] = 0
+            continue
+        take = chunk
+        for remaining, best in charges:
+            take = min(take, remaining[best])
+        additions[rank] = take
+        for remaining, best in charges:
+            remaining[best] = remaining[best] - take
+        extra[rank] = ~allowed  # forbid everything outside the picks
+    return extra
 
 
 def _expand_spread_rows(  # lint: allow-complexity — per-domain chunking: each guard is a documented spread rule
@@ -856,9 +1017,14 @@ def _expand_spread_rows(  # lint: allow-complexity — per-domain chunking: each
     wider / mark more unschedulable than a lopsided-but-legal placement,
     never the reverse): maxSkew slack beyond 1 is not exploited when
     counts are level; with multiple constrained keys the split runs on
-    the FIRST (key, selector) entry while the others contribute
-    key-presence exclusion only; without a census (hand-built snapshot
-    paths) counts are zero and the split is plain balanced.
+    the FIRST (key, selector) entry while the others are enforced
+    through key-presence exclusion, zero-capacity dead masks, and the
+    per-chunk domain DESIGNATION pass (_designate_chunks) that pins and
+    budgets their finite capacities — a placement spanning several of a
+    non-split key's domains within one split domain is not attempted;
+    rows of one workload consume a SHARED budget in canonical content
+    order; without a census (hand-built snapshot paths) counts are zero
+    and the split is plain balanced.
 
     Returns (row_idx, row_weight, spread_forbidden[rows, T]-or-None);
     unconstrained snapshots pass through untouched.
@@ -904,30 +1070,43 @@ def _expand_spread_rows(  # lint: allow-complexity — per-domain chunking: each
         keys = [entry[0] for entry in entries]
         split_key = entries[0][0]
         domains: Dict[str, list] = {}
+        eligible = []
         for t, labels in enumerate(label_dicts):
             if all(key in labels for key in keys):
+                eligible.append(t)
                 domains.setdefault(labels[split_key], []).append(t)
         values = sorted(domains)
         masks = np.ones((len(values), n_groups), bool)
         for rank, value in enumerate(values):
             masks[rank, domains[value]] = False
-        plan[int(s)] = (namespace, entries, values, masks)
+        plan[int(s)] = (namespace, entries, values, masks, eligible)
 
     all_forbidden = np.ones(n_groups, bool)
     no_forbidden = np.zeros(n_groups, bool)
-    # caps (pre-weight-clamp) and fill counts are a pure function of
-    # (shape, row node filter): every row of a replicated workload
-    # shares them — only weight and the rotation seed differ per row
-    caps_memo: Dict[tuple, tuple] = {}
+    # the placement-budget state is a pure function of (shape, row node
+    # filter) and is SHARED — and consumed — by every row of the
+    # workload; rows of one shape process in canonical content order so
+    # the budget hand-out never depends on arena-local numbering (the
+    # path-stability rule _expand_anti_rows already follows)
+    state_memo: Dict[tuple, dict] = {}
+    order = sorted(
+        range(len(live_ids)),
+        key=lambda i: (
+            (0, (), i)
+            if not live_ids[i] or plan.get(int(live_ids[i])) is None
+            else (1, int(live_ids[i]), _canonical_row_key(snap, row_idx[i]))
+        ),
+    )
     out_idx, out_weight, out_forbidden = [], [], []
-    for i, sid in enumerate(live_ids):
+    for i in order:
+        sid = live_ids[i]
         entry = plan.get(int(sid))
         if entry is None:
             out_idx.append(row_idx[i])
             out_weight.append(row_weight[i])
             out_forbidden.append(no_forbidden)
             continue
-        namespace, entries, values, masks = entry
+        namespace, entries, values, masks, eligible = entry
         weight = int(row_weight[i])
         if not values or weight == 0:
             # no group exposes the key(s): unschedulable by spread —
@@ -943,14 +1122,16 @@ def _expand_spread_rows(  # lint: allow-complexity — per-domain chunking: each
             else (None, None)
         )
         memo_key = (int(sid), row_filter[0])
-        memoized = caps_memo.get(memo_key)
-        if memoized is None:
-            memoized = _spread_caps(
-                namespace, entries, values, census, row_filter
+        state = state_memo.get(memo_key)
+        if state is None:
+            state = _spread_state(
+                namespace, entries, values, census, row_filter,
+                label_dicts, eligible,
             )
-            caps_memo[memo_key] = memoized
-        raw_caps, fill = memoized
-        caps = np.minimum(raw_caps, weight)  # weight == unbounded
+            state_memo[memo_key] = state
+        caps = np.minimum(
+            np.minimum(state["static"], state["budget"]), weight
+        )
         schedulable = min(weight, int(caps.sum()))
         # content-keyed remainder rotation (see _water_fill)
         seed = weight + int(
@@ -958,17 +1139,37 @@ def _expand_spread_rows(  # lint: allow-complexity — per-domain chunking: each
             .view(np.uint8)
             .sum()
         )
-        additions = _water_fill(fill, caps, schedulable, seed)
+        additions = _water_fill(
+            state["counts"], caps, schedulable, seed
+        )
+        extra = _designate_chunks(additions, masks, state, n_groups)
+        # consume the shared budgets: a later row of this workload sees
+        # what THIS row placed (selfMatch placements also accumulate
+        # into the fill-order counts, exactly like the scheduler's
+        # sequential skew accounting)
+        state["budget"] = np.maximum(state["budget"] - additions, 0)
+        if state["first_selfmatch"]:
+            state["counts"] = state["counts"] + additions
+        dead = state["dead"]
+        placed = 0
         for rank in range(d):
             chunk = int(additions[rank])
             if chunk == 0:
                 continue
+            placed += chunk
+            forbidden = masks[rank]
+            if dead is not None or extra[rank] is not None:
+                forbidden = forbidden.copy()
+                if dead is not None:
+                    forbidden |= dead
+                if extra[rank] is not None:
+                    forbidden |= extra[rank]
             out_idx.append(row_idx[i])
             out_weight.append(np.int32(chunk))
-            out_forbidden.append(masks[rank])
-        if schedulable < weight:
+            out_forbidden.append(forbidden)
+        if placed < weight:
             out_idx.append(row_idx[i])
-            out_weight.append(np.int32(weight - schedulable))
+            out_weight.append(np.int32(weight - placed))
             out_forbidden.append(all_forbidden)
     return (
         np.asarray(out_idx, np.intp),

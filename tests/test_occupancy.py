@@ -601,6 +601,190 @@ class TestSpreadOccupancy:
         assert ("pod-template-hash", "v2") in sel_with[0]
         assert sel_without == ((("app", "web"),), ())
 
+    def test_other_key_zero_capacity_domains_are_excluded(self, env):
+        """Multi-key spread: the non-split key can't drive the split,
+        but a domain of it with ZERO remaining capacity is a hard
+        exclusion — replicas must not be promised to racks the second
+        constraint already fills (r3)."""
+        # sorts AFTER the zone key: the split must run on zone and
+        # treat this as the non-split (budgeted) key
+        rack = "x-topology.example.com/rack"
+        runtime, _ = env
+        for z, r in (("a", "r1"), ("b", "r2")):
+            runtime.store.create(
+                ready_node(
+                    f"n-{z}",
+                    {"group": z, ZONE_KEY: f"us-{z}", rack: r},
+                )
+            )
+            runtime.store.create(pending_mp(f"group-{z}", {"group": z}))
+        # rack r1 already holds maxSkew matching pods under the
+        # minDomains-unsatisfied rule (2 racks < minDomains 3)
+        runtime.store.create(bound_pod("old", {"app": "web"}, "n-a"))
+        for i in range(4):
+            pod = spread_pod(f"p{i}", {"app": "web"})
+            pod.spec.topology_spread_constraints.append(
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=rack,
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector={"matchLabels": {"app": "web"}},
+                    min_domains=3,
+                )
+            )
+            runtime.store.create(pod)
+        runtime.manager.reconcile_all()
+        counts = pods_per_group(runtime, ["group-a", "group-b"])
+        # rack r1 (group-a) capped at 1-1=0 by the rack entry; the rack
+        # total (0 + 1) also bounds schedulable at 1
+        assert counts == {"group-a": 0, "group-b": 1}
+        assert total_unschedulable(runtime, "group-a") == 3
+
+    def test_other_key_without_existing_pods_is_unchanged(self, env):
+        """No occupancy: the secondary key contributes key-presence
+        exclusion only, exactly the prior behavior."""
+        # sorts AFTER the zone key: the split must run on zone and
+        # treat this as the non-split (budgeted) key
+        rack = "x-topology.example.com/rack"
+        runtime, _ = env
+        for z, r in (("a", "r1"), ("b", "r2")):
+            runtime.store.create(
+                ready_node(
+                    f"n-{z}",
+                    {"group": z, ZONE_KEY: f"us-{z}", rack: r},
+                )
+            )
+            runtime.store.create(pending_mp(f"group-{z}", {"group": z}))
+        for i in range(4):
+            pod = spread_pod(f"p{i}", {"app": "web"})
+            pod.spec.topology_spread_constraints.append(
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=rack,
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector={"matchLabels": {"app": "web"}},
+                )
+            )
+            runtime.store.create(pod)
+        runtime.manager.reconcile_all()
+        counts = pods_per_group(runtime, ["group-a", "group-b"])
+        assert sorted(counts.values()) == [2, 2]
+        assert total_unschedulable(runtime, "group-a") == 0
+
+    def test_other_key_positive_caps_are_designated_not_overdrawn(
+        self, env
+    ):
+        """Regression (r3 code review): positive finite capacity on a
+        non-split key must bound the DISTRIBUTION, not just the total —
+        each chunk pins to one of that key's domains and consumes its
+        budget, so concentration can't overdraw a rack."""
+        # sorts AFTER the zone key: the split must run on zone and
+        # treat this as the non-split (budgeted) key
+        rack = "x-topology.example.com/rack"
+        runtime, _ = env
+        for z, r in (("a", "r1"), ("b", "r2")):
+            runtime.store.create(
+                ready_node(
+                    f"n-{z}",
+                    {"group": z, ZONE_KEY: f"us-{z}", rack: r},
+                )
+            )
+            runtime.store.create(pending_mp(f"group-{z}", {"group": z}))
+        # rack budget under the minDomains-unsatisfied rule (2 < 3),
+        # selector tier=db: r1 admits 2, r2 admits 2-1=1
+        runtime.store.create(bound_pod("old", {"tier": "db"}, "n-b"))
+        for i in range(4):
+            pod = spread_pod(
+                f"p{i}", {"app": "web", "tier": "db"},
+                selector={"app": "web"},
+            )
+            pod.spec.topology_spread_constraints.append(
+                TopologySpreadConstraint(
+                    max_skew=2,
+                    topology_key=rack,
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector={"matchLabels": {"tier": "db"}},
+                    min_domains=3,
+                )
+            )
+            runtime.store.create(pod)
+        runtime.manager.reconcile_all()
+        counts = pods_per_group(runtime, ["group-a", "group-b"])
+        # zone split is balanced [2, 2]; rack budgets cap group-a at 2
+        # and group-b at 1 — the 4th replica must NOT be promised to
+        # rack r2 just because the total (3) had room elsewhere
+        assert counts == {"group-a": 2, "group-b": 1}
+        assert total_unschedulable(runtime, "group-a") == 1
+
+    def test_dead_split_domain_freezes_the_minimum(self, env):
+        """Regression (r3 code review): a split domain whose groups are
+        all excluded by a non-split key is unfillable — it freezes the
+        split-key global minimum, capping the surviving domains at its
+        count + maxSkew, exactly like an unfillable outside zone."""
+        # sorts AFTER the zone key: the split must run on zone and
+        # treat this as the non-split (budgeted) key
+        rack = "x-topology.example.com/rack"
+        runtime, _ = env
+        for z, r in (("a", "r1"), ("b", "r2")):
+            runtime.store.create(
+                ready_node(
+                    f"n-{z}",
+                    {"group": z, ZONE_KEY: f"us-{z}", rack: r},
+                )
+            )
+            runtime.store.create(pending_mp(f"group-{z}", {"group": z}))
+        # rack r1 already violates the foreign-selector rack constraint
+        for i in range(2):
+            runtime.store.create(
+                bound_pod(f"db-{i}", {"tier": "db"}, "n-a")
+            )
+        for i in range(4):
+            pod = spread_pod(f"p{i}", {"app": "web"})
+            pod.spec.topology_spread_constraints.append(
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=rack,
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector={"matchLabels": {"tier": "db"}},
+                )
+            )
+            runtime.store.create(pod)
+        runtime.manager.reconcile_all()
+        counts = pods_per_group(runtime, ["group-a", "group-b"])
+        # zone a is dead (rack r1 over-skewed for the rack selector);
+        # its frozen count 0 caps zone b at 0 + maxSkew = 1 — NOT the 2
+        # a balanced-then-masked split would promise
+        assert counts == {"group-a": 0, "group-b": 1}
+        assert total_unschedulable(runtime, "group-a") == 3
+
+    def test_rows_of_one_workload_share_the_budget(self, env):
+        """Regression (r3 code review): a workload split across
+        request-distinct rows (mid-VPA) draws from ONE budget — two
+        rows must not each spend the same per-domain capacity."""
+        runtime, _ = env
+        zoned(runtime)
+        # empty zone c among filter-passing nodes freezes the global
+        # minimum: each zone admits maxSkew=1 new replicas TOTAL
+        runtime.store.create(
+            ready_node("unmanaged", {ZONE_KEY: "us-c"})
+        )
+        for i in range(2):
+            runtime.store.create(
+                spread_pod(f"small-{i}", {"app": "web"})
+            )
+        for i in range(2):
+            pod = spread_pod(f"big-{i}", {"app": "web"})
+            pod.spec.containers[0].requests = resource_list(
+                cpu="2", memory="2Gi"
+            )
+            runtime.store.create(pod)
+        runtime.manager.reconcile_all()
+        counts = pods_per_group(runtime, ["group-a", "group-b"])
+        # 2 schedulable TOTAL across both rows (1 per zone), 2 stuck —
+        # independent per-row budgets would have promised all 4
+        assert sum(counts.values()) == 2
+        assert total_unschedulable(runtime, "group-a") == 2
+
     def test_same_key_dual_policy_takes_the_tighter_cap(self, env):
         """Regression (r3 code review): two same-key constraints with
         different policies are BOTH enforced — the per-domain cap is
