@@ -751,8 +751,29 @@ def _water_fill(counts, caps, schedulable: int, seed: int) -> np.ndarray:
 _UNBOUNDED = np.iinfo(np.int64).max // 4
 
 
-def _entry_caps(skew, min_domains, self_match, values, counts_e,
-                present_e) -> np.ndarray:
+def _entry_census(census, namespace, entry, row_filter):
+    """({value: count}, present values) for one spread entry under one
+    row filter — THE census dispatch (honor vs Ignore policy, the
+    census-less fallback), shared by the split budgets and the anti
+    path's zero-cap masks so the two can never diverge."""
+    _key, _skew, _mind, sel, _self, honor = entry
+    if census is None or sel is None:
+        return {}, set()
+    if honor:
+        token, node_passes = row_filter
+        return census.spread(
+            namespace, sel, entry[0], token, node_passes
+        )
+    # nodeAffinityPolicy=Ignore: every live node exposing the key
+    # defines a domain and contributes counts
+    return census.spread(
+        namespace, sel, entry[0], ("ignore",), lambda labels: True
+    )
+
+
+def _entry_caps(
+    skew, min_domains, self_match, values, counts_e, present_e
+) -> Tuple[np.ndarray, np.ndarray, bool]:
     """Per-value new-replica caps imposed by ONE spread constraint
     entry over the `values` domain list (_UNBOUNDED where it imposes
     nothing). The three regimes the scheduler's skew check induces:
@@ -793,42 +814,32 @@ def _entry_caps(skew, min_domains, self_match, values, counts_e,
 
 def _spread_state(namespace, entries, values, census, row_filter,  # lint: allow-complexity — one guard per budget regime (split/static/other-key/dead), the whole shape contract in one place
                   label_dicts, eligible):
-    """Mutable placement-budget STATE for one spread shape under one
-    row node filter, SHARED by every row of the workload through the
-    caller's memo — a workload split across request-distinct rows
-    (mid-VPA) draws from one budget, so two rows never spend the same
-    domain capacity twice (r3 code review):
+    """IMMUTABLE per-(shape, node-filter) cap VIEW — what the
+    scheduler's skew checks admit for a row carrying this filter:
 
     - `static`[d]: split-key caps from non-selfMatch entries (0 or
       unbounded — placements never consume them);
     - `budget`[d]: split-key caps from selfMatch entries, the MIN over
       every same-key entry (a single "first entry" cap could silently
-      drop a tighter same-key constraint); DEPLETED as rows place;
-    - `counts`[d]: the running fill-order counts (first entry's census
-      counts plus placements when the first entry self-matches);
+      drop a tighter same-key constraint, r3 code review);
+    - `counts`[d]: the first entry's census counts (the fill-order
+      seed);
     - `dead`: groups excluded outright by a non-split entry's
       zero-capacity domains;
     - `others`: per non-split selfMatch entry with finite caps,
-      (value_groups, remaining budget) — consumed by the caller's
-      DESIGNATION pass, which pins each chunk to one of that key's
-      domains and masks the sub-row to it, so a chunk can never land
-      in a domain whose budget another chunk spent (the per-domain
-      distribution soundness a bare total bound cannot give, r3 code
-      review)."""
+      (entry index, value_groups, per-value caps) — enforced by the
+      caller's DESIGNATION pass.
+
+    CONSUMPTION lives one level up, in the per-WORKLOAD shared ledgers
+    (_expand_spread_rows): placements count against the workload's
+    skew regardless of which row's node filter admitted them, so rows
+    with DIFFERENT filters still spend one budget — each row's
+    effective cap is its own view minus everything the workload already
+    placed (r3 code review)."""
     split_key = entries[0][0]
-    token, node_passes = row_filter
 
     def entry_counts(e):
-        key, _skew, _mind, sel, _self, honor = e
-        if census is None or sel is None:
-            return {}, set()
-        if honor:
-            return census.spread(namespace, sel, key, token, node_passes)
-        # nodeAffinityPolicy=Ignore: every live node exposing the key
-        # defines a domain and contributes counts
-        return census.spread(
-            namespace, sel, key, ("ignore",), lambda labels: True
-        )
+        return _entry_census(census, namespace, e, row_filter)
 
     d = len(values)
     static = np.full(d, _UNBOUNDED, np.int64)
@@ -841,7 +852,7 @@ def _spread_state(namespace, entries, values, census, row_filter,  # lint: allow
     # an unfillable outside domain — otherwise the surviving domains
     # are over-promised capacity the scheduler's skew check denies
     # against the frozen one (r3 code review)
-    for e in entries:
+    for entry_idx, e in enumerate(entries):
         if e[0] == split_key:
             continue
         _key, skew, min_domains, _sel, self_match, _honor = e
@@ -865,6 +876,7 @@ def _spread_state(namespace, entries, values, census, row_filter,  # lint: allow
         if self_match and (caps2 < _UNBOUNDED).any():
             others.append(
                 (
+                    entry_idx,
                     {v: vals2[v] for v in values2},
                     {
                         v: int(caps2[j])
@@ -922,37 +934,87 @@ def _spread_state(namespace, entries, values, census, row_filter,  # lint: allow
     }
 
 
-def _designate_chunks(additions, masks, state, n_groups):  # lint: allow-complexity — the joint designation walk: choose, narrow, min-take, charge, in one auditable pass
+def _spread_zero_cap_groups(shape, row_filter, label_dicts, census,
+                            n_groups):
+    """bool[n_groups]: groups whose domain some spread entry gives ZERO
+    remaining capacity, plus groups missing a constrained key — the
+    binding slice of a SKIPPED spread split. Used by the anti expansion:
+    its 1-per-domain hand-out supersedes the spread split, but placing
+    a replica into a spread domain with no capacity left would
+    over-promise (r3 code review)."""
+    namespace, entries = shape
+    dead = np.zeros(n_groups, bool)
+    for entry in entries:
+        key, skew, min_domains, _sel, self_match, _honor = entry
+        vals: Dict[str, list] = {}
+        for t, labels in enumerate(label_dicts):
+            value = labels.get(key)
+            if value is None:
+                dead[t] = True
+            else:
+                vals.setdefault(value, []).append(t)
+        if not vals:
+            continue
+        counts_e, present_e = _entry_census(
+            census, namespace, entry, row_filter
+        )
+        if not counts_e and not present_e:
+            continue
+        values = sorted(vals)
+        caps_e, _, _ = _entry_caps(
+            skew, min_domains, self_match, values, counts_e, present_e
+        )
+        for j, value in enumerate(values):
+            if caps_e[j] <= 0:
+                dead[vals[value]] = True
+    return dead
+
+
+def _designate_chunks(additions, masks, view, others_placed, n_groups):  # lint: allow-complexity — the joint designation walk: choose, narrow, min-take, charge, in one auditable pass
     """For every non-split selfMatch entry with finite domain budgets:
     pin each split-domain chunk to ONE of that key's domains (greedy:
     most remaining budget, deterministic tie-break), shrink the chunk
     to what EVERY designated domain still admits, then charge each
     ledger by that FINAL take — charging at choice time would leak
     budget a later entry's shrink never uses, starving later rows of
-    the shared state (r3 code review). Sound by construction: every
-    promised replica lands in domains with budget reserved for it —
-    concentration can't overdraw a domain another chunk already spent.
-    Conservative: a placement spanning several of a key's domains
-    within one split domain is not attempted. Returns per-rank extra
-    masks (None = no restriction); mutates `additions` and the state's
-    budgets."""
+    the shared state (r3 code review). Remaining = this row's cap VIEW
+    minus the WORKLOAD-shared `others_placed` ledger (keyed by entry
+    index + value), so rows with different node filters still spend one
+    budget. Dead groups are excluded from candidacy up front — a dead
+    value with a fat ledger must not outbid a live one (r3 code
+    review). Sound by construction: every promised replica lands in
+    domains with budget reserved for it. Conservative: a placement
+    spanning several of a key's domains within one split domain is not
+    attempted. Returns per-rank extra masks (None = no restriction);
+    mutates `additions` and `others_placed`."""
     extra = [None] * len(additions)
-    if not state["others"]:
+    if not view["others"]:
         return extra
+    dead = view["dead"]
     inverses = []
-    for value_groups, remaining in state["others"]:
+    for entry_idx, value_groups, caps2 in view["others"]:
         group_value = {}
         for value, groups in value_groups.items():
             for t in groups:
                 group_value[t] = value
-        inverses.append((group_value, value_groups, remaining))
+        placed = others_placed.setdefault(entry_idx, {})
+        inverses.append((group_value, value_groups, caps2, placed))
     for rank in range(len(additions)):
         chunk = int(additions[rank])
         if chunk == 0:
             continue
         allowed = ~masks[rank]
-        charges = []  # (remaining ledger, chosen value)
-        for group_value, value_groups, remaining in inverses:
+        if dead is not None:
+            allowed = allowed & ~dead
+        charges = []  # (caps2, placed ledger, chosen value)
+        for group_value, value_groups, caps2, placed in inverses:
+
+            def remaining(v):
+                cap = caps2.get(v)
+                if cap is None:
+                    return _UNBOUNDED
+                return cap - placed.get(v, 0)
+
             candidates = sorted(
                 {
                     group_value[t]
@@ -963,12 +1025,9 @@ def _designate_chunks(additions, masks, state, n_groups):  # lint: allow-complex
             if not candidates:
                 allowed = None
                 break
-            best = max(
-                candidates,
-                key=lambda v: (remaining.get(v, _UNBOUNDED), v),
-            )
-            if best in remaining:
-                charges.append((remaining, best))
+            best = max(candidates, key=lambda v: (remaining(v), v))
+            if best in caps2:
+                charges.append((caps2, placed, best))
             # narrow for the NEXT entry: designation is joint — later
             # entries choose among groups the earlier picks allow
             keep = np.zeros(n_groups, bool)
@@ -978,11 +1037,11 @@ def _designate_chunks(additions, masks, state, n_groups):  # lint: allow-complex
             additions[rank] = 0
             continue
         take = chunk
-        for remaining, best in charges:
-            take = min(take, remaining[best])
+        for caps2, placed, best in charges:
+            take = min(take, max(0, caps2[best] - placed.get(best, 0)))
         additions[rank] = take
-        for remaining, best in charges:
-            remaining[best] = remaining[best] - take
+        for caps2, placed, best in charges:
+            placed[best] = placed.get(best, 0) + take
         extra[rank] = ~allowed  # forbid everything outside the picks
     return extra
 
@@ -1083,18 +1142,33 @@ def _expand_spread_rows(  # lint: allow-complexity — per-domain chunking: each
 
     all_forbidden = np.ones(n_groups, bool)
     no_forbidden = np.zeros(n_groups, bool)
-    # the placement-budget state is a pure function of (shape, row node
-    # filter) and is SHARED — and consumed — by every row of the
-    # workload; rows of one shape process in canonical content order so
-    # the budget hand-out never depends on arena-local numbering (the
-    # path-stability rule _expand_anti_rows already follows)
-    state_memo: Dict[tuple, dict] = {}
+    # per-(shape, filter) cap VIEWS are immutable; consumption lives in
+    # per-WORKLOAD (per-sid) shared ledgers, so rows with DIFFERENT node
+    # filters still spend one budget — placements count against the
+    # workload's skew regardless of which filter admitted them (r3 code
+    # review). Multi-row shapes process in canonical content order so
+    # the hand-out never depends on arena-local numbering (the
+    # path-stability rule _expand_anti_rows already follows); the
+    # canonical key is only computed for shapes that actually have
+    # several rows (it walks every universe — too hot for the common
+    # one-row-per-workload tick).
+    view_memo: Dict[tuple, dict] = {}
+    ledgers: Dict[int, dict] = {}
+    sid_rows = collections.Counter(
+        int(s) for s in live_ids if s and plan.get(int(s)) is not None
+    )
     order = sorted(
         range(len(live_ids)),
         key=lambda i: (
             (0, (), i)
             if not live_ids[i] or plan.get(int(live_ids[i])) is None
-            else (1, int(live_ids[i]), _canonical_row_key(snap, row_idx[i]))
+            else (
+                1,
+                int(live_ids[i]),
+                _canonical_row_key(snap, row_idx[i])
+                if sid_rows[int(live_ids[i])] > 1
+                else (),
+            )
         ),
     )
     out_idx, out_weight, out_forbidden = [], [], []
@@ -1121,16 +1195,30 @@ def _expand_spread_rows(  # lint: allow-complexity — per-domain chunking: each
             if census is not None
             else (None, None)
         )
-        memo_key = (int(sid), row_filter[0])
-        state = state_memo.get(memo_key)
-        if state is None:
-            state = _spread_state(
+        view_key = (int(sid), row_filter[0])
+        view = view_memo.get(view_key)
+        if view is None:
+            view = _spread_state(
                 namespace, entries, values, census, row_filter,
                 label_dicts, eligible,
             )
-            state_memo[memo_key] = state
+            view_memo[view_key] = view
+        ledger = ledgers.get(int(sid))
+        if ledger is None:
+            ledger = {
+                "placed": np.zeros(d, np.int64),
+                "counts": view["counts"].copy(),
+                "others_placed": {},
+            }
+            ledgers[int(sid)] = ledger
         caps = np.minimum(
-            np.minimum(state["static"], state["budget"]), weight
+            np.clip(
+                np.minimum(view["static"], view["budget"])
+                - ledger["placed"],
+                0,
+                None,
+            ),
+            weight,
         )
         schedulable = min(weight, int(caps.sum()))
         # content-keyed remainder rotation (see _water_fill)
@@ -1140,17 +1228,19 @@ def _expand_spread_rows(  # lint: allow-complexity — per-domain chunking: each
             .sum()
         )
         additions = _water_fill(
-            state["counts"], caps, schedulable, seed
+            ledger["counts"], caps, schedulable, seed
         )
-        extra = _designate_chunks(additions, masks, state, n_groups)
-        # consume the shared budgets: a later row of this workload sees
+        extra = _designate_chunks(
+            additions, masks, view, ledger["others_placed"], n_groups
+        )
+        # consume the shared ledgers: a later row of this workload sees
         # what THIS row placed (selfMatch placements also accumulate
         # into the fill-order counts, exactly like the scheduler's
         # sequential skew accounting)
-        state["budget"] = np.maximum(state["budget"] - additions, 0)
-        if state["first_selfmatch"]:
-            state["counts"] = state["counts"] + additions
-        dead = state["dead"]
+        ledger["placed"] = ledger["placed"] + additions
+        if view["first_selfmatch"]:
+            ledger["counts"] = ledger["counts"] + additions
+        dead = view["dead"]
         placed = 0
         for rank in range(d):
             chunk = int(additions[rank])
@@ -1318,6 +1408,9 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
     # SHARED across rows with the same shape, handed out in canonical
     # content order (path-stable — see docstring)
     sid_rows = collections.Counter(int(s) for s in live_ids)
+    # (spread shape id, row filter token) -> zero-capacity group mask
+    # for anti rows whose spread split was skipped (see below)
+    spread_dead_memo: Dict[tuple, np.ndarray] = {}
     plan: Dict[int, tuple] = {}
     for s in np.unique(live_ids):
         shape = shapes[s]
@@ -1414,26 +1507,69 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
                         excluded[t] = True
         plan[int(s)] = (domains, excluded, bool(hostname_excl))
 
-    # pre-allocate each row's domain range (start, take) in canonical
-    # content order within its workload
-    alloc: Dict[int, tuple] = {}
+    def row_spread_dead(i):
+        """Zero-capacity spread exclusion for an anti-split row (the
+        spread SPLIT was skipped in favor of the anti split, but a
+        spread domain with NO remaining capacity must still never
+        receive the anti replica — r3 code review)."""
+        if (
+            live_spread is None
+            or live_spread[i] == 0
+            or spread_shapes is None
+        ):
+            return None
+        spread_sid = int(live_spread[i])
+        row_filter = (
+            _row_node_filter(snap, row_idx[i])
+            if census is not None
+            else (None, None)
+        )
+        key = (spread_sid, row_filter[0])
+        dead = spread_dead_memo.get(key)
+        if dead is None:
+            dead = _spread_zero_cap_groups(
+                spread_shapes[spread_sid], row_filter, label_dicts,
+                census, n_groups,
+            )
+            spread_dead_memo[key] = dead
+        return dead
+
+    # hand out domains per workload in canonical content order; a
+    # domain dead for one row (its spread capacity spent, or every
+    # group of it excluded) is SKIPPED, not consumed — a later row may
+    # still use it, while consumption stays GLOBAL per workload so no
+    # two rows ever share a domain (the no-doubling invariant)
+    picks: Dict[int, list] = {}
+    row_dead: Dict[int, np.ndarray] = {}
     rows_by_sid: Dict[int, list] = {}
     for i, sid in enumerate(live_ids):
         entry = plan.get(int(sid))
         if entry is not None and entry[0] is not None:
             rows_by_sid.setdefault(int(sid), []).append(i)
     for sid, rows_i in rows_by_sid.items():
-        n_domains = len(plan[sid][0])
+        domain_list = plan[sid][0]
         if len(rows_i) > 1:
             rows_i = sorted(
                 rows_i,
                 key=lambda i: _canonical_row_key(snap, row_idx[i]),
             )
-        pos = 0
+        consumed = [False] * len(domain_list)
         for i in rows_i:
-            take = min(int(row_weight[i]), max(0, n_domains - pos))
-            alloc[i] = (pos, take)
-            pos += take
+            dead = row_spread_dead(i)
+            if dead is not None:
+                row_dead[i] = dead
+            need = int(row_weight[i])
+            mine = []
+            for rank, groups in enumerate(domain_list):
+                if len(mine) >= need:
+                    break
+                if consumed[rank]:
+                    continue
+                if dead is not None and all(dead[t] for t in groups):
+                    continue
+                consumed[rank] = True
+                mine.append(rank)
+            picks[i] = mine
 
     out_idx, out_weight, out_forbidden, out_exclusive = [], [], [], []
     for i, sid in enumerate(live_ids):
@@ -1451,19 +1587,10 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
             continue
         domains, excluded, hostname_excl = entry
         excluded = excluded | prior
-        # spread keys of a domain-capped row: key-presence exclusion
-        # (the spread SPLIT was skipped in favor of the anti split)
-        if (
-            domains is not None
-            and live_spread is not None
-            and live_spread[i] != 0
-            and spread_shapes is not None
-        ):
-            # excluded is already a fresh per-row array (| prior above)
-            for key, *_rest in spread_shapes[live_spread[i]][1]:
-                for t, labels in enumerate(label_dicts):
-                    if key not in labels:
-                        excluded[t] = True
+        if i in row_dead:
+            # partial-dead domains stay usable through their live
+            # groups; the mask forbids the spent ones
+            excluded |= row_dead[i]
         weight = int(row_weight[i])
         if domains is None:
             # hostname/co-location only: no split, mask + flag ride along
@@ -1472,8 +1599,8 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
             out_forbidden.append(excluded)
             out_exclusive.append(hostname_excl)
             continue
-        start, take = alloc[i]
-        for rank in range(start, start + take):
+        mine = picks[i]
+        for rank in mine:
             forbidden = np.ones(n_groups, bool)
             forbidden[domains[rank]] = False
             forbidden |= excluded
@@ -1481,11 +1608,12 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
             out_weight.append(np.int32(1))
             out_forbidden.append(forbidden)
             out_exclusive.append(hostname_excl)
-        if weight > take:
-            # beyond the domain count: unschedulable by anti-affinity —
-            # keep the excess as a forbidden-everywhere row so it COUNTS
+        if weight > len(mine):
+            # beyond the usable domain count: unschedulable by
+            # anti-affinity — keep the excess as a forbidden-everywhere
+            # row so it COUNTS
             out_idx.append(row_idx[i])
-            out_weight.append(np.int32(weight - take))
+            out_weight.append(np.int32(weight - len(mine)))
             out_forbidden.append(np.ones(n_groups, bool))
             out_exclusive.append(hostname_excl)
     return (

@@ -716,6 +716,35 @@ class TestSpreadOccupancy:
         assert counts == {"group-a": 2, "group-b": 1}
         assert total_unschedulable(runtime, "group-a") == 1
 
+    def test_rows_with_different_node_filters_share_the_budget(
+        self, env
+    ):
+        """Regression (r3 code review): a mid-rollout workload whose new
+        revision adds a nodeSelector still spends ONE budget — per-(row
+        filter) cap views must not each get a fresh ledger."""
+        runtime, _ = env
+        zoned(runtime, extra_node_labels={"tier": "app"})
+        # unmanaged empty zone passing BOTH rows' filters: every row's
+        # view caps each zone at maxSkew=1 total for the workload
+        runtime.store.create(
+            ready_node("unmanaged", {ZONE_KEY: "us-c", "tier": "app"})
+        )
+        for i in range(2):
+            runtime.store.create(
+                spread_pod(f"plain-{i}", {"app": "web"})
+            )
+        for i in range(2):
+            runtime.store.create(
+                spread_pod(
+                    f"selector-{i}", {"app": "web"},
+                    node_selector={"tier": "app"},
+                )
+            )
+        runtime.manager.reconcile_all()
+        counts = pods_per_group(runtime, ["group-a", "group-b"])
+        assert sum(counts.values()) == 2
+        assert total_unschedulable(runtime, "group-a") == 2
+
     def test_dead_split_domain_freezes_the_minimum(self, env):
         """Regression (r3 code review): a split domain whose groups are
         all excluded by a non-split key is unfillable — it freezes the
@@ -975,6 +1004,38 @@ class TestAntiAffinityOccupancy:
         runtime.manager.reconcile_all()
         counts = pods_per_group(runtime, ["group-a", "group-b"])
         assert sorted(counts.values()) == [1, 1]
+        assert total_unschedulable(runtime, "group-a") == 0
+
+    def test_anti_split_respects_spread_zero_capacity(self, env):
+        """Regression (r3 code review): a row with BOTH hard spread and
+        zone anti-affinity splits by the anti rule, but a zone whose
+        spread capacity is already spent (here by a foreign-selector
+        constraint over existing cache pods) must never receive the
+        anti replica."""
+        runtime, _ = env
+        zoned(runtime, zones=("a", "b", "c"))
+        for i in range(2):
+            runtime.store.create(
+                bound_pod(f"cache-{i}", {"tier": "cache"}, "n-a")
+            )
+        for i in range(2):
+            pod = anti_pod(f"db-{i}")
+            pod.spec.topology_spread_constraints = [
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=ZONE_KEY,
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector={"matchLabels": {"tier": "cache"}},
+                )
+            ]
+            runtime.store.create(pod)
+        runtime.manager.reconcile_all()
+        # zone a holds 2 cache pods, skew 2 > maxSkew 1 over b/c's 0:
+        # its spread capacity is zero, so the anti hand-out must use
+        # zones b and c even though a is anti-free
+        assert pods_per_group(
+            runtime, ["group-a", "group-b", "group-c"]
+        ) == {"group-a": 0, "group-b": 1, "group-c": 1}
         assert total_unschedulable(runtime, "group-a") == 0
 
     def test_co_location_pins_to_existing_domain(self, env):
