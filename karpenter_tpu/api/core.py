@@ -195,10 +195,12 @@ class PodAffinity:
 class Affinity:
     node_affinity: Optional[NodeAffinity] = None
     # inter-pod (anti-)affinity: the SELF-matching required slice is
-    # constrained (pod_affinity_shape) and the self-matching preferred
-    # slice scored (soft_pod_affinity_shape); selectors over OTHER
-    # pods' labels need pairwise pod state and are decoded for
-    # fidelity only (docs/OPERATIONS.md 'Scheduling fidelity')
+    # constrained (pod_affinity_shape), the self-matching preferred
+    # slice scored (soft_pod_affinity_shape), and required FOREIGN
+    # selectors enforced against SCHEDULED state through the occupancy
+    # census (_foreign_terms); only pending-vs-pending interactions and
+    # namespaceSelector terms stay decode-only (docs/OPERATIONS.md
+    # 'Scheduling fidelity')
     pod_affinity: Optional[PodAffinity] = None
     pod_anti_affinity: Optional[PodAntiAffinity] = None
 
@@ -389,16 +391,19 @@ def pod_affinity_shape(
       promised by a group-level pack and stays out of scope.
 
     Returns () when unconstrained, else
-    (hostname_exclusive, anti_keys, co_keys, ident) where ident is the
-    WORKLOAD IDENTITY: the pod's namespace plus the canonical forms of
-    the self-matching domain-relevant selectors. Two pods share an
-    anti-group iff they match each other's selectors; replicas of one
-    workload share the selector even when their LABELS differ per pod
-    (StatefulSets stamp statefulset.kubernetes.io/pod-name on each
-    replica — raw labels would fragment the group, r3 code review), and
-    two workloads whose pods all match one selector genuinely are one
-    mutual anti-group. Preferred (soft) terms and selectors over other
-    pods' labels are decoded, never constrained.
+    (hostname_exclusive, anti_keys, co_keys, ident, foreign) where
+    ident is the WORKLOAD IDENTITY: the pod's namespace plus the
+    canonical forms of the self-matching domain-relevant selectors. Two
+    pods share an anti-group iff they match each other's selectors;
+    replicas of one workload share the selector even when their LABELS
+    differ per pod (StatefulSets stamp
+    statefulset.kubernetes.io/pod-name on each replica — raw labels
+    would fragment the group, r3 code review), and two workloads whose
+    pods all match one selector genuinely are one mutual anti-group.
+    `foreign` is the required terms whose selectors match OTHER
+    workloads' pods (_foreign_terms), enforced against SCHEDULED state
+    through the occupancy census. Preferred (soft) foreign terms are
+    decoded, never constrained.
     """
     if affinity is None:
         return ()
@@ -427,7 +432,15 @@ def pod_affinity_shape(
     )
     anti_keys = _domain_keys(anti_terms)
     co_keys = _domain_keys(co_terms)
-    if not hostname_exclusive and not anti_keys and not co_keys:
+    foreign = _foreign_terms(
+        affinity, labels, namespace, anti_terms, co_terms
+    )
+    if (
+        not hostname_exclusive
+        and not anti_keys
+        and not co_keys
+        and not foreign
+    ):
         return ()
     ident = (
         (
@@ -445,7 +458,76 @@ def pod_affinity_shape(
         if anti_keys or co_keys
         else ()
     )
-    return (int(hostname_exclusive), anti_keys, co_keys, ident)
+    return (int(hostname_exclusive), anti_keys, co_keys, ident, foreign)
+
+
+def _foreign_terms(affinity, labels, namespace, anti_terms, co_terms):  # lint: allow-complexity — one guard per k8s term rule (selector/nsSelector/hostname/own-vs-extra namespaces)
+    """Canonical FOREIGN required (anti-)affinity terms — selectors that
+    do NOT match the pod's own labels, i.e. constraints against OTHER
+    workloads' pods. The solver enforces them against SCHEDULED state
+    (the occupancy census): an anti term forbids the domains existing
+    matching pods occupy; a co term requires one (no first-replica
+    bootstrap for foreign selectors — if no matching pod exists, the
+    pod is genuinely unschedulable, exactly the scheduler's rule).
+    Interactions with the matching workload's PENDING pods (placed in
+    the same solve) still need pairwise pod state and remain out of
+    scope (docs/OPERATIONS.md). Returns sorted (sign, topologyKey,
+    selectorForm, namespaces) tuples, sign -1 anti / +1 co; namespaces
+    is the term's explicit list or () = the pod's own. Skipped (never
+    constrained): namespaceSelector terms (need namespace label state),
+    and hostname ANTI terms — a scale-up's fresh nodes host nothing,
+    so they can never be blocked. Hostname CO terms are kept: a fresh
+    node can never satisfy "must run beside an existing pod on one
+    node", so the row is honestly unschedulable."""
+    out = set()
+    own_anti = set(map(id, anti_terms))
+    own_co = set(map(id, co_terms))
+    for sign, block, own in (
+        (-1, affinity.pod_anti_affinity, own_anti),
+        (1, affinity.pod_affinity, own_co),
+    ):
+        if block is None:
+            continue
+        for t in block.required_during_scheduling_ignored_during_execution:
+            if t.label_selector is None or not t.topology_key:
+                continue
+            if t.namespace_selector is not None:
+                continue
+            if sign < 0 and t.topology_key == HOSTNAME_TOPOLOGY_KEY:
+                continue
+            listed = tuple(sorted(t.namespaces or ()))
+            if id(t) in own:
+                # the self-matching slice is modeled by the self
+                # machinery for the pod's OWN namespace — but an anti
+                # term listing ADDITIONAL namespaces also blocks on
+                # matching pods THERE, which only the census-backed
+                # foreign mask can enforce (r3 code review). Co terms
+                # need no projection: admitting only own-namespace
+                # evidence under-admits, which is conservative.
+                extra = tuple(
+                    ns for ns in listed if ns != namespace
+                )
+                if sign < 0 and extra:
+                    out.add(
+                        (
+                            sign,
+                            t.topology_key,
+                            _selector_form(t.label_selector),
+                            extra,
+                        )
+                    )
+                continue
+            out.add(
+                (
+                    sign,
+                    t.topology_key,
+                    _selector_form(t.label_selector),
+                    # resolve the k8s default at build time: an empty
+                    # namespaces list means the POD'S OWN namespace
+                    listed or (namespace,),
+                )
+            )
+    return tuple(sorted(out))
 
 
 def soft_spread_shape(

@@ -36,6 +36,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from karpenter_tpu.api.core import (
+    HOSTNAME_TOPOLOGY_KEY,
     Taint,
     is_ready_and_schedulable,
     matches_affinity_shape,
@@ -1416,7 +1417,7 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
         shape = shapes[s]
         if not shape:
             continue
-        hostname_excl, anti_keys, co_keys, ident = shape
+        hostname_excl, anti_keys, co_keys, ident, foreign = shape
         need_keys = [*anti_keys, *co_keys]
         # existing-pod occupancy (DomainCensus): domains already holding
         # a replica are spent for anti-affinity; domains holding the
@@ -1444,6 +1445,34 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
                 # self-affinity pins new replicas to domains that hold a
                 # matching pod — groups elsewhere are excluded
                 excluded[t] = True
+        # FOREIGN required terms (selectors over OTHER workloads' pods)
+        # enforced against SCHEDULED state: anti forbids the domains
+        # existing matching pods occupy; co requires one — with no
+        # first-replica bootstrap (a foreign selector the incoming pod
+        # doesn't match gets no such grace, the scheduler's rule).
+        # Interactions with that workload's PENDING pods remain out of
+        # scope (docs/OPERATIONS.md).
+        if foreign and census is not None:
+            for sign, key, sel, namespaces in foreign:
+                occupied: set = set()
+                for foreign_ns in namespaces:
+                    occupied |= census.domain_counts(
+                        foreign_ns, sel, key
+                    ).keys()
+                if sign < 0:
+                    for t, labels in enumerate(label_dicts):
+                        if labels.get(key) in occupied:
+                            excluded[t] = True
+                elif key == HOSTNAME_TOPOLOGY_KEY:
+                    # "must share a NODE with an existing pod": a
+                    # scale-up's fresh nodes never can — honestly
+                    # unschedulable
+                    excluded[:] = True
+                else:
+                    for t, labels in enumerate(label_dicts):
+                        value = labels.get(key)
+                        if value is None or value not in occupied:
+                            excluded[t] = True
         domains = None
         if anti_keys:
             # Combined-value accounting so EVERY key's cap holds (a

@@ -1316,6 +1316,173 @@ class TestSoftConstraintScoring:
         assert results[0]["group-b"][0] == 3
 
 
+def foreign_pod(name, sign="anti", key=ZONE_KEY, selector=None,
+                namespaces=()):
+    """A pending pod with a required (anti-)affinity term whose selector
+    matches ANOTHER workload's pods (app=redis), not its own."""
+    pod = Pod(
+        metadata=ObjectMeta(name=name, labels={"app": "web"}),
+        spec=PodSpec(
+            node_name="",
+            containers=[
+                Container(requests=resource_list(cpu="1", memory="1Gi"))
+            ],
+        ),
+    )
+    term = PodAffinityTerm(
+        label_selector=LabelSelector(
+            match_labels=dict(selector or {"app": "redis"})
+        ),
+        topology_key=key,
+        namespaces=list(namespaces),
+    )
+    pod.spec.affinity = Affinity(
+        pod_anti_affinity=(
+            PodAntiAffinity(
+                required_during_scheduling_ignored_during_execution=[term]
+            )
+            if sign == "anti"
+            else None
+        ),
+        pod_affinity=(
+            PodAffinity(
+                required_during_scheduling_ignored_during_execution=[term]
+            )
+            if sign == "co"
+            else None
+        ),
+    )
+    return pod
+
+
+class TestForeignAffinityOccupancy:
+    """Required (anti-)affinity against OTHER workloads' pods, enforced
+    against SCHEDULED state through the census (the pending-vs-pending
+    interaction stays out of scope, docs/OPERATIONS.md)."""
+
+    def test_foreign_anti_blocks_occupied_domains(self, env):
+        runtime, _ = env
+        zoned(runtime, zones=("a", "b"))
+        runtime.store.create(bound_pod("redis", {"app": "redis"}, "n-a"))
+        for i in range(3):
+            runtime.store.create(foreign_pod(f"web-{i}"))
+        runtime.manager.reconcile_all()
+        assert pods_per_group(runtime, ["group-a", "group-b"]) == {
+            "group-a": 0,
+            "group-b": 3,
+        }
+        assert total_unschedulable(runtime, "group-a") == 0
+
+    def test_foreign_anti_without_matching_pods_is_free(self, env):
+        runtime, _ = env
+        zoned(runtime, zones=("a", "b"))
+        for i in range(2):
+            runtime.store.create(foreign_pod(f"web-{i}"))
+        runtime.manager.reconcile_all()
+        counts = pods_per_group(runtime, ["group-a", "group-b"])
+        assert sum(counts.values()) == 2
+        assert total_unschedulable(runtime, "group-a") == 0
+
+    def test_foreign_co_requires_an_occupied_domain(self, env):
+        runtime, _ = env
+        zoned(runtime, zones=("a", "b"))
+        runtime.store.create(bound_pod("redis", {"app": "redis"}, "n-b"))
+        for i in range(2):
+            runtime.store.create(foreign_pod(f"web-{i}", sign="co"))
+        runtime.manager.reconcile_all()
+        assert pods_per_group(runtime, ["group-a", "group-b"]) == {
+            "group-a": 0,
+            "group-b": 2,
+        }
+
+    def test_foreign_co_without_matching_pods_is_unschedulable(self, env):
+        """No first-replica bootstrap for a foreign selector: if no
+        matching pod exists anywhere, the scheduler will never admit
+        the pod — the signal must not size a scale-up for it."""
+        runtime, _ = env
+        zoned(runtime, zones=("a", "b"))
+        runtime.store.create(foreign_pod("web-0", sign="co"))
+        runtime.manager.reconcile_all()
+        counts = pods_per_group(runtime, ["group-a", "group-b"])
+        assert sum(counts.values()) == 0
+        assert total_unschedulable(runtime, "group-a") == 1
+
+    def test_foreign_namespaces_scope_the_census(self, env):
+        runtime, _ = env
+        zoned(runtime, zones=("a", "b"))
+        runtime.store.create(
+            bound_pod("redis", {"app": "redis"}, "n-a",
+                      namespace="other")
+        )
+        # the term scopes to namespace "other": the redis there blocks
+        runtime.store.create(
+            foreign_pod("web-0", namespaces=("other",))
+        )
+        # an unscoped term sees only the pod's OWN namespace: free
+        runtime.store.create(foreign_pod("web-1"))
+        runtime.manager.reconcile_all()
+        assert pods_per_group(runtime, ["group-a", "group-b"]) == {
+            "group-a": 1,
+            "group-b": 1,
+        }
+
+    def test_self_anti_with_extra_namespaces_blocks_there_too(self, env):
+        """Regression (r3 code review): a SELF-matching anti term whose
+        namespaces list spans the own namespace plus others must also
+        block on matching pods in those other namespaces — the self
+        machinery only censuses the own one."""
+        runtime, _ = env
+        zoned(runtime, zones=("a", "b"))
+        runtime.store.create(
+            bound_pod("twin", {"app": "db"}, "n-a", namespace="staging")
+        )
+        pod = anti_pod("db-0")
+        term = (
+            pod.spec.affinity.pod_anti_affinity
+            .required_during_scheduling_ignored_during_execution[0]
+        )
+        term.namespaces = ["default", "staging"]
+        runtime.store.create(pod)
+        runtime.manager.reconcile_all()
+        # the staging twin occupies zone a: the replica must land in b
+        assert pods_per_group(runtime, ["group-a", "group-b"]) == {
+            "group-a": 0,
+            "group-b": 1,
+        }
+
+    def test_none_namespaces_field_is_tolerated(self):
+        """namespaces: null hydrates to None — the shape build must not
+        crash (r3 code review)."""
+        from karpenter_tpu.api.core import pod_affinity_shape
+
+        pod = foreign_pod("web-0")
+        term = (
+            pod.spec.affinity.pod_anti_affinity
+            .required_during_scheduling_ignored_during_execution[0]
+        )
+        term.namespaces = None
+        shape = pod_affinity_shape(
+            pod.spec.affinity, pod.metadata.labels, "default"
+        )
+        assert shape[4] == (
+            (-1, ZONE_KEY, ((("app", "redis"),), ()), ("default",)),
+        )
+
+    def test_foreign_hostname_co_is_unschedulable(self, env):
+        """'Must share a NODE with an existing pod' can never be met by
+        a scale-up's fresh nodes."""
+        runtime, _ = env
+        zoned(runtime, zones=("a",))
+        runtime.store.create(bound_pod("redis", {"app": "redis"}, "n-a"))
+        runtime.store.create(
+            foreign_pod("web-0", sign="co",
+                        key="kubernetes.io/hostname")
+        )
+        runtime.manager.reconcile_all()
+        assert pods_per_group(runtime, ["group-a"]) == {"group-a": 0}
+        assert total_unschedulable(runtime, "group-a") == 1
+
+
 class TestEncodeMemoWithOccupancy:
     """Bound-pod churn must not thrash the encode memo of fleets without
     spread/anti constraints — and must invalidate it for fleets with."""

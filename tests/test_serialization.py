@@ -151,8 +151,10 @@ class TestCodecPosture:
     def test_pod_anti_affinity_roundtrip(self):
         """core/v1 podAntiAffinity/podAffinity manifest dialect hydrates
         reflectively, and the SELF-matching slice canonicalizes into
-        pod_affinity_shape (solver model scope; foreign selectors and
-        out-of-namespace terms fall out)."""
+        pod_affinity_shape (solver model scope); foreign hostname anti
+        terms fall out entirely — a scale-up's fresh nodes can never be
+        blocked by them — while non-hostname foreign terms canonicalize
+        into the shape's foreign slice."""
         pod = from_manifest(
             {
                 "apiVersion": "v1",
@@ -236,6 +238,9 @@ class TestCodecPosture:
                     ((("app", "db"),), ()),
                 ),
             ),
+            # foreign slice: both foreign terms here are hostname ANTI
+            # (never constraining on fresh nodes) -> empty
+            (),
         )
         from karpenter_tpu.api.serialization import to_dict
 
