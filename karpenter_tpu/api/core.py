@@ -350,21 +350,34 @@ def spread_shape(
 
 
 def _self_matching_terms(
-    terms: list, labels: Dict[str, str], namespace: str
+    terms: list,
+    labels: Dict[str, str],
+    namespace: str,
+    assume_ns_selector: bool = False,
 ) -> list:
     """The PodAffinityTerms whose selector matches the POD'S OWN labels
     with the pod's own namespace in scope — the replica-spread /
     replica-co-location pattern, the only inter-pod slice a group-level
-    scale-up signal can honor without pairwise pod state. A term with a
-    namespace_selector, or namespaces excluding the pod's own, can match
-    only OTHER pods and is out of model scope."""
+    scale-up signal can honor without pairwise pod state.
+
+    assume_ns_selector (the ANTI call): a namespaceSelector term whose
+    selector matches the pod's own labels is ALSO treated as self —
+    whether the own namespace's labels match can't be known at shape
+    build, and assuming they do only adds the 1-per-domain restriction
+    among the pending replicas, which is conservative for an
+    anti-affinity. The CO call must NOT assume it: own-in-scope would
+    grant the first-replica bootstrap the scheduler may not give."""
     out = []
     for term in terms:
         if term.label_selector is None or not term.topology_key:
             continue
-        if term.namespace_selector is not None:
+        if term.namespace_selector is not None and not assume_ns_selector:
             continue
-        if term.namespaces and namespace not in term.namespaces:
+        if (
+            term.namespace_selector is None
+            and term.namespaces
+            and namespace not in term.namespaces
+        ):
             continue
         if term.label_selector.matches(labels):
             out.append(term)
@@ -414,6 +427,7 @@ def pod_affinity_shape(
             anti.required_during_scheduling_ignored_during_execution,
             labels,
             namespace,
+            assume_ns_selector=True,
         )
         if anti is not None
         else []
@@ -472,13 +486,17 @@ def _foreign_terms(affinity, labels, namespace, anti_terms, co_terms):  # lint: 
     Interactions with the matching workload's PENDING pods (placed in
     the same solve) still need pairwise pod state and remain out of
     scope (docs/OPERATIONS.md). Returns sorted (sign, topologyKey,
-    selectorForm, namespaces) tuples, sign -1 anti / +1 co; namespaces
-    is the term's explicit list or () = the pod's own. Skipped (never
-    constrained): namespaceSelector terms (need namespace label state),
-    and hostname ANTI terms — a scale-up's fresh nodes host nothing,
-    so they can never be blocked. Hostname CO terms are kept: a fresh
-    node can never satisfy "must run beside an existing pod on one
-    node", so the row is honestly unschedulable."""
+    selectorForm, namespaces) tuples, sign -1 anti / +1 co. The
+    namespaces component is either a plain tuple of names (the term's
+    explicit list, or () resolved to the pod's own namespace), or the
+    marker ("~", nsSelectorForm, explicitNames): namespaceSelector
+    terms resolve to the matching namespaces at ENCODE time against
+    the live Namespace set, unioned with any explicit list (the k8s
+    combination rule). Skipped (never constrained): hostname ANTI
+    terms — a scale-up's fresh nodes host nothing, so they can never
+    be blocked. Hostname CO terms are kept: a fresh node can never
+    satisfy "must run beside an existing pod on one node", so the row
+    is honestly unschedulable."""
     out = set()
     own_anti = set(map(id, anti_terms))
     own_co = set(map(id, co_terms))
@@ -491,31 +509,40 @@ def _foreign_terms(affinity, labels, namespace, anti_terms, co_terms):  # lint: 
         for t in block.required_during_scheduling_ignored_during_execution:
             if t.label_selector is None or not t.topology_key:
                 continue
-            if t.namespace_selector is not None:
-                continue
             if sign < 0 and t.topology_key == HOSTNAME_TOPOLOGY_KEY:
                 continue
             listed = tuple(sorted(t.namespaces or ()))
+            if t.namespace_selector is not None:
+                scope = (
+                    "~",
+                    _selector_form(t.namespace_selector),
+                    listed,
+                )
+            else:
+                scope = None
             if id(t) in own:
                 # the self-matching slice is modeled by the self
                 # machinery for the pod's OWN namespace — but an anti
-                # term listing ADDITIONAL namespaces also blocks on
-                # matching pods THERE, which only the census-backed
-                # foreign mask can enforce (r3 code review). Co terms
-                # need no projection: admitting only own-namespace
-                # evidence under-admits, which is conservative.
-                extra = tuple(
-                    ns for ns in listed if ns != namespace
-                )
-                if sign < 0 and extra:
-                    out.add(
-                        (
-                            sign,
-                            t.topology_key,
-                            _selector_form(t.label_selector),
-                            extra,
-                        )
+                # term reaching ADDITIONAL namespaces (an explicit list
+                # or a namespaceSelector) also blocks on matching pods
+                # THERE, which only the census-backed foreign mask can
+                # enforce (r3 code review). Co terms need no
+                # projection: admitting only own-namespace evidence
+                # under-admits, which is conservative.
+                if sign < 0:
+                    extra = tuple(
+                        ns for ns in listed if ns != namespace
                     )
+                    if scope is not None:
+                        out.add(
+                            (sign, t.topology_key,
+                             _selector_form(t.label_selector), scope)
+                        )
+                    elif extra:
+                        out.add(
+                            (sign, t.topology_key,
+                             _selector_form(t.label_selector), extra)
+                        )
                 continue
             out.add(
                 (
@@ -524,7 +551,9 @@ def _foreign_terms(affinity, labels, namespace, anti_terms, co_terms):  # lint: 
                     _selector_form(t.label_selector),
                     # resolve the k8s default at build time: an empty
                     # namespaces list means the POD'S OWN namespace
-                    listed or (namespace,),
+                    scope
+                    if scope is not None
+                    else (listed or (namespace,)),
                 )
             )
     return tuple(sorted(out))
@@ -857,6 +886,17 @@ class Node:
     status: NodeStatus = field(default_factory=NodeStatus)
 
     KIND = "Node"
+
+
+@dataclass(slots=True)
+class Namespace:
+    """core/v1 Namespace (metadata only): the labels resolve
+    namespaceSelector terms in inter-pod (anti-)affinity — which
+    namespaces' pods a foreign term censuses."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+
+    KIND = "Namespace"
 
 
 def is_ready_and_schedulable(node: Node) -> bool:

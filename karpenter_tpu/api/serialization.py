@@ -23,7 +23,13 @@ from typing import Any, Dict, List, Type
 
 import yaml
 
-from karpenter_tpu.api.core import Container, Node, ObjectMeta, Pod
+from karpenter_tpu.api.core import (
+    Container,
+    Namespace,
+    Node,
+    ObjectMeta,
+    Pod,
+)
 from karpenter_tpu.api.horizontalautoscaler import HorizontalAutoscaler
 from karpenter_tpu.api.metricsproducer import MetricsProducer
 from karpenter_tpu.api.scalablenodegroup import ScalableNodeGroup
@@ -44,6 +50,7 @@ KINDS: Dict[str, type] = {
     # core kinds so test fixtures can be manifests too
     "Node": Node,
     "Pod": Pod,
+    "Namespace": Namespace,
 }
 
 # YAML key -> dataclass field, where mechanical mapping doesn't hold

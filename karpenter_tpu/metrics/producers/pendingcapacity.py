@@ -42,6 +42,7 @@ from karpenter_tpu.api.core import (
     matches_affinity_shape,
     matches_selector,
     preference_score,
+    selector_form_matches,
 )
 from karpenter_tpu.api.metricsproducer import PendingCapacityStatus
 from karpenter_tpu.metrics.registry import GaugeRegistry, default_registry
@@ -278,6 +279,7 @@ def solve_pending(  # lint: allow-complexity — the one batched solve: per-targ
         )
     )
     census = None
+    namespace_state = ()
     if needs_census:
         if feed is not None:
             if feed.census is None:
@@ -293,6 +295,21 @@ def solve_pending(  # lint: allow-complexity — the one batched solve: per-targ
             census = DomainCensus(
                 occupancy_from_pods(all_pods), lambda: nodes
             )
+        # ONE Namespace read per solve: the encode-memo fingerprint and
+        # the namespaceSelector resolution must see the SAME snapshot
+        # (a label change landing between two reads would cache an
+        # encode under a state it was not computed from)
+        namespace_objects = store.list("Namespace")
+        census.set_namespaces(namespace_objects)
+        namespace_state = tuple(
+            sorted(
+                (
+                    ns.metadata.name,
+                    tuple(sorted(ns.metadata.labels.items())),
+                )
+                for ns in namespace_objects
+            )
+        )
 
     # Encode memo (feed path only): inputs are a pure function of
     # (pod arena generation, node set, producer selectors, occupancy).
@@ -310,6 +327,7 @@ def solve_pending(  # lint: allow-complexity — the one batched solve: per-targ
             # constraint is live; otherwise pin the slot so the memo
             # survives scheduled-pod events
             feed.occupancy.generation if needs_census else -1,
+            namespace_state,
             tuple(
                 (
                     namespace,
@@ -493,6 +511,12 @@ class DomainCensus:
         self._occupancy = occupancy
         self._nodes_fn = nodes_fn  # () -> list of Node objects
         self._node_version_fn = node_version_fn or (lambda: 0)
+        # Namespace objects FROZEN per solve (set_namespaces): the
+        # encode-memo fingerprint and the namespaceSelector resolution
+        # must read the same snapshot, or a label change landing
+        # between the two reads caches an encode under a state it was
+        # not computed from (r3 code review)
+        self._namespaces: list = []
         self._epoch: Optional[tuple] = None
         self._memo: Dict[tuple, object] = {}
         self._node_memo: Dict[tuple, object] = {}
@@ -586,6 +610,31 @@ class DomainCensus:
             got = (counts, present)
             self._memo[memo_key] = got
         return got
+
+    def set_namespaces(self, namespaces: list) -> None:
+        """Freeze the Namespace set for this solve (see __init__)."""
+        self._namespaces = list(namespaces)
+
+    def has_namespace_objects(self) -> bool:
+        return bool(self._namespaces)
+
+    def namespaces_matching(self, ns_sel_form: tuple) -> set:
+        """Names of live namespaces whose labels match the canonical
+        namespaceSelector form (empty form = all namespaces, the k8s
+        rule)."""
+        return {
+            ns.metadata.name
+            for ns in self._namespaces
+            if selector_form_matches(ns_sel_form, ns.metadata.labels)
+        }
+
+    def occupancy_namespaces(self) -> set:
+        """Every namespace the occupancy census holds scheduled pods
+        in — the conservative ANTI fallback when no Namespace objects
+        exist to resolve a namespaceSelector against (fixtures,
+        simulations): blocking against every known namespace's pods
+        can only under-promise."""
+        return self._occupancy.namespace_names()
 
     def domain_counts(self, namespace, sel_form, key) -> Dict[str, int]:
         """{topology value: matching-pod count} over ALL live nodes —
@@ -1454,6 +1503,21 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
         # scope (docs/OPERATIONS.md).
         if foreign and census is not None:
             for sign, key, sel, namespaces in foreign:
+                if len(namespaces) == 3 and namespaces[0] == "~":
+                    # namespaceSelector marker: resolve against the live
+                    # Namespace set, unioned with the explicit list (the
+                    # k8s combination rule)
+                    resolved = set(namespaces[2])
+                    resolved |= census.namespaces_matching(namespaces[1])
+                    if sign < 0 and not census.has_namespace_objects():
+                        # no Namespace objects to resolve against
+                        # (fixtures, simulations): an ANTI term blocks
+                        # conservatively against every namespace the
+                        # occupancy knows — silently unenforced would
+                        # over-promise (r3 code review). Co terms stay
+                        # strict: admitting nothing under-promises.
+                        resolved |= census.occupancy_namespaces()
+                    namespaces = sorted(resolved)
                 occupied: set = set()
                 for foreign_ns in namespaces:
                     occupied |= census.domain_counts(

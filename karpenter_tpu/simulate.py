@@ -148,6 +148,7 @@ def simulate(  # lint: allow-complexity — report assembly: one guard per optio
     # census nodes are the REAL ones (a what-if group's domains hold no
     # existing pods by construction)
     census = DomainCensus(occupancy_from_pods(all_pods), lambda: nodes)
+    census.set_namespaces(store.list("Namespace"))
     inputs, row_idx, row_weight = _encode_from_cache(
         snap, profiles, with_rows=True, census=census
     )

@@ -779,6 +779,12 @@ class ScheduledOccupancy:
         with self._lock:
             return self._generation
 
+    def namespace_names(self) -> set:
+        """Namespaces holding scheduled pods — the conservative
+        namespaceSelector fallback scope (DomainCensus)."""
+        with self._lock:
+            return set(self._spaces)
+
     @contextlib.contextmanager
     def view(self):
         """(generation, {namespace: {labels_items: {node: count}}})

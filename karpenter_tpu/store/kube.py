@@ -77,6 +77,8 @@ RESOURCES: Dict[str, Tuple[str, str, bool]] = {
     ),
     "Pod": ("api/v1", "pods", True),
     "Node": ("api/v1", "nodes", False),
+    # labels resolve namespaceSelector terms in inter-pod affinity
+    "Namespace": ("api/v1", "namespaces", False),
 }
 
 WATCHED_KINDS = tuple(RESOURCES)

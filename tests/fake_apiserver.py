@@ -25,6 +25,7 @@ PLURALS = {
     "scalablenodegroups": "ScalableNodeGroup",
     "pods": "Pod",
     "nodes": "Node",
+    "namespaces": "Namespace",
     "leases": "Lease",
 }
 

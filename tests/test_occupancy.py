@@ -1468,6 +1468,193 @@ class TestForeignAffinityOccupancy:
             (-1, ZONE_KEY, ((("app", "redis"),), ()), ("default",)),
         )
 
+    def test_namespace_selector_resolves_against_labels(self, env):
+        """A namespaceSelector term censuses every namespace whose
+        labels match — the Namespace mirror closes the last decode-only
+        slice."""
+        from karpenter_tpu.api.core import Namespace
+
+        runtime, _ = env
+        zoned(runtime, zones=("a", "b"))
+        runtime.store.create(
+            Namespace(metadata=ObjectMeta(
+                name="data", namespace="", labels={"team": "data"}))
+        )
+        runtime.store.create(
+            Namespace(metadata=ObjectMeta(
+                name="web", namespace="", labels={"team": "web"}))
+        )
+        runtime.store.create(
+            bound_pod("redis", {"app": "redis"}, "n-a", namespace="data")
+        )
+        pod = foreign_pod("app-0")
+        term = (
+            pod.spec.affinity.pod_anti_affinity
+            .required_during_scheduling_ignored_during_execution[0]
+        )
+        term.namespace_selector = LabelSelector(
+            match_labels={"team": "data"}
+        )
+        runtime.store.create(pod)
+        runtime.manager.reconcile_all()
+        # the data namespace's redis occupies zone a: blocked there
+        assert pods_per_group(runtime, ["group-a", "group-b"]) == {
+            "group-a": 0,
+            "group-b": 1,
+        }
+
+    def test_empty_namespace_selector_means_all_namespaces(self, env):
+        from karpenter_tpu.api.core import Namespace
+
+        runtime, _ = env
+        zoned(runtime, zones=("a", "b"))
+        runtime.store.create(
+            Namespace(metadata=ObjectMeta(name="anywhere", namespace=""))
+        )
+        runtime.store.create(
+            bound_pod("redis", {"app": "redis"}, "n-a",
+                      namespace="anywhere")
+        )
+        pod = foreign_pod("app-0")
+        term = (
+            pod.spec.affinity.pod_anti_affinity
+            .required_during_scheduling_ignored_during_execution[0]
+        )
+        term.namespace_selector = LabelSelector()  # {} = every namespace
+        runtime.store.create(pod)
+        runtime.manager.reconcile_all()
+        assert pods_per_group(runtime, ["group-a", "group-b"]) == {
+            "group-a": 0,
+            "group-b": 1,
+        }
+
+    def test_self_anti_with_namespace_selector_stays_one_per_domain(
+        self, env
+    ):
+        """A namespaceSelector anti term whose selector matches the
+        pod's OWN labels keeps the self 1-per-domain rule (conservative:
+        whether the own namespace matches can't be known at shape
+        build) AND blocks on matching pods in selector-matching
+        namespaces."""
+        from karpenter_tpu.api.core import Namespace
+
+        runtime, _ = env
+        zoned(runtime, zones=("a", "b", "c"))
+        runtime.store.create(
+            Namespace(metadata=ObjectMeta(
+                name="prod", namespace="", labels={"env": "prod"}))
+        )
+        runtime.store.create(
+            bound_pod("db-live", {"app": "db"}, "n-a", namespace="prod")
+        )
+        for i in range(3):
+            pod = anti_pod(f"db-{i}")
+            term = (
+                pod.spec.affinity.pod_anti_affinity
+                .required_during_scheduling_ignored_during_execution[0]
+            )
+            term.namespace_selector = LabelSelector(
+                match_labels={"env": "prod"}
+            )
+            runtime.store.create(pod)
+        runtime.manager.reconcile_all()
+        # zone a blocked by prod's replica; the three pending replicas
+        # still spread one-per-domain over b and c: one unschedulable
+        assert pods_per_group(
+            runtime, ["group-a", "group-b", "group-c"]
+        ) == {"group-a": 0, "group-b": 1, "group-c": 1}
+        assert total_unschedulable(runtime, "group-a") == 1
+
+    def test_namespace_selector_unions_with_explicit_list(self, env):
+        """The k8s combination rule: namespaces + namespaceSelector is
+        the UNION of both scopes."""
+        from karpenter_tpu.api.core import Namespace
+
+        runtime, _ = env
+        zoned(runtime, zones=("a", "b", "c"))
+        runtime.store.create(
+            Namespace(metadata=ObjectMeta(
+                name="data", namespace="", labels={"team": "data"}))
+        )
+        runtime.store.create(
+            bound_pod("redis-1", {"app": "redis"}, "n-a",
+                      namespace="data")
+        )
+        runtime.store.create(
+            bound_pod("redis-2", {"app": "redis"}, "n-b",
+                      namespace="legacy")
+        )
+        pod = foreign_pod("app-0", namespaces=("legacy",))
+        term = (
+            pod.spec.affinity.pod_anti_affinity
+            .required_during_scheduling_ignored_during_execution[0]
+        )
+        term.namespace_selector = LabelSelector(
+            match_labels={"team": "data"}
+        )
+        runtime.store.create(pod)
+        runtime.manager.reconcile_all()
+        # both scopes block: data's redis in zone a, legacy's in zone b
+        assert pods_per_group(
+            runtime, ["group-a", "group-b", "group-c"]
+        ) == {"group-a": 0, "group-b": 0, "group-c": 1}
+
+    def test_co_with_namespace_selector_requires_matching_ns(self, env):
+        from karpenter_tpu.api.core import Namespace
+
+        runtime, _ = env
+        zoned(runtime, zones=("a", "b"))
+        runtime.store.create(
+            Namespace(metadata=ObjectMeta(
+                name="data", namespace="", labels={"team": "data"}))
+        )
+        runtime.store.create(
+            bound_pod("redis", {"app": "redis"}, "n-b", namespace="data")
+        )
+        pod = foreign_pod("app-0", sign="co")
+        term = (
+            pod.spec.affinity.pod_affinity
+            .required_during_scheduling_ignored_during_execution[0]
+        )
+        term.namespace_selector = LabelSelector(
+            match_labels={"team": "data"}
+        )
+        runtime.store.create(pod)
+        runtime.manager.reconcile_all()
+        # must join data's redis zone
+        assert pods_per_group(runtime, ["group-a", "group-b"]) == {
+            "group-a": 0,
+            "group-b": 1,
+        }
+
+    def test_anti_selector_falls_back_without_namespace_objects(
+        self, env
+    ):
+        """Regression (r3 code review): with NO Namespace objects to
+        resolve against (fixtures, simulations), an anti
+        namespaceSelector must block conservatively against every
+        namespace the occupancy knows — silent non-enforcement would
+        over-promise."""
+        runtime, _ = env
+        zoned(runtime, zones=("a", "b"))
+        runtime.store.create(
+            bound_pod("redis", {"app": "redis"}, "n-a", namespace="data")
+        )
+        pod = foreign_pod("app-0")
+        term = (
+            pod.spec.affinity.pod_anti_affinity
+            .required_during_scheduling_ignored_during_execution[0]
+        )
+        term.namespace_selector = LabelSelector(
+            match_labels={"team": "data"}
+        )
+        runtime.store.create(pod)
+        runtime.manager.reconcile_all()
+        assert pods_per_group(runtime, ["group-a", "group-b"]) == {
+            "group-a": 0,
+            "group-b": 1,
+        }
+
     def test_foreign_hostname_co_is_unschedulable(self, env):
         """'Must share a NODE with an existing pod' can never be met by
         a scale-up's fresh nodes."""
