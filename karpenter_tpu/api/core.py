@@ -486,13 +486,14 @@ def _foreign_terms(affinity, labels, namespace, anti_terms, co_terms):  # lint: 
     Interactions with the matching workload's PENDING pods (placed in
     the same solve) still need pairwise pod state and remain out of
     scope (docs/OPERATIONS.md). Returns sorted (sign, topologyKey,
-    selectorForm, namespaces) tuples, sign -1 anti / +1 co. The
-    namespaces component is either a plain tuple of names (the term's
-    explicit list, or () resolved to the pod's own namespace), or the
-    marker ("~", nsSelectorForm, explicitNames): namespaceSelector
-    terms resolve to the matching namespaces at ENCODE time against
-    the live Namespace set, unioned with any explicit list (the k8s
-    combination rule). Skipped (never constrained): hostname ANTI
+    selectorForm, scope) tuples, sign -1 anti / +1 co. The scope is
+    TAGGED: ("names", namesTuple) — the term's explicit list, or the
+    pod's own namespace when empty — or ("selector", nsSelectorForm,
+    explicitNames): namespaceSelector terms resolve to the matching
+    namespaces at ENCODE time against the live Namespace set, unioned
+    with any explicit list (the k8s combination rule). The tag makes
+    the two shapes self-describing — discrimination must never lean on
+    namespace-name syntax. Skipped (never constrained): hostname ANTI
     terms — a scale-up's fresh nodes host nothing, so they can never
     be blocked. Hostname CO terms are kept: a fresh node can never
     satisfy "must run beside an existing pod on one node", so the row
@@ -514,7 +515,7 @@ def _foreign_terms(affinity, labels, namespace, anti_terms, co_terms):  # lint: 
             listed = tuple(sorted(t.namespaces or ()))
             if t.namespace_selector is not None:
                 scope = (
-                    "~",
+                    "selector",
                     _selector_form(t.namespace_selector),
                     listed,
                 )
@@ -541,7 +542,8 @@ def _foreign_terms(affinity, labels, namespace, anti_terms, co_terms):  # lint: 
                     elif extra:
                         out.add(
                             (sign, t.topology_key,
-                             _selector_form(t.label_selector), extra)
+                             _selector_form(t.label_selector),
+                             ("names", extra))
                         )
                 continue
             out.add(
@@ -553,7 +555,7 @@ def _foreign_terms(affinity, labels, namespace, anti_terms, co_terms):  # lint: 
                     # namespaces list means the POD'S OWN namespace
                     scope
                     if scope is not None
-                    else (listed or (namespace,)),
+                    else ("names", listed or (namespace,)),
                 )
             )
     return tuple(sorted(out))

@@ -615,8 +615,8 @@ class DomainCensus:
         """Freeze the Namespace set for this solve (see __init__)."""
         self._namespaces = list(namespaces)
 
-    def has_namespace_objects(self) -> bool:
-        return bool(self._namespaces)
+    def known_namespace_names(self) -> set:
+        return {ns.metadata.name for ns in self._namespaces}
 
     def namespaces_matching(self, ns_sel_form: tuple) -> set:
         """Names of live namespaces whose labels match the canonical
@@ -1502,21 +1502,30 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
         # Interactions with that workload's PENDING pods remain out of
         # scope (docs/OPERATIONS.md).
         if foreign and census is not None:
-            for sign, key, sel, namespaces in foreign:
-                if len(namespaces) == 3 and namespaces[0] == "~":
-                    # namespaceSelector marker: resolve against the live
-                    # Namespace set, unioned with the explicit list (the
-                    # k8s combination rule)
-                    resolved = set(namespaces[2])
-                    resolved |= census.namespaces_matching(namespaces[1])
-                    if sign < 0 and not census.has_namespace_objects():
-                        # no Namespace objects to resolve against
-                        # (fixtures, simulations): an ANTI term blocks
-                        # conservatively against every namespace the
-                        # occupancy knows — silently unenforced would
-                        # over-promise (r3 code review). Co terms stay
-                        # strict: admitting nothing under-promises.
-                        resolved |= census.occupancy_namespaces()
+            for sign, key, sel, scope in foreign:
+                if scope[0] == "names":
+                    namespaces = scope[1]
+                else:
+                    # ("selector", form, explicit): resolve against the
+                    # live Namespace set, unioned with the explicit
+                    # list (the k8s combination rule)
+                    _tag, ns_form, explicit = scope
+                    resolved = set(explicit)
+                    resolved |= census.namespaces_matching(ns_form)
+                    if sign < 0:
+                        # an ANTI term must also block against every
+                        # occupancy namespace that has NO Namespace
+                        # object to judge (fixtures, simulations, a
+                        # partially-mirrored relist): silently treating
+                        # an unjudgeable namespace as non-matching
+                        # would over-promise (r3 code review). Co terms
+                        # stay strict: admitting nothing under-promises.
+                        known = census.known_namespace_names()
+                        resolved |= {
+                            ns
+                            for ns in census.occupancy_namespaces()
+                            if ns not in known
+                        }
                     namespaces = sorted(resolved)
                 occupied: set = set()
                 for foreign_ns in namespaces:

@@ -85,6 +85,11 @@ class Store:
         self._watchers: List[Tuple[Optional[str], Callable]] = []
         # spec.nodeName index for Pods
         self._pods_by_node: Dict[str, set] = {}
+        # kind -> insertion-ordered keys: list(kind)/keys(kind) must
+        # never scan OTHER kinds (listing zero Namespaces used to walk
+        # all 100k pods); dict-as-ordered-set keeps the iteration order
+        # callers observed before the index existed
+        self._by_kind: Dict[str, Dict[Tuple[str, str, str], None]] = {}
 
     # -- watch ------------------------------------------------------------
 
@@ -107,16 +112,37 @@ class Store:
     # -- index maintenance ------------------------------------------------
 
     def _index_add(self, obj) -> None:
+        self._by_kind.setdefault(_kind_of(obj), {})[_key(obj)] = None
         if _kind_of(obj) == "Pod" and obj.spec.node_name:
             self._pods_by_node.setdefault(obj.spec.node_name, set()).add(_key(obj))
 
     def _index_remove(self, obj) -> None:
+        kind_keys = self._by_kind.get(_kind_of(obj))
+        if kind_keys is not None:
+            kind_keys.pop(_key(obj), None)
+            if not kind_keys:
+                del self._by_kind[_kind_of(obj)]
+        self._node_index_remove(obj)
+
+    def _node_index_remove(self, obj) -> None:
         if _kind_of(obj) == "Pod" and obj.spec.node_name:
             keys = self._pods_by_node.get(obj.spec.node_name)
             if keys is not None:
                 keys.discard(_key(obj))
                 if not keys:
                     del self._pods_by_node[obj.spec.node_name]
+
+    def _index_replace(self, old, new) -> None:
+        """Same-key replacement (update / watch echo): the kind index
+        keeps the key's POSITION — remove-then-add would move every
+        modified object to the end, churning list() order (and with it
+        the oracle encoder's row order) on every status write. Only the
+        nodeName index re-files (the binding may have changed)."""
+        self._node_index_remove(old)
+        if _kind_of(new) == "Pod" and new.spec.node_name:
+            self._pods_by_node.setdefault(
+                new.spec.node_name, set()
+            ).add(_key(new))
 
     # -- CRUD -------------------------------------------------------------
 
@@ -177,14 +203,13 @@ class Store:
                     f"{obj.metadata.resource_version} != "
                     f"{stored.metadata.resource_version}"
                 )
-            self._index_remove(stored)
             self._rv += 1
             obj.metadata.resource_version = self._rv
             obj.metadata.uid = stored.metadata.uid
             obj.metadata.creation_timestamp = stored.metadata.creation_timestamp
             new = fast_clone(obj)
             self._objects[key] = new
-            self._index_add(new)
+            self._index_replace(stored, new)
             self._notify(MODIFIED, new)
             return obj
 
@@ -223,7 +248,7 @@ class Store:
     def keys(self, kind: str) -> list:
         """(kind, namespace, name) keys of a kind, without copying objects."""
         with self._lock:
-            return [k for k in self._objects if k[0] == kind]
+            return list(self._by_kind.get(kind, ()))
 
     def list(
         self,
@@ -233,10 +258,9 @@ class Store:
     ) -> list:
         with self._lock:
             out = []
-            for (k, ns, _), obj in self._objects.items():
-                if k != kind:
-                    continue
-                if namespace is not None and ns != namespace:
+            for key in self._by_kind.get(kind, ()):
+                obj = self._objects[key]
+                if namespace is not None and key[1] != namespace:
                     continue
                 if label_selector is not None and not all(
                     obj.metadata.labels.get(lk) == lv
@@ -276,11 +300,12 @@ class Store:
                 == obj.metadata.resource_version
             ):
                 return  # relist echo of an unchanged object: no watcher spam
-            if stored is not None:
-                self._index_remove(stored)
             obj = fast_clone(obj)
             self._objects[key] = obj
-            self._index_add(obj)
+            if stored is not None:
+                self._index_replace(stored, obj)
+            else:
+                self._index_add(obj)
             if isinstance(obj.metadata.resource_version, int):
                 # externally-sourced rvs may be opaque non-numeric strings
                 # (k8s API conventions); only numeric ones can advance the

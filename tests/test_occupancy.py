@@ -1465,7 +1465,8 @@ class TestForeignAffinityOccupancy:
             pod.spec.affinity, pod.metadata.labels, "default"
         )
         assert shape[4] == (
-            (-1, ZONE_KEY, ((("app", "redis"),), ()), ("default",)),
+            (-1, ZONE_KEY, ((("app", "redis"),), ()),
+             ("names", ("default",))),
         )
 
     def test_namespace_selector_resolves_against_labels(self, env):
