@@ -863,7 +863,7 @@ def _entry_caps(
 
 
 def _spread_state(namespace, entries, values, census, row_filter,  # lint: allow-complexity — one guard per budget regime (split/static/other-key/dead), the whole shape contract in one place
-                  label_dicts, eligible):
+                  label_dicts, eligible, extra_dead=None):
     """IMMUTABLE per-(shape, node-filter) cap VIEW — what the
     scheduler's skew checks admit for a row carrying this filter:
 
@@ -894,7 +894,11 @@ def _spread_state(namespace, entries, values, census, row_filter,  # lint: allow
     d = len(values)
     static = np.full(d, _UNBOUNDED, np.int64)
     budget = np.full(d, _UNBOUNDED, np.int64)
-    dead = None
+    # `extra_dead` seeds the dead mask with the anti stage's
+    # row-independent exclusions (co pins, foreign terms): a domain
+    # those will forbid must freeze the minimum HERE, before the split
+    # balances weight into it (found by the soundness fuzz)
+    dead = extra_dead.copy() if extra_dead is not None else None
     others = []
     # NON-SPLIT entries first: their zero-capacity domains (dead
     # groups) can leave a split domain with no live group at all, and
@@ -982,6 +986,121 @@ def _spread_state(namespace, entries, values, census, row_filter,  # lint: allow
         "dead": dead,
         "others": others,
     }
+
+
+def _anti_base_exclusion(shape, census, label_dicts, n_groups):  # lint: allow-complexity — one block per k8s exclusion rule (key presence, co pinning, foreign anti/co, namespace scoping)
+    """(excluded mask, anti blocked values, co allowed values) — the
+    ROW-INDEPENDENT group exclusions a pod_affinity_shape imposes:
+    key-presence, required self co-location pinning to occupied
+    domains, and FOREIGN required terms enforced against SCHEDULED
+    state (anti forbids occupied domains; co requires one, with no
+    first-replica bootstrap — a foreign selector the incoming pod
+    doesn't match gets no such grace, the scheduler's rule; foreign
+    hostname co can never be met by a fresh node). namespaceSelector
+    scopes resolve against the frozen Namespace set, and an anti term
+    also blocks against every occupancy namespace with NO Namespace
+    object to judge. Shared by the anti expansion's plan AND the
+    spread caps' frozen-domain feedback — the one implementation of
+    the exclusion rules."""
+    _hostname_excl, anti_keys, co_keys, ident, foreign = shape
+    need_keys = [*anti_keys, *co_keys]
+    blocked: Dict[str, set] = {}
+    co_allowed = None
+    if census is not None and ident:
+        ident_ns, sel_forms = ident
+        if anti_keys:
+            blocked = census.anti_domains(ident_ns, sel_forms, anti_keys)
+        if co_keys:
+            co_allowed = census.co_domains(ident_ns, sel_forms, co_keys)
+    excluded = np.zeros(n_groups, bool)
+    for t, labels in enumerate(label_dicts):
+        if any(key not in labels for key in need_keys):
+            excluded[t] = True
+        elif co_allowed is not None and any(
+            labels[key] not in co_allowed[key] for key in co_keys
+        ):
+            # the workload already runs somewhere: required
+            # self-affinity pins new replicas to domains that hold a
+            # matching pod — groups elsewhere are excluded
+            excluded[t] = True
+    if foreign and census is not None:
+        for sign, key, sel, scope in foreign:
+            if scope[0] == "names":
+                namespaces = scope[1]
+            else:
+                # ("selector", form, explicit): resolve against the
+                # live Namespace set, unioned with the explicit list
+                # (the k8s combination rule)
+                _tag, ns_form, explicit = scope
+                resolved = set(explicit)
+                resolved |= census.namespaces_matching(ns_form)
+                if sign < 0:
+                    known = census.known_namespace_names()
+                    resolved |= {
+                        ns
+                        for ns in census.occupancy_namespaces()
+                        if ns not in known
+                    }
+                namespaces = sorted(resolved)
+            occupied: set = set()
+            for foreign_ns in namespaces:
+                occupied |= census.domain_counts(
+                    foreign_ns, sel, key
+                ).keys()
+            if sign < 0:
+                for t, labels in enumerate(label_dicts):
+                    if labels.get(key) in occupied:
+                        excluded[t] = True
+            elif key == HOSTNAME_TOPOLOGY_KEY:
+                excluded[:] = True
+            else:
+                for t, labels in enumerate(label_dicts):
+                    value = labels.get(key)
+                    if value is None or value not in occupied:
+                        excluded[t] = True
+    return excluded, blocked, co_allowed
+
+
+def _anti_frozen_mask(shape, census, label_dicts, n_groups):
+    """The anti-stage exclusions a SPREAD split must anticipate: base
+    exclusion plus the co-only single-bucket pin (a spread split
+    produces several rows, which triggers the multi-row pin in
+    _expand_anti_rows). A spread domain whose groups are all excluded
+    here can never receive its chunk — without feeding that back into
+    the caps, the split balances over domains the anti stage then
+    forbids, over-promising the survivors (found by the soundness
+    fuzz). Anticipating the pin when the split ends up single-row only
+    tightens: conservative."""
+    _hostname_excl, anti_keys, co_keys, _ident, _foreign = shape
+    excluded, _blocked, _co_allowed = _anti_base_exclusion(
+        shape, census, label_dicts, n_groups
+    )
+    if co_keys and not anti_keys:
+        excluded = _co_pin(excluded, label_dicts, co_keys, n_groups)
+    return excluded
+
+
+def _co_pin(excluded, label_dicts, co_keys, n_groups):
+    """Pin a co-only multi-row workload to ONE deterministic co bucket
+    (lexicographically first among non-excluded groups) — THE single
+    implementation: the anti expansion and the spread caps' frozen
+    feedback must pick the identical bucket, or the split balances
+    weight into a domain the pin then forbids (the over-promise class
+    the soundness fuzz caught)."""
+    co_vecs: Dict[tuple, list] = {}
+    for t, labels in enumerate(label_dicts):
+        if not excluded[t]:
+            co_vecs.setdefault(
+                tuple(labels[k] for k in co_keys), []
+            ).append(t)
+    if not co_vecs:
+        return excluded
+    chosen = set(co_vecs[min(co_vecs)])
+    excluded = excluded.copy()
+    for t in range(n_groups):
+        if t not in chosen:
+            excluded[t] = True
+    return excluded
 
 
 def _spread_zero_cap_groups(shape, row_filter, label_dicts, census,
@@ -1204,6 +1323,7 @@ def _expand_spread_rows(  # lint: allow-complexity — per-domain chunking: each
     # one-row-per-workload tick).
     view_memo: Dict[tuple, dict] = {}
     ledgers: Dict[int, dict] = {}
+    anti_dead_memo: Dict[int, np.ndarray] = {}
     sid_rows = collections.Counter(
         int(s) for s in live_ids if s and plan.get(int(s)) is not None
     )
@@ -1245,12 +1365,41 @@ def _expand_spread_rows(  # lint: allow-complexity — per-domain chunking: each
             if census is not None
             else (None, None)
         )
-        view_key = (int(sid), row_filter[0])
+        # the anti stage's row-independent exclusions (co pins, foreign
+        # terms) feed the caps as dead groups, so a domain the anti
+        # masks will forbid freezes the minimum instead of absorbing a
+        # balanced chunk (found by the soundness fuzz); domain-capped
+        # anti rows never reach here (their split is the anti rule's)
+        anti_sid = (
+            int(snap.anti_id[row_idx[i]])
+            if snap.anti_id is not None and snap.anti_shapes is not None
+            else 0
+        )
+        anti_dead = None
+        if anti_sid and snap.anti_shapes[anti_sid]:
+            if anti_sid in anti_dead_memo:
+                anti_dead = anti_dead_memo[anti_sid]
+            else:
+                anti_dead = _anti_frozen_mask(
+                    snap.anti_shapes[anti_sid], census, label_dicts,
+                    n_groups,
+                )
+                if not anti_dead.any():
+                    # a shape imposing no exclusions must not fragment
+                    # the view memo or tax every chunk with a
+                    # copy-and-OR of an all-False mask
+                    anti_dead = None
+                anti_dead_memo[anti_sid] = anti_dead
+        view_key = (
+            int(sid),
+            row_filter[0],
+            anti_sid if anti_dead is not None else 0,
+        )
         view = view_memo.get(view_key)
         if view is None:
             view = _spread_state(
                 namespace, entries, values, census, row_filter,
-                label_dicts, eligible,
+                label_dicts, eligible, extra_dead=anti_dead,
             )
             view_memo[view_key] = view
         ledger = ledgers.get(int(sid))
@@ -1467,85 +1616,9 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
         if not shape:
             continue
         hostname_excl, anti_keys, co_keys, ident, foreign = shape
-        need_keys = [*anti_keys, *co_keys]
-        # existing-pod occupancy (DomainCensus): domains already holding
-        # a replica are spent for anti-affinity; domains holding the
-        # workload's pods are the ONLY ones required co-affinity admits
-        blocked: Dict[str, set] = {}
-        co_allowed = None
-        if census is not None and ident:
-            ident_ns, sel_forms = ident
-            if anti_keys:
-                blocked = census.anti_domains(
-                    ident_ns, sel_forms, anti_keys
-                )
-            if co_keys:
-                co_allowed = census.co_domains(
-                    ident_ns, sel_forms, co_keys
-                )
-        excluded = np.zeros(n_groups, bool)
-        for t, labels in enumerate(label_dicts):
-            if any(key not in labels for key in need_keys):
-                excluded[t] = True
-            elif co_allowed is not None and any(
-                labels[key] not in co_allowed[key] for key in co_keys
-            ):
-                # the workload already runs somewhere: required
-                # self-affinity pins new replicas to domains that hold a
-                # matching pod — groups elsewhere are excluded
-                excluded[t] = True
-        # FOREIGN required terms (selectors over OTHER workloads' pods)
-        # enforced against SCHEDULED state: anti forbids the domains
-        # existing matching pods occupy; co requires one — with no
-        # first-replica bootstrap (a foreign selector the incoming pod
-        # doesn't match gets no such grace, the scheduler's rule).
-        # Interactions with that workload's PENDING pods remain out of
-        # scope (docs/OPERATIONS.md).
-        if foreign and census is not None:
-            for sign, key, sel, scope in foreign:
-                if scope[0] == "names":
-                    namespaces = scope[1]
-                else:
-                    # ("selector", form, explicit): resolve against the
-                    # live Namespace set, unioned with the explicit
-                    # list (the k8s combination rule)
-                    _tag, ns_form, explicit = scope
-                    resolved = set(explicit)
-                    resolved |= census.namespaces_matching(ns_form)
-                    if sign < 0:
-                        # an ANTI term must also block against every
-                        # occupancy namespace that has NO Namespace
-                        # object to judge (fixtures, simulations, a
-                        # partially-mirrored relist): silently treating
-                        # an unjudgeable namespace as non-matching
-                        # would over-promise (r3 code review). Co terms
-                        # stay strict: admitting nothing under-promises.
-                        known = census.known_namespace_names()
-                        resolved |= {
-                            ns
-                            for ns in census.occupancy_namespaces()
-                            if ns not in known
-                        }
-                    namespaces = sorted(resolved)
-                occupied: set = set()
-                for foreign_ns in namespaces:
-                    occupied |= census.domain_counts(
-                        foreign_ns, sel, key
-                    ).keys()
-                if sign < 0:
-                    for t, labels in enumerate(label_dicts):
-                        if labels.get(key) in occupied:
-                            excluded[t] = True
-                elif key == HOSTNAME_TOPOLOGY_KEY:
-                    # "must share a NODE with an existing pod": a
-                    # scale-up's fresh nodes never can — honestly
-                    # unschedulable
-                    excluded[:] = True
-                else:
-                    for t, labels in enumerate(label_dicts):
-                        value = labels.get(key)
-                        if value is None or value not in occupied:
-                            excluded[t] = True
+        excluded, blocked, co_allowed = _anti_base_exclusion(
+            shape, census, label_dicts, n_groups
+        )
         domains = None
         if anti_keys:
             # Combined-value accounting so EVERY key's cap holds (a
@@ -1593,20 +1666,10 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
             # co-location-only workload split across request-distinct
             # rows (mid-VPA): whole-row-to-one-group no longer pins ONE
             # domain, so pin all the workload's rows to a single
-            # deterministic co bucket (lexicographically first among
-            # eligible); single-row workloads keep full group freedom
-            co_vecs: Dict[tuple, list] = {}
-            for t, labels in enumerate(label_dicts):
-                if not excluded[t]:
-                    co_vecs.setdefault(
-                        tuple(labels[k] for k in co_keys), []
-                    ).append(t)
-            if co_vecs:
-                chosen = set(co_vecs[min(co_vecs)])
-                excluded = excluded.copy()
-                for t in range(n_groups):
-                    if t not in chosen:
-                        excluded[t] = True
+            # deterministic co bucket (_co_pin — the same choice the
+            # spread caps anticipated); single-row workloads keep full
+            # group freedom
+            excluded = _co_pin(excluded, label_dicts, co_keys, n_groups)
         plan[int(s)] = (domains, excluded, bool(hostname_excl))
 
     def row_spread_dead(i):

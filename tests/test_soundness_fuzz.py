@@ -1,0 +1,390 @@
+"""Randomized soundness fuzz: the pending-pods signal must NEVER
+promise a placement the kube-scheduler refuses.
+
+Random fleets (zones x racks, bound pods, workloads mixing hard spread,
+self/foreign (anti-)affinity, namespaceSelector scopes) are solved
+through the real encode+solve (the simulate() surface, which shares the
+production path), and every promised placement is checked against
+SCALAR final-state rules:
+
+- hard spread (selfMatch): in the final state (existing + promised),
+  no eligible domain exceeds the global minimum over filter-passing
+  domains by more than maxSkew — any legal placement sequence ends
+  within that bound, so violating it proves an impossible promise;
+- self anti-affinity: at most one matching pod (existing + promised)
+  per domain of every constrained key;
+- self co-affinity: a promised pod's domain holds an existing matching
+  pod, or no matching pod exists anywhere (the bootstrap) and ALL the
+  workload's promised pods share one domain;
+- foreign anti: the promised pod's domain holds no existing pod
+  matching the term's selector in its namespace scope;
+- foreign co: the domain holds one (no bootstrap);
+- accounting: promised + unschedulable == pending.
+
+Under-promising (extra unschedulable) is allowed — the documented
+conservative direction; over-promising fails the fuzz.
+"""
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api.core import (
+    Affinity,
+    Container,
+    LabelSelector,
+    Namespace,
+    Node,
+    NodeCondition,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    PodSpec,
+    PodStatus,
+    TopologySpreadConstraint,
+    resource_list,
+)
+from karpenter_tpu.api.metricsproducer import (
+    MetricsProducer,
+    MetricsProducerSpec,
+    PendingCapacitySpec,
+)
+from karpenter_tpu.simulate import simulate
+from karpenter_tpu.store.store import Store
+
+ZONE = "topology.kubernetes.io/zone"
+RACK = "x-example.com/rack"
+APPS = ("red", "blue", "green")
+
+
+def build_fleet(rng):
+    """(store, groups: {name: labels}) — a random constrained fleet."""
+    store = Store()
+    n_zones = int(rng.integers(2, 4))
+    n_groups = int(rng.integers(2, 5))
+    groups = {}
+    for g in range(n_groups):
+        labels = {
+            "group": f"g{g}",
+            ZONE: f"z{int(rng.integers(0, n_zones))}",
+            RACK: f"r{int(rng.integers(0, 2))}",
+        }
+        groups[f"group-{g}"] = labels
+        store.create(
+            Node(
+                metadata=ObjectMeta(name=f"n{g}", labels=dict(labels)),
+                spec=NodeSpec(),
+                status=NodeStatus(
+                    allocatable=resource_list(
+                        cpu="64", memory="64Gi", pods="110"
+                    ),
+                    conditions=[NodeCondition("Ready", "True")],
+                ),
+            )
+        )
+        store.create(
+            MetricsProducer(
+                metadata=ObjectMeta(name=f"group-{g}"),
+                spec=MetricsProducerSpec(
+                    pending_capacity=PendingCapacitySpec(
+                        node_selector={"group": f"g{g}"}
+                    )
+                ),
+            )
+        )
+    # sometimes an unmanaged node (outside-minimum / foreign domains)
+    if rng.random() < 0.5:
+        store.create(
+            Node(
+                metadata=ObjectMeta(
+                    name="unmanaged",
+                    labels={ZONE: f"z{int(rng.integers(0, n_zones))}"},
+                ),
+                spec=NodeSpec(),
+                status=NodeStatus(
+                    conditions=[NodeCondition("Ready", "True")]
+                ),
+            )
+        )
+    # namespaces (sometimes absent: the fallback path)
+    if rng.random() < 0.7:
+        for team in ("a", "b"):
+            store.create(
+                Namespace(
+                    metadata=ObjectMeta(
+                        name=f"team-{team}",
+                        namespace="",
+                        labels={"team": team},
+                    )
+                )
+            )
+    # bound pods: random apps on random group nodes, random namespaces
+    for i in range(int(rng.integers(0, 12))):
+        app = APPS[int(rng.integers(0, len(APPS)))]
+        store.create(
+            Pod(
+                metadata=ObjectMeta(
+                    name=f"bound-{i}",
+                    namespace=rng.choice(
+                        ["default", "team-a", "team-b"]
+                    ),
+                    labels={"app": app},
+                ),
+                spec=PodSpec(
+                    node_name=f"n{int(rng.integers(0, n_groups))}",
+                    containers=[
+                        Container(requests=resource_list(cpu="1"))
+                    ],
+                ),
+                status=PodStatus(phase="Running"),
+            )
+        )
+    return store, groups
+
+
+def random_workload(rng, widx):
+    """(pods, spec dict describing the constraints for the validator)."""
+    app = f"w{widx}"
+    count = int(rng.integers(1, 6))
+    spec = {
+        "app": app,
+        "spread": None,
+        "self_anti": False,
+        "self_co": False,
+        "foreign": [],
+    }
+    constraints = []
+    anti_terms = []
+    co_terms = []
+    if rng.random() < 0.6:
+        skew = int(rng.integers(1, 3))
+        spec["spread"] = skew
+        constraints.append(
+            TopologySpreadConstraint(
+                max_skew=skew,
+                topology_key=ZONE,
+                when_unsatisfiable="DoNotSchedule",
+                label_selector={"matchLabels": {"app": app}},
+            )
+        )
+    if rng.random() < 0.4:
+        spec["self_anti"] = True
+        anti_terms.append(
+            PodAffinityTerm(
+                label_selector=LabelSelector(match_labels={"app": app}),
+                topology_key=ZONE,
+            )
+        )
+    elif rng.random() < 0.3:
+        spec["self_co"] = True
+        co_terms.append(
+            PodAffinityTerm(
+                label_selector=LabelSelector(match_labels={"app": app}),
+                topology_key=ZONE,
+            )
+        )
+    if rng.random() < 0.5:
+        target = APPS[int(rng.integers(0, len(APPS)))]
+        sign = "anti" if rng.random() < 0.6 else "co"
+        term = PodAffinityTerm(
+            label_selector=LabelSelector(match_labels={"app": target}),
+            topology_key=ZONE,
+        )
+        scope = ["default"]
+        if rng.random() < 0.4:
+            term.namespace_selector = LabelSelector(
+                match_labels={"team": "a"}
+            )
+            scope = ("~selector", "a")
+        spec["foreign"].append((sign, target, scope))
+        (anti_terms if sign == "anti" else co_terms).append(term)
+    affinity = None
+    if anti_terms or co_terms:
+        affinity = Affinity(
+            pod_anti_affinity=(
+                PodAntiAffinity(
+                    required_during_scheduling_ignored_during_execution=anti_terms
+                )
+                if anti_terms
+                else None
+            ),
+            pod_affinity=(
+                PodAffinity(
+                    required_during_scheduling_ignored_during_execution=co_terms
+                )
+                if co_terms
+                else None
+            ),
+        )
+    pods = []
+    for i in range(count):
+        pods.append(
+            Pod(
+                metadata=ObjectMeta(
+                    name=f"{app}-{i}", labels={"app": app}
+                ),
+                spec=PodSpec(
+                    node_name="",
+                    containers=[
+                        Container(
+                            requests=resource_list(
+                                cpu="1", memory="1Gi"
+                            )
+                        )
+                    ],
+                    affinity=affinity,
+                    topology_spread_constraints=constraints,
+                ),
+            )
+        )
+    return pods, spec
+
+
+def bound_index(store):
+    """{(namespace, app): [zone values]} of bound non-terminal pods."""
+    zones_by_node = {
+        n.metadata.name: n.metadata.labels.get(ZONE)
+        for n in store.list("Node")
+    }
+    out = {}
+    for pod in store.list("Pod"):
+        if not pod.spec.node_name or pod.status.phase in (
+            "Succeeded",
+            "Failed",
+        ):
+            continue
+        zone = zones_by_node.get(pod.spec.node_name)
+        if zone is None:
+            continue
+        key = (
+            pod.metadata.namespace,
+            pod.metadata.labels.get("app"),
+        )
+        out.setdefault(key, []).append(zone)
+    return out
+
+
+def scopes_zones(store, bound, target, scope):
+    """Zones occupied by pods of `target` app within a namespace scope."""
+    if isinstance(scope, tuple) and scope[0] == "~selector":
+        team = scope[1]
+        names = {
+            ns.metadata.name
+            for ns in store.list("Namespace")
+            if ns.metadata.labels.get("team") == team
+        }
+        if not store.list("Namespace"):
+            names = set()
+        zones = set()
+        for (ns, app), zs in bound.items():
+            if app == target and ns in names:
+                zones.update(zs)
+        return zones, bool(names) or bool(store.list("Namespace"))
+    zones = set()
+    for ns in scope:
+        zones.update(
+            z
+            for (n, app), zs in bound.items()
+            if n == ns and app == target
+            for z in zs
+        )
+    return zones, True
+
+
+def validate(store, groups, workloads, report, rng_label):  # lint: allow-complexity — one block per scheduler rule, the whole scalar oracle in one place
+    """Assert every promised placement admissible; returns promised count."""
+    bound = bound_index(store)
+    group_zone = {name: labels.get(ZONE) for name, labels in groups.items()}
+    # per-workload promised zone multiset, from simulate's per-row detail
+    promised = {}
+    for row in report["rows"]:
+        if row["assigned"] is None:
+            continue
+        pod_name = row["pod"].split("/", 1)[1]
+        app = pod_name.rsplit("-", 1)[0]
+        gname = row["assigned"].split("/", 1)[1]
+        promised.setdefault(app, []).extend(
+            [group_zone[gname]] * row["pods"]
+        )
+    # zones of ALL live nodes (incl. unmanaged): the spread filter set
+    # for pods with no nodeSelector
+    present_zones = {
+        n.metadata.labels.get(ZONE)
+        for n in store.list("Node")
+        if ZONE in n.metadata.labels
+    }
+    for spec in workloads:
+        app = spec["app"]
+        placed = promised.get(app, [])
+        if spec["spread"] is not None and placed:
+            skew = spec["spread"]
+            final = {z: 0 for z in present_zones}
+            for z in bound.get(("default", app), []):
+                if z in final:
+                    final[z] += 1
+            for z in placed:
+                final[z] += 1
+            floor = min(final.values())
+            worst = max(final.values())
+            assert worst - floor <= skew, (
+                f"[{rng_label}] {app}: spread skew {worst - floor} > "
+                f"{skew}; final={final}, placed={placed}"
+            )
+        if spec["self_anti"] and placed:
+            for zone in set(placed):
+                total = placed.count(zone) + bound.get(
+                    ("default", app), []
+                ).count(zone)
+                assert total <= 1, (
+                    f"[{rng_label}] {app}: {total} replicas in {zone} "
+                    f"violate self anti-affinity"
+                )
+        if spec["self_co"] and placed:
+            existing = set(bound.get(("default", app), []))
+            if existing:
+                assert set(placed) <= existing, (
+                    f"[{rng_label}] {app}: co replicas outside "
+                    f"occupied zones {existing}: {placed}"
+                )
+            else:
+                assert len(set(placed)) == 1, (
+                    f"[{rng_label}] {app}: bootstrap co split across "
+                    f"{set(placed)}"
+                )
+        for sign, target, scope in spec["foreign"]:
+            occupied, judgeable = scopes_zones(
+                store, bound, target, scope
+            )
+            for zone in placed:
+                if sign == "anti" and judgeable:
+                    assert zone not in occupied, (
+                        f"[{rng_label}] {app}: placed in {zone} beside "
+                        f"{target} (foreign anti)"
+                    )
+                if sign == "co":
+                    assert zone in occupied, (
+                        f"[{rng_label}] {app}: placed in {zone} but "
+                        f"{target} occupies only {occupied}"
+                    )
+    return sum(len(v) for v in promised.values())
+
+
+class TestSoundnessFuzz:
+    @pytest.mark.parametrize("seed", range(60))
+    def test_promises_are_scheduler_admissible(self, seed):
+        rng = np.random.default_rng(seed)
+        store, groups = build_fleet(rng)
+        workloads = []
+        pending_total = 0
+        for widx in range(int(rng.integers(1, 4))):
+            pods, spec = random_workload(rng, widx)
+            workloads.append(spec)
+            pending_total += len(pods)
+            for pod in pods:
+                store.create(pod)
+        report = simulate(store)
+        promised = validate(store, groups, workloads, report, seed)
+        assert promised + report["unschedulable_pods"] == pending_total
