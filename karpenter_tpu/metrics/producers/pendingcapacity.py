@@ -874,11 +874,14 @@ def _spread_state(namespace, entries, values, census, row_filter,  # lint: allow
       drop a tighter same-key constraint, r3 code review);
     - `counts`[d]: the first entry's census counts (the fill-order
       seed);
-    - `dead`: groups excluded outright by a non-split entry's
-      zero-capacity domains;
-    - `others`: per non-split selfMatch entry with finite caps,
-      (entry index, value_groups, per-value caps) — enforced by the
-      caller's DESIGNATION pass.
+    - `dead`: groups excluded outright — extra_dead (the anti stage's
+      row-independent exclusions) plus every entry's zero-capacity
+      domains;
+    - `others`: EVERY selfMatch entry — non-split ones first, then the
+      split entry itself, so the joint partition
+      (_partition_chunks) re-validates the split after other keys
+      narrow — as (entry index, maxSkew, value->groups, per-value caps
+      with None = unbounded, per-value existing counts) 5-tuples.
 
     CONSUMPTION lives one level up, in the per-WORKLOAD shared ledgers
     (_expand_spread_rows): placements count against the workload's
@@ -927,30 +930,38 @@ def _spread_state(namespace, entries, values, census, row_filter,  # lint: allow
             for j, value in enumerate(values2):
                 if caps2[j] <= 0:
                     dead[vals2[value]] = True
-        if self_match and (caps2 < _UNBOUNDED).any():
+        if self_match:
+            # EVERY selfMatch non-split entry participates in the
+            # chunk partition — even with unbounded caps its skew
+            # binds placements to a balanced distribution across its
+            # domains (the soundness fuzz caught whole chunks piling
+            # into one rack)
             others.append(
                 (
                     entry_idx,
+                    int(skew),
                     {v: vals2[v] for v in values2},
                     {
-                        v: int(caps2[j])
+                        v: (
+                            int(caps2[j])
+                            if caps2[j] < _UNBOUNDED
+                            else None
+                        )
                         for j, v in enumerate(values2)
-                        if caps2[j] < _UNBOUNDED
                     },
+                    {v: counts_e.get(v, 0) for v in values2},
                 )
             )
+    split_groups: Dict[str, list] = {}
+    for t in eligible:
+        split_groups.setdefault(label_dicts[t][split_key], []).append(t)
     # split values every live group of which is dead: unfillable
     frozen = np.zeros(d, bool)
     if dead is not None:
-        value_groups_split: Dict[str, list] = {}
-        for t in eligible:
-            value_groups_split.setdefault(
-                label_dicts[t][split_key], []
-            ).append(t)
         for j, v in enumerate(values):
-            if all(dead[t] for t in value_groups_split[v]):
+            if all(dead[t] for t in split_groups[v]):
                 frozen[j] = True
-    for e in entries:
+    for entry_idx, e in enumerate(entries):
         if e[0] != split_key:
             continue
         _key, skew, min_domains, _sel, self_match, _honor = e
@@ -970,6 +981,29 @@ def _spread_state(namespace, entries, values, census, row_filter,  # lint: allow
             caps_e[frozen] = 0  # nothing can actually land there
         if self_match:
             budget = np.minimum(budget, caps_e)
+            # the split entry ALSO joins the joint partition (LAST, so
+            # it re-validates after other keys narrow): when another
+            # key's budget drops part of a domain's chunk, the split
+            # key's own balance must re-bind against the shrunken
+            # totals — the pre-allocation alone would leave e.g. zone
+            # [2,0,1] standing after a rack cap emptied the middle
+            # zone (found by the soundness fuzz)
+            others.append(
+                (
+                    entry_idx,
+                    int(skew),
+                    dict(split_groups),
+                    {
+                        v: (
+                            int(caps_e[j])
+                            if caps_e[j] < _UNBOUNDED
+                            else None
+                        )
+                        for j, v in enumerate(values)
+                    },
+                    {v: counts_e.get(v, 0) for v in values},
+                )
+            )
         else:
             static = np.minimum(static, caps_e)
     first_counts, _ = entry_counts(entries[0])
@@ -1103,17 +1137,24 @@ def _co_pin(excluded, label_dicts, co_keys, n_groups):
     return excluded
 
 
-def _spread_zero_cap_groups(shape, row_filter, label_dicts, census,
-                            n_groups):
-    """bool[n_groups]: groups whose domain some spread entry gives ZERO
-    remaining capacity, plus groups missing a constrained key — the
-    binding slice of a SKIPPED spread split. Used by the anti expansion:
-    its 1-per-domain hand-out supersedes the spread split, but placing
-    a replica into a spread domain with no capacity left would
-    over-promise (r3 code review)."""
+def _spread_partition_view(shape, row_filter, label_dicts, census,
+                           n_groups):
+    """Partition-form view of ALL of a spread shape's entries, for rows
+    whose SPLIT was skipped in favor of the anti rule: the anti
+    hand-out decides the anti domains, but every spread entry still
+    binds — through the same _partition_chunks water-fill the spread
+    path uses (zero-cap exclusion alone let the hand-out concentrate a
+    workload onto one rack, found by the soundness fuzz).
+
+    dead: groups missing a constrained key, non-selfMatch zero-cap
+    domains, and selfMatch currently-full domains (cap 0 — also kept
+    in the partition caps, but dead lets the hand-out skip them
+    without consuming a pick). others: every selfMatch entry as a
+    partition dimension (skew + remaining caps + existing counts)."""
     namespace, entries = shape
     dead = np.zeros(n_groups, bool)
-    for entry in entries:
+    others = []
+    for idx, entry in enumerate(entries):
         key, skew, min_domains, _sel, self_match, _honor = entry
         vals: Dict[str, list] = {}
         for t, labels in enumerate(label_dicts):
@@ -1127,8 +1168,6 @@ def _spread_zero_cap_groups(shape, row_filter, label_dicts, census,
         counts_e, present_e = _entry_census(
             census, namespace, entry, row_filter
         )
-        if not counts_e and not present_e:
-            continue
         values = sorted(vals)
         caps_e, _, _ = _entry_caps(
             skew, min_domains, self_match, values, counts_e, present_e
@@ -1136,84 +1175,151 @@ def _spread_zero_cap_groups(shape, row_filter, label_dicts, census,
         for j, value in enumerate(values):
             if caps_e[j] <= 0:
                 dead[vals[value]] = True
-    return dead
+        if self_match:
+            others.append(
+                (
+                    ("spread", idx),
+                    int(skew),
+                    {v: vals[v] for v in values},
+                    {
+                        v: (
+                            int(caps_e[j])
+                            if caps_e[j] < _UNBOUNDED
+                            else None
+                        )
+                        for j, v in enumerate(values)
+                    },
+                    {v: counts_e.get(v, 0) for v in values},
+                )
+            )
+    return {
+        "others": others,
+        "dead": dead if dead.any() else None,
+    }
 
 
-def _designate_chunks(additions, masks, view, others_placed, n_groups):  # lint: allow-complexity — the joint designation walk: choose, narrow, min-take, charge, in one auditable pass
-    """For every non-split selfMatch entry with finite domain budgets:
-    pin each split-domain chunk to ONE of that key's domains (greedy:
-    most remaining budget, deterministic tie-break), shrink the chunk
-    to what EVERY designated domain still admits, then charge each
-    ledger by that FINAL take — charging at choice time would leak
-    budget a later entry's shrink never uses, starving later rows of
-    the shared state (r3 code review). Remaining = this row's cap VIEW
-    minus the WORKLOAD-shared `others_placed` ledger (keyed by entry
-    index + value), so rows with different node filters still spend one
-    budget. Dead groups are excluded from candidacy up front — a dead
-    value with a fat ledger must not outbid a live one (r3 code
-    review). Sound by construction: every promised replica lands in
-    domains with budget reserved for it. Conservative: a placement
-    spanning several of a key's domains within one split domain is not
-    attempted. Returns per-rank extra masks (None = no restriction);
-    mutates `additions` and `others_placed`."""
-    extra = [None] * len(additions)
-    if not view["others"]:
-        return extra
+def _partition_chunks(additions, masks, view, others_placed, n_groups,  # lint: allow-complexity — the wave loop: reach, floor, fill, charge, refund, repeat to fixpoint
+                      seed):
+    """Partition each chunk across every partition entry's domains by
+    the SAME water-fill the split key uses: each entry's skew binds
+    placements to a balanced distribution over its domains, and finite
+    caps (occupancy, frozen minima) bound it absolutely. The relative
+    bound holds against domains a chunk CANNOT reach, with WAVES to
+    the fixpoint: a chunk capped by the floor may admit more once
+    other chunks raise the unreachable minima (zone<->rack correlated
+    topologies grow in lock-step instead of stranding weight). Totals
+    and caps charge the WORKLOAD-shared `others_placed` ledger (keyed
+    by entry index + value), so every row of a workload spends one
+    budget; weight a LATER entry sheds is REFUNDED along its charge
+    history, so phantom charges never starve later rows. Entries apply
+    sequentially — a later entry re-partitions the earlier one's
+    sub-chunks (product of domain counts at worst, fleet-scale
+    constants). Dead groups are excluded from candidacy up front.
+
+    Returns [(rank, count, extra mask or None)] — the pieces the
+    caller emits; pods no piece can hold fall out (the caller counts
+    them unschedulable). Mutates `others_placed`."""
     dead = view["dead"]
-    inverses = []
-    for entry_idx, value_groups, caps2 in view["others"]:
+    pieces = []  # (rank, count, extra mask, charge history)
+    for rank in range(len(additions)):
+        chunk = int(additions[rank])
+        if chunk:
+            pieces.append((rank, chunk, None, ()))
+    if not view["others"] or not pieces:
+        return [(rank, count, extra) for rank, count, extra, _ in pieces]
+
+    def refund(history, amount):
+        for ledger, value in history:
+            ledger[value] = ledger.get(value, 0) - amount
+
+    for entry_idx, skew, value_groups, caps2, counts2 in view["others"]:
         group_value = {}
         for value, groups in value_groups.items():
             for t in groups:
                 group_value[t] = value
         placed = others_placed.setdefault(entry_idx, {})
-        inverses.append((group_value, value_groups, caps2, placed))
-    for rank in range(len(additions)):
-        chunk = int(additions[rank])
-        if chunk == 0:
-            continue
-        allowed = ~masks[rank]
-        if dead is not None:
-            allowed = allowed & ~dead
-        charges = []  # (caps2, placed ledger, chosen value)
-        for group_value, value_groups, caps2, placed in inverses:
-
-            def remaining(v):
-                cap = caps2.get(v)
-                if cap is None:
-                    return _UNBOUNDED
-                return cap - placed.get(v, 0)
-
-            candidates = sorted(
+        work = []  # (rank, remaining, extra, history, reachable)
+        for rank, count, extra, history in pieces:
+            allowed = ~masks[rank]
+            if dead is not None:
+                allowed = allowed & ~dead
+            if extra is not None:
+                allowed = allowed & ~extra
+            reachable = sorted(
                 {
                     group_value[t]
                     for t in np.flatnonzero(allowed)
                     if t in group_value
                 }
             )
-            if not candidates:
-                allowed = None
-                break
-            best = max(candidates, key=lambda v: (remaining(v), v))
-            if best in caps2:
-                charges.append((caps2, placed, best))
-            # narrow for the NEXT entry: designation is joint — later
-            # entries choose among groups the earlier picks allow
-            keep = np.zeros(n_groups, bool)
-            keep[value_groups[best]] = True
-            allowed = allowed & keep
-        if allowed is None or not allowed.any():
-            additions[rank] = 0
-            continue
-        take = chunk
-        for caps2, placed, best in charges:
-            take = min(take, max(0, caps2[best] - placed.get(best, 0)))
-        additions[rank] = take
-        for caps2, placed, best in charges:
-            placed[best] = placed.get(best, 0) + take
-        extra[rank] = ~allowed  # forbid everything outside the picks
-    return extra
-
+            work.append([rank, count, extra, history, reachable])
+        taken = [dict() for _ in work]  # value -> count per piece
+        progressed = True
+        while progressed:
+            progressed = False
+            for w, (rank, remaining, _extra, _hist, reachable) in enumerate(
+                work
+            ):
+                if remaining == 0 or not reachable:
+                    continue
+                totals = [
+                    counts2.get(v, 0) + placed.get(v, 0)
+                    for v in reachable
+                ]
+                floor = min(
+                    counts2.get(v, 0) + placed.get(v, 0)
+                    for v in value_groups
+                )
+                caps = []
+                for v, total_v in zip(reachable, totals):
+                    cap = caps2.get(v)
+                    relative = max(0, floor + skew - total_v)
+                    cap_v = (
+                        relative
+                        if cap is None
+                        else min(
+                            relative,
+                            max(0, cap - placed.get(v, 0)),
+                        )
+                    )
+                    caps.append(min(remaining, cap_v))
+                schedulable = min(remaining, int(np.sum(caps)))
+                if schedulable == 0:
+                    continue
+                adds = _water_fill(
+                    totals, caps, schedulable, seed + rank
+                )
+                for j, value in enumerate(reachable):
+                    take = int(adds[j])
+                    if take:
+                        taken[w][value] = taken[w].get(value, 0) + take
+                        placed[value] = placed.get(value, 0) + take
+                work[w][1] = remaining - schedulable
+                progressed = True
+        next_pieces = []
+        for w, (rank, remaining, extra, history, _reachable) in enumerate(
+            work
+        ):
+            if remaining:
+                # this entry shed weight an EARLIER entry already
+                # charged for: refund it, or the phantom charge starves
+                # later rows (the charge-by-final-take rule, r3)
+                refund(history, remaining)
+            for value in sorted(taken[w]):
+                restrict = np.ones(n_groups, bool)
+                restrict[value_groups[value]] = False
+                next_pieces.append(
+                    (
+                        rank,
+                        taken[w][value],
+                        restrict
+                        if extra is None
+                        else (extra | restrict),
+                        (*history, (placed, value)),
+                    )
+                )
+        pieces = next_pieces
+    return [(rank, count, extra) for rank, count, extra, _ in pieces]
 
 def _expand_spread_rows(  # lint: allow-complexity — per-domain chunking: each guard is a documented spread rule
     snap, profiles, row_idx, row_weight, label_dicts_fn, census=None
@@ -1247,12 +1353,11 @@ def _expand_spread_rows(  # lint: allow-complexity — per-domain chunking: each
     counts are level; with multiple constrained keys the split runs on
     the FIRST (key, selector) entry while the others are enforced
     through key-presence exclusion, zero-capacity dead masks, and the
-    per-chunk domain DESIGNATION pass (_designate_chunks) that pins and
-    budgets their finite capacities — a placement spanning several of a
-    non-split key's domains within one split domain is not attempted;
-    rows of one workload consume a SHARED budget in canonical content
-    order; without a census (hand-built snapshot paths) counts are zero
-    and the split is plain balanced.
+    per-chunk domain PARTITION pass (_partition_chunks) that
+    water-fills each chunk across their domains under their skews and
+    remaining capacities; rows of one workload consume a SHARED budget
+    in canonical content order; without a census (hand-built snapshot
+    paths) counts are zero and the splits are plain balanced.
 
     Returns (row_idx, row_weight, spread_forbidden[rows, T]-or-None);
     unconstrained snapshots pass through untouched.
@@ -1429,32 +1534,34 @@ def _expand_spread_rows(  # lint: allow-complexity — per-domain chunking: each
         additions = _water_fill(
             ledger["counts"], caps, schedulable, seed
         )
-        extra = _designate_chunks(
-            additions, masks, view, ledger["others_placed"], n_groups
+        pieces = _partition_chunks(
+            additions, masks, view, ledger["others_placed"], n_groups,
+            seed,
         )
-        # consume the shared ledgers: a later row of this workload sees
-        # what THIS row placed (selfMatch placements also accumulate
-        # into the fill-order counts, exactly like the scheduler's
-        # sequential skew accounting)
-        ledger["placed"] = ledger["placed"] + additions
+        # consume the shared ledgers with the KEPT counts (the
+        # partition may shed part of a chunk): a later row of this
+        # workload sees what THIS row placed — selfMatch placements
+        # also accumulate into the fill-order counts, exactly like the
+        # scheduler's sequential skew accounting
+        kept = np.zeros(d, np.int64)
+        for rank, count, _extra in pieces:
+            kept[rank] += count
+        ledger["placed"] = ledger["placed"] + kept
         if view["first_selfmatch"]:
-            ledger["counts"] = ledger["counts"] + additions
+            ledger["counts"] = ledger["counts"] + kept
         dead = view["dead"]
         placed = 0
-        for rank in range(d):
-            chunk = int(additions[rank])
-            if chunk == 0:
-                continue
-            placed += chunk
+        for rank, count, extra in pieces:
+            placed += count
             forbidden = masks[rank]
-            if dead is not None or extra[rank] is not None:
+            if dead is not None or extra is not None:
                 forbidden = forbidden.copy()
                 if dead is not None:
                     forbidden |= dead
-                if extra[rank] is not None:
-                    forbidden |= extra[rank]
+                if extra is not None:
+                    forbidden |= extra
             out_idx.append(row_idx[i])
-            out_weight.append(np.int32(chunk))
+            out_weight.append(np.int32(count))
             out_forbidden.append(forbidden)
         if placed < weight:
             out_idx.append(row_idx[i])
@@ -1607,9 +1714,11 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
     # SHARED across rows with the same shape, handed out in canonical
     # content order (path-stable — see docstring)
     sid_rows = collections.Counter(int(s) for s in live_ids)
-    # (spread shape id, row filter token) -> zero-capacity group mask
-    # for anti rows whose spread split was skipped (see below)
-    spread_dead_memo: Dict[tuple, np.ndarray] = {}
+    # (spread shape id, row filter token) -> partition view; ledgers
+    # keyed per spread sid ONLY (one budget per workload) — for anti
+    # rows whose spread split was skipped (see below)
+    spread_view_memo: Dict[tuple, dict] = {}
+    spread_ledgers: Dict[int, dict] = {}
     plan: Dict[int, tuple] = {}
     for s in np.unique(live_ids):
         shape = shapes[s]
@@ -1672,17 +1781,18 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
             excluded = _co_pin(excluded, label_dicts, co_keys, n_groups)
         plan[int(s)] = (domains, excluded, bool(hostname_excl))
 
-    def row_spread_dead(i):
-        """Zero-capacity spread exclusion for an anti-split row (the
-        spread SPLIT was skipped in favor of the anti split, but a
-        spread domain with NO remaining capacity must still never
-        receive the anti replica — r3 code review)."""
+    def row_spread_view(i):
+        """Partition view + shared ledger for an anti-split row's SKIPPED
+        spread shape: the anti hand-out decides the anti domains, but
+        every spread entry still binds through the same water-fill
+        partition the spread path uses (r3; zero-cap exclusion alone let
+        a workload concentrate onto one rack — soundness fuzz)."""
         if (
             live_spread is None
             or live_spread[i] == 0
             or spread_shapes is None
         ):
-            return None
+            return None, None
         spread_sid = int(live_spread[i])
         row_filter = (
             _row_node_filter(snap, row_idx[i])
@@ -1690,14 +1800,17 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
             else (None, None)
         )
         key = (spread_sid, row_filter[0])
-        dead = spread_dead_memo.get(key)
-        if dead is None:
-            dead = _spread_zero_cap_groups(
+        view = spread_view_memo.get(key)
+        if view is None:
+            view = _spread_partition_view(
                 spread_shapes[spread_sid], row_filter, label_dicts,
                 census, n_groups,
             )
-            spread_dead_memo[key] = dead
-        return dead
+            spread_view_memo[key] = view
+        # the LEDGER is per WORKLOAD (per spread sid), never per filter
+        # token: rows with different node selectors must spend one
+        # budget (r3 code review)
+        return view, spread_ledgers.setdefault(spread_sid, {})
 
     # hand out domains per workload in canonical content order; a
     # domain dead for one row (its spread capacity spent, or every
@@ -1705,7 +1818,7 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
     # still use it, while consumption stays GLOBAL per workload so no
     # two rows ever share a domain (the no-doubling invariant)
     picks: Dict[int, list] = {}
-    row_dead: Dict[int, np.ndarray] = {}
+    row_views: Dict[int, tuple] = {}
     rows_by_sid: Dict[int, list] = {}
     for i, sid in enumerate(live_ids):
         entry = plan.get(int(sid))
@@ -1720,9 +1833,10 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
             )
         consumed = [False] * len(domain_list)
         for i in rows_i:
-            dead = row_spread_dead(i)
-            if dead is not None:
-                row_dead[i] = dead
+            view, ledger = row_spread_view(i)
+            if view is not None:
+                row_views[i] = (view, ledger)
+            dead = view["dead"] if view is not None else None
             need = int(row_weight[i])
             mine = []
             for rank, groups in enumerate(domain_list):
@@ -1752,10 +1866,10 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
             continue
         domains, excluded, hostname_excl = entry
         excluded = excluded | prior
-        if i in row_dead:
+        if i in row_views and row_views[i][0]["dead"] is not None:
             # partial-dead domains stay usable through their live
             # groups; the mask forbids the spent ones
-            excluded |= row_dead[i]
+            excluded |= row_views[i][0]["dead"]
         weight = int(row_weight[i])
         if domains is None:
             # hostname/co-location only: no split, mask + flag ride along
@@ -1765,20 +1879,53 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
             out_exclusive.append(hostname_excl)
             continue
         mine = picks[i]
+        view_ledger = row_views.get(i)
+        placed = 0
+        # content-keyed, invariant across this row's ranks (arena
+        # numbering must not steer the partition)
+        content_sum = int(
+            np.ascontiguousarray(snap.requests[row_idx[i]])
+            .view(np.uint8)
+            .sum()
+        )
         for rank in mine:
             forbidden = np.ones(n_groups, bool)
             forbidden[domains[rank]] = False
             forbidden |= excluded
+            if view_ledger is None:
+                placed += 1
+                out_idx.append(row_idx[i])
+                out_weight.append(np.int32(1))
+                out_forbidden.append(forbidden)
+                out_exclusive.append(hostname_excl)
+                continue
+            # the SKIPPED spread shape still binds: partition this
+            # weight-1 sub-row across every spread entry's domains
+            # against the workload-shared ledger (picking e.g. the
+            # rack with remaining balance, not whichever group the
+            # solver tries first)
+            view, ledger = view_ledger
+            seed = rank + content_sum
+            pieces = _partition_chunks(
+                np.array([1], np.int64), [forbidden], view, ledger,
+                n_groups, seed,
+            )
+            for _rank0, count, extra in pieces:
+                placed += count
+                sub = forbidden
+                if extra is not None:
+                    # view["dead"] already rode in through `excluded`
+                    sub = sub | extra
+                out_idx.append(row_idx[i])
+                out_weight.append(np.int32(count))
+                out_forbidden.append(sub)
+                out_exclusive.append(hostname_excl)
+        if weight > placed:
+            # beyond the usable domain count / spread capacity:
+            # unschedulable by anti-affinity — keep the excess as a
+            # forbidden-everywhere row so it COUNTS
             out_idx.append(row_idx[i])
-            out_weight.append(np.int32(1))
-            out_forbidden.append(forbidden)
-            out_exclusive.append(hostname_excl)
-        if weight > len(mine):
-            # beyond the usable domain count: unschedulable by
-            # anti-affinity — keep the excess as a forbidden-everywhere
-            # row so it COUNTS
-            out_idx.append(row_idx[i])
-            out_weight.append(np.int32(weight - len(mine)))
+            out_weight.append(np.int32(weight - placed))
             out_forbidden.append(np.ones(n_groups, bool))
             out_exclusive.append(hostname_excl)
     return (

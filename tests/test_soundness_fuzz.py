@@ -152,7 +152,10 @@ def random_workload(rng, widx):
     spec = {
         "app": app,
         "spread": None,
+        "min_domains": None,
+        "rack_spread": None,
         "self_anti": False,
+        "self_anti_rack": False,
         "self_co": False,
         "foreign": [],
     }
@@ -162,14 +165,30 @@ def random_workload(rng, widx):
     if rng.random() < 0.6:
         skew = int(rng.integers(1, 3))
         spec["spread"] = skew
+        min_domains = (
+            int(rng.integers(2, 5)) if rng.random() < 0.3 else None
+        )
+        spec["min_domains"] = min_domains
         constraints.append(
             TopologySpreadConstraint(
                 max_skew=skew,
                 topology_key=ZONE,
                 when_unsatisfiable="DoNotSchedule",
                 label_selector={"matchLabels": {"app": app}},
+                min_domains=min_domains,
             )
         )
+        if rng.random() < 0.3:
+            rack_skew = int(rng.integers(1, 3))
+            spec["rack_spread"] = rack_skew
+            constraints.append(
+                TopologySpreadConstraint(
+                    max_skew=rack_skew,
+                    topology_key=RACK,
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector={"matchLabels": {"app": app}},
+                )
+            )
     if rng.random() < 0.4:
         spec["self_anti"] = True
         anti_terms.append(
@@ -178,6 +197,16 @@ def random_workload(rng, widx):
                 topology_key=ZONE,
             )
         )
+        if rng.random() < 0.3:
+            spec["self_anti_rack"] = True
+            anti_terms.append(
+                PodAffinityTerm(
+                    label_selector=LabelSelector(
+                        match_labels={"app": app}
+                    ),
+                    topology_key=RACK,
+                )
+            )
     elif rng.random() < 0.3:
         spec["self_co"] = True
         co_terms.append(
@@ -298,7 +327,8 @@ def validate(store, groups, workloads, report, rng_label):  # lint: allow-comple
     """Assert every promised placement admissible; returns promised count."""
     bound = bound_index(store)
     group_zone = {name: labels.get(ZONE) for name, labels in groups.items()}
-    # per-workload promised zone multiset, from simulate's per-row detail
+    group_rack = {name: labels.get(RACK) for name, labels in groups.items()}
+    # per-workload promised (zone, rack) multiset from per-row detail
     promised = {}
     for row in report["rows"]:
         if row["assigned"] is None:
@@ -307,18 +337,24 @@ def validate(store, groups, workloads, report, rng_label):  # lint: allow-comple
         app = pod_name.rsplit("-", 1)[0]
         gname = row["assigned"].split("/", 1)[1]
         promised.setdefault(app, []).extend(
-            [group_zone[gname]] * row["pods"]
+            [(group_zone[gname], group_rack[gname])] * row["pods"]
         )
-    # zones of ALL live nodes (incl. unmanaged): the spread filter set
-    # for pods with no nodeSelector
+    # domains of ALL live nodes (incl. unmanaged): the spread filter
+    # set for pods with no nodeSelector
     present_zones = {
         n.metadata.labels.get(ZONE)
         for n in store.list("Node")
         if ZONE in n.metadata.labels
     }
+    present_racks = {
+        n.metadata.labels.get(RACK)
+        for n in store.list("Node")
+        if RACK in n.metadata.labels
+    }
     for spec in workloads:
         app = spec["app"]
-        placed = promised.get(app, [])
+        placed_pairs = promised.get(app, [])
+        placed = [z for z, _ in placed_pairs]
         if spec["spread"] is not None and placed:
             skew = spec["spread"]
             final = {z: 0 for z in present_zones}
@@ -333,6 +369,24 @@ def validate(store, groups, workloads, report, rng_label):  # lint: allow-comple
                 f"[{rng_label}] {app}: spread skew {worst - floor} > "
                 f"{skew}; final={final}, placed={placed}"
             )
+            min_domains = spec["min_domains"]
+            if min_domains and len(present_zones) < min_domains:
+                # the scheduler's global-minimum-0 rule
+                assert worst <= skew, (
+                    f"[{rng_label}] {app}: minDomains cap {skew} "
+                    f"exceeded: final={final}"
+                )
+        if spec["rack_spread"] is not None and placed_pairs:
+            skew = spec["rack_spread"]
+            final = {r: 0 for r in present_racks}
+            for _, rack in placed_pairs:
+                final[rack] += 1
+            floor = min(final.values())
+            worst = max(final.values())
+            assert worst - floor <= skew, (
+                f"[{rng_label}] {app}: rack skew {worst - floor} > "
+                f"{skew}; final={final}"
+            )
         if spec["self_anti"] and placed:
             for zone in set(placed):
                 total = placed.count(zone) + bound.get(
@@ -341,6 +395,13 @@ def validate(store, groups, workloads, report, rng_label):  # lint: allow-comple
                 assert total <= 1, (
                     f"[{rng_label}] {app}: {total} replicas in {zone} "
                     f"violate self anti-affinity"
+                )
+        if spec["self_anti_rack"] and placed_pairs:
+            racks = [r for _, r in placed_pairs]
+            for rack in set(racks):
+                assert racks.count(rack) <= 1, (
+                    f"[{rng_label}] {app}: {racks.count(rack)} replicas "
+                    f"in rack {rack} violate self anti-affinity"
                 )
         if spec["self_co"] and placed:
             existing = set(bound.get(("default", app), []))
