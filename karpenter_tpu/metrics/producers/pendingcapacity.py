@@ -952,6 +952,7 @@ def _spread_state(namespace, entries, values, census, row_filter,  # lint: allow
                     {v: counts_e.get(v, 0) for v in values2},
                 )
             )
+    has_other_partitions = bool(others)
     split_groups: Dict[str, list] = {}
     for t in eligible:
         split_groups.setdefault(label_dicts[t][split_key], []).append(t)
@@ -987,23 +988,27 @@ def _spread_state(namespace, entries, values, census, row_filter,  # lint: allow
             # key's own balance must re-bind against the shrunken
             # totals — the pre-allocation alone would leave e.g. zone
             # [2,0,1] standing after a rack cap emptied the middle
-            # zone (found by the soundness fuzz)
-            others.append(
-                (
-                    entry_idx,
-                    int(skew),
-                    dict(split_groups),
-                    {
-                        v: (
-                            int(caps_e[j])
-                            if caps_e[j] < _UNBOUNDED
-                            else None
-                        )
-                        for j, v in enumerate(values)
-                    },
-                    {v: counts_e.get(v, 0) for v in values},
+            # zone (found by the soundness fuzz). With NO other
+            # partition entries nothing can shed, the split water-fill
+            # is already a fixpoint of these exact bounds, and the
+            # common single-key fleet skips the partition entirely.
+            if has_other_partitions:
+                others.append(
+                    (
+                        entry_idx,
+                        int(skew),
+                        dict(split_groups),
+                        {
+                            v: (
+                                int(caps_e[j])
+                                if caps_e[j] < _UNBOUNDED
+                                else None
+                            )
+                            for j, v in enumerate(values)
+                        },
+                        {v: counts_e.get(v, 0) for v in values},
+                    )
                 )
-            )
         else:
             static = np.minimum(static, caps_e)
     first_counts, _ = entry_counts(entries[0])
