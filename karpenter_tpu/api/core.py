@@ -150,14 +150,20 @@ class LabelSelector:
 class PodAffinityTerm:
     """core/v1 PodAffinityTerm: pods matching label_selector in the
     namespace scope, co-/anti-located by topology_key. namespaces=[]
-    means the pod's own namespace (the k8s default); namespace_selector
-    is decoded for fidelity but only the own-namespace case can be
-    SELF-matching (see anti_affinity_shape)."""
+    means the pod's own namespace (the k8s default).
+    matchLabelKeys/mismatchLabelKeys (k8s >= 1.29) merge the INCOMING
+    pod's values for those keys into the selector as In/NotIn
+    requirements before shape canonicalization — the per-revision
+    anti-affinity pattern (pod-template-hash): a mismatch key on the
+    pod's own labels turns a self-matching selector into a foreign one
+    automatically."""
 
     label_selector: Optional[LabelSelector] = None
     topology_key: str = ""
     namespaces: List[str] = field(default_factory=list)
     namespace_selector: Optional[LabelSelector] = None
+    match_label_keys: List[str] = field(default_factory=list)
+    mismatch_label_keys: List[str] = field(default_factory=list)
 
 
 @dataclass(slots=True)
@@ -349,6 +355,49 @@ def spread_shape(
     return (namespace, entries)
 
 
+def _refine_term(term: "PodAffinityTerm", labels: Dict[str, str]):
+    """PodAffinityTerm with matchLabelKeys/mismatchLabelKeys (k8s >=
+    1.29) merged into the selector: the incoming pod's value for each
+    present key becomes an In (match) or NotIn (mismatch) requirement;
+    keys the pod doesn't carry are ignored, and the API forbids the
+    fields without a labelSelector. Everything downstream (self/foreign
+    classification, census matching) then sees the effective
+    selector."""
+    if not (term.match_label_keys or term.mismatch_label_keys):
+        return term
+    if term.label_selector is None:
+        return term
+    extra = []
+    for key in sorted(set(term.match_label_keys)):
+        if key in labels:
+            extra.append(
+                NodeSelectorRequirement(
+                    key=key, operator="In", values=[labels[key]]
+                )
+            )
+    for key in sorted(set(term.mismatch_label_keys)):
+        if key in labels:
+            extra.append(
+                NodeSelectorRequirement(
+                    key=key, operator="NotIn", values=[labels[key]]
+                )
+            )
+    if not extra:
+        return term
+    return PodAffinityTerm(
+        label_selector=LabelSelector(
+            match_labels=dict(term.label_selector.match_labels),
+            match_expressions=[
+                *term.label_selector.match_expressions,
+                *extra,
+            ],
+        ),
+        topology_key=term.topology_key,
+        namespaces=list(term.namespaces or []),
+        namespace_selector=term.namespace_selector,
+    )
+
+
 def _self_matching_terms(
     terms: list,
     labels: Dict[str, str],
@@ -422,32 +471,29 @@ def pod_affinity_shape(
         return ()
     anti = affinity.pod_anti_affinity
     co = affinity.pod_affinity
-    anti_terms = (
-        _self_matching_terms(
-            anti.required_during_scheduling_ignored_during_execution,
-            labels,
-            namespace,
-            assume_ns_selector=True,
-        )
-        if anti is not None
-        else []
+    # matchLabelKeys/mismatchLabelKeys refine every term FIRST, so the
+    # self/foreign split and the census all see the effective selector
+    def refined_required(block):
+        if block is None:
+            return []
+        return [
+            _refine_term(t, labels)
+            for t in block.required_during_scheduling_ignored_during_execution
+        ]
+
+    anti_required = refined_required(anti)
+    co_required = refined_required(co)
+    anti_terms = _self_matching_terms(
+        anti_required, labels, namespace, assume_ns_selector=True
     )
-    co_terms = (
-        _self_matching_terms(
-            co.required_during_scheduling_ignored_during_execution,
-            labels,
-            namespace,
-        )
-        if co is not None
-        else []
-    )
+    co_terms = _self_matching_terms(co_required, labels, namespace)
     hostname_exclusive = any(
         t.topology_key == HOSTNAME_TOPOLOGY_KEY for t in anti_terms
     )
     anti_keys = _domain_keys(anti_terms)
     co_keys = _domain_keys(co_terms)
     foreign = _foreign_terms(
-        affinity, labels, namespace, anti_terms, co_terms
+        anti_required, co_required, namespace, anti_terms, co_terms
     )
     if (
         not hostname_exclusive
@@ -475,7 +521,7 @@ def pod_affinity_shape(
     return (int(hostname_exclusive), anti_keys, co_keys, ident, foreign)
 
 
-def _foreign_terms(affinity, labels, namespace, anti_terms, co_terms):  # lint: allow-complexity — one guard per k8s term rule (selector/nsSelector/hostname/own-vs-extra namespaces)
+def _foreign_terms(anti_required, co_required, namespace, anti_terms, co_terms):  # lint: allow-complexity — one guard per k8s term rule (selector/nsSelector/hostname/own-vs-extra namespaces)
     """Canonical FOREIGN required (anti-)affinity terms — selectors that
     do NOT match the pod's own labels, i.e. constraints against OTHER
     workloads' pods. The solver enforces them against SCHEDULED state
@@ -501,13 +547,11 @@ def _foreign_terms(affinity, labels, namespace, anti_terms, co_terms):  # lint: 
     out = set()
     own_anti = set(map(id, anti_terms))
     own_co = set(map(id, co_terms))
-    for sign, block, own in (
-        (-1, affinity.pod_anti_affinity, own_anti),
-        (1, affinity.pod_affinity, own_co),
+    for sign, terms, own in (
+        (-1, anti_required, own_anti),
+        (1, co_required, own_co),
     ):
-        if block is None:
-            continue
-        for t in block.required_during_scheduling_ignored_during_execution:
+        for t in terms:
             if t.label_selector is None or not t.topology_key:
                 continue
             if sign < 0 and t.topology_key == HOSTNAME_TOPOLOGY_KEY:
@@ -616,7 +660,7 @@ def soft_pod_affinity_shape(
         if block is None:
             continue
         for wt in block.preferred_during_scheduling_ignored_during_execution:
-            term = wt.pod_affinity_term
+            term = _refine_term(wt.pod_affinity_term, labels)
             if (
                 term.topology_key
                 and term.topology_key != HOSTNAME_TOPOLOGY_KEY

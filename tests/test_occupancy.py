@@ -1469,6 +1469,70 @@ class TestForeignAffinityOccupancy:
              ("names", ("default",))),
         )
 
+    def test_match_label_keys_make_per_revision_anti_groups(self, env):
+        """podAffinityTerm.matchLabelKeys (k8s >= 1.29): the incoming
+        pod's values refine the selector, so two revisions of one app
+        form SEPARATE anti-groups — v1's zone doesn't block v2."""
+        runtime, _ = env
+        zoned(runtime, zones=("a", "b"))
+        runtime.store.create(
+            bound_pod(
+                "v1-0",
+                {"app": "db", "pod-template-hash": "v1"},
+                "n-a",
+            )
+        )
+        pod = anti_pod(
+            "v2-0",
+            labels={"app": "db", "pod-template-hash": "v2"},
+            selector_labels={"app": "db"},
+        )
+        term = (
+            pod.spec.affinity.pod_anti_affinity
+            .required_during_scheduling_ignored_during_execution[0]
+        )
+        term.match_label_keys = ["pod-template-hash"]
+        runtime.store.create(pod)
+        runtime.manager.reconcile_all()
+        # the v1 replica in zone a does NOT match the refined selector
+        # (hash=v2): zone a stays open and first-feasible wins
+        assert pods_per_group(runtime, ["group-a", "group-b"]) == {
+            "group-a": 1,
+            "group-b": 0,
+        }
+
+    def test_mismatch_label_keys_turn_self_terms_foreign(self, env):
+        """mismatchLabelKeys excludes the pod's own value: the term can
+        only match OTHER revisions — enforced as a foreign term against
+        their scheduled replicas."""
+        runtime, _ = env
+        zoned(runtime, zones=("a", "b"))
+        runtime.store.create(
+            bound_pod(
+                "v1-0",
+                {"app": "db", "pod-template-hash": "v1"},
+                "n-a",
+            )
+        )
+        pod = anti_pod(
+            "v2-0",
+            labels={"app": "db", "pod-template-hash": "v2"},
+            selector_labels={"app": "db"},
+        )
+        term = (
+            pod.spec.affinity.pod_anti_affinity
+            .required_during_scheduling_ignored_during_execution[0]
+        )
+        term.mismatch_label_keys = ["pod-template-hash"]
+        runtime.store.create(pod)
+        runtime.manager.reconcile_all()
+        # the refined selector (app=db AND hash NotIn [v2]) matches the
+        # v1 replica: its zone a is forbidden
+        assert pods_per_group(runtime, ["group-a", "group-b"]) == {
+            "group-a": 0,
+            "group-b": 1,
+        }
+
     def test_namespace_selector_resolves_against_labels(self, env):
         """A namespaceSelector term censuses every namespace whose
         labels match — the Namespace mirror closes the last decode-only
