@@ -1896,6 +1896,45 @@ class TestLongRunBoundedState:
 
 
 class TestSimulateWithOccupancy:
+    def test_what_if_zone_relieves_spread_pressure(self):
+        """A hypothetical group in a FRESH zone becomes an eligible
+        domain with zero occupancy: the water-fill routes the overflow
+        there, and the delta report shows the unschedulable pods it
+        absorbs."""
+        from karpenter_tpu.simulate import simulate_delta
+        from karpenter_tpu.store.store import Store
+
+        store = Store()
+        store.create(
+            ready_node("n-a", {"group": "a", ZONE_KEY: "us-a"})
+        )
+        store.create(pending_mp("group-a", {"group": "a"}))
+        # an empty unmanaged zone freezes the minimum: one zone-a slot
+        store.create(ready_node("unmanaged", {ZONE_KEY: "us-b"}))
+        for i in range(3):
+            store.create(spread_pod(f"p{i}", {"app": "web"}))
+        report = simulate_delta(
+            store,
+            [
+                {
+                    "name": "what-if-b",
+                    "allocatable": {"cpu": "64", "memory": "64Gi"},
+                    "labels": {ZONE_KEY: "us-b"},
+                }
+            ],
+        )
+        base = report["baseline"]["groups"]["default/group-a"]
+        assert base["pending_pods"] == 1  # frozen minimum caps zone a
+        assert report["baseline"]["unschedulable_pods"] == 2
+        # the hypothetical zone-b group fills the frozen zone itself:
+        # every pod schedules — and the what-if group absorbs ONLY the
+        # overflow no real group can take (the no-steal invariant)
+        assert report["what_if"]["unschedulable_pods"] == 0
+        assert report["delta"]["unschedulable_pods"] == -2
+        groups = report["what_if"]["groups"]
+        assert groups["default/group-a"]["pending_pods"] == 2
+        assert groups["what-if-b"]["pending_pods"] == 1
+
     def test_simulation_respects_existing_replicas(self):
         """The dry-run solve sees the same census the production tick
         does: an occupied zone never receives the simulated replica."""
