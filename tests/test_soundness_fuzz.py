@@ -433,19 +433,34 @@ def validate(store, groups, workloads, report, rng_label):  # lint: allow-comple
     return sum(len(v) for v in promised.values())
 
 
+def _run_seed(seed, max_workloads=4):
+    rng = np.random.default_rng(seed)
+    store, groups = build_fleet(rng)
+    workloads = []
+    pending_total = 0
+    for widx in range(int(rng.integers(1, max_workloads))):
+        pods, spec = random_workload(rng, widx)
+        workloads.append(spec)
+        pending_total += len(pods)
+        for pod in pods:
+            store.create(pod)
+    report = simulate(store)
+    promised = validate(store, groups, workloads, report, seed)
+    assert promised + report["unschedulable_pods"] == pending_total
+
+
 class TestSoundnessFuzz:
     @pytest.mark.parametrize("seed", range(60))
     def test_promises_are_scheduler_admissible(self, seed):
-        rng = np.random.default_rng(seed)
-        store, groups = build_fleet(rng)
-        workloads = []
-        pending_total = 0
-        for widx in range(int(rng.integers(1, 4))):
-            pods, spec = random_workload(rng, widx)
-            workloads.append(spec)
-            pending_total += len(pods)
-            for pod in pods:
-                store.create(pod)
-        report = simulate(store)
-        promised = validate(store, groups, workloads, report, seed)
-        assert promised + report["unschedulable_pods"] == pending_total
+        _run_seed(seed)
+
+    @pytest.mark.skipif(
+        not __import__("os").environ.get("KARPENTER_SCALE_TESTS"),
+        reason="wide sweep; battletest sets KARPENTER_SCALE_TESTS=1",
+    )
+    def test_heavy_fleet_sweep(self):
+        """battletest tier: 300 extra seeds with up to 6 workloads per
+        solve — the cross-workload interaction surface (shared foreign
+        targets, competing budgets) at higher density."""
+        for seed in range(3000, 3300):
+            _run_seed(seed, max_workloads=7)
