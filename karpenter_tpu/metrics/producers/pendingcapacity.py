@@ -1233,7 +1233,10 @@ def _partition_chunks(additions, masks, view, others_placed, n_groups,  # lint: 
     if not view["others"] or not pieces:
         return [(rank, count, extra) for rank, count, extra, _ in pieces]
 
+    refunded = [False]
+
     def refund(history, amount):
+        refunded[0] = True
         for ledger, value in history:
             ledger[value] = ledger.get(value, 0) - amount
 
@@ -1314,17 +1317,61 @@ def _partition_chunks(additions, masks, view, others_placed, n_groups,  # lint: 
                 restrict = np.ones(n_groups, bool)
                 restrict[value_groups[value]] = False
                 next_pieces.append(
-                    (
+                    [
                         rank,
                         taken[w][value],
                         restrict
                         if extra is None
                         else (extra | restrict),
                         (*history, (placed, value)),
-                    )
+                    ]
                 )
         pieces = next_pieces
-    return [(rank, count, extra) for rank, count, extra, _ in pieces]
+
+    # CASCADE: a refund at a later entry can invalidate the relative
+    # floor that JUSTIFIED an earlier allocation (r0's third pod was
+    # legal only while r1 held the charge the zone stage then shed —
+    # soundness fuzz, heavy sweep). Verify every entry against the
+    # FINAL ledgers and shed the excess from THIS row's pieces until
+    # stable; prior rows stay valid because refunds only remove this
+    # row's charges, so totals never drop below their end state. With
+    # no refund, charges only grew the floor: nothing to verify.
+    changed = refunded[0]
+    while changed:
+        changed = False
+        for entry_idx, skew, value_groups, caps2, counts2 in (
+            view["others"]
+        ):
+            ledger = others_placed[entry_idx]
+            totals = {
+                v: counts2.get(v, 0) + ledger.get(v, 0)
+                for v in value_groups
+            }
+            floor = min(totals.values())
+            for v in sorted(value_groups):
+                excess = totals[v] - (floor + skew)
+                cap = caps2.get(v)
+                if cap is not None:
+                    excess = max(excess, ledger.get(v, 0) - cap)
+                if excess <= 0:
+                    continue
+                for piece in reversed(pieces):
+                    if excess <= 0:
+                        break
+                    if piece[1] and any(
+                        led is ledger and val == v
+                        for led, val in piece[3]
+                    ):
+                        take = min(piece[1], excess)
+                        piece[1] -= take
+                        excess -= take
+                        refund(piece[3], take)
+                        changed = True
+    return [
+        (rank, count, extra)
+        for rank, count, extra, _ in pieces
+        if count
+    ]
 
 def _expand_spread_rows(  # lint: allow-complexity — per-domain chunking: each guard is a documented spread rule
     snap, profiles, row_idx, row_weight, label_dicts_fn, census=None
