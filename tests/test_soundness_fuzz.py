@@ -275,10 +275,9 @@ def random_workload(rng, widx):
 
 
 def bound_index(store):
-    """{(namespace, app): [zone values]} of bound non-terminal pods."""
-    zones_by_node = {
-        n.metadata.name: n.metadata.labels.get(ZONE)
-        for n in store.list("Node")
+    """{(namespace, app): [(zone, rack)]} of bound non-terminal pods."""
+    labels_by_node = {
+        n.metadata.name: n.metadata.labels for n in store.list("Node")
     }
     out = {}
     for pod in store.list("Pod"):
@@ -287,14 +286,15 @@ def bound_index(store):
             "Failed",
         ):
             continue
-        zone = zones_by_node.get(pod.spec.node_name)
+        node_labels = labels_by_node.get(pod.spec.node_name, {})
+        zone = node_labels.get(ZONE)
         if zone is None:
             continue
         key = (
             pod.metadata.namespace,
             pod.metadata.labels.get("app"),
         )
-        out.setdefault(key, []).append(zone)
+        out.setdefault(key, []).append((zone, node_labels.get(RACK)))
     return out
 
 
@@ -310,17 +310,17 @@ def scopes_zones(store, bound, target, scope):
         if not store.list("Namespace"):
             names = set()
         zones = set()
-        for (ns, app), zs in bound.items():
+        for (ns, app), pairs in bound.items():
             if app == target and ns in names:
-                zones.update(zs)
+                zones.update(z for z, _ in pairs)
         return zones, bool(names) or bool(store.list("Namespace"))
     zones = set()
     for ns in scope:
         zones.update(
             z
-            for (n, app), zs in bound.items()
+            for (n, app), pairs in bound.items()
             if n == ns and app == target
-            for z in zs
+            for z, _ in pairs
         )
     return zones, True
 
@@ -357,10 +357,11 @@ def validate(store, groups, workloads, report, rng_label):  # lint: allow-comple
         app = spec["app"]
         placed_pairs = promised.get(app, [])
         placed = [z for z, _ in placed_pairs]
+        bound_pairs = bound.get(("default", app), [])
         if spec["spread"] is not None and placed:
             skew = spec["spread"]
             final = {z: 0 for z in present_zones}
-            for z in bound.get(("default", app), []):
+            for z, _ in bound_pairs:
                 if z in final:
                     final[z] += 1
             for z in placed:
@@ -381,6 +382,9 @@ def validate(store, groups, workloads, report, rng_label):  # lint: allow-comple
         if spec["rack_spread"] is not None and placed_pairs:
             skew = spec["rack_spread"]
             final = {r: 0 for r in present_racks}
+            for _, rack in bound_pairs:
+                if rack in final:
+                    final[rack] += 1
             for _, rack in placed_pairs:
                 final[rack] += 1
             floor = min(final.values())
@@ -390,23 +394,24 @@ def validate(store, groups, workloads, report, rng_label):  # lint: allow-comple
                 f"{skew}; final={final}"
             )
         if spec["self_anti"] and placed:
+            bound_zones = [z for z, _ in bound_pairs]
             for zone in set(placed):
-                total = placed.count(zone) + bound.get(
-                    ("default", app), []
-                ).count(zone)
+                total = placed.count(zone) + bound_zones.count(zone)
                 assert total <= 1, (
                     f"[{rng_label}] {app}: {total} replicas in {zone} "
                     f"violate self anti-affinity"
                 )
         if spec["self_anti_rack"] and placed_pairs:
-            racks = [r for _, r in placed_pairs]
-            for rack in set(racks):
+            racks = [r for _, r in placed_pairs] + [
+                r for _, r in bound_pairs if r is not None
+            ]
+            for rack in set(r for _, r in placed_pairs):
                 assert racks.count(rack) <= 1, (
                     f"[{rng_label}] {app}: {racks.count(rack)} replicas "
                     f"in rack {rack} violate self anti-affinity"
                 )
         if spec["self_co"] and placed:
-            existing = set(bound.get(("default", app), []))
+            existing = set(z for z, _ in bound_pairs)
             if existing:
                 assert set(placed) <= existing, (
                     f"[{rng_label}] {app}: co replicas outside "
@@ -438,6 +443,7 @@ def validate(store, groups, workloads, report, rng_label):  # lint: allow-comple
 def _run_seed(seed, max_workloads=3):
     rng = np.random.default_rng(seed)
     store, groups = build_fleet(rng)
+    n_groups = len(groups)
     workloads = []
     pending_total = 0
     for widx in range(int(rng.integers(1, max_workloads + 1))):
@@ -446,6 +452,25 @@ def _run_seed(seed, max_workloads=3):
         pending_total += len(pods)
         for pod in pods:
             store.create(pod)
+        if rng.random() < 0.3:
+            # the workload already RUNS one replica somewhere: the own
+            # workload's census paths (co pinning, anti-spent domains,
+            # spread self counts) engage, not just the bootstrap
+            store.create(
+                Pod(
+                    metadata=ObjectMeta(
+                        name=f"{spec['app']}-live",
+                        labels={"app": spec["app"]},
+                    ),
+                    spec=PodSpec(
+                        node_name=f"n{int(rng.integers(0, n_groups))}",
+                        containers=[
+                            Container(requests=resource_list(cpu="1"))
+                        ],
+                    ),
+                    status=PodStatus(phase="Running"),
+                )
+            )
     report = simulate(store)
     promised = validate(store, groups, workloads, report, seed)
     assert promised + report["unschedulable_pods"] == pending_total, (
