@@ -567,17 +567,23 @@ def _foreign_terms(anti_required, co_required, namespace, anti_terms, co_terms):
                 scope = None
             if id(t) in own:
                 # the self-matching slice is modeled by the self
-                # machinery for the pod's OWN namespace — but an anti
-                # term reaching ADDITIONAL namespaces (an explicit list
-                # or a namespaceSelector) also blocks on matching pods
-                # THERE, which only the census-backed foreign mask can
-                # enforce (r3 code review). Co terms need no
-                # projection: admitting only own-namespace evidence
-                # under-admits, which is conservative.
+                # machinery for the pod's OWN namespace — but a term
+                # reaching ADDITIONAL namespaces (an explicit list or a
+                # namespaceSelector) also binds on matching pods THERE,
+                # which only the census-backed foreign mask can enforce
+                # (r3 code review). An anti term blocks their domains
+                # (sign -1). A CO term with extra namespaces is pinned
+                # by them too: matching pods in a foreign in-scope
+                # namespace restrict placement to their domains even
+                # when the own namespace is empty — admitting only
+                # own-namespace evidence then grants a first-replica
+                # bootstrap the scheduler does not give (r3 advisor).
+                # It projects with sign +2 (bootstrap-eligible co) over
+                # the FULL scope: the pod itself is in scope, so an
+                # empty census keeps the scheduler's first-replica
+                # grace, unlike a true foreign co term.
+                extra = tuple(ns for ns in listed if ns != namespace)
                 if sign < 0:
-                    extra = tuple(
-                        ns for ns in listed if ns != namespace
-                    )
                     if scope is not None:
                         out.add(
                             (sign, t.topology_key,
@@ -589,6 +595,21 @@ def _foreign_terms(anti_required, co_required, namespace, anti_terms, co_terms):
                              _selector_form(t.label_selector),
                              ("names", extra))
                         )
+                elif extra:
+                    # self co terms never carry a namespaceSelector
+                    # (_self_matching_terms filters those for CO), so
+                    # the scope is always an explicit name list here.
+                    # Hostname keys project too: a matching pod in a
+                    # foreign in-scope namespace pins the pod to an
+                    # EXISTING node, which a scale-up's fresh nodes can
+                    # never satisfy — the census handler marks the row
+                    # honestly unschedulable (empty census keeps the
+                    # first-replica grace, same as domain keys).
+                    out.add(
+                        (2, t.topology_key,
+                         _selector_form(t.label_selector),
+                         ("names", tuple(sorted((namespace, *extra)))))
+                    )
                 continue
             out.add(
                 (

@@ -648,6 +648,14 @@ class DomainCensus:
         )
         return counts
 
+    def matching_nodes(self, namespace, sel_form) -> set:
+        """Node names hosting scheduled pods matching the selector —
+        the hostname-key census. kubernetes.io/hostname domains ARE
+        node names (the kubelet's well-known label), so this reads the
+        materialized per-node view directly instead of requiring the
+        label on Node objects (fixtures often omit it)."""
+        return set(self._node_counts(namespace, sel_form))
+
     def _workload_nodes(self, namespace, sel_forms) -> tuple:
         """(any_nodes, all_nodes_or_None): node-name sets occupied by
         pods matching ANY of the workload's selectors (the anti-blocking
@@ -878,10 +886,14 @@ def _spread_state(namespace, entries, values, census, row_filter,  # lint: allow
       row-independent exclusions) plus every entry's zero-capacity
       domains;
     - `others`: EVERY selfMatch entry — non-split ones first, then the
-      split entry itself, so the joint partition
+      split entries themselves, so the joint partition
       (_partition_chunks) re-validates the split after other keys
       narrow — as (entry index, maxSkew, value->groups, per-value caps
-      with None = unbounded, per-value existing counts) 5-tuples.
+      with None = unbounded, per-value existing counts) 5-tuples. The
+      split entries also join whenever MORE THAN ONE selfMatch entry
+      shares the split key (or the seed entry isn't selfMatch): each
+      same-key selector has its own census counts and its relative
+      skew bound only holds through the partition (r3 advisor).
 
     CONSUMPTION lives one level up, in the per-WORKLOAD shared ledgers
     (_expand_spread_rows): placements count against the workload's
@@ -953,6 +965,19 @@ def _spread_state(namespace, entries, values, census, row_filter,  # lint: allow
                 )
             )
     has_other_partitions = bool(others)
+    # The initial water-fill balances against entries[0]'s counts ONLY
+    # (view["counts"]). That is a fixpoint of a selfMatch split entry's
+    # relative skew bound just for THAT entry: a same-key selfMatch
+    # entry with a DIFFERENT selector has its own census counts, and
+    # with every live domain fillable its _entry_caps are unbounded —
+    # nothing enforces its skew against its own imbalance unless it
+    # joins the joint partition (r3 advisor, medium: two same-key
+    # DoNotSchedule constraints promised a replica into a domain the
+    # scheduler's second skew check denies).
+    selfmatch_split = sum(
+        1 for e in entries if e[0] == split_key and e[4]
+    )
+    seed_covers = bool(entries[0][4]) and selfmatch_split == 1
     split_groups: Dict[str, list] = {}
     for t in eligible:
         split_groups.setdefault(label_dicts[t][split_key], []).append(t)
@@ -989,10 +1014,13 @@ def _spread_state(namespace, entries, values, census, row_filter,  # lint: allow
             # totals — the pre-allocation alone would leave e.g. zone
             # [2,0,1] standing after a rack cap emptied the middle
             # zone (found by the soundness fuzz). With NO other
-            # partition entries nothing can shed, the split water-fill
-            # is already a fixpoint of these exact bounds, and the
-            # common single-key fleet skips the partition entirely.
-            if has_other_partitions:
+            # partition entries AND a single selfMatch split entry
+            # seeding the fill, nothing can shed and the split
+            # water-fill is already a fixpoint of these exact bounds —
+            # the common single-key fleet skips the partition entirely.
+            # Same-key selfMatch entries beyond the seed always join
+            # (seed_covers above).
+            if has_other_partitions or not seed_covers:
                 others.append(
                     (
                         entry_idx,
@@ -1081,15 +1109,34 @@ def _anti_base_exclusion(shape, census, label_dicts, n_groups):  # lint: allow-c
                         if ns not in known
                     }
                 namespaces = sorted(resolved)
+            if sign == 1 and key == HOSTNAME_TOPOLOGY_KEY:
+                # true foreign hostname co: a fresh node can never host
+                # the required neighbor, occupied or not — skip the
+                # census walk entirely
+                excluded[:] = True
+                continue
             occupied: set = set()
             for foreign_ns in namespaces:
-                occupied |= census.domain_counts(
-                    foreign_ns, sel, key
-                ).keys()
+                if key == HOSTNAME_TOPOLOGY_KEY:
+                    # hostname domains are node names; the per-node
+                    # materialized view answers without requiring the
+                    # hostname label on Node objects
+                    occupied |= census.matching_nodes(foreign_ns, sel)
+                else:
+                    occupied |= census.domain_counts(
+                        foreign_ns, sel, key
+                    ).keys()
             if sign < 0:
                 for t, labels in enumerate(label_dicts):
                     if labels.get(key) in occupied:
                         excluded[t] = True
+            elif sign > 1 and not occupied:
+                # bootstrap-eligible co (a SELF term projected over its
+                # extra namespaces, api/core._foreign_terms): no
+                # matching pod anywhere in scope means the scheduler's
+                # first-replica grace applies — the term imposes
+                # nothing; true foreign co (sign +1) gets no grace
+                continue
             elif key == HOSTNAME_TOPOLOGY_KEY:
                 excluded[:] = True
             else:
@@ -1626,12 +1673,31 @@ def _expand_spread_rows(  # lint: allow-complexity — per-domain chunking: each
     )
 
 
+def _total_order(value):
+    """Totally-ordered encoding of a canonical shape component. Shape
+    tuples embed OPTIONAL selector forms (None when the field is absent
+    — e.g. spread_shape's selectorForm, metav1 nil-selector semantics),
+    and plain tuple comparison raises TypeError on None-vs-tuple, so a
+    legal spec mixing a nil and a set selector would crash the whole
+    solve (r3 advisor, high). Every node gets a type rank so any two
+    encoded keys compare: None < numbers < strings < tuples."""
+    if isinstance(value, tuple):
+        return (3, tuple(_total_order(v) for v in value))
+    if value is None:
+        return (0, 0.0)
+    if isinstance(value, str):
+        return (2, value)
+    return (1, float(value))  # bool / int / float
+
+
 def _canonical_row_key(snap, slot: int) -> tuple:
     """Arena-independent content key for a snapshot row: every component
     is resolved through its universe REGISTRY (resource names, label
     items, canonical shape tuples), so two arenas that numbered the same
     pod shapes differently still produce the same key. Used to order
-    domain hand-out across a workload's rows (_expand_anti_rows)."""
+    domain hand-out across a workload's rows (_expand_anti_rows). The
+    result is passed through _total_order so keys embedding optional
+    (None) selector forms stay comparable under sorted()."""
     requests = tuple(
         sorted(
             (snap.resources[r], float(snap.requests[slot, r]))
@@ -1648,8 +1714,12 @@ def _canonical_row_key(snap, slot: int) -> tuple:
     )
     tolerations = tuple(
         sorted(
-            (t.key, t.operator, t.value, t.effect)
-            for t in snap.shape_tolerations[snap.shape_id[slot]]
+            (
+                (t.key, t.operator, t.value, t.effect)
+                for t in snap.shape_tolerations[snap.shape_id[slot]]
+            ),
+            # toleration value/key may be None (Exists operator)
+            key=_total_order,
         )
     )
     affinity = (
@@ -1676,8 +1746,10 @@ def _canonical_row_key(snap, slot: int) -> tuple:
         )
         if shapes is not None and ids is not None
     )
-    return (requests, selector, tolerations, affinity, preferred, spread,
-            soft)
+    return _total_order(
+        (requests, selector, tolerations, affinity, preferred, spread,
+         soft)
+    )
 
 
 def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each guard is a documented anti-affinity rule

@@ -450,6 +450,77 @@ class TestSpreadOccupancy:
         assert sorted(counts.values()) == [2, 3]
         assert total_unschedulable(runtime, "group-a") == 0
 
+    def test_mixed_nil_and_set_soft_selectors_do_not_crash(self, env):
+        """Two rows of one hard-spread workload whose SOFT rack
+        constraints differ only in selector presence (nil labelSelector
+        vs a set one): the canonical row-key ordering used for multi-row
+        hand-out must stay totally ordered — None-vs-tuple selector
+        forms inside shape tuples crashed every reconcile with TypeError
+        before _total_order (r3 advisor, high)."""
+        runtime, _ = env
+        zoned(runtime)
+        rack = "topology.kubernetes.io/rack"
+        soft = [
+            None,  # nil labelSelector: counts nothing (metav1 nil)
+            {"matchLabels": {"app": "web"}},
+        ]
+        for i, sel in enumerate(soft):
+            pod = spread_pod(f"p{i}", {"app": "web"})
+            pod.spec.topology_spread_constraints.append(
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=rack,
+                    when_unsatisfiable="ScheduleAnyway",
+                    label_selector=sel,
+                )
+            )
+            runtime.store.create(pod)
+        runtime.manager.reconcile_all()
+        # the reconcile completes and the hard zone spread still splits
+        # the two replicas across the zones
+        assert pods_per_group(runtime, ["group-a", "group-b"]) == {
+            "group-a": 1,
+            "group-b": 1,
+        }
+        assert total_unschedulable(runtime, "group-a") == 0
+
+    def test_same_key_different_selectors_enforce_own_counts(self, env):
+        """Two DoNotSchedule constraints on the SAME topology key with
+        DIFFERENT selectors: each selector's skew binds against its OWN
+        census counts. 4 bound tier=backend pods sit in zone a; 2
+        pending {app:web, tier:backend} pods carry maxSkew-1 zone
+        constraints on both selectors. tier=backend forbids zone a
+        (4+1-min > 1) and app=web forbids a second pod in zone b
+        (0+2-0 > 1): exactly one pod schedules, into zone b. The
+        pre-fix signal promised a pod into zone a — a placement the
+        scheduler's second skew check denies (r3 advisor, medium)."""
+        runtime, _ = env
+        zoned(runtime)
+        for i in range(4):
+            runtime.store.create(
+                bound_pod(f"old{i}", {"tier": "backend"}, "n-a")
+            )
+        for i in range(2):
+            pod = spread_pod(
+                f"p{i}", {"app": "web", "tier": "backend"},
+                selector={"app": "web"},
+            )
+            pod.spec.topology_spread_constraints.append(
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=ZONE_KEY,
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector={"matchLabels": {"tier": "backend"}},
+                )
+            )
+            runtime.store.create(pod)
+        runtime.manager.reconcile_all()
+        assert pods_per_group(runtime, ["group-a", "group-b"]) == {
+            "group-a": 0,
+            "group-b": 1,
+        }
+        assert total_unschedulable(runtime, "group-a") == 1
+
     def test_min_domains_cap_subtracts_existing(self, env):
         """minDomains unsatisfied treats the global minimum as 0: each
         domain holds at most maxSkew matching pods INCLUDING existing
@@ -1449,6 +1520,87 @@ class TestForeignAffinityOccupancy:
             "group-a": 0,
             "group-b": 1,
         }
+
+    def test_self_co_with_extra_namespaces_pins_to_their_domains(self, env):
+        """Regression (r3 advisor, low): a SELF-matching required
+        co-location term whose namespaces list spans the own namespace
+        plus others — matching pods in THOSE namespaces pin the
+        scheduler to their domains even when the own namespace holds no
+        match. The pre-fix model granted first-replica bootstrap and
+        promised placement anywhere."""
+        runtime, _ = env
+        zoned(runtime, zones=("a", "b"))
+        runtime.store.create(
+            bound_pod("twin", {"app": "db"}, "n-b", namespace="staging")
+        )
+        pod = anti_pod("db-0", keys=(), co_keys=(ZONE_KEY,))
+        term = (
+            pod.spec.affinity.pod_affinity
+            .required_during_scheduling_ignored_during_execution[0]
+        )
+        term.namespaces = ["default", "staging"]
+        runtime.store.create(pod)
+        runtime.manager.reconcile_all()
+        # the staging twin occupies zone b: the replica is pinned there
+        assert pods_per_group(runtime, ["group-a", "group-b"]) == {
+            "group-a": 0,
+            "group-b": 1,
+        }
+
+    def test_self_co_with_extra_namespaces_keeps_bootstrap(self, env):
+        """The +2 projection keeps the scheduler's first-replica grace:
+        with NO matching pod in ANY in-scope namespace the term imposes
+        nothing (the pod itself is in scope and matches), unlike a true
+        foreign co term."""
+        runtime, _ = env
+        zoned(runtime, zones=("a", "b"))
+        pod = anti_pod("db-0", keys=(), co_keys=(ZONE_KEY,))
+        term = (
+            pod.spec.affinity.pod_affinity
+            .required_during_scheduling_ignored_during_execution[0]
+        )
+        term.namespaces = ["default", "staging"]
+        runtime.store.create(pod)
+        runtime.manager.reconcile_all()
+        counts = pods_per_group(runtime, ["group-a", "group-b"])
+        assert sum(counts.values()) == 1
+        assert total_unschedulable(runtime, "group-a") == 0
+
+    def test_self_co_hostname_with_extra_namespaces_is_honest(self, env):
+        """A hostname-keyed self co term with extra namespaces: a
+        matching pod in a foreign in-scope namespace pins the pod to an
+        EXISTING node, which fresh nodes can never satisfy — the row is
+        honestly unschedulable; with no matching pod anywhere the
+        first-replica grace applies (r4 code review)."""
+        runtime, _ = env
+        zoned(runtime, zones=("a", "b"))
+        runtime.store.create(
+            bound_pod("twin", {"app": "db"}, "n-b", namespace="staging")
+        )
+        pod = anti_pod("db-0", keys=(), co_keys=("kubernetes.io/hostname",))
+        term = (
+            pod.spec.affinity.pod_affinity
+            .required_during_scheduling_ignored_during_execution[0]
+        )
+        term.namespaces = ["default", "staging"]
+        runtime.store.create(pod)
+        # grace case: no matching pod in scope for this second workload
+        pod2 = anti_pod(
+            "web-0", labels={"app": "web"}, keys=(),
+            co_keys=("kubernetes.io/hostname",),
+        )
+        term2 = (
+            pod2.spec.affinity.pod_affinity
+            .required_during_scheduling_ignored_during_execution[0]
+        )
+        term2.namespaces = ["default", "staging"]
+        runtime.store.create(pod2)
+        runtime.manager.reconcile_all()
+        counts = pods_per_group(runtime, ["group-a", "group-b"])
+        # db-0 pinned to the staging twin's node: unschedulable on any
+        # scale-up; web-0 bootstraps freely
+        assert sum(counts.values()) == 1
+        assert total_unschedulable(runtime, "group-a") == 1
 
     def test_none_namespaces_field_is_tolerated(self):
         """namespaces: null hydrates to None — the shape build must not
