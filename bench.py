@@ -15,14 +15,121 @@ vs_baseline > 1 means faster than the 200 ms budget.
 from __future__ import annotations
 
 import argparse
+import datetime
 import json
 import math
+import os
 import sys
 import time
 
 import numpy as np
 
 BASELINE_MS = 200.0
+
+# Raw-evidence sidecar (r3 verdict): every run appends its full raw record
+# — argv, backend, devices, per-iteration times, transport-floor probe —
+# to bench_evidence/runs.jsonl (and mirrors the newest to latest.json), so
+# a perf claim is always reconstructable from committed data instead of
+# resting on a summarized p50 in a doc table.
+EVIDENCE: dict = {}
+EVIDENCE_DIR = os.environ.get(
+    "KARPENTER_BENCH_EVIDENCE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "bench_evidence"),
+)
+
+
+def record_evidence(**kv) -> None:
+    """Stash raw measurement context for the evidence sidecar. Values
+    must be JSON-serializable (lists, not ndarrays)."""
+    EVIDENCE.update(kv)
+
+
+def measure_transport_floor(iters: int = 20) -> dict:
+    """p50 cost of the smallest possible host<->device interactions —
+    the transport floor every sync'd measurement sits on top of:
+
+    - put_ms: device_put of one f32 scalar + block_until_ready;
+    - dispatch_ms: a compiled 1-element add, dispatch + block;
+    - fetch_ms: device_get of a 1-element array.
+
+    On a locally-attached chip these are tens of microseconds; through
+    a network tunnel each is >= 1 RTT. Recording them next to every
+    solve p50 makes the tunnel-tax attribution MEASURED instead of
+    inferred — r2's builder capture claimed a 0.071 ms sync'd solve AND
+    a 35-70 ms tunnel round-trip, which cannot both be true, and had no
+    artifact to tell which was wrong (r3 verdict, weak #1)."""
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        tiny = jnp.ones((1,), jnp.float32)
+        add = jax.jit(lambda x: x + 1.0)
+        jax.block_until_ready(add(tiny))  # compile outside timing
+        put, disp, fetch = [], [], []
+        host = np.ones((1,), np.float32)
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            dev = jax.device_put(host)
+            jax.block_until_ready(dev)
+            put.append((time.perf_counter() - t0) * 1e3)
+            t0 = time.perf_counter()
+            jax.block_until_ready(add(tiny))
+            disp.append((time.perf_counter() - t0) * 1e3)
+            t0 = time.perf_counter()
+            jax.device_get(tiny)
+            fetch.append((time.perf_counter() - t0) * 1e3)
+        floor = {
+            "put_ms": round(float(np.percentile(put, 50)), 4),
+            "dispatch_ms": round(float(np.percentile(disp, 50)), 4),
+            "fetch_ms": round(float(np.percentile(fetch, 50)), 4),
+            "iters": iters,
+        }
+        print(
+            "transport floor: "
+            f"put={floor['put_ms']}ms dispatch={floor['dispatch_ms']}ms "
+            f"fetch={floor['fetch_ms']}ms",
+            file=sys.stderr,
+        )
+        return floor
+    except Exception as e:  # noqa: BLE001 — evidence-only, never fatal
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
+def _backend_evidence() -> dict:
+    """Backend identity for the evidence record (safe pre-init)."""
+    try:
+        import jax
+
+        return {
+            "backend": jax.default_backend(),
+            "devices": [str(d) for d in jax.devices()],
+        }
+    except Exception as e:  # noqa: BLE001
+        return {"backend_error": f"{type(e).__name__}: {e}"[:200]}
+
+
+def _write_evidence(rec: dict) -> None:
+    """Append the full raw record; never let evidence IO break the ONE
+    JSON line contract."""
+    try:
+        full = {
+            "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+                timespec="seconds"
+            ),
+            "argv": sys.argv[1:],
+            **_backend_evidence(),
+            **EVIDENCE,
+            "result": rec,
+        }
+        os.makedirs(EVIDENCE_DIR, exist_ok=True)
+        line = json.dumps(full)
+        with open(os.path.join(EVIDENCE_DIR, "runs.jsonl"), "a") as f:
+            f.write(line + "\n")
+        with open(os.path.join(EVIDENCE_DIR, "latest.json"), "w") as f:
+            f.write(line + "\n")
+    except Exception as e:  # noqa: BLE001
+        print(f"evidence write failed: {e}", file=sys.stderr)
 
 
 def probe_real_devices(
@@ -98,6 +205,7 @@ def emit(
         rec["note"] = note
     if error:
         rec["error"] = error
+    _write_evidence(rec)
     print(json.dumps(rec))
 
 
@@ -460,6 +568,11 @@ def run(args, metric: str, note: str) -> None:
         out = solve(inputs, buckets=args.buckets, backend=args.backend)
         jax.block_until_ready(out)
         times.append((time.perf_counter() - t0) * 1e3)
+    record_evidence(
+        compile_ms=round(compile_ms, 3),
+        iter_ms=[round(t, 4) for t in times],
+        transport_floor=measure_transport_floor(),
+    )
     p50 = float(np.percentile(times, 50))
     p95 = float(np.percentile(times, 95))
     scheduled = int(np.sum(np.asarray(out.assigned) >= 0))
@@ -511,6 +624,10 @@ def run_decide(args, metric: str, note: str) -> None:
         t0 = time.perf_counter()
         jax.block_until_ready(decide_jit(inputs))
         times.append((time.perf_counter() - t0) * 1e3)
+    record_evidence(
+        iter_ms=[round(t, 4) for t in times],
+        transport_floor=measure_transport_floor(),
+    )
     p50 = float(np.percentile(times, 50))
     dps = args.decide * 1000.0 / p50 if p50 else 0.0
     print(
@@ -587,6 +704,11 @@ def run_mesh(args, metric: str) -> None:
         out = sharded_binpack(mesh, inputs, buckets=args.buckets)
         jax.block_until_ready(out.nodes_needed)
         times.append((time.perf_counter() - t0) * 1e3)
+    record_evidence(
+        iter_ms=[round(t, 4) for t in times],
+        mesh_shape=dict(mesh.shape),
+        transport_floor=measure_transport_floor(),
+    )
     p50 = float(np.percentile(times, 50))
     print(f"sharded p50={p50:.2f}ms over {args.iters} iters", file=sys.stderr)
     emit(f"{metric} ({jax.default_backend()})", p50)
@@ -949,6 +1071,14 @@ def run_e2e(args, metric: str, note: str = "") -> None:  # lint: allow-complexit
             store.create(pod)
         tick()
         times.append((time.perf_counter() - t0) * 1e3)
+    record_evidence(
+        steady_iter_ms=[round(t, 4) for t in steady],
+        iter_ms=[round(t, 4) for t in times],
+        churn=churn,
+        transport_floor=(
+            measure_transport_floor() if not args.host_only else None
+        ),
+    )
     p50 = float(np.percentile(times, 50))
     p95 = float(np.percentile(times, 95))
     print(
