@@ -1,0 +1,48 @@
+# syntax=docker/dockerfile:1
+# Container image for the two-container karpenter-tpu pod
+# (config/manager/manager.yaml): the same image serves
+#   - the controller:  karpenter-tpu  (console script -> karpenter_tpu.__main__)
+#   - the solver:      python -m karpenter_tpu.sidecar --port=9090
+# The reference publishes with ko (reference Makefile `publish`/`apply`,
+# ko resolve over config/); the analog here is `make image` / `make apply`.
+#
+# Build args:
+#   JAX_EXTRAS=tpu   bake the libtpu PJRT plugin for GKE TPU node pools
+#                    (default; the same install falls back to CPU off-TPU,
+#                    so one image serves both containers)
+#   JAX_EXTRAS=      CPU-only image (CI, kind clusters)
+
+FROM python:3.12-slim AS build
+ARG JAX_EXTRAS=tpu
+# gcc: compiles the native C accelerators (karpenter_tpu/native) at build
+# time so the runtime layer needs no toolchain and can run read-only
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends gcc libc6-dev \
+    && rm -rf /var/lib/apt/lists/*
+WORKDIR /src
+COPY pyproject.toml README.md ./
+COPY karpenter_tpu ./karpenter_tpu
+RUN if [ -n "$JAX_EXTRAS" ]; then \
+        pip install --no-cache-dir ".[$JAX_EXTRAS]"; \
+    else \
+        pip install --no-cache-dir .; \
+    fi
+# Pre-build the C accelerators into the installed package and prove the
+# degraded-mode (no-TPU) solver path imports cleanly.
+RUN python - <<'EOF'
+from karpenter_tpu.native import load_kbinpack, load_kquantity
+assert load_kquantity() is not None, "quantity kernel build failed"
+assert load_kbinpack() is not None, "binpack kernel build failed"
+import karpenter_tpu  # noqa: F401  (wiring sanity)
+print("native kernels prebuilt")
+EOF
+
+FROM python:3.12-slim
+# Runtime layer: python + installed site-packages only (no toolchain).
+COPY --from=build /usr/local/lib/python3.12/site-packages /usr/local/lib/python3.12/site-packages
+COPY --from=build /usr/local/bin/karpenter-tpu /usr/local/bin/karpenter-tpu
+# Non-root, read-only-friendly (webhook certs + JAX caches live in /tmp).
+RUN useradd --uid 65532 --no-create-home karpenter
+USER 65532
+ENV PYTHONUNBUFFERED=1
+ENTRYPOINT ["karpenter-tpu"]

@@ -1,5 +1,12 @@
-# Build system (reference: Makefile — dev/ci/test/battletest/verify/codegen).
+# Build system (reference: Makefile — dev/ci/test/battletest/verify/codegen,
+# plus the ko-based publish/apply flow the image targets mirror).
 PYTHON ?= python
+# Container engine + image coordinates (reference: KO_DOCKER_REPO/RELEASE_REPO)
+ENGINE ?= $(shell command -v docker || command -v podman)
+IMAGE_REPO ?= karpenter-tpu
+IMAGE_TAG ?= latest
+IMAGE = $(IMAGE_REPO):$(IMAGE_TAG)
+JAX_EXTRAS ?= tpu
 
 help: ## Display help
 	@grep -E '^[a-zA-Z_-]+:.*## ' $(MAKEFILE_LIST) | awk -F':.*## ' '{printf "%-12s %s\n", $$1, $$2}'
@@ -46,4 +53,29 @@ dryrun: ## Multi-chip sharding compile check on 8 virtual CPU devices
 		import jax; jax.config.update('jax_platforms', 'cpu'); \
 		import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
 
-.PHONY: help dev ci test battletest verify codegen docs native bench dryrun
+image: ## Build the controller+solver OCI image (reference: ko publish --local)
+	@test -n "$(ENGINE)" || { echo "no docker/podman found; install one or set ENGINE="; exit 1; }
+	$(ENGINE) build --build-arg JAX_EXTRAS=$(JAX_EXTRAS) -t $(IMAGE) .
+
+publish: image ## Push the image to IMAGE_REPO (reference: Makefile publish via ko)
+	$(ENGINE) push $(IMAGE)
+
+apply: image ## Build/push the image and apply config/ with it (reference: Makefile apply via ko resolve)
+	@# registry-qualified repos (contain a /) are pushed like ko does;
+	@# bare local names (the kind/kind-load path) are not pushable
+	@if echo "$(IMAGE_REPO)" | grep -q /; then $(ENGINE) push $(IMAGE); fi
+	kubectl kustomize config/ | sed "s|karpenter-tpu:latest|$(IMAGE)|g" | kubectl apply -f -
+
+delete: ## Remove the applied resources (reference: Makefile delete)
+	kubectl kustomize config/ | kubectl delete --ignore-not-found -f -
+
+kind-load: image ## Side-load the image into a kind cluster (no registry needed)
+	@# `kind load docker-image` reads the DOCKER daemon; podman builds
+	@# need the archive path
+	@case "$(notdir $(ENGINE))" in \
+	  docker) kind load docker-image $(IMAGE) ;; \
+	  *) $(ENGINE) save $(IMAGE) | kind load image-archive /dev/stdin ;; \
+	esac
+
+.PHONY: help dev ci test battletest verify codegen docs native bench dryrun \
+	image publish apply delete kind-load

@@ -24,7 +24,11 @@ prometheus() {
 }
 
 karpenter() {
-  kubectl apply -k config/
+  # Build the image the Deployment references (config/manager/
+  # manager.yaml pins karpenter-tpu:latest) and apply config/ with it;
+  # `make apply` also handles a custom IMAGE_REPO/IMAGE_TAG. On kind,
+  # run `make kind-load` first so the node can pull the local image.
+  make -C "$(dirname "$0")/.." apply
   kubectl wait --for=condition=Available --timeout=120s \
     -n karpenter deployment/karpenter-tpu
 }
