@@ -14,6 +14,19 @@ import subprocess
 import sys
 
 
+def _clean_cpu_env() -> dict:
+    """Subprocess env forcing the CPU backend with no inherited
+    multihost topology (the pytest process's axon/topology vars must
+    not leak into the spawned fleet)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                "JAX_PROCESS_ID"):
+        env.pop(var, None)
+    return env
+
+
 def test_single_process_fleet_joins_and_solves():
     script = r"""
 from karpenter_tpu.utils.backend import force_virtual_cpu
@@ -32,10 +45,7 @@ from karpenter_tpu.parallel.mesh import dryrun_fleet_step
 dryrun_fleet_step(jax.device_count())
 print("MULTIHOST-OK")
 """
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PALLAS_AXON_POOL_IPS"] = ""
-    env.pop("JAX_COORDINATOR_ADDRESS", None)
+    env = _clean_cpu_env()
     proc = subprocess.run(
         [sys.executable, "-c", script],
         capture_output=True,
@@ -45,6 +55,111 @@ print("MULTIHOST-OK")
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "MULTIHOST-OK" in proc.stdout
+
+
+_TWO_PROCESS_SCRIPT = r"""
+import sys
+pid = int(sys.argv[1]); port = sys.argv[2]
+from karpenter_tpu.utils.backend import force_virtual_cpu
+force_virtual_cpu(4)  # 4 local devices per process -> 8 global
+from karpenter_tpu.parallel.multihost import initialize_multihost
+joined = initialize_multihost(
+    coordinator_address=f"localhost:{port}", num_processes=2, process_id=pid
+)
+assert joined, "explicit 2-process topology must join"
+import jax
+import numpy as np
+assert jax.process_count() == 2, jax.process_count()
+# the conftest env may pre-force a LARGER per-process device count (the
+# flag is never reduced); the invariant is the split, not the total
+n_local = len(jax.local_devices())
+assert n_local >= 4
+assert jax.device_count() == 2 * n_local, (jax.device_count(), n_local)
+
+import dataclasses
+import jax.numpy as jnp
+from karpenter_tpu.parallel.mesh import (
+    build_mesh, example_binpack_inputs, example_decision_inputs,
+    fleet_step, shard_binpack_inputs, shard_decision_inputs,
+)
+
+rng = np.random.default_rng(7)
+weights = np.ones(33, np.int32); weights[:4] = 5
+d_in = example_decision_inputs(N=16, M=4)
+b_in = dataclasses.replace(
+    example_binpack_inputs(P_=33, T=8, K=8, L=8),
+    pod_weight=jnp.asarray(weights),
+    pod_group_forbidden=jnp.asarray(rng.random((33, 8)) < 0.3),
+    pod_group_score=jnp.asarray(rng.integers(0, 100, (33, 8)).astype(np.float32)),
+    pod_exclusive=jnp.asarray(rng.random(33) < 0.25),
+)
+# the GLOBAL slice x pods x groups mesh spans both processes
+mesh = build_mesh(n_devices=jax.device_count(), slices=2)
+
+# single-process reference on LOCAL devices over the SAME mesh-padded
+# inputs (identical on both processes by construction: same seeds), so
+# shard indices line up with the padded global shape
+from karpenter_tpu.parallel.mesh import (
+    pad_binpack_inputs_for_mesh, pad_decision_inputs_for_mesh,
+)
+pb_in = pad_binpack_inputs_for_mesh(b_in, mesh)
+pd_in = pad_decision_inputs_for_mesh(d_in, mesh)
+d_ref, b_ref = jax.device_get(fleet_step(pd_in, pb_in, buckets=8))
+gd_in = shard_decision_inputs(mesh, d_in)
+gb_in = shard_binpack_inputs(mesh, b_in)
+d_out, b_out = fleet_step(gd_in, gb_in, buckets=8)
+
+def check(global_arr, ref):
+    for shard in global_arr.addressable_shards:
+        np.testing.assert_array_equal(
+            np.asarray(shard.data), np.asarray(ref[shard.index])
+        )
+
+check(b_out.assigned, np.asarray(b_ref.assigned))       # includes mesh padding rows
+check(d_out.desired, np.asarray(d_ref.desired))
+check(b_out.nodes_needed, np.asarray(b_ref.nodes_needed))
+check(b_out.assigned_count, np.asarray(b_ref.assigned_count))
+print(f"TWOPROC-OK pid={pid}")
+"""
+
+
+def test_two_process_fleet_joins_and_matches_single_process():
+    """THE multi-host seam, exercised with two real processes
+    (coordinator + worker) on the CPU backend: both join via
+    jax.distributed, build one GLOBAL 2-slice mesh over 8 devices split
+    4+4 across the processes, run the collective fleet_step, and every
+    addressable output shard equals the single-process reference
+    (r3 verdict item 5 — the one seam the single-process dryrun cannot
+    prove)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    env = _clean_cpu_env()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _TWO_PROCESS_SCRIPT, str(pid), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=env,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for proc in procs:
+            out, err = proc.communicate(timeout=420)
+            outs.append((proc.returncode, out, err))
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+    for pid, (rc, out, err) in enumerate(outs):
+        assert rc == 0, f"process {pid} failed:\n{err[-3000:]}"
+        assert f"TWOPROC-OK pid={pid}" in out
+    # padding rows equal too: the check covered the full padded arrays
 
 
 def test_no_topology_is_single_host_noop():
@@ -57,12 +172,7 @@ from karpenter_tpu.parallel.multihost import initialize_multihost
 assert initialize_multihost() is False
 print("NOOP-OK")
 """
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PALLAS_AXON_POOL_IPS"] = ""
-    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
-                "JAX_PROCESS_ID"):
-        env.pop(var, None)
+    env = _clean_cpu_env()
     proc = subprocess.run(
         [sys.executable, "-c", script],
         capture_output=True, text=True, timeout=300, env=env,
