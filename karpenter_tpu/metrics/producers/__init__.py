@@ -25,8 +25,8 @@ def profile_from_template(template):
     resource defaults when undeclared, only hard taints constrain."""
     from karpenter_tpu.metrics.producers.pendingcapacity import (
         DEFAULT_PODS_PER_NODE,
-        RESOURCE_PODS,
     )
+    from karpenter_tpu.store.columnar import RESOURCE_PODS
 
     alloc = {r: q.to_float() for r, q in template.allocatable.items()}
     if alloc and alloc.get(RESOURCE_PODS, 0.0) <= 0:

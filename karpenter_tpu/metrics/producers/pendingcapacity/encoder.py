@@ -1,0 +1,421 @@
+"""THE single encoder: store snapshot -> fixed-shape solver operands
+(group arrays, deduplicated weighted shape rows, spread/anti row
+expansions, soft-constraint scores). Output equality across cache
+states is pinned by the oracle suites."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from karpenter_tpu.api.core import (
+    Taint,
+    is_ready_and_schedulable,
+    matches_affinity_shape,
+    matches_selector,
+)
+from karpenter_tpu.ops import binpack as B
+from karpenter_tpu.store.columnar import RESOURCE_PODS
+from karpenter_tpu.utils.functional import pad_to_multiple
+
+from .anti import _expand_anti_rows
+from .constants import (
+    DEFAULT_PODS_PER_NODE,
+    GROUP_PAD,
+    LABEL_PAD,
+    POD_PAD,
+    RESOURCE_PAD,
+    RESOURCES_BASE,
+    TAINT_PAD,
+)
+from .scoring import _score_rows
+from .spread import _expand_spread_rows
+
+_pad = pad_to_multiple
+
+def _group_profile(
+    nodes: List, selector: Dict[str, str]
+) -> Tuple[Dict[str, float], set, set]:
+    """(allocatable by resource name, labels set, taints set) for one group.
+
+    Ready+schedulable nodes define the group's shape; when the group is empty
+    we fall back to any node matching the selector (a group scaled to zero
+    still needs a shape to reason about — a limitation shared with every
+    pending-pods autoscaler that lacks instance-type metadata).
+
+    The shape is the elementwise MIN over candidate nodes (a resource a node
+    lacks counts as 0): in a heterogeneous group, claiming the max across
+    nodes would invent a phantom node shape no real scale-up can deliver,
+    and the signal would demand nodes forever without ever scheduling the
+    pod. Min keeps the promise: any node the group adds can host what we
+    report feasible.
+
+    `nodes` is the full node list (listed ONCE per solve by the caller);
+    selector filtering happens here to avoid O(groups) store scans.
+    """
+    matching = [
+        n for n in nodes if matches_selector(n.metadata.labels, selector)
+    ]
+    ready = [n for n in matching if is_ready_and_schedulable(n)]
+    candidates = ready or matching
+    alloc: Dict[str, float] = {}
+    labels: set = set()
+    taints: set = set()
+    for i, node in enumerate(candidates):
+        node_alloc = {
+            r: q.to_float() for r, q in node.status.allocatable.items()
+        }
+        if i == 0:
+            alloc = node_alloc
+        else:
+            alloc = {
+                r: min(alloc.get(r, 0.0), node_alloc.get(r, 0.0))
+                for r in set(alloc) | set(node_alloc)
+            }
+        node_labels = set(node.metadata.labels.items())
+        labels = node_labels if i == 0 else (labels & node_labels)
+        # only hard taints exclude pods; PreferNoSchedule is a preference
+        # in the kube scheduler, never a constraint
+        taints |= {
+            (t.key, t.value, t.effect)
+            for t in node.spec.taints
+            if t.effect in ("NoSchedule", "NoExecute")
+        }
+    if candidates and alloc.get(RESOURCE_PODS, 0.0) <= 0:
+        alloc[RESOURCE_PODS] = DEFAULT_PODS_PER_NODE
+    return alloc, labels, taints
+
+
+
+
+def _group_arrays(profiles, resources, taint_universe, label_universe,
+                  n_groups, n_resources, n_taints, n_labels):
+    group_allocatable = np.zeros((n_groups, n_resources), np.float32)
+    group_taints = np.zeros((n_groups, n_taints), bool)
+    group_labels = np.zeros((n_groups, n_labels), bool)
+    for t, (alloc, labels, taints) in enumerate(profiles):
+        for r, resource in enumerate(resources):
+            group_allocatable[t, r] = alloc.get(resource, 0.0)
+        for taint, k in taint_universe.items():
+            group_taints[t, k] = taint in taints
+        for item, l in label_universe.items():
+            group_labels[t, l] = item in labels
+    return group_allocatable, group_taints, group_labels
+
+
+def _dedup_rows(snap):
+    """Collapse identical pod rows into (row indices, multiplicities).
+
+    Two pods with the same (requests vector, required labels, toleration
+    shape, validity) are interchangeable to every solver stage — same
+    feasibility row, same first-feasible group, same size bucket — so the
+    solve is exact over distinct shapes weighted by count. This is what
+    makes the device upload O(distinct shapes), not O(pods): fleets are
+    dominated by replicated workloads (Deployments/Jobs stamp identical
+    pod templates).
+
+    Raw-byte uniqueness on the concatenated row bytes: float bit-equality
+    only (never merges distinct values; -0.0 vs 0.0 over-splits, which is
+    merely suboptimal, never wrong).
+
+    Fast path: cache-produced snapshots carry the INCREMENTALLY-maintained
+    dedup (store/columnar.PendingPodCache._dedup_slots) — one rep row +
+    count per distinct live shape, maintained at watch-event time. Only
+    the S rep rows (distinct shapes, fleet-scale constant) are byte-sorted
+    here for deterministic row order; the np.unique-over-all-rows below is
+    the fallback for hand-built snapshots, and was ~60 ms/tick of argsort
+    at 100k pods. The incremental dedup indexes live slots only; free
+    (valid=False, zeroed) rows are dropped rather than collapsed into a
+    zero row — output-equal, since invalid rows never contribute to any
+    solver aggregate.
+    """
+    hi = snap.requests.shape[0]
+    if hi == 0 or (snap.dedup_idx is not None and len(snap.dedup_idx) == 0):
+        # hi > 0 with an empty dedup is the pending set draining to zero
+        # while freed arena rows remain — the normal all-pods-scheduled
+        # state, not an error
+        return np.zeros(0, np.intp), np.zeros(0, np.int32)
+
+    def row_bytes(idx):
+        # idx=slice(None) gives zero-copy views (the arrays are already
+        # contiguous); index arrays (the fast path's rep rows) gather
+        n = hi if isinstance(idx, slice) else len(idx)
+        parts = [
+            np.ascontiguousarray(snap.requests[idx])
+            .view(np.uint8)
+            .reshape(n, -1),
+            np.ascontiguousarray(snap.required[idx])
+            .view(np.uint8)
+            .reshape(n, -1),
+            np.ascontiguousarray(snap.shape_id[idx])
+            .view(np.uint8)
+            .reshape(n, -1),
+            snap.valid[idx].astype(np.uint8).reshape(n, 1),
+        ]
+        if snap.affinity_id is not None:
+            parts.append(
+                np.ascontiguousarray(snap.affinity_id[idx])
+                .view(np.uint8)
+                .reshape(n, -1)
+            )
+        if snap.preferred_id is not None:
+            parts.append(
+                np.ascontiguousarray(snap.preferred_id[idx])
+                .view(np.uint8)
+                .reshape(n, -1)
+            )
+        if snap.spread_id is not None:
+            parts.append(
+                np.ascontiguousarray(snap.spread_id[idx])
+                .view(np.uint8)
+                .reshape(n, -1)
+            )
+        if snap.anti_id is not None:
+            parts.append(
+                np.ascontiguousarray(snap.anti_id[idx])
+                .view(np.uint8)
+                .reshape(n, -1)
+            )
+        if snap.soft_spread_id is not None:
+            parts.append(
+                np.ascontiguousarray(snap.soft_spread_id[idx])
+                .view(np.uint8)
+                .reshape(n, -1)
+            )
+        if snap.soft_anti_id is not None:
+            parts.append(
+                np.ascontiguousarray(snap.soft_anti_id[idx])
+                .view(np.uint8)
+                .reshape(n, -1)
+            )
+        rows = np.ascontiguousarray(np.concatenate(parts, axis=1))
+        return rows.view([("k", np.void, rows.shape[1])]).ravel()
+
+    if snap.dedup_idx is not None:
+        order = np.argsort(row_bytes(snap.dedup_idx))  # O(S log S), S tiny
+        return snap.dedup_idx[order], snap.dedup_weight[order]
+
+    _, idx, counts = np.unique(
+        row_bytes(slice(None)), return_index=True, return_counts=True
+    )
+    return idx, counts.astype(np.int32)
+
+
+
+
+def _resource_universe(snap, profiles):
+    """(resources list, resource_index, pod slot): base resources plus
+    every extended resource seen in requests or allocatable, the 'pods'
+    slot axis always LAST (each pod occupies exactly 1)."""
+    extended = {
+        r for r in snap.resources
+        if r not in RESOURCES_BASE and r != RESOURCE_PODS
+    }
+    for alloc, _, _ in profiles:
+        extended |= {
+            r for r in alloc
+            if r not in RESOURCES_BASE and r != RESOURCE_PODS
+        }
+    resources = [*RESOURCES_BASE, *sorted(extended), RESOURCE_PODS]
+    resource_index = {r: idx for idx, r in enumerate(resources)}
+    return resources, resource_index, resources.index(RESOURCE_PODS)
+
+
+def _pod_arrays(snap, row_idx, row_weight, resources, resource_index,
+                pod_slot, n_pods, n_resources, n_taints, n_labels,
+                taint_universe):
+    """The per-pod solver operands, gathered in bulk from the snapshot:
+    requests, validity, required-label bitset, intolerance bitset (one
+    evaluation per DISTINCT toleration shape, gathered to rows by
+    shape id), and the dedup multiplicities (padding rows weigh
+    nothing)."""
+    hi = len(row_idx)
+    pod_requests = np.zeros((n_pods, n_resources), np.float32)
+    pod_valid = np.zeros(n_pods, bool)
+    pod_required = np.zeros((n_pods, n_labels), bool)
+    pod_intolerant = np.zeros((n_pods, n_taints), bool)
+    pod_weight = np.zeros(n_pods, np.int32)
+    if hi:
+        valid = snap.valid[row_idx]
+        cols = np.array(
+            [resource_index[r] for r in snap.resources], np.intp
+        )
+        pod_requests[:hi, cols] = snap.requests[row_idx]
+        pod_requests[:hi, pod_slot] = valid.astype(np.float32)
+        pod_valid[:hi] = valid
+        pod_weight[:hi] = row_weight
+        if snap.labels:
+            pod_required[:hi, : len(snap.labels)] = snap.required[row_idx]
+        if snap.shape_tolerations:
+            taint_objects = {
+                k: Taint(key=taint[0], value=taint[1], effect=taint[2])
+                for taint, k in taint_universe.items()
+            }
+            rows = np.zeros((len(snap.shape_tolerations), n_taints), bool)
+            for s, tolerations in enumerate(snap.shape_tolerations):
+                for k, taint in taint_objects.items():
+                    rows[s, k] = not any(
+                        tol.tolerates(taint) for tol in tolerations
+                    )
+            pod_intolerant[:hi] = rows[snap.shape_id[row_idx]]
+    return pod_requests, pod_valid, pod_required, pod_intolerant, pod_weight
+
+
+def _affinity_forbidden(snap, row_idx, group_label_dicts, n_pods,
+                        n_groups):
+    """Required node affinity: matchExpression semantics (In/NotIn/
+    Exists/DoesNotExist/Gt/Lt, OR'd terms) don't factor into the
+    conjunctive required-label bitset, so each DISTINCT affinity shape
+    is evaluated host-side against each group's label assignment (the
+    profile label set — the INTERSECTION of node labels, i.e. the same
+    conservative single-node shape the min-allocatable uses;
+    heterogeneous groups may over-admit negative operators, the caveat
+    _group_profile documents for resources) and the S_a x T verdicts
+    gather to rows. None when no pod constrains affinity — the common
+    fleet pays nothing. Gated on LIVE rows (shape id 0 =
+    unconstrained): the shape registry retains entries until
+    compaction, and a long-gone affinity Job must not keep the whole
+    fleet on the masked (extra-operand) kernel path."""
+    hi = len(row_idx)
+    shapes = snap.affinity_shapes
+    live = (
+        snap.affinity_id[row_idx]
+        if hi and snap.affinity_id is not None and shapes is not None
+        else None
+    )
+    if live is None or not (live != 0).any():
+        return None
+    allowed = np.ones((len(shapes), n_groups), bool)
+    for s in np.unique(live):  # only shapes in live use
+        shape = shapes[s]
+        if not shape:
+            continue
+        for t, labels in enumerate(group_label_dicts()):
+            allowed[s, t] = matches_affinity_shape(labels, shape)
+    forbidden = np.zeros((n_pods, n_groups), bool)
+    forbidden[:hi] = ~allowed[live]
+    return forbidden
+
+
+def _encode_from_cache(snap, profiles, with_rows: bool = False, census=None):
+    """Snapshot (store/columnar.PendingSnapshot) -> solver inputs, with
+    rows DEDUPLICATED into distinct pod shapes + multiplicities
+    (pod_weight) — see _dedup_rows. Every solve path (feed, pod_cache,
+    oracle store.list) flows through here, so outputs stay identical
+    across paths by construction.
+
+    All per-pod work here is bulk numpy (column gathers, row gathers by
+    toleration-shape id); the only Python loops left are over universes —
+    resources, group profiles, taints, distinct toleration shapes — whose
+    cardinalities are fleet-scale constants, not pod counts.
+    """
+    # group label dicts: built at most once, shared by the spread
+    # expansion and the affinity/preferred evaluation blocks below
+    label_dicts_box: list = []
+
+    def group_label_dicts():
+        if not label_dicts_box:
+            label_dicts_box.append(
+                [dict(labels) for _, labels, _ in profiles]
+            )
+        return label_dicts_box[0]
+
+    row_idx, row_weight = _dedup_rows(snap)
+    # hard topology spread: constrained rows split into balanced
+    # per-domain sub-rows (same source row gathered more than once, each
+    # chunk masked to its domain's groups) — the device program is
+    # unchanged, spread rides the existing forbidden-mask operand
+    row_idx, row_weight, spread_forbidden = _expand_spread_rows(
+        snap, profiles, row_idx, row_weight, group_label_dicts,
+        census=census,
+    )
+    # required self pod-(anti-)affinity: hostname rows flag the
+    # pod_exclusive operand, domain keys cap one replica per domain
+    # (further sub-row expansion; the spread mask rides through)
+    row_idx, row_weight, spread_forbidden, row_exclusive = (
+        _expand_anti_rows(
+            snap, profiles, row_idx, row_weight, spread_forbidden,
+            group_label_dicts, census=census,
+        )
+    )
+    hi = len(row_idx)
+
+    resources, resource_index, pod_slot = _resource_universe(
+        snap, profiles
+    )
+    n_resources = _pad(len(resources), RESOURCE_PAD)
+
+    taint_universe: Dict[tuple, int] = {}
+    for _, _, taints in profiles:
+        for taint in sorted(taints):
+            if taint not in taint_universe:
+                taint_universe[taint] = len(taint_universe)
+    label_universe = {item: l for l, item in enumerate(snap.labels)}
+
+    n_pods = _pad(hi, POD_PAD)
+    n_groups = _pad(len(profiles), GROUP_PAD)
+    n_taints = _pad(len(taint_universe), TAINT_PAD)
+    n_labels = _pad(len(label_universe), LABEL_PAD)
+
+    (pod_requests, pod_valid, pod_required, pod_intolerant,
+     pod_weight) = _pod_arrays(
+        snap, row_idx, row_weight, resources, resource_index, pod_slot,
+        n_pods, n_resources, n_taints, n_labels, taint_universe,
+    )
+
+    group_allocatable, group_taints, group_labels = _group_arrays(
+        profiles, resources, taint_universe, label_universe,
+        n_groups, n_resources, n_taints, n_labels,
+    )
+
+    pod_group_forbidden = _affinity_forbidden(
+        snap, row_idx, group_label_dicts, n_pods, n_groups
+    )
+
+    # Topology spread + self pod-(anti-)affinity: OR the per-sub-row
+    # masks into the same forbidden operand the affinity path uses
+    # (padding groups are all-zero allocatable and already infeasible,
+    # so mask width T_real suffices)
+    if spread_forbidden is not None:
+        if pod_group_forbidden is None:
+            pod_group_forbidden = np.zeros((n_pods, n_groups), bool)
+        pod_group_forbidden[:hi, : len(profiles)] |= spread_forbidden
+
+    # hostname self-anti-affinity rows take a whole node each — absent
+    # unless some live pod actually carries the constraint
+    pod_exclusive = None
+    if row_exclusive is not None and row_exclusive.any():
+        pod_exclusive = np.zeros(n_pods, bool)
+        pod_exclusive[:hi] = row_exclusive
+
+    # Scoring operand (ops/binpack.py pod_group_score): the kube-
+    # scheduler's scoring plugins modeled over groups — preferred node
+    # affinity, ScheduleAnyway spread, preferred self pod-(anti-)
+    # affinity — absent unless some live pod actually prefers
+    pod_group_score = _score_rows(
+        snap, profiles, row_idx, group_label_dicts, census,
+        n_pods, n_groups,
+    )
+
+    inputs = B.BinPackInputs(
+        pod_requests=pod_requests,
+        pod_valid=pod_valid,
+        pod_intolerant=pod_intolerant,
+        pod_required=pod_required,
+        group_allocatable=group_allocatable,
+        group_taints=group_taints,
+        group_labels=group_labels,
+        pod_weight=pod_weight,
+        pod_group_forbidden=pod_group_forbidden,
+        pod_group_score=pod_group_score,
+        pod_exclusive=pod_exclusive,
+    )
+    if with_rows:
+        # the simulation API maps per-row solver outputs back to pods:
+        # row i of `inputs` gathers snapshot row row_idx[i] (an arena
+        # slot) with multiplicity row_weight[i]
+        return inputs, row_idx, row_weight
+    return inputs
+
+

@@ -155,7 +155,12 @@ def test_two_process_fleet_joins_and_matches_single_process():
     finally:
         for proc in procs:
             if proc.poll() is None:
+                # kill then reap: drain the pipes so a hung join still
+                # leaves its stderr for diagnosis, and no zombie
+                # survives into the rest of the pytest run
                 proc.kill()
+                out, err = proc.communicate()
+                print(f"killed pid={proc.pid} stderr tail:\n{err[-2000:]}")
     for pid, (rc, out, err) in enumerate(outs):
         assert rc == 0, f"process {pid} failed:\n{err[-3000:]}"
         assert f"TWOPROC-OK pid={pid}" in out
