@@ -17,6 +17,9 @@ ci: codegen verify battletest ## Everything the gate runs
 
 test: ## Run the test suite (virtual 8-device CPU mesh)
 	$(PYTHON) -m pytest tests/ -x -q
+	@echo "note: ~300 skips are the battletest-gated tiers (fuzz sweep," \
+		"scale/stress, real-backend/apiserver) — 'make battletest' or" \
+		"'make ci' runs them"
 
 battletest: ## Randomized order + scale + stress + coverage when available (reference: Makefile battletest)
 	@# coverage is opportunistic but NEVER silent: the gate says which
