@@ -93,6 +93,7 @@ def _bind_ctypes(so: str):
     lib = ctypes.CDLL(so)
     lib.karpenter_assign.restype = None
     lib.karpenter_shelf_bfd.restype = None
+    lib.karpenter_pack_bits.restype = None
     return lib
 
 

@@ -201,3 +201,41 @@ void karpenter_assign(
         histogram[best * buckets + (bucket - 1)] += w_of;
     }
 }
+
+/* bool[N, K] row-major (as uint8) -> uint64[N, W] little-endian bit
+ * words — the taint/label operand packer. numpy's packbits pays
+ * per-row overhead on narrow matrices and a full 64-column bool pad on
+ * wide ones (profiled r4: the pack was most of the degraded-mode
+ * solve); one scalar pass is memory-bound and shape-indifferent. */
+void karpenter_pack_bits(
+    long long n, long long k, long long words,
+    const unsigned char *matrix, unsigned long long *out
+) {
+    /* 8 bools at a time: bytes are 0/1 (the caller feeds numpy bool
+     * storage), and for a uint64 of 0/1 bytes the multiply by
+     * 0x0102040810204080 gathers byte i into bit 56+i (all cross terms
+     * land outside bits 56..63 or overflow away) — one load + multiply
+     * + shift packs a byte octet. Each output word accumulates in a
+     * register across its 8 octets before one store. */
+    const unsigned long long GATHER = 0x0102040810204080ull;
+    for (long long i = 0; i < n; i++) {
+        const unsigned char *row = matrix + i * k;
+        unsigned long long *orow = out + i * words;
+        long long j = 0;
+        for (long long w = 0; w < words; w++) {
+            unsigned long long word = 0ull;
+            long long hi = (w + 1) * 64 < k ? (w + 1) * 64 : k;
+            for (; j + 8 <= hi; j += 8) {
+                unsigned long long chunk;
+                __builtin_memcpy(&chunk, row + j, 8);
+                word |= ((chunk * GATHER) >> 56) << (unsigned)(j & 63);
+            }
+            for (; j < hi; j++) {
+                if (row[j]) {
+                    word |= 1ull << (unsigned)(j & 63);
+                }
+            }
+            orow[w] = word;
+        }
+    }
+}

@@ -42,11 +42,37 @@ def _as_np(x, dtype=None):
     return arr if dtype is None else arr.astype(dtype, copy=False)
 
 
-def _pack_bits(matrix: np.ndarray) -> np.ndarray:
+def _pack_bits(matrix: np.ndarray, lib=None) -> np.ndarray:
     """bool[N, K] -> uint64[N, W] little-endian bit words (the native
-    kernel's taint/label operand layout)."""
+    kernel's taint/label operand layout). With the native lib, one
+    scalar C pass (memory-bound, shape-indifferent) replaces
+    np.packbits, which pays per-row overhead on narrow matrices and a
+    full 64-column bool pad on wide ones — the pack was most of the
+    degraded-mode solve before this (profiled r4)."""
     n, k = matrix.shape
     words = max(1, -(-k // 64))
+    if lib is not None and n and k:
+        import ctypes
+
+        src = np.asarray(matrix)
+        if src.dtype != np.bool_:
+            # the C octet-gather needs strictly 0/1 bytes
+            src = src != 0
+        src = (
+            # bool and uint8 share layout: view, don't cast-copy
+            src.view(np.uint8)
+            if src.flags.c_contiguous
+            else np.ascontiguousarray(src).view(np.uint8)
+        )
+        out = np.empty((n, words), np.uint64)
+        lib.karpenter_pack_bits(
+            ctypes.c_longlong(n),
+            ctypes.c_longlong(k),
+            ctypes.c_longlong(words),
+            src.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        )
+        return out
     padded = np.zeros((n, words * 64), bool)
     padded[:, :k] = matrix
     return np.ascontiguousarray(
@@ -65,10 +91,10 @@ def _assign_native(
 
     n_pods, n_resources = requests.shape
     n_groups = alloc.shape[0]
-    intolerant_words = _pack_bits(intolerant)
-    taint_words = _pack_bits(taints)
-    required_words = _pack_bits(required)
-    missing_words = _pack_bits(~labels)
+    intolerant_words = _pack_bits(intolerant, lib)
+    taint_words = _pack_bits(taints, lib)
+    required_words = _pack_bits(required, lib)
+    missing_words = _pack_bits(~labels, lib)
 
     assigned = np.empty(n_pods, np.int32)
     assigned_count = np.zeros(n_groups, np.int64)

@@ -123,9 +123,14 @@ def build_fleet(rng):
                     )
                 )
             )
-    # bound pods: random apps on random group nodes, random namespaces
+    # bound pods: random apps on random group nodes, random namespaces;
+    # some carry a tier label so the same-key different-selector spread
+    # dimension sees imbalanced tier counts
     for i in range(int(rng.integers(0, 12))):
         app = APPS[int(rng.integers(0, len(APPS)))]
+        labels = {"app": app}
+        if rng.random() < 0.4:
+            labels["tier"] = f"t{int(rng.integers(0, 2))}"
         store.create(
             Pod(
                 metadata=ObjectMeta(
@@ -133,7 +138,7 @@ def build_fleet(rng):
                     namespace=rng.choice(
                         ["default", "team-a", "team-b"]
                     ),
-                    labels={"app": app},
+                    labels=labels,
                 ),
                 spec=PodSpec(
                     node_name=f"n{int(rng.integers(0, n_groups))}",
@@ -147,23 +152,50 @@ def build_fleet(rng):
     return store, groups
 
 
-def random_workload(rng, widx):
-    """(pods, spec dict describing the constraints for the validator)."""
+def random_workload(rng, widx, tier_skew=None):
+    """(pods, spec dict describing the constraints for the validator).
+
+    tier_skew (run-level, from _run_seed): when set, WORKLOAD 0 carries
+    a SECOND zone DoNotSchedule constraint selecting the shared tier
+    label — the same-topology-key different-selector class whose skew
+    must bind against the tier's own census counts (r3 advisor, medium
+    — fixed r4; bound pods with tier labels supply the imbalance).
+    Only ONE workload is constrained: tier-matching PENDING pods of
+    other workloads are a pending-vs-pending interaction the solver
+    documents as out of scope (each workload's shape has its own
+    ledgers), and the oracle orders the constrained workload first, so
+    its bound counts only bound pods plus its own adds.
+    """
     app = f"w{widx}"
     count = int(rng.integers(1, 6))
+    # a tier label SHARED across workloads (two tiers)
+    tier = f"t{widx % 2}"
+    tier_skew = tier_skew if widx == 0 else None
     spec = {
         "app": app,
+        "tier": tier,
         "spread": None,
         "min_domains": None,
         "rack_spread": None,
+        "tier_spread": tier_skew,
         "self_anti": False,
         "self_anti_rack": False,
         "self_co": False,
+        "self_co_extra_ns": None,
         "foreign": [],
     }
     constraints = []
     anti_terms = []
     co_terms = []
+    if tier_skew is not None:
+        constraints.append(
+            TopologySpreadConstraint(
+                max_skew=tier_skew,
+                topology_key=ZONE,
+                when_unsatisfiable="DoNotSchedule",
+                label_selector={"matchLabels": {"tier": tier}},
+            )
+        )
     if rng.random() < 0.6:
         skew = int(rng.integers(1, 3))
         spec["spread"] = skew
@@ -191,6 +223,22 @@ def random_workload(rng, widx):
                     label_selector={"matchLabels": {"app": app}},
                 )
             )
+    if rng.random() < 0.2:
+        # soft constraints never constrain, so no validator rule — but
+        # mixed nil/set selector forms crashed the whole solve before
+        # _total_order (r3 advisor, high; fixed r4)
+        constraints.append(
+            TopologySpreadConstraint(
+                max_skew=1,
+                topology_key=RACK,
+                when_unsatisfiable="ScheduleAnyway",
+                label_selector=(
+                    None
+                    if rng.random() < 0.5
+                    else {"matchLabels": {"app": app}}
+                ),
+            )
+        )
     if rng.random() < 0.4:
         spec["self_anti"] = True
         anti_terms.append(
@@ -211,12 +259,17 @@ def random_workload(rng, widx):
             )
     elif rng.random() < 0.3:
         spec["self_co"] = True
-        co_terms.append(
-            PodAffinityTerm(
-                label_selector=LabelSelector(match_labels={"app": app}),
-                topology_key=ZONE,
-            )
+        term = PodAffinityTerm(
+            label_selector=LabelSelector(match_labels={"app": app}),
+            topology_key=ZONE,
         )
+        if rng.random() < 0.4:
+            # the term reaches an EXTRA namespace: matching pods THERE
+            # pin the scheduler even when the own namespace is empty
+            # (r3 advisor, low — fixed r4 with the sign +2 projection)
+            spec["self_co_extra_ns"] = "team-a"
+            term.namespaces = ["default", "team-a"]
+        co_terms.append(term)
     if rng.random() < 0.5:
         target = APPS[int(rng.integers(0, len(APPS)))]
         sign = "anti" if rng.random() < 0.6 else "co"
@@ -255,7 +308,8 @@ def random_workload(rng, widx):
         pods.append(
             Pod(
                 metadata=ObjectMeta(
-                    name=f"{app}-{i}", labels={"app": app}
+                    name=f"{app}-{i}",
+                    labels={"app": app, "tier": tier},
                 ),
                 spec=PodSpec(
                     node_name="",
@@ -325,6 +379,53 @@ def scopes_zones(store, bound, target, scope):
     return zones, True
 
 
+def _validate_tier_spread(store, workloads, promised, present_zones,
+                          rng_label):
+    """SAME topology key, DIFFERENT selector: workload 0's tier
+    constraint binds against the TIER's own census counts (bound pods
+    with tier labels supply the imbalance the app selector doesn't
+    see). Sound rule under a w0-first placement order: counts = bound
+    tier-matching pods + w0's own adds (other workloads' pending
+    tier-carrying pods may be placed later and are not counted); for
+    any zone that received a w0 add, the last add there required
+    count - running_min <= skew with the running min only growing, so
+    final[z] - final_min <= skew. Zones holding only pre-existing
+    excess are unconstrained (legal initial imbalance)."""
+    spec0 = workloads[0]
+    skew = spec0["tier_spread"]
+    if not skew:
+        return
+    tier = spec0["tier"]
+    node_zone = {
+        n.metadata.name: n.metadata.labels.get(ZONE)
+        for n in store.list("Node")
+    }
+    final = {z: 0 for z in present_zones}
+    for pod in store.list("Pod"):
+        if (
+            pod.spec.node_name
+            and pod.status.phase not in ("Succeeded", "Failed")
+            and pod.metadata.namespace == "default"
+            and pod.metadata.labels.get("tier") == tier
+        ):
+            zone = node_zone.get(pod.spec.node_name)
+            if zone in final:
+                final[zone] += 1
+    added = set()
+    for z, _ in promised.get(spec0["app"], []):
+        final[z] += 1
+        added.add(z)
+    if not added:
+        return
+    floor = min(final.values())
+    for zone in added:
+        assert final[zone] - floor <= skew, (
+            f"[{rng_label}] tier {tier}: promised zone {zone} at "
+            f"{final[zone]} exceeds min {floor} + skew {skew}; "
+            f"final={final}"
+        )
+
+
 def validate(store, groups, workloads, report, rng_label):  # lint: allow-complexity — one block per scheduler rule, the whole scalar oracle in one place
     """Assert every promised placement admissible; returns promised count."""
     bound = bound_index(store)
@@ -353,6 +454,9 @@ def validate(store, groups, workloads, report, rng_label):  # lint: allow-comple
         for n in store.list("Node")
         if RACK in n.metadata.labels
     }
+    _validate_tier_spread(
+        store, workloads, promised, present_zones, rng_label
+    )
     for spec in workloads:
         app = spec["app"]
         placed_pairs = promised.get(app, [])
@@ -412,6 +516,16 @@ def validate(store, groups, workloads, report, rng_label):  # lint: allow-comple
                 )
         if spec["self_co"] and placed:
             existing = set(z for z, _ in bound_pairs)
+            if spec["self_co_extra_ns"]:
+                # the term's namespaces list reaches a second
+                # namespace: matching pods THERE pin placement too
+                # (r3 advisor, low — fixed r4)
+                existing |= {
+                    z
+                    for z, _ in bound.get(
+                        (spec["self_co_extra_ns"], app), []
+                    )
+                }
             if existing:
                 assert set(placed) <= existing, (
                     f"[{rng_label}] {app}: co replicas outside "
@@ -446,12 +560,35 @@ def _run_seed(seed, max_workloads=3):
     n_groups = len(groups)
     workloads = []
     pending_total = 0
+    # run-level same-key different-selector dimension (one shared skew
+    # keeps the tier oracle sound — random_workload docstring)
+    tier_skew = int(rng.integers(1, 3)) if rng.random() < 0.25 else None
     for widx in range(int(rng.integers(1, max_workloads + 1))):
-        pods, spec = random_workload(rng, widx)
+        pods, spec = random_workload(rng, widx, tier_skew=tier_skew)
         workloads.append(spec)
         pending_total += len(pods)
         for pod in pods:
             store.create(pod)
+        if spec["self_co_extra_ns"] and rng.random() < 0.6:
+            # a TWIN of this workload already runs in the extra
+            # namespace: the scheduler pins the co term to its domain
+            # even though the own namespace is empty (r4 low fix)
+            store.create(
+                Pod(
+                    metadata=ObjectMeta(
+                        name=f"{spec['app']}-twin",
+                        namespace=spec["self_co_extra_ns"],
+                        labels={"app": spec["app"]},
+                    ),
+                    spec=PodSpec(
+                        node_name=f"n{int(rng.integers(0, n_groups))}",
+                        containers=[
+                            Container(requests=resource_list(cpu="1"))
+                        ],
+                    ),
+                    status=PodStatus(phase="Running"),
+                )
+            )
         if rng.random() < 0.3:
             # the workload already RUNS one replica somewhere: the own
             # workload's census paths (co pinning, anti-spent domains,
@@ -460,7 +597,8 @@ def _run_seed(seed, max_workloads=3):
                 Pod(
                     metadata=ObjectMeta(
                         name=f"{spec['app']}-live",
-                        labels={"app": spec["app"]},
+                        labels={"app": spec["app"],
+                                "tier": spec["tier"]},
                     ),
                     spec=PodSpec(
                         node_name=f"n{int(rng.integers(0, n_groups))}",
