@@ -167,6 +167,100 @@ def test_two_process_fleet_joins_and_matches_single_process():
     # padding rows equal too: the check covered the full padded arrays
 
 
+def test_two_sidecar_fleet_joins_and_serves():
+    """The DEPLOYMENT contract (docs/OPERATIONS.md 'Scaling past one
+    chip'): one solver sidecar per host, `--multihost`, topology from
+    the standard env. Two real `python -m karpenter_tpu.sidecar`
+    processes join one jax.distributed fleet; the coordinator's Health
+    reports the GLOBAL device count (both processes' devices) and its
+    Solve RPC answers identically to an in-process solve."""
+    import json
+    import socket
+
+    import numpy as np
+
+    ports = []
+    for _ in range(3):  # coordinator + two gRPC ports
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            ports.append(s.getsockname()[1])
+    coord, grpc0, grpc1 = ports
+    procs = []
+    try:
+        for pid, gport in ((0, grpc0), (1, grpc1)):
+            env = _clean_cpu_env()
+            # pin the per-process device count so the global-count
+            # assertion below can DISTINGUISH a joined fleet (8) from a
+            # lone sidecar that failed to join (4)
+            env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+            env["JAX_COORDINATOR_ADDRESS"] = f"localhost:{coord}"
+            env["JAX_NUM_PROCESSES"] = "2"
+            env["JAX_PROCESS_ID"] = str(pid)
+            procs.append(
+                subprocess.Popen(
+                    [
+                        # -u: the banner must not sit in a block buffer
+                        sys.executable, "-u", "-m",
+                        "karpenter_tpu.sidecar",
+                        "--multihost", "--host", "127.0.0.1",
+                        "--port", str(gport),
+                    ],
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                    text=True,
+                    env=env,
+                )
+            )
+        # wait for the coordinator's serving banner: select-based so a
+        # stuck coordinator trips the deadline and a crashed one fails
+        # fast with its stderr (drained in the finally block)
+        import select
+        import time
+
+        deadline = time.monotonic() + 120
+        banner = None
+        while time.monotonic() < deadline and banner is None:
+            if procs[0].poll() is not None:
+                break  # coordinator died; finally drains its stderr
+            ready, _, _ = select.select([procs[0].stdout], [], [], 0.5)
+            if ready:
+                line = procs[0].stdout.readline()
+                if line:
+                    banner = json.loads(line)
+        assert banner and banner["serving"].endswith(str(grpc0)), (
+            f"no serving banner (coordinator rc={procs[0].poll()})"
+        )
+
+        from karpenter_tpu.ops.binpack import solve
+        from karpenter_tpu.parallel.mesh import example_binpack_inputs
+        from karpenter_tpu.sidecar.client import SolverClient
+
+        client = SolverClient(f"127.0.0.1:{grpc0}", timeout_seconds=60.0)
+        ok, health = client.health()
+        assert ok
+        # the coordinator sees the GLOBAL device set (4 local + 4 from
+        # the worker); a lone sidecar that failed to join would see 4
+        assert health["device_count"] == 8, health
+
+        inputs = example_binpack_inputs(P_=64, T=8)
+        remote = client.solve(inputs, buckets=8)
+        local = solve(inputs, buckets=8)
+        np.testing.assert_array_equal(
+            np.asarray(remote.assigned), np.asarray(local.assigned)
+        )
+        assert int(remote.unschedulable) == int(local.unschedulable)
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+            # drain ALIVE and DEAD alike: a crashed sidecar's stderr is
+            # the diagnostic for why the banner never came
+            _out, err = proc.communicate()
+            tail = err[-1500:] if err else ""
+            print(f"sidecar pid={proc.pid} rc={proc.returncode} "
+                  f"stderr tail:\n{tail}")
+
+
 def test_no_topology_is_single_host_noop():
     """Without a coordinator/env topology on a non-TPU host, the seam
     reports False and the caller proceeds single-host. Runs in a fresh
