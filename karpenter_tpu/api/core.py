@@ -449,11 +449,18 @@ def pod_affinity_shape(
     - affinity (co-location) on non-hostname keys -> all replicas in
       ONE domain: groups must expose the key single-valued, and the
       solver's whole-row-to-one-group assignment provides the rest.
-      hostname co-location (all replicas on one NODE) cannot be
-      promised by a group-level pack and stays out of scope.
+      hostname co-location (all replicas on one NODE) is modeled
+      CONSERVATIVELY: with a matching scheduled pod anywhere in scope,
+      new replicas are pinned to its existing node — honestly
+      unschedulable on a scale-up (the sign +2 projection below); with
+      none, the first-replica bootstrap admits ONE promised replica
+      and the rest are reported unschedulable, since replicas beyond
+      the first must join the first's node and a group-level pack
+      cannot promise single-node co-residence.
 
     Returns () when unconstrained, else
-    (hostname_exclusive, anti_keys, co_keys, ident, foreign) where
+    (flags, anti_keys, co_keys, ident, foreign) where flags bit 0 is
+    hostname anti (exclusive rows) and bit 1 hostname co, and where
     ident is the WORKLOAD IDENTITY: the pod's namespace plus the
     canonical forms of the self-matching domain-relevant selectors. Two
     pods share an anti-group iff they match each other's selectors;
@@ -487,20 +494,25 @@ def pod_affinity_shape(
         anti_required, labels, namespace, assume_ns_selector=True
     )
     co_terms = _self_matching_terms(co_required, labels, namespace)
-    hostname_exclusive = any(
-        t.topology_key == HOSTNAME_TOPOLOGY_KEY for t in anti_terms
+    # shape[0] is a FLAGS field: bit 0 = hostname ANTI (one replica per
+    # node, the pod_exclusive operand), bit 1 = hostname CO (all
+    # replicas on one node — census-pinned via the sign +2 foreign
+    # projection, bootstrap capped to one promised replica)
+    flags = int(
+        any(t.topology_key == HOSTNAME_TOPOLOGY_KEY for t in anti_terms)
+    ) | (
+        2
+        if any(
+            t.topology_key == HOSTNAME_TOPOLOGY_KEY for t in co_terms
+        )
+        else 0
     )
     anti_keys = _domain_keys(anti_terms)
     co_keys = _domain_keys(co_terms)
     foreign = _foreign_terms(
         anti_required, co_required, namespace, anti_terms, co_terms
     )
-    if (
-        not hostname_exclusive
-        and not anti_keys
-        and not co_keys
-        and not foreign
-    ):
+    if not flags and not anti_keys and not co_keys and not foreign:
         return ()
     ident = (
         (
@@ -518,7 +530,7 @@ def pod_affinity_shape(
         if anti_keys or co_keys
         else ()
     )
-    return (int(hostname_exclusive), anti_keys, co_keys, ident, foreign)
+    return (flags, anti_keys, co_keys, ident, foreign)
 
 
 def _foreign_terms(anti_required, co_required, namespace, anti_terms, co_terms):  # lint: allow-complexity — one guard per k8s term rule (selector/nsSelector/hostname/own-vs-extra namespaces)
@@ -595,16 +607,21 @@ def _foreign_terms(anti_required, co_required, namespace, anti_terms, co_terms):
                              _selector_form(t.label_selector),
                              ("names", extra))
                         )
-                elif extra:
+                elif extra or t.topology_key == HOSTNAME_TOPOLOGY_KEY:
                     # self co terms never carry a namespaceSelector
                     # (_self_matching_terms filters those for CO), so
                     # the scope is always an explicit name list here.
-                    # Hostname keys project too: a matching pod in a
-                    # foreign in-scope namespace pins the pod to an
-                    # EXISTING node, which a scale-up's fresh nodes can
-                    # never satisfy — the census handler marks the row
-                    # honestly unschedulable (empty census keeps the
-                    # first-replica grace, same as domain keys).
+                    # Hostname keys ALWAYS project (even with no extra
+                    # namespaces): a matching pod anywhere in scope pins
+                    # new replicas to its EXISTING node, which a
+                    # scale-up's fresh nodes can never satisfy — the
+                    # census handler marks the row honestly
+                    # unschedulable, while an empty census keeps the
+                    # first-replica grace (the bootstrap itself is
+                    # capped to ONE promised replica by the anti
+                    # expansion — replicas beyond the first must join
+                    # the first's node, which a group-level pack cannot
+                    # promise).
                     out.add(
                         (2, t.topology_key,
                          _selector_form(t.label_selector),

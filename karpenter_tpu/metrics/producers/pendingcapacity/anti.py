@@ -110,7 +110,9 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
         shape = shapes[s]
         if not shape:
             continue
-        hostname_excl, anti_keys, co_keys, ident, foreign = shape
+        flags, anti_keys, co_keys, ident, foreign = shape
+        hostname_excl = bool(flags & 1)
+        hostname_co = bool(flags & 2)
         excluded, blocked, co_allowed = _anti_base_exclusion(
             shape, census, label_dicts, n_groups
         )
@@ -165,7 +167,7 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
             # spread caps anticipated); single-row workloads keep full
             # group freedom
             excluded = _co_pin(excluded, label_dicts, co_keys, n_groups)
-        plan[int(s)] = (domains, excluded, bool(hostname_excl))
+        plan[int(s)] = (domains, excluded, hostname_excl, hostname_co)
 
     def row_spread_view(i):
         """Partition view + shared ledger for an anti-split row's SKIPPED
@@ -236,6 +238,26 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
                 mine.append(rank)
             picks[i] = mine
 
+    # hostname CO bootstrap cap: ONE promised replica per workload
+    # (replicas beyond the first must join the first's node, which a
+    # group-level pack cannot promise; with an occupied census the +2
+    # foreign projection already forbade every group). The single
+    # promise goes to the CANONICALLY-first row so every encode path
+    # hands it out identically (the domain hand-out's path-stability
+    # rule).
+    co_budget_row: Dict[int, int] = {}
+    for s, entry in plan.items():
+        if entry[3]:
+            rows_i = [i for i, s2 in enumerate(live_ids) if int(s2) == s]
+            co_budget_row[s] = (
+                min(
+                    rows_i,
+                    key=lambda i: _canonical_row_key(snap, row_idx[i]),
+                )
+                if len(rows_i) > 1
+                else rows_i[0]
+            )
+
     out_idx, out_weight, out_forbidden, out_exclusive = [], [], [], []
     for i, sid in enumerate(live_ids):
         prior = (
@@ -250,7 +272,7 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
             out_forbidden.append(prior)
             out_exclusive.append(False)
             continue
-        domains, excluded, hostname_excl = entry
+        domains, excluded, hostname_excl, hostname_co = entry
         excluded = excluded | prior
         if i in row_views and row_views[i][0]["dead"] is not None:
             # partial-dead domains stay usable through their live
@@ -258,6 +280,23 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
             excluded |= row_views[i][0]["dead"]
         weight = int(row_weight[i])
         if domains is None:
+            if hostname_co:
+                take = (
+                    min(1, weight)
+                    if co_budget_row.get(int(sid)) == i
+                    else 0
+                )
+                if take:
+                    out_idx.append(row_idx[i])
+                    out_weight.append(np.int32(take))
+                    out_forbidden.append(excluded)
+                    out_exclusive.append(hostname_excl)
+                if weight > take:
+                    out_idx.append(row_idx[i])
+                    out_weight.append(np.int32(weight - take))
+                    out_forbidden.append(np.ones(n_groups, bool))
+                    out_exclusive.append(hostname_excl)
+                continue
             # hostname/co-location only: no split, mask + flag ride along
             out_idx.append(row_idx[i])
             out_weight.append(row_weight[i])
@@ -265,6 +304,9 @@ def _expand_anti_rows(  # lint: allow-complexity — per-domain capping: each gu
             out_exclusive.append(hostname_excl)
             continue
         mine = picks[i]
+        if hostname_co:
+            # one replica total: only the budget row places, one domain
+            mine = mine[:1] if co_budget_row.get(int(sid)) == i else []
         view_ledger = row_views.get(i)
         placed = 0
         # content-keyed, invariant across this row's ranks (arena

@@ -1602,6 +1602,77 @@ class TestForeignAffinityOccupancy:
         assert sum(counts.values()) == 1
         assert total_unschedulable(runtime, "group-a") == 1
 
+    def test_hostname_self_co_pins_to_existing_node(self, env):
+        """Required self co-location on kubernetes.io/hostname with a
+        matching scheduled pod: new replicas must join its EXISTING
+        node, which no scale-up's fresh node can satisfy — honestly
+        unschedulable (was silently unconstrained before r4)."""
+        runtime, _ = env
+        zoned(runtime, zones=("a", "b"))
+        runtime.store.create(bound_pod("db-live", {"app": "db"}, "n-a"))
+        for i in range(2):
+            runtime.store.create(
+                anti_pod(f"db-{i}", keys=(),
+                         co_keys=("kubernetes.io/hostname",))
+            )
+        runtime.manager.reconcile_all()
+        counts = pods_per_group(runtime, ["group-a", "group-b"])
+        assert sum(counts.values()) == 0
+        assert total_unschedulable(runtime, "group-a") == 2
+
+    def test_hostname_self_co_bootstrap_promises_one(self, env):
+        """With NO matching pod anywhere, the first replica bootstraps
+        onto a fresh node — but replicas beyond the first must join
+        ITS node, which a group-level pack cannot promise: exactly one
+        replica is promised, the rest honestly unschedulable."""
+        runtime, _ = env
+        zoned(runtime, zones=("a", "b"))
+        for i in range(3):
+            runtime.store.create(
+                anti_pod(f"db-{i}", keys=(),
+                         co_keys=("kubernetes.io/hostname",))
+            )
+        runtime.manager.reconcile_all()
+        counts = pods_per_group(runtime, ["group-a", "group-b"])
+        assert sum(counts.values()) == 1
+        assert total_unschedulable(runtime, "group-a") == 2
+
+    def test_hostname_self_co_multi_row_promises_one_total(self, env):
+        """A hostname-co workload split across request-distinct rows
+        (mid-VPA rollout): the single bootstrap promise is handed to
+        the canonically-first row — one replica total, never one per
+        row."""
+        runtime, _ = env
+        zoned(runtime, zones=("a", "b"))
+        for i, cpu in enumerate(("1", "2", "2", "1")):
+            pod = anti_pod(f"db-{i}", keys=(),
+                           co_keys=("kubernetes.io/hostname",))
+            pod.spec.containers[0].requests = resource_list(
+                cpu=cpu, memory="1Gi"
+            )
+            runtime.store.create(pod)
+        runtime.manager.reconcile_all()
+        counts = pods_per_group(runtime, ["group-a", "group-b"])
+        assert sum(counts.values()) == 1
+        assert total_unschedulable(runtime, "group-a") == 3
+
+    def test_hostname_self_co_with_zone_anti_promises_one(self, env):
+        """Required zone ANTI-affinity (one per zone) combined with
+        required hostname CO-location (all on one node) is contradictory
+        beyond a single replica: the per-domain hand-out is truncated to
+        ONE promise total, never one per anti domain."""
+        runtime, _ = env
+        zoned(runtime, zones=("a", "b"))
+        for i in range(3):
+            runtime.store.create(
+                anti_pod(f"db-{i}", keys=(ZONE_KEY,),
+                         co_keys=("kubernetes.io/hostname",))
+            )
+        runtime.manager.reconcile_all()
+        counts = pods_per_group(runtime, ["group-a", "group-b"])
+        assert sum(counts.values()) == 1
+        assert total_unschedulable(runtime, "group-a") == 2
+
     def test_none_namespaces_field_is_tolerated(self):
         """namespaces: null hydrates to None — the shape build must not
         crash (r3 code review)."""

@@ -181,6 +181,7 @@ def random_workload(rng, widx, tier_skew=None):
         "self_anti": False,
         "self_anti_rack": False,
         "self_co": False,
+        "self_co_hostname": False,
         "self_co_extra_ns": None,
         "foreign": [],
     }
@@ -257,6 +258,31 @@ def random_workload(rng, widx, tier_skew=None):
                     topology_key=RACK,
                 )
             )
+        if rng.random() < 0.15:
+            # anti + hostname co TOGETHER: contradictory beyond one
+            # replica — the hand-out must truncate to one promise
+            # total (reachable combination, r4 code review)
+            spec["self_co_hostname"] = True
+            co_terms.append(
+                PodAffinityTerm(
+                    label_selector=LabelSelector(
+                        match_labels={"app": app}
+                    ),
+                    topology_key="kubernetes.io/hostname",
+                )
+            )
+    elif rng.random() < 0.15:
+        # hostname self co-location: all replicas on ONE node — with a
+        # matching scheduled pod it pins to that existing node
+        # (unschedulable on scale-up); empty census bootstraps exactly
+        # one promised replica (r4 conservative modeling)
+        spec["self_co_hostname"] = True
+        co_terms.append(
+            PodAffinityTerm(
+                label_selector=LabelSelector(match_labels={"app": app}),
+                topology_key="kubernetes.io/hostname",
+            )
+        )
     elif rng.random() < 0.3:
         spec["self_co"] = True
         term = PodAffinityTerm(
@@ -535,6 +561,19 @@ def validate(store, groups, workloads, report, rng_label):  # lint: allow-comple
                 assert len(set(placed)) == 1, (
                     f"[{rng_label}] {app}: bootstrap co split across "
                     f"{set(placed)}"
+                )
+        if spec["self_co_hostname"]:
+            # one-node co-residence: at most ONE promised replica, and
+            # none at all when a matching scheduled pod already pins
+            # the workload to its existing node
+            assert len(placed) <= 1, (
+                f"[{rng_label}] {app}: {len(placed)} replicas promised "
+                f"under hostname self co-location"
+            )
+            if bound_pairs:
+                assert not placed, (
+                    f"[{rng_label}] {app}: promised {placed} despite a "
+                    f"scheduled matching pod pinning the node"
                 )
         for sign, target, scope in spec["foreign"]:
             occupied, judgeable = scopes_zones(
