@@ -35,7 +35,7 @@ def profile_from_template(template):
     taints = {
         (t.key, t.value, t.effect)
         for t in template.taints
-        if t.effect in ("NoSchedule", "NoExecute")
+        if t.effect in ("NoSchedule", "NoExecute", "PreferNoSchedule")
     }
     return alloc, labels, taints
 

@@ -75,12 +75,15 @@ def _group_profile(
             }
         node_labels = set(node.metadata.labels.items())
         labels = node_labels if i == 0 else (labels & node_labels)
-        # only hard taints exclude pods; PreferNoSchedule is a preference
-        # in the kube scheduler, never a constraint
+        # hard taints (NoSchedule/NoExecute) exclude pods;
+        # PreferNoSchedule is carried too but only SCORES (the
+        # kube-scheduler's TaintToleration plugin — scoring.py): the
+        # encoder's intolerance bitset filters to hard effects
         taints |= {
             (t.key, t.value, t.effect)
             for t in node.spec.taints
-            if t.effect in ("NoSchedule", "NoExecute")
+            if t.effect
+            in ("NoSchedule", "NoExecute", "PreferNoSchedule")
         }
     if candidates and alloc.get(RESOURCE_PODS, 0.0) <= 0:
         alloc[RESOURCE_PODS] = DEFAULT_PODS_PER_NODE
@@ -349,6 +352,11 @@ def _encode_from_cache(snap, profiles, with_rows: bool = False, census=None):
     taint_universe: Dict[tuple, int] = {}
     for _, _, taints in profiles:
         for taint in sorted(taints):
+            # only HARD taints join the intolerance bitset; soft
+            # (PreferNoSchedule) taints ride the profiles into the
+            # scoring plugin and must never gate feasibility
+            if taint[2] == "PreferNoSchedule":
+                continue
             if taint not in taint_universe:
                 taint_universe[taint] = len(taint_universe)
     label_universe = {item: l for l, item in enumerate(snap.labels)}

@@ -6,7 +6,34 @@ from __future__ import annotations
 
 import numpy as np
 
-from karpenter_tpu.api.core import preference_score
+from karpenter_tpu.api.core import Taint, preference_score
+
+
+def _taint_toleration_raw(snap, profiles, row_idx, n_real):
+    """TaintToleration plugin: groups with FEWER PreferNoSchedule
+    taints the pod does not tolerate rank higher (soft taints never
+    gate feasibility — the encoder keeps them out of the intolerance
+    bitset). One evaluation per DISTINCT toleration shape, gathered to
+    rows by shape id. None when no group carries a soft taint — the
+    common fleet pays nothing."""
+    soft = [
+        [
+            Taint(key=k, value=v, effect=e)
+            for (k, v, e) in sorted(taints)
+            if e == "PreferNoSchedule"
+        ]
+        for _, _, taints in profiles
+    ]
+    if not any(soft):
+        return None
+    shapes = snap.shape_tolerations
+    raw = np.zeros((len(shapes), n_real), np.float32)
+    for s, tolerations in enumerate(shapes):
+        for t, group_soft in enumerate(soft):
+            for taint in group_soft:
+                if not any(tol.tolerates(taint) for tol in tolerations):
+                    raw[s, t] -= 1.0
+    return raw[snap.shape_id[row_idx]]
 
 
 def _live_ids(snap_ids, shapes, row_idx):
@@ -98,17 +125,26 @@ def _score_rows(
     - InterPodAffinity (weight 1): preferred self-(anti-)affinity
       terms add sign x weight per existing matching pod in the
       group's domain.
+    - TaintToleration (weight 3): groups with fewer PreferNoSchedule
+      taints the pod does not tolerate rank higher.
 
-    Returns None when no live row carries any preference — the common
-    fleet skips the score operand entirely. census=None (hand-built
-    snapshots) scores with zero counts: spread still ranks keyless
-    groups last; inter-pod terms contribute nothing.
+    Returns None when no live row carries any preference AND no group
+    carries a soft taint — the common fleet skips the score operand
+    entirely. census=None (hand-built snapshots) scores with zero
+    counts: spread still ranks keyless groups last; inter-pod terms
+    contribute nothing.
     """
     hi = len(row_idx)
     if hi == 0:
         return None
     n_real = len(profiles)
     pieces = []  # (plugin weight, raw[hi, n_real])
+
+    taint_raw = _taint_toleration_raw(snap, profiles, row_idx, n_real)
+    if taint_raw is not None and taint_raw.any():
+        # all-zero contributions (every pod tolerates every soft taint)
+        # must not put the fleet on the scored kernel path
+        pieces.append((3.0, taint_raw))
 
     live = _live_ids(snap.preferred_id, snap.preferred_shapes, row_idx)
     if live is not None:

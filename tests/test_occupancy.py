@@ -1238,6 +1238,87 @@ class TestSoftConstraintScoring:
     pod_group_score contributions — the kube-scheduler's scoring
     plugins, steering but never constraining."""
 
+    def test_prefer_no_schedule_taint_steers_but_never_blocks(self, env):
+        """The TaintToleration scoring plugin: a PreferNoSchedule taint
+        steers intolerant pods to the untainted group; a tolerating pod
+        is indifferent (index tie-break); and with ONLY the tainted
+        group present the pods still schedule — a preference, never a
+        constraint."""
+        from karpenter_tpu.api.core import Taint, Toleration
+
+        runtime, _ = env
+        soft = Taint(key="burst", value="spot", effect="PreferNoSchedule")
+        tainted = ready_node("n-a", {"group": "a"})
+        tainted.spec.taints = [soft]
+        runtime.store.create(tainted)
+        runtime.store.create(pending_mp("group-a", {"group": "a"}))
+        runtime.store.create(ready_node("n-b", {"group": "b"}))
+        runtime.store.create(pending_mp("group-b", {"group": "b"}))
+        for i in range(3):
+            runtime.store.create(
+                bound_pod(f"x{i}", {"app": "w"}, "n-a")
+            )  # occupancy noise; scoring ignores it
+        intolerant = Pod(
+            metadata=ObjectMeta(name="plain", labels={"app": "w"}),
+            spec=PodSpec(
+                node_name="",
+                containers=[
+                    Container(requests=resource_list(cpu="1", memory="1Gi"))
+                ],
+            ),
+        )
+        tolerating = Pod(
+            metadata=ObjectMeta(name="tol", labels={"app": "w"}),
+            spec=PodSpec(
+                node_name="",
+                containers=[
+                    Container(requests=resource_list(cpu="1", memory="1Gi"))
+                ],
+            ),
+        )
+        tolerating.spec.tolerations = [
+            Toleration(key="burst", value="spot",
+                       effect="PreferNoSchedule")
+        ]
+        runtime.store.create(intolerant)
+        runtime.store.create(tolerating)
+        runtime.manager.reconcile_all()
+        counts = pods_per_group(runtime, ["group-a", "group-b"])
+        # intolerant steers to b; tolerating ties -> group-a (index 0)
+        assert counts == {"group-a": 1, "group-b": 1}, counts
+        assert total_unschedulable(runtime, "group-a") == 0
+
+    def test_prefer_no_schedule_only_group_still_schedules(self, env):
+        from karpenter_tpu.api.core import Taint
+
+        runtime, _ = env
+        tainted = ready_node("n-a", {"group": "a"})
+        tainted.spec.taints = [
+            Taint(key="burst", value="spot", effect="PreferNoSchedule")
+        ]
+        runtime.store.create(tainted)
+        runtime.store.create(pending_mp("group-a", {"group": "a"}))
+        for i in range(2):
+            runtime.store.create(
+                Pod(
+                    metadata=ObjectMeta(name=f"p{i}",
+                                        labels={"app": "w"}),
+                    spec=PodSpec(
+                        node_name="",
+                        containers=[
+                            Container(
+                                requests=resource_list(
+                                    cpu="1", memory="1Gi"
+                                )
+                            )
+                        ],
+                    ),
+                )
+            )
+        runtime.manager.reconcile_all()
+        assert pods_per_group(runtime, ["group-a"]) == {"group-a": 2}
+        assert total_unschedulable(runtime, "group-a") == 0
+
     def test_schedule_anyway_steers_to_emptier_domain(self, env):
         runtime, _ = env
         zoned(runtime)
