@@ -254,8 +254,16 @@ class AutoScalingGroup:
 
     def get_replicas(self) -> int:
         groups = self._describe()
-        if len(groups) != 1:
-            raise RuntimeError(f"autoscaling group has no instances: {self.id}")
+        if len(groups) == 0:
+            # distinct from "zero instances": the describe found NO group
+            # with this name, so the SNG points at something that doesn't
+            # exist (deleted, typo, wrong region/account)
+            raise RuntimeError(f"autoscaling group not found: {self.id}")
+        if len(groups) > 1:
+            raise RuntimeError(
+                f"autoscaling group name is ambiguous "
+                f"({len(groups)} groups matched): {self.id}"
+            )
         return self._count_healthy(groups[0])
 
     def set_replicas(self, count: int) -> None:
@@ -358,6 +366,23 @@ class ManagedNodeGroup:
         )
 
 
+def _oldest_sent_ms(messages) -> Optional[int]:
+    """Smallest (oldest) SentTimestamp in a sampled batch, epoch ms;
+    None when the batch is empty or carries no parsable timestamps."""
+    oldest_ms: Optional[int] = None
+    for message in messages or []:
+        raw = (message.get("Attributes") or {}).get("SentTimestamp")
+        if raw is None:
+            continue
+        try:
+            sent = int(raw)
+        except ValueError:
+            continue
+        if oldest_ms is None or sent < oldest_ms:
+            oldest_ms = sent
+    return oldest_ms
+
+
 class SQSQueue:
     """reference: sqsqueue.go:36-98."""
 
@@ -437,17 +462,7 @@ class SQSQueue:
             raise RuntimeError(
                 f"could not sample SQS messages for age: {e}"
             ) from e
-        oldest_ms: Optional[int] = None
-        for message in messages or []:
-            raw = (message.get("Attributes") or {}).get("SentTimestamp")
-            if raw is None:
-                continue
-            try:
-                sent = int(raw)
-            except ValueError:
-                continue
-            if oldest_ms is None or sent < oldest_ms:
-                oldest_ms = sent
+        oldest_ms = _oldest_sent_ms(messages)
         self._age_sampled_at = now
         self._age_saw_message = oldest_ms is not None
         self._age_sample = (

@@ -251,6 +251,26 @@ class TestAutoScalingGroup:
         AutoScalingGroup(ASG_ARN, api).set_replicas(7)
         assert api.updated == ("asg-name", 7)
 
+    def test_missing_group_names_the_condition(self):
+        """An empty describe means the group does not exist — the error
+        must say so, not claim the group 'has no instances' (a healthy
+        scaled-to-zero group also has no instances)."""
+
+        class EmptyAPI(FakeAutoscalingAPI):
+            def describe_auto_scaling_groups(self, names, max_records):
+                return []
+
+        with pytest.raises(RuntimeError, match="not found"):
+            AutoScalingGroup("my-asg", EmptyAPI()).get_replicas()
+
+    def test_ambiguous_group_names_the_condition(self):
+        class DoubleAPI(FakeAutoscalingAPI):
+            def describe_auto_scaling_groups(self, names, max_records):
+                return [{"instances": []}, {"instances": []}]
+
+        with pytest.raises(RuntimeError, match="ambiguous"):
+            AutoScalingGroup("my-asg", DoubleAPI()).get_replicas()
+
     def test_api_error_is_transient(self):
         api = FakeAutoscalingAPI(
             want_err=AWSAPIError("throttled", code="ThrottlingException")
