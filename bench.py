@@ -515,19 +515,38 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
         sys.exit(0)
 
 
-def run(args, metric: str, note: str) -> None:
+def _warm_native_kernel(args) -> None:
+    """Block on the C kernel build before ANY dispatch (incl. --e2e/
+    --decide) and outside every timed region — the async production path
+    would otherwise leave early measured iterations on the numpy
+    fallback (like jit warmup, one-time setup is excluded from the
+    measurement)."""
     import jax
 
     if jax.default_backend() == "cpu" and args.backend in ("auto", "numpy"):
-        # block on the C kernel build HERE, before ANY dispatch (incl.
-        # --e2e/--decide) and outside every timed region — the async
-        # production path would otherwise leave early measured iterations
-        # on the numpy fallback (like jit warmup, one-time setup is
-        # excluded from the measurement)
         from karpenter_tpu.native import load_kbinpack
 
         if load_kbinpack() is None:
             print("native kernel unavailable: numpy stages", file=sys.stderr)
+
+
+def _bench_inputs(args):
+    if args.clusters:
+        return build_multicluster_inputs(
+            args.pods, args.clusters, args.types,
+            max(args.taints, 8), max(args.labels, args.clusters + 8),
+            args.seed,
+        )
+    return build_inputs(
+        args.pods, args.types, args.taints, args.labels, args.seed,
+        affinity=args.affinity, anti=args.anti,
+    )
+
+
+def run(args, metric: str, note: str) -> None:
+    import jax
+
+    _warm_native_kernel(args)
 
     if args.decide:
         run_decide(args, metric, note)
@@ -542,18 +561,7 @@ def run(args, metric: str, note: str) -> None:
         f"backend={jax.default_backend()} devices={jax.devices()}",
         file=sys.stderr,
     )
-    if args.clusters:
-        inputs = build_multicluster_inputs(
-            args.pods, args.clusters, args.types,
-            max(args.taints, 8), max(args.labels, args.clusters + 8),
-            args.seed,
-        )
-    else:
-        inputs = build_inputs(
-            args.pods, args.types, args.taints, args.labels, args.seed,
-            affinity=args.affinity, anti=args.anti,
-        )
-    inputs = jax.device_put(inputs)
+    inputs = jax.device_put(_bench_inputs(args))
     jax.block_until_ready(inputs)
 
     t0 = time.perf_counter()

@@ -181,83 +181,49 @@ def _run_simulation(args, store) -> int:
     return 0
 
 
-def main(argv=None) -> int:  # lint: allow-complexity — flag-to-subsystem wiring, one branch per optional server
-    args = parse_args(argv)
-    log_setup(verbose=args.verbose)
+def _make_store(args):
+    """KubeStore when --apiserver names a real cluster, else None (the
+    runtime then builds its in-process store)."""
+    if not args.apiserver:
+        return None
+    from karpenter_tpu.store.kube import KubeClient, KubeStore
 
-    # the batched HPA decision kernel ALWAYS runs in-process (only the
-    # bin-pack is optionally routed to a sidecar), so an unreachable TPU
-    # must degrade to CPU decisions unconditionally — not freeze the
-    # control plane at its first jit (utils/backend.py rationale)
-    from karpenter_tpu.utils.backend import ensure_usable_backend
-
-    note = ensure_usable_backend()
-    if note:
-        print(f"decision backend: {note}", file=sys.stderr)
-
-    store = None
-    if args.apiserver:
-        from karpenter_tpu.store.kube import KubeClient, KubeStore
-
-        store = KubeStore(
-            KubeClient(
-                base_url=args.apiserver,
-                token_file=args.kube_token_file,
-                ca_file=args.kube_ca,
-                insecure=args.kube_insecure,
-            )
+    return KubeStore(
+        KubeClient(
+            base_url=args.apiserver,
+            token_file=args.kube_token_file,
+            ca_file=args.kube_ca,
+            insecure=args.kube_insecure,
         )
-    if args.simulate:
-        try:
-            return _run_simulation(args, store)
-        finally:
-            if store is not None:
-                store.close()
-    runtime = KarpenterRuntime(
-        Options(
-            prometheus_uri=args.prometheus_uri,
-            cloud_provider=args.cloud_provider,
-            solver_uri=args.solver_uri,
-            data_dir=args.data_dir,
-            verbose=args.verbose,
-        ),
-        store=store,
-    )
-    metrics_server = MetricsServer(runtime.registry, port=args.metrics_port)
-    port = metrics_server.start()
-    print(f"serving /metrics and /healthz on :{port}", file=sys.stderr)
-    webhook_server = None
-    if args.webhook_port:
-        import os.path
-
-        from karpenter_tpu.webhook import WebhookServer
-
-        cert = key = None
-        if args.webhook_cert_dir:
-            cert = os.path.join(args.webhook_cert_dir, "tls.crt")
-            key = os.path.join(args.webhook_cert_dir, "tls.key")
-        webhook_server = WebhookServer(
-            port=args.webhook_port, cert_file=cert, key_file=key
-        )
-        wport = webhook_server.start()
-        print(f"serving admission webhooks on :{wport}", file=sys.stderr)
-    if args.profiler_port:
-        if start_profiler_server(args.profiler_port):
-            print(
-                f"jax profiler listening on :{args.profiler_port}",
-                file=sys.stderr,
-            )
-
-    elector = (
-        LeaderElector(runtime.store, clock=runtime.clock)
-        if args.leader_elect
-        else None
     )
 
-    # clean shutdown on SIGTERM (what kubernetes sends on pod
-    # termination): finish the current tick, then run the same teardown
-    # as normal exit — the reference's manager stops on SIGTERM/SIGINT
-    # via controller-runtime's signal handler (main.go run-until-signalled)
+
+def _start_webhook_server(args):
+    if not args.webhook_port:
+        return None
+    import os.path
+
+    from karpenter_tpu.webhook import WebhookServer
+
+    cert = key = None
+    if args.webhook_cert_dir:
+        cert = os.path.join(args.webhook_cert_dir, "tls.crt")
+        key = os.path.join(args.webhook_cert_dir, "tls.key")
+    server = WebhookServer(
+        port=args.webhook_port, cert_file=cert, key_file=key
+    )
+    wport = server.start()
+    print(f"serving admission webhooks on :{wport}", file=sys.stderr)
+    return server
+
+
+def _run_loop(args, runtime, elector) -> None:
+    """Tick until the duration elapses, SIGTERM arrives, or ^C.
+
+    Clean shutdown on SIGTERM (what kubernetes sends on pod termination):
+    finish the current tick, then run the same teardown as normal exit —
+    the reference's manager stops on SIGTERM/SIGINT via controller-
+    runtime's signal handler (main.go run-until-signalled)."""
     import signal
 
     stopping = {"flag": False}
@@ -285,6 +251,57 @@ def main(argv=None) -> int:  # lint: allow-complexity — flag-to-subsystem wiri
             # previous disposition (a stale handler flipping a dead flag
             # would make the process unkillable by TERM)
             signal.signal(signal.SIGTERM, previous_handler)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    log_setup(verbose=args.verbose)
+
+    # the batched HPA decision kernel ALWAYS runs in-process (only the
+    # bin-pack is optionally routed to a sidecar), so an unreachable TPU
+    # must degrade to CPU decisions unconditionally — not freeze the
+    # control plane at its first jit (utils/backend.py rationale)
+    from karpenter_tpu.utils.backend import ensure_usable_backend
+
+    note = ensure_usable_backend()
+    if note:
+        print(f"decision backend: {note}", file=sys.stderr)
+
+    store = _make_store(args)
+    if args.simulate:
+        try:
+            return _run_simulation(args, store)
+        finally:
+            if store is not None:
+                store.close()
+    runtime = KarpenterRuntime(
+        Options(
+            prometheus_uri=args.prometheus_uri,
+            cloud_provider=args.cloud_provider,
+            solver_uri=args.solver_uri,
+            data_dir=args.data_dir,
+            verbose=args.verbose,
+        ),
+        store=store,
+    )
+    metrics_server = MetricsServer(runtime.registry, port=args.metrics_port)
+    port = metrics_server.start()
+    print(f"serving /metrics and /healthz on :{port}", file=sys.stderr)
+    webhook_server = _start_webhook_server(args)
+    if args.profiler_port and start_profiler_server(args.profiler_port):
+        print(
+            f"jax profiler listening on :{args.profiler_port}",
+            file=sys.stderr,
+        )
+
+    elector = (
+        LeaderElector(runtime.store, clock=runtime.clock)
+        if args.leader_elect
+        else None
+    )
+    try:
+        _run_loop(args, runtime, elector)
+    finally:
         metrics_server.stop()
         if webhook_server is not None:
             webhook_server.stop()

@@ -309,27 +309,7 @@ def spread_shape(
     is soft (scheduler preference), never a constraint."""
     if not constraints:
         return ()
-    # identity is (key, selector, affinityPolicy): constraints differing
-    # in ANY of those are enforced independently by the scheduler, so
-    # they must stay separate entries — merging a Honor and an Ignore
-    # view of the same selector could loosen the caps either view
-    # enforces alone (r3 code review)
-    binding: Dict[tuple, Tuple[int, int]] = {}
-    for c in constraints:
-        if (
-            c.when_unsatisfiable == "DoNotSchedule"
-            and c.topology_key
-            and c.topology_key != HOSTNAME_TOPOLOGY_KEY
-        ):
-            skew = max(1, int(c.max_skew))
-            min_domains = max(0, int(c.min_domains or 0))
-            honor = c.node_affinity_policy != "Ignore"
-            sel = _spread_selector(c, labels)
-            prev = binding.get((c.topology_key, sel, honor))
-            if prev is not None:
-                skew = min(prev[0], skew)
-                min_domains = max(prev[1], min_domains)
-            binding[(c.topology_key, sel, honor)] = (skew, min_domains)
+    binding = _bind_spread_constraints(constraints, labels)
     if not binding:
         return ()
     entries = tuple(
@@ -353,6 +333,37 @@ def spread_shape(
         )
     )
     return (namespace, entries)
+
+
+def _bind_spread_constraints(
+    constraints: list, labels: Optional[Dict[str, str]]
+) -> Dict[tuple, Tuple[int, int]]:
+    """(key, selectorForm, honorAffinity) -> (maxSkew, minDomains) for the
+    binding (DoNotSchedule, non-hostname) constraints.
+
+    Identity is (key, selector, affinityPolicy): constraints differing in
+    ANY of those are enforced independently by the scheduler, so they must
+    stay separate entries — merging a Honor and an Ignore view of the same
+    selector could loosen the caps either view enforces alone (r3 code
+    review). Within one identity, smallest skew and largest minDomains
+    win — the most restrictive combination."""
+    binding: Dict[tuple, Tuple[int, int]] = {}
+    for c in constraints:
+        if (
+            c.when_unsatisfiable == "DoNotSchedule"
+            and c.topology_key
+            and c.topology_key != HOSTNAME_TOPOLOGY_KEY
+        ):
+            skew = max(1, int(c.max_skew))
+            min_domains = max(0, int(c.min_domains or 0))
+            honor = c.node_affinity_policy != "Ignore"
+            sel = _spread_selector(c, labels)
+            prev = binding.get((c.topology_key, sel, honor))
+            if prev is not None:
+                skew = min(prev[0], skew)
+                min_domains = max(prev[1], min_domains)
+            binding[(c.topology_key, sel, honor)] = (skew, min_domains)
+    return binding
 
 
 def _refine_term(term: "PodAffinityTerm", labels: Dict[str, str]):
@@ -494,19 +505,7 @@ def pod_affinity_shape(
         anti_required, labels, namespace, assume_ns_selector=True
     )
     co_terms = _self_matching_terms(co_required, labels, namespace)
-    # shape[0] is a FLAGS field: bit 0 = hostname ANTI (one replica per
-    # node, the pod_exclusive operand), bit 1 = hostname CO (all
-    # replicas on one node — census-pinned via the sign +2 foreign
-    # projection, bootstrap capped to one promised replica)
-    flags = int(
-        any(t.topology_key == HOSTNAME_TOPOLOGY_KEY for t in anti_terms)
-    ) | (
-        2
-        if any(
-            t.topology_key == HOSTNAME_TOPOLOGY_KEY for t in co_terms
-        )
-        else 0
-    )
+    flags = _hostname_flags(anti_terms, co_terms)
     anti_keys = _domain_keys(anti_terms)
     co_keys = _domain_keys(co_terms)
     foreign = _foreign_terms(
@@ -514,26 +513,103 @@ def pod_affinity_shape(
     )
     if not flags and not anti_keys and not co_keys and not foreign:
         return ()
-    ident = (
-        (
-            namespace,
-            tuple(
-                sorted(
-                    {
-                        _selector_form(t.label_selector)
-                        for t in (*anti_terms, *co_terms)
-                        if t.topology_key != HOSTNAME_TOPOLOGY_KEY
-                    }
-                )
-            ),
-        )
-        if anti_keys or co_keys
-        else ()
-    )
+    ident = _workload_ident(namespace, anti_keys, co_keys, anti_terms, co_terms)
     return (flags, anti_keys, co_keys, ident, foreign)
 
 
-def _foreign_terms(anti_required, co_required, namespace, anti_terms, co_terms):  # lint: allow-complexity — one guard per k8s term rule (selector/nsSelector/hostname/own-vs-extra namespaces)
+def _hostname_flags(anti_terms: list, co_terms: list) -> int:
+    """shape[0] is a FLAGS field: bit 0 = hostname ANTI (one replica per
+    node, the pod_exclusive operand), bit 1 = hostname CO (all replicas
+    on one node — census-pinned via the sign +2 foreign projection,
+    bootstrap capped to one promised replica)."""
+    flags = int(
+        any(t.topology_key == HOSTNAME_TOPOLOGY_KEY for t in anti_terms)
+    )
+    if any(t.topology_key == HOSTNAME_TOPOLOGY_KEY for t in co_terms):
+        flags |= 2
+    return flags
+
+
+def _workload_ident(
+    namespace: str, anti_keys, co_keys, anti_terms, co_terms
+) -> tuple:
+    """The WORKLOAD IDENTITY: the pod's namespace plus the canonical
+    forms of the self-matching domain-relevant selectors (see
+    pod_affinity_shape docstring for why selectors, not raw labels)."""
+    if not (anti_keys or co_keys):
+        return ()
+    return (
+        namespace,
+        tuple(
+            sorted(
+                {
+                    _selector_form(t.label_selector)
+                    for t in (*anti_terms, *co_terms)
+                    if t.topology_key != HOSTNAME_TOPOLOGY_KEY
+                }
+            )
+        ),
+    )
+
+
+def _term_ns_scope(t, listed: tuple):
+    """The tagged ("selector", ...) scope for a namespaceSelector term;
+    None when the term scopes by explicit names / own namespace only."""
+    if t.namespace_selector is not None:
+        return ("selector", _selector_form(t.namespace_selector), listed)
+    return None
+
+
+def _resolved_scope(scope, listed: tuple, namespace: str):
+    """Resolve the k8s default at build time: an empty namespaces list
+    means the POD'S OWN namespace."""
+    if scope is not None:
+        return scope
+    return ("names", listed or (namespace,))
+
+
+def _own_term_entries(sign, t, scope, listed, namespace):
+    """Foreign-mask entries projected for a SELF-matching term.
+
+    The self-matching slice is modeled by the self machinery for the
+    pod's OWN namespace — but a term reaching ADDITIONAL namespaces (an
+    explicit list or a namespaceSelector) also binds on matching pods
+    THERE, which only the census-backed foreign mask can enforce (r3
+    code review). An anti term blocks their domains (sign -1). A CO term
+    with extra namespaces is pinned by them too: matching pods in a
+    foreign in-scope namespace restrict placement to their domains even
+    when the own namespace is empty — admitting only own-namespace
+    evidence then grants a first-replica bootstrap the scheduler does
+    not give (r3 advisor). It projects with sign +2 (bootstrap-eligible
+    co) over the FULL scope: the pod itself is in scope, so an empty
+    census keeps the scheduler's first-replica grace, unlike a true
+    foreign co term. Self co terms never carry a namespaceSelector
+    (_self_matching_terms filters those for CO), so the +2 scope is
+    always an explicit name list. Hostname CO keys ALWAYS project (even
+    with no extra namespaces): a matching pod anywhere in scope pins new
+    replicas to its EXISTING node, which a scale-up's fresh nodes can
+    never satisfy — the census handler marks the row honestly
+    unschedulable, while an empty census keeps the first-replica grace
+    (the bootstrap itself is capped to ONE promised replica by the anti
+    expansion — replicas beyond the first must join the first's node,
+    which a group-level pack cannot promise)."""
+    extra = tuple(ns for ns in listed if ns != namespace)
+    sel = _selector_form(t.label_selector)
+    if sign < 0:
+        if scope is not None:
+            return [(sign, t.topology_key, sel, scope)]
+        if extra:
+            return [(sign, t.topology_key, sel, ("names", extra))]
+        return []
+    if extra or t.topology_key == HOSTNAME_TOPOLOGY_KEY:
+        return [
+            (2, t.topology_key, sel,
+             ("names", tuple(sorted((namespace, *extra)))))
+        ]
+    return []
+
+
+def _foreign_terms(anti_required, co_required, namespace, anti_terms, co_terms):
     """Canonical FOREIGN required (anti-)affinity terms — selectors that
     do NOT match the pod's own labels, i.e. constraints against OTHER
     workloads' pods. The solver enforces them against SCHEDULED state
@@ -569,75 +645,18 @@ def _foreign_terms(anti_required, co_required, namespace, anti_terms, co_terms):
             if sign < 0 and t.topology_key == HOSTNAME_TOPOLOGY_KEY:
                 continue
             listed = tuple(sorted(t.namespaces or ()))
-            if t.namespace_selector is not None:
-                scope = (
-                    "selector",
-                    _selector_form(t.namespace_selector),
-                    listed,
-                )
-            else:
-                scope = None
+            scope = _term_ns_scope(t, listed)
             if id(t) in own:
-                # the self-matching slice is modeled by the self
-                # machinery for the pod's OWN namespace — but a term
-                # reaching ADDITIONAL namespaces (an explicit list or a
-                # namespaceSelector) also binds on matching pods THERE,
-                # which only the census-backed foreign mask can enforce
-                # (r3 code review). An anti term blocks their domains
-                # (sign -1). A CO term with extra namespaces is pinned
-                # by them too: matching pods in a foreign in-scope
-                # namespace restrict placement to their domains even
-                # when the own namespace is empty — admitting only
-                # own-namespace evidence then grants a first-replica
-                # bootstrap the scheduler does not give (r3 advisor).
-                # It projects with sign +2 (bootstrap-eligible co) over
-                # the FULL scope: the pod itself is in scope, so an
-                # empty census keeps the scheduler's first-replica
-                # grace, unlike a true foreign co term.
-                extra = tuple(ns for ns in listed if ns != namespace)
-                if sign < 0:
-                    if scope is not None:
-                        out.add(
-                            (sign, t.topology_key,
-                             _selector_form(t.label_selector), scope)
-                        )
-                    elif extra:
-                        out.add(
-                            (sign, t.topology_key,
-                             _selector_form(t.label_selector),
-                             ("names", extra))
-                        )
-                elif extra or t.topology_key == HOSTNAME_TOPOLOGY_KEY:
-                    # self co terms never carry a namespaceSelector
-                    # (_self_matching_terms filters those for CO), so
-                    # the scope is always an explicit name list here.
-                    # Hostname keys ALWAYS project (even with no extra
-                    # namespaces): a matching pod anywhere in scope pins
-                    # new replicas to its EXISTING node, which a
-                    # scale-up's fresh nodes can never satisfy — the
-                    # census handler marks the row honestly
-                    # unschedulable, while an empty census keeps the
-                    # first-replica grace (the bootstrap itself is
-                    # capped to ONE promised replica by the anti
-                    # expansion — replicas beyond the first must join
-                    # the first's node, which a group-level pack cannot
-                    # promise).
-                    out.add(
-                        (2, t.topology_key,
-                         _selector_form(t.label_selector),
-                         ("names", tuple(sorted((namespace, *extra)))))
-                    )
+                out.update(
+                    _own_term_entries(sign, t, scope, listed, namespace)
+                )
                 continue
             out.add(
                 (
                     sign,
                     t.topology_key,
                     _selector_form(t.label_selector),
-                    # resolve the k8s default at build time: an empty
-                    # namespaces list means the POD'S OWN namespace
-                    scope
-                    if scope is not None
-                    else ("names", listed or (namespace,)),
+                    _resolved_scope(scope, listed, namespace),
                 )
             )
     return tuple(sorted(out))
@@ -824,6 +843,18 @@ def preference_score(labels: Dict[str, str], shape: tuple) -> int:
     )
 
 
+def _numeric_requirement(labels, key, operator, values) -> bool:
+    """Gt/Lt: integer comparison; missing key, empty values, or
+    non-integer text never match (upstream nodeaffinity semantics)."""
+    if key not in labels or not values:
+        return False
+    try:
+        have, want = int(labels[key]), int(values[0])
+    except ValueError:
+        return False
+    return have > want if operator == "Gt" else have < want
+
+
 def _requirement_matches(labels: Dict[str, str], key, operator, values) -> bool:
     present = key in labels
     if operator == "In":
@@ -836,13 +867,7 @@ def _requirement_matches(labels: Dict[str, str], key, operator, values) -> bool:
     if operator == "DoesNotExist":
         return not present
     if operator in ("Gt", "Lt"):
-        if not present or not values:
-            return False
-        try:
-            have, want = int(labels[key]), int(values[0])
-        except ValueError:
-            return False
-        return have > want if operator == "Gt" else have < want
+        return _numeric_requirement(labels, key, operator, values)
     return False  # unknown operator: never matches (validation's job)
 
 

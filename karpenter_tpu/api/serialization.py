@@ -85,10 +85,17 @@ def _unwrap_optional(tp: Any) -> Any:
     return tp
 
 
-def _coerce(value: Any, tp: Any, lenient: bool = False) -> Any:  # lint: allow-complexity — one isinstance arm per wire type, a dispatch table in if-form
+def _coerce(value: Any, tp: Any, lenient: bool = False) -> Any:
     tp = _unwrap_optional(tp)
     if value is None:
         return None
+    if typing.get_origin(tp) is not None:
+        return _coerce_generic(value, tp, lenient)
+    return _coerce_scalar(value, tp, lenient)
+
+
+def _coerce_generic(value: Any, tp: Any, lenient: bool) -> Any:
+    """Containers and unions (types with a typing origin)."""
     origin = typing.get_origin(tp)
     if origin is typing.Union:
         # Union[int, str] (resourceVersion): numeric when locally minted,
@@ -106,6 +113,11 @@ def _coerce(value: Any, tp: Any, lenient: bool = False) -> Any:  # lint: allow-c
         return {
             k: _coerce(v, val_tp, lenient=lenient) for k, v in value.items()
         }
+    return value
+
+
+def _coerce_scalar(value: Any, tp: Any, lenient: bool) -> Any:
+    """One arm per wire type: Quantity, nested dataclass, primitives."""
     if tp is Quantity:
         return Quantity.parse(str(value))
     if dataclasses.is_dataclass(tp):
@@ -132,7 +144,35 @@ def _rfc3339_to_epoch(value: str) -> float:
     return _dt.datetime.fromisoformat(text).timestamp()
 
 
-def from_dict(cls: Type, data: Dict[str, Any], lenient: bool = False):  # lint: allow-complexity — decode dialect handling, branches enumerated not nested
+def _flatten_container_resources(data: Dict[str, Any]) -> Dict[str, Any]:
+    """Real-apiserver dialect: requests/limits nest under `resources`
+    (core/v1 ResourceRequirements); our manifest dialect flattens to
+    `requests`. Lenient (apiserver-read) decode accepts both; strict
+    user manifests still hard-error on `resources` so misconfig never
+    silently drops limits/requests."""
+    nested = data.get("resources") or {}
+    data = {k: v for k, v in data.items() if k != "resources"}
+    if "requests" not in data and "requests" in nested:
+        data["requests"] = nested["requests"]
+    return data
+
+
+def _resolve_field(cls: Type, key: str, field_names, lenient: bool):
+    """Manifest key -> dataclass field name; None = skip this key."""
+    if key in ("apiVersion", "kind") and "api_version" not in field_names:
+        return None  # envelope keys on top-level kinds
+    field = _KEY_TO_FIELD.get(key, camel_to_snake(key))
+    if field in field_names:
+        return field
+    if lenient:
+        return None
+    raise ValueError(
+        f"unknown field {key!r} for {cls.__name__} "
+        f"(known: {sorted(field_names)})"
+    )
+
+
+def from_dict(cls: Type, data: Dict[str, Any], lenient: bool = False):
     """Hydrate dataclass `cls` from a manifest-shaped dict (camelCase keys).
     Unknown keys are an error — same posture as apiserver structural schemas
     (silently dropped config is misconfig that 'works').
@@ -144,30 +184,14 @@ def from_dict(cls: Type, data: Dict[str, Any], lenient: bool = False):  # lint: 
     if data is None:
         data = {}
     if lenient and cls is Container and "resources" in data:
-        # real-apiserver dialect: requests/limits nest under `resources`
-        # (core/v1 ResourceRequirements); our manifest dialect flattens to
-        # `requests`. Lenient (apiserver-read) decode accepts both; strict
-        # user manifests still hard-error on `resources` so misconfig
-        # never silently drops limits/requests.
-        nested = data.get("resources") or {}
-        data = {k: v for k, v in data.items() if k != "resources"}
-        if "requests" not in data and "requests" in nested:
-            data["requests"] = nested["requests"]
+        data = _flatten_container_resources(data)
     types = _field_types(cls)
     field_names = {f.name for f in dataclasses.fields(cls)}
     kwargs = {}
     for key, value in data.items():
-        if key in ("apiVersion", "kind") and "api_version" not in field_names:
-            continue  # envelope keys on top-level kinds
-        field = _KEY_TO_FIELD.get(key, camel_to_snake(key))
-        if field not in field_names:
-            if lenient:
-                continue
-            raise ValueError(
-                f"unknown field {key!r} for {cls.__name__} "
-                f"(known: {sorted(field_names)})"
-            )
-        kwargs[field] = _coerce(value, types[field], lenient=lenient)
+        field = _resolve_field(cls, key, field_names, lenient)
+        if field is not None:
+            kwargs[field] = _coerce(value, types[field], lenient=lenient)
     return cls(**kwargs)
 
 

@@ -132,6 +132,26 @@ def _instance_type_allocatable(ec2, instance_type: str) -> Dict[str, str]:
     return allocatable
 
 
+def _labels_taints_from_tags(tags: Dict[str, str]):
+    """Decode the cluster-autoscaler node-template tag convention into
+    (labels, taints)."""
+    labels: Dict[str, str] = {}
+    taints = []
+    for key, value in tags.items():
+        if key.startswith(_CAS_LABEL_TAG):
+            labels[key[len(_CAS_LABEL_TAG):]] = value
+        elif key.startswith(_CAS_TAINT_TAG):
+            taint_value, _, effect = value.partition(":")
+            taints.append(
+                {
+                    "key": key[len(_CAS_TAINT_TAG):],
+                    "value": taint_value,
+                    "effect": effect,
+                }
+            )
+    return labels, taints
+
+
 class Boto3AutoscalingClient:
     """AutoscalingAPI over boto3 autoscaling (+ ec2 for templates)."""
 
@@ -182,7 +202,26 @@ class Boto3AutoscalingClient:
             DesiredCapacity=desired_capacity,
         )
 
-    def describe_node_template(self, name: str) -> Optional[dict]:  # lint: allow-complexity — per-API-shape fallbacks (override/id/name), each a guard
+    def _launch_template_instance_type(self, spec: dict) -> Optional[str]:
+        """Instance type from a LaunchTemplateSpecification. Specs carry
+        EITHER an id or a name (both shapes are returned by AWS); passing
+        a None id would be a ParamValidationError."""
+        if spec.get("LaunchTemplateId"):
+            lt_ref = {"LaunchTemplateId": spec["LaunchTemplateId"]}
+        elif spec.get("LaunchTemplateName"):
+            lt_ref = {"LaunchTemplateName": spec["LaunchTemplateName"]}
+        else:
+            return None
+        versions = _translate_call(
+            self._ec2.describe_launch_template_versions,
+            Versions=[spec.get("Version", "$Default")],
+            **lt_ref,
+        ).get("LaunchTemplateVersions") or []
+        if not versions:
+            return None
+        return versions[0].get("LaunchTemplateData", {}).get("InstanceType")
+
+    def describe_node_template(self, name: str) -> Optional[dict]:
         """Scale-from-zero template: instance type from the ASG's launch
         template (override first — mixed policies list the real types
         there), sized via DescribeInstanceTypes; labels/taints from the
@@ -197,40 +236,12 @@ class Boto3AutoscalingClient:
                 instance_type = override["InstanceType"]
                 break
         if instance_type is None and group["launch_template"] and self._ec2:
-            spec = group["launch_template"]
-            # specs carry EITHER an id or a name (both shapes are returned
-            # by AWS); passing a None id would be a ParamValidationError
-            if spec.get("LaunchTemplateId"):
-                lt_ref = {"LaunchTemplateId": spec["LaunchTemplateId"]}
-            elif spec.get("LaunchTemplateName"):
-                lt_ref = {"LaunchTemplateName": spec["LaunchTemplateName"]}
-            else:
-                return None
-            versions = _translate_call(
-                self._ec2.describe_launch_template_versions,
-                Versions=[spec.get("Version", "$Default")],
-                **lt_ref,
-            ).get("LaunchTemplateVersions") or []
-            if versions:
-                instance_type = versions[0].get(
-                    "LaunchTemplateData", {}
-                ).get("InstanceType")
+            instance_type = self._launch_template_instance_type(
+                group["launch_template"]
+            )
         if instance_type is None or self._ec2 is None:
             return None
-        labels = {}
-        taints = []
-        for key, value in group["tags"].items():
-            if key.startswith(_CAS_LABEL_TAG):
-                labels[key[len(_CAS_LABEL_TAG):]] = value
-            elif key.startswith(_CAS_TAINT_TAG):
-                taint_value, _, effect = value.partition(":")
-                taints.append(
-                    {
-                        "key": key[len(_CAS_TAINT_TAG):],
-                        "value": taint_value,
-                        "effect": effect,
-                    }
-                )
+        labels, taints = _labels_taints_from_tags(group["tags"])
         allocatable = _instance_type_allocatable(self._ec2, instance_type)
         if not allocatable:
             return None

@@ -121,6 +121,10 @@ def schema_for_type(tp: Any) -> Dict[str, Any]:
             "type": "object",
             "additionalProperties": schema_for_type(val),
         }
+    return _schema_for_scalar(tp)
+
+
+def _schema_for_scalar(tp: Any) -> Dict[str, Any]:
     if tp is Quantity:
         # apimachinery resource.Quantity serializes as a string
         return {"type": "string"}
@@ -272,6 +276,42 @@ def _type_label(tp: Any) -> str:
     return getattr(tp, "__name__", str(tp))
 
 
+def _field_default_label(f) -> str:
+    if f.default is not dataclasses.MISSING:
+        return repr(f.default)
+    if f.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
+        return f.default_factory.__name__ + "()"
+    return ""
+
+
+def _render_class_docs(cls, queue: list) -> list:
+    """Markdown section for one API dataclass; nested dataclass types are
+    appended to `queue` for later sections."""
+    lines = [f"## {cls.__name__}", ""]
+    doc = (cls.__doc__ or "").strip()
+    if doc and not doc.startswith(f"{cls.__name__}("):
+        # real docstring (the auto-generated dataclass signature is noise)
+        lines.append(doc.split("\n\n")[0])
+        lines.append("")
+    lines.append("| Field | Type | Default |")
+    lines.append("|---|---|---|")
+    hints = typing.get_type_hints(cls)
+    for f in dataclasses.fields(cls):
+        key = _FIELD_TO_KEY.get(f.name, snake_to_camel(f.name))
+        tp = _unwrap_optional(hints[f.name])
+        if dataclasses.is_dataclass(tp):
+            queue.append(tp)
+        else:
+            for arg in typing.get_args(tp):
+                arg = _unwrap_optional(arg)
+                if dataclasses.is_dataclass(arg):
+                    queue.append(arg)
+        default = _field_default_label(f)
+        lines.append(f"| `{key}` | {_type_label(hints[f.name])} | {default} |")
+    lines.append("")
+    return lines
+
+
 def api_docs_markdown() -> str:
     """One markdown API reference for the three CRDs, generated from the
     API dataclasses (single source of truth with the CRD schemas above)."""
@@ -289,34 +329,7 @@ def api_docs_markdown() -> str:
         if cls.__name__ in rendered:
             continue
         rendered.add(cls.__name__)
-        lines.append(f"## {cls.__name__}")
-        lines.append("")
-        doc = (cls.__doc__ or "").strip()
-        if doc and not doc.startswith(f"{cls.__name__}("):
-            # real docstring (the auto-generated dataclass signature is noise)
-            lines.append(doc.split("\n\n")[0])
-            lines.append("")
-        lines.append("| Field | Type | Default |")
-        lines.append("|---|---|---|")
-        hints = typing.get_type_hints(cls)
-        for f in dataclasses.fields(cls):
-            key = _FIELD_TO_KEY.get(f.name, snake_to_camel(f.name))
-            tp = _unwrap_optional(hints[f.name])
-            if dataclasses.is_dataclass(tp):
-                queue.append(tp)
-            else:
-                for arg in typing.get_args(tp):
-                    arg = _unwrap_optional(arg)
-                    if dataclasses.is_dataclass(arg):
-                        queue.append(arg)
-            if f.default is not dataclasses.MISSING:
-                default = repr(f.default)
-            elif f.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
-                default = f.default_factory.__name__ + "()"
-            else:
-                default = ""
-            lines.append(f"| `{key}` | {_type_label(hints[f.name])} | {default} |")
-        lines.append("")
+        lines.extend(_render_class_docs(cls, queue))
     return "\n".join(lines)
 
 

@@ -115,49 +115,56 @@ class Manager:
         except Exception as e:  # noqa: BLE001
             return e
 
-    def reconcile_all(self) -> None:  # lint: allow-complexity — error-taxonomy arms of the reconcile loop
+    def _reconcile_controller(self, controller, now: float) -> None:
+        """One controller's slice of the tick: collect due objects,
+        validate, dispatch."""
+        kind = controller.kind()
+        # dueness is decided on keys so idle ticks never deep-copy the
+        # fleet; only due objects are fetched
+        due_objs = [
+            obj
+            for key in self.store.keys(kind)
+            if self._due.get(key, 0.0) <= now
+            and (obj := self.store.try_get(*key)) is not None
+        ]
+        if not due_objs:
+            return
+
+        valid_objs = []
+        for obj in due_objs:
+            error = self._validate(obj)
+            if error is not None:
+                self._finish(controller, obj, error)
+            else:
+                valid_objs.append(obj)
+        self._dispatch(controller, valid_objs)
+
+    def _dispatch(self, controller, valid_objs) -> None:
+        """Batch path when the controller offers one, else per-object."""
+        batch = getattr(controller, "reconcile_batch", None)
+        if batch is not None and valid_objs:
+            obj_key = lambda o: (o.metadata.namespace, o.metadata.name)
+            try:
+                errors = batch(valid_objs)
+            except Exception as e:  # noqa: BLE001 - batch-wide failure
+                errors = {obj_key(o): e for o in valid_objs}
+            for obj in valid_objs:
+                self._finish(controller, obj, errors.get(obj_key(obj)))
+        else:
+            for obj in valid_objs:
+                try:
+                    controller.reconcile(obj)
+                    error = None
+                except Exception as e:  # noqa: BLE001
+                    error = e
+                self._finish(controller, obj, error)
+
+    def reconcile_all(self) -> None:
         """One manager tick: every due object of every controller."""
         start = _time.perf_counter()
         now = self.clock()
         for controller in self._controllers:
-            kind = controller.kind()
-            # dueness is decided on keys so idle ticks never deep-copy the
-            # fleet; only due objects are fetched
-            due_objs = [
-                obj
-                for key in self.store.keys(kind)
-                if self._due.get(key, 0.0) <= now
-                and (obj := self.store.try_get(*key)) is not None
-            ]
-            if not due_objs:
-                continue
-
-            valid_objs = []
-            for obj in due_objs:
-                error = self._validate(obj)
-                if error is not None:
-                    self._finish(controller, obj, error)
-                else:
-                    valid_objs.append(obj)
-
-            batch = getattr(controller, "reconcile_batch", None)
-            if batch is not None and valid_objs:
-                obj_key = lambda o: (o.metadata.namespace, o.metadata.name)
-                try:
-                    errors = batch(valid_objs)
-                except Exception as e:  # noqa: BLE001 - batch-wide failure
-                    errors = {obj_key(o): e for o in valid_objs}
-                for obj in valid_objs:
-                    self._finish(controller, obj, errors.get(obj_key(obj)))
-            else:
-                for obj in valid_objs:
-                    try:
-                        controller.reconcile(obj)
-                        error = None
-                    except Exception as e:  # noqa: BLE001
-                        error = e
-                    self._finish(controller, obj, error)
-
+            self._reconcile_controller(controller, now)
         if self._tick_gauge is not None:
             self._tick_gauge.set(
                 "manager", "-", _time.perf_counter() - start

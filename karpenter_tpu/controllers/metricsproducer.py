@@ -27,6 +27,24 @@ class MetricsProducerController:
     def reconcile(self, mp) -> None:
         self.factory.for_producer(mp).reconcile()
 
+    def _solve_pending_batch(self, pending, key, results) -> None:
+        """One device bin-pack call for every due pendingCapacity producer."""
+        try:
+            outcomes = solve_pending(
+                self.factory.store,
+                pending,
+                self.factory.registry,
+                solver=self.factory.solver,
+                feed=self.factory.pending_feed(),
+                template_resolver=self.factory.template_resolver(),
+            )
+            for mp in pending:
+                # per-ROW outcome: a poisoned spec fails only itself
+                results[key(mp)] = outcomes.get(key(mp))
+        except Exception as e:  # noqa: BLE001 — global failure
+            for mp in pending:
+                results[key(mp)] = e
+
     def reconcile_batch(
         self, mps: List[MetricsProducer]
     ) -> Dict[tuple, Optional[Exception]]:
@@ -36,21 +54,7 @@ class MetricsProducerController:
         others = [mp for mp in mps if mp.spec.pending_capacity is None]
 
         if pending:
-            try:
-                outcomes = solve_pending(
-                    self.factory.store,
-                    pending,
-                    self.factory.registry,
-                    solver=self.factory.solver,
-                    feed=self.factory.pending_feed(),
-                    template_resolver=self.factory.template_resolver(),
-                )
-                for mp in pending:
-                    # per-ROW outcome: a poisoned spec fails only itself
-                    results[key(mp)] = outcomes.get(key(mp))
-            except Exception as e:  # noqa: BLE001 — global failure
-                for mp in pending:
-                    results[key(mp)] = e
+            self._solve_pending_batch(pending, key, results)
 
         for mp in others:
             try:

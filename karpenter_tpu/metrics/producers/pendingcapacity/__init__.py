@@ -260,23 +260,46 @@ def solve_pending(
         targets, feed, nodes, template_resolver, errors
     )
 
-    # ONE encode implementation for every path (store/columnar.py): the
-    # caches snapshot their watch-maintained arenas; the oracle path runs
-    # the same detached encoder over a fresh store.list — no drift possible
-    all_pods = None
-    if feed is not None:
-        snap = feed.pods.snapshot()
-    elif pod_cache is not None:
-        snap = pod_cache.snapshot()
-    else:
-        all_pods = store.list("Pod")
-        snap = snapshot_from_pods(all_pods)
+    snap, all_pods = _pods_snapshot(store, feed, pod_cache)
+    census, namespace_state, needs_census = _occupancy_census(
+        store, feed, all_pods, nodes, snap
+    )
 
-    # Existing-pod domain occupancy: only fleets with live spread/anti
-    # constraints or soft preferences pay for a census (freed arena
-    # slots are zeroed, so the id scan is exact); unconstrained fleets
-    # skip it entirely — and their encode memo stays insensitive to
-    # bound-pod churn
+    if feed is not None:
+        _solve_feed_path(
+            feed, snap, profiles, census, needs_census, namespace_state,
+            targets, template_rows, registry, solver, errors,
+        )
+    else:
+        inputs = _encode_from_cache(snap, profiles, census=census)
+        _dispatch_and_record(inputs, targets, registry, solver, errors)
+    _publish_census(registry, census)
+    return {
+        (namespace, name): errors.get((namespace, name))
+        for namespace, name, _, _, _ in targets
+    }
+
+
+def _pods_snapshot(store, feed, pod_cache):
+    """ONE encode implementation for every path (store/columnar.py): the
+    caches snapshot their watch-maintained arenas; the oracle path runs
+    the same detached encoder over a fresh store.list — no drift
+    possible. Returns (snapshot, all_pods) — all_pods is only non-None
+    on the oracle path, where the census can reuse the listing."""
+    if feed is not None:
+        return feed.pods.snapshot(), None
+    if pod_cache is not None:
+        return pod_cache.snapshot(), None
+    all_pods = store.list("Pod")
+    return snapshot_from_pods(all_pods), all_pods
+
+
+def _occupancy_census(store, feed, all_pods, nodes, snap):
+    """Existing-pod domain occupancy: only fleets with live spread/anti
+    constraints or soft preferences pay for a census (freed arena slots
+    are zeroed, so the id scan is exact); unconstrained fleets skip it
+    entirely — and their encode memo stays insensitive to bound-pod
+    churn. Returns (census, namespace_state, needs_census)."""
     needs_census = any(
         ids is not None and bool((ids != 0).any())
         for ids in (
@@ -286,53 +309,46 @@ def solve_pending(
             snap.soft_anti_id,
         )
     )
-    census = None
-    namespace_state = ()
-    if needs_census:
-        if feed is None and all_pods is None:
-            all_pods = store.list("Pod")
-        census, namespace_state = _build_census(
-            store, feed, all_pods, nodes
-        )
+    if not needs_census:
+        return None, (), False
+    if feed is None and all_pods is None:
+        all_pods = store.list("Pod")
+    census, namespace_state = _build_census(store, feed, all_pods, nodes)
+    return census, namespace_state, True
 
-    # Encode memo (feed path only): inputs are a pure function of
-    # (pod arena generation, node set, producer selectors, occupancy).
-    # When none of those moved since the last solve, reuse the previous
-    # BinPackInputs OBJECT — the solver's identity-keyed device cache
-    # (ops/binpack.solve) then skips the host->device transfer entirely,
-    # which dominates the tick when the chip sits behind a network
-    # tunnel.
-    if feed is not None:
-        fingerprint = _feed_fingerprint(
-            feed, snap, needs_census, namespace_state, targets,
-            template_rows,
-        )
-        memo = feed.encode_memo
-        cached_outputs = None
-        if memo is not None and memo[0] == fingerprint:
-            inputs = memo[1]
-            # the solve is a pure function of inputs: identical inputs
-            # reuse the PREVIOUS host outputs and skip the device call
-            # entirely — an unchanged tick costs no round-trip at all
-            cached_outputs = memo[2]
-            _count_cache(registry, "hit")
-        else:
-            inputs = _encode_from_cache(snap, profiles, census=census)
-            feed.encode_memo = (fingerprint, inputs, None)
-            _count_cache(registry, "miss")
-        host = _dispatch_and_record(
-            inputs, targets, registry, solver, errors,
-            cached_outputs=cached_outputs,
-        )
-        feed.encode_memo = (fingerprint, inputs, host)
+
+def _solve_feed_path(
+    feed, snap, profiles, census, needs_census, namespace_state,
+    targets, template_rows, registry, solver, errors,
+) -> None:
+    """Encode memo (feed path only): inputs are a pure function of
+    (pod arena generation, node set, producer selectors, occupancy).
+    When none of those moved since the last solve, reuse the previous
+    BinPackInputs OBJECT — the solver's identity-keyed device cache
+    (ops/binpack.solve) then skips the host->device transfer entirely,
+    which dominates the tick when the chip sits behind a network
+    tunnel."""
+    fingerprint = _feed_fingerprint(
+        feed, snap, needs_census, namespace_state, targets, template_rows
+    )
+    memo = feed.encode_memo
+    cached_outputs = None
+    if memo is not None and memo[0] == fingerprint:
+        inputs = memo[1]
+        # the solve is a pure function of inputs: identical inputs
+        # reuse the PREVIOUS host outputs and skip the device call
+        # entirely — an unchanged tick costs no round-trip at all
+        cached_outputs = memo[2]
+        _count_cache(registry, "hit")
     else:
         inputs = _encode_from_cache(snap, profiles, census=census)
-        _dispatch_and_record(inputs, targets, registry, solver, errors)
-    _publish_census(registry, census)
-    return {
-        (namespace, name): errors.get((namespace, name))
-        for namespace, name, _, _, _ in targets
-    }
+        feed.encode_memo = (fingerprint, inputs, None)
+        _count_cache(registry, "miss")
+    host = _dispatch_and_record(
+        inputs, targets, registry, solver, errors,
+        cached_outputs=cached_outputs,
+    )
+    feed.encode_memo = (fingerprint, inputs, host)
 
 
 

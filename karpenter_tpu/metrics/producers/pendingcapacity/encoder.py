@@ -34,6 +34,17 @@ from .spread import _expand_spread_rows
 
 _pad = pad_to_multiple
 
+def _profile_candidates(nodes: List, selector: Dict[str, str]) -> List:
+    """Ready+schedulable matching nodes, falling back to ANY matching
+    node when none are ready (a group scaled to zero still needs a
+    shape)."""
+    matching = [
+        n for n in nodes if matches_selector(n.metadata.labels, selector)
+    ]
+    ready = [n for n in matching if is_ready_and_schedulable(n)]
+    return ready or matching
+
+
 def _group_profile(
     nodes: List, selector: Dict[str, str]
 ) -> Tuple[Dict[str, float], set, set]:
@@ -54,11 +65,7 @@ def _group_profile(
     `nodes` is the full node list (listed ONCE per solve by the caller);
     selector filtering happens here to avoid O(groups) store scans.
     """
-    matching = [
-        n for n in nodes if matches_selector(n.metadata.labels, selector)
-    ]
-    ready = [n for n in matching if is_ready_and_schedulable(n)]
-    candidates = ready or matching
+    candidates = _profile_candidates(nodes, selector)
     alloc: Dict[str, float] = {}
     labels: set = set()
     taints: set = set()
@@ -301,6 +308,20 @@ def _affinity_forbidden(snap, row_idx, group_label_dicts, n_pods,
     return forbidden
 
 
+def _taint_universe(profiles) -> Dict[tuple, int]:
+    """Distinct HARD taints across group profiles -> bitset slot. Soft
+    (PreferNoSchedule) taints ride the profiles into the scoring plugin
+    and must never gate feasibility, so they never join the bitset."""
+    universe: Dict[tuple, int] = {}
+    for _, _, taints in profiles:
+        for taint in sorted(taints):
+            if taint[2] == "PreferNoSchedule":
+                continue
+            if taint not in universe:
+                universe[taint] = len(universe)
+    return universe
+
+
 def _encode_from_cache(snap, profiles, with_rows: bool = False, census=None):
     """Snapshot (store/columnar.PendingSnapshot) -> solver inputs, with
     rows DEDUPLICATED into distinct pod shapes + multiplicities
@@ -349,16 +370,7 @@ def _encode_from_cache(snap, profiles, with_rows: bool = False, census=None):
     )
     n_resources = _pad(len(resources), RESOURCE_PAD)
 
-    taint_universe: Dict[tuple, int] = {}
-    for _, _, taints in profiles:
-        for taint in sorted(taints):
-            # only HARD taints join the intolerance bitset; soft
-            # (PreferNoSchedule) taints ride the profiles into the
-            # scoring plugin and must never gate feasibility
-            if taint[2] == "PreferNoSchedule":
-                continue
-            if taint not in taint_universe:
-                taint_universe[taint] = len(taint_universe)
+    taint_universe = _taint_universe(profiles)
     label_universe = {item: l for l, item in enumerate(snap.labels)}
 
     n_pods = _pad(hi, POD_PAD)

@@ -76,17 +76,24 @@ def _apply_foreign_term(excluded, census, label_dicts, sign, key, sel,
         for t, labels in enumerate(label_dicts):
             if labels.get(key) in occupied:
                 excluded[t] = True
-    elif sign > 1 and not occupied:
+    else:
+        _require_occupied_domain(excluded, label_dicts, sign, key, occupied)
+
+
+def _require_occupied_domain(excluded, label_dicts, sign, key, occupied):
+    """The CO arms of a foreign term: placement must land in a domain
+    holding a matching pod."""
+    if sign > 1 and not occupied:
         # the scheduler's first-replica grace: the pod itself is in
         # scope and matches, so an empty census imposes nothing
         return
-    elif key == HOSTNAME_TOPOLOGY_KEY:
+    if key == HOSTNAME_TOPOLOGY_KEY:
         excluded[:] = True
-    else:
-        for t, labels in enumerate(label_dicts):
-            value = labels.get(key)
-            if value is None or value not in occupied:
-                excluded[t] = True
+        return
+    for t, labels in enumerate(label_dicts):
+        value = labels.get(key)
+        if value is None or value not in occupied:
+            excluded[t] = True
 
 
 def _anti_base_exclusion(shape, census, label_dicts, n_groups):
@@ -180,6 +187,14 @@ def _total_order(value):
     return (1, float(value))  # bool / int / float
 
 
+def _shape_of(shapes, ids, slot) -> tuple:
+    """A row's canonical shape from an optional (registry, id-column)
+    pair; () when the snapshot doesn't carry that column."""
+    if shapes is not None and ids is not None:
+        return shapes[ids[slot]]
+    return ()
+
+
 def _canonical_row_key(snap, slot: int) -> tuple:
     """Arena-independent content key for a snapshot row: every component
     is resolved through its universe REGISTRY (resource names, label
@@ -212,22 +227,9 @@ def _canonical_row_key(snap, slot: int) -> tuple:
             key=_total_order,
         )
     )
-    affinity = (
-        snap.affinity_shapes[snap.affinity_id[slot]]
-        if snap.affinity_shapes is not None and snap.affinity_id is not None
-        else ()
-    )
-    preferred = (
-        snap.preferred_shapes[snap.preferred_id[slot]]
-        if snap.preferred_shapes is not None
-        and snap.preferred_id is not None
-        else ()
-    )
-    spread = (
-        snap.spread_shapes[snap.spread_id[slot]]
-        if snap.spread_shapes is not None and snap.spread_id is not None
-        else ()
-    )
+    affinity = _shape_of(snap.affinity_shapes, snap.affinity_id, slot)
+    preferred = _shape_of(snap.preferred_shapes, snap.preferred_id, slot)
+    spread = _shape_of(snap.spread_shapes, snap.spread_id, slot)
     soft = tuple(
         shapes[ids[slot]]
         for shapes, ids in (

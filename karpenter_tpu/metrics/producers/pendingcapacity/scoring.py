@@ -106,6 +106,40 @@ def _soft_anti_raw(shapes, live, label_dicts_fn, census, n_real):
     return raw
 
 
+def _score_pieces(snap, profiles, row_idx, label_dicts_fn, census, n_real):
+    """(plugin weight, raw[hi, n_real]) per active scoring plugin."""
+    pieces = []
+
+    taint_raw = _taint_toleration_raw(snap, profiles, row_idx, n_real)
+    if taint_raw is not None and taint_raw.any():
+        # all-zero contributions (every pod tolerates every soft taint)
+        # must not put the fleet on the scored kernel path
+        pieces.append((3.0, taint_raw))
+
+    live = _live_ids(snap.preferred_id, snap.preferred_shapes, row_idx)
+    if live is not None:
+        raw = _node_affinity_raw(
+            snap.preferred_shapes, live, label_dicts_fn, n_real
+        )
+        pieces.append((1.0, raw[live]))
+
+    live = _live_ids(snap.soft_spread_id, snap.soft_spread_shapes, row_idx)
+    if live is not None:
+        raw = _soft_spread_raw(
+            snap.soft_spread_shapes, live, label_dicts_fn, census, n_real
+        )
+        pieces.append((2.0, raw[live]))
+
+    live = _live_ids(snap.soft_anti_id, snap.soft_anti_shapes, row_idx)
+    if live is not None and census is not None:
+        raw = _soft_anti_raw(
+            snap.soft_anti_shapes, live, label_dicts_fn, census, n_real
+        )
+        if raw.any():
+            pieces.append((1.0, raw[live]))
+    return pieces
+
+
 def _score_rows(
     snap, profiles, row_idx, label_dicts_fn, census, n_pods, n_groups
 ):
@@ -138,36 +172,9 @@ def _score_rows(
     if hi == 0:
         return None
     n_real = len(profiles)
-    pieces = []  # (plugin weight, raw[hi, n_real])
-
-    taint_raw = _taint_toleration_raw(snap, profiles, row_idx, n_real)
-    if taint_raw is not None and taint_raw.any():
-        # all-zero contributions (every pod tolerates every soft taint)
-        # must not put the fleet on the scored kernel path
-        pieces.append((3.0, taint_raw))
-
-    live = _live_ids(snap.preferred_id, snap.preferred_shapes, row_idx)
-    if live is not None:
-        raw = _node_affinity_raw(
-            snap.preferred_shapes, live, label_dicts_fn, n_real
-        )
-        pieces.append((1.0, raw[live]))
-
-    live = _live_ids(snap.soft_spread_id, snap.soft_spread_shapes, row_idx)
-    if live is not None:
-        raw = _soft_spread_raw(
-            snap.soft_spread_shapes, live, label_dicts_fn, census, n_real
-        )
-        pieces.append((2.0, raw[live]))
-
-    live = _live_ids(snap.soft_anti_id, snap.soft_anti_shapes, row_idx)
-    if live is not None and census is not None:
-        raw = _soft_anti_raw(
-            snap.soft_anti_shapes, live, label_dicts_fn, census, n_real
-        )
-        if raw.any():
-            pieces.append((1.0, raw[live]))
-
+    pieces = _score_pieces(
+        snap, profiles, row_idx, label_dicts_fn, census, n_real
+    )
     if not pieces:
         return None
     acc = np.zeros((hi, n_real), np.float32)

@@ -71,6 +71,16 @@ def _partition_entry(entry_idx, skew, value_groups, caps, values,
     )
 
 
+def _mark_dead_domains(dead, caps2, values2, vals2, n_groups):
+    """Flag every group of a zero-capacity domain in the dead mask."""
+    if dead is None:
+        dead = np.zeros(n_groups, bool)
+    for j, value in enumerate(values2):
+        if caps2[j] <= 0:
+            dead[vals2[value]] = True
+    return dead
+
+
 def _nonsplit_entry_states(entries, split_key, entry_counts, eligible,
                            label_dicts, dead):
     """Fold the NON-split entries into (others, dead). Their
@@ -100,11 +110,9 @@ def _nonsplit_entry_states(entries, split_key, entry_counts, eligible,
         caps2, _, _ = _entry_caps(skew, min_domains, self_match,
                                   values2, counts_e, present_e)
         if (caps2 <= 0).any():
-            if dead is None:
-                dead = np.zeros(len(label_dicts), bool)
-            for j, value in enumerate(values2):
-                if caps2[j] <= 0:
-                    dead[vals2[value]] = True
+            dead = _mark_dead_domains(
+                dead, caps2, values2, vals2, len(label_dicts)
+            )
         if self_match:
             others.append(
                 _partition_entry(
@@ -302,24 +310,12 @@ def _spread_partition_view(shape, row_filter, label_dicts, census,
         caps_e, _, _ = _entry_caps(
             skew, min_domains, self_match, values, counts_e, present_e
         )
-        for j, value in enumerate(values):
-            if caps_e[j] <= 0:
-                dead[vals[value]] = True
+        dead = _mark_dead_domains(dead, caps_e, values, vals, n_groups)
         if self_match:
             others.append(
-                (
-                    ("spread", idx),
-                    int(skew),
-                    {v: vals[v] for v in values},
-                    {
-                        v: (
-                            int(caps_e[j])
-                            if caps_e[j] < _UNBOUNDED
-                            else None
-                        )
-                        for j, v in enumerate(values)
-                    },
-                    {v: counts_e.get(v, 0) for v in values},
+                _partition_entry(
+                    ("spread", idx), skew, {v: vals[v] for v in values},
+                    caps_e, values, counts_e,
                 )
             )
     return {

@@ -373,6 +373,26 @@ def solve(
 # ---------------------------------------------------------------------------
 
 
+def _bfd_fill_existing(bins: np.ndarray, k: int, c: int, buckets: int) -> int:
+    """Place as many of `c` items of size k into existing bins, best-fit
+    (smallest sufficient remnant first), re-scanning as remnants shrink.
+    Returns the unplaced count."""
+    while c > 0:
+        placed = False
+        for rem in range(k, buckets + 1):
+            m = min(c, int(bins[rem]))
+            if m > 0:
+                bins[rem] -= m
+                bins[rem - k] += m
+                c -= m
+                placed = True
+            if c == 0:
+                break
+        if not placed:
+            break
+    return c
+
+
 def oracle_shelf_bfd(histogram: np.ndarray, buckets: int) -> np.ndarray:
     """histogram: i32[T, B] -> i32[T], mirroring _shelf_bfd semantics."""
     n_groups = histogram.shape[0]
@@ -380,24 +400,7 @@ def oracle_shelf_bfd(histogram: np.ndarray, buckets: int) -> np.ndarray:
     for t in range(n_groups):
         bins = np.zeros(buckets + 1, np.int64)  # count by remaining capacity
         for k in range(buckets, 0, -1):
-            c = int(histogram[t, k - 1])
-            # fill existing bins best-fit (smallest sufficient rem first),
-            # re-scanning as remnants shrink
-            while c > 0:
-                placed = False
-                for rem in range(k, buckets + 1):
-                    if rem == 0:
-                        continue
-                    m = min(c, int(bins[rem]))
-                    if m > 0:
-                        bins[rem] -= m
-                        bins[rem - k] += m
-                        c -= m
-                        placed = True
-                    if c == 0:
-                        break
-                if not placed:
-                    break
+            c = _bfd_fill_existing(bins, k, int(histogram[t, k - 1]), buckets)
             if c > 0:
                 per_bin = buckets // k
                 full = c // per_bin
@@ -406,7 +409,6 @@ def oracle_shelf_bfd(histogram: np.ndarray, buckets: int) -> np.ndarray:
                 bins[buckets - per_bin * k] += full
                 if leftover > 0:
                     bins[buckets - leftover * k] += 1
-        totals[t] += 0
     return totals.astype(np.int64)
 
 

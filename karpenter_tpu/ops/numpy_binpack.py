@@ -248,6 +248,84 @@ def _shelf_bfd_np(histogram: np.ndarray, buckets: int) -> np.ndarray:
     return total.astype(np.int32)
 
 
+def _assign_numpy(
+    requests, valid, intolerant, required, alloc, taints, labels,
+    forbidden, score, weight, exclusive, buckets,
+):
+    """The pure-numpy assignment pass (the fallback while the C kernel's
+    background build finishes). Sparse layout: everything after the
+    argmax scatters over the ONE assigned group per pod — O(P), where
+    the dense XLA layout is O(P*T*(B|R))."""
+    _, n_resources = requests.shape
+    n_groups = alloc.shape[0]
+    feasible = _feasibility_np(
+        requests, valid, intolerant, required, alloc, taints, labels,
+        forbidden,
+    )
+    any_feasible = feasible.any(axis=1)
+    if score is None:
+        choice = np.argmax(feasible, axis=1)
+    else:
+        choice = np.argmax(np.where(feasible, score, -np.inf), axis=1)
+    assigned = np.where(any_feasible, choice, -1).astype(np.int32)
+
+    rows = np.nonzero(any_feasible & valid)[0]
+    groups_of = choice[rows]
+    w_of = (
+        np.ones(len(rows), np.int64)
+        if weight is None
+        else weight[rows]
+    )
+
+    assigned_count = np.bincount(
+        groups_of, weights=w_of, minlength=n_groups
+    ).astype(np.int32)
+
+    # dominant share of each assigned pod ON ITS GROUP ONLY, f32 ops
+    # in the same order as _dominant_share so the quantized bucket
+    # matches the XLA program bit for bit
+    share = np.zeros(len(rows), np.float32)
+    row_alloc = alloc[groups_of]  # [n, R]
+    row_req = requests[rows]
+    for r in range(n_resources):
+        a = row_alloc[:, r]
+        s = np.where(
+            a > 0,
+            row_req[:, r] / np.maximum(a, np.float32(1e-30)),
+            np.float32(np.inf),
+        ).astype(np.float32)
+        s = np.where(
+            (a <= 0) & (row_req[:, r] <= 0), np.float32(0.0), s
+        )
+        share = np.maximum(share, s)
+    bucket_of = np.clip(
+        np.ceil(share * np.float32(buckets)).astype(np.int64),
+        1,
+        buckets,
+    )
+    if exclusive is not None:
+        # hostname self-anti-affinity: the pod takes a whole node
+        bucket_of = np.where(exclusive[rows], buckets, bucket_of)
+    histogram = np.bincount(
+        groups_of.astype(np.int64) * buckets + (bucket_of - 1),
+        weights=w_of,
+        minlength=n_groups * buckets,
+    ).reshape(n_groups, buckets)
+
+    # f64 demand accumulation in pod order — bitwise-identical to
+    # the native kernel's accumulation
+    demand64 = np.zeros((n_groups, n_resources), np.float64)
+    np.add.at(
+        demand64, groups_of, row_req.astype(np.float64) * w_of[:, None]
+    )
+    unsched_mask = (~any_feasible) & valid
+    if weight is None:
+        unschedulable = int(unsched_mask.sum())
+    else:
+        unschedulable = int(weight[unsched_mask].sum())
+    return assigned, assigned_count, histogram, demand64, unschedulable
+
+
 def binpack_numpy(
     inputs: BinPackInputs, buckets: int = 32, use_native: bool = True
 ) -> BinPackOutputs:
@@ -311,76 +389,16 @@ def binpack_numpy(
         )
         assigned_count = assigned_count64.astype(np.int32)
     else:
-        feasible = _feasibility_np(
+        (
+            assigned,
+            assigned_count,
+            histogram,
+            demand64,
+            unschedulable,
+        ) = _assign_numpy(
             requests, valid, intolerant, required, alloc, taints, labels,
-            forbidden,
+            forbidden, score, weight, exclusive, buckets,
         )
-        any_feasible = feasible.any(axis=1)
-        if score is None:
-            choice = np.argmax(feasible, axis=1)
-        else:
-            choice = np.argmax(
-                np.where(feasible, score, -np.inf), axis=1
-            )
-        assigned = np.where(any_feasible, choice, -1).astype(np.int32)
-
-        # the sparse layout: everything below scatters over the ONE
-        # assigned group per pod — O(P), where the dense XLA layout is
-        # O(P*T*(B|R))
-        rows = np.nonzero(any_feasible & valid)[0]
-        groups_of = choice[rows]
-        w_of = (
-            np.ones(len(rows), np.int64)
-            if weight is None
-            else weight[rows]
-        )
-
-        assigned_count = np.bincount(
-            groups_of, weights=w_of, minlength=n_groups
-        ).astype(np.int32)
-
-        # dominant share of each assigned pod ON ITS GROUP ONLY, f32 ops
-        # in the same order as _dominant_share so the quantized bucket
-        # matches the XLA program bit for bit
-        share = np.zeros(len(rows), np.float32)
-        row_alloc = alloc[groups_of]  # [n, R]
-        row_req = requests[rows]
-        for r in range(n_resources):
-            a = row_alloc[:, r]
-            s = np.where(
-                a > 0,
-                row_req[:, r] / np.maximum(a, np.float32(1e-30)),
-                np.float32(np.inf),
-            ).astype(np.float32)
-            s = np.where(
-                (a <= 0) & (row_req[:, r] <= 0), np.float32(0.0), s
-            )
-            share = np.maximum(share, s)
-        bucket_of = np.clip(
-            np.ceil(share * np.float32(buckets)).astype(np.int64),
-            1,
-            buckets,
-        )
-        if exclusive is not None:
-            # hostname self-anti-affinity: the pod takes a whole node
-            bucket_of = np.where(exclusive[rows], buckets, bucket_of)
-        histogram = np.bincount(
-            groups_of.astype(np.int64) * buckets + (bucket_of - 1),
-            weights=w_of,
-            minlength=n_groups * buckets,
-        ).reshape(n_groups, buckets)
-
-        # f64 demand accumulation in pod order — bitwise-identical to
-        # the native kernel's accumulation
-        demand64 = np.zeros((n_groups, n_resources), np.float64)
-        np.add.at(
-            demand64, groups_of, row_req.astype(np.float64) * w_of[:, None]
-        )
-        unsched_mask = (~any_feasible) & valid
-        if weight is None:
-            unschedulable = int(unsched_mask.sum())
-        else:
-            unschedulable = int(weight[unsched_mask].sum())
 
     nodes_needed = _shelf_bfd(histogram, buckets, lib)
 

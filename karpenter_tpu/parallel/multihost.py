@@ -30,6 +30,62 @@ from karpenter_tpu.utils.log import logger
 _initialized = False
 
 
+def _resolve_topology(coordinator_address, num_processes, process_id):
+    """Resolve each parameter (explicit argument, then standard env var)
+    and enforce all-or-nothing: a PARTIAL explicit topology raises —
+    silently degrading a mis-wired multi-host fleet to N independent
+    single-host solvers would double-solve the fleet."""
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    env_processes = os.environ.get("JAX_NUM_PROCESSES")
+    num_processes = (
+        num_processes
+        if num_processes is not None
+        else (int(env_processes) if env_processes else None)
+    )
+    env_process_id = os.environ.get("JAX_PROCESS_ID")
+    process_id = (
+        process_id
+        if process_id is not None
+        else (int(env_process_id) if env_process_id else None)
+    )
+    explicit = (coordinator_address, num_processes, process_id)
+    configured = [value for value in explicit if value is not None]
+    if configured and len(configured) != len(explicit):
+        raise ValueError(
+            "partial multihost topology: coordinator_address, "
+            f"num_processes, process_id must be set together (got "
+            f"{explicit!r}); a half-configured host joining single-host "
+            "would double-solve the fleet while the rest hang"
+        )
+    return explicit
+
+
+def _auto_initialize(jax) -> bool:
+    """The auto path: let jax's cluster detection decide. Attempted
+    UNCONDITIONALLY (probing the backend first would itself initialize
+    XLA and poison the join). Returns False only on the EXACT no-cluster
+    sentinel: jax's cluster auto-detection found no cluster and fell
+    through to the bare-args validation (jax._src.distributed raises
+    RuntimeError 'coordinator_address should be defined.'). Anything
+    else — a detected-but-unreachable coordinator, a partial detection,
+    'must be called before any JAX calls' (an ordering bug in the
+    caller) — is a REAL failure and raises: degrading a detected
+    multi-host fleet to N independent solvers would double-solve the
+    fleet while the other hosts hang in initialize. Substring matching
+    here once misread real join failures (r3 code review)."""
+    try:
+        jax.distributed.initialize()
+    except Exception as e:  # noqa: BLE001 — classified above
+        if str(e).strip() == "coordinator_address should be defined.":
+            # the normal single-host case
+            logger().info("no multihost topology detected: %s", e)
+            return False
+        raise
+    return True
+
+
 def initialize_multihost(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -57,56 +113,15 @@ def initialize_multihost(
     global _initialized
     if _initialized:
         return True
-    coordinator_address = coordinator_address or os.environ.get(
-        "JAX_COORDINATOR_ADDRESS"
+    coordinator_address, num_processes, process_id = _resolve_topology(
+        coordinator_address, num_processes, process_id
     )
-    env_processes = os.environ.get("JAX_NUM_PROCESSES")
-    num_processes = (
-        num_processes
-        if num_processes is not None
-        else (int(env_processes) if env_processes else None)
-    )
-    env_process_id = os.environ.get("JAX_PROCESS_ID")
-    process_id = (
-        process_id
-        if process_id is not None
-        else (int(env_process_id) if env_process_id else None)
-    )
-    explicit = (coordinator_address, num_processes, process_id)
-    configured = [value for value in explicit if value is not None]
-    if configured and len(configured) != len(explicit):
-        raise ValueError(
-            "partial multihost topology: coordinator_address, "
-            f"num_processes, process_id must be set together (got "
-            f"{explicit!r}); a half-configured host joining single-host "
-            "would double-solve the fleet while the rest hang"
-        )
 
     import jax
 
-    if not configured:
-        # auto path: let jax's cluster detection decide. Attempted
-        # UNCONDITIONALLY (probing the backend first would itself
-        # initialize XLA and poison the join).
-        try:
-            jax.distributed.initialize()
-        except Exception as e:  # noqa: BLE001 — classified below
-            # EXACT sentinel only: jax's cluster auto-detection found no
-            # cluster and fell through to the bare-args validation
-            # (jax._src.distributed raises RuntimeError
-            # 'coordinator_address should be defined.'). Anything else —
-            # a detected-but-unreachable coordinator, a partial
-            # detection, 'must be called before any JAX calls' (an
-            # ordering bug in the caller) — is a REAL failure and
-            # raises: degrading a detected multi-host fleet to N
-            # independent solvers would double-solve the fleet while
-            # the other hosts hang in initialize. Substring matching
-            # here once misread real join failures (r3 code review).
-            if str(e).strip() == "coordinator_address should be defined.":
-                # the normal single-host case
-                logger().info("no multihost topology detected: %s", e)
-                return False
-            raise
+    if coordinator_address is None:
+        if not _auto_initialize(jax):
+            return False
         _initialized = True
     else:
         jax.distributed.initialize(

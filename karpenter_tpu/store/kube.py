@@ -136,6 +136,25 @@ def _null_vanished(old: dict, new: dict) -> dict:
     return out
 
 
+def _make_ssl_context(base_url: str, insecure: bool, ca_file):
+    """SSL context for an https apiserver URL (None for plain http):
+    CERT_NONE when insecure, else the given CA / the in-cluster
+    serviceaccount CA / system defaults."""
+    if not base_url.startswith("https"):
+        return None
+    if insecure:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        return ctx
+    ca = ca_file or (
+        os.path.join(_SA_DIR, "ca.crt")
+        if os.path.exists(os.path.join(_SA_DIR, "ca.crt"))
+        else None
+    )
+    return ssl.create_default_context(cafile=ca)
+
+
 class KubeClient:
     """Minimal apiserver REST client; no client library, just urllib."""
 
@@ -166,20 +185,7 @@ class KubeClient:
             else None
         )
         self.timeout = timeout
-        if self.base_url.startswith("https"):
-            if insecure:
-                self._ssl = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
-                self._ssl.check_hostname = False
-                self._ssl.verify_mode = ssl.CERT_NONE
-            else:
-                ca = ca_file or (
-                    os.path.join(_SA_DIR, "ca.crt")
-                    if os.path.exists(os.path.join(_SA_DIR, "ca.crt"))
-                    else None
-                )
-                self._ssl = ssl.create_default_context(cafile=ca)
-        else:
-            self._ssl = None
+        self._ssl = _make_ssl_context(self.base_url, insecure, ca_file)
 
     def _headers(self, content_type: Optional[str] = None) -> dict:
         headers = {"Accept": "application/json"}

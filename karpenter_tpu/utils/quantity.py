@@ -166,28 +166,38 @@ class Quantity:
         v = self.value
         if v == 0:
             return "0"
-        neg = v < 0
-        if neg:
+        sign = "-" if v < 0 else ""
+        if v < 0:
             v = -v
         if self.format == BINARY_SI:
-            for suffix in ("Ei", "Pi", "Ti", "Gi", "Mi", "Ki"):
-                unit = _BINARY_SUFFIXES[suffix]
-                if v >= unit and (v / unit).denominator == 1:
-                    return f"{'-' if neg else ''}{v // unit}{suffix}"
-            if v.denominator == 1:
-                return f"{'-' if neg else ''}{v}"
-            # fractional binary quantities fall back to milli, like k8s does
-            # when forced below base units
+            text = _binary_str(v, sign)
+            if text is not None:
+                return text
+            # fractional binary quantities fall back to milli, like k8s
+            # does when forced below base units
         # decimal canonicalization: largest engineering exponent with an
         # integer mantissa
         for suffix in ("E", "P", "T", "G", "M", "k", "", "m", "u", "n"):
             unit = _DECIMAL_SUFFIXES[suffix]
             scaled = v / unit
             if scaled.denominator == 1:
-                return f"{'-' if neg else ''}{scaled}{suffix}"
+                return f"{sign}{scaled}{suffix}"
         # sub-nano: round up to nano (k8s rounds up when precision is lost)
         scaled = v / _DECIMAL_SUFFIXES["n"]
-        return f"{'-' if neg else ''}{int(scaled) + 1}n"
+        return f"{sign}{int(scaled) + 1}n"
+
+
+def _binary_str(v, sign: str):
+    """Canonical binary-SI rendering: the largest Ki..Ei suffix with an
+    integer mantissa, else the bare integer; None when v is fractional
+    below base units (caller falls back to decimal)."""
+    for suffix in ("Ei", "Pi", "Ti", "Gi", "Mi", "Ki"):
+        unit = _BINARY_SUFFIXES[suffix]
+        if v >= unit and (v / unit).denominator == 1:
+            return f"{sign}{v // unit}{suffix}"
+    if v.denominator == 1:
+        return f"{sign}{v}"
+    return None
 
 
 def parse_quantity(s) -> Quantity:
