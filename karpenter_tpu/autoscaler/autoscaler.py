@@ -98,8 +98,13 @@ class BatchAutoscaler:
         row = _Row(ha=ha, scale=None, values=[], targets=[], types=[])
         try:
             ref = ha.spec.scale_target_ref
+            # the ref's apiVersion rides along so ARBITRARY scalable kinds
+            # (a Deployment, any scale-marker CRD) resolve via discovery,
+            # not a hard-coded kind table (reference: autoscaler.go:196-237
+            # parseGroupResource + ScalesGetter)
             row.scale = self.store.get_scale(
-                ref.kind, ha.metadata.namespace, ref.name
+                ref.kind, ha.metadata.namespace, ref.name,
+                api_version=ref.api_version,
             )
             # spec-driven algorithm selection (the seam the reference left
             # as a TODO, algorithm.go:37-39): default rows encode raw
@@ -372,7 +377,10 @@ class BatchAutoscaler:
         if scale.spec_replicas is not None and desired == scale.spec_replicas:
             return
         scale.spec_replicas = desired
-        self.store.update_scale(ha.spec.scale_target_ref.kind, scale)
+        self.store.update_scale(
+            ha.spec.scale_target_ref.kind, scale,
+            api_version=ha.spec.scale_target_ref.api_version,
+        )
         ha.status.desired_replicas = desired
         ha.status.last_scale_time = now
 

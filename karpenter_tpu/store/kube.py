@@ -186,6 +186,11 @@ class KubeClient:
         )
         self.timeout = timeout
         self._ssl = _make_ssl_context(self.base_url, insecure, ca_file)
+        # (kind, apiVersion) resolved via API discovery (resolve_kind),
+        # memoized for the client's lifetime — discovery output only
+        # changes on CRD install/uninstall, which warrants a process
+        # restart anyway
+        self._discovered: Dict[tuple, Tuple[str, str, bool]] = {}
 
     def _headers(self, content_type: Optional[str] = None) -> dict:
         headers = {"Accept": "application/json"}
@@ -233,14 +238,115 @@ class KubeClient:
 
     # -- collection paths --------------------------------------------------
 
-    def _collection(self, kind: str, namespace: Optional[str]) -> str:
-        api, plural, namespaced = RESOURCES[kind]
+    # -- kind -> resource mapping (discovery) ------------------------------
+
+    def resolve_kind(
+        self, kind: str, api_version: str = ""
+    ) -> Tuple[str, str, bool]:
+        """(api prefix, plural, namespaced) for a kind. The framework's
+        own kinds come from the static table; anything else — e.g. an
+        HA's scaleTargetRef pointing at a Deployment — is resolved via
+        API discovery and memoized, the RESTMapper-over-discovery
+        pattern the reference leans on (reference:
+        autoscaler.go:196-237 parseGroupResource + RESTMapping).
+
+        Resolution (and the memo) is keyed by (kind, apiVersion): two
+        CRDs may legally share a kind across API groups, and a
+        kind-only cache would scale whichever group was discovered
+        first. The static table only short-circuits when the requested
+        apiVersion matches (or is unspecified)."""
+        static = RESOURCES.get(kind)
+        if static is not None and (
+            not api_version or static[0] == self._api_prefix(api_version)
+        ):
+            return static
+        key = (kind, api_version)
+        entry = self._discovered.get(key)
+        if entry is None:
+            entry = self._discover_kind(kind, api_version)
+            self._discovered[key] = entry
+        return entry
+
+    @staticmethod
+    def _api_prefix(api_version: str) -> str:
+        # core group ("v1") lives under /api; everything else /apis
+        return (
+            f"api/{api_version}"
+            if "/" not in api_version
+            else f"apis/{api_version}"
+        )
+
+    def _discover_kind(
+        self, kind: str, api_version: str
+    ) -> Tuple[str, str, bool]:
+        """Find the (group-version, plural, namespaced) serving `kind`.
+        With an apiVersion (the CrossVersionObjectReference always has
+        one) only that group-version's APIResourceList is consulted;
+        without, every served group-version is walked (preferred
+        versions first), plus core /api/v1."""
+        if api_version:
+            prefixes = [self._api_prefix(api_version)]
+            lenient = False  # the target group itself failing is an error
+        else:
+            prefixes = self._discovery_prefixes()
+            # the blind walk must tolerate partial discovery failure: a
+            # stale APIService (e.g. metrics.k8s.io with its backend
+            # down answers 503) must not poison resolution of a kind
+            # served by a healthy group — the RESTMapper posture
+            lenient = True
+        for prefix in prefixes:
+            entry = self._find_kind_in(prefix, kind, lenient)
+            if entry is not None:
+                return entry
+        raise NotFoundError(
+            f"kind {kind!r} (apiVersion {api_version!r}) is not served by "
+            "the apiserver (discovery found no matching resource)"
+        )
+
+    def _discovery_prefixes(self) -> list:
+        """Every served group-version (preferred versions first), plus
+        core /api/v1 — the blind-discovery walk order."""
+        prefixes = ["api/v1"]
+        for group in self._request("GET", "apis").get("groups", []):
+            preferred = (group.get("preferredVersion") or {}).get(
+                "groupVersion"
+            )
+            versions = [
+                v.get("groupVersion") for v in group.get("versions", [])
+            ]
+            ordered = [preferred] + [v for v in versions if v != preferred]
+            prefixes.extend(f"apis/{gv}" for gv in ordered if gv)
+        return prefixes
+
+    def _find_kind_in(self, prefix: str, kind: str, lenient: bool = False):
+        try:
+            payload = self._request("GET", prefix)
+        except NotFoundError:
+            return None  # group-version not served
+        except RuntimeError as e:  # incl. ConflictError; 404 handled above
+            if lenient:
+                log.warning("discovery: skipping %s: %s", prefix, e)
+                return None
+            raise
+        for res in payload.get("resources", []):
+            # subresources list as "deployments/scale" — the primary
+            # resource is the entry without a slash
+            if res.get("kind") == kind and "/" not in res.get("name", ""):
+                return (prefix, res["name"], bool(res.get("namespaced")))
+        return None
+
+    def _collection(
+        self, kind: str, namespace: Optional[str], api_version: str = ""
+    ) -> str:
+        api, plural, namespaced = self.resolve_kind(kind, api_version)
         if namespaced and namespace is not None:
             return f"{api}/namespaces/{namespace}/{plural}"
         return f"{api}/{plural}"  # all-namespaces (or cluster-scoped)
 
-    def _object_path(self, kind: str, namespace: str, name: str) -> str:
-        return f"{self._collection(kind, namespace)}/{name}"
+    def _object_path(
+        self, kind: str, namespace: str, name: str, api_version: str = ""
+    ) -> str:
+        return f"{self._collection(kind, namespace, api_version)}/{name}"
 
     # -- typed operations --------------------------------------------------
 
@@ -393,9 +499,12 @@ class KubeClient:
             "DELETE", self._object_path(kind, namespace, name)
         )
 
-    def get_scale(self, kind: str, namespace: str, name: str) -> Scale:
+    def get_scale(
+        self, kind: str, namespace: str, name: str, api_version: str = ""
+    ) -> Scale:
         payload = self._request(
-            "GET", self._object_path(kind, namespace, name) + "/scale"
+            "GET",
+            self._object_path(kind, namespace, name, api_version) + "/scale",
         )
         return Scale(
             namespace=namespace,
@@ -404,10 +513,13 @@ class KubeClient:
             status_replicas=payload.get("status", {}).get("replicas", 0) or 0,
         )
 
-    def update_scale(self, kind: str, scale: Scale) -> None:
+    def update_scale(
+        self, kind: str, scale: Scale, api_version: str = ""
+    ) -> None:
         self._request(
             "PUT",
-            self._object_path(kind, scale.namespace, scale.name) + "/scale",
+            self._object_path(kind, scale.namespace, scale.name, api_version)
+            + "/scale",
             {
                 "apiVersion": "autoscaling/v1",
                 "kind": "Scale",
@@ -635,8 +747,12 @@ class KubeStore:
             name = obj_or_kind.metadata.name
         self.client.delete(kind, namespace, name)
 
-    def get_scale(self, kind: str, namespace: str, name: str) -> Scale:
-        return self.client.get_scale(kind, namespace, name)
+    def get_scale(
+        self, kind: str, namespace: str, name: str, api_version: str = ""
+    ) -> Scale:
+        return self.client.get_scale(kind, namespace, name, api_version)
 
-    def update_scale(self, kind: str, scale: Scale) -> None:
-        self.client.update_scale(kind, scale)
+    def update_scale(
+        self, kind: str, scale: Scale, api_version: str = ""
+    ) -> None:
+        self.client.update_scale(kind, scale, api_version)

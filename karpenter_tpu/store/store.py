@@ -315,14 +315,32 @@ class Store:
 
     # -- scale subresource -------------------------------------------------
 
-    def get_scale(self, kind: str, namespace: str, name: str) -> Scale:
+    def _scale_hooks(self, kind: str, obj) -> _ScaleHooks:
+        """Registered hooks, else the duck-typed fallback: any stored
+        object shaped like a scalable workload (spec.replicas +
+        status.replicas — Deployments, StatefulSets, and every
+        kubebuilder scale-marker CRD use exactly this layout) implements
+        scale without registration. The reference gets the same
+        generality from discovery + scale.ScalesGetter
+        (reference: autoscaler.go:196-237); in-memory mode derives it
+        from the object shape."""
         hooks = _scale_kinds.get(kind)
-        if hooks is None:
-            raise NotFoundError(f"kind {kind} does not implement scale")
+        if hooks is not None:
+            return hooks
+        spec = getattr(obj, "spec", None)
+        status = getattr(obj, "status", None)
+        if hasattr(spec, "replicas") and hasattr(status, "replicas"):
+            return _DUCK_SCALE_HOOKS
+        raise NotFoundError(f"kind {kind} does not implement scale")
+
+    def get_scale(
+        self, kind: str, namespace: str, name: str, api_version: str = ""
+    ) -> Scale:
         with self._lock:
             obj = self._objects.get((kind, namespace, name))
             if obj is None:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            hooks = self._scale_hooks(kind, obj)
             status = hooks.get_status(obj)
             return Scale(
                 namespace=namespace,
@@ -331,16 +349,16 @@ class Store:
                 status_replicas=int(status) if status is not None else 0,
             )
 
-    def update_scale(self, kind: str, scale: Scale) -> None:
-        hooks = _scale_kinds.get(kind)
-        if hooks is None:
-            raise NotFoundError(f"kind {kind} does not implement scale")
+    def update_scale(
+        self, kind: str, scale: Scale, api_version: str = ""
+    ) -> None:
         with self._lock:
             obj = self._objects.get((kind, scale.namespace, scale.name))
             if obj is None:
                 raise NotFoundError(
                     f"{kind} {scale.namespace}/{scale.name} not found"
                 )
+            hooks = self._scale_hooks(kind, obj)
             # copy-on-write (same contract as patch_status)
             new = fast_clone(obj)
             hooks.set_spec(new, scale.spec_replicas)
@@ -348,6 +366,13 @@ class Store:
             new.metadata.resource_version = self._rv
             self._objects[(kind, scale.namespace, scale.name)] = new
             self._notify(MODIFIED, new)
+
+
+_DUCK_SCALE_HOOKS = _ScaleHooks(
+    get_spec=lambda obj: obj.spec.replicas,
+    set_spec=lambda obj, replicas: setattr(obj.spec, "replicas", replicas),
+    get_status=lambda obj: obj.status.replicas,
+)
 
 
 def _register_builtin_scale_kinds():

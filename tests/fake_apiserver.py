@@ -27,6 +27,37 @@ PLURALS = {
     "nodes": "Node",
     "namespaces": "Namespace",
     "leases": "Lease",
+    # a standard scalable workload kind the framework does NOT model:
+    # exercises discovery-based scale-target resolution (an HA pointing
+    # its scaleTargetRef at a Deployment, reference autoscaler.go:196-237)
+    "deployments": "Deployment",
+}
+
+# API discovery documents (GET /apis, /api/v1, /apis/<group>/<version>):
+# what KubeClient.resolve_kind walks to map an unknown kind to its
+# (group-version, plural) — the RESTMapper-over-discovery pattern.
+API_GROUPS = {
+    "autoscaling.karpenter.sh": ["v1alpha1"],
+    "apps": ["v1"],
+    "coordination.k8s.io": ["v1"],
+}
+API_RESOURCES = {
+    "api/v1": [
+        ("pods", "Pod", True),
+        ("nodes", "Node", False),
+        ("namespaces", "Namespace", False),
+    ],
+    "apis/autoscaling.karpenter.sh/v1alpha1": [
+        ("horizontalautoscalers", "HorizontalAutoscaler", True),
+        ("metricsproducers", "MetricsProducer", True),
+        ("scalablenodegroups", "ScalableNodeGroup", True),
+        ("scalablenodegroups/scale", "Scale", True),
+    ],
+    "apis/apps/v1": [
+        ("deployments", "Deployment", True),
+        ("deployments/scale", "Scale", True),
+    ],
+    "apis/coordination.k8s.io/v1": [("leases", "Lease", True)],
 }
 
 _PATH_RE = re.compile(
@@ -128,6 +159,41 @@ class FakeApiServer:
             if want == plural:
                 q.put({"type": event, "object": doc})
 
+    @staticmethod
+    def discovery_doc(path: str) -> Optional[dict]:
+        """The discovery document for a path, or None when the path is a
+        resource request (handled by the CRUD machinery)."""
+        path = path.strip("/")
+        if path == "apis":
+            return {
+                "kind": "APIGroupList",
+                "groups": [
+                    {
+                        "name": group,
+                        "versions": [
+                            {"groupVersion": f"{group}/{v}", "version": v}
+                            for v in versions
+                        ],
+                        "preferredVersion": {
+                            "groupVersion": f"{group}/{versions[0]}",
+                            "version": versions[0],
+                        },
+                    }
+                    for group, versions in API_GROUPS.items()
+                ],
+            }
+        resources = API_RESOURCES.get(path)
+        if resources is None:
+            return None
+        return {
+            "kind": "APIResourceList",
+            "groupVersion": path.split("apis/")[-1],
+            "resources": [
+                {"name": name, "kind": kind, "namespaced": namespaced}
+                for name, kind, namespaced in resources
+            ],
+        }
+
     def objects(self, plural: str) -> List[dict]:
         with self._lock:
             return [
@@ -165,6 +231,9 @@ class FakeApiServer:
                 return m, parse_qs(parts.query)
 
             def do_GET(self):  # noqa: N802
+                discovery = fake.discovery_doc(urlsplit(self.path).path)
+                if discovery is not None:
+                    return self._send_json(200, discovery)
                 matched = self._match()
                 if matched is None:
                     return

@@ -14,6 +14,8 @@ registry metrics client → batched decision kernel → scale subresource →
 provider actuation.
 """
 
+from dataclasses import dataclass
+
 import pytest
 
 from karpenter_tpu.api import conditions as cond
@@ -710,3 +712,93 @@ class TestCurrentMetricsStatus:
         )
         assert status.prometheus.current.average_utilization == 85
         assert status.prometheus.current.value is None
+
+
+# -- arbitrary scale targets (reference: autoscaler.go:196-237) -------------
+
+
+@dataclass
+class _WorkloadSpec:
+    replicas: int = 1
+
+
+@dataclass
+class _WorkloadStatus:
+    replicas: int = 0
+
+
+@dataclass
+class _Deployment:
+    """A scalable kind the framework does not model: exercises the
+    duck-typed scale path (spec.replicas/status.replicas) the way the
+    reference's discovery + ScalesGetter reaches ANY scalable resource."""
+
+    metadata: ObjectMeta
+    spec: _WorkloadSpec
+    status: _WorkloadStatus
+
+    KIND = "Deployment"
+
+
+def deployment_ha(name="web"):
+    ha = utilization_ha(name, queries=(
+        "karpenter_reserved_capacity_cpu_utilization",))
+    ha.spec.scale_target_ref = CrossVersionObjectReference(
+        api_version="apps/v1", kind="Deployment", name=name
+    )
+    return ha
+
+
+class TestArbitraryScaleTarget:
+    def test_ha_targeting_deployment_converges(self, env):
+        """An HA pointing scaleTargetRef at a Deployment — legal in the
+        reference via discovery+RESTMapper — actuates through the
+        in-memory store's duck-typed scale subresource."""
+        runtime, provider, clock = env
+        name = "web"
+        gauge = runtime.registry.register(
+            "reserved_capacity", "cpu_utilization"
+        )
+        gauge.set(name, "default", 0.85)
+        runtime.store.create(
+            _Deployment(
+                metadata=ObjectMeta(name=name),
+                spec=_WorkloadSpec(replicas=5),
+                status=_WorkloadStatus(replicas=5),
+            )
+        )
+        runtime.store.create(deployment_ha(name))
+        runtime.manager.reconcile_all()
+
+        happy, ha = all_happy(runtime.store, deployment_ha(name))
+        assert happy, [
+            (c.type, c.status, c.message) for c in ha.status.conditions
+        ]
+        assert ha.status.desired_replicas == 8  # ceil(5 * 85/60)
+        target = runtime.store.get("Deployment", "default", name)
+        assert target.spec.replicas == 8
+
+    def test_unscalable_kind_marks_not_active(self, env):
+        """A target without spec.replicas/status.replicas does not
+        implement scale: the HA row fails (Active False), nothing
+        crashes."""
+        runtime, provider, clock = env
+        name = "cfg"
+
+        @dataclass
+        class _ConfigMap:
+            metadata: ObjectMeta
+            KIND = "ConfigMap"
+
+        runtime.store.create(_ConfigMap(metadata=ObjectMeta(name=name)))
+        ha = deployment_ha(name)
+        ha.spec.scale_target_ref.kind = "ConfigMap"
+        ha.spec.scale_target_ref.api_version = "v1"
+        runtime.store.create(ha)
+        runtime.manager.reconcile_all()
+        fresh = runtime.store.get(
+            "HorizontalAutoscaler", "default", name
+        )
+        conds = {c.type: c for c in fresh.status.conditions}
+        assert conds["Active"].status == "False"
+        assert "does not implement scale" in conds["Active"].message

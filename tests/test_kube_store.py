@@ -575,3 +575,181 @@ class TestChunkedList:
         ]
         assert api.list_pages_served - before == 3  # 10 + 10 + 3
         assert rv and rv != "0"
+
+
+class TestArbitraryScaleTargetOnKube:
+    """Discovery-based scale-target resolution (reference:
+    autoscaler.go:196-237 — GVK->GVR via RESTMapper over discovery).
+    Kinds outside the static RESOURCES table resolve through /apis."""
+
+    def deployment_doc(self, name="web", replicas=5):
+        return {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"replicas": replicas},
+            "status": {"replicas": replicas},
+        }
+
+    def test_scale_via_explicit_api_version(self, api, kube):
+        api.put_object("deployments", self.deployment_doc())
+        scale = kube.get_scale(
+            "Deployment", "default", "web", api_version="apps/v1"
+        )
+        assert (scale.spec_replicas, scale.status_replicas) == (5, 5)
+        scale.spec_replicas = 9
+        kube.update_scale("Deployment", scale, api_version="apps/v1")
+        (doc,) = [
+            d for d in api.objects("deployments")
+            if d["metadata"]["name"] == "web"
+        ]
+        assert doc["spec"]["replicas"] == 9
+
+    def test_discovery_without_api_version_walks_groups(self, api):
+        client = KubeClient(base_url=api.url, timeout=5.0)
+        assert client.resolve_kind("Deployment") == (
+            "apis/apps/v1", "deployments", True
+        )
+
+    def test_unknown_kind_reports_not_served(self, api):
+        client = KubeClient(base_url=api.url, timeout=5.0)
+        with pytest.raises(Exception, match="not served"):
+            client.resolve_kind("FlumeJob", "flume.example.com/v9")
+
+    def test_ha_targeting_deployment_converges(self, api, kube):
+        """The whole control plane over HTTP: an HA whose scaleTargetRef
+        names a Deployment (apps/v1) resolves via discovery and actuates
+        through PUT .../deployments/web/scale."""
+        from karpenter_tpu.api.core import ObjectMeta as Meta
+        from karpenter_tpu.api.horizontalautoscaler import (
+            CrossVersionObjectReference,
+            HorizontalAutoscaler,
+            HorizontalAutoscalerSpec,
+            Metric,
+            MetricTarget,
+            PrometheusMetricSource,
+        )
+        from karpenter_tpu.runtime import KarpenterRuntime
+
+        api.put_object("deployments", self.deployment_doc())
+        runtime = KarpenterRuntime(store=kube)
+        gauge = runtime.registry.register(
+            "reserved_capacity", "cpu_utilization"
+        )
+        gauge.set("web", "default", 0.85)
+        kube.create(
+            HorizontalAutoscaler(
+                metadata=Meta(name="web", namespace="default"),
+                spec=HorizontalAutoscalerSpec(
+                    scale_target_ref=CrossVersionObjectReference(
+                        api_version="apps/v1", kind="Deployment", name="web"
+                    ),
+                    min_replicas=1,
+                    max_replicas=23,
+                    metrics=[
+                        Metric(
+                            prometheus=PrometheusMetricSource(
+                                query=(
+                                    "karpenter_reserved_capacity_cpu_"
+                                    'utilization{name="web"}'
+                                ),
+                                target=MetricTarget(
+                                    type="Utilization", value=60
+                                ),
+                            )
+                        )
+                    ],
+                ),
+            )
+        )
+        assert wait_for(
+            lambda: kube.try_get("HorizontalAutoscaler", "default", "web")
+            is not None
+        )
+        runtime.manager.reconcile_all()
+
+        def scaled():
+            docs = [
+                d for d in api.objects("deployments")
+                if d["metadata"]["name"] == "web"
+            ]
+            return docs and docs[0]["spec"]["replicas"] == 8
+
+        assert wait_for(scaled), api.objects("deployments")
+        runtime.close()
+
+    def test_same_kind_across_groups_resolves_per_api_version(self, api):
+        """Two CRDs may share a kind across API groups; resolution (and
+        the memo) must key on (kind, apiVersion), not kind alone."""
+        from tests import fake_apiserver as f
+
+        f.API_GROUPS.setdefault("b.example.com", ["v1"])
+        f.API_RESOURCES["apis/b.example.com/v1"] = [
+            ("widgets", "Deployment", True)
+        ]
+        try:
+            client = KubeClient(base_url=api.url, timeout=5.0)
+            assert client.resolve_kind("Deployment", "apps/v1") == (
+                "apis/apps/v1", "deployments", True
+            )
+            # the apps/v1 answer must not be served for b.example.com/v1
+            assert client.resolve_kind(
+                "Deployment", "b.example.com/v1"
+            ) == ("apis/b.example.com/v1", "widgets", True)
+        finally:
+            f.API_GROUPS.pop("b.example.com", None)
+            f.API_RESOURCES.pop("apis/b.example.com/v1", None)
+
+    def test_blind_walk_tolerates_broken_group(self):
+        """A stale APIService (503 on its APIResourceList) must not
+        poison blind resolution of a kind served by a healthy group —
+        the RESTMapper's partial-discovery posture. The broken group is
+        walked FIRST, so only the skip keeps resolution alive; with an
+        EXPLICIT apiVersion naming the broken group, the failure must
+        surface instead."""
+        client = KubeClient(base_url="http://127.0.0.1:1", timeout=1.0)
+
+        def fake_request(method, path, *args, **kwargs):
+            if path == "apis":
+                return {
+                    "groups": [
+                        {
+                            "name": "broken.example.com",
+                            "preferredVersion": {
+                                "groupVersion": "broken.example.com/v1"
+                            },
+                            "versions": [
+                                {"groupVersion": "broken.example.com/v1"}
+                            ],
+                        },
+                        {
+                            "name": "apps",
+                            "preferredVersion": {
+                                "groupVersion": "apps/v1"
+                            },
+                            "versions": [{"groupVersion": "apps/v1"}],
+                        },
+                    ]
+                }
+            if path == "api/v1":
+                return {"resources": []}
+            if path == "apis/broken.example.com/v1":
+                raise RuntimeError("GET: 503 service unavailable")
+            if path == "apis/apps/v1":
+                return {
+                    "resources": [
+                        {
+                            "name": "deployments",
+                            "kind": "Deployment",
+                            "namespaced": True,
+                        }
+                    ]
+                }
+            raise AssertionError(f"unexpected discovery GET {path}")
+
+        client._request = fake_request
+        assert client.resolve_kind("Deployment") == (
+            "apis/apps/v1", "deployments", True
+        )
+        with pytest.raises(RuntimeError, match="503"):
+            client.resolve_kind("Widget", "broken.example.com/v1")

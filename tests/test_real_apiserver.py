@@ -255,3 +255,74 @@ class TestWatchRecovery:
                 store.close()
         finally:
             client.delete("Pod", namespace, "old-rv")
+
+
+class TestScaleTargetDiscovery:
+    """Arbitrary scale-target resolution against a genuine apiserver
+    (reference: autoscaler.go:196-237): resolve a built-in kind the
+    framework does not model via /apis discovery and drive its /scale
+    subresource — GET and PUT — end to end."""
+
+    def test_deployment_scale_round_trips(self, client, namespace):
+        client._request(
+            "POST",
+            f"apis/apps/v1/namespaces/{namespace}/deployments",
+            {
+                "apiVersion": "apps/v1",
+                "kind": "Deployment",
+                "metadata": {"name": "scale-disc", "namespace": namespace},
+                "spec": {
+                    "replicas": 2,
+                    "selector": {
+                        "matchLabels": {"app": "scale-disc"}
+                    },
+                    "template": {
+                        "metadata": {"labels": {"app": "scale-disc"}},
+                        "spec": {
+                            "nodeSelector": {
+                                "karpenter-conformance/no-such": "node"
+                            },
+                            "containers": [
+                                {
+                                    "name": "main",
+                                    "image": (
+                                        "registry.k8s.io/pause:3.9"
+                                    ),
+                                }
+                            ],
+                        },
+                    },
+                },
+            },
+        )
+        try:
+            # discovery with the ref's apiVersion (the production path)
+            assert client.resolve_kind("Deployment", "apps/v1") == (
+                "apis/apps/v1", "deployments", True
+            )
+            # and blind discovery (walks /apis groups)
+            fresh = _client()
+            assert fresh.resolve_kind("Deployment") == (
+                "apis/apps/v1", "deployments", True
+            )
+            scale = client.get_scale(
+                "Deployment", namespace, "scale-disc",
+                api_version="apps/v1",
+            )
+            assert scale.spec_replicas == 2
+            scale.spec_replicas = 4
+            client.update_scale(
+                "Deployment", scale, api_version="apps/v1"
+            )
+            assert wait_until(
+                lambda: client.get_scale(
+                    "Deployment", namespace, "scale-disc",
+                    api_version="apps/v1",
+                ).spec_replicas == 4
+            )
+        finally:
+            client._request(
+                "DELETE",
+                f"apis/apps/v1/namespaces/{namespace}"
+                "/deployments/scale-disc",
+            )
