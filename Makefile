@@ -80,5 +80,11 @@ kind-load: image ## Side-load the image into a kind cluster (no registry needed)
 	  *) $(ENGINE) save $(IMAGE) | kind load image-archive /dev/stdin ;; \
 	esac
 
+conformance: ## Run the real-apiserver tier against a kind-booted apiserver (the envtest analog)
+	bash hack/conformance-kind.sh
+
+kind-smoke: ## Deploy smoke on kind: image -> apply -> pod Ready -> one HA end to end
+	bash hack/kind-smoke.sh
+
 .PHONY: help dev ci test battletest verify codegen docs native bench dryrun \
-	image publish apply delete kind-load
+	image publish apply delete kind-load conformance kind-smoke

@@ -1,0 +1,38 @@
+# Shared helpers for the kind-backed gates (conformance-kind.sh,
+# kind-smoke.sh). Sourced, not executed. Contract both scripts document:
+# exit 3 = this environment cannot run the gate (tooling missing),
+# exit 1 = the gate ran and failed, exit 0 = passed.
+
+log() { echo "[$(date -u +%Y-%m-%dT%H:%M:%SZ)] $*" | tee -a "$LOG" >&2; }
+
+fail() { log "$*"; exit 1; }
+
+# require_kind_tools <what-for>: logs every missing tool, exits 3 if any
+require_kind_tools() {
+  local missing=0 tool
+  for tool in kind kubectl; do
+    if ! command -v "$tool" >/dev/null 2>&1; then
+      log "MISSING: $tool not on PATH"
+      missing=1
+    fi
+  done
+  if ! { command -v docker || command -v podman; } >/dev/null 2>&1; then
+    log "MISSING: no container engine (docker/podman)"
+    missing=1
+  fi
+  if [ "$missing" -ne 0 ]; then
+    log "cannot run $1 in this environment; NOT run"
+    exit 3
+  fi
+}
+
+# boot_kind_cluster <name>: create + arm the delete trap + use-context
+boot_kind_cluster() {
+  local cluster="$1"
+  log "creating kind cluster $cluster"
+  kind create cluster --name "$cluster" --wait 180s >>"$LOG" 2>&1 \
+    || fail "kind create cluster FAILED (see $LOG)"
+  # shellcheck disable=SC2064 — expand the name now, not at trap time
+  trap "log 'deleting cluster'; kind delete cluster --name '$cluster' >>'$LOG' 2>&1" EXIT
+  kubectl config use-context "kind-$cluster" >>"$LOG" 2>&1
+}
