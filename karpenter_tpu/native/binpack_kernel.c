@@ -29,6 +29,7 @@
 #include <math.h>
 #include <stddef.h>
 #include <stdint.h>
+#include <stdlib.h>
 
 /* Shelf best-fit-decreasing over bucket histograms: the exact semantics
  * of ops/binpack._shelf_bfd / oracle_shelf_bfd (repeated passes of
@@ -79,6 +80,48 @@ void karpenter_shelf_bfd(
     }
 }
 
+/* Post-choice accounting for one assigned pod: count, dominant-share
+ * bucket, histogram, f64 demand — shared by the fast and generic scans
+ * so the f32/f64 arithmetic order stays identical on both. */
+static inline void karpenter_assign_record(
+    long long p, long long best, long long n_resources, long long buckets,
+    const float *req, const float *a, const long long *weight,
+    const unsigned char *exclusive, int32_t *assigned,
+    long long *assigned_count, long long *histogram, double *demand
+) {
+    assigned[p] = (int32_t)best;
+    long long w_of = weight ? weight[p] : 1;
+    assigned_count[best] += w_of;
+    float share = 0.0f;
+    for (long long r = 0; r < n_resources; r++) {
+        /* same f32 formula/order as _dominant_share; feasibility
+         * guarantees req <= alloc, so share stays in [0, 1] */
+        float s;
+        if (a[r] > 0.0f) {
+            float denom = a[r] > 1e-30f ? a[r] : 1e-30f;
+            s = req[r] / denom;
+        } else {
+            s = (req[r] <= 0.0f) ? 0.0f : INFINITY;
+        }
+        if (s > share) {
+            share = s;
+        }
+        demand[best * n_resources + r] += (double)req[r] * (double)w_of;
+    }
+    long long bucket = (long long)ceilf(share * (float)buckets);
+    if (bucket < 1) {
+        bucket = 1;
+    }
+    if (bucket > buckets) {
+        bucket = buckets;
+    }
+    if (exclusive && exclusive[p]) {
+        /* hostname self-anti-affinity: the pod takes a whole node */
+        bucket = buckets;
+    }
+    histogram[best * buckets + (bucket - 1)] += w_of;
+}
+
 void karpenter_assign(
     long long n_pods,
     long long n_groups,
@@ -103,7 +146,70 @@ void karpenter_assign(
     double *demand,                 /* out [T, R], zeroed by caller */
     long long *unschedulable        /* out [1], zeroed by caller */
 ) {
-    /* group usability precomputed once: any allocatable > 0 */
+    /* group usability precomputed ONCE: any allocatable > 0. The
+     * generic scan's per-pod `a[r] > 0` probes only matter after the
+     * fit check passes every resource, at which point the outcome
+     * equals this per-group constant — hoisting it drops a branch per
+     * (pod, group) pair from the hot loop. */
+    unsigned char *usable = (unsigned char *)malloc((size_t)n_groups);
+    if (usable) {
+        for (long long t = 0; t < n_groups; t++) {
+            unsigned char any = 0;
+            const float *a = alloc + t * n_resources;
+            for (long long r = 0; r < n_resources; r++) {
+                any |= (a[r] > 0.0f);
+            }
+            usable[t] = any;
+        }
+    }
+
+    /* Fast path for the dominant shape: no steering scores, no
+     * forbidden mask, and both bitsets within one 64-bit word (any
+     * fleet with <= 64 distinct hard taints and <= 64 label items —
+     * the bench shape and most production fleets). The pod's two words
+     * load once, the per-group checks collapse to one OR of two ANDs,
+     * and the resource fit runs branch-free (R is small; `&=` lets the
+     * compiler unroll instead of predicting a break). Choice semantics
+     * are IDENTICAL to the generic scan: first feasible group wins. */
+    if (usable && score == NULL && forbidden == NULL && taint_words == 1
+        && label_words == 1) {
+        for (long long p = 0; p < n_pods; p++) {
+            assigned[p] = -1;
+            if (!valid[p]) {
+                continue;
+            }
+            const float *req = requests + p * n_resources;
+            const uint64_t iw = intolerant[p];
+            const uint64_t nw = required[p];
+            long long best = -1;
+            for (long long t = 0; t < n_groups; t++) {
+                if (!usable[t]) {
+                    continue;
+                }
+                const float *a = alloc + t * n_resources;
+                int fit = 1;
+                for (long long r = 0; r < n_resources; r++) {
+                    fit &= (req[r] <= a[r]);
+                }
+                if (!fit || ((iw & taints[t]) | (nw & missing[t]))) {
+                    continue;
+                }
+                best = t;
+                break;
+            }
+            if (best < 0) {
+                *unschedulable += (weight ? weight[p] : 1);
+                continue;
+            }
+            karpenter_assign_record(
+                p, best, n_resources, buckets, req,
+                alloc + best * n_resources, weight, exclusive, assigned,
+                assigned_count, histogram, demand);
+        }
+        free(usable);
+        return;
+    }
+
     for (long long p = 0; p < n_pods; p++) {
         assigned[p] = -1;
         if (!valid[p]) {
@@ -116,6 +222,9 @@ void karpenter_assign(
         float best_score = 0.0f;
         for (long long t = 0; t < n_groups; t++) {
             if (forbidden && forbidden[p * n_groups + t]) {
+                continue;
+            }
+            if (usable && !usable[t]) {
                 continue;
             }
             const float *a = alloc + t * n_resources;
@@ -167,39 +276,11 @@ void karpenter_assign(
             *unschedulable += (weight ? weight[p] : 1);
             continue;
         }
-        assigned[p] = (int32_t)best;
-        long long w_of = weight ? weight[p] : 1;
-        assigned_count[best] += w_of;
-        const float *a = alloc + best * n_resources;
-        float share = 0.0f;
-        for (long long r = 0; r < n_resources; r++) {
-            /* same f32 formula/order as _dominant_share; feasibility
-             * guarantees req <= alloc, so share stays in [0, 1] */
-            float s;
-            if (a[r] > 0.0f) {
-                float denom = a[r] > 1e-30f ? a[r] : 1e-30f;
-                s = req[r] / denom;
-            } else {
-                s = (req[r] <= 0.0f) ? 0.0f : INFINITY;
-            }
-            if (s > share) {
-                share = s;
-            }
-            demand[best * n_resources + r] += (double)req[r] * (double)w_of;
-        }
-        long long bucket = (long long)ceilf(share * (float)buckets);
-        if (bucket < 1) {
-            bucket = 1;
-        }
-        if (bucket > buckets) {
-            bucket = buckets;
-        }
-        if (exclusive && exclusive[p]) {
-            /* hostname self-anti-affinity: the pod takes a whole node */
-            bucket = buckets;
-        }
-        histogram[best * buckets + (bucket - 1)] += w_of;
+        karpenter_assign_record(
+            p, best, n_resources, buckets, req, alloc + best * n_resources,
+            weight, exclusive, assigned, assigned_count, histogram, demand);
     }
+    free(usable);
 }
 
 /* bool[N, K] row-major (as uint8) -> uint64[N, W] little-endian bit

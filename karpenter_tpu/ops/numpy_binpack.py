@@ -80,9 +80,34 @@ def _pack_bits(matrix: np.ndarray, lib=None) -> np.ndarray:
     ).view(np.uint64)
 
 
+# one-slot identity-keyed pack cache, the numpy-path analog of
+# ops/binpack._put_memo: callers that pass the SAME BinPackInputs object
+# again (the encode memo, the bench's steady-state loop) skip re-packing
+# the [P, K] bool operands into bit words — ~2 ms of pure memory traffic
+# per solve at the 100k x 64-taint scale. Same contract as the device
+# cache: inputs are immutable once passed to solve().
+_pack_memo = None
+
+
+def _packed_operands(inputs, intolerant, taints, labels, required, lib):
+    global _pack_memo
+    memo = _pack_memo
+    if inputs is not None and memo is not None and memo[0] is inputs:
+        return memo[1]
+    packed = (
+        _pack_bits(intolerant, lib),
+        _pack_bits(taints, lib),
+        _pack_bits(required, lib),
+        _pack_bits(~labels, lib),
+    )
+    if inputs is not None:
+        _pack_memo = (inputs, packed)
+    return packed
+
+
 def _assign_native(
     lib, requests, valid, intolerant, required, alloc, taints, labels,
-    forbidden, score, weight, exclusive, buckets,
+    forbidden, score, weight, exclusive, buckets, inputs=None,
 ):
     """One fused native pass: (assigned, assigned_count, histogram,
     demand, unschedulable). Same contract as the numpy stages it
@@ -91,10 +116,12 @@ def _assign_native(
 
     n_pods, n_resources = requests.shape
     n_groups = alloc.shape[0]
-    intolerant_words = _pack_bits(intolerant, lib)
-    taint_words = _pack_bits(taints, lib)
-    required_words = _pack_bits(required, lib)
-    missing_words = _pack_bits(~labels, lib)
+    (
+        intolerant_words,
+        taint_words,
+        required_words,
+        missing_words,
+    ) = _packed_operands(inputs, intolerant, taints, labels, required, lib)
 
     assigned = np.empty(n_pods, np.int32)
     assigned_count = np.zeros(n_groups, np.int64)
@@ -386,6 +413,7 @@ def binpack_numpy(
         ) = _assign_native(
             lib, requests, valid, intolerant, required, alloc, taints,
             labels, forbidden, score, weight, exclusive, buckets,
+            inputs=inputs,
         )
         assigned_count = assigned_count64.astype(np.int32)
     else:
