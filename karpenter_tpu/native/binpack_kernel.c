@@ -146,12 +146,26 @@ void karpenter_assign(
     double *demand,                 /* out [T, R], zeroed by caller */
     long long *unschedulable        /* out [1], zeroed by caller */
 ) {
-    /* group usability precomputed ONCE: any allocatable > 0. The
-     * generic scan's per-pod `a[r] > 0` probes only matter after the
-     * fit check passes every resource, at which point the outcome
-     * equals this per-group constant — hoisting it drops a branch per
-     * (pod, group) pair from the hot loop. */
-    unsigned char *usable = (unsigned char *)malloc((size_t)n_groups);
+    /* Fast path for the dominant shape: no steering scores, no
+     * forbidden mask, and both bitsets within one 64-bit word (any
+     * fleet with <= 64 distinct hard taints and <= 64 label items —
+     * the bench shape and most production fleets). The pod's two words
+     * load once, the per-group checks collapse to one OR of two ANDs,
+     * and the resource fit runs branch-free (R is small; `&=` lets the
+     * compiler unroll instead of predicting a break). Choice semantics
+     * are IDENTICAL to the generic scan: first feasible group wins.
+     *
+     * Group usability (any allocatable > 0) is precomputed once, for
+     * this path ONLY: its first-feasible scan gains from skipping dead
+     * groups before the fit check, while the generic dense scan
+     * (scores disable the early exit) measurably loses a cycle per
+     * (pod, group) pair to the extra load+branch, so it keeps its
+     * original per-pod probes and never pays for the precompute. */
+    unsigned char *usable = NULL;
+    if (score == NULL && forbidden == NULL && taint_words == 1
+        && label_words == 1) {
+        usable = (unsigned char *)malloc((size_t)n_groups);
+    }
     if (usable) {
         for (long long t = 0; t < n_groups; t++) {
             unsigned char any = 0;
@@ -161,18 +175,6 @@ void karpenter_assign(
             }
             usable[t] = any;
         }
-    }
-
-    /* Fast path for the dominant shape: no steering scores, no
-     * forbidden mask, and both bitsets within one 64-bit word (any
-     * fleet with <= 64 distinct hard taints and <= 64 label items —
-     * the bench shape and most production fleets). The pod's two words
-     * load once, the per-group checks collapse to one OR of two ANDs,
-     * and the resource fit runs branch-free (R is small; `&=` lets the
-     * compiler unroll instead of predicting a break). Choice semantics
-     * are IDENTICAL to the generic scan: first feasible group wins. */
-    if (usable && score == NULL && forbidden == NULL && taint_words == 1
-        && label_words == 1) {
         for (long long p = 0; p < n_pods; p++) {
             assigned[p] = -1;
             if (!valid[p]) {
@@ -222,9 +224,6 @@ void karpenter_assign(
         float best_score = 0.0f;
         for (long long t = 0; t < n_groups; t++) {
             if (forbidden && forbidden[p * n_groups + t]) {
-                continue;
-            }
-            if (usable && !usable[t]) {
                 continue;
             }
             const float *a = alloc + t * n_resources;
@@ -280,7 +279,6 @@ void karpenter_assign(
             p, best, n_resources, buckets, req, alloc + best * n_resources,
             weight, exclusive, assigned, assigned_count, histogram, demand);
     }
-    free(usable);
 }
 
 /* bool[N, K] row-major (as uint8) -> uint64[N, W] little-endian bit
