@@ -122,6 +122,24 @@ def _write_evidence(rec: dict) -> None:
             **EVIDENCE,
             "result": rec,
         }
+        # a run under a profiler/tracer is a DIAGNOSTIC, not a
+        # measurement — instrumentation overhead inflates every number
+        # (an r5 cProfile run recorded a 3.5x-inflated e2e tick before
+        # this flag existed). Tag it so evidence consumers can filter.
+        # On 3.12+ cProfile registers via sys.monitoring, not
+        # sys.setprofile, so both registries are consulted.
+        tool = None
+        monitoring = getattr(sys, "monitoring", None)
+        if monitoring is not None:
+            tool = monitoring.get_tool(
+                monitoring.PROFILER_ID
+            ) or monitoring.get_tool(monitoring.DEBUGGER_ID)
+        if (
+            sys.getprofile() is not None
+            or sys.gettrace() is not None
+            or tool is not None
+        ):
+            full["diagnostic"] = "profiled"
         os.makedirs(EVIDENCE_DIR, exist_ok=True)
         line = json.dumps(full)
         with open(os.path.join(EVIDENCE_DIR, "runs.jsonl"), "a") as f:

@@ -1,8 +1,12 @@
 #!/usr/bin/env bash
 # Deploy smoke: prove the SHIPPED artifacts converge on a real cluster —
-# image builds, kind side-load, `make apply` (CRDs + RBAC + two-container
-# Deployment), pod Ready, and one HorizontalAutoscaler driven end to end
-# through the deployed controller. The role the reference's
+# image build, kind side-load, then `kubectl kustomize config/ |
+# hack/smoke-manifest.py | kubectl apply` (the smoke transform strips
+# only what a bare kind cluster cannot satisfy: cert-manager certs,
+# ServiceMonitor, failurePolicy=Fail webhooks, TPU claims, the GKE node
+# pin — do NOT use `make apply` here, it ships those as-is and wedges),
+# two-container pod Ready, and one HorizontalAutoscaler driven end to
+# end through the deployed controller. The role the reference's
 # hack/quick-install.sh flow plays for its users (reference:
 # hack/quick-install.sh:40-66).
 #
@@ -26,25 +30,15 @@ log "building + side-loading image (CPU jax: kind nodes have no TPU)"
 make kind-load IMAGE_TAG="$IMAGE_TAG" JAX_EXTRAS= >>"$LOG" 2>&1 \
   || fail "make kind-load FAILED"
 
-log "applying CRDs + RBAC + deployment"
-make apply IMAGE_TAG="$IMAGE_TAG" JAX_EXTRAS= >>"$LOG" 2>&1 \
-  || fail "make apply FAILED"
-
-# the stock manifest targets GKE TPU node pools and expects cert-manager
-# for the webhook; a kind smoke drops the node pin, runs the fake
-# provider, and skips the webhook listener (admission still runs
-# in-store) — everything else (image, RBAC, probes, two containers) is
-# exactly what ships
-log "patching deployment for the kind environment"
-kubectl -n karpenter patch deployment karpenter-tpu --type=json -p '[
-  {"op": "remove", "path": "/spec/template/spec/nodeSelector"},
-  {"op": "replace", "path": "/spec/replicas", "value": 1},
-  {"op": "replace", "path": "/spec/template/spec/containers/0/args", "value": [
-    "--apiserver=https://kubernetes.default.svc",
-    "--cloud-provider=fake",
-    "--solver-uri=127.0.0.1:9090"
-  ]}
-]' >>"$LOG" 2>&1 || fail "deployment patch FAILED"
+# the stock tree targets production GKE (cert-manager certs,
+# ServiceMonitor, failurePolicy=Fail webhooks, TPU resource claims, GKE
+# node pin) — hack/smoke-manifest.py strips exactly those for a bare
+# kind cluster and keeps everything else as shipped (image, RBAC,
+# probes, the two-container split)
+log "applying CRDs + RBAC + deployment (smoke-transformed manifest)"
+kubectl kustomize config/ \
+  | python3 hack/smoke-manifest.py "karpenter-tpu:$IMAGE_TAG" \
+  | kubectl apply -f - >>"$LOG" 2>&1 || fail "apply FAILED"
 
 log "waiting for the two-container pod to become Ready"
 kubectl -n karpenter rollout status deployment/karpenter-tpu \

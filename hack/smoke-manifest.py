@@ -1,0 +1,90 @@
+#!/usr/bin/env python3
+"""Transform `kubectl kustomize config/` output for a bare kind cluster
+(hack/kind-smoke.sh). The stock tree targets production GKE: cert-manager
+certificates, a ServiceMonitor, failurePolicy=Fail admission webhooks, a
+TPU-requesting solver container, and a GKE node pin. None of those exist
+on kind, and each would wedge the smoke in a different way (unknown CRD
+kinds at apply time; every CR create rejected by an unreachable webhook;
+the pod Pending forever on google.com/tpu). The smoke keeps everything
+else exactly as shipped: image, RBAC, probes, the two-container split.
+
+Usage: kubectl kustomize config/ | python3 hack/smoke-manifest.py [image]
+
+Drops: cert-manager.io and monitoring.coreos.com documents,
+Validating/MutatingWebhookConfigurations.
+Rewrites the controller Deployment: replicas 1, no nodeSelector, fake
+cloud provider + no webhook listener, no TPU resource claims on the
+solver, no cert-manager secret volume, and (optionally) the image tag.
+"""
+
+import sys
+
+import yaml
+
+SMOKE_ARGS = [
+    "--apiserver=https://kubernetes.default.svc",
+    "--cloud-provider=fake",
+    "--solver-uri=127.0.0.1:9090",
+]
+
+
+def dropped(doc) -> bool:
+    api = doc.get("apiVersion", "")
+    if api.startswith(("cert-manager.io/", "monitoring.coreos.com/")):
+        return True
+    return doc.get("kind", "").endswith("WebhookConfiguration")
+
+
+def _drop_cert_entries(holder, key) -> None:
+    """Remove only the cert-manager entries (name == 'cert') from a
+    volumes/volumeMounts list: any other entry added later is part of
+    the shipped spec and the smoke must keep validating it."""
+    kept = [e for e in holder.get(key, []) if e.get("name") != "cert"]
+    if kept:
+        holder[key] = kept
+    else:
+        holder.pop(key, None)
+
+
+def _drop_tpu_claims(container) -> None:
+    resources = container.get("resources", {})
+    for section in ("requests", "limits"):
+        entries = resources.get(section)
+        if entries:
+            entries.pop("google.com/tpu", None)
+            # an empty limits/requests map is valid but noisy
+            if not entries:
+                resources.pop(section)
+
+
+def rewrite_deployment(doc, image) -> None:
+    spec = doc["spec"]
+    spec["replicas"] = 1
+    pod = spec["template"]["spec"]
+    pod.pop("nodeSelector", None)
+    _drop_cert_entries(pod, "volumes")
+    for container in pod.get("containers", []):
+        if image:
+            container["image"] = image
+        _drop_cert_entries(container, "volumeMounts")
+        _drop_tpu_claims(container)
+        if container.get("name") == "controller":
+            container["args"] = list(SMOKE_ARGS)
+
+
+def main() -> int:
+    image = sys.argv[1] if len(sys.argv) > 1 else ""
+    docs = [d for d in yaml.safe_load_all(sys.stdin) if d is not None]
+    kept = []
+    for doc in docs:
+        if dropped(doc):
+            continue
+        if doc.get("kind") == "Deployment":
+            rewrite_deployment(doc, image)
+        kept.append(doc)
+    yaml.safe_dump_all(kept, sys.stdout, sort_keys=False)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
