@@ -257,11 +257,24 @@ def main(argv=None) -> int:
     args = parse_args(argv)
     log_setup(verbose=args.verbose)
 
+    # standalone mode compiles the decision kernel (and, without
+    # --solver-uri, the bin-pack) in-process: honor the same persistent
+    # compile cache the sidecar offers, so control-plane restarts skip
+    # recompiles too (flag on the sidecar, env here — the CLI stays the
+    # reference's flag surface)
+    import os as _os
+
+    from karpenter_tpu.utils.backend import (
+        configure_compile_cache,
+        ensure_usable_backend,
+    )
+
+    configure_compile_cache(_os.environ.get("KARPENTER_COMPILE_CACHE", ""))
+
     # the batched HPA decision kernel ALWAYS runs in-process (only the
     # bin-pack is optionally routed to a sidecar), so an unreachable TPU
     # must degrade to CPU decisions unconditionally — not freeze the
     # control plane at its first jit (utils/backend.py rationale)
-    from karpenter_tpu.utils.backend import ensure_usable_backend
 
     note = ensure_usable_backend()
     if note:

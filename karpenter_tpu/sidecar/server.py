@@ -12,6 +12,7 @@ arrive in the request, matching the reference's checkpoint/resume posture
 from __future__ import annotations
 
 import json
+import os
 from concurrent import futures
 from typing import Optional
 
@@ -139,7 +140,19 @@ def main(argv=None) -> None:
         "(topology from TPU pod metadata or JAX_COORDINATOR_ADDRESS/"
         "JAX_NUM_PROCESSES/JAX_PROCESS_ID; see parallel/multihost.py)",
     )
+    ap.add_argument(
+        "--compile-cache-dir",
+        default=os.environ.get("KARPENTER_COMPILE_CACHE", ""),
+        help="persistent XLA compilation cache directory (also env "
+        "KARPENTER_COMPILE_CACHE): a restarted sidecar reloads compiled "
+        "solver programs instead of paying the 20-40s TPU compile again; "
+        "point it at an emptyDir/PVC in the pod spec. Empty = disabled.",
+    )
     args = ap.parse_args(argv)
+
+    from karpenter_tpu.utils.backend import configure_compile_cache
+
+    configure_compile_cache(args.compile_cache_dir)
 
     joined = False
     if args.multihost:

@@ -161,3 +161,20 @@ def ensure_usable_backend(
 
     jax.config.update("jax_platforms", "cpu")
     return f"default backend unavailable ({reason}); cpu fallback"
+
+
+def configure_compile_cache(path: str) -> bool:
+    """Enable JAX's persistent compilation cache at `path` (no-op when
+    empty). Must run before the FIRST compile (not the backend init):
+    every cache-missed compile taking >=1s is persisted, which covers
+    the solver programs while skipping trivial host jits. A restarted
+    process then reloads compiled programs instead of paying the 20-40s
+    TPU compile again. Shared by the sidecar (--compile-cache-dir) and
+    the standalone entry point (KARPENTER_COMPILE_CACHE)."""
+    if not path:
+        return False
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return True
