@@ -753,3 +753,132 @@ class TestArbitraryScaleTargetOnKube:
         )
         with pytest.raises(RuntimeError, match="503"):
             client.resolve_kind("Widget", "broken.example.com/v1")
+
+
+class TestDiscoveryFuzz:
+    """Property sweep over randomized discovery documents: resolve_kind
+    must honor (kind, apiVersion) addressing, preferred-version order,
+    and partial-discovery tolerance for ANY served layout."""
+
+    def _client_for(self, groups, broken):
+        """groups: {group: {version: [(plural, kind, namespaced)]}};
+        broken: set of 'group/version' whose APIResourceList 500s."""
+        client = KubeClient(base_url="http://127.0.0.1:1", timeout=1.0)
+
+        def fake_request(method, path, *args, **kwargs):
+            if path == "apis":
+                return {
+                    "groups": [
+                        {
+                            "name": g,
+                            "preferredVersion": {
+                                "groupVersion": f"{g}/{sorted(vs)[0]}"
+                            },
+                            "versions": [
+                                {"groupVersion": f"{g}/{v}"}
+                                for v in sorted(vs)
+                            ],
+                        }
+                        for g, vs in groups.items()
+                    ]
+                }
+            if path == "api/v1":
+                return {"resources": []}
+            assert path.startswith("apis/"), path
+            gv = path[len("apis/"):]
+            if gv in broken:
+                raise RuntimeError(f"GET {path}: 503")
+            g, _, v = gv.partition("/")
+            entries = groups.get(g, {}).get(v)
+            if entries is None:
+                from karpenter_tpu.store import NotFoundError
+
+                raise NotFoundError(f"GET {path}: 404")
+            return {
+                "resources": [
+                    {"name": plural, "kind": kind, "namespaced": ns}
+                    for plural, kind, ns in entries
+                ]
+            }
+
+        client._request = fake_request
+        return client
+
+    @staticmethod
+    def _walk_order(groups):
+        """The exact group-version order _discovery_prefixes promises:
+        /apis group order, preferred version (sorted(vs)[0] in the fake)
+        first within each group."""
+        order = []
+        for group, versions in groups.items():
+            ordered = sorted(versions)
+            order.extend(f"{group}/{v}" for v in ordered)
+        return order
+
+    def test_fuzzed_layouts(self):
+        import random
+
+        from karpenter_tpu.store import NotFoundError
+
+        rng = random.Random(7)
+        kinds = ["Widget", "Gadget", "Sprocket", "Deployment"]
+        for case in range(60):
+            groups = {}
+            broken = set()
+            # kind -> {group/version: (plural, namespaced)}
+            serving = {}
+            for g in range(rng.randint(1, 4)):
+                group = f"g{g}.example.com"
+                versions = {}
+                for v in range(rng.randint(1, 3)):
+                    version = f"v{v + 1}"
+                    entries = []
+                    for kind in kinds:
+                        if rng.random() < 0.3:
+                            # irregular plurals and cluster-scoped kinds
+                            # are both legal; the resolver must return
+                            # the WIRE values, not conventions
+                            plural = kind.lower() + rng.choice(
+                                ["s", "es", "-irregular"]
+                            )
+                            namespaced = rng.random() < 0.5
+                            entries.append((plural, kind, namespaced))
+                            serving.setdefault(kind, {})[
+                                f"{group}/{version}"
+                            ] = (plural, namespaced)
+                    versions[version] = entries
+                    if rng.random() < 0.2:
+                        broken.add(f"{group}/{version}")
+                groups[group] = versions
+            client = self._client_for(groups, broken)
+            for kind in kinds:
+                served = serving.get(kind, {})
+                # explicit apiVersion: exact group-version addressing,
+                # echoing the wire plural/namespaced values
+                for gv, (plural, namespaced) in sorted(served.items()):
+                    if gv in broken:
+                        with pytest.raises(RuntimeError, match="503"):
+                            client.resolve_kind(kind, gv)
+                        continue
+                    assert client.resolve_kind(kind, gv) == (
+                        f"apis/{gv}", plural, namespaced
+                    )
+                # blind: the FIRST healthy serving group-version in the
+                # documented walk order wins (not just any member)
+                expected_gv = next(
+                    (
+                        gv
+                        for gv in self._walk_order(groups)
+                        if gv in served and gv not in broken
+                    ),
+                    None,
+                )
+                fresh = self._client_for(groups, broken)
+                if expected_gv is not None:
+                    plural, namespaced = served[expected_gv]
+                    assert fresh.resolve_kind(kind) == (
+                        f"apis/{expected_gv}", plural, namespaced
+                    ), (case, kind)
+                else:
+                    with pytest.raises(NotFoundError):
+                        fresh.resolve_kind(kind)
