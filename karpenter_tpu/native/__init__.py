@@ -39,6 +39,7 @@ def _compile(src: str, out: str) -> bool:
         "-O2",
         "-fPIC",
         "-shared",
+        "-pthread",  # the threaded assignment variant (binpack_kernel.c)
         f"-I{include}",
         src,
         "-o",
@@ -94,6 +95,8 @@ def _bind_ctypes(so: str):
     lib.karpenter_assign.restype = None
     lib.karpenter_shelf_bfd.restype = None
     lib.karpenter_pack_bits.restype = None
+    if hasattr(lib, "karpenter_assign_mt"):  # older prebuilt .so lacks it
+        lib.karpenter_assign_mt.restype = None
     return lib
 
 

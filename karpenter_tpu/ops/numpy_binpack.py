@@ -152,7 +152,8 @@ def _assign_native(
         else np.ascontiguousarray(exclusive, np.uint8)
     )
     null = ctypes.POINTER(ctypes.c_float)()
-    lib.karpenter_assign(
+    entry, extra = _assign_entry(lib, ctypes, n_pods)
+    entry(
         ctypes.c_longlong(n_pods),
         ctypes.c_longlong(n_groups),
         ctypes.c_longlong(n_resources),
@@ -187,8 +188,50 @@ def _assign_native(
         ptr(histogram, ctypes.c_longlong),
         ptr(demand, ctypes.c_double),
         ptr(unschedulable, ctypes.c_longlong),
+        *extra,
     )
     return assigned, assigned_count, histogram, demand, int(unschedulable[0])
+
+
+# minimum pods per thread before fan-out pays: below this, the per-call
+# pthread create/join (~tens of us each) rivals the whole fused solve
+# (a 1000-pod tick measures ~0.2 ms), so small solves stay single-pass
+_MIN_PODS_PER_THREAD = 8192
+
+
+def _assign_entry(lib, ctypes, n_pods: int):
+    """The native entry point + trailing args: the threaded choice phase
+    when the host has cores for it AND the problem is big enough to
+    amortize spawn/join. KARPENTER_SOLVER_THREADS overrides both (an
+    explicit operator/test choice bypasses the size gate); 0/1, a small
+    auto-sized solve, or a prebuilt .so without the symbol = the fused
+    single pass. Outputs are bitwise identical either way: the C side
+    accumulates every aggregate sequentially in pod order."""
+    n_threads = _solver_threads(n_pods)
+    if n_threads > 1 and hasattr(lib, "karpenter_assign_mt"):
+        return lib.karpenter_assign_mt, (ctypes.c_longlong(n_threads),)
+    return lib.karpenter_assign, ()
+
+
+def _solver_threads(n_pods: int) -> int:
+    """Choice-phase thread count. Explicit KARPENTER_SOLVER_THREADS is
+    honored as-is; otherwise the CPUs actually AVAILABLE to this
+    process — sched_getaffinity sees cgroup cpusets/affinity where
+    os.cpu_count() reports the node's cores and would oversubscribe a
+    cpu-limited pod — capped by the size gate. 1 = the fused pass."""
+    import os
+
+    raw = os.environ.get("KARPENTER_SOLVER_THREADS", "")
+    if raw:
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            return 1
+    try:
+        cores = len(os.sched_getaffinity(0)) or 1
+    except (AttributeError, OSError):  # non-Linux
+        cores = os.cpu_count() or 1
+    return min(cores, max(1, n_pods // _MIN_PODS_PER_THREAD))
 
 
 def _feasibility_np(

@@ -329,3 +329,58 @@ class TestNativeKernel:
             binpack_numpy(inputs, buckets=32, use_native=True),
             binpack(inputs, buckets=32),
         )
+
+
+class TestThreadedAssign:
+    """karpenter_assign_mt: the choice phase fans out over threads, every
+    aggregate accumulates sequentially in pod order — outputs must be
+    BITWISE identical to the fused single pass for any thread count and
+    any operand mix (score/forbidden/weight/exclusive)."""
+
+    @pytest.mark.parametrize("threads", [2, 3, 8])
+    def test_bitwise_equal_to_single_pass(self, monkeypatch, threads):
+        from karpenter_tpu.native import load_kbinpack
+        from karpenter_tpu.ops import numpy_binpack as nb
+
+        lib = load_kbinpack()
+        if lib is None or not hasattr(lib, "karpenter_assign_mt"):
+            pytest.skip("native mt kernel unavailable")
+        rng = np.random.default_rng(23)
+        for case in range(12):
+            P, T = int(rng.integers(1, 400)), int(rng.integers(1, 24))
+            K, L = int(rng.integers(1, 100)), int(rng.integers(1, 100))
+            args = dict(
+                requests=rng.uniform(0, 2, (P, 4)).astype(np.float32),
+                valid=rng.random(P) < 0.9,
+                intolerant=rng.random((P, K)) < 0.1,
+                required=rng.random((P, L)) < 0.1,
+                alloc=rng.uniform(0, 4, (T, 4)).astype(np.float32),
+                taints=rng.random((T, K)) < 0.2,
+                labels=rng.random((T, L)) < 0.8,
+                # independent coin flips: score+forbidden TOGETHER (the
+                # argmax-with-mask branch) must occur, not just each alone
+                forbidden=(
+                    rng.random((P, T)) < 0.2 if rng.random() < 0.5 else None
+                ),
+                score=(
+                    rng.normal(size=(P, T)).astype(np.float32)
+                    if rng.random() < 0.5
+                    else None
+                ),
+                weight=(
+                    rng.integers(1, 9, P).astype(np.int64)
+                    if rng.random() < 0.5
+                    else None
+                ),
+                exclusive=(
+                    rng.random(P) < 0.1 if rng.random() < 0.5 else None
+                ),
+                buckets=int(rng.integers(2, 33)),
+            )
+            monkeypatch.setenv("KARPENTER_SOLVER_THREADS", "1")
+            single = nb._assign_native(lib, **args)
+            monkeypatch.setenv("KARPENTER_SOLVER_THREADS", str(threads))
+            multi = nb._assign_native(lib, **args)
+            for s, m in zip(single[:4], multi[:4]):
+                np.testing.assert_array_equal(np.asarray(s), np.asarray(m))
+            assert single[4] == multi[4], case
