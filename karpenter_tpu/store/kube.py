@@ -83,6 +83,12 @@ RESOURCES: Dict[str, Tuple[str, str, bool]] = {
 
 WATCHED_KINDS = tuple(RESOURCES)
 
+# negative-cache lifetime for discovery misses (resolve_kind): long
+# enough that a misconfigured HA costs one discovery walk per window
+# instead of one per reconcile, short enough that installing the
+# missing CRD is picked up without a restart
+DISCOVERY_MISS_TTL = 30.0
+
 _LEASE_API = "apis/coordination.k8s.io/v1"
 
 
@@ -189,8 +195,12 @@ class KubeClient:
         # (kind, apiVersion) resolved via API discovery (resolve_kind),
         # memoized for the client's lifetime — discovery output only
         # changes on CRD install/uninstall, which warrants a process
-        # restart anyway
+        # restart anyway. Misses are cached with a TTL instead: a
+        # misconfigured scaleTargetRef would otherwise re-walk the full
+        # discovery surface every reconcile (every 10 s per bad HA),
+        # while a short TTL still picks up a late-installed CRD.
         self._discovered: Dict[tuple, Tuple[str, str, bool]] = {}
+        self._discovery_misses: Dict[tuple, float] = {}
 
     def _headers(self, content_type: Optional[str] = None) -> dict:
         headers = {"Accept": "application/json"}
@@ -262,9 +272,38 @@ class KubeClient:
             return static
         key = (kind, api_version)
         entry = self._discovered.get(key)
+        if entry is not None:
+            return entry
+        miss_until = self._discovery_misses.get(key)
+        if miss_until is not None and time.monotonic() < miss_until:
+            raise NotFoundError(
+                f"kind {kind!r} (apiVersion {api_version!r}) is not served "
+                "by the apiserver (cached discovery miss; retries after "
+                f"{DISCOVERY_MISS_TTL:.0f}s in case the CRD was installed)"
+            )
+        entry, degraded = self._discover_kind(kind, api_version)
         if entry is None:
-            entry = self._discover_kind(kind, api_version)
-            self._discovered[key] = entry
+            # only a DEFINITIVE miss (every group-version answered and
+            # none serves the kind) is cached: a walk that skipped a
+            # broken group may have skipped exactly the serving one, and
+            # caching that would turn a momentary aggregated-API hiccup
+            # into a DISCOVERY_MISS_TTL resolution outage
+            if not degraded:
+                self._discovery_misses[key] = (
+                    time.monotonic() + DISCOVERY_MISS_TTL
+                )
+            raise NotFoundError(
+                f"kind {kind!r} (apiVersion {api_version!r}) is not served "
+                "by the apiserver (discovery found no matching resource"
+                + (
+                    "; some group-versions failed and were skipped"
+                    if degraded
+                    else ""
+                )
+                + ")"
+            )
+        self._discovered[key] = entry
+        self._discovery_misses.pop(key, None)
         return entry
 
     @staticmethod
@@ -276,14 +315,14 @@ class KubeClient:
             else f"apis/{api_version}"
         )
 
-    def _discover_kind(
-        self, kind: str, api_version: str
-    ) -> Tuple[str, str, bool]:
+    def _discover_kind(self, kind: str, api_version: str):
         """Find the (group-version, plural, namespaced) serving `kind`.
         With an apiVersion (the CrossVersionObjectReference always has
         one) only that group-version's APIResourceList is consulted;
         without, every served group-version is walked (preferred
-        versions first), plus core /api/v1."""
+        versions first), plus core /api/v1. Returns (entry or None,
+        degraded) — degraded means some group-version failed and was
+        skipped, so a None result is NOT a definitive miss."""
         if api_version:
             prefixes = [self._api_prefix(api_version)]
             lenient = False  # the target group itself failing is an error
@@ -294,14 +333,13 @@ class KubeClient:
             # down answers 503) must not poison resolution of a kind
             # served by a healthy group — the RESTMapper posture
             lenient = True
+        degraded = False
         for prefix in prefixes:
-            entry = self._find_kind_in(prefix, kind, lenient)
+            entry, skipped = self._find_kind_in(prefix, kind, lenient)
+            degraded = degraded or skipped
             if entry is not None:
-                return entry
-        raise NotFoundError(
-            f"kind {kind!r} (apiVersion {api_version!r}) is not served by "
-            "the apiserver (discovery found no matching resource)"
-        )
+                return entry, degraded
+        return None, degraded
 
     def _discovery_prefixes(self) -> list:
         """Every served group-version (preferred versions first), plus
@@ -319,21 +357,23 @@ class KubeClient:
         return prefixes
 
     def _find_kind_in(self, prefix: str, kind: str, lenient: bool = False):
+        """(entry or None, skipped): skipped marks a group-version whose
+        APIResourceList FAILED (not one that answered without the kind)."""
         try:
             payload = self._request("GET", prefix)
         except NotFoundError:
-            return None  # group-version not served
+            return None, False  # group-version not served: definitive
         except RuntimeError as e:  # incl. ConflictError; 404 handled above
             if lenient:
                 log.warning("discovery: skipping %s: %s", prefix, e)
-                return None
+                return None, True
             raise
         for res in payload.get("resources", []):
             # subresources list as "deployments/scale" — the primary
             # resource is the entry without a slash
             if res.get("kind") == kind and "/" not in res.get("name", ""):
-                return (prefix, res["name"], bool(res.get("namespaced")))
-        return None
+                return (prefix, res["name"], bool(res.get("namespaced"))), False
+        return None, False
 
     def _collection(
         self, kind: str, namespace: Optional[str], api_version: str = ""

@@ -882,3 +882,93 @@ class TestDiscoveryFuzz:
                 else:
                     with pytest.raises(NotFoundError):
                         fresh.resolve_kind(kind)
+
+    def test_miss_is_negative_cached_with_ttl(self, monkeypatch):
+        """A misconfigured scaleTargetRef must not re-walk the whole
+        discovery surface every reconcile: misses cache for
+        DISCOVERY_MISS_TTL, then retry (a late-installed CRD is picked
+        up without a restart)."""
+        from karpenter_tpu.store import NotFoundError
+        from karpenter_tpu.store import kube as kube_mod
+
+        client = KubeClient(base_url="http://127.0.0.1:1", timeout=1.0)
+        calls = {"n": 0}
+        resources = {"resources": []}
+
+        def fake_request(method, path, *args, **kwargs):
+            if path == "apis":
+                calls["n"] += 1
+                return {"groups": []}
+            if path == "api/v1":
+                return resources
+            raise AssertionError(path)
+
+        client._request = fake_request
+        clock = {"t": 1000.0}
+        monkeypatch.setattr(
+            kube_mod.time, "monotonic", lambda: clock["t"]
+        )
+        with pytest.raises(NotFoundError):
+            client.resolve_kind("Widget")
+        assert calls["n"] == 1
+        # within the TTL: no new walk, same typed error
+        with pytest.raises(NotFoundError, match="cached"):
+            client.resolve_kind("Widget")
+        assert calls["n"] == 1
+        # after the TTL: the walk retries; the now-served kind resolves
+        # and clears the miss entry
+        clock["t"] += kube_mod.DISCOVERY_MISS_TTL + 1
+        resources["resources"] = [
+            {"name": "widgets", "kind": "Widget", "namespaced": True}
+        ]
+        assert client.resolve_kind("Widget") == (
+            "api/v1", "widgets", True
+        )
+        assert ("Widget", "") not in client._discovery_misses
+
+    def test_degraded_walk_is_not_negative_cached(self, monkeypatch):
+        """A blind walk that SKIPPED a broken group may have skipped
+        exactly the serving one: the miss must NOT enter the negative
+        cache, so the next reconcile retries immediately (the r5 review
+        case: a momentary aggregated-API 503 must not become a 30 s
+        resolution outage)."""
+        from karpenter_tpu.store import NotFoundError
+
+        client = KubeClient(base_url="http://127.0.0.1:1", timeout=1.0)
+        state = {"healthy": False}
+
+        def fake_request(method, path, *args, **kwargs):
+            if path == "apis":
+                return {
+                    "groups": [
+                        {
+                            "name": "agg.example.com",
+                            "preferredVersion": {
+                                "groupVersion": "agg.example.com/v1"
+                            },
+                            "versions": [
+                                {"groupVersion": "agg.example.com/v1"}
+                            ],
+                        }
+                    ]
+                }
+            if path == "api/v1":
+                return {"resources": []}
+            assert path == "apis/agg.example.com/v1", path
+            if not state["healthy"]:
+                raise RuntimeError(f"GET {path}: 503")
+            return {
+                "resources": [
+                    {"name": "widgets", "kind": "Widget", "namespaced": True}
+                ]
+            }
+
+        client._request = fake_request
+        with pytest.raises(NotFoundError, match="skipped"):
+            client.resolve_kind("Widget")
+        assert ("Widget", "") not in client._discovery_misses
+        # the backend recovers: the VERY NEXT resolve succeeds (no TTL)
+        state["healthy"] = True
+        assert client.resolve_kind("Widget") == (
+            "apis/agg.example.com/v1", "widgets", True
+        )
