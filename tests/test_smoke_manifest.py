@@ -1,0 +1,107 @@
+"""hack/smoke-manifest.py: the kind-smoke transform must track config/.
+
+The smoke job (make kind-smoke, presubmit `smoke`) pipes the kustomize
+output through this transform; if config/ grows something a bare kind
+cluster cannot satisfy and the transform misses it, the smoke wedges in
+CI. Pinning the transform against the LIVE config tree catches that at
+unit speed."""
+
+import importlib.util
+import pathlib
+
+import pytest
+
+yaml = pytest.importorskip("yaml")
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _load_transform():
+    spec = importlib.util.spec_from_file_location(
+        "smoke_manifest", REPO / "hack" / "smoke-manifest.py"
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def _config_docs():
+    """The kustomize output equivalent: every resource the tree lists."""
+    kustomization = yaml.safe_load(
+        (REPO / "config" / "kustomization.yaml").read_text()
+    )
+    docs = []
+    for rel in kustomization["resources"]:
+        docs.extend(
+            d
+            for d in yaml.safe_load_all(
+                (REPO / "config" / rel).read_text()
+            )
+            if d is not None
+        )
+    return docs
+
+
+class TestSmokeTransform:
+    def test_strips_exactly_the_kind_incompatible_docs(self):
+        sm = _load_transform()
+        kept = []
+        for doc in _config_docs():
+            if sm.dropped(doc):
+                continue
+            if doc.get("kind") == "Deployment":
+                sm.rewrite_deployment(doc, "karpenter-tpu:smoke")
+            kept.append(doc)
+        kinds = {d.get("kind") for d in kept}
+        # everything a bare kind cluster can't satisfy is gone
+        assert not any(k.endswith("WebhookConfiguration") for k in kinds)
+        assert all(
+            not d.get("apiVersion", "").startswith(
+                ("cert-manager.io/", "monitoring.coreos.com/")
+            )
+            for d in kept
+        )
+        # and the deployable core is intact
+        assert {
+            "CustomResourceDefinition",
+            "ClusterRole",
+            "ClusterRoleBinding",
+            "ServiceAccount",
+            "Deployment",
+            "Namespace",
+        } <= kinds
+
+    def test_deployment_rewrite_invariants(self):
+        sm = _load_transform()
+        dep = next(
+            d for d in _config_docs() if d.get("kind") == "Deployment"
+        )
+        sm.rewrite_deployment(dep, "karpenter-tpu:smoke")
+        pod = dep["spec"]["template"]["spec"]
+        assert dep["spec"]["replicas"] == 1
+        assert "nodeSelector" not in pod
+        # cert-manager volume dropped BY NAME; everything else kept
+        names = [v["name"] for v in pod.get("volumes", [])]
+        assert "cert" not in names
+        for container in pod["containers"]:
+            assert container["image"] == "karpenter-tpu:smoke"
+            mounts = [
+                m["name"] for m in container.get("volumeMounts", [])
+            ]
+            assert "cert" not in mounts
+            for section in ("requests", "limits"):
+                entries = container.get("resources", {}).get(section, {})
+                assert "google.com/tpu" not in entries
+        controller = next(
+            c for c in pod["containers"] if c["name"] == "controller"
+        )
+        assert "--cloud-provider=fake" in controller["args"]
+        assert not any("webhook" in a for a in controller["args"])
+        # the solver keeps its compile cache (emptyDir works on kind)
+        solver = next(
+            c for c in pod["containers"] if c["name"] == "solver"
+        )
+        assert any(
+            m["name"] == "compile-cache"
+            for m in solver.get("volumeMounts", [])
+        )
