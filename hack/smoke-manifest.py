@@ -72,9 +72,10 @@ def rewrite_deployment(doc, image) -> None:
             container["args"] = list(SMOKE_ARGS)
 
 
-def main() -> int:
-    image = sys.argv[1] if len(sys.argv) > 1 else ""
-    docs = [d for d in yaml.safe_load_all(sys.stdin) if d is not None]
+def transform(docs, image):
+    """The whole smoke pipeline over parsed documents — main() and the
+    pinning test (tests/test_smoke_manifest.py) both call THIS, so a
+    new transform step can never be tested-around."""
     kept = []
     for doc in docs:
         if dropped(doc):
@@ -82,7 +83,13 @@ def main() -> int:
         if doc.get("kind") == "Deployment":
             rewrite_deployment(doc, image)
         kept.append(doc)
-    yaml.safe_dump_all(kept, sys.stdout, sort_keys=False)
+    return kept
+
+
+def main() -> int:
+    image = sys.argv[1] if len(sys.argv) > 1 else ""
+    docs = [d for d in yaml.safe_load_all(sys.stdin) if d is not None]
+    yaml.safe_dump_all(transform(docs, image), sys.stdout, sort_keys=False)
     return 0
 
 
