@@ -114,3 +114,37 @@ class TestSmokeTransform:
             m["name"] == "compile-cache"
             for m in solver.get("volumeMounts", [])
         )
+
+
+class TestChartTemplates:
+    """No helm binary ships in this environment, so the chart renders
+    nowhere before CI users run it; pin the cheap invariants a broken
+    edit would trip (unbalanced actions, values references that do not
+    exist in values.yaml)."""
+
+    def test_actions_balanced_and_values_exist(self):
+        import re
+
+        chart = REPO / "charts" / "karpenter-tpu"
+        values = yaml.safe_load((chart / "values.yaml").read_text())
+
+        def has_path(root, dotted):
+            node = root
+            for part in dotted.split("."):
+                if not isinstance(node, dict) or part not in node:
+                    return False
+                node = node[part]
+            return True
+
+        for template in sorted((chart / "templates").glob("*.yaml")):
+            text = template.read_text()
+            opens = len(re.findall(r"{{-?\s*(?:if|range|with)\b", text))
+            ends = len(re.findall(r"{{-?\s*end\s*-?}}", text))
+            assert opens == ends, (
+                f"{template.name}: {opens} if/range/with vs {ends} end"
+            )
+            for dotted in re.findall(r"\.Values\.([A-Za-z0-9_.]+)", text):
+                assert has_path(values, dotted), (
+                    f"{template.name} references .Values.{dotted}, "
+                    "absent from values.yaml"
+                )
