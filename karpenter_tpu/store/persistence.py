@@ -225,6 +225,38 @@ class DurableStore(Store):
         if isinstance(obj.metadata.resource_version, int):
             self._rv = max(self._rv, obj.metadata.resource_version)
 
+    # -- write-time restorability gate -------------------------------------
+
+    def _check_restorable(self, obj) -> None:
+        """Fail at WRITE time if this kind could not be decoded at
+        recovery: journaling an unregistered custom kind (easy to do —
+        the scale subresource duck-types any spec.replicas object)
+        would otherwise succeed silently and crash the NEXT process
+        start inside _recover, far from the mistake."""
+        kind = _kind_of(obj)
+        if kind not in KINDS and kind not in _EXTRA_KINDS:
+            raise ValueError(
+                f"kind {kind!r} cannot be journaled durably: recovery "
+                "could not decode it. Call store.persistence."
+                f"register_persistent_kind({kind!r}, "
+                f"{type(obj).__name__}) before storing it in a durable "
+                "store."
+            )
+
+    def create(self, obj):
+        self._check_restorable(obj)
+        return super().create(obj)
+
+    def update(self, obj):
+        self._check_restorable(obj)
+        return super().update(obj)
+
+    def apply_event(self, event: str, obj) -> None:
+        # every journaling entry path is gated, DELETED included: a
+        # delete record of an unknown kind is decoded at recovery too
+        self._check_restorable(obj)
+        super().apply_event(event, obj)
+
     # -- journaling --------------------------------------------------------
 
     def _notify(self, event: str, obj) -> None:

@@ -8,6 +8,7 @@ coordination leases — over actual HTTP against tests/fake_apiserver.py,
 then run the WHOLE control plane (KarpenterRuntime) on top of it.
 """
 
+import os
 import time
 
 import pytest
@@ -975,7 +976,7 @@ class TestDiscoveryFuzz:
 
 
 @pytest.mark.skipif(
-    not __import__("os").environ.get("KARPENTER_SCALE_TESTS"),
+    not os.environ.get("KARPENTER_SCALE_TESTS"),
     reason="50k-object HTTP mirror; battletest sets KARPENTER_SCALE_TESTS=1",
 )
 class TestMirrorAtScale:

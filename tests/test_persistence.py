@@ -315,3 +315,71 @@ class TestFactory:
         durable.close()
         plain = open_store(None)
         assert isinstance(plain, Store) and not isinstance(plain, DurableStore)
+
+
+class TestRestorabilityGate:
+    def test_unregistered_kind_fails_at_write_not_recovery(self, tmp_path):
+        """Journaling an unregistered custom kind must fail AT CREATE
+        (actionable, points at register_persistent_kind) instead of
+        succeeding and crashing the next process start inside
+        _recover — the duck-typed scale path makes such objects easy
+        to make."""
+        from dataclasses import dataclass, field
+
+        from karpenter_tpu.api.core import ObjectMeta
+        from karpenter_tpu.store.persistence import (
+            DurableStore,
+            register_persistent_kind,
+        )
+
+        @dataclass
+        class _WidgetSpec:
+            replicas: int = 1
+
+        @dataclass
+        class _WidgetStatus:
+            replicas: int = 0
+
+        @dataclass
+        class _Widget:
+            metadata: ObjectMeta = field(default_factory=ObjectMeta)
+            spec: _WidgetSpec = field(default_factory=_WidgetSpec)
+            status: _WidgetStatus = field(default_factory=_WidgetStatus)
+            KIND = "FuzzWidget"
+
+        from karpenter_tpu.store import persistence as _p
+        from karpenter_tpu.store.store import ADDED
+
+        try:
+            store = DurableStore(str(tmp_path / "data"))
+            try:
+                with pytest.raises(
+                    ValueError, match="register_persistent_kind"
+                ):
+                    store.create(_Widget(metadata=ObjectMeta(name="w")))
+                # the watch-driven entry path is gated too
+                with pytest.raises(
+                    ValueError, match="register_persistent_kind"
+                ):
+                    store.apply_event(
+                        ADDED, _Widget(metadata=ObjectMeta(name="w2"))
+                    )
+                # registration makes the SAME object durable end to end
+                register_persistent_kind("FuzzWidget", _Widget)
+                store.create(_Widget(metadata=ObjectMeta(name="w")))
+            finally:
+                store.close()
+            reopened = DurableStore(str(tmp_path / "data"))
+            try:
+                assert (
+                    reopened.get(
+                        "FuzzWidget", "default", "w"
+                    ).spec.replicas
+                    == 1
+                )
+            finally:
+                reopened.close()
+        finally:
+            # always unregister: a leak would warp later unregistered-kind
+            # assertions in this process
+            _p._EXTRA_KINDS.pop("FuzzWidget", None)
