@@ -27,14 +27,30 @@ RUN if [ -n "$JAX_EXTRAS" ]; then \
     else \
         pip install --no-cache-dir .; \
     fi
-# Pre-build the C accelerators into the installed package and prove the
-# degraded-mode (no-TPU) solver path imports cleanly.
+# Pre-build the C accelerators into the INSTALLED package and prove the
+# degraded-mode (no-TPU) solver path imports cleanly. Run from / so the
+# /src/karpenter_tpu source tree cannot shadow site-packages (stdin
+# scripts put the cwd on sys.path): with the shadow, the kernels built
+# into /src — which the runtime layer never copies — and the shipped
+# read-only image silently degraded to the pure-numpy solve. The
+# __file__ assertion makes that regression loud.
+WORKDIR /
 RUN python - <<'EOF'
+import karpenter_tpu
+assert "site-packages" in karpenter_tpu.__file__, (
+    f"prebuild imported the wrong tree: {karpenter_tpu.__file__}"
+)
 from karpenter_tpu.native import load_kbinpack, load_kquantity
 assert load_kquantity() is not None, "quantity kernel build failed"
 assert load_kbinpack() is not None, "binpack kernel build failed"
-import karpenter_tpu  # noqa: F401  (wiring sanity)
-print("native kernels prebuilt")
+# the loader builds next to the imported module, so a successful load
+# plus the site-packages __file__ assertion above proves the kernels
+# landed in the tree the runtime layer copies
+import glob, os
+built = glob.glob(os.path.join(
+    os.path.dirname(karpenter_tpu.__file__), "native", "_build", "*.so"
+))
+print("native kernels prebuilt into", built)
 EOF
 
 FROM python:3.12-slim
