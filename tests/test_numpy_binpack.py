@@ -384,3 +384,40 @@ class TestThreadedAssign:
             for s, m in zip(single[:4], multi[:4]):
                 np.testing.assert_array_equal(np.asarray(s), np.asarray(m))
             assert single[4] == multi[4], case
+
+
+class TestPackBits:
+    """The C octet-gather packer vs the pure-numpy np.packbits fallback:
+    identical words at every adversarial width (word boundaries, single
+    column, sub-octet tails, non-contiguous views)."""
+
+    def test_c_pack_equals_numpy_pack(self):
+        from karpenter_tpu.native import load_kbinpack
+        from karpenter_tpu.ops.numpy_binpack import _pack_bits
+
+        lib = load_kbinpack()
+        if lib is None:
+            pytest.skip("native packer unavailable")
+        rng = np.random.default_rng(5)
+        widths = [1, 2, 7, 8, 9, 63, 64, 65, 127, 128, 129, 200]
+        for k in widths:
+            for n in (0, 1, 3, 257):
+                matrix = rng.random((n, k)) < 0.4
+                np.testing.assert_array_equal(
+                    _pack_bits(matrix, lib),
+                    _pack_bits(matrix, None),
+                    err_msg=f"n={n} k={k}",
+                )
+        # non-contiguous view (every other row): the C path must copy,
+        # not read strided memory as if dense
+        big = rng.random((64, 70)) < 0.5
+        view = big[::2]
+        assert not view.flags.c_contiguous
+        np.testing.assert_array_equal(
+            _pack_bits(view, lib), _pack_bits(np.ascontiguousarray(view), None)
+        )
+        # int storage (not bool): the packer must see 0/1 bytes
+        ints = (rng.random((5, 66)) < 0.5).astype(np.int64)
+        np.testing.assert_array_equal(
+            _pack_bits(ints, lib), _pack_bits(ints != 0, None)
+        )
