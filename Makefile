@@ -50,6 +50,11 @@ native: ## Pre-build the C accelerators (otherwise built lazily in background)
 bench: ## Headline benchmark (runs on the real TPU when present)
 	$(PYTHON) bench.py
 
+bench-solver: ## Direct vs coalesced solver-service p50/p99 (10k pods x 50 types); appends a BENCHMARKS row + publishes to BASELINE.json
+	$(PYTHON) bench.py --solver-service --pods 10000 --types 50 \
+		--backend xla --iters 10 \
+		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
+
 dryrun: ## Multi-chip sharding compile check on 8 virtual CPU devices
 	$(PYTHON) -c "import os; \
 		os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS','') + ' --xla_force_host_platform_device_count=8').strip(); \
@@ -86,5 +91,6 @@ conformance: ## Run the real-apiserver tier against a kind-booted apiserver (the
 kind-smoke: ## Deploy smoke on kind: image -> apply -> pod Ready -> one HA end to end
 	bash hack/kind-smoke.sh
 
-.PHONY: help dev ci test battletest verify codegen docs native bench dryrun \
-	image publish apply delete kind-load conformance kind-smoke
+.PHONY: help dev ci test battletest verify codegen docs native bench \
+	bench-solver dryrun image publish apply delete kind-load conformance \
+	kind-smoke

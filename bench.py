@@ -410,6 +410,33 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
         "N autoscalers x 4 metrics instead of the bin-pack",
     )
     ap.add_argument(
+        "--solver-service",
+        action="store_true",
+        help="benchmark the shared solve service (karpenter_tpu/solver): "
+        "--concurrency threads submit concurrently through the coalescing "
+        "queue vs. the same load on direct ops/binpack calls; reports both "
+        "p50/p99 plus coalesce factor and dispatch counts",
+    )
+    ap.add_argument(
+        "--concurrency",
+        type=int,
+        default=8,
+        help="with --solver-service: concurrent submitter threads",
+    )
+    ap.add_argument(
+        "--publish-baseline",
+        action="store_true",
+        help="with --solver-service: write the result into BASELINE.json's "
+        "'published' map",
+    )
+    ap.add_argument(
+        "--append-benchmarks",
+        default="",
+        metavar="FILE",
+        help="with --solver-service: append a markdown row to this "
+        "benchmarks table (e.g. docs/BENCHMARKS.md)",
+    )
+    ap.add_argument(
         "--e2e",
         action="store_true",
         help="headline the full reconcile tick (columnar-cache snapshot + "
@@ -469,8 +496,31 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
         )
     if args.host_only and not args.e2e:
         ap.error("--host-only only applies to --e2e")
+    if args.solver_service and (
+        args.mesh or args.e2e or args.decide or args.clusters
+    ):
+        ap.error(
+            "--solver-service benchmarks the service front door on the "
+            "plain solver workload; it cannot combine with "
+            "--mesh/--e2e/--decide/--clusters"
+        )
+    if args.concurrency < 1:
+        ap.error("--concurrency must be >= 1")
+    if (args.publish_baseline or args.append_benchmarks) and (
+        not args.solver_service
+    ):
+        ap.error(
+            "--publish-baseline/--append-benchmarks only apply to "
+            "--solver-service (nothing would be published otherwise)"
+        )
 
-    if args.decide:
+    if args.solver_service:
+        metric = (
+            f"solver-service coalesced bin-pack p50 latency, {args.pods} "
+            f"pods x {args.types} instance types, {args.concurrency} "
+            f"concurrent callers"
+        )
+    elif args.decide:
         metric = (
             f"batched HPA decision kernel p50 latency, fleet of "
             f"{args.decide} autoscalers x 4 metrics (recommendation + "
@@ -566,6 +616,9 @@ def run(args, metric: str, note: str) -> None:
 
     _warm_native_kernel(args)
 
+    if args.solver_service:
+        run_solver_service(args, metric, note)
+        return
     if args.decide:
         run_decide(args, metric, note)
         return
@@ -616,6 +669,184 @@ def run(args, metric: str, note: str) -> None:
     emit(
         f"{metric} ({jax.default_backend()})",
         p50,
+        note=f"{note}; {extra}" if note else extra,
+    )
+
+
+def _measure_concurrent(call, inputs_list, iters: int):
+    """Per-request latencies (ms) with len(inputs_list) submitter threads
+    issuing `iters` sequential calls each — the concurrent-callers load
+    shape the solver service's coalescing window exists for."""
+    import threading
+
+    latencies = [[] for _ in inputs_list]
+    barrier = threading.Barrier(len(inputs_list))
+
+    def submitter(i):
+        barrier.wait()
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            call(inputs_list[i])
+            latencies[i].append((time.perf_counter() - t0) * 1e3)
+
+    threads = [
+        threading.Thread(target=submitter, args=(i,))
+        for i in range(len(inputs_list))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return [t for per in latencies for t in per]
+
+
+def _solver_service_record(args, backend, direct, service, svc) -> dict:
+    reqs = max(1, svc.stats.requests)
+    return {
+        "config": f"{args.pods} pods x {args.types} types",
+        "backend": backend,
+        "concurrency": args.concurrency,
+        "direct_p50_ms": round(float(np.percentile(direct, 50)), 3),
+        "direct_p99_ms": round(float(np.percentile(direct, 99)), 3),
+        "service_p50_ms": round(float(np.percentile(service, 50)), 3),
+        "service_p99_ms": round(float(np.percentile(service, 99)), 3),
+        "avg_coalesce_factor": round(reqs / max(1, svc.stats.dispatches), 2),
+        "dispatches": svc.stats.dispatches,
+        "requests": svc.stats.requests,
+        "compile_cache_misses": svc.stats.compile_cache_misses,
+    }
+
+
+def _publish_solver_baseline(record: dict) -> None:
+    """Land the result in BASELINE.json's `published` map (the satellite
+    contract: measured configs graduate from claim to committed data)."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE.json")
+    with open(path) as f:
+        baseline = json.load(f)
+    key = f"{record['config']} solver service ({record['backend']})"
+    baseline.setdefault("published", {})[key] = {
+        k: v for k, v in record.items() if k != "config"
+    }
+    with open(path, "w") as f:
+        json.dump(baseline, f, indent=2)
+        f.write("\n")
+    print(f"published to BASELINE.json: {key}", file=sys.stderr)
+
+
+def _append_benchmarks_row(path: str, record: dict) -> None:
+    header = (
+        "\n## Solver service (make bench-solver)\n\n"
+        "Direct `ops/binpack` calls vs. the shared solve service "
+        "(coalescing + shape-bucketed compile cache), same concurrent "
+        "load on both paths.\n\n"
+        "| Date | Backend | Config | Callers | Direct p50/p99 (ms) | "
+        "Service p50/p99 (ms) | Avg coalesce | Dispatches |\n"
+        "|---|---|---|---|---|---|---|---|\n"
+    )
+    date = datetime.date.today().isoformat()
+    row = (
+        f"| {date} | {record['backend']} | {record['config']} "
+        f"| {record['concurrency']} "
+        f"| {record['direct_p50_ms']} / {record['direct_p99_ms']} "
+        f"| {record['service_p50_ms']} / {record['service_p99_ms']} "
+        f"| {record['avg_coalesce_factor']}x "
+        f"| {record['dispatches']} |\n"
+    )
+    with open(path) as f:
+        content = f.read()
+    if "## Solver service (make bench-solver)" not in content:
+        content = content.rstrip("\n") + "\n" + header
+    with open(path, "w") as f:
+        f.write(content.rstrip("\n") + "\n" + row)
+    print(f"appended row to {path}", file=sys.stderr)
+
+
+def run_solver_service(args, metric: str, note: str) -> None:
+    """Direct vs. coalesced: the same C-concurrent-callers load through
+    plain ops/binpack.solve and through the shared solve service. The
+    service number includes its queue/window/scatter overhead — the
+    honest cost of coalescing — while direct calls contend for the
+    device serially."""
+    import jax
+
+    from karpenter_tpu.ops.binpack import solve as direct_solve
+    from karpenter_tpu.solver import SolverService
+
+    print(
+        f"backend={jax.default_backend()} devices={jax.devices()}",
+        file=sys.stderr,
+    )
+    # distinct seeds so coalesced problems are genuinely different work;
+    # same shape = one compile bucket, as in a fleet of same-scale ticks
+    inputs_list = [
+        build_inputs(
+            args.pods, args.types, args.taints, args.labels,
+            args.seed + i, affinity=args.affinity, anti=args.anti,
+        )
+        for i in range(args.concurrency)
+    ]
+
+    def direct(x):
+        jax.block_until_ready(
+            direct_solve(x, buckets=args.buckets, backend=args.backend)
+        )
+
+    svc = SolverService(
+        window_s=0.002, max_batch=args.concurrency, backend=args.backend
+    )
+
+    def through_service(x):
+        svc.solve(x, buckets=args.buckets)
+
+    try:
+        # warm both paths outside the timed region (compiles + first
+        # coalesced batch size)
+        t0 = time.perf_counter()
+        direct(inputs_list[0])
+        _measure_concurrent(through_service, inputs_list, 1)
+        print(
+            f"warmup (compiles): {(time.perf_counter() - t0) * 1e3:.1f} ms",
+            file=sys.stderr,
+        )
+        direct_lat = _measure_concurrent(direct, inputs_list, args.iters)
+        service_lat = _measure_concurrent(
+            through_service, inputs_list, args.iters
+        )
+        record = _solver_service_record(
+            args, jax.default_backend(), direct_lat, service_lat, svc
+        )
+    finally:
+        svc.close()
+    record_evidence(
+        direct_iter_ms=[round(t, 4) for t in direct_lat],
+        service_iter_ms=[round(t, 4) for t in service_lat],
+        solver_service=record,
+        transport_floor=measure_transport_floor(),
+    )
+    print(
+        f"direct p50={record['direct_p50_ms']}ms "
+        f"p99={record['direct_p99_ms']}ms | service "
+        f"p50={record['service_p50_ms']}ms "
+        f"p99={record['service_p99_ms']}ms "
+        f"coalesce={record['avg_coalesce_factor']}x "
+        f"dispatches={record['dispatches']}",
+        file=sys.stderr,
+    )
+    if args.publish_baseline:
+        _publish_solver_baseline(record)
+    if args.append_benchmarks:
+        _append_benchmarks_row(args.append_benchmarks, record)
+    extra = (
+        f"direct p50={record['direct_p50_ms']}ms/"
+        f"p99={record['direct_p99_ms']}ms; coalesce "
+        f"{record['avg_coalesce_factor']}x over "
+        f"{record['requests']} requests in "
+        f"{record['dispatches']} dispatches"
+    )
+    emit(
+        f"{metric} ({jax.default_backend()})",
+        record["service_p50_ms"],
         note=f"{note}; {extra}" if note else extra,
     )
 
@@ -855,7 +1086,7 @@ def run_e2e(args, metric: str, note: str = "") -> None:  # lint: allow-complexit
     from karpenter_tpu.ops.binpack import solve
     from karpenter_tpu.metrics.registry import GaugeRegistry
     from karpenter_tpu.metrics.producers.pendingcapacity import (
-        _group_profile,
+        group_profile,
     )
     from karpenter_tpu.store import Store
     from karpenter_tpu.store.columnar import PendingFeed
@@ -867,7 +1098,7 @@ def run_e2e(args, metric: str, note: str = "") -> None:  # lint: allow-complexit
     )
     rng = np.random.default_rng(args.seed)
     store = Store()
-    feed = PendingFeed(store, _group_profile)
+    feed = PendingFeed(store, group_profile)
     cpu_choices = [Quantity.parse(q) for q in ("100m", "250m", "500m", "1", "2", "4")]
     mem_choices = [Quantity.parse(q) for q in ("128Mi", "512Mi", "1Gi", "4Gi")]
 

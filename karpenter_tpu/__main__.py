@@ -157,11 +157,10 @@ def _run_simulation(args, store) -> int:
         ),
         store=store,
     )
-    solver = (
-        runtime.solver_client.solve
-        if runtime.solver_client is not None
-        else None
-    )
+    # route through the runtime's shared solve service (not the raw
+    # sidecar client): the dry run gets the same queueing, deadlines,
+    # and numpy fallback the production tick gets
+    solver = runtime.solver_service.solve
     # the scale-from-zero seam the production solve uses: without it,
     # empty groups with a nodeGroupRef would simulate as infeasible
     resolver = runtime.producer_factory.template_resolver()

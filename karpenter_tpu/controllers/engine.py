@@ -41,9 +41,17 @@ class Controller(Protocol):
 
 
 class Manager:
-    def __init__(self, store: Store, clock=_time.time, registry=None):
+    def __init__(
+        self, store: Store, clock=_time.time, registry=None,
+        solver_service=None,
+    ):
         self.store = store
         self.clock = clock
+        # shared solve service (solver/service.py): the manager refreshes
+        # its point-in-time gauges (queue depth, coalesce factor, stage
+        # percentiles) every tick, so /metrics shows them alongside the
+        # runtime series with no extra wiring in __main__.py
+        self._solver_service = solver_service
         self._controllers: List[Controller] = []
         # (kind, namespace, name) -> next due time; 0 = due now
         self._due: Dict[tuple, float] = {}
@@ -165,6 +173,8 @@ class Manager:
         now = self.clock()
         for controller in self._controllers:
             self._reconcile_controller(controller, now)
+        if self._solver_service is not None:
+            self._solver_service.publish_gauges()
         if self._tick_gauge is not None:
             self._tick_gauge.set(
                 "manager", "-", _time.perf_counter() - start

@@ -16,8 +16,17 @@ from karpenter_tpu.autoscaler import BatchAutoscaler
 
 
 class HorizontalAutoscalerController:
-    def __init__(self, batch_autoscaler: BatchAutoscaler):
+    """`solver_service` (solver/service.py) is the shared solve service:
+    the BatchAutoscaler's decision kernel is already routed through it as
+    the `decider` seam (runtime.py wiring); the controller additionally
+    records each fleet evaluation into the service's latency surface so
+    /metrics shows the decide stage next to the bin-pack stages."""
+
+    def __init__(
+        self, batch_autoscaler: BatchAutoscaler, solver_service=None
+    ):
         self.autoscaler = batch_autoscaler
+        self.solver_service = solver_service
 
     def kind(self) -> str:
         return HorizontalAutoscaler.KIND
@@ -26,7 +35,7 @@ class HorizontalAutoscalerController:
         return 10.0
 
     def reconcile(self, ha) -> None:
-        error = self.autoscaler.reconcile_batch([ha]).get(
+        error = self.reconcile_batch([ha]).get(
             (ha.metadata.namespace, ha.metadata.name)
         )
         if error is not None:
@@ -36,4 +45,7 @@ class HorizontalAutoscalerController:
         self, has: List[HorizontalAutoscaler]
     ) -> Dict[tuple, Optional[Exception]]:
         """Keyed by (namespace, name)."""
+        if self.solver_service is not None:
+            with self.solver_service.track("reconcile_batch"):
+                return self.autoscaler.reconcile_batch(has)
         return self.autoscaler.reconcile_batch(has)

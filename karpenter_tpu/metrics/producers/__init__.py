@@ -59,11 +59,11 @@ class ProducerFactory:
         lazy like the feeds that use it."""
         if self._node_mirror is None:
             from karpenter_tpu.metrics.producers.pendingcapacity import (
-                _group_profile,
+                group_profile,
             )
             from karpenter_tpu.store.columnar import NodeMirror
 
-            self._node_mirror = NodeMirror(self.store, _group_profile)
+            self._node_mirror = NodeMirror(self.store, group_profile)
         return self._node_mirror
 
     def reservations(self):
@@ -83,12 +83,12 @@ class ProducerFactory:
         cost."""
         if self._pending_feed is None:
             from karpenter_tpu.metrics.producers.pendingcapacity import (
-                _group_profile,
+                group_profile,
             )
             from karpenter_tpu.store.columnar import PendingFeed
 
             self._pending_feed = PendingFeed(
-                self.store, _group_profile, node_mirror=self.node_mirror()
+                self.store, group_profile, node_mirror=self.node_mirror()
             )
         return self._pending_feed
 

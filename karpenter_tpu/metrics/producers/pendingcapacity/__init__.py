@@ -59,11 +59,60 @@ from .constants import (  # noqa: F401
     TAINT_PAD,
     UNSCHEDULABLE_PODS,
 )
-from .encoder import _dedup_rows, _encode_from_cache, _group_arrays, _group_profile  # noqa: F401
+from .encoder import _dedup_rows, _group_arrays  # noqa: F401
+from .encoder import _encode_from_cache  # noqa: F401 — deprecated seam:
+# stays an eager module global because (a) internal solve paths resolve
+# it at call time and (b) tests monkeypatch it to count encodes; new
+# code uses encode_snapshot below
+from .encoder import _group_profile as _group_profile_impl
 from .exclusion import _anti_base_exclusion, _canonical_row_key, _co_pin, _total_order  # noqa: F401
 from .partition import _partition_chunks, _water_fill  # noqa: F401
 from .scoring import _score_rows  # noqa: F401
 from .spread import _entry_caps, _expand_spread_rows, _spread_state  # noqa: F401
+
+
+def encode_snapshot(snap, profiles, with_rows: bool = False, census=None):
+    """PUBLIC encoding API: store snapshot -> fixed-shape solver inputs.
+
+    The one encoder every solve path uses (encoder._encode_from_cache),
+    promoted for external callers — simulate, custom tooling — that
+    previously reached for the underscore name. Delegates through the
+    module-global `_encode_from_cache` so test seams that patch it still
+    intercept every path. See encoder.py for the full contract
+    (deduplicated weighted shape rows, spread/anti expansion, padding)."""
+    return _encode_from_cache(
+        snap, profiles, with_rows=with_rows, census=census
+    )
+
+
+def group_profile(nodes, selector):
+    """PUBLIC profile API: (allocatable by resource, labels set, taints
+    set) for one node group — the conservative elementwise-MIN shape over
+    ready+schedulable nodes matching `selector` (encoder._group_profile,
+    promoted like encode_snapshot)."""
+    return _group_profile_impl(nodes, selector)
+
+
+def __getattr__(name: str):
+    # deprecated underscore import: `_group_profile` is served lazily so
+    # legacy importers keep working but see the deprecation; internal
+    # code and new callers use the public group_profile above
+    if name == "_group_profile":
+        import warnings
+
+        warnings.warn(
+            "importing _group_profile from "
+            "karpenter_tpu.metrics.producers.pendingcapacity is "
+            "deprecated; use group_profile (or encode_snapshot for "
+            "_encode_from_cache)",
+            DeprecationWarning,
+            stacklevel=2,
+        )
+        return _group_profile_impl
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )
+
 
 def register_gauges(registry: GaugeRegistry) -> None:
     for name in (
@@ -118,7 +167,7 @@ def _target_profiles(targets, feed, nodes, template_resolver, errors):
             profile = (
                 feed.nodes.profile(sel)
                 if feed is not None
-                else _group_profile(nodes, sel)
+                else _group_profile_impl(nodes, sel)
             )
             if not profile[0] and ref and template_resolver is not None:
                 resolved = template_resolver(namespace, ref)

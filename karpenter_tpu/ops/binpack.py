@@ -339,7 +339,11 @@ def solve(
     O(P)). All backends are pinned element-for-element equal by
     tests/test_pallas_binpack.py and tests/test_numpy_binpack.py. Inputs
     are device-cached by object identity (see _device_resident): treat
-    them as immutable."""
+    them as immutable.
+
+    This is the kernel-level entry; production callers submit through the
+    shared solve service (karpenter_tpu/solver — coalescing, shape
+    bucketing, backpressure) rather than calling here directly."""
     if backend == "auto":
         if jax.default_backend() == "tpu":
             backend = "pallas"

@@ -70,25 +70,39 @@ class KarpenterRuntime:
             )
         )
         self.solver_client = None
-        solver = decider = None
+        device_solver = decider = None
         if options.solver_uri:
             from karpenter_tpu.sidecar.client import SolverClient
 
             self.solver_client = SolverClient(options.solver_uri)
-            solver = self.solver_client.solve
             # the decision kernel rides the same split: with a sidecar
             # configured the control-plane process runs NO device math
+            device_solver = self.solver_client.solve
             decider = self.solver_client.decide
+        # ALL bin-pack callers route through the shared solve service
+        # (solver/service.py): coalescing, shape-bucketed compile cache,
+        # backpressure + numpy fallback, and a metrics surface in THIS
+        # runtime's registry so /metrics exposes it with no extra wiring.
+        # Under the gRPC split the service fronts the sidecar client —
+        # queueing/deadlines/fallback still apply, device math does not
+        # return to this process.
+        from karpenter_tpu.solver import SolverService
+
+        self.solver_service = SolverService(
+            registry=self.registry,
+            device_solver=device_solver,
+            decider=decider,
+        )
         self.producer_factory = ProducerFactory(
             self.store, self.cloud_provider, registry=self.registry,
-            solver=solver,
+            solver=self.solver_service.solve,
         )
         self.metrics_clients = MetricsClientFactory(
             registry=self.registry, prometheus_uri=options.prometheus_uri
         )
         self.batch_autoscaler = BatchAutoscaler(
             self.metrics_clients, self.store, clock=self.clock,
-            decider=decider,
+            decider=self.solver_service.decide,
         )
         # Registration order = in-tick evaluation order. Producers run first
         # so signals are fresh, then node groups observe, then the batched
@@ -96,17 +110,22 @@ class KarpenterRuntime:
         # reference's produce→scrape→poll chain costs up to 20s of interval
         # latency; SURVEY.md §6).
         self.manager = Manager(
-            self.store, clock=self.clock, registry=self.registry
+            self.store, clock=self.clock, registry=self.registry,
+            solver_service=self.solver_service,
         ).register(
             MetricsProducerController(self.producer_factory),
             ScalableNodeGroupController(self.cloud_provider),
-            HorizontalAutoscalerController(self.batch_autoscaler),
+            HorizontalAutoscalerController(
+                self.batch_autoscaler, solver_service=self.solver_service
+            ),
         )
 
     def run(self, duration: float) -> None:
         self.manager.run(duration)
 
     def close(self) -> None:
+        if self.solver_service is not None:
+            self.solver_service.close()
         if self.solver_client is not None:
             self.solver_client.close()
             self.solver_client = None

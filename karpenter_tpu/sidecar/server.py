@@ -2,11 +2,14 @@
 
 The service/method names and message semantics are proto/solver.proto;
 handlers are registered generically (no generated stubs, see
-sidecar/__init__.py). Solve runs the pending-pods bin-pack
-(ops/binpack.solve, Pallas backend on TPU), Decide the batched HPA
-decision kernel (ops/decision.decide_jit). Both are stateless: all inputs
-arrive in the request, matching the reference's checkpoint/resume posture
-(all durable state in the store; SURVEY.md §5).
+sidecar/__init__.py). Solve routes the pending-pods bin-pack through the
+process-shared solve service (solver/service.py): concurrent Solve RPCs
+from the gRPC thread pool coalesce into one batched device call, shapes
+are bucketed through the shared compile cache, and a sick device path
+degrades to the numpy backend instead of erroring every caller. Decide
+runs the batched HPA decision kernel (ops/decision.decide_jit). Both are
+stateless: all inputs arrive in the request, matching the reference's
+checkpoint/resume posture (all durable state in the store; SURVEY.md §5).
 """
 
 from __future__ import annotations
@@ -25,9 +28,8 @@ SERVICE = "karpenter.solver.v1.Solver"
 
 
 def _solve(request: bytes) -> bytes:
-    import jax
-
-    from karpenter_tpu.ops.binpack import BinPackInputs, solve
+    from karpenter_tpu.ops.binpack import BinPackInputs
+    from karpenter_tpu.solver import default_service
 
     # optional tensors (pod_weight) may be absent from the wire; the codec
     # fills dataclass defaults and rejects missing-required/extra tensors
@@ -35,8 +37,12 @@ def _solve(request: bytes) -> bytes:
     buckets = int(meta.get("buckets", 32))
     backend = meta.get("backend", "auto")
     with solver_trace("sidecar.solve"):
-        out = solve(jax.device_put(inputs), buckets=buckets, backend=backend)
-        jax.block_until_ready(out)
+        # the shared service owns device access: concurrent RPCs from the
+        # gRPC worker pool coalesce into one dispatch, and outputs come
+        # back as host numpy ready for the wire
+        out = default_service().solve(
+            inputs, buckets=buckets, backend=backend
+        )
     return codec.pack_dataclass(out)
 
 

@@ -25,10 +25,9 @@ import numpy as np
 
 from karpenter_tpu.metrics.producers.pendingcapacity import (
     DomainCensus,
-    _encode_from_cache,
-    _group_profile,
+    encode_snapshot,
+    group_profile,
 )
-from karpenter_tpu.ops import binpack as B
 from karpenter_tpu.store.columnar import (
     PendingPodCache,
     is_pending,
@@ -83,7 +82,15 @@ def simulate(  # lint: allow-complexity — report assembly: one guard per optio
     feasible assignment only routes pods to them when no real group
     is feasible earlier in the order — the delta a what-if run shows is
     capacity the existing fleet genuinely lacks."""
-    solver = solver or B.solve
+    if solver is None:
+        # the process-default solve service (solver/service.py): a
+        # standalone simulation gets bucketing/backpressure/fallback for
+        # free, and callers co-resident with other default-service users
+        # (the sidecar server's RPCs) share one queue. A control plane
+        # passes its runtime's own service here (__main__.py does).
+        from karpenter_tpu.solver import default_service
+
+        solver = default_service().solve
 
     producers = sorted(
         (
@@ -103,7 +110,7 @@ def simulate(  # lint: allow-complexity — report assembly: one guard per optio
         # same-named producers in different namespaces must not collapse
         names.append(f"{mp.metadata.namespace}/{mp.metadata.name}")
         try:
-            profile = _group_profile(
+            profile = group_profile(
                 nodes, mp.spec.pending_capacity.node_selector
             )
             if not profile[0] and template_resolver is not None:
@@ -149,7 +156,7 @@ def simulate(  # lint: allow-complexity — report assembly: one guard per optio
     # existing pods by construction)
     census = DomainCensus(occupancy_from_pods(all_pods), lambda: nodes)
     census.set_namespaces(store.list("Namespace"))
-    inputs, row_idx, row_weight = _encode_from_cache(
+    inputs, row_idx, row_weight = encode_snapshot(
         snap, profiles, with_rows=True, census=census
     )
     if what_if_names and inputs.pod_group_score is not None:

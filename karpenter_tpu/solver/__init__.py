@@ -1,0 +1,42 @@
+"""Shared solve service: request coalescing, shape-bucketed compile
+cache, and backpressure for every bin-pack caller (docs/solver-service.md).
+
+Public surface:
+
+  * SolverService       — the long-lived in-process service
+  * SolveFuture         — async handle from SolverService.submit
+  * SolverSaturated     — bounded-queue backpressure signal
+  * SolverTimeout       — per-request deadline expiry
+  * default_service     — the process-shared instance (simulate, sidecar)
+  * bucket_up / bucket_shape / pad_to_bucket — the shape ladder
+"""
+
+from karpenter_tpu.solver.bucketing import (
+    bucket_shape,
+    bucket_up,
+    pad_to_bucket,
+)
+from karpenter_tpu.solver.service import (
+    SUBSYSTEM,
+    SolveFuture,
+    SolverSaturated,
+    SolverService,
+    SolverStatistics,
+    SolverTimeout,
+    default_service,
+    reset_default_service,
+)
+
+__all__ = [
+    "SUBSYSTEM",
+    "SolveFuture",
+    "SolverSaturated",
+    "SolverService",
+    "SolverStatistics",
+    "SolverTimeout",
+    "bucket_shape",
+    "bucket_up",
+    "default_service",
+    "pad_to_bucket",
+    "reset_default_service",
+]

@@ -1,0 +1,177 @@
+"""Shape bucketing for the shared solve service.
+
+Every distinct operand shape is a distinct XLA program: a fleet whose
+pending-pod count wanders 9,812 → 10,407 → 9,955 across ticks would
+recompile the bin-pack on every tick if requests were dispatched at
+their natural sizes. The encoder already pads to coarse multiples
+(producers/pendingcapacity/constants.py), but other callers — the
+sidecar's wire requests, simulate, bench — arrive at arbitrary shapes,
+and even encoder-padded shapes step at every +256 pods.
+
+The service therefore rounds every axis UP a power-of-two-ish ladder
+(1, 1.5, 2, 3, 4, 6, 8, ... × floor): consecutive rungs are ≤ 1.5×
+apart, so padding waste is bounded at 50% (33% amortized) while the
+number of distinct compiled shapes for traffic in [floor, N] is
+O(log N), not O(N). Steady-state traffic whose sizes jitter inside one
+rung hits the same compiled program forever — zero recompiles after
+warmup, which is what turns the 20–40 s TPU compile from a per-tick
+hazard into a once-per-deployment cost.
+
+Padding is SEMANTICS-PRESERVING by construction (the same argument the
+encoder's own padding rests on):
+
+  * extra pod rows: valid=False, weight=0 — excluded from assignment,
+    every aggregate they touch adds exact zeros;
+  * extra group columns: zero allocatable — `_feasibility` rejects them
+    outright, so no pod is ever assigned to a padding group and their
+    output rows are sliced off before results scatter back;
+  * extra taint/label bits: zero on both sides of the bitset matmuls —
+    they contribute nothing to either violation count.
+
+Integer outputs (assigned, counts, node totals, unschedulable) are
+therefore EQUAL to the unpadded solve, not merely close; the float
+intermediate (the LP-bound einsum) only gains exactly-zero terms.
+tests/test_solver_service.py pins service outputs against direct
+ops/binpack calls element for element.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+from karpenter_tpu.ops.binpack import BinPackInputs
+
+# Axis floors: the smallest bucket on each ladder. Pods/groups mirror the
+# encoder's pads (a fleet encoded at POD_PAD multiples lands exactly on a
+# rung for small fleets); constraint universes mirror their pad constants.
+POD_FLOOR = 256
+GROUP_FLOOR = 8
+TAINT_FLOOR = 32
+LABEL_FLOOR = 64
+RESOURCE_FLOOR = 4
+# Coalesced batches are padded up this ladder too (1, 2, 3, 4, 6, 8, ...)
+# so the number of distinct batched programs stays logarithmic in the
+# coalesce cap.
+BATCH_FLOOR = 1
+
+
+def bucket_up(n: int, floor: int) -> int:
+    """Round `n` up to the next rung of the {1, 1.5} × 2^k ladder above
+    `floor` (floor, 1.5·floor, 2·floor, 3·floor, 4·floor, ...)."""
+    if n <= floor:
+        return floor
+    rung = floor
+    while True:
+        if n <= rung:
+            return rung
+        if n <= rung + rung // 2:
+            return rung + rung // 2
+        rung *= 2
+
+
+def bucket_shape(inputs: BinPackInputs) -> Tuple[int, int, int, int, int]:
+    """(P, T, R, K, L) rounded up their ladders — the shape half of the
+    compile-cache key."""
+    p, r = inputs.pod_requests.shape
+    t = inputs.group_allocatable.shape[0]
+    k = inputs.pod_intolerant.shape[1]
+    l = inputs.pod_required.shape[1]
+    return (
+        bucket_up(p, POD_FLOOR),
+        bucket_up(t, GROUP_FLOOR),
+        bucket_up(r, RESOURCE_FLOOR),
+        bucket_up(k, TAINT_FLOOR),
+        bucket_up(l, LABEL_FLOOR),
+    )
+
+
+def presence(inputs: BinPackInputs) -> Tuple[bool, bool, bool, bool]:
+    """Which optional operands ride this request — the other half of the
+    compile-cache key (an absent operand removes whole program stages)."""
+    return (
+        inputs.pod_weight is not None,
+        inputs.pod_group_forbidden is not None,
+        inputs.pod_group_score is not None,
+        inputs.pod_exclusive is not None,
+    )
+
+
+def _pad2(a, rows: int, cols: Optional[int] = None):
+    """Zero-pad a 1-D/2-D array up to (rows[, cols]); the same object is
+    returned when no padding is needed so already-bucketed traffic (the
+    encoder's steady state) keeps identity-based device caches warm."""
+    a = np.asarray(a)
+    if a.ndim == 1:
+        if a.shape[0] == rows:
+            return a
+        out = np.zeros(rows, a.dtype)
+        out[: a.shape[0]] = a
+        return out
+    if a.shape == (rows, cols):
+        return a
+    out = np.zeros((rows, cols), a.dtype)
+    out[: a.shape[0], : a.shape[1]] = a
+    return out
+
+
+def pad_to_bucket(
+    inputs: BinPackInputs, shape: Tuple[int, int, int, int, int]
+) -> BinPackInputs:
+    """Pad every operand to the bucket `shape` (see module docstring for
+    why this is exact). Returns `inputs` unchanged when already there."""
+    p, t, r, k, l = shape
+    if (
+        inputs.pod_requests.shape == (p, r)
+        and inputs.group_allocatable.shape == (t, r)
+        and inputs.pod_intolerant.shape == (p, k)
+        and inputs.pod_required.shape == (p, l)
+    ):
+        return inputs
+    # pod_weight: absent means "every row counts once", so padding an
+    # absent weight must materialize ones for real rows + zeros for pads
+    # (an all-ones pad would count invalid padding rows into nothing —
+    # they are valid=False — but zero weight keeps the aggregates exact
+    # even if a future stage forgets the validity mask)
+    weight = inputs.pod_weight
+    if weight is not None:
+        weight = _pad2(weight, p)
+    forbidden = inputs.pod_group_forbidden
+    if forbidden is not None:
+        forbidden = _pad2(forbidden, p, t)
+    score = inputs.pod_group_score
+    if score is not None:
+        score = _pad2(score, p, t)
+    exclusive = inputs.pod_exclusive
+    if exclusive is not None:
+        exclusive = _pad2(exclusive, p)
+    return BinPackInputs(
+        pod_requests=_pad2(inputs.pod_requests, p, r),
+        pod_valid=_pad2(inputs.pod_valid, p),
+        pod_intolerant=_pad2(inputs.pod_intolerant, p, k),
+        pod_required=_pad2(inputs.pod_required, p, l),
+        group_allocatable=_pad2(inputs.group_allocatable, t, r),
+        group_taints=_pad2(inputs.group_taints, t, k),
+        group_labels=_pad2(inputs.group_labels, t, l),
+        pod_weight=weight,
+        pod_group_forbidden=forbidden,
+        pod_group_score=score,
+        pod_exclusive=exclusive,
+    )
+
+
+def crop_outputs(out, n_pods: int, n_groups: int):
+    """Slice a padded solve's outputs back to the request's true axes.
+
+    Padding groups are all-infeasible, so no real pod's `assigned` index
+    ever points past n_groups; padding pods are invalid, so the scalar
+    `unschedulable` never counts them. Host numpy in, host numpy out."""
+    return dataclasses.replace(
+        out,
+        assigned=out.assigned[:n_pods],
+        assigned_count=out.assigned_count[:n_groups],
+        nodes_needed=out.nodes_needed[:n_groups],
+        lp_bound=out.lp_bound[:n_groups],
+    )

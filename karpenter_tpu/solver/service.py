@@ -1,0 +1,682 @@
+"""Shared solve service: one amortized device pipeline for every caller.
+
+Before this subsystem, each bin-pack caller — the pendingCapacity batch
+solve, simulate, the gRPC sidecar's concurrent Solve RPCs, bench — drove
+ops/binpack on its own: concurrent ticks paid separate XLA dispatches,
+and every novel operand shape paid a fresh compile. The service is the
+single in-process front door that turns those independent calls into one
+production pipeline:
+
+  submit → coalesce → pad → dispatch → scatter
+
+  * COALESCING QUEUE: requests arriving within a short window (default
+    2 ms) are gathered, grouped by compatibility key, and same-key
+    requests ride ONE batched device call (`lax.map` over the stacked
+    operands — the per-item program is the same HLO as a direct solve,
+    so results match a direct ops/binpack call element for element).
+  * SHAPE BUCKETING + COMPILE CACHE: operands are padded up the
+    power-of-two-ish ladder (solver/bucketing.py) and the compiled
+    program is cached per (shape bucket, batch bucket, buckets,
+    operand presence, backend). Steady-state traffic whose sizes jitter
+    inside one rung never recompiles; the hit/miss counters make that
+    claim testable.
+  * BACKPRESSURE + DEADLINES: the queue is bounded — a full queue
+    degrades the overflow request to the numpy backend inline instead
+    of growing an unbounded backlog; each request carries a deadline,
+    and an expired wait degrades the same way (or raises, per
+    `on_timeout`). A device-path failure falls back to numpy per
+    request: the control plane keeps producing signals through an
+    accelerator outage, the posture every entry point in this repo
+    takes (utils/backend.py).
+  * METRICS: queue depth, coalesce factor, compile-cache hits/misses,
+    rejections/expiries/fallbacks, and per-stage latency percentiles,
+    registered through the same GaugeRegistry the runtime serves on
+    /metrics (subsystem "solver").
+
+The service holds NO domain state — it is a pure function of each
+request — so callers keep their own caches (the encode memo, the
+device-residency memo) and their public APIs unchanged.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import threading
+import time as _time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from karpenter_tpu.metrics.registry import GaugeRegistry, default_registry
+from karpenter_tpu.observability import solver_trace
+from karpenter_tpu.ops.binpack import DEFAULT_BUCKETS, BinPackInputs
+from karpenter_tpu.solver.bucketing import (
+    bucket_up,
+    bucket_shape,
+    crop_outputs,
+    pad_to_bucket,
+    presence,
+)
+from karpenter_tpu.utils.log import logger
+
+SUBSYSTEM = "solver"
+
+QUEUE_DEPTH = "queue_depth"
+COALESCE_FACTOR = "coalesce_factor"
+REQUESTS_TOTAL = "requests_total"
+DISPATCH_TOTAL = "dispatch_total"
+COMPILE_CACHE_HITS = "compile_cache_hits_total"
+COMPILE_CACHE_MISSES = "compile_cache_misses_total"
+FALLBACK_TOTAL = "fallback_total"
+REJECTED_TOTAL = "rejected_total"
+DEADLINE_EXPIRED_TOTAL = "deadline_expired_total"
+STAGE_P50_MS = "stage_p50_ms"
+STAGE_P99_MS = "stage_p99_ms"
+
+_STAGE_WINDOW = 256  # per-stage latency ring size (fleet-scale constant)
+
+
+class SolverSaturated(RuntimeError):
+    """The bounded request queue is full (backpressure signal)."""
+
+
+class SolverTimeout(TimeoutError):
+    """A request's deadline expired before the device path answered."""
+
+
+@dataclass
+class SolverStatistics:
+    """Plain-int mirror of the service counters (tests and callers read
+    these directly; the registry carries the same values for /metrics)."""
+
+    requests: int = 0
+    dispatches: int = 0
+    coalesced_batches: int = 0
+    compile_cache_hits: int = 0
+    compile_cache_misses: int = 0
+    fallbacks: int = 0
+    rejected: int = 0
+    deadline_expired: int = 0
+    last_coalesce_factor: int = 0
+    decide_calls: int = 0
+    decide_errors: int = 0
+
+
+@dataclass
+class _Request:
+    inputs: BinPackInputs
+    buckets: int
+    backend: str
+    key: tuple
+    n_pods: int
+    n_groups: int
+    deadline: Optional[float]
+    enqueued_at: float
+    event: threading.Event = field(default_factory=threading.Event)
+    result: Optional[object] = None
+    error: Optional[BaseException] = None
+    abandoned: bool = False
+
+    def finish(self, result=None, error=None) -> None:
+        self.result = result
+        self.error = error
+        self.event.set()
+
+
+class SolveFuture:
+    """Handle returned by submit(); result() blocks with a deadline."""
+
+    def __init__(self, request: _Request, service: "SolverService"):
+        self._request = request
+        self._service = service
+
+    def result(self, timeout: Optional[float] = None):
+        req = self._request
+        if not req.event.wait(timeout):
+            req.abandoned = True  # the worker will skip it
+            self._service._on_expired(req)
+            raise SolverTimeout(
+                f"solve deadline expired after {timeout}s "
+                f"(queue depth {self._service.queue_depth()})"
+            )
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+
+class SolverService:
+    """Long-lived in-process solve service (module docstring).
+
+    `device_solver` overrides the in-process device path with any
+    (inputs, buckets=..., backend=...) -> BinPackOutputs callable — the
+    sidecar SolverClient.solve under the gRPC process split, or a fault
+    injector in tests. With an override the worker dispatches requests
+    individually (the wire codec carries one problem per message), but
+    queueing, deadlines, backpressure, fallback, and metrics still
+    apply. `decider` seams the HPA decision kernel the same way.
+    """
+
+    def __init__(
+        self,
+        registry: Optional[GaugeRegistry] = None,
+        *,
+        window_s: float = 0.002,
+        max_queue: int = 64,
+        max_batch: int = 8,
+        default_timeout_s: float = 30.0,
+        backend: str = "auto",
+        on_timeout: str = "fallback",  # or "raise"
+        device_solver: Optional[Callable] = None,
+        decider: Optional[Callable] = None,
+        clock: Callable[[], float] = _time.monotonic,
+    ):
+        if on_timeout not in ("fallback", "raise"):
+            raise ValueError(f"on_timeout must be fallback|raise, got {on_timeout!r}")
+        self.registry = registry if registry is not None else default_registry()
+        self.window_s = window_s
+        self.max_queue = max_queue
+        self.max_batch = max_batch
+        self.default_timeout_s = default_timeout_s
+        self.backend = backend
+        self.on_timeout = on_timeout
+        self.device_solver = device_solver
+        self._decider = decider
+        self._clock = clock
+        self.stats = SolverStatistics()
+        self._queue: collections.deque = collections.deque()
+        self._cond = threading.Condition()
+        self._worker: Optional[threading.Thread] = None
+        self._closed = False
+        # (backend, shape, batch, buckets, presence) -> compiled callable
+        self._compiled: Dict[tuple, Callable] = {}
+        self._compile_seen: set = set()
+        self._stages: Dict[str, collections.deque] = {}
+        self._stage_lock = threading.Lock()
+        self._register_metrics()
+
+    # -- metrics ----------------------------------------------------------
+
+    def _register_metrics(self) -> None:
+        reg = self.registry.register
+        self._g_queue = reg(SUBSYSTEM, QUEUE_DEPTH)
+        self._g_coalesce = reg(SUBSYSTEM, COALESCE_FACTOR)
+        self._c_requests = reg(SUBSYSTEM, REQUESTS_TOTAL, kind="counter")
+        self._c_dispatch = reg(SUBSYSTEM, DISPATCH_TOTAL, kind="counter")
+        self._c_hits = reg(SUBSYSTEM, COMPILE_CACHE_HITS, kind="counter")
+        self._c_misses = reg(SUBSYSTEM, COMPILE_CACHE_MISSES, kind="counter")
+        self._c_fallback = reg(SUBSYSTEM, FALLBACK_TOTAL, kind="counter")
+        self._c_rejected = reg(SUBSYSTEM, REJECTED_TOTAL, kind="counter")
+        self._c_expired = reg(
+            SUBSYSTEM, DEADLINE_EXPIRED_TOTAL, kind="counter"
+        )
+        self._g_stage_p50 = reg(SUBSYSTEM, STAGE_P50_MS)
+        self._g_stage_p99 = reg(SUBSYSTEM, STAGE_P99_MS)
+
+    def _record_stage(self, stage: str, seconds: float) -> None:
+        ms = seconds * 1e3
+        with self._stage_lock:
+            ring = self._stages.get(stage)
+            if ring is None:
+                ring = self._stages[stage] = collections.deque(
+                    maxlen=_STAGE_WINDOW
+                )
+            ring.append(ms)
+
+    def publish_gauges(self) -> None:
+        """Refresh the point-in-time gauges (queue depth, coalesce
+        factor, per-stage latency percentiles). Counters are incremented
+        at event time and need no refresh; the Manager calls this each
+        tick so /metrics stays current even across idle windows."""
+        self._g_queue.set("-", "-", float(self.queue_depth()))
+        self._g_coalesce.set(
+            "-", "-", float(self.stats.last_coalesce_factor)
+        )
+        with self._stage_lock:
+            snapshot = {k: list(v) for k, v in self._stages.items()}
+        for stage, samples in snapshot.items():
+            if samples:
+                self._g_stage_p50.set(
+                    stage, "-", float(np.percentile(samples, 50))
+                )
+                self._g_stage_p99.set(
+                    stage, "-", float(np.percentile(samples, 99))
+                )
+
+    @contextlib.contextmanager
+    def track(self, stage: str):
+        """Record an arbitrary caller stage (e.g. the HA controller's
+        fleet decide) into the service's latency surface."""
+        t0 = _time.perf_counter()
+        try:
+            yield
+        finally:
+            self._record_stage(stage, _time.perf_counter() - t0)
+
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    # -- submission -------------------------------------------------------
+
+    def _resolve_backend(self, backend: Optional[str]) -> str:
+        backend = backend or self.backend
+        if self.device_solver is not None:
+            return backend  # the override owns backend semantics
+        if backend == "auto":
+            import jax
+
+            if jax.default_backend() == "tpu":
+                return "pallas"
+            if jax.default_backend() == "cpu":
+                return "numpy"
+            return "xla"
+        return backend
+
+    def submit(
+        self,
+        inputs: BinPackInputs,
+        buckets: int = DEFAULT_BUCKETS,
+        backend: Optional[str] = None,
+        timeout: Optional[float] = None,
+    ) -> SolveFuture:
+        """Enqueue one solve; raises SolverSaturated when the bounded
+        queue is full (solve() turns that into the numpy fallback)."""
+        if self._closed:
+            raise RuntimeError("solver service is closed")
+        resolved = self._resolve_backend(backend)
+        timeout = self.default_timeout_s if timeout is None else timeout
+        now = self._clock()
+        request = _Request(
+            inputs=inputs,
+            buckets=buckets,
+            backend=resolved,
+            key=(
+                bucket_shape(inputs),
+                buckets,
+                resolved,
+                presence(inputs),
+            ),
+            n_pods=inputs.pod_requests.shape[0],
+            n_groups=inputs.group_allocatable.shape[0],
+            deadline=(now + timeout) if timeout else None,
+            enqueued_at=now,
+        )
+        with self._cond:
+            if len(self._queue) >= self.max_queue:
+                self.stats.rejected += 1
+                self._c_rejected.inc("-", "-")
+                raise SolverSaturated(
+                    f"solver queue full ({self.max_queue})"
+                )
+            self._ensure_worker()
+            self._queue.append(request)
+            self.stats.requests += 1
+            self._c_requests.inc("-", "-")
+            self._g_queue.set("-", "-", float(len(self._queue)))
+            self._cond.notify_all()
+        return SolveFuture(request, self)
+
+    def solve(
+        self,
+        inputs: BinPackInputs,
+        buckets: int = DEFAULT_BUCKETS,
+        backend: Optional[str] = None,
+        timeout: Optional[float] = None,
+    ):
+        """Synchronous solve through the service — the drop-in `solver`
+        seam every caller already takes (any (inputs, buckets=...) ->
+        BinPackOutputs callable). Saturation and (by default) deadline
+        expiry degrade to the numpy backend inline, so a caller always
+        gets an answer while the device path is sick."""
+        timeout = self.default_timeout_s if timeout is None else timeout
+        try:
+            future = self.submit(
+                inputs, buckets=buckets, backend=backend, timeout=timeout
+            )
+        except SolverSaturated:
+            logger().warning(
+                "solver queue saturated; degrading one request to numpy"
+            )
+            return self._numpy_fallback(inputs, buckets)
+        try:
+            return future.result(timeout if timeout else None)
+        except SolverTimeout:
+            if self.on_timeout == "raise":
+                raise
+            logger().warning(
+                "solve deadline expired; degrading one request to numpy"
+            )
+            return self._numpy_fallback(inputs, buckets)
+
+    def decide(self, inputs):
+        """The HPA decision kernel through the service: same metrics
+        surface and error accounting, no coalescing (the batch
+        autoscaler already evaluates the whole fleet in one call)."""
+        self.stats.decide_calls += 1
+        t0 = _time.perf_counter()
+        try:
+            with solver_trace("solver.decide"):
+                return self._decide_fn()(inputs)
+        except Exception:
+            self.stats.decide_errors += 1
+            raise
+        finally:
+            self._record_stage("decide", _time.perf_counter() - t0)
+
+    def _decide_fn(self):
+        if self._decider is None:
+            from karpenter_tpu.ops.decision import decide_jit
+
+            self._decider = decide_jit
+        return self._decider
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        worker = self._worker
+        if worker is not None:
+            worker.join(timeout=5.0)
+            self._worker = None
+
+    # -- worker -----------------------------------------------------------
+
+    def _ensure_worker(self) -> None:
+        # called under self._cond
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._run, name="solver-service", daemon=True
+            )
+            self._worker.start()
+
+    def _run(self) -> None:
+        while True:
+            batch = self._collect()
+            if batch is None:
+                return
+            groups: Dict[tuple, List[_Request]] = {}
+            for request in batch:
+                groups.setdefault(request.key, []).append(request)
+            for key, requests in groups.items():
+                self._dispatch_group(key, requests)
+            self.publish_gauges()
+
+    def _collect(self) -> Optional[List[_Request]]:
+        """Block for the first request, then hold the coalescing window
+        open, gathering up to max_batch requests. None = closed+drained."""
+        with self._cond:
+            while not self._queue:
+                if self._closed:
+                    return None
+                self._cond.wait()
+            batch = [self._queue.popleft()]
+        window_end = self._clock() + self.window_s
+        while len(batch) < self.max_batch:
+            remaining = window_end - self._clock()
+            if remaining <= 0:
+                break
+            with self._cond:
+                if not self._queue:
+                    self._cond.wait(timeout=remaining)
+                while self._queue and len(batch) < self.max_batch:
+                    batch.append(self._queue.popleft())
+        with self._cond:
+            self._g_queue.set("-", "-", float(len(self._queue)))
+        return batch
+
+    def _dispatch_group(self, key: tuple, requests: List[_Request]) -> None:
+        now = self._clock()
+        live: List[_Request] = []
+        for request in requests:
+            if request.abandoned:
+                continue  # caller already gave up (counted there)
+            if request.deadline is not None and now > request.deadline:
+                self._on_expired(request)
+                request.finish(
+                    error=SolverTimeout("deadline expired in queue")
+                )
+                continue
+            self._record_stage("queue_wait", now - request.enqueued_at)
+            live.append(request)
+        if not live:
+            return
+        self.stats.last_coalesce_factor = len(live)
+        if len(live) > 1:
+            self.stats.coalesced_batches += 1
+        self._g_coalesce.set("-", "-", float(len(live)))
+        try:
+            self._solve_group(key, live)
+        except Exception as error:  # noqa: BLE001 — device failure path
+            logger().warning(
+                "solver device path failed (%s: %s); degrading %d "
+                "request(s) to numpy",
+                type(error).__name__, error, len(live),
+            )
+            for request in live:
+                try:
+                    request.finish(
+                        result=self._numpy_fallback(
+                            request.inputs, request.buckets
+                        )
+                    )
+                except Exception as numpy_error:  # noqa: BLE001
+                    request.finish(error=numpy_error)
+
+    def _solve_group(self, key: tuple, live: List[_Request]) -> None:
+        shape, buckets, backend, _present = key
+        if backend == "numpy":
+            # host program: no device dispatch, no padding (the sparse
+            # numpy stages don't compile, so shape stability buys
+            # nothing), and no fallback counting — this is the REQUESTED
+            # backend, not a degradation
+            for request in live:
+                t0 = _time.perf_counter()
+                request.finish(
+                    result=self._numpy_solve(request.inputs, buckets)
+                )
+                self._record_stage("dispatch", _time.perf_counter() - t0)
+            return
+        if self.device_solver is not None:
+            for request in live:
+                t0 = _time.perf_counter()
+                out = self.device_solver(
+                    request.inputs, buckets=buckets, backend=backend
+                )
+                self._record_stage("dispatch", _time.perf_counter() - t0)
+                self._count_dispatch()
+                request.finish(result=out)
+            return
+        if backend == "pallas":
+            # the fused Mosaic kernel has no batched entry; requests
+            # still share the bucketed shapes (compile stability) and
+            # the single worker (bounded device pressure)
+            self._solve_pallas(shape, buckets, live)
+            return
+        self._solve_batched_xla(shape, buckets, live)
+
+    def _solve_pallas(self, shape, buckets: int, live: List[_Request]) -> None:
+        import jax
+
+        from karpenter_tpu.ops import binpack as B
+
+        self._count_compile(("pallas", shape, buckets, live[0].key[3]))
+        for request in live:
+            padded = pad_to_bucket(request.inputs, shape)
+            t0 = _time.perf_counter()
+            out = B.solve(padded, buckets=buckets, backend="pallas")
+            jax.block_until_ready(out)
+            self._record_stage("dispatch", _time.perf_counter() - t0)
+            self._count_dispatch()
+            request.finish(result=self._crop_host(out, request))
+
+    def _solve_batched_xla(
+        self, shape, buckets: int, live: List[_Request]
+    ) -> None:
+        """The coalesced path: pad each request to the shape bucket,
+        stack along a new leading axis, pad the batch axis up its own
+        ladder, run ONE compiled lax.map program, scatter slices back.
+        The per-item program inside the scan is the same computation as
+        a direct binpack call on the same (padded) shapes, so outputs
+        match direct calls element for element."""
+        t0 = _time.perf_counter()
+        padded = [pad_to_bucket(r.inputs, shape) for r in live]
+        n_batch = bucket_up(len(padded), 1)
+        # batch padding replicates the first request: cheapest valid
+        # filler (its outputs are computed and discarded)
+        padded.extend(padded[:1] * (n_batch - len(padded)))
+        stacked = _stack_inputs(padded)
+        self._record_stage("pad", _time.perf_counter() - t0)
+
+        import jax
+
+        fn = self._compiled_for(
+            ("xla", shape, n_batch, buckets, live[0].key[3])
+        )
+        t0 = _time.perf_counter()
+        with solver_trace("solver.dispatch"):
+            out = fn(stacked, buckets)
+            jax.block_until_ready(out)
+        self._record_stage("dispatch", _time.perf_counter() - t0)
+        self._count_dispatch()
+
+        t0 = _time.perf_counter()
+        host = _fetch_outputs(out)
+        for i, request in enumerate(live):
+            request.finish(result=self._crop_host(_index_outputs(host, i),
+                                                  request))
+        self._record_stage("scatter", _time.perf_counter() - t0)
+
+    def _crop_host(self, out, request: _Request):
+        return crop_outputs(
+            _fetch_outputs(out), request.n_pods, request.n_groups
+        )
+
+    def _compiled_for(self, cache_key: tuple) -> Callable:
+        self._count_compile(cache_key)
+        fn = self._compiled.get(cache_key)
+        if fn is not None:
+            return fn
+
+        from functools import partial
+
+        import jax
+        from jax import lax
+
+        from karpenter_tpu.ops import binpack as B
+
+        @partial(jax.jit, static_argnames=("buckets",))
+        def batched(stacked, buckets):
+            return lax.map(
+                lambda one: B.binpack(one, buckets=buckets), stacked
+            )
+
+        self._compiled[cache_key] = batched
+        return batched
+
+    def _count_compile(self, cache_key: tuple) -> None:
+        if cache_key in self._compile_seen:
+            self.stats.compile_cache_hits += 1
+            self._c_hits.inc("-", "-")
+        else:
+            self._compile_seen.add(cache_key)
+            self.stats.compile_cache_misses += 1
+            self._c_misses.inc("-", "-")
+
+    def _count_dispatch(self) -> None:
+        self.stats.dispatches += 1
+        self._c_dispatch.inc("-", "-")
+
+    def _on_expired(self, request: _Request) -> None:
+        self.stats.deadline_expired += 1
+        self._c_expired.inc("-", "-")
+
+    def _numpy_fallback(self, inputs: BinPackInputs, buckets: int):
+        self.stats.fallbacks += 1
+        self._c_fallback.inc("-", "-")
+        return self._numpy_solve(inputs, buckets)
+
+    def _numpy_solve(self, inputs: BinPackInputs, buckets: int):
+        from karpenter_tpu.ops.numpy_binpack import binpack_numpy
+
+        return binpack_numpy(inputs, buckets=buckets)
+
+
+def _stack_inputs(padded: List[BinPackInputs]) -> BinPackInputs:
+    """Stack same-shaped requests along a new leading batch axis (host
+    numpy; one device transfer happens inside the jitted dispatch).
+    Optional operands are presence-consistent across the batch (the
+    compatibility key includes the presence tuple)."""
+    import dataclasses
+
+    def stack(name: str):
+        leaves = [getattr(p, name) for p in padded]
+        if leaves[0] is None:
+            return None
+        return np.stack([np.asarray(leaf) for leaf in leaves], axis=0)
+
+    return BinPackInputs(
+        **{
+            f.name: stack(f.name)
+            for f in dataclasses.fields(BinPackInputs)
+        }
+    )
+
+
+def _fetch_outputs(out):
+    """Device outputs -> host numpy (one transfer per leaf, amortized
+    over the whole coalesced batch). Host outputs pass through."""
+    import dataclasses
+
+    import jax
+
+    if not isinstance(out.assigned, jax.Array):
+        return out
+    return dataclasses.replace(
+        out,
+        assigned=np.asarray(out.assigned),
+        assigned_count=np.asarray(out.assigned_count),
+        nodes_needed=np.asarray(out.nodes_needed),
+        lp_bound=np.asarray(out.lp_bound),
+        unschedulable=np.asarray(out.unschedulable),
+    )
+
+
+def _index_outputs(host, i: int):
+    import dataclasses
+
+    return dataclasses.replace(
+        host,
+        assigned=host.assigned[i],
+        assigned_count=host.assigned_count[i],
+        nodes_needed=host.nodes_needed[i],
+        lp_bound=host.lp_bound[i],
+        unschedulable=host.unschedulable[i],
+    )
+
+
+# -- process-default service -------------------------------------------------
+# simulate and the sidecar server share one service per process (the whole
+# point: concurrent callers coalesce); the runtime builds its OWN instance
+# so its gauges land in the runtime registry.
+
+_default_lock = threading.Lock()
+_default_service: Optional[SolverService] = None
+
+
+def default_service() -> SolverService:
+    global _default_service
+    with _default_lock:
+        if _default_service is None:
+            _default_service = SolverService()
+        return _default_service
+
+
+def reset_default_service() -> None:
+    """Close and drop the process-default service (test isolation)."""
+    global _default_service
+    with _default_lock:
+        if _default_service is not None:
+            _default_service.close()
+            _default_service = None

@@ -228,7 +228,14 @@ class TestDecideSplit:
                 ),
                 cloud_provider_factory=provider,
             )
-            assert rt.batch_autoscaler.decider == rt.solver_client.decide
+            # the shared solve service fronts the sidecar client: the
+            # autoscaler submits through the service, whose decider seam
+            # is the remote decide — device math stays out of process
+            assert rt.batch_autoscaler.decider == rt.solver_service.decide
+            assert rt.solver_service._decider == rt.solver_client.decide
+            assert (
+                rt.solver_service.device_solver == rt.solver_client.solve
+            )
             gauge = rt.registry.register("reserved_capacity",
                                          "cpu_utilization")
             gauge.set("g", "default", 0.85)
