@@ -55,6 +55,11 @@ bench-solver: ## Direct vs coalesced solver-service p50/p99 (10k pods x 50 types
 		--backend xla --iters 10 \
 		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
 
+bench-consolidate: ## Batched vs sequential drain-candidate evaluation (32 candidates x 480 bound pods); appends a BENCHMARKS row + publishes to BASELINE.json
+	$(PYTHON) bench.py --consolidate --candidates 32 --pods 480 \
+		--backend xla --iters 10 \
+		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
+
 dryrun: ## Multi-chip sharding compile check on 8 virtual CPU devices
 	$(PYTHON) -c "import os; \
 		os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS','') + ' --xla_force_host_platform_device_count=8').strip(); \
@@ -92,5 +97,5 @@ kind-smoke: ## Deploy smoke on kind: image -> apply -> pod Ready -> one HA end t
 	bash hack/kind-smoke.sh
 
 .PHONY: help dev ci test battletest verify codegen docs native bench \
-	bench-solver dryrun image publish apply delete kind-load conformance \
-	kind-smoke
+	bench-solver bench-consolidate dryrun image publish apply delete \
+	kind-load conformance kind-smoke

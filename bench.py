@@ -424,6 +424,22 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
         help="with --solver-service: concurrent submitter threads",
     )
     ap.add_argument(
+        "--consolidate",
+        action="store_true",
+        help="benchmark batched consolidation candidate evaluation "
+        "(karpenter_tpu/consolidation): --candidates drain candidates "
+        "evaluated in ONE service.consolidate dispatch vs. the same "
+        "masked bin-packs submitted sequentially; reports candidates/sec "
+        "both ways and the speedup",
+    )
+    ap.add_argument(
+        "--candidates",
+        type=int,
+        default=32,
+        help="with --consolidate: cluster nodes (every loaded node is a "
+        "drain candidate); --pods spread across them",
+    )
+    ap.add_argument(
         "--publish-baseline",
         action="store_true",
         help="with --solver-service: write the result into BASELINE.json's "
@@ -504,14 +520,26 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
             "plain solver workload; it cannot combine with "
             "--mesh/--e2e/--decide/--clusters"
         )
+    if args.consolidate and (
+        args.mesh or args.e2e or args.decide or args.clusters
+        or args.solver_service
+    ):
+        ap.error(
+            "--consolidate builds its own cluster workload; it cannot "
+            "combine with --mesh/--e2e/--decide/--clusters/"
+            "--solver-service"
+        )
+    if args.candidates < 2:
+        ap.error("--candidates must be >= 2 (a drain needs a receiver)")
     if args.concurrency < 1:
         ap.error("--concurrency must be >= 1")
-    if (args.publish_baseline or args.append_benchmarks) and (
-        not args.solver_service
+    if (args.publish_baseline or args.append_benchmarks) and not (
+        args.solver_service or args.consolidate
     ):
         ap.error(
             "--publish-baseline/--append-benchmarks only apply to "
-            "--solver-service (nothing would be published otherwise)"
+            "--solver-service/--consolidate (nothing would be published "
+            "otherwise)"
         )
 
     if args.solver_service:
@@ -519,6 +547,12 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
             f"solver-service coalesced bin-pack p50 latency, {args.pods} "
             f"pods x {args.types} instance types, {args.concurrency} "
             f"concurrent callers"
+        )
+    elif args.consolidate:
+        metric = (
+            f"batched consolidation candidate evaluation p50, "
+            f"{args.candidates} drain candidates x {args.pods} bound "
+            f"pods (one masked bin-pack per candidate, one dispatch)"
         )
     elif args.decide:
         metric = (
@@ -618,6 +652,9 @@ def run(args, metric: str, note: str) -> None:
 
     if args.solver_service:
         run_solver_service(args, metric, note)
+        return
+    if args.consolidate:
+        run_consolidate(args, metric, note)
         return
     if args.decide:
         run_decide(args, metric, note)
@@ -848,6 +885,255 @@ def run_solver_service(args, metric: str, note: str) -> None:
         f"{metric} ({jax.default_backend()})",
         record["service_p50_ms"],
         note=f"{note}; {extra}" if note else extra,
+    )
+
+
+def build_consolidation_cluster(candidates: int, pods: int, seed: int):
+    """A synthetic fragmented cluster in the in-memory store: every node
+    is a drain candidate; utilization is deliberately uneven (rng pod
+    counts, small requests) so a realistic fraction of drains fit."""
+    from karpenter_tpu.api.core import (
+        Container,
+        Node,
+        NodeCondition,
+        NodeSpec,
+        NodeStatus,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+    )
+    from karpenter_tpu.api.metricsproducer import (
+        MetricsProducer,
+        MetricsProducerSpec,
+        PendingCapacitySpec,
+    )
+    from karpenter_tpu.store import Store
+    from karpenter_tpu.utils.quantity import Quantity
+
+    rng = np.random.default_rng(seed)
+    store = Store()
+    store.create(
+        MetricsProducer(
+            metadata=ObjectMeta(name="bench"),
+            spec=MetricsProducerSpec(
+                pending_capacity=PendingCapacitySpec(
+                    node_selector={"pool": "bench"},
+                    node_group_ref="bench-group",
+                )
+            ),
+        )
+    )
+    for n in range(candidates):
+        store.create(
+            Node(
+                metadata=ObjectMeta(
+                    name=f"node-{n:04d}", labels={"pool": "bench"}
+                ),
+                spec=NodeSpec(),
+                status=NodeStatus(
+                    allocatable={
+                        "cpu": Quantity.parse("16"),
+                        "memory": Quantity.parse("64Gi"),
+                        "pods": Quantity.parse("110"),
+                    },
+                    conditions=[NodeCondition("Ready", "True")],
+                ),
+            )
+        )
+    # skewed spread (u^2 concentrates pods on low-index nodes): the head
+    # nodes run hot and veto, the long tail is lightly loaded and drains
+    # — the fragmented-cluster shape consolidation exists for
+    for i in range(pods):
+        n = int(candidates * rng.random() ** 2) % candidates
+        cpu = float(rng.choice([0.25, 0.5, 1.0, 2.0]))
+        store.create(
+            Pod(
+                metadata=ObjectMeta(name=f"pod-{i:05d}"),
+                spec=PodSpec(
+                    node_name=f"node-{n:04d}",
+                    containers=[
+                        Container(
+                            requests={
+                                "cpu": Quantity.parse(str(cpu)),
+                                "memory": Quantity.parse(
+                                    f"{int(cpu * 2048)}Mi"
+                                ),
+                            }
+                        )
+                    ],
+                ),
+            )
+        )
+    return store
+
+
+def _consolidate_record(args, backend, batched, sequential,
+                        candidates: int, drainable: int, svc) -> dict:
+    batched_p50 = float(np.percentile(batched, 50))
+    sequential_p50 = float(np.percentile(sequential, 50))
+    return {
+        "config": (
+            f"{candidates} candidates x {args.pods} bound pods "
+            f"consolidation"
+        ),
+        "backend": backend,
+        "candidates": candidates,
+        "drainable": drainable,
+        "batched_p50_ms": round(batched_p50, 3),
+        "sequential_p50_ms": round(sequential_p50, 3),
+        "batched_cps": round(candidates * 1000.0 / batched_p50, 1),
+        "sequential_cps": round(
+            candidates * 1000.0 / sequential_p50, 1
+        ),
+        "speedup": round(sequential_p50 / batched_p50, 2),
+        "dispatches": svc.stats.dispatches,
+        "compile_cache_misses": svc.stats.compile_cache_misses,
+    }
+
+
+def _publish_consolidate_baseline(record: dict) -> None:
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE.json")
+    with open(path) as f:
+        baseline = json.load(f)
+    key = f"{record['config']} ({record['backend']})"
+    baseline.setdefault("published", {})[key] = {
+        k: v for k, v in record.items() if k != "config"
+    }
+    with open(path, "w") as f:
+        json.dump(baseline, f, indent=2)
+        f.write("\n")
+    print(f"published to BASELINE.json: {key}", file=sys.stderr)
+
+
+def _append_consolidate_row(path: str, record: dict) -> None:
+    header = (
+        "\n## Consolidation (make bench-consolidate)\n\n"
+        "Batched drain-candidate evaluation (`service.consolidate`: one "
+        "device dispatch for every candidate in a shape bucket) vs. the "
+        "same masked bin-packs submitted sequentially through the "
+        "service.\n\n"
+        "| Date | Backend | Config | Batched p50 (ms) | Sequential p50 "
+        "(ms) | Batched cand/s | Sequential cand/s | Speedup |\n"
+        "|---|---|---|---|---|---|---|---|\n"
+    )
+    date = datetime.date.today().isoformat()
+    row = (
+        f"| {date} | {record['backend']} | {record['config']} "
+        f"| {record['batched_p50_ms']} | {record['sequential_p50_ms']} "
+        f"| {record['batched_cps']} | {record['sequential_cps']} "
+        f"| {record['speedup']}x |\n"
+    )
+    with open(path) as f:
+        content = f.read()
+    if "## Consolidation (make bench-consolidate)" not in content:
+        content = content.rstrip("\n") + "\n" + header
+    with open(path, "w") as f:
+        f.write(content.rstrip("\n") + "\n" + row)
+    print(f"appended row to {path}", file=sys.stderr)
+
+
+def _warm_and_check_consolidate(svc, inputs, args) -> int:
+    """Warm both submission paths' compiles outside the timed region and
+    assert their verdicts agree; returns the drainable count."""
+    from karpenter_tpu.consolidation import drainable
+
+    batched_out = svc.consolidate(inputs, buckets=args.buckets)
+    sequential_out = [
+        svc.solve(x, buckets=args.buckets) for x in inputs
+    ]
+    mismatch = sum(
+        drainable(a) != drainable(b)
+        for a, b in zip(batched_out, sequential_out)
+    )
+    if mismatch:
+        raise AssertionError(
+            f"{mismatch} verdict(s) differ between batched and "
+            "sequential paths"
+        )
+    return sum(drainable(o) for o in batched_out)
+
+
+def run_consolidate(args, metric: str, note: str) -> None:
+    """Batched vs sequential candidate evaluation: the consolidation
+    acceptance claim. Both paths run the IDENTICAL masked per-candidate
+    bin-packs through the shared solve service; only the submission
+    shape differs — one atomic `consolidate` batch (one dispatch per
+    shape bucket) vs. one `solve` at a time (one dispatch each)."""
+    import jax
+
+    from karpenter_tpu.consolidation import (
+        build_problems,
+        cluster_view,
+        drainable,
+    )
+    from karpenter_tpu.solver import SolverService
+
+    print(
+        f"backend={jax.default_backend()} devices={jax.devices()}",
+        file=sys.stderr,
+    )
+    store = build_consolidation_cluster(
+        args.candidates, args.pods, args.seed
+    )
+    view = cluster_view(store)
+    solved, inputs, trivial = build_problems(
+        view, [nv.name for nv in view.nodes]
+    )
+    print(
+        f"candidates: {len(solved)} solved + {len(trivial)} empty",
+        file=sys.stderr,
+    )
+    backend = args.backend
+    svc = SolverService(window_s=0.002, max_batch=8, backend=backend)
+    try:
+        n_drainable = _warm_and_check_consolidate(svc, inputs, args)
+        batched_times, sequential_times = [], []
+        for _ in range(args.iters):
+            t0 = time.perf_counter()
+            svc.consolidate(inputs, buckets=args.buckets)
+            batched_times.append((time.perf_counter() - t0) * 1e3)
+        for _ in range(args.iters):
+            t0 = time.perf_counter()
+            for x in inputs:
+                svc.solve(x, buckets=args.buckets)
+            sequential_times.append((time.perf_counter() - t0) * 1e3)
+        record = _consolidate_record(
+            args, jax.default_backend(), batched_times,
+            sequential_times, len(solved), n_drainable, svc,
+        )
+    finally:
+        svc.close()
+    record_evidence(
+        batched_iter_ms=[round(t, 4) for t in batched_times],
+        sequential_iter_ms=[round(t, 4) for t in sequential_times],
+        consolidate=record,
+        transport_floor=measure_transport_floor(),
+    )
+    print(
+        f"batched p50={record['batched_p50_ms']}ms "
+        f"({record['batched_cps']} cand/s) | sequential "
+        f"p50={record['sequential_p50_ms']}ms "
+        f"({record['sequential_cps']} cand/s) | "
+        f"speedup={record['speedup']}x "
+        f"drainable={record['drainable']}/{record['candidates']}",
+        file=sys.stderr,
+    )
+    if args.publish_baseline:
+        _publish_consolidate_baseline(record)
+    if args.append_benchmarks:
+        _append_consolidate_row(args.append_benchmarks, record)
+    extra = (
+        f"{record['batched_cps']} vs {record['sequential_cps']} "
+        f"candidates/sec batched vs sequential "
+        f"({record['speedup']}x); {record['drainable']}/"
+        f"{record['candidates']} drainable"
+    )
+    emit(
+        f"{metric} ({jax.default_backend()})",
+        record["batched_p50_ms"],
+        note=f"{note}; {extra}" if note else extra,
+        against_baseline=False,
     )
 
 

@@ -120,6 +120,15 @@ def parse_args(argv=None) -> argparse.Namespace:
         "([{name, allocatable, labels, taints}]) appended to the solve; "
         "the report then includes baseline vs what-if and the delta",
     )
+    parser.add_argument(
+        "--consolidate",
+        action="store_true",
+        help="enable the consolidation engine (batched node-drain "
+        "planning + cordon/verify/drain actuation; "
+        "docs/consolidation.md). With --simulate: print the dry-run "
+        "drain plan instead of the pending-pods report and exit "
+        "without mutating anything",
+    )
     return parser.parse_args(argv)
 
 
@@ -165,7 +174,13 @@ def _run_simulation(args, store) -> int:
     # empty groups with a nodeGroupRef would simulate as infeasible
     resolver = runtime.producer_factory.template_resolver()
     try:
-        if what_if is not None:
+        if args.consolidate:
+            from karpenter_tpu.simulate import simulate_consolidation
+
+            report = simulate_consolidation(
+                runtime.store, service=runtime.solver_service
+            )
+        elif what_if is not None:
             report = simulate_delta(
                 runtime.store, what_if, solver=solver,
                 template_resolver=resolver,
@@ -293,6 +308,7 @@ def main(argv=None) -> int:
             solver_uri=args.solver_uri,
             data_dir=args.data_dir,
             verbose=args.verbose,
+            consolidate=args.consolidate,
         ),
         store=store,
     )

@@ -4,6 +4,14 @@ reference: pkg/controllers/scalablenodegroup/v1alpha1/controller.go:48-95 —
 stabilization check, observe replicas into status, set replicas when spec
 diverges; retryable provider errors mark AbleToScale false WITHOUT
 deactivating the resource (the next loop will likely succeed).
+
+Consolidation (karpenter_tpu/consolidation) rides this controller: the
+engine plans drains on this controller's cadence (`maybe_plan`), expresses
+an approved drain as a spec.replicas decrement through the scale
+subresource, and this controller's ordinary spec-vs-observed loop performs
+the provider call — consolidation never bypasses the one actuation door.
+When the scale-down lands, the engine is told (`on_scale_down`) so it can
+finalize the drained nodes.
 """
 
 from __future__ import annotations
@@ -15,8 +23,11 @@ from karpenter_tpu.utils.log import logger
 
 
 class ScalableNodeGroupController:
-    def __init__(self, cloud_provider_factory):
+    def __init__(self, cloud_provider_factory, consolidator=None):
         self.cloud_provider = cloud_provider_factory
+        # ConsolidationEngine (or None): planning is bounded by the
+        # engine's own interval, so calling it every reconcile is cheap
+        self.consolidator = consolidator
 
     def kind(self) -> str:
         return ScalableNodeGroup.KIND
@@ -25,6 +36,12 @@ class ScalableNodeGroupController:
         return 60.0
 
     def _reconcile(self, resource) -> None:
+        if self.consolidator is not None:
+            # plan before observing: an approved drain decrements
+            # spec.replicas via the scale subresource, and the resulting
+            # watch event requeues this resource immediately — the
+            # actuation lands on the very next tick
+            self.consolidator.maybe_plan()
         node_group = self.cloud_provider.node_group_for(resource.spec)
         mgr = resource.status_conditions()
 
@@ -59,6 +76,37 @@ class ScalableNodeGroupController:
             observed,
             resource.spec.replicas,
         )
+        if resource.spec.replicas < observed:
+            self._finish_scale_down(
+                resource, mgr, observed, stable, message
+            )
+
+    def _finish_scale_down(
+        self, resource, mgr, observed: int, stable: bool, message: str
+    ) -> None:
+        """Post-actuation bookkeeping for a shrink: let the consolidation
+        engine finalize any drains this scale-down carries, and surface a
+        disruption-under-instability as a STRUCTURED condition (reason +
+        transition timestamp) on the API object, not just a log line —
+        operators watching the resource see WHY a shrinking group moved
+        while unconverged."""
+        drained = []
+        if self.consolidator is not None:
+            drained = self.consolidator.on_scale_down(
+                resource.metadata.namespace,
+                resource.metadata.name,
+                observed - resource.spec.replicas,
+            )
+        if not stable:
+            detail = (
+                f"scale-down {observed}->{resource.spec.replicas} "
+                f"actuated while unstable: {message}"
+            )
+            if drained:
+                detail += f" (consolidation drained {', '.join(drained)})"
+            mgr.mark_false(
+                cond.STABILIZED, "ScaleDownWhileUnstable", detail
+            )
 
     def reconcile(self, resource) -> None:
         mgr = resource.status_conditions()

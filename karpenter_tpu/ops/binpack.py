@@ -177,9 +177,23 @@ def _shelf_bfd(histogram: jax.Array, buckets: int) -> jax.Array:
 
         # repeatedly fill existing bins; each pass places one item per
         # available bin (smallest sufficient rem first), remnants re-enter at
-        # rem-k and may take another item next pass — cap passes at B
-        def body(i, state):
-            bins_i, c_i = state
+        # rem-k and may take another item next pass — capped at B passes,
+        # exiting EARLY once nothing is left or a pass makes no progress
+        # (both make every further pass a no-op: place=0 leaves bins and c
+        # untouched, so the early exit is bit-exact vs. running out the
+        # cap; the usual 1-2 productive passes are what actually run,
+        # which is the difference between O(B^2) and ~O(B) lax steps per
+        # solve)
+        def fill_cond(state):
+            i, _, c_i, placed = state
+            return (
+                (i < buckets)
+                & jnp.any(c_i > 0)
+                & ((i == 0) | (placed > 0))
+            )
+
+        def fill_body(state):
+            i, bins_i, c_i, _ = state
             avail = jnp.where(
                 (rem_index[None, :] >= k) & (rem_index[None, :] > 0), bins_i, 0
             )
@@ -187,9 +201,12 @@ def _shelf_bfd(histogram: jax.Array, buckets: int) -> jax.Array:
             place = jnp.clip(c_i[:, None] - cum_before, 0, avail)
             bins_i = bins_i - place + jnp.roll(place, -k, axis=1)
             c_i = c_i - jnp.sum(place, axis=1)
-            return bins_i, c_i
+            return i + 1, bins_i, c_i, jnp.sum(place)
 
-        bins, c = lax.fori_loop(0, buckets, body, (bins, c))
+        _, bins, c, _ = lax.while_loop(
+            fill_cond, fill_body,
+            (jnp.int32(0), bins, c, jnp.int32(0)),
+        )
 
         # leftovers open fresh bins, floor(B/k) items per bin
         per_bin = buckets // k

@@ -37,6 +37,11 @@ class Options:
     # (sidecar/client.py); None = in-process device solve
     data_dir: Optional[str] = None  # WAL+snapshot dir; None = in-memory only
     verbose: bool = False
+    # opt-in consolidation engine (karpenter_tpu/consolidation): batched
+    # node-drain planning + cordon→verify→drain actuation through the
+    # ScalableNodeGroup controller. Off by default: draining nodes is a
+    # disruptive posture an operator must choose (--consolidate).
+    consolidate: bool = False
 
 
 class KarpenterRuntime:
@@ -104,6 +109,20 @@ class KarpenterRuntime:
             self.metrics_clients, self.store, clock=self.clock,
             decider=self.solver_service.decide,
         )
+        # consolidation engine (opt-in): plans batched node drains
+        # through the shared solve service and actuates them through the
+        # ScalableNodeGroup controller below; its karpenter_consolidation_*
+        # gauges land in THIS runtime's registry
+        self.consolidation = None
+        if options.consolidate:
+            from karpenter_tpu.consolidation import ConsolidationEngine
+
+            self.consolidation = ConsolidationEngine(
+                self.store,
+                solver_service=self.solver_service,
+                registry=self.registry,
+                clock=self.clock,
+            )
         # Registration order = in-tick evaluation order. Producers run first
         # so signals are fresh, then node groups observe, then the batched
         # autoscaler decides — one tick moves a signal end to end (the
@@ -114,7 +133,9 @@ class KarpenterRuntime:
             solver_service=self.solver_service,
         ).register(
             MetricsProducerController(self.producer_factory),
-            ScalableNodeGroupController(self.cloud_provider),
+            ScalableNodeGroupController(
+                self.cloud_provider, consolidator=self.consolidation
+            ),
             HorizontalAutoscalerController(
                 self.batch_autoscaler, solver_service=self.solver_service
             ),

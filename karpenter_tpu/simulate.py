@@ -227,6 +227,72 @@ def simulate(  # lint: allow-complexity — report assembly: one guard per optio
     }
 
 
+def simulate_consolidation(store, service=None, buckets: int = 32) -> dict:
+    """Dry-run consolidation plan: which nodes' pods would re-pack onto
+    the remainder of the cluster, and why the rest are ineligible.
+
+    The same candidate generation and batched masked bin-pack the
+    production engine runs (karpenter_tpu/consolidation), minus the
+    runtime-state safety gates — cooldown clocks and in-flight budgets
+    live in the long-running engine, so a fresh dry-run process reports
+    STRUCTURAL drainability and leaves pacing to the engine. Nothing is
+    cordoned, scaled, or otherwise mutated.
+
+    Report shape:
+      nodes: per node {group, pods, drainable | ineligible reason}
+      drainable: [node names]
+      candidates_evaluated: how many masked solves the batch carried
+    """
+    from karpenter_tpu.consolidation import (
+        DO_NOT_DISRUPT,
+        cluster_view,
+        discover_groups,
+        evaluate,
+    )
+
+    if service is None:
+        from karpenter_tpu.solver import default_service
+
+        service = default_service()
+
+    def node_entry(nv) -> dict:
+        entry: dict = {
+            "group": (
+                f"{nv.group[0]}/{nv.group[2]}"
+                if nv.group is not None and nv.group[2]
+                else None
+            ),
+            "pods": len(nv.pods),
+        }
+        if nv.group is None or not nv.group[2]:
+            entry["ineligible"] = "no nodeGroupRef to actuate"
+        elif not nv.receiver:
+            entry["ineligible"] = "not ready/schedulable"
+        elif nv.do_not_disrupt:
+            entry["ineligible"] = f"{DO_NOT_DISRUPT} annotation"
+        return entry
+
+    groups = discover_groups(store)
+    view = cluster_view(store, groups)
+    report: Dict[str, dict] = {
+        nv.name: node_entry(nv) for nv in view.nodes
+    }
+    candidates = [
+        name for name, entry in report.items()
+        if "ineligible" not in entry
+    ]
+    verdicts = evaluate(view, candidates, service, buckets=buckets)
+    for name, verdict in verdicts.items():
+        report[name]["drainable"] = verdict
+    return {
+        "nodes": report,
+        "drainable": sorted(
+            name for name, v in verdicts.items() if v
+        ),
+        "candidates_evaluated": len(candidates),
+    }
+
+
 def simulate_delta(
     store, what_if_groups: List[dict], solver=None, template_resolver=None
 ) -> dict:

@@ -102,6 +102,8 @@ class SolverStatistics:
     last_coalesce_factor: int = 0
     decide_calls: int = 0
     decide_errors: int = 0
+    consolidate_calls: int = 0
+    consolidate_candidates: int = 0
 
 
 @dataclass
@@ -118,6 +120,10 @@ class _Request:
     result: Optional[object] = None
     error: Optional[BaseException] = None
     abandoned: bool = False
+    # consolidate() batch marker: requests sharing an id were enqueued
+    # atomically and must ride ONE dispatch — _collect keeps draining the
+    # queue past max_batch while the head continues the same batch
+    coalesce_id: Optional[int] = None
 
     def finish(self, result=None, error=None) -> None:
         self.result = result
@@ -186,6 +192,7 @@ class SolverService:
         self._clock = clock
         self.stats = SolverStatistics()
         self._queue: collections.deque = collections.deque()
+        self._coalesce_seq = 0  # consolidate() batch-marker source
         self._cond = threading.Condition()
         self._worker: Optional[threading.Thread] = None
         self._closed = False
@@ -350,6 +357,132 @@ class SolverService:
             )
             return self._numpy_fallback(inputs, buckets)
 
+    def consolidate(
+        self,
+        inputs_list,
+        buckets: int = DEFAULT_BUCKETS,
+        backend: Optional[str] = None,
+        timeout: Optional[float] = None,
+    ) -> list:
+        """Batched candidate evaluation for the consolidation planner:
+        N masked bin-packs (one per drain candidate) submitted ATOMICALLY
+        and answered as a list in input order.
+
+        The requests ride the normal pipeline — same coalescing queue,
+        shape ladder, compile cache, numpy fallback — but carry a shared
+        batch marker, so the worker drains the whole set past `max_batch`
+        and same-bucket candidates ride ONE device dispatch (lax.map over
+        the stacked candidates). Candidate-count jitter only moves along
+        the batch ladder, so steady-state consolidation never recompiles.
+
+        Degradations match solve(): a full queue answers the overflow
+        candidates from numpy inline; a deadline expiry degrades (or
+        raises, per `on_timeout`) per candidate."""
+        if not inputs_list:
+            return []
+        if self._closed:
+            raise RuntimeError("solver service is closed")
+        self.stats.consolidate_calls += 1
+        self.stats.consolidate_candidates += len(inputs_list)
+        resolved = self._resolve_backend(backend)
+        timeout = self.default_timeout_s if timeout is None else timeout
+        requests = self._enqueue_batch(
+            inputs_list, buckets, resolved, timeout
+        )
+        return self._gather_batch(requests, inputs_list, buckets, timeout)
+
+    def _gather_batch(
+        self, requests, inputs_list, buckets: int, timeout
+    ) -> list:
+        """Wait out a consolidate() batch in input order; overflow slots
+        (None) and expired candidates degrade to numpy per solve()'s
+        semantics."""
+        results = []
+        for i, request in enumerate(requests):
+            if request is None:
+                logger().warning(
+                    "solver queue saturated; degrading one consolidation "
+                    "candidate to numpy"
+                )
+                results.append(
+                    self._numpy_fallback(inputs_list[i], buckets)
+                )
+                continue
+            try:
+                results.append(
+                    SolveFuture(request, self).result(
+                        timeout if timeout else None
+                    )
+                )
+            except SolverTimeout:
+                if self.on_timeout == "raise":
+                    # nobody will wait on the rest of the batch: flag
+                    # them abandoned so the worker skips them instead of
+                    # burning a full dispatch for dropped results
+                    for rest in requests[i + 1:]:
+                        if rest is not None:
+                            rest.abandoned = True
+                    raise
+                logger().warning(
+                    "consolidation candidate deadline expired; degrading "
+                    "to numpy"
+                )
+                results.append(
+                    self._numpy_fallback(inputs_list[i], buckets)
+                )
+        return results
+
+    def _enqueue_batch(
+        self, inputs_list, buckets: int, resolved: str, timeout
+    ) -> List[Optional[_Request]]:
+        """Enqueue a consolidate() batch atomically under one lock hold
+        (contiguous in the deque, shared coalesce_id). Overflow slots
+        come back as None, in order, for inline numpy degradation."""
+        now = self._clock()
+        requests: List[Optional[_Request]] = []
+        with self._cond:
+            self._coalesce_seq += 1
+            cid = self._coalesce_seq
+            for inputs in inputs_list:
+                if len(self._queue) >= self.max_queue:
+                    self.stats.rejected += 1
+                    self._c_rejected.inc("-", "-")
+                    requests.append(None)
+                    continue
+                request = _Request(
+                    inputs=inputs,
+                    buckets=buckets,
+                    backend=resolved,
+                    # 5th key element: consolidation batches vectorize
+                    # across candidates (jax.vmap) instead of scanning
+                    # (lax.map) — cluster-scale operands make the C×
+                    # memory amplification trivial, and vectorization
+                    # is where the batched >> sequential throughput
+                    # comes from. The distinct key keeps these groups
+                    # from mixing with plain solve() traffic compiled
+                    # for the memory-bounded scan.
+                    key=(
+                        bucket_shape(inputs),
+                        buckets,
+                        resolved,
+                        presence(inputs),
+                        "vmap",
+                    ),
+                    n_pods=inputs.pod_requests.shape[0],
+                    n_groups=inputs.group_allocatable.shape[0],
+                    deadline=(now + timeout) if timeout else None,
+                    enqueued_at=now,
+                    coalesce_id=cid,
+                )
+                self._queue.append(request)
+                self.stats.requests += 1
+                self._c_requests.inc("-", "-")
+                requests.append(request)
+            self._ensure_worker()
+            self._g_queue.set("-", "-", float(len(self._queue)))
+            self._cond.notify_all()
+        return requests
+
     def decide(self, inputs):
         """The HPA decision kernel through the service: same metrics
         surface and error accounting, no coalescing (the batch
@@ -423,8 +556,24 @@ class SolverService:
                 while self._queue and len(batch) < self.max_batch:
                     batch.append(self._queue.popleft())
         with self._cond:
+            self._drain_batch_tail(batch)
             self._g_queue.set("-", "-", float(len(self._queue)))
         return batch
+
+    def _drain_batch_tail(self, batch: List[_Request]) -> None:
+        """consolidate() batches are enqueued atomically (contiguous in
+        the deque) and must ride one dispatch: keep draining past
+        max_batch while the queue head continues a batch already
+        partially collected. Called under self._cond."""
+        taken = {
+            r.coalesce_id for r in batch if r.coalesce_id is not None
+        }
+        while (
+            self._queue
+            and self._queue[0].coalesce_id is not None
+            and self._queue[0].coalesce_id in taken
+        ):
+            batch.append(self._queue.popleft())
 
     def _dispatch_group(self, key: tuple, requests: List[_Request]) -> None:
         now = self._clock()
@@ -465,7 +614,7 @@ class SolverService:
                     request.finish(error=numpy_error)
 
     def _solve_group(self, key: tuple, live: List[_Request]) -> None:
-        shape, buckets, backend, _present = key
+        shape, buckets, backend = key[0], key[1], key[2]
         if backend == "numpy":
             # host program: no device dispatch, no padding (the sparse
             # numpy stages don't compile, so shape stability buys
@@ -494,7 +643,10 @@ class SolverService:
             # the single worker (bounded device pressure)
             self._solve_pallas(shape, buckets, live)
             return
-        self._solve_batched_xla(shape, buckets, live)
+        self._solve_batched_xla(
+            shape, buckets, live,
+            strategy=key[4] if len(key) > 4 else "map",
+        )
 
     def _solve_pallas(self, shape, buckets: int, live: List[_Request]) -> None:
         import jax
@@ -512,14 +664,22 @@ class SolverService:
             request.finish(result=self._crop_host(out, request))
 
     def _solve_batched_xla(
-        self, shape, buckets: int, live: List[_Request]
+        self, shape, buckets: int, live: List[_Request],
+        strategy: str = "map",
     ) -> None:
         """The coalesced path: pad each request to the shape bucket,
         stack along a new leading axis, pad the batch axis up its own
-        ladder, run ONE compiled lax.map program, scatter slices back.
-        The per-item program inside the scan is the same computation as
+        ladder, run ONE compiled program, scatter slices back.
+
+        strategy="map" (plain solve() traffic) scans the batch with
+        lax.map: the per-item program inside the scan is the same HLO as
         a direct binpack call on the same (padded) shapes, so outputs
-        match direct calls element for element."""
+        match direct calls element for element, and peak memory stays at
+        one item's working set (coalesced 100k-pod ticks must not pay a
+        batch× amplification). strategy="vmap" (consolidate() batches)
+        vectorizes across the batch instead — candidates are cluster-
+        scale operands, so the amplification is trivial and the batched
+        throughput gain is the whole point."""
         t0 = _time.perf_counter()
         padded = [pad_to_bucket(r.inputs, shape) for r in live]
         n_batch = bucket_up(len(padded), 1)
@@ -532,7 +692,7 @@ class SolverService:
         import jax
 
         fn = self._compiled_for(
-            ("xla", shape, n_batch, buckets, live[0].key[3])
+            ("xla", shape, n_batch, buckets, live[0].key[3], strategy)
         )
         t0 = _time.perf_counter()
         with solver_trace("solver.dispatch"):
@@ -566,11 +726,21 @@ class SolverService:
 
         from karpenter_tpu.ops import binpack as B
 
-        @partial(jax.jit, static_argnames=("buckets",))
-        def batched(stacked, buckets):
-            return lax.map(
-                lambda one: B.binpack(one, buckets=buckets), stacked
-            )
+        if cache_key[-1] == "vmap":
+
+            @partial(jax.jit, static_argnames=("buckets",))
+            def batched(stacked, buckets):
+                return jax.vmap(
+                    lambda one: B.binpack(one, buckets=buckets)
+                )(stacked)
+
+        else:
+
+            @partial(jax.jit, static_argnames=("buckets",))
+            def batched(stacked, buckets):
+                return lax.map(
+                    lambda one: B.binpack(one, buckets=buckets), stacked
+                )
 
         self._compiled[cache_key] = batched
         return batched

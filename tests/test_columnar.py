@@ -261,7 +261,7 @@ class TestReservationsCache:
 
     def test_matches_oracle_under_churn(self):
         from karpenter_tpu.metrics.producers.pendingcapacity import (
-            _group_profile,
+            group_profile,
         )
         from karpenter_tpu.store.columnar import (
             NodeMirror,
@@ -271,7 +271,7 @@ class TestReservationsCache:
         rng = np.random.default_rng(3)
         store = Store()
         cache = ReservationsCache(store)
-        mirror = NodeMirror(store, _group_profile)
+        mirror = NodeMirror(store, group_profile)
         store.create(node("n0", {"group": "small"}, cpu="16", mem="64Gi"))
         store.create(node("n1", {"group": "small"}, cpu="8", mem="32Gi"))
         live = {}
@@ -317,7 +317,7 @@ class TestReservationsCache:
         canonicalizes to the capacity side's format (order-stable), so both
         paths must render the SAME string."""
         from karpenter_tpu.metrics.producers.pendingcapacity import (
-            _group_profile,
+            group_profile,
         )
         from karpenter_tpu.store.columnar import (
             NodeMirror,
@@ -326,7 +326,7 @@ class TestReservationsCache:
 
         store = Store()
         cache = ReservationsCache(store)
-        mirror = NodeMirror(store, _group_profile)
+        mirror = NodeMirror(store, group_profile)
         store.create(node("n0", {"group": "small"}, cpu="16", mem="96Gi"))
         # same node, creation order ("z" first, decimal) opposite to the
         # oracle's sorted-key order ("a" first, binary): the cache's
@@ -341,7 +341,7 @@ class TestReservationsCache:
 
     def test_unready_nodes_excluded(self):
         from karpenter_tpu.metrics.producers.pendingcapacity import (
-            _group_profile,
+            group_profile,
         )
         from karpenter_tpu.store.columnar import (
             NodeMirror,
@@ -351,7 +351,7 @@ class TestReservationsCache:
 
         store = Store()
         cache = ReservationsCache(store)
-        mirror = NodeMirror(store, _group_profile)
+        mirror = NodeMirror(store, group_profile)
         store.create(node("ready", {"group": "small"}, cpu="8"))
         broken = node("broken", {"group": "small"}, cpu="8")
         broken.status.conditions = [
@@ -429,12 +429,12 @@ class TestEquivalence:
         """The full feed (pod arena + node-profile memo + producer index)
         must match the oracle after nodes and producers change too."""
         from karpenter_tpu.metrics.producers.pendingcapacity import (
-            _group_profile,
+            group_profile,
         )
         from karpenter_tpu.store.columnar import PendingFeed
 
         store = Store()
-        feed = PendingFeed(store, _group_profile)
+        feed = PendingFeed(store, group_profile)
         cache = PendingPodCache(store)
         self._cluster(store)
         for i in range(12):
@@ -452,14 +452,14 @@ class TestEquivalence:
 
     def test_equivalence_under_random_churn(self):
         from karpenter_tpu.metrics.producers.pendingcapacity import (
-            _group_profile,
+            group_profile,
         )
         from karpenter_tpu.store.columnar import PendingFeed
 
         rng = np.random.default_rng(7)
         store = Store()
         cache = PendingPodCache(store, capacity=16)
-        feed = PendingFeed(store, _group_profile)
+        feed = PendingFeed(store, group_profile)
         self._cluster(store)
         live = {}
         serial = 0
@@ -530,7 +530,7 @@ class TestSolveCaching:
         from karpenter_tpu.store.columnar import PendingFeed
 
         store = Store()
-        feed = PendingFeed(store, PC._group_profile)
+        feed = PendingFeed(store, PC.group_profile)
         store.create(node("n0", {"group": "g"}, cpu="8", mem="32Gi"))
         store.create(producer("mp", {"group": "g"}))
         for i in range(3):
@@ -601,7 +601,7 @@ class TestShapeDedup:
         snap = cache.snapshot()
         profiles = [({"cpu": 8.0, "memory": 64.0, "pods": 110.0},
                      set(), set())]
-        inputs = PC._encode_from_cache(snap, profiles)
+        inputs = PC.encode_snapshot(snap, profiles)
         weights = np.asarray(inputs.pod_weight)
         live = sorted(int(w) for w in weights[weights > 0])
         assert live == [1, 30, 50]  # 81 pods -> 3 weighted shape rows
@@ -680,12 +680,12 @@ class TestShapeDedup:
             NodeSelectorTerm,
         )
         from karpenter_tpu.metrics.producers.pendingcapacity import (
-            _group_profile,
+            group_profile,
         )
         from karpenter_tpu.store.columnar import PendingFeed
 
         store = Store()
-        feed = PendingFeed(store, _group_profile)
+        feed = PendingFeed(store, group_profile)
         cache = PendingPodCache(store)
         store.create(
             node("n0", {"group": "a", "disk": "hdd"}, cpu="8", mem="32Gi")
@@ -742,7 +742,7 @@ class TestShapeDedup:
             ({"cpu": 8.0, "memory": 32.0 * 1024**3, "pods": 110.0},
              {("group", "a"), ("disk", "hdd")}, set()),
         ]
-        inputs = PC._encode_from_cache(snap, profiles)
+        inputs = PC.encode_snapshot(snap, profiles)
         assert inputs.pod_group_forbidden is None
 
     def test_preferred_affinity_steers_assignment(self):
@@ -757,12 +757,12 @@ class TestShapeDedup:
             PreferredSchedulingTerm,
         )
         from karpenter_tpu.metrics.producers.pendingcapacity import (
-            _group_profile,
+            group_profile,
         )
         from karpenter_tpu.store.columnar import PendingFeed
 
         store = Store()
-        feed = PendingFeed(store, _group_profile)
+        feed = PendingFeed(store, group_profile)
         cache = PendingPodCache(store)
         store.create(
             node("n0", {"group": "a", "disk": "hdd"}, cpu="8", mem="32Gi")
@@ -861,12 +861,12 @@ class TestShapeDedup:
         the init size (k8s scheduler fit semantics), on BOTH the feed and
         the oracle path."""
         from karpenter_tpu.metrics.producers.pendingcapacity import (
-            _group_profile,
+            group_profile,
         )
         from karpenter_tpu.store.columnar import PendingFeed
 
         store = Store()
-        feed = PendingFeed(store, _group_profile)
+        feed = PendingFeed(store, group_profile)
         cache = PendingPodCache(store)
         store.create(node("n0", {"group": "g"}, cpu="8", mem="32Gi"))
         store.create(producer("mp", {"group": "g"}))
@@ -902,7 +902,7 @@ class TestShapeDedup:
         assert len(idx) == 0 and len(weights) == 0
         profiles = [({"cpu": 8.0, "memory": 64.0, "pods": 110.0},
                      set(), set())]
-        inputs = PC._encode_from_cache(snap, profiles)
+        inputs = PC.encode_snapshot(snap, profiles)
         from karpenter_tpu.ops import binpack as B
 
         out = B.binpack(inputs, buckets=16)
@@ -913,12 +913,12 @@ class TestShapeDedup:
         """The dedup must be output-invisible: feed path, pod-cache path,
         and oracle path still agree after heavy duplication + churn."""
         from karpenter_tpu.metrics.producers.pendingcapacity import (
-            _group_profile,
+            group_profile,
         )
         from karpenter_tpu.store.columnar import PendingFeed
 
         store = Store()
-        feed = PendingFeed(store, _group_profile)
+        feed = PendingFeed(store, group_profile)
         cache = PendingPodCache(store)
         store.create(node("n0", {"group": "small"}, cpu="8", mem="32Gi"))
         store.create(node("n1", {"group": "big"}, cpu="64", mem="256Gi"))

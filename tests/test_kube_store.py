@@ -343,7 +343,7 @@ class TestOccupancyOnKube:
         the ScheduledOccupancy adoption + watch contract certified
         against HTTP, not just the in-memory store."""
         from karpenter_tpu.metrics.producers.pendingcapacity import (
-            _group_profile,
+            group_profile,
             solve_pending,
         )
         from karpenter_tpu.metrics.registry import GaugeRegistry
@@ -426,7 +426,7 @@ class TestOccupancyOnKube:
         api.put_object("pods", pod_doc("db-live", bound_to="n-a"))
         api.put_object("pods", pod_doc("db-pending"))
 
-        feed = PendingFeed(kube, _group_profile)
+        feed = PendingFeed(kube, group_profile)
         assert wait_for(lambda: len(feed.pods) == 1)
         assert wait_for(lambda: feed.occupancy.generation >= 1)
         # each kind rides its own watch stream: synchronize on ALL the

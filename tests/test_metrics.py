@@ -157,7 +157,7 @@ class TestRuntimeSelfMetrics:
             PendingCapacitySpec,
         )
         from karpenter_tpu.metrics.producers.pendingcapacity import (
-            _group_profile,
+            group_profile,
             solve_pending,
         )
         from karpenter_tpu.store import Store
@@ -165,7 +165,7 @@ class TestRuntimeSelfMetrics:
         from karpenter_tpu.utils.quantity import Quantity
 
         store = Store()
-        feed = PendingFeed(store, _group_profile)
+        feed = PendingFeed(store, group_profile)
         store.create(
             Node(
                 metadata=ObjectMeta(name="n", labels={"g": "a"}),

@@ -972,7 +972,7 @@ class TestSpreadOccupancy:
         """Oracle, pod-cache, and feed paths must emit identical
         statuses when existing pods shape the split."""
         from karpenter_tpu.metrics.producers.pendingcapacity import (
-            _group_profile,
+            group_profile,
             solve_pending,
         )
         from karpenter_tpu.metrics.registry import GaugeRegistry
@@ -984,7 +984,7 @@ class TestSpreadOccupancy:
 
         store = Store()
         cache = PendingPodCache(store)
-        feed = PendingFeed(store, _group_profile)
+        feed = PendingFeed(store, group_profile)
         for z in ("a", "b"):
             store.create(
                 ready_node(f"n-{z}", {"group": z, ZONE_KEY: f"us-{z}"})
@@ -1424,7 +1424,7 @@ class TestSoftConstraintScoring:
 
     def test_all_encode_paths_agree_with_soft_scoring(self):
         from karpenter_tpu.metrics.producers.pendingcapacity import (
-            _group_profile,
+            group_profile,
             solve_pending,
         )
         from karpenter_tpu.metrics.registry import GaugeRegistry
@@ -1436,7 +1436,7 @@ class TestSoftConstraintScoring:
 
         store = Store()
         cache = PendingPodCache(store)
-        feed = PendingFeed(store, _group_profile)
+        feed = PendingFeed(store, group_profile)
         for z in ("a", "b"):
             store.create(
                 ready_node(f"n-{z}", {"group": z, ZONE_KEY: f"us-{z}"})
@@ -2070,13 +2070,13 @@ class TestEncodeMemoWithOccupancy:
 
     def test_unconstrained_fleet_ignores_bound_churn(self, counting_encode):
         from karpenter_tpu.metrics.producers.pendingcapacity import (
-            _group_profile,
+            group_profile,
         )
         from karpenter_tpu.store.columnar import PendingFeed
         from karpenter_tpu.store.store import Store
 
         store = Store()
-        feed = PendingFeed(store, _group_profile)
+        feed = PendingFeed(store, group_profile)
         store.create(ready_node("n1", {"group": "a"}))
         store.create(pending_mp("group-a", {"group": "a"}))
         store.create(
@@ -2094,14 +2094,14 @@ class TestEncodeMemoWithOccupancy:
     def test_census_refresh_counter_published(self):
         from karpenter_tpu.metrics.producers import pendingcapacity as PC
         from karpenter_tpu.metrics.producers.pendingcapacity import (
-            _group_profile,
+            group_profile,
         )
         from karpenter_tpu.metrics.registry import GaugeRegistry
         from karpenter_tpu.store.columnar import PendingFeed
         from karpenter_tpu.store.store import Store
 
         store = Store()
-        feed = PendingFeed(store, _group_profile)
+        feed = PendingFeed(store, group_profile)
         registry = GaugeRegistry()
         store.create(ready_node("n1", {"group": "a", ZONE_KEY: "us-a"}))
         store.create(pending_mp("group-a", {"group": "a"}))
@@ -2130,13 +2130,13 @@ class TestEncodeMemoWithOccupancy:
         self, counting_encode
     ):
         from karpenter_tpu.metrics.producers.pendingcapacity import (
-            _group_profile,
+            group_profile,
         )
         from karpenter_tpu.store.columnar import PendingFeed
         from karpenter_tpu.store.store import Store
 
         store = Store()
-        feed = PendingFeed(store, _group_profile)
+        feed = PendingFeed(store, group_profile)
         store.create(ready_node("n1", {"group": "a", ZONE_KEY: "us-a"}))
         store.create(pending_mp("group-a", {"group": "a"}))
         store.create(spread_pod("p0", {"app": "web"}))

@@ -754,7 +754,7 @@ class TestTopologySpread:
         same statuses for spread-constrained fleets (the same invariant
         tests/test_columnar.py holds for the unconstrained encode)."""
         from karpenter_tpu.metrics.producers.pendingcapacity import (
-            _group_profile,
+            group_profile,
             solve_pending,
         )
         from karpenter_tpu.metrics.registry import GaugeRegistry
@@ -763,7 +763,7 @@ class TestTopologySpread:
 
         store = Store()
         cache = PendingPodCache(store)
-        feed = PendingFeed(store, _group_profile)
+        feed = PendingFeed(store, group_profile)
         for z in ("a", "b"):
             store.create(
                 ready_node(f"n-{z}", {"group": z, ZONE_KEY: f"us-{z}"},
@@ -1150,7 +1150,7 @@ class TestSelfAntiAffinity:
         statuses for anti-affinity fleets (the spread/columnar
         invariant, extended to the new constraint)."""
         from karpenter_tpu.metrics.producers.pendingcapacity import (
-            _group_profile,
+            group_profile,
             solve_pending,
         )
         from karpenter_tpu.metrics.registry import GaugeRegistry
@@ -1159,7 +1159,7 @@ class TestSelfAntiAffinity:
 
         store = Store()
         cache = PendingPodCache(store)
-        feed = PendingFeed(store, _group_profile)
+        feed = PendingFeed(store, group_profile)
         for z in ("a", "b"):
             store.create(
                 ready_node(f"n-{z}", {"group": z, ZONE_KEY: f"us-{z}"},

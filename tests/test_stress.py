@@ -417,9 +417,9 @@ class TestPendingFeedUnderConcurrency:
         ]
         from karpenter_tpu.ops import binpack as B
 
-        got = B.binpack(PC._encode_from_cache(snap, profiles), buckets=8)
+        got = B.binpack(PC.encode_snapshot(snap, profiles), buckets=8)
         want = B.binpack(
-            PC._encode_from_cache(snapshot_from_pods(live), profiles),
+            PC.encode_snapshot(snapshot_from_pods(live), profiles),
             buckets=8,
         )
         np.testing.assert_array_equal(
