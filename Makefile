@@ -55,6 +55,11 @@ bench-solver: ## Direct vs coalesced solver-service p50/p99 (10k pods x 50 types
 		--backend xla --iters 10 \
 		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
 
+bench-hotpath: ## Idle-queue service vs direct p50 + per-stage breakdown (10k pods x 50 types); appends a BENCHMARKS row + publishes to BASELINE.json
+	$(PYTHON) bench.py --hotpath --pods 10000 --types 50 \
+		--backend xla --iters 10 \
+		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
+
 bench-consolidate: ## Batched vs sequential drain-candidate evaluation (32 candidates x 480 bound pods); appends a BENCHMARKS row + publishes to BASELINE.json
 	$(PYTHON) bench.py --consolidate --candidates 32 --pods 480 \
 		--backend xla --iters 10 \

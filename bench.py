@@ -421,7 +421,18 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
         "--concurrency",
         type=int,
         default=8,
-        help="with --solver-service: concurrent submitter threads",
+        help="with --solver-service/--hotpath: concurrent submitter "
+        "threads",
+    )
+    ap.add_argument(
+        "--hotpath",
+        action="store_true",
+        help="benchmark the solver-service HOT PATH: idle-queue "
+        "single-caller latency through the service vs a direct "
+        "ops/binpack call (the adaptive-window acceptance ratio), the "
+        "coalesce factor preserved under --concurrency concurrent "
+        "callers, and the per-stage breakdown (queue-wait / pad / "
+        "dispatch / scatter) from the service's latency rings",
     )
     ap.add_argument(
         "--consolidate",
@@ -520,6 +531,14 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
             "plain solver workload; it cannot combine with "
             "--mesh/--e2e/--decide/--clusters"
         )
+    if args.hotpath and (
+        args.mesh or args.e2e or args.decide or args.clusters
+        or args.solver_service or args.consolidate
+    ):
+        ap.error(
+            "--hotpath benchmarks the service hot path on the plain "
+            "solver workload; it cannot combine with other modes"
+        )
     if args.consolidate and (
         args.mesh or args.e2e or args.decide or args.clusters
         or args.solver_service
@@ -534,15 +553,22 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
     if args.concurrency < 1:
         ap.error("--concurrency must be >= 1")
     if (args.publish_baseline or args.append_benchmarks) and not (
-        args.solver_service or args.consolidate
+        args.solver_service or args.consolidate or args.hotpath
     ):
         ap.error(
             "--publish-baseline/--append-benchmarks only apply to "
-            "--solver-service/--consolidate (nothing would be published "
-            "otherwise)"
+            "--solver-service/--consolidate/--hotpath (nothing would be "
+            "published otherwise)"
         )
 
-    if args.solver_service:
+    if args.hotpath:
+        metric = (
+            f"solver-service idle-queue bin-pack p50 latency, "
+            f"{args.pods} pods x {args.types} instance types "
+            f"(vs direct; coalesce preserved at "
+            f"{args.concurrency} callers)"
+        )
+    elif args.solver_service:
         metric = (
             f"solver-service coalesced bin-pack p50 latency, {args.pods} "
             f"pods x {args.types} instance types, {args.concurrency} "
@@ -650,6 +676,9 @@ def run(args, metric: str, note: str) -> None:
 
     _warm_native_kernel(args)
 
+    if args.hotpath:
+        run_hotpath(args, metric, note)
+        return
     if args.solver_service:
         run_solver_service(args, metric, note)
         return
@@ -885,6 +914,195 @@ def run_solver_service(args, metric: str, note: str) -> None:
         f"{metric} ({jax.default_backend()})",
         record["service_p50_ms"],
         note=f"{note}; {extra}" if note else extra,
+    )
+
+
+def _hotpath_record(args, backend, direct_idle, service_idle,
+                    service_conc, svc) -> dict:
+    """The hotpath evidence record: idle-queue service-vs-direct (the
+    acceptance ratio), the concurrent coalesce factor (must be
+    preserved), and the per-stage breakdown — queue-wait, pad
+    (the service-side encode), dispatch, scatter (the crop)."""
+    direct_p50 = float(np.percentile(direct_idle, 50))
+    service_p50 = float(np.percentile(service_idle, 50))
+    reqs = max(1, svc.stats.requests)
+    return {
+        "config": f"{args.pods} pods x {args.types} types",
+        "backend": backend,
+        "concurrency": args.concurrency,
+        "direct_idle_p50_ms": round(direct_p50, 3),
+        "direct_idle_p99_ms": round(
+            float(np.percentile(direct_idle, 99)), 3
+        ),
+        "service_idle_p50_ms": round(service_p50, 3),
+        "service_idle_p99_ms": round(
+            float(np.percentile(service_idle, 99)), 3
+        ),
+        "idle_ratio": round(service_p50 / max(direct_p50, 1e-9), 3),
+        "service_concurrent_p50_ms": round(
+            float(np.percentile(service_conc, 50)), 3
+        ),
+        "avg_coalesce_factor": round(
+            reqs / max(1, svc.stats.dispatches), 2
+        ),
+        "dispatches": svc.stats.dispatches,
+        "requests": svc.stats.requests,
+        "compile_cache_misses": svc.stats.compile_cache_misses,
+        "immediate_dispatches": svc.stats.immediate_dispatches,
+        "pipeline_overlaps": svc.stats.pipeline_overlaps,
+        "stage_p50_ms": {
+            stage: p["p50_ms"]
+            for stage, p in svc.stage_percentiles().items()
+        },
+    }
+
+
+def _publish_hotpath_baseline(record: dict) -> None:
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE.json")
+    with open(path) as f:
+        baseline = json.load(f)
+    key = f"{record['config']} solver hotpath ({record['backend']})"
+    baseline.setdefault("published", {})[key] = {
+        k: v for k, v in record.items() if k != "config"
+    }
+    with open(path, "w") as f:
+        json.dump(baseline, f, indent=2)
+        f.write("\n")
+    print(f"published to BASELINE.json: {key}", file=sys.stderr)
+
+
+def _append_hotpath_row(path: str, record: dict) -> None:
+    header = (
+        "\n## Solver hot path (make bench-hotpath)\n\n"
+        "Idle-queue single-caller latency through the service vs a "
+        "direct `ops/binpack` call — the adaptive-window guard (the "
+        "ratio is the acceptance bound) — plus the coalesce factor "
+        "under concurrent load, which pipelined dispatch must "
+        "preserve. Stage columns are the service-side breakdown: "
+        "queue-wait, pad (encode), dispatch, scatter (crop).\n\n"
+        "| Date | Backend | Config | Direct idle p50 (ms) | Service "
+        "idle p50 (ms) | Ratio | Coalesce (concurrent) | queue-wait / "
+        "pad / dispatch / scatter p50 (ms) |\n"
+        "|---|---|---|---|---|---|---|---|\n"
+    )
+    stages = record["stage_p50_ms"]
+    breakdown = " / ".join(
+        str(stages.get(s, "-"))
+        for s in ("queue_wait", "pad", "dispatch", "scatter")
+    )
+    date = datetime.date.today().isoformat()
+    row = (
+        f"| {date} | {record['backend']} | {record['config']} "
+        f"| {record['direct_idle_p50_ms']} "
+        f"| {record['service_idle_p50_ms']} "
+        f"| {record['idle_ratio']}x "
+        f"| {record['avg_coalesce_factor']}x @ {record['concurrency']} "
+        f"| {breakdown} |\n"
+    )
+    with open(path) as f:
+        content = f.read()
+    if "## Solver hot path (make bench-hotpath)" not in content:
+        content = content.rstrip("\n") + "\n" + header
+    with open(path, "w") as f:
+        f.write(content.rstrip("\n") + "\n" + row)
+    print(f"appended row to {path}", file=sys.stderr)
+
+
+def run_hotpath(args, metric: str, note: str) -> None:
+    """The solver hot-path acceptance measurement: a LONE caller on an
+    idle queue must ride the service at direct-call latency (adaptive
+    window: no batching-timer tax), while a concurrent burst must still
+    coalesce. Per-stage p50s localize any regression to queue-wait /
+    pad / dispatch / scatter."""
+    import jax
+
+    from karpenter_tpu.ops.binpack import solve as direct_solve
+    from karpenter_tpu.solver import SolverService
+
+    print(
+        f"backend={jax.default_backend()} devices={jax.devices()}",
+        file=sys.stderr,
+    )
+    inputs_list = [
+        build_inputs(
+            args.pods, args.types, args.taints, args.labels,
+            args.seed + i, affinity=args.affinity, anti=args.anti,
+        )
+        for i in range(args.concurrency)
+    ]
+    single = inputs_list[0]
+
+    def direct(x):
+        jax.block_until_ready(
+            direct_solve(x, buckets=args.buckets, backend=args.backend)
+        )
+
+    svc = SolverService(
+        window_s=0.002, max_batch=args.concurrency, backend=args.backend
+    )
+
+    def through_service(x):
+        svc.solve(x, buckets=args.buckets)
+
+    try:
+        t0 = time.perf_counter()
+        direct(single)
+        _measure_concurrent(through_service, inputs_list, 1)  # warm
+        print(
+            f"warmup (compiles): {(time.perf_counter() - t0) * 1e3:.1f} ms",
+            file=sys.stderr,
+        )
+        direct_idle, service_idle = [], []
+        for _ in range(args.iters):
+            t0 = time.perf_counter()
+            direct(single)
+            direct_idle.append((time.perf_counter() - t0) * 1e3)
+        for _ in range(args.iters):
+            t0 = time.perf_counter()
+            through_service(single)
+            service_idle.append((time.perf_counter() - t0) * 1e3)
+        service_conc = _measure_concurrent(
+            through_service, inputs_list, args.iters
+        )
+        record = _hotpath_record(
+            args, jax.default_backend(), direct_idle, service_idle,
+            service_conc, svc,
+        )
+    finally:
+        svc.close()
+    record_evidence(
+        direct_idle_iter_ms=[round(t, 4) for t in direct_idle],
+        service_idle_iter_ms=[round(t, 4) for t in service_idle],
+        service_concurrent_iter_ms=[round(t, 4) for t in service_conc],
+        hotpath=record,
+        stage_percentiles=record["stage_p50_ms"],
+        transport_floor=measure_transport_floor(),
+    )
+    print(
+        f"idle: direct p50={record['direct_idle_p50_ms']}ms | service "
+        f"p50={record['service_idle_p50_ms']}ms "
+        f"(ratio {record['idle_ratio']}x) | concurrent service "
+        f"p50={record['service_concurrent_p50_ms']}ms "
+        f"coalesce={record['avg_coalesce_factor']}x | stages "
+        f"{record['stage_p50_ms']}",
+        file=sys.stderr,
+    )
+    if args.publish_baseline:
+        _publish_hotpath_baseline(record)
+    if args.append_benchmarks:
+        _append_hotpath_row(args.append_benchmarks, record)
+    extra = (
+        f"direct idle p50={record['direct_idle_p50_ms']}ms (ratio "
+        f"{record['idle_ratio']}x); coalesce "
+        f"{record['avg_coalesce_factor']}x under {args.concurrency} "
+        f"callers; stages(ms) {record['stage_p50_ms']}"
+    )
+    emit(
+        f"{metric} ({jax.default_backend()})",
+        record["service_idle_p50_ms"],
+        note=f"{note}; {extra}" if note else extra,
+        against_baseline=False,
     )
 
 

@@ -43,9 +43,9 @@ from karpenter_tpu.store.columnar import (
     snapshot_from_pods,
 )
 
-from .anti import _expand_anti_rows  # noqa: F401 — compat re-export
-from .census import DomainCensus, _entry_census, _row_node_filter  # noqa: F401
-from .constants import (  # noqa: F401
+from . import encoder as _encoder
+from .census import DomainCensus
+from .constants import (  # noqa: F401 — public constants (gauge names, pads)
     ADDITIONAL_NODES_NEEDED,
     DEFAULT_PODS_PER_NODE,
     GROUP_PAD,
@@ -59,28 +59,30 @@ from .constants import (  # noqa: F401
     TAINT_PAD,
     UNSCHEDULABLE_PODS,
 )
-from .encoder import _dedup_rows, _group_arrays  # noqa: F401
-from .encoder import _encode_from_cache  # noqa: F401 — deprecated seam:
-# stays an eager module global because (a) internal solve paths resolve
-# it at call time and (b) tests monkeypatch it to count encodes; new
-# code uses encode_snapshot below
 from .encoder import _group_profile as _group_profile_impl
-from .exclusion import _anti_base_exclusion, _canonical_row_key, _co_pin, _total_order  # noqa: F401
-from .partition import _partition_chunks, _water_fill  # noqa: F401
-from .scoring import _score_rows  # noqa: F401
-from .spread import _entry_caps, _expand_spread_rows, _spread_state  # noqa: F401
+
+# NOTE: the pendingcapacity._* underscore re-exports (PR 1's deprecated
+# compat shims: _encode_from_cache, _dedup_rows, _group_profile, the
+# spread/anti/exclusion/partition helpers) are GONE — every in-repo
+# caller was migrated to the public names below or to the helpers'
+# home submodules (encoder, partition, ...). Test seams intercept
+# `encode_snapshot`, which every internal solve path resolves at call
+# time through this module's global namespace.
 
 
 def encode_snapshot(snap, profiles, with_rows: bool = False, census=None):
     """PUBLIC encoding API: store snapshot -> fixed-shape solver inputs.
 
-    The one encoder every solve path uses (encoder._encode_from_cache),
-    promoted for external callers — simulate, custom tooling — that
-    previously reached for the underscore name. Delegates through the
-    module-global `_encode_from_cache` so test seams that patch it still
-    intercept every path. See encoder.py for the full contract
-    (deduplicated weighted shape rows, spread/anti expansion, padding)."""
-    return _encode_from_cache(
+    The one encoder every solve path uses — runtime reconcile, HA
+    controller, consolidation, simulate, the oracle tests. Routes
+    through encoder._encode_from_cache, whose incremental delta layer
+    (encoder.SnapshotDeltaCache) reuses the last encode per (group-set,
+    resource-universe) key and splices pod add/remove/rebind deltas in
+    place of a full rebuild — output parity with a full re-encode is
+    bit-identical (pinned by tests/test_encoder_delta.py). See
+    encoder.py for the full contract (deduplicated weighted shape rows,
+    spread/anti expansion, padding)."""
+    return _encoder._encode_from_cache(
         snap, profiles, with_rows=with_rows, census=census
     )
 
@@ -91,27 +93,6 @@ def group_profile(nodes, selector):
     ready+schedulable nodes matching `selector` (encoder._group_profile,
     promoted like encode_snapshot)."""
     return _group_profile_impl(nodes, selector)
-
-
-def __getattr__(name: str):
-    # deprecated underscore import: `_group_profile` is served lazily so
-    # legacy importers keep working but see the deprecation; internal
-    # code and new callers use the public group_profile above
-    if name == "_group_profile":
-        import warnings
-
-        warnings.warn(
-            "importing _group_profile from "
-            "karpenter_tpu.metrics.producers.pendingcapacity is "
-            "deprecated; use group_profile (or encode_snapshot for "
-            "_encode_from_cache)",
-            DeprecationWarning,
-            stacklevel=2,
-        )
-        return _group_profile_impl
-    raise AttributeError(
-        f"module {__name__!r} has no attribute {name!r}"
-    )
 
 
 def register_gauges(registry: GaugeRegistry) -> None:
@@ -320,7 +301,7 @@ def solve_pending(
             targets, template_rows, registry, solver, errors,
         )
     else:
-        inputs = _encode_from_cache(snap, profiles, census=census)
+        inputs = encode_snapshot(snap, profiles, census=census)
         _dispatch_and_record(inputs, targets, registry, solver, errors)
     _publish_census(registry, census)
     return {
@@ -390,7 +371,7 @@ def _solve_feed_path(
         cached_outputs = memo[2]
         _count_cache(registry, "hit")
     else:
-        inputs = _encode_from_cache(snap, profiles, census=census)
+        inputs = encode_snapshot(snap, profiles, census=census)
         feed.encode_memo = (fingerprint, inputs, None)
         _count_cache(registry, "miss")
     host = _dispatch_and_record(

@@ -147,69 +147,74 @@ def _dedup_rows(snap):
         # state, not an error
         return np.zeros(0, np.intp), np.zeros(0, np.int32)
 
-    def row_bytes(idx):
-        # idx=slice(None) gives zero-copy views (the arrays are already
-        # contiguous); index arrays (the fast path's rep rows) gather
-        n = hi if isinstance(idx, slice) else len(idx)
-        parts = [
-            np.ascontiguousarray(snap.requests[idx])
-            .view(np.uint8)
-            .reshape(n, -1),
-            np.ascontiguousarray(snap.required[idx])
-            .view(np.uint8)
-            .reshape(n, -1),
-            np.ascontiguousarray(snap.shape_id[idx])
-            .view(np.uint8)
-            .reshape(n, -1),
-            snap.valid[idx].astype(np.uint8).reshape(n, 1),
-        ]
-        if snap.affinity_id is not None:
-            parts.append(
-                np.ascontiguousarray(snap.affinity_id[idx])
-                .view(np.uint8)
-                .reshape(n, -1)
-            )
-        if snap.preferred_id is not None:
-            parts.append(
-                np.ascontiguousarray(snap.preferred_id[idx])
-                .view(np.uint8)
-                .reshape(n, -1)
-            )
-        if snap.spread_id is not None:
-            parts.append(
-                np.ascontiguousarray(snap.spread_id[idx])
-                .view(np.uint8)
-                .reshape(n, -1)
-            )
-        if snap.anti_id is not None:
-            parts.append(
-                np.ascontiguousarray(snap.anti_id[idx])
-                .view(np.uint8)
-                .reshape(n, -1)
-            )
-        if snap.soft_spread_id is not None:
-            parts.append(
-                np.ascontiguousarray(snap.soft_spread_id[idx])
-                .view(np.uint8)
-                .reshape(n, -1)
-            )
-        if snap.soft_anti_id is not None:
-            parts.append(
-                np.ascontiguousarray(snap.soft_anti_id[idx])
-                .view(np.uint8)
-                .reshape(n, -1)
-            )
-        rows = np.ascontiguousarray(np.concatenate(parts, axis=1))
-        return rows.view([("k", np.void, rows.shape[1])]).ravel()
-
     if snap.dedup_idx is not None:
-        order = np.argsort(row_bytes(snap.dedup_idx))  # O(S log S), S tiny
+        # O(S log S), S tiny
+        order = np.argsort(_row_bytes(snap, snap.dedup_idx))
         return snap.dedup_idx[order], snap.dedup_weight[order]
 
     _, idx, counts = np.unique(
-        row_bytes(slice(None)), return_index=True, return_counts=True
+        _row_bytes(snap, slice(None)), return_index=True, return_counts=True
     )
     return idx, counts.astype(np.int32)
+
+
+def _row_bytes(snap, idx):
+    """Concatenated raw bytes of the given snapshot rows, one void scalar
+    per row — the canonical sort/uniqueness key of _dedup_rows."""
+    # idx=slice(None) gives zero-copy views (the arrays are already
+    # contiguous); index arrays (the fast path's rep rows) gather
+    hi = snap.requests.shape[0]
+    n = hi if isinstance(idx, slice) else len(idx)
+    parts = [
+        np.ascontiguousarray(snap.requests[idx])
+        .view(np.uint8)
+        .reshape(n, -1),
+        np.ascontiguousarray(snap.required[idx])
+        .view(np.uint8)
+        .reshape(n, -1),
+        np.ascontiguousarray(snap.shape_id[idx])
+        .view(np.uint8)
+        .reshape(n, -1),
+        snap.valid[idx].astype(np.uint8).reshape(n, 1),
+    ]
+    for ids in (
+        snap.affinity_id,
+        snap.preferred_id,
+        snap.spread_id,
+        snap.anti_id,
+        snap.soft_spread_id,
+        snap.soft_anti_id,
+    ):
+        if ids is not None:
+            parts.append(
+                np.ascontiguousarray(ids[idx]).view(np.uint8).reshape(n, -1)
+            )
+    rows = np.ascontiguousarray(np.concatenate(parts, axis=1))
+    return rows.view([("k", np.void, rows.shape[1])]).ravel()
+
+
+def _dedup_rows_keyed(snap):
+    """(row_idx, row_weight, keys): _dedup_rows plus the canonical sparse
+    dedup keys (store/columnar.PendingSnapshot.dedup_keys) reordered into
+    the same byte-sorted row order. keys is None when the snapshot lacks
+    the incremental dedup — the delta layer then has no stable identity
+    to diff on and falls back to a full encode."""
+    if (
+        snap.dedup_idx is None
+        or snap.dedup_keys is None
+        or snap.requests.shape[0] == 0
+        or len(snap.dedup_idx) == 0
+    ):
+        row_idx, row_weight = _dedup_rows(snap)
+        keys = (
+            ()
+            if snap.dedup_keys is not None and len(row_idx) == 0
+            else None
+        )
+        return row_idx, row_weight, keys
+    order = np.argsort(_row_bytes(snap, snap.dedup_idx))
+    keys = tuple(snap.dedup_keys[i] for i in order)
+    return snap.dedup_idx[order], snap.dedup_weight[order], keys
 
 
 
@@ -322,7 +327,7 @@ def _taint_universe(profiles) -> Dict[tuple, int]:
     return universe
 
 
-def _encode_from_cache(snap, profiles, with_rows: bool = False, census=None):
+def _encode_full(snap, profiles, with_rows: bool = False, census=None):
     """Snapshot (store/columnar.PendingSnapshot) -> solver inputs, with
     rows DEDUPLICATED into distinct pod shapes + multiplicities
     (pod_weight) — see _dedup_rows. Every solve path (feed, pod_cache,
@@ -437,5 +442,268 @@ def _encode_from_cache(snap, profiles, with_rows: bool = False, census=None):
         # slot) with multiplicity row_weight[i]
         return inputs, row_idx, row_weight
     return inputs
+
+
+# -- incremental (delta) encoding --------------------------------------------
+
+
+class _DeltaEntry:
+    """One cached encode per (group-set, universe) key: the canonical
+    sorted dedup keys, their row positions, the operand arrays those
+    positions index, and the BinPackInputs built from them. Arrays are
+    never mutated after construction — a delta builds NEW arrays and
+    splices cached rows across, so inputs objects handed to callers (and
+    any identity-keyed device cache holding them) stay frozen."""
+
+    __slots__ = (
+        "profiles", "resources", "resource_index", "pod_slot",
+        "taint_universe", "keys", "pos", "row_weight",
+        "n_pods", "n_resources", "n_taints", "n_labels",
+        "inputs",
+    )
+
+    def __init__(self, keys, row_weight, n_pods, inputs):
+        self.keys = keys
+        self.pos = {key: i for i, key in enumerate(keys)}
+        self.row_weight = np.asarray(row_weight)
+        self.n_pods = n_pods
+        self.inputs = inputs
+
+    def successor(self, keys, row_weight, n_pods, inputs) -> "_DeltaEntry":
+        """Next-tick entry sharing every universe-derived field (equal
+        by the eligibility checks) — ONE construction path, so a field
+        added to the entry can't be populated on the cold path only."""
+        entry = _DeltaEntry(keys, row_weight, n_pods, inputs)
+        entry.profiles = self.profiles
+        entry.resources = self.resources
+        entry.resource_index = self.resource_index
+        entry.pod_slot = self.pod_slot
+        entry.taint_universe = self.taint_universe
+        entry.n_resources = self.n_resources
+        entry.n_taints = self.n_taints
+        entry.n_labels = self.n_labels
+        return entry
+
+
+class SnapshotDeltaCache:
+    """Delta layer over _encode_full: caches the last encoded snapshot
+    per (group-set, resource-universe) key and answers the next tick by
+    splicing unchanged rows instead of rebuilding _pod_arrays /
+    _group_arrays from scratch.
+
+    Output parity is BIT-IDENTICAL to a full re-encode, by construction:
+
+      * rows are matched on the CANONICAL sparse dedup key
+        (store/columnar.PendingSnapshot.dedup_keys) — the identity that
+        survives slot reuse, universe growth, and arena compaction. With
+        equal resource/label universes and the same group profiles, the
+        same key encodes to the same operand row byte for byte, so a
+        copied row equals a recomputed one;
+      * row ORDER is the same byte-sort _dedup_rows canonicalizes, so
+        matched rows land at the positions a full encode would put them;
+      * fresh rows are produced by the SAME _pod_arrays code path on
+        just their subset, then scattered into position.
+
+    The fast path only engages for the unconstrained fleet (no live
+    affinity / spread / anti / soft-score rows, no census, no with_rows)
+    — everything else, and any universe or profile change, falls back to
+    _encode_full (which also refreshes the cache entry). Group profiles
+    are compared by IDENTITY: the runtime's NodeMirror memoizes profile
+    tuples, so unchanged nodes present the same objects every tick, and
+    a recomputed profile (node churn) invalidates naturally.
+
+    An unchanged dedup set returns the SAME BinPackInputs OBJECT, so
+    identity-keyed device-residency caches skip the host->device
+    transfer even when the pod set churned through identical shapes."""
+
+    _MAX_ENTRIES = 4  # distinct (group-set, universe) keys kept live
+
+    def __init__(self):
+        import collections
+        import threading
+
+        self._lock = threading.Lock()
+        self._entries = collections.OrderedDict()
+        # observability: identical-set hits, row-level deltas, full passes
+        self.hits = 0
+        self.deltas = 0
+        self.fulls = 0
+
+    def encode(self, snap, profiles, with_rows: bool = False, census=None):
+        if (
+            with_rows
+            or census is not None
+            # no incremental dedup (hand-built / oracle snapshots): bail
+            # BEFORE the keyed dedup pass, or a 100k-row snapshot would
+            # pay the O(N) np.unique row sort twice (here and inside
+            # _encode_full)
+            or snap.dedup_idx is None
+            or snap.dedup_keys is None
+        ):
+            self.fulls += 1
+            return _encode_full(
+                snap, profiles, with_rows=with_rows, census=census
+            )
+        row_idx, row_weight, keys = _dedup_rows_keyed(snap)
+        if keys is None or self._live_constraints(snap, row_idx):
+            self.fulls += 1
+            return _encode_full(snap, profiles, census=census)
+        cache_key = (
+            len(profiles),
+            tuple(snap.resources),
+            tuple(snap.labels),
+        )
+        with self._lock:
+            return self._encode_locked(
+                cache_key, snap, profiles, row_idx, row_weight, keys
+            )
+
+    def _encode_locked(
+        self, cache_key, snap, profiles, row_idx, row_weight, keys
+    ):
+        entry = self._entries.get(cache_key)
+        if entry is not None and self._entry_valid(entry, profiles):
+            n_pods = _pad(len(row_idx), POD_PAD)
+            if (
+                entry.keys == keys
+                and entry.n_pods == n_pods
+                and np.array_equal(entry.row_weight, row_weight)
+            ):
+                self.hits += 1
+                self._entries.move_to_end(cache_key)
+                return entry.inputs
+            self.deltas += 1
+            entry = self._apply_delta(
+                entry, snap, row_idx, row_weight, keys, n_pods
+            )
+        else:
+            self.fulls += 1
+            entry = self._build_entry(
+                snap, profiles, row_idx, row_weight, keys
+            )
+        self._entries[cache_key] = entry
+        self._entries.move_to_end(cache_key)
+        while len(self._entries) > self._MAX_ENTRIES:
+            self._entries.popitem(last=False)
+        return entry.inputs
+
+    @staticmethod
+    def _live_constraints(snap, row_idx) -> bool:
+        """Any live row carrying affinity/spread/anti/soft shapes routes
+        to the full encode (those operands need census + row expansion);
+        id 0 is always the unconstrained shape."""
+        if len(row_idx) == 0:
+            return False
+        for ids in (
+            snap.affinity_id,
+            snap.preferred_id,
+            snap.spread_id,
+            snap.anti_id,
+            snap.soft_spread_id,
+            snap.soft_anti_id,
+        ):
+            if ids is not None and bool((ids[row_idx] != 0).any()):
+                return True
+        return False
+
+    @staticmethod
+    def _entry_valid(entry, profiles) -> bool:
+        # identity, not equality: profile tuples are memoized upstream
+        # (NodeMirror), so pointer-equal means node state unchanged, and
+        # value comparison would cost what _group_arrays costs
+        return len(entry.profiles) == len(profiles) and all(
+            a is b for a, b in zip(entry.profiles, profiles)
+        )
+
+    def _build_entry(self, snap, profiles, row_idx, row_weight, keys):
+        """Cold path: one _encode_full pass, then index its output rows
+        by dedup key so the next tick can splice from them. The cached
+        inputs ARE the full encode's output — parity is definitional."""
+        inputs = _encode_full(snap, profiles)
+        entry = _DeltaEntry(
+            keys, row_weight, inputs.pod_requests.shape[0], inputs
+        )
+        entry.profiles = list(profiles)
+        entry.resources, entry.resource_index, entry.pod_slot = (
+            _resource_universe(snap, profiles)
+        )
+        entry.taint_universe = _taint_universe(profiles)
+        entry.n_resources = inputs.pod_requests.shape[1]
+        entry.n_taints = inputs.pod_intolerant.shape[1]
+        entry.n_labels = inputs.pod_required.shape[1]
+        return entry
+
+    def _apply_delta(self, entry, snap, row_idx, row_weight, keys, n_pods):
+        """Row-level splice: copy rows whose canonical key survived from
+        the cached arrays, gather only the fresh rows through the normal
+        _pod_arrays path, and reuse the group arrays untouched."""
+        hi = len(row_idx)
+        matched_new, matched_old, fresh_new = [], [], []
+        for i, key in enumerate(keys):
+            j = entry.pos.get(key)
+            if j is None:
+                fresh_new.append(i)
+            else:
+                matched_new.append(i)
+                matched_old.append(j)
+
+        pod_requests = np.zeros((n_pods, entry.n_resources), np.float32)
+        pod_valid = np.zeros(n_pods, bool)
+        pod_required = np.zeros((n_pods, entry.n_labels), bool)
+        pod_intolerant = np.zeros((n_pods, entry.n_taints), bool)
+        pod_weight = np.zeros(n_pods, np.int32)
+
+        old = entry.inputs
+        if matched_new:
+            m_new = np.asarray(matched_new, np.intp)
+            m_old = np.asarray(matched_old, np.intp)
+            pod_requests[m_new] = old.pod_requests[m_old]
+            pod_valid[m_new] = old.pod_valid[m_old]
+            pod_required[m_new] = old.pod_required[m_old]
+            pod_intolerant[m_new] = old.pod_intolerant[m_old]
+        if fresh_new:
+            f_new = np.asarray(fresh_new, np.intp)
+            sub = _pod_arrays(
+                snap,
+                row_idx[f_new],
+                row_weight[f_new],
+                entry.resources,
+                entry.resource_index,
+                entry.pod_slot,
+                len(fresh_new),
+                entry.n_resources,
+                entry.n_taints,
+                entry.n_labels,
+                entry.taint_universe,
+            )
+            pod_requests[f_new] = sub[0]
+            pod_valid[f_new] = sub[1]
+            pod_required[f_new] = sub[2]
+            pod_intolerant[f_new] = sub[3]
+        pod_weight[:hi] = row_weight
+
+        inputs = B.BinPackInputs(
+            pod_requests=pod_requests,
+            pod_valid=pod_valid,
+            pod_intolerant=pod_intolerant,
+            pod_required=pod_required,
+            group_allocatable=old.group_allocatable,
+            group_taints=old.group_taints,
+            group_labels=old.group_labels,
+            pod_weight=pod_weight,
+        )
+        return entry.successor(keys, row_weight, n_pods, inputs)
+
+
+_default_delta = SnapshotDeltaCache()
+
+
+def _encode_from_cache(snap, profiles, with_rows: bool = False, census=None):
+    """THE encode seam (public face: pendingcapacity.encode_snapshot):
+    delta-accelerated when the process-default SnapshotDeltaCache has a
+    matching entry, bit-identical to _encode_full always."""
+    return _default_delta.encode(
+        snap, profiles, with_rows=with_rows, census=census
+    )
 
 

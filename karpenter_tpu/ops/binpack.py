@@ -53,8 +53,8 @@ class BinPackInputs:
     (requests, required labels, tolerations) are interchangeable to every
     stage of the solve — same feasibility row, same first-feasible group,
     same bucket — so the encoder collapses them into one row with
-    `pod_weight` = multiplicity (producers/pendingcapacity.py
-    _encode_from_cache). That is what turns the 100k-pod snapshot into a
+    `pod_weight` = multiplicity (producers/pendingcapacity
+    encode_snapshot). That is what turns the 100k-pod snapshot into a
     few-hundred-row upload. pod_weight=None means every row counts once.
     """
 
@@ -71,7 +71,7 @@ class BinPackInputs:
     # Arbitrary boolean structure doesn't factor into the conjunctive
     # required-label bitset, so the host evaluates each DISTINCT affinity
     # shape against each group profile (S_a x T, both tiny) and gathers to
-    # rows (producers/pendingcapacity._encode_from_cache); rows are
+    # rows (producers/pendingcapacity.encode_snapshot); rows are
     # deduplicated shapes, so this stays KB-scale. None = no pod
     # constrains affinity (the common case costs nothing).
     pod_group_forbidden: Optional[jax.Array] = None

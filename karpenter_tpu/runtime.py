@@ -42,6 +42,12 @@ class Options:
     # ScalableNodeGroup controller. Off by default: draining nodes is a
     # disruptive posture an operator must choose (--consolidate).
     consolidate: bool = False
+    # solver hot-path tuning (docs/solver-service.md "Latency tuning"):
+    # the MAX coalescing window (adaptive: an idle queue dispatches
+    # immediately) and the in-flight dispatch cap (1 = double-buffered
+    # pipeline, 0 = serial)
+    solver_window_s: float = 0.002
+    solver_pipeline_depth: int = 1
 
 
 class KarpenterRuntime:
@@ -95,6 +101,8 @@ class KarpenterRuntime:
 
         self.solver_service = SolverService(
             registry=self.registry,
+            window_s=options.solver_window_s,
+            pipeline_depth=options.solver_pipeline_depth,
             device_solver=device_solver,
             decider=decider,
         )

@@ -9,11 +9,21 @@ production pipeline:
 
   submit → coalesce → pad → dispatch → scatter
 
-  * COALESCING QUEUE: requests arriving within a short window (default
-    2 ms) are gathered, grouped by compatibility key, and same-key
-    requests ride ONE batched device call (`lax.map` over the stacked
-    operands — the per-item program is the same HLO as a direct solve,
-    so results match a direct ops/binpack call element for element).
+  * ADAPTIVE COALESCING QUEUE: requests arriving within a gather window
+    are batched, grouped by compatibility key, and same-key requests
+    ride ONE batched device call (`lax.map` over the stacked operands —
+    the per-item program is the same HLO as a direct solve, so results
+    match a direct ops/binpack call element for element). The window is
+    LOAD-ADAPTIVE: an idle queue dispatches immediately (a lone
+    reconcile tick pays no batching-timer tax), and the window widens
+    to `window_s` only while recent traffic was actually concurrent
+    (backlog present, or the batch-size EWMA above the idle threshold).
+  * PIPELINED DISPATCH: the worker double-buffers device work — while
+    batch k computes on device, batch k+1 is gathered, padded, stacked,
+    and dispatched, and only then is batch k's host-side fetch/crop
+    paid. Steady-state dispatches stop paying the host round-trip in
+    line, and `donate_argnums` on the (device-put) stacked operands
+    lets XLA reuse batch buffers instead of reallocating per dispatch.
   * SHAPE BUCKETING + COMPILE CACHE: operands are padded up the
     power-of-two-ish ladder (solver/bucketing.py) and the compiled
     program is cached per (shape bucket, batch bucket, buckets,
@@ -74,8 +84,15 @@ REJECTED_TOTAL = "rejected_total"
 DEADLINE_EXPIRED_TOTAL = "deadline_expired_total"
 STAGE_P50_MS = "stage_p50_ms"
 STAGE_P99_MS = "stage_p99_ms"
+WINDOW_MS = "window_ms"
+PIPELINE_DEPTH = "pipeline_depth"
 
 _STAGE_WINDOW = 256  # per-stage latency ring size (fleet-scale constant)
+# Adaptive-window load tracking: EWMA of gathered batch sizes. Below the
+# threshold the queue is treated as idle (dispatch immediately); at or
+# above it the full window holds so concurrent bursts keep coalescing.
+_LOAD_ALPHA = 0.5
+_LOAD_IDLE = 1.5
 
 
 class SolverSaturated(RuntimeError):
@@ -100,6 +117,8 @@ class SolverStatistics:
     rejected: int = 0
     deadline_expired: int = 0
     last_coalesce_factor: int = 0
+    immediate_dispatches: int = 0  # idle-queue batches that skipped the window
+    pipeline_overlaps: int = 0  # dispatches issued while another was in flight
     decide_calls: int = 0
     decide_errors: int = 0
     consolidate_calls: int = 0
@@ -169,6 +188,8 @@ class SolverService:
         registry: Optional[GaugeRegistry] = None,
         *,
         window_s: float = 0.002,
+        adaptive_window: bool = True,
+        pipeline_depth: int = 1,
         max_queue: int = 64,
         max_batch: int = 8,
         default_timeout_s: float = 30.0,
@@ -181,7 +202,16 @@ class SolverService:
         if on_timeout not in ("fallback", "raise"):
             raise ValueError(f"on_timeout must be fallback|raise, got {on_timeout!r}")
         self.registry = registry if registry is not None else default_registry()
+        # window_s is now the MAX gather window: with adaptive_window an
+        # idle queue dispatches immediately and only concurrent traffic
+        # waits the window out; adaptive_window=False pins the fixed
+        # always-wait window (the pre-overhaul behavior)
         self.window_s = window_s
+        self.adaptive_window = adaptive_window
+        # how many dispatched-but-unfetched batches may be in flight (1 =
+        # double buffering: host scatter of batch k overlaps device
+        # compute of batch k+1); 0 disables pipelining
+        self.pipeline_depth = pipeline_depth
         self.max_queue = max_queue
         self.max_batch = max_batch
         self.default_timeout_s = default_timeout_s
@@ -201,6 +231,12 @@ class SolverService:
         self._compile_seen: set = set()
         self._stages: Dict[str, collections.deque] = {}
         self._stage_lock = threading.Lock()
+        # worker-only state: batch-size EWMA (adaptive window), in-flight
+        # dispatches (pipeline), and the gauges mirroring both
+        self._load = 0.0
+        self._window_now_s = 0.0 if adaptive_window else window_s
+        self._inflight: collections.deque = collections.deque()
+        self._last_pipeline_depth = 0
         self._register_metrics()
 
     # -- metrics ----------------------------------------------------------
@@ -220,6 +256,8 @@ class SolverService:
         )
         self._g_stage_p50 = reg(SUBSYSTEM, STAGE_P50_MS)
         self._g_stage_p99 = reg(SUBSYSTEM, STAGE_P99_MS)
+        self._g_window = reg(SUBSYSTEM, WINDOW_MS)
+        self._g_pipeline = reg(SUBSYSTEM, PIPELINE_DEPTH)
 
     def _record_stage(self, stage: str, seconds: float) -> None:
         ms = seconds * 1e3
@@ -240,6 +278,12 @@ class SolverService:
         self._g_coalesce.set(
             "-", "-", float(self.stats.last_coalesce_factor)
         )
+        # the EFFECTIVE window of the last gather (0 on an idle queue,
+        # window_s under concurrency) and the in-flight depth of the
+        # last dispatch — the two tuning signals docs/solver-service.md's
+        # latency section reads
+        self._g_window.set("-", "-", self._window_now_s * 1e3)
+        self._g_pipeline.set("-", "-", float(self._last_pipeline_depth))
         with self._stage_lock:
             snapshot = {k: list(v) for k, v in self._stages.items()}
         for stage, samples in snapshot.items():
@@ -264,6 +308,21 @@ class SolverService:
     def queue_depth(self) -> int:
         with self._cond:
             return len(self._queue)
+
+    def stage_percentiles(self) -> Dict[str, Dict[str, float]]:
+        """{stage: {"p50_ms", "p99_ms", "n"}} over the retained latency
+        rings — the per-stage breakdown bench.py --hotpath publishes."""
+        with self._stage_lock:
+            snapshot = {k: list(v) for k, v in self._stages.items()}
+        return {
+            stage: {
+                "p50_ms": round(float(np.percentile(samples, 50)), 4),
+                "p99_ms": round(float(np.percentile(samples, 99)), 4),
+                "n": len(samples),
+            }
+            for stage, samples in snapshot.items()
+            if samples
+        }
 
     # -- submission -------------------------------------------------------
 
@@ -526,39 +585,94 @@ class SolverService:
 
     def _run(self) -> None:
         while True:
-            batch = self._collect()
-            if batch is None:
-                return
+            if self._inflight:
+                # a dispatch is computing on device: gather the NEXT
+                # batch without blocking — if nothing is queued, the
+                # useful work left is fetching the in-flight results
+                batch = self._collect(block=False)
+                if batch is None:
+                    self._drain_inflight()
+                    return
+                if not batch:
+                    self._drain_one()
+                    continue
+            else:
+                batch = self._collect()
+                if batch is None:
+                    self._drain_inflight()
+                    return
             groups: Dict[tuple, List[_Request]] = {}
             for request in batch:
                 groups.setdefault(request.key, []).append(request)
             for key, requests in groups.items():
                 self._dispatch_group(key, requests)
+            if not self._queue:
+                # nothing else waiting: complete in-flight work now
+                # rather than holding a lone batch's results hostage to
+                # traffic that may never come
+                self._drain_inflight()
             self.publish_gauges()
 
-    def _collect(self) -> Optional[List[_Request]]:
-        """Block for the first request, then hold the coalescing window
-        open, gathering up to max_batch requests. None = closed+drained."""
+    def _collect(self, block: bool = True) -> Optional[List[_Request]]:
+        """Gather one batch: block for the first request (block=True),
+        then hold the ADAPTIVE coalescing window open, gathering up to
+        max_batch requests. The window is 0 — dispatch immediately —
+        when the queue was empty behind the first request and recent
+        batches were singletons; it widens to window_s while traffic is
+        concurrent. None = closed+drained; [] = block=False and idle."""
         with self._cond:
             while not self._queue:
                 if self._closed:
                     return None
+                if not block:
+                    return []
                 self._cond.wait()
             batch = [self._queue.popleft()]
-        window_end = self._clock() + self.window_s
-        while len(batch) < self.max_batch:
-            remaining = window_end - self._clock()
-            if remaining <= 0:
-                break
+            backlog = len(self._queue)
+        window = self._effective_window(backlog)
+        self._window_now_s = window
+        if window > 0:
+            self._gather_window(batch, window)
+        else:
+            self.stats.immediate_dispatches += 1
             with self._cond:
-                if not self._queue:
-                    self._cond.wait(timeout=remaining)
                 while self._queue and len(batch) < self.max_batch:
                     batch.append(self._queue.popleft())
         with self._cond:
             self._drain_batch_tail(batch)
             self._g_queue.set("-", "-", float(len(self._queue)))
+        # worker-only EWMA of observed concurrency: decays back to 1 a
+        # few idle batches after a burst, so steady singleton traffic
+        # keeps dispatching immediately
+        self._load = (
+            (1 - _LOAD_ALPHA) * self._load + _LOAD_ALPHA * len(batch)
+        )
         return batch
+
+    def _gather_window(self, batch: List[_Request], window: float) -> None:
+        """Hold the coalescing window open, folding arrivals into the
+        batch until it fills or the window closes."""
+        window_end = self._clock() + window
+        while len(batch) < self.max_batch:
+            remaining = window_end - self._clock()
+            if remaining <= 0:
+                return
+            with self._cond:
+                if not self._queue:
+                    self._cond.wait(timeout=remaining)
+                while self._queue and len(batch) < self.max_batch:
+                    batch.append(self._queue.popleft())
+
+    def _effective_window(self, backlog: int) -> float:
+        """The gather window for this batch: 0 (dispatch now) on an idle
+        queue, window_s while concurrency is observed — either directly
+        (requests already queued behind the head) or recently (the
+        batch-size EWMA is still above the idle threshold)."""
+        if not self.adaptive_window:
+            return self.window_s
+        if backlog > 0 or self._load >= _LOAD_IDLE:
+            return self.window_s
+        return 0.0
 
     def _drain_batch_tail(self, batch: List[_Request]) -> None:
         """consolidate() batches are enqueued atomically (contiguous in
@@ -619,7 +733,9 @@ class SolverService:
             # host program: no device dispatch, no padding (the sparse
             # numpy stages don't compile, so shape stability buys
             # nothing), and no fallback counting — this is the REQUESTED
-            # backend, not a degradation
+            # backend, not a degradation. Completes inline, so in-flight
+            # device work drains first to keep completion ordered.
+            self._drain_inflight()
             for request in live:
                 t0 = _time.perf_counter()
                 request.finish(
@@ -628,6 +744,7 @@ class SolverService:
                 self._record_stage("dispatch", _time.perf_counter() - t0)
             return
         if self.device_solver is not None:
+            self._drain_inflight()
             for request in live:
                 t0 = _time.perf_counter()
                 out = self.device_solver(
@@ -641,9 +758,10 @@ class SolverService:
             # the fused Mosaic kernel has no batched entry; requests
             # still share the bucketed shapes (compile stability) and
             # the single worker (bounded device pressure)
+            self._drain_inflight()
             self._solve_pallas(shape, buckets, live)
             return
-        self._solve_batched_xla(
+        self._begin_batched_xla(
             shape, buckets, live,
             strategy=key[4] if len(key) > 4 else "map",
         )
@@ -663,13 +781,17 @@ class SolverService:
             self._count_dispatch()
             request.finish(result=self._crop_host(out, request))
 
-    def _solve_batched_xla(
+    def _begin_batched_xla(
         self, shape, buckets: int, live: List[_Request],
         strategy: str = "map",
     ) -> None:
         """The coalesced path: pad each request to the shape bucket,
         stack along a new leading axis, pad the batch axis up its own
-        ladder, run ONE compiled program, scatter slices back.
+        ladder, dispatch ONE compiled program — and DON'T wait for it.
+        The dispatch joins the in-flight pipeline; its host-side fetch +
+        crop + scatter are paid by _drain_one, which the worker calls
+        after dispatching the NEXT batch (overlap) or when the queue
+        goes idle (no result is ever held hostage to future traffic).
 
         strategy="map" (plain solve() traffic) scans the batch with
         lax.map: the per-item program inside the scan is the same HLO as
@@ -679,7 +801,14 @@ class SolverService:
         batch× amplification). strategy="vmap" (consolidate() batches)
         vectorizes across the batch instead — candidates are cluster-
         scale operands, so the amplification is trivial and the batched
-        throughput gain is the whole point."""
+        throughput gain is the whole point.
+
+        The stacked operands are device_put FIRST and the compiled
+        program donates them (donate_argnums): on backends with real
+        donation support the batch buffers are reused instead of
+        reallocated every dispatch; where donation is unimplemented it
+        is a no-op with identical outputs (pinned by the donation-parity
+        test)."""
         t0 = _time.perf_counter()
         padded = [pad_to_bucket(r.inputs, shape) for r in live]
         n_batch = bucket_up(len(padded), 1)
@@ -692,28 +821,105 @@ class SolverService:
         import jax
 
         fn = self._compiled_for(
-            ("xla", shape, n_batch, buckets, live[0].key[3], strategy)
+            ("xla", shape, n_batch, buckets, live[0].key[3], strategy),
+            donate=self._donation_supported(),
         )
         t0 = _time.perf_counter()
         with solver_trace("solver.dispatch"):
+            stacked = jax.device_put(stacked)
             out = fn(stacked, buckets)
-            jax.block_until_ready(out)
-        self._record_stage("dispatch", _time.perf_counter() - t0)
+        if self._inflight:
+            self.stats.pipeline_overlaps += 1
+        self._inflight.append((out, live, t0))
+        self._last_pipeline_depth = len(self._inflight)
         self._count_dispatch()
+        # cap in-flight work at pipeline_depth, draining OLDEST first:
+        # with depth 1 this is classic double buffering (batch k's fetch
+        # is paid here, after batch k+1's dispatch); depth 0 restores
+        # the serial dispatch→wait→scatter loop
+        while len(self._inflight) > max(0, self.pipeline_depth):
+            self._drain_one()
 
-        t0 = _time.perf_counter()
-        host = _fetch_outputs(out)
-        for i, request in enumerate(live):
-            request.finish(result=self._crop_host(_index_outputs(host, i),
-                                                  request))
-        self._record_stage("scatter", _time.perf_counter() - t0)
+    def _drain_one(self) -> None:
+        """Complete the OLDEST in-flight dispatch: wait out the device,
+        fetch once, crop + scatter per request. Device-path failures
+        surface here (async dispatch defers them to the wait) and
+        degrade each request to numpy exactly like a sync failure.
+
+        Stage-metric caveat: under pipelining the "dispatch" sample is
+        dispatch-to-drain WALL time — it includes whatever gather/pad
+        work for the next batch overlapped the device compute, not pure
+        device time. On an idle queue (drain immediately follows
+        dispatch) it degenerates to the device latency; under load read
+        it as "time a batch spent in flight" (docs/solver-service.md
+        "Latency tuning")."""
+        if not self._inflight:
+            return
+        out, live, t_dispatch = self._inflight.popleft()
+        try:
+            import jax
+
+            jax.block_until_ready(out)
+            self._record_stage(
+                "dispatch", _time.perf_counter() - t_dispatch
+            )
+            t0 = _time.perf_counter()
+            host = _fetch_outputs(out)
+            for i, request in enumerate(live):
+                request.finish(
+                    result=self._crop_host(_index_outputs(host, i), request)
+                )
+            self._record_stage("scatter", _time.perf_counter() - t0)
+        except Exception as error:  # noqa: BLE001 — device failure path
+            logger().warning(
+                "solver device path failed in flight (%s: %s); degrading "
+                "%d request(s) to numpy",
+                type(error).__name__, error, len(live),
+            )
+            for request in live:
+                try:
+                    request.finish(
+                        result=self._numpy_fallback(
+                            request.inputs, request.buckets
+                        )
+                    )
+                except Exception as numpy_error:  # noqa: BLE001
+                    request.finish(error=numpy_error)
+
+    def _drain_inflight(self) -> None:
+        while self._inflight:
+            self._drain_one()
 
     def _crop_host(self, out, request: _Request):
         return crop_outputs(
             _fetch_outputs(out), request.n_pods, request.n_groups
         )
 
-    def _compiled_for(self, cache_key: tuple) -> Callable:
+    _donation_ok: Optional[bool] = None
+
+    def _donation_supported(self) -> bool:
+        """Donate only where the backend can actually alias donated
+        buffers (TPU/GPU); on CPU donation is a warning-per-executable
+        no-op, so the worker compiles the non-donating family there.
+        Outputs are identical either way — the donation-parity test
+        compiles BOTH families explicitly regardless of backend."""
+        if SolverService._donation_ok is None:
+            import jax
+
+            SolverService._donation_ok = jax.default_backend() in (
+                "tpu", "gpu", "cuda", "rocm"
+            )
+        return SolverService._donation_ok
+
+    def _compiled_for(self, cache_key: tuple, donate: bool = False) -> Callable:
+        """Compiled batched program for the cache key. donate=True marks
+        the stacked operand pytree donated (donate_argnums=0): the
+        worker device_puts the stack first, so backends with donation
+        support recycle the batch buffers instead of allocating fresh
+        ones every dispatch; outputs are identical either way (the
+        donation-parity test pins it). The flag is part of the cache key
+        so the two program families never alias."""
+        cache_key = (*cache_key, "donate" if donate else "keep")
         self._count_compile(cache_key)
         fn = self._compiled.get(cache_key)
         if fn is not None:
@@ -726,9 +932,14 @@ class SolverService:
 
         from karpenter_tpu.ops import binpack as B
 
-        if cache_key[-1] == "vmap":
+        jit = partial(
+            jax.jit,
+            static_argnames=("buckets",),
+            **({"donate_argnums": (0,)} if donate else {}),
+        )
+        if "vmap" in cache_key:
 
-            @partial(jax.jit, static_argnames=("buckets",))
+            @jit
             def batched(stacked, buckets):
                 return jax.vmap(
                     lambda one: B.binpack(one, buckets=buckets)
@@ -736,7 +947,7 @@ class SolverService:
 
         else:
 
-            @partial(jax.jit, static_argnames=("buckets",))
+            @jit
             def batched(stacked, buckets):
                 return lax.map(
                     lambda one: B.binpack(one, buckets=buckets), stacked

@@ -456,15 +456,21 @@ class PendingPodCache:
             ):
                 return self._snap_memo[1]
             hi = self._hi
+            # one items() walk so keys/reps/weights share the dict order:
+            # dedup_keys[i] is the CANONICAL sparse key of the shape that
+            # dedup_idx[i]/dedup_weight[i] describe — the stable identity
+            # the encoder's delta layer diffs consecutive snapshots on
+            # (slot ids and universe ids churn; the canonical key doesn't)
+            dedup_items = list(self._dedup_slots.items())
             reps = np.fromiter(
-                (next(iter(s)) for s in self._dedup_slots.values()),
+                (next(iter(s)) for _, s in dedup_items),
                 np.intp,
-                len(self._dedup_slots),
+                len(dedup_items),
             )
             weights = np.fromiter(
-                (len(s) for s in self._dedup_slots.values()),
+                (len(s) for _, s in dedup_items),
                 np.int32,
-                len(self._dedup_slots),
+                len(dedup_items),
             )
             snap = PendingSnapshot(
                 requests=self._requests[:hi, : len(self._resources)].copy(),
@@ -477,6 +483,7 @@ class PendingPodCache:
                 generation=self._generation,
                 dedup_idx=reps,
                 dedup_weight=weights,
+                dedup_keys=tuple(k for k, _ in dedup_items),
                 affinity_id=self._affinity_id[:hi].copy(),
                 affinity_shapes=list(self._affinity_shapes),
                 preferred_id=self._preferred_id[:hi].copy(),
@@ -959,6 +966,11 @@ class PendingSnapshot:                        # no 100k-row reprs in logs
     # canonicalizes order by row bytes
     dedup_idx: Optional[np.ndarray] = None
     dedup_weight: Optional[np.ndarray] = None
+    # canonical sparse dedup keys aligned with dedup_idx/dedup_weight:
+    # the shape identity that survives slot reuse, universe growth, and
+    # compaction — what the encoder's delta layer matches rows on across
+    # consecutive snapshots. None on hand-built snapshots.
+    dedup_keys: Optional[tuple] = None
     # required node affinity: per-row shape id into affinity_shapes
     # (canonical api/core.affinity_shape tuples; id 0 = unconstrained).
     # None on hand-built snapshots = no pod constrains affinity.

@@ -537,13 +537,13 @@ class TestSolveCaching:
             store.create(pod(f"p{i}"))
 
         calls = []
-        real = PC._encode_from_cache
+        real = PC.encode_snapshot
 
         def counting(*args, **kwargs):
             calls.append(1)
             return real(*args, **kwargs)
 
-        monkeypatch.setattr(PC, "_encode_from_cache", counting)
+        monkeypatch.setattr(PC, "encode_snapshot", counting)
         solves = []
         from karpenter_tpu.ops import binpack as B
 
@@ -621,7 +621,7 @@ class TestShapeDedup:
         content (row ORDER is canonicalized by byte-sort either way)."""
         import dataclasses
 
-        import karpenter_tpu.metrics.producers.pendingcapacity as PC
+        from karpenter_tpu.metrics.producers.pendingcapacity import encoder as PCE
 
         rng = np.random.default_rng(11)
         store = Store()
@@ -645,10 +645,10 @@ class TestShapeDedup:
                 store.update(pod(victim, cpu=str(rng.choice(cpus))))
         snap = cache.snapshot()
         assert snap.dedup_idx is not None
-        inc_idx, inc_w = PC._dedup_rows(snap)
+        inc_idx, inc_w = PCE._dedup_rows(snap)
         # force the np.unique fallback on the same snapshot content
         full = dataclasses.replace(snap, dedup_idx=None, dedup_weight=None)
-        uni_idx, uni_w = PC._dedup_rows(full)
+        uni_idx, uni_w = PCE._dedup_rows(full)
 
         def keyed(idx, weights, include_invalid):
             out = {}
@@ -889,6 +889,7 @@ class TestShapeDedup:
         freed arena rows with an EMPTY incremental dedup — the encode
         must yield the empty solve, not crash on a 0-row gather."""
         import karpenter_tpu.metrics.producers.pendingcapacity as PC
+        from karpenter_tpu.metrics.producers.pendingcapacity import encoder as PCE
 
         store = Store()
         cache = PendingPodCache(store)
@@ -898,7 +899,7 @@ class TestShapeDedup:
             store.delete("Pod", "default", f"p{i}")
         snap = cache.snapshot()
         assert snap.requests.shape[0] > 0 and len(snap.dedup_idx) == 0
-        idx, weights = PC._dedup_rows(snap)
+        idx, weights = PCE._dedup_rows(snap)
         assert len(idx) == 0 and len(weights) == 0
         profiles = [({"cpu": 8.0, "memory": 64.0, "pods": 110.0},
                      set(), set())]

@@ -1,0 +1,340 @@
+"""Incremental (delta) snapshot encoding — bit-identical parity with the
+full re-encode.
+
+The delta layer (pendingcapacity/encoder.SnapshotDeltaCache) caches the
+last encode per (group-set, resource-universe) key and splices pod
+add/remove/rebind deltas instead of rebuilding _pod_arrays/_group_arrays
+each tick. Its ONLY license to exist is exact equality: every property
+here pins delta-encoded inputs bitwise against encoder._encode_full on
+the same snapshot, across churn histories, universe growth, profile
+churn, and the constrained-fleet bailout — and pins the SOLVED outputs
+equal on both the device (xla) and numpy fallback paths.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api.core import (
+    Container,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    PodStatus,
+    Toleration,
+)
+from karpenter_tpu.metrics.producers.pendingcapacity import encoder
+from karpenter_tpu.metrics.producers.pendingcapacity.encoder import (
+    SnapshotDeltaCache,
+    _encode_full,
+)
+from karpenter_tpu.store import Store
+from karpenter_tpu.store.columnar import PendingPodCache
+from karpenter_tpu.utils.quantity import Quantity
+
+
+def pod(name, cpu="100m", mem="128Mi", node=None, selector=None,
+        tolerations=None, extra=None):
+    requests = {"cpu": Quantity.parse(cpu), "memory": Quantity.parse(mem)}
+    for r, v in (extra or {}).items():
+        requests[r] = Quantity.parse(v)
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=PodSpec(
+            node_name=node,
+            containers=[Container(requests=requests)],
+            node_selector=dict(selector or {}),
+            tolerations=list(tolerations or []),
+        ),
+        status=PodStatus(phase="Pending"),
+    )
+
+
+def make_profiles():
+    """Stable profile tuples — reused across ticks like NodeMirror's
+    memo, which is what arms the delta cache's identity check."""
+    return [
+        ({"cpu": 8.0, "memory": 32.0 * 1024**3, "pods": 110.0},
+         {("zone", "z"), ("group", "a")}, set()),
+        ({"cpu": 64.0, "memory": 256.0 * 1024**3, "pods": 110.0},
+         {("group", "b")},
+         {("dedicated", "infra", "NoSchedule")}),
+    ]
+
+
+def assert_inputs_identical(got, want):
+    """Bitwise equality over every BinPackInputs field, including the
+    None-ness of optional operands."""
+    for field in dataclasses.fields(want):
+        a = getattr(got, field.name)
+        b = getattr(want, field.name)
+        if b is None or a is None:
+            assert a is None and b is None, field.name
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=field.name
+        )
+
+
+def assert_outputs_equal(got, want):
+    for name in ("assigned", "assigned_count", "nodes_needed", "lp_bound"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got, name)),
+            np.asarray(getattr(want, name)),
+            err_msg=name,
+        )
+    assert int(got.unschedulable) == int(want.unschedulable)
+
+
+class TestDeltaParity:
+    def test_bitwise_identical_under_randomized_churn(self):
+        """Adds, removes, rebinds, shape mutations, slot reuse: after
+        EVERY mutation the delta encode equals a fresh full encode, and
+        the sequence actually exercises the delta/hit paths (no silent
+        always-full fallback)."""
+        rng = np.random.default_rng(3)
+        store = Store()
+        cache = PendingPodCache(store)
+        delta = SnapshotDeltaCache()
+        profiles = make_profiles()
+        cpus = ["100m", "250m", "1", "2"]
+        tol = [Toleration(key="dedicated", operator="Equal",
+                          value="infra", effect="NoSchedule")]
+        live = {}
+        for step in range(80):
+            op = rng.random()
+            if op < 0.45 or not live:
+                name = f"p{step}"
+                store.create(pod(
+                    name,
+                    cpu=str(rng.choice(cpus)),
+                    selector={"zone": "z"} if rng.random() < 0.3 else None,
+                    tolerations=tol if rng.random() < 0.2 else None,
+                ))
+                live[name] = True
+            elif op < 0.65:
+                victim = str(rng.choice(list(live)))
+                store.delete("Pod", "default", victim)
+                del live[victim]
+            elif op < 0.8:
+                # rebind: the pod schedules away (leaves the pending set)
+                victim = str(rng.choice(list(live)))
+                store.update(pod(victim, node="n1"))
+                del live[victim]
+            else:
+                victim = str(rng.choice(list(live)))
+                store.update(pod(victim, cpu=str(rng.choice(cpus))))
+            snap = cache.snapshot()
+            assert_inputs_identical(
+                delta.encode(snap, profiles),
+                _encode_full(snap, profiles),
+            )
+        assert delta.deltas > 0, "churn never took the delta path"
+        assert delta.fulls >= 1  # the cold build
+
+    def test_unchanged_and_identical_shape_churn_hit_identity(self):
+        """An unchanged dedup set — including a pod replaced by another
+        with the IDENTICAL spec — returns the SAME inputs object, so
+        identity-keyed device caches skip the re-upload."""
+        store = Store()
+        cache = PendingPodCache(store)
+        delta = SnapshotDeltaCache()
+        profiles = make_profiles()
+        for i in range(5):
+            store.create(pod(f"p{i}", cpu="2"))
+        first = delta.encode(cache.snapshot(), profiles)
+        # unchanged tick
+        assert delta.encode(cache.snapshot(), profiles) is first
+        # identical-shape churn: delete + recreate the same shape
+        store.delete("Pod", "default", "p0")
+        store.create(pod("replacement", cpu="2"))
+        snap = cache.snapshot()
+        assert snap.generation > 0
+        again = delta.encode(snap, profiles)
+        assert again is first
+        assert_inputs_identical(again, _encode_full(snap, profiles))
+        assert delta.hits >= 2
+
+    def test_universe_growth_invalidates_and_stays_exact(self):
+        """A new extended resource or selector label changes the cache
+        key (universe invalidation); encodes remain bit-identical
+        through the transition and after."""
+        store = Store()
+        cache = PendingPodCache(store)
+        delta = SnapshotDeltaCache()
+        profiles = make_profiles()
+        store.create(pod("a", cpu="1"))
+        delta.encode(cache.snapshot(), profiles)
+        store.create(pod("gpu", extra={"vendor.io/tpu": "4"}))
+        snap = cache.snapshot()
+        assert_inputs_identical(
+            delta.encode(snap, profiles), _encode_full(snap, profiles)
+        )
+        store.create(pod("picky", selector={"disk": "ssd"}))
+        snap = cache.snapshot()
+        assert_inputs_identical(
+            delta.encode(snap, profiles), _encode_full(snap, profiles)
+        )
+        # post-transition churn rides the (new) delta entry again
+        deltas_before = delta.deltas
+        store.create(pod("b", cpu="1"))
+        snap = cache.snapshot()
+        assert_inputs_identical(
+            delta.encode(snap, profiles), _encode_full(snap, profiles)
+        )
+        assert delta.deltas == deltas_before + 1
+
+    def test_profile_churn_invalidates(self):
+        """Fresh profile objects (node churn recomputes them) must miss
+        the identity check and rebuild — never serve stale group
+        arrays."""
+        store = Store()
+        cache = PendingPodCache(store)
+        delta = SnapshotDeltaCache()
+        store.create(pod("a", cpu="1"))
+        snap = cache.snapshot()
+        profiles = make_profiles()
+        first = delta.encode(snap, profiles)
+        grown = [
+            ({"cpu": 16.0, "memory": 64.0 * 1024**3, "pods": 110.0},
+             {("zone", "z"), ("group", "a")}, set()),
+            profiles[1],
+        ]
+        second = delta.encode(snap, grown)
+        assert second is not first
+        assert_inputs_identical(second, _encode_full(snap, grown))
+
+    def test_constrained_fleet_falls_back_to_full(self):
+        """Live affinity/spread/anti rows route to the full encoder —
+        the delta path never has to reproduce mask/score/expansion
+        semantics."""
+        from karpenter_tpu.api.core import (
+            Affinity,
+            NodeAffinity,
+            NodeSelector,
+            NodeSelectorRequirement,
+            NodeSelectorTerm,
+        )
+
+        store = Store()
+        cache = PendingPodCache(store)
+        delta = SnapshotDeltaCache()
+        profiles = make_profiles()
+        store.create(pod("plain", cpu="1"))
+        delta.encode(cache.snapshot(), profiles)
+        affinity = Affinity(
+            node_affinity=NodeAffinity(
+                required_during_scheduling_ignored_during_execution=(
+                    NodeSelector(
+                        node_selector_terms=[
+                            NodeSelectorTerm(
+                                match_expressions=[
+                                    NodeSelectorRequirement(
+                                        key="zone",
+                                        operator="In",
+                                        values=["z"],
+                                    )
+                                ]
+                            )
+                        ]
+                    )
+                )
+            )
+        )
+        constrained = pod("picky", cpu="1")
+        constrained.spec.affinity = affinity
+        store.create(constrained)
+        fulls_before = delta.fulls
+        snap = cache.snapshot()
+        got = delta.encode(snap, profiles)
+        assert delta.fulls == fulls_before + 1
+        want = _encode_full(snap, profiles)
+        assert want.pod_group_forbidden is not None  # constraint is live
+        assert_inputs_identical(got, want)
+
+    def test_drain_to_empty_and_refill(self):
+        store = Store()
+        cache = PendingPodCache(store)
+        delta = SnapshotDeltaCache()
+        profiles = make_profiles()
+        for i in range(4):
+            store.create(pod(f"p{i}"))
+        delta.encode(cache.snapshot(), profiles)
+        for i in range(4):
+            store.delete("Pod", "default", f"p{i}")
+        snap = cache.snapshot()
+        assert_inputs_identical(
+            delta.encode(snap, profiles), _encode_full(snap, profiles)
+        )
+        store.create(pod("fresh", cpu="4"))
+        snap = cache.snapshot()
+        assert_inputs_identical(
+            delta.encode(snap, profiles), _encode_full(snap, profiles)
+        )
+
+    def test_with_rows_and_census_bypass_the_cache(self):
+        store = Store()
+        cache = PendingPodCache(store)
+        delta = SnapshotDeltaCache()
+        profiles = make_profiles()
+        store.create(pod("a"))
+        snap = cache.snapshot()
+        inputs, row_idx, row_weight = delta.encode(
+            snap, profiles, with_rows=True
+        )
+        want, want_idx, want_w = _encode_full(
+            snap, profiles, with_rows=True
+        )
+        assert_inputs_identical(inputs, want)
+        np.testing.assert_array_equal(row_idx, want_idx)
+        np.testing.assert_array_equal(row_weight, want_w)
+
+
+class TestSolvedParity:
+    """Delta-encoded inputs must SOLVE identically to full-encoded ones
+    on both the device (xla) and numpy fallback paths — the encode is
+    upstream of every backend, so parity must survive the dispatch."""
+
+    @pytest.mark.parametrize("backend", ["xla", "numpy"])
+    def test_solved_outputs_equal(self, backend):
+        from karpenter_tpu.ops import binpack as B
+        from karpenter_tpu.ops.numpy_binpack import binpack_numpy
+
+        store = Store()
+        cache = PendingPodCache(store)
+        delta = SnapshotDeltaCache()
+        profiles = make_profiles()
+        rng = np.random.default_rng(5)
+        for i in range(20):
+            store.create(pod(f"p{i}", cpu=str(rng.choice(["1", "2"]))))
+        delta.encode(cache.snapshot(), profiles)  # cold entry
+        store.delete("Pod", "default", "p3")
+        store.create(pod("late", cpu="4"))
+        snap = cache.snapshot()
+        got = delta.encode(snap, profiles)
+        want = _encode_full(snap, profiles)
+        assert delta.deltas >= 1
+        solve = (
+            (lambda x: binpack_numpy(x, buckets=16))
+            if backend == "numpy"
+            else (lambda x: B.solve(x, buckets=16, backend="xla"))
+        )
+        assert_outputs_equal(solve(got), solve(want))
+
+
+class TestDefaultSeam:
+    def test_encode_snapshot_routes_through_default_delta(self):
+        """The public encode_snapshot rides the process-default delta
+        cache: two encodes of an unchanged snapshot return the same
+        object."""
+        from karpenter_tpu.metrics.producers import pendingcapacity as PC
+
+        store = Store()
+        cache = PendingPodCache(store)
+        store.create(pod("a", cpu="7"))  # distinctive shape
+        profiles = make_profiles()
+        snap = cache.snapshot()
+        first = PC.encode_snapshot(snap, profiles)
+        assert PC.encode_snapshot(snap, profiles) is first
+        assert encoder._default_delta.hits >= 1

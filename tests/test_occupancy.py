@@ -221,7 +221,7 @@ class TestWaterFill:
     def test_matches_scalar_oracle_totals(self):
         import numpy as np
 
-        from karpenter_tpu.metrics.producers.pendingcapacity import (
+        from karpenter_tpu.metrics.producers.pendingcapacity.partition import (
             _water_fill,
         )
 
@@ -258,7 +258,7 @@ class TestWaterFill:
         caps = m_out + skew - c (the frozen-outside-minimum rule)."""
         import numpy as np
 
-        from karpenter_tpu.metrics.producers.pendingcapacity import (
+        from karpenter_tpu.metrics.producers.pendingcapacity.partition import (
             _water_fill,
         )
 
@@ -2059,13 +2059,13 @@ class TestEncodeMemoWithOccupancy:
         from karpenter_tpu.metrics.producers import pendingcapacity as PC
 
         counter = [0]
-        real = PC._encode_from_cache
+        real = PC.encode_snapshot
 
         def counting(*args, **kwargs):
             counter[0] += 1
             return real(*args, **kwargs)
 
-        monkeypatch.setattr(PC, "_encode_from_cache", counting)
+        monkeypatch.setattr(PC, "encode_snapshot", counting)
         return counter
 
     def test_unconstrained_fleet_ignores_bound_churn(self, counting_encode):

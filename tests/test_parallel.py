@@ -189,6 +189,16 @@ def test_dryrun_fleet_step(n_devices):
     dryrun_fleet_step(n_devices)
 
 
+def test_graft_entry_dryrun_multichip_smoke():
+    """The driver-facing sharded-dispatch seam (__graft_entry__
+    .dryrun_multichip -> force_virtual_cpu -> dryrun_fleet_step) runs on
+    the 8-device CPU mesh — the exact composition the CI driver invokes,
+    so the hook can't rot independently of the mesh tests above."""
+    import __graft_entry__ as graft
+
+    graft.dryrun_multichip(8)
+
+
 def test_sharded_outputs_sliced_to_input_sizes():
     """Mesh padding must not leak: output shapes equal input P/T even when
     padding occurred (P=33->36, T=5->6 on a 4x2 mesh)."""

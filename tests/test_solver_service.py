@@ -318,6 +318,8 @@ class TestMetricsSurface:
                 "karpenter_solver_dispatch_total",
                 "karpenter_solver_compile_cache_misses_total",
                 "karpenter_solver_stage_p50_ms",
+                "karpenter_solver_window_ms",
+                "karpenter_solver_pipeline_depth",
             ):
                 assert series in text, series
         finally:
@@ -376,14 +378,17 @@ class TestMetricsSurface:
 
 
 class TestPublicEncodingAPI:
-    def test_encode_snapshot_matches_underscore_seam(self):
+    def test_encode_snapshot_matches_encoder_module(self):
         from karpenter_tpu.metrics.producers import pendingcapacity as PC
+        from karpenter_tpu.metrics.producers.pendingcapacity import (
+            encoder,
+        )
         from karpenter_tpu.store.columnar import snapshot_from_pods
 
         snap = snapshot_from_pods([])
         profiles = [({"cpu": 8.0, "pods": 110.0}, set(), set())]
         public = PC.encode_snapshot(snap, profiles)
-        private = PC._encode_from_cache(snap, profiles)
+        private = encoder._encode_full(snap, profiles)
         np.testing.assert_array_equal(
             public.group_allocatable, private.group_allocatable
         )
@@ -393,41 +398,89 @@ class TestPublicEncodingAPI:
 
         assert PC.group_profile([], {}) == ({}, set(), set())
 
-    def test_underscore_group_profile_import_warns(self):
+    def test_underscore_shims_are_gone(self):
+        """The deprecated PR-1 compat shims were removed: the package no
+        longer re-exports the private helpers (their home submodules do
+        — encoder, partition, spread, anti, exclusion)."""
         import importlib
 
         module = importlib.import_module(
             "karpenter_tpu.metrics.producers.pendingcapacity"
         )
-        with pytest.warns(DeprecationWarning):
-            deprecated = module._group_profile
-        assert deprecated([], {}) == ({}, set(), set())
+        for name in (
+            "_group_profile",
+            "_encode_from_cache",
+            "_dedup_rows",
+            "_group_arrays",
+            "_water_fill",
+            "_expand_spread_rows",
+            "_expand_anti_rows",
+        ):
+            with pytest.raises(AttributeError):
+                getattr(module, name)
 
     def test_encode_snapshot_honors_patched_seam(self, monkeypatch):
-        """encode_snapshot delegates through the module-global
-        `_encode_from_cache`, so existing test seams keep intercepting."""
+        """Internal solve paths resolve `encode_snapshot` through the
+        package namespace at call time, so patching it intercepts every
+        encode (the seam the encode-counting tests rely on)."""
         from karpenter_tpu.metrics.producers import pendingcapacity as PC
-        from karpenter_tpu.store.columnar import snapshot_from_pods
+        from karpenter_tpu.metrics.registry import GaugeRegistry
+        from karpenter_tpu.store.store import Store
 
         calls = []
-        real = PC._encode_from_cache
+        real = PC.encode_snapshot
 
         def counting(*args, **kwargs):
             calls.append(1)
             return real(*args, **kwargs)
 
-        monkeypatch.setattr(PC, "_encode_from_cache", counting)
-        PC.encode_snapshot(
-            snapshot_from_pods([]), [({"cpu": 1.0}, set(), set())]
+        monkeypatch.setattr(PC, "encode_snapshot", counting)
+        store = Store()
+        from karpenter_tpu.api.core import (
+            Container,
+            ObjectMeta,
+            Pod,
+            PodSpec,
         )
+        from karpenter_tpu.api.metricsproducer import (
+            MetricsProducer,
+            MetricsProducerSpec,
+            PendingCapacitySpec,
+        )
+        from karpenter_tpu.utils.quantity import Quantity
+
+        store.create(
+            MetricsProducer(
+                metadata=ObjectMeta(name="mp"),
+                spec=MetricsProducerSpec(
+                    pending_capacity=PendingCapacitySpec(
+                        node_selector={"g": "a"}
+                    )
+                ),
+            )
+        )
+        store.create(
+            Pod(
+                metadata=ObjectMeta(name="p0"),
+                spec=PodSpec(
+                    containers=[
+                        Container(requests={"cpu": Quantity.parse("1")})
+                    ]
+                ),
+            )
+        )
+        mps = store.list("MetricsProducer")
+        PC.solve_pending(store, mps, GaugeRegistry())
         assert calls == [1]
 
 
 class TestCoalesceTiming:
-    def test_window_holds_for_stragglers(self):
-        """A submit landing inside the window joins the open batch."""
+    def test_fixed_window_holds_for_stragglers(self):
+        """adaptive_window=False pins the pre-overhaul fixed window: a
+        submit landing inside it joins the open batch."""
         svc = SolverService(
-            registry=GaugeRegistry(), window_s=0.2, max_batch=4
+            registry=GaugeRegistry(), window_s=0.2, max_batch=4,
+            adaptive_window=False,
         )
         try:
             results = {}
@@ -451,3 +504,230 @@ class TestCoalesceTiming:
             assert svc.stats.last_coalesce_factor == 2
         finally:
             svc.close()
+
+    def test_adaptive_idle_queue_skips_the_window(self):
+        """The tentpole fix: a lone request on an idle queue must NOT
+        wait out the batching timer. With a punitive 0.5 s max window,
+        sequential solves complete in far less than one window each."""
+        svc = SolverService(
+            registry=GaugeRegistry(), window_s=0.5, max_batch=8
+        )
+        try:
+            inputs = make_inputs(40, 4, seed=1)
+            svc.solve(inputs, backend="xla")  # warm the compile
+            t0 = time.perf_counter()
+            for _ in range(3):
+                svc.solve(inputs, backend="xla")
+            elapsed = time.perf_counter() - t0
+            assert elapsed < 0.5, (
+                f"3 idle-queue solves took {elapsed:.3f}s — the fixed "
+                "window is back"
+            )
+            assert svc.stats.immediate_dispatches >= 3
+        finally:
+            svc.close()
+
+    def test_adaptive_window_widens_under_concurrency(self):
+        """Concurrent submitters must still coalesce (the acceptance
+        criterion: coalesce factor >= 4 under concurrency >= 4) even
+        with the adaptive window dispatching idle traffic immediately."""
+        svc = SolverService(
+            registry=GaugeRegistry(), window_s=0.05, max_batch=8
+        )
+        try:
+            inputs = [make_inputs(60 + i, 4, seed=i) for i in range(8)]
+            svc.solve(make_inputs(50, 4, seed=99), backend="xla")  # warm
+            results = [None] * 8
+            barrier = threading.Barrier(8)
+
+            def submit(i):
+                barrier.wait()
+                results[i] = svc.solve(inputs[i], backend="xla")
+
+            threads = [
+                threading.Thread(target=submit, args=(i,))
+                for i in range(8)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert all(r is not None for r in results)
+            assert svc.stats.last_coalesce_factor >= 4
+        finally:
+            svc.close()
+
+
+class TestPipelinedDispatch:
+    def test_sustained_load_overlaps_dispatches(self):
+        """With max_batch capping each dispatch, a burst larger than one
+        batch must pipeline: at least one dispatch is issued while the
+        previous one is still in flight — and every result stays
+        bitwise-correct."""
+        svc = SolverService(
+            registry=GaugeRegistry(), window_s=0.02, max_batch=2,
+            pipeline_depth=1,
+        )
+        try:
+            inputs = [make_inputs(30 + i, 3, seed=i) for i in range(6)]
+            svc.solve(inputs[0], backend="xla")  # warm batch=1
+            futures = [
+                svc.submit(inp, backend="xla") for inp in inputs
+            ]
+            results = [f.result(30.0) for f in futures]
+            for inp, out in zip(inputs, results):
+                assert_outputs_equal(out, B.solve(inp, backend="xla"))
+            assert svc.stats.pipeline_overlaps >= 1
+        finally:
+            svc.close()
+
+    def test_pipeline_depth_zero_is_serial(self):
+        svc = SolverService(
+            registry=GaugeRegistry(), window_s=0.0, pipeline_depth=0
+        )
+        try:
+            inputs = make_inputs(20, 3, seed=5)
+            assert_outputs_equal(
+                svc.solve(inputs, backend="xla"),
+                B.solve(inputs, backend="xla"),
+            )
+            assert svc.stats.pipeline_overlaps == 0
+        finally:
+            svc.close()
+
+    def test_inflight_device_failure_degrades_to_numpy(self):
+        """An async dispatch whose failure surfaces at drain time (not
+        dispatch time) must still answer every request from numpy."""
+        svc = SolverService(registry=GaugeRegistry(), window_s=0.0)
+        try:
+            import dataclasses
+
+            calls = {"n": 0}
+            real = svc._compiled_for
+
+            def exploding(cache_key, donate=False):
+                fn = real(cache_key, donate=donate)
+
+                def wrapped(stacked, buckets):
+                    calls["n"] += 1
+                    out = fn(stacked, buckets)
+                    # poison the result so the block_until_ready in the
+                    # drain path raises (async-failure analog)
+                    return dataclasses.replace(
+                        out, assigned=_Exploding()
+                    )
+
+                return wrapped
+
+            class _Exploding:
+                def block_until_ready(self):
+                    raise RuntimeError("injected in-flight failure")
+
+                @property
+                def shape(self):
+                    return (0,)
+
+            svc._compiled_for = exploding
+            inputs = make_inputs(15, 3, seed=9)
+            out = svc.solve(inputs, backend="xla")
+            assert_outputs_equal(out, binpack_numpy(inputs, buckets=32))
+            assert svc.stats.fallbacks == 1
+            assert calls["n"] == 1
+        finally:
+            svc.close()
+
+
+class TestDonationParity:
+    def test_donating_compile_matches_non_donating(self):
+        """The donation-backed program family must produce outputs
+        bitwise-identical to the non-donating family on the same
+        stacked operands (donation changes buffer lifetime, never
+        values) — compiled explicitly on BOTH families regardless of
+        whether this backend supports donation."""
+        import warnings
+
+        import jax
+
+        from karpenter_tpu.solver.bucketing import (
+            bucket_shape,
+            pad_to_bucket,
+        )
+        from karpenter_tpu.solver.service import _stack_inputs
+
+        svc = SolverService(registry=GaugeRegistry(), window_s=0.0)
+        try:
+            inputs = make_inputs(40, 4, seed=11)
+            shape = bucket_shape(inputs)
+            padded = pad_to_bucket(inputs, shape)
+            key = ("xla", shape, 1, 32, (False, False, False, False),
+                   "map")
+            keep = svc._compiled_for(key, donate=False)
+            donate = svc._compiled_for(key, donate=True)
+            out_keep = jax.device_get(
+                keep(jax.device_put(_stack_inputs([padded])), 32)
+            )
+            with warnings.catch_warnings():
+                # on CPU donation is a no-op and jax says so per
+                # executable; this test compiles the donating family
+                # here deliberately
+                warnings.filterwarnings(
+                    "ignore",
+                    message="Some donated buffers were not usable",
+                )
+                out_donate = jax.device_get(
+                    donate(jax.device_put(_stack_inputs([padded])), 32)
+                )
+            for name in (
+                "assigned", "assigned_count", "nodes_needed",
+                "lp_bound", "unschedulable",
+            ):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(out_donate, name)),
+                    np.asarray(getattr(out_keep, name)),
+                    err_msg=name,
+                )
+            # two distinct compile-cache families, no aliasing
+            assert svc.stats.compile_cache_misses == 2
+        finally:
+            svc.close()
+
+
+class TestLatencyRegressionGuard:
+    def test_idle_service_p50_within_2x_of_direct(self):
+        """The coalescing tax must not return: on an idle queue the
+        service path stays within 2x of a direct ops/binpack call on a
+        small fixed workload (the non-slow canary for the bench-hotpath
+        acceptance ratio)."""
+        inputs = make_inputs(256, 8, seed=42)
+        iters = 15
+
+        def p50(fn):
+            times = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                fn()
+                times.append(time.perf_counter() - t0)
+            return float(np.percentile(times, 50))
+
+        import jax
+
+        def direct():
+            jax.block_until_ready(B.solve(inputs, backend="xla"))
+
+        direct()  # warm
+        direct_p50 = p50(direct)
+
+        svc = SolverService(registry=GaugeRegistry(), max_batch=8)
+        try:
+            svc.solve(inputs, backend="xla")  # warm
+            service_p50 = p50(
+                lambda: svc.solve(inputs, backend="xla")
+            )
+        finally:
+            svc.close()
+        # generous absolute floor: at sub-millisecond direct solves the
+        # thread handoff dominates and the RATIO is meaningless noise
+        assert service_p50 <= max(2 * direct_p50, 0.01), (
+            f"idle service p50 {service_p50 * 1e3:.2f}ms vs direct "
+            f"{direct_p50 * 1e3:.2f}ms — coalescing tax is back"
+        )

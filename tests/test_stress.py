@@ -306,6 +306,7 @@ class TestPendingFeedUnderConcurrency:
         import numpy as np
 
         import karpenter_tpu.metrics.producers.pendingcapacity as PC
+        from karpenter_tpu.metrics.producers.pendingcapacity import encoder as PCE
         from karpenter_tpu.api.core import (
             Affinity,
             Container,
@@ -385,7 +386,7 @@ class TestPendingFeedUnderConcurrency:
         def reader():
             while not stop.is_set():
                 snap = cache.snapshot()
-                idx, weights = PC._dedup_rows(snap)
+                idx, weights = PCE._dedup_rows(snap)
                 # internal coherence mid-race: weights positive, indices
                 # inside the snapshot
                 assert (weights > 0).all()
@@ -404,7 +405,7 @@ class TestPendingFeedUnderConcurrency:
 
         live = store.list("Pod")
         snap = cache.snapshot()
-        _, weights = PC._dedup_rows(snap)
+        _, weights = PCE._dedup_rows(snap)
         assert int(np.sum(weights)) == len(live) == len(cache)
 
         # the watch-maintained cache must solve exactly like a fresh
