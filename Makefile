@@ -21,6 +21,9 @@ test: ## Run the test suite (virtual 8-device CPU mesh)
 		"scale/stress, real-backend/apiserver) — 'make battletest' or" \
 		"'make ci' runs them"
 
+test-chaos: ## Seeded chaos suite: runtime + solver under injected faults (docs/resilience.md)
+	$(PYTHON) -m pytest tests/test_faults.py tests/test_chaos.py -q
+
 battletest: ## Randomized order + scale + stress + coverage when available (reference: Makefile battletest)
 	@# coverage is opportunistic but NEVER silent: the gate says which
 	@# mode it runs in, and a failing test fails it in either mode
@@ -101,6 +104,6 @@ conformance: ## Run the real-apiserver tier against a kind-booted apiserver (the
 kind-smoke: ## Deploy smoke on kind: image -> apply -> pod Ready -> one HA end to end
 	bash hack/kind-smoke.sh
 
-.PHONY: help dev ci test battletest verify codegen docs native bench \
-	bench-solver bench-consolidate dryrun image publish apply delete \
-	kind-load conformance kind-smoke
+.PHONY: help dev ci test test-chaos battletest verify codegen docs native \
+	bench bench-solver bench-consolidate dryrun image publish apply \
+	delete kind-load conformance kind-smoke

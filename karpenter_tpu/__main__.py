@@ -121,6 +121,40 @@ def parse_args(argv=None) -> argparse.Namespace:
         "the report then includes baseline vs what-if and the delta",
     )
     parser.add_argument(
+        "--backoff-base",
+        type=float,
+        default=1.0,
+        help="engine requeue backoff base seconds under retryable "
+        "failures (docs/resilience.md)",
+    )
+    parser.add_argument(
+        "--backoff-cap",
+        type=float,
+        default=60.0,
+        help="engine requeue backoff cap seconds",
+    )
+    parser.add_argument(
+        "--circuit-threshold",
+        type=int,
+        default=5,
+        help="consecutive provider failures before a node group's "
+        "actuation circuit opens",
+    )
+    parser.add_argument(
+        "--circuit-reset",
+        type=float,
+        default=120.0,
+        help="seconds an open actuation circuit waits before a "
+        "half-open probe reconcile",
+    )
+    parser.add_argument(
+        "--solver-watchdog-timeout",
+        type=float,
+        default=30.0,
+        help="seconds a solver device call may run before the watchdog "
+        "restarts the worker and drains to numpy (0 = off)",
+    )
+    parser.add_argument(
         "--consolidate",
         action="store_true",
         help="enable the consolidation engine (batched node-drain "
@@ -309,6 +343,11 @@ def main(argv=None) -> int:
             data_dir=args.data_dir,
             verbose=args.verbose,
             consolidate=args.consolidate,
+            backoff_base_s=args.backoff_base,
+            backoff_cap_s=args.backoff_cap,
+            circuit_failure_threshold=args.circuit_threshold,
+            circuit_reset_s=args.circuit_reset,
+            solver_watchdog_timeout_s=args.solver_watchdog_timeout,
         ),
         store=store,
     )

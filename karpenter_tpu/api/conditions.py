@@ -27,6 +27,13 @@ ABLE_TO_SCALE = "AbleToScale"
 SCALING_UNBOUNDED = "ScalingUnbounded"
 STABILIZED = "Stabilized"
 
+# Structured condition REASONS (machine-readable; the message carries the
+# human detail). ActuationCircuitOpen: the per-node-group actuation
+# circuit breaker is open after repeated provider failures — the message
+# threads the last RetryableError.code and the next-probe ETA
+# (docs/resilience.md "Circuit breaker").
+ACTUATION_CIRCUIT_OPEN = "ActuationCircuitOpen"
+
 
 @dataclass(slots=True)
 class Condition:

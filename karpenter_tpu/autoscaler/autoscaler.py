@@ -35,7 +35,7 @@ from karpenter_tpu.api.horizontalautoscaler import (
 )
 from karpenter_tpu.observability import solver_trace
 from karpenter_tpu.ops import decision as D
-from karpenter_tpu.store import Store
+from karpenter_tpu.store import NotFoundError, Store
 
 _TYPE_CODES = {
     VALUE: D.TYPE_VALUE,
@@ -166,6 +166,16 @@ class BatchAutoscaler:
                     row.types.append(
                         _TYPE_CODES.get(target.type, D.TYPE_UNKNOWN)
                     )
+        except NotFoundError as e:
+            # a missing scale target is RETRYABLE: the target may be
+            # created any moment, and its creation fires no watch event
+            # on the HA — deactivation would strand the autoscaler
+            # (engine ladder: docs/resilience.md). Lazy import: the
+            # controllers package imports this module.
+            from karpenter_tpu.controllers.errors import RetryableError
+
+            row.error = RetryableError(str(e), code="ScaleTargetNotFound")
+            row.error.__cause__ = e
         except Exception as e:  # noqa: BLE001 - row-isolated failure
             row.error = e
         return row

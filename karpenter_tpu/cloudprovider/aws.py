@@ -30,6 +30,7 @@ from karpenter_tpu.api.scalablenodegroup import (
 from karpenter_tpu.cloudprovider import Options, node_template_from_raw
 from karpenter_tpu.cloudprovider.fake import FakeFactory
 from karpenter_tpu.controllers.errors import RetryableError
+from karpenter_tpu.faults import inject
 
 # Node label EKS applies to managed-node-group members
 # (reference: managednodegroup.go NodeGroupLabel).
@@ -236,6 +237,7 @@ class AutoScalingGroup:
     def _describe(self) -> List[dict]:
         if self._describe_memo is None:
             try:
+                inject("cloud.get_replicas")
                 self._describe_memo = self.client.describe_auto_scaling_groups(
                     names=[self.id], max_records=1
                 )
@@ -268,6 +270,7 @@ class AutoScalingGroup:
 
     def set_replicas(self, count: int) -> None:
         try:
+            inject("cloud.set_replicas")
             self.client.update_auto_scaling_group(
                 name=self.id, desired_capacity=count
             )
@@ -327,6 +330,7 @@ class ManagedNodeGroup:
         self.store = store
 
     def get_replicas(self) -> int:
+        inject("cloud.get_replicas")
         nodes = self.store.list(
             "Node", label_selector={NODE_GROUP_LABEL: self.node_group}
         )
@@ -334,6 +338,7 @@ class ManagedNodeGroup:
 
     def set_replicas(self, count: int) -> None:
         try:
+            inject("cloud.set_replicas")
             self.eks_client.update_nodegroup_config(
                 cluster_name=self.cluster,
                 nodegroup_name=self.node_group,

@@ -16,6 +16,7 @@ from karpenter_tpu.api.scalablenodegroup import (
 )
 from karpenter_tpu.cloudprovider import Options
 from karpenter_tpu.controllers.errors import RetryableError
+from karpenter_tpu.faults import inject
 
 # Providers register admission validators for the types they serve
 # (reference: pkg/cloudprovider/aws/sqsqueue.go:29-34 init pattern).
@@ -36,6 +37,7 @@ class FakeNodeGroup:
         self._id = group_id
 
     def get_replicas(self) -> int:
+        inject("cloud.get_replicas")
         if self._factory.want_err is not None:
             raise self._factory.want_err
         replicas = self._factory.node_replicas.get(self._id)
@@ -47,6 +49,10 @@ class FakeNodeGroup:
         return replicas
 
     def set_replicas(self, count: int) -> None:
+        # inject BEFORE applying: a failed provider call must be atomic
+        # (no partially-applied resize), so retry-vs-duplicate actuation
+        # is observable in chaos runs
+        inject("cloud.set_replicas")
         if self._factory.want_err is not None:
             raise self._factory.want_err
         self._factory.node_replicas[self._id] = count

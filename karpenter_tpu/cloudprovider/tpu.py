@@ -30,6 +30,7 @@ from karpenter_tpu.api.scalablenodegroup import (
 from karpenter_tpu.cloudprovider import Options, node_template_from_raw
 from karpenter_tpu.cloudprovider.fake import FakeFactory
 from karpenter_tpu.controllers.errors import RetryableError
+from karpenter_tpu.faults import inject
 
 # GKE labels node-pool members with the pool name
 NODE_POOL_LABEL = "cloud.google.com/gke-nodepool"
@@ -102,6 +103,7 @@ class TPUPodSlicePool:
         that host) against the slice topology — hardware generations differ
         (4 chips/host on v4/v5p, 8 on single-host v5e/v6e shapes), so a
         constant would halve or double the count."""
+        inject("cloud.get_replicas")
         nodes = self.store.list(
             "Node", label_selector={NODE_POOL_LABEL: self.pool}
         )
@@ -115,6 +117,7 @@ class TPUPodSlicePool:
 
     def set_replicas(self, count: int) -> None:
         try:
+            inject("cloud.set_replicas")
             self.api.set_node_pool_size(
                 self.project, self.location, self.cluster, self.pool, count
             )

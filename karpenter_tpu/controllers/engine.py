@@ -14,6 +14,23 @@ due object of its kind in one call (the HA controller turns this into a
 single device kernel invocation for the whole fleet). Controllers without a
 batch path get the per-object workflow. Watch events requeue immediately
 (the reference's watch-driven actuation, DESIGN.md:435).
+
+Failure ladder (docs/resilience.md): the fixed-interval requeue applies
+only to SUCCESSFUL reconciles. A failed one is classified through
+errors.is_retryable —
+
+  retryable     → requeue on per-object decorrelated-jitter exponential
+                  backoff (monotone, bounded by backoff_cap_s): a flaky
+                  dependency is retried promptly at first, then ever
+                  slower, and the jitter keeps a fleet of failers from
+                  herding the dependency's recovery;
+  non-retryable → DEACTIVATE: Active=False is persisted and the object
+                  is not requeued at all until a watch event (spec edit,
+                  external patch) revives it — a poisoned spec stops
+                  consuming ticks instead of failing forever.
+
+A failed status patch itself backs off too (the store is a dependency
+like any other).
 """
 
 from __future__ import annotations
@@ -22,8 +39,12 @@ import time as _time
 from typing import Dict, List, Optional, Protocol
 
 from karpenter_tpu.api import conditions as cond
+from karpenter_tpu.controllers.errors import is_retryable
+from karpenter_tpu.resilience import DecorrelatedJitterBackoff
 from karpenter_tpu.store import Store
 from karpenter_tpu.utils.log import logger
+
+_NEVER = float("inf")  # the deactivated requeue time
 
 
 class Controller(Protocol):
@@ -44,6 +65,9 @@ class Manager:
     def __init__(
         self, store: Store, clock=_time.time, registry=None,
         solver_service=None,
+        backoff_base_s: float = 1.0,
+        backoff_cap_s: float = 60.0,
+        backoff_seed: int = 0,
     ):
         self.store = store
         self.clock = clock
@@ -53,13 +77,20 @@ class Manager:
         # runtime series with no extra wiring in __main__.py
         self._solver_service = solver_service
         self._controllers: List[Controller] = []
-        # (kind, namespace, name) -> next due time; 0 = due now
+        # (kind, namespace, name) -> next due time; 0 = due now,
+        # inf = deactivated (revived only by a watch event)
         self._due: Dict[tuple, float] = {}
+        # per-object retryable-failure ladder: key -> previous delay
+        self._backoff = DecorrelatedJitterBackoff(
+            base_s=backoff_base_s, cap_s=backoff_cap_s, seed=backoff_seed
+        )
+        self._backoff_prev: Dict[tuple, float] = {}
         # self-observability (the reference gets controller-runtime's
         # metrics for free; here the manager publishes its own):
         # karpenter_runtime_{tick_seconds,reconciles_total,
         # reconcile_errors_total}{name=<kind>|manager}
         self._tick_gauge = self._count_gauge = self._error_gauge = None
+        self._backoff_gauge = self._deactivated_gauge = None
         if registry is not None:
             self._tick_gauge = registry.register("runtime", "tick_seconds")
             self._count_gauge = registry.register(
@@ -67,6 +98,15 @@ class Manager:
             )
             self._error_gauge = registry.register(
                 "runtime", "reconcile_errors_total", kind="counter"
+            )
+            # ladder observability: the last requeue backoff per kind and
+            # how many objects have been deactivated by non-retryable
+            # errors (karpenter_resilience_* — docs/resilience.md)
+            self._backoff_gauge = registry.register(
+                "resilience", "requeue_backoff_seconds"
+            )
+            self._deactivated_gauge = registry.register(
+                "resilience", "deactivated_total", kind="counter"
             )
 
     def _count(self, gauge, name: str, delta: float = 1.0) -> None:
@@ -86,10 +126,19 @@ class Manager:
         key = (obj.KIND, obj.metadata.namespace, obj.metadata.name)
         if event == "Deleted":
             self._due.pop(key, None)
+            self._backoff_prev.pop(key, None)
+            # controllers may keep per-object state of their own (the
+            # SNG controller's circuit breakers + gauge series): give
+            # them the same pruning signal the engine's maps get
+            for controller in self._controllers:
+                hook = getattr(controller, "on_deleted", None)
+                if hook is not None and controller.kind() == obj.KIND:
+                    hook(obj)
         else:
             # watch events trigger immediate reconcile on the next tick,
             # overriding any scheduled requeue (the reference's watch-driven
-            # actuation, DESIGN.md:435)
+            # actuation, DESIGN.md:435) — including the inf requeue of a
+            # DEACTIVATED object: an external edit is the revival signal
             self._due[key] = 0.0
 
     # -- the generic workflow (reference: controller.go:67-97) -------------
@@ -110,11 +159,67 @@ class Manager:
         if error is not None:
             self._count(self._error_gauge, obj.KIND)
         try:
-            self.store.patch_status(obj)
+            patched = self.store.patch_status(obj)
         except KeyError:
             return  # deleted mid-reconcile
-        key = (obj.KIND, obj.metadata.namespace, obj.metadata.name)
-        self._due[key] = self.clock() + controller.interval()
+        except Exception as patch_error:  # noqa: BLE001 — store hiccup
+            # the store is a dependency like the provider: a failed
+            # status write requeues on the retryable ladder (the write
+            # is redone wholesale by the next reconcile) and NEVER
+            # deactivates — the conditions were not persisted, so a
+            # deactivation here would strand the object invisibly
+            logger().warning(
+                "status patch failed for %s %s: %s; requeueing with "
+                "backoff", obj.KIND, obj.metadata.name, patch_error,
+            )
+            self._count(self._error_gauge, obj.KIND)
+            self._requeue_backoff(self._key_of(obj))
+            return
+        self._requeue(controller, self._key_of(obj), error, patched)
+
+    @staticmethod
+    def _key_of(obj) -> tuple:
+        return (obj.KIND, obj.metadata.namespace, obj.metadata.name)
+
+    def _requeue(
+        self, controller, key, error: Optional[Exception], patched=None
+    ) -> None:
+        """The supervised requeue ladder: interval on success, jittered
+        backoff on retryable failure, deactivation on non-retryable."""
+        if error is None:
+            self._backoff_prev.pop(key, None)
+            self._due[key] = self.clock() + controller.interval()
+        elif is_retryable(error):
+            self._requeue_backoff(key)
+        else:
+            # DEACTIVATE: no requeue until a watch event revives the
+            # object (_on_event). Exactly-once by construction — the
+            # object is never due again, so _finish cannot re-run.
+            # Concurrency guard: an EXTERNAL write landing during this
+            # reconcile fired its revival event before we got here and
+            # due=inf would silently discard it — detectable because the
+            # stored resourceVersion has moved past our own status
+            # patch. Reconcile once more instead of deactivating.
+            current = self.store.try_get(*key)
+            if (
+                current is not None
+                and patched is not None
+                and current.metadata.resource_version
+                != patched.metadata.resource_version
+            ):
+                self._due[key] = 0.0
+                return
+            self._backoff_prev.pop(key, None)
+            self._due[key] = _NEVER
+            if self._deactivated_gauge is not None:
+                self._deactivated_gauge.inc(key[0], "-")
+
+    def _requeue_backoff(self, key) -> None:
+        delay = self._backoff.next(self._backoff_prev.get(key, 0.0))
+        self._backoff_prev[key] = delay
+        self._due[key] = self.clock() + delay
+        if self._backoff_gauge is not None:
+            self._backoff_gauge.set(key[0], "-", delay)
 
     def _validate(self, obj) -> Optional[Exception]:
         try:

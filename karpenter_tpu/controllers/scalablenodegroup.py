@@ -12,28 +12,97 @@ subresource, and this controller's ordinary spec-vs-observed loop performs
 the provider call — consolidation never bypasses the one actuation door.
 When the scale-down lands, the engine is told (`on_scale_down`) so it can
 finalize the drained nodes.
+
+Circuit breaker (docs/resilience.md): each node group carries its own
+breaker around the provider calls. After `circuit_failure_threshold`
+consecutive provider failures the circuit OPENS: reconciles stop
+touching the provider entirely (a flapping cloud API no longer eats the
+tick) and the resource reports AbleToScale=False with the structured
+ActuationCircuitOpen reason, the last RetryableError.code, and the
+next-probe ETA. After `circuit_reset_s` one half-open probe reconcile is
+admitted; success closes the circuit, failure re-opens it for a fresh
+window.
 """
 
 from __future__ import annotations
 
+from typing import Dict
+
 from karpenter_tpu.api import conditions as cond
 from karpenter_tpu.api.scalablenodegroup import ScalableNodeGroup
 from karpenter_tpu.controllers.errors import error_code, is_retryable
+from karpenter_tpu.resilience import CLOSED as resilience_CLOSED
+from karpenter_tpu.resilience import CircuitBreaker
 from karpenter_tpu.utils.log import logger
 
 
 class ScalableNodeGroupController:
-    def __init__(self, cloud_provider_factory, consolidator=None):
+    def __init__(
+        self,
+        cloud_provider_factory,
+        consolidator=None,
+        registry=None,
+        circuit_failure_threshold: int = 5,
+        circuit_reset_s: float = 120.0,
+        clock=None,
+    ):
+        import time as _time
+
         self.cloud_provider = cloud_provider_factory
         # ConsolidationEngine (or None): planning is bounded by the
         # engine's own interval, so calling it every reconcile is cheap
         self.consolidator = consolidator
+        self.circuit_failure_threshold = circuit_failure_threshold
+        self.circuit_reset_s = circuit_reset_s
+        self.clock = clock or _time.monotonic
+        # one breaker per resource (namespace, name): group A's flapping
+        # ASG must not trip group B's actuation
+        self._breakers: Dict[tuple, CircuitBreaker] = {}
+        self._g_circuit = self._c_opens = None
+        if registry is not None:
+            self._g_circuit = registry.register(
+                "resilience", "circuit_state"
+            )
+            self._c_opens = registry.register(
+                "resilience", "circuit_open_total", kind="counter"
+            )
 
     def kind(self) -> str:
         return ScalableNodeGroup.KIND
 
     def interval(self) -> float:
         return 60.0
+
+    def on_deleted(self, resource) -> None:
+        """Engine deletion hook: drop the per-object breaker and its
+        gauge series — a recreated group with the same name must start
+        with a CLOSED circuit, not inherit a dead group's open one."""
+        self._breakers.pop(
+            (resource.metadata.namespace, resource.metadata.name), None
+        )
+        if self._g_circuit is not None:
+            self._g_circuit.remove(
+                resource.metadata.name, resource.metadata.namespace
+            )
+
+    def _breaker(self, resource) -> CircuitBreaker:
+        key = (resource.metadata.namespace, resource.metadata.name)
+        breaker = self._breakers.get(key)
+        if breaker is None:
+            breaker = self._breakers[key] = CircuitBreaker(
+                failure_threshold=self.circuit_failure_threshold,
+                reset_s=self.circuit_reset_s,
+                clock=self.clock,
+            )
+        return breaker
+
+    def _publish_circuit(self, resource, breaker: CircuitBreaker) -> None:
+        if self._g_circuit is not None:
+            self._g_circuit.set(
+                resource.metadata.name,
+                resource.metadata.namespace,
+                breaker.state_value(),
+            )
 
     def _reconcile(self, resource) -> None:
         if self.consolidator is not None:
@@ -108,15 +177,67 @@ class ScalableNodeGroupController:
                 cond.STABILIZED, "ScaleDownWhileUnstable", detail
             )
 
+    def _mark_circuit_open(self, resource, breaker: CircuitBreaker) -> None:
+        """ActuationCircuitOpen condition: machine-readable reason, with
+        the last RetryableError.code and next-probe ETA in the message —
+        the operator sees WHY actuation is paused without log-diving."""
+        resource.status_conditions().mark_false(
+            cond.ABLE_TO_SCALE,
+            cond.ACTUATION_CIRCUIT_OPEN,
+            f"actuation circuit open for {resource.spec.id}: "
+            f"{breaker.consecutive_failures} consecutive provider "
+            f"failures (last code "
+            f"{breaker.last_error_code or 'unknown'}); next probe in "
+            f"{breaker.retry_in():.0f}s",
+        )
+
+    def _record_provider_failure(self, resource, breaker, err) -> None:
+        opens_before = breaker.opens_total
+        breaker.record_failure(error_code(err))
+        if breaker.opens_total > opens_before:
+            logger().warning(
+                "actuation circuit OPENED for ScalableNodeGroup %s/%s "
+                "after %d consecutive provider failures (last: %s)",
+                resource.metadata.namespace, resource.metadata.name,
+                breaker.consecutive_failures, err,
+            )
+            if self._c_opens is not None:
+                self._c_opens.inc(
+                    resource.metadata.name, resource.metadata.namespace
+                )
+
     def reconcile(self, resource) -> None:
         mgr = resource.status_conditions()
+        breaker = self._breaker(resource)
+        if not breaker.allow():
+            # open circuit: skip the provider ENTIRELY this tick — the
+            # whole point of the breaker is that a flapping cloud API
+            # stops consuming reconcile time. The resource stays Active
+            # (this is a supervised degradation, not a resource fault).
+            self._mark_circuit_open(resource, breaker)
+            self._publish_circuit(resource, breaker)
+            return
         try:
             self._reconcile(resource)
         except Exception as e:  # noqa: BLE001
+            # EVERY failure feeds the breaker — in particular a
+            # non-retryable one during a HALF_OPEN probe must record an
+            # outcome, or the breaker wedges half-open (allow() False
+            # forever) with no probe ever admitted again
+            self._record_provider_failure(resource, breaker, e)
+            self._publish_circuit(resource, breaker)
             if is_retryable(e):
                 # stay Active; just flag the transient inability to scale
-                # (reference: controller.go:83-95)
-                mgr.mark_false(cond.ABLE_TO_SCALE, "", error_code(e) or str(e))
+                # (reference: controller.go:83-95) — K consecutive
+                # failures open the circuit
+                if breaker.state != resilience_CLOSED:
+                    self._mark_circuit_open(resource, breaker)
+                else:
+                    mgr.mark_false(
+                        cond.ABLE_TO_SCALE, "", error_code(e) or str(e)
+                    )
                 return
             raise
+        breaker.record_success()
+        self._publish_circuit(resource, breaker)
         mgr.mark_true(cond.ABLE_TO_SCALE)

@@ -1,0 +1,29 @@
+"""Fault injection (registry.py) — the testable-failure subsystem.
+
+Public surface: `inject(point)` for instrumented production code paths,
+`FaultRegistry`/`FaultPlan` + install/uninstall/injected_faults for
+chaos suites. See docs/resilience.md for the point catalog and plan
+format.
+"""
+
+from karpenter_tpu.faults.registry import (
+    FaultInjected,
+    FaultPlan,
+    FaultRegistry,
+    active,
+    inject,
+    injected_faults,
+    install,
+    uninstall,
+)
+
+__all__ = [
+    "FaultInjected",
+    "FaultPlan",
+    "FaultRegistry",
+    "active",
+    "inject",
+    "injected_faults",
+    "install",
+    "uninstall",
+]

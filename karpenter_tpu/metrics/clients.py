@@ -22,6 +22,8 @@ import urllib.parse
 import urllib.request
 from typing import Dict, Optional, Tuple
 
+from karpenter_tpu.controllers.errors import RetryableError
+from karpenter_tpu.faults import inject
 from karpenter_tpu.metrics.registry import GaugeRegistry, default_registry
 from karpenter_tpu.metrics.types import Metric
 from karpenter_tpu.utils.log import invariant_violated
@@ -35,8 +37,17 @@ _LABEL_RE = re.compile(
 )
 
 
-class MetricQueryError(RuntimeError):
-    pass
+class MetricQueryError(RetryableError):
+    """A metric read that failed NOW but may succeed later: network
+    blips against Prometheus, and metrics that simply don't exist YET
+    (a producer that hasn't ticked, an HA created before its signal).
+    RETRYABLE in the controller taxonomy — the engine must keep
+    requeueing (with backoff) rather than deactivate the autoscaler,
+    because the metric can appear without any watch event on the HA
+    object to revive it."""
+
+    def __init__(self, message: str):
+        super().__init__(message, code="MetricQuery", retryable=True)
 
 
 def parse_instant_selector(query: str) -> Tuple[str, Dict[str, str]]:
@@ -75,6 +86,7 @@ class RegistryMetricsClient:
         self.registry = registry if registry is not None else default_registry()
 
     def get_current_value(self, metric_spec) -> Metric:
+        inject("metrics.query")
         query = metric_spec.prometheus.query
         name, labels = parse_instant_selector(query)
         vec = self.registry.lookup_by_full_name(name)
@@ -103,6 +115,7 @@ class PrometheusMetricsClient:
         self.timeout = timeout_seconds
 
     def get_current_value(self, metric_spec) -> Metric:
+        inject("metrics.query")
         query = metric_spec.prometheus.query
         data = urllib.parse.urlencode({"query": query}).encode()
         request = urllib.request.Request(

@@ -702,6 +702,12 @@ def _encode_from_cache(snap, profiles, with_rows: bool = False, census=None):
     """THE encode seam (public face: pendingcapacity.encode_snapshot):
     delta-accelerated when the process-default SnapshotDeltaCache has a
     matching entry, bit-identical to _encode_full always."""
+    # injection point (faults/registry.py): a failed encode is a
+    # producer-reconcile failure — row-isolated by solve_pending, then
+    # ridden down the engine's retryable-backoff ladder
+    from karpenter_tpu.faults import inject
+
+    inject("encoder.encode")
     return _default_delta.encode(
         snap, profiles, with_rows=with_rows, census=census
     )

@@ -48,6 +48,22 @@ class Options:
     # pipeline, 0 = serial)
     solver_window_s: float = 0.002
     solver_pipeline_depth: int = 1
+    # degradation-ladder tuning (docs/resilience.md):
+    # engine requeue backoff under retryable failures — first retry in
+    # ~[base, 3*base], monotone up to the cap
+    backoff_base_s: float = 1.0
+    backoff_cap_s: float = 60.0
+    # per-node-group actuation circuit breaker: consecutive provider
+    # failures before opening, and the open window before a half-open
+    # probe reconcile is admitted
+    circuit_failure_threshold: int = 5
+    circuit_reset_s: float = 120.0
+    # solver backend health FSM: consecutive device failures before a
+    # wholesale trip to numpy, probe cadence while degraded, and the
+    # hung-worker watchdog timeout (0 disables the watchdog)
+    solver_health_threshold: int = 3
+    solver_probe_interval_s: float = 5.0
+    solver_watchdog_timeout_s: float = 30.0
 
 
 class KarpenterRuntime:
@@ -105,6 +121,9 @@ class KarpenterRuntime:
             pipeline_depth=options.solver_pipeline_depth,
             device_solver=device_solver,
             decider=decider,
+            health_failure_threshold=options.solver_health_threshold,
+            health_probe_interval_s=options.solver_probe_interval_s,
+            watchdog_timeout_s=options.solver_watchdog_timeout_s,
         )
         self.producer_factory = ProducerFactory(
             self.store, self.cloud_provider, registry=self.registry,
@@ -139,10 +158,16 @@ class KarpenterRuntime:
         self.manager = Manager(
             self.store, clock=self.clock, registry=self.registry,
             solver_service=self.solver_service,
+            backoff_base_s=options.backoff_base_s,
+            backoff_cap_s=options.backoff_cap_s,
         ).register(
             MetricsProducerController(self.producer_factory),
             ScalableNodeGroupController(
-                self.cloud_provider, consolidator=self.consolidation
+                self.cloud_provider, consolidator=self.consolidation,
+                registry=self.registry,
+                circuit_failure_threshold=options.circuit_failure_threshold,
+                circuit_reset_s=options.circuit_reset_s,
+                clock=self.clock,
             ),
             HorizontalAutoscalerController(
                 self.batch_autoscaler, solver_service=self.solver_service

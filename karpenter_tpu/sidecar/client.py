@@ -5,26 +5,92 @@ pluggable-Algorithm boundary, pkg/autoscaler/algorithms/algorithm.go:24-40):
 `SolverClient.solve` has the same (inputs, buckets) -> BinPackOutputs
 contract as ops/binpack.solve, so metrics/producers/pendingcapacity.py
 routes through it when the runtime is configured with a solver URI.
+
+Resilience posture (docs/resilience.md): every RPC carries a DEADLINE
+(`timeout_seconds`, default 30 s — never an unbounded wait on a dead
+server) and transient failures (UNAVAILABLE — server restarting, channel
+reconnecting — and DEADLINE_EXCEEDED) get ONE retry after a short
+jittered sleep, decorrelating concurrent callers hitting the same
+restart. Anything still failing surfaces to the caller, where the solve
+service's numpy fallback (solver/service.py) takes over — the client
+never retries indefinitely, because the layer above already owns
+degradation.
 """
 
 from __future__ import annotations
 
+import random
+import time as _time
 from typing import Any, Dict, Optional, Tuple
 
+from karpenter_tpu.faults import FaultInjected, inject
 from karpenter_tpu.sidecar import codec
 from karpenter_tpu.sidecar.server import SERVICE
+from karpenter_tpu.utils.log import logger
+
+DEFAULT_TIMEOUT_S = 30.0
+# one retry, after uniform(0, retry_jitter_s) — enough to ride out a
+# sidecar restart without amplifying load against a genuinely dead one
+DEFAULT_RETRIES = 1
+DEFAULT_RETRY_JITTER_S = 0.25
+
+
+def _retryable_rpc_error(err: BaseException) -> bool:
+    import grpc
+
+    if isinstance(err, FaultInjected):
+        return err.retryable  # injected transport faults ride the retry
+    if not isinstance(err, grpc.RpcError):
+        return False
+    code = err.code() if callable(getattr(err, "code", None)) else None
+    return code in (
+        grpc.StatusCode.UNAVAILABLE,
+        grpc.StatusCode.DEADLINE_EXCEEDED,
+    )
 
 
 class SolverClient:
-    def __init__(self, target: str, timeout_seconds: float = 30.0):
+    def __init__(
+        self,
+        target: str,
+        timeout_seconds: float = DEFAULT_TIMEOUT_S,
+        retries: int = DEFAULT_RETRIES,
+        retry_jitter_s: float = DEFAULT_RETRY_JITTER_S,
+        seed: int = 0,
+    ):
         import grpc
 
         self.target = target
-        self.timeout = timeout_seconds
+        self.timeout = (
+            timeout_seconds if timeout_seconds else DEFAULT_TIMEOUT_S
+        )
+        self.retries = retries
+        self.retry_jitter_s = retry_jitter_s
+        self._rng = random.Random(seed)
         self._channel = grpc.insecure_channel(target)
         self._solve = self._channel.unary_unary(f"/{SERVICE}/Solve")
         self._decide = self._channel.unary_unary(f"/{SERVICE}/Decide")
         self._health = self._channel.unary_unary(f"/{SERVICE}/Health")
+
+    def _call(self, rpc, request, timeout: Optional[float] = None):
+        """One RPC under the default deadline, with one jittered retry on
+        transient transport failure. `sidecar.rpc` is the fault-injection
+        point (faults/registry.py)."""
+        deadline = timeout if timeout else self.timeout
+        attempts = 1 + max(0, self.retries)
+        for attempt in range(attempts):
+            try:
+                inject("sidecar.rpc")
+                return rpc(request, timeout=deadline)
+            except Exception as e:  # noqa: BLE001 — classified below
+                if attempt + 1 >= attempts or not _retryable_rpc_error(e):
+                    raise
+                delay = self._rng.uniform(0.0, self.retry_jitter_s)
+                logger().warning(
+                    "sidecar RPC failed (%s); retrying once in %.3fs",
+                    e, delay,
+                )
+                _time.sleep(delay)
 
     def solve(self, inputs, buckets: int = 32, backend: str = "auto"):
         """BinPackInputs -> BinPackOutputs via the sidecar (numpy-backed)."""
@@ -33,7 +99,7 @@ class SolverClient:
         request = codec.pack_dataclass(
             inputs, meta={"buckets": buckets, "backend": backend}
         )
-        response = self._solve(request, timeout=self.timeout)
+        response = self._call(self._solve, request)
         out, _ = codec.unpack_dataclass(BinPackOutputs, response)
         return out
 
@@ -41,15 +107,13 @@ class SolverClient:
         """DecisionInputs -> DecisionOutputs via the sidecar."""
         from karpenter_tpu.ops.decision import DecisionOutputs
 
-        response = self._decide(
-            codec.pack_dataclass(inputs), timeout=self.timeout
-        )
+        response = self._call(self._decide, codec.pack_dataclass(inputs))
         out, _ = codec.unpack_dataclass(DecisionOutputs, response)
         return out
 
     def health(self) -> Tuple[bool, Dict[str, Any]]:
         arrays, meta = codec.unpack(
-            self._health(codec.pack({}), timeout=self.timeout)
+            self._call(self._health, codec.pack({}))
         )
         return bool(arrays["ok"]), meta
 

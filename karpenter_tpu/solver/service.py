@@ -38,6 +38,20 @@ production pipeline:
     request: the control plane keeps producing signals through an
     accelerator outage, the posture every entry point in this repo
     takes (utils/backend.py).
+  * BACKEND HEALTH FSM + WATCHDOG (docs/resilience.md): per-request
+    fallback is the first rung; the FSM is the wholesale one. After
+    `health_failure_threshold` CONSECUTIVE device failures the service
+    trips to DEGRADED: every request routes straight to numpy with no
+    device attempt (a dead accelerator stops billing each request a
+    failed dispatch), and one probe dispatch per
+    `health_probe_interval_s` rides the device path — a probe success
+    flips back to HEALTHY. Separately, a watchdog (enabled by
+    `watchdog_timeout_s` > 0) detects a worker HUNG inside a device
+    call — the failure mode fallback can't catch, because the except
+    never runs — restarts the worker thread (generation-stamped; the
+    stale thread's late results are discarded) and drains the stuck
+    requests to numpy, so no caller waits out a dead device. Both
+    export karpenter_resilience_* metrics.
   * METRICS: queue depth, coalesce factor, compile-cache hits/misses,
     rejections/expiries/fallbacks, and per-stage latency percentiles,
     registered through the same GaugeRegistry the runtime serves on
@@ -59,6 +73,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from karpenter_tpu.faults import inject
 from karpenter_tpu.metrics.registry import GaugeRegistry, default_registry
 from karpenter_tpu.observability import solver_trace
 from karpenter_tpu.ops.binpack import DEFAULT_BUCKETS, BinPackInputs
@@ -86,6 +101,17 @@ STAGE_P50_MS = "stage_p50_ms"
 STAGE_P99_MS = "stage_p99_ms"
 WINDOW_MS = "window_ms"
 PIPELINE_DEPTH = "pipeline_depth"
+
+# Backend health FSM states (karpenter_resilience_solver_backend_state)
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+
+# Extra watchdog headroom for a dispatch that MISSED the compile cache:
+# first-call XLA/Mosaic compiles legitimately run tens of seconds (TPU
+# solver programs: 20-40s), and a restart mid-compile would loop — the
+# fresh worker would just compile again. Steady-state dispatches (cache
+# hits) get the bare watchdog_timeout_s.
+COMPILE_GRACE_S = 120.0
 
 _STAGE_WINDOW = 256  # per-stage latency ring size (fleet-scale constant)
 # Adaptive-window load tracking: EWMA of gathered batch sizes. Below the
@@ -123,6 +149,13 @@ class SolverStatistics:
     decide_errors: int = 0
     consolidate_calls: int = 0
     consolidate_candidates: int = 0
+    # backend health FSM + watchdog (docs/resilience.md)
+    device_failures: int = 0  # total device-path failures (any rung)
+    fsm_trips: int = 0  # healthy -> degraded transitions
+    fsm_recoveries: int = 0  # degraded -> healthy transitions
+    fsm_probes: int = 0  # device probes granted while degraded
+    fsm_short_circuits: int = 0  # batches routed to numpy with no attempt
+    watchdog_restarts: int = 0  # hung-worker restarts
 
 
 @dataclass
@@ -143,11 +176,21 @@ class _Request:
     # atomically and must ride ONE dispatch — _collect keeps draining the
     # queue past max_batch while the head continues the same batch
     coalesce_id: Optional[int] = None
+    _finish_lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False
+    )
 
-    def finish(self, result=None, error=None) -> None:
-        self.result = result
-        self.error = error
-        self.event.set()
+    def finish(self, result=None, error=None) -> bool:
+        """First finisher wins (idempotent): the watchdog may drain a
+        stuck request to numpy while the stale worker later unwedges and
+        tries to answer it too — the caller must see exactly one result."""
+        with self._finish_lock:
+            if self.event.is_set():
+                return False
+            self.result = result
+            self.error = error
+            self.event.set()
+            return True
 
 
 class SolveFuture:
@@ -198,6 +241,9 @@ class SolverService:
         device_solver: Optional[Callable] = None,
         decider: Optional[Callable] = None,
         clock: Callable[[], float] = _time.monotonic,
+        health_failure_threshold: int = 3,
+        health_probe_interval_s: float = 5.0,
+        watchdog_timeout_s: float = 0.0,  # 0 = watchdog disabled
     ):
         if on_timeout not in ("fallback", "raise"):
             raise ValueError(f"on_timeout must be fallback|raise, got {on_timeout!r}")
@@ -237,6 +283,26 @@ class SolverService:
         self._window_now_s = 0.0 if adaptive_window else window_s
         self._inflight: collections.deque = collections.deque()
         self._last_pipeline_depth = 0
+        # backend health FSM (module docstring): trips wholesale to numpy
+        # after K consecutive device failures, probes recovery
+        self.health_failure_threshold = health_failure_threshold
+        self.health_probe_interval_s = health_probe_interval_s
+        self.watchdog_timeout_s = watchdog_timeout_s
+        self._health_lock = threading.Lock()
+        self._health = HEALTHY
+        self._consec_device_failures = 0
+        self._next_probe = 0.0
+        # watchdog: generation-stamped worker threads; a restart bumps
+        # the generation and the superseded thread discards its results
+        self._worker_gen = 0
+        self._watchdog: Optional[threading.Thread] = None
+        self._busy_since: Optional[float] = None
+        self._busy_requests: List[_Request] = []
+        # the FULL batch the worker is currently processing (already
+        # popped from the queue): on a watchdog restart, groups not yet
+        # dispatched live only here and must be drained too
+        self._current_batch: List[_Request] = []
+        self._tls = threading.local()
         self._register_metrics()
 
     # -- metrics ----------------------------------------------------------
@@ -258,6 +324,22 @@ class SolverService:
         self._g_stage_p99 = reg(SUBSYSTEM, STAGE_P99_MS)
         self._g_window = reg(SUBSYSTEM, WINDOW_MS)
         self._g_pipeline = reg(SUBSYSTEM, PIPELINE_DEPTH)
+        # degradation-ladder surface (docs/resilience.md): FSM state
+        # (0 healthy / 1 degraded) + transition and watchdog counters
+        self._g_backend_state = reg("resilience", "solver_backend_state")
+        self._g_backend_state.set("-", "-", 0.0)
+        self._c_trips = reg(
+            "resilience", "solver_trips_total", kind="counter"
+        )
+        self._c_probes = reg(
+            "resilience", "solver_probes_total", kind="counter"
+        )
+        self._c_recoveries = reg(
+            "resilience", "solver_recoveries_total", kind="counter"
+        )
+        self._c_watchdog = reg(
+            "resilience", "solver_watchdog_restarts_total", kind="counter"
+        )
 
     def _record_stage(self, stage: str, seconds: float) -> None:
         ms = seconds * 1e3
@@ -572,19 +654,170 @@ class SolverService:
         if worker is not None:
             worker.join(timeout=5.0)
             self._worker = None
+        watchdog = self._watchdog
+        if watchdog is not None:
+            watchdog.join(timeout=2.0)
+            self._watchdog = None
+
+    # -- backend health FSM + watchdog ------------------------------------
+
+    def backend_health(self) -> str:
+        with self._health_lock:
+            return self._health
+
+    def _device_allowed(self) -> bool:
+        """Gate one batch's device attempt through the FSM: always in
+        HEALTHY; in DEGRADED only the periodic probe — everything else
+        short-circuits to numpy without billing a failed dispatch."""
+        with self._health_lock:
+            if self._health == HEALTHY:
+                return True
+            now = self._clock()
+            if now >= self._next_probe:
+                # this dispatch IS the recovery probe; schedule the next
+                # one now so concurrent groups don't all probe at once
+                self._next_probe = now + self.health_probe_interval_s
+                self.stats.fsm_probes += 1
+                self._c_probes.inc("-", "-")
+                return True
+            self.stats.fsm_short_circuits += 1
+            return False
+
+    def _record_device_failure(self) -> None:
+        with self._health_lock:
+            self.stats.device_failures += 1
+            self._consec_device_failures += 1
+            tripped = (
+                self._health == HEALTHY
+                and self._consec_device_failures
+                >= self.health_failure_threshold
+            )
+            if tripped:
+                self._health = DEGRADED
+                self._next_probe = (
+                    self._clock() + self.health_probe_interval_s
+                )
+                self.stats.fsm_trips += 1
+                self._c_trips.inc("-", "-")
+                self._g_backend_state.set("-", "-", 1.0)
+        if tripped:
+            logger().warning(
+                "solver backend DEGRADED after %d consecutive device "
+                "failures; serving from numpy, probing recovery every "
+                "%.1fs",
+                self._consec_device_failures,
+                self.health_probe_interval_s,
+            )
+
+    def _record_device_success(self) -> None:
+        with self._health_lock:
+            self._consec_device_failures = 0
+            recovered = self._health == DEGRADED
+            if recovered:
+                self._health = HEALTHY
+                self.stats.fsm_recoveries += 1
+                self._c_recoveries.inc("-", "-")
+                self._g_backend_state.set("-", "-", 0.0)
+        if recovered:
+            logger().info(
+                "solver backend recovered; device path re-enabled"
+            )
+
+    def _stale(self) -> bool:
+        """True on a worker thread superseded by a watchdog restart: its
+        late results are discarded (the watchdog already answered its
+        requests from numpy)."""
+        gen = getattr(self._tls, "gen", None)
+        return gen is not None and gen != self._worker_gen
+
+    @contextlib.contextmanager
+    def _device_section(self, requests: List[_Request], grace: float = 0.0):
+        """Mark the worker busy inside a device call — the window the
+        watchdog supervises. A hang here never raises, so supervision
+        must come from outside the thread. `grace` shifts the busy mark
+        forward (compile-miss dispatches get COMPILE_GRACE_S headroom)."""
+        with self._cond:
+            self._busy_since = self._clock() + grace
+            self._busy_requests = list(requests)
+        try:
+            yield
+        finally:
+            with self._cond:
+                if not self._stale():  # a restart already reset these
+                    self._busy_since = None
+                    self._busy_requests = []
+
+    def _watchdog_loop(self) -> None:
+        poll = max(0.05, self.watchdog_timeout_s / 4.0)
+        while not self._closed:
+            _time.sleep(poll)
+            self._watchdog_check()
+
+    def _watchdog_check(self) -> None:
+        """One supervision pass: if the worker has been inside a device
+        call longer than watchdog_timeout_s, supersede it (generation
+        bump + fresh thread) and drain every request it held — the stuck
+        batch AND the pipelined in-flight ones — to numpy."""
+        stuck: List[_Request] = []
+        with self._cond:
+            busy = self._busy_since
+            if busy is None or (
+                self._clock() - busy <= self.watchdog_timeout_s
+            ):
+                return
+            # everything the superseded worker holds: the stuck device
+            # batch, pipelined in-flight batches, AND the not-yet-
+            # dispatched groups of its current batch (already popped
+            # from the queue — they live nowhere else). Dedup by
+            # identity: a request can appear in more than one list.
+            stuck.extend(self._busy_requests)
+            for _out, live, _t in self._inflight:
+                stuck.extend(live)
+            stuck.extend(self._current_batch)
+            self._inflight.clear()
+            self._busy_since = None
+            self._busy_requests = []
+            self._current_batch = []
+            self.stats.watchdog_restarts += 1
+            self._c_watchdog.inc("-", "-")
+            if not self._closed:
+                self._spawn_worker()
+        stuck = list({id(r): r for r in stuck}.values())
+        logger().warning(
+            "solver worker hung in a device call > %.1fs; restarted the "
+            "worker and draining %d request(s) to numpy",
+            self.watchdog_timeout_s, len(stuck),
+        )
+        self._record_device_failure()  # a hang counts toward the FSM trip
+        self._finish_from_numpy(stuck)
 
     # -- worker -----------------------------------------------------------
+
+    def _spawn_worker(self) -> None:
+        # called under self._cond
+        self._worker_gen += 1
+        self._worker = threading.Thread(
+            target=self._run, args=(self._worker_gen,),
+            name="solver-service", daemon=True,
+        )
+        self._worker.start()
 
     def _ensure_worker(self) -> None:
         # called under self._cond
         if self._worker is None or not self._worker.is_alive():
-            self._worker = threading.Thread(
-                target=self._run, name="solver-service", daemon=True
+            self._spawn_worker()
+        if self.watchdog_timeout_s > 0 and (
+            self._watchdog is None or not self._watchdog.is_alive()
+        ):
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop, name="solver-watchdog",
+                daemon=True,
             )
-            self._worker.start()
+            self._watchdog.start()
 
-    def _run(self) -> None:
-        while True:
+    def _run(self, gen: Optional[int] = None) -> None:
+        self._tls.gen = gen
+        while not self._stale():
             if self._inflight:
                 # a dispatch is computing on device: gather the NEXT
                 # batch without blocking — if nothing is queued, the
@@ -601,11 +834,16 @@ class SolverService:
                 if batch is None:
                     self._drain_inflight()
                     return
+            with self._cond:
+                self._current_batch = list(batch)
             groups: Dict[tuple, List[_Request]] = {}
             for request in batch:
                 groups.setdefault(request.key, []).append(request)
             for key, requests in groups.items():
                 self._dispatch_group(key, requests)
+            with self._cond:
+                if not self._stale():  # a restart already drained it
+                    self._current_batch = []
             if not self._queue:
                 # nothing else waiting: complete in-flight work now
                 # rather than holding a lone batch's results hostage to
@@ -689,7 +927,9 @@ class SolverService:
         ):
             batch.append(self._queue.popleft())
 
-    def _dispatch_group(self, key: tuple, requests: List[_Request]) -> None:
+    def _filter_live(self, requests: List[_Request]) -> List[_Request]:
+        """Drop abandoned and queue-expired requests; the survivors are
+        the batch that actually dispatches."""
         now = self._clock()
         live: List[_Request] = []
         for request in requests:
@@ -703,29 +943,49 @@ class SolverService:
                 continue
             self._record_stage("queue_wait", now - request.enqueued_at)
             live.append(request)
+        return live
+
+    def _dispatch_group(self, key: tuple, requests: List[_Request]) -> None:
+        live = self._filter_live(requests)
         if not live:
             return
         self.stats.last_coalesce_factor = len(live)
         if len(live) > 1:
             self.stats.coalesced_batches += 1
         self._g_coalesce.set("-", "-", float(len(live)))
+        device_path = key[2] != "numpy"
+        if device_path and not self._device_allowed():
+            # FSM degraded, not this window's probe: serve the whole
+            # batch from numpy without attempting the sick device
+            self._finish_from_numpy(live)
+            return
         try:
             self._solve_group(key, live)
         except Exception as error:  # noqa: BLE001 — device failure path
+            if device_path and not self._stale():
+                self._record_device_failure()
             logger().warning(
                 "solver device path failed (%s: %s); degrading %d "
                 "request(s) to numpy",
                 type(error).__name__, error, len(live),
             )
-            for request in live:
-                try:
-                    request.finish(
-                        result=self._numpy_fallback(
-                            request.inputs, request.buckets
-                        )
+            self._finish_from_numpy(live)
+
+    def _finish_from_numpy(self, live: List[_Request]) -> None:
+        for request in live:
+            if request.event.is_set():
+                # already answered (watchdog drain vs. stale-worker
+                # unwind): don't burn a redundant host solve on a
+                # result finish() would discard anyway
+                continue
+            try:
+                request.finish(
+                    result=self._numpy_fallback(
+                        request.inputs, request.buckets
                     )
-                except Exception as numpy_error:  # noqa: BLE001
-                    request.finish(error=numpy_error)
+                )
+            except Exception as numpy_error:  # noqa: BLE001
+                request.finish(error=numpy_error)
 
     def _solve_group(self, key: tuple, live: List[_Request]) -> None:
         shape, buckets, backend = key[0], key[1], key[2]
@@ -743,23 +1003,36 @@ class SolverService:
                 )
                 self._record_stage("dispatch", _time.perf_counter() - t0)
             return
+        # the device-dispatch injection point (faults/registry.py): an
+        # error plan here exercises the per-request numpy fallback and
+        # the FSM trip; a hang plan blocks inside a supervised device
+        # section, exercising the watchdog restart + drain
+        with self._device_section(live):
+            inject("solver.dispatch")
         if self.device_solver is not None:
             self._drain_inflight()
-            for request in live:
-                t0 = _time.perf_counter()
-                out = self.device_solver(
-                    request.inputs, buckets=buckets, backend=backend
-                )
-                self._record_stage("dispatch", _time.perf_counter() - t0)
-                self._count_dispatch()
-                request.finish(result=out)
+            with self._device_section(live):
+                for request in live:
+                    t0 = _time.perf_counter()
+                    out = self.device_solver(
+                        request.inputs, buckets=buckets, backend=backend
+                    )
+                    self._record_stage(
+                        "dispatch", _time.perf_counter() - t0
+                    )
+                    self._count_dispatch()
+                    request.finish(result=out)
+            self._record_device_success()
             return
         if backend == "pallas":
             # the fused Mosaic kernel has no batched entry; requests
             # still share the bucketed shapes (compile stability) and
-            # the single worker (bounded device pressure)
+            # the single worker (bounded device pressure). Supervision
+            # happens per request inside (_solve_pallas), where the
+            # compile-miss grace is known.
             self._drain_inflight()
             self._solve_pallas(shape, buckets, live)
+            self._record_device_success()
             return
         self._begin_batched_xla(
             shape, buckets, live,
@@ -771,12 +1044,17 @@ class SolverService:
 
         from karpenter_tpu.ops import binpack as B
 
-        self._count_compile(("pallas", shape, buckets, live[0].key[3]))
+        fresh = self._count_compile(
+            ("pallas", shape, buckets, live[0].key[3])
+        )
+        grace = COMPILE_GRACE_S if fresh else 0.0
         for request in live:
             padded = pad_to_bucket(request.inputs, shape)
             t0 = _time.perf_counter()
-            out = B.solve(padded, buckets=buckets, backend="pallas")
-            jax.block_until_ready(out)
+            with self._device_section([request], grace=grace):
+                out = B.solve(padded, buckets=buckets, backend="pallas")
+                jax.block_until_ready(out)
+            grace = 0.0  # only the first call of the batch compiles
             self._record_stage("dispatch", _time.perf_counter() - t0)
             self._count_dispatch()
             request.finish(result=self._crop_host(out, request))
@@ -820,14 +1098,22 @@ class SolverService:
 
         import jax
 
-        fn = self._compiled_for(
+        fn, fresh = self._compiled_for(
             ("xla", shape, n_batch, buckets, live[0].key[3], strategy),
             donate=self._donation_supported(),
         )
         t0 = _time.perf_counter()
-        with solver_trace("solver.dispatch"):
-            stacked = jax.device_put(stacked)
-            out = fn(stacked, buckets)
+        with self._device_section(
+            live, grace=COMPILE_GRACE_S if fresh else 0.0
+        ):
+            with solver_trace("solver.dispatch"):
+                stacked = jax.device_put(stacked)
+                out = fn(stacked, buckets)
+        if self._stale():
+            # superseded by a watchdog restart while dispatching: the
+            # watchdog already answered these requests from numpy —
+            # discard the late device results
+            return
         if self._inflight:
             self.stats.pipeline_overlaps += 1
         self._inflight.append((out, live, t0))
@@ -853,13 +1139,18 @@ class SolverService:
         dispatch) it degenerates to the device latency; under load read
         it as "time a batch spent in flight" (docs/solver-service.md
         "Latency tuning")."""
-        if not self._inflight:
-            return
-        out, live, t_dispatch = self._inflight.popleft()
+        with self._cond:
+            # pop under the lock: the watchdog clears _inflight when it
+            # supersedes a hung worker, and a stale worker must not
+            # steal the NEW worker's in-flight batches
+            if self._stale() or not self._inflight:
+                return
+            out, live, t_dispatch = self._inflight.popleft()
         try:
             import jax
 
-            jax.block_until_ready(out)
+            with self._device_section(live):
+                jax.block_until_ready(out)
             self._record_stage(
                 "dispatch", _time.perf_counter() - t_dispatch
             )
@@ -870,21 +1161,16 @@ class SolverService:
                     result=self._crop_host(_index_outputs(host, i), request)
                 )
             self._record_stage("scatter", _time.perf_counter() - t0)
+            self._record_device_success()
         except Exception as error:  # noqa: BLE001 — device failure path
+            if not self._stale():
+                self._record_device_failure()
             logger().warning(
                 "solver device path failed in flight (%s: %s); degrading "
                 "%d request(s) to numpy",
                 type(error).__name__, error, len(live),
             )
-            for request in live:
-                try:
-                    request.finish(
-                        result=self._numpy_fallback(
-                            request.inputs, request.buckets
-                        )
-                    )
-                except Exception as numpy_error:  # noqa: BLE001
-                    request.finish(error=numpy_error)
+            self._finish_from_numpy(live)
 
     def _drain_inflight(self) -> None:
         while self._inflight:
@@ -911,19 +1197,21 @@ class SolverService:
             )
         return SolverService._donation_ok
 
-    def _compiled_for(self, cache_key: tuple, donate: bool = False) -> Callable:
-        """Compiled batched program for the cache key. donate=True marks
-        the stacked operand pytree donated (donate_argnums=0): the
+    def _compiled_for(self, cache_key: tuple, donate: bool = False):
+        """(compiled batched program, fresh) for the cache key — fresh
+        means the key was a compile-cache MISS, so the first dispatch
+        pays the compile (and gets the watchdog grace). donate=True
+        marks the stacked operand pytree donated (donate_argnums=0): the
         worker device_puts the stack first, so backends with donation
         support recycle the batch buffers instead of allocating fresh
         ones every dispatch; outputs are identical either way (the
         donation-parity test pins it). The flag is part of the cache key
         so the two program families never alias."""
         cache_key = (*cache_key, "donate" if donate else "keep")
-        self._count_compile(cache_key)
+        fresh = self._count_compile(cache_key)
         fn = self._compiled.get(cache_key)
         if fn is not None:
-            return fn
+            return fn, fresh
 
         from functools import partial
 
@@ -954,16 +1242,20 @@ class SolverService:
                 )
 
         self._compiled[cache_key] = batched
-        return batched
+        return batched, fresh
 
-    def _count_compile(self, cache_key: tuple) -> None:
+    def _count_compile(self, cache_key: tuple) -> bool:
+        """Count a compile-cache lookup; True = MISS (first sight of the
+        key — the following dispatch pays a fresh compile and earns the
+        watchdog's COMPILE_GRACE_S headroom)."""
         if cache_key in self._compile_seen:
             self.stats.compile_cache_hits += 1
             self._c_hits.inc("-", "-")
-        else:
-            self._compile_seen.add(cache_key)
-            self.stats.compile_cache_misses += 1
-            self._c_misses.inc("-", "-")
+            return False
+        self._compile_seen.add(cache_key)
+        self.stats.compile_cache_misses += 1
+        self._c_misses.inc("-", "-")
+        return True
 
     def _count_dispatch(self) -> None:
         self.stats.dispatches += 1

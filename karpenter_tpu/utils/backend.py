@@ -177,4 +177,16 @@ def configure_compile_cache(path: str) -> bool:
 
     jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # the cache SINGLETON latches its directory at the first compile: a
+    # process that already compiled anything (with the cache implicitly
+    # initialized as disabled) would silently ignore the new dir. Reset
+    # so the next compile re-initializes against `path`. The reset API
+    # is jax-internal; if a future jax drops it, the config above still
+    # covers the not-yet-initialized case.
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except (ImportError, AttributeError):
+        pass
     return True

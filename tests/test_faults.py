@@ -1,0 +1,609 @@
+"""Fault-injection registry (karpenter_tpu/faults) + degradation-ladder
+primitives (karpenter_tpu/resilience) + the engine's supervised requeue.
+
+Chaos SCENARIOS (whole-runtime runs under seeded fault plans) live in
+tests/test_chaos.py; this file pins the unit layer: plan semantics,
+determinism, the instrumented injection points, breaker/backoff math,
+and the engine ladder properties the satellite list names (backoff
+bounded+monotone, non-retryable deactivates exactly once).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from karpenter_tpu import faults
+from karpenter_tpu.controllers.engine import Manager
+from karpenter_tpu.controllers.errors import RetryableError, is_retryable
+from karpenter_tpu.faults import FaultInjected, FaultRegistry
+from karpenter_tpu.resilience import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+    DecorrelatedJitterBackoff,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_registry():
+    """Every test leaves the process with no active fault registry."""
+    yield
+    faults.uninstall()
+
+
+class FakeClock:
+    def __init__(self, start=1000.0):
+        self.now = start
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, seconds):
+        self.now += seconds
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+
+class TestFaultRegistry:
+    def test_inactive_is_noop(self):
+        faults.inject("solver.dispatch")  # no registry: must not raise
+
+    def test_error_plan_raises_typed_retryable(self):
+        with FaultRegistry(seed=1) as reg:
+            reg.plan("p", mode="error", code="Throttling")
+            with pytest.raises(FaultInjected) as e:
+                faults.inject("p")
+            assert e.value.code == "Throttling"
+            assert is_retryable(e.value)
+
+    def test_non_retryable_error_plan(self):
+        with FaultRegistry(seed=1) as reg:
+            reg.plan("p", retryable=False)
+            with pytest.raises(FaultInjected) as e:
+                faults.inject("p")
+            assert not is_retryable(e.value)
+
+    def test_flaky_fails_first_n_then_passes_forever(self):
+        with FaultRegistry(seed=1) as reg:
+            plan = reg.plan("p", mode="flaky", times=3)
+            for _ in range(3):
+                with pytest.raises(FaultInjected):
+                    faults.inject("p")
+            for _ in range(10):
+                faults.inject("p")  # healed
+            assert plan.fired == 3
+            assert plan.attempts == 13
+
+    def test_latency_plan_sleeps(self):
+        with FaultRegistry(seed=1) as reg:
+            reg.plan("p", mode="latency", latency_s=0.05, times=1)
+            t0 = time.perf_counter()
+            faults.inject("p")
+            assert time.perf_counter() - t0 >= 0.05
+            faults.inject("p")  # exhausted: no sleep, no error
+
+    def test_hang_blocks_until_released_then_raises(self):
+        reg = faults.install(FaultRegistry(seed=1))
+        reg.plan("p", mode="hang", times=1)
+        state = {}
+
+        def hit():
+            try:
+                faults.inject("p")
+            except FaultInjected as e:
+                state["error"] = e
+
+        thread = threading.Thread(target=hit, daemon=True)
+        thread.start()
+        thread.join(timeout=0.2)
+        assert thread.is_alive(), "hang plan must block the caller"
+        faults.uninstall()  # releases hangs
+        thread.join(timeout=5.0)
+        assert not thread.is_alive()
+        assert state["error"].code == "FaultHangReleased"
+
+    def test_prefix_plan_matches_family(self):
+        with FaultRegistry(seed=1) as reg:
+            reg.plan("cloud.*")
+            with pytest.raises(FaultInjected):
+                faults.inject("cloud.get_replicas")
+            with pytest.raises(FaultInjected):
+                faults.inject("cloud.set_replicas")
+            faults.inject("metrics.query")  # unmatched point passes
+
+    def test_probability_sequence_is_seed_deterministic(self):
+        def pattern(seed):
+            reg = FaultRegistry(seed=seed)
+            plan = reg.plan("p", probability=0.5)
+            fired = []
+            with reg:
+                for _ in range(64):
+                    try:
+                        faults.inject("p")
+                        fired.append(False)
+                    except FaultInjected:
+                        fired.append(True)
+            assert plan.attempts == 64
+            return fired
+
+        a, b = pattern(7), pattern(7)
+        assert a == b, "same seed must replay the same firing sequence"
+        assert any(a) and not all(a), "p=0.5 over 64 tries fires some"
+        assert pattern(8) != a, "different seed, different sequence"
+
+    def test_counters_and_metrics_export(self):
+        from karpenter_tpu.metrics.registry import GaugeRegistry
+
+        gauges = GaugeRegistry()
+        with FaultRegistry(seed=1, registry=gauges) as reg:
+            reg.plan("p", times=1)
+            with pytest.raises(FaultInjected):
+                faults.inject("p")
+            faults.inject("p")
+            faults.inject("q")
+        assert reg.attempts == {"p": 2, "q": 1}
+        assert reg.injected == {"p": 1}
+        text = gauges.expose_text()
+        assert 'karpenter_faults_attempts_total{name="p"' in text
+        assert 'karpenter_faults_injected_total{name="p"' in text
+
+
+# ---------------------------------------------------------------------------
+# instrumented injection points
+# ---------------------------------------------------------------------------
+
+
+class TestInjectionPoints:
+    def test_store_patch_status(self):
+        from karpenter_tpu.api.core import ObjectMeta
+        from karpenter_tpu.api.scalablenodegroup import (
+            ScalableNodeGroup,
+            ScalableNodeGroupSpec,
+        )
+        from karpenter_tpu.store import Store
+
+        store = Store()
+        sng = store.create(
+            ScalableNodeGroup(
+                metadata=ObjectMeta(name="g"),
+                spec=ScalableNodeGroupSpec(
+                    replicas=1, type="FakeNodeGroup", id="g"
+                ),
+            )
+        )
+        with FaultRegistry(seed=1) as reg:
+            reg.plan("store.patch_status", times=1)
+            with pytest.raises(FaultInjected):
+                store.patch_status(sng)
+            store.patch_status(sng)  # exhausted: healthy again
+
+    def test_metrics_client_query(self):
+        from karpenter_tpu.api.horizontalautoscaler import (
+            Metric,
+            MetricTarget,
+            PrometheusMetricSource,
+        )
+        from karpenter_tpu.metrics.clients import RegistryMetricsClient
+        from karpenter_tpu.metrics.registry import GaugeRegistry
+
+        gauges = GaugeRegistry()
+        gauges.register("queue", "length").set("q", "default", 3.0)
+        client = RegistryMetricsClient(gauges)
+        spec = Metric(
+            prometheus=PrometheusMetricSource(
+                query='karpenter_queue_length{name="q"}',
+                target=MetricTarget(type="AverageValue", value=4),
+            )
+        )
+        assert client.get_current_value(spec).value == 3.0
+        with FaultRegistry(seed=1) as reg:
+            reg.plan("metrics.query")
+            with pytest.raises(FaultInjected):
+                client.get_current_value(spec)
+
+    def test_fake_provider_replicas(self):
+        from karpenter_tpu.cloudprovider.fake import FakeFactory
+
+        factory = FakeFactory()
+        factory.node_replicas["g"] = 4
+        group = factory.node_group_for(
+            type("Spec", (), {"id": "g", "type": "FakeNodeGroup"})()
+        )
+        with FaultRegistry(seed=1) as reg:
+            reg.plan("cloud.*", times=2, code="Throttling")
+            with pytest.raises(FaultInjected):
+                group.get_replicas()
+            with pytest.raises(FaultInjected):
+                group.set_replicas(9)
+            # atomic: the failed set must not have applied
+            assert factory.node_replicas["g"] == 4
+            assert group.get_replicas() == 4
+
+    def test_encoder_encode(self):
+        from karpenter_tpu.metrics.producers.pendingcapacity import (
+            encode_snapshot,
+        )
+
+        with FaultRegistry(seed=1) as reg:
+            reg.plan("encoder.encode")
+            with pytest.raises(FaultInjected):
+                encode_snapshot(None, [])
+
+    def test_solver_dispatch_falls_back_to_numpy(self):
+        from karpenter_tpu.metrics.registry import GaugeRegistry
+        from karpenter_tpu.ops.numpy_binpack import binpack_numpy
+        from karpenter_tpu.solver import SolverService
+        from test_binpack import make_inputs
+
+        import numpy as np
+
+        inputs = make_inputs(
+            pod_requests=[[1, 1], [3, 1]], group_allocatable=[[4, 4]]
+        )
+        service = SolverService(
+            registry=GaugeRegistry(), backend="xla",
+            health_failure_threshold=100,
+        )
+        try:
+            with FaultRegistry(seed=1) as reg:
+                reg.plan("solver.dispatch", times=1)
+                out = service.solve(inputs, buckets=8)
+            expect = binpack_numpy(inputs, buckets=8)
+            np.testing.assert_array_equal(
+                np.asarray(out.assigned), np.asarray(expect.assigned)
+            )
+            assert service.stats.fallbacks == 1
+            assert service.stats.device_failures == 1
+        finally:
+            service.close()
+
+    def test_sidecar_rpc_retries_once_with_jitter(self):
+        grpc = pytest.importorskip("grpc")  # noqa: F841 — client needs it
+        from karpenter_tpu.sidecar.client import SolverClient
+
+        client = SolverClient("127.0.0.1:1", retry_jitter_s=0.01)
+        calls = []
+
+        def fake_rpc(request, timeout=None):
+            calls.append(timeout)
+            return b"ok"
+
+        with FaultRegistry(seed=1) as reg:
+            reg.plan("sidecar.rpc", mode="flaky", times=1)
+            assert client._call(fake_rpc, b"") == b"ok"
+        # first attempt consumed by the injected fault, second landed
+        assert calls == [client.timeout]
+        # a SECOND consecutive transport failure surfaces to the caller
+        with FaultRegistry(seed=1) as reg:
+            reg.plan("sidecar.rpc", mode="flaky", times=2)
+            with pytest.raises(FaultInjected):
+                client._call(fake_rpc, b"")
+        client.close()
+
+    def test_sidecar_rpc_always_has_deadline(self):
+        pytest.importorskip("grpc")
+        from karpenter_tpu.sidecar.client import SolverClient
+
+        client = SolverClient("127.0.0.1:1", timeout_seconds=0)
+        seen = {}
+
+        def fake_rpc(request, timeout=None):
+            seen["timeout"] = timeout
+            return b"ok"
+
+        client._call(fake_rpc, b"")
+        assert seen["timeout"] and seen["timeout"] > 0
+        client.close()
+
+
+# ---------------------------------------------------------------------------
+# ladder primitives
+# ---------------------------------------------------------------------------
+
+
+class TestDecorrelatedJitterBackoff:
+    def test_monotone_and_bounded(self):
+        backoff = DecorrelatedJitterBackoff(base_s=1.0, cap_s=30.0, seed=3)
+        prev = 0.0
+        delays = []
+        for _ in range(64):
+            prev = backoff.next(prev)
+            delays.append(prev)
+        assert all(
+            later >= earlier
+            for earlier, later in zip(delays, delays[1:])
+        ), "decorrelated-jitter ladder must never speed back up"
+        assert all(1.0 <= d <= 30.0 for d in delays)
+        assert delays[-1] == 30.0, "repeated failures saturate at the cap"
+
+    def test_seeded_determinism(self):
+        seq = [
+            DecorrelatedJitterBackoff(seed=5).next(0.0) for _ in range(2)
+        ]
+        assert seq[0] == seq[1]
+
+    def test_rejects_bad_bounds(self):
+        with pytest.raises(ValueError):
+            DecorrelatedJitterBackoff(base_s=10.0, cap_s=1.0)
+
+
+class TestCircuitBreaker:
+    def make(self, clock, threshold=3, reset=30.0):
+        return CircuitBreaker(
+            failure_threshold=threshold, reset_s=reset, clock=clock
+        )
+
+    def test_opens_after_threshold_then_half_open_probe(self):
+        clock = FakeClock()
+        breaker = self.make(clock)
+        for _ in range(2):
+            assert breaker.allow()
+            breaker.record_failure("Throttling")
+        assert breaker.state == CLOSED
+        breaker.record_failure("Throttling")
+        assert breaker.state == OPEN
+        assert breaker.last_error_code == "Throttling"
+        assert not breaker.allow(), "open circuit blocks"
+        assert breaker.retry_in() == pytest.approx(30.0)
+        clock.advance(31)
+        assert breaker.allow(), "reset window admits the half-open probe"
+        assert breaker.state == HALF_OPEN
+        assert not breaker.allow(), "only ONE probe per window"
+        breaker.record_success()
+        assert breaker.state == CLOSED
+        assert breaker.allow()
+
+    def test_failed_probe_reopens_fresh_window(self):
+        clock = FakeClock()
+        breaker = self.make(clock, threshold=1, reset=10.0)
+        breaker.record_failure("X")
+        assert breaker.state == OPEN
+        clock.advance(11)
+        assert breaker.allow()
+        breaker.record_failure("Y")
+        assert breaker.state == OPEN
+        assert not breaker.allow(), "fresh open window after failed probe"
+        assert breaker.opens_total == 2
+
+    def test_non_retryable_probe_failure_does_not_wedge_half_open(self):
+        """A probe reconcile dying on a NON-retryable error must still
+        record an outcome: the SNG controller records the failure before
+        re-raising, so the breaker re-opens a fresh window instead of
+        wedging in HALF_OPEN (where allow() is False forever and no
+        probe is ever admitted again)."""
+        from karpenter_tpu.cloudprovider.fake import (
+            FakeFactory,
+            retryable_error,
+        )
+        from karpenter_tpu.controllers.scalablenodegroup import (
+            ScalableNodeGroupController,
+        )
+        from karpenter_tpu.store import Store
+
+        clock = FakeClock()
+        provider = FakeFactory()
+        provider.node_replicas["g"] = 1
+        controller = ScalableNodeGroupController(
+            provider, circuit_failure_threshold=2, circuit_reset_s=10.0,
+            clock=clock,
+        )
+        store = Store()
+        sng = store.create(_sng())
+        provider.want_err = retryable_error("Throttling")
+        controller.reconcile(sng)
+        controller.reconcile(sng)  # opens
+        breaker = controller._breaker(sng)
+        assert breaker.state == OPEN
+        clock.advance(11)
+        provider.want_err = RuntimeError("hard provider bug")
+        with pytest.raises(RuntimeError):
+            controller.reconcile(sng)  # the half-open probe
+        assert breaker.state == OPEN, "failed probe must re-open"
+        assert breaker.retry_in() > 0
+        clock.advance(11)
+        provider.want_err = None
+        controller.reconcile(sng)  # next probe heals
+        assert breaker.state == CLOSED
+
+    def test_deleted_group_prunes_breaker_state(self):
+        """A recreated node group must start with a CLOSED circuit, not
+        inherit the deleted group's open one (engine on_deleted hook)."""
+        from karpenter_tpu.cloudprovider.fake import (
+            FakeFactory,
+            retryable_error,
+        )
+        from karpenter_tpu.controllers.scalablenodegroup import (
+            ScalableNodeGroupController,
+        )
+        from karpenter_tpu.store import Store
+
+        clock = FakeClock()
+        provider = FakeFactory()
+        provider.want_err = retryable_error("Throttling")
+        controller = ScalableNodeGroupController(
+            provider, circuit_failure_threshold=1, clock=clock
+        )
+        store = Store()
+        manager = Manager(store, clock=clock).register(controller)
+        store.create(_sng())
+        clock.advance(10_000)
+        manager.reconcile_all()
+        assert controller._breaker(store.get(*self.KEY)).state == OPEN
+        store.delete("ScalableNodeGroup", "default", "g")
+        assert controller._breakers == {}
+        provider.want_err = None
+        provider.node_replicas["g"] = 1
+        recreated = store.create(_sng())
+        assert controller._breaker(recreated).state == CLOSED
+
+    KEY = ("ScalableNodeGroup", "default", "g")
+
+
+class TestRetryableTaxonomy:
+    def test_metric_query_error_is_retryable(self):
+        """A failed metric read must ride the backoff ladder, never
+        deactivate the autoscaler: the metric can appear later with no
+        watch event on the HA to revive it."""
+        from karpenter_tpu.metrics.clients import MetricQueryError
+
+        assert is_retryable(MetricQueryError("no metric named x"))
+
+    def test_missing_scale_target_is_retryable(self):
+        """Same posture for a missing scale target: creating the target
+        fires no watch event on the HA, so deactivation would strand it."""
+        from karpenter_tpu.autoscaler import BatchAutoscaler
+        from karpenter_tpu.metrics.clients import MetricsClientFactory
+        from karpenter_tpu.metrics.registry import GaugeRegistry
+        from karpenter_tpu.store import Store
+        from test_chaos import queue_ha
+
+        store = Store()
+        autoscaler = BatchAutoscaler(
+            MetricsClientFactory(registry=GaugeRegistry()), store
+        )
+        ha = queue_ha("missing-target", 'karpenter_queue_length{name="q"}')
+        row = autoscaler._snapshot_row(ha)
+        assert row.error is not None
+        assert is_retryable(row.error)
+
+
+# ---------------------------------------------------------------------------
+# engine requeue ladder (satellite: property tests)
+# ---------------------------------------------------------------------------
+
+
+def _sng(name="g"):
+    from karpenter_tpu.api.core import ObjectMeta
+    from karpenter_tpu.api.scalablenodegroup import (
+        ScalableNodeGroup,
+        ScalableNodeGroupSpec,
+    )
+
+    return ScalableNodeGroup(
+        metadata=ObjectMeta(name=name),
+        spec=ScalableNodeGroupSpec(
+            replicas=1, type="FakeNodeGroup", id=name
+        ),
+    )
+
+
+class CountingController:
+    """Minimal controller whose reconcile raises what the test injects."""
+
+    def __init__(self, error_factory=None):
+        self.error_factory = error_factory
+        self.calls = 0
+
+    def kind(self):
+        return "ScalableNodeGroup"
+
+    def interval(self):
+        return 60.0
+
+    def reconcile(self, obj):
+        self.calls += 1
+        if self.error_factory is not None:
+            raise self.error_factory()
+
+
+class TestEngineBackoffLadder:
+    KEY = ("ScalableNodeGroup", "default", "g")
+
+    def make(self, error_factory, cap_s=30.0):
+        from karpenter_tpu.store import Store
+
+        clock = FakeClock()
+        store = Store()
+        controller = CountingController(error_factory)
+        manager = Manager(
+            store, clock=clock, backoff_base_s=1.0, backoff_cap_s=cap_s
+        ).register(controller)
+        store.create(_sng())
+        return manager, controller, clock
+
+    def test_retryable_backoff_bounded_and_monotone(self):
+        manager, controller, clock = self.make(
+            lambda: RetryableError("throttled", code="Throttling")
+        )
+        delays = []
+        for i in range(40):
+            clock.advance(10_000)  # always past any scheduled backoff
+            manager.reconcile_all()
+            assert controller.calls == i + 1, "retryable keeps retrying"
+            delay = manager._due[self.KEY] - clock.now
+            assert 0 < delay <= 30.0, "backoff must respect the cap"
+            delays.append(delay)
+        assert all(
+            later >= earlier
+            for earlier, later in zip(delays, delays[1:])
+        ), "per-object backoff must be monotone under repeated failures"
+        assert delays[0] < delays[-1] == 30.0
+
+    def test_backoff_resets_after_success(self):
+        manager, controller, clock = self.make(
+            lambda: RetryableError("throttled")
+        )
+        for _ in range(10):
+            clock.advance(10_000)
+            manager.reconcile_all()
+        controller.error_factory = None  # dependency heals
+        clock.advance(10_000)
+        manager.reconcile_all()
+        assert manager._due[self.KEY] - clock.now == pytest.approx(60.0), (
+            "success requeues at the controller interval again"
+        )
+        assert self.KEY not in manager._backoff_prev
+
+    def test_non_retryable_deactivates_exactly_once(self):
+        manager, controller, clock = self.make(
+            lambda: RuntimeError("poisoned spec")
+        )
+        for _ in range(8):
+            clock.advance(10_000)
+            manager.reconcile_all()
+        assert controller.calls == 1, (
+            "a non-retryable error must deactivate the object: exactly "
+            "one reconcile, no retries"
+        )
+        assert manager._due[self.KEY] == float("inf")
+        obj = manager.store.get(*self.KEY)
+        from karpenter_tpu.api import conditions as cond
+
+        assert (
+            obj.status_conditions().get(cond.ACTIVE).status == cond.FALSE
+        )
+
+    def test_watch_event_revives_deactivated_object(self):
+        manager, controller, clock = self.make(
+            lambda: RuntimeError("poisoned spec")
+        )
+        clock.advance(10_000)
+        manager.reconcile_all()
+        assert controller.calls == 1
+        controller.error_factory = None
+        obj = manager.store.get(*self.KEY)
+        obj.spec.replicas = 2  # the operator fixes the spec
+        manager.store.update(obj)
+        clock.advance(10_000)
+        manager.reconcile_all()
+        assert controller.calls == 2, "an external edit revives the object"
+        assert manager._due[self.KEY] < float("inf")
+
+    def test_failed_status_patch_requeues_with_backoff(self):
+        manager, controller, clock = self.make(None)
+        with FaultRegistry(seed=1) as reg:
+            reg.plan("store.patch_status", times=1)
+            clock.advance(10_000)
+            manager.reconcile_all()  # must not raise
+        delay = manager._due[self.KEY] - clock.now
+        assert 0 < delay <= 30.0, "patch failure rides the backoff ladder"
+        clock.advance(10_000)
+        manager.reconcile_all()
+        assert manager._due[self.KEY] - clock.now == pytest.approx(60.0)

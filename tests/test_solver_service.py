@@ -606,7 +606,7 @@ class TestPipelinedDispatch:
             real = svc._compiled_for
 
             def exploding(cache_key, donate=False):
-                fn = real(cache_key, donate=donate)
+                fn, fresh = real(cache_key, donate=donate)
 
                 def wrapped(stacked, buckets):
                     calls["n"] += 1
@@ -617,7 +617,7 @@ class TestPipelinedDispatch:
                         out, assigned=_Exploding()
                     )
 
-                return wrapped
+                return wrapped, fresh
 
             class _Exploding:
                 def block_until_ready(self):
@@ -661,8 +661,8 @@ class TestDonationParity:
             padded = pad_to_bucket(inputs, shape)
             key = ("xla", shape, 1, 32, (False, False, False, False),
                    "map")
-            keep = svc._compiled_for(key, donate=False)
-            donate = svc._compiled_for(key, donate=True)
+            keep, _ = svc._compiled_for(key, donate=False)
+            donate, _ = svc._compiled_for(key, donate=True)
             out_keep = jax.device_get(
                 keep(jax.device_put(_stack_inputs([padded])), 32)
             )
