@@ -68,6 +68,11 @@ bench-consolidate: ## Batched vs sequential drain-candidate evaluation (32 candi
 		--backend xla --iters 10 \
 		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
 
+bench-forecast: ## Batched one-dispatch fleet forecast vs per-series loop (512 series x 64 samples); appends a BENCHMARKS row + publishes to BASELINE.json
+	$(PYTHON) bench.py --forecast --series 512 --history 64 \
+		--iters 10 \
+		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
+
 dryrun: ## Multi-chip sharding compile check on 8 virtual CPU devices
 	$(PYTHON) -c "import os; \
 		os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS','') + ' --xla_force_host_platform_device_count=8').strip(); \
@@ -105,5 +110,5 @@ kind-smoke: ## Deploy smoke on kind: image -> apply -> pod Ready -> one HA end t
 	bash hack/kind-smoke.sh
 
 .PHONY: help dev ci test test-chaos battletest verify codegen docs native \
-	bench bench-solver bench-consolidate dryrun image publish apply \
-	delete kind-load conformance kind-smoke
+	bench bench-solver bench-consolidate bench-forecast dryrun image \
+	publish apply delete kind-load conformance kind-smoke

@@ -451,6 +451,26 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
         "drain candidate); --pods spread across them",
     )
     ap.add_argument(
+        "--forecast",
+        action="store_true",
+        help="benchmark the batched forecast kernel "
+        "(karpenter_tpu/forecast): --series metric series forecast in "
+        "ONE device dispatch vs the same series dispatched one at a "
+        "time; reports series/sec both ways and the speedup",
+    )
+    ap.add_argument(
+        "--series",
+        type=int,
+        default=512,
+        help="with --forecast: number of metric series in the fleet",
+    )
+    ap.add_argument(
+        "--history",
+        type=int,
+        default=64,
+        help="with --forecast: history samples per series",
+    )
+    ap.add_argument(
         "--publish-baseline",
         action="store_true",
         help="with --solver-service: write the result into BASELINE.json's "
@@ -552,16 +572,35 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
         ap.error("--candidates must be >= 2 (a drain needs a receiver)")
     if args.concurrency < 1:
         ap.error("--concurrency must be >= 1")
+    if args.forecast and (
+        args.mesh or args.e2e or args.decide or args.clusters
+        or args.solver_service or args.hotpath or args.consolidate
+    ):
+        ap.error(
+            "--forecast builds its own workload (metric histories); it "
+            "cannot combine with other modes"
+        )
+    if args.series < 2:
+        ap.error("--series must be >= 2")
+    if args.history < 4:
+        ap.error("--history must be >= 4")
     if (args.publish_baseline or args.append_benchmarks) and not (
         args.solver_service or args.consolidate or args.hotpath
+        or args.forecast
     ):
         ap.error(
             "--publish-baseline/--append-benchmarks only apply to "
-            "--solver-service/--consolidate/--hotpath (nothing would be "
-            "published otherwise)"
+            "--solver-service/--consolidate/--hotpath/--forecast "
+            "(nothing would be published otherwise)"
         )
 
-    if args.hotpath:
+    if args.forecast:
+        metric = (
+            f"batched metric forecast p50, {args.series} series x "
+            f"{args.history} history samples (Holt-Winters + robust "
+            f"linear, one dispatch vs per-series loop)"
+        )
+    elif args.hotpath:
         metric = (
             f"solver-service idle-queue bin-pack p50 latency, "
             f"{args.pods} pods x {args.types} instance types "
@@ -671,11 +710,14 @@ def _bench_inputs(args):
     )
 
 
-def run(args, metric: str, note: str) -> None:
+def run(args, metric: str, note: str) -> None:  # lint: allow-complexity — bench mode dispatch, one arm per measured configuration
     import jax
 
     _warm_native_kernel(args)
 
+    if args.forecast:
+        run_forecast(args, metric, note)
+        return
     if args.hotpath:
         run_hotpath(args, metric, note)
         return
@@ -783,14 +825,14 @@ def _solver_service_record(args, backend, direct, service, svc) -> dict:
     }
 
 
-def _publish_solver_baseline(record: dict) -> None:
-    """Land the result in BASELINE.json's `published` map (the satellite
-    contract: measured configs graduate from claim to committed data)."""
+def _publish_to_baseline(key: str, record: dict) -> None:
+    """Land a result in BASELINE.json's `published` map (the satellite
+    contract: measured configs graduate from claim to committed data).
+    Shared by every publishing bench mode."""
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "BASELINE.json")
     with open(path) as f:
         baseline = json.load(f)
-    key = f"{record['config']} solver service ({record['backend']})"
     baseline.setdefault("published", {})[key] = {
         k: v for k, v in record.items() if k != "config"
     }
@@ -800,9 +842,29 @@ def _publish_solver_baseline(record: dict) -> None:
     print(f"published to BASELINE.json: {key}", file=sys.stderr)
 
 
+def _append_table_row(path: str, marker: str, header: str, row: str) -> None:
+    """Append one markdown row to the benchmarks table identified by
+    `marker`, creating the section (at end of file) on first use.
+    Shared by every publishing bench mode."""
+    with open(path) as f:
+        content = f.read()
+    if marker not in content:
+        content = content.rstrip("\n") + "\n" + header
+    with open(path, "w") as f:
+        f.write(content.rstrip("\n") + "\n" + row)
+    print(f"appended row to {path}", file=sys.stderr)
+
+
+def _publish_solver_baseline(record: dict) -> None:
+    _publish_to_baseline(
+        f"{record['config']} solver service ({record['backend']})", record
+    )
+
+
 def _append_benchmarks_row(path: str, record: dict) -> None:
+    marker = "## Solver service (make bench-solver)"
     header = (
-        "\n## Solver service (make bench-solver)\n\n"
+        f"\n{marker}\n\n"
         "Direct `ops/binpack` calls vs. the shared solve service "
         "(coalescing + shape-bucketed compile cache), same concurrent "
         "load on both paths.\n\n"
@@ -819,13 +881,7 @@ def _append_benchmarks_row(path: str, record: dict) -> None:
         f"| {record['avg_coalesce_factor']}x "
         f"| {record['dispatches']} |\n"
     )
-    with open(path) as f:
-        content = f.read()
-    if "## Solver service (make bench-solver)" not in content:
-        content = content.rstrip("\n") + "\n" + header
-    with open(path, "w") as f:
-        f.write(content.rstrip("\n") + "\n" + row)
-    print(f"appended row to {path}", file=sys.stderr)
+    _append_table_row(path, marker, header, row)
 
 
 def run_solver_service(args, metric: str, note: str) -> None:
@@ -958,23 +1014,15 @@ def _hotpath_record(args, backend, direct_idle, service_idle,
 
 
 def _publish_hotpath_baseline(record: dict) -> None:
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "BASELINE.json")
-    with open(path) as f:
-        baseline = json.load(f)
-    key = f"{record['config']} solver hotpath ({record['backend']})"
-    baseline.setdefault("published", {})[key] = {
-        k: v for k, v in record.items() if k != "config"
-    }
-    with open(path, "w") as f:
-        json.dump(baseline, f, indent=2)
-        f.write("\n")
-    print(f"published to BASELINE.json: {key}", file=sys.stderr)
+    _publish_to_baseline(
+        f"{record['config']} solver hotpath ({record['backend']})", record
+    )
 
 
 def _append_hotpath_row(path: str, record: dict) -> None:
+    marker = "## Solver hot path (make bench-hotpath)"
     header = (
-        "\n## Solver hot path (make bench-hotpath)\n\n"
+        f"\n{marker}\n\n"
         "Idle-queue single-caller latency through the service vs a "
         "direct `ops/binpack` call — the adaptive-window guard (the "
         "ratio is the acceptance bound) — plus the coalesce factor "
@@ -1000,13 +1048,7 @@ def _append_hotpath_row(path: str, record: dict) -> None:
         f"| {record['avg_coalesce_factor']}x @ {record['concurrency']} "
         f"| {breakdown} |\n"
     )
-    with open(path) as f:
-        content = f.read()
-    if "## Solver hot path (make bench-hotpath)" not in content:
-        content = content.rstrip("\n") + "\n" + header
-    with open(path, "w") as f:
-        f.write(content.rstrip("\n") + "\n" + row)
-    print(f"appended row to {path}", file=sys.stderr)
+    _append_table_row(path, marker, header, row)
 
 
 def run_hotpath(args, metric: str, note: str) -> None:
@@ -1210,23 +1252,15 @@ def _consolidate_record(args, backend, batched, sequential,
 
 
 def _publish_consolidate_baseline(record: dict) -> None:
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "BASELINE.json")
-    with open(path) as f:
-        baseline = json.load(f)
-    key = f"{record['config']} ({record['backend']})"
-    baseline.setdefault("published", {})[key] = {
-        k: v for k, v in record.items() if k != "config"
-    }
-    with open(path, "w") as f:
-        json.dump(baseline, f, indent=2)
-        f.write("\n")
-    print(f"published to BASELINE.json: {key}", file=sys.stderr)
+    _publish_to_baseline(
+        f"{record['config']} ({record['backend']})", record
+    )
 
 
 def _append_consolidate_row(path: str, record: dict) -> None:
+    marker = "## Consolidation (make bench-consolidate)"
     header = (
-        "\n## Consolidation (make bench-consolidate)\n\n"
+        f"\n{marker}\n\n"
         "Batched drain-candidate evaluation (`service.consolidate`: one "
         "device dispatch for every candidate in a shape bucket) vs. the "
         "same masked bin-packs submitted sequentially through the "
@@ -1242,13 +1276,7 @@ def _append_consolidate_row(path: str, record: dict) -> None:
         f"| {record['batched_cps']} | {record['sequential_cps']} "
         f"| {record['speedup']}x |\n"
     )
-    with open(path) as f:
-        content = f.read()
-    if "## Consolidation (make bench-consolidate)" not in content:
-        content = content.rstrip("\n") + "\n" + header
-    with open(path, "w") as f:
-        f.write(content.rstrip("\n") + "\n" + row)
-    print(f"appended row to {path}", file=sys.stderr)
+    _append_table_row(path, marker, header, row)
 
 
 def _warm_and_check_consolidate(svc, inputs, args) -> int:
@@ -1346,6 +1374,170 @@ def run_consolidate(args, metric: str, note: str) -> None:
         f"candidates/sec batched vs sequential "
         f"({record['speedup']}x); {record['drainable']}/"
         f"{record['candidates']} drainable"
+    )
+    emit(
+        f"{metric} ({jax.default_backend()})",
+        record["batched_p50_ms"],
+        note=f"{note}; {extra}" if note else extra,
+        against_baseline=False,
+    )
+
+
+def build_forecast_inputs(series: int, history: int, seed: int):
+    """A fleet of metric histories: mixed flat/ramping/seasonal series
+    with gaps, half Holt-Winters and half robust-linear — the shape the
+    BatchAutoscaler hands the service every tick."""
+    from karpenter_tpu.forecast.models import ForecastInputs
+
+    rng = np.random.RandomState(seed)
+    S, T = series, history
+    base = rng.uniform(5, 500, (S, 1)).astype(np.float32)
+    slope = rng.uniform(-0.5, 2.0, (S, 1)).astype(np.float32)
+    ticks = np.arange(T, dtype=np.float32)[None, :]
+    seasonal = (
+        rng.uniform(0, 30, (S, 1))
+        * np.sin(ticks * 2 * np.pi / 12)
+    ).astype(np.float32)
+    noise = rng.normal(0, 3, (S, T)).astype(np.float32)
+    values = (base + slope * ticks * 10.0 + seasonal + noise).astype(
+        np.float32
+    )
+    valid = rng.rand(S, T) > 0.1
+    times = ((ticks - (T - 1)) * 10.0).repeat(S, axis=0).astype(np.float32)
+    horizon = rng.uniform(30, 120, S).astype(np.float32)
+    weights = np.power(
+        np.float32(0.5), (-times) / horizon[:, None]
+    ).astype(np.float32)
+    return ForecastInputs(
+        values=values, valid=valid, times=times, weights=weights,
+        horizon=horizon,
+        step_s=np.full(S, 10.0, np.float32),
+        model=(np.arange(S) % 2).astype(np.int32),
+        season=rng.choice([0, 6, 12], S).astype(np.int32),
+        alpha=np.full(S, 0.5, np.float32),
+        beta=np.full(S, 0.1, np.float32),
+        gamma=np.full(S, 0.3, np.float32),
+    )
+
+
+def _forecast_record(args, backend, batched, per_series) -> dict:
+    batched_p50 = float(np.percentile(batched, 50))
+    loop_p50 = float(np.percentile(per_series, 50))
+    return {
+        "config": f"{args.series} series x {args.history} samples "
+                  "forecast",
+        "backend": backend,
+        "series": args.series,
+        "history": args.history,
+        "batched_p50_ms": round(batched_p50, 3),
+        "per_series_p50_ms": round(loop_p50, 3),
+        "batched_sps": round(args.series * 1000.0 / batched_p50, 1),
+        "per_series_sps": round(args.series * 1000.0 / loop_p50, 1),
+        "speedup": round(loop_p50 / batched_p50, 2),
+    }
+
+
+def _publish_forecast_baseline(record: dict) -> None:
+    _publish_to_baseline(
+        f"{record['config']} ({record['backend']})", record
+    )
+
+
+def _append_forecast_row(path: str, record: dict) -> None:
+    marker = "## Forecast (make bench-forecast)"
+    header = (
+        f"\n{marker}\n\n"
+        "Batched fleet forecast (every metric series in ONE device "
+        "dispatch — the shape the BatchAutoscaler submits each tick) "
+        "vs. the same series forecast one dispatch at a time.\n\n"
+        "| Date | Backend | Config | Batched p50 (ms) | Per-series p50 "
+        "(ms) | Batched series/s | Per-series series/s | Speedup |\n"
+        "|---|---|---|---|---|---|---|---|\n"
+    )
+    date = datetime.date.today().isoformat()
+    row = (
+        f"| {date} | {record['backend']} | {record['config']} "
+        f"| {record['batched_p50_ms']} | {record['per_series_p50_ms']} "
+        f"| {record['batched_sps']} | {record['per_series_sps']} "
+        f"| {record['speedup']}x |\n"
+    )
+    _append_table_row(path, marker, header, row)
+
+
+def _measure_forecast(args, inputs, rows):
+    """Timed batched vs per-series loops (compiles warmed outside)."""
+    import jax
+
+    from karpenter_tpu.forecast.models import forecast_jit
+
+    jax.block_until_ready(forecast_jit(inputs))
+    jax.block_until_ready(forecast_jit(rows[0]))
+    batched_times, per_series_times = [], []
+    for _ in range(args.iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(forecast_jit(inputs))
+        batched_times.append((time.perf_counter() - t0) * 1e3)
+    for _ in range(args.iters):
+        t0 = time.perf_counter()
+        for row in rows:
+            jax.block_until_ready(forecast_jit(row))
+        per_series_times.append((time.perf_counter() - t0) * 1e3)
+    return batched_times, per_series_times
+
+
+def run_forecast(args, metric: str, note: str) -> None:
+    """Batched vs per-series forecasting: the predictive subsystem's
+    one-dispatch claim (docs/forecasting.md). Both paths run the
+    IDENTICAL jitted kernel on the identical histories; only the
+    dispatch shape differs — one [S, T] program vs S [1, T] programs
+    (the second compiled once and reused, so the gap is pure dispatch
+    and launch overhead, not recompiles)."""
+    import dataclasses
+
+    import jax
+
+    print(
+        f"backend={jax.default_backend()} devices={jax.devices()}",
+        file=sys.stderr,
+    )
+    inputs = build_forecast_inputs(args.series, args.history, args.seed)
+    rows = [
+        dataclasses.replace(
+            inputs,
+            **{
+                f.name: np.asarray(getattr(inputs, f.name))[i: i + 1]
+                for f in dataclasses.fields(inputs)
+            },
+        )
+        for i in range(args.series)
+    ]
+    batched_times, per_series_times = _measure_forecast(
+        args, inputs, rows
+    )
+    record = _forecast_record(
+        args, jax.default_backend(), batched_times, per_series_times
+    )
+    record_evidence(
+        batched_iter_ms=[round(t, 4) for t in batched_times],
+        per_series_iter_ms=[round(t, 4) for t in per_series_times],
+        forecast=record,
+        transport_floor=measure_transport_floor(),
+    )
+    print(
+        f"batched p50={record['batched_p50_ms']}ms "
+        f"({record['batched_sps']} series/s) | per-series "
+        f"p50={record['per_series_p50_ms']}ms "
+        f"({record['per_series_sps']} series/s) | "
+        f"speedup={record['speedup']}x",
+        file=sys.stderr,
+    )
+    if args.publish_baseline:
+        _publish_forecast_baseline(record)
+    if args.append_benchmarks:
+        _append_forecast_row(args.append_benchmarks, record)
+    extra = (
+        f"{record['batched_sps']} vs {record['per_series_sps']} "
+        f"series/sec batched vs per-series ({record['speedup']}x)"
     )
     emit(
         f"{metric} ({jax.default_backend()})",

@@ -163,6 +163,41 @@ def parse_args(argv=None) -> argparse.Namespace:
         "drain plan instead of the pending-pods report and exit "
         "without mutating anything",
     )
+    parser.add_argument(
+        "--forecast",
+        action="store_true",
+        help="with --simulate: replay a synthetic diurnal ramp through "
+        "a forecast-enabled and a reactive-only autoscaler and report "
+        "the proactive provisioning lead (docs/forecasting.md); "
+        "forecasting in the running control plane is opt-in per HA via "
+        "spec.behavior.forecast, no flag needed",
+    )
+    parser.add_argument(
+        "--forecast-horizon",
+        type=float,
+        default=60.0,
+        help="with --simulate --forecast: horizon seconds for the replay",
+    )
+    parser.add_argument(
+        "--forecast-model",
+        default="holt-winters",
+        choices=("holt-winters", "linear"),
+        help="with --simulate --forecast: model for the replay",
+    )
+    parser.add_argument(
+        "--forecast-history",
+        type=int,
+        default=64,
+        help="metric-history ring capacity per series "
+        "(docs/forecasting.md)",
+    )
+    parser.add_argument(
+        "--stale-metric-max-age",
+        type=float,
+        default=60.0,
+        help="seconds a history sample may stand in for a FAILED live "
+        "metric query before the row errors instead (0 disables reuse)",
+    )
     return parser.parse_args(argv)
 
 
@@ -170,6 +205,17 @@ def _run_simulation(args, store) -> int:
     import json
 
     from karpenter_tpu.simulate import simulate, simulate_delta
+
+    if args.forecast:
+        # self-contained replay (no store, no provider): proactive vs
+        # reactive on a scripted diurnal ramp
+        from karpenter_tpu.simulate import simulate_forecast
+
+        report = simulate_forecast(
+            horizon_s=args.forecast_horizon, model=args.forecast_model
+        )
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return 0
 
     what_if = None
     if args.what_if:
@@ -348,6 +394,8 @@ def main(argv=None) -> int:
             circuit_failure_threshold=args.circuit_threshold,
             circuit_reset_s=args.circuit_reset,
             solver_watchdog_timeout_s=args.solver_watchdog_timeout,
+            forecast_history=args.forecast_history,
+            stale_metric_max_age_s=args.stale_metric_max_age,
         ),
         store=store,
     )

@@ -27,6 +27,14 @@ ABLE_TO_SCALE = "AbleToScale"
 SCALING_UNBOUNDED = "ScalingUnbounded"
 STABILIZED = "Stabilized"
 
+# Forecasting: set only on HorizontalAutoscalers whose spec opts into
+# predictive scaling (behavior.forecast, docs/forecasting.md). True =
+# forecasts are blending into scale-up decisions; False = degraded to
+# reactive-only, with the reason naming why (warming up, skill below
+# the confidence floor, forecast path unavailable). NOT a dependent of
+# Ready — a degraded forecast is a posture, not a failure.
+FORECASTING = "Forecasting"
+
 # Structured condition REASONS (machine-readable; the message carries the
 # human detail). ActuationCircuitOpen: the per-node-group actuation
 # circuit breaker is open after repeated provider failures — the message

@@ -171,9 +171,77 @@ class ScalingRules:
 
 
 @dataclass(slots=True)
+class ForecastSpec:
+    """Proactive-scaling behavior (docs/forecasting.md): forecast every
+    metric `horizonSeconds` ahead and scale up to max(reactive,
+    predicted). Scale-DOWN stays reactive-only by construction — a
+    forecast can only raise the recommendation (the blend monotonicity
+    the decision kernel pins), so a wrong forecast costs headroom,
+    never availability.
+
+    The reference has no predictive surface at all; this spec is the
+    declarative face of the forecast subsystem (forecast/), evaluated
+    for the whole fleet in one device dispatch per tick.
+    """
+
+    # how far ahead to forecast; a node group should set this at or
+    # above its node-provisioning latency so capacity lands before the
+    # load does
+    horizon_seconds: float = 60.0
+    # "holt-winters" (level/trend/seasonal) or "linear" (robust trend)
+    model: str = "holt-winters"
+    # confidence floor: blending auto-disables while the online skill
+    # score (EWMA of horizon-ago forecast error; docs/forecasting.md
+    # "Skill gating") sits below this
+    min_skill: float = 0.25
+    # dominant load period for the seasonal component (0 = no
+    # seasonality; converted to ring-buffer sample slots at runtime)
+    season_seconds: float = 0.0
+    # Holt-Winters smoothing factors
+    alpha: float = 0.5
+    beta: float = 0.1
+    gamma: float = 0.3
+    # history samples required before the first forecast is trusted
+    min_samples: int = 6
+
+    def validate(self) -> None:
+        if self.horizon_seconds <= 0:
+            raise ValueError(
+                f"forecast horizonSeconds must be > 0, got "
+                f"{self.horizon_seconds}"
+            )
+        if self.model not in ("holt-winters", "linear"):
+            raise ValueError(
+                "forecast model must be holt-winters or linear, got "
+                f"{self.model!r}"
+            )
+        if not 0.0 <= self.min_skill <= 1.0:
+            raise ValueError(
+                f"forecast minSkill must be in [0, 1], got {self.min_skill}"
+            )
+        if self.season_seconds < 0:
+            raise ValueError(
+                f"forecast seasonSeconds must be >= 0, got "
+                f"{self.season_seconds}"
+            )
+        for field_name in ("alpha", "beta", "gamma"):
+            v = getattr(self, field_name)
+            if not 0.0 < v <= 1.0:
+                raise ValueError(
+                    f"forecast {field_name} must be in (0, 1], got {v}"
+                )
+        if self.min_samples < 2:
+            raise ValueError(
+                f"forecast minSamples must be >= 2, got {self.min_samples}"
+            )
+
+
+@dataclass(slots=True)
 class Behavior:
     scale_up: Optional[ScalingRules] = None
     scale_down: Optional[ScalingRules] = None
+    # opt-in predictive scaling (docs/forecasting.md)
+    forecast: Optional[ForecastSpec] = None
 
     def scale_up_rules(self) -> ScalingRules:
         """Defaults: no stabilization, Max select (reference:
@@ -302,6 +370,8 @@ class HorizontalAutoscaler:
                 )
             for policy in rules.policies or []:
                 policy.validate()
+        if self.spec.behavior.forecast is not None:
+            self.spec.behavior.forecast.validate()
 
     def default(self) -> None:
         """reference: horizontalautoscaler_defaults.go (no-op)."""

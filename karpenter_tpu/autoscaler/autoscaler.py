@@ -61,6 +61,12 @@ class _Row:
     # even when a custom algorithm replaces `values` with recommendations
     observed: List = field(default_factory=list)
     error: Optional[Exception] = None
+    # a custom Algorithm replaced `values` with recommendations: the
+    # forecaster must not blend predicted RAW metric values into them
+    custom: bool = False
+    # metric indices whose live query failed and reused the last history
+    # sample (age-bounded) — excluded from new history appends
+    stale_metrics: set = field(default_factory=set)
 
 
 class BatchAutoscaler:
@@ -77,12 +83,16 @@ class BatchAutoscaler:
 
     def __init__(
         self, metrics_client_factory, store: Store, clock=_time.time,
-        decider=None,
+        decider=None, forecaster=None,
     ):
         self.metrics = metrics_client_factory
         self.store = store
         self.clock = clock
         self.decider = decider if decider is not None else D.decide_jit
+        # predictive-scaling seam (forecast/, docs/forecasting.md): a
+        # FleetForecaster owning metric history, the batched forecast
+        # dispatch, and online skill gating. None = reactive-only.
+        self.forecaster = forecaster
         # Times enter the kernel as f32 seconds relative to this epoch so a
         # long-lived process never loses sub-second precision to f32.
         self.epoch = clock()
@@ -124,10 +134,9 @@ class BatchAutoscaler:
                 if custom is None:
                     custom = algorithms.for_spec(ha)
                     self._algorithm_instances[name] = custom
-            for metric_spec in ha.spec.metrics:
-                observed = self.metrics.for_metric(metric_spec).get_current_value(
-                    metric_spec
-                )
+            row.custom = custom is not None
+            for j, metric_spec in enumerate(ha.spec.metrics):
+                observed = self._observe_metric(ha, j, metric_spec, row)
                 target = metric_spec.get_target()
                 row.observed.append((metric_spec, target, observed.value))
                 if custom is not None:
@@ -180,6 +189,38 @@ class BatchAutoscaler:
             row.error = e
         return row
 
+    def _observe_metric(self, ha, j: int, metric_spec, row: _Row):
+        """One metric read, with the stale-sample bridge: a failed query
+        reuses the newest history sample when it is young enough
+        (forecaster.stale_max_age_s), so a transient exporter blip
+        degrades ONE input instead of dropping the whole row from the
+        batch. Older-than-bound history re-raises — an autoscaler must
+        not keep scaling on a signal that has been dark for minutes."""
+        # lazy import (the controllers package imports this module)
+        from karpenter_tpu.metrics.clients import MetricQueryError
+
+        try:
+            return self.metrics.for_metric(metric_spec).get_current_value(
+                metric_spec
+            )
+        except MetricQueryError:
+            if self.forecaster is None:
+                raise
+            value = self.forecaster.stale_value(ha, j, self.clock())
+            if value is None:
+                raise
+            row.stale_metrics.add(j)
+            from karpenter_tpu.metrics.types import Metric as MetricValue
+
+            return MetricValue(
+                name=(
+                    metric_spec.prometheus.query
+                    if metric_spec.prometheus is not None
+                    else ""
+                ),
+                value=value,
+            )
+
     # -- batch reconcile --------------------------------------------------
 
     def reconcile_batch(
@@ -195,14 +236,25 @@ class BatchAutoscaler:
                 results[key(row.ha)] = row.error
 
         if live:
-            outputs = self._decide(live)
+            # the forecast pass: ingest this tick's observations into
+            # the history store and predict every eligible series in ONE
+            # batched dispatch; {} (no spec, warming up, skill-gated, or
+            # ANY failure) keeps the tick purely reactive
+            forecasts: Dict[tuple, float] = {}
+            if self.forecaster is not None:
+                forecasts = self.forecaster.forecast_rows(
+                    live, self.clock()
+                )
+            outputs = self._decide(live, forecasts)
             now = self.clock()
             for i, row in enumerate(live):
                 self._apply(row, outputs, i, now)
                 results[key(row.ha)] = None
         return results
 
-    def _decide(self, rows: List[_Row]) -> D.DecisionOutputs:
+    def _decide(
+        self, rows: List[_Row], forecasts: Optional[Dict[tuple, float]] = None
+    ) -> D.DecisionOutputs:
         n = D.pad_to(len(rows))
         m = max(1, max(len(r.values) for r in rows))
 
@@ -270,6 +322,17 @@ class BatchAutoscaler:
         up_ptype, up_pvalue, up_pperiod, up_pvalid = policy_slots(0)
         down_ptype, down_pvalue, down_pperiod, down_pvalid = policy_slots(1)
 
+        # proactive blend operands: predicted metric values slot into
+        # the same [N, M] layout; absent forecasts leave the fields None
+        # so a reactive-only fleet keeps the pre-forecast program
+        forecast_value = forecast_valid = None
+        if forecasts:
+            forecast_value = np.zeros((n, m), np.float32)
+            forecast_valid = np.zeros((n, m), bool)
+            for (i, j), predicted in forecasts.items():
+                forecast_value[i, j] = predicted
+                forecast_valid[i, j] = True
+
         now = np.float32(self.clock() - self.epoch)
         inputs = D.DecisionInputs(
             metric_value=pad2(lambda r: r.values, 0.0, np.float32),
@@ -323,9 +386,32 @@ class BatchAutoscaler:
             down_pvalue=down_pvalue,
             down_pperiod=down_pperiod,
             down_pvalid=down_pvalid,
+            forecast_value=forecast_value,
+            forecast_valid=forecast_valid,
         )
         with solver_trace("autoscaler.decide"):
             return self.decider(inputs)
+
+    def _mark_forecast_condition(self, ha, mgr) -> None:
+        """Predictive posture on status (docs/forecasting.md): True
+        while forecasts blend into scale-up, False (with the structured
+        reason) while degraded to reactive-only — warming up, skill
+        below the floor, or the forecast path failing. A spec that
+        opted back OUT drops the condition entirely: a frozen last
+        value would keep reporting a posture nothing computes."""
+        if ha.spec.behavior.forecast is not None and self.forecaster is not None:
+            active, reason, message = self.forecaster.verdict(
+                ha.metadata.namespace, ha.metadata.name
+            )
+            if active:
+                mgr.mark_true(cond.FORECASTING)
+            else:
+                mgr.mark_false(cond.FORECASTING, reason, message)
+        else:
+            ha.status.conditions[:] = [
+                c for c in ha.status.conditions
+                if c.type != cond.FORECASTING
+            ]
 
     def _apply(self, row: _Row, out: D.DecisionOutputs, i: int, now: float):
         """Write back one row's decision (reference: autoscaler.go:81-113,
@@ -384,6 +470,8 @@ class BatchAutoscaler:
                 f"[{ha.spec.min_replicas}, {ha.spec.max_replicas}]",
             )
 
+        self._mark_forecast_condition(ha, mgr)
+
         if scale.spec_replicas is not None and desired == scale.spec_replicas:
             return
         scale.spec_replicas = desired
@@ -426,10 +514,11 @@ class AutoscalerFactory:
 
     def __init__(
         self, metrics_client_factory, store: Store, clock=_time.time,
-        decider=None,
+        decider=None, forecaster=None,
     ):
         self.batch = BatchAutoscaler(
-            metrics_client_factory, store, clock, decider=decider
+            metrics_client_factory, store, clock, decider=decider,
+            forecaster=forecaster,
         )
 
     def reconcile(self, ha: HorizontalAutoscaler) -> None:

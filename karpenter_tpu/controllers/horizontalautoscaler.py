@@ -34,6 +34,15 @@ class HorizontalAutoscalerController:
     def interval(self) -> float:
         return 10.0
 
+    def on_deleted(self, ha) -> None:
+        """Engine pruning signal: drop the deleted autoscaler's metric
+        history, skill state, and forecast gauges (forecast/engine.py) —
+        the ring buffers are bounded, but a deleted object's series must
+        not linger until eviction."""
+        forecaster = getattr(self.autoscaler, "forecaster", None)
+        if forecaster is not None:
+            forecaster.prune(ha.metadata.namespace, ha.metadata.name)
+
     def reconcile(self, ha) -> None:
         error = self.reconcile_batch([ha]).get(
             (ha.metadata.namespace, ha.metadata.name)

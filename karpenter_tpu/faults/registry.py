@@ -24,6 +24,7 @@ a chaos run replays exactly under a fixed seed.
 Points instrumented across the stack (docs/resilience.md):
 
   solver.dispatch     device path of the shared solve service
+  forecast.predict    device path of the batched forecast seam
   encoder.encode      snapshot -> solver-operand encode
   cloud.get_replicas  provider replica observation
   cloud.set_replicas  provider actuation

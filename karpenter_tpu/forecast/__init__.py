@@ -1,0 +1,44 @@
+"""Predictive scaling subsystem (docs/forecasting.md).
+
+history.py  bounded columnar ring buffers of observed metric samples
+models.py   batched Holt-Winters / robust-linear device kernels with a
+            bit-identical numpy mirror
+engine.py   FleetForecaster — per-tick orchestration: ingest, skill
+            tracking, ONE coalesced device dispatch for the whole fleet
+"""
+
+from karpenter_tpu.forecast.engine import (
+    FleetForecaster,
+    REASON_SKILL_DEGRADED,
+    REASON_UNAVAILABLE,
+    REASON_WARMING_UP,
+    query_key,
+)
+from karpenter_tpu.forecast.history import MetricHistoryStore
+from karpenter_tpu.forecast.models import (
+    ForecastInputs,
+    ForecastOutputs,
+    MODEL_CODES,
+    MODEL_HOLT_WINTERS,
+    MODEL_LINEAR,
+    forecast,
+    forecast_jit,
+    forecast_numpy,
+)
+
+__all__ = [
+    "FleetForecaster",
+    "ForecastInputs",
+    "ForecastOutputs",
+    "MetricHistoryStore",
+    "MODEL_CODES",
+    "MODEL_HOLT_WINTERS",
+    "MODEL_LINEAR",
+    "REASON_SKILL_DEGRADED",
+    "REASON_UNAVAILABLE",
+    "REASON_WARMING_UP",
+    "forecast",
+    "forecast_jit",
+    "forecast_numpy",
+    "query_key",
+]

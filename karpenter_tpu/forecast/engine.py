@@ -1,0 +1,371 @@
+"""FleetForecaster: the host-side orchestration of predictive scaling.
+
+Owns the pieces the BatchAutoscaler composes each tick
+(docs/forecasting.md):
+
+  * the MetricHistoryStore (forecast/history.py) — every observed
+    metric sample of every HorizontalAutoscaler lands here, surviving
+    engine requeues and deactivations, pruned on HA deletion;
+  * ONE batched forecast per tick — every forecast-enabled series in
+    the fleet rides a single ForecastInputs matrix through the
+    `forecast_fn` seam (SolverService.forecast in production: coalesced
+    queue, compile cache, numpy fallback, backend-health FSM);
+  * online SKILL tracking — each prediction is remembered until its
+    horizon elapses, then scored against what actually happened
+    (normalized absolute error folded into a per-HA EWMA). Skill below
+    the spec's floor auto-disables blending for that HA: a forecast
+    that has been wrong lately doesn't get to provision nodes;
+  * the never-block contract — forecast_rows() NEVER raises. Any
+    failure (device fault past every service degradation rung, a
+    poisoned spec) logs, counts karpenter_forecast_disabled_total, and
+    returns no forecasts: the tick proceeds purely reactive, exactly as
+    if the subsystem didn't exist.
+
+Metrics: karpenter_forecast_{skill,horizon_value} gauges and
+karpenter_forecast_{blend,disabled}_total counters, labeled
+{name, namespace} per HorizontalAutoscaler.
+"""
+
+from __future__ import annotations
+
+import collections
+import time as _time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from karpenter_tpu.forecast import models as M
+from karpenter_tpu.forecast.history import MetricHistoryStore
+from karpenter_tpu.utils.log import logger
+
+SUBSYSTEM = "forecast"
+
+# FORECASTING condition reasons (api/conditions.py carries the type)
+REASON_WARMING_UP = "ForecastWarmingUp"
+REASON_SKILL_DEGRADED = "ForecastSkillDegraded"
+REASON_UNAVAILABLE = "ForecastUnavailable"
+
+_ERR_FLOOR = 1e-6  # normalization floor for the skill error ratio
+# query-pool dedupe window: N autoscalers sharing one query read it N
+# times per tick — appending each read would shrink the pool's apparent
+# sample spacing N-fold and wreck any series seeded from it
+_QUERY_DEDUPE_S = 1.0
+
+
+def _ha_key(ha) -> Tuple[str, str]:
+    return (ha.metadata.namespace, ha.metadata.name)
+
+
+def _series_key(ha, metric_index: int) -> tuple:
+    return ("ha", ha.metadata.namespace, ha.metadata.name, metric_index)
+
+
+def query_key(name: str, labels: Optional[dict]) -> tuple:
+    """Key for raw metrics-client observations (the warm pool)."""
+    return ("q", name, tuple(sorted((labels or {}).items())))
+
+
+class FleetForecaster:
+    """One per runtime; see module docstring.
+
+    `forecast_fn` is the device seam: any (ForecastInputs) ->
+    ForecastOutputs callable — SolverService.forecast in production
+    (runtime.py wiring), the jitted kernel directly when standalone.
+    """
+
+    def __init__(
+        self,
+        forecast_fn=None,
+        registry=None,
+        clock=_time.time,
+        capacity: int = 64,
+        stale_max_age_s: float = 60.0,
+        skill_alpha: float = 0.3,
+    ):
+        self.forecast_fn = (
+            forecast_fn if forecast_fn is not None else M.forecast_jit
+        )
+        self.clock = clock
+        self.stale_max_age_s = stale_max_age_s
+        self.skill_alpha = skill_alpha
+        self.history = MetricHistoryStore(capacity=capacity)
+        # (ns, name) -> skill EWMA in [0, 1]; optimistic start (1.0) so a
+        # fresh forecaster blends until its predictions prove bad
+        self._skill: Dict[tuple, float] = {}
+        # series key -> pending (target_time, predicted) awaiting scoring
+        self._pending: Dict[tuple, collections.deque] = {}
+        # (ns, name) -> (active, reason, message) for the FORECASTING
+        # condition, refreshed each forecast_rows pass
+        self._verdicts: Dict[tuple, Tuple[bool, str, str]] = {}
+        self._g_skill = self._g_value = None
+        self._c_blend = self._c_disabled = None
+        if registry is not None:
+            self._g_skill = registry.register(SUBSYSTEM, "skill")
+            self._g_value = registry.register(SUBSYSTEM, "horizon_value")
+            self._c_blend = registry.register(
+                SUBSYSTEM, "blend_total", kind="counter"
+            )
+            self._c_disabled = registry.register(
+                SUBSYSTEM, "disabled_total", kind="counter"
+            )
+
+    # -- observation paths -------------------------------------------------
+
+    def observe_query(self, metric) -> None:
+        """Metrics-client observation hook (metrics/clients.py): every
+        successful instant query feeds the query-keyed warm pool.
+        Reads landing within the dedupe window of the last sample are
+        dropped — same-tick reads from autoscalers sharing a query
+        carry no new information and would corrupt the pool's sample
+        spacing."""
+        key = query_key(metric.name, metric.labels)
+        now = self.clock()
+        last = self.history.last(key)
+        if last is not None and now - last[0] < _QUERY_DEDUPE_S:
+            return
+        self.history.append(key, now, float(metric.value))
+
+    def stale_value(self, ha, metric_index: int, now: float):
+        """Age-bounded last sample for a row whose live metric query
+        failed (the stale-metric fix): the value the batch can reuse, or
+        None when history is empty/too old to stand in."""
+        last = self.history.last(_series_key(ha, metric_index))
+        if last is None:
+            return None
+        t, value = last
+        if now - t > self.stale_max_age_s:
+            return None
+        return value
+
+    def skill(self, namespace: str, name: str) -> float:
+        return self._skill.get((namespace, name), 1.0)
+
+    def verdict(self, namespace: str, name: str):
+        """(active, reason, message) for the FORECASTING condition."""
+        return self._verdicts.get(
+            (namespace, name), (False, REASON_WARMING_UP, "no forecast yet")
+        )
+
+    def prune(self, namespace: str, name: str) -> None:
+        """Forget a deleted HorizontalAutoscaler (HA controller
+        on_deleted hook): history, skill, pending scores, gauges."""
+        self.history.prune("ha", namespace, name)
+        self._skill.pop((namespace, name), None)
+        self._verdicts.pop((namespace, name), None)
+        for key in [
+            k for k in self._pending if k[1] == namespace and k[2] == name
+        ]:
+            del self._pending[key]
+        if self._g_skill is not None:
+            self._g_skill.remove(name, namespace)
+            self._g_value.remove(name, namespace)
+
+    # -- the per-tick pass -------------------------------------------------
+
+    def forecast_rows(self, rows, now: float) -> Dict[tuple, float]:
+        """The BatchAutoscaler's per-tick call: ingest every live row's
+        observations, score matured predictions, and forecast every
+        eligible series in ONE batched dispatch. Returns
+        {(row_index, metric_index): predicted_value}; empty on any
+        failure (never raises — module docstring)."""
+        try:
+            eligible = self._ingest(rows, now)
+            if not eligible:
+                return {}
+            return self._predict(rows, eligible, now)
+        except Exception as error:  # noqa: BLE001 — never-block contract
+            logger().warning(
+                "forecast pass failed (%s: %s); this tick scales "
+                "reactive-only", type(error).__name__, error,
+            )
+            for row in rows:
+                if getattr(row.ha.spec.behavior, "forecast", None) is None:
+                    continue
+                ns, name = _ha_key(row.ha)
+                self._verdicts[(ns, name)] = (
+                    False, REASON_UNAVAILABLE, f"forecast failed: {error}"
+                )
+                if self._c_disabled is not None:
+                    self._c_disabled.inc(name, ns)
+            return {}
+
+    def _ingest(self, rows, now: float) -> List[tuple]:
+        """Append observations, mature skill scores, and collect the
+        (row_index, metric_index, key, spec) tuples eligible for this
+        tick's batched forecast."""
+        eligible: List[tuple] = []
+        for i, row in enumerate(rows):
+            ha = row.ha
+            fspec = getattr(ha.spec.behavior, "forecast", None)
+            stale = getattr(row, "stale_metrics", set())
+            for j, (metric_spec, _target, value) in enumerate(row.observed):
+                key = _series_key(ha, j)
+                if j not in stale and np.isfinite(value):
+                    self._mature(key, _ha_key(ha), now, float(value))
+                    self.history.append(key, now, float(value))
+            if fspec is None or getattr(row, "custom", False):
+                continue
+            self._seed_from_queries(ha)
+            eligible.extend(self._eligible_row(i, row, fspec))
+        return eligible
+
+    def _seed_from_queries(self, ha) -> None:
+        """Warm-pool seeding: a fresh HA series copies the query-keyed
+        history another observer already accumulated."""
+        for j, metric_spec in enumerate(ha.spec.metrics):
+            key = _series_key(ha, j)
+            if self.history.count(key) > 0:
+                continue
+            if metric_spec.prometheus is None:
+                continue
+            from karpenter_tpu.metrics.clients import parse_instant_selector
+
+            try:
+                name, labels = parse_instant_selector(
+                    metric_spec.prometheus.query
+                )
+            except Exception:  # noqa: BLE001 — unparseable query: no seed
+                continue
+            self.history.seed(key, query_key(name, labels))
+
+    def _eligible_row(self, i: int, row, fspec) -> List[tuple]:
+        """(row_index, metric_index, key, spec, blend) tuples for this
+        row's warm series. A skill-gated row still forecasts — in
+        SHADOW mode (blend=False): its predictions keep being scored so
+        the skill EWMA can actually recover, they just don't raise any
+        scale-up decision while below the floor."""
+        ns, name = _ha_key(row.ha)
+        skill = self.skill(ns, name)
+        blend = skill >= fspec.min_skill
+        if not blend:
+            self._verdicts[(ns, name)] = (
+                False,
+                REASON_SKILL_DEGRADED,
+                f"skill {skill:.3f} below floor {fspec.min_skill:.3f}; "
+                "scaling reactive-only until it recovers",
+            )
+            if self._c_disabled is not None:
+                self._c_disabled.inc(name, ns)
+        out: List[tuple] = []
+        need = max(int(fspec.min_samples), 2)
+        short = 0
+        for j in range(len(row.observed)):
+            key = _series_key(row.ha, j)
+            if self.history.count(key) >= need:
+                out.append((i, j, key, fspec, blend))
+            else:
+                short += 1
+        if blend:
+            # any warm series IS blending: the condition must say so
+            # even while a freshly added metric is still warming up
+            if out:
+                self._verdicts[(ns, name)] = (True, "", "")
+            elif short:
+                self._verdicts[(ns, name)] = (
+                    False,
+                    REASON_WARMING_UP,
+                    f"{short} metric series below {need} samples",
+                )
+        return out
+
+    def _mature(self, key, ha_key, now: float, actual: float) -> None:
+        """Score every pending prediction for `key` whose horizon has
+        elapsed against the freshly observed value. The error is
+        normalized by the LARGER of |actual| and the metric's target
+        value (the scale replicas are decided at): a queue idling near
+        zero overnight with exporter noise must not register as huge
+        relative error and strip the skill the morning ramp needs."""
+        pending = self._pending.get(key)
+        if not pending:
+            return
+        scored = None
+        while pending and pending[0][0] <= now:
+            scored = pending.popleft()
+        if scored is None:
+            return
+        _target_t, predicted, scale = scored
+        err = abs(predicted - actual) / max(
+            abs(actual), scale, _ERR_FLOOR
+        )
+        sample = max(0.0, 1.0 - err)
+        prev = self._skill.get(ha_key, 1.0)
+        self._skill[ha_key] = (
+            (1.0 - self.skill_alpha) * prev + self.skill_alpha * sample
+        )
+
+    def _predict(
+        self, rows, eligible: List[tuple], now: float
+    ) -> Dict[tuple, float]:
+        inputs = self._build_inputs(eligible, now)
+        out = self.forecast_fn(inputs)
+        points = np.asarray(out.point, np.float32)
+        n_valid = np.asarray(out.n_valid)
+        step_s = np.asarray(inputs.step_s)
+        forecasts: Dict[tuple, float] = {}
+        for k, (i, j, key, fspec, blend) in enumerate(eligible):
+            if n_valid[k] < max(int(fspec.min_samples), 2):
+                continue
+            point = float(points[k])
+            ns, name = _ha_key(rows[i].ha)
+            if blend:
+                forecasts[(i, j)] = point
+            # remember the prediction for horizon-elapsed scoring —
+            # shadow (skill-gated) predictions too, or the skill EWMA
+            # could never recover; the deque is bounded so a stalled
+            # metric can't grow it. The metric's target value rides
+            # along as the error-normalization scale (_mature).
+            target = rows[i].observed[j][1]
+            try:
+                scale = abs(float(target.target_value()))
+            except Exception:  # noqa: BLE001 — unscaled metric shapes
+                scale = 0.0
+            pending = self._pending.setdefault(
+                key, collections.deque(maxlen=self.history.capacity)
+            )
+            pending.append(
+                (now + float(fspec.horizon_seconds), point, scale)
+            )
+            observed = rows[i].observed[j][2]
+            if self._g_skill is not None:
+                self._g_skill.set(name, ns, self.skill(ns, name))
+                if j == 0:
+                    self._g_value.set(name, ns, point)
+                if blend and np.isfinite(observed) and point > observed:
+                    self._c_blend.inc(name, ns)
+        return forecasts
+
+    def _build_inputs(
+        self, eligible: List[tuple], now: float
+    ) -> M.ForecastInputs:
+        keys = [key for (_i, _j, key, _f, _b) in eligible]
+        values, valid, times, step_s = self.history.matrix(keys, now)
+        K = len(eligible)
+        horizon = np.zeros(K, np.float32)
+        half_life = np.ones(K, np.float32)
+        model = np.zeros(K, np.int32)
+        season = np.zeros(K, np.int32)
+        alpha = np.zeros(K, np.float32)
+        beta = np.zeros(K, np.float32)
+        gamma = np.zeros(K, np.float32)
+        for k, (_i, _j, _key, fspec, _b) in enumerate(eligible):
+            horizon[k] = fspec.horizon_seconds
+            half_life[k] = max(float(fspec.horizon_seconds), 1.0)
+            model[k] = M.MODEL_CODES.get(fspec.model, M.MODEL_LINEAR)
+            if fspec.season_seconds > 0 and step_s[k] > 0:
+                season[k] = int(round(fspec.season_seconds / step_s[k]))
+            alpha[k] = fspec.alpha
+            beta[k] = fspec.beta
+            gamma[k] = fspec.gamma
+        # recency decay for the linear fit, computed HOST-side (a
+        # transcendental inside the kernel would break numpy parity —
+        # forecast/models.py): a sample one horizon old weighs half as
+        # much as the newest, so a regime change overtakes stale history
+        # within a few horizons
+        weights = np.power(
+            np.float32(0.5), (-times) / half_life[:, None]
+        ).astype(np.float32)
+        return M.ForecastInputs(
+            values=values, valid=valid, times=times, weights=weights,
+            horizon=horizon, step_s=step_s, model=model, season=season,
+            alpha=alpha, beta=beta, gamma=gamma,
+        )

@@ -80,10 +80,20 @@ def parse_instant_selector(query: str) -> Tuple[str, Dict[str, str]]:
 
 
 class RegistryMetricsClient:
-    """Instant-selector evaluation against the in-process gauge registry."""
+    """Instant-selector evaluation against the in-process gauge registry.
 
-    def __init__(self, registry: Optional[GaugeRegistry] = None):
+    `observer` (any (Metric) -> None callable) sees every successful
+    read — the forecast subsystem's metric-history hook
+    (forecast/history.py): client-path observations feed the query-keyed
+    warm pool that seeds a fresh HorizontalAutoscaler's history."""
+
+    def __init__(
+        self,
+        registry: Optional[GaugeRegistry] = None,
+        observer=None,
+    ):
         self.registry = registry if registry is not None else default_registry()
+        self.observer = observer
 
     def get_current_value(self, metric_spec) -> Metric:
         inject("metrics.query")
@@ -104,15 +114,24 @@ class RegistryMetricsClient:
                 f"expected instant vector of length 1 for query {query!r}, "
                 f"got {len(matches)} series"
             )
-        return Metric(name=name, labels=matches[0].labels, value=matches[0].value)
+        metric = Metric(
+            name=name, labels=matches[0].labels, value=matches[0].value
+        )
+        if self.observer is not None:
+            self.observer(metric)
+        return metric
 
 
 class PrometheusMetricsClient:
-    """HTTP instant query (reference: prometheus.go:35-55)."""
+    """HTTP instant query (reference: prometheus.go:35-55). `observer`
+    as on RegistryMetricsClient."""
 
-    def __init__(self, uri: str, timeout_seconds: float = 5.0):
+    def __init__(
+        self, uri: str, timeout_seconds: float = 5.0, observer=None
+    ):
         self.uri = uri.rstrip("/")
         self.timeout = timeout_seconds
+        self.observer = observer
 
     def get_current_value(self, metric_spec) -> Metric:
         inject("metrics.query")
@@ -141,10 +160,13 @@ class PrometheusMetricsClient:
                 f"expected instant vector of length 1 for {query!r}, "
                 f"got {len(vector)}"
             )
-        return Metric(
+        metric = Metric(
             name=query, labels=vector[0].get("metric", {}),
             value=float(vector[0]["value"][1]),
         )
+        if self.observer is not None:
+            self.observer(metric)
+        return metric
 
 
 class MetricsClientFactory:
@@ -154,10 +176,15 @@ class MetricsClientFactory:
         self,
         registry: Optional[GaugeRegistry] = None,
         prometheus_uri: Optional[str] = None,
+        observer=None,
     ):
-        self._registry_client = RegistryMetricsClient(registry)
+        self._registry_client = RegistryMetricsClient(
+            registry, observer=observer
+        )
         self._prometheus_client = (
-            PrometheusMetricsClient(prometheus_uri) if prometheus_uri else None
+            PrometheusMetricsClient(prometheus_uri, observer=observer)
+            if prometheus_uri
+            else None
         )
 
     def for_metric(self, metric_spec):

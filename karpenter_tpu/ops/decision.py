@@ -25,6 +25,7 @@ Design notes (TPU):
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -86,6 +87,16 @@ class DecisionInputs:
     down_pvalue: jax.Array  # i32[N, K]
     down_pperiod: jax.Array  # i32[N, K]
     down_pvalid: jax.Array  # bool[N, K]
+    # proactive blend (docs/forecasting.md): the metric values the
+    # forecaster predicts `horizon` seconds ahead. Optional — None (the
+    # reactive-only fleet) keeps the pre-forecast program; when present,
+    # each valid forecast's recommendation is max()-blended into the
+    # reactive one, so a predicted breach scales up EARLY while
+    # scale-down stays governed by the observed values alone (the blend
+    # can only raise a recommendation — monotonicity is pinned by
+    # tests/test_forecast.py).
+    forecast_value: Optional[jax.Array] = None  # f32[N, M]
+    forecast_valid: Optional[jax.Array] = None  # bool[N, M]
 
 
 @jax.tree_util.register_dataclass
@@ -104,13 +115,19 @@ def _ceil_guarded(x: jax.Array) -> jax.Array:
     return jnp.ceil(x - _CEIL_GUARD)
 
 
-def _recommendations(inputs: DecisionInputs) -> jax.Array:
-    """Per-metric desired replicas, f32[N, M] (reference: proportional.go:30-47)."""
+def _recommendations(
+    inputs: DecisionInputs, values: Optional[jax.Array] = None
+) -> jax.Array:
+    """Per-metric desired replicas, f32[N, M] (reference: proportional.go:30-47).
+    `values` overrides the observed metric values (the forecast blend
+    runs the identical HPA math on the predicted values)."""
+    if values is None:
+        values = inputs.metric_value
     # zero target: ratio collapses to 0, matching the scalar oracle
     # (algorithms/proportional.py) — float division by zero never reaches XLA
     safe_target = jnp.where(inputs.target_value != 0, inputs.target_value, 1.0)
     ratio = jnp.where(
-        inputs.target_value != 0, inputs.metric_value / safe_target, 0.0
+        inputs.target_value != 0, values / safe_target, 0.0
     )
     status = inputs.status_replicas[:, None].astype(jnp.float32)
     proportional = status * ratio
@@ -135,6 +152,15 @@ def _recommendations(inputs: DecisionInputs) -> jax.Array:
 def decide(inputs: DecisionInputs) -> DecisionOutputs:
     """The full decision pipeline (reference: autoscaler.go:144-194)."""
     rec = _recommendations(inputs)  # f32[N, M]
+    if inputs.forecast_value is not None:
+        # proactive blend: run the SAME per-metric math on the predicted
+        # values and take the max — a forecasted breach raises the
+        # recommendation early, a forecasted lull changes nothing (the
+        # blend is monotone up; everything downstream — select policy,
+        # stabilization, rate limits, bounds — applies unchanged)
+        rec_forecast = _recommendations(inputs, inputs.forecast_value)
+        blend = inputs.forecast_valid & inputs.metric_valid
+        rec = jnp.where(blend, jnp.maximum(rec, rec_forecast), rec)
     valid = inputs.metric_valid
     spec = inputs.spec_replicas.astype(jnp.float32)  # [N]
 

@@ -64,6 +64,13 @@ class Options:
     solver_health_threshold: int = 3
     solver_probe_interval_s: float = 5.0
     solver_watchdog_timeout_s: float = 30.0
+    # predictive scaling (docs/forecasting.md): metric-history ring
+    # capacity per series, and how old a history sample may be and still
+    # stand in for a FAILED live metric query (the stale-metric bridge;
+    # 0 disables reuse). Forecasting itself is opt-in per HA via
+    # spec.behavior.forecast — these knobs size the shared machinery.
+    forecast_history: int = 64
+    stale_metric_max_age_s: float = 60.0
 
 
 class KarpenterRuntime:
@@ -129,12 +136,27 @@ class KarpenterRuntime:
             self.store, self.cloud_provider, registry=self.registry,
             solver=self.solver_service.solve,
         )
+        # predictive scaling (forecast/, docs/forecasting.md): history,
+        # skill gating, and the batched forecast riding the solve
+        # service's queue/compile-cache/FSM; the metrics clients feed
+        # the query-keyed warm pool through the observer hook
+        from karpenter_tpu.forecast import FleetForecaster
+
+        self.forecaster = FleetForecaster(
+            forecast_fn=self.solver_service.forecast,
+            registry=self.registry,
+            clock=self.clock,
+            capacity=options.forecast_history,
+            stale_max_age_s=options.stale_metric_max_age_s,
+        )
         self.metrics_clients = MetricsClientFactory(
-            registry=self.registry, prometheus_uri=options.prometheus_uri
+            registry=self.registry, prometheus_uri=options.prometheus_uri,
+            observer=self.forecaster.observe_query,
         )
         self.batch_autoscaler = BatchAutoscaler(
             self.metrics_clients, self.store, clock=self.clock,
             decider=self.solver_service.decide,
+            forecaster=self.forecaster,
         )
         # consolidation engine (opt-in): plans batched node drains
         # through the shared solve service and actuates them through the

@@ -293,6 +293,195 @@ def simulate_consolidation(store, service=None, buckets: int = 32) -> dict:
     }
 
 
+def simulate_forecast(  # lint: allow-complexity — scenario assembly: world build + two replays + report
+    ticks: int = 90,
+    interval_s: float = 10.0,
+    horizon_s: float = 60.0,
+    model: str = "holt-winters",
+    base: float = 8.0,
+    amplitude: float = 120.0,
+    ramp_start: int = 10,
+    ramp_ticks: int = 24,
+    target: float = 4.0,
+    min_samples: int = 4,
+    seed: int = 0,
+    backend: str = "xla",
+) -> dict:
+    """Dry-run the predictive subsystem against a synthetic diurnal
+    ramp (docs/forecasting.md "Dry-running"): the same scripted metric —
+    flat overnight base, a smooth morning surge of `amplitude` over
+    `ramp_ticks`, then a daytime plateau — is replayed through two
+    otherwise-identical autoscalers, one with spec.behavior.forecast and
+    one reactive-only, and the report quantifies the PROVISIONING LEAD:
+    how many ticks earlier the forecast-enabled autoscaler reached each
+    capacity milestone, i.e. how much node-provisioning latency a real
+    node group would have hidden. Nothing here touches a store or a
+    cloud provider — both worlds are built from scratch in memory.
+    """
+    import math as _math
+
+    from karpenter_tpu.api.core import ObjectMeta
+    from karpenter_tpu.api.horizontalautoscaler import (
+        Behavior,
+        CrossVersionObjectReference,
+        ForecastSpec,
+        HorizontalAutoscaler,
+        HorizontalAutoscalerSpec,
+        Metric,
+        MetricTarget,
+        PrometheusMetricSource,
+    )
+    from karpenter_tpu.api.scalablenodegroup import (
+        ScalableNodeGroup,
+        ScalableNodeGroupSpec,
+    )
+    from karpenter_tpu.autoscaler import BatchAutoscaler
+    from karpenter_tpu.forecast import FleetForecaster
+    from karpenter_tpu.metrics.clients import MetricsClientFactory
+    from karpenter_tpu.metrics.registry import GaugeRegistry
+    from karpenter_tpu.solver import SolverService
+
+    rng = np.random.RandomState(seed)
+    noise = rng.normal(0.0, 0.01 * amplitude, size=ticks)
+
+    def metric_at(tick: int) -> float:
+        # the morning side of a diurnal wave: smooth cosine S-ramp from
+        # base to base+amplitude, then plateau
+        progress = min(max(tick - ramp_start, 0) / max(ramp_ticks, 1), 1.0)
+        level = base + amplitude * 0.5 * (1.0 - _math.cos(_math.pi * progress))
+        return max(0.0, level + float(noise[tick]))
+
+    def replay(forecast_spec):
+        from karpenter_tpu.store import Store as _Store
+
+        store = _Store()
+        registry = GaugeRegistry()
+        gauge = registry.register("queue", "length")
+        store.create(
+            ScalableNodeGroup(
+                metadata=ObjectMeta(name="g"),
+                spec=ScalableNodeGroupSpec(
+                    replicas=1, type="FakeNodeGroup", id="g"
+                ),
+            )
+        )
+        store.create(
+            HorizontalAutoscaler(
+                metadata=ObjectMeta(name="ha"),
+                spec=HorizontalAutoscalerSpec(
+                    scale_target_ref=CrossVersionObjectReference(
+                        kind="ScalableNodeGroup", name="g"
+                    ),
+                    min_replicas=1,
+                    max_replicas=10_000,
+                    metrics=[
+                        Metric(
+                            prometheus=PrometheusMetricSource(
+                                query='karpenter_queue_length{name="q"}',
+                                target=MetricTarget(
+                                    type="AverageValue", value=target
+                                ),
+                            )
+                        )
+                    ],
+                    behavior=Behavior(forecast=forecast_spec),
+                ),
+            )
+        )
+        clock = {"now": 1_000_000.0}
+        service = SolverService(backend=backend)
+        forecaster = (
+            FleetForecaster(
+                forecast_fn=service.forecast,
+                clock=lambda: clock["now"],
+                capacity=64,
+            )
+            if forecast_spec is not None
+            else None
+        )
+        autoscaler = BatchAutoscaler(
+            MetricsClientFactory(registry=registry),
+            store,
+            clock=lambda: clock["now"],
+            decider=service.decide,
+            forecaster=forecaster,
+        )
+        desired: List[int] = []
+        try:
+            for tick in range(ticks):
+                gauge.set("q", "default", metric_at(tick))
+                ha = store.get("HorizontalAutoscaler", "default", "ha")
+                errors = autoscaler.reconcile_batch([ha])
+                error = errors[("default", "ha")]
+                if error is not None:
+                    raise error
+                store.patch_status(ha)
+                desired.append(
+                    store.get_scale(
+                        "ScalableNodeGroup", "default", "g"
+                    ).spec_replicas
+                )
+                clock["now"] += interval_s
+        finally:
+            service.close()
+        dispatches = (
+            service.stats.forecast_dispatches if forecaster else 0
+        )
+        return desired, dispatches
+
+    spec = ForecastSpec(
+        horizon_seconds=horizon_s, model=model, min_samples=min_samples
+    )
+    proactive, dispatches = replay(spec)
+    reactive, _ = replay(None)
+
+    peak = max(reactive)
+
+    def first_at(seq, level):
+        return next(
+            (i for i, v in enumerate(seq) if v is not None and v >= level),
+            None,
+        )
+
+    milestones = {}
+    leads = []
+    for pct in (25, 50, 75, 100):
+        level = max(1, int(round(peak * pct / 100.0)))
+        p, r = first_at(proactive, level), first_at(reactive, level)
+        milestones[f"{pct}%"] = {
+            "replicas": level,
+            "proactive_tick": p,
+            "reactive_tick": r,
+            "lead_ticks": (r - p) if p is not None and r is not None else None,
+        }
+        if p is not None and r is not None:
+            leads.append(r - p)
+    mean_lead = (sum(leads) / len(leads)) if leads else 0.0
+    return {
+        "config": {
+            "ticks": ticks,
+            "interval_s": interval_s,
+            "horizon_s": horizon_s,
+            "model": model,
+            "ramp": f"{base} -> {base + amplitude} over ticks "
+                    f"[{ramp_start}, {ramp_start + ramp_ticks}]",
+            "target": target,
+            "seed": seed,
+        },
+        "proactive_desired": proactive,
+        "reactive_desired": reactive,
+        "milestones": milestones,
+        "mean_lead_ticks": round(mean_lead, 2),
+        "mean_lead_seconds": round(mean_lead * interval_s, 1),
+        "fixed_point": {
+            "proactive": proactive[-1],
+            "reactive": reactive[-1],
+            "identical": proactive[-1] == reactive[-1],
+        },
+        "forecast_dispatches": dispatches,
+    }
+
+
 def simulate_delta(
     store, what_if_groups: List[dict], solver=None, template_resolver=None
 ) -> dict:

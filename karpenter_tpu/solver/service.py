@@ -57,6 +57,13 @@ production pipeline:
     registered through the same GaugeRegistry the runtime serves on
     /metrics (subsystem "solver").
 
+Besides bin-packs the queue carries two more program families through
+the same pipeline: `decide` (the HPA decision kernel — no coalescing,
+the batch autoscaler already evaluates the whole fleet at once) and
+`forecast` (forecast/models.py — concurrent forecast requests
+concatenate along the series axis and ride ONE dispatch; the numpy
+degradation target is bit-identical to the device kernel).
+
 The service holds NO domain state — it is a pure function of each
 request — so callers keep their own caches (the encode memo, the
 device-residency memo) and their public APIs unchanged.
@@ -106,6 +113,11 @@ PIPELINE_DEPTH = "pipeline_depth"
 HEALTHY = "healthy"
 DEGRADED = "degraded"
 
+# Forecast shape ladders (forecast requests share the bin-pack compile
+# cache but bucket on (series, history-length) instead)
+FORECAST_T_FLOOR = 16
+FORECAST_S_FLOOR = 8
+
 # Extra watchdog headroom for a dispatch that MISSED the compile cache:
 # first-call XLA/Mosaic compiles legitimately run tens of seconds (TPU
 # solver programs: 20-40s), and a restart mid-compile would loop — the
@@ -149,6 +161,10 @@ class SolverStatistics:
     decide_errors: int = 0
     consolidate_calls: int = 0
     consolidate_candidates: int = 0
+    # forecast seam (forecast/, docs/forecasting.md)
+    forecast_calls: int = 0  # forecast() entries
+    forecast_series: int = 0  # total series submitted across calls
+    forecast_dispatches: int = 0  # coalesced forecast device dispatches
     # backend health FSM + watchdog (docs/resilience.md)
     device_failures: int = 0  # total device-path failures (any rung)
     fsm_trips: int = 0  # healthy -> degraded transitions
@@ -451,6 +467,12 @@ class SolverService:
             deadline=(now + timeout) if timeout else None,
             enqueued_at=now,
         )
+        self._enqueue_one(request)
+        return SolveFuture(request, self)
+
+    def _enqueue_one(self, request: _Request) -> None:
+        """Admit one request to the bounded queue (raises
+        SolverSaturated when full) and wake the worker."""
         with self._cond:
             if len(self._queue) >= self.max_queue:
                 self.stats.rejected += 1
@@ -464,7 +486,6 @@ class SolverService:
             self._c_requests.inc("-", "-")
             self._g_queue.set("-", "-", float(len(self._queue)))
             self._cond.notify_all()
-        return SolveFuture(request, self)
 
     def solve(
         self,
@@ -623,6 +644,88 @@ class SolverService:
             self._g_queue.set("-", "-", float(len(self._queue)))
             self._cond.notify_all()
         return requests
+
+    def forecast(self, inputs, backend: Optional[str] = None,
+                 timeout: Optional[float] = None):
+        """Batched metric forecasting through the service
+        (forecast/models.py, docs/forecasting.md): one ForecastInputs
+        matrix of S series in, one ForecastOutputs out.
+
+        Requests ride the SAME coalescing queue as bin-packs: concurrent
+        forecast() callers whose histories share a time-axis bucket are
+        concatenated along the series axis and answered by ONE device
+        dispatch through the shared compile cache (shape-bucketed on
+        (series, history) — steady fleets never recompile). Degradations
+        match solve(): a full queue or expired deadline answers from the
+        bit-identical numpy mirror inline, a device failure falls back
+        per batch, and the backend-health FSM short-circuits a sick
+        device wholesale. `forecast.predict` is the fault-injection
+        point on the device path (docs/resilience.md)."""
+        n_series = int(np.asarray(inputs.values).shape[0])
+        self.stats.forecast_calls += 1
+        self.stats.forecast_series += n_series
+        if n_series == 0:
+            from karpenter_tpu.forecast.models import ForecastOutputs
+
+            empty = np.zeros(0, np.float32)
+            return ForecastOutputs(
+                point=empty, sigma2=empty.copy(),
+                n_valid=np.zeros(0, np.int32),
+            )
+        if self._closed:
+            raise RuntimeError("solver service is closed")
+        timeout = self.default_timeout_s if timeout is None else timeout
+        request = self._forecast_request(
+            inputs, n_series, backend, timeout
+        )
+        try:
+            self._enqueue_one(request)
+        except SolverSaturated:
+            logger().warning(
+                "solver queue saturated; degrading one forecast to numpy"
+            )
+            return self._numpy_fallback(request.inputs, 0)
+        try:
+            return SolveFuture(request, self).result(
+                timeout if timeout else None
+            )
+        except SolverTimeout:
+            if self.on_timeout == "raise":
+                raise
+            logger().warning(
+                "forecast deadline expired; degrading to numpy"
+            )
+            return self._numpy_fallback(request.inputs, 0)
+
+    def _forecast_request(
+        self, inputs, n_series: int, backend: Optional[str], timeout
+    ) -> _Request:
+        """Resolve the backend and build one queue-ready forecast
+        request, padded up the history-length ladder."""
+        from karpenter_tpu.forecast.models import pad_forecast_inputs
+
+        resolved = self._resolve_backend(backend)
+        if self.device_solver is not None:
+            # the sidecar wire carries bin-packs only: under the gRPC
+            # process split the control plane must not run device math,
+            # so forecasts serve from the numpy mirror
+            resolved = "numpy"
+        elif resolved == "pallas":
+            resolved = "xla"  # no Mosaic forecast kernel; XLA runs on TPU
+        now = self._clock()
+        t_bucket = bucket_up(
+            int(np.asarray(inputs.values).shape[1]), FORECAST_T_FLOOR
+        )
+        return _Request(
+            inputs=pad_forecast_inputs(inputs, t_bucket),
+            buckets=0,
+            backend=resolved,
+            key=("forecast", t_bucket, resolved),
+            n_pods=n_series,
+            n_groups=0,
+            deadline=(now + timeout) if timeout else None,
+            enqueued_at=now,
+        )
 
     def decide(self, inputs):
         """The HPA decision kernel through the service: same metrics
@@ -988,6 +1091,9 @@ class SolverService:
                 request.finish(error=numpy_error)
 
     def _solve_group(self, key: tuple, live: List[_Request]) -> None:
+        if key[0] == "forecast":
+            self._forecast_group(key, live)
+            return
         shape, buckets, backend = key[0], key[1], key[2]
         if backend == "numpy":
             # host program: no device dispatch, no padding (the sparse
@@ -1038,6 +1144,81 @@ class SolverService:
             shape, buckets, live,
             strategy=key[4] if len(key) > 4 else "map",
         )
+
+    def _forecast_group(self, key: tuple, live: List[_Request]) -> None:
+        """One coalesced forecast dispatch: same-T-bucket requests are
+        concatenated along the series axis, padded up the series ladder,
+        and answered by ONE compiled program; results slice back per
+        request. backend == "numpy" serves the mirror inline (the
+        REQUESTED backend, not a degradation). Device failures raise to
+        _dispatch_group, which degrades the batch to numpy and feeds the
+        backend-health FSM like any other device path."""
+        from karpenter_tpu.forecast import models as FM
+
+        t_bucket, backend = key[1], key[2]
+        # completes inline (no pipelining: forecast batches are small
+        # and latency-bound), so drain in-flight bin-pack work first to
+        # keep completion ordered
+        self._drain_inflight()
+        if backend == "numpy":
+            for request in live:
+                t0 = _time.perf_counter()
+                request.finish(result=FM.forecast_numpy(request.inputs))
+                self._record_stage("dispatch", _time.perf_counter() - t0)
+            return
+        t0 = _time.perf_counter()
+        sizes = [request.n_pods for request in live]
+        s_bucket = bucket_up(sum(sizes), FORECAST_S_FLOOR)
+        stacked = FM.concat_forecast_inputs(
+            [request.inputs for request in live], s_bucket
+        )
+        self._record_stage("pad", _time.perf_counter() - t0)
+        fn, fresh = self._forecast_compiled(
+            ("forecast", s_bucket, t_bucket, backend)
+        )
+        import jax
+
+        t0 = _time.perf_counter()
+        with self._device_section(
+            live, grace=COMPILE_GRACE_S if fresh else 0.0
+        ):
+            with solver_trace("solver.forecast"):
+                # the forecast-path fault-injection point
+                # (faults/registry.py, docs/resilience.md): an error
+                # plan exercises the numpy degradation + FSM, a hang
+                # plan the watchdog drain
+                inject("forecast.predict")
+                out = fn(stacked)
+                jax.block_until_ready(out)
+        if self._stale():
+            return  # watchdog already answered these from numpy
+        self._record_stage("dispatch", _time.perf_counter() - t0)
+        self._count_dispatch()
+        self.stats.forecast_dispatches += 1
+        t0 = _time.perf_counter()
+        offset = 0
+        for request, size in zip(live, sizes):
+            request.finish(
+                result=FM.slice_forecast_outputs(
+                    out, offset, offset + size
+                )
+            )
+            offset += size
+        self._record_stage("scatter", _time.perf_counter() - t0)
+        self._record_device_success()
+
+    def _forecast_compiled(self, cache_key: tuple):
+        """(compiled batched forecast program, fresh) — the forecast
+        face of the shared compile cache (same hit/miss counters)."""
+        fresh = self._count_compile(cache_key)
+        fn = self._compiled.get(cache_key)
+        if fn is None:
+            import jax
+
+            from karpenter_tpu.forecast import models as FM
+
+            fn = self._compiled[cache_key] = jax.jit(FM.forecast)
+        return fn, fresh
 
     def _solve_pallas(self, shape, buckets: int, live: List[_Request]) -> None:
         import jax
@@ -1270,7 +1451,16 @@ class SolverService:
         self._c_fallback.inc("-", "-")
         return self._numpy_solve(inputs, buckets)
 
-    def _numpy_solve(self, inputs: BinPackInputs, buckets: int):
+    def _numpy_solve(self, inputs, buckets: int):
+        from karpenter_tpu.forecast.models import (
+            ForecastInputs,
+            forecast_numpy,
+        )
+
+        if isinstance(inputs, ForecastInputs):
+            # bit-identical mirror of the device kernel
+            # (forecast/models.py parity contract)
+            return forecast_numpy(inputs)
         from karpenter_tpu.ops.numpy_binpack import binpack_numpy
 
         return binpack_numpy(inputs, buckets=buckets)

@@ -362,6 +362,73 @@ class TestSimulateUnderFaults:
             service.close()
 
 
+class TestForecastChaos:
+    """Satellite pin: forecast-path device faults degrade DOWN the
+    ladder (numpy mirror first, reactive-only at worst) and NEVER block
+    the reconcile loop — the fleet converges to the same fixed point a
+    fault-free reactive run reaches."""
+
+    FIXED_POINT = 11  # queue=41, AverageValue target=4 -> ceil(41/4)
+
+    def test_forecast_device_faults_degrade_not_block(self):
+        from karpenter_tpu.api.horizontalautoscaler import ForecastSpec
+
+        clock = FakeClock()
+        provider = RecordingFactory()
+        provider.node_replicas["g"] = 5
+        runtime = KarpenterRuntime(
+            Options(solver_health_threshold=2,
+                    solver_probe_interval_s=0.0),
+            cloud_provider_factory=provider,
+            clock=clock,
+        )
+        runtime.solver_service.backend = "xla"
+        runtime.registry.register("queue", "length").set(
+            "q", "default", 41.0
+        )
+        runtime.store.create(sng_of("g", replicas=5))
+        ha = queue_ha("g", 'karpenter_queue_length{name="q"}')
+        ha.spec.behavior.forecast = ForecastSpec(
+            horizon_seconds=30.0, model="linear", min_samples=3
+        )
+        runtime.store.create(ha)
+        service = runtime.solver_service
+        try:
+            registry = faults.install(FaultRegistry(seed=CHAOS_SEED))
+            registry.plan("forecast.predict", probability=1.0)
+            for _ in range(30):
+                clock.advance(61.0)
+                runtime.manager.reconcile_all()
+            assert registry.injected.get("forecast.predict", 0) >= 1, (
+                "the scenario must actually have exercised forecast "
+                "faults"
+            )
+            # every forecast answered from the numpy mirror; the
+            # reconcile loop never stalled and the fleet sits at the
+            # reactive fixed point (a flat metric forecasts flat)
+            assert service.stats.forecast_calls >= 20
+            assert service.stats.fallbacks >= 1
+            assert service.queue_depth() == 0
+            assert provider.node_replicas["g"] == self.FIXED_POINT
+            got = runtime.store.get(
+                "HorizontalAutoscaler", "default", "ha"
+            )
+            assert got.status.desired_replicas == self.FIXED_POINT
+            # the repeated device faults tripped the backend FSM — the
+            # forecast path feeds the SAME health ladder bin-packs do
+            assert service.stats.fsm_trips >= 1
+
+            faults.uninstall()  # ---- faults clear ----
+            for _ in range(3):
+                clock.advance(61.0)
+                runtime.manager.reconcile_all()
+            assert service.backend_health() == "healthy"
+            assert provider.node_replicas["g"] == self.FIXED_POINT
+        finally:
+            faults.uninstall()
+            runtime.close()
+
+
 class TestSolverFSM:
     def test_trips_wholesale_and_recovers_via_probe(self):
         service = SolverService(

@@ -84,11 +84,14 @@ class TestCrdGeneration:
             "behavior",
         }
         behavior = spec["behavior"]["properties"]
-        assert set(behavior) == {"scaleUp", "scaleDown"}
+        assert set(behavior) == {"scaleUp", "scaleDown", "forecast"}
         window = behavior["scaleUp"]["properties"][
             "stabilizationWindowSeconds"
         ]
         assert window == {"type": "integer"}
+        forecast = behavior["forecast"]["properties"]
+        assert forecast["horizonSeconds"] == {"type": "number"}
+        assert forecast["minSamples"] == {"type": "integer"}
 
     def test_metric_target_values_are_numbers(self):
         # design departure from the reference: target values are plain

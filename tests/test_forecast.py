@@ -1,0 +1,925 @@
+"""Predictive-scaling subsystem tests (karpenter_tpu/forecast/).
+
+Pins the ISSUE's acceptance bar:
+  * device == numpy forecast parity BIT-FOR-BIT per shape bucket
+    (property sweep over models, masks, seasons, shapes);
+  * ring-buffer wraparound / pruning / eviction correctness;
+  * blend monotonicity — a forecast can only RAISE desired replicas,
+    never lower them below the reactive decision;
+  * all N HA series forecast in ONE coalesced device dispatch;
+  * proactive lead — on a scripted ramp the forecast-enabled HA reaches
+    target replicas >= 2 ticks before the reactive baseline, with an
+    identical steady-state fixed point;
+  * stale-metric bridge — a failed query reuses the last history sample
+    (age-bounded) instead of dropping the row from the batch;
+  * never-block — a failing forecast path degrades to reactive-only.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import conditions as cond
+from karpenter_tpu.api.core import ObjectMeta
+from karpenter_tpu.api.horizontalautoscaler import (
+    Behavior,
+    CrossVersionObjectReference,
+    ForecastSpec,
+    HorizontalAutoscaler,
+    HorizontalAutoscalerSpec,
+    Metric,
+    MetricTarget,
+    PrometheusMetricSource,
+)
+from karpenter_tpu.api.scalablenodegroup import (
+    ScalableNodeGroup,
+    ScalableNodeGroupSpec,
+)
+from karpenter_tpu.autoscaler import BatchAutoscaler
+from karpenter_tpu.forecast import (
+    FleetForecaster,
+    MetricHistoryStore,
+    models as M,
+)
+from karpenter_tpu.metrics.clients import MetricsClientFactory
+from karpenter_tpu.metrics.registry import GaugeRegistry
+from karpenter_tpu.ops import decision as D
+from karpenter_tpu.solver import SolverService
+from karpenter_tpu.store import Store
+
+SEED = 20260803
+
+
+class FakeClock:
+    def __init__(self, start=1_000_000.0):
+        self.now = start
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, seconds):
+        self.now += seconds
+
+
+def random_forecast_inputs(rng, S, T):
+    """Seeded, adversarially-shaped histories: ramps, seasonality,
+    noise, gaps, mixed models, out-of-range seasons."""
+    base = rng.uniform(0, 300, (S, 1)).astype(np.float32)
+    ramp = rng.uniform(-2, 4, (S, 1)).astype(np.float32)
+    ticks = np.arange(T, dtype=np.float32)[None, :]
+    seasonal = (
+        rng.uniform(0, 25, (S, 1)) * np.sin(ticks * 2 * np.pi / 8)
+    ).astype(np.float32)
+    noise = rng.normal(0, 4, (S, T)).astype(np.float32)
+    values = (base + ramp * ticks * 10 + seasonal + noise).astype(
+        np.float32
+    )
+    valid = rng.rand(S, T) > 0.3
+    times = (
+        (ticks - (T - 1)) * 10.0 + rng.uniform(-1, 1, (S, T))
+    ).astype(np.float32)
+    horizon = rng.uniform(10, 200, S).astype(np.float32)
+    weights = np.power(
+        np.float32(0.5), (-times) / horizon[:, None]
+    ).astype(np.float32)
+    return M.ForecastInputs(
+        values=values,
+        valid=valid,
+        times=times,
+        weights=weights,
+        horizon=horizon,
+        step_s=rng.uniform(0, 30, S).astype(np.float32),
+        model=rng.choice([M.MODEL_LINEAR, M.MODEL_HOLT_WINTERS], S).astype(
+            np.int32
+        ),
+        season=rng.choice([0, 1, 4, 8, 3 * T], S).astype(np.int32),
+        alpha=rng.uniform(0.1, 1.0, S).astype(np.float32),
+        beta=rng.uniform(0.05, 1.0, S).astype(np.float32),
+        gamma=rng.uniform(0.05, 1.0, S).astype(np.float32),
+    )
+
+
+def assert_outputs_equal(a, b, context=""):
+    for field in ("point", "sigma2", "n_valid"):
+        x, y = np.asarray(getattr(a, field)), np.asarray(getattr(b, field))
+        assert np.array_equal(x, y), (
+            f"{context}: {field} differs bit-for-bit "
+            f"(max |diff| {np.abs(x.astype(np.float64) - y.astype(np.float64)).max()})"
+        )
+
+
+class TestParity:
+    """Device (jitted XLA) == numpy mirror, bit for bit — the fallback
+    the degradation ladder serves must be indistinguishable."""
+
+    def test_bit_for_bit_across_buckets(self):
+        rng = np.random.RandomState(SEED)
+        for S, T in [(1, 8), (5, 16), (33, 64), (128, 32)]:
+            inputs = random_forecast_inputs(rng, S, T)
+            dev = M.forecast_jit(inputs)
+            host = M.forecast_numpy(inputs)
+            assert_outputs_equal(dev, host, f"S={S} T={T}")
+
+    def test_all_invalid_series_is_calm(self):
+        rng = np.random.RandomState(SEED)
+        inputs = random_forecast_inputs(rng, 4, 16)
+        inputs.valid[:] = False
+        dev = M.forecast_jit(inputs)
+        host = M.forecast_numpy(inputs)
+        assert_outputs_equal(dev, host, "all-invalid")
+        assert np.all(np.asarray(dev.n_valid) == 0)
+        assert np.all(np.asarray(dev.point) == 0.0)
+        assert np.all(np.isfinite(np.asarray(dev.sigma2)))
+
+    def test_padding_is_semantics_preserving(self):
+        """Left-padding T and appending invalid S rows (what the service
+        does to hit a shape bucket) must not move a single bit."""
+        rng = np.random.RandomState(SEED + 1)
+        inputs = random_forecast_inputs(rng, 6, 24)
+        bare = M.forecast_numpy(inputs)
+        padded = M.pad_forecast_inputs(inputs, 32)
+        stacked = M.concat_forecast_inputs([padded], 8)
+        padded_out = M.forecast_numpy(stacked)
+        cropped = M.slice_forecast_outputs(padded_out, 0, 6)
+        assert_outputs_equal(bare, cropped, "padding")
+
+    def test_service_device_equals_direct_numpy(self):
+        """Through the full service path (queue, bucketing, coalesced
+        dispatch) the answer still equals the direct numpy mirror."""
+        rng = np.random.RandomState(SEED + 2)
+        inputs = random_forecast_inputs(rng, 9, 20)
+        service = SolverService(backend="xla")
+        try:
+            out = service.forecast(inputs)
+        finally:
+            service.close()
+        assert_outputs_equal(out, M.forecast_numpy(inputs), "service")
+
+    def test_ramp_forecast_projects_ahead(self):
+        """Sanity on the math itself: a clean linear ramp forecasts
+        above its newest sample by roughly slope * horizon."""
+        T = 32
+        values = (10.0 + 2.0 * np.arange(T, dtype=np.float32))[None, :]
+        times = ((np.arange(T, dtype=np.float32) - (T - 1)) * 10.0)[None, :]
+        horizon = np.array([60.0], np.float32)
+        weights = np.power(np.float32(0.5), (-times) / 60.0).astype(
+            np.float32
+        )
+        inputs = M.ForecastInputs(
+            values=values,
+            valid=np.ones((1, T), bool),
+            times=times,
+            weights=weights,
+            horizon=horizon,
+            step_s=np.array([10.0], np.float32),
+            model=np.array([M.MODEL_LINEAR], np.int32),
+            season=np.zeros(1, np.int32),
+            alpha=np.array([0.5], np.float32),
+            beta=np.array([0.1], np.float32),
+            gamma=np.array([0.3], np.float32),
+        )
+        point = float(M.forecast_numpy(inputs).point[0])
+        newest = float(values[0, -1])
+        # slope is 0.2/s, horizon 60s -> ~+12 over the newest sample
+        assert newest + 8 < point < newest + 16
+
+
+class TestHistoryStore:
+    def test_wraparound_keeps_newest_in_order(self):
+        store = MetricHistoryStore(capacity=8)
+        for i in range(37):
+            store.append(("ha", "ns", "x", 0), 100.0 + i, float(i))
+        ts, vs = store.series(("ha", "ns", "x", 0))
+        assert len(vs) == 8
+        assert list(vs) == [float(i) for i in range(29, 37)]
+        assert list(ts) == [100.0 + i for i in range(29, 37)]
+        assert store.last(("ha", "ns", "x", 0)) == (136.0, 36.0)
+
+    def test_non_finite_samples_dropped(self):
+        store = MetricHistoryStore(capacity=4)
+        store.append(("k",), 1.0, float("nan"))
+        store.append(("k",), 2.0, float("inf"))
+        store.append(("k",), 3.0, 7.0)
+        assert store.count(("k",)) == 1
+
+    def test_prune_by_prefix(self):
+        store = MetricHistoryStore(capacity=4)
+        store.append(("ha", "a", "x", 0), 1.0, 1.0)
+        store.append(("ha", "a", "x", 1), 1.0, 1.0)
+        store.append(("ha", "a", "y", 0), 1.0, 1.0)
+        store.append(("q", "metric", ()), 1.0, 1.0)
+        assert store.prune("ha", "a", "x") == 2
+        assert store.count(("ha", "a", "x", 0)) == 0
+        assert store.count(("ha", "a", "y", 0)) == 1
+        assert store.count(("q", "metric", ())) == 1
+
+    def test_bounded_series_eviction(self):
+        store = MetricHistoryStore(capacity=4, max_series=3)
+        for i in range(5):
+            store.append(("s", i), float(i), 1.0)
+        assert len(store) == 3
+        # the oldest-touched series were evicted, the newest retained
+        assert store.count(("s", 4)) == 1
+        assert store.count(("s", 0)) == 0
+
+    def test_seed_respects_series_bound(self):
+        store = MetricHistoryStore(capacity=4, max_series=2)
+        store.append(("q", "m", ()), 1.0, 1.0)
+        store.append(("other",), 2.0, 1.0)
+        assert store.seed(("ha", "ns", "x", 0), ("q", "m", ()))
+        # seeding enforces the same bound append() does
+        assert len(store) == 2
+
+    def test_seed_copies_warm_pool(self):
+        store = MetricHistoryStore(capacity=8)
+        for i in range(5):
+            store.append(("q", "m", ()), float(i), float(10 + i))
+        assert store.seed(("ha", "ns", "x", 0), ("q", "m", ()))
+        ts, vs = store.series(("ha", "ns", "x", 0))
+        assert list(vs) == [10.0, 11.0, 12.0, 13.0, 14.0]
+        # seeding never overwrites an existing series
+        store.append(("ha", "ns", "y", 0), 9.0, 9.0)
+        assert not store.seed(("ha", "ns", "y", 0), ("q", "m", ()))
+
+    def test_matrix_right_aligned(self):
+        store = MetricHistoryStore(capacity=6)
+        for i in range(3):
+            store.append(("k",), 100.0 + 10 * i, float(i))
+        values, valid, times, step_s = store.matrix([("k",)], now=130.0)
+        assert values.shape == (1, 6)
+        assert list(valid[0]) == [False, False, False, True, True, True]
+        assert list(values[0, 3:]) == [0.0, 1.0, 2.0]
+        assert list(times[0, 3:]) == [-30.0, -20.0, -10.0]
+        assert step_s[0] == pytest.approx(10.0)
+
+
+def decision_inputs_with_forecast(rng, n=7, m=3):
+    """A random reactive DecisionInputs plus a forecast overlay."""
+    spec = rng.randint(1, 30, n).astype(np.int32)
+    inputs = D.DecisionInputs(
+        metric_value=rng.uniform(0, 100, (n, m)).astype(np.float32),
+        target_value=rng.uniform(1, 20, (n, m)).astype(np.float32),
+        target_type=rng.choice(
+            [D.TYPE_VALUE, D.TYPE_AVERAGE_VALUE, D.TYPE_UTILIZATION], (n, m)
+        ).astype(np.int32),
+        metric_valid=rng.rand(n, m) > 0.2,
+        spec_replicas=spec,
+        status_replicas=spec,
+        min_replicas=np.zeros(n, np.int32),
+        max_replicas=np.full(n, 10_000, np.int32),
+        up_window=np.zeros(n, np.int32),
+        down_window=np.zeros(n, np.int32),
+        up_policy=np.full(n, D.POLICY_MAX, np.int32),
+        down_policy=np.full(n, D.POLICY_MAX, np.int32),
+        last_scale_time=np.zeros(n, np.float32),
+        has_last_scale=np.zeros(n, bool),
+        now=np.float32(0.0),
+        up_ptype=np.zeros((n, 1), np.int32),
+        up_pvalue=np.zeros((n, 1), np.int32),
+        up_pperiod=np.ones((n, 1), np.int32),
+        up_pvalid=np.zeros((n, 1), bool),
+        down_ptype=np.zeros((n, 1), np.int32),
+        down_pvalue=np.zeros((n, 1), np.int32),
+        down_pperiod=np.ones((n, 1), np.int32),
+        down_pvalid=np.zeros((n, 1), bool),
+    )
+    forecast_value = rng.uniform(0, 150, (n, m)).astype(np.float32)
+    forecast_valid = rng.rand(n, m) > 0.4
+    return inputs, forecast_value, forecast_valid
+
+
+class TestBlendMonotonicity:
+    """The kernel property the spec's safety story rests on: forecasts
+    can only RAISE desired replicas, never lower them below reactive."""
+
+    def test_blend_never_lowers_desired(self):
+        import dataclasses
+
+        rng = np.random.RandomState(SEED)
+        for _ in range(20):
+            inputs, fv, fok = decision_inputs_with_forecast(rng)
+            reactive = D.decide_jit(inputs)
+            blended = D.decide_jit(
+                dataclasses.replace(
+                    inputs, forecast_value=fv, forecast_valid=fok
+                )
+            )
+            assert np.all(
+                np.asarray(blended.desired) >= np.asarray(reactive.desired)
+            )
+            assert np.all(
+                np.asarray(blended.recommendation)
+                >= np.asarray(reactive.recommendation)
+            )
+
+    def test_low_forecast_is_identity(self):
+        """A forecast at-or-below the observed values changes nothing —
+        scale-down stays purely reactive."""
+        import dataclasses
+
+        rng = np.random.RandomState(SEED + 1)
+        for _ in range(10):
+            inputs, _fv, fok = decision_inputs_with_forecast(rng)
+            low = (np.asarray(inputs.metric_value) * 0.5).astype(np.float32)
+            reactive = D.decide_jit(inputs)
+            blended = D.decide_jit(
+                dataclasses.replace(
+                    inputs, forecast_value=low, forecast_valid=fok
+                )
+            )
+            assert np.array_equal(
+                np.asarray(blended.desired), np.asarray(reactive.desired)
+            )
+
+    def test_wire_codec_roundtrips_forecast_fields(self):
+        """The sidecar's tensor framing carries (and tolerates the
+        absence of) the new optional fields."""
+        import dataclasses
+
+        from karpenter_tpu.sidecar.codec import (
+            pack_dataclass,
+            unpack_dataclass,
+        )
+
+        rng = np.random.RandomState(SEED)
+        inputs, fv, fok = decision_inputs_with_forecast(rng)
+        with_fields = dataclasses.replace(
+            inputs, forecast_value=fv, forecast_valid=fok
+        )
+        decoded, _ = unpack_dataclass(
+            D.DecisionInputs, pack_dataclass(with_fields)
+        )
+        assert np.array_equal(decoded.forecast_value, fv)
+        assert np.array_equal(decoded.forecast_valid, fok)
+        legacy, _ = unpack_dataclass(
+            D.DecisionInputs, pack_dataclass(inputs)
+        )
+        assert legacy.forecast_value is None
+        assert legacy.forecast_valid is None
+
+
+def forecast_ha(name="ha", target_name="g", spec=None, query=None):
+    return HorizontalAutoscaler(
+        metadata=ObjectMeta(name=name),
+        spec=HorizontalAutoscalerSpec(
+            scale_target_ref=CrossVersionObjectReference(
+                kind="ScalableNodeGroup", name=target_name
+            ),
+            min_replicas=1,
+            max_replicas=10_000,
+            metrics=[
+                Metric(
+                    prometheus=PrometheusMetricSource(
+                        query=query
+                        or f'karpenter_queue_length{{name="{name}"}}',
+                        target=MetricTarget(type="AverageValue", value=4),
+                    )
+                )
+            ],
+            behavior=Behavior(forecast=spec),
+        ),
+    )
+
+
+def fleet_world(n_has, spec):
+    store = Store()
+    registry = GaugeRegistry()
+    gauge = registry.register("queue", "length")
+    has = []
+    for i in range(n_has):
+        name = f"ha-{i}"
+        gauge.set(name, "default", 8.0 + i)
+        store.create(
+            ScalableNodeGroup(
+                metadata=ObjectMeta(name=f"g-{i}"),
+                spec=ScalableNodeGroupSpec(
+                    replicas=2, type="FakeNodeGroup", id=f"g-{i}"
+                ),
+            )
+        )
+        ha = forecast_ha(name=name, target_name=f"g-{i}", spec=spec)
+        store.create(ha)
+        has.append(ha)
+    return store, registry, gauge
+
+
+class TestSingleDispatch:
+    def test_all_series_one_coalesced_dispatch(self):
+        """The acceptance criterion: N HAs' series forecast in ONE
+        device dispatch per tick (stats.forecast_dispatches advances by
+        exactly 1 once histories are warm)."""
+        n = 9
+        spec = ForecastSpec(
+            horizon_seconds=60.0, model="linear", min_samples=4
+        )
+        store, registry, gauge = fleet_world(n, spec)
+        clock = FakeClock()
+        service = SolverService(backend="xla")
+        forecaster = FleetForecaster(
+            forecast_fn=service.forecast,
+            registry=registry,
+            clock=clock,
+            capacity=16,
+        )
+        autoscaler = BatchAutoscaler(
+            MetricsClientFactory(registry=registry),
+            store,
+            clock=clock,
+            decider=service.decide,
+            forecaster=forecaster,
+        )
+        try:
+            has = store.list("HorizontalAutoscaler")
+            for _ in range(5):  # warm every series past min_samples
+                errors = autoscaler.reconcile_batch(has)
+                assert all(e is None for e in errors.values())
+                clock.advance(10.0)
+            before = service.stats.forecast_dispatches
+            errors = autoscaler.reconcile_batch(has)
+            assert all(e is None for e in errors.values())
+            assert service.stats.forecast_dispatches == before + 1, (
+                "all HA series must ride ONE coalesced forecast dispatch"
+            )
+            # and that one dispatch carried every series in the fleet
+            assert service.stats.forecast_series >= n
+        finally:
+            service.close()
+
+    def test_forecasting_condition_goes_true(self):
+        spec = ForecastSpec(
+            horizon_seconds=60.0, model="linear", min_samples=3
+        )
+        store, registry, gauge = fleet_world(1, spec)
+        clock = FakeClock()
+        service = SolverService(backend="xla")
+        forecaster = FleetForecaster(
+            forecast_fn=service.forecast, clock=clock, capacity=16
+        )
+        autoscaler = BatchAutoscaler(
+            MetricsClientFactory(registry=registry),
+            store,
+            clock=clock,
+            decider=service.decide,
+            forecaster=forecaster,
+        )
+        try:
+            ha = store.get("HorizontalAutoscaler", "default", "ha-0")
+            autoscaler.reconcile_batch([ha])
+            warming = ha.status_conditions().get(cond.FORECASTING)
+            assert warming is not None and warming.status == cond.FALSE
+            assert warming.reason == "ForecastWarmingUp"
+            for _ in range(4):
+                clock.advance(10.0)
+                autoscaler.reconcile_batch([ha])
+            active = ha.status_conditions().get(cond.FORECASTING)
+            assert active.status == cond.TRUE
+        finally:
+            service.close()
+
+
+class TestProactiveLead:
+    def test_scripted_ramp_lead_and_fixed_point(self):
+        """The seeded acceptance scenario: on a scripted ramp the
+        forecast-enabled HA reaches target replicas >= 2 ticks before
+        the reactive baseline, and both settle on the SAME fixed
+        point."""
+        from karpenter_tpu.simulate import simulate_forecast
+
+        report = simulate_forecast(
+            ticks=80,
+            model="holt-winters",
+            seed=SEED,
+            backend="xla",
+        )
+        full = report["milestones"]["100%"]
+        assert full["lead_ticks"] is not None and full["lead_ticks"] >= 2, (
+            f"proactive lead below the bar: {report['milestones']}"
+        )
+        assert report["fixed_point"]["identical"], report["fixed_point"]
+        assert report["forecast_dispatches"] > 0
+
+    def test_linear_model_also_leads(self):
+        from karpenter_tpu.simulate import simulate_forecast
+
+        report = simulate_forecast(
+            ticks=80, model="linear", seed=SEED + 1, backend="xla"
+        )
+        assert report["milestones"]["100%"]["lead_ticks"] >= 2
+        assert report["fixed_point"]["identical"]
+
+
+class TestStaleMetricBridge:
+    def build(self, stale_max_age_s=60.0):
+        spec = ForecastSpec(
+            horizon_seconds=60.0, model="linear", min_samples=4
+        )
+        store, registry, gauge = fleet_world(1, spec)
+        clock = FakeClock()
+        service = SolverService(backend="xla")
+        forecaster = FleetForecaster(
+            forecast_fn=service.forecast,
+            clock=clock,
+            capacity=16,
+            stale_max_age_s=stale_max_age_s,
+        )
+        autoscaler = BatchAutoscaler(
+            MetricsClientFactory(registry=registry),
+            store,
+            clock=clock,
+            decider=service.decide,
+            forecaster=forecaster,
+        )
+        return store, registry, gauge, clock, service, autoscaler
+
+    def test_failed_query_reuses_last_sample(self):
+        store, registry, gauge, clock, service, autoscaler = self.build()
+        try:
+            ha = store.get("HorizontalAutoscaler", "default", "ha-0")
+            gauge.set("ha-0", "default", 40.0)
+            assert autoscaler.reconcile_batch([ha])[("default", "ha-0")] is None
+            # the metric disappears (exporter restart): the row must
+            # keep deciding on the last sample instead of erroring
+            gauge.remove("ha-0", "default")
+            clock.advance(10.0)
+            error = autoscaler.reconcile_batch([ha])[("default", "ha-0")]
+            assert error is None
+            # ceil(40 / 4) = 10 — the decision used the stale sample
+            assert ha.status.desired_replicas == 10
+        finally:
+            service.close()
+
+    def test_stale_sample_ages_out(self):
+        store, registry, gauge, clock, service, autoscaler = self.build(
+            stale_max_age_s=30.0
+        )
+        try:
+            ha = store.get("HorizontalAutoscaler", "default", "ha-0")
+            assert autoscaler.reconcile_batch([ha])[("default", "ha-0")] is None
+            gauge.remove("ha-0", "default")
+            clock.advance(31.0)  # past the bound: the row must ERROR now
+            error = autoscaler.reconcile_batch([ha])[("default", "ha-0")]
+            assert error is not None
+        finally:
+            service.close()
+
+    def test_without_forecaster_failure_still_errors(self):
+        """Reactive-only runtimes keep the original posture: a failed
+        query fails the row."""
+        store, registry, gauge = fleet_world(1, None)
+        clock = FakeClock()
+        autoscaler = BatchAutoscaler(
+            MetricsClientFactory(registry=registry), store, clock=clock
+        )
+        ha = store.get("HorizontalAutoscaler", "default", "ha-0")
+        assert autoscaler.reconcile_batch([ha])[("default", "ha-0")] is None
+        gauge.remove("ha-0", "default")
+        assert (
+            autoscaler.reconcile_batch([ha])[("default", "ha-0")]
+            is not None
+        )
+
+
+class TestDegradation:
+    def test_forecast_failure_degrades_to_reactive(self):
+        """The never-block contract: a forecast path that RAISES (past
+        every service degradation rung) costs the tick nothing but its
+        proactivity."""
+
+        def broken(_inputs):
+            raise RuntimeError("device on fire")
+
+        spec = ForecastSpec(
+            horizon_seconds=60.0, model="linear", min_samples=2
+        )
+        store, registry, gauge = fleet_world(1, spec)
+        clock = FakeClock()
+        service = SolverService(backend="xla")
+        forecaster = FleetForecaster(
+            forecast_fn=broken, registry=registry, clock=clock, capacity=16
+        )
+        autoscaler = BatchAutoscaler(
+            MetricsClientFactory(registry=registry),
+            store,
+            clock=clock,
+            decider=service.decide,
+            forecaster=forecaster,
+        )
+        try:
+            ha = store.get("HorizontalAutoscaler", "default", "ha-0")
+            gauge.set("ha-0", "default", 40.0)
+            for _ in range(4):
+                error = autoscaler.reconcile_batch([ha])[
+                    ("default", "ha-0")
+                ]
+                assert error is None  # never blocks the reconcile
+                clock.advance(10.0)
+            # purely reactive decision: ceil(40/4)
+            assert ha.status.desired_replicas == 10
+            forecasting = ha.status_conditions().get(cond.FORECASTING)
+            assert forecasting.status == cond.FALSE
+            assert forecasting.reason == "ForecastUnavailable"
+            disabled = registry.gauge("forecast", "disabled_total").get(
+                "ha-0", "default"
+            )
+            assert disabled is not None and disabled >= 1
+        finally:
+            service.close()
+
+    def test_skill_gate_disables_blend(self):
+        """Consistently wrong forecasts push the skill EWMA under the
+        spec floor and blending auto-disables with the structured
+        reason."""
+        spec = ForecastSpec(
+            horizon_seconds=10.0, model="linear", min_samples=2,
+            min_skill=0.9,
+        )
+        store, registry, gauge = fleet_world(1, spec)
+        clock = FakeClock()
+
+        def wild(inputs):  # a forecaster that is always 10x too high
+            out = M.forecast_numpy(inputs)
+            return M.ForecastOutputs(
+                point=out.point * 10.0 + 1000.0,
+                sigma2=out.sigma2,
+                n_valid=out.n_valid,
+            )
+
+        forecaster = FleetForecaster(
+            forecast_fn=wild, clock=clock, capacity=16
+        )
+        autoscaler = BatchAutoscaler(
+            MetricsClientFactory(registry=registry),
+            store,
+            clock=clock,
+            decider=None,
+            forecaster=forecaster,
+        )
+        ha = store.get("HorizontalAutoscaler", "default", "ha-0")
+        for _ in range(8):
+            assert autoscaler.reconcile_batch([ha])[
+                ("default", "ha-0")
+            ] is None
+            clock.advance(10.0)
+        assert forecaster.skill("default", "ha-0") < 0.9
+        forecasting = ha.status_conditions().get(cond.FORECASTING)
+        assert forecasting.status == cond.FALSE
+        assert forecasting.reason == "ForecastSkillDegraded"
+
+    def test_skill_gate_recovers_via_shadow_predictions(self):
+        """While gated, forecasts keep running in SHADOW (scored but
+        not blended), so a forecaster that starts predicting well again
+        lifts the skill EWMA back over the floor and blending
+        re-enables — the gate is a pause, not a ratchet."""
+        spec = ForecastSpec(
+            horizon_seconds=10.0, model="linear", min_samples=2,
+            min_skill=0.6,
+        )
+        store, registry, gauge = fleet_world(1, spec)
+        clock = FakeClock()
+        mode = {"wild": True}
+
+        def switchable(inputs):
+            out = M.forecast_numpy(inputs)
+            if mode["wild"]:
+                return M.ForecastOutputs(
+                    point=out.point * 10.0 + 1000.0,
+                    sigma2=out.sigma2,
+                    n_valid=out.n_valid,
+                )
+            return out
+
+        forecaster = FleetForecaster(
+            forecast_fn=switchable, clock=clock, capacity=16
+        )
+        autoscaler = BatchAutoscaler(
+            MetricsClientFactory(registry=registry),
+            store,
+            clock=clock,
+            forecaster=forecaster,
+        )
+        ha = store.get("HorizontalAutoscaler", "default", "ha-0")
+
+        def tick(n):
+            for _ in range(n):
+                assert autoscaler.reconcile_batch([ha])[
+                    ("default", "ha-0")
+                ] is None
+                clock.advance(10.0)
+
+        tick(10)
+        assert forecaster.skill("default", "ha-0") < 0.6
+        assert (
+            ha.status_conditions().get(cond.FORECASTING).reason
+            == "ForecastSkillDegraded"
+        )
+        mode["wild"] = False  # the forecaster heals
+        tick(20)
+        assert forecaster.skill("default", "ha-0") >= 0.6, (
+            "shadow predictions must let the skill EWMA recover"
+        )
+        assert (
+            ha.status_conditions().get(cond.FORECASTING).status
+            == cond.TRUE
+        )
+
+    def test_query_observer_dedupes_shared_reads(self):
+        """N autoscalers sharing one query read it N times per tick;
+        the warm pool must keep ONE sample per tick or its apparent
+        spacing (and any series seeded from it) would shrink N-fold."""
+        from karpenter_tpu.metrics.types import Metric as MetricValue
+
+        clock = FakeClock()
+        forecaster = FleetForecaster(
+            forecast_fn=M.forecast_numpy, clock=clock, capacity=16
+        )
+        for tick in range(5):
+            for _reader in range(3):  # three HAs share the query
+                forecaster.observe_query(
+                    MetricValue(name="q", labels={"name": "x"}, value=4.0)
+                )
+            clock.advance(10.0)
+        from karpenter_tpu.forecast import query_key
+
+        ts, _vs = forecaster.history.series(
+            query_key("q", {"name": "x"})
+        )
+        assert len(ts) == 5
+        assert list(np.diff(ts)) == [10.0] * 4
+
+    def test_partially_warm_multimetric_ha_reports_active(self):
+        """A second, freshly added metric must not flip the Forecasting
+        condition to WarmingUp while the first metric's forecasts are
+        actively blending."""
+        spec = ForecastSpec(
+            horizon_seconds=60.0, model="linear", min_samples=3
+        )
+        store, registry, gauge = fleet_world(1, spec)
+        clock = FakeClock()
+        forecaster = FleetForecaster(
+            forecast_fn=M.forecast_numpy, clock=clock, capacity=16
+        )
+        autoscaler = BatchAutoscaler(
+            MetricsClientFactory(registry=registry),
+            store,
+            clock=clock,
+            forecaster=forecaster,
+        )
+        ha = store.get("HorizontalAutoscaler", "default", "ha-0")
+        for _ in range(4):  # warm metric 0 past min_samples
+            assert autoscaler.reconcile_batch([ha])[
+                ("default", "ha-0")
+            ] is None
+            clock.advance(10.0)
+        assert (
+            ha.status_conditions().get(cond.FORECASTING).status
+            == cond.TRUE
+        )
+        # a second metric appears mid-life: series 1 is cold
+        registry.gauge("queue", "length").set("extra", "default", 2.0)
+        ha.spec.metrics.append(
+            Metric(
+                prometheus=PrometheusMetricSource(
+                    query='karpenter_queue_length{name="extra"}',
+                    target=MetricTarget(type="AverageValue", value=4),
+                )
+            )
+        )
+        assert autoscaler.reconcile_batch([ha])[("default", "ha-0")] is None
+        forecasting = ha.status_conditions().get(cond.FORECASTING)
+        assert forecasting.status == cond.TRUE, (
+            "warm series still blend; the condition must say so"
+        )
+
+    def test_spec_removal_clears_condition(self):
+        """Editing behavior.forecast OFF must drop the Forecasting
+        condition from status — a frozen last value would keep
+        reporting a posture nothing computes anymore."""
+        spec = ForecastSpec(horizon_seconds=60.0, min_samples=2)
+        store, registry, gauge = fleet_world(1, spec)
+        clock = FakeClock()
+        forecaster = FleetForecaster(
+            forecast_fn=M.forecast_numpy, clock=clock, capacity=16
+        )
+        autoscaler = BatchAutoscaler(
+            MetricsClientFactory(registry=registry),
+            store,
+            clock=clock,
+            forecaster=forecaster,
+        )
+        ha = store.get("HorizontalAutoscaler", "default", "ha-0")
+        for _ in range(3):
+            autoscaler.reconcile_batch([ha])
+            clock.advance(10.0)
+        assert ha.status_conditions().get(cond.FORECASTING) is not None
+        ha.spec.behavior.forecast = None
+        autoscaler.reconcile_batch([ha])
+        assert ha.status_conditions().get(cond.FORECASTING) is None
+
+    def test_skill_tolerates_near_zero_idle(self):
+        """An accurate forecaster over a metric idling near zero with
+        exporter noise must keep high skill — the error is normalized
+        by the metric's TARGET scale, not the near-zero actual."""
+        spec = ForecastSpec(
+            horizon_seconds=10.0, model="linear", min_samples=2,
+            min_skill=0.5,
+        )
+        store, registry, gauge = fleet_world(1, spec)
+        clock = FakeClock()
+        forecaster = FleetForecaster(
+            forecast_fn=M.forecast_numpy, clock=clock, capacity=16
+        )
+        autoscaler = BatchAutoscaler(
+            MetricsClientFactory(registry=registry),
+            store,
+            clock=clock,
+            forecaster=forecaster,
+        )
+        ha = store.get("HorizontalAutoscaler", "default", "ha-0")
+        rng = np.random.RandomState(SEED)
+        for _ in range(12):  # overnight idle: ~0 with tiny noise
+            gauge.set("ha-0", "default", abs(rng.normal(0.0, 0.01)))
+            assert autoscaler.reconcile_batch([ha])[
+                ("default", "ha-0")
+            ] is None
+            clock.advance(10.0)
+        # |pred - actual| is a few hundredths against target scale 4:
+        # skill must stay comfortably above the floor
+        assert forecaster.skill("default", "ha-0") > 0.9
+
+    def test_prune_forgets_deleted_autoscaler(self):
+        spec = ForecastSpec(horizon_seconds=60.0, min_samples=2)
+        store, registry, gauge = fleet_world(1, spec)
+        clock = FakeClock()
+        forecaster = FleetForecaster(
+            forecast_fn=M.forecast_numpy, registry=registry, clock=clock,
+            capacity=16,
+        )
+        autoscaler = BatchAutoscaler(
+            MetricsClientFactory(registry=registry),
+            store,
+            clock=clock,
+            forecaster=forecaster,
+        )
+        ha = store.get("HorizontalAutoscaler", "default", "ha-0")
+        for _ in range(3):
+            autoscaler.reconcile_batch([ha])
+            clock.advance(10.0)
+        assert forecaster.history.count(("ha", "default", "ha-0", 0)) == 3
+        forecaster.prune("default", "ha-0")
+        assert forecaster.history.count(("ha", "default", "ha-0", 0)) == 0
+
+    def test_ha_controller_on_deleted_prunes(self):
+        from karpenter_tpu.controllers import HorizontalAutoscalerController
+
+        spec = ForecastSpec(horizon_seconds=60.0, min_samples=2)
+        store, registry, gauge = fleet_world(1, spec)
+        forecaster = FleetForecaster(
+            forecast_fn=M.forecast_numpy, capacity=16
+        )
+        autoscaler = BatchAutoscaler(
+            MetricsClientFactory(registry=registry),
+            store,
+            forecaster=forecaster,
+        )
+        controller = HorizontalAutoscalerController(autoscaler)
+        forecaster.history.append(("ha", "default", "ha-0", 0), 1.0, 1.0)
+        controller.on_deleted(
+            store.get("HorizontalAutoscaler", "default", "ha-0")
+        )
+        assert forecaster.history.count(("ha", "default", "ha-0", 0)) == 0
+
+
+class TestSpecValidation:
+    def test_bad_specs_rejected(self):
+        bad = [
+            ForecastSpec(horizon_seconds=0),
+            ForecastSpec(model="prophet"),
+            ForecastSpec(min_skill=1.5),
+            ForecastSpec(season_seconds=-1),
+            ForecastSpec(alpha=0.0),
+            ForecastSpec(min_samples=1),
+        ]
+        for spec in bad:
+            ha = forecast_ha(spec=spec)
+            with pytest.raises(ValueError):
+                ha.validate()
+
+    def test_good_spec_roundtrips_yaml(self):
+        from karpenter_tpu.api.serialization import (
+            from_manifest,
+            to_dict,
+        )
+
+        ha = forecast_ha(
+            spec=ForecastSpec(
+                horizon_seconds=120.0, model="holt-winters",
+                season_seconds=3600.0,
+            )
+        )
+        ha.validate()
+        doc = to_dict(ha)
+        assert doc["spec"]["behavior"]["forecast"]["horizonSeconds"] == 120.0
+        back = from_manifest(doc)
+        assert back.spec.behavior.forecast.model == "holt-winters"
+        assert back.spec.behavior.forecast.season_seconds == 3600.0

@@ -455,7 +455,10 @@ class TestExamplesConverge:
                 provider.queue_lengths[obj.spec.queue.id] = 8
             runtime.store.create(obj)
 
-        for _ in range(3):
+        # enough ticks for every subsystem an example opts into to warm
+        # up — the forecast example's minSamples gate needs 6 observed
+        # ticks before its Forecasting condition goes True
+        for _ in range(8):
             runtime.manager.reconcile_all()
             clock["now"] += 61
 
