@@ -73,6 +73,11 @@ bench-forecast: ## Batched one-dispatch fleet forecast vs per-series loop (512 s
 		--iters 10 \
 		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
 
+bench-preempt: ## Batched one-dispatch eviction planning vs per-candidate loop (32 candidates x 50 node columns x 10k victims); appends a BENCHMARKS row + publishes to BASELINE.json
+	$(PYTHON) bench.py --preempt --candidates 32 --types 50 \
+		--pods 10000 --backend xla --iters 10 \
+		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
+
 dryrun: ## Multi-chip sharding compile check on 8 virtual CPU devices
 	$(PYTHON) -c "import os; \
 		os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS','') + ' --xla_force_host_platform_device_count=8').strip(); \
@@ -110,5 +115,5 @@ kind-smoke: ## Deploy smoke on kind: image -> apply -> pod Ready -> one HA end t
 	bash hack/kind-smoke.sh
 
 .PHONY: help dev ci test test-chaos battletest verify codegen docs native \
-	bench bench-solver bench-consolidate bench-forecast dryrun image \
-	publish apply delete kind-load conformance kind-smoke
+	bench bench-solver bench-consolidate bench-forecast bench-preempt \
+	dryrun image publish apply delete kind-load conformance kind-smoke

@@ -451,6 +451,15 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
         "drain candidate); --pods spread across them",
     )
     ap.add_argument(
+        "--preempt",
+        action="store_true",
+        help="benchmark batched eviction planning (ops/preempt.py via "
+        "service.preempt): --candidates pending pods planned against "
+        "--types node columns x --pods victims in ONE dispatch vs. the "
+        "same plans submitted one candidate at a time; reports "
+        "candidates/sec both ways and the speedup",
+    )
+    ap.add_argument(
         "--forecast",
         action="store_true",
         help="benchmark the batched forecast kernel "
@@ -580,21 +589,36 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
             "--forecast builds its own workload (metric histories); it "
             "cannot combine with other modes"
         )
+    if args.preempt and (
+        args.mesh or args.e2e or args.decide or args.clusters
+        or args.solver_service or args.hotpath or args.consolidate
+        or args.forecast
+    ):
+        ap.error(
+            "--preempt builds its own workload (candidates x nodes x "
+            "victims); it cannot combine with other modes"
+        )
     if args.series < 2:
         ap.error("--series must be >= 2")
     if args.history < 4:
         ap.error("--history must be >= 4")
     if (args.publish_baseline or args.append_benchmarks) and not (
         args.solver_service or args.consolidate or args.hotpath
-        or args.forecast
+        or args.forecast or args.preempt
     ):
         ap.error(
             "--publish-baseline/--append-benchmarks only apply to "
-            "--solver-service/--consolidate/--hotpath/--forecast "
-            "(nothing would be published otherwise)"
+            "--solver-service/--consolidate/--hotpath/--forecast/"
+            "--preempt (nothing would be published otherwise)"
         )
 
-    if args.forecast:
+    if args.preempt:
+        metric = (
+            f"batched eviction-planning p50, {args.candidates} "
+            f"candidates x {args.types} node columns x {args.pods} "
+            f"victims (one dispatch vs per-candidate loop)"
+        )
+    elif args.forecast:
         metric = (
             f"batched metric forecast p50, {args.series} series x "
             f"{args.history} history samples (Holt-Winters + robust "
@@ -715,6 +739,9 @@ def run(args, metric: str, note: str) -> None:  # lint: allow-complexity — ben
 
     _warm_native_kernel(args)
 
+    if args.preempt:
+        run_preempt(args, metric, note)
+        return
     if args.forecast:
         run_forecast(args, metric, note)
         return
@@ -1374,6 +1401,200 @@ def run_consolidate(args, metric: str, note: str) -> None:
         f"candidates/sec batched vs sequential "
         f"({record['speedup']}x); {record['drainable']}/"
         f"{record['candidates']} drainable"
+    )
+    emit(
+        f"{metric} ({jax.default_backend()})",
+        record["batched_p50_ms"],
+        note=f"{note}; {extra}" if note else extra,
+        against_baseline=False,
+    )
+
+
+def build_preempt_inputs(candidates: int, types: int, pods: int, seed: int):
+    """A synthetic contended fleet for eviction planning: mostly-full
+    node columns, priority-striped victim occupancy (sorted by
+    (node, priority) — the kernel's contract), and high-priority
+    candidate pods big enough that most placements need evictions."""
+    from karpenter_tpu.ops.preempt import PreemptInputs
+
+    rng = np.random.default_rng(seed)
+    C, N, V, R = candidates, types, pods, 4
+    node_free = rng.uniform(0.0, 2.0, (N, R)).astype(np.float32)
+    node_tier = (rng.random(N) < 0.3).astype(np.int32)
+    victim_node = np.sort(rng.integers(0, N, V)).astype(np.int32)
+    victim_priority = np.zeros(V, np.int32)
+    for n in range(N):
+        seg = victim_node == n
+        victim_priority[seg] = np.sort(rng.integers(0, 500, seg.sum()))
+    return PreemptInputs(
+        pod_requests=rng.uniform(1.0, 6.0, (C, R)).astype(np.float32),
+        pod_priority=rng.integers(100, 1000, C).astype(np.int32),
+        pod_valid=np.ones(C, bool),
+        pod_node_forbidden=rng.random((C, N)) < 0.1,
+        node_free=node_free,
+        node_tier=node_tier,
+        victim_requests=rng.uniform(0.1, 2.0, (V, R)).astype(
+            np.float32
+        ),
+        victim_priority=victim_priority,
+        victim_node=victim_node,
+        victim_valid=np.ones(V, bool),
+        victim_evictable=rng.random(V) < 0.95,
+    )
+
+
+def _single_candidate_inputs(inputs, c: int):
+    """The same fleet, one candidate — what a per-candidate caller
+    would submit (quantization scales are fleet-derived, so the plans
+    match the batched rows bit for bit)."""
+    import dataclasses
+
+    return dataclasses.replace(
+        inputs,
+        pod_requests=inputs.pod_requests[c : c + 1],
+        pod_priority=inputs.pod_priority[c : c + 1],
+        pod_valid=inputs.pod_valid[c : c + 1],
+        pod_node_forbidden=inputs.pod_node_forbidden[c : c + 1],
+    )
+
+
+def _warm_and_check_preempt(svc, inputs, args) -> int:
+    """Warm both submission paths' compiles outside the timed region;
+    assert batched plans == independent per-candidate plans == the
+    numpy mirror, element for element. Returns the placed count."""
+    from karpenter_tpu.ops.preempt import preempt_numpy
+
+    batched = svc.preempt(inputs)
+    mirror = preempt_numpy(inputs)
+    for field in ("chosen_node", "evict_count", "evict_mask"):
+        if not np.array_equal(
+            np.asarray(getattr(batched, field)),
+            np.asarray(getattr(mirror, field)),
+        ):
+            raise AssertionError(f"device/numpy mismatch on {field}")
+    for c in range(args.candidates):
+        single = svc.preempt(_single_candidate_inputs(inputs, c))
+        if int(single.chosen_node[0]) != int(batched.chosen_node[c]):
+            raise AssertionError(
+                f"candidate {c}: batched plan != independent plan"
+            )
+    return int((np.asarray(batched.chosen_node) >= 0).sum())
+
+
+def _preempt_record(args, backend, batched, sequential, placed: int,
+                    svc) -> dict:
+    batched_p50 = float(np.percentile(batched, 50))
+    sequential_p50 = float(np.percentile(sequential, 50))
+    return {
+        "config": (
+            f"{args.candidates} candidates x {args.types} node "
+            f"columns x {args.pods} victims eviction planning"
+        ),
+        "backend": backend,
+        "candidates": args.candidates,
+        "placed": placed,
+        "batched_p50_ms": round(batched_p50, 3),
+        "sequential_p50_ms": round(sequential_p50, 3),
+        "batched_cps": round(
+            args.candidates * 1000.0 / batched_p50, 1
+        ),
+        "sequential_cps": round(
+            args.candidates * 1000.0 / sequential_p50, 1
+        ),
+        "speedup": round(sequential_p50 / batched_p50, 2),
+        "dispatches": svc.stats.preempt_dispatches,
+        "compile_cache_misses": svc.stats.compile_cache_misses,
+    }
+
+
+def _append_preempt_row(path: str, record: dict) -> None:
+    marker = "## Preemption (make bench-preempt)"
+    header = (
+        f"\n{marker}\n\n"
+        "Batched eviction planning (`service.preempt`: every candidate "
+        "pod's minimal-eviction placement in ONE device dispatch) vs. "
+        "the same plans submitted one candidate at a time.\n\n"
+        "| Date | Backend | Config | Batched p50 (ms) | Sequential p50 "
+        "(ms) | Batched cand/s | Sequential cand/s | Speedup |\n"
+        "|---|---|---|---|---|---|---|---|\n"
+    )
+    date = datetime.date.today().isoformat()
+    row = (
+        f"| {date} | {record['backend']} | {record['config']} "
+        f"| {record['batched_p50_ms']} | {record['sequential_p50_ms']} "
+        f"| {record['batched_cps']} | {record['sequential_cps']} "
+        f"| {record['speedup']}x |\n"
+    )
+    _append_table_row(path, marker, header, row)
+
+
+def run_preempt(args, metric: str, note: str) -> None:
+    """Batched vs sequential eviction planning: the preemption
+    acceptance claim (docs/preemption.md). Both paths run IDENTICAL
+    per-candidate plans through the shared solve service; only the
+    submission shape differs — all candidates in one PreemptInputs
+    (one dispatch) vs. one single-candidate problem at a time."""
+    import jax
+
+    from karpenter_tpu.solver import SolverService
+
+    print(
+        f"backend={jax.default_backend()} devices={jax.devices()}",
+        file=sys.stderr,
+    )
+    inputs = build_preempt_inputs(
+        args.candidates, args.types, args.pods, args.seed
+    )
+    singles = [
+        _single_candidate_inputs(inputs, c)
+        for c in range(args.candidates)
+    ]
+    backend = args.backend
+    svc = SolverService(window_s=0.002, max_batch=8, backend=backend)
+    try:
+        placed = _warm_and_check_preempt(svc, inputs, args)
+        batched_times, sequential_times = [], []
+        for _ in range(args.iters):
+            t0 = time.perf_counter()
+            svc.preempt(inputs)
+            batched_times.append((time.perf_counter() - t0) * 1e3)
+        for _ in range(args.iters):
+            t0 = time.perf_counter()
+            for single in singles:
+                svc.preempt(single)
+            sequential_times.append((time.perf_counter() - t0) * 1e3)
+        record = _preempt_record(
+            args, jax.default_backend(), batched_times,
+            sequential_times, placed, svc,
+        )
+    finally:
+        svc.close()
+    record_evidence(
+        batched_iter_ms=[round(t, 4) for t in batched_times],
+        sequential_iter_ms=[round(t, 4) for t in sequential_times],
+        preempt=record,
+        transport_floor=measure_transport_floor(),
+    )
+    print(
+        f"batched p50={record['batched_p50_ms']}ms "
+        f"({record['batched_cps']} cand/s) | sequential "
+        f"p50={record['sequential_p50_ms']}ms "
+        f"({record['sequential_cps']} cand/s) | "
+        f"speedup={record['speedup']}x "
+        f"placed={record['placed']}/{record['candidates']}",
+        file=sys.stderr,
+    )
+    if args.publish_baseline:
+        _publish_to_baseline(
+            f"{record['config']} ({record['backend']})", record
+        )
+    if args.append_benchmarks:
+        _append_preempt_row(args.append_benchmarks, record)
+    extra = (
+        f"{record['batched_cps']} vs {record['sequential_cps']} "
+        f"candidates/sec batched vs sequential "
+        f"({record['speedup']}x); {record['placed']}/"
+        f"{record['candidates']} placeable"
     )
     emit(
         f"{metric} ({jax.default_backend()})",

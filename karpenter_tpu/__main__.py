@@ -164,6 +164,31 @@ def parse_args(argv=None) -> argparse.Namespace:
         "without mutating anything",
     )
     parser.add_argument(
+        "--preempt",
+        action="store_true",
+        help="enable the preemption engine (batched eviction planning "
+        "for high-priority pending pods + budgeted eviction actuation; "
+        "docs/preemption.md). With --simulate: replay a seeded "
+        "spot-reclaim storm and report evictions vs scale-ups vs "
+        "pending-pod recovery, mutating nothing",
+    )
+    parser.add_argument(
+        "--preempt-budget",
+        type=int,
+        default=1,
+        help="default max concurrent evictions charged against one "
+        "node group per hold window (120s; spec.eviction_budget "
+        "overrides per group)",
+    )
+    parser.add_argument(
+        "--default-priority",
+        type=int,
+        default=0,
+        help="priority assumed for pods naming an unknown "
+        "PriorityClass (resolved spec.priority and the system classes "
+        "always win; docs/preemption.md)",
+    )
+    parser.add_argument(
         "--forecast",
         action="store_true",
         help="with --simulate: replay a synthetic diurnal ramp through "
@@ -213,6 +238,18 @@ def _run_simulation(args, store) -> int:
 
         report = simulate_forecast(
             horizon_s=args.forecast_horizon, model=args.forecast_model
+        )
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return 0
+
+    if args.preempt:
+        # self-contained replay (no live store, no provider): a seeded
+        # spot-reclaim storm over mixed on-demand/spot pools
+        from karpenter_tpu.simulate import simulate_preempt
+
+        report = simulate_preempt(
+            preempt_budget=args.preempt_budget,
+            default_priority=args.default_priority,
         )
         print(json.dumps(report, indent=2, sort_keys=True))
         return 0
@@ -389,6 +426,9 @@ def main(argv=None) -> int:
             data_dir=args.data_dir,
             verbose=args.verbose,
             consolidate=args.consolidate,
+            preempt=args.preempt,
+            preempt_budget=args.preempt_budget,
+            default_pod_priority=args.default_priority,
             backoff_base_s=args.backoff_base,
             backoff_cap_s=args.backoff_cap,
             circuit_failure_threshold=args.circuit_threshold,

@@ -912,6 +912,11 @@ class PodSpec:
     topology_spread_constraints: List[TopologySpreadConstraint] = field(
         default_factory=list
     )
+    # PriorityClass value resolved by admission (core/v1 PodSpec.priority);
+    # None = unresolved — effective_priority() then falls back to the
+    # named class (well-known system classes) or the fleet default
+    priority: Optional[int] = None
+    priority_class_name: str = ""
 
 
 @dataclass(slots=True)
@@ -1006,6 +1011,58 @@ class Namespace:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
 
     KIND = "Namespace"
+
+
+# Well-known PriorityClass values (the kube-scheduler's built-in system
+# classes); any other named class without a resolved spec.priority falls
+# back to the caller-supplied fleet default.
+SYSTEM_PRIORITY_CLASSES = {
+    "system-cluster-critical": 2_000_000_000,
+    "system-node-critical": 2_000_001_000,
+}
+
+
+def effective_priority(pod: Pod, default: int = 0) -> int:
+    """The pod's scheduling priority — the value the packing/eviction
+    kernels compare (ops/binpack pod_priority, ops/preempt): a resolved
+    spec.priority always wins, then the well-known system classes by
+    name, then `default` (the --default-priority knob) for pods NAMING
+    an unknown class. Class-less pods are plain priority 0 — the
+    default must never lift the whole fleet into nonzero-priority
+    encoding (that would disable the encoder's delta path and make
+    every pending pod a preemption candidate)."""
+    if pod.spec.priority is not None:
+        return int(pod.spec.priority)
+    name = pod.spec.priority_class_name
+    if not name:
+        return 0
+    return SYSTEM_PRIORITY_CLASSES.get(name, default)
+
+
+# Capacity-tier node labels every major provider stamps on
+# spot/preemptible capacity; the packing kernels treat any match as
+# tier 1 (preemptible — ops/binpack group_tier, ops/preempt node_tier).
+PREEMPTIBLE_CAPACITY_LABELS = frozenset(
+    {
+        ("karpenter.sh/capacity-type", "spot"),
+        ("cloud.google.com/gke-spot", "true"),
+        ("cloud.google.com/gke-preemptible", "true"),
+        ("eks.amazonaws.com/capacityType", "SPOT"),
+        ("kubernetes.azure.com/scalesetpriority", "spot"),
+    }
+)
+
+
+def capacity_tier_of(labels) -> int:
+    """0 = on-demand, 1 = preemptible/spot, from a node/group label set
+    (a dict or an iterable of (key, value) items — group profiles carry
+    the latter)."""
+    items = labels.items() if isinstance(labels, dict) else labels
+    return (
+        1
+        if any(item in PREEMPTIBLE_CAPACITY_LABELS for item in items)
+        else 0
+    )
 
 
 def is_ready_and_schedulable(node: Node) -> bool:

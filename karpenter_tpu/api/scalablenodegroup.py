@@ -31,6 +31,17 @@ class ScalableNodeGroupSpec:
     replicas: Optional[int] = None
     type: str = ""
     id: str = ""
+    # capacity tier: True marks the whole group preemptible/spot —
+    # its pods are evictable-by-contract to the eviction planner
+    # (docs/preemption.md), independent of the per-node capacity-type
+    # labels the packing tier is derived from (api/core.capacity_tier_of)
+    preemptible: bool = False
+    # PDB-style disruption budget: max CONCURRENT preemption evictions
+    # charged against this group's nodes in one HOLD window (the
+    # engine's hold_s, 120s — charges expire with the hold, not the
+    # 30s plan cadence); None = the engine-level --preempt-budget
+    # default
+    eviction_budget: Optional[int] = None
 
 
 @dataclass(slots=True)

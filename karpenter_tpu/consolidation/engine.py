@@ -107,6 +107,12 @@ class ConsolidationEngine:
     ):
         self.store = store
         self.service = solver_service
+        # optional coordination seam: a callable returning node names
+        # some OTHER disruption engine currently owns (the preemption
+        # engine's active_nodes — runtime.py wires it). Guarded nodes
+        # are never consolidation candidates, so the two engines cannot
+        # fight over one node (docs/preemption.md "Coordination").
+        self.node_guard = None
         self.config = config or ConsolidationConfig()
         self.registry = (
             registry if registry is not None else default_registry()
@@ -211,13 +217,18 @@ class ConsolidationEngine:
         )
         return self.config.budget_per_group - in_flight
 
-    def _eligible(self, nv: P.NodeView, now: float) -> bool:
-        """All the pre-solve gates: in-flight, actuatability (a group
-        with a ScalableNodeGroup ref), schedulability (cordoned nodes
-        are someone's in-progress intent), do-not-disrupt, pod-churn
-        cooldown, and the group's disruption budget."""
+    def _eligible(
+        self, nv: P.NodeView, now: float, guarded=frozenset()
+    ) -> bool:
+        """All the pre-solve gates: in-flight, another engine's node
+        hold, actuatability (a group with a ScalableNodeGroup ref),
+        schedulability (cordoned nodes are someone's in-progress
+        intent), do-not-disrupt, pod-churn cooldown, and the group's
+        disruption budget."""
         if nv.name in self._in_flight or nv.do_not_disrupt:
             return False
+        if nv.name in guarded:
+            return False  # another disruption engine owns this node
         if nv.group is None or not nv.group[2]:
             return False  # no ScalableNodeGroup to shrink: unactuatable
         if not nv.receiver:
@@ -232,8 +243,15 @@ class ConsolidationEngine:
     ) -> List[str]:
         """Eligible fresh candidates, emptiest-first (the cheapest drains
         evaluate and actuate first), capped at max_candidates."""
+        # one guard snapshot per planning round, not per candidate
+        guarded = (
+            self.node_guard() if self.node_guard is not None
+            else frozenset()
+        )
         eligible = [
-            nv for nv in view.nodes if self._eligible(nv, now)
+            nv
+            for nv in view.nodes
+            if self._eligible(nv, now, guarded)
         ]
         eligible.sort(key=lambda nv: (len(nv.pods), nv.name))
         return [nv.name for nv in eligible[: self.config.max_candidates]]

@@ -174,22 +174,47 @@ def cluster_view(store, groups=None) -> ClusterView:
     return view
 
 
-def _resource_universe(view: ClusterView, candidates: List[NodeView]):
-    """cpu/memory + every extended resource in candidate-pod requests or
-    node free capacity, the 'pods' slot axis always last."""
+def is_extended_resource(resource: str) -> bool:
+    return resource not in _BASE_RESOURCES and resource != RESOURCE_PODS
+
+
+def resource_universe_for(view: ClusterView, pods) -> List[str]:
+    """cpu/memory + every extended resource in the view's node free
+    capacity or the given pods' requests, the 'pods' slot axis always
+    LAST — THE single universe rule both disruption planners encode
+    against (preemption/planner.py reuses it over its candidate +
+    victim pod set; a change here moves both in lockstep)."""
     extended = set()
     for nv in view.nodes:
-        extended |= {
-            r for r in nv.free
-            if r not in _BASE_RESOURCES and r != RESOURCE_PODS
-        }
-    for nv in candidates:
-        for pod in nv.pods:
-            extended |= {
-                r for r in pod.effective_requests()
-                if r not in _BASE_RESOURCES and r != RESOURCE_PODS
-            }
+        extended.update(r for r in nv.free if is_extended_resource(r))
+    for pod in pods:
+        extended.update(
+            r for r in pod.effective_requests()
+            if is_extended_resource(r)
+        )
     return [*_BASE_RESOURCES, *sorted(extended), RESOURCE_PODS]
+
+
+def request_row(pod, resources: List[str]) -> np.ndarray:
+    """f32[R]: the pod's scheduler-effective requests gathered onto the
+    universe axis, its one 'pods' slot included — the single per-pod
+    row encoding both disruption planners share."""
+    row = np.zeros(len(resources), np.float32)
+    requests = {
+        r: q.to_float() for r, q in pod.effective_requests().items()
+    }
+    requests[RESOURCE_PODS] = 1.0
+    for r, resource in enumerate(resources):
+        row[r] = requests.get(resource, 0.0)
+    return row
+
+
+def _resource_universe(view: ClusterView, candidates: List[NodeView]):
+    """The consolidation universe: node free capacity + the DRAIN
+    candidates' bound pods (the rows that re-pack)."""
+    return resource_universe_for(
+        view, (pod for nv in candidates for pod in nv.pods)
+    )
 
 
 def _pod_compatible(pod, node_labels: dict, hard_taints: list) -> bool:
@@ -274,12 +299,7 @@ def _candidate_inputs(
     forbidden[:, ~receiver_ok] = True
     forbidden[:, self_col] = True  # never back onto the drain
     for i, pod in enumerate(nv.pods):
-        requests = {
-            r: q.to_float() for r, q in pod.effective_requests().items()
-        }
-        requests[RESOURCE_PODS] = 1.0
-        for r, resource in enumerate(resources):
-            pod_requests[i, r] = requests.get(resource, 0.0)
+        pod_requests[i] = request_row(pod, resources)
         for t in range(n_groups):
             if not forbidden[i, t] and not _pod_compatible(
                 pod, node_labels[t], hard_taints[t]

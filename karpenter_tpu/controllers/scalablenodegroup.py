@@ -41,6 +41,7 @@ class ScalableNodeGroupController:
         self,
         cloud_provider_factory,
         consolidator=None,
+        preemptor=None,
         registry=None,
         circuit_failure_threshold: int = 5,
         circuit_reset_s: float = 120.0,
@@ -52,6 +53,9 @@ class ScalableNodeGroupController:
         # ConsolidationEngine (or None): planning is bounded by the
         # engine's own interval, so calling it every reconcile is cheap
         self.consolidator = consolidator
+        # PreemptionEngine (or None): same cadence door — eviction
+        # planning rides the reconcile loop, interval-bounded in-engine
+        self.preemptor = preemptor
         self.circuit_failure_threshold = circuit_failure_threshold
         self.circuit_reset_s = circuit_reset_s
         self.clock = clock or _time.monotonic
@@ -105,6 +109,12 @@ class ScalableNodeGroupController:
             )
 
     def _reconcile(self, resource) -> None:
+        if self.preemptor is not None:
+            # preemption plans BEFORE consolidation: admitting a
+            # high-priority pending pod may consume the very free
+            # capacity a drain was counting on — planning order makes
+            # the preemption hold visible to this tick's drain gate
+            self.preemptor.maybe_plan()
         if self.consolidator is not None:
             # plan before observing: an approved drain decrements
             # spec.replicas via the scale subresource, and the resulting

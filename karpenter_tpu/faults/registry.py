@@ -25,6 +25,7 @@ Points instrumented across the stack (docs/resilience.md):
 
   solver.dispatch     device path of the shared solve service
   forecast.predict    device path of the batched forecast seam
+  preempt.plan        device path of the eviction-planning seam
   encoder.encode      snapshot -> solver-operand encode
   cloud.get_replicas  provider replica observation
   cloud.set_replicas  provider actuation

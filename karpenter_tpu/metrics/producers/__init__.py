@@ -41,7 +41,10 @@ def profile_from_template(template):
 
 
 class ProducerFactory:
-    def __init__(self, store, cloud_provider_factory, registry=None, solver=None):
+    def __init__(
+        self, store, cloud_provider_factory, registry=None, solver=None,
+        default_priority: int = 0,
+    ):
         from karpenter_tpu.metrics.registry import default_registry
 
         self.store = store
@@ -50,6 +53,9 @@ class ProducerFactory:
         # optional remote bin-pack (sidecar SolverClient.solve); None =
         # in-process device call
         self.solver = solver
+        # fleet default for pods naming an unknown PriorityClass
+        # (runtime --default-priority; docs/preemption.md)
+        self.default_priority = default_priority
         self._pending_feed = None
         self._node_mirror = None
         self._reservations = None
@@ -88,7 +94,8 @@ class ProducerFactory:
             from karpenter_tpu.store.columnar import PendingFeed
 
             self._pending_feed = PendingFeed(
-                self.store, group_profile, node_mirror=self.node_mirror()
+                self.store, group_profile, node_mirror=self.node_mirror(),
+                default_priority=self.default_priority,
             )
         return self._pending_feed
 

@@ -11,6 +11,7 @@ import numpy as np
 
 from karpenter_tpu.api.core import (
     Taint,
+    capacity_tier_of,
     is_ready_and_schedulable,
     matches_affinity_shape,
     matches_selector,
@@ -177,6 +178,14 @@ def _row_bytes(snap, idx):
         .reshape(n, -1),
         snap.valid[idx].astype(np.uint8).reshape(n, 1),
     ]
+    if snap.priority is not None:
+        # priority is row identity (steering + evictability): equal-spec
+        # rows of different PriorityClasses must sort/dedup apart
+        parts.append(
+            np.ascontiguousarray(snap.priority[idx])
+            .view(np.uint8)
+            .reshape(n, -1)
+        )
     for ids in (
         snap.affinity_id,
         snap.preferred_id,
@@ -327,6 +336,29 @@ def _taint_universe(profiles) -> Dict[tuple, int]:
     return universe
 
 
+def _priority_tier_operands(snap, profiles, row_idx, n_pods, n_groups):
+    """Priority + capacity-tier operands (ops/binpack.py steering,
+    ops/preempt.py evictability) — each absent unless the fleet
+    actually carries it (a nonzero-priority pod / a spot-labeled
+    group), so priority-free fleets encode byte-identically to before
+    these columns existed."""
+    hi = len(row_idx)
+    pod_priority = None
+    if (
+        snap.priority is not None
+        and hi
+        and bool((snap.priority[row_idx] != 0).any())
+    ):
+        pod_priority = np.zeros(n_pods, np.int32)
+        pod_priority[:hi] = snap.priority[row_idx]
+    group_tier = None
+    tiers = [capacity_tier_of(labels) for _, labels, _ in profiles]
+    if any(tiers):
+        group_tier = np.zeros(n_groups, np.int32)
+        group_tier[: len(profiles)] = tiers
+    return pod_priority, group_tier
+
+
 def _encode_full(snap, profiles, with_rows: bool = False, census=None):
     """Snapshot (store/columnar.PendingSnapshot) -> solver inputs, with
     rows DEDUPLICATED into distinct pod shapes + multiplicities
@@ -423,6 +455,10 @@ def _encode_full(snap, profiles, with_rows: bool = False, census=None):
         n_pods, n_groups,
     )
 
+    pod_priority, group_tier = _priority_tier_operands(
+        snap, profiles, row_idx, n_pods, n_groups
+    )
+
     inputs = B.BinPackInputs(
         pod_requests=pod_requests,
         pod_valid=pod_valid,
@@ -435,6 +471,8 @@ def _encode_full(snap, profiles, with_rows: bool = False, census=None):
         pod_group_forbidden=pod_group_forbidden,
         pod_group_score=pod_group_score,
         pod_exclusive=pod_exclusive,
+        pod_priority=pod_priority,
+        group_tier=group_tier,
     )
     if with_rows:
         # the simulation API maps per-row solver outputs back to pods:
@@ -591,9 +629,16 @@ class SnapshotDeltaCache:
     def _live_constraints(snap, row_idx) -> bool:
         """Any live row carrying affinity/spread/anti/soft shapes routes
         to the full encode (those operands need census + row expansion);
-        id 0 is always the unconstrained shape."""
+        id 0 is always the unconstrained shape. Nonzero-priority rows
+        route there too: the delta layer does not splice the
+        pod_priority operand, and priority fleets are preemption-scale
+        (small), so the full encode is cheap where it matters."""
         if len(row_idx) == 0:
             return False
+        if snap.priority is not None and bool(
+            (snap.priority[row_idx] != 0).any()
+        ):
+            return True
         for ids in (
             snap.affinity_id,
             snap.preferred_id,
@@ -691,6 +736,11 @@ class SnapshotDeltaCache:
             group_taints=old.group_taints,
             group_labels=old.group_labels,
             pod_weight=pod_weight,
+            # tier is a pure function of the (identity-equal) profiles:
+            # reuse like the other group arrays. pod_priority needs no
+            # splice — priority rows never reach the delta path
+            # (_live_constraints).
+            group_tier=old.group_tier,
         )
         return entry.successor(keys, row_weight, n_pods, inputs)
 

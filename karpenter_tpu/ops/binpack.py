@@ -83,6 +83,21 @@ class BinPackInputs:
     # exactly the first-feasible rule. Integer-valued (weight sums
     # <= 100 x terms), so f32 comparison is exact.
     pod_group_score: Optional[jax.Array] = None
+    # i32[P]: pod priority (the PriorityClass value; 0 when unset).
+    # Paired with group_tier it STEERS assignment: among feasible
+    # groups, a pod with positive priority prefers the lowest-tier
+    # (on-demand) group — encoded as an integer-valued score (binpack
+    # docstring "priority steering"), so priority-0 fleets and
+    # absent-priority fleets assign identically. The eviction-planning
+    # kernel (ops/preempt.py) consumes the same vector for
+    # evictability. None = all-equal priority, today's behavior
+    # bit-identically.
+    pod_priority: Optional[jax.Array] = None
+    # i32[T]: capacity tier per group — 0 on-demand, >0 preemptible/
+    # spot (derived from the well-known capacity-type node labels,
+    # api/core.capacity_tier_of). Only acts when pod_priority is also
+    # present; alone it rides through for the preemption encoder.
+    group_tier: Optional[jax.Array] = None
     # bool[P]: the row's pods demand a node to themselves — required
     # inter-pod SELF-anti-affinity on kubernetes.io/hostname ("one
     # replica per node", the StatefulSet/daemon pattern). Encoded by
@@ -103,6 +118,53 @@ class BinPackOutputs:
     nodes_needed: jax.Array  # i32[T] shelf-BFD node count (valid upper bound)
     lp_bound: jax.Array  # i32[T] LP-relaxation lower bound
     unschedulable: jax.Array  # i32 scalar: pods with no feasible group
+
+
+# Priority steering (pod_priority x group_tier). The steer never
+# COMPOSES arithmetically with the preference score — score magnitudes
+# are unbounded (soft-spread scores scale with live domain counts), so
+# any clamp-and-add scheme silently reorders large scores. Steering is
+# instead LEXICOGRAPHIC: best steer first, preference score as the
+# tie-break within the winning steer level (steered_choice).
+
+
+def steer_matrix(priority, tier, xp=np):
+    """f32[P, T] steer — 0 everywhere except -1 where a
+    positive-priority pod meets a tier>0 group — or None when priority
+    or tier is absent. Boolean by design: within one pod's row the only
+    question argmax/max can ask is on-demand vs preemptible, so the
+    priority MAGNITUDE can never reorder anything (the eviction kernel
+    is where magnitudes compare). Priority-0 rows steer nowhere, so
+    fleets without PriorityClasses order exactly as before; the -1/0
+    values are trivially exact in f32 on both backends."""
+    if priority is None or tier is None:
+        return None
+    return (
+        -(
+            (tier > 0)[None, :] & (priority > 0)[:, None]
+        ).astype(np.int32)
+    ).astype(np.float32)
+
+
+def steered_choice(feasible, score, steer, xp=np):
+    """i32[P]: the assignment argmax under lexicographic
+    (steer, score) preference — among feasible groups, take the
+    best-steer level (positive-priority pods prefer on-demand tiers),
+    then the best score within it, argmax's first-max rule breaking
+    the final tie to the lowest index. With steer absent this is
+    exactly the historical score path; with both absent callers use
+    the plain first-feasible argmax. All comparisons are on
+    integer-valued f32 (steer) or caller-provided scores compared
+    verbatim — no composition arithmetic, so no magnitude limits."""
+    neg_inf = np.float32(-np.inf)
+    if steer is None:
+        return xp.argmax(xp.where(feasible, score, neg_inf), axis=1)
+    masked_steer = xp.where(feasible, steer, neg_inf)
+    if score is None:
+        return xp.argmax(masked_steer, axis=1)
+    best_steer = xp.max(masked_steer, axis=1, keepdims=True)
+    tie = feasible & (masked_steer == best_steer)
+    return xp.argmax(xp.where(tie, score, neg_inf), axis=1)
 
 
 def _feasibility(inputs: BinPackInputs) -> jax.Array:
@@ -240,13 +302,19 @@ def binpack(inputs: BinPackInputs, buckets: int = DEFAULT_BUCKETS) -> BinPackOut
     # first feasible group wins (argmax returns the first True); with
     # preference scores, highest score among feasible wins and argmax's
     # first-max rule provides the lowest-index tie-break — identical to
-    # first-feasible when scores are absent or uniform
+    # first-feasible when scores are absent or uniform. Priority x tier
+    # steering is LEXICOGRAPHICALLY senior to the score
+    # (steered_choice): positive-priority pods prefer on-demand over
+    # preemptible tiers, preference scores break ties within a tier.
     any_feasible = jnp.any(feasible, axis=1)
-    if inputs.pod_group_score is None:
+    steer = steer_matrix(
+        inputs.pod_priority, inputs.group_tier, xp=jnp
+    )
+    if steer is None and inputs.pod_group_score is None:
         choice = jnp.argmax(feasible, axis=1)
     else:
-        choice = jnp.argmax(
-            jnp.where(feasible, inputs.pod_group_score, -jnp.inf), axis=1
+        choice = steered_choice(
+            feasible, inputs.pod_group_score, steer, xp=jnp
         )
     assigned = jnp.where(any_feasible, choice.astype(jnp.int32), -1)
     n_groups = inputs.group_allocatable.shape[0]
@@ -323,6 +391,39 @@ def binpack(inputs: BinPackInputs, buckets: int = DEFAULT_BUCKETS) -> BinPackOut
     )
 
 
+def _fold_for_pallas(inputs: BinPackInputs):
+    """(inputs, backend) for the Mosaic path, which predates the
+    priority operands. Score-free priority fleets fold the steer
+    matrix into the score operand the kernel does understand (with no
+    base score, steer IS the score — assignment identical by
+    construction) and strip the priority fields. A fleet carrying BOTH
+    a preference score and steering needs the lexicographic
+    (steer, score) choice, which a single score operand cannot
+    express without magnitude limits — that rare combination routes to
+    the XLA program instead (exact, still on-device). Everyone else
+    passes through untouched; only priority fleets pay the host fold
+    (and forgo the identity device memo)."""
+    if inputs.pod_priority is None or inputs.group_tier is None:
+        return inputs, "pallas"
+    if inputs.pod_group_score is not None:
+        return inputs, "xla"
+    import dataclasses
+
+    return (
+        dataclasses.replace(
+            inputs,
+            pod_group_score=steer_matrix(
+                np.asarray(inputs.pod_priority),
+                np.asarray(inputs.group_tier),
+                xp=np,
+            ),
+            pod_priority=None,
+            group_tier=None,
+        ),
+        "pallas",
+    )
+
+
 # one-slot identity-keyed device residency cache: callers that pass the SAME
 # BinPackInputs object again (the encode memo in producers/pendingcapacity.py
 # does exactly that when no pod/node/producer changed) skip the host->device
@@ -372,6 +473,8 @@ def solve(
         from karpenter_tpu.ops.numpy_binpack import binpack_numpy
 
         return binpack_numpy(inputs, buckets=buckets)
+    if backend == "pallas":
+        inputs, backend = _fold_for_pallas(inputs)
     inputs = _device_resident(inputs)
     if backend == "xla":
         return binpack(inputs, buckets=buckets)

@@ -320,12 +320,13 @@ def _shelf_bfd_np(histogram: np.ndarray, buckets: int) -> np.ndarray:
 
 def _assign_numpy(
     requests, valid, intolerant, required, alloc, taints, labels,
-    forbidden, score, weight, exclusive, buckets,
+    forbidden, score, weight, exclusive, buckets, steer=None,
 ):
     """The pure-numpy assignment pass (the fallback while the C kernel's
-    background build finishes). Sparse layout: everything after the
-    argmax scatters over the ONE assigned group per pod — O(P), where
-    the dense XLA layout is O(P*T*(B|R))."""
+    background build finishes, and the only pass expressing the
+    two-stage lexicographic steer+score choice). Sparse layout:
+    everything after the argmax scatters over the ONE assigned group
+    per pod — O(P), where the dense XLA layout is O(P*T*(B|R))."""
     _, n_resources = requests.shape
     n_groups = alloc.shape[0]
     feasible = _feasibility_np(
@@ -333,10 +334,12 @@ def _assign_numpy(
         forbidden,
     )
     any_feasible = feasible.any(axis=1)
-    if score is None:
+    if score is None and steer is None:
         choice = np.argmax(feasible, axis=1)
     else:
-        choice = np.argmax(np.where(feasible, score, -np.inf), axis=1)
+        from karpenter_tpu.ops.binpack import steered_choice
+
+        choice = steered_choice(feasible, score, steer, xp=np)
     assigned = np.where(any_feasible, choice, -1).astype(np.int32)
 
     rows = np.nonzero(any_feasible & valid)[0]
@@ -396,6 +399,29 @@ def _assign_numpy(
     return assigned, assigned_count, histogram, demand64, unschedulable
 
 
+def _steered(inputs: BinPackInputs, score):
+    """(score, steer) under priority x tier steering, mirroring the
+    XLA kernel exactly (ops/binpack.steer_matrix/steered_choice are the
+    single definitions). Score-free steering folds the steer matrix
+    INTO the score slot — the native C pass consumes it unchanged, and
+    argmax-over-steer equals the lexicographic choice when no base
+    score exists. A fleet carrying BOTH keeps them separate for the
+    two-stage choice (and routes around the native pass, which takes a
+    single score operand)."""
+    if inputs.pod_priority is None or inputs.group_tier is None:
+        return score, None
+    from karpenter_tpu.ops.binpack import steer_matrix
+
+    steer = steer_matrix(
+        _as_np(inputs.pod_priority, np.int32),
+        _as_np(inputs.group_tier, np.int32),
+        xp=np,
+    )
+    if score is None:
+        return steer, None
+    return score, steer
+
+
 def binpack_numpy(
     inputs: BinPackInputs, buckets: int = 32, use_native: bool = True
 ) -> BinPackOutputs:
@@ -432,11 +458,15 @@ def binpack_numpy(
         if inputs.pod_exclusive is None
         else _as_np(inputs.pod_exclusive, bool)
     )
+    score, steer = _steered(inputs, score)
     n_pods, n_resources = requests.shape
     n_groups = alloc.shape[0]
 
     lib = None
-    if use_native and n_pods:
+    # steer != None means BOTH a preference score and tier steering are
+    # live: the choice is two-stage (lexicographic) and the native
+    # kernel's single-score argmax can't express it — numpy stages only
+    if use_native and n_pods and steer is None:
         # never block a degraded-mode tick inside a cc subprocess: use
         # the kernel only once its background build has finished, and
         # run the numpy stages meanwhile (peek/ensure-async pattern,
@@ -468,7 +498,7 @@ def binpack_numpy(
             unschedulable,
         ) = _assign_numpy(
             requests, valid, intolerant, required, alloc, taints, labels,
-            forbidden, score, weight, exclusive, buckets,
+            forbidden, score, weight, exclusive, buckets, steer=steer,
         )
 
     nodes_needed = _shelf_bfd(histogram, buckets, lib)

@@ -1,0 +1,327 @@
+"""Fleet-wide eviction planning: the "what do I evict to place this?" kernel.
+
+The bin-pack (ops/binpack.py) answers which GROUP a pending pod should
+scale up; it treats the fleet's existing occupancy as immovable. Real
+clusters ask a second question constantly — can a high-priority pending
+pod be placed NOW by evicting lower-priority occupancy, and if so, what
+is the cheapest eviction set? This module answers that for every
+candidate pod against every node in ONE fixed-shape device program:
+
+1. EVICTABILITY [C, V]: victim v may be evicted for candidate c iff the
+   victim is valid, policy allows it (``victim_evictable`` — the host
+   folds do-not-disrupt and coordination holds into this mask), and
+   either the victim's priority is STRICTLY below the candidate's or the
+   victim's node is a preemptible/spot tier (capacity that is reclaimable
+   by contract, regardless of priority).
+2. MINIMAL EVICTION PREFIX: victims arrive SORTED by (node, priority,
+   index) — the input contract the planner/encoder upholds — so for each
+   node the evictable victims form a lowest-priority-first order. The
+   kernel computes, per (candidate, node), the shortest prefix of that
+   order whose freed capacity (plus the node's current free capacity)
+   fits the candidate: within-node prefix sums of freed resources via
+   one global cumsum minus per-node base offsets. "Minimal" is minimal
+   UNDER THE PRIORITY ORDER (evict the lowest-priority occupants first,
+   the kube-scheduler's preemption posture), not minimal cardinality
+   over arbitrary subsets — the latter is a knapsack.
+3. PLACEMENT [C]: each candidate takes the (evictions, node-index)
+   lexicographically smallest feasible placement — zero-eviction fits
+   win outright, ties break to the lowest node column. Candidates are
+   planned INDEPENDENTLY (the whole [C] axis is data-parallel), so a
+   batched plan equals C single-candidate plans row for row; the host
+   engine resolves cross-candidate conflicts (two plans claiming one
+   victim) where policy lives.
+
+BIT-IDENTICAL BACKENDS BY CONSTRUCTION: all capacity arithmetic is
+integer. Resources are quantized to QUANT units per axis-max (need
+rounds UP, free/freed round DOWN — an integer fit implies a real fit,
+so a plan never under-evicts), after which every accumulation (cumsum),
+comparison, and reduction (min over placement keys) is exact i32 math
+whose result is association-independent. The only float ops are
+elementwise scale/multiply/floor/ceil, identical ops in identical order
+on both backends — so ``preempt_numpy`` mirrors ``preempt_plan`` with
+no f32-reduction caveats at all (tests/test_preemption.py pins it).
+The price is quantization slack: a fit within 1/QUANT of exact may be
+judged infeasible, always in the conservative direction.
+
+Production callers submit through ``SolverService.preempt`` (coalescing
+queue, shape bucketing, numpy-fallback ladder, health FSM); this module
+is the kernel-level entry.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Integer capacity resolution: every resource axis is scaled so its
+# largest operand maps to QUANT units. 1/65536 relative resolution, and
+# V victims * QUANT stays inside i32 for V <= MAX_VICTIMS.
+QUANT = 65536
+MAX_VICTIMS = 16384
+# i32 sentinel for "no feasible placement" in the key minimum
+_NO_FIT = np.int32(2**31 - 1)
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class PreemptInputs:
+    """Structure-of-arrays eviction-planning problem.
+
+    C = candidate pending pods, N = node columns (real nodes for the
+    planner; node groups for coarse what-if/bench runs), V = victim
+    occupancy rows, R = resource axes.
+
+    Input contract (the planner/encoder and the service's padding both
+    uphold it; the kernel does not re-verify):
+      * victims are sorted by (node, priority, index) — within one node,
+        ascending priority;
+      * invalid rows/columns are ZEROED (padding must not perturb the
+        per-resource maxima the quantization scales derive from);
+      * padding node columns are forbidden for every candidate.
+    """
+
+    pod_requests: jax.Array  # f32[C, R] candidate requests
+    pod_priority: jax.Array  # i32[C]
+    pod_valid: jax.Array  # bool[C]
+    pod_node_forbidden: jax.Array  # bool[C, N] host-folded feasibility
+    node_free: jax.Array  # f32[N, R] free (unreserved) capacity
+    node_tier: jax.Array  # i32[N] 0 = on-demand, >0 = preemptible/spot
+    victim_requests: jax.Array  # f32[V, R] scheduler-effective requests
+    victim_priority: jax.Array  # i32[V]
+    victim_node: jax.Array  # i32[V] column index (sorted axis)
+    victim_valid: jax.Array  # bool[V]
+    victim_evictable: jax.Array  # bool[V] policy mask (do-not-disrupt, holds)
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class PreemptOutputs:
+    chosen_node: jax.Array  # i32[C] placement column, -1 = unplaceable
+    evict_count: jax.Array  # i32[C] evictions the placement needs
+    evict_mask: jax.Array  # bool[C, V] the minimal eviction set per plan
+    unplaceable: jax.Array  # i32 scalar: valid candidates with no plan
+
+
+# `need` values above every possible free+freed total clip here BEFORE
+# the f32->i32 conversion: the largest left-hand side is one node's free
+# (<= QUANT) plus a full victim prefix (<= MAX_VICTIMS * QUANT = 2^30),
+# so any need at the clip is genuinely unplaceable — and the clip keeps
+# a pod requesting vastly more than any node from overflowing i32
+# (conversion of out-of-range floats is undefined and backend-divergent).
+_NEED_CLIP = np.float32(2**30 + 2**17)
+
+
+def _quantize(inputs: PreemptInputs):
+    """(need i32[C,R], free i32[N,R], shed i32[V,R]): per-resource
+    integer capacities. The scale denominator is the max over the NODE
+    and VICTIM families only — never the candidates — so it is a pure
+    function of the fleet and a single-candidate subproblem over the
+    same fleet quantizes identically (the batched == independent
+    property rests on this; a candidate-derived scale would shift the
+    ceil/floor rounding when the batch composition changes)."""
+    xp = jnp if isinstance(inputs.pod_requests, jax.Array) else np
+    denom = np.float32(1e-30) * xp.ones(
+        inputs.pod_requests.shape[1], np.float32
+    )
+    if inputs.node_free.shape[0]:  # static: N=0 has no node max
+        denom = xp.maximum(denom, xp.max(inputs.node_free, axis=0))
+    if inputs.victim_requests.shape[0]:  # static: V=0 likewise
+        denom = xp.maximum(
+            denom, xp.max(inputs.victim_requests, axis=0)
+        )  # f32[R]
+    scale = np.float32(QUANT) / denom  # f32[R], elementwise
+    need = xp.minimum(
+        xp.ceil(inputs.pod_requests * scale[None, :]), _NEED_CLIP
+    ).astype(np.int32)
+    free = xp.floor(inputs.node_free * scale[None, :]).astype(np.int32)
+    shed = xp.floor(
+        inputs.victim_requests * scale[None, :]
+    ).astype(np.int32)
+    return need, free, shed
+
+
+def _evictable(inputs: PreemptInputs):
+    """bool[C, V]: victim v may be evicted to admit candidate c."""
+    xp = jnp if isinstance(inputs.pod_requests, jax.Array) else np
+    victim_tier = inputs.node_tier[inputs.victim_node]  # i32[V]
+    outranked = (
+        inputs.victim_priority[None, :] < inputs.pod_priority[:, None]
+    )
+    reclaimable = (victim_tier > 0)[None, :]
+    return (
+        (inputs.victim_valid & inputs.victim_evictable)[None, :]
+        & (outranked | reclaimable)
+        & inputs.pod_valid[:, None]
+    ), xp
+
+
+def _node_base_index(victim_node, n_nodes: int, xp):
+    """i32[N]: index of the last victim BEFORE each node's segment (the
+    sorted-victim contract makes segments contiguous), -1 when a node's
+    segment starts at row 0. O(V + N) via bincount + exclusive cumsum
+    (a [V, N] comparison matrix would be hundreds of MB at the victim
+    ceiling on a large cluster); integer throughout, so both backends
+    agree exactly."""
+    if xp is np:
+        counts = np.bincount(
+            victim_node, minlength=n_nodes
+        )[:n_nodes].astype(np.int32)
+    else:
+        counts = jnp.bincount(victim_node, length=n_nodes).astype(
+            np.int32
+        )
+    before = xp.cumsum(counts, dtype=np.int32) - counts
+    return before - 1
+
+
+def _plan(inputs: PreemptInputs):
+    """The shared program: identical operations on either jnp or np
+    arrays — integer accumulation makes the two backends bit-equal
+    without mirrored-scan tricks (module docstring)."""
+    evictable, xp = _evictable(inputs)  # bool[C, V]
+    n_nodes = inputs.node_free.shape[0]
+    n_victims = inputs.victim_requests.shape[0]
+    if n_nodes == 0:  # static: a nodeless fleet (e.g. a full spot
+        # reclaim) places nothing — every valid candidate is
+        # unplaceable, on BOTH backends (the device path only ever saw
+        # this through bucket padding; the raw mirror must agree)
+        c = inputs.pod_requests.shape[0]
+        return PreemptOutputs(
+            chosen_node=xp.full(c, -1, np.int32),
+            evict_count=xp.zeros(c, np.int32),
+            evict_mask=xp.zeros((c, n_victims), bool),
+            unplaceable=xp.sum(
+                inputs.pod_valid.astype(np.int32), dtype=np.int32
+            ),
+        )
+    need, free, shed = _quantize(inputs)
+
+    if n_victims:  # static shape branch: V=0 plans from free space only
+        # within-node inclusive prefix of freed capacity per candidate:
+        # one global cumsum along the sorted victim axis, re-based per
+        # node (victims of earlier node columns subtract out)
+        shed_c = shed[None, :, :] * evictable[:, :, None].astype(np.int32)
+        gcum = xp.cumsum(shed_c, axis=1, dtype=np.int32)  # i32[C, V, R]
+        base_idx = _node_base_index(inputs.victim_node, n_nodes, xp)
+        base = xp.where(
+            (base_idx >= 0)[None, :, None],
+            xp.take(gcum, xp.maximum(base_idx, 0), axis=1),
+            np.int32(0),
+        )  # i32[C, N, R]: freed total on all earlier nodes
+        prefix = gcum - xp.take(base, inputs.victim_node, axis=1)
+
+        # same re-based prefix over eviction COUNTS
+        cnt_g = xp.cumsum(evictable.astype(np.int32), axis=1)
+        cnt_base = xp.where(
+            (base_idx >= 0)[None, :],
+            xp.take(cnt_g, xp.maximum(base_idx, 0), axis=1),
+            np.int32(0),
+        )  # i32[C, N]
+        cnt = cnt_g - xp.take(cnt_base, inputs.victim_node, axis=1)
+
+        # placement keys: (evictions, node) packed lexicographically.
+        # The victim-prefix keys and the zero-eviction keys share one
+        # i32 space; min over both is the plan. Forbidden columns and
+        # invalid candidates never produce a finite key.
+        victim_col = inputs.victim_node  # i32[V]
+        fit_v = xp.all(
+            xp.take(free, victim_col, axis=0)[None, :, :] + prefix
+            >= need[:, None, :],
+            axis=2,
+        )  # bool[C, V]
+        allowed_v = ~xp.take(
+            inputs.pod_node_forbidden, victim_col, axis=1
+        )  # bool[C, V]
+        key_v = xp.where(
+            fit_v & allowed_v & inputs.pod_valid[:, None],
+            cnt * np.int32(n_nodes) + victim_col[None, :],
+            _NO_FIT,
+        )  # i32[C, V]
+        best_v = xp.min(key_v, axis=1)
+    else:
+        cnt = xp.zeros(
+            (inputs.pod_requests.shape[0], 0), np.int32
+        )
+        best_v = _NO_FIT
+
+    fit_0 = xp.all(
+        free[None, :, :] >= need[:, None, :], axis=2
+    )  # bool[C, N]
+    key_0 = xp.where(
+        fit_0 & ~inputs.pod_node_forbidden & inputs.pod_valid[:, None],
+        xp.arange(n_nodes, dtype=np.int32)[None, :],
+        _NO_FIT,
+    )  # i32[C, N]
+
+    best = xp.minimum(best_v, xp.min(key_0, axis=1))  # i32[C]
+    placed = best != _NO_FIT
+    chosen = xp.where(placed, best % np.int32(n_nodes), np.int32(-1))
+    evict_count = xp.where(placed, best // np.int32(n_nodes), np.int32(0))
+    evict_mask = (
+        placed[:, None]
+        & (inputs.victim_node[None, :] == chosen[:, None])
+        & evictable
+        & (cnt <= evict_count[:, None])
+    )
+    unplaceable = xp.sum(
+        (inputs.pod_valid & ~placed).astype(np.int32), dtype=np.int32
+    )
+    return PreemptOutputs(
+        chosen_node=chosen,
+        evict_count=evict_count,
+        evict_mask=evict_mask,
+        unplaceable=unplaceable,
+    )
+
+
+@jax.jit
+def preempt_plan(inputs: PreemptInputs) -> PreemptOutputs:
+    """The XLA program (CPU/TPU). One dispatch plans every candidate."""
+    return _plan(inputs)
+
+
+def preempt_numpy(inputs: PreemptInputs) -> PreemptOutputs:
+    """The host mirror — the numpy-fallback rung of the service ladder.
+    Bit-identical to preempt_plan (integer arithmetic; module
+    docstring), pinned by tests/test_preemption.py."""
+    host = PreemptInputs(
+        pod_requests=np.asarray(inputs.pod_requests, np.float32),
+        pod_priority=np.asarray(inputs.pod_priority, np.int32),
+        pod_valid=np.asarray(inputs.pod_valid, bool),
+        pod_node_forbidden=np.asarray(inputs.pod_node_forbidden, bool),
+        node_free=np.asarray(inputs.node_free, np.float32),
+        node_tier=np.asarray(inputs.node_tier, np.int32),
+        victim_requests=np.asarray(inputs.victim_requests, np.float32),
+        victim_priority=np.asarray(inputs.victim_priority, np.int32),
+        victim_node=np.asarray(inputs.victim_node, np.int32),
+        victim_valid=np.asarray(inputs.victim_valid, bool),
+        victim_evictable=np.asarray(inputs.victim_evictable, bool),
+    )
+    return _plan(host)
+
+
+def solve_preempt(
+    inputs: PreemptInputs, backend: str = "auto"
+) -> PreemptOutputs:
+    """Kernel-level dispatcher: 'xla', 'numpy', or 'auto' (numpy on a
+    CPU default backend — the same degraded-mode posture as
+    ops/binpack.solve; there is no Mosaic preempt kernel, so TPU runs
+    the XLA program). Production callers use SolverService.preempt."""
+    if inputs.victim_requests.shape[0] > MAX_VICTIMS:
+        raise ValueError(
+            f"preempt solve supports at most {MAX_VICTIMS} victims "
+            f"(i32 capacity headroom), got "
+            f"{inputs.victim_requests.shape[0]}"
+        )
+    if backend == "auto":
+        backend = (
+            "numpy" if jax.default_backend() == "cpu" else "xla"
+        )
+    if backend == "numpy":
+        return preempt_numpy(inputs)
+    if backend in ("xla", "pallas"):
+        return preempt_plan(jax.device_put(inputs))
+    raise ValueError(f"unknown preempt backend {backend!r}")

@@ -71,6 +71,18 @@ class Options:
     # spec.behavior.forecast — these knobs size the shared machinery.
     forecast_history: int = 64
     stale_metric_max_age_s: float = 60.0
+    # opt-in preemption engine (karpenter_tpu/preemption,
+    # docs/preemption.md): batched eviction planning for high-priority
+    # pending pods + budgeted eviction actuation, coordinated with
+    # consolidation. Off by default: evicting workloads is a disruptive
+    # posture an operator must choose (--preempt).
+    preempt: bool = False
+    # default max concurrent evictions charged against one node group
+    # per hold window (120s; spec.eviction_budget overrides per group)
+    preempt_budget: int = 1
+    # fleet default priority for pods naming an unknown PriorityClass
+    # (--default-priority): feeds the census encoder AND the engines
+    default_pod_priority: int = 0
 
 
 class KarpenterRuntime:
@@ -135,6 +147,7 @@ class KarpenterRuntime:
         self.producer_factory = ProducerFactory(
             self.store, self.cloud_provider, registry=self.registry,
             solver=self.solver_service.solve,
+            default_priority=options.default_pod_priority,
         )
         # predictive scaling (forecast/, docs/forecasting.md): history,
         # skill gating, and the batched forecast riding the solve
@@ -172,6 +185,33 @@ class KarpenterRuntime:
                 registry=self.registry,
                 clock=self.clock,
             )
+        # preemption engine (opt-in): batched eviction planning through
+        # SolverService.preempt, actuating budgeted evictions through
+        # the store; coordinated BOTH ways with consolidation — it
+        # skips consolidation's in-flight nodes, and consolidation's
+        # candidate gate consults its holds (node_guard)
+        self.preemption = None
+        if options.preempt:
+            from karpenter_tpu.preemption import (
+                PreemptionConfig,
+                PreemptionEngine,
+            )
+
+            self.preemption = PreemptionEngine(
+                self.store,
+                solver_service=self.solver_service,
+                consolidation=self.consolidation,
+                registry=self.registry,
+                config=PreemptionConfig(
+                    budget_per_group=options.preempt_budget,
+                    default_priority=options.default_pod_priority,
+                ),
+                clock=self.clock,
+            )
+            if self.consolidation is not None:
+                self.consolidation.node_guard = (
+                    self.preemption.active_nodes
+                )
         # Registration order = in-tick evaluation order. Producers run first
         # so signals are fresh, then node groups observe, then the batched
         # autoscaler decides — one tick moves a signal end to end (the
@@ -186,6 +226,7 @@ class KarpenterRuntime:
             MetricsProducerController(self.producer_factory),
             ScalableNodeGroupController(
                 self.cloud_provider, consolidator=self.consolidation,
+                preemptor=self.preemption,
                 registry=self.registry,
                 circuit_failure_threshold=options.circuit_failure_threshold,
                 circuit_reset_s=options.circuit_reset_s,

@@ -482,6 +482,371 @@ def simulate_forecast(  # lint: allow-complexity — scenario assembly: world bu
     }
 
 
+# -- spot-reclaim storm replay (--simulate --preempt) -------------------------
+
+
+def _storm_world(
+    on_demand_nodes: int, spot_nodes: int, node_cpu: float,
+    default_priority: int,
+):
+    """The pre-storm fleet: an on-demand pool and a spot pool, each with
+    its own pendingCapacity producer + ScalableNodeGroup; spot nodes run
+    priority-0 batch, on-demand nodes run priority-100 services beside
+    some batch, everything ~75% utilized."""
+    from karpenter_tpu.api.core import (
+        Container,
+        Node,
+        NodeCondition,
+        NodeSpec,
+        NodeStatus,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+    )
+    from karpenter_tpu.api.metricsproducer import (
+        MetricsProducer,
+        MetricsProducerSpec,
+        PendingCapacitySpec,
+    )
+    from karpenter_tpu.api.scalablenodegroup import (
+        ScalableNodeGroup,
+        ScalableNodeGroupSpec,
+    )
+    from karpenter_tpu.store import Store
+    from karpenter_tpu.utils.quantity import Quantity
+
+    q = lambda v: Quantity.parse(str(v))  # noqa: E731
+
+    def make_node(name, labels):
+        return Node(
+            metadata=ObjectMeta(name=name, labels=dict(labels)),
+            spec=NodeSpec(),
+            status=NodeStatus(
+                allocatable={
+                    "cpu": q(node_cpu),
+                    "memory": q(f"{int(node_cpu * 2)}Gi"),
+                    "pods": q(64),
+                },
+                conditions=[NodeCondition("Ready", "True")],
+            ),
+        )
+
+    def make_pod(name, node_name, priority):
+        return Pod(
+            metadata=ObjectMeta(name=name),
+            spec=PodSpec(
+                node_name=node_name,
+                priority=priority,
+                containers=[
+                    Container(
+                        requests={"cpu": q(1), "memory": q("1Gi")}
+                    )
+                ],
+            ),
+        )
+
+    store = Store()
+    pools = {
+        "od": ({"pool": "od"}, on_demand_nodes),
+        "spot": (
+            {"pool": "spot", "karpenter.sh/capacity-type": "spot"},
+            spot_nodes,
+        ),
+    }
+    per_node = max(2, int(node_cpu))  # fully-packed nodes: the storm
+    # must CONTEND — free slack would let the bind pass absorb the
+    # displaced services and the eviction path would never exercise
+    for pool, (labels, count) in pools.items():
+        store.create(
+            MetricsProducer(
+                metadata=ObjectMeta(name=pool),
+                spec=MetricsProducerSpec(
+                    pending_capacity=PendingCapacitySpec(
+                        node_selector={"pool": pool},
+                        node_group_ref=f"{pool}-group",
+                    )
+                ),
+            )
+        )
+        store.create(
+            ScalableNodeGroup(
+                metadata=ObjectMeta(name=f"{pool}-group"),
+                spec=ScalableNodeGroupSpec(
+                    replicas=count, type="FakeNodeGroup",
+                    id=f"{pool}-group",
+                    preemptible=(pool == "spot"),
+                ),
+            )
+        )
+        for n in range(count):
+            node_name = f"{pool}-{n:03d}"
+            store.create(make_node(node_name, labels))
+            for i in range(per_node):
+                # on-demand nodes run mostly services (2/3) over batch;
+                # spot nodes run a couple of cost-optimized services
+                # (the pods the storm displaces and preemption rescues)
+                # over batch
+                if pool == "od":
+                    is_service = i < (2 * per_node) // 3
+                else:
+                    is_service = i < 2
+                priority = 100 if is_service else default_priority
+                store.create(
+                    make_pod(
+                        f"{pool}-{n:03d}-p{i}", node_name, priority
+                    )
+                )
+    return store, pools, make_node
+
+
+def _reclaim_wave(store, spot_nodes: int, fraction: float, rng):
+    """Seeded spot reclaim: the provider takes `fraction` of the spot
+    pool; each taken node vanishes and its pods go pending (the
+    workload controllers re-create them unbound)."""
+    taken = sorted(
+        rng.choice(
+            spot_nodes,
+            size=max(1, int(round(spot_nodes * fraction))),
+            replace=False,
+        )
+    )
+    displaced = 0
+    for n in taken:
+        name = f"spot-{int(n):03d}"
+        for pod in store.pods_on_node(name):
+            pod.spec.node_name = ""
+            pod.status.phase = "Pending"
+            store.update(pod)
+            displaced += 1
+        key = next(
+            (k for k in store.keys("Node") if k[2] == name), None
+        )
+        if key is not None:
+            store.delete(*key)
+    return len(taken), displaced
+
+
+def _node_takes(labels: dict, cap: dict, pod, needs: dict) -> bool:
+    """One (pod, node) first-fit check: selector match + capacity."""
+    selector = pod.spec.node_selector
+    if selector and any(
+        labels.get(k) != v for k, v in selector.items()
+    ):
+        return False
+    return all(cap.get(r, 0.0) >= v for r, v in needs.items())
+
+
+def _bind_state(store, default_priority: int):
+    """(free capacity by node, labels by node, pending pods in
+    priority-then-name order) — the deterministic inputs of one bind
+    pass."""
+    from karpenter_tpu.api.core import effective_priority
+    from karpenter_tpu.consolidation.planner import cluster_view
+
+    view = cluster_view(store)
+    free = {
+        nv.name: dict(nv.free) for nv in view.nodes if nv.receiver
+    }
+    labels = {
+        nv.name: dict(nv.node.metadata.labels) for nv in view.nodes
+    }
+    pending = sorted(
+        (p for p in store.list("Pod") if is_pending(p)),
+        key=lambda p: (
+            -effective_priority(p, default=default_priority),
+            p.metadata.name,
+        ),
+    )
+    return free, labels, pending
+
+
+def _bind_pending(store, default_priority: int) -> int:
+    """Toy first-fit scheduler pass: bind pending pods (highest
+    priority first) onto pool-matching nodes with free capacity —
+    deterministic, so the replay's recovery ticks are reproducible."""
+    free, labels, pending = _bind_state(store, default_priority)
+    bound = 0
+    for pod in pending:
+        needs = {
+            r: quant.to_float()
+            for r, quant in pod.effective_requests().items()
+        }
+        needs["pods"] = 1.0
+        for name in sorted(free):
+            if not _node_takes(labels[name], free[name], pod, needs):
+                continue
+            for r, v in needs.items():
+                free[name][r] = free[name].get(r, 0.0) - v
+            pod.spec.node_name = name
+            pod.status.phase = "Running"
+            store.update(pod)
+            bound += 1
+            break
+    return bound
+
+
+def simulate_preempt(  # lint: allow-complexity — scenario assembly: storm + replay loop + report
+    on_demand_nodes: int = 4,
+    spot_nodes: int = 8,
+    node_cpu: float = 8.0,
+    ticks: int = 24,
+    interval_s: float = 10.0,
+    reclaim_tick: int = 3,
+    reclaim_fraction: float = 0.5,
+    provision_lag: int = 4,
+    preempt_budget: int = 8,
+    default_priority: int = 0,
+    seed: int = 0,
+    backend: str = "xla",
+) -> dict:
+    """Seeded spot-reclaim-storm replay (docs/preemption.md
+    "Dry-running"): a mixed on-demand/spot fleet loses a seeded
+    fraction of its spot pool in one wave; displaced priority-100
+    services and priority-0 batch go pending together. Each tick runs
+    the REAL preemption engine (budgeted evictions through
+    SolverService.preempt), a deterministic first-fit bind pass (the
+    scheduler stand-in), and the pending-capacity scale-up signal with
+    `provision_lag`-tick node arrivals — so the report shows the
+    trade the subsystem exists for: services recover via eviction in
+    ~1 tick while batch waits for provisioned capacity. Self-contained
+    and mutation-free toward any real cluster (own in-memory store)."""
+    from karpenter_tpu.preemption import (
+        PreemptionConfig,
+        PreemptionEngine,
+    )
+    from karpenter_tpu.solver import SolverService
+
+    rng = np.random.RandomState(seed)
+    store, pools, make_node = _storm_world(
+        on_demand_nodes, spot_nodes, node_cpu, default_priority
+    )
+    clock = {"now": 1_000_000.0}
+    service = SolverService(backend=backend)
+    engine = PreemptionEngine(
+        store,
+        service,
+        config=PreemptionConfig(
+            plan_interval_s=0.0,
+            budget_per_group=preempt_budget,
+            hold_s=2 * interval_s,
+            default_priority=default_priority,
+            backend=backend,
+        ),
+        clock=lambda: clock["now"],
+    )
+    trail = []
+    evictions_total = 0
+    scale_ups_total = 0
+    arrivals = []  # (due_tick, pool)
+    reclaimed = displaced = 0
+    service_recovery = full_recovery = None
+    try:
+        for tick in range(ticks):
+            if tick == reclaim_tick:
+                reclaimed, displaced = _reclaim_wave(
+                    store, spot_nodes, reclaim_fraction, rng
+                )
+            for due, pool in [a for a in arrivals if a[0] == tick]:
+                labels, _ = pools[pool]
+                scale_ups_total += 1
+                store.create(
+                    make_node(f"{pool}-new-{tick:02d}-{scale_ups_total:03d}", labels)
+                )
+            arrivals = [a for a in arrivals if a[0] > tick]
+
+            bound_before = {
+                (p.metadata.namespace, p.metadata.name): p
+                for p in store.list("Pod")
+                if p.spec.node_name
+            }
+            plans = engine.plan(clock["now"])
+            evicted_keys = [
+                key
+                for p in plans.values()
+                if p
+                for key in p["evictions"]
+            ]
+            evictions_total += len(evicted_keys)
+            # the workload-controller analog: an evicted pod's owner
+            # re-creates it unbound — it re-enters the pending set and
+            # rides the ordinary bind/scale-up path
+            import dataclasses as _dc
+
+            for key in evicted_keys:
+                old = bound_before[key]
+                replacement = _dc.replace(old)
+                replacement.metadata = _dc.replace(
+                    old.metadata, name=f"{key[1]}-r{tick}",
+                    resource_version="",
+                )
+                replacement.spec = _dc.replace(
+                    old.spec, node_name=""
+                )
+                replacement.status = _dc.replace(
+                    old.status, phase="Pending"
+                )
+                store.create(replacement)
+            _bind_pending(store, default_priority)
+
+            report = simulate(store, solver=service.solve)
+            needed = {
+                pool: report["groups"][f"default/{pool}"][
+                    "additional_nodes_needed"
+                ]
+                for pool in pools
+            }
+            for pool, n in needed.items():
+                outstanding = sum(1 for _, p in arrivals if p == pool)
+                for _ in range(max(0, n - outstanding)):
+                    arrivals.append((tick + provision_lag, pool))
+
+            pending = [
+                p for p in store.list("Pod") if is_pending(p)
+            ]
+            high = sum(
+                1 for p in pending if (p.spec.priority or 0) > 0
+            )
+            if service_recovery is None and tick >= reclaim_tick and high == 0:
+                service_recovery = tick
+            if full_recovery is None and tick >= reclaim_tick and not pending:
+                full_recovery = tick
+            trail.append(
+                {
+                    "tick": tick,
+                    "pending": len(pending),
+                    "pending_high_priority": high,
+                    "evictions": len(evicted_keys),
+                    "scale_up_signal": dict(needed),
+                }
+            )
+            clock["now"] += interval_s
+    finally:
+        service.close()
+    return {
+        "config": {
+            "on_demand_nodes": on_demand_nodes,
+            "spot_nodes": spot_nodes,
+            "node_cpu": node_cpu,
+            "reclaim": f"{reclaimed} spot nodes at tick {reclaim_tick} "
+                       f"({displaced} pods displaced)",
+            "provision_lag_ticks": provision_lag,
+            "preempt_budget": preempt_budget,
+            "seed": seed,
+        },
+        "ticks": trail,
+        "evictions_total": evictions_total,
+        "scale_ups_total": scale_ups_total,
+        "service_recovery_tick": service_recovery,
+        "full_recovery_tick": full_recovery,
+        "recovery_ticks_after_reclaim": (
+            None
+            if full_recovery is None
+            else full_recovery - reclaim_tick
+        ),
+        "preempt_dispatches": service.stats.preempt_dispatches,
+    }
+
+
 def simulate_delta(
     store, what_if_groups: List[dict], solver=None, template_resolver=None
 ) -> dict:

@@ -88,7 +88,7 @@ def bucket_shape(inputs: BinPackInputs) -> Tuple[int, int, int, int, int]:
     )
 
 
-def presence(inputs: BinPackInputs) -> Tuple[bool, bool, bool, bool]:
+def presence(inputs: BinPackInputs) -> Tuple[bool, ...]:
     """Which optional operands ride this request — the other half of the
     compile-cache key (an absent operand removes whole program stages)."""
     return (
@@ -96,6 +96,8 @@ def presence(inputs: BinPackInputs) -> Tuple[bool, bool, bool, bool]:
         inputs.pod_group_forbidden is not None,
         inputs.pod_group_score is not None,
         inputs.pod_exclusive is not None,
+        inputs.pod_priority is not None,
+        inputs.group_tier is not None,
     )
 
 
@@ -117,7 +119,7 @@ def _pad2(a, rows: int, cols: Optional[int] = None):
     return out
 
 
-def pad_to_bucket(
+def pad_to_bucket(  # lint: allow-complexity — one presence guard per optional operand
     inputs: BinPackInputs, shape: Tuple[int, int, int, int, int]
 ) -> BinPackInputs:
     """Pad every operand to the bucket `shape` (see module docstring for
@@ -147,6 +149,14 @@ def pad_to_bucket(
     exclusive = inputs.pod_exclusive
     if exclusive is not None:
         exclusive = _pad2(exclusive, p)
+    # priority pads at 0 (no steering) and tier at 0 (on-demand) — both
+    # only act on rows/columns that are valid/feasible anyway
+    priority = inputs.pod_priority
+    if priority is not None:
+        priority = _pad2(priority, p)
+    tier = inputs.group_tier
+    if tier is not None:
+        tier = _pad2(tier, t)
     return BinPackInputs(
         pod_requests=_pad2(inputs.pod_requests, p, r),
         pod_valid=_pad2(inputs.pod_valid, p),
@@ -159,6 +169,84 @@ def pad_to_bucket(
         pod_group_forbidden=forbidden,
         pod_group_score=score,
         pod_exclusive=exclusive,
+        pod_priority=priority,
+        group_tier=tier,
+    )
+
+
+# -- eviction-planning (ops/preempt.py) shape ladder --------------------------
+# Candidate counts are preemption-scale (a handful of high-priority
+# pending pods), victim counts are occupancy-scale; each gets its own
+# floor so both single-candidate probes and fleet-wide storms land on
+# stable rungs.
+CANDIDATE_FLOOR = 8
+VICTIM_FLOOR = 64
+
+
+def preempt_bucket_shape(inputs) -> Tuple[int, int, int, int]:
+    """(C, N, R, V) rounded up their ladders — the shape half of the
+    preempt compile-cache key."""
+    c, r = inputs.pod_requests.shape
+    n = inputs.node_free.shape[0]
+    v = inputs.victim_requests.shape[0]
+    return (
+        bucket_up(c, CANDIDATE_FLOOR),
+        bucket_up(n, GROUP_FLOOR),
+        bucket_up(r, RESOURCE_FLOOR),
+        bucket_up(v, VICTIM_FLOOR),
+    )
+
+
+def pad_preempt_inputs(inputs, shape: Tuple[int, int, int, int]):
+    """Zero-pad a PreemptInputs up to the bucket `shape`, semantics-
+    preserving: padding candidates are invalid (excluded from every
+    aggregate), padding node columns are zero-free AND forbidden for
+    every candidate (never chosen), padding victims are invalid +
+    zero-request with the LAST node column (the sorted-victim contract
+    survives) and contribute nothing to prefix sums or maxima."""
+    from karpenter_tpu.ops.preempt import PreemptInputs
+
+    c, n, r, v = shape
+    if (
+        inputs.pod_requests.shape == (c, r)
+        and inputs.node_free.shape == (n, r)
+        and inputs.victim_requests.shape == (v, r)
+    ):
+        return inputs
+    c0, n0, v0 = (
+        inputs.pod_requests.shape[0],
+        inputs.node_free.shape[0],
+        inputs.victim_requests.shape[0],
+    )
+    forbidden = np.ones((c, n), bool)
+    forbidden[:c0, :n0] = inputs.pod_node_forbidden
+    victim_node = np.full(v, n - 1, np.int32)
+    victim_node[:v0] = np.asarray(inputs.victim_node, np.int32)
+    return PreemptInputs(
+        pod_requests=_pad2(inputs.pod_requests, c, r),
+        pod_priority=_pad2(inputs.pod_priority, c),
+        pod_valid=_pad2(inputs.pod_valid, c),
+        pod_node_forbidden=forbidden,
+        node_free=_pad2(inputs.node_free, n, r),
+        node_tier=_pad2(inputs.node_tier, n),
+        victim_requests=_pad2(inputs.victim_requests, v, r),
+        victim_priority=_pad2(inputs.victim_priority, v),
+        victim_node=victim_node,
+        victim_valid=_pad2(inputs.victim_valid, v),
+        victim_evictable=_pad2(inputs.victim_evictable, v),
+    )
+
+
+def crop_preempt_outputs(out, n_candidates: int, n_victims: int):
+    """Slice a padded preempt solve back to the true candidate/victim
+    axes. Padding nodes are forbidden, so no real candidate's
+    chosen_node points past the real columns; padding candidates are
+    invalid, so `unplaceable` never counts them."""
+    return dataclasses.replace(
+        out,
+        chosen_node=out.chosen_node[:n_candidates],
+        evict_count=out.evict_count[:n_candidates],
+        evict_mask=out.evict_mask[:n_candidates, :n_victims],
     )
 
 

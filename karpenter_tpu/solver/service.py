@@ -57,12 +57,14 @@ production pipeline:
     registered through the same GaugeRegistry the runtime serves on
     /metrics (subsystem "solver").
 
-Besides bin-packs the queue carries two more program families through
+Besides bin-packs the queue carries three more program families through
 the same pipeline: `decide` (the HPA decision kernel — no coalescing,
-the batch autoscaler already evaluates the whole fleet at once) and
+the batch autoscaler already evaluates the whole fleet at once),
 `forecast` (forecast/models.py — concurrent forecast requests
-concatenate along the series axis and ride ONE dispatch; the numpy
-degradation target is bit-identical to the device kernel).
+concatenate along the series axis and ride ONE dispatch), and `preempt`
+(ops/preempt.py — fleet-wide placement-with-eviction planning, every
+candidate in one dispatch). Both of the latter degrade to numpy mirrors
+that are bit-identical to their device kernels.
 
 The service holds NO domain state — it is a pure function of each
 request — so callers keep their own caches (the encode memo, the
@@ -88,7 +90,10 @@ from karpenter_tpu.solver.bucketing import (
     bucket_up,
     bucket_shape,
     crop_outputs,
+    crop_preempt_outputs,
+    pad_preempt_inputs,
     pad_to_bucket,
+    preempt_bucket_shape,
     presence,
 )
 from karpenter_tpu.utils.log import logger
@@ -165,6 +170,10 @@ class SolverStatistics:
     forecast_calls: int = 0  # forecast() entries
     forecast_series: int = 0  # total series submitted across calls
     forecast_dispatches: int = 0  # coalesced forecast device dispatches
+    # eviction-planning seam (ops/preempt.py, docs/preemption.md)
+    preempt_calls: int = 0  # preempt() entries
+    preempt_candidates: int = 0  # total candidates submitted across calls
+    preempt_dispatches: int = 0  # preempt device dispatches
     # backend health FSM + watchdog (docs/resilience.md)
     device_failures: int = 0  # total device-path failures (any rung)
     fsm_trips: int = 0  # healthy -> degraded transitions
@@ -727,6 +736,88 @@ class SolverService:
             enqueued_at=now,
         )
 
+    def preempt(self, inputs, backend: Optional[str] = None,
+                timeout: Optional[float] = None):
+        """Fleet-wide placement-with-eviction through the service
+        (ops/preempt.py, docs/preemption.md): one PreemptInputs problem
+        — C candidate pods x N node columns x V victims — in, one
+        PreemptOutputs out, ONE device dispatch planning every
+        candidate. Requests ride the same coalescing queue, shape-
+        bucketed compile cache (preempt_bucket_shape ladder), numpy-
+        fallback ladder, and backend-health FSM as bin-packs; the numpy
+        mirror is BIT-IDENTICAL to the device kernel (integer-capacity
+        arithmetic — ops/preempt.py docstring), so a degraded answer is
+        the same answer. `preempt.plan` is the fault-injection point on
+        the device path (docs/resilience.md)."""
+        from karpenter_tpu.ops.preempt import MAX_VICTIMS, PreemptOutputs
+
+        n_candidates = int(np.asarray(inputs.pod_requests).shape[0])
+        n_victims = int(np.asarray(inputs.victim_requests).shape[0])
+        self.stats.preempt_calls += 1
+        self.stats.preempt_candidates += n_candidates
+        if n_candidates == 0:
+            return PreemptOutputs(
+                chosen_node=np.zeros(0, np.int32),
+                evict_count=np.zeros(0, np.int32),
+                evict_mask=np.zeros((0, n_victims), bool),
+                unplaceable=np.int32(0),
+            )
+        if n_victims > MAX_VICTIMS:
+            raise ValueError(
+                f"preempt solve supports at most {MAX_VICTIMS} victims, "
+                f"got {n_victims}"
+            )
+        if self._closed:
+            raise RuntimeError("solver service is closed")
+        timeout = self.default_timeout_s if timeout is None else timeout
+        request = self._preempt_request(
+            inputs, n_candidates, n_victims, backend, timeout
+        )
+        try:
+            self._enqueue_one(request)
+        except SolverSaturated:
+            logger().warning(
+                "solver queue saturated; degrading one eviction plan "
+                "to numpy"
+            )
+            return self._numpy_fallback(inputs, 0)
+        try:
+            return SolveFuture(request, self).result(
+                timeout if timeout else None
+            )
+        except SolverTimeout:
+            if self.on_timeout == "raise":
+                raise
+            logger().warning(
+                "eviction-plan deadline expired; degrading to numpy"
+            )
+            return self._numpy_fallback(inputs, 0)
+
+    def _preempt_request(
+        self, inputs, n_candidates: int, n_victims: int,
+        backend: Optional[str], timeout,
+    ) -> _Request:
+        """Resolve the backend and build one queue-ready eviction-plan
+        request (keyed on the preempt shape ladder)."""
+        resolved = self._resolve_backend(backend)
+        if self.device_solver is not None:
+            # the sidecar wire carries bin-packs only: under the gRPC
+            # process split eviction plans serve from the numpy mirror
+            resolved = "numpy"
+        elif resolved == "pallas":
+            resolved = "xla"  # no Mosaic preempt kernel; XLA runs on TPU
+        now = self._clock()
+        return _Request(
+            inputs=inputs,
+            buckets=0,
+            backend=resolved,
+            key=("preempt", preempt_bucket_shape(inputs), resolved),
+            n_pods=n_candidates,
+            n_groups=n_victims,
+            deadline=(now + timeout) if timeout else None,
+            enqueued_at=now,
+        )
+
     def decide(self, inputs):
         """The HPA decision kernel through the service: same metrics
         surface and error accounting, no coalescing (the batch
@@ -1094,6 +1185,9 @@ class SolverService:
         if key[0] == "forecast":
             self._forecast_group(key, live)
             return
+        if key[0] == "preempt":
+            self._preempt_group(key, live)
+            return
         shape, buckets, backend = key[0], key[1], key[2]
         if backend == "numpy":
             # host program: no device dispatch, no padding (the sparse
@@ -1205,6 +1299,67 @@ class SolverService:
             )
             offset += size
         self._record_stage("scatter", _time.perf_counter() - t0)
+        self._record_device_success()
+
+    def _preempt_group(self, key: tuple, live: List[_Request]) -> None:
+        """Eviction-planning dispatches: each request is already a
+        whole-fleet batched problem (the candidate axis IS the batch —
+        ops/preempt.py plans candidates data-parallel), so same-key
+        requests dispatch one after another through one compiled
+        program. Completes inline (latency-bound, like forecasts), so
+        in-flight bin-pack work drains first to keep completion
+        ordered. Device failures raise to _dispatch_group, which
+        degrades the batch to the bit-identical numpy mirror and feeds
+        the backend-health FSM like any other device path."""
+        from karpenter_tpu.ops import preempt as PK
+
+        shape, backend = key[1], key[2]
+        self._drain_inflight()
+        if backend == "numpy":
+            # the REQUESTED backend, not a degradation: no padding (the
+            # host program doesn't compile), no fallback counting
+            for request in live:
+                t0 = _time.perf_counter()
+                request.finish(result=PK.preempt_numpy(request.inputs))
+                self._record_stage("dispatch", _time.perf_counter() - t0)
+            return
+        import jax
+
+        fresh = self._count_compile(("preempt", shape, backend))
+        grace = COMPILE_GRACE_S if fresh else 0.0
+        for request in live:
+            t0 = _time.perf_counter()
+            padded = pad_preempt_inputs(request.inputs, shape)
+            self._record_stage("pad", _time.perf_counter() - t0)
+            t0 = _time.perf_counter()
+            with self._device_section([request], grace=grace):
+                with solver_trace("solver.preempt"):
+                    # the preempt-path fault-injection point
+                    # (faults/registry.py, docs/resilience.md): an error
+                    # plan exercises the numpy degradation + FSM, a
+                    # hang plan the watchdog drain
+                    inject("preempt.plan")
+                    out = PK.preempt_plan(jax.device_put(padded))
+                    jax.block_until_ready(out)
+            grace = 0.0  # only the first dispatch of the batch compiles
+            if self._stale():
+                return  # watchdog already answered these from numpy
+            self._record_stage("dispatch", _time.perf_counter() - t0)
+            self._count_dispatch()
+            self.stats.preempt_dispatches += 1
+            t0 = _time.perf_counter()
+            host = PK.PreemptOutputs(
+                chosen_node=np.asarray(out.chosen_node),
+                evict_count=np.asarray(out.evict_count),
+                evict_mask=np.asarray(out.evict_mask),
+                unplaceable=np.asarray(out.unplaceable),
+            )
+            request.finish(
+                result=crop_preempt_outputs(
+                    host, request.n_pods, request.n_groups
+                )
+            )
+            self._record_stage("scatter", _time.perf_counter() - t0)
         self._record_device_success()
 
     def _forecast_compiled(self, cache_key: tuple):
@@ -1461,6 +1616,11 @@ class SolverService:
             # bit-identical mirror of the device kernel
             # (forecast/models.py parity contract)
             return forecast_numpy(inputs)
+        from karpenter_tpu.ops.preempt import PreemptInputs, preempt_numpy
+
+        if isinstance(inputs, PreemptInputs):
+            # bit-identical mirror (ops/preempt.py parity contract)
+            return preempt_numpy(inputs)
         from karpenter_tpu.ops.numpy_binpack import binpack_numpy
 
         return binpack_numpy(inputs, buckets=buckets)

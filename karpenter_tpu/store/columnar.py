@@ -102,6 +102,7 @@ class _SparsePod:
     selector: List[Tuple[str, str]]
     shape: tuple
     tolerations: list
+    priority: int = 0  # resolved scheduling priority (api/core)
     affinity: tuple = ()  # canonical required-node-affinity shape
     preferred: tuple = ()  # canonical preferred-node-affinity shape
     spread: tuple = ()  # canonical hard topology-spread shape
@@ -117,7 +118,15 @@ class PendingPodCache:
     snapshot_from_pods() — the oracle path reuses the exact same encode.
     """
 
-    def __init__(self, store: Optional[Store] = None, capacity: int = 1024):
+    def __init__(
+        self,
+        store: Optional[Store] = None,
+        capacity: int = 1024,
+        default_priority: int = 0,
+    ):
+        # fleet default for pods naming an unknown PriorityClass (the
+        # --default-priority knob); resolved spec.priority always wins
+        self._default_priority = default_priority
         self._lock = threading.Lock()
         # generation counts MUTATIONS (upsert/remove/compact), not resets:
         # snapshot() memoizes on it, and downstream encode/device caches key
@@ -170,6 +179,7 @@ class PendingPodCache:
             (capacity, len(self._resources) + 4), np.float32
         )
         self._required = np.zeros((capacity, 8), bool)
+        self._priority = np.zeros(capacity, np.int32)
         self._shape_id = np.zeros(capacity, np.int32)
         self._affinity_id = np.zeros(capacity, np.int32)
         self._preferred_id = np.zeros(capacity, np.int32)
@@ -202,6 +212,7 @@ class PendingPodCache:
         self._valid[slot] = False
         self._requests[slot, :] = 0.0
         self._required[slot, :] = False
+        self._priority[slot] = 0
         self._shape_id[slot] = 0
         self._affinity_id[slot] = 0
         self._preferred_id[slot] = 0
@@ -224,6 +235,8 @@ class PendingPodCache:
                 del self._dedup_slots[dedup_key]
 
     def _upsert(self, key, pod) -> None:
+        from karpenter_tpu.api.core import effective_priority
+
         sparse = _SparsePod(
             # effective_requests: the SCHEDULER's fit semantics (init
             # containers max'd against the container sum, overhead added) —
@@ -263,6 +276,9 @@ class PendingPodCache:
                 pod.spec.affinity,
                 pod.metadata.labels,
                 pod.metadata.namespace,
+            ),
+            priority=effective_priority(
+                pod, default=self._default_priority
             ),
         )
         slot = self._slot.get(key)
@@ -312,13 +328,16 @@ class PendingPodCache:
             self._soft_anti_index,
             sparse.soft_anti,
         )
+        self._priority[slot] = sparse.priority
         self._valid[slot] = True
         self._sparse[slot] = sparse
         # dedup maintenance: two slots share a key iff their canonical
         # sparse encodings match, which (with stable universe columns)
         # guarantees identical arena rows. Resource order in `requests` is
         # dict-iteration order, so sort for canonicality; selector/shape
-        # are already sorted at build time.
+        # are already sorted at build time. Priority is part of shape
+        # identity: it drives steering and evictability, so equal-spec
+        # pods of different PriorityClasses must not collapse.
         dedup_key = (
             tuple(sorted(sparse.requests)),
             tuple(sparse.selector),
@@ -329,6 +348,7 @@ class PendingPodCache:
             sparse.anti,
             sparse.soft_spread,
             sparse.soft_anti,
+            sparse.priority,
         )
         if self._slot_key.get(slot) != dedup_key:
             self._dedup_discard(slot)
@@ -392,6 +412,7 @@ class PendingPodCache:
         if self._hi == self._requests.shape[0]:
             self._requests = self._grow_rows(self._requests)
             self._required = self._grow_rows(self._required)
+            self._priority = self._grow_rows(self._priority)
             self._shape_id = self._grow_rows(self._shape_id)
             self._affinity_id = self._grow_rows(self._affinity_id)
             self._preferred_id = self._grow_rows(self._preferred_id)
@@ -475,6 +496,7 @@ class PendingPodCache:
             snap = PendingSnapshot(
                 requests=self._requests[:hi, : len(self._resources)].copy(),
                 required=self._required[:hi, : len(self._labels)].copy(),
+                priority=self._priority[:hi].copy(),
                 shape_id=self._shape_id[:hi].copy(),
                 valid=self._valid[:hi].copy(),
                 resources=list(self._resources),
@@ -913,8 +935,13 @@ class PendingFeed:
     node profiles + producer selectors, all watch-maintained. One object
     so the factory wires one thing and solve_pending takes one seam."""
 
-    def __init__(self, store: Store, profile_fn, node_mirror=None):
-        self.pods = PendingPodCache(store)
+    def __init__(
+        self, store: Store, profile_fn, node_mirror=None,
+        default_priority: int = 0,
+    ):
+        self.pods = PendingPodCache(
+            store, default_priority=default_priority
+        )
         self.nodes = (
             node_mirror
             if node_mirror is not None
@@ -971,6 +998,11 @@ class PendingSnapshot:                        # no 100k-row reprs in logs
     # compaction — what the encoder's delta layer matches rows on across
     # consecutive snapshots. None on hand-built snapshots.
     dedup_keys: Optional[tuple] = None
+    # resolved scheduling priority per row (api/core.effective_priority;
+    # part of the dedup identity). None on hand-built snapshots = every
+    # row priority 0 — the encoder then emits NO priority operand, so
+    # priority-free fleets solve exactly as before.
+    priority: Optional[np.ndarray] = None
     # required node affinity: per-row shape id into affinity_shapes
     # (canonical api/core.affinity_shape tuples; id 0 = unconstrained).
     # None on hand-built snapshots = no pod constrains affinity.

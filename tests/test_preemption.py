@@ -1,0 +1,865 @@
+"""Priority- and preemption-aware packing (ops/preempt.py,
+karpenter_tpu/preemption, the solver service's `preempt` seam, and the
+binpack priority/tier operands).
+
+The acceptance pins:
+
+  * XLA and numpy eviction plans are BIT-IDENTICAL (integer-capacity
+    arithmetic — ops/preempt.py docstring), including through the
+    service's shape-bucket padding;
+  * batched plans equal independent per-candidate plans row for row
+    (the candidate axis is data-parallel; quantization scales are
+    fleet-derived, not candidate-derived);
+  * priority-off inputs reproduce today's binpack outputs exactly —
+    absent operands take the pre-existing code path, and explicit
+    all-zero priority/tier operands produce identical outputs;
+  * the engine's safety layer: budgets never exceeded, no duplicate
+    evictions, do-not-disrupt respected, and the two disruption
+    engines (preemption/consolidation) never touch one node at once.
+"""
+
+import numpy as np
+
+from karpenter_tpu.api.core import (
+    Container,
+    Node,
+    NodeCondition,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    capacity_tier_of,
+    effective_priority,
+)
+from karpenter_tpu.api.metricsproducer import (
+    MetricsProducer,
+    MetricsProducerSpec,
+    PendingCapacitySpec,
+)
+from karpenter_tpu.api.scalablenodegroup import (
+    ScalableNodeGroup,
+    ScalableNodeGroupSpec,
+)
+from karpenter_tpu.ops import binpack as B
+from karpenter_tpu.ops.numpy_binpack import binpack_numpy
+from karpenter_tpu.ops.preempt import (
+    PreemptInputs,
+    preempt_numpy,
+    preempt_plan,
+    solve_preempt,
+)
+from karpenter_tpu.preemption import PreemptionConfig, PreemptionEngine
+from karpenter_tpu.solver import SolverService
+from karpenter_tpu.solver.bucketing import (
+    crop_preempt_outputs,
+    pad_preempt_inputs,
+    preempt_bucket_shape,
+)
+from karpenter_tpu.store import Store
+from karpenter_tpu.utils.quantity import Quantity
+
+OUTPUT_FIELDS = ("chosen_node", "evict_count", "evict_mask", "unplaceable")
+
+
+def random_problem(rng, c=None, n=None, v=None, r=None):
+    """A seeded random eviction problem honoring the kernel's input
+    contract (victims sorted by (node, priority, index))."""
+    c = c if c is not None else int(rng.integers(1, 12))
+    n = n if n is not None else int(rng.integers(1, 10))
+    v = v if v is not None else int(rng.integers(0, 40))
+    r = r if r is not None else int(rng.integers(1, 5))
+    victim_node = np.sort(rng.integers(0, n, v)).astype(np.int32)
+    victim_priority = np.zeros(v, np.int32)
+    for col in range(n):
+        seg = victim_node == col
+        victim_priority[seg] = np.sort(
+            rng.integers(0, 300, int(seg.sum()))
+        )
+    return PreemptInputs(
+        pod_requests=rng.uniform(0.1, 5.0, (c, r)).astype(np.float32),
+        pod_priority=rng.integers(0, 400, c).astype(np.int32),
+        pod_valid=rng.random(c) < 0.9,
+        pod_node_forbidden=rng.random((c, n)) < 0.15,
+        node_free=rng.uniform(0.0, 3.0, (n, r)).astype(np.float32),
+        node_tier=(rng.random(n) < 0.3).astype(np.int32),
+        victim_requests=rng.uniform(0.05, 2.0, (v, r)).astype(
+            np.float32
+        ),
+        victim_priority=victim_priority,
+        victim_node=victim_node,
+        victim_valid=rng.random(v) < 0.95,
+        victim_evictable=rng.random(v) < 0.9,
+    )
+
+
+def assert_outputs_equal(a, b, context=""):
+    for field in OUTPUT_FIELDS:
+        left = np.asarray(getattr(a, field))
+        right = np.asarray(getattr(b, field))
+        assert np.array_equal(left, right), (
+            f"{field} mismatch {context}: {left} vs {right}"
+        )
+
+
+def single_candidate(inputs, c):
+    import dataclasses
+
+    return dataclasses.replace(
+        inputs,
+        pod_requests=inputs.pod_requests[c : c + 1],
+        pod_priority=inputs.pod_priority[c : c + 1],
+        pod_valid=inputs.pod_valid[c : c + 1],
+        pod_node_forbidden=inputs.pod_node_forbidden[c : c + 1],
+    )
+
+
+class TestKernelParity:
+    def test_xla_equals_numpy_bit_identically(self):
+        import jax
+
+        rng = np.random.default_rng(7)
+        for trial in range(25):
+            inputs = random_problem(rng)
+            host = preempt_numpy(inputs)
+            device = preempt_plan(jax.device_put(inputs))
+            assert_outputs_equal(host, device, f"(trial {trial})")
+
+    def test_parity_survives_bucket_padding(self):
+        import jax
+
+        rng = np.random.default_rng(11)
+        for trial in range(10):
+            inputs = random_problem(rng)
+            c = inputs.pod_requests.shape[0]
+            v = inputs.victim_requests.shape[0]
+            padded = pad_preempt_inputs(
+                inputs, preempt_bucket_shape(inputs)
+            )
+            cropped = crop_preempt_outputs(
+                preempt_numpy(padded), c, v
+            )
+            assert_outputs_equal(
+                preempt_numpy(inputs), cropped, f"(numpy, trial {trial})"
+            )
+            cropped_dev = crop_preempt_outputs(
+                preempt_plan(jax.device_put(padded)), c, v
+            )
+            # full-axis comparison after crop: padded device == raw host
+            raw = preempt_numpy(inputs)
+            for field in ("chosen_node", "evict_count", "evict_mask"):
+                assert np.array_equal(
+                    np.asarray(getattr(raw, field)),
+                    np.asarray(getattr(cropped_dev, field)),
+                ), f"{field} (device pad, trial {trial})"
+
+    def test_quantization_scale_is_candidate_independent(self):
+        """Regression (r6 review): the scale denominator must derive
+        from the fleet (nodes + victims) only — a candidate-derived
+        max would shift ceil/floor rounding with batch composition and
+        flip borderline plans between batched and single-candidate
+        submissions."""
+        inputs = PreemptInputs(
+            pod_requests=np.array(
+                [[1.17], [2.87], [0.83], [2.60], [4.34]], np.float32
+            ),
+            pod_priority=np.full(5, 100, np.int32),
+            pod_valid=np.ones(5, bool),
+            pod_node_forbidden=np.zeros((5, 1), bool),
+            node_free=np.array([[2.6019135]], np.float32),
+            node_tier=np.zeros(1, np.int32),
+            victim_requests=np.zeros((0, 1), np.float32),
+            victim_priority=np.zeros(0, np.int32),
+            victim_node=np.zeros(0, np.int32),
+            victim_valid=np.zeros(0, bool),
+            victim_evictable=np.zeros(0, bool),
+        )
+        batched = preempt_numpy(inputs)
+        for c in range(5):
+            one = preempt_numpy(single_candidate(inputs, c))
+            assert int(one.chosen_node[0]) == int(
+                batched.chosen_node[c]
+            ), f"candidate {c}"
+
+    def test_nodeless_fleet_is_unplaceable_on_both_backends(self):
+        """Regression (r6 review): a fleet with zero node columns —
+        e.g. a FULL spot reclaim — reports every valid candidate
+        unplaceable on the raw numpy mirror too (the device path only
+        ever saw N=0 through bucket padding)."""
+        import jax
+
+        inputs = PreemptInputs(
+            pod_requests=np.array([[1.0], [2.0]], np.float32),
+            pod_priority=np.array([100, 50], np.int32),
+            pod_valid=np.array([True, False]),
+            pod_node_forbidden=np.zeros((2, 0), bool),
+            node_free=np.zeros((0, 1), np.float32),
+            node_tier=np.zeros(0, np.int32),
+            victim_requests=np.zeros((0, 1), np.float32),
+            victim_priority=np.zeros(0, np.int32),
+            victim_node=np.zeros(0, np.int32),
+            victim_valid=np.zeros(0, bool),
+            victim_evictable=np.zeros(0, bool),
+        )
+        host = preempt_numpy(inputs)
+        assert np.asarray(host.chosen_node).tolist() == [-1, -1]
+        assert int(host.unplaceable) == 1  # only the valid candidate
+        assert_outputs_equal(
+            host, preempt_plan(jax.device_put(inputs)), "(N=0)"
+        )
+
+    def test_empty_victim_axis(self):
+        rng = np.random.default_rng(3)
+        inputs = random_problem(rng, v=0)
+        out = solve_preempt(inputs, backend="numpy")
+        # with no victims every plan is a zero-eviction fit or nothing
+        assert (np.asarray(out.evict_count) == 0).all()
+
+    def test_plans_actually_fit(self):
+        """Conservative quantization: an accepted plan's freed + free
+        capacity covers the candidate — never an under-eviction."""
+        rng = np.random.default_rng(5)
+        for _ in range(10):
+            inputs = random_problem(rng)
+            out = preempt_numpy(inputs)
+            chosen = np.asarray(out.chosen_node)
+            mask = np.asarray(out.evict_mask)
+            for c in range(chosen.shape[0]):
+                col = int(chosen[c])
+                if col < 0:
+                    continue
+                freed = inputs.victim_requests[mask[c]].sum(axis=0)
+                assert (
+                    inputs.node_free[col]
+                    + freed
+                    + 1e-3  # f32 verification slack only
+                    >= inputs.pod_requests[c]
+                ).all()
+
+
+class TestBatchedIndependence:
+    def test_batched_equals_per_candidate(self):
+        rng = np.random.default_rng(13)
+        for trial in range(10):
+            inputs = random_problem(rng)
+            batched = preempt_numpy(inputs)
+            for c in range(inputs.pod_requests.shape[0]):
+                one = preempt_numpy(single_candidate(inputs, c))
+                assert int(one.chosen_node[0]) == int(
+                    batched.chosen_node[c]
+                ), f"candidate {c} (trial {trial})"
+                assert int(one.evict_count[0]) == int(
+                    batched.evict_count[c]
+                )
+                assert np.array_equal(
+                    np.asarray(one.evict_mask)[0],
+                    np.asarray(batched.evict_mask)[c],
+                )
+
+    def test_batched_equals_per_candidate_on_device(self):
+        import jax
+
+        rng = np.random.default_rng(17)
+        inputs = random_problem(rng, c=6, n=5, v=24, r=3)
+        batched = preempt_plan(jax.device_put(inputs))
+        for c in range(6):
+            one = preempt_plan(
+                jax.device_put(single_candidate(inputs, c))
+            )
+            assert int(one.chosen_node[0]) == int(batched.chosen_node[c])
+            assert int(one.evict_count[0]) == int(batched.evict_count[c])
+
+
+class TestKernelSemantics:
+    def fleet(self):
+        """One 4-cpu node, three 1-cpu victims at priorities 10/20/30."""
+        return PreemptInputs(
+            pod_requests=np.array([[2.0]], np.float32),
+            pod_priority=np.array([100], np.int32),
+            pod_valid=np.ones(1, bool),
+            pod_node_forbidden=np.zeros((1, 1), bool),
+            node_free=np.array([[0.0]], np.float32),
+            node_tier=np.zeros(1, np.int32),
+            victim_requests=np.array(
+                [[1.0], [1.0], [1.0]], np.float32
+            ),
+            victim_priority=np.array([10, 20, 30], np.int32),
+            victim_node=np.zeros(3, np.int32),
+            victim_valid=np.ones(3, bool),
+            victim_evictable=np.ones(3, bool),
+        )
+
+    def test_minimal_prefix_evicts_lowest_priority_first(self):
+        out = preempt_numpy(self.fleet())
+        assert int(out.chosen_node[0]) == 0
+        assert int(out.evict_count[0]) == 2
+        assert np.asarray(out.evict_mask)[0].tolist() == [
+            True, True, False,
+        ]
+
+    def test_higher_priority_victims_are_protected(self):
+        import dataclasses
+
+        inputs = dataclasses.replace(
+            self.fleet(), pod_priority=np.array([15], np.int32)
+        )
+        out = preempt_numpy(inputs)
+        # only the priority-10 victim is outranked: 1 cpu freed < 2
+        assert int(out.chosen_node[0]) == -1
+        assert int(out.unplaceable) == 1
+
+    def test_spot_tier_is_evictable_by_contract(self):
+        import dataclasses
+
+        inputs = dataclasses.replace(
+            self.fleet(),
+            pod_priority=np.array([15], np.int32),
+            node_tier=np.ones(1, np.int32),
+        )
+        out = preempt_numpy(inputs)
+        assert int(out.chosen_node[0]) == 0
+        assert int(out.evict_count[0]) == 2
+
+    def test_do_not_disrupt_mask_respected(self):
+        import dataclasses
+
+        inputs = dataclasses.replace(
+            self.fleet(),
+            victim_evictable=np.array([False, True, True]),
+        )
+        out = preempt_numpy(inputs)
+        # the protected lowest-priority victim is skipped, not evicted
+        assert np.asarray(out.evict_mask)[0].tolist() == [
+            False, True, True,
+        ]
+
+    def test_zero_eviction_fit_wins(self):
+        import dataclasses
+
+        base = self.fleet()
+        inputs = dataclasses.replace(
+            base,
+            node_free=np.array([[0.0], [2.0]], np.float32),
+            node_tier=np.zeros(2, np.int32),
+            pod_node_forbidden=np.zeros((1, 2), bool),
+        )
+        out = preempt_numpy(inputs)
+        assert int(out.chosen_node[0]) == 1
+        assert int(out.evict_count[0]) == 0
+
+
+class TestPriorityOffBinpack:
+    """Acceptance pin (c): priority-off inputs reproduce today's
+    binpack outputs exactly — and explicit zero operands change
+    nothing either."""
+
+    def problem(self, rng):
+        p, t = 40, 6
+        return dict(
+            pod_requests=rng.uniform(0.1, 3.0, (p, 2)).astype(
+                np.float32
+            ),
+            pod_valid=rng.random(p) < 0.95,
+            pod_intolerant=rng.random((p, 4)) < 0.1,
+            pod_required=rng.random((p, 4)) < 0.1,
+            group_allocatable=rng.uniform(1.0, 4.0, (t, 2)).astype(
+                np.float32
+            ),
+            group_taints=rng.random((t, 4)) < 0.2,
+            group_labels=rng.random((t, 4)) < 0.5,
+        )
+
+    def test_absent_equals_zero_operands(self):
+        import jax
+
+        rng = np.random.default_rng(23)
+        for trial in range(5):
+            fields = self.problem(rng)
+            absent = B.BinPackInputs(**fields)
+            zeroed = B.BinPackInputs(
+                **fields,
+                pod_priority=np.zeros(
+                    fields["pod_requests"].shape[0], np.int32
+                ),
+                group_tier=np.zeros(
+                    fields["group_allocatable"].shape[0], np.int32
+                ),
+            )
+            for solver in (
+                lambda x: B.binpack(jax.device_put(x)),
+                binpack_numpy,
+            ):
+                a, z = solver(absent), solver(zeroed)
+                assert np.array_equal(
+                    np.asarray(a.assigned), np.asarray(z.assigned)
+                ), f"trial {trial}"
+                assert np.array_equal(
+                    np.asarray(a.nodes_needed),
+                    np.asarray(z.nodes_needed),
+                )
+                assert int(a.unschedulable) == int(z.unschedulable)
+
+    def test_priority_steers_away_from_preemptible_tiers(self):
+        fields = dict(
+            pod_requests=np.full((4, 1), 1.0, np.float32),
+            pod_valid=np.ones(4, bool),
+            pod_intolerant=np.zeros((4, 1), bool),
+            pod_required=np.zeros((4, 1), bool),
+            group_allocatable=np.full((2, 1), 8.0, np.float32),
+            group_taints=np.zeros((2, 1), bool),
+            group_labels=np.zeros((2, 1), bool),
+        )
+        inputs = B.BinPackInputs(
+            **fields,
+            pod_priority=np.array([0, 0, 500, 500], np.int32),
+            group_tier=np.array([1, 0], np.int32),
+        )
+        import jax
+
+        device = B.binpack(jax.device_put(inputs))
+        host = binpack_numpy(inputs)
+        # priority-0 pods keep first-feasible (the spot group);
+        # priority-500 pods steer to the on-demand group
+        assert np.asarray(device.assigned).tolist() == [0, 0, 1, 1]
+        assert np.array_equal(
+            np.asarray(device.assigned), np.asarray(host.assigned)
+        )
+
+
+    def test_large_preference_scores_survive_steering(self):
+        """Regression (r6 review): soft-spread scores scale with live
+        domain counts (magnitudes beyond a few thousand are routine),
+        so steering must never clamp-and-compose them — a priority-0
+        pod in a fleet that merely CARRIES the operands must assign
+        exactly as if they were absent."""
+        import jax
+
+        fields = dict(
+            pod_requests=np.full((1, 1), 1.0, np.float32),
+            pod_valid=np.ones(1, bool),
+            pod_intolerant=np.zeros((1, 1), bool),
+            pod_required=np.zeros((1, 1), bool),
+            group_allocatable=np.full((2, 1), 8.0, np.float32),
+            group_taints=np.zeros((2, 1), bool),
+            group_labels=np.zeros((2, 1), bool),
+            pod_group_score=np.array([[-3000.0, -2500.0]], np.float32),
+        )
+        plain = B.BinPackInputs(**fields)
+        carrying = B.BinPackInputs(
+            **fields,
+            pod_priority=np.zeros(1, np.int32),
+            group_tier=np.array([0, 1], np.int32),
+        )
+        for solver in (
+            lambda x: B.binpack(jax.device_put(x)),
+            binpack_numpy,
+        ):
+            assert np.asarray(solver(plain).assigned).tolist() == [1]
+            assert np.asarray(solver(carrying).assigned).tolist() == [1]
+
+    def test_steer_is_lexicographically_senior_to_score(self):
+        """A positive-priority pod leaves a preemptible group even when
+        the preference score strongly favors it; the score still breaks
+        ties among same-tier groups."""
+        import jax
+
+        inputs = B.BinPackInputs(
+            pod_requests=np.full((1, 1), 1.0, np.float32),
+            pod_valid=np.ones(1, bool),
+            pod_intolerant=np.zeros((1, 1), bool),
+            pod_required=np.zeros((1, 1), bool),
+            group_allocatable=np.full((3, 1), 8.0, np.float32),
+            group_taints=np.zeros((3, 1), bool),
+            group_labels=np.zeros((3, 1), bool),
+            pod_group_score=np.array(
+                [[9000.0, -5000.0, -4000.0]], np.float32
+            ),
+            pod_priority=np.array([100], np.int32),
+            group_tier=np.array([1, 0, 0], np.int32),
+        )
+        for solver in (
+            lambda x: B.binpack(jax.device_put(x)),
+            binpack_numpy,
+        ):
+            # spot group 0 loses despite its 9000 score; score picks
+            # group 2 among the two on-demand groups
+            assert np.asarray(solver(inputs).assigned).tolist() == [2]
+
+
+class TestServiceSeam:
+    def test_service_matches_mirror_and_caches_compiles(self):
+        rng = np.random.default_rng(29)
+        svc = SolverService(backend="xla")
+        try:
+            first = random_problem(rng, c=4, n=6, v=30, r=3)
+            assert_outputs_equal(
+                svc.preempt(first), preempt_numpy(first), "(service)"
+            )
+            misses = svc.stats.compile_cache_misses
+            # same rungs (jittered sizes inside one bucket): no recompile
+            again = random_problem(rng, c=5, n=6, v=28, r=3)
+            assert_outputs_equal(
+                svc.preempt(again), preempt_numpy(again), "(service 2)"
+            )
+            assert svc.stats.compile_cache_misses == misses
+            assert svc.stats.preempt_dispatches == 2
+        finally:
+            svc.close()
+
+    def test_empty_candidate_axis_short_circuits(self):
+        svc = SolverService(backend="xla")
+        try:
+            rng = np.random.default_rng(31)
+            inputs = random_problem(rng, c=1, n=2, v=4, r=2)
+            import dataclasses
+
+            empty = dataclasses.replace(
+                inputs,
+                pod_requests=inputs.pod_requests[:0],
+                pod_priority=inputs.pod_priority[:0],
+                pod_valid=inputs.pod_valid[:0],
+                pod_node_forbidden=inputs.pod_node_forbidden[:0],
+            )
+            out = svc.preempt(empty)
+            assert np.asarray(out.chosen_node).shape == (0,)
+            assert svc.stats.dispatches == 0
+        finally:
+            svc.close()
+
+
+# -- planner + engine ---------------------------------------------------------
+
+
+def q(value):
+    return Quantity.parse(str(value))
+
+
+def make_node(name, labels=None, cpu="4", ready=True, annotations=None):
+    return Node(
+        metadata=ObjectMeta(
+            name=name,
+            labels=dict(labels or {"pool": "a"}),
+            annotations=dict(annotations or {}),
+        ),
+        spec=NodeSpec(),
+        status=NodeStatus(
+            allocatable={
+                "cpu": q(cpu), "memory": q("8Gi"), "pods": q("16")
+            },
+            conditions=[
+                NodeCondition("Ready", "True" if ready else "False")
+            ],
+        ),
+    )
+
+
+def make_pod(name, node=None, cpu="1", priority=None, annotations=None,
+             priority_class=""):
+    return Pod(
+        metadata=ObjectMeta(
+            name=name, annotations=dict(annotations or {})
+        ),
+        spec=PodSpec(
+            node_name=node or "",
+            priority=priority,
+            priority_class_name=priority_class,
+            containers=[
+                Container(
+                    requests={"cpu": q(cpu), "memory": q("1Gi")}
+                )
+            ],
+        ),
+    )
+
+
+def storm_store(eviction_budget=None, preemptible=False):
+    store = Store()
+    store.create(
+        MetricsProducer(
+            metadata=ObjectMeta(name="pool"),
+            spec=MetricsProducerSpec(
+                pending_capacity=PendingCapacitySpec(
+                    node_selector={"pool": "a"}, node_group_ref="grp"
+                )
+            ),
+        )
+    )
+    store.create(
+        ScalableNodeGroup(
+            metadata=ObjectMeta(name="grp"),
+            spec=ScalableNodeGroupSpec(
+                replicas=2,
+                type="FakeNodeGroup",
+                id="grp",
+                preemptible=preemptible,
+                eviction_budget=eviction_budget,
+            ),
+        )
+    )
+    for name in ("n1", "n2"):
+        store.create(make_node(name))
+        for i in range(4):
+            store.create(
+                make_pod(f"{name}-batch-{i}", node=name, priority=0)
+            )
+    return store
+
+
+def engine_for(store, clock=None, **config):
+    svc = SolverService(backend="xla")
+    engine = PreemptionEngine(
+        store,
+        svc,
+        config=PreemptionConfig(
+            min_candidate_priority=1, plan_interval_s=0.0, **config
+        ),
+        clock=clock,
+    )
+    return svc, engine
+
+
+class TestEngine:
+    def test_evicts_lowest_priority_to_admit_candidate(self):
+        store = storm_store(eviction_budget=4)
+        store.create(make_pod("critical", cpu="2", priority=1000))
+        svc, engine = engine_for(store)
+        try:
+            plans = engine.plan()
+            plan = plans[("default", "critical")]
+            assert plan is not None and len(plan["evictions"]) == 2
+            assert all(
+                store.try_get("Pod", ns, name) is None
+                for ns, name in plan["evictions"]
+            )
+        finally:
+            svc.close()
+
+    def test_budget_never_exceeded(self):
+        store = storm_store(eviction_budget=1)
+        store.create(make_pod("critical", cpu="2", priority=1000))
+        svc, engine = engine_for(store)
+        try:
+            plans = engine.plan()
+            # the plan needs 2 evictions but the budget allows 1:
+            # DEFERRED, not trimmed — nothing was evicted
+            assert plans[("default", "critical")] is None
+            assert (
+                sum(
+                    1
+                    for p in store.list("Pod")
+                    if p.spec.node_name
+                )
+                == 8
+            )
+        finally:
+            svc.close()
+
+    def test_no_duplicate_evictions_across_conflicting_plans(self):
+        store = storm_store(eviction_budget=8)
+        store.create(make_pod("crit-a", cpu="2", priority=1000))
+        store.create(make_pod("crit-b", cpu="2", priority=900))
+        svc, engine = engine_for(store)
+        try:
+            plans = engine.plan()
+            accepted = [p for p in plans.values() if p]
+            evicted = [
+                key for p in accepted for key in p["evictions"]
+            ]
+            assert len(evicted) == len(set(evicted)), (
+                "one victim evicted twice"
+            )
+            # plans that share a target node defer; each accepted plan
+            # holds a distinct node
+            nodes = [p["node"] for p in accepted]
+            assert len(nodes) == len(set(nodes))
+        finally:
+            svc.close()
+
+    def test_do_not_disrupt_pod_never_evicted(self):
+        store = storm_store(eviction_budget=8)
+        for name in ("n1", "n2"):
+            for i in range(4):
+                pod = store.get("Pod", "default", f"{name}-batch-{i}")
+                pod.metadata.annotations[
+                    "karpenter.sh/do-not-disrupt"
+                ] = "true"
+                store.update(pod)
+        store.create(make_pod("critical", cpu="2", priority=1000))
+        svc, engine = engine_for(store)
+        try:
+            plans = engine.plan()
+            assert plans[("default", "critical")] is None
+            assert sum(
+                1 for p in store.list("Pod") if p.spec.node_name
+            ) == 8
+        finally:
+            svc.close()
+
+    def test_candidate_hold_prevents_amplification(self):
+        store = storm_store(eviction_budget=8)
+        store.create(make_pod("critical", cpu="2", priority=1000))
+        svc, engine = engine_for(store)
+        try:
+            first = engine.plan()
+            assert first[("default", "critical")] is not None
+            # the candidate stays pending (nothing binds it here): the
+            # hold keeps the next rounds from evicting MORE pods for it
+            assert engine.plan() == {}
+            assert sum(
+                1 for p in store.list("Pod") if p.spec.node_name
+            ) == 6
+        finally:
+            svc.close()
+
+    def test_partial_actuation_is_not_an_accepted_plan(self):
+        """Regression (r6 review): a store conflict vetoing part of an
+        eviction set must not record the plan as accepted — the
+        candidate is re-planned promptly instead of sitting out a full
+        hold with insufficient freed capacity."""
+        store = storm_store(eviction_budget=4)
+        store.create(make_pod("critical", cpu="2", priority=1000))
+        svc, engine = engine_for(store)
+        real_delete = store.delete
+        vetoed = {"n": 0}
+
+        def flaky_delete(kind, namespace=None, name=None):
+            if name == "n1-batch-1" and vetoed["n"] == 0:
+                vetoed["n"] += 1
+                raise RuntimeError("conflict")
+            return real_delete(kind, namespace, name)
+
+        store.delete = flaky_delete
+        try:
+            plans = engine.plan()
+            assert plans[("default", "critical")] is None
+            # the pod that DID evict stays charged; the candidate is
+            # free to re-plan immediately
+            assert ("default", "critical") not in engine._candidate_holds
+            again = engine.plan(engine.clock() + 1.0)
+            assert again[("default", "critical")] is not None
+        finally:
+            store.delete = real_delete
+            svc.close()
+
+    def test_ungrouped_nodes_budget_independently(self):
+        from karpenter_tpu.preemption.engine import PreemptionEngine
+
+        assert PreemptionEngine._budget_key(
+            ("default", "pool", "grp"), "n1"
+        ) == ("default", "grp")
+        assert PreemptionEngine._budget_key(None, "n1") != (
+            PreemptionEngine._budget_key(None, "n2")
+        )
+
+    def test_consolidation_coordination_both_ways(self):
+        from karpenter_tpu.consolidation import ConsolidationEngine
+
+        store = storm_store(eviction_budget=8)
+        store.create(make_pod("critical", cpu="2", priority=1000))
+        svc = SolverService(backend="xla")
+        try:
+            consolidation = ConsolidationEngine(
+                store, solver_service=svc
+            )
+            engine = PreemptionEngine(
+                store,
+                svc,
+                consolidation=consolidation,
+                config=PreemptionConfig(
+                    min_candidate_priority=1, plan_interval_s=0.0
+                ),
+            )
+            consolidation.node_guard = engine.active_nodes
+            # consolidation owns n1: preemption must plan around it
+            consolidation._in_flight["n1"] = type(
+                "S", (), {"node": "n1", "group": ("default", "x", "grp"),
+                          "phase": "cordoned", "since": 0.0}
+            )()
+            plans = engine.plan()
+            plan = plans[("default", "critical")]
+            assert plan is not None and plan["node"] == "n2"
+            # ...and the preemption hold guards n2 from consolidation
+            assert "n2" in engine.active_nodes()
+            view_node = [
+                nv
+                for nv in __import__(
+                    "karpenter_tpu.consolidation.planner",
+                    fromlist=["cluster_view"],
+                ).cluster_view(store).nodes
+                if nv.name == "n2"
+            ][0]
+            assert not consolidation._eligible(
+                view_node, now=1e9,
+                guarded=consolidation.node_guard(),
+            )
+        finally:
+            svc.close()
+
+
+class TestPriorityPlumbing:
+    def test_effective_priority_resolution(self):
+        assert effective_priority(make_pod("p", priority=7)) == 7
+        assert (
+            effective_priority(
+                make_pod("p", priority_class="system-node-critical")
+            )
+            == 2_000_001_000
+        )
+        # the fleet default covers pods NAMING an unknown class only;
+        # class-less pods stay at 0 (a nonzero knob must not lift the
+        # whole fleet into nonzero-priority encoding)
+        assert (
+            effective_priority(
+                make_pod("p", priority_class="important"), default=42
+            )
+            == 42
+        )
+        assert effective_priority(make_pod("p"), default=42) == 0
+
+    def test_capacity_tier_labels(self):
+        assert capacity_tier_of({"karpenter.sh/capacity-type": "spot"}) == 1
+        assert capacity_tier_of({"cloud.google.com/gke-spot": "true"}) == 1
+        assert capacity_tier_of({"pool": "a"}) == 0
+        assert capacity_tier_of({("pool", "a"), ("x", "y")}) == 0
+
+    def test_encoder_emits_priority_and_tier_only_when_present(self):
+        from karpenter_tpu.metrics.producers.pendingcapacity import (
+            encode_snapshot,
+            group_profile,
+        )
+        from karpenter_tpu.store.columnar import snapshot_from_pods
+
+        spot = make_node(
+            "s1", labels={"pool": "a", "karpenter.sh/capacity-type": "spot"}
+        )
+        plain = make_node("p1", labels={"pool": "b"})
+        profiles_plain = [group_profile([plain], {"pool": "b"})]
+        profiles_spot = [group_profile([spot], {"pool": "a"})]
+
+        flat = snapshot_from_pods([make_pod("w", cpu="1")])
+        inputs = encode_snapshot(flat, profiles_plain)
+        assert inputs.pod_priority is None
+        assert inputs.group_tier is None
+
+        prioritized = snapshot_from_pods(
+            [make_pod("w", cpu="1", priority=100)]
+        )
+        inputs = encode_snapshot(prioritized, profiles_spot)
+        assert inputs.pod_priority is not None
+        assert int(inputs.pod_priority[0]) == 100
+        assert inputs.group_tier is not None
+        assert int(inputs.group_tier[0]) == 1
+
+    def test_priority_splits_dedup_rows(self):
+        from karpenter_tpu.store.columnar import snapshot_from_pods
+
+        snap = snapshot_from_pods(
+            [
+                make_pod("a", cpu="1", priority=0),
+                make_pod("b", cpu="1", priority=0),
+                make_pod("c", cpu="1", priority=50),
+            ]
+        )
+        # identical specs at two priorities: two distinct shapes
+        assert len(snap.dedup_idx) == 2
+        assert sorted(snap.dedup_weight.tolist()) == [1, 2]
