@@ -24,6 +24,9 @@ test: ## Run the test suite (virtual 8-device CPU mesh)
 test-chaos: ## Seeded chaos suite: runtime + solver under injected faults (docs/resilience.md)
 	$(PYTHON) -m pytest tests/test_faults.py tests/test_chaos.py -q
 
+test-recovery: ## Seeded kill-and-restart suite: crash-safe state, fencing, warm-up (docs/resilience.md "Crash recovery")
+	$(PYTHON) -m pytest tests/test_recovery.py tests/test_restart_chaos.py -q
+
 battletest: ## Randomized order + scale + stress + coverage when available (reference: Makefile battletest)
 	@# coverage is opportunistic but NEVER silent: the gate says which
 	@# mode it runs in, and a failing test fails it in either mode
@@ -78,6 +81,10 @@ bench-preempt: ## Batched one-dispatch eviction planning vs per-candidate loop (
 		--pods 10000 --backend xla --iters 10 \
 		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
 
+bench-journal: ## Protective-state journal overhead on the reconcile hot path (target <5% tick-latency regression); appends a BENCHMARKS row + publishes to BASELINE.json
+	$(PYTHON) bench.py --journal --journal-ticks 40 \
+		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
+
 dryrun: ## Multi-chip sharding compile check on 8 virtual CPU devices
 	$(PYTHON) -c "import os; \
 		os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS','') + ' --xla_force_host_platform_device_count=8').strip(); \
@@ -114,6 +121,7 @@ conformance: ## Run the real-apiserver tier against a kind-booted apiserver (the
 kind-smoke: ## Deploy smoke on kind: image -> apply -> pod Ready -> one HA end to end
 	bash hack/kind-smoke.sh
 
-.PHONY: help dev ci test test-chaos battletest verify codegen docs native \
-	bench bench-solver bench-consolidate bench-forecast bench-preempt \
-	dryrun image publish apply delete kind-load conformance kind-smoke
+.PHONY: help dev ci test test-chaos test-recovery battletest verify codegen \
+	docs native bench bench-solver bench-consolidate bench-forecast \
+	bench-preempt bench-journal dryrun image publish apply delete \
+	kind-load conformance kind-smoke

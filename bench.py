@@ -480,6 +480,20 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
         help="with --forecast: history samples per series",
     )
     ap.add_argument(
+        "--journal",
+        action="store_true",
+        help="benchmark protective-state journal overhead on the "
+        "reconcile hot path (karpenter_tpu/recovery): the same seeded "
+        "world ticks with the journal ON vs OFF (target: <5%% tick-"
+        "latency regression), plus raw StateJournal.append throughput",
+    )
+    ap.add_argument(
+        "--journal-ticks",
+        type=int,
+        default=40,
+        help="with --journal: measured manager ticks per configuration",
+    )
+    ap.add_argument(
         "--publish-baseline",
         action="store_true",
         help="with --solver-service: write the result into BASELINE.json's "
@@ -602,17 +616,32 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
         ap.error("--series must be >= 2")
     if args.history < 4:
         ap.error("--history must be >= 4")
+    if args.journal and (
+        args.mesh or args.e2e or args.decide or args.clusters
+        or args.solver_service or args.hotpath or args.consolidate
+        or args.forecast or args.preempt
+    ):
+        ap.error(
+            "--journal builds its own ticking world; it cannot combine "
+            "with other modes"
+        )
     if (args.publish_baseline or args.append_benchmarks) and not (
         args.solver_service or args.consolidate or args.hotpath
-        or args.forecast or args.preempt
+        or args.forecast or args.preempt or args.journal
     ):
         ap.error(
             "--publish-baseline/--append-benchmarks only apply to "
             "--solver-service/--consolidate/--hotpath/--forecast/"
-            "--preempt (nothing would be published otherwise)"
+            "--preempt/--journal (nothing would be published otherwise)"
         )
 
-    if args.preempt:
+    if args.journal:
+        metric = (
+            f"reconcile tick p50 with the protective-state journal, "
+            f"{args.journal_ticks} ticks (journal ON vs OFF + raw "
+            f"append throughput)"
+        )
+    elif args.preempt:
         metric = (
             f"batched eviction-planning p50, {args.candidates} "
             f"candidates x {args.types} node columns x {args.pods} "
@@ -734,11 +763,221 @@ def _bench_inputs(args):
     )
 
 
+def _journal_world(runtime):
+    """The chaos-suite world: one profiled node group, one pending pod,
+    an SNG, and a queue-metric HA — every tick drives an encode + solve
+    + decide + status writes, i.e. the real reconcile hot path the
+    journal must not slow down."""
+    from karpenter_tpu.api.core import (
+        Node, NodeCondition, NodeSpec, NodeStatus, ObjectMeta, Pod,
+        PodSpec, resource_list,
+    )
+    from karpenter_tpu.api.horizontalautoscaler import (
+        CrossVersionObjectReference, HorizontalAutoscaler,
+        HorizontalAutoscalerSpec, Metric, MetricTarget,
+        PrometheusMetricSource,
+    )
+    from karpenter_tpu.api.metricsproducer import (
+        MetricsProducer, MetricsProducerSpec, PendingCapacitySpec,
+    )
+    from karpenter_tpu.api.scalablenodegroup import (
+        ScalableNodeGroup, ScalableNodeGroupSpec,
+    )
+
+    store = runtime.store
+    store.create(Node(
+        metadata=ObjectMeta(name="n1", labels={"pool": "a"}),
+        spec=NodeSpec(),
+        status=NodeStatus(
+            allocatable=resource_list(cpu="8", memory="16Gi", pods="16"),
+            conditions=[NodeCondition("Ready", "True")],
+        ),
+    ))
+    store.create(Pod(metadata=ObjectMeta(name="p1"), spec=PodSpec()))
+    store.create(MetricsProducer(
+        metadata=ObjectMeta(name="pending"),
+        spec=MetricsProducerSpec(
+            pending_capacity=PendingCapacitySpec(
+                node_selector={"pool": "a"}, node_group_ref="g",
+            )
+        ),
+    ))
+    store.create(ScalableNodeGroup(
+        metadata=ObjectMeta(name="g"),
+        spec=ScalableNodeGroupSpec(
+            replicas=3, type="FakeNodeGroup", id="g"
+        ),
+    ))
+    store.create(HorizontalAutoscaler(
+        metadata=ObjectMeta(name="ha"),
+        spec=HorizontalAutoscalerSpec(
+            scale_target_ref=CrossVersionObjectReference(
+                kind="ScalableNodeGroup", name="g"
+            ),
+            min_replicas=1, max_replicas=100,
+            metrics=[Metric(prometheus=PrometheusMetricSource(
+                query='karpenter_queue_length{name="q"}',
+                target=MetricTarget(type="AverageValue", value=4),
+            ))],
+        ),
+    ))
+    runtime.registry.register("queue", "length").set("q", "default", 12.0)
+
+
+def _journal_tick_times(args, journal_dir):
+    """Per-tick wall times for one configuration (journal on/off) over
+    the identical seeded world: churn pod toggled each tick so the
+    encode memo misses and every tick pays a real solve."""
+    from karpenter_tpu.api.core import ObjectMeta, Pod, PodSpec
+    from karpenter_tpu.cloudprovider.fake import FakeFactory
+    from karpenter_tpu.runtime import KarpenterRuntime, Options
+
+    clock = {"now": 1_000_000.0}
+    provider = FakeFactory()
+    provider.node_replicas["g"] = 3
+    runtime = KarpenterRuntime(
+        Options(consolidate=True, journal_dir=journal_dir),
+        cloud_provider_factory=provider,
+        clock=lambda: clock["now"],
+    )
+    _journal_world(runtime)
+
+    def tick():
+        try:
+            runtime.store.delete("Pod", "default", "churn-pod")
+        except KeyError:
+            runtime.store.create(
+                Pod(metadata=ObjectMeta(name="churn-pod"), spec=PodSpec())
+            )
+        clock["now"] += 61.0
+        runtime.manager.reconcile_all()
+
+    times = []
+    try:
+        for _ in range(5):  # warmup: compiles, first encodes
+            tick()
+        for _ in range(args.journal_ticks):
+            t0 = time.perf_counter()
+            tick()
+            times.append((time.perf_counter() - t0) * 1e3)
+    finally:
+        runtime.close()
+    return times
+
+
+def _append_throughput(journal_dir, n=20_000):
+    from karpenter_tpu.recovery import StateJournal
+
+    journal = StateJournal(journal_dir)
+    handle = journal.handle("bench")
+    t0 = time.perf_counter()
+    for i in range(n):
+        handle.set(("k", i % 64), {"v": i})
+    elapsed = time.perf_counter() - t0
+    journal.close()
+    return {
+        "append_us": round(elapsed / n * 1e6, 3),
+        "appends_per_sec": int(n / elapsed),
+    }
+
+
+def _append_journal_row(path: str, record: dict) -> None:
+    marker = "## Journal overhead (make bench-journal)"
+    header = (
+        f"\n{marker}\n\n"
+        "Reconcile tick latency with the protective-state journal "
+        "(karpenter_tpu/recovery) ON vs OFF over the identical seeded "
+        "world, plus raw append throughput. Acceptance target: journal "
+        "overhead under 5% of tick latency.\n\n"
+        "| Date | Backend | Ticks | Tick p50 off/on (ms) | Overhead | "
+        "Append (µs) | Appends/s |\n"
+        "|---|---|---|---|---|---|---|\n"
+    )
+    date = datetime.date.today().isoformat()
+    row = (
+        f"| {date} | {record['backend']} | {record['ticks']} "
+        f"| {record['tick_p50_off_ms']} / {record['tick_p50_on_ms']} "
+        f"| {record['overhead_pct']}% "
+        f"| {record['append_us']} | {record['appends_per_sec']} |\n"
+    )
+    _append_table_row(path, marker, header, row)
+
+
+def run_journal(args, metric: str, note: str) -> None:
+    """Journal append overhead on the reconcile hot path (ISSUE 7
+    acceptance: <5% tick-latency regression vs the unjournaled tick).
+    Same seeded world both ways; the ON configuration journals FSM
+    transitions, breaker/backoff state, and forecast history through
+    the real runtime wiring."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    print(
+        f"backend={jax.default_backend()} devices={jax.devices()}",
+        file=sys.stderr,
+    )
+    off = _journal_tick_times(args, None)
+    root = tempfile.mkdtemp(prefix="karpenter-bench-journal-")
+    try:
+        on = _journal_tick_times(args, os.path.join(root, "ticks"))
+        throughput = _append_throughput(os.path.join(root, "appends"))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    p50_off = float(np.percentile(off, 50))
+    p50_on = float(np.percentile(on, 50))
+    overhead = (p50_on / p50_off - 1.0) * 100.0 if p50_off else 0.0
+    record = {
+        "config": f"{args.journal_ticks} ticks",
+        "backend": jax.default_backend(),
+        "ticks": args.journal_ticks,
+        "tick_p50_off_ms": round(p50_off, 3),
+        "tick_p50_on_ms": round(p50_on, 3),
+        "tick_p99_off_ms": round(float(np.percentile(off, 99)), 3),
+        "tick_p99_on_ms": round(float(np.percentile(on, 99)), 3),
+        "overhead_pct": round(overhead, 2),
+        **throughput,
+    }
+    record_evidence(
+        tick_off_ms=[round(t, 4) for t in off],
+        tick_on_ms=[round(t, 4) for t in on],
+        journal=record,
+    )
+    print(
+        f"tick p50 off={record['tick_p50_off_ms']}ms "
+        f"on={record['tick_p50_on_ms']}ms "
+        f"overhead={record['overhead_pct']}% | append "
+        f"{record['append_us']}µs ({record['appends_per_sec']}/s)",
+        file=sys.stderr,
+    )
+    if args.publish_baseline:
+        _publish_to_baseline(
+            f"{record['config']} journal overhead ({record['backend']})",
+            record,
+        )
+    if args.append_benchmarks:
+        _append_journal_row(args.append_benchmarks, record)
+    emit(
+        f"{metric} ({jax.default_backend()})",
+        p50_on,
+        note=(
+            f"{note}; " if note else ""
+        ) + f"journal overhead {record['overhead_pct']}% "
+        f"(off p50 {record['tick_p50_off_ms']}ms), append "
+        f"{record['append_us']}µs",
+        against_baseline=False,
+    )
+
+
 def run(args, metric: str, note: str) -> None:  # lint: allow-complexity — bench mode dispatch, one arm per measured configuration
     import jax
 
     _warm_native_kernel(args)
 
+    if args.journal:
+        run_journal(args, metric, note)
+        return
     if args.preempt:
         run_preempt(args, metric, note)
         return

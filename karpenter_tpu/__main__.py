@@ -65,6 +65,23 @@ def parse_args(argv=None) -> argparse.Namespace:
         "in-memory only",
     )
     parser.add_argument(
+        "--journal-dir",
+        default=None,
+        help="directory for the crash-safe protective-state journal "
+        "(FSM phases, holds, budgets, breakers, backoff, forecast "
+        "history) + actuation fence generation; omit for ephemeral "
+        "state and unfenced actuation (docs/resilience.md 'Crash "
+        "recovery')",
+    )
+    parser.add_argument(
+        "--recovery-warmup-ticks",
+        type=int,
+        default=1,
+        help="full reconcile ticks a RECOVERED boot holds the "
+        "conservative warm-up (no consolidation or preemption) while "
+        "fleet state is confirmed; first boots skip it",
+    )
+    parser.add_argument(
         "--apiserver",
         default=None,
         help="kube-apiserver base URL for real-cluster mode (e.g. "
@@ -189,6 +206,21 @@ def parse_args(argv=None) -> argparse.Namespace:
         "always win; docs/preemption.md)",
     )
     parser.add_argument(
+        "--restart-storm",
+        action="store_true",
+        help="with --simulate: replay a seeded kill-and-restart storm "
+        "against a consolidating fleet (crash mid-drain, reboot from "
+        "the protective-state journal, repeat) and report exactly-once "
+        "actuation, FSM resumption, and the fence rejecting a stale "
+        "incarnation's replay (docs/resilience.md 'Crash recovery')",
+    )
+    parser.add_argument(
+        "--storm-crashes",
+        type=int,
+        default=3,
+        help="with --simulate --restart-storm: kill/reboot cycles",
+    )
+    parser.add_argument(
         "--forecast",
         action="store_true",
         help="with --simulate: replay a synthetic diurnal ramp through "
@@ -238,6 +270,20 @@ def _run_simulation(args, store) -> int:
 
         report = simulate_forecast(
             horizon_s=args.forecast_horizon, model=args.forecast_model
+        )
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return 0
+
+    if args.restart_storm:
+        # self-contained replay (own store/provider/journal dir): a
+        # seeded kill-and-restart storm pinning the crash-safety
+        # contract — exactly-once actuation, FSM resumption, fencing
+        from karpenter_tpu.simulate import simulate_restart_storm
+
+        report = simulate_restart_storm(
+            crashes=args.storm_crashes,
+            journal_dir=args.journal_dir,
+            warmup_ticks=args.recovery_warmup_ticks,
         )
         print(json.dumps(report, indent=2, sort_keys=True))
         return 0
@@ -429,6 +475,8 @@ def main(argv=None) -> int:
             preempt=args.preempt,
             preempt_budget=args.preempt_budget,
             default_pod_priority=args.default_priority,
+            journal_dir=args.journal_dir,
+            recovery_warmup_ticks=args.recovery_warmup_ticks,
             backoff_base_s=args.backoff_base,
             backoff_cap_s=args.backoff_cap,
             circuit_failure_threshold=args.circuit_threshold,

@@ -78,7 +78,13 @@ def node_template_from_raw(
 
 
 class NodeGroup(Protocol):
-    def set_replicas(self, count: int) -> None: ...
+    def set_replicas(self, count: int, token=None) -> None:
+        """Apply the desired replica count. `token` is an optional
+        actuation fence stamp (recovery/fence.FenceToken): providers
+        that enforce fencing verify it BEFORE applying and raise
+        FenceRejectedError for a superseded generation; None (unfenced
+        deployments) must always be accepted."""
+        ...
 
     def get_replicas(self) -> int: ...
 

@@ -225,9 +225,12 @@ class _NotImplementedClient:
 class AutoScalingGroup:
     """reference: autoscalinggroup.go:79-112."""
 
-    def __init__(self, id_: str, client: AutoscalingAPI):
+    def __init__(self, id_: str, client: AutoscalingAPI, fence=None):
         self.id = normalize_asg_id(id_)
         self.client = client
+        # actuation fence (karpenter_tpu/recovery): the factory's shared
+        # FenceValidator; None = unfenced (direct construction, tests)
+        self.fence = fence
         # one describe per reconcile: the controller calls stabilized()
         # then get_replicas() on the same short-lived instance (a fresh
         # one per reconcile), so memoizing the first describe halves the
@@ -268,7 +271,13 @@ class AutoScalingGroup:
             )
         return self._count_healthy(groups[0])
 
-    def set_replicas(self, count: int) -> None:
+    def set_replicas(self, count: int, token=None) -> None:
+        # fence verification BEFORE apply (karpenter_tpu/recovery): a
+        # stale incarnation's stamp is rejected, never applied — and
+        # never wrapped as transient (retrying a dead decision is the
+        # exact failure fencing exists to stop)
+        if self.fence is not None:
+            self.fence.admit(token)
         try:
             inject("cloud.set_replicas")
             self.client.update_auto_scaling_group(
@@ -319,7 +328,7 @@ class ManagedNodeGroup:
     ready+schedulable nodes carrying the EKS node-group label — read from
     the object store (the apiserver analog), not the EKS API."""
 
-    def __init__(self, id_: str, eks_client: EKSAPI, store):
+    def __init__(self, id_: str, eks_client: EKSAPI, store, fence=None):
         try:
             self.cluster, self.node_group = parse_mng_id(id_)
         except ValueError:
@@ -328,6 +337,7 @@ class ManagedNodeGroup:
             self.cluster, self.node_group = "", ""
         self.eks_client = eks_client
         self.store = store
+        self.fence = fence  # shared FenceValidator, or None = unfenced
 
     def get_replicas(self) -> int:
         inject("cloud.get_replicas")
@@ -336,7 +346,9 @@ class ManagedNodeGroup:
         )
         return sum(1 for n in nodes if is_ready_and_schedulable(n))
 
-    def set_replicas(self, count: int) -> None:
+    def set_replicas(self, count: int, token=None) -> None:
+        if self.fence is not None:
+            self.fence.admit(token)  # verified BEFORE apply; not transient
         try:
             inject("cloud.set_replicas")
             self.eks_client.update_nodegroup_config(
@@ -529,15 +541,27 @@ class AWSFactory:
         self.eks_client = eks_client or _NotImplementedClient("eks")
         self.sqs_client = sqs_client or _NotImplementedClient("sqs")
         self._fallback = FakeFactory.not_implemented()
+        # one actuation fence per factory — the cloud is shared
+        # infrastructure, so every controller incarnation races the
+        # same highest-seen generation (karpenter_tpu/recovery)
+        from karpenter_tpu.recovery.fence import FenceValidator
+
+        self.fence_validator = FenceValidator()
         # queue objects are cached per ARN so the SQSQueue URL cache
         # actually spans polls (producers resolve queue_for every tick)
         self._queues: Dict[str, SQSQueue] = {}
 
     def node_group_for(self, spec):
         if spec.type == AWS_EC2_AUTO_SCALING_GROUP:
-            return AutoScalingGroup(spec.id, self.autoscaling_client)
+            return AutoScalingGroup(
+                spec.id, self.autoscaling_client,
+                fence=self.fence_validator,
+            )
         if spec.type == AWS_EKS_NODE_GROUP:
-            return ManagedNodeGroup(spec.id, self.eks_client, self.store)
+            return ManagedNodeGroup(
+                spec.id, self.eks_client, self.store,
+                fence=self.fence_validator,
+            )
         return self._fallback.node_group_for(spec)
 
     def queue_for(self, spec):

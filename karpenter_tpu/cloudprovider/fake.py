@@ -17,6 +17,7 @@ from karpenter_tpu.api.scalablenodegroup import (
 from karpenter_tpu.cloudprovider import Options
 from karpenter_tpu.controllers.errors import RetryableError
 from karpenter_tpu.faults import inject
+from karpenter_tpu.recovery.fence import FenceValidator
 
 # Providers register admission validators for the types they serve
 # (reference: pkg/cloudprovider/aws/sqsqueue.go:29-34 init pattern).
@@ -48,7 +49,14 @@ class FakeNodeGroup:
             )
         return replicas
 
-    def set_replicas(self, count: int) -> None:
+    def set_replicas(self, count: int, token=None) -> None:
+        # actuation fence (karpenter_tpu/recovery): verified FIRST —
+        # before fault injection, like the AWS/TPU providers — so a
+        # stale incarnation's call is rejected without consuming a
+        # chaos plan's injection budget, and chaos runs mixing fault
+        # plans with fencing behave identically across providers.
+        # Unstamped calls (token None) pass unchecked.
+        self._factory.fence_validator.admit(token)
         # inject BEFORE applying: a failed provider call must be atomic
         # (no partially-applied resize), so retry-vs-duplicate actuation
         # is observable in chaos runs
@@ -103,6 +111,10 @@ class FakeFactory:
         self.node_group_stable = True
         self.queue_lengths: Dict[str, int] = {}
         self.queue_oldest_ages: Dict[str, int] = {}
+        # the cloud is shared infrastructure: every controller
+        # incarnation actuating through this factory races one fence
+        # (karpenter_tpu/recovery/fence.py)
+        self.fence_validator = FenceValidator()
 
     @classmethod
     def not_implemented(cls) -> "FakeFactory":

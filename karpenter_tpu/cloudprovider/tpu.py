@@ -87,12 +87,15 @@ class _NotImplementedContainerAPI:
 
 
 class TPUPodSlicePool:
-    def __init__(self, id_: str, api: ContainerAPI, store):
+    def __init__(self, id_: str, api: ContainerAPI, store, fence=None):
         self.project, self.location, self.cluster, self.pool = parse_pool_id(
             id_
         )
         self.api = api
         self.store = store
+        # actuation fence (karpenter_tpu/recovery): the factory's shared
+        # FenceValidator; None = unfenced (direct construction, tests)
+        self.fence = fence
 
     def get_replicas(self) -> int:
         """Ready slices = ready+schedulable nodes labeled with the pool name.
@@ -115,7 +118,13 @@ class TPUPodSlicePool:
         )
         return len(ready) // max(hosts_per_slice, 1)
 
-    def set_replicas(self, count: int) -> None:
+    def set_replicas(self, count: int, token=None) -> None:
+        # fence verification BEFORE apply (karpenter_tpu/recovery): a
+        # stale incarnation's stamp is rejected, never applied, and the
+        # rejection is NOT wrapped as a transient resize race below —
+        # retrying a dead decision is what fencing exists to stop
+        if self.fence is not None:
+            self.fence.admit(token)
         try:
             inject("cloud.set_replicas")
             self.api.set_node_pool_size(
@@ -313,10 +322,18 @@ class TPUFactory:
         self.container_api = container_api or _NotImplementedContainerAPI()
         self.pubsub_api = pubsub_api or _NotImplementedPubSubAPI()
         self._fallback = FakeFactory.not_implemented()
+        # one actuation fence per factory — every controller incarnation
+        # actuating through it races the same highest-seen generation
+        from karpenter_tpu.recovery.fence import FenceValidator
+
+        self.fence_validator = FenceValidator()
 
     def node_group_for(self, spec):
         if spec.type == TPU_POD_SLICE_POOL:
-            return TPUPodSlicePool(spec.id, self.container_api, self.store)
+            return TPUPodSlicePool(
+                spec.id, self.container_api, self.store,
+                fence=self.fence_validator,
+            )
         return self._fallback.node_group_for(spec)
 
     def queue_for(self, spec):

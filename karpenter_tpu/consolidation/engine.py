@@ -42,6 +42,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from karpenter_tpu.consolidation import planner as P
+from karpenter_tpu.faults import inject
 from karpenter_tpu.metrics.registry import GaugeRegistry, default_registry
 from karpenter_tpu.utils.log import logger
 
@@ -113,6 +114,14 @@ class ConsolidationEngine:
         # are never consolidation candidates, so the two engines cannot
         # fight over one node (docs/preemption.md "Coordination").
         self.node_guard = None
+        # crash safety (karpenter_tpu/recovery, docs/resilience.md):
+        # `journal` is a JournalHandle recording every FSM transition so
+        # a restarted controller resumes each node's phase instead of
+        # re-cordoning; `disruption_gate` is the recovery warm-up gate —
+        # while it returns False (fleet state unconfirmed after a
+        # restart) no planning happens at all
+        self.journal = None
+        self.disruption_gate = None
         self.config = config or ConsolidationConfig()
         self.registry = (
             registry if registry is not None else default_registry()
@@ -151,6 +160,11 @@ class ConsolidationEngine:
         new candidates in one batched solver call, cordon the drainable
         ones. Returns {candidate: verdict} for observability/tests."""
         now = self.clock() if now is None else now
+        if self.disruption_gate is not None and not self.disruption_gate():
+            # recovery warm-up: fleet state is unconfirmed after a
+            # restart — plan nothing, and do NOT stamp _last_plan so the
+            # first post-warm-up reconcile plans immediately
+            return {}
         self._last_plan = now
         groups = P.discover_groups(self.store)
         view = P.cluster_view(self.store, groups)
@@ -202,6 +216,96 @@ class ConsolidationEngine:
             # the node left the cluster out from under the FSM (a manual
             # delete, another actor): nothing left to drain
             del self._in_flight[name]
+            self._journal_del(name)
+
+    # -- crash-safe FSM journal (karpenter_tpu/recovery) -------------------
+
+    def _journal_set(self, state: _InFlight) -> None:
+        if self.journal is not None:
+            self.journal.set(
+                ("node", state.node),
+                {
+                    "group": list(state.group),
+                    "phase": state.phase,
+                    "since": state.since,
+                },
+            )
+
+    def _journal_del(self, name: str) -> None:
+        if self.journal is not None:
+            self.journal.delete(("node", name))
+
+    def snapshot_state(self) -> Dict[str, dict]:
+        """Full FSM table for the recovery checkpoint (same layout the
+        journal folds to)."""
+        from karpenter_tpu.recovery.journal import key_str
+
+        return {
+            key_str(("node", s.node)): {
+                "group": list(s.group),
+                "phase": s.phase,
+                "since": s.since,
+            }
+            for s in self._in_flight.values()
+        }
+
+    def restore_state(self, entries: dict, now: Optional[float] = None) -> None:
+        """Rebuild the in-flight FSM from a replayed journal table: a
+        cordoned node resumes its phase (and its verify soak) instead of
+        being re-cordoned from scratch. Restored `since` stamps are
+        capped at `now` — the shared clock is wall time, but a skewed
+        stamp must never fast-forward a soak."""
+        from karpenter_tpu.recovery.journal import key_tuple
+
+        now = self.clock() if now is None else now
+        for k, v in entries.items():
+            name = key_tuple(k)[1]
+            self._in_flight[name] = _InFlight(
+                node=name,
+                group=tuple(v["group"]),
+                phase=v["phase"],
+                since=min(float(v["since"]), now),
+            )
+        if self._in_flight:
+            self._publish_in_flight()
+            logger().info(
+                "consolidation: restored %d in-flight drain(s) from "
+                "the journal: %s",
+                len(self._in_flight),
+                {s.node: s.phase for s in self._in_flight.values()},
+            )
+        self._release_orphan_cordons()
+
+    def _release_orphan_cordons(self) -> None:
+        """Uncordon nodes carrying OUR state annotation with no restored
+        FSM entry — a crash between the durable cordon write and its
+        journal append leaves exactly this orphan, and the candidate
+        gate would otherwise exclude it forever (a cordoned node is
+        nobody's receiver). The invariant stands: a node is never left
+        unschedulable with nobody owning it."""
+        for key in list(self.store.keys("Node")):
+            name = key[2]
+            if name in self._in_flight:
+                continue
+            node = self.store.try_get(*key)
+            if (
+                node is None
+                or STATE_ANNOTATION not in node.metadata.annotations
+            ):
+                continue
+            logger().warning(
+                "consolidation: releasing orphan cordon on %s (state "
+                "annotation present, no journaled FSM entry — crash "
+                "between cordon and journal append)", name,
+            )
+            if not self._uncordon(name):
+                # the uncordon write conflicted: adopt the node in
+                # UNCORDONING so every plan retries until it lands
+                self._in_flight[name] = _InFlight(
+                    node=name, group=("", "", ""),
+                    phase=UNCORDONING, since=self.clock(),
+                )
+                self._journal_set(self._in_flight[name])
 
     @staticmethod
     def _budget_key(group: tuple) -> tuple:
@@ -289,11 +393,13 @@ class ConsolidationEngine:
         never be left unschedulable with nobody owning it."""
         if self._uncordon(name):
             self._in_flight.pop(name, None)
+            self._journal_del(name)
             return
         state = self._in_flight.get(name)
         if state is not None:
             state.phase = UNCORDONING
             state.since = self.clock()
+            self._journal_set(state)
 
     def _advance_cordoned(self, reverify, verdicts, now: float) -> None:
         for name in reverify:
@@ -315,9 +421,10 @@ class ConsolidationEngine:
                 continue  # an earlier candidate took the budget slot
             if not self._cordon(name):
                 continue
-            self._in_flight[name] = _InFlight(
+            state = self._in_flight[name] = _InFlight(
                 node=name, group=nv.group, phase=CORDONED, since=now
             )
+            self._journal_set(state)
             self._c_planned.inc("-", "-")
             logger().info(
                 "consolidation: cordoned %s (group %s/%s), verifying "
@@ -363,8 +470,19 @@ class ConsolidationEngine:
         """Decrement the owning ScalableNodeGroup's spec.replicas through
         the scale subresource — the same intent door the autoscaler
         writes; the ScalableNodeGroup controller's spec-vs-observed loop
-        then performs the provider call."""
+        then performs the provider call.
+
+        The DRAINING transition is journaled WRITE-AHEAD (before the
+        scale write): a crash between the journal record and the store
+        write restores to DRAINING whose scale-down is never observed —
+        drain_timeout_s then vetoes it safely. The reverse order would
+        restore to APPROVED after a landed decrement and decrement
+        AGAIN on the next plan: one drain, two replicas gone."""
         namespace, _, ref = state.group
+        state.phase = DRAINING
+        state.since = self.clock()  # drain_timeout_s measures THIS phase
+        self._journal_set(state)
+        inject("process.crash.drain")  # the mid-drain kill point
         try:
             scale = self.store.get_scale(
                 "ScalableNodeGroup", namespace, ref
@@ -387,8 +505,6 @@ class ConsolidationEngine:
                 f"actuation failed ({type(e).__name__}: {e})",
             )
             return
-        state.phase = DRAINING
-        state.since = self.clock()  # drain_timeout_s measures THIS phase
         logger().info(
             "consolidation: draining %s (scaled %s/%s to %d)",
             state.node, namespace, ref, current - 1,
@@ -420,6 +536,7 @@ class ConsolidationEngine:
             except Exception:  # noqa: BLE001 — already gone is fine
                 pass
             del self._in_flight[name]
+            self._journal_del(name)
             self._c_actuated.inc("-", "-")
             finalized.append(name)
             logger().info("consolidation: drained %s", name)

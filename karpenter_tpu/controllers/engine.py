@@ -68,9 +68,19 @@ class Manager:
         backoff_base_s: float = 1.0,
         backoff_cap_s: float = 60.0,
         backoff_seed: int = 0,
+        tick_hook=None,
+        recovery_journal=None,
     ):
         self.store = store
         self.clock = clock
+        # crash safety (karpenter_tpu/recovery): `recovery_journal` is a
+        # JournalHandle persisting per-object backoff state — without
+        # it, a crash-looping object restarts its ladder at the base
+        # delay every controller restart, defeating the backoff exactly
+        # when it matters. `tick_hook` fires after each full
+        # reconcile_all pass (the recovery warm-up counts ticks on it).
+        self._tick_hook = tick_hook
+        self._journal = recovery_journal
         # shared solve service (solver/service.py): the manager refreshes
         # its point-in-time gauges (queue depth, coalesce factor, stage
         # percentiles) every tick, so /metrics shows them alongside the
@@ -126,7 +136,7 @@ class Manager:
         key = (obj.KIND, obj.metadata.namespace, obj.metadata.name)
         if event == "Deleted":
             self._due.pop(key, None)
-            self._backoff_prev.pop(key, None)
+            self._drop_backoff(key)
             # controllers may keep per-object state of their own (the
             # SNG controller's circuit breakers + gauge series): give
             # them the same pruning signal the engine's maps get
@@ -187,39 +197,102 @@ class Manager:
         """The supervised requeue ladder: interval on success, jittered
         backoff on retryable failure, deactivation on non-retryable."""
         if error is None:
-            self._backoff_prev.pop(key, None)
+            self._drop_backoff(key)
             self._due[key] = self.clock() + controller.interval()
         elif is_retryable(error):
             self._requeue_backoff(key)
         else:
-            # DEACTIVATE: no requeue until a watch event revives the
-            # object (_on_event). Exactly-once by construction — the
-            # object is never due again, so _finish cannot re-run.
-            # Concurrency guard: an EXTERNAL write landing during this
-            # reconcile fired its revival event before we got here and
-            # due=inf would silently discard it — detectable because the
-            # stored resourceVersion has moved past our own status
-            # patch. Reconcile once more instead of deactivating.
-            current = self.store.try_get(*key)
-            if (
-                current is not None
-                and patched is not None
-                and current.metadata.resource_version
-                != patched.metadata.resource_version
-            ):
-                self._due[key] = 0.0
-                return
-            self._backoff_prev.pop(key, None)
-            self._due[key] = _NEVER
-            if self._deactivated_gauge is not None:
-                self._deactivated_gauge.inc(key[0], "-")
+            self._deactivate(key, patched)
+
+    def _deactivate(self, key, patched) -> None:
+        """DEACTIVATE: no requeue until a watch event revives the
+        object (_on_event). Exactly-once by construction — the object
+        is never due again, so _finish cannot re-run. Concurrency
+        guard: an EXTERNAL write landing during this reconcile fired
+        its revival event before we got here and due=inf would silently
+        discard it — detectable because the stored resourceVersion has
+        moved past our own status patch. Reconcile once more instead of
+        deactivating."""
+        current = self.store.try_get(*key)
+        if (
+            current is not None
+            and patched is not None
+            and current.metadata.resource_version
+            != patched.metadata.resource_version
+        ):
+            self._due[key] = 0.0
+            return
+        # the journaled ladder is dropped too: a crash-restart must not
+        # revive a DEACTIVATED object through a stale finite due time
+        # restored from the journal
+        self._drop_backoff(key)
+        self._due[key] = _NEVER
+        if self._deactivated_gauge is not None:
+            self._deactivated_gauge.inc(key[0], "-")
+
+    def _drop_backoff(self, key) -> None:
+        """Retire an object's backoff ladder, in memory AND in the
+        journal (one idiom for success, deletion, and deactivation)."""
+        if (
+            self._backoff_prev.pop(key, None) is not None
+            and self._journal is not None
+        ):
+            self._journal.delete(key)
 
     def _requeue_backoff(self, key) -> None:
         delay = self._backoff.next(self._backoff_prev.get(key, 0.0))
         self._backoff_prev[key] = delay
         self._due[key] = self.clock() + delay
+        if self._journal is not None:
+            self._journal.set(
+                key, {"prev": delay, "due": self._due[key]}
+            )
         if self._backoff_gauge is not None:
             self._backoff_gauge.set(key[0], "-", delay)
+
+    def snapshot_backoff(self) -> Dict[str, dict]:
+        """Live backoff table for the recovery checkpoint."""
+        from karpenter_tpu.recovery.journal import key_str
+
+        return {
+            key_str(key): {"prev": prev, "due": self._due.get(key, 0.0)}
+            for key, prev in self._backoff_prev.items()
+        }
+
+    def restore_backoff(self, entries: dict) -> None:
+        """Rebuild the per-object backoff ladder from a replayed journal
+        table, so a crash-looping object cannot reset its ladder by
+        crashing the controller. Restored due times are CAPPED at
+        now + backoff cap: an object journaled long before the outage
+        ended must come due within one max-backoff window, never stay
+        parked on a stale far-future (or inf) stamp."""
+        from karpenter_tpu.recovery.journal import key_tuple
+
+        now = self.clock()
+        restored = 0
+        # snapshot the items: `entries` aliases the journal's live
+        # mirror table, and the delete below folds back into it —
+        # iterating the dict itself would crash the recovery boot
+        for k, doc in list(entries.items()):
+            key = key_tuple(k)
+            if self.store.try_get(*key) is None:
+                # deleted while we were down: no Deleted event will
+                # ever fire for it — drop the entry now or it would
+                # re-persist through every future checkpoint
+                if self._journal is not None:
+                    self._journal.delete(key)
+                continue
+            prev = min(float(doc["prev"]), self._backoff.cap_s)
+            self._backoff_prev[key] = prev
+            self._due[key] = min(
+                float(doc["due"]), now + self._backoff.cap_s
+            )
+            restored += 1
+        if restored:
+            logger().info(
+                "engine: restored backoff state for %d object(s) from "
+                "the journal", restored,
+            )
 
     def _validate(self, obj) -> Optional[Exception]:
         try:
@@ -280,6 +353,8 @@ class Manager:
             self._reconcile_controller(controller, now)
         if self._solver_service is not None:
             self._solver_service.publish_gauges()
+        if self._tick_hook is not None:
+            self._tick_hook()
         if self._tick_gauge is not None:
             self._tick_gauge.set(
                 "manager", "-", _time.perf_counter() - start

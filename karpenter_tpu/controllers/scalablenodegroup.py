@@ -22,6 +22,18 @@ ActuationCircuitOpen reason, the last RetryableError.code, and the
 next-probe ETA. After `circuit_reset_s` one half-open probe reconcile is
 admitted; success closes the circuit, failure re-opens it for a fresh
 window.
+
+Crash safety (karpenter_tpu/recovery, docs/resilience.md "Crash
+recovery"): with a RecoveryManager wired, every provider write is
+FENCED — stamped with the incarnation's generation token, which the
+provider verifies before applying, so a stale (restarted-over or
+split-brain) controller cannot replay a dead decision — and journaled
+as an intent/ack pair: an intent without an ack after a crash marks an
+actuation of unknown fate, which the level-triggered spec-vs-observed
+loop resolves idempotently (observed already at target → the write
+landed; otherwise it is re-issued under a fresh token) — exactly-once
+either way. Breaker state journals too: a provider that was flapping
+before the crash is still circuit-broken after it.
 """
 
 from __future__ import annotations
@@ -46,6 +58,7 @@ class ScalableNodeGroupController:
         circuit_failure_threshold: int = 5,
         circuit_reset_s: float = 120.0,
         clock=None,
+        recovery=None,
     ):
         import time as _time
 
@@ -62,6 +75,18 @@ class ScalableNodeGroupController:
         # one breaker per resource (namespace, name): group A's flapping
         # ASG must not trip group B's actuation
         self._breakers: Dict[tuple, CircuitBreaker] = {}
+        # crash safety (module docstring): the RecoveryManager supplies
+        # the fence generation, the breaker/actuation journal handles,
+        # and the replayed tables restored below
+        self.recovery = recovery
+        self.fence = recovery.fence if recovery is not None else None
+        self._j_breaker = self._j_actuation = None
+        # (namespace, name) -> un-acked intent: live during the provider
+        # write, and restored from the journal after a crash
+        self._intents: Dict[tuple, dict] = {}
+        # breakers currently present in the journal table (avoids a
+        # delete record per healthy reconcile)
+        self._journaled_breakers: set = set()
         self._g_circuit = self._c_opens = None
         if registry is not None:
             self._g_circuit = registry.register(
@@ -70,6 +95,12 @@ class ScalableNodeGroupController:
             self._c_opens = registry.register(
                 "resilience", "circuit_open_total", kind="counter"
             )
+        if recovery is not None:
+            self._j_breaker = recovery.handle("breaker")
+            self._j_actuation = recovery.handle("actuation")
+            self._restore_recovery_state()
+            recovery.register_snapshot("breaker", self.snapshot_breakers)
+            recovery.register_snapshot("actuation", self._snapshot_intents)
 
     def kind(self) -> str:
         return ScalableNodeGroup.KIND
@@ -81,13 +112,126 @@ class ScalableNodeGroupController:
         """Engine deletion hook: drop the per-object breaker and its
         gauge series — a recreated group with the same name must start
         with a CLOSED circuit, not inherit a dead group's open one."""
-        self._breakers.pop(
-            (resource.metadata.namespace, resource.metadata.name), None
-        )
+        key = (resource.metadata.namespace, resource.metadata.name)
+        self._breakers.pop(key, None)
+        if self._j_breaker is not None and key in self._journaled_breakers:
+            self._j_breaker.delete(key)
+            self._journaled_breakers.discard(key)
+        # a pending intent dies with its group too: a later group
+        # RECREATED under the same name must not resolve a dead epoch's
+        # actuation intent
+        if self._intents.pop(key, None) is not None and (
+            self._j_actuation is not None
+        ):
+            self._j_actuation.delete(key)
         if self._g_circuit is not None:
             self._g_circuit.remove(
                 resource.metadata.name, resource.metadata.namespace
             )
+
+    # -- crash-safe state (karpenter_tpu/recovery) -------------------------
+
+    def _journal_breaker(self, key: tuple, breaker: CircuitBreaker) -> None:
+        if self._j_breaker is None:
+            return
+        if breaker.state == resilience_CLOSED and (
+            breaker.consecutive_failures == 0
+        ):
+            # a pristine breaker is the default: journal a delete (once)
+            # instead of a set, so the table — and the per-tick journal
+            # traffic of a HEALTHY fleet — stays proportional to sick
+            # groups, not to fleet size
+            if key in self._journaled_breakers:
+                self._j_breaker.delete(key)
+                self._journaled_breakers.discard(key)
+            return
+        self._j_breaker.set(key, self._breaker_doc(breaker))
+        self._journaled_breakers.add(key)
+
+    @staticmethod
+    def _breaker_doc(breaker: CircuitBreaker) -> dict:
+        return {
+            "state": breaker.state,
+            "failures": breaker.consecutive_failures,
+            "opened_at": breaker.opened_at,
+            "opens_total": breaker.opens_total,
+            "code": breaker.last_error_code,
+        }
+
+    def snapshot_breakers(self) -> Dict[str, dict]:
+        from karpenter_tpu.recovery.journal import key_str
+
+        return {
+            key_str(key): self._breaker_doc(breaker)
+            for key, breaker in self._breakers.items()
+            if not (
+                breaker.state == resilience_CLOSED
+                and breaker.consecutive_failures == 0
+            )
+        }
+
+    def _snapshot_intents(self) -> Dict[str, dict]:
+        from karpenter_tpu.recovery.journal import key_str
+
+        return {key_str(k): v for k, v in self._intents.items()}
+
+    def _restore_recovery_state(self) -> None:
+        """Rebuild breakers and pending actuation intents from the
+        replayed journal tables. A restored OPEN breaker keeps its
+        window (opened_at capped at now — a skewed stamp must not
+        shorten it); a pending intent marks a pre-crash provider write
+        of unknown fate, resolved idempotently on first reconcile."""
+        from karpenter_tpu.recovery.journal import key_tuple
+
+        now = self.clock()
+        for k, doc in self.recovery.table("breaker").items():
+            key = key_tuple(k)
+            breaker = CircuitBreaker(
+                failure_threshold=self.circuit_failure_threshold,
+                reset_s=self.circuit_reset_s,
+                clock=self.clock,
+            )
+            breaker.state = doc["state"]
+            breaker.consecutive_failures = int(doc["failures"])
+            opened = doc.get("opened_at")
+            breaker.opened_at = (
+                None if opened is None else min(float(opened), now)
+            )
+            breaker.opens_total = int(doc.get("opens_total", 0))
+            breaker.last_error_code = doc.get("code", "")
+            self._breakers[key] = breaker
+            self._journaled_breakers.add(key)
+        for k, doc in self.recovery.table("actuation").items():
+            # mark journal-restored intents: only THESE get the
+            # crash-recovery log wording when resolved (an in-session
+            # provider failure also leaves an un-acked intent, and
+            # calling that "recovered" would send operators hunting
+            # for restarts that never happened)
+            self._intents[key_tuple(k)] = dict(doc, restored=True)
+        if self._breakers or self._intents:
+            logger().info(
+                "scalablenodegroup: restored %d breaker(s) and %d "
+                "pending actuation intent(s) from the journal",
+                len(self._breakers), len(self._intents),
+            )
+
+    def prune_restored_missing(self, store) -> None:
+        """Drop restored breakers/intents whose group was deleted while
+        the controller was down — no Deleted event will ever fire for
+        them, so without this sweep they would re-persist through every
+        future checkpoint forever. The runtime calls this once after
+        restore, against the re-listed store."""
+        for key in list(self._breakers):
+            if store.try_get("ScalableNodeGroup", *key) is None:
+                self._breakers.pop(key, None)
+                if key in self._journaled_breakers:
+                    self._j_breaker.delete(key)
+                    self._journaled_breakers.discard(key)
+        for akey in list(self._intents):
+            if store.try_get("ScalableNodeGroup", *akey) is None:
+                self._intents.pop(akey, None)
+                if self._j_actuation is not None:
+                    self._j_actuation.delete(akey)
 
     def _breaker(self, resource) -> CircuitBreaker:
         key = (resource.metadata.namespace, resource.metadata.name)
@@ -135,6 +279,8 @@ class ScalableNodeGroupController:
         observed = node_group.get_replicas()
         resource.status.replicas = observed
 
+        self._resolve_pending_intent(resource, observed)
+
         # 3. actuate when spec diverges from observation. Scale-UPS never
         # pile onto a group mid-change: overlapping grow resizes against a
         # pool whose previous resize is in flight can strand partial TPU
@@ -148,7 +294,7 @@ class ScalableNodeGroupController:
             return
         if not stable and resource.spec.replicas > observed:
             return
-        node_group.set_replicas(resource.spec.replicas)
+        self._set_replicas(node_group, resource)
         logger().debug(
             "ScalableNodeGroup %s updated nodes %d -> %d",
             resource.spec.id,
@@ -159,6 +305,64 @@ class ScalableNodeGroupController:
             self._finish_scale_down(
                 resource, mgr, observed, stable, message
             )
+
+    def _resolve_pending_intent(self, resource, observed: int) -> None:
+        """Resolve a pre-crash actuation of unknown fate (an intent
+        journaled without an ack): the fresh observation settles it —
+        either the write landed before the crash (observed == target;
+        nothing to redo) or it didn't and the level-triggered
+        spec-vs-observed step re-issues it under a fresh fence token.
+        Exactly-once by idempotent replay, never a blind redo."""
+        akey = (resource.metadata.namespace, resource.metadata.name)
+        intent = self._intents.pop(akey, None)
+        if intent is None or self._j_actuation is None:
+            return
+        self._j_actuation.delete(akey)
+        outcome = (
+            "landed before the crash"
+            if intent.get("target") == observed
+            else "not applied; the reconcile loop re-issues it"
+        )
+        if intent.get("restored"):
+            logger().info(
+                "recovered actuation intent for %s/%s (target %s): "
+                "observed %d — %s",
+                akey[0], akey[1], intent.get("target"), observed, outcome,
+            )
+        else:
+            # same-incarnation leftover of a raised provider call: the
+            # ordinary retry path, not a crash recovery
+            logger().debug(
+                "unresolved actuation intent for %s/%s (target %s): "
+                "observed %d — %s",
+                akey[0], akey[1], intent.get("target"), observed, outcome,
+            )
+
+    def _set_replicas(self, node_group, resource) -> None:
+        """The one provider-write door. Unfenced (no RecoveryManager):
+        the plain call, byte-compatible with every existing provider
+        fake. Fenced: journal the intent, stamp the incarnation's fence
+        token (the provider verifies it before applying), ack on
+        success. A raised provider call leaves the intent UN-acked —
+        its fate is unknown (a timeout may have landed), and the next
+        reconcile's observation resolves it idempotently."""
+        if self.fence is None:
+            node_group.set_replicas(resource.spec.replicas)
+            return
+        akey = (resource.metadata.namespace, resource.metadata.name)
+        intent = {
+            "target": resource.spec.replicas,
+            "gen": self.fence.generation,
+        }
+        self._intents[akey] = intent
+        if self._j_actuation is not None:
+            self._j_actuation.set(akey, intent)
+        node_group.set_replicas(
+            resource.spec.replicas, token=self.fence.token()
+        )
+        self._intents.pop(akey, None)
+        if self._j_actuation is not None:
+            self._j_actuation.delete(akey)
 
     def _finish_scale_down(
         self, resource, mgr, observed: int, stable: bool, message: str
@@ -203,7 +407,17 @@ class ScalableNodeGroupController:
 
     def _record_provider_failure(self, resource, breaker, err) -> None:
         opens_before = breaker.opens_total
-        breaker.record_failure(error_code(err))
+        code = error_code(err)
+        breaker.record_failure(code)
+        key = (resource.metadata.namespace, resource.metadata.name)
+        self._journal_breaker(key, breaker)
+        if self.recovery is not None:
+            from karpenter_tpu.recovery.fence import FENCE_REJECTED_CODE
+
+            if code == FENCE_REJECTED_CODE:
+                # a provider refused this incarnation's stamp: we are
+                # the stale (restarted-over / split-brain) controller
+                self.recovery.count_fence_rejection()
         if breaker.opens_total > opens_before:
             logger().warning(
                 "actuation circuit OPENED for ScalableNodeGroup %s/%s "
@@ -249,5 +463,8 @@ class ScalableNodeGroupController:
                 return
             raise
         breaker.record_success()
+        self._journal_breaker(
+            (resource.metadata.namespace, resource.metadata.name), breaker
+        )
         self._publish_circuit(resource, breaker)
         mgr.mark_true(cond.ABLE_TO_SCALE)

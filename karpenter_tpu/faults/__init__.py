@@ -10,6 +10,7 @@ from karpenter_tpu.faults.registry import (
     FaultInjected,
     FaultPlan,
     FaultRegistry,
+    ProcessCrash,
     active,
     inject,
     injected_faults,
@@ -21,6 +22,7 @@ __all__ = [
     "FaultInjected",
     "FaultPlan",
     "FaultRegistry",
+    "ProcessCrash",
     "active",
     "inject",
     "injected_faults",

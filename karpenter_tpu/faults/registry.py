@@ -15,6 +15,11 @@ hot path pays nothing), and a chaos suite installs plans against them:
               solver watchdog is expected to trip first)
   * flaky   — fail the first N matching attempts, then pass forever
               (times=N on an error plan)
+  * crash   — raise ProcessCrash, a BaseException the tick's blanket
+              `except Exception` handlers can NOT swallow: the injected
+              analog of SIGKILL. The kill-and-restart chaos suite
+              (tests/test_restart_chaos.py) catches it at harness level,
+              abandons the incarnation, and reboots from the journal.
 
 Determinism: every plan owns its own `random.Random` stream seeded from
 (registry seed, plan index), so a plan's fire/skip sequence depends only
@@ -32,6 +37,12 @@ Points instrumented across the stack (docs/resilience.md):
   metrics.query       metrics-client instant queries
   sidecar.rpc         gRPC solver client calls
   store.patch_status  controller status writes
+  process.crash.*     kill points for the restart chaos suite — target a
+                      site exactly, or the whole family via the glob:
+                      .drain (consolidation actuation), .evict
+                      (preemption mid-eviction-batch), .journal (the
+                      recovery StateJournal, which flushes a REAL torn
+                      half-record before dying)
 
 Registries also export `karpenter_faults_{attempts,injected}_total`
 {name=<point>} when given a GaugeRegistry, so a chaos run's injection
@@ -52,12 +63,20 @@ from karpenter_tpu.controllers.errors import RetryableError
 
 SUBSYSTEM = "faults"
 
-MODES = ("error", "latency", "hang", "flaky")
+MODES = ("error", "latency", "hang", "flaky", "crash")
 
 
 class FaultInjected(RetryableError):
     """The default injected error: retryable, coded, and typed so tests
     can tell an injected failure from an organic one."""
+
+
+class ProcessCrash(BaseException):
+    """The injected SIGKILL analog (mode "crash"). Deliberately NOT an
+    Exception: the reconcile engine's blanket `except Exception` must
+    not be able to absorb a simulated process death — it propagates out
+    of the tick to the test harness, which abandons the incarnation and
+    restarts from the journal."""
 
 
 @dataclass
@@ -196,6 +215,8 @@ class FaultRegistry:
         if plan.mode == "latency":
             _time.sleep(plan.latency_s)
             return
+        if plan.mode == "crash":
+            raise ProcessCrash(f"injected process crash at {point}")
         if plan.mode == "hang":
             # block until the registry releases (clear()/uninstall/exit),
             # then surface as a retryable error: the stalled caller's

@@ -89,6 +89,13 @@ class FleetForecaster:
         self.stale_max_age_s = stale_max_age_s
         self.skill_alpha = skill_alpha
         self.history = MetricHistoryStore(capacity=capacity)
+        # crash safety (karpenter_tpu/recovery): skill updates journal
+        # through this handle so the blend resumes after a restart with
+        # its earned skill — neither an optimistic reset (a forecaster
+        # that was WRONG pre-crash would immediately provision nodes
+        # again) nor a cold start (one that was RIGHT would stop
+        # helping). History appends journal via history.journal.
+        self.journal = None
         # (ns, name) -> skill EWMA in [0, 1]; optimistic start (1.0) so a
         # fresh forecaster blends until its predictions prove bad
         self._skill: Dict[tuple, float] = {}
@@ -150,7 +157,11 @@ class FleetForecaster:
         """Forget a deleted HorizontalAutoscaler (HA controller
         on_deleted hook): history, skill, pending scores, gauges."""
         self.history.prune("ha", namespace, name)
-        self._skill.pop((namespace, name), None)
+        if (
+            self._skill.pop((namespace, name), None) is not None
+            and self.journal is not None
+        ):
+            self.journal.delete(("skill", namespace, name))
         self._verdicts.pop((namespace, name), None)
         for key in [
             k for k in self._pending if k[1] == namespace and k[2] == name
@@ -159,6 +170,38 @@ class FleetForecaster:
         if self._g_skill is not None:
             self._g_skill.remove(name, namespace)
             self._g_value.remove(name, namespace)
+
+    # -- crash-safe restore/snapshot (karpenter_tpu/recovery) --------------
+
+    def snapshot_state(self) -> Dict[str, float]:
+        """Skill table for the recovery checkpoint."""
+        from karpenter_tpu.recovery.journal import key_str
+
+        return {
+            key_str(("skill",) + ha_key): value
+            for ha_key, value in self._skill.items()
+        }
+
+    def restore_state(
+        self, skill_entries: dict, history_entries: dict
+    ) -> None:
+        """Rebuild skill EWMAs and history rings from replayed journal
+        tables: the forecast blend resumes where the crashed
+        incarnation left it — earned skill, warm series — instead of a
+        cold start."""
+        from karpenter_tpu.recovery.journal import key_tuple
+
+        for k, value in skill_entries.items():
+            key = key_tuple(k)  # ("skill", ns, name)
+            self._skill[(key[1], key[2])] = float(value)
+        for k, samples in history_entries.items():
+            self.history.restore_ring(key_tuple(k), samples)
+        if skill_entries or history_entries:
+            logger().info(
+                "forecast: restored %d skill entr(ies) and %d history "
+                "series from the journal",
+                len(skill_entries), len(history_entries),
+            )
 
     # -- the per-tick pass -------------------------------------------------
 
@@ -292,6 +335,8 @@ class FleetForecaster:
         self._skill[ha_key] = (
             (1.0 - self.skill_alpha) * prev + self.skill_alpha * sample
         )
+        if self.journal is not None:
+            self.journal.set(("skill",) + ha_key, self._skill[ha_key])
 
     def _predict(
         self, rows, eligible: List[tuple], now: float

@@ -79,6 +79,12 @@ class MetricHistoryStore:
         self._rings: Dict[tuple, _Ring] = {}
         self._touched: Dict[tuple, float] = {}  # key -> last append time
         self._lock = threading.Lock()
+        # crash safety (karpenter_tpu/recovery): a JournalHandle
+        # recording appends (bounded by the ring capacity — the journal
+        # fold keeps only the newest `cap` samples per key), so forecast
+        # history survives a controller restart instead of cold-starting
+        # every series
+        self.journal = None
 
     def __len__(self) -> int:
         with self._lock:
@@ -97,6 +103,10 @@ class MetricHistoryStore:
                     self._evict_oldest_locked()
             ring.append(float(t), float(value))
             self._touched[key] = float(t)
+        if self.journal is not None:
+            self.journal.append_sample(
+                key, float(t), float(value), cap=self.capacity
+            )
 
     def _evict_oldest_locked(self) -> None:
         victim = min(self._touched, key=self._touched.get, default=None)
@@ -156,7 +166,44 @@ class MetricHistoryStore:
             for k in victims:
                 del self._rings[k]
                 self._touched.pop(k, None)
-            return len(victims)
+        if self.journal is not None:
+            for k in victims:
+                self.journal.delete(k)
+        return len(victims)
+
+    # -- crash-safe snapshot/restore (karpenter_tpu/recovery) --------------
+
+    def snapshot_rings(self) -> Dict[str, list]:
+        """Columnar checkpoint of every ring: {key_str: [[t, v], ...]}
+        in chronological order — the recovery checkpoint format (the
+        journal fold produces the same shape from appends)."""
+        from karpenter_tpu.recovery.journal import key_str
+
+        with self._lock:
+            items = [
+                (key, ring.chronological())
+                for key, ring in self._rings.items()
+            ]
+        return {
+            key_str(key): [
+                [float(t), float(v)] for t, v in zip(ts, vs)
+            ]
+            for key, (ts, vs) in items
+        }
+
+    def restore_ring(self, key: tuple, samples: list) -> None:
+        """Rebuild one series from replayed [t, value] samples WITHOUT
+        re-journaling them (the caller just read them from the journal)."""
+        if not samples:
+            return
+        with self._lock:
+            ring = _Ring(self.capacity)
+            for t, v in samples[-self.capacity:]:
+                ring.append(float(t), float(v))
+            self._rings[key] = ring
+            self._touched[key] = float(samples[-1][0])
+            if len(self._rings) > self.max_series:
+                self._evict_oldest_locked()
 
     # -- batched snapshot --------------------------------------------------
 

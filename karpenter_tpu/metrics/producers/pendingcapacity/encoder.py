@@ -567,6 +567,16 @@ class SnapshotDeltaCache:
         self.deltas = 0
         self.fulls = 0
 
+    def reset(self) -> None:
+        """Recovery-boot seam (docs/resilience.md "Crash recovery"):
+        drop every cached entry. The delta layer's fast path returns the
+        SAME BinPackInputs OBJECT for an unchanged dedup set — an
+        identity contract downstream device-residency caches key on —
+        so after a crash-recovery boot the pre-crash entries must not be
+        splice sources: the next encode of each key is a full pass."""
+        with self._lock:
+            self._entries.clear()
+
     def encode(self, snap, profiles, with_rows: bool = False, census=None):
         if (
             with_rows
@@ -746,6 +756,12 @@ class SnapshotDeltaCache:
 
 
 _default_delta = SnapshotDeltaCache()
+
+
+def reset_delta_cache() -> None:
+    """Invalidate the process-default SnapshotDeltaCache (the recovery
+    boot calls this — see SnapshotDeltaCache.reset)."""
+    _default_delta.reset()
 
 
 def _encode_from_cache(snap, profiles, with_rows: bool = False, census=None):

@@ -53,6 +53,7 @@ from karpenter_tpu.consolidation.planner import (
     cluster_view,
     discover_groups,
 )
+from karpenter_tpu.faults import inject
 from karpenter_tpu.metrics.registry import GaugeRegistry, default_registry
 from karpenter_tpu.preemption import planner as P
 from karpenter_tpu.store.columnar import is_pending
@@ -111,6 +112,13 @@ class PreemptionEngine:
         self.store = store
         self.service = solver_service
         self.consolidation = consolidation
+        # crash safety (karpenter_tpu/recovery, docs/resilience.md):
+        # holds and budget charges journal through `journal` so a
+        # restarted controller keeps honoring disruption budgets spent
+        # before the crash; `disruption_gate` is the recovery warm-up —
+        # no eviction planning while it returns False
+        self.journal = None
+        self.disruption_gate = None
         self.config = config or PreemptionConfig()
         self.registry = (
             registry if registry is not None else default_registry()
@@ -210,6 +218,11 @@ class PreemptionEngine:
         Returns {(namespace, name): accepted plan or None} per candidate
         for observability/tests."""
         now = self.clock() if now is None else now
+        if self.disruption_gate is not None and not self.disruption_gate():
+            # recovery warm-up: no eviction until fleet state is
+            # confirmed; _last_plan stays unset so the first
+            # post-warm-up reconcile plans immediately
+            return {}
         self._last_plan = now
         self._expire_charges(now)
         candidates = self._candidates()
@@ -281,11 +294,97 @@ class PreemptionEngine:
     def _charge(
         self, group: Optional[tuple], count: int, now: float, node: str
     ) -> None:
-        self._charges.setdefault(
-            self._budget_key(group, node), []
-        ).append(
+        bkey = self._budget_key(group, node)
+        self._charges.setdefault(bkey, []).append(
             _Charge(expires=now + self.config.hold_s, evictions=count)
         )
+        self._journal_charges(bkey)
+
+    # -- crash-safe journal (karpenter_tpu/recovery) -----------------------
+
+    def _journal_charges(self, bkey: Tuple[str, str]) -> None:
+        if self.journal is None:
+            return
+        live = self._charges.get(bkey, [])
+        if live:
+            self.journal.set(
+                ("charge",) + bkey,
+                [[c.expires, c.evictions] for c in live],
+            )
+        else:
+            self.journal.delete(("charge",) + bkey)
+
+    def _journal_hold(self, node: str, expires: Optional[float]) -> None:
+        if self.journal is None:
+            return
+        if expires is None:
+            self.journal.delete(("hold", node))
+        else:
+            self.journal.set(("hold", node), expires)
+
+    def _journal_candidate_hold(self, key: Tuple[str, str]) -> None:
+        if self.journal is not None:
+            self.journal.set(
+                ("cand",) + key, self._candidate_holds[key]
+            )
+
+    def snapshot_state(self) -> Dict[str, object]:
+        """Full holds/charges table for the recovery checkpoint (the
+        layout the journal folds to)."""
+        from karpenter_tpu.recovery.journal import key_str
+
+        state: Dict[str, object] = {}
+        for node, exp in self._holds.items():
+            state[key_str(("hold", node))] = exp
+        for ckey, exp in self._candidate_holds.items():
+            state[key_str(("cand",) + ckey)] = exp
+        for bkey, charges in self._charges.items():
+            if charges:
+                state[key_str(("charge",) + bkey)] = [
+                    [c.expires, c.evictions] for c in charges
+                ]
+        return state
+
+    def restore_state(self, entries: dict, now: Optional[float] = None) -> None:
+        """Rebuild holds and budget charges from a replayed journal
+        table: disruption spent before the crash stays spent, so a
+        restart cannot double an eviction budget. Expired entries are
+        dropped; surviving expiries are capped at now + hold_s (a
+        skewed stamp must not hold a node hostage past one window)."""
+        from karpenter_tpu.recovery.journal import key_tuple
+
+        now = self.clock() if now is None else now
+        cap = now + self.config.hold_s
+        restored = 0
+        for k, v in entries.items():
+            restored += self._restore_entry(key_tuple(k), v, now, cap)
+        if restored:
+            logger().info(
+                "preemption: restored %d hold/budget entr(ies) from "
+                "the journal", restored,
+            )
+
+    def _restore_entry(self, key, v, now: float, cap: float) -> int:
+        if key[0] == "hold":
+            exp = min(float(v), cap)
+            if exp > now:
+                self._holds[key[1]] = exp
+                return 1
+        elif key[0] == "cand":
+            exp = min(float(v), cap)
+            if exp > now:
+                self._candidate_holds[(key[1], key[2])] = exp
+                return 1
+        elif key[0] == "charge":
+            live = [
+                _Charge(expires=min(float(e), cap), evictions=int(n))
+                for e, n in v
+                if min(float(e), cap) > now
+            ]
+            if live:
+                self._charges[(key[1], key[2])] = live
+                return 1
+        return 0
 
     def _resolve_and_actuate(
         self, view, candidates, plans, now: float
@@ -325,18 +424,43 @@ class PreemptionEngine:
                 )
                 results[key] = None
                 continue
-            evicted = self._actuate(plan)
+            evicted = self._actuate_with_charge(plan, group, node, now)
             if not evicted:
                 results[key] = None
                 continue
             claimed_nodes.add(node)
             claimed_victims.update(plan["evictions"])
-            self._holds[node] = now + self.config.hold_s
-            self._charge(group, len(evicted), now, node)
             results[key] = self._finish_accepted(
                 key, node, plan, evicted, now
             )
         return results
+
+    def _actuate_with_charge(
+        self, plan: dict, group, node: str, now: float
+    ) -> List[tuple]:
+        """WRITE-AHEAD actuation: the hold and the FULL plan's budget
+        charge journal BEFORE any eviction lands, so a crash mid-batch
+        restores with the disruption already charged — a restarted
+        controller can never spend a budget twice. What actually
+        happened is reconciled after actuation: zero evictions releases
+        the charge and hold, a partial set adjusts the charge down to
+        the evictions that landed."""
+        self._holds[node] = now + self.config.hold_s
+        self._journal_hold(node, self._holds[node])
+        self._charge(group, len(plan["evictions"]), now, node)
+        evicted = self._actuate(plan)
+        bkey = self._budget_key(group, node)
+        if not evicted:
+            self._charges[bkey].pop()
+            if not self._charges[bkey]:
+                del self._charges[bkey]
+            self._journal_charges(bkey)
+            self._holds.pop(node, None)
+            self._journal_hold(node, None)
+        elif len(evicted) < len(plan["evictions"]):
+            self._charges[bkey][-1].evictions = len(evicted)
+            self._journal_charges(bkey)
+        return evicted
 
     def _finish_accepted(
         self, key, node: str, plan: dict, evicted: List[tuple],
@@ -358,6 +482,7 @@ class PreemptionEngine:
             )
             return None
         self._candidate_holds[key] = now + self.config.hold_s
+        self._journal_candidate_hold(key)
         self._c_plans.inc("-", "-")
         logger().info(
             "preemption: evicted %d pod(s) from %s to admit %s/%s",
@@ -372,7 +497,10 @@ class PreemptionEngine:
         a store conflict vetoes just that victim and the plan reports
         what it actually evicted."""
         evicted = []
-        for namespace, name in plan["evictions"]:
+        for i, (namespace, name) in enumerate(plan["evictions"]):
+            if i:
+                # the mid-eviction-batch kill point
+                inject("process.crash.evict")
             pod = self.store.try_get("Pod", namespace, name)
             if pod is None or not pod.spec.node_name:
                 continue  # already gone or already unbound

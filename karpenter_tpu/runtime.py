@@ -83,6 +83,16 @@ class Options:
     # fleet default priority for pods naming an unknown PriorityClass
     # (--default-priority): feeds the census encoder AND the engines
     default_pod_priority: int = 0
+    # crash-safe controller state (karpenter_tpu/recovery,
+    # docs/resilience.md "Crash recovery"): directory for the
+    # protective-state journal + checkpoints + fence generation. None =
+    # ephemeral (a restart cold-starts FSMs/holds/budgets/backoff/
+    # forecast state and actuation is unfenced — the pre-PR-7 posture).
+    journal_dir: Optional[str] = None
+    # full manager ticks a RECOVERED boot holds the warm-up: no
+    # consolidation or preemption planning until this many reconcile
+    # passes have confirmed fleet state (first boots skip it)
+    recovery_warmup_ticks: int = 1
 
 
 class KarpenterRuntime:
@@ -100,13 +110,14 @@ class KarpenterRuntime:
         self.options = options
         self.clock = clock or _time.time
         self._owns_store = store is None
-        if store is not None:
-            self.store = store
-        else:
-            from karpenter_tpu.store.persistence import open_store
-
-            self.store = open_store(options.data_dir)
+        self.store = store if store is not None else self._open_store(options)
         self.registry = registry if registry is not None else GaugeRegistry()
+
+        # crash-safe state subsystem (karpenter_tpu/recovery): built
+        # FIRST — it claims the fence generation durably before anything
+        # can actuate, and replays the protective-state journal the
+        # subsystems below restore from
+        self.recovery = self._build_recovery(options)
 
         self.cloud_provider = (
             cloud_provider_factory
@@ -115,16 +126,8 @@ class KarpenterRuntime:
                 CloudOptions(store=self.store), provider=options.cloud_provider
             )
         )
-        self.solver_client = None
-        device_solver = decider = None
-        if options.solver_uri:
-            from karpenter_tpu.sidecar.client import SolverClient
-
-            self.solver_client = SolverClient(options.solver_uri)
-            # the decision kernel rides the same split: with a sidecar
-            # configured the control-plane process runs NO device math
-            device_solver = self.solver_client.solve
-            decider = self.solver_client.decide
+        self._seed_fence_validator()
+        device_solver, decider = self._build_solver_client(options)
         # ALL bin-pack callers route through the shared solve service
         # (solver/service.py): coalescing, shape-bucketed compile cache,
         # backpressure + numpy fallback, and a metrics surface in THIS
@@ -144,6 +147,7 @@ class KarpenterRuntime:
             health_probe_interval_s=options.solver_probe_interval_s,
             watchdog_timeout_s=options.solver_watchdog_timeout_s,
         )
+        self._reset_caches_for_recovery()
         self.producer_factory = ProducerFactory(
             self.store, self.cloud_provider, registry=self.registry,
             solver=self.solver_service.solve,
@@ -162,6 +166,7 @@ class KarpenterRuntime:
             capacity=options.forecast_history,
             stale_max_age_s=options.stale_metric_max_age_s,
         )
+        self._attach_recovery_forecast()
         self.metrics_clients = MetricsClientFactory(
             registry=self.registry, prometheus_uri=options.prometheus_uri,
             observer=self.forecaster.observe_query,
@@ -184,6 +189,9 @@ class KarpenterRuntime:
                 solver_service=self.solver_service,
                 registry=self.registry,
                 clock=self.clock,
+            )
+            self._attach_recovery_engine(
+                "consolidation", self.consolidation
             )
         # preemption engine (opt-in): batched eviction planning through
         # SolverService.preempt, actuating budgeted evictions through
@@ -208,6 +216,7 @@ class KarpenterRuntime:
                 ),
                 clock=self.clock,
             )
+            self._attach_recovery_engine("preemption", self.preemption)
             if self.consolidation is not None:
                 self.consolidation.node_guard = (
                     self.preemption.active_nodes
@@ -217,30 +226,154 @@ class KarpenterRuntime:
         # autoscaler decides — one tick moves a signal end to end (the
         # reference's produce→scrape→poll chain costs up to 20s of interval
         # latency; SURVEY.md §6).
+        tick_hook = backoff_journal = None
+        if self.recovery is not None:
+            tick_hook = self.recovery.on_tick
+            backoff_journal = self.recovery.handle("backoff")
+        self._sng_controller = ScalableNodeGroupController(
+            self.cloud_provider, consolidator=self.consolidation,
+            preemptor=self.preemption,
+            registry=self.registry,
+            circuit_failure_threshold=options.circuit_failure_threshold,
+            circuit_reset_s=options.circuit_reset_s,
+            clock=self.clock,
+            recovery=self.recovery,
+        )
         self.manager = Manager(
             self.store, clock=self.clock, registry=self.registry,
             solver_service=self.solver_service,
             backoff_base_s=options.backoff_base_s,
             backoff_cap_s=options.backoff_cap_s,
+            tick_hook=tick_hook,
+            recovery_journal=backoff_journal,
         ).register(
             MetricsProducerController(self.producer_factory),
-            ScalableNodeGroupController(
-                self.cloud_provider, consolidator=self.consolidation,
-                preemptor=self.preemption,
-                registry=self.registry,
-                circuit_failure_threshold=options.circuit_failure_threshold,
-                circuit_reset_s=options.circuit_reset_s,
-                clock=self.clock,
-            ),
+            self._sng_controller,
             HorizontalAutoscalerController(
                 self.batch_autoscaler, solver_service=self.solver_service
             ),
         )
+        self._finish_recovery_boot()
+
+    @staticmethod
+    def _open_store(options: Options):
+        from karpenter_tpu.store.persistence import open_store
+
+        return open_store(options.data_dir)
+
+    def _build_solver_client(self, options: Options):
+        """(device_solver, decider) seams for the gRPC process split:
+        with a sidecar configured the control-plane process runs NO
+        device math — the decision kernel rides the same split."""
+        self.solver_client = None
+        if not options.solver_uri:
+            return None, None
+        from karpenter_tpu.sidecar.client import SolverClient
+
+        self.solver_client = SolverClient(options.solver_uri)
+        return self.solver_client.solve, self.solver_client.decide
+
+    def _build_recovery(self, options: Options):
+        if not options.journal_dir:
+            return None
+        from karpenter_tpu.recovery import RecoveryManager
+
+        return RecoveryManager(
+            options.journal_dir,
+            registry=self.registry,
+            clock=self.clock,
+            warmup_ticks=options.recovery_warmup_ticks,
+        )
+
+    def _seed_fence_validator(self) -> None:
+        """Raise the provider's fence floor to this incarnation's
+        generation at boot: a stale (restarted-over) incarnation is
+        rejected even before our first actuation, and a provider
+        factory freshly constructed by a restarted process does not
+        start with an empty memory of generations."""
+        if self.recovery is None:
+            return
+        validator = getattr(self.cloud_provider, "fence_validator", None)
+        if validator is not None:
+            validator.observe(self.recovery.fence.generation)
+
+    def _reset_caches_for_recovery(self) -> None:
+        """Recovery boot: identity-keyed PROCESS-LEVEL caches must
+        rebuild cold — stale pre-crash entries (the encoder delta
+        layer's same-object fast path, compiled-program keys) must not
+        be silently reused against post-restart state. This runtime's
+        OWN SolverService is freshly constructed (already cold); the
+        state that actually survives an in-process restart is the
+        module-global encoder delta cache and the process-default
+        solver service (simulate/sidecar embedders share it across
+        runtime incarnations)."""
+        if self.recovery is None or not self.recovery.recovered:
+            return
+        from karpenter_tpu.metrics.producers.pendingcapacity import (
+            encoder as _encoder,
+        )
+        from karpenter_tpu.solver.service import (
+            reset_default_service_caches,
+        )
+
+        _encoder.reset_delta_cache()
+        reset_default_service_caches()
+
+    def _attach_recovery_forecast(self) -> None:
+        """Forecast state journals (skill EWMAs as sets, history as
+        bounded ring appends; the checkpoint stores rings columnar) and
+        restores here, so the blend resumes with its earned skill
+        instead of a cold start."""
+        if self.recovery is None:
+            return
+        self.forecaster.journal = self.recovery.handle("forecast")
+        self.forecaster.history.journal = self.recovery.handle("history")
+        self.forecaster.restore_state(
+            self.recovery.table("forecast"),
+            self.recovery.table("history"),
+        )
+        self.recovery.register_snapshot(
+            "forecast", self.forecaster.snapshot_state
+        )
+        self.recovery.register_snapshot(
+            "history", self.forecaster.history.snapshot_rings
+        )
+
+    def _attach_recovery_engine(self, sub: str, engine) -> None:
+        """Disruption-engine crash safety: FSM transitions / holds /
+        budget charges journal WRITE-AHEAD of the effects they cover, a
+        restarted controller restores them (resuming phases instead of
+        re-planning disruption), and no planning happens until the
+        recovery warm-up confirms fleet state."""
+        if self.recovery is None:
+            return
+        engine.journal = self.recovery.handle(sub)
+        engine.disruption_gate = self.recovery.allow_disruption
+        engine.restore_state(self.recovery.table(sub))
+        self.recovery.register_snapshot(sub, engine.snapshot_state)
+
+    def _finish_recovery_boot(self) -> None:
+        """Restore the requeue-backoff ladder (restored due times are
+        capped at now + backoff cap) and compact the journal: every
+        boot re-bounds it, so a restart storm cannot grow it."""
+        if self.recovery is None:
+            return
+        self.manager.restore_backoff(self.recovery.table("backoff"))
+        self.recovery.register_snapshot(
+            "backoff", self.manager.snapshot_backoff
+        )
+        # drop restored breaker/intent state for groups deleted while
+        # we were down — no Deleted event will ever fire for them
+        self._sng_controller.prune_restored_missing(self.store)
+        self.recovery.finish_boot()
 
     def run(self, duration: float) -> None:
         self.manager.run(duration)
 
     def close(self) -> None:
+        if self.recovery is not None:
+            self.recovery.close()
+            self.recovery = None
         if self.solver_service is not None:
             self.solver_service.close()
         if self.solver_client is not None:

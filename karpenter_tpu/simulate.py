@@ -847,6 +847,267 @@ def simulate_preempt(  # lint: allow-complexity — scenario assembly: storm + r
     }
 
 
+def simulate_restart_storm(  # lint: allow-complexity — scenario assembly: crash/reboot cycles + convergence + report
+    nodes: int = 5,
+    crashes: int = 3,
+    seed: int = 0,
+    journal_dir: Optional[str] = None,
+    warmup_ticks: int = 1,
+) -> dict:
+    """Seeded restart-storm replay (docs/resilience.md "Crash
+    recovery"): a consolidating fleet is repeatedly SIGKILLed
+    mid-drain — alternating (seeded) between a kill after the replica
+    decrement landed and a kill inside actuation before it — and
+    rebooted from the protective-state journal each time. The report
+    pins the crash-safety contract end to end: every completed drain
+    actuated EXACTLY once across all incarnations (no duplicate cloud
+    writes), restored nodes resumed their FSM phase instead of being
+    re-cordoned, the fence generation climbed once per boot, and a
+    stale-incarnation replay probe at the end was fence-rejected
+    instead of applied. Self-contained: own in-memory store, fake
+    provider, fake clock, and (by default) a temporary journal dir."""
+    import shutil
+    import tempfile
+
+    from karpenter_tpu.api.core import (
+        Container,
+        Node,
+        NodeCondition,
+        NodeSpec,
+        NodeStatus,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+    )
+    from karpenter_tpu.api.metricsproducer import (
+        MetricsProducer,
+        MetricsProducerSpec,
+        PendingCapacitySpec,
+    )
+    from karpenter_tpu.api.scalablenodegroup import (
+        ScalableNodeGroup,
+        ScalableNodeGroupSpec,
+    )
+    from karpenter_tpu.cloudprovider.fake import FakeFactory, FakeNodeGroup
+    from karpenter_tpu.faults import (
+        FaultRegistry,
+        ProcessCrash,
+        install,
+        uninstall,
+    )
+    from karpenter_tpu.runtime import KarpenterRuntime, Options
+    from karpenter_tpu.store import Store
+    from karpenter_tpu.utils.quantity import Quantity
+
+    rng = np.random.RandomState(seed)
+    own_dir = journal_dir is None
+    journal_dir = journal_dir or tempfile.mkdtemp(prefix="karpenter-storm-")
+
+    class _RecordingGroup(FakeNodeGroup):
+        def set_replicas(self, count, token=None):
+            super().set_replicas(count, token=token)
+            self._factory.actuations.append((self._id, count))
+
+    class _RecordingFactory(FakeFactory):
+        def __init__(self):
+            super().__init__()
+            self.actuations = []
+
+        def node_group_for(self, spec):
+            return _RecordingGroup(self, spec.id)
+
+    q = Quantity.parse
+    store = Store()
+    provider = _RecordingFactory()
+    provider.node_replicas["grp-id"] = nodes
+    clock = {"now": 1_000_000.0}
+    store.create(
+        MetricsProducer(
+            metadata=ObjectMeta(name="pc"),
+            spec=MetricsProducerSpec(
+                pending_capacity=PendingCapacitySpec(
+                    node_selector={"pool": "a"}, node_group_ref="grp"
+                )
+            ),
+        )
+    )
+    store.create(
+        ScalableNodeGroup(
+            metadata=ObjectMeta(name="grp"),
+            spec=ScalableNodeGroupSpec(
+                replicas=nodes, type="FakeNodeGroup", id="grp-id"
+            ),
+        )
+    )
+    for i in range(nodes):
+        store.create(
+            Node(
+                metadata=ObjectMeta(name=f"n{i}", labels={"pool": "a"}),
+                spec=NodeSpec(),
+                status=NodeStatus(
+                    allocatable={
+                        "cpu": q("8"), "memory": q("16Gi"),
+                        "pods": q("16"),
+                    },
+                    conditions=[NodeCondition("Ready", "True")],
+                ),
+            )
+        )
+    store.create(  # one bound pod anchors n0: only empty nodes drain
+        Pod(
+            metadata=ObjectMeta(name="p0"),
+            spec=PodSpec(
+                node_name="n0",
+                containers=[Container(requests={"cpu": q("1")})],
+            ),
+        )
+    )
+
+    def boot():
+        return KarpenterRuntime(
+            Options(
+                consolidate=True,
+                journal_dir=journal_dir,
+                recovery_warmup_ticks=warmup_ticks,
+            ),
+            store=store,
+            cloud_provider_factory=provider,
+            clock=lambda: clock["now"],
+        )
+
+    def kill(rt):  # SIGKILL analog: no graceful checkpoint
+        rt.solver_service.close()
+        rt.recovery.journal.close()
+
+    def tick(rt, advance=61.0):
+        clock["now"] += advance
+        rt.manager._due = {k: 0.0 for k in rt.manager._due}
+        rt.manager.reconcile_all()
+
+    cordons_planned = 0  # across ALL incarnations (re-cordon detector)
+    crash_sites = []
+    rt = boot()
+    try:
+        for crash in range(crashes):
+            engine = rt.consolidation
+            engine.plan(clock["now"])  # first sight starts churn clocks
+            clock["now"] += engine.config.cooldown_s + 1
+            engine.plan(clock["now"])
+            clock["now"] += engine.config.verify_s + 1
+            site = rng.choice(["after-decrement", "mid-actuate"])
+            crash_sites.append(str(site))
+            if site == "mid-actuate":
+                install(FaultRegistry(seed=seed + crash))
+                from karpenter_tpu.faults import active
+
+                active().plan(
+                    "process.crash.drain", mode="crash", times=1
+                )
+                try:
+                    engine.plan(clock["now"])
+                except ProcessCrash:
+                    pass
+                uninstall()
+            else:
+                engine.plan(clock["now"])  # decrement lands, then "die"
+            cordons_planned += int(
+                rt.registry.gauge(
+                    "consolidation", "drains_planned_total"
+                ).get("-", "-")
+                or 0
+            )
+            kill(rt)
+            rt = boot()
+            # drain the FULL warm-up (however many ticks were asked
+            # for) so the next cycle's planning is actually admitted
+            for _ in range(max(1, warmup_ticks)):
+                tick(rt)
+            if site == "mid-actuate":
+                # the decrement never landed: the restored DRAINING
+                # entry times out and the node returns to service
+                clock["now"] += rt.consolidation.config.drain_timeout_s + 1
+            tick(rt)
+        # run the final incarnation clean to convergence: every empty
+        # node drains, only the pod's node remains
+        for _ in range(8 * nodes):
+            if provider.node_replicas["grp-id"] <= 1:
+                break
+            engine = rt.consolidation
+            clock["now"] += engine.config.cooldown_s + 1
+            engine.plan(clock["now"])
+            clock["now"] += engine.config.verify_s + 1
+            engine.plan(clock["now"])
+            tick(rt)
+        cordons_planned += int(
+            rt.registry.gauge(
+                "consolidation", "drains_planned_total"
+            ).get("-", "-")
+            or 0
+        )
+
+        drains_completed = nodes - provider.node_replicas["grp-id"]
+
+        # stale-incarnation probe: a NEW incarnation boots (bumping the
+        # fence) and actuates a fresh decision; then the prior
+        # incarnation — now a split-brain zombie — replays a dead one.
+        # The provider must fence-reject the stale stamp, not apply it.
+        successor = boot()  # `rt` is now the stale incarnation
+        fresh = store.get("ScalableNodeGroup", "default", "grp")
+        fresh.spec.replicas = provider.node_replicas["grp-id"] + 1
+        store.update(fresh)
+        tick(successor)  # the successor's write records its generation
+        replicas_after_successor = provider.node_replicas["grp-id"]
+        stale_ctrl = rt.manager._controllers[1]
+        probe = store.get("ScalableNodeGroup", "default", "grp")
+        probe.spec.replicas = nodes  # a long-dead scale-up decision
+        try:
+            stale_ctrl.reconcile(probe)
+        except Exception:  # noqa: BLE001 — the rejection surfaces as a
+            pass  # reconcile failure; the provider state is the proof
+        stale_applied = (
+            provider.node_replicas["grp-id"] != replicas_after_successor
+        )
+        fence_generation = successor.recovery.fence.generation
+        successor.close()
+        return {
+            "config": {
+                "nodes": nodes,
+                "crashes": crashes,
+                "seed": seed,
+                "warmup_ticks": warmup_ticks,
+            },
+            "crash_sites": crash_sites,
+            "restarts": crashes + 1,
+            "fence_generation": fence_generation,
+            "fence_rejections": provider.fence_validator.rejections,
+            "stale_replay_applied": stale_applied,
+            "actuations": list(provider.actuations),
+            # a duplicate is the SAME (group, count) write landing again
+            # with no other transition in between — a replayed decision,
+            # not a later legitimate return to a previous size
+            "duplicate_actuations": sum(
+                1
+                for a, b in zip(
+                    provider.actuations, provider.actuations[1:]
+                )
+                if a == b
+            ),
+            "drains_completed": drains_completed,
+            "cordons_planned": cordons_planned,
+            "resumed_not_recordoned": cordons_planned == drains_completed
+            + sum(1 for s in crash_sites if s == "mid-actuate"),
+            "final_replicas": provider.node_replicas["grp-id"],
+            "nodes_remaining": sorted(
+                n.metadata.name for n in store.list("Node")
+            ),
+        }
+    finally:
+        with __import__("contextlib").suppress(Exception):
+            rt.close()
+        if own_dir:
+            shutil.rmtree(journal_dir, ignore_errors=True)
+
+
 def simulate_delta(
     store, what_if_groups: List[dict], solver=None, template_resolver=None
 ) -> dict:

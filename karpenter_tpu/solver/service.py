@@ -416,6 +416,20 @@ class SolverService:
         with self._cond:
             return len(self._queue)
 
+    def reset_caches(self) -> None:
+        """Recovery-boot seam (docs/resilience.md "Crash recovery"):
+        drop the compiled-program cache and the compile-seen keys so
+        post-restart dispatches rebuild from scratch. Identity-keyed
+        device caches (the encoder's delta layer hands back the SAME
+        inputs object to skip re-upload) are only sound within one
+        process lifetime of consistent state — a recovery boot must not
+        silently reuse pre-crash arrays. Fresh dict/set objects are
+        swapped in whole, so a worker mid-lookup keeps a consistent
+        (old) view and the next lookup sees the reset."""
+        with self._cond:
+            self._compiled = {}
+            self._compile_seen = set()
+
     def stage_percentiles(self) -> Dict[str, Dict[str, float]]:
         """{stage: {"p50_ms", "p99_ms", "n"}} over the retained latency
         rings — the per-stage breakdown bench.py --hotpath publishes."""
@@ -1703,3 +1717,13 @@ def reset_default_service() -> None:
         if _default_service is not None:
             _default_service.close()
             _default_service = None
+
+
+def reset_default_service_caches() -> None:
+    """Invalidate the process-default service's compile caches WITHOUT
+    closing it — the recovery-boot seam for the one solver instance
+    that can genuinely outlive an in-process controller restart
+    (simulate/sidecar embedders share it across runtime incarnations)."""
+    with _default_lock:
+        if _default_service is not None:
+            _default_service.reset_caches()

@@ -1,0 +1,643 @@
+"""Crash-safe controller state: unit + property layer.
+
+Pins the ISSUE 7 satellite contracts for karpenter_tpu/recovery:
+
+  * journal replay is IDEMPOTENT (replaying the same journal twice from
+    the same checkpoint yields identical state) and checkpoint + journal
+    tail == journal-only, at every split point — property-pinned over
+    seeded random op streams;
+  * a torn final record (crash mid-append) is discarded and the file
+    repaired to a record boundary;
+  * the actuation fence: monotonic generations across incarnations on
+    one journal dir, provider-side rejection of superseded stamps;
+  * DecorrelatedJitterBackoff state restores from the journal with
+    restored due-times capped at now + cap (a long-dead object is never
+    stuck parked);
+  * circuit-breaker state restores (a provider flapping before the
+    crash is still circuit-broken after it);
+  * the recovery-boot cache invalidation seams: SolverService
+    .reset_caches() and SnapshotDeltaCache.reset();
+  * warm-up semantics: a RECOVERED boot holds disruption until the
+    configured ticks complete; first boots skip the warm-up.
+
+`make test-recovery` runs this file + tests/test_restart_chaos.py.
+"""
+
+import os
+import random
+
+import pytest
+
+from karpenter_tpu import faults
+from karpenter_tpu.faults import FaultRegistry, ProcessCrash
+from karpenter_tpu.recovery import (
+    ActuationFence,
+    FenceRejectedError,
+    FenceToken,
+    FenceValidator,
+    RecoveryManager,
+    StateJournal,
+    key_str,
+    key_tuple,
+    replay,
+)
+from karpenter_tpu.recovery.journal import apply_record
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_registry():
+    yield
+    faults.uninstall()
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# journal basics
+# ---------------------------------------------------------------------------
+
+
+class TestJournal:
+    def test_append_recover_roundtrip(self, tmp_path):
+        j = StateJournal(str(tmp_path))
+        h = j.handle("demo")
+        h.set(("node", "n1"), {"phase": "cordoned"})
+        h.set(("node", "n2"), {"phase": "draining"})
+        h.delete(("node", "n1"))
+        h.append_sample(("ring", "a"), 1.0, 2.0, cap=3)
+        j.close()
+
+        j2 = StateJournal(str(tmp_path))
+        checkpoint, records = j2.recover()
+        state = replay(checkpoint, records)
+        assert state["demo"] == {
+            key_str(("node", "n2")): {"phase": "draining"},
+            key_str(("ring", "a")): [[1.0, 2.0]],
+        }
+        j2.close()
+
+    def test_ring_appends_bounded_by_cap(self, tmp_path):
+        j = StateJournal(str(tmp_path))
+        h = j.handle("history")
+        for i in range(10):
+            h.append_sample(("s",), float(i), float(i), cap=4)
+        checkpoint, records = j.recover()
+        state = replay(checkpoint, records)
+        ring = state["history"][key_str(("s",))]
+        assert ring == [[float(i), float(i)] for i in range(6, 10)]
+        j.close()
+
+    def test_torn_final_record_discarded_and_repaired(self, tmp_path):
+        j = StateJournal(str(tmp_path))
+        h = j.handle("demo")
+        h.set(("a",), 1)
+        h.set(("b",), 2)
+        j.close()
+        path = os.path.join(str(tmp_path), "state-journal.jsonl")
+        with open(path, "a", encoding="utf-8") as f:
+            f.write('{"sub": "demo", "op": "set", "k"')  # torn tail
+
+        j2 = StateJournal(str(tmp_path))
+        checkpoint, records = j2.recover()
+        assert len(records) == 2
+        state = replay(checkpoint, records)
+        assert state["demo"] == {key_str(("a",)): 1, key_str(("b",)): 2}
+        # the fragment is gone: appends resume on a record boundary
+        j2.handle("demo").set(("c",), 3)
+        j2.close()
+        j3 = StateJournal(str(tmp_path))
+        _, records = j3.recover()
+        assert replay(None, records)["demo"][key_str(("c",))] == 3
+        j3.close()
+
+    def test_compaction_bounds_journal(self, tmp_path):
+        j = StateJournal(
+            str(tmp_path), compact_every=8, compact_min_interval_s=0.0
+        )
+        table = {}
+
+        def provider():
+            return {"demo": dict(table)}
+
+        j.checkpoint_provider = provider
+        h = j.handle("demo")
+        for i in range(50):
+            table[key_str(("k", i % 4))] = i
+            h.set(("k", i % 4), i)
+        # the journal truncated at least once: far fewer live records
+        # than appends, and recovery still yields the full table
+        checkpoint, records = j.recover()
+        assert len(records) < 8
+        assert replay(checkpoint, records)["demo"] == table
+        j.close()
+
+    def test_append_never_raises_after_close(self, tmp_path):
+        j = StateJournal(str(tmp_path))
+        j.close()
+        j.handle("demo").set(("a",), 1)  # crashed incarnation: no-op
+
+    def test_crash_fault_leaves_recoverable_torn_record(self, tmp_path):
+        """The process.crash injection point inside append flushes a
+        REAL half-record before dying; recovery discards it and keeps
+        everything before."""
+        j = StateJournal(str(tmp_path))
+        h = j.handle("demo")
+        h.set(("a",), {"value": 1})
+        with FaultRegistry(seed=1) as reg:
+            reg.plan("process.crash.journal", mode="crash", times=1)
+            with pytest.raises(ProcessCrash):
+                h.set(("b",), {"value": 2})
+        j.close()
+        j2 = StateJournal(str(tmp_path))
+        checkpoint, records = j2.recover()
+        state = replay(checkpoint, records)
+        assert state["demo"] == {key_str(("a",)): {"value": 1}}
+        j2.close()
+
+    def test_key_roundtrip_nested(self):
+        for key in [
+            ("node", "n1"),
+            ("q", "metric", (("a", "1"), ("b", "2"))),
+            ("charge", "ns", "grp"),
+            ("ha", "default", "ha", 0),
+        ]:
+            assert key_tuple(key_str(key)) == key
+
+
+# ---------------------------------------------------------------------------
+# replay properties (satellite: property-pin replay idempotency and
+# checkpoint+journal == journal-only equivalence)
+# ---------------------------------------------------------------------------
+
+
+def _random_records(rng, n):
+    records = []
+    for _ in range(n):
+        sub = rng.choice(("consolidation", "preemption", "history"))
+        k = key_str((rng.choice("abcd"), rng.randrange(3)))
+        op = rng.choice(("set", "set", "del", "append"))
+        if op == "set":
+            records.append(
+                {"sub": sub, "op": "set", "k": k,
+                 "v": {"x": rng.randrange(100)}}
+            )
+        elif op == "del":
+            records.append({"sub": sub, "op": "del", "k": k})
+        else:
+            records.append(
+                {"sub": sub, "op": "append", "k": k,
+                 "t": rng.random(), "v": rng.random(), "cap": 4}
+            )
+    return records
+
+
+class TestReplayProperties:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_replay_is_idempotent(self, seed):
+        records = _random_records(random.Random(seed), 200)
+        assert replay(None, records) == replay(None, records)
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_checkpoint_plus_tail_equals_full_journal(self, seed):
+        rng = random.Random(seed)
+        records = _random_records(rng, 200)
+        full = replay(None, records)
+        for split in sorted(rng.sample(range(201), 8)):
+            checkpoint = {"state": replay(None, records[:split])}
+            assert replay(checkpoint, records[split:]) == full
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_on_disk_roundtrip_matches_in_memory_fold(self, seed, tmp_path):
+        """Writing the stream through a real journal (with compaction
+        forcing checkpoints mid-stream) recovers to the same state as
+        the pure in-memory fold."""
+        records = _random_records(random.Random(seed), 120)
+        expected = replay(None, records)
+
+        state = {}
+        j = StateJournal(
+            str(tmp_path), compact_every=16, compact_min_interval_s=0.0
+        )
+        j.checkpoint_provider = lambda: {
+            sub: dict(t) for sub, t in state.items()
+        }
+        for record in records:
+            apply_record(state, record)
+            j.record(record)
+        j.close()
+
+        j2 = StateJournal(str(tmp_path))
+        checkpoint, tail = j2.recover()
+        assert replay(checkpoint, tail) == expected
+        j2.close()
+
+
+# ---------------------------------------------------------------------------
+# fence
+# ---------------------------------------------------------------------------
+
+
+class TestFence:
+    def test_generation_monotonic_across_incarnations(self, tmp_path):
+        gens = [ActuationFence(str(tmp_path)).generation for _ in range(3)]
+        assert gens == [1, 2, 3]
+
+    def test_validator_rejects_superseded_generation(self):
+        validator = FenceValidator()
+        validator.admit(FenceToken(generation=1))
+        validator.admit(FenceToken(generation=2))
+        with pytest.raises(FenceRejectedError) as err:
+            validator.admit(FenceToken(generation=1))
+        assert err.value.code == "FenceRejected"
+        assert err.value.retryable  # soft failure for the zombie
+        assert validator.rejections == 1
+        # the live generation is never blocked
+        validator.admit(FenceToken(generation=2))
+
+    def test_unstamped_calls_pass(self):
+        validator = FenceValidator()
+        validator.admit(None)
+        validator.admit(FenceToken(generation=5))
+        validator.admit(None)  # unfenced legacy caller still fine
+        assert validator.rejections == 0
+
+    def test_fence_file_survives_torn_write(self, tmp_path):
+        ActuationFence(str(tmp_path))  # gen 1
+        # a torn tmp file from a crashed claim must not poison the next
+        tmp = os.path.join(str(tmp_path), "FENCE.tmp")
+        with open(tmp, "w") as f:
+            f.write("garb")
+        assert ActuationFence(str(tmp_path)).generation == 2
+
+
+# ---------------------------------------------------------------------------
+# manager: warm-up + tables + checkpoint merge
+# ---------------------------------------------------------------------------
+
+
+class TestRecoveryManager:
+    def test_first_boot_skips_warmup(self, tmp_path):
+        mgr = RecoveryManager(str(tmp_path), warmup_ticks=3)
+        assert not mgr.recovered
+        assert mgr.allow_disruption()
+        mgr.close()
+
+    def test_recovered_boot_holds_warmup_for_ticks(self, tmp_path):
+        mgr = RecoveryManager(str(tmp_path), warmup_ticks=2)
+        mgr.handle("demo").set(("a",), 1)
+        mgr.close()
+        mgr2 = RecoveryManager(str(tmp_path), warmup_ticks=2)
+        assert mgr2.recovered
+        assert not mgr2.allow_disruption()
+        mgr2.on_tick()
+        assert not mgr2.allow_disruption()
+        mgr2.on_tick()
+        assert mgr2.allow_disruption()
+        assert mgr2.table("demo") == {key_str(("a",)): 1}
+        mgr2.close()
+
+    def test_boot_compacts_and_unregistered_tables_survive(self, tmp_path):
+        """finish_boot() checkpoints the replayed state; a subsystem NOT
+        running this incarnation (feature toggled off) keeps its table
+        verbatim through the checkpoint instead of losing it."""
+        mgr = RecoveryManager(str(tmp_path))
+        mgr.handle("consolidation").set(("node", "n1"), {"phase": "cordoned"})
+        mgr.close()
+
+        mgr2 = RecoveryManager(str(tmp_path))
+        mgr2.register_snapshot("other", lambda: {key_str(("x",)): 7})
+        mgr2.finish_boot()  # compacts: checkpoint written, journal empty
+        mgr2.close()
+
+        mgr3 = RecoveryManager(str(tmp_path))
+        assert mgr3.table("consolidation") == {
+            key_str(("node", "n1")): {"phase": "cordoned"}
+        }
+        assert mgr3.table("other") == {key_str(("x",)): 7}
+        mgr3.close()
+
+
+# ---------------------------------------------------------------------------
+# restored subsystem state: backoff cap, breakers, cache resets
+# ---------------------------------------------------------------------------
+
+
+def _runtime(tmp_path, clock, provider, store=None, **opts):
+    from karpenter_tpu.runtime import KarpenterRuntime, Options
+
+    return KarpenterRuntime(
+        Options(journal_dir=str(tmp_path), **opts),
+        store=store,
+        cloud_provider_factory=provider,
+        clock=clock,
+    )
+
+
+def _kill(runtime):
+    """Abandon an incarnation the way SIGKILL would: no graceful
+    checkpoint, just stop its threads and drop its journal handle."""
+    runtime.solver_service.close()
+    runtime.recovery.journal.close()
+
+
+class TestBackoffRestore:
+    def test_backoff_restored_and_due_capped(self, tmp_path):
+        from karpenter_tpu.api.core import ObjectMeta
+        from karpenter_tpu.api.scalablenodegroup import (
+            ScalableNodeGroup,
+            ScalableNodeGroupSpec,
+        )
+        from karpenter_tpu.cloudprovider.fake import FakeFactory
+        from karpenter_tpu.store import Store
+
+        store = Store()
+        clock = FakeClock()
+        provider = FakeFactory()
+        provider.node_replicas["g"] = 1
+        rt1 = _runtime(
+            tmp_path, clock, provider, store=store,
+            backoff_base_s=1.0, backoff_cap_s=60.0,
+        )
+        store.create(
+            ScalableNodeGroup(
+                metadata=ObjectMeta(name="g"),
+                spec=ScalableNodeGroupSpec(
+                    replicas=1, type="FakeNodeGroup", id="g"
+                ),
+            )
+        )
+        # a flaky store: every status patch fails, so each reconcile
+        # requeues on the backoff ladder (the rung that journals)
+        registry = faults.install(FaultRegistry(seed=3))
+        registry.plan("store.patch_status", probability=1.0)
+        for _ in range(6):
+            clock.advance(120.0)
+            rt1.manager._due = {k: 0.0 for k in rt1.manager._due}
+            rt1.manager.reconcile_all()
+        faults.uninstall()
+        key = ("ScalableNodeGroup", "default", "g")
+        prev1 = rt1.manager._backoff_prev[key]
+        assert prev1 > 1.0
+        _kill(rt1)
+
+        # long outage between crash and restart: the journaled due time
+        # is far in the past / the prev delay large — the restored due
+        # must be capped at now + cap, never parking the object
+        clock.advance(10_000.0)
+        rt2 = _runtime(
+            tmp_path, clock, provider, store=store,
+            backoff_base_s=1.0, backoff_cap_s=60.0,
+        )
+        try:
+            assert rt2.manager._backoff_prev[key] == pytest.approx(prev1)
+            assert rt2.manager._due[key] <= clock() + 60.0
+            assert rt2.manager._due[key] != float("inf")
+        finally:
+            rt2.close()
+
+    def test_restore_prunes_objects_deleted_during_downtime(
+        self, tmp_path
+    ):
+        """An object whose backoff was journaled, then deleted while
+        the controller was down: the restore must boot cleanly (the
+        prune deletes fold into the very table being restored — a live
+        mirror) and drop the entry instead of reviving it."""
+        from karpenter_tpu.api.core import ObjectMeta
+        from karpenter_tpu.api.scalablenodegroup import (
+            ScalableNodeGroup,
+            ScalableNodeGroupSpec,
+        )
+        from karpenter_tpu.cloudprovider.fake import FakeFactory
+        from karpenter_tpu.store import Store
+
+        store = Store()
+        clock = FakeClock()
+        provider = FakeFactory()
+        provider.node_replicas["g"] = 1
+        provider.node_replicas["h"] = 1
+        rt1 = _runtime(tmp_path, clock, provider, store=store)
+        for name in ("g", "h"):
+            store.create(
+                ScalableNodeGroup(
+                    metadata=ObjectMeta(name=name),
+                    spec=ScalableNodeGroupSpec(
+                        replicas=1, type="FakeNodeGroup", id=name
+                    ),
+                )
+            )
+        registry = faults.install(FaultRegistry(seed=5))
+        registry.plan("store.patch_status", probability=1.0)
+        for _ in range(3):
+            clock.advance(120.0)
+            rt1.manager._due = {k: 0.0 for k in rt1.manager._due}
+            rt1.manager.reconcile_all()
+        faults.uninstall()
+        assert len(rt1.manager._backoff_prev) == 2
+        _kill(rt1)
+
+        store.delete("ScalableNodeGroup", "default", "g")  # while down
+        rt2 = _runtime(tmp_path, clock, provider, store=store)
+        try:
+            key_g = ("ScalableNodeGroup", "default", "g")
+            key_h = ("ScalableNodeGroup", "default", "h")
+            assert key_g not in rt2.manager._backoff_prev
+            assert key_h in rt2.manager._backoff_prev
+        finally:
+            rt2.close()
+
+
+class TestBreakerRestore:
+    def test_open_breaker_survives_restart(self, tmp_path):
+        from karpenter_tpu.api.core import ObjectMeta
+        from karpenter_tpu.api.scalablenodegroup import (
+            ScalableNodeGroup,
+            ScalableNodeGroupSpec,
+        )
+        from karpenter_tpu.cloudprovider.fake import (
+            FakeFactory,
+            retryable_error,
+        )
+        from karpenter_tpu.store import Store
+
+        store = Store()
+        clock = FakeClock()
+        provider = FakeFactory()
+        provider.node_replicas["g"] = 1
+        provider.want_err = retryable_error("Throttling")
+        rt1 = _runtime(
+            tmp_path, clock, provider, store=store,
+            circuit_failure_threshold=2, circuit_reset_s=300.0,
+        )
+        store.create(
+            ScalableNodeGroup(
+                metadata=ObjectMeta(name="g"),
+                spec=ScalableNodeGroupSpec(
+                    replicas=1, type="FakeNodeGroup", id="g"
+                ),
+            )
+        )
+        for _ in range(3):
+            clock.advance(120.0)
+            rt1.manager._due = {k: 0.0 for k in rt1.manager._due}
+            rt1.manager.reconcile_all()
+        sng_ctrl = rt1.manager._controllers[1]
+        assert sng_ctrl._breakers[("default", "g")].state == "open"
+        _kill(rt1)
+
+        provider.want_err = None  # the provider healed while we were dead
+        clock.advance(1.0)
+        rt2 = _runtime(
+            tmp_path, clock, provider, store=store,
+            circuit_failure_threshold=2, circuit_reset_s=300.0,
+        )
+        try:
+            ctrl2 = rt2.manager._controllers[1]
+            breaker = ctrl2._breakers[("default", "g")]
+            # still OPEN: a provider that was flapping before the crash
+            # does not get a clean slate by crashing us
+            assert breaker.state == "open"
+            assert breaker.consecutive_failures >= 2
+            # ...and the normal half-open probe heals it
+            clock.advance(301.0)
+            rt2.manager._due = {k: 0.0 for k in rt2.manager._due}
+            rt2.manager.reconcile_all()
+            assert breaker.state == "closed"
+        finally:
+            rt2.close()
+
+
+class TestCacheResetSeams:
+    def test_solver_service_reset_caches(self):
+        from karpenter_tpu.metrics.registry import GaugeRegistry
+        from karpenter_tpu.solver import SolverService
+
+        svc = SolverService(registry=GaugeRegistry())
+        try:
+            svc._compiled[("fake-key",)] = lambda: None
+            svc._compile_seen.add(("fake-key",))
+            svc.reset_caches()
+            assert svc._compiled == {}
+            assert svc._compile_seen == set()
+        finally:
+            svc.close()
+
+    def test_delta_cache_reset(self):
+        from karpenter_tpu.metrics.producers.pendingcapacity.encoder import (
+            SnapshotDeltaCache,
+        )
+
+        cache = SnapshotDeltaCache()
+        cache._entries["k"] = object()
+        cache.reset()
+        assert len(cache._entries) == 0
+
+    def test_recovery_boot_invalidates_process_caches(self, tmp_path):
+        from karpenter_tpu.cloudprovider.fake import FakeFactory
+        from karpenter_tpu.metrics.producers.pendingcapacity import (
+            encoder,
+        )
+
+        clock = FakeClock()
+        rt1 = _runtime(tmp_path, clock, FakeFactory())
+        _kill(rt1)  # leaves journal state + fence generation behind
+
+        from karpenter_tpu.solver.service import (
+            default_service,
+            reset_default_service,
+        )
+
+        encoder._default_delta._entries["stale"] = object()
+        shared = default_service()  # outlives in-process restarts
+        shared._compiled[("stale",)] = lambda: None
+        shared._compile_seen.add(("stale",))
+        rt2 = _runtime(tmp_path, clock, FakeFactory())
+        try:
+            assert rt2.recovery.recovered
+            # the recovery boot reset the process-level caches:
+            # pre-crash identity-keyed entries must not be reused
+            assert len(encoder._default_delta._entries) == 0
+            assert shared._compiled == {}
+            assert shared._compile_seen == set()
+            assert rt2.solver_service._compiled == {}
+        finally:
+            rt2.close()
+            reset_default_service()
+
+
+class TestJournalGauges:
+    def test_gauges_registered_and_updated(self, tmp_path):
+        from karpenter_tpu.metrics.registry import GaugeRegistry
+
+        registry = GaugeRegistry()
+        mgr = RecoveryManager(str(tmp_path), registry=registry)
+        mgr.handle("demo").set(("a",), 1)
+        mgr.on_tick()
+        assert (
+            registry.gauge("recovery", "replay_seconds").get("-", "-")
+            is not None
+        )
+        assert (
+            registry.gauge("recovery", "journal_bytes").get("-", "-") > 0
+        )
+        assert (
+            registry.gauge(
+                "recovery", "warmup_ticks_remaining"
+            ).get("-", "-")
+            == 0.0
+        )
+        mgr.close()
+
+
+class TestZombieSelfFence:
+    def test_stale_incarnation_cannot_overwrite_live_state(self, tmp_path):
+        """Rolling-restart overlap: the OLD incarnation is still alive
+        when a NEW one claims the journal dir. The zombie's appends and
+        its close-time checkpoint must be suppressed — otherwise its
+        stale protective state would override the live incarnation's."""
+        mgr1 = RecoveryManager(str(tmp_path))
+        mgr1.handle("demo").set(("a",), "from-gen-1")
+
+        mgr2 = RecoveryManager(str(tmp_path))  # supersedes gen 1
+        mgr2.handle("demo").set(("a",), "from-gen-2")
+
+        # the zombie keeps writing and then exits "gracefully" —
+        # neither its append nor its checkpoint may land
+        mgr1.handle("demo").set(("a",), "stale-zombie-write")
+        mgr1.close()
+        assert mgr1.journal._superseded
+
+        mgr2.close()  # live incarnation checkpoints normally
+
+        mgr3 = RecoveryManager(str(tmp_path))
+        assert mgr3.table("demo") == {key_str(("a",)): "from-gen-2"}
+        mgr3.close()
+
+    def test_concurrent_claims_get_distinct_generations(self, tmp_path):
+        """The fence claim is serialized under an exclusive flock: N
+        racing boots must claim N distinct, strictly increasing
+        generations (equal generations would both pass admit())."""
+        import threading
+
+        gens = []
+        lock = threading.Lock()
+
+        def claim():
+            fence = ActuationFence(str(tmp_path))
+            with lock:
+                gens.append(fence.generation)
+
+        threads = [threading.Thread(target=claim) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sorted(gens) == list(range(1, 9))

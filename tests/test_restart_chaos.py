@@ -1,0 +1,565 @@
+"""Seeded kill-and-restart chaos suite (ISSUE 7 acceptance).
+
+Each scenario crashes a controller incarnation at a `process.crash`
+injection point (mid-drain, mid-eviction-batch — mid-journal-write is
+covered in tests/test_recovery.py), abandons it the way SIGKILL would
+(no graceful checkpoint), reboots a fresh incarnation on the same
+journal dir + store + provider, and runs to convergence. Pins:
+
+  * no duplicate cloud actuations — a landed (group, count) transition
+    is applied exactly once across incarnations, and a stale
+    (split-brain) incarnation's replay is FENCE-REJECTED instead of
+    applied;
+  * eviction budgets and holds are preserved across the restart (spend
+    journaled write-ahead of the evictions it covers);
+  * cordoned nodes RESUME their FSM phase after the restart rather
+    than being re-cordoned (or double-decrementing their group);
+  * the recovery warm-up holds all disruption planning until one full
+    reconcile confirms fleet state;
+  * the forecast blend resumes with its earned skill and warm history
+    (no cold-start reset).
+
+`make test-recovery` runs this file + tests/test_recovery.py.
+"""
+
+import pytest
+
+from karpenter_tpu import faults
+from karpenter_tpu.api.core import (
+    Container,
+    Node,
+    NodeCondition,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from karpenter_tpu.api.metricsproducer import (
+    MetricsProducer,
+    MetricsProducerSpec,
+    PendingCapacitySpec,
+)
+from karpenter_tpu.api.scalablenodegroup import (
+    FAKE_NODE_GROUP,
+    ScalableNodeGroup,
+    ScalableNodeGroupSpec,
+)
+from karpenter_tpu.cloudprovider.fake import FakeFactory, FakeNodeGroup
+from karpenter_tpu.faults import FaultRegistry, ProcessCrash
+from karpenter_tpu.runtime import KarpenterRuntime, Options
+from karpenter_tpu.store import Store
+from karpenter_tpu.utils.quantity import Quantity
+
+CHAOS_SEED = 20260803
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_registry():
+    yield
+    faults.uninstall()
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class RecordingNodeGroup(FakeNodeGroup):
+    def set_replicas(self, count, token=None):
+        super().set_replicas(count, token=token)
+        self._factory.actuations.append((self._id, count))
+
+
+class RecordingFactory(FakeFactory):
+    """Records every SUCCESSFUL actuation: a repeated successful write
+    of the same transition is a duplicate actuation."""
+
+    def __init__(self):
+        super().__init__()
+        self.actuations = []
+
+    def node_group_for(self, spec):
+        return RecordingNodeGroup(self, spec.id)
+
+
+def q(value):
+    return Quantity.parse(str(value))
+
+
+def make_node(name, cpu="8", labels=None):
+    return Node(
+        metadata=ObjectMeta(name=name, labels=dict(labels or {"pool": "a"})),
+        spec=NodeSpec(),
+        status=NodeStatus(
+            allocatable={"cpu": q(cpu), "memory": q("16Gi"), "pods": q("16")},
+            conditions=[NodeCondition("Ready", "True")],
+        ),
+    )
+
+
+def make_pod(name, node=None, cpu="1", priority=None):
+    return Pod(
+        metadata=ObjectMeta(name=name),
+        spec=PodSpec(
+            node_name=node or "",
+            priority=priority,
+            containers=[
+                Container(requests={"cpu": q(cpu), "memory": q("1Gi")})
+            ],
+        ),
+    )
+
+
+def make_producer(ref="grp"):
+    return MetricsProducer(
+        metadata=ObjectMeta(name="pc"),
+        spec=MetricsProducerSpec(
+            pending_capacity=PendingCapacitySpec(
+                node_selector={"pool": "a"}, node_group_ref=ref
+            )
+        ),
+    )
+
+
+def make_group(name="grp", id_="grp-id", replicas=3, eviction_budget=None):
+    return ScalableNodeGroup(
+        metadata=ObjectMeta(name=name),
+        spec=ScalableNodeGroupSpec(
+            replicas=replicas, type=FAKE_NODE_GROUP, id=id_,
+            eviction_budget=eviction_budget,
+        ),
+    )
+
+
+def boot(journal_dir, store, provider, clock, **opts):
+    """One controller incarnation. The store (the apiserver analog) and
+    the provider (the cloud) are SHARED infrastructure that survives
+    controller crashes; only the journal dir carries controller state."""
+    return KarpenterRuntime(
+        Options(journal_dir=str(journal_dir), **opts),
+        store=store,
+        cloud_provider_factory=provider,
+        clock=clock,
+    )
+
+
+def kill(runtime):
+    """SIGKILL analog: stop threads and drop the journal handle WITHOUT
+    a graceful checkpoint — recovery must work from the raw journal."""
+    runtime.solver_service.close()
+    runtime.recovery.journal.close()
+
+
+def tick(runtime, clock, advance=61.0):
+    clock.advance(advance)
+    runtime.manager._due = {k: 0.0 for k in runtime.manager._due}
+    runtime.manager.reconcile_all()
+
+
+# ---------------------------------------------------------------------------
+# mid-drain crashes (consolidation)
+# ---------------------------------------------------------------------------
+
+
+class TestCrashMidDrain:
+    def _world(self, tmp_path):
+        store = Store()
+        clock = FakeClock()
+        provider = RecordingFactory()
+        provider.node_replicas["grp-id"] = 3
+        store.create(make_producer())
+        store.create(make_group())
+        for i in range(3):
+            store.create(make_node(f"n{i}"))
+        store.create(make_pod("p0", node="n0"))
+        rt = boot(tmp_path, store, provider, clock, consolidate=True)
+        return rt, store, provider, clock
+
+    def _drive_to_draining(self, rt, clock):
+        engine = rt.consolidation
+        engine.plan()  # first sight starts churn clocks
+        clock.advance(engine.config.cooldown_s + 1)
+        engine.plan()
+        assert list(engine.in_flight().values()) == ["cordoned"]
+        cordoned = next(iter(engine.in_flight()))
+        clock.advance(engine.config.verify_s + 1)
+        return cordoned
+
+    def test_crash_after_decrement_resumes_and_drains_exactly_once(
+        self, tmp_path
+    ):
+        """Kill between the spec decrement and the provider actuation:
+        the restarted incarnation must RESUME the draining node (not
+        re-cordon it) and complete the scale-down exactly once."""
+        rt1, store, provider, clock = self._world(tmp_path)
+        cordoned = self._drive_to_draining(rt1, clock)
+        rt1.consolidation.plan()  # APPROVED -> DRAINING + spec 3 -> 2
+        assert rt1.consolidation.in_flight()[cordoned] == "draining"
+        assert (
+            store.get("ScalableNodeGroup", "default", "grp").spec.replicas
+            == 2
+        )
+        assert provider.actuations == []  # provider untouched yet
+        kill(rt1)
+
+        rt2 = boot(tmp_path, store, provider, clock, consolidate=True)
+        try:
+            # the FSM resumed: same node, same phase, still cordoned —
+            # NOT re-planned from scratch
+            assert rt2.consolidation.in_flight() == {cordoned: "draining"}
+            node = store.get("Node", "default", cordoned)
+            assert node.spec.unschedulable
+            planned = rt2.registry.gauge(
+                "consolidation", "drains_planned_total"
+            ).get("-", "-")
+            assert not planned  # no re-cordon in the new incarnation
+
+            tick(rt2, clock)  # warm-up tick: completes the committed drain
+            assert provider.node_replicas["grp-id"] == 2
+            # exactly one successful provider write across BOTH
+            # incarnations, stamped with the new fence generation
+            assert provider.actuations == [("grp-id", 2)]
+            assert provider.fence_validator.highest_seen == 2
+            assert rt2.consolidation.in_flight() == {}
+            names = {n.metadata.name for n in store.list("Node")}
+            assert cordoned not in names  # drained node finalized
+        finally:
+            rt2.close()
+
+    def test_crash_before_decrement_times_out_without_double_drain(
+        self, tmp_path
+    ):
+        """Kill at the process.crash point INSIDE actuation (DRAINING
+        journaled, scale write never issued): the restarted incarnation
+        restores DRAINING — never APPROVED, so it can never decrement
+        again — and the drain times out back to service with zero
+        replica loss."""
+        rt1, store, provider, clock = self._world(tmp_path)
+        cordoned = self._drive_to_draining(rt1, clock)
+        registry = faults.install(FaultRegistry(seed=CHAOS_SEED))
+        registry.plan("process.crash.drain", mode="crash", times=1)
+        with pytest.raises(ProcessCrash):
+            rt1.consolidation.plan()
+        faults.uninstall()
+        assert (
+            store.get("ScalableNodeGroup", "default", "grp").spec.replicas
+            == 3
+        )  # the crash preceded the decrement
+        kill(rt1)
+
+        rt2 = boot(tmp_path, store, provider, clock, consolidate=True)
+        try:
+            engine = rt2.consolidation
+            assert engine.in_flight() == {cordoned: "draining"}
+            tick(rt2, clock)  # warm-up
+            # ride past the drain timeout: the stuck drain is vetoed and
+            # the node returns to service — no decrement ever happens
+            clock.advance(engine.config.drain_timeout_s + 1)
+            engine.plan()
+            assert engine.in_flight().get(cordoned) != "draining"
+            assert provider.node_replicas["grp-id"] == 3
+            assert provider.actuations == []
+            sng = store.get("ScalableNodeGroup", "default", "grp")
+            assert sng.spec.replicas == 3  # never double-decremented
+        finally:
+            rt2.close()
+
+
+# ---------------------------------------------------------------------------
+# mid-eviction-batch crash (preemption)
+# ---------------------------------------------------------------------------
+
+
+class TestCrashMidEvictionBatch:
+    def _world(self, tmp_path, eviction_budget=2):
+        store = Store()
+        clock = FakeClock()
+        provider = RecordingFactory()
+        provider.node_replicas["grp-id"] = 2
+        store.create(make_producer())
+        store.create(
+            make_group(replicas=2, eviction_budget=eviction_budget)
+        )
+        for name in ("n1", "n2"):
+            store.create(make_node(name, cpu="4"))
+            for i in range(4):
+                store.create(
+                    make_pod(f"{name}-batch-{i}", node=name, priority=0)
+                )
+        store.create(make_pod("critical", cpu="2", priority=1000))
+        rt = boot(tmp_path, store, provider, clock, preempt=True)
+        return rt, store, provider, clock
+
+    @staticmethod
+    def _bound_batch_pods(store):
+        return sorted(
+            p.metadata.name
+            for p in store.list("Pod")
+            if p.spec.node_name and "batch" in p.metadata.name
+        )
+
+    def test_budget_spend_survives_crash_mid_batch(self, tmp_path):
+        """The plan needs 2 evictions against a budget of 2. Crash
+        after the FIRST eviction lands: the full charge was journaled
+        write-ahead, so the restarted incarnation sees the budget
+        EXHAUSTED — it defers instead of evicting more, and the victim
+        already evicted is never double-counted."""
+        rt1, store, provider, clock = self._world(tmp_path)
+        registry = faults.install(FaultRegistry(seed=CHAOS_SEED))
+        registry.plan("process.crash.evict", mode="crash", times=1)
+        with pytest.raises(ProcessCrash):
+            rt1.preemption.plan()
+        faults.uninstall()
+        survivors = self._bound_batch_pods(store)
+        assert len(survivors) == 7  # exactly one victim landed pre-crash
+        kill(rt1)
+
+        rt2 = boot(tmp_path, store, provider, clock, preempt=True)
+        try:
+            engine = rt2.preemption
+            # the hold and the FULL charge (2 evictions) were restored
+            assert engine.active_nodes()  # target node still held
+            spent = sum(
+                c.evictions
+                for charges in engine._charges.values()
+                for c in charges
+            )
+            assert spent == 2
+
+            # warm-up: the first reconcile plans NOTHING
+            tick(rt2, clock)
+            assert self._bound_batch_pods(store) == survivors
+
+            # post-warm-up planning DEFERS: the restored charge exhausts
+            # the budget, so no fresh evictions happen this window
+            clock.advance(engine.config.plan_interval_s + 1)
+            plans = engine.plan()
+            assert plans.get(("default", "critical")) is None
+            assert self._bound_batch_pods(store) == survivors
+
+            # once the restored charge expires, preemption proceeds —
+            # budgets pause disruption, they don't deadlock it
+            clock.advance(engine.config.hold_s + 1)
+            engine.plan()
+            after = self._bound_batch_pods(store)
+            assert len(after) < len(survivors)
+            # no zombie victims: everything evicted pre-crash stayed gone
+            assert set(after) <= set(survivors)
+        finally:
+            rt2.close()
+
+
+# ---------------------------------------------------------------------------
+# split-brain: a stale incarnation replays a dead decision
+# ---------------------------------------------------------------------------
+
+
+class TestSplitBrainFencing:
+    def test_stale_incarnation_is_fence_rejected(self, tmp_path):
+        store = Store()
+        clock = FakeClock()
+        provider = RecordingFactory()
+        provider.node_replicas["g"] = 3
+        store.create(
+            ScalableNodeGroup(
+                metadata=ObjectMeta(name="g"),
+                spec=ScalableNodeGroupSpec(
+                    replicas=5, type=FAKE_NODE_GROUP, id="g"
+                ),
+            )
+        )
+        rt1 = boot(tmp_path, store, provider, clock)
+        tick(rt1, clock)
+        assert provider.node_replicas["g"] == 5  # gen-1 write admitted
+        # rt1 "dies" (journal handle gone) but the PROCESS lingers — the
+        # split-brain zombie scenario
+        kill(rt1)
+
+        rt2 = boot(tmp_path, store, provider, clock)
+        try:
+            sng = store.get("ScalableNodeGroup", "default", "g")
+            sng.spec.replicas = 6
+            store.update(sng)
+            tick(rt2, clock)
+            assert provider.node_replicas["g"] == 6  # gen-2 admitted
+
+            # the zombie wakes up and replays a STALE decision
+            stale_ctrl = rt1.manager._controllers[1]
+            zombie_view = store.get("ScalableNodeGroup", "default", "g")
+            zombie_view.spec.replicas = 4
+            stale_ctrl.reconcile(zombie_view)
+
+            # the provider REJECTED the stale stamp instead of applying
+            assert provider.node_replicas["g"] == 6
+            assert provider.fence_validator.rejections == 1
+            rejections = rt1.registry.gauge(
+                "recovery", "fence_rejections_total"
+            ).get("-", "-")
+            assert rejections == 1.0
+            # no duplicate / out-of-order actuations across incarnations
+            assert provider.actuations == [("g", 5), ("g", 6)]
+        finally:
+            rt2.close()
+            rt1.recovery = None  # journal already closed by kill()
+            rt1.close()
+
+
+# ---------------------------------------------------------------------------
+# forecast: skill + history resume warm
+# ---------------------------------------------------------------------------
+
+
+class TestForecastStateSurvivesRestart:
+    def test_skill_and_history_restored(self, tmp_path):
+        import collections
+
+        store = Store()
+        clock = FakeClock()
+        provider = RecordingFactory()
+        rt1 = boot(tmp_path, store, provider, clock)
+        f1 = rt1.forecaster
+        key = ("ha", "default", "ha", 0)
+        for i in range(10):
+            f1.history.append(key, clock() + i, 10.0 + i)
+        # mature one pending prediction through the real scoring path,
+        # earning a non-default skill EWMA (journaled as it lands)
+        f1._pending[key] = collections.deque([(clock(), 20.0, 4.0)])
+        f1._mature(key, ("default", "ha"), clock() + 60, actual=10.0)
+        skill1 = f1.skill("default", "ha")
+        assert skill1 != 1.0  # genuinely earned, not the optimistic start
+        count1 = f1.history.count(key)
+        kill(rt1)
+
+        rt2 = boot(tmp_path, store, provider, clock)
+        try:
+            f2 = rt2.forecaster
+            # the blend resumes with its earned skill — no cold-start
+            # reset to the optimistic 1.0
+            assert f2.skill("default", "ha") == pytest.approx(skill1)
+            assert f2.history.count(key) == count1
+            ts1, vs1 = f1.history.series(key)
+            ts2, vs2 = f2.history.series(key)
+            assert list(ts2) == list(ts1)
+            assert list(vs2) == list(vs1)
+        finally:
+            rt2.close()
+
+
+# ---------------------------------------------------------------------------
+# determinism: the suite is a replay, not a dice roll
+# ---------------------------------------------------------------------------
+
+
+class TestRestartScenarioDeterminism:
+    def test_same_seed_same_world_same_outcome(self, tmp_path):
+        def run(root):
+            store = Store()
+            clock = FakeClock()
+            provider = RecordingFactory()
+            provider.node_replicas["grp-id"] = 2
+            store.create(make_producer())
+            store.create(make_group(replicas=2, eviction_budget=2))
+            for name in ("n1", "n2"):
+                store.create(make_node(name, cpu="4"))
+                for i in range(4):
+                    store.create(
+                        make_pod(f"{name}-batch-{i}", node=name, priority=0)
+                    )
+            store.create(make_pod("critical", cpu="2", priority=1000))
+            rt1 = boot(root, store, provider, clock, preempt=True)
+            with FaultRegistry(seed=CHAOS_SEED) as registry:
+                registry.plan("process.crash.evict", mode="crash", times=1)
+                try:
+                    rt1.preemption.plan()
+                except ProcessCrash:
+                    pass
+            kill(rt1)
+            rt2 = boot(root, store, provider, clock, preempt=True)
+            try:
+                tick(rt2, clock)
+                clock.advance(rt2.preemption.config.hold_s + 1)
+                rt2.preemption.plan()
+                return (
+                    sorted(
+                        p.metadata.name
+                        for p in store.list("Pod")
+                        if p.spec.node_name
+                    ),
+                    dict(provider.node_replicas),
+                )
+            finally:
+                rt2.close()
+
+        a = run(tmp_path / "a")
+        b = run(tmp_path / "b")
+        assert a == b
+
+
+class TestReviewRegressionPins:
+    def test_orphan_cordon_released_at_boot(self, tmp_path):
+        """A crash between the durable cordon write and its journal
+        append leaves a cordoned node with no FSM owner: the recovery
+        boot must release it (uncordon), never strand it unschedulable
+        forever."""
+        store = Store()
+        clock = FakeClock()
+        provider = RecordingFactory()
+        provider.node_replicas["grp-id"] = 2
+        store.create(make_producer())
+        store.create(make_group(replicas=2))
+        node = make_node("n-orphan")
+        node.spec.unschedulable = True
+        node.metadata.annotations[
+            "karpenter.sh/consolidation-state"
+        ] = "cordoned"
+        store.create(node)
+        rt = boot(tmp_path, store, provider, clock, consolidate=True)
+        try:
+            refreshed = store.get("Node", "default", "n-orphan")
+            assert not refreshed.spec.unschedulable
+            assert (
+                "karpenter.sh/consolidation-state"
+                not in refreshed.metadata.annotations
+            )
+        finally:
+            rt.close()
+
+    def test_fence_floor_seeded_before_first_actuation(self, tmp_path):
+        """A freshly booted incarnation raises the provider's fence
+        floor at construction: the stale zombie is rejected even if the
+        successor has not actuated anything yet."""
+        store = Store()
+        clock = FakeClock()
+        provider = RecordingFactory()
+        provider.node_replicas["g"] = 3
+        store.create(
+            ScalableNodeGroup(
+                metadata=ObjectMeta(name="g"),
+                spec=ScalableNodeGroupSpec(
+                    replicas=5, type=FAKE_NODE_GROUP, id="g"
+                ),
+            )
+        )
+        rt1 = boot(tmp_path, store, provider, clock)
+        tick(rt1, clock)
+        assert provider.node_replicas["g"] == 5
+        kill(rt1)
+
+        rt2 = boot(tmp_path, store, provider, clock)  # no actuation yet
+        try:
+            assert provider.fence_validator.highest_seen == 2
+            stale_ctrl = rt1.manager._controllers[1]
+            zombie_view = store.get("ScalableNodeGroup", "default", "g")
+            zombie_view.spec.replicas = 4
+            stale_ctrl.reconcile(zombie_view)
+            assert provider.node_replicas["g"] == 5  # not applied
+            assert provider.fence_validator.rejections == 1
+        finally:
+            rt2.close()

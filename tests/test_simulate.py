@@ -382,3 +382,36 @@ class TestSimulatePreempt:
             "preempt_dispatches",
         }
         assert len(report["ticks"]) == 6
+
+
+class TestSimulateRestartStorm:
+    def test_storm_pins_crash_safety_contract(self, tmp_path):
+        """The --simulate --restart-storm replay: exactly-once cloud
+        actuation across every incarnation, FSM resumption (no
+        re-cordon of a restored drain), a fence generation per boot,
+        and the stale-incarnation replay probe REJECTED."""
+        from karpenter_tpu.simulate import simulate_restart_storm
+
+        report = simulate_restart_storm(
+            nodes=4, crashes=2, seed=0, journal_dir=str(tmp_path)
+        )
+        assert report["restarts"] == 3
+        assert report["duplicate_actuations"] == 0
+        assert report["fence_rejections"] == 1
+        assert report["stale_replay_applied"] is False
+        assert report["resumed_not_recordoned"] is True
+        assert report["fence_generation"] == 4  # one per boot + probe
+        assert report["drains_completed"] == 3  # every empty node gone
+
+    def test_storm_is_deterministic(self, tmp_path):
+        from karpenter_tpu.simulate import simulate_restart_storm
+
+        def run(sub):
+            report = simulate_restart_storm(
+                nodes=3, crashes=1, seed=7,
+                journal_dir=str(tmp_path / sub),
+            )
+            report.pop("nodes_remaining")
+            return report
+
+        assert run("a") == run("b")
