@@ -85,6 +85,11 @@ bench-journal: ## Protective-state journal overhead on the reconcile hot path (t
 	$(PYTHON) bench.py --journal --journal-ticks 40 \
 		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
 
+bench-shard: ## Sharded fleet-scale solve (1M pods x 1k types through the SolverService seam on an 8-device mesh, 1/2/4/8 scaling + parity pins); appends a BENCHMARKS row + publishes to BASELINE.json
+	$(PYTHON) bench.py --shard --pods 1000000 --types 1000 \
+		--backend xla --iters 3 --shard-scaling 1,2,4,8 \
+		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
+
 dryrun: ## Multi-chip sharding compile check on 8 virtual CPU devices
 	$(PYTHON) -c "import os; \
 		os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS','') + ' --xla_force_host_platform_device_count=8').strip(); \
@@ -123,5 +128,5 @@ kind-smoke: ## Deploy smoke on kind: image -> apply -> pod Ready -> one HA end t
 
 .PHONY: help dev ci test test-chaos test-recovery battletest verify codegen \
 	docs native bench bench-solver bench-consolidate bench-forecast \
-	bench-preempt bench-journal dryrun image publish apply delete \
-	kind-load conformance kind-smoke
+	bench-preempt bench-journal bench-shard dryrun image publish apply \
+	delete kind-load conformance kind-smoke

@@ -172,6 +172,29 @@ def parse_args(argv=None) -> argparse.Namespace:
         "restarts the worker and drains to numpy (0 = off)",
     )
     parser.add_argument(
+        "--shard-threshold",
+        type=int,
+        default=1 << 24,
+        help="pods x groups cell count at which a solve routes through "
+        "the multi-device mesh instead of the single-device program "
+        "(docs/solver-service.md 'Sharded dispatch'); 0 disables "
+        "sharding",
+    )
+    parser.add_argument(
+        "--shard-devices",
+        type=int,
+        default=None,
+        help="cap the sharded-dispatch mesh at N devices (default: "
+        "every visible device; < 2 leaves the mesh unbuilt)",
+    )
+    parser.add_argument(
+        "--shard-mesh",
+        default=None,
+        metavar="PODSxGROUPS",
+        help="explicit mesh extents for the sharded dispatch, e.g. "
+        "'8x1' (default: pods-major factorization of the device count)",
+    )
+    parser.add_argument(
         "--consolidate",
         action="store_true",
         help="enable the consolidation engine (batched node-drain "
@@ -256,6 +279,23 @@ def parse_args(argv=None) -> argparse.Namespace:
         "metric query before the row errors instead (0 disables reuse)",
     )
     return parser.parse_args(argv)
+
+
+def _parse_mesh_shape(spec):
+    """'8x1' -> (8, 1): the --shard-mesh override for the sharded
+    dispatch strategy (docs/solver-service.md 'Sharded dispatch')."""
+    if not spec:
+        return None
+    try:
+        pods, groups = spec.lower().split("x")
+        shape = (int(pods), int(groups))
+    except ValueError:
+        raise SystemExit(
+            f"--shard-mesh {spec!r}: expected PODSxGROUPS, e.g. 8x1"
+        )
+    if shape[0] < 1 or shape[1] < 1:
+        raise SystemExit(f"--shard-mesh {spec!r}: extents must be >= 1")
+    return shape
 
 
 def _run_simulation(args, store) -> int:
@@ -482,6 +522,9 @@ def main(argv=None) -> int:
             circuit_failure_threshold=args.circuit_threshold,
             circuit_reset_s=args.circuit_reset,
             solver_watchdog_timeout_s=args.solver_watchdog_timeout,
+            solver_shard_threshold=args.shard_threshold,
+            solver_shard_devices=args.shard_devices,
+            solver_shard_mesh=_parse_mesh_shape(args.shard_mesh),
             forecast_history=args.forecast_history,
             stale_metric_max_age_s=args.stale_metric_max_age,
         ),

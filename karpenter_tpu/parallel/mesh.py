@@ -35,8 +35,9 @@ from typing import Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding
-from jax.sharding import PartitionSpec as P
+
+from karpenter_tpu.parallel.compat import Mesh, NamedSharding
+from karpenter_tpu.parallel.compat import PartitionSpec as P
 
 from karpenter_tpu.ops.binpack import BinPackInputs, BinPackOutputs, binpack
 from karpenter_tpu.ops.decision import (
@@ -68,6 +69,7 @@ def build_mesh(
     n_devices: Optional[int] = None,
     devices: Optional[Sequence] = None,
     slices: int = 1,
+    shape: Optional[Tuple[int, int]] = None,
 ) -> Mesh:
     """2D pods×groups mesh, or 3D slice×pods×groups when slices > 1.
 
@@ -79,6 +81,11 @@ def build_mesh(
     identical to the 2D mesh). jax.distributed deployments hand the
     flattened global device list here; virtual CPU devices stand in for
     tests and the driver dryrun.
+
+    `shape` overrides the pods-major factorization with explicit
+    (pods, groups) extents — the SolverService mesh-shape knob for
+    operators whose problem aspect ratio disagrees with the default
+    split. Mutually exclusive with slices > 1.
     """
     devices = list(devices if devices is not None else jax.devices())
     if n_devices is not None:
@@ -88,6 +95,17 @@ def build_mesh(
             )
         devices = devices[:n_devices]
     n = len(devices)
+    if shape is not None:
+        if slices > 1:
+            raise ValueError("shape= and slices>1 are mutually exclusive")
+        pods, groups = shape
+        if pods * groups > n:
+            raise ValueError(
+                f"mesh shape {shape} needs {pods * groups} devices, "
+                f"have {n}"
+            )
+        dev_array = np.array(devices[: pods * groups]).reshape(pods, groups)
+        return Mesh(dev_array, (AXIS_PODS, AXIS_GROUPS))
     if slices > 1:
         if n % slices:
             raise ValueError(f"{n} devices not divisible into {slices} slices")
@@ -108,6 +126,18 @@ def _row_axes(mesh: Mesh):
     )
 
 
+def mesh_extents(mesh: Mesh) -> Tuple[int, int]:
+    """(row extent, group extent): the divisibility the pod and group
+    axes must satisfy on this mesh — rows fold the slice axis in on a
+    3D multi-host mesh. This pair is what the SolverService folds into
+    its compile-cache key (the padded shape is a deterministic function
+    of bucket shape × extents)."""
+    return (
+        mesh.shape[AXIS_PODS] * mesh.shape.get(AXIS_SLICE, 1),
+        mesh.shape[AXIS_GROUPS],
+    )
+
+
 # ---------------------------------------------------------------------------
 # Sharding specs
 # ---------------------------------------------------------------------------
@@ -119,17 +149,29 @@ def binpack_shardings(
     with_forbidden: bool = False,
     with_score: bool = False,
     with_exclusive: bool = False,
+    with_priority: bool = False,
+    with_tier: bool = False,
+    batch: bool = False,
 ) -> BinPackInputs:
     """A BinPackInputs-shaped pytree of NamedShardings.
 
     Pod-major arrays shard their leading dim over "pods"; group-major arrays
     over "groups". Constraint-universe axes (R, K, L) are small and
     replicated. pod_weight (present only for deduplicated inputs) rides the
-    pods axis like every other row-major array; pod_group_forbidden is the
-    one 2D pods x groups array and shards over BOTH mesh axes — the same
-    tiling the feasibility matrix it masks gets from GSPMD.
+    pods axis like every other row-major array; pod_group_forbidden and
+    pod_group_score are the 2D pods x groups arrays and shard over BOTH
+    mesh axes — the same tiling the feasibility matrix they mask/steer
+    gets from GSPMD. pod_priority rides the pods axis, group_tier the
+    groups axis (the PR 6 steering operands).
+
+    batch=True prepends a REPLICATED leading axis to every spec: the
+    shardings for a SolverService-coalesced stack [B, ...] — each device
+    holds every batch item's slab of its pod/group shard, so the
+    lax.map/vmap batched programs partition exactly like the single-item
+    program.
     """
-    s = lambda *spec: NamedSharding(mesh, P(*spec))
+    lead = (None,) if batch else ()
+    s = lambda *spec: NamedSharding(mesh, P(*lead, *spec))
     rows = _row_axes(mesh)  # (slice, pods) on a 3D multi-host mesh
     return BinPackInputs(
         pod_requests=s(rows, None),
@@ -143,6 +185,27 @@ def binpack_shardings(
         pod_group_forbidden=s(rows, AXIS_GROUPS) if with_forbidden else None,
         pod_group_score=s(rows, AXIS_GROUPS) if with_score else None,
         pod_exclusive=s(rows) if with_exclusive else None,
+        pod_priority=s(rows) if with_priority else None,
+        group_tier=s(AXIS_GROUPS) if with_tier else None,
+    )
+
+
+def stacked_binpack_shardings(
+    mesh: Mesh, presence: Tuple[bool, ...]
+) -> BinPackInputs:
+    """binpack_shardings for a coalesced batch stack, keyed by the
+    solver service's operand-presence tuple (solver/bucketing.presence:
+    weight, forbidden, score, exclusive, priority, tier)."""
+    w, f, sc, e, pr, ti = presence
+    return binpack_shardings(
+        mesh,
+        with_weight=w,
+        with_forbidden=f,
+        with_score=sc,
+        with_exclusive=e,
+        with_priority=pr,
+        with_tier=ti,
+        batch=True,
     )
 
 
@@ -250,6 +313,18 @@ def pad_binpack_inputs_for_mesh(
             # False padding: padded rows are invalid, never bucketed
             else pad0(inputs.pod_exclusive, P1)
         ),
+        pod_priority=(
+            None
+            if inputs.pod_priority is None
+            # priority 0 = no steering; padded rows are invalid anyway
+            else pad0(inputs.pod_priority, P1)
+        ),
+        group_tier=(
+            None
+            if inputs.group_tier is None
+            # tier 0 = on-demand; padded columns are zero-alloc/infeasible
+            else pad0(inputs.group_tier, T1)
+        ),
     )
 
 
@@ -284,6 +359,8 @@ def shard_binpack_inputs(mesh: Mesh, inputs: BinPackInputs) -> BinPackInputs:
             with_forbidden=inputs.pod_group_forbidden is not None,
             with_score=inputs.pod_group_score is not None,
             with_exclusive=inputs.pod_exclusive is not None,
+            with_priority=inputs.pod_priority is not None,
+            with_tier=inputs.group_tier is not None,
         ),
     )
 
@@ -472,3 +549,34 @@ def dryrun_fleet_step(n_devices: int) -> None:
         )
         assert int(b_out.unschedulable) == int(b_ref.unschedulable)
         np.testing.assert_array_equal(d_out.desired[:16], d_ref.desired)
+
+    # the PRODUCTION route onto the same mesh: a SolverService with the
+    # shard threshold forced low must route this solve through its
+    # sharded dispatch strategy (docs/solver-service.md "Sharded
+    # dispatch") and answer bit-identically to the single-device
+    # program — certifying the seam every caller actually takes, not
+    # just the raw helpers above
+    from karpenter_tpu.metrics.registry import GaugeRegistry
+    from karpenter_tpu.solver import SolverService
+
+    service = SolverService(
+        registry=GaugeRegistry(),
+        shard_threshold=1,
+        shard_devices=n_devices,
+    )
+    try:
+        svc_out = service.solve(b_ref_in, buckets=8, backend="xla")
+        # a 1-device dryrun has no mesh to build: the service must fall
+        # through to the single-device program (still parity-checked)
+        expected = 1 if n_devices >= 2 else 0
+        assert service.stats.shard_dispatches == expected, service.stats
+        np.testing.assert_array_equal(svc_out.assigned, b_ref.assigned)
+        np.testing.assert_array_equal(
+            svc_out.assigned_count, b_ref.assigned_count
+        )
+        np.testing.assert_array_equal(
+            svc_out.nodes_needed, b_ref.nodes_needed
+        )
+        assert int(svc_out.unschedulable) == int(b_ref.unschedulable)
+    finally:
+        service.close()

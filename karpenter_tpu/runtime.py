@@ -48,6 +48,15 @@ class Options:
     # pipeline, 0 = serial)
     solver_window_s: float = 0.002
     solver_pipeline_depth: int = 1
+    # sharded dispatch (docs/solver-service.md "Sharded dispatch"):
+    # requests whose pods x groups cell count reaches the threshold ride
+    # the multi-device mesh; 0 disables. shard_devices caps the mesh
+    # device count (None = every visible device); shard_mesh_shape pins
+    # explicit (pods, groups) extents instead of the pods-major
+    # factorization.
+    solver_shard_threshold: int = 1 << 24
+    solver_shard_devices: Optional[int] = None
+    solver_shard_mesh: Optional[tuple] = None
     # degradation-ladder tuning (docs/resilience.md):
     # engine requeue backoff under retryable failures — first retry in
     # ~[base, 3*base], monotone up to the cap
@@ -146,6 +155,9 @@ class KarpenterRuntime:
             health_failure_threshold=options.solver_health_threshold,
             health_probe_interval_s=options.solver_probe_interval_s,
             watchdog_timeout_s=options.solver_watchdog_timeout_s,
+            shard_threshold=options.solver_shard_threshold,
+            shard_devices=options.solver_shard_devices,
+            shard_mesh_shape=options.solver_shard_mesh,
         )
         self._reset_caches_for_recovery()
         self.producer_factory = ProducerFactory(

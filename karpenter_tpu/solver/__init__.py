@@ -14,9 +14,11 @@ Public surface:
 from karpenter_tpu.solver.bucketing import (
     bucket_shape,
     bucket_up,
+    mesh_aligned_shape,
     pad_to_bucket,
 )
 from karpenter_tpu.solver.service import (
+    DEFAULT_SHARD_THRESHOLD,
     SUBSYSTEM,
     SolveFuture,
     SolverSaturated,
@@ -28,6 +30,7 @@ from karpenter_tpu.solver.service import (
 )
 
 __all__ = [
+    "DEFAULT_SHARD_THRESHOLD",
     "SUBSYSTEM",
     "SolveFuture",
     "SolverSaturated",
@@ -37,6 +40,7 @@ __all__ = [
     "bucket_shape",
     "bucket_up",
     "default_service",
+    "mesh_aligned_shape",
     "pad_to_bucket",
     "reset_default_service",
 ]

@@ -88,6 +88,25 @@ def bucket_shape(inputs: BinPackInputs) -> Tuple[int, int, int, int, int]:
     )
 
 
+def mesh_aligned_shape(
+    shape: Tuple[int, int, int, int, int], extents: Tuple[int, int]
+) -> Tuple[int, int, int, int, int]:
+    """Grow a bucket shape's pod/group axes to the mesh-divisible
+    multiples GSPMD requires (extents = parallel.mesh.mesh_extents).
+    Constraint-universe axes are replicated on the mesh and stay on
+    their own ladders. The result is a deterministic function of
+    (bucket shape, extents), so the sharded compile-cache key only
+    needs to carry the extents — same-rung traffic still never
+    recompiles."""
+    from karpenter_tpu.utils.functional import pad_to_multiple
+
+    p, t, r, k, l = shape
+    rows, cols = extents
+    return (pad_to_multiple(p, rows) if rows > 1 else p,
+            pad_to_multiple(t, cols) if cols > 1 else t,
+            r, k, l)
+
+
 def presence(inputs: BinPackInputs) -> Tuple[bool, ...]:
     """Which optional operands ride this request — the other half of the
     compile-cache key (an absent operand removes whole program stages)."""

@@ -113,6 +113,25 @@ STAGE_P50_MS = "stage_p50_ms"
 STAGE_P99_MS = "stage_p99_ms"
 WINDOW_MS = "window_ms"
 PIPELINE_DEPTH = "pipeline_depth"
+UPLOAD_MS = "upload_ms"
+SHARD_DEVICES = "shard_devices"
+
+# Sharded dispatch (docs/solver-service.md "Sharded dispatch"): a request
+# whose pods x groups constraint matrix reaches this many cells routes
+# through the multi-device mesh (parallel/mesh.py) instead of the
+# single-device program — when a mesh with >= 2 devices exists. 2^24
+# cells ≈ the north-star 100k x 300 fleet at 5% occupancy headroom:
+# small-fleet traffic (10k x 50 = 5 x 10^5) never pays mesh padding or
+# the sharded compile, fleet-scale decisions (1M x 1k = 10^9) always
+# shard. 0 disables sharding outright.
+DEFAULT_SHARD_THRESHOLD = 1 << 24
+
+# A lone coalesced map-strategy batch splits into pipeline_depth+1
+# chunked dispatches (so the double buffer has something to overlap)
+# only at or above this size — smaller batches aren't worth a second
+# dispatch's fixed cost, and 2-request batches must keep riding one
+# dispatch (the coalescing contract tests pin).
+_PIPELINE_SPLIT_MIN = 4
 
 # Backend health FSM states (karpenter_resilience_solver_backend_state)
 HEALTHY = "healthy"
@@ -174,6 +193,11 @@ class SolverStatistics:
     preempt_calls: int = 0  # preempt() entries
     preempt_candidates: int = 0  # total candidates submitted across calls
     preempt_dispatches: int = 0  # preempt device dispatches
+    # sharded dispatch (docs/solver-service.md "Sharded dispatch")
+    shard_dispatches: int = 0  # batches answered by the mesh-sharded program
+    shard_requests: int = 0  # requests routed onto the mesh at submit
+    shard_fallbacks: int = 0  # shard-path failures retried single-device
+    pipeline_splits: int = 0  # lone batches chunked so the pipeline overlaps
     # backend health FSM + watchdog (docs/resilience.md)
     device_failures: int = 0  # total device-path failures (any rung)
     fsm_trips: int = 0  # healthy -> degraded transitions
@@ -269,6 +293,9 @@ class SolverService:
         health_failure_threshold: int = 3,
         health_probe_interval_s: float = 5.0,
         watchdog_timeout_s: float = 0.0,  # 0 = watchdog disabled
+        shard_threshold: int = DEFAULT_SHARD_THRESHOLD,
+        shard_devices: Optional[int] = None,
+        shard_mesh_shape: Optional[tuple] = None,
     ):
         if on_timeout not in ("fallback", "raise"):
             raise ValueError(f"on_timeout must be fallback|raise, got {on_timeout!r}")
@@ -308,6 +335,24 @@ class SolverService:
         self._window_now_s = 0.0 if adaptive_window else window_s
         self._inflight: collections.deque = collections.deque()
         self._last_pipeline_depth = 0
+        # sharded dispatch (docs/solver-service.md "Sharded dispatch"):
+        # requests whose pods x groups cell count reaches the threshold
+        # route through a multi-device mesh, built lazily on first use.
+        # shard_devices caps the device count (None = all), and
+        # shard_mesh_shape pins explicit (pods, groups) extents instead
+        # of the pods-major factorization.
+        self.shard_threshold = shard_threshold
+        self.shard_devices = shard_devices
+        self.shard_mesh_shape = (
+            tuple(shard_mesh_shape) if shard_mesh_shape else None
+        )
+        self._mesh = None
+        self._mesh_ready = False
+        self._mesh_lock = threading.Lock()
+        # one shard-path failure stops routing NEW traffic to the mesh
+        # (the single-device program keeps serving); reset_caches — the
+        # recovery-boot seam — re-arms it
+        self._shard_broken = False
         # backend health FSM (module docstring): trips wholesale to numpy
         # after K consecutive device failures, probes recovery
         self.health_failure_threshold = health_failure_threshold
@@ -349,6 +394,13 @@ class SolverService:
         self._g_stage_p99 = reg(SUBSYSTEM, STAGE_P99_MS)
         self._g_window = reg(SUBSYSTEM, WINDOW_MS)
         self._g_pipeline = reg(SUBSYSTEM, PIPELINE_DEPTH)
+        # host->device transfer p50 of recent dispatches — the measured
+        # baseline the device-resident-state work (ROADMAP item 4)
+        # attacks; also present per-dispatch under stage_p50_ms{upload}
+        self._g_upload = reg(SUBSYSTEM, UPLOAD_MS)
+        # devices behind the sharded dispatch strategy (0 = single-device:
+        # no mesh, below threshold traffic only, or shard path tripped)
+        self._g_shard = reg(SUBSYSTEM, SHARD_DEVICES)
         # degradation-ladder surface (docs/resilience.md): FSM state
         # (0 healthy / 1 degraded) + transition and watchdog counters
         self._g_backend_state = reg("resilience", "solver_backend_state")
@@ -391,8 +443,17 @@ class SolverService:
         # latency section reads
         self._g_window.set("-", "-", self._window_now_s * 1e3)
         self._g_pipeline.set("-", "-", float(self._last_pipeline_depth))
+        n_shard = 0
+        if self._mesh is not None and not self._shard_broken:
+            n_shard = int(self._mesh.devices.size)
+        self._g_shard.set("-", "-", float(n_shard))
         with self._stage_lock:
             snapshot = {k: list(v) for k, v in self._stages.items()}
+        uploads = snapshot.get("upload")
+        if uploads:
+            self._g_upload.set(
+                "-", "-", float(np.percentile(uploads, 50))
+            )
         for stage, samples in snapshot.items():
             if samples:
                 self._g_stage_p50.set(
@@ -429,6 +490,10 @@ class SolverService:
         with self._cond:
             self._compiled = {}
             self._compile_seen = set()
+        # a recovery boot also re-arms the sharded dispatch strategy: a
+        # pre-crash shard failure shouldn't pin the successor single-
+        # device forever (the ladder re-trips on the next failure)
+        self._shard_broken = False
 
     def stage_percentiles(self) -> Dict[str, Dict[str, float]]:
         """{stage: {"p50_ms", "p99_ms", "n"}} over the retained latency
@@ -461,6 +526,74 @@ class SolverService:
             return "xla"
         return backend
 
+    def _shard_mesh(self):
+        """The lazily-built dispatch mesh (parallel/mesh.py), or None
+        when sharding is unavailable: disabled (shard_threshold <= 0),
+        fewer than 2 devices and no explicit shape, or mesh construction
+        failed (logged once; the single-device path serves)."""
+        if self._mesh_ready:
+            return self._mesh
+        with self._mesh_lock:
+            if self._mesh_ready:
+                return self._mesh
+            mesh = None
+            try:
+                if self.shard_threshold > 0:
+                    import jax
+
+                    from karpenter_tpu.parallel.mesh import build_mesh
+
+                    devices = jax.devices()
+                    n = len(devices)
+                    if self.shard_devices is not None:
+                        n = min(n, self.shard_devices)
+                    shape = self.shard_mesh_shape
+                    if shape is not None and shape[0] * shape[1] >= 2:
+                        mesh = build_mesh(
+                            devices=devices[:n], shape=shape
+                        )
+                    elif shape is None and n >= 2:
+                        # a 1-device "mesh" (explicit 1x1 included)
+                        # would route traffic through the inline
+                        # sharded path with zero parallelism gain while
+                        # reporting sharding active — below 2 devices
+                        # the single-device program IS the right path
+                        mesh = build_mesh(n_devices=n, devices=devices)
+            except Exception as error:  # noqa: BLE001 — optional fast path
+                logger().warning(
+                    "sharded dispatch unavailable (%s: %s); staying "
+                    "single-device",
+                    type(error).__name__, error,
+                )
+            self._mesh = mesh
+            self._mesh_ready = True
+            return mesh
+
+    def _shard_extents(self, resolved: str, n_pods: int, n_groups: int):
+        """Route one request: (effective backend, mesh extents | None).
+
+        A request whose pods x groups cell count reaches shard_threshold
+        rides the mesh — including pallas-resolved traffic: the fused
+        Mosaic kernel has no multi-chip entry, and above the threshold
+        using every chip through the GSPMD-partitioned XLA program beats
+        one chip's fused kernel. Below threshold (or with sharding
+        unavailable/tripped, or under a device_solver override where
+        device math lives out of process) nothing changes."""
+        if (
+            self.shard_threshold <= 0
+            or self._shard_broken
+            or self.device_solver is not None
+            or resolved not in ("xla", "pallas")
+            or n_pods * n_groups < self.shard_threshold
+        ):
+            return resolved, None
+        mesh = self._shard_mesh()
+        if mesh is None:
+            return resolved, None
+        from karpenter_tpu.parallel.mesh import mesh_extents
+
+        return "xla", mesh_extents(mesh)
+
     def submit(
         self,
         inputs: BinPackInputs,
@@ -472,21 +605,24 @@ class SolverService:
         queue is full (solve() turns that into the numpy fallback)."""
         if self._closed:
             raise RuntimeError("solver service is closed")
-        resolved = self._resolve_backend(backend)
+        n_pods = inputs.pod_requests.shape[0]
+        n_groups = inputs.group_allocatable.shape[0]
+        resolved, extents = self._shard_extents(
+            self._resolve_backend(backend), n_pods, n_groups
+        )
+        key = (bucket_shape(inputs), buckets, resolved, presence(inputs))
+        if extents is not None:
+            key += ("shard", extents)
+            self.stats.shard_requests += 1
         timeout = self.default_timeout_s if timeout is None else timeout
         now = self._clock()
         request = _Request(
             inputs=inputs,
             buckets=buckets,
             backend=resolved,
-            key=(
-                bucket_shape(inputs),
-                buckets,
-                resolved,
-                presence(inputs),
-            ),
-            n_pods=inputs.pod_requests.shape[0],
-            n_groups=inputs.group_allocatable.shape[0],
+            key=key,
+            n_pods=n_pods,
+            n_groups=n_groups,
             deadline=(now + timeout) if timeout else None,
             enqueued_at=now,
         )
@@ -617,18 +753,55 @@ class SolverService:
                 )
         return results
 
+    def _consolidate_key(self, inputs, buckets: int, resolved: str):
+        """(key, effective backend) for one consolidate() candidate.
+
+        5th key element: consolidation batches vectorize across
+        candidates (jax.vmap) instead of scanning (lax.map) —
+        cluster-scale operands make the C× memory amplification
+        trivial, and vectorization is where the batched >> sequential
+        throughput comes from. The distinct key keeps these groups from
+        mixing with plain solve() traffic compiled for the
+        memory-bounded scan. Fleet-scale candidate evaluations
+        additionally ride the mesh ("vmap_shard" + extents — the
+        sharded dispatch strategy, same ladder as solve())."""
+        backend_eff, extents = self._shard_extents(
+            resolved,
+            inputs.pod_requests.shape[0],
+            inputs.group_allocatable.shape[0],
+        )
+        if extents is None:
+            return (
+                bucket_shape(inputs), buckets, backend_eff,
+                presence(inputs), "vmap",
+            ), backend_eff
+        self.stats.shard_requests += 1
+        return (
+            bucket_shape(inputs), buckets, backend_eff,
+            presence(inputs), "vmap_shard", extents,
+        ), backend_eff
+
     def _enqueue_batch(
         self, inputs_list, buckets: int, resolved: str, timeout
     ) -> List[Optional[_Request]]:
         """Enqueue a consolidate() batch atomically under one lock hold
         (contiguous in the deque, shared coalesce_id). Overflow slots
-        come back as None, in order, for inline numpy degradation."""
+        come back as None, in order, for inline numpy degradation.
+
+        Routing (keys + shard extents) resolves BEFORE the lock: the
+        first fleet-scale batch lazily initializes the backend and
+        builds the mesh, and doing that under self._cond would stall
+        every submitter, the worker, and the watchdog."""
         now = self._clock()
+        keyed = [
+            self._consolidate_key(inputs, buckets, resolved)
+            for inputs in inputs_list
+        ]
         requests: List[Optional[_Request]] = []
         with self._cond:
             self._coalesce_seq += 1
             cid = self._coalesce_seq
-            for inputs in inputs_list:
+            for inputs, (key, backend_eff) in zip(inputs_list, keyed):
                 if len(self._queue) >= self.max_queue:
                     self.stats.rejected += 1
                     self._c_rejected.inc("-", "-")
@@ -637,22 +810,8 @@ class SolverService:
                 request = _Request(
                     inputs=inputs,
                     buckets=buckets,
-                    backend=resolved,
-                    # 5th key element: consolidation batches vectorize
-                    # across candidates (jax.vmap) instead of scanning
-                    # (lax.map) — cluster-scale operands make the C×
-                    # memory amplification trivial, and vectorization
-                    # is where the batched >> sequential throughput
-                    # comes from. The distinct key keeps these groups
-                    # from mixing with plain solve() traffic compiled
-                    # for the memory-bounded scan.
-                    key=(
-                        bucket_shape(inputs),
-                        buckets,
-                        resolved,
-                        presence(inputs),
-                        "vmap",
-                    ),
+                    backend=backend_eff,
+                    key=key,
                     n_pods=inputs.pod_requests.shape[0],
                     n_groups=inputs.group_allocatable.shape[0],
                     deadline=(now + timeout) if timeout else None,
@@ -1047,8 +1206,14 @@ class SolverService:
             groups: Dict[tuple, List[_Request]] = {}
             for request in batch:
                 groups.setdefault(request.key, []).append(request)
+            # lone = this batch is one compatibility group with nothing
+            # else in flight to overlap — the shape the pipeline
+            # chunk-split exists for (multi-group batches overlap
+            # naturally: group k+1 dispatches while group k computes)
             for key, requests in groups.items():
-                self._dispatch_group(key, requests)
+                self._dispatch_group(
+                    key, requests, lone=len(groups) == 1
+                )
             with self._cond:
                 if not self._stale():  # a restart already drained it
                     self._current_batch = []
@@ -1153,13 +1318,32 @@ class SolverService:
             live.append(request)
         return live
 
-    def _dispatch_group(self, key: tuple, requests: List[_Request]) -> None:
+    @staticmethod
+    def _shard_strategy(key: tuple) -> Optional[str]:
+        """The shard strategy marker of a request key, or None for a
+        single-device key. Sharded keys: (shape, buckets, backend,
+        presence, "shard"|"vmap_shard", extents)."""
+        if len(key) > 5 and key[4] in ("shard", "vmap_shard"):
+            return key[4]
+        return None
+
+    @staticmethod
+    def _single_device_key(key: tuple) -> tuple:
+        """The single-device key a sharded group degrades to — same
+        bucket shape/buckets/backend/presence, mesh routing stripped
+        ("vmap_shard" keeps the vectorized consolidate program)."""
+        if key[4] == "vmap_shard":
+            return key[:4] + ("vmap",)
+        return key[:4]
+
+    def _dispatch_group(
+        self, key: tuple, requests: List[_Request], lone: bool = False
+    ) -> None:
         live = self._filter_live(requests)
         if not live:
             return
         self.stats.last_coalesce_factor = len(live)
-        if len(live) > 1:
-            self.stats.coalesced_batches += 1
+        self.stats.coalesced_batches += len(live) > 1
         self._g_coalesce.set("-", "-", float(len(live)))
         device_path = key[2] != "numpy"
         if device_path and not self._device_allowed():
@@ -1168,16 +1352,47 @@ class SolverService:
             self._finish_from_numpy(live)
             return
         try:
-            self._solve_group(key, live)
-        except Exception as error:  # noqa: BLE001 — device failure path
+            self._solve_group(key, live, lone=lone)
+            return
+        except Exception as exc:  # noqa: BLE001 — device failure path
+            error: BaseException = exc
             if device_path and not self._stale():
                 self._record_device_failure()
-            logger().warning(
-                "solver device path failed (%s: %s); degrading %d "
-                "request(s) to numpy",
-                type(error).__name__, error, len(live),
-            )
-            self._finish_from_numpy(live)
+        if self._shard_strategy(key) is not None and not self._stale():
+            error = self._retry_unsharded(key, live, error)
+            if error is None:
+                return
+        logger().warning(
+            "solver device path failed (%s: %s); degrading %d "
+            "request(s) to numpy",
+            type(error).__name__, error, len(live),
+        )
+        self._finish_from_numpy(live)
+
+    def _retry_unsharded(
+        self, key: tuple, live: List[_Request], error: BaseException
+    ) -> Optional[BaseException]:
+        """The sharded rung of the degradation ladder
+        (docs/solver-service.md): shard -> single-device BEFORE numpy —
+        the mesh failing is not the device failing, so the same program
+        re-runs unpartitioned. One shard failure also stops routing NEW
+        traffic to the mesh (reset_caches, the recovery-boot seam,
+        re-arms it). Returns None on success, else the error the numpy
+        rung should report."""
+        self.stats.shard_fallbacks += 1
+        self._shard_broken = True
+        logger().warning(
+            "sharded dispatch failed (%s: %s); retrying %d request(s) "
+            "on the single-device path and disabling the shard route",
+            type(error).__name__, error, len(live),
+        )
+        try:
+            self._solve_group(self._single_device_key(key), live)
+            return None
+        except Exception as single_error:  # noqa: BLE001
+            if not self._stale():
+                self._record_device_failure()
+            return single_error
 
     def _finish_from_numpy(self, live: List[_Request]) -> None:
         for request in live:
@@ -1195,7 +1410,14 @@ class SolverService:
             except Exception as numpy_error:  # noqa: BLE001
                 request.finish(error=numpy_error)
 
-    def _solve_group(self, key: tuple, live: List[_Request]) -> None:
+    def _solve_group(
+        self, key: tuple, live: List[_Request], lone: bool = False
+    ) -> None:
+        # forecast and preempt requests are PINNED to the single-device
+        # path in this ladder: their kernels are not mesh-certified (no
+        # sharded parity pin), and their problem sizes — S series x T
+        # history, C candidates x N nodes — sit orders of magnitude
+        # below the bin-pack cell threshold anyway
         if key[0] == "forecast":
             self._forecast_group(key, live)
             return
@@ -1248,10 +1470,57 @@ class SolverService:
             self._solve_pallas(shape, buckets, live)
             self._record_device_success()
             return
-        self._begin_batched_xla(
+        if self._shard_strategy(key) is not None:
+            # mesh-partitioned dispatch: completes INLINE — fleet-scale
+            # operands must not double-buffer (two in-flight 10^9-cell
+            # batches would double peak memory), and a synchronous
+            # failure is what lets _dispatch_group walk the
+            # shard -> single-device -> numpy ladder. Success is
+            # recorded INSIDE (_sharded_xla), after its stale check —
+            # a watchdog-superseded dispatch completing late must not
+            # erase the failure the watchdog just counted.
+            self._drain_inflight()
+            self._sharded_xla(shape, buckets, live, key)
+            return
+        self._begin_pipelined_xla(
             shape, buckets, live,
-            strategy=key[4] if len(key) > 4 else "map",
+            strategy=key[4] if len(key) > 4 else "map", lone=lone,
         )
+
+    def _begin_pipelined_xla(
+        self, shape, buckets: int, live: List[_Request],
+        strategy: str, lone: bool,
+    ) -> None:
+        """Dispatch a map/vmap group, SPLITTING a lone map batch into
+        pipeline chunks — the dead-pipeline fix: a lone coalesced batch
+        with nothing in flight would dispatch once and drain immediately
+        (closed-loop callers can't enqueue the next round until this one
+        answers), so the double buffer never engaged. Chunking gives it
+        the overlap: chunk k+1's pad/stack/dispatch runs while chunk k
+        computes, and chunk k's fetch/scatter overlaps chunk k+1's
+        compute. lax.map scans the batch SERIALLY on device, so halving
+        a batch costs no device efficiency — only the vmap (consolidate)
+        family, whose vectorization is the point, never splits."""
+        split = (
+            strategy == "map"
+            and lone
+            and self.pipeline_depth > 0
+            and not self._inflight
+            and len(live) >= _PIPELINE_SPLIT_MIN
+        )
+        if not split:
+            self._begin_batched_xla(
+                shape, buckets, live, strategy=strategy
+            )
+            return
+        n_chunks = min(self.pipeline_depth + 1, len(live) // 2)
+        size = -(-len(live) // n_chunks)
+        self.stats.pipeline_splits += 1
+        for start in range(0, len(live), size):
+            self._begin_batched_xla(
+                shape, buckets, live[start:start + size],
+                strategy=strategy,
+            )
 
     def _forecast_group(self, key: tuple, live: List[_Request]) -> None:
         """One coalesced forecast dispatch: same-T-bucket requests are
@@ -1437,17 +1706,7 @@ class SolverService:
         reallocated every dispatch; where donation is unimplemented it
         is a no-op with identical outputs (pinned by the donation-parity
         test)."""
-        t0 = _time.perf_counter()
-        padded = [pad_to_bucket(r.inputs, shape) for r in live]
-        n_batch = bucket_up(len(padded), 1)
-        # batch padding replicates the first request: cheapest valid
-        # filler (its outputs are computed and discarded)
-        padded.extend(padded[:1] * (n_batch - len(padded)))
-        stacked = _stack_inputs(padded)
-        self._record_stage("pad", _time.perf_counter() - t0)
-
-        import jax
-
+        stacked, n_batch = self._stack_group(shape, live)
         fn, fresh = self._compiled_for(
             ("xla", shape, n_batch, buckets, live[0].key[3], strategy),
             donate=self._donation_supported(),
@@ -1457,7 +1716,7 @@ class SolverService:
             live, grace=COMPILE_GRACE_S if fresh else 0.0
         ):
             with solver_trace("solver.dispatch"):
-                stacked = jax.device_put(stacked)
+                stacked = self._upload(stacked)
                 out = fn(stacked, buckets)
         if self._stale():
             # superseded by a watchdog restart while dispatching: the
@@ -1475,6 +1734,109 @@ class SolverService:
         # the serial dispatch→wait→scatter loop
         while len(self._inflight) > max(0, self.pipeline_depth):
             self._drain_one()
+
+    def _stack_group(self, shape, live: List[_Request]):
+        """(stacked operands, batch bucket): pad each request to the
+        shape bucket, stack along a new leading axis, pad the batch
+        axis up its own ladder — batch padding replicates the first
+        request, the cheapest valid filler (its outputs are computed
+        and discarded). Shared by the single-device and sharded
+        dispatch paths; records the "pad" stage."""
+        t0 = _time.perf_counter()
+        padded = [pad_to_bucket(r.inputs, shape) for r in live]
+        n_batch = bucket_up(len(padded), 1)
+        padded.extend(padded[:1] * (n_batch - len(padded)))
+        stacked = _stack_inputs(padded)
+        self._record_stage("pad", _time.perf_counter() - t0)
+        return stacked, n_batch
+
+    def _upload(self, stacked, shardings=None):
+        """device_put the stack (with NamedShardings on the sharded
+        path) and record the ISOLATED host->device transfer cost (the
+        device-resident-state target, ROADMAP item 4): compute waits on
+        the transfer either way, so the sync point only moves the wait
+        to where it can be measured."""
+        import jax
+
+        t_up = _time.perf_counter()
+        stacked = (
+            jax.device_put(stacked)
+            if shardings is None
+            else jax.device_put(stacked, shardings)
+        )
+        jax.block_until_ready(stacked)
+        self._record_stage("upload", _time.perf_counter() - t_up)
+        return stacked
+
+    def _sharded_xla(
+        self, shape, buckets: int, live: List[_Request], key: tuple
+    ) -> None:
+        """The sharded dispatch strategy (docs/solver-service.md
+        "Sharded dispatch"): the same batched program the single-device
+        path compiles, partitioned over the pods x groups mesh by GSPMD.
+
+        Each request pads up the normal bucket ladder GROWN to
+        mesh-divisible pod/group extents (mesh_aligned_shape — padding
+        stays semantics-preserving: extra rows invalid, extra columns
+        infeasible), the stack is device_put with NamedShardings (pod
+        axis over mesh rows, group axis over mesh columns, batch axis
+        replicated), and the jitted lax.map/vmap program runs with its
+        feasibility matmuls as local blocks and one cross-shard
+        reduction per aggregate. Results merge host-side: one fetch per
+        batch, then the standard per-request crop — the caller-visible
+        slices carry no mesh padding. Outputs are BIT-IDENTICAL to the
+        single-device program on integer fields (the padding argument of
+        solver/bucketing.py; property-pinned in tests/test_parallel.py
+        and tests/test_solver_service.py); the f32 lp_bound may differ
+        by the reduction-order ulp the numpy-parity contract already
+        carves out."""
+        import jax
+
+        from karpenter_tpu.parallel.mesh import stacked_binpack_shardings
+        from karpenter_tpu.solver.bucketing import mesh_aligned_shape
+
+        mesh = self._shard_mesh()
+        if mesh is None:
+            raise RuntimeError(
+                "shard mesh unavailable for a shard-routed batch"
+            )
+        extents = key[5]
+        strategy = "vmap" if key[4] == "vmap_shard" else "map"
+        aligned = mesh_aligned_shape(shape, extents)
+        stacked, n_batch = self._stack_group(aligned, live)
+        fn, fresh = self._compiled_for(
+            (
+                "xla", aligned, n_batch, buckets, key[3], strategy,
+                "shard", extents,
+            ),
+            donate=self._donation_supported(),
+        )
+        t0 = _time.perf_counter()
+        with self._device_section(
+            live, grace=COMPILE_GRACE_S if fresh else 0.0
+        ):
+            with solver_trace("solver.shard"):
+                stacked = self._upload(
+                    stacked, stacked_binpack_shardings(mesh, key[3])
+                )
+                out = fn(stacked, buckets)
+                jax.block_until_ready(out)
+        if self._stale():
+            return  # watchdog already answered these from numpy
+        self._record_stage("dispatch", _time.perf_counter() - t0)
+        self._count_dispatch()
+        self.stats.shard_dispatches += 1
+        t0 = _time.perf_counter()
+        host = _fetch_outputs(out)
+        for i, request in enumerate(live):
+            request.finish(
+                result=crop_outputs(
+                    _index_outputs(host, i),
+                    request.n_pods, request.n_groups,
+                )
+            )
+        self._record_stage("scatter", _time.perf_counter() - t0)
+        self._record_device_success()
 
     def _drain_one(self) -> None:
         """Complete the OLDEST in-flight dispatch: wait out the device,

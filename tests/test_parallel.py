@@ -329,3 +329,172 @@ def test_sliced_mesh_matches_single_device():
     np.testing.assert_array_equal(
         d_out.able_to_scale, d_ref.able_to_scale
     )
+
+
+# ---------------------------------------------------------------------------
+# PR 8 satellites: padding/sharding helper property pins + the honest
+# compat surface behind the sharded dispatch strategy.
+# ---------------------------------------------------------------------------
+
+
+def _full_operand_inputs(P_: int, T: int, seed: int):
+    """example inputs carrying EVERY optional operand the encoder can
+    emit — the widest pytree the mesh helpers must round-trip."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    return dataclasses.replace(
+        example_binpack_inputs(P_=P_, T=T, K=8, L=8, seed=seed),
+        pod_weight=jnp.asarray(
+            rng.integers(1, 9, P_).astype(np.int32)
+        ),
+        pod_group_forbidden=jnp.asarray(rng.random((P_, T)) < 0.25),
+        pod_group_score=jnp.asarray(
+            rng.integers(0, 100, (P_, T)).astype(np.float32)
+        ),
+        pod_exclusive=jnp.asarray(rng.random(P_) < 0.2),
+        pod_priority=jnp.asarray(
+            rng.integers(0, 4, P_).astype(np.int32)
+        ),
+        group_tier=jnp.asarray(
+            (rng.random(T) < 0.4).astype(np.int32)
+        ),
+    )
+
+
+def test_pad_shard_unpad_is_identity_property():
+    """The satellite property pin: for arbitrary NON-divisible shapes,
+    pad_binpack_inputs_for_mesh -> device_put with shardings -> slice
+    back to the original axes is the IDENTITY on every operand (padding
+    masks, never mutates), and the padded axes are mesh-divisible."""
+    from karpenter_tpu.parallel import (
+        mesh_extents,
+        shard_binpack_inputs,
+    )
+
+    mesh = build_mesh(n_devices=8)
+    rows, cols = mesh_extents(mesh)
+    rng = np.random.default_rng(99)
+    for trial in range(6):
+        P_ = int(rng.integers(1, 120))
+        T = int(rng.integers(1, 15))
+        inputs = _full_operand_inputs(P_, T, seed=100 + trial)
+        padded = pad_binpack_inputs_for_mesh(inputs, mesh)
+        assert padded.pod_requests.shape[0] % rows == 0
+        assert padded.group_allocatable.shape[0] % cols == 0
+        sharded = shard_binpack_inputs(mesh, inputs)
+        for name, axis_pod in (
+            ("pod_requests", True), ("pod_valid", True),
+            ("pod_intolerant", True), ("pod_required", True),
+            ("group_allocatable", False), ("group_taints", False),
+            ("group_labels", False), ("pod_weight", True),
+            ("pod_group_forbidden", True), ("pod_group_score", True),
+            ("pod_exclusive", True), ("pod_priority", True),
+            ("group_tier", False),
+        ):
+            orig = np.asarray(getattr(inputs, name))
+            got = np.asarray(getattr(sharded, name))
+            n = P_ if axis_pod else T
+            if name in ("pod_group_forbidden", "pod_group_score"):
+                got = got[:P_, :T]
+            else:
+                got = got[:n]
+            np.testing.assert_array_equal(
+                got, orig, err_msg=f"trial {trial}: {name}"
+            )
+
+
+def test_pad_for_mesh_carries_priority_operands():
+    """Regression: pad_binpack_inputs_for_mesh used to rebuild the
+    pytree WITHOUT pod_priority/group_tier, silently stripping the
+    PR 6 steering operands from any padded sharded solve."""
+    inputs = _full_operand_inputs(33, 5, seed=7)
+    mesh = build_mesh(n_devices=8)
+    padded = pad_binpack_inputs_for_mesh(inputs, mesh)
+    assert padded.pod_priority is not None
+    assert padded.group_tier is not None
+    # the padding itself is inert: priority 0 (no steering), tier 0
+    # (on-demand) on rows/columns that are invalid/infeasible anyway
+    assert np.all(np.asarray(padded.pod_priority)[33:] == 0)
+    assert np.all(np.asarray(padded.group_tier)[5:] == 0)
+
+
+@pytest.mark.parametrize("n_devices", [2, 8])
+def test_sharded_matches_unsharded_matches_numpy(n_devices):
+    """The three-way parity pin behind `make bench-shard`: the sharded
+    program == the single-device program == the numpy mirror on a
+    non-divisible full-operand problem (integer outputs exact; lp_bound
+    rides the established numpy contract of ±1 at f32 reduction-order
+    boundaries)."""
+    from karpenter_tpu.ops.numpy_binpack import binpack_numpy
+
+    inputs = _full_operand_inputs(77, 9, seed=5)
+    ref = jax.device_get(binpack(inputs, buckets=8))
+    ref_np = binpack_numpy(inputs, buckets=8)
+    mesh = build_mesh(n_devices=n_devices)
+    out = jax.device_get(sharded_binpack(mesh, inputs, buckets=8))
+    for mirror, label in ((ref, "xla"), (ref_np, "numpy")):
+        np.testing.assert_array_equal(
+            out.assigned, np.asarray(mirror.assigned), err_msg=label
+        )
+        np.testing.assert_array_equal(
+            out.assigned_count, np.asarray(mirror.assigned_count),
+            err_msg=label,
+        )
+        np.testing.assert_array_equal(
+            out.nodes_needed, np.asarray(mirror.nodes_needed),
+            err_msg=label,
+        )
+        assert int(out.unschedulable) == int(mirror.unschedulable)
+        assert (
+            np.abs(
+                np.asarray(out.lp_bound, np.int64)
+                - np.asarray(mirror.lp_bound, np.int64)
+            ).max(initial=0)
+            <= 1
+        ), label
+
+
+def test_build_mesh_shape_override():
+    """The --shard-mesh knob: explicit (pods, groups) extents replace
+    the pods-major factorization; impossible shapes fail loudly."""
+    mesh = build_mesh(shape=(8, 1))
+    assert mesh.shape[AXIS_PODS] == 8
+    assert mesh.shape[AXIS_GROUPS] == 1
+    mesh = build_mesh(shape=(2, 4))
+    assert mesh.shape[AXIS_PODS] == 2
+    assert mesh.shape[AXIS_GROUPS] == 4
+    with pytest.raises(ValueError):
+        build_mesh(shape=(16, 2))  # more devices than exist
+    with pytest.raises(ValueError):
+        build_mesh(shape=(4, 2), slices=2)  # mutually exclusive
+
+
+def test_compat_surface_is_honest():
+    """parallel/compat.py must expose the modern sharding names and must
+    NOT carry the long-dead `jax.interpreters.sharded_jit` rung: the
+    pinned JAX (pyproject: >=0.4.30) deleted that module years ago, so
+    a ladder reaching for it would be unreachable dead weight
+    misrepresenting what this repo supports."""
+    import inspect
+
+    from karpenter_tpu.parallel import compat
+
+    assert compat.PartitionSpec is jax.sharding.PartitionSpec
+    assert compat.Mesh is jax.sharding.Mesh
+    assert compat.NamedSharding is jax.sharding.NamedSharding
+    assert callable(compat.shard_map)
+    assert callable(compat.pjit)
+    # no executable line reaches for the dead module (the docstring
+    # documenting WHY the rung is pruned is allowed to name it)
+    tree = __import__("ast").parse(inspect.getsource(compat))
+    for node in __import__("ast").walk(tree):
+        module = getattr(node, "module", "") or ""
+        assert "sharded_jit" not in module, "dead compat rung is back"
+    # and the module the pruned rung reached for really is gone
+    import importlib
+
+    with pytest.raises(ImportError):
+        importlib.import_module("jax.interpreters.sharded_jit")

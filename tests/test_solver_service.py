@@ -581,6 +581,76 @@ class TestPipelinedDispatch:
         finally:
             svc.close()
 
+    def test_closed_loop_concurrency_overlaps_dispatches(self):
+        """The PR 8 satellite pin: CLOSED-LOOP concurrent callers (the
+        bench-hotpath shape that used to publish pipeline_overlaps: 0)
+        must record >= 1 overlap. The fix: a lone coalesced batch with
+        nothing in flight splits into pipeline chunks, so chunk k+1's
+        dispatch overlaps chunk k's compute — and every result stays
+        bitwise-correct."""
+        svc = SolverService(
+            registry=GaugeRegistry(), window_s=0.2, max_batch=8,
+            adaptive_window=False, pipeline_depth=1,
+        )
+        try:
+            inputs = [make_inputs(30 + i, 3, seed=i) for i in range(8)]
+            svc.solve(inputs[0], backend="xla")  # warm
+            results = [None] * 8
+            barrier = threading.Barrier(8)
+
+            def submit(i):
+                barrier.wait()
+                results[i] = svc.solve(inputs[i], backend="xla")
+
+            threads = [
+                threading.Thread(target=submit, args=(i,))
+                for i in range(8)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for inp, out in zip(inputs, results):
+                assert_outputs_equal(out, B.solve(inp, backend="xla"))
+            assert svc.stats.pipeline_splits >= 1
+            assert svc.stats.pipeline_overlaps >= 1
+        finally:
+            svc.close()
+
+    def test_small_batches_never_split(self):
+        """The coalescing contract: batches below the split floor keep
+        riding ONE dispatch (the fixed-window straggler test above pins
+        dispatches == 1 for a pair — this pins the boundary)."""
+        svc = SolverService(
+            registry=GaugeRegistry(), window_s=0.2, max_batch=8,
+            adaptive_window=False, pipeline_depth=1,
+        )
+        try:
+            inputs = [make_inputs(25 + i, 3, seed=i) for i in range(3)]
+            svc.solve(inputs[0], backend="xla")  # warm
+            dispatches = svc.stats.dispatches
+            results = [None] * 3
+            barrier = threading.Barrier(3)
+
+            def submit(i):
+                barrier.wait()
+                results[i] = svc.solve(inputs[i], backend="xla")
+
+            threads = [
+                threading.Thread(target=submit, args=(i,))
+                for i in range(3)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert svc.stats.dispatches - dispatches == 1
+            assert svc.stats.pipeline_splits == 0
+            for inp, out in zip(inputs, results):
+                assert_outputs_equal(out, B.solve(inp, backend="xla"))
+        finally:
+            svc.close()
+
     def test_pipeline_depth_zero_is_serial(self):
         svc = SolverService(
             registry=GaugeRegistry(), window_s=0.0, pipeline_depth=0
@@ -731,3 +801,188 @@ class TestLatencyRegressionGuard:
             f"idle service p50 {service_p50 * 1e3:.2f}ms vs direct "
             f"{direct_p50 * 1e3:.2f}ms — coalescing tax is back"
         )
+
+
+class TestShardedDispatch:
+    """The PR 8 tentpole (docs/solver-service.md "Sharded dispatch"):
+    above the cell threshold a request routes through the pods x groups
+    mesh behind the SAME service seam, bit-identical to the
+    single-device program, degrading shard -> single-device -> numpy."""
+
+    def test_above_threshold_routes_through_mesh_with_parity(self):
+        svc = SolverService(registry=GaugeRegistry(), shard_threshold=1)
+        try:
+            inputs = make_inputs(333, 13, seed=3, weighted=True,
+                                 constrained=True)
+            out = svc.solve(inputs, backend="xla")
+            assert svc.stats.shard_requests == 1
+            assert svc.stats.shard_dispatches == 1
+            assert svc.stats.fallbacks == 0
+            assert_outputs_equal(out, B.solve(inputs, backend="xla"))
+            assert_outputs_equal(out, binpack_numpy(inputs, buckets=32))
+            # the sharded route is visible on the latency surface too
+            assert "upload" in svc.stage_percentiles()
+        finally:
+            svc.close()
+
+    def test_below_threshold_stays_single_device(self):
+        svc = SolverService(
+            registry=GaugeRegistry(), shard_threshold=10**9
+        )
+        try:
+            inputs = make_inputs(64, 4, seed=1)
+            out = svc.solve(inputs, backend="xla")
+            assert svc.stats.shard_requests == 0
+            assert svc.stats.shard_dispatches == 0
+            assert_outputs_equal(out, B.solve(inputs, backend="xla"))
+        finally:
+            svc.close()
+
+    def test_threshold_zero_disables_sharding(self):
+        svc = SolverService(registry=GaugeRegistry(), shard_threshold=0)
+        try:
+            svc.solve(make_inputs(300, 12, seed=2), backend="xla")
+            assert svc.stats.shard_dispatches == 0
+            assert svc._shard_mesh() is None
+        finally:
+            svc.close()
+
+    def test_single_device_mesh_shapes_stay_unsharded(self):
+        """An explicit 1x1 --shard-mesh (or a 1-device cap) must NOT
+        build a mesh: routing above-threshold traffic through the
+        inline sharded path with zero parallelism gain while reporting
+        sharding active is strictly worse than the single-device
+        program."""
+        for kwargs in (
+            {"shard_mesh_shape": (1, 1)},
+            {"shard_devices": 1},
+        ):
+            svc = SolverService(
+                registry=GaugeRegistry(), shard_threshold=1, **kwargs
+            )
+            try:
+                inputs = make_inputs(128, 6, seed=6)
+                out = svc.solve(inputs, backend="xla")
+                assert svc._shard_mesh() is None, kwargs
+                assert svc.stats.shard_dispatches == 0, kwargs
+                assert_outputs_equal(out, B.solve(inputs, backend="xla"))
+            finally:
+                svc.close()
+
+    def test_shard_failure_degrades_to_single_device_then_sticks(self):
+        """The ladder: a shard-path failure re-runs the SAME batch on
+        the single-device program (answered on device, NOT from numpy)
+        and stops routing new traffic to the mesh; reset_caches — the
+        recovery-boot seam — re-arms it."""
+        svc = SolverService(registry=GaugeRegistry(), shard_threshold=1)
+
+        def explode(*_a, **_k):
+            raise RuntimeError("injected shard failure")
+
+        svc._sharded_xla = explode
+        try:
+            inputs = make_inputs(200, 9, seed=4)
+            out = svc.solve(inputs, backend="xla")
+            assert_outputs_equal(out, B.solve(inputs, backend="xla"))
+            assert svc.stats.shard_fallbacks == 1
+            assert svc.stats.fallbacks == 0  # device answered, not numpy
+            assert svc._shard_broken
+            # subsequent traffic routes single-device straight away
+            out2 = svc.solve(inputs, backend="xla")
+            assert_outputs_equal(out2, B.solve(inputs, backend="xla"))
+            assert svc.stats.shard_fallbacks == 1
+            svc.reset_caches()
+            assert not svc._shard_broken
+        finally:
+            svc.close()
+
+    def test_shard_and_single_device_compile_families_never_alias(self):
+        """Shard-count is part of the bucket key: the same bucket shape
+        compiled sharded and unsharded must be two cache entries."""
+        svc = SolverService(registry=GaugeRegistry(), shard_threshold=1)
+        try:
+            inputs = make_inputs(128, 6, seed=8)
+            svc.solve(inputs, backend="xla")
+            misses_sharded = svc.stats.compile_cache_misses
+            assert misses_sharded >= 1
+            svc.shard_threshold = 10**12  # same shapes, unsharded now
+            svc.solve(inputs, backend="xla")
+            assert svc.stats.compile_cache_misses == misses_sharded + 1
+            # and a REPEAT on each route hits its own program
+            hits = svc.stats.compile_cache_hits
+            svc.solve(inputs, backend="xla")
+            svc.shard_threshold = 1
+            svc.solve(inputs, backend="xla")
+            assert svc.stats.compile_cache_hits == hits + 2
+            assert svc.stats.compile_cache_misses == misses_sharded + 1
+        finally:
+            svc.close()
+
+    def test_consolidate_routes_through_mesh_with_parity(self):
+        svc = SolverService(registry=GaugeRegistry(), shard_threshold=1)
+        try:
+            inputs_list = [
+                make_inputs(96, 8, seed=10 + i) for i in range(4)
+            ]
+            results = svc.consolidate(inputs_list, backend="xla")
+            assert svc.stats.shard_dispatches >= 1
+            assert svc.stats.fallbacks == 0
+            for inputs, out in zip(inputs_list, results):
+                assert_outputs_equal(
+                    out, B.solve(inputs, backend="xla")
+                )
+        finally:
+            svc.close()
+
+    def test_forecast_and_preempt_never_shard(self):
+        """This PR pins the forecast/preempt seams to the single-device
+        path: their kernels carry no sharded parity pin, so no request
+        of theirs may acquire a shard key even with the threshold
+        floored."""
+        from karpenter_tpu.forecast.models import ForecastInputs
+
+        svc = SolverService(registry=GaugeRegistry(), shard_threshold=1)
+        try:
+            rng = np.random.default_rng(0)
+            S, T = 6, 16
+            svc.forecast(
+                ForecastInputs(
+                    values=rng.uniform(0, 10, (S, T)).astype(np.float32),
+                    valid=np.ones((S, T), bool),
+                    times=np.tile(
+                        (np.arange(T, dtype=np.float32) - (T - 1)) * 10,
+                        (S, 1),
+                    ),
+                    weights=np.ones((S, T), np.float32),
+                    horizon=np.full(S, 30.0, np.float32),
+                    step_s=np.full(S, 10.0, np.float32),
+                    model=np.zeros(S, np.int32),
+                    season=np.zeros(S, np.int32),
+                    alpha=np.full(S, 0.5, np.float32),
+                    beta=np.full(S, 0.2, np.float32),
+                    gamma=np.full(S, 0.2, np.float32),
+                )
+            )
+            assert svc.stats.shard_dispatches == 0
+            assert svc.stats.shard_requests == 0
+        finally:
+            svc.close()
+
+
+class TestUploadStage:
+    def test_upload_stage_and_gauge_recorded(self):
+        """The satellite: host->device transfer isolated as its own
+        stage (the measured baseline ROADMAP item 4's device-resident
+        state attacks) and published as karpenter_solver_upload_ms."""
+        registry = GaugeRegistry()
+        svc = SolverService(registry=registry)
+        try:
+            svc.solve(make_inputs(64, 4, seed=3), backend="xla")
+            stages = svc.stage_percentiles()
+            assert "upload" in stages
+            assert stages["upload"]["n"] >= 1
+            svc.publish_gauges()
+            text = registry.expose_text()
+            assert "karpenter_solver_upload_ms" in text
+        finally:
+            svc.close()
