@@ -85,6 +85,10 @@ bench-journal: ## Protective-state journal overhead on the reconcile hot path (t
 	$(PYTHON) bench.py --journal --journal-ticks 40 \
 		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
 
+bench-trace: ## Reconcile-tracing overhead on the hot path: tracer enabled vs disabled, interleaved (target <5% tick-latency regression); appends a BENCHMARKS row + publishes to BASELINE.json
+	$(PYTHON) bench.py --trace --trace-ticks 200 \
+		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
+
 bench-shard: ## Sharded fleet-scale solve (1M pods x 1k types through the SolverService seam on an 8-device mesh, 1/2/4/8 scaling + parity pins); appends a BENCHMARKS row + publishes to BASELINE.json
 	$(PYTHON) bench.py --shard --pods 1000000 --types 1000 \
 		--backend xla --iters 3 --shard-scaling 1,2,4,8 \
@@ -128,5 +132,5 @@ kind-smoke: ## Deploy smoke on kind: image -> apply -> pod Ready -> one HA end t
 
 .PHONY: help dev ci test test-chaos test-recovery battletest verify codegen \
 	docs native bench bench-solver bench-consolidate bench-forecast \
-	bench-preempt bench-journal bench-shard dryrun image publish apply \
-	delete kind-load conformance kind-smoke
+	bench-preempt bench-journal bench-trace bench-shard dryrun image \
+	publish apply delete kind-load conformance kind-smoke

@@ -116,6 +116,16 @@ def parse_args(argv=None) -> argparse.Namespace:
         help="start the JAX profiler server on this port (0 = off)",
     )
     parser.add_argument(
+        "--trace-export",
+        default=None,
+        metavar="FILE",
+        help="export reconcile traces as Chrome-trace/Perfetto JSONL to "
+        "FILE at exit (docs/observability.md); with --simulate and no "
+        "other scenario flag, replays a seeded end-to-end scenario "
+        "(tick -> coalesced solver dispatch -> actuation) and exports "
+        "its trace",
+    )
+    parser.add_argument(
         "--duration",
         type=float,
         default=float("inf"),
@@ -298,10 +308,28 @@ def _parse_mesh_shape(spec):
     return shape
 
 
-def _run_simulation(args, store) -> int:
+def _run_simulation(args, store) -> int:  # lint: allow-complexity — simulation mode dispatch, one arm per replay flag
     import json
 
     from karpenter_tpu.simulate import simulate, simulate_delta
+
+    if args.trace_export and not (
+        args.forecast or args.restart_storm or args.preempt
+        or args.consolidate or args.what_if
+    ):
+        # the traced end-to-end replay (docs/observability.md): a seeded
+        # consolidating world driven tick by tick, exporting a trace in
+        # which the coalesced solver dispatch links the candidate
+        # request spans and the SNG actuation closes the e2e window
+        from karpenter_tpu.simulate import simulate_trace
+
+        report = simulate_trace(export_path=args.trace_export)
+        # simulate_trace already exported (the report pins the event
+        # count): clear the flag so main's exit-time _export_trace
+        # doesn't rewrite the identical file
+        args.trace_export = None
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return 0
 
     if args.forecast:
         # self-contained replay (no store, no provider): proactive vs
@@ -396,6 +424,42 @@ def _run_simulation(args, store) -> int:
     finally:
         runtime.close()
     return 0
+
+
+def _export_trace(args) -> None:
+    """Flush the reconcile-span ring as Chrome-trace JSONL when
+    --trace-export names a file (docs/observability.md)."""
+    if not args.trace_export:
+        return
+    from karpenter_tpu.observability import default_tracer
+
+    events = default_tracer().export_jsonl(args.trace_export)
+    print(
+        f"exported {events} trace event(s) to {args.trace_export}",
+        file=sys.stderr,
+    )
+
+
+def _readiness(runtime):
+    """/readyz wired to REAL state (docs/observability.md): NOT ready
+    during the recovery warm-up (fleet state unconfirmed — disruption is
+    gated too) and while the solver backend-health FSM is tripped
+    (decisions are numpy-degraded). /healthz stays liveness-only."""
+    from karpenter_tpu.solver.service import HEALTHY
+
+    def check():
+        recovery = runtime.recovery
+        if recovery is not None and recovery.warmup_remaining > 0:
+            return False, (
+                f"recovery warm-up: {recovery.warmup_remaining} "
+                "tick(s) remaining"
+            )
+        health = runtime.solver_service.backend_health()
+        if health != HEALTHY:
+            return False, f"solver backend {health}"
+        return True, "ok"
+
+    return check
 
 
 def _make_store(args):
@@ -502,6 +566,7 @@ def main(argv=None) -> int:
         try:
             return _run_simulation(args, store)
         finally:
+            _export_trace(args)
             if store is not None:
                 store.close()
     runtime = KarpenterRuntime(
@@ -530,7 +595,11 @@ def main(argv=None) -> int:
         ),
         store=store,
     )
-    metrics_server = MetricsServer(runtime.registry, port=args.metrics_port)
+    metrics_server = MetricsServer(
+        runtime.registry,
+        port=args.metrics_port,
+        readiness=_readiness(runtime),
+    )
     port = metrics_server.start()
     print(f"serving /metrics and /healthz on :{port}", file=sys.stderr)
     webhook_server = _start_webhook_server(args)
@@ -548,6 +617,7 @@ def main(argv=None) -> int:
     try:
         _run_loop(args, runtime, elector)
     finally:
+        _export_trace(args)
         metrics_server.stop()
         if webhook_server is not None:
             webhook_server.stop()

@@ -40,6 +40,7 @@ from typing import Dict, List, Optional, Protocol
 
 from karpenter_tpu.api import conditions as cond
 from karpenter_tpu.controllers.errors import is_retryable
+from karpenter_tpu.observability import default_tracer
 from karpenter_tpu.resilience import DecorrelatedJitterBackoff
 from karpenter_tpu.store import Store
 from karpenter_tpu.utils.log import logger
@@ -87,6 +88,11 @@ class Manager:
         # runtime series with no extra wiring in __main__.py
         self._solver_service = solver_service
         self._controllers: List[Controller] = []
+        # kinds whose controller ACKS the e2e lead-time mark
+        # (`acks_e2e = True`, the SNG controller): marks are only
+        # stamped for these — stamping every kind would be per-object
+        # tracer-lock traffic on the hot path that no ack ever reads
+        self._e2e_kinds: set = set()
         # (kind, namespace, name) -> next due time; 0 = due now,
         # inf = deactivated (revived only by a watch event)
         self._due: Dict[tuple, float] = {}
@@ -129,6 +135,8 @@ class Manager:
         """reference: manager.go:59-71"""
         for controller in controllers:
             self._controllers.append(controller)
+            if getattr(controller, "acks_e2e", False):
+                self._e2e_kinds.add(controller.kind())
             self.store.watch(controller.kind(), self._on_event)
         return self
 
@@ -137,6 +145,7 @@ class Manager:
         if event == "Deleted":
             self._due.pop(key, None)
             self._drop_backoff(key)
+            default_tracer().drop_observed(key)
             # controllers may keep per-object state of their own (the
             # SNG controller's circuit breakers + gauge series): give
             # them the same pruning signal the engine's maps get
@@ -150,11 +159,35 @@ class Manager:
             # actuation, DESIGN.md:435) — including the inf requeue of a
             # DEACTIVATED object: an external edit is the revival signal
             self._due[key] = 0.0
+            # event-observed stamp for the end-to-end lead-time
+            # histogram (karpenter_reconcile_e2e_seconds), only for
+            # kinds whose controller acks it. overwrite=False: EVERY
+            # store write notifies here — including this engine's own
+            # per-reconcile status patches — so a pending mark must
+            # survive re-notification or a multi-tick actuation would
+            # be measured from its last self-patch (~one tick) instead
+            # of the triggering event. The earliest stamp since the
+            # mark was last retired IS the divergence observation: the
+            # SNG controller acks the mark on actuation and drops it
+            # on every converged reconcile, and the validation/
+            # deactivation paths drop it too, so a stamp never
+            # predates the divergence by more than one reconcile
+            # interval
+            if obj.KIND in self._e2e_kinds:
+                default_tracer().mark_observed(key, overwrite=False)
 
     # -- the generic workflow (reference: controller.go:67-97) -------------
 
     def _finish(self, controller, obj, error: Optional[Exception]) -> None:
         mgr = obj.status_conditions()
+        if error is not None and obj.KIND in self._e2e_kinds:
+            # a failed reconcile proved nothing about convergence: keep
+            # the mark and a converged-but-flapping object would carry
+            # it into a much later actuation's karpenter_reconcile_e2e_
+            # seconds sample. Dropping under-reports lead during fault
+            # windows instead — the conservative direction (degraded-
+            # path visibility is the flight recorder's job)
+            default_tracer().drop_observed(self._key_of(obj))
         if error is not None:
             mgr.mark_false(cond.ACTIVE, "", str(error))
             logger().error(
@@ -226,6 +259,10 @@ class Manager:
         # revive a DEACTIVATED object through a stale finite due time
         # restored from the journal
         self._drop_backoff(key)
+        # a deactivated object will not actuate until revived: retire
+        # any pending e2e mark so the revival's actuation measures from
+        # the reviving edit, not from before the deactivation
+        default_tracer().drop_observed(key)
         self._due[key] = _NEVER
         if self._deactivated_gauge is not None:
             self._deactivated_gauge.inc(key[0], "-")
@@ -316,14 +353,32 @@ class Manager:
         if not due_objs:
             return
 
+        tracer = default_tracer()
+        e2e = kind in self._e2e_kinds
         valid_objs = []
         for obj in due_objs:
             error = self._validate(obj)
             if error is not None:
+                # _finish retires any pending e2e mark on the error
+                # path: an invalid object cannot actuate, and an
+                # hours-later revival must not measure its lead time
+                # from a stamp that predates the fix
                 self._finish(controller, obj, error)
             else:
+                if e2e:
+                    # interval-driven reconciles have no watch event:
+                    # the tick entry IS the observation point for the
+                    # e2e lead time (stamped AFTER validation — a
+                    # failing object never accrues a mark). setdefault
+                    # semantics — an earlier event stamp wins.
+                    tracer.mark_observed(
+                        self._key_of(obj), overwrite=False
+                    )
                 valid_objs.append(obj)
-        self._dispatch(controller, valid_objs)
+        with tracer.span(
+            f"reconcile.{kind}", objects=len(valid_objs)
+        ):
+            self._dispatch(controller, valid_objs)
 
     def _dispatch(self, controller, valid_objs) -> None:
         """Batch path when the controller offers one, else per-object."""
@@ -346,11 +401,19 @@ class Manager:
                 self._finish(controller, obj, error)
 
     def reconcile_all(self) -> None:
-        """One manager tick: every due object of every controller."""
+        """One manager tick: every due object of every controller.
+
+        The tick is a reconcile-trace entry point (docs/observability.md):
+        a trace id is minted here and every span opened inside — the
+        per-kind reconcile, the HA fleet decide, solver requests, SNG
+        actuation — inherits it through the tracer's thread-local
+        stack, so one trace connects a watch event to the coalesced
+        dispatch to the provider write it caused."""
         start = _time.perf_counter()
         now = self.clock()
-        for controller in self._controllers:
-            self._reconcile_controller(controller, now)
+        with default_tracer().trace("reconcile.tick"):
+            for controller in self._controllers:
+                self._reconcile_controller(controller, now)
         if self._solver_service is not None:
             self._solver_service.publish_gauges()
         if self._tick_hook is not None:

@@ -43,12 +43,22 @@ from typing import Dict
 from karpenter_tpu.api import conditions as cond
 from karpenter_tpu.api.scalablenodegroup import ScalableNodeGroup
 from karpenter_tpu.controllers.errors import error_code, is_retryable
+from karpenter_tpu.observability import (
+    default_flight_recorder,
+    default_tracer,
+)
 from karpenter_tpu.resilience import CLOSED as resilience_CLOSED
 from karpenter_tpu.resilience import CircuitBreaker
 from karpenter_tpu.utils.log import logger
 
 
 class ScalableNodeGroupController:
+    # this controller ACKS the e2e lead-time mark (ack_observed on the
+    # provider-write return, drop_observed on convergence): the engine
+    # only stamps marks for kinds that declare this — stamping kinds
+    # nothing acks would be pure hot-path overhead (engine._on_event)
+    acks_e2e = True
+
     def __init__(
         self,
         cloud_provider_factory,
@@ -291,10 +301,18 @@ class ScalableNodeGroupController:
         # check), the corrective shrink is exactly the action that
         # unsticks it, and blocking it would deadlock the resource.
         if resource.spec.replicas is None or resource.spec.replicas == observed:
+            # converged, nothing to actuate: retire any e2e observation
+            # mark — a stale stamp must not inflate a later ack's
+            # karpenter_reconcile_e2e_seconds sample
+            default_tracer().drop_observed(self._e2e_key(resource))
             return
         if not stable and resource.spec.replicas > observed:
             return
         self._set_replicas(node_group, resource)
+        # the provider write returned: the actuation is ACKED — close
+        # the event-observed -> actuation-acked window (the BLITZSCALE
+        # lead-time observable, docs/observability.md)
+        default_tracer().ack_observed(self._e2e_key(resource))
         logger().debug(
             "ScalableNodeGroup %s updated nodes %d -> %d",
             resource.spec.id,
@@ -338,6 +356,16 @@ class ScalableNodeGroupController:
                 akey[0], akey[1], intent.get("target"), observed, outcome,
             )
 
+    @staticmethod
+    def _e2e_key(resource) -> tuple:
+        """The engine's object key — where the manager stamped the
+        event-observed time this controller's ack closes."""
+        return (
+            resource.KIND,
+            resource.metadata.namespace,
+            resource.metadata.name,
+        )
+
     def _set_replicas(self, node_group, resource) -> None:
         """The one provider-write door. Unfenced (no RecoveryManager):
         the plain call, byte-compatible with every existing provider
@@ -346,23 +374,29 @@ class ScalableNodeGroupController:
         success. A raised provider call leaves the intent UN-acked —
         its fate is unknown (a timeout may have landed), and the next
         reconcile's observation resolves it idempotently."""
-        if self.fence is None:
-            node_group.set_replicas(resource.spec.replicas)
-            return
-        akey = (resource.metadata.namespace, resource.metadata.name)
-        intent = {
-            "target": resource.spec.replicas,
-            "gen": self.fence.generation,
-        }
-        self._intents[akey] = intent
-        if self._j_actuation is not None:
-            self._j_actuation.set(akey, intent)
-        node_group.set_replicas(
-            resource.spec.replicas, token=self.fence.token()
-        )
-        self._intents.pop(akey, None)
-        if self._j_actuation is not None:
-            self._j_actuation.delete(akey)
+        with default_tracer().span(
+            "actuate.set_replicas",
+            group=resource.spec.id,
+            target=resource.spec.replicas,
+            fenced=self.fence is not None,
+        ):
+            if self.fence is None:
+                node_group.set_replicas(resource.spec.replicas)
+                return
+            akey = (resource.metadata.namespace, resource.metadata.name)
+            intent = {
+                "target": resource.spec.replicas,
+                "gen": self.fence.generation,
+            }
+            self._intents[akey] = intent
+            if self._j_actuation is not None:
+                self._j_actuation.set(akey, intent)
+            node_group.set_replicas(
+                resource.spec.replicas, token=self.fence.token()
+            )
+            self._intents.pop(akey, None)
+            if self._j_actuation is not None:
+                self._j_actuation.delete(akey)
 
     def _finish_scale_down(
         self, resource, mgr, observed: int, stable: bool, message: str
@@ -429,6 +463,15 @@ class ScalableNodeGroupController:
                 self._c_opens.inc(
                     resource.metadata.name, resource.metadata.namespace
                 )
+            # flight-recorder event (trace id captured from the tick
+            # span): which group's actuation went dark, and on what code
+            default_flight_recorder().record(
+                "circuit_open",
+                group=f"{resource.metadata.namespace}/"
+                      f"{resource.metadata.name}",
+                failures=breaker.consecutive_failures,
+                code=breaker.last_error_code or error_code(err) or "",
+            )
 
     def reconcile(self, resource) -> None:
         mgr = resource.status_conditions()
@@ -438,6 +481,13 @@ class ScalableNodeGroupController:
             # whole point of the breaker is that a flapping cloud API
             # stops consuming reconcile time. The resource stays Active
             # (this is a supervised degradation, not a resource fault).
+            # Retire any pending e2e mark: convergence is UNKNOWABLE
+            # without the provider, and a mark accrued on a converged
+            # group during a flap would inflate the next real
+            # actuation's lead time by the whole outage. Conservative
+            # trade: lead during a circuit-open window is under-
+            # reported (the flight recorder carries that story).
+            default_tracer().drop_observed(self._e2e_key(resource))
             self._mark_circuit_open(resource, breaker)
             self._publish_circuit(resource, breaker)
             return

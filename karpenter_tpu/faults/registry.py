@@ -205,6 +205,14 @@ class FaultRegistry:
             if self._c_injected is not None:
                 self._c_injected.inc(point, "-")
             release = self._release
+        # flight-recorder breadcrumb BEFORE executing (a crash plan
+        # raises out of _execute): the chaos timeline shows what was
+        # injected where, backlinked to the reconcile trace it hit
+        from karpenter_tpu.observability import default_flight_recorder
+
+        default_flight_recorder().record(
+            "fault_injected", point=point, mode=plan.mode,
+        )
         self._execute(plan, point, release)
 
     def _execute(

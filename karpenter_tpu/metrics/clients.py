@@ -26,6 +26,7 @@ from karpenter_tpu.controllers.errors import RetryableError
 from karpenter_tpu.faults import inject
 from karpenter_tpu.metrics.registry import GaugeRegistry, default_registry
 from karpenter_tpu.metrics.types import Metric
+from karpenter_tpu.observability import default_tracer
 from karpenter_tpu.utils.log import invariant_violated
 
 _SELECTOR_RE = re.compile(
@@ -96,8 +97,15 @@ class RegistryMetricsClient:
         self.observer = observer
 
     def get_current_value(self, metric_spec) -> Metric:
-        inject("metrics.query")
         query = metric_spec.prometheus.query
+        # inject sits INSIDE the span so a latency/hang chaos plan at
+        # metrics.query shows up as metrics.query time in the trace,
+        # not as an unexplained gap in the parent reconcile span
+        with default_tracer().span("metrics.query", query=query):
+            inject("metrics.query")
+            return self._evaluate(query)
+
+    def _evaluate(self, query: str) -> Metric:
         name, labels = parse_instant_selector(query)
         vec = self.registry.lookup_by_full_name(name)
         if vec is None:
@@ -134,8 +142,16 @@ class PrometheusMetricsClient:
         self.observer = observer
 
     def get_current_value(self, metric_spec) -> Metric:
-        inject("metrics.query")
         query = metric_spec.prometheus.query
+        # the HTTP query is the metrics path with REAL network latency —
+        # exactly what the trace must attribute
+        with default_tracer().span(
+            "metrics.query", query=query, backend="prometheus"
+        ):
+            inject("metrics.query")
+            return self._query(query)
+
+    def _query(self, query: str) -> Metric:
         data = urllib.parse.urlencode({"query": query}).encode()
         request = urllib.request.Request(
             f"{self.uri}/api/v1/query",

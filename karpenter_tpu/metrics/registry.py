@@ -1,4 +1,4 @@
-"""Gauge registry with Prometheus naming and text exposition.
+"""Metric registry with Prometheus naming and text exposition.
 
 reference: pkg/metrics/gauge.go:22-50 — gauges named
 karpenter_<subsystem>_<name>, labeled {name, namespace}, registered into the
@@ -7,18 +7,71 @@ registry doubles as the metrics STORE: the in-process metrics client reads
 gauge values directly (no scrape hop), while the /metrics text exposition
 (karpenter_tpu.observability) keeps drop-in Prometheus compatibility for
 external scrapers.
+
+Beyond the reference's gauges the registry carries counters and NATIVE
+HISTOGRAMS (`kind="histogram"`, per-vec bucket ladders): cumulative
+`_bucket{le=...}` series, `_sum`/`_count`, and `+Inf` always present —
+the shape promtool expects, pinned by the exposition-conformance tests.
+The solver stage latencies, coalesce batch sizes, and the end-to-end
+`karpenter_reconcile_e2e_seconds` lead time (docs/observability.md)
+export through it as real histograms, so Prometheus
+`histogram_quantile()` works instead of the pre-histogram p50/p99 gauge
+snapshots.
 """
 
 from __future__ import annotations
 
+import bisect
 import math
 import threading
 from dataclasses import dataclass
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 METRIC_NAMESPACE = "karpenter"
 LABEL_NAME = "name"
 LABEL_NAMESPACE = "namespace"
+
+# default histogram ladder (seconds): sub-ms device dispatches through
+# multi-second cloud actuations
+DEFAULT_HISTOGRAM_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0,
+)
+
+
+def escape_label_value(value: str) -> str:
+    """Prometheus text-format label escaping: backslash, double quote,
+    and newline must be escaped inside label values — an unescaped
+    quote in an object name would corrupt every series after it."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _format_value(value: float) -> str:
+    if math.isnan(value):
+        return "NaN"
+    if math.isinf(value):
+        return "+Inf" if value > 0 else "-Inf"
+    return repr(value)
+
+
+def _format_le(bound: float) -> str:
+    """Bucket bounds render promtool-style: '+Inf', integers bare,
+    floats shortest ('0.005', not '0.005000000000000001')."""
+    if math.isinf(bound):
+        return "+Inf"
+    return f"{bound:g}"
+
+
+def _render_labels(labels: Dict[str, str]) -> str:
+    return ",".join(
+        f'{k}="{escape_label_value(v)}"'
+        for k, v in sorted(labels.items())
+    )
 
 
 @dataclass
@@ -64,27 +117,169 @@ class GaugeVec:
                 for (n, ns), v in sorted(self._samples.items())
             ]
 
+    def expose_lines(self) -> List[str]:
+        lines = [
+            f"# HELP {self.full_name} {self.help}",
+            f"# TYPE {self.full_name} {self.kind}",
+        ]
+        for sample in self.samples():
+            lines.append(
+                f"{self.full_name}{{{_render_labels(sample.labels)}}} "
+                f"{_format_value(sample.value)}"
+            )
+        return lines
+
+
+class HistogramVec:
+    """A native Prometheus histogram parameterized by {name, namespace}
+    labels: per-series bucket counts + sum, exposed as cumulative
+    `_bucket{le=...}` / `_sum` / `_count` with `+Inf` always present.
+
+    Buckets are upper bounds, strictly increasing; `+Inf` is implicit
+    (and stripped if passed). observe() is O(log buckets) under the vec
+    lock — cumulation happens at exposition, not on the hot path."""
+
+    def __init__(self, full_name: str, help_text: str, buckets=None):
+        self.full_name = full_name
+        self.help = help_text
+        self.kind = "histogram"
+        bounds = sorted(
+            float(b) for b in (buckets or DEFAULT_HISTOGRAM_BUCKETS)
+            if not math.isinf(float(b))
+        )
+        if not bounds:
+            raise ValueError(f"{full_name}: histogram needs finite buckets")
+        if len(set(bounds)) != len(bounds):
+            raise ValueError(f"{full_name}: duplicate histogram buckets")
+        self.buckets: Tuple[float, ...] = tuple(bounds)
+        # per series: [per-bucket counts..., +Inf overflow count], sum
+        self._counts: Dict[Tuple[str, str], List[int]] = {}
+        self._sums: Dict[Tuple[str, str], float] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, name: str, namespace: str, value: float) -> None:
+        value = float(value)
+        idx = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            key = (name, namespace)
+            counts = self._counts.get(key)
+            if counts is None:
+                counts = self._counts[key] = [0] * (len(self.buckets) + 1)
+                self._sums[key] = 0.0
+            counts[idx] += 1
+            self._sums[key] += value
+
+    def get(self, name: str, namespace: str) -> Optional[float]:
+        """Vec-protocol read (the in-process metrics client resolves
+        metric names through the registry): a histogram reads as its
+        observation COUNT — the only scalar that is well-defined."""
+        with self._lock:
+            counts = self._counts.get((name, namespace))
+            return None if counts is None else float(sum(counts))
+
+    def count(self, name: str, namespace: str) -> int:
+        with self._lock:
+            counts = self._counts.get((name, namespace))
+            return 0 if counts is None else sum(counts)
+
+    def sum(self, name: str, namespace: str) -> float:
+        with self._lock:
+            return self._sums.get((name, namespace), 0.0)
+
+    def remove(self, name: str, namespace: str) -> None:
+        with self._lock:
+            self._counts.pop((name, namespace), None)
+            self._sums.pop((name, namespace), None)
+
+    def series(self) -> List[Tuple[str, str]]:
+        with self._lock:
+            return sorted(self._counts)
+
+    def samples(self):
+        """Vec-protocol view (the in-process metrics client iterates
+        samples()): one sample per series, valued at its observation
+        count — same scalar get() reports."""
+        with self._lock:
+            return [
+                GaugeSample(
+                    {LABEL_NAME: n, LABEL_NAMESPACE: ns},
+                    float(sum(counts)),
+                )
+                for (n, ns), counts in sorted(self._counts.items())
+            ]
+
+    def expose_lines(self) -> List[str]:
+        lines = [
+            f"# HELP {self.full_name} {self.help}",
+            f"# TYPE {self.full_name} histogram",
+        ]
+        with self._lock:
+            snapshot = [
+                (key, list(counts), self._sums[key])
+                for key, counts in sorted(self._counts.items())
+            ]
+        bounds = [*self.buckets, math.inf]
+        for (name, namespace), counts, total in snapshot:
+            base = {LABEL_NAME: name, LABEL_NAMESPACE: namespace}
+            cumulative = 0
+            for bound, count in zip(bounds, counts):
+                cumulative += count
+                labels = _render_labels({**base, "le": _format_le(bound)})
+                lines.append(
+                    f"{self.full_name}_bucket{{{labels}}} {cumulative}"
+                )
+            labels = _render_labels(base)
+            lines.append(
+                f"{self.full_name}_sum{{{labels}}} "
+                f"{_format_value(total)}"
+            )
+            lines.append(f"{self.full_name}_count{{{labels}}} {cumulative}")
+        return lines
+
 
 class GaugeRegistry:
     def __init__(self):
-        self._gauges: Dict[str, Dict[str, GaugeVec]] = {}
+        self._gauges: Dict[str, Dict[str, object]] = {}
         self._lock = threading.Lock()
 
     def register(
-        self, subsystem: str, name: str, kind: str = "gauge"
-    ) -> GaugeVec:
-        """reference: gauge.go:35-50 (RegisterNewGauge)."""
+        self, subsystem: str, name: str, kind: str = "gauge", buckets=None
+    ):
+        """reference: gauge.go:35-50 (RegisterNewGauge); kind="histogram"
+        (optionally with a `buckets` ladder) registers a HistogramVec."""
         full = f"{METRIC_NAMESPACE}_{subsystem}_{name}"
         with self._lock:
             sub = self._gauges.setdefault(subsystem, {})
             vec = sub.get(name)
             if vec is None:
-                vec = sub[name] = GaugeVec(
-                    full,
-                    "Metric computed by a karpenter metrics producer "
-                    "corresponding to name and namespace labels",
-                    kind=kind,
-                )
+                if kind == "histogram":
+                    vec = sub[name] = HistogramVec(
+                        full,
+                        "Metric computed by a karpenter metrics producer "
+                        "corresponding to name and namespace labels",
+                        buckets=buckets,
+                    )
+                else:
+                    vec = sub[name] = GaugeVec(
+                        full,
+                        "Metric computed by a karpenter metrics producer "
+                        "corresponding to name and namespace labels",
+                        kind=kind,
+                    )
+            elif kind == "histogram" and vec.kind == "histogram":
+                # the bucket ladder is decided at first registration
+                # like the TYPE line: a second caller silently landing
+                # observations in a ladder it never chose would skew
+                # histogram_quantile() with no error anywhere
+                if buckets is not None and tuple(
+                    sorted(float(b) for b in buckets
+                           if not math.isinf(float(b)))
+                ) != vec.buckets:
+                    raise ValueError(
+                        f"{full} already registered with buckets "
+                        f"{vec.buckets}; conflicting ladder "
+                        f"{tuple(buckets)}"
+                    )
             elif vec.kind != kind:
                 # the TYPE line is decided at first registration; a silent
                 # mismatch would expose a counter as a gauge (or vice
@@ -94,11 +289,11 @@ class GaugeRegistry:
                 )
             return vec
 
-    def gauge(self, subsystem: str, name: str) -> GaugeVec:
+    def gauge(self, subsystem: str, name: str):
         with self._lock:
             return self._gauges[subsystem][name]
 
-    def lookup_by_full_name(self, full_name: str) -> Optional[GaugeVec]:
+    def lookup_by_full_name(self, full_name: str):
         with self._lock:
             for sub in self._gauges.values():
                 for vec in sub.values():
@@ -108,24 +303,11 @@ class GaugeRegistry:
 
     def expose_text(self) -> str:
         """Prometheus text exposition format of all samples."""
-        lines = []
         with self._lock:
             vecs = [v for sub in self._gauges.values() for v in sub.values()]
+        lines: List[str] = []
         for vec in sorted(vecs, key=lambda v: v.full_name):
-            lines.append(f"# HELP {vec.full_name} {vec.help}")
-            lines.append(f"# TYPE {vec.full_name} {vec.kind}")
-            for sample in vec.samples():
-                labels = ",".join(
-                    f'{k}="{v}"' for k, v in sorted(sample.labels.items())
-                )
-                value = sample.value
-                if math.isnan(value):
-                    rendered = "NaN"
-                elif math.isinf(value):
-                    rendered = "+Inf" if value > 0 else "-Inf"
-                else:
-                    rendered = repr(value)
-                lines.append(f"{vec.full_name}{{{labels}}} {rendered}")
+            lines.extend(vec.expose_lines())
         return "\n".join(lines) + "\n"
 
 

@@ -1,0 +1,54 @@
+"""Observability package: tracing, flight recorder, metrics/debug HTTP.
+
+Grown from the single-module observability.py (which held MetricsServer
+and the xprof hooks) into the correlation layer for the whole control
+plane — docs/observability.md is the operator guide:
+
+  tracing.py         per-reconcile trace IDs + spans, coalesced-dispatch
+                     links, Chrome-trace/Perfetto JSONL export, and the
+                     karpenter_reconcile_e2e_seconds lead-time histogram
+  flightrecorder.py  bounded structured event ring (fault injections,
+                     FSM trips, circuit opens, fence rejections, shard
+                     fallbacks, journal compactions) with trace-ID
+                     backlinks and crash-safe dumps into --journal-dir
+  server.py          /metrics, /healthz (liveness), /readyz (real
+                     readiness), /debug/traces, /debug/flightrecorder
+  profiler.py        device-timeline annotations (solver_trace, probed
+                     once) + the xprof profiler server
+
+The public names below are the pre-package import surface — existing
+importers (`from karpenter_tpu.observability import MetricsServer,
+solver_trace, start_profiler_server`) are unchanged.
+"""
+
+from karpenter_tpu.observability.flightrecorder import (
+    FlightRecorder,
+    default_flight_recorder,
+    reset_default_flight_recorder,
+    set_default_flight_recorder,
+)
+from karpenter_tpu.observability.profiler import (
+    solver_trace,
+    start_profiler_server,
+)
+from karpenter_tpu.observability.server import MetricsServer
+from karpenter_tpu.observability.tracing import (
+    Tracer,
+    default_tracer,
+    reset_default_tracer,
+    set_default_tracer,
+)
+
+__all__ = [
+    "FlightRecorder",
+    "MetricsServer",
+    "Tracer",
+    "default_flight_recorder",
+    "default_tracer",
+    "reset_default_flight_recorder",
+    "reset_default_tracer",
+    "set_default_flight_recorder",
+    "set_default_tracer",
+    "solver_trace",
+    "start_profiler_server",
+]

@@ -1,0 +1,174 @@
+"""Observability HTTP surface: /metrics, health, and the debug endpoints.
+
+reference: the manager serves controller metrics on :8080
+(cmd/controller/main.go:52,61) scraped by a dedicated Prometheus via a 5s
+ServiceMonitor (config/prometheus/monitor.yaml:10-14); health/readiness
+come from the manager. Here the same server additionally serves:
+
+  /healthz               liveness ONLY: the process is up and serving —
+                         always "ok" (a degraded-but-supervising control
+                         plane must NOT be restarted by its liveness
+                         probe; degradation is what /readyz reports)
+  /readyz                readiness wired to REAL state via the
+                         `readiness` callable: 503 during recovery
+                         warm-up ticks and while the solver backend
+                         health FSM is tripped (__main__.py wires it)
+  /metrics               Prometheus text exposition (gauges, counters,
+                         and native histograms — metrics/registry.py)
+  /debug/traces          recent reconcile spans as JSON (?limit=N),
+                         same records `--trace-export` writes as
+                         Chrome-trace JSONL (observability.tracing)
+  /debug/flightrecorder  the flight-recorder event ring as JSON
+                         (?kind=fsm_trip filters)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
+
+from karpenter_tpu.metrics.registry import GaugeRegistry
+
+# readiness callable contract: () -> (ready, reason)
+ReadinessCheck = Callable[[], Tuple[bool, str]]
+
+
+class MetricsServer:
+    """Serves the gauge registry in Prometheus text exposition format
+    plus the health/debug endpoints (module docstring).
+
+    port=0 binds an ephemeral port (tests); `port` attribute holds the
+    bound port after start(). `readiness` gates /readyz (None = always
+    ready); `tracer`/`recorder` back the debug endpoints (None = the
+    process defaults).
+    """
+
+    def __init__(
+        self,
+        registry: GaugeRegistry,
+        port: int = 8080,
+        host: str = "0.0.0.0",
+        readiness: Optional[ReadinessCheck] = None,
+        tracer=None,
+        recorder=None,
+    ):
+        self.registry = registry
+        self.host = host
+        self.port = port
+        self.readiness = readiness
+        self._tracer = tracer
+        self._recorder = recorder
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def _tracer_or_default(self):
+        if self._tracer is not None:
+            return self._tracer
+        from karpenter_tpu.observability.tracing import default_tracer
+
+        return default_tracer()
+
+    def _recorder_or_default(self):
+        if self._recorder is not None:
+            return self._recorder
+        from karpenter_tpu.observability.flightrecorder import (
+            default_flight_recorder,
+        )
+
+        return default_flight_recorder()
+
+    # -- responses ---------------------------------------------------------
+
+    def _respond_ready(self) -> Tuple[int, bytes, str]:
+        if self.readiness is None:
+            return 200, b"ok", "text/plain"
+        try:
+            ready, reason = self.readiness()
+        except Exception as error:  # noqa: BLE001 — a broken check is NOT ready
+            ready, reason = False, f"readiness check failed: {error}"
+        if ready:
+            return 200, b"ok", "text/plain"
+        return 503, reason.encode(), "text/plain"
+
+    def _respond_traces(self, query: dict) -> Tuple[int, bytes, str]:
+        limit = None
+        try:
+            if "limit" in query:
+                limit = int(query["limit"][0])
+        except (ValueError, IndexError):
+            limit = None
+        tracer = self._tracer_or_default()
+        body = json.dumps({
+            "epoch_unix": tracer.epoch_unix,
+            "spans_total": tracer.spans_total,
+            "spans_dropped": tracer.spans_dropped,
+            "spans": tracer.snapshot(limit=limit),
+        }, sort_keys=True).encode()
+        return 200, body, "application/json"
+
+    def _respond_flightrecorder(self, query: dict) -> Tuple[int, bytes, str]:
+        kind = query.get("kind", [None])[0]
+        body = json.dumps({
+            "events": self._recorder_or_default().events(kind=kind),
+        }, sort_keys=True).encode()
+        return 200, body, "application/json"
+
+    def _route(self, path: str, query: dict) -> Optional[Tuple[int, bytes, str]]:
+        """(status, body, content-type) or None for 404."""
+        if path in ("", "/healthz"):
+            return 200, b"ok", "text/plain"
+        if path == "/readyz":
+            return self._respond_ready()
+        if path == "/metrics":
+            return (
+                200,
+                self.registry.expose_text().encode(),
+                "text/plain; version=0.0.4",
+            )
+        if path == "/debug/traces":
+            return self._respond_traces(query)
+        if path == "/debug/flightrecorder":
+            return self._respond_flightrecorder(query)
+        return None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> int:
+        route = self._route
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                split = urlsplit(self.path)
+                response = route(
+                    split.path.rstrip("/"), parse_qs(split.query)
+                )
+                if response is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                status, body, content_type = response
+                self.send_response(status)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # quiet: scrapes every 5s
+                pass
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None

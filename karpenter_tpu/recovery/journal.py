@@ -395,6 +395,17 @@ class StateJournal:
             self._checkpoint_path,
             json.dumps({"state": state}, sort_keys=True),
         )
+        # post-mortem breadcrumb (observability.flightrecorder): a
+        # compaction re-bounds the journal — record how much it folded
+        # so a restart-storm timeline shows journal growth vs re-bounds
+        from karpenter_tpu.observability import default_flight_recorder
+
+        default_flight_recorder().record(
+            "journal_compaction",
+            records=self._count,
+            bytes=self._bytes,
+            tables=len(state),
+        )
         self._last_checkpoint = _time.monotonic()
         if self._file is not None and not self._file.closed:
             self._file.close()

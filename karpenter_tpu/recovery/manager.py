@@ -182,6 +182,13 @@ class RecoveryManager:
         rejects a stale stamp (karpenter_recovery_fence_rejections_total)."""
         if self._c_fence_rejections is not None:
             self._c_fence_rejections.inc("-", "-")
+        # a fence rejection means THIS incarnation is the stale one —
+        # exactly the post-mortem a flight-recorder dump should explain
+        from karpenter_tpu.observability import default_flight_recorder
+
+        default_flight_recorder().record(
+            "fence_rejection", generation=self.fence.generation
+        )
 
     def close(self) -> None:
         """Graceful shutdown: checkpoint the live state (a clean restart

@@ -122,6 +122,8 @@ class KarpenterRuntime:
         self.store = store if store is not None else self._open_store(options)
         self.registry = registry if registry is not None else GaugeRegistry()
 
+        self._bind_observability(options)
+
         # crash-safe state subsystem (karpenter_tpu/recovery): built
         # FIRST — it claims the fence generation durably before anything
         # can actuate, and replays the protective-state journal the
@@ -272,6 +274,24 @@ class KarpenterRuntime:
         from karpenter_tpu.store.persistence import open_store
 
         return open_store(options.data_dir)
+
+    def _bind_observability(self, options: Options) -> None:
+        """Observability wiring (docs/observability.md): the process
+        tracer and flight recorder publish their counters + the
+        karpenter_reconcile_e2e_seconds histogram into THIS runtime's
+        registry, and trip-class recorder events dump crash-safely
+        into --journal-dir next to the recovery journal."""
+        from karpenter_tpu.observability import (
+            default_flight_recorder,
+            default_tracer,
+        )
+
+        self.tracer = default_tracer()
+        self.tracer.bind_registry(self.registry)
+        self.flight_recorder = default_flight_recorder()
+        self.flight_recorder.bind_registry(self.registry)
+        if options.journal_dir:
+            self.flight_recorder.configure(dump_dir=options.journal_dir)
 
     def _build_solver_client(self, options: Options):
         """(device_solver, decider) seams for the gRPC process split:

@@ -293,6 +293,152 @@ def simulate_consolidation(store, service=None, buckets: int = 32) -> dict:
     }
 
 
+def simulate_trace(export_path: Optional[str] = None) -> dict:  # lint: allow-complexity — scenario assembly: world build + FSM-phased replay + report
+    """The traced end-to-end replay (docs/observability.md): a seeded
+    consolidating world driven tick by tick with the reconcile tracer
+    capturing every layer — tick entry, producer solves, the HA fleet
+    decide, the COALESCED consolidation dispatch (one solver dispatch
+    span linking every candidate request span that rode it), and the
+    ScalableNodeGroup actuation that closes the event-observed ->
+    actuation-acked window. `export_path` writes the capture as
+    Chrome-trace/Perfetto JSONL; the report summarizes what the trace
+    must contain (the acceptance pin in tests/test_observability.py).
+
+    Nothing here touches a live store or provider: the world is
+    self-contained (fake provider, scripted clock)."""
+    from karpenter_tpu.api.core import (
+        Container, Node, NodeCondition, NodeSpec, NodeStatus,
+        ObjectMeta, Pod, PodSpec, resource_list,
+    )
+    from karpenter_tpu.api.horizontalautoscaler import (
+        CrossVersionObjectReference, HorizontalAutoscaler,
+        HorizontalAutoscalerSpec, Metric, MetricTarget,
+        PrometheusMetricSource,
+    )
+    from karpenter_tpu.api.metricsproducer import (
+        MetricsProducer, MetricsProducerSpec, PendingCapacitySpec,
+    )
+    from karpenter_tpu.api.scalablenodegroup import (
+        FAKE_NODE_GROUP, ScalableNodeGroup, ScalableNodeGroupSpec,
+    )
+    from karpenter_tpu.cloudprovider.fake import FakeFactory
+    from karpenter_tpu.observability import default_tracer
+    from karpenter_tpu.runtime import KarpenterRuntime, Options
+    from karpenter_tpu.utils.quantity import Quantity
+
+    tracer = default_tracer()
+    tracer.clear()
+    clock = {"now": 1_000_000.0}
+    provider = FakeFactory()
+    provider.node_replicas["grp-id"] = 3
+    runtime = KarpenterRuntime(
+        Options(consolidate=True),
+        cloud_provider_factory=provider,
+        clock=lambda: clock["now"],
+    )
+    store = runtime.store
+    for i in range(3):
+        store.create(Node(
+            metadata=ObjectMeta(name=f"n{i}", labels={"pool": "a"}),
+            spec=NodeSpec(),
+            status=NodeStatus(
+                allocatable=resource_list(
+                    cpu="8", memory="16Gi", pods="16"
+                ),
+                conditions=[NodeCondition("Ready", "True")],
+            ),
+        ))
+    for i in range(3):
+        # one small pod per node: every candidate needs a REAL masked
+        # bin-pack (empty nodes short-circuit as trivially drainable and
+        # would never ride the coalesced dispatch this replay exists to
+        # trace)
+        store.create(Pod(
+            metadata=ObjectMeta(name=f"p{i}"),
+            spec=PodSpec(
+                node_name=f"n{i}",
+                containers=[Container(requests={
+                    "cpu": Quantity.parse("1"),
+                    "memory": Quantity.parse("1Gi"),
+                })],
+            ),
+        ))
+    store.create(MetricsProducer(
+        metadata=ObjectMeta(name="pending"),
+        spec=MetricsProducerSpec(
+            pending_capacity=PendingCapacitySpec(
+                node_selector={"pool": "a"}, node_group_ref="grp",
+            )
+        ),
+    ))
+    store.create(ScalableNodeGroup(
+        metadata=ObjectMeta(name="grp"),
+        spec=ScalableNodeGroupSpec(
+            replicas=3, type=FAKE_NODE_GROUP, id="grp-id",
+        ),
+    ))
+    store.create(HorizontalAutoscaler(
+        metadata=ObjectMeta(name="ha"),
+        spec=HorizontalAutoscalerSpec(
+            scale_target_ref=CrossVersionObjectReference(
+                kind="ScalableNodeGroup", name="grp"
+            ),
+            min_replicas=2, max_replicas=100,
+            metrics=[Metric(prometheus=PrometheusMetricSource(
+                query='karpenter_queue_length{name="q"}',
+                target=MetricTarget(type="AverageValue", value=4),
+            ))],
+        ),
+    ))
+    # queue length 8 / target 4 -> the HA computes desired 2 against the
+    # observed 3: the decide patches spec.replicas, the watch event
+    # stamps the e2e observation, and the next tick's SNG reconcile
+    # actuates — the event-observed -> actuation-acked chain the trace
+    # and karpenter_reconcile_e2e_seconds must both capture
+    runtime.registry.register("queue", "length").set("q", "default", 8.0)
+
+    engine = runtime.consolidation
+    e2e_before = tracer.e2e_observed
+    try:
+        # tick through the consolidation FSM: first sight starts the
+        # churn clock, cooldown expiry plans (the COALESCED candidate
+        # dispatch), verify soaks, drain decrements spec.replicas, and
+        # the watch-requeued SNG reconcile actuates the provider write
+        runtime.manager.converge(1)
+        clock["now"] += engine.config.cooldown_s + 1
+        runtime.manager.converge(1)
+        clock["now"] += engine.config.verify_s + 1
+        runtime.manager.converge(1)
+        runtime.manager.converge(2)
+        actuated = provider.node_replicas["grp-id"]
+    finally:
+        runtime.close()
+
+    spans = tracer.snapshot()
+    dispatches = [
+        s for s in spans if s["name"].startswith("solver.dispatch")
+    ]
+    max_links = max((len(s["links"]) for s in dispatches), default=0)
+    report = {
+        "replicas_after": actuated,
+        "spans": len(spans),
+        "traces": len({s["trace"] for s in spans}),
+        "dispatch_spans": len(dispatches),
+        "max_dispatch_links": max_links,
+        "actuation_spans": sum(
+            1 for s in spans if s["name"] == "actuate.set_replicas"
+        ),
+        "tick_spans": sum(
+            1 for s in spans if s["name"] == "reconcile.tick"
+        ),
+        "e2e_samples": tracer.e2e_observed - e2e_before,
+    }
+    if export_path:
+        report["trace_export"] = export_path
+        report["trace_events"] = tracer.export_jsonl(export_path)
+    return report
+
+
 def simulate_forecast(  # lint: allow-complexity — scenario assembly: world build + two replays + report
     ticks: int = 90,
     interval_s: float = 10.0,

@@ -84,7 +84,11 @@ import numpy as np
 
 from karpenter_tpu.faults import inject
 from karpenter_tpu.metrics.registry import GaugeRegistry, default_registry
-from karpenter_tpu.observability import solver_trace
+from karpenter_tpu.observability import (
+    default_flight_recorder,
+    default_tracer,
+    solver_trace,
+)
 from karpenter_tpu.ops.binpack import DEFAULT_BUCKETS, BinPackInputs
 from karpenter_tpu.solver.bucketing import (
     bucket_up,
@@ -111,6 +115,8 @@ REJECTED_TOTAL = "rejected_total"
 DEADLINE_EXPIRED_TOTAL = "deadline_expired_total"
 STAGE_P50_MS = "stage_p50_ms"
 STAGE_P99_MS = "stage_p99_ms"
+STAGE_SECONDS = "stage_seconds"
+COALESCE_BATCH_SIZE = "coalesce_batch_size"
 WINDOW_MS = "window_ms"
 PIPELINE_DEPTH = "pipeline_depth"
 UPLOAD_MS = "upload_ms"
@@ -150,6 +156,14 @@ FORECAST_S_FLOOR = 8
 COMPILE_GRACE_S = 120.0
 
 _STAGE_WINDOW = 256  # per-stage latency ring size (fleet-scale constant)
+# native-histogram ladders (docs/observability.md): stage latencies run
+# from sub-ms host work to tens-of-seconds first compiles; coalesce
+# batch sizes follow the power-of-two batch ladder
+_STAGE_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 30.0,
+)
+_COALESCE_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
 # Adaptive-window load tracking: EWMA of gathered batch sizes. Below the
 # threshold the queue is treated as idle (dispatch immediately); at or
 # above it the full window holds so concurrent bursts keep coalescing.
@@ -225,20 +239,36 @@ class _Request:
     # atomically and must ride ONE dispatch — _collect keeps draining the
     # queue past max_batch while the head continues the same batch
     coalesce_id: Optional[int] = None
+    # reconcile-trace span opened at submit (observability.tracing):
+    # covers queue wait through completion; the coalesced dispatch span
+    # LINKS it, and the FSM-trip flight-recorder event backlinks its
+    # trace id. None with tracing disabled.
+    span: Optional[object] = None
     _finish_lock: threading.Lock = field(
         default_factory=threading.Lock, repr=False
     )
 
-    def finish(self, result=None, error=None) -> bool:
+    def trace_id(self) -> Optional[str]:
+        return self.span.trace_id if self.span is not None else None
+
+    def finish(self, result=None, error=None, degraded: bool = False) -> bool:
         """First finisher wins (idempotent): the watchdog may drain a
         stuck request to numpy while the stale worker later unwedges and
-        tries to answer it too — the caller must see exactly one result."""
+        tries to answer it too — the caller must see exactly one result.
+        `degraded` marks the span of a request the ladder answered from
+        numpy AFTER a device failure/hang — a trace reader must be able
+        to tell those from healthy device-served requests (the
+        fsm_trip flight-recorder event backlinks their traces)."""
         with self._finish_lock:
             if self.event.is_set():
                 return False
             self.result = result
             self.error = error
             self.event.set()
+            if self.span is not None:
+                self.span.close(
+                    ok=error is None, degraded=degraded or None
+                )
             return True
 
 
@@ -392,6 +422,18 @@ class SolverService:
         )
         self._g_stage_p50 = reg(SUBSYSTEM, STAGE_P50_MS)
         self._g_stage_p99 = reg(SUBSYSTEM, STAGE_P99_MS)
+        # native histograms (docs/observability.md): the stage rings as
+        # real bucketed distributions {name=<stage>}, and the coalesce
+        # factor as a batch-size histogram — histogram_quantile() works
+        # where the p50/p99 gauge snapshots only sampled
+        self._h_stage = reg(
+            SUBSYSTEM, STAGE_SECONDS, kind="histogram",
+            buckets=_STAGE_BUCKETS,
+        )
+        self._h_coalesce = reg(
+            SUBSYSTEM, COALESCE_BATCH_SIZE, kind="histogram",
+            buckets=_COALESCE_BUCKETS,
+        )
         self._g_window = reg(SUBSYSTEM, WINDOW_MS)
         self._g_pipeline = reg(SUBSYSTEM, PIPELINE_DEPTH)
         # host->device transfer p50 of recent dispatches — the measured
@@ -427,6 +469,7 @@ class SolverService:
                     maxlen=_STAGE_WINDOW
                 )
             ring.append(ms)
+        self._h_stage.observe(stage, "-", seconds)
 
     def publish_gauges(self) -> None:
         """Refresh the point-in-time gauges (queue depth, coalesce
@@ -629,13 +672,40 @@ class SolverService:
         self._enqueue_one(request)
         return SolveFuture(request, self)
 
+    def _begin_request_span(self, request: _Request) -> None:
+        """Open the request's reconcile-trace span (parented to the
+        submitter's current span, so a tick-minted trace id follows the
+        request across the worker-thread boundary). No-op — request.span
+        stays None — when tracing is disabled."""
+        family = (
+            request.key[0] if isinstance(request.key[0], str) else "binpack"
+        )
+        request.span = default_tracer().begin(
+            "solver.request", family=family, backend=request.backend,
+        )
+
+    def _record_rejected_span(self, key, backend: str) -> None:
+        """Open-and-close a rejected request span for an overflow slot
+        that never became a _Request (the coalesced batch path) — a
+        trace export taken during saturation must show the rejected
+        fleet-batch candidates, not just rejected singletons."""
+        family = key[0] if isinstance(key[0], str) else "binpack"
+        span = default_tracer().begin(
+            "solver.request", family=family, backend=backend,
+        )
+        if span is not None:
+            span.close(ok=False, rejected=True)
+
     def _enqueue_one(self, request: _Request) -> None:
         """Admit one request to the bounded queue (raises
         SolverSaturated when full) and wake the worker."""
+        self._begin_request_span(request)
         with self._cond:
             if len(self._queue) >= self.max_queue:
                 self.stats.rejected += 1
                 self._c_rejected.inc("-", "-")
+                if request.span is not None:
+                    request.span.close(ok=False, rejected=True)
                 raise SolverSaturated(
                     f"solver queue full ({self.max_queue})"
                 )
@@ -805,6 +875,7 @@ class SolverService:
                 if len(self._queue) >= self.max_queue:
                     self.stats.rejected += 1
                     self._c_rejected.inc("-", "-")
+                    self._record_rejected_span(key, backend_eff)
                     requests.append(None)
                     continue
                 request = _Request(
@@ -818,6 +889,7 @@ class SolverService:
                     enqueued_at=now,
                     coalesce_id=cid,
                 )
+                self._begin_request_span(request)
                 self._queue.append(request)
                 self.stats.requests += 1
                 self._c_requests.inc("-", "-")
@@ -998,8 +1070,9 @@ class SolverService:
         self.stats.decide_calls += 1
         t0 = _time.perf_counter()
         try:
-            with solver_trace("solver.decide"):
-                return self._decide_fn()(inputs)
+            with default_tracer().span("solver.decide"):
+                with solver_trace("solver.decide"):
+                    return self._decide_fn()(inputs)
         except Exception:
             self.stats.decide_errors += 1
             raise
@@ -1050,7 +1123,7 @@ class SolverService:
             self.stats.fsm_short_circuits += 1
             return False
 
-    def _record_device_failure(self) -> None:
+    def _record_device_failure(self, requests: List[_Request] = ()) -> bool:
         with self._health_lock:
             self.stats.device_failures += 1
             self._consec_device_failures += 1
@@ -1075,6 +1148,26 @@ class SolverService:
                 self._consec_device_failures,
                 self.health_probe_interval_s,
             )
+            # post-mortem surface (observability.flightrecorder): WHICH
+            # reconcile traces the trip degraded, not just that it
+            # happened — dumps crash-safely when a dump dir is wired
+            default_flight_recorder().record(
+                "fsm_trip",
+                trace_ids=self._trace_ids(requests),
+                subsystem="solver",
+                consecutive_failures=self._consec_device_failures,
+                requests=len(requests),
+            )
+        return tripped
+
+    @staticmethod
+    def _trace_ids(requests: List[_Request]) -> List[str]:
+        """Distinct trace ids of the requests a degradation touched
+        (insertion-ordered, deduped)."""
+        return list(dict.fromkeys(
+            tid for r in requests
+            if (tid := r.trace_id()) is not None
+        ))
 
     def _record_device_success(self) -> None:
         with self._health_lock:
@@ -1155,7 +1248,21 @@ class SolverService:
             "worker and draining %d request(s) to numpy",
             self.watchdog_timeout_s, len(stuck),
         )
-        self._record_device_failure()  # a hang counts toward the FSM trip
+        recorder = default_flight_recorder()
+        # one incident, one dump: when the hang also trips the FSM, the
+        # fsm_trip auto-dump lands milliseconds later with THIS event
+        # already in the ring, so dumping here too would write two
+        # near-identical fsync'd files and burn two retention slots
+        recorder.record(
+            "watchdog_restart",
+            trace_ids=self._trace_ids(stuck),
+            subsystem="solver",
+            requests=len(stuck),
+            auto_dump=False,
+        )
+        tripped = self._record_device_failure(stuck)  # hang counts toward trip
+        if not tripped:
+            recorder.maybe_auto_dump("watchdog_restart")
         self._finish_from_numpy(stuck)
 
     # -- worker -----------------------------------------------------------
@@ -1307,7 +1414,13 @@ class SolverService:
         live: List[_Request] = []
         for request in requests:
             if request.abandoned:
-                continue  # caller already gave up (counted there)
+                # caller already gave up (counted there) — but the
+                # caller-side timeout never calls finish(), so close
+                # the trace span HERE or the timed-out request (the
+                # most diagnosis-worthy kind) vanishes from the export
+                if request.span is not None:
+                    request.span.close(ok=False, abandoned=True)
+                continue
             if request.deadline is not None and now > request.deadline:
                 self._on_expired(request)
                 request.finish(
@@ -1345,6 +1458,7 @@ class SolverService:
         self.stats.last_coalesce_factor = len(live)
         self.stats.coalesced_batches += len(live) > 1
         self._g_coalesce.set("-", "-", float(len(live)))
+        self._h_coalesce.observe("-", "-", float(len(live)))
         device_path = key[2] != "numpy"
         if device_path and not self._device_allowed():
             # FSM degraded, not this window's probe: serve the whole
@@ -1357,7 +1471,7 @@ class SolverService:
         except Exception as exc:  # noqa: BLE001 — device failure path
             error: BaseException = exc
             if device_path and not self._stale():
-                self._record_device_failure()
+                self._record_device_failure(live)
         if self._shard_strategy(key) is not None and not self._stale():
             error = self._retry_unsharded(key, live, error)
             if error is None:
@@ -1386,13 +1500,38 @@ class SolverService:
             "on the single-device path and disabling the shard route",
             type(error).__name__, error, len(live),
         )
+        default_flight_recorder().record(
+            "shard_fallback",
+            trace_ids=self._trace_ids(live),
+            error=type(error).__name__,
+            requests=len(live),
+        )
         try:
             self._solve_group(self._single_device_key(key), live)
             return None
         except Exception as single_error:  # noqa: BLE001
             if not self._stale():
-                self._record_device_failure()
+                self._record_device_failure(live)
             return single_error
+
+    def _dispatch_span(self, name: str, live: List[_Request], **args):
+        """The coalesced dispatch span (observability.tracing): opened
+        on the worker thread, parented into the FIRST rider's trace for
+        correlation, and LINKING every request span that rode the
+        dispatch — the one-to-many join the coalescing queue otherwise
+        erases (trace-export renders the links as Perfetto flow
+        arrows)."""
+        tracer = default_tracer()
+        if not tracer.enabled:
+            return tracer.span(name)  # the shared no-op span
+        spans = [r.span for r in live if r.span is not None]
+        return tracer.span(
+            name,
+            parent=spans[0] if spans else None,
+            links=spans,
+            n_requests=len(live),
+            **args,
+        )
 
     def _finish_from_numpy(self, live: List[_Request]) -> None:
         for request in live:
@@ -1405,10 +1544,11 @@ class SolverService:
                 request.finish(
                     result=self._numpy_fallback(
                         request.inputs, request.buckets
-                    )
+                    ),
+                    degraded=True,
                 )
             except Exception as numpy_error:  # noqa: BLE001
-                request.finish(error=numpy_error)
+                request.finish(error=numpy_error, degraded=True)
 
     def _solve_group(
         self, key: tuple, live: List[_Request], lone: bool = False
@@ -1430,14 +1570,21 @@ class SolverService:
             # numpy stages don't compile, so shape stability buys
             # nothing), and no fallback counting — this is the REQUESTED
             # backend, not a degradation. Completes inline, so in-flight
-            # device work drains first to keep completion ordered.
+            # device work drains first to keep completion ordered. It is
+            # still ONE coalesced group answer, so the dispatch span
+            # links its riders like the device paths do.
             self._drain_inflight()
-            for request in live:
-                t0 = _time.perf_counter()
-                request.finish(
-                    result=self._numpy_solve(request.inputs, buckets)
-                )
-                self._record_stage("dispatch", _time.perf_counter() - t0)
+            with self._dispatch_span(
+                "solver.dispatch", live, strategy="host"
+            ):
+                for request in live:
+                    t0 = _time.perf_counter()
+                    request.finish(
+                        result=self._numpy_solve(request.inputs, buckets)
+                    )
+                    self._record_stage(
+                        "dispatch", _time.perf_counter() - t0
+                    )
             return
         # the device-dispatch injection point (faults/registry.py): an
         # error plan here exercises the per-request numpy fallback and
@@ -1556,17 +1703,18 @@ class SolverService:
         import jax
 
         t0 = _time.perf_counter()
-        with self._device_section(
-            live, grace=COMPILE_GRACE_S if fresh else 0.0
-        ):
-            with solver_trace("solver.forecast"):
-                # the forecast-path fault-injection point
-                # (faults/registry.py, docs/resilience.md): an error
-                # plan exercises the numpy degradation + FSM, a hang
-                # plan the watchdog drain
-                inject("forecast.predict")
-                out = fn(stacked)
-                jax.block_until_ready(out)
+        with self._dispatch_span("solver.dispatch.forecast", live):
+            with self._device_section(
+                live, grace=COMPILE_GRACE_S if fresh else 0.0
+            ):
+                with solver_trace("solver.forecast"):
+                    # the forecast-path fault-injection point
+                    # (faults/registry.py, docs/resilience.md): an error
+                    # plan exercises the numpy degradation + FSM, a hang
+                    # plan the watchdog drain
+                    inject("forecast.predict")
+                    out = fn(stacked)
+                    jax.block_until_ready(out)
         if self._stale():
             return  # watchdog already answered these from numpy
         self._record_stage("dispatch", _time.perf_counter() - t0)
@@ -1615,15 +1763,16 @@ class SolverService:
             padded = pad_preempt_inputs(request.inputs, shape)
             self._record_stage("pad", _time.perf_counter() - t0)
             t0 = _time.perf_counter()
-            with self._device_section([request], grace=grace):
-                with solver_trace("solver.preempt"):
-                    # the preempt-path fault-injection point
-                    # (faults/registry.py, docs/resilience.md): an error
-                    # plan exercises the numpy degradation + FSM, a
-                    # hang plan the watchdog drain
-                    inject("preempt.plan")
-                    out = PK.preempt_plan(jax.device_put(padded))
-                    jax.block_until_ready(out)
+            with self._dispatch_span("solver.dispatch.preempt", [request]):
+                with self._device_section([request], grace=grace):
+                    with solver_trace("solver.preempt"):
+                        # the preempt-path fault-injection point
+                        # (faults/registry.py, docs/resilience.md): an
+                        # error plan exercises the numpy degradation +
+                        # FSM, a hang plan the watchdog drain
+                        inject("preempt.plan")
+                        out = PK.preempt_plan(jax.device_put(padded))
+                        jax.block_until_ready(out)
             grace = 0.0  # only the first dispatch of the batch compiles
             if self._stale():
                 return  # watchdog already answered these from numpy
@@ -1712,12 +1861,15 @@ class SolverService:
             donate=self._donation_supported(),
         )
         t0 = _time.perf_counter()
-        with self._device_section(
-            live, grace=COMPILE_GRACE_S if fresh else 0.0
+        with self._dispatch_span(
+            "solver.dispatch", live, strategy=strategy, batch=n_batch
         ):
-            with solver_trace("solver.dispatch"):
-                stacked = self._upload(stacked)
-                out = fn(stacked, buckets)
+            with self._device_section(
+                live, grace=COMPILE_GRACE_S if fresh else 0.0
+            ):
+                with solver_trace("solver.dispatch"):
+                    stacked = self._upload(stacked)
+                    out = fn(stacked, buckets)
         if self._stale():
             # superseded by a watchdog restart while dispatching: the
             # watchdog already answered these requests from numpy —
@@ -1812,15 +1964,19 @@ class SolverService:
             donate=self._donation_supported(),
         )
         t0 = _time.perf_counter()
-        with self._device_section(
-            live, grace=COMPILE_GRACE_S if fresh else 0.0
+        with self._dispatch_span(
+            "solver.dispatch.shard", live,
+            strategy=strategy, devices=int(mesh.devices.size),
         ):
-            with solver_trace("solver.shard"):
-                stacked = self._upload(
-                    stacked, stacked_binpack_shardings(mesh, key[3])
-                )
-                out = fn(stacked, buckets)
-                jax.block_until_ready(out)
+            with self._device_section(
+                live, grace=COMPILE_GRACE_S if fresh else 0.0
+            ):
+                with solver_trace("solver.shard"):
+                    stacked = self._upload(
+                        stacked, stacked_binpack_shardings(mesh, key[3])
+                    )
+                    out = fn(stacked, buckets)
+                    jax.block_until_ready(out)
         if self._stale():
             return  # watchdog already answered these from numpy
         self._record_stage("dispatch", _time.perf_counter() - t0)
@@ -1876,7 +2032,7 @@ class SolverService:
             self._record_device_success()
         except Exception as error:  # noqa: BLE001 — device failure path
             if not self._stale():
-                self._record_device_failure()
+                self._record_device_failure(live)
             logger().warning(
                 "solver device path failed in flight (%s: %s); degrading "
                 "%d request(s) to numpy",
