@@ -81,6 +81,11 @@ bench-preempt: ## Batched one-dispatch eviction planning vs per-candidate loop (
 		--pods 10000 --backend xla --iters 10 \
 		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
 
+bench-cost: ## Batched multi-objective cost/SLO refine vs per-HA sequential loop (512 autoscalers x 3 metrics, numpy parity pinned); appends a BENCHMARKS row + publishes to BASELINE.json
+	$(PYTHON) bench.py --cost --cost-rows 512 --cost-metrics 3 \
+		--backend xla --iters 10 \
+		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
+
 bench-journal: ## Protective-state journal overhead on the reconcile hot path (target <5% tick-latency regression); appends a BENCHMARKS row + publishes to BASELINE.json
 	$(PYTHON) bench.py --journal --journal-ticks 40 \
 		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
@@ -132,5 +137,5 @@ kind-smoke: ## Deploy smoke on kind: image -> apply -> pod Ready -> one HA end t
 
 .PHONY: help dev ci test test-chaos test-recovery battletest verify codegen \
 	docs native bench bench-solver bench-consolidate bench-forecast \
-	bench-preempt bench-journal bench-trace bench-shard dryrun image \
-	publish apply delete kind-load conformance kind-smoke
+	bench-preempt bench-cost bench-journal bench-trace bench-shard dryrun \
+	image publish apply delete kind-load conformance kind-smoke

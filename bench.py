@@ -508,6 +508,27 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
         help="with --trace: measured manager ticks per configuration",
     )
     ap.add_argument(
+        "--cost",
+        action="store_true",
+        help="benchmark the batched multi-objective cost/SLO refinement "
+        "(ops/cost.py via karpenter_tpu/cost): --cost-rows autoscalers "
+        "refined in ONE device dispatch vs the same rows dispatched one "
+        "HA at a time; pins XLA == numpy bit-parity on every output "
+        "before timing; reports rows/sec both ways and the speedup",
+    )
+    ap.add_argument(
+        "--cost-rows",
+        type=int,
+        default=512,
+        help="with --cost: SLO-opted autoscaler rows in the fleet",
+    )
+    ap.add_argument(
+        "--cost-metrics",
+        type=int,
+        default=3,
+        help="with --cost: metrics per autoscaler row",
+    )
+    ap.add_argument(
         "--shard",
         action="store_true",
         help="benchmark the SHARDED dispatch strategy (docs/solver-"
@@ -665,6 +686,20 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
             "--trace builds its own ticking world; it cannot combine "
             "with other modes"
         )
+    if args.cost and (
+        args.mesh or args.e2e or args.decide or args.clusters
+        or args.solver_service or args.hotpath or args.consolidate
+        or args.forecast or args.preempt or args.journal or args.trace
+        or args.shard
+    ):
+        ap.error(
+            "--cost builds its own workload (SLO-opted fleet rows); it "
+            "cannot combine with other modes"
+        )
+    if args.cost_rows < 2:
+        ap.error("--cost-rows must be >= 2")
+    if args.cost_metrics < 1:
+        ap.error("--cost-metrics must be >= 1")
     if args.shard and (
         args.mesh or args.e2e or args.decide or args.clusters
         or args.solver_service or args.hotpath or args.consolidate
@@ -686,13 +721,13 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
     if (args.publish_baseline or args.append_benchmarks) and not (
         args.solver_service or args.consolidate or args.hotpath
         or args.forecast or args.preempt or args.journal or args.shard
-        or args.trace
+        or args.trace or args.cost
     ):
         ap.error(
             "--publish-baseline/--append-benchmarks only apply to "
             "--solver-service/--consolidate/--hotpath/--forecast/"
-            "--preempt/--journal/--shard/--trace (nothing would be "
-            "published otherwise)"
+            "--preempt/--journal/--shard/--trace/--cost (nothing would "
+            "be published otherwise)"
         )
 
     if args.shard:
@@ -714,6 +749,13 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
             f"reconcile tick p50 with reconcile tracing, "
             f"{args.trace_ticks} ticks (tracer ENABLED vs DISABLED + "
             f"raw span throughput)"
+        )
+    elif args.cost:
+        metric = (
+            f"batched multi-objective cost/SLO refine p50, "
+            f"{args.cost_rows} autoscalers x {args.cost_metrics} "
+            f"metrics (one dispatch vs per-HA loop; numpy parity "
+            f"pinned)"
         )
     elif args.preempt:
         metric = (
@@ -853,9 +895,9 @@ def _journal_world(runtime):
         PodSpec, resource_list,
     )
     from karpenter_tpu.api.horizontalautoscaler import (
-        CrossVersionObjectReference, HorizontalAutoscaler,
+        Behavior, CrossVersionObjectReference, HorizontalAutoscaler,
         HorizontalAutoscalerSpec, Metric, MetricTarget,
-        PrometheusMetricSource,
+        PrometheusMetricSource, ScalingRules,
     )
     from karpenter_tpu.api.metricsproducer import (
         MetricsProducer, MetricsProducerSpec, PendingCapacitySpec,
@@ -899,6 +941,13 @@ def _journal_world(runtime):
                 query='karpenter_queue_length{name="q"}',
                 target=MetricTarget(type="AverageValue", value=4),
             ))],
+            # no scale-down hold: the churn tick toggles the queue
+            # metric (see _churn_runtime) so every tick actuates and
+            # the karpenter_reconcile_e2e_seconds histogram fills —
+            # the lead-time surface bench-journal publishes
+            behavior=Behavior(
+                scale_down=ScalingRules(stabilization_window_seconds=0)
+            ),
         ),
     ))
     runtime.registry.register("queue", "length").set("q", "default", 12.0)
@@ -925,6 +974,8 @@ def _churn_runtime(journal_dir=None):
         clock=lambda: clock["now"],
     )
     _journal_world(runtime)
+    queue_gauge = runtime.registry.gauge("queue", "length")
+    flip = {"high": False}
 
     def tick():
         try:
@@ -933,6 +984,12 @@ def _churn_runtime(journal_dir=None):
             runtime.store.create(
                 Pod(metadata=ObjectMeta(name="churn-pod"), spec=PodSpec())
             )
+        # toggle the decision signal so every tick carries a REAL
+        # actuation (desired 3 <-> 5): the provider write path and the
+        # e2e lead-time histogram are part of the tick both overhead
+        # benches claim to measure
+        flip["high"] = not flip["high"]
+        queue_gauge.set("q", "default", 20.0 if flip["high"] else 12.0)
         clock["now"] += 61.0
         runtime.manager.reconcile_all()
 
@@ -940,8 +997,11 @@ def _churn_runtime(journal_dir=None):
 
 
 def _journal_tick_times(args, journal_dir):
-    """Per-tick wall times for one configuration (journal on/off) over
-    the identical seeded world."""
+    """(per-tick wall times, e2e lead-time percentiles) for one
+    configuration (journal on/off) over the identical seeded world.
+    The e2e numbers come from the PR 9 karpenter_reconcile_e2e_seconds
+    histogram the churn world's per-tick actuations fill — the
+    provisioning-lead observable warm pools attack (docs/cost.md)."""
     runtime, tick = _churn_runtime(journal_dir)
 
     times = []
@@ -952,9 +1012,21 @@ def _journal_tick_times(args, journal_dir):
             t0 = time.perf_counter()
             tick()
             times.append((time.perf_counter() - t0) * 1e3)
+        hist = runtime.registry.gauge("reconcile", "e2e_seconds")
+        e2e = {
+            "e2e_p50_ms": round(
+                (hist.percentile("ScalableNodeGroup", "-", 50) or 0.0)
+                * 1e3, 3,
+            ),
+            "e2e_p99_ms": round(
+                (hist.percentile("ScalableNodeGroup", "-", 99) or 0.0)
+                * 1e3, 3,
+            ),
+            "e2e_samples": hist.count("ScalableNodeGroup", "-"),
+        }
     finally:
         runtime.close()
-    return times
+    return times, e2e
 
 
 def _append_throughput(journal_dir, n=20_000):
@@ -1010,10 +1082,10 @@ def run_journal(args, metric: str, note: str) -> None:
         f"backend={jax.default_backend()} devices={jax.devices()}",
         file=sys.stderr,
     )
-    off = _journal_tick_times(args, None)
+    off, _ = _journal_tick_times(args, None)
     root = tempfile.mkdtemp(prefix="karpenter-bench-journal-")
     try:
-        on = _journal_tick_times(args, os.path.join(root, "ticks"))
+        on, e2e = _journal_tick_times(args, os.path.join(root, "ticks"))
         throughput = _append_throughput(os.path.join(root, "appends"))
     finally:
         shutil.rmtree(root, ignore_errors=True)
@@ -1030,6 +1102,10 @@ def run_journal(args, metric: str, note: str) -> None:
         "tick_p99_on_ms": round(float(np.percentile(on, 99)), 3),
         "overhead_pct": round(overhead, 2),
         **throughput,
+        # event-observed -> actuation-acked lead time over the journaled
+        # run (the PR 9 histogram; docs/cost.md quantifies warm pools
+        # against the same observable)
+        **e2e,
     }
     record_evidence(
         tick_off_ms=[round(t, 4) for t in off],
@@ -1040,7 +1116,9 @@ def run_journal(args, metric: str, note: str) -> None:
         f"tick p50 off={record['tick_p50_off_ms']}ms "
         f"on={record['tick_p50_on_ms']}ms "
         f"overhead={record['overhead_pct']}% | append "
-        f"{record['append_us']}µs ({record['appends_per_sec']}/s)",
+        f"{record['append_us']}µs ({record['appends_per_sec']}/s) | "
+        f"e2e lead p50={record['e2e_p50_ms']}ms "
+        f"p99={record['e2e_p99_ms']}ms (n={record['e2e_samples']})",
         file=sys.stderr,
     )
     if args.publish_baseline:
@@ -1223,6 +1301,9 @@ def run(args, metric: str, note: str) -> None:  # lint: allow-complexity — ben
         return
     if args.trace:
         run_trace(args, metric, note)
+        return
+    if args.cost:
+        run_cost(args, metric, note)
         return
     if args.preempt:
         run_preempt(args, metric, note)
@@ -2273,6 +2354,168 @@ def run_forecast(args, metric: str, note: str) -> None:
     extra = (
         f"{record['batched_sps']} vs {record['per_series_sps']} "
         f"series/sec batched vs per-series ({record['speedup']}x)"
+    )
+    emit(
+        f"{metric} ({jax.default_backend()})",
+        record["batched_p50_ms"],
+        note=f"{note}; {extra}" if note else extra,
+        against_baseline=False,
+    )
+
+
+def build_cost_inputs(rows: int, metrics: int, seed: int):
+    """A fleet of SLO-opted autoscaler rows: mixed demand regimes, a
+    spread of unit costs and violation weights, some budget-capped and
+    some forecast-sigma'd — the shape the CostEngine hands the service
+    each tick (every row slo_valid: the bench measures the refine, not
+    the opt-out)."""
+    from karpenter_tpu.ops.cost import CostInputs
+
+    rng = np.random.RandomState(seed)
+    N, M = rows, metrics
+    base = rng.randint(1, 200, N).astype(np.int32)
+    return CostInputs(
+        base_desired=base,
+        min_replicas=np.maximum(base - 50, 0).astype(np.int32),
+        max_replicas=(base + rng.randint(50, 500, N)).astype(np.int32),
+        unit_cost=rng.choice([0.07, 0.19, 1.0, 4.8], N).astype(np.float32),
+        slo_weight=rng.choice([0.0, 5.0, 50.0, 500.0], N).astype(
+            np.float32
+        ),
+        max_hourly_cost=rng.choice([0.0, 25.0, 250.0], N).astype(
+            np.float32
+        ),
+        slo_valid=np.ones(N, bool),
+        slo_target=rng.uniform(0.5, 10, (N, M)).astype(np.float32),
+        demand_mu=rng.uniform(0, 1000, (N, M)).astype(np.float32),
+        demand_sigma=rng.choice([0.0, 5.0, 50.0], (N, M)).astype(
+            np.float32
+        ),
+        demand_valid=rng.rand(N, M) > 0.1,
+    )
+
+
+def _cost_record(args, backend, batched, per_row) -> dict:
+    batched_p50 = float(np.percentile(batched, 50))
+    loop_p50 = float(np.percentile(per_row, 50))
+    return {
+        "config": f"{args.cost_rows} autoscalers x {args.cost_metrics} "
+                  "metrics cost refine",
+        "backend": backend,
+        "rows": args.cost_rows,
+        "metrics": args.cost_metrics,
+        "batched_p50_ms": round(batched_p50, 3),
+        "per_ha_p50_ms": round(loop_p50, 3),
+        "batched_rps": round(args.cost_rows * 1000.0 / batched_p50, 1),
+        "per_ha_rps": round(args.cost_rows * 1000.0 / loop_p50, 1),
+        "speedup": round(loop_p50 / batched_p50, 2),
+    }
+
+
+def _append_cost_row(path: str, record: dict) -> None:
+    marker = "## Cost refine (make bench-cost)"
+    header = (
+        f"\n{marker}\n\n"
+        "Batched multi-objective cost/SLO refinement (every SLO-opted "
+        "autoscaler's candidate ladder scored in ONE device dispatch — "
+        "the shape the CostEngine submits each tick) vs. the same rows "
+        "refined one HA at a time. XLA == numpy bit-parity on every "
+        "output field is asserted before timing.\n\n"
+        "| Date | Backend | Config | Batched p50 (ms) | Per-HA p50 "
+        "(ms) | Batched rows/s | Per-HA rows/s | Speedup |\n"
+        "|---|---|---|---|---|---|---|---|\n"
+    )
+    date = datetime.date.today().isoformat()
+    row = (
+        f"| {date} | {record['backend']} | {record['config']} "
+        f"| {record['batched_p50_ms']} | {record['per_ha_p50_ms']} "
+        f"| {record['batched_rps']} | {record['per_ha_rps']} "
+        f"| {record['speedup']}x |\n"
+    )
+    _append_table_row(path, marker, header, row)
+
+
+def run_cost(args, metric: str, note: str) -> None:  # lint: allow-complexity — bench arm: parity pin + two timed dispatch shapes inline
+    """Batched vs per-HA multi-objective refinement: the cost
+    subsystem's one-dispatch claim (docs/cost.md). Both paths run the
+    IDENTICAL jitted kernel on identical rows; only the dispatch shape
+    differs — one [N, K, M] program vs N [1, K, M] programs (the second
+    compiled once and reused, so the gap is pure dispatch/launch
+    overhead, not recompiles). The numpy mirror is asserted
+    bit-identical on every output field before any timing."""
+    import dataclasses
+
+    import jax
+
+    from karpenter_tpu.ops.cost import CostOutputs, cost_jit, cost_numpy
+
+    print(
+        f"backend={jax.default_backend()} devices={jax.devices()}",
+        file=sys.stderr,
+    )
+    inputs = build_cost_inputs(args.cost_rows, args.cost_metrics, args.seed)
+    rows = [
+        dataclasses.replace(
+            inputs,
+            **{
+                f.name: np.asarray(getattr(inputs, f.name))[i: i + 1]
+                for f in dataclasses.fields(inputs)
+            },
+        )
+        for i in range(args.cost_rows)
+    ]
+    # parity pin FIRST (the bench's acceptance gate): device == mirror,
+    # bit for bit, on the exact workload about to be timed
+    device_out = cost_jit(inputs)
+    jax.block_until_ready(device_out)
+    host_out = cost_numpy(inputs)
+    for f in dataclasses.fields(CostOutputs):
+        a = np.asarray(getattr(device_out, f.name))
+        b = np.asarray(getattr(host_out, f.name))
+        if not np.array_equal(a, b):
+            raise AssertionError(
+                f"cost kernel parity violated on {f.name}: "
+                f"device != numpy mirror"
+            )
+    jax.block_until_ready(cost_jit(rows[0]))  # warm the per-HA shape
+
+    batched_times, per_row_times = [], []
+    for _ in range(args.iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(cost_jit(inputs))
+        batched_times.append((time.perf_counter() - t0) * 1e3)
+    for _ in range(args.iters):
+        t0 = time.perf_counter()
+        for row in rows:
+            jax.block_until_ready(cost_jit(row))
+        per_row_times.append((time.perf_counter() - t0) * 1e3)
+
+    record = _cost_record(
+        args, jax.default_backend(), batched_times, per_row_times
+    )
+    record_evidence(
+        batched_iter_ms=[round(t, 4) for t in batched_times],
+        per_ha_iter_ms=[round(t, 4) for t in per_row_times],
+        cost=record,
+        transport_floor=measure_transport_floor(),
+    )
+    print(
+        f"batched p50={record['batched_p50_ms']}ms "
+        f"({record['batched_rps']} rows/s) | per-HA "
+        f"p50={record['per_ha_p50_ms']}ms "
+        f"({record['per_ha_rps']} rows/s) | "
+        f"speedup={record['speedup']}x",
+        file=sys.stderr,
+    )
+    if args.publish_baseline:
+        _publish_to_baseline(
+            f"{record['config']} ({record['backend']})", record
+        )
+    if args.append_benchmarks:
+        _append_cost_row(args.append_benchmarks, record)
+    extra = (
+        f"{record['batched_rps']} vs {record['per_ha_rps']} rows/sec "
+        f"batched vs per-HA ({record['speedup']}x); numpy parity pinned"
     )
     emit(
         f"{metric} ({jax.default_backend()})",

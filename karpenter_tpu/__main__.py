@@ -254,6 +254,32 @@ def parse_args(argv=None) -> argparse.Namespace:
         help="with --simulate --restart-storm: kill/reboot cycles",
     )
     parser.add_argument(
+        "--cost",
+        action="store_true",
+        help="with --simulate: replay a seeded diurnal ramp + spot-price "
+        "step through two cost-aware worlds (warm pool on vs off) behind "
+        "a lagged provider and report the hourly-cost and "
+        "provisioning-lead-time deltas (docs/cost.md); cost-aware "
+        "scaling in the running control plane is opt-in per HA via "
+        "spec.behavior.slo and per group via spec.warmPool, no flag "
+        "needed",
+    )
+    parser.add_argument(
+        "--cost-default-hourly",
+        type=float,
+        default=1.0,
+        help="hourly price for a node whose instance type the built-in "
+        "cost catalog doesn't know (docs/cost.md); per-group overrides "
+        "via the cost.karpenter.sh/hourly-cost annotation win",
+    )
+    parser.add_argument(
+        "--cost-spot-multiplier",
+        type=float,
+        default=0.35,
+        help="spot/preemptible-tier price as a fraction of on-demand "
+        "in the cost model (docs/cost.md)",
+    )
+    parser.add_argument(
         "--forecast",
         action="store_true",
         help="with --simulate: replay a synthetic diurnal ramp through "
@@ -315,7 +341,7 @@ def _run_simulation(args, store) -> int:  # lint: allow-complexity — simulatio
 
     if args.trace_export and not (
         args.forecast or args.restart_storm or args.preempt
-        or args.consolidate or args.what_if
+        or args.consolidate or args.what_if or args.cost
     ):
         # the traced end-to-end replay (docs/observability.md): a seeded
         # consolidating world driven tick by tick, exporting a trace in
@@ -328,6 +354,19 @@ def _run_simulation(args, store) -> int:  # lint: allow-complexity — simulatio
         # count): clear the flag so main's exit-time _export_trace
         # doesn't rewrite the identical file
         args.trace_export = None
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return 0
+
+    if args.cost:
+        # self-contained replay (own stores, lagged fake provider):
+        # warm pool on vs off through the cost-aware pipeline
+        from karpenter_tpu.simulate import simulate_cost
+
+        report = simulate_cost(
+            horizon_s=args.forecast_horizon,
+            default_hourly=args.cost_default_hourly,
+            spot_multiplier=args.cost_spot_multiplier,
+        )
         print(json.dumps(report, indent=2, sort_keys=True))
         return 0
 
@@ -394,6 +433,8 @@ def _run_simulation(args, store) -> int:  # lint: allow-complexity — simulatio
             solver_uri=args.solver_uri,
             cloud_provider=args.cloud_provider,
             verbose=args.verbose,
+            cost_default_hourly=args.cost_default_hourly,
+            cost_spot_multiplier=args.cost_spot_multiplier,
         ),
         store=store,
     )
@@ -415,10 +456,12 @@ def _run_simulation(args, store) -> int:  # lint: allow-complexity — simulatio
             report = simulate_delta(
                 runtime.store, what_if, solver=solver,
                 template_resolver=resolver,
+                cost_model=runtime.cost_model,
             )
         else:
             report = simulate(
-                runtime.store, solver=solver, template_resolver=resolver
+                runtime.store, solver=solver, template_resolver=resolver,
+                cost_model=runtime.cost_model,
             )
         print(json.dumps(report, indent=2, sort_keys=True))
     finally:
@@ -592,6 +635,8 @@ def main(argv=None) -> int:
             solver_shard_mesh=_parse_mesh_shape(args.shard_mesh),
             forecast_history=args.forecast_history,
             stale_metric_max_age_s=args.stale_metric_max_age,
+            cost_default_hourly=args.cost_default_hourly,
+            cost_spot_multiplier=args.cost_spot_multiplier,
         ),
         store=store,
     )

@@ -237,11 +237,73 @@ class ForecastSpec:
 
 
 @dataclass(slots=True)
+class SLOSpec:
+    """Cost- and SLO-aware scaling behavior (docs/cost.md): opt a
+    HorizontalAutoscaler into the fleet's multi-objective refinement —
+    the batched decide gains a second pass (ops/cost.py, ONE device
+    dispatch for the whole fleet) that weighs expected hourly cost
+    against SLO-violation risk, using the forecast distribution
+    (spec.behavior.forecast) as the risk input when present.
+
+    The reference has no cost surface at all; absent this spec the
+    decision pipeline is bit-identical to the cost-blind one
+    (wire-compat pinned in tests/test_cost.py).
+    """
+
+    # per-replica capacity the SLO deems safe, in metric units (e.g.
+    # queue items one replica absorbs within the latency objective);
+    # 0/None falls back to each metric's own HPA target value
+    target_value: Optional[float] = None
+    # $/hour penalty at full violation risk: the exchange rate between
+    # the two objectives — 0 keeps decisions cost-visible (gauges) but
+    # never moves them
+    violation_cost_weight: float = 0.0
+    # hard budget: candidates above floor(maxHourlyCost / unitCost)
+    # replicas are trimmed (never below minReplicas); 0 = uncapped
+    max_hourly_cost: float = 0.0
+
+    def validate(self) -> None:
+        if self.target_value is not None and self.target_value <= 0:
+            raise ValueError(
+                f"slo targetValue must be > 0, got {self.target_value}"
+            )
+        if self.violation_cost_weight < 0:
+            raise ValueError(
+                "slo violationCostWeight must be >= 0, got "
+                f"{self.violation_cost_weight}"
+            )
+        if self.max_hourly_cost < 0:
+            raise ValueError(
+                f"slo maxHourlyCost must be >= 0, got "
+                f"{self.max_hourly_cost}"
+            )
+
+
+@dataclass(slots=True)
 class Behavior:
     scale_up: Optional[ScalingRules] = None
     scale_down: Optional[ScalingRules] = None
     # opt-in predictive scaling (docs/forecasting.md)
     forecast: Optional[ForecastSpec] = None
+    # opt-in cost- and SLO-aware refinement (docs/cost.md)
+    slo: Optional[SLOSpec] = None
+
+    def validate(self) -> None:
+        for rules in (self.scale_up, self.scale_down):
+            if rules is None:
+                continue
+            if rules.stabilization_window_seconds is not None and not (
+                0 <= rules.stabilization_window_seconds <= 3600
+            ):
+                raise ValueError(
+                    "stabilizationWindowSeconds must be in [0, 3600], "
+                    f"got {rules.stabilization_window_seconds}"
+                )
+            for policy in rules.policies or []:
+                policy.validate()
+        for sub in (self.forecast, self.slo):
+            if sub is not None:
+                sub.validate()
 
     def scale_up_rules(self) -> ScalingRules:
         """Defaults: no stabilization, Max select (reference:
@@ -358,20 +420,7 @@ class HorizontalAutoscaler:
                 "maxReplicas cannot be less than minReplicas "
                 f"({self.spec.max_replicas} < {self.spec.min_replicas})"
             )
-        for rules in (self.spec.behavior.scale_up, self.spec.behavior.scale_down):
-            if rules is None:
-                continue
-            if rules.stabilization_window_seconds is not None and not (
-                0 <= rules.stabilization_window_seconds <= 3600
-            ):
-                raise ValueError(
-                    "stabilizationWindowSeconds must be in [0, 3600], got "
-                    f"{rules.stabilization_window_seconds}"
-                )
-            for policy in rules.policies or []:
-                policy.validate()
-        if self.spec.behavior.forecast is not None:
-            self.spec.behavior.forecast.validate()
+        self.spec.behavior.validate()
 
     def default(self) -> None:
         """reference: horizontalautoscaler_defaults.go (no-op)."""

@@ -83,7 +83,7 @@ class BatchAutoscaler:
 
     def __init__(
         self, metrics_client_factory, store: Store, clock=_time.time,
-        decider=None, forecaster=None,
+        decider=None, forecaster=None, cost_engine=None,
     ):
         self.metrics = metrics_client_factory
         self.store = store
@@ -93,6 +93,12 @@ class BatchAutoscaler:
         # FleetForecaster owning metric history, the batched forecast
         # dispatch, and online skill gating. None = reactive-only.
         self.forecaster = forecaster
+        # cost/SLO refinement seam (cost/, docs/cost.md): a CostEngine
+        # refining the fleet decide in one batched dispatch — desired
+        # counts only; conditions keep reporting the behavior-pipeline
+        # view. None (or an SLO-free fleet) = cost-blind, bit-identical
+        # decisions.
+        self.cost_engine = cost_engine
         # Times enter the kernel as f32 seconds relative to this epoch so a
         # long-lived process never loses sub-second precision to f32.
         self.epoch = clock()
@@ -246,6 +252,12 @@ class BatchAutoscaler:
                     live, self.clock()
                 )
             outputs = self._decide(live, forecasts)
+            if self.cost_engine is not None:
+                # the multi-objective pass (docs/cost.md): ONE batched
+                # refine of the whole fleet's desired counts; any
+                # failure returns the base outputs (never-block) and
+                # an SLO-free fleet returns the SAME object untouched
+                outputs = self.cost_engine.adjust(live, outputs)
             now = self.clock()
             for i, row in enumerate(live):
                 self._apply(row, outputs, i, now)
@@ -514,11 +526,11 @@ class AutoscalerFactory:
 
     def __init__(
         self, metrics_client_factory, store: Store, clock=_time.time,
-        decider=None, forecaster=None,
+        decider=None, forecaster=None, cost_engine=None,
     ):
         self.batch = BatchAutoscaler(
             metrics_client_factory, store, clock, decider=decider,
-            forecaster=forecaster,
+            forecaster=forecaster, cost_engine=cost_engine,
         )
 
     def reconcile(self, ha: HorizontalAutoscaler) -> None:

@@ -42,6 +42,9 @@ class HorizontalAutoscalerController:
         forecaster = getattr(self.autoscaler, "forecaster", None)
         if forecaster is not None:
             forecaster.prune(ha.metadata.namespace, ha.metadata.name)
+        cost_engine = getattr(self.autoscaler, "cost_engine", None)
+        if cost_engine is not None:
+            cost_engine.prune(ha.metadata.namespace, ha.metadata.name)
 
     def reconcile(self, ha) -> None:
         error = self.reconcile_batch([ha]).get(

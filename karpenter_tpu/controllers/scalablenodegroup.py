@@ -52,6 +52,21 @@ from karpenter_tpu.resilience import CircuitBreaker
 from karpenter_tpu.utils.log import logger
 
 
+def _serving_replicas(resource, observed: int, warm: int) -> int:
+    """What status.replicas reports: SERVING replicas, warm headroom
+    excluded. The scale subresource feeds the decision kernel's
+    proportional math as current replicas (Value/Utilization targets),
+    and counting warm nodes there would ratchet the fleet up by the
+    warm amount every tick (spec rises to match the inflated status,
+    warm rides on top, repeat until maxReplicas). Only nodes BEYOND
+    spec.replicas are warm — mid-transition, everything observed up to
+    spec is serving — and with warm 0 this is exactly `observed`
+    (byte-identical pre-warm-pool behavior)."""
+    if resource.spec.replicas is None or warm <= 0:
+        return observed
+    return min(observed, max(resource.spec.replicas, observed - warm))
+
+
 class ScalableNodeGroupController:
     # this controller ACKS the e2e lead-time mark (ack_observed on the
     # provider-write return, drop_observed on convergence): the engine
@@ -64,6 +79,7 @@ class ScalableNodeGroupController:
         cloud_provider_factory,
         consolidator=None,
         preemptor=None,
+        warmpool=None,
         registry=None,
         circuit_failure_threshold: int = 5,
         circuit_reset_s: float = 120.0,
@@ -79,6 +95,11 @@ class ScalableNodeGroupController:
         # PreemptionEngine (or None): same cadence door — eviction
         # planning rides the reconcile loop, interval-bounded in-engine
         self.preemptor = preemptor
+        # WarmPoolEngine (or None): spec.warmPool groups actuate
+        # spec.replicas + warm through this controller's one provider
+        # door (docs/cost.md "Warm pools"); groups without the spec see
+        # byte-identical behavior (warm == 0)
+        self.warmpool = warmpool
         self.circuit_failure_threshold = circuit_failure_threshold
         self.circuit_reset_s = circuit_reset_s
         self.clock = clock or _time.monotonic
@@ -124,6 +145,8 @@ class ScalableNodeGroupController:
         with a CLOSED circuit, not inherit a dead group's open one."""
         key = (resource.metadata.namespace, resource.metadata.name)
         self._breakers.pop(key, None)
+        if self.warmpool is not None:
+            self.warmpool.on_deleted(resource)
         if self._j_breaker is not None and key in self._journaled_breakers:
             self._j_breaker.delete(key)
             self._journaled_breakers.discard(key)
@@ -286,42 +309,58 @@ class ScalableNodeGroupController:
             mgr.mark_false(cond.STABILIZED, "", message)
 
         # 2. observe replicas
+        warm = (
+            self.warmpool.warm_for(resource)
+            if self.warmpool is not None
+            else 0
+        )
         observed = node_group.get_replicas()
-        resource.status.replicas = observed
+        resource.status.replicas = _serving_replicas(
+            resource, observed, warm
+        )
 
         self._resolve_pending_intent(resource, observed)
 
-        # 3. actuate when spec diverges from observation. Scale-UPS never
-        # pile onto a group mid-change: overlapping grow resizes against a
-        # pool whose previous resize is in flight can strand partial TPU
+        # 3. actuate when the TARGET diverges from observation — target
+        # = spec.replicas + warm headroom (docs/cost.md "Warm pools";
+        # warm is 0 without spec.warmPool, keeping the pre-cost
+        # divergence check byte for byte). Scale-UPS never pile onto a
+        # group mid-change: overlapping grow resizes against a pool
+        # whose previous resize is in flight can strand partial TPU
         # slices (tpu.py module doc); the next loop grows once stable.
-        # Scale-DOWNS actuate even while unstable — when a group is stuck
-        # converging (e.g. an ASG capped below desired by a capacity
-        # shortage, permanently un-stable under the healthy==desired
-        # check), the corrective shrink is exactly the action that
-        # unsticks it, and blocking it would deadlock the resource.
-        if resource.spec.replicas is None or resource.spec.replicas == observed:
+        # Scale-DOWNS actuate even while unstable — when a group is
+        # stuck converging (e.g. an ASG capped below desired by a
+        # capacity shortage, permanently un-stable under the
+        # healthy==desired check), the corrective shrink is exactly the
+        # action that unsticks it, and blocking it would deadlock the
+        # resource.
+        if resource.spec.replicas is None:
+            default_tracer().drop_observed(self._e2e_key(resource))
+            return
+        target = resource.spec.replicas + warm
+        if target == observed:
             # converged, nothing to actuate: retire any e2e observation
             # mark — a stale stamp must not inflate a later ack's
             # karpenter_reconcile_e2e_seconds sample
             default_tracer().drop_observed(self._e2e_key(resource))
             return
-        if not stable and resource.spec.replicas > observed:
+        if not stable and target > observed:
             return
-        self._set_replicas(node_group, resource)
+        self._set_replicas(node_group, resource, target)
         # the provider write returned: the actuation is ACKED — close
         # the event-observed -> actuation-acked window (the BLITZSCALE
         # lead-time observable, docs/observability.md)
         default_tracer().ack_observed(self._e2e_key(resource))
         logger().debug(
-            "ScalableNodeGroup %s updated nodes %d -> %d",
+            "ScalableNodeGroup %s updated nodes %d -> %d (%d warm)",
             resource.spec.id,
             observed,
-            resource.spec.replicas,
+            target,
+            warm,
         )
-        if resource.spec.replicas < observed:
+        if target < observed:
             self._finish_scale_down(
-                resource, mgr, observed, stable, message
+                resource, mgr, observed, target, stable, message
             )
 
     def _resolve_pending_intent(self, resource, observed: int) -> None:
@@ -366,8 +405,9 @@ class ScalableNodeGroupController:
             resource.metadata.name,
         )
 
-    def _set_replicas(self, node_group, resource) -> None:
-        """The one provider-write door. Unfenced (no RecoveryManager):
+    def _set_replicas(self, node_group, resource, target: int) -> None:
+        """The one provider-write door — `target` includes any warm-pool
+        headroom on top of spec.replicas. Unfenced (no RecoveryManager):
         the plain call, byte-compatible with every existing provider
         fake. Fenced: journal the intent, stamp the incarnation's fence
         token (the provider verifies it before applying), ack on
@@ -377,29 +417,28 @@ class ScalableNodeGroupController:
         with default_tracer().span(
             "actuate.set_replicas",
             group=resource.spec.id,
-            target=resource.spec.replicas,
+            target=target,
             fenced=self.fence is not None,
         ):
             if self.fence is None:
-                node_group.set_replicas(resource.spec.replicas)
+                node_group.set_replicas(target)
                 return
             akey = (resource.metadata.namespace, resource.metadata.name)
             intent = {
-                "target": resource.spec.replicas,
+                "target": target,
                 "gen": self.fence.generation,
             }
             self._intents[akey] = intent
             if self._j_actuation is not None:
                 self._j_actuation.set(akey, intent)
-            node_group.set_replicas(
-                resource.spec.replicas, token=self.fence.token()
-            )
+            node_group.set_replicas(target, token=self.fence.token())
             self._intents.pop(akey, None)
             if self._j_actuation is not None:
                 self._j_actuation.delete(akey)
 
     def _finish_scale_down(
-        self, resource, mgr, observed: int, stable: bool, message: str
+        self, resource, mgr, observed: int, target: int, stable: bool,
+        message: str,
     ) -> None:
         """Post-actuation bookkeeping for a shrink: let the consolidation
         engine finalize any drains this scale-down carries, and surface a
@@ -412,11 +451,11 @@ class ScalableNodeGroupController:
             drained = self.consolidator.on_scale_down(
                 resource.metadata.namespace,
                 resource.metadata.name,
-                observed - resource.spec.replicas,
+                observed - target,
             )
         if not stable:
             detail = (
-                f"scale-down {observed}->{resource.spec.replicas} "
+                f"scale-down {observed}->{target} "
                 f"actuated while unstable: {message}"
             )
             if drained:

@@ -31,6 +31,10 @@ Points instrumented across the stack (docs/resilience.md):
   solver.dispatch     device path of the shared solve service
   forecast.predict    device path of the batched forecast seam
   preempt.plan        device path of the eviction-planning seam
+  cost.score          device path of the multi-objective cost/SLO
+                      refinement (SolverService.cost) — failures make
+                      the tick COST-BLIND, not mirror-served
+                      (docs/cost.md degradation contract)
   encoder.encode      snapshot -> solver-operand encode
   cloud.get_replicas  provider replica observation
   cloud.set_replicas  provider actuation

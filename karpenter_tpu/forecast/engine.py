@@ -65,6 +65,12 @@ def query_key(name: str, labels: Optional[dict]) -> tuple:
     return ("q", name, tuple(sorted((labels or {}).items())))
 
 
+def _drop_keys(table: Dict, predicate) -> None:
+    """Delete every key matching predicate (prune helper)."""
+    for key in [k for k in table if predicate(k)]:
+        del table[key]
+
+
 class FleetForecaster:
     """One per runtime; see module docstring.
 
@@ -99,6 +105,12 @@ class FleetForecaster:
         # (ns, name) -> skill EWMA in [0, 1]; optimistic start (1.0) so a
         # fresh forecaster blends until its predictions prove bad
         self._skill: Dict[tuple, float] = {}
+        # (ns, name, metric_index) -> (point, sigma2, expires_at) of the
+        # newest batched forecast — the demand DISTRIBUTION the cost
+        # subsystem reads as its risk input (docs/cost.md); refreshed
+        # each _predict pass, pruned with the HA, dropped by
+        # distribution() two horizons after its last refresh
+        self._dist: Dict[tuple, Tuple[float, float, float]] = {}
         # series key -> pending (target_time, predicted) awaiting scoring
         self._pending: Dict[tuple, collections.deque] = {}
         # (ns, name) -> (active, reason, message) for the FORECASTING
@@ -153,6 +165,24 @@ class FleetForecaster:
             (namespace, name), (False, REASON_WARMING_UP, "no forecast yet")
         )
 
+    def distribution(
+        self, namespace: str, name: str, metric_index: int
+    ) -> Optional[Tuple[float, float]]:
+        """(point, sigma2) of the newest forecast for one HA metric —
+        the demand distribution the cost subsystem's risk term consumes
+        (docs/cost.md); None while the series hasn't forecast yet, and
+        None again once a forecast goes two horizons without a refresh
+        (the stale entry is dropped, not served)."""
+        key = (namespace, name, metric_index)
+        entry = self._dist.get(key)
+        if entry is None:
+            return None
+        point, sigma2, expires = entry
+        if self.clock() >= expires:
+            del self._dist[key]
+            return None
+        return (point, sigma2)
+
     def prune(self, namespace: str, name: str) -> None:
         """Forget a deleted HorizontalAutoscaler (HA controller
         on_deleted hook): history, skill, pending scores, gauges."""
@@ -163,10 +193,12 @@ class FleetForecaster:
         ):
             self.journal.delete(("skill", namespace, name))
         self._verdicts.pop((namespace, name), None)
-        for key in [
-            k for k in self._pending if k[1] == namespace and k[2] == name
-        ]:
-            del self._pending[key]
+        _drop_keys(
+            self._pending, lambda k: k[1] == namespace and k[2] == name
+        )
+        _drop_keys(
+            self._dist, lambda k: k[0] == namespace and k[1] == name
+        )
         if self._g_skill is not None:
             self._g_skill.remove(name, namespace)
             self._g_value.remove(name, namespace)
@@ -344,6 +376,7 @@ class FleetForecaster:
         inputs = self._build_inputs(eligible, now)
         out = self.forecast_fn(inputs)
         points = np.asarray(out.point, np.float32)
+        sigma2 = np.asarray(out.sigma2, np.float32)
         n_valid = np.asarray(out.n_valid)
         step_s = np.asarray(inputs.step_s)
         forecasts: Dict[tuple, float] = {}
@@ -352,6 +385,15 @@ class FleetForecaster:
                 continue
             point = float(points[k])
             ns, name = _ha_key(rows[i].ha)
+            # the distribution surface (cost subsystem risk input) —
+            # refreshed for SHADOW (skill-gated) series too: the risk
+            # term gates on its own spec, not on the blend verdict.
+            # Expiry-stamped: a series that stops forecasting (broken
+            # metric, history reset) must not pin an obsolete spike as
+            # the risk input forever — two horizons without a refresh
+            # and distribution() forgets it.
+            expires = now + 2.0 * max(float(fspec.horizon_seconds), 1.0)
+            self._dist[(ns, name, j)] = (point, float(sigma2[k]), expires)
             if blend:
                 forecasts[(i, j)] = point
             # remember the prediction for horizon-elapsed scoring —

@@ -186,6 +186,40 @@ class HistogramVec:
         with self._lock:
             return self._sums.get((name, namespace), 0.0)
 
+    def percentile(self, name: str, namespace: str, q: float):
+        """Estimate the q-th percentile (q in [0, 100]) for one series
+        from the bucket counts — the same linear-within-bucket
+        interpolation Prometheus's histogram_quantile() applies, so the
+        simulator reports and bench output quote the number an operator
+        would read off a dashboard. None for an empty series; samples
+        beyond the last finite bucket clamp to that bound (+Inf has no
+        upper edge to interpolate toward)."""
+        with self._lock:
+            counts = self._counts.get((name, namespace))
+            if counts is None:
+                return None
+            counts = list(counts)
+        total = sum(counts)
+        if total == 0:
+            return None
+        rank = (q / 100.0) * total
+        cumulative = 0.0
+        lower = 0.0
+        for idx, count in enumerate(counts):
+            upper = (
+                self.buckets[idx]
+                if idx < len(self.buckets)
+                else self.buckets[-1]  # +Inf bucket clamps to last bound
+            )
+            if cumulative + count >= rank:
+                if idx >= len(self.buckets) or count == 0:
+                    return float(upper)
+                fraction = (rank - cumulative) / count
+                return float(lower + (upper - lower) * fraction)
+            cumulative += count
+            lower = upper
+        return float(self.buckets[-1])
+
     def remove(self, name: str, namespace: str) -> None:
         with self._lock:
             self._counts.pop((name, namespace), None)

@@ -109,6 +109,11 @@ class DecisionOutputs:
     scaling_unbounded: jax.Array  # bool[N] False iff clamped by [min, max]
     able_at: jax.Array  # f32[N] hold end time (valid when !able_to_scale)
     rate_limited: jax.Array  # bool[N] True iff a scaling policy clamped
+    # furthest a hypothetical up/down move could go this tick under the
+    # declared stabilization windows + rate policies (the bound the cost
+    # refinement's candidate ladder must respect — cost/engine.py)
+    up_ceiling: jax.Array  # i32[N]
+    down_floor: jax.Array  # i32[N]
 
 
 def _ceil_guarded(x: jax.Array) -> jax.Array:
@@ -276,6 +281,23 @@ def decide(inputs: DecisionInputs) -> DecisionOutputs:
     )
     scaling_unbounded = bounded == limited
 
+    # --- per-direction movement bounds (the cost-refinement contract) -----
+    # The furthest a HYPOTHETICAL move could go this tick under the
+    # declared behavior, independent of where the reactive recommendation
+    # actually landed: a direction still inside its stabilization window
+    # holds at spec, otherwise the rate budget bounds the step. The cost
+    # subsystem (cost/engine.py) clamps its candidate ladder to
+    # [down_floor, up_ceiling] so an SLO raise or budget trim can never
+    # outrun the scaleUp/scaleDown rules the operator declared.
+    up_hold = inputs.has_last_scale & (
+        elapsed < inputs.up_window.astype(jnp.float32)
+    )
+    down_hold = inputs.has_last_scale & (
+        elapsed < inputs.down_window.astype(jnp.float32)
+    )
+    up_ceiling = jnp.where(up_hold, spec, spec + allowed_up)
+    down_floor = jnp.where(down_hold, spec, spec - allowed_down)
+
     to_i32 = lambda x: jnp.clip(
         x, jnp.float32(_I32_SAFE_MIN), jnp.float32(_I32_SAFE_MAX)
     ).astype(jnp.int32)
@@ -287,6 +309,8 @@ def decide(inputs: DecisionInputs) -> DecisionOutputs:
         scaling_unbounded=scaling_unbounded,
         able_at=able_at,
         rate_limited=rate_limited,
+        up_ceiling=to_i32(up_ceiling),
+        down_floor=to_i32(down_floor),
     )
 
 

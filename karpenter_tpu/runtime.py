@@ -102,6 +102,12 @@ class Options:
     # consolidation or preemption planning until this many reconcile
     # passes have confirmed fleet state (first boots skip it)
     recovery_warmup_ticks: int = 1
+    # cost model knobs (karpenter_tpu/cost, docs/cost.md): price for
+    # catalog-unknown instance types and the spot-tier multiplier. The
+    # subsystem itself is opt-in per HA (spec.behavior.slo) and per
+    # node group (spec.warmPool) — these size the shared pricing only.
+    cost_default_hourly: float = 1.0
+    cost_spot_multiplier: float = 0.35
 
 
 class KarpenterRuntime:
@@ -185,10 +191,34 @@ class KarpenterRuntime:
             registry=self.registry, prometheus_uri=options.prometheus_uri,
             observer=self.forecaster.observe_query,
         )
+        # cost/SLO subsystem (cost/, docs/cost.md): the multi-objective
+        # refinement of the fleet decide through SolverService.cost and
+        # the forecast-risk-sized warm pools it signals. Always built —
+        # an SLO-free fleet pays one list comprehension per tick and
+        # decisions stay bit-identical (the engine's zero-overhead
+        # opt-out contract).
+        from karpenter_tpu.cost import CostEngine, CostModel, WarmPoolEngine
+
+        self.cost_model = CostModel(
+            default_hourly=options.cost_default_hourly,
+            spot_multiplier=options.cost_spot_multiplier,
+        )
+        self.cost_engine = CostEngine(
+            store=self.store,
+            cost_fn=self.solver_service.cost,
+            model=self.cost_model,
+            forecaster=self.forecaster,
+            registry=self.registry,
+        )
+        self.warmpool = WarmPoolEngine(
+            headroom_source=self.cost_engine.headroom,
+            registry=self.registry,
+        )
         self.batch_autoscaler = BatchAutoscaler(
             self.metrics_clients, self.store, clock=self.clock,
             decider=self.solver_service.decide,
             forecaster=self.forecaster,
+            cost_engine=self.cost_engine,
         )
         # consolidation engine (opt-in): plans batched node drains
         # through the shared solve service and actuates them through the
@@ -247,6 +277,7 @@ class KarpenterRuntime:
         self._sng_controller = ScalableNodeGroupController(
             self.cloud_provider, consolidator=self.consolidation,
             preemptor=self.preemption,
+            warmpool=self.warmpool,
             registry=self.registry,
             circuit_failure_threshold=options.circuit_failure_threshold,
             circuit_reset_s=options.circuit_reset_s,

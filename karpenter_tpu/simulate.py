@@ -60,6 +60,7 @@ def simulate(  # lint: allow-complexity — report assembly: one guard per optio
     what_if_groups: Optional[List[dict]] = None,
     solver=None,
     template_resolver=None,
+    cost_model=None,
 ) -> dict:
     """One dry-run solve over the store's pendingCapacity producers plus
     `what_if_groups` (each {"name", "allocatable", "labels", "taints"}).
@@ -170,6 +171,16 @@ def simulate(  # lint: allow-complexity — report assembly: one guard per optio
         score = np.array(inputs.pod_group_score)
         score[:, len(profiles) - len(what_if_names): len(profiles)] = 0.0
         inputs = dataclasses.replace(inputs, pod_group_score=score)
+    # per-group node pricing (cost/model.py): the columnar cost face of
+    # the SAME profiles the solve encodes, so the report prices what a
+    # scale-up signal would actually cost per hour (`cost_model` lets
+    # the CLI's --cost-default-hourly/--cost-spot-multiplier knobs
+    # reach the dry-run report)
+    if cost_model is None:
+        from karpenter_tpu.cost import CostModel
+
+        cost_model = CostModel()
+    group_cost = cost_model.group_costs(profiles)
     if len(row_idx) == 0:
         return {
             "groups": {
@@ -177,6 +188,8 @@ def simulate(  # lint: allow-complexity — report assembly: one guard per optio
                     "pending_pods": 0,
                     "additional_nodes_needed": 0,
                     "lp_lower_bound": 0,
+                    "node_hourly_cost": round(float(group_cost[t]), 4),
+                    "scale_up_hourly_cost": 0.0,
                     "what_if": name in what_if_names,
                     **(
                         {"error": group_errors[name]}
@@ -184,7 +197,7 @@ def simulate(  # lint: allow-complexity — report assembly: one guard per optio
                         else {}
                     ),
                 }
-                for name in names
+                for t, name in enumerate(names)
             },
             "rows": [],
             "unschedulable_pods": 0,
@@ -213,6 +226,10 @@ def simulate(  # lint: allow-complexity — report assembly: one guard per optio
                 "pending_pods": int(assigned_count[t]),
                 "additional_nodes_needed": int(nodes_needed[t]),
                 "lp_lower_bound": int(lp_bound[t]),
+                "node_hourly_cost": round(float(group_cost[t]), 4),
+                "scale_up_hourly_cost": round(
+                    float(nodes_needed[t]) * float(group_cost[t]), 4
+                ),
                 "what_if": name in what_if_names,
                 **(
                     {"error": group_errors[name]}
@@ -1254,17 +1271,357 @@ def simulate_restart_storm(  # lint: allow-complexity — scenario assembly: cra
             shutil.rmtree(journal_dir, ignore_errors=True)
 
 
+# -- cost / warm-pool replay (--simulate --cost) ------------------------------
+
+
+def _cost_world(
+    warm_on: bool, initial: int, target: float, provision_lag: int,
+    horizon_s: float, min_samples: int, violation_weight: float,
+    max_hourly_cost: float, min_warm: int, max_warm: int, clock, backend,
+    options=None,
+):
+    """One self-contained cost-replay world: a spot-tier node group
+    behind a LAGGED provider (resizes ack immediately, PROVISIONED
+    capacity trails scale-ups by `provision_lag` ticks — the lead time
+    warm pools exist to hide), an SLO- and forecast-enabled autoscaler,
+    and a full KarpenterRuntime so the warm target rides the real
+    fenced SNG actuation path and the reconcile tracer's e2e histogram
+    fills. Returns (runtime, provider, group_id)."""
+    from karpenter_tpu.api.core import ObjectMeta
+    from karpenter_tpu.api.horizontalautoscaler import (
+        Behavior,
+        CrossVersionObjectReference,
+        ForecastSpec,
+        HorizontalAutoscaler,
+        HorizontalAutoscalerSpec,
+        Metric,
+        MetricTarget,
+        PrometheusMetricSource,
+        SLOSpec,
+    )
+    from karpenter_tpu.api.scalablenodegroup import (
+        ScalableNodeGroup,
+        ScalableNodeGroupSpec,
+        WarmPoolSpec,
+    )
+    from karpenter_tpu.cloudprovider.fake import FakeFactory, FakeNodeGroup
+    from karpenter_tpu.cost import INSTANCE_TYPE_ANNOTATION
+    from karpenter_tpu.runtime import KarpenterRuntime, Options
+    from karpenter_tpu.store import Store
+
+    class _LaggedGroup(FakeNodeGroup):
+        def set_replicas(self, count, token=None):
+            super().set_replicas(count, token=token)
+            f = self._factory
+            f.writes.append((f.tick_now, self._id, count))
+            have = f.provisioned.get(self._id, 0)
+            # ANY write supersedes in-flight grows above its target —
+            # including a shrink that still lands above provisioned
+            # capacity, which must not leave a larger stale grow alive
+            # to overshoot later
+            f.pending = [
+                p for p in f.pending
+                if p[1] != self._id or p[2] <= count
+            ]
+            if count <= have:
+                # shrinks release capacity immediately
+                f.provisioned[self._id] = count
+            else:
+                f.pending.append((f.tick_now + f.lag, self._id, count))
+
+    class _LaggedFactory(FakeFactory):
+        def __init__(self, lag):
+            super().__init__()
+            self.lag = lag
+            self.tick_now = 0
+            self.provisioned = {}
+            self.pending = []  # (due_tick, group_id, count)
+            self.writes = []
+
+        def node_group_for(self, spec):
+            return _LaggedGroup(self, spec.id)
+
+        def advance(self):
+            self.tick_now += 1
+            for due, gid, count in list(self.pending):
+                if due <= self.tick_now:
+                    self.provisioned[gid] = max(
+                        self.provisioned.get(gid, 0), count
+                    )
+            self.pending = [
+                p for p in self.pending if p[0] > self.tick_now
+            ]
+
+    gid = "cost-group"
+    store = Store()
+    provider = _LaggedFactory(provision_lag)
+    provider.node_replicas[gid] = initial
+    provider.provisioned[gid] = initial
+    store.create(ScalableNodeGroup(
+        metadata=ObjectMeta(
+            name="grp",
+            # spot-tier m5.xlarge pricing (cost/model.py): the replay's
+            # spot-price step multiplies the model's spot multiplier
+            annotations={INSTANCE_TYPE_ANNOTATION: "m5.xlarge"},
+        ),
+        spec=ScalableNodeGroupSpec(
+            replicas=initial, type="FakeNodeGroup", id=gid,
+            preemptible=True,
+            warm_pool=(
+                WarmPoolSpec(min_warm=min_warm, max_warm=max_warm)
+                if warm_on
+                else None
+            ),
+        ),
+    ))
+    store.create(HorizontalAutoscaler(
+        metadata=ObjectMeta(name="ha"),
+        spec=HorizontalAutoscalerSpec(
+            scale_target_ref=CrossVersionObjectReference(
+                kind="ScalableNodeGroup", name="grp"
+            ),
+            min_replicas=1,
+            max_replicas=10_000,
+            metrics=[Metric(prometheus=PrometheusMetricSource(
+                query='karpenter_queue_length{name="q"}',
+                target=MetricTarget(type="AverageValue", value=target),
+            ))],
+            behavior=Behavior(
+                forecast=ForecastSpec(
+                    horizon_seconds=horizon_s, model="linear",
+                    min_samples=min_samples,
+                ),
+                slo=SLOSpec(
+                    violation_cost_weight=violation_weight,
+                    max_hourly_cost=max_hourly_cost,
+                ),
+            ),
+        ),
+    ))
+    runtime = KarpenterRuntime(
+        options if options is not None else Options(),
+        store=store, cloud_provider_factory=provider,
+        clock=clock,
+    )
+    runtime.solver_service.backend = backend
+    return runtime, provider, gid
+
+
+def simulate_cost(  # lint: allow-complexity — scenario assembly: two replays + milestone/violation/e2e accounting
+    ticks: int = 110,
+    interval_s: float = 10.0,
+    horizon_s: float = 60.0,
+    target: float = 4.0,
+    base: float = 8.0,
+    amplitude: float = 120.0,
+    ramp_start: int = 25,
+    ramp_ticks: int = 20,
+    spot_step_tick: int = 70,
+    spot_step_factor: float = 3.0,
+    provision_lag: int = 6,
+    min_warm: int = 2,
+    max_warm: int = 8,
+    violation_weight: float = 50.0,
+    max_hourly_cost: float = 0.0,
+    min_samples: int = 4,
+    seed: int = 0,
+    backend: str = "xla",
+    default_hourly: float = 1.0,
+    spot_multiplier: float = 0.35,
+) -> dict:
+    """Seeded cost/warm-pool replay (docs/cost.md "Dry-running"): the
+    same scripted load — flat overnight base, a diurnal morning ramp,
+    a mid-run SPOT-PRICE STEP (the model's spot multiplier jumps
+    `spot_step_factor`x) — is driven through two otherwise-identical
+    cost-aware worlds, warm pool ON vs OFF, behind a provider whose
+    provisioned capacity trails accepted resizes by `provision_lag`
+    ticks. The report quantifies the trade the subsystem exists for:
+    the warm pool's extra hourly cost vs the PROVISIONING LEAD TIME it
+    removes (capacity-coverage milestones) at equal-or-lower
+    SLO-violation count, plus the karpenter_reconcile_e2e_seconds
+    p50/p99 each world measured. Self-contained and mutation-free
+    toward any real cluster (own stores, fake lagged provider)."""
+    import math as _math
+
+    from karpenter_tpu.observability import reset_default_tracer
+
+    rng = np.random.RandomState(seed)
+    noise = rng.normal(0.0, 0.01 * amplitude, size=ticks)
+
+    def metric_at(tick: int) -> float:
+        progress = min(
+            max(tick - ramp_start, 0) / max(ramp_ticks, 1), 1.0
+        )
+        level = base + amplitude * 0.5 * (
+            1.0 - _math.cos(_math.pi * progress)
+        )
+        return max(0.0, level + float(noise[tick]))
+
+    initial = max(1, int(_math.ceil(base / target)))
+
+    def replay(warm_on: bool) -> dict:
+        from karpenter_tpu.runtime import Options
+
+        reset_default_tracer()
+        clock = {"now": 1_000_000.0}
+        runtime, provider, gid = _cost_world(
+            warm_on, initial, target, provision_lag, horizon_s,
+            min_samples, violation_weight, max_hourly_cost,
+            min_warm, max_warm, lambda: clock["now"], backend,
+            options=Options(
+                cost_default_hourly=default_hourly,
+                cost_spot_multiplier=spot_multiplier,
+            ),
+        )
+        gauge = runtime.registry.register("queue", "length")
+        sng = runtime.store.get("ScalableNodeGroup", "default", "grp")
+        provisioned_trail, hourly_trail = [], []
+        violations = shortfall = 0
+        try:
+            for tick in range(ticks):
+                if tick == spot_step_tick:
+                    runtime.cost_model.spot_multiplier *= spot_step_factor
+                demand = metric_at(tick)
+                gauge.set("q", "default", demand)
+                runtime.manager._due = {
+                    k: 0.0 for k in runtime.manager._due
+                }
+                runtime.manager.reconcile_all()
+                provider.advance()
+                clock["now"] += interval_s
+                have = provider.provisioned[gid]
+                provisioned_trail.append(have)
+                hourly_trail.append(
+                    have * runtime.cost_model.unit_cost(sng)
+                )
+                if have * target < demand:
+                    violations += 1
+                    # replica-ticks of uncovered demand: a finer,
+                    # deterministic lead measure than tick counts
+                    shortfall += int(
+                        _math.ceil(demand / target)
+                    ) - have
+            hist = runtime.registry.gauge("reconcile", "e2e_seconds")
+            e2e = {
+                "p50_s": hist.percentile("ScalableNodeGroup", "-", 50),
+                "p99_s": hist.percentile("ScalableNodeGroup", "-", 99),
+                "n": hist.count("ScalableNodeGroup", "-"),
+            }
+            stats = runtime.solver_service.stats
+            return {
+                "provisioned": provisioned_trail,
+                "mean_hourly_cost": round(
+                    float(np.mean(hourly_trail)), 4
+                ),
+                "slo_violation_ticks": violations,
+                "shortfall_replica_ticks": shortfall,
+                "e2e_seconds": e2e,
+                "cost_dispatches": stats.cost_dispatches,
+                "provider_writes": len(provider.writes),
+            }
+        finally:
+            runtime.close()
+
+    on = replay(True)
+    off = replay(False)
+
+    # capacity-coverage milestones: how many ticks after demand reached
+    # a level did PROVISIONED capacity cover it — the end-to-end
+    # provisioning lead the warm pool attacks
+    demand_trail = [metric_at(t) for t in range(ticks)]
+    peak_needed = int(_math.ceil(max(demand_trail) / target))
+
+    def coverage_lag(provisioned, pct: int):
+        level = max(1, int(round(peak_needed * pct / 100.0)))
+        demand_tick = next(
+            (
+                t for t, d in enumerate(demand_trail)
+                if _math.ceil(d / target) >= level
+            ),
+            None,
+        )
+        cover_tick = next(
+            (t for t, p in enumerate(provisioned) if p >= level), None
+        )
+        if demand_tick is None or cover_tick is None:
+            return None
+        return max(0, cover_tick - demand_tick)
+
+    milestones, lags_on, lags_off = {}, [], []
+    for pct in range(10, 101, 10):
+        lag_on = coverage_lag(on["provisioned"], pct)
+        lag_off = coverage_lag(off["provisioned"], pct)
+        milestones[f"{pct}%"] = {
+            "warm_on_lag_ticks": lag_on,
+            "warm_off_lag_ticks": lag_off,
+        }
+        if lag_on is not None and lag_off is not None:
+            lags_on.append(lag_on)
+            lags_off.append(lag_off)
+    mean_on = (sum(lags_on) / len(lags_on)) if lags_on else 0.0
+    mean_off = (sum(lags_off) / len(lags_off)) if lags_off else 0.0
+    return {
+        "config": {
+            "ticks": ticks,
+            "interval_s": interval_s,
+            "horizon_s": horizon_s,
+            "target": target,
+            "ramp": f"{base} -> {base + amplitude} over ticks "
+                    f"[{ramp_start}, {ramp_start + ramp_ticks}]",
+            "spot_step": f"x{spot_step_factor} at tick {spot_step_tick}",
+            "provision_lag_ticks": provision_lag,
+            "warm_pool": f"[{min_warm}, {max_warm}]",
+            "violation_cost_weight": violation_weight,
+            "max_hourly_cost": max_hourly_cost,
+            "seed": seed,
+        },
+        "runs": {"warm_on": on, "warm_off": off},
+        "hourly_cost": {
+            "warm_on_mean": on["mean_hourly_cost"],
+            "warm_off_mean": off["mean_hourly_cost"],
+            "warm_premium": round(
+                on["mean_hourly_cost"] - off["mean_hourly_cost"], 4
+            ),
+        },
+        "slo_violations": {
+            "warm_on": on["slo_violation_ticks"],
+            "warm_off": off["slo_violation_ticks"],
+            "warm_on_shortfall_replica_ticks": on[
+                "shortfall_replica_ticks"
+            ],
+            "warm_off_shortfall_replica_ticks": off[
+                "shortfall_replica_ticks"
+            ],
+        },
+        "provisioning_lead": {
+            "milestones": milestones,
+            "warm_on_mean_lag_ticks": round(mean_on, 2),
+            "warm_off_mean_lag_ticks": round(mean_off, 2),
+            "reduction_ticks": round(mean_off - mean_on, 2),
+            "reduction_seconds": round(
+                (mean_off - mean_on) * interval_s, 1
+            ),
+        },
+        "e2e_seconds": {
+            "warm_on": on["e2e_seconds"],
+            "warm_off": off["e2e_seconds"],
+        },
+    }
+
+
 def simulate_delta(
-    store, what_if_groups: List[dict], solver=None, template_resolver=None
+    store, what_if_groups: List[dict], solver=None,
+    template_resolver=None, cost_model=None,
 ) -> dict:
     """Baseline solve vs what-if solve, with the per-group delta: the
     operator's 'what would adding node group X change?'."""
     baseline = simulate(
-        store, solver=solver, template_resolver=template_resolver
+        store, solver=solver, template_resolver=template_resolver,
+        cost_model=cost_model,
     )
     with_groups = simulate(
         store, what_if_groups, solver=solver,
-        template_resolver=template_resolver,
+        template_resolver=template_resolver, cost_model=cost_model,
     )
     delta = {}
     for name, after in with_groups["groups"].items():

@@ -64,7 +64,10 @@ the batch autoscaler already evaluates the whole fleet at once),
 concatenate along the series axis and ride ONE dispatch), and `preempt`
 (ops/preempt.py — fleet-wide placement-with-eviction planning, every
 candidate in one dispatch). Both of the latter degrade to numpy mirrors
-that are bit-identical to their device kernels.
+that are bit-identical to their device kernels. A fourth synchronous
+family, `cost` (ops/cost.py — the fleet's multi-objective cost/SLO
+refinement in one dispatch), rides the same FSM with a deliberately
+different failure posture: cost-blind, not mirror-served (docs/cost.md).
 
 The service holds NO domain state — it is a pure function of each
 request — so callers keep their own caches (the encode memo, the
@@ -175,6 +178,12 @@ class SolverSaturated(RuntimeError):
     """The bounded request queue is full (backpressure signal)."""
 
 
+class CostUnavailable(RuntimeError):
+    """The cost-refinement path is short-circuited (backend-health FSM
+    degraded, no probe due): the caller proceeds cost-blind this tick
+    (docs/cost.md degradation contract)."""
+
+
 class SolverTimeout(TimeoutError):
     """A request's deadline expired before the device path answered."""
 
@@ -197,6 +206,10 @@ class SolverStatistics:
     pipeline_overlaps: int = 0  # dispatches issued while another was in flight
     decide_calls: int = 0
     decide_errors: int = 0
+    # cost-refinement seam (karpenter_tpu/cost, docs/cost.md)
+    cost_calls: int = 0  # cost() entries
+    cost_errors: int = 0  # cost() failures (the caller goes cost-blind)
+    cost_dispatches: int = 0  # cost device dispatches
     consolidate_calls: int = 0
     consolidate_candidates: int = 0
     # forecast seam (forecast/, docs/forecasting.md)
@@ -1062,6 +1075,79 @@ class SolverService:
             deadline=(now + timeout) if timeout else None,
             enqueued_at=now,
         )
+
+    def cost(self, inputs, backend: Optional[str] = None):
+        """The multi-objective cost/SLO refinement through the service
+        (ops/cost.py, docs/cost.md): one CostInputs matrix for the whole
+        fleet in, one CostOutputs out, ONE device dispatch — synchronous
+        like decide() (the BatchAutoscaler already batches the fleet).
+        Shapes ride the decision kernel's pad_to bucket, so steady
+        fleets never recompile (the module-level jit IS the cache).
+
+        Degradation posture (deliberately different from forecast):
+        the refinement is ADVISORY — on any failure the right answer is
+        the UNREFINED base decision (the caller's never-block contract,
+        CostEngine.adjust), not a host re-score every tick through an
+        outage. So: the numpy mirror serves as the REQUESTED backend
+        (CPU auto-resolution, the gRPC process split — bit-identical,
+        tests/test_cost.py), device failures count toward the shared
+        backend-health FSM and PROPAGATE (the tick goes cost-blind),
+        and a DEGRADED FSM short-circuits with CostUnavailable instead
+        of attempting the sick device — probes ride the normal recovery
+        path. `cost.score` is the fault-injection point
+        (faults/registry.py, docs/resilience.md)."""
+        from karpenter_tpu.ops import cost as CK
+
+        self.stats.cost_calls += 1
+        resolved = self._resolve_backend(backend)
+        if self.device_solver is not None:
+            # the sidecar wire carries bin-packs only: under the gRPC
+            # process split cost refinement serves from the numpy mirror
+            resolved = "numpy"
+        elif resolved == "pallas":
+            resolved = "xla"  # no Mosaic cost kernel; XLA runs on TPU
+        t0 = _time.perf_counter()
+        try:
+            if resolved == "numpy":
+                # the REQUESTED backend, not a degradation: the
+                # bit-identical mirror, no fallback counting
+                with default_tracer().span("solver.cost", backend="numpy"):
+                    return CK.cost_numpy(inputs)
+            if not self._device_allowed():
+                raise CostUnavailable(
+                    "solver backend degraded; scaling cost-blind until "
+                    "a probe recovers the device path"
+                )
+            import jax
+
+            try:
+                with default_tracer().span("solver.cost", backend=resolved):
+                    with solver_trace("solver.cost"):
+                        # the cost-path fault-injection point: an error
+                        # plan exercises the cost-blind degradation +
+                        # FSM trip (docs/resilience.md)
+                        inject("cost.score")
+                        out = CK.cost_jit(inputs)
+                        jax.block_until_ready(out)
+            except Exception:
+                self._record_device_failure()
+                raise
+            self._record_device_success()
+            self.stats.cost_dispatches += 1
+            self._count_dispatch()
+            return CK.CostOutputs(
+                desired=np.asarray(out.desired),
+                expected_hourly=np.asarray(out.expected_hourly),
+                violation_risk=np.asarray(out.violation_risk),
+                headroom=np.asarray(out.headroom),
+                cost_limited=np.asarray(out.cost_limited),
+                slo_raised=np.asarray(out.slo_raised),
+            )
+        except Exception:
+            self.stats.cost_errors += 1
+            raise
+        finally:
+            self._record_stage("cost", _time.perf_counter() - t0)
 
     def decide(self, inputs):
         """The HPA decision kernel through the service: same metrics

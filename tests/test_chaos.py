@@ -646,3 +646,80 @@ class TestActuationCircuit:
             ).get("g", "default") == 0.0
         finally:
             runtime.close()
+
+
+class TestCostChaos:
+    """Satellite pin (docs/cost.md degradation contract): cost-kernel
+    faults make the tick COST-BLIND — the base reactive decision stands,
+    the reconcile loop never blocks — and the repeated device failures
+    feed the SAME backend-health FSM everything else rides; once faults
+    clear, probes recover the device path and the multi-objective
+    refinement resumes."""
+
+    REACTIVE = 11  # queue 41 / AverageValue target 4 -> ceil
+    COST_AWARE = 14  # 41 demand / 3-per-replica sloTarget -> ceil
+
+    def test_cost_faults_degrade_to_cost_blind_then_recover(self):
+        from karpenter_tpu.api.horizontalautoscaler import SLOSpec
+
+        clock = FakeClock()
+        provider = RecordingFactory()
+        provider.node_replicas["g"] = 5
+        runtime = KarpenterRuntime(
+            Options(solver_health_threshold=2,
+                    solver_probe_interval_s=0.0),
+            cloud_provider_factory=provider,
+            clock=clock,
+        )
+        runtime.solver_service.backend = "xla"
+        runtime.registry.register("queue", "length").set(
+            "q", "default", 41.0
+        )
+        runtime.store.create(sng_of("g", replicas=5))
+        ha = queue_ha("g", 'karpenter_queue_length{name="q"}')
+        # an sloTarget below the HPA target prices risk into extra
+        # replicas, so the cost-aware and cost-blind fixed points are
+        # DISTINGUISHABLE (14 vs 11) and the degradation is observable
+        ha.spec.behavior.slo = SLOSpec(
+            target_value=3.0, violation_cost_weight=100.0
+        )
+        runtime.store.create(ha)
+        service = runtime.solver_service
+        try:
+            registry = faults.install(FaultRegistry(seed=CHAOS_SEED))
+            registry.plan("cost.score", probability=1.0)
+            for _ in range(30):
+                clock.advance(61.0)
+                runtime.manager.reconcile_all()
+            assert registry.injected.get("cost.score", 0) >= 1, (
+                "the scenario must actually have exercised cost faults"
+            )
+            # every tick went COST-BLIND (the unrefined reactive
+            # decision, NOT a mirror-served refinement) and the loop
+            # never stalled
+            assert service.stats.cost_errors >= 1
+            assert service.queue_depth() == 0
+            assert provider.node_replicas["g"] == self.REACTIVE
+            got = runtime.store.get(
+                "HorizontalAutoscaler", "default", "ha"
+            )
+            assert got.status.desired_replicas == self.REACTIVE
+            assert runtime.registry.gauge("cost", "blind_total").get(
+                "ha", "default"
+            ) >= 1.0
+            # the repeated device faults tripped the shared FSM — the
+            # cost path feeds the SAME health ladder bin-packs do
+            assert service.stats.fsm_trips >= 1
+
+            faults.uninstall()  # ---- faults clear ----
+            for _ in range(5):
+                clock.advance(61.0)
+                runtime.manager.reconcile_all()
+            # probes re-arm the device path; the refinement resumes and
+            # the fleet moves to the cost-aware fixed point
+            assert service.backend_health() == "healthy"
+            assert service.stats.cost_dispatches >= 1
+            assert provider.node_replicas["g"] == self.COST_AWARE
+        finally:
+            faults.uninstall()
+            runtime.close()

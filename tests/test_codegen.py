@@ -84,7 +84,7 @@ class TestCrdGeneration:
             "behavior",
         }
         behavior = spec["behavior"]["properties"]
-        assert set(behavior) == {"scaleUp", "scaleDown", "forecast"}
+        assert set(behavior) == {"scaleUp", "scaleDown", "forecast", "slo"}
         window = behavior["scaleUp"]["properties"][
             "stabilizationWindowSeconds"
         ]
@@ -92,6 +92,18 @@ class TestCrdGeneration:
         forecast = behavior["forecast"]["properties"]
         assert forecast["horizonSeconds"] == {"type": "number"}
         assert forecast["minSamples"] == {"type": "integer"}
+        slo = behavior["slo"]["properties"]
+        assert slo["violationCostWeight"] == {"type": "number"}
+        assert slo["maxHourlyCost"] == {"type": "number"}
+
+    def test_schema_covers_warm_pool(self):
+        crd = crd_manifest("ScalableNodeGroup")
+        spec = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"][
+            "properties"
+        ]["spec"]["properties"]
+        warm = spec["warmPool"]["properties"]
+        assert warm["minWarm"] == {"type": "integer"}
+        assert warm["maxWarm"] == {"type": "integer"}
 
     def test_metric_target_values_are_numbers(self):
         # design departure from the reference: target values are plain

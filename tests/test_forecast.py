@@ -923,3 +923,43 @@ class TestSpecValidation:
         back = from_manifest(doc)
         assert back.spec.behavior.forecast.model == "holt-winters"
         assert back.spec.behavior.forecast.season_seconds == 3600.0
+
+
+class TestDistributionSurface:
+    """The (point, sigma2) distribution face the cost subsystem reads
+    as its risk input (docs/cost.md): fresh after a predict pass, None
+    before one, and DROPPED once a series goes two horizons without a
+    refresh — a broken metric must not pin an obsolete forecast spike
+    as phantom demand forever."""
+
+    def _world(self):
+        spec = ForecastSpec(
+            horizon_seconds=60.0, model="linear", min_samples=3
+        )
+        store, registry, gauge = fleet_world(1, spec)
+        clock = FakeClock()
+        forecaster = FleetForecaster(clock=clock, capacity=16)
+        autoscaler = BatchAutoscaler(
+            MetricsClientFactory(registry=registry),
+            store,
+            clock=clock,
+            forecaster=forecaster,
+        )
+        return store, clock, forecaster, autoscaler
+
+    def test_distribution_fresh_then_expires(self):
+        store, clock, forecaster, autoscaler = self._world()
+        ha = store.get("HorizontalAutoscaler", "default", "ha-0")
+        assert forecaster.distribution("default", "ha-0", 0) is None
+        for _ in range(5):
+            autoscaler.reconcile_batch([ha])
+            clock.advance(10.0)
+        dist = forecaster.distribution("default", "ha-0", 0)
+        assert dist is not None
+        point, sigma2 = dist
+        assert np.isfinite(point) and sigma2 >= 0.0
+        # no refresh for two horizons (series stops forecasting):
+        # the stale entry is dropped, not served
+        clock.advance(2 * 60.0 + 1.0)
+        assert forecaster.distribution("default", "ha-0", 0) is None
+        assert ("default", "ha-0", 0) not in forecaster._dist

@@ -201,3 +201,42 @@ class TestRuntimeSelfMetrics:
         gauge = registry.gauge("runtime", "encode_cache_total")
         assert gauge.get("miss", "-") == 1.0
         assert gauge.get("hit", "-") == 1.0
+
+
+class TestHistogramPercentile:
+    """HistogramVec.percentile — the estimator behind the simulator
+    report's and bench-journal's provisioning-lead p50/p99 columns —
+    must apply Prometheus's histogram_quantile() semantics: linear
+    interpolation within the bucket holding the rank, clamp-to-bound
+    beyond the last finite bucket, None for an empty series."""
+
+    def _hist(self):
+        from karpenter_tpu.metrics.registry import GaugeRegistry
+
+        registry = GaugeRegistry()
+        return registry.register(
+            "lead", "seconds", kind="histogram",
+            buckets=(0.1, 1.0, 10.0),
+        )
+
+    def test_empty_series_is_none(self):
+        hist = self._hist()
+        assert hist.percentile("g", "default", 50) is None
+        assert hist.percentile("missing", "default", 99) is None
+
+    def test_linear_within_bucket_matches_prometheus(self):
+        hist = self._hist()
+        for _ in range(4):
+            hist.observe("g", "default", 0.05)  # bucket (0, 0.1]
+        for _ in range(4):
+            hist.observe("g", "default", 5.0)  # bucket (1.0, 10.0]
+        # rank 4 of 8 lands exactly at the first bucket's upper bound
+        assert hist.percentile("g", "default", 50) == pytest.approx(0.1)
+        # rank 6 sits halfway through the (1.0, 10.0] bucket's 4 samples
+        assert hist.percentile("g", "default", 75) == pytest.approx(5.5)
+
+    def test_overflow_clamps_to_last_finite_bound(self):
+        hist = self._hist()
+        for _ in range(10):
+            hist.observe("g", "default", 100.0)  # all +Inf bucket
+        assert hist.percentile("g", "default", 99) == pytest.approx(10.0)

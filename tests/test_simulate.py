@@ -415,3 +415,68 @@ class TestSimulateRestartStorm:
             return report
 
         assert run("a") == run("b")
+
+
+class TestSimulateCost:
+    """Satellite pin (docs/cost.md "Dry-running"): the --simulate --cost
+    warm-pool replay must show a MEASURED provisioning lead-time
+    reduction at equal-or-lower SLO-violation count — the acceptance
+    headline — and the deterministic halves of the report must replay
+    identically (the e2e histogram carries real wall time and is pinned
+    by shape only)."""
+
+    CONFIG = dict(
+        ticks=60, ramp_start=15, ramp_ticks=10, spot_step_tick=40,
+        provision_lag=4, min_samples=3, seed=7,
+    )
+
+    def _deterministic_view(self, report):
+        view = {
+            k: report[k]
+            for k in ("config", "hourly_cost", "slo_violations",
+                      "provisioning_lead")
+        }
+        view["provisioned"] = {
+            run: report["runs"][run]["provisioned"]
+            for run in ("warm_on", "warm_off")
+        }
+        return view
+
+    def test_warm_pool_cuts_provisioning_lead_within_slo(self):
+        from karpenter_tpu.simulate import simulate_cost
+
+        report = simulate_cost(**self.CONFIG)
+        lead = report["provisioning_lead"]
+        assert lead["reduction_ticks"] > 0, (
+            "warm pool must reduce the mean capacity-coverage lag"
+        )
+        assert lead["warm_on_mean_lag_ticks"] < lead[
+            "warm_off_mean_lag_ticks"
+        ]
+        viol = report["slo_violations"]
+        assert viol["warm_on"] <= viol["warm_off"]
+        assert (
+            viol["warm_on_shortfall_replica_ticks"]
+            <= viol["warm_off_shortfall_replica_ticks"]
+        )
+        # warm capacity costs real money — the report must price it,
+        # not hide it
+        assert report["hourly_cost"]["warm_on_mean"] > 0
+        # both worlds refined through the batched cost seam and filled
+        # the PR 9 e2e histogram (the lead-time observable)
+        for run in ("warm_on", "warm_off"):
+            world = report["runs"][run]
+            assert world["cost_dispatches"] >= 1
+            assert world["e2e_seconds"]["n"] >= 1
+            assert world["e2e_seconds"]["p50_s"] is not None
+            assert (
+                world["e2e_seconds"]["p99_s"]
+                >= world["e2e_seconds"]["p50_s"]
+            )
+
+    def test_cost_replay_is_deterministic(self):
+        from karpenter_tpu.simulate import simulate_cost
+
+        a = simulate_cost(**self.CONFIG)
+        b = simulate_cost(**self.CONFIG)
+        assert self._deterministic_view(a) == self._deterministic_view(b)
