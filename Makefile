@@ -99,6 +99,11 @@ bench-shard: ## Sharded fleet-scale solve (1M pods x 1k types through the Solver
 		--backend xla --iters 3 --shard-scaling 1,2,4,8 \
 		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
 
+bench-multitenant: ## Aggregate decisions/sec at 1k simulated tenants: cross-tenant concatenated decide+cost vs a sequential per-tenant loop (concat == independent parity pinned on device + numpy paths); appends a BENCHMARKS row + publishes to BASELINE.json
+	$(PYTHON) bench.py --multitenant --tenants 1000 --tenant-rows 4 \
+		--backend xla --iters 10 \
+		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
+
 dryrun: ## Multi-chip sharding compile check on 8 virtual CPU devices
 	$(PYTHON) -c "import os; \
 		os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS','') + ' --xla_force_host_platform_device_count=8').strip(); \
@@ -136,6 +141,7 @@ kind-smoke: ## Deploy smoke on kind: image -> apply -> pod Ready -> one HA end t
 	bash hack/kind-smoke.sh
 
 .PHONY: help dev ci test test-chaos test-recovery battletest verify codegen \
-	docs native bench bench-solver bench-consolidate bench-forecast \
-	bench-preempt bench-cost bench-journal bench-trace bench-shard dryrun \
+	docs native bench bench-solver bench-hotpath bench-consolidate \
+	bench-forecast bench-preempt bench-cost bench-journal bench-trace \
+	bench-shard bench-multitenant dryrun \
 	image publish apply delete kind-load conformance kind-smoke

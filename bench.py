@@ -529,6 +529,35 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
         help="with --cost: metrics per autoscaler row",
     )
     ap.add_argument(
+        "--multitenant",
+        action="store_true",
+        help="benchmark the multi-tenant control plane "
+        "(docs/multitenancy.md): --tenants simulated tenant clusters' "
+        "decide+cost matrices through ONE MultiTenantScheduler "
+        "(cross-tenant concatenated dispatches) vs a sequential "
+        "per-tenant loop through the same SolverService seam; "
+        "cross-tenant == independent parity is pinned on the device "
+        "AND numpy paths before timing",
+    )
+    ap.add_argument(
+        "--tenants",
+        type=int,
+        default=1000,
+        help="with --multitenant: simulated tenant cluster count",
+    )
+    ap.add_argument(
+        "--tenant-rows",
+        type=int,
+        default=4,
+        help="with --multitenant: autoscaler rows per tenant cluster",
+    )
+    ap.add_argument(
+        "--tenant-metrics",
+        type=int,
+        default=2,
+        help="with --multitenant: metrics per autoscaler row",
+    )
+    ap.add_argument(
         "--shard",
         action="store_true",
         help="benchmark the SHARDED dispatch strategy (docs/solver-"
@@ -700,6 +729,22 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
         ap.error("--cost-rows must be >= 2")
     if args.cost_metrics < 1:
         ap.error("--cost-metrics must be >= 1")
+    if args.multitenant and (
+        args.mesh or args.e2e or args.decide or args.clusters
+        or args.solver_service or args.hotpath or args.consolidate
+        or args.forecast or args.preempt or args.journal or args.trace
+        or args.cost or args.shard
+    ):
+        ap.error(
+            "--multitenant builds its own workload (N tenant fleets); "
+            "it cannot combine with other modes"
+        )
+    if args.multitenant and args.tenants < 2:
+        ap.error("--tenants must be >= 2")
+    if args.multitenant and (
+        args.tenant_rows < 1 or args.tenant_metrics < 1
+    ):
+        ap.error("--tenant-rows and --tenant-metrics must be >= 1")
     if args.shard and (
         args.mesh or args.e2e or args.decide or args.clusters
         or args.solver_service or args.hotpath or args.consolidate
@@ -721,13 +766,13 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
     if (args.publish_baseline or args.append_benchmarks) and not (
         args.solver_service or args.consolidate or args.hotpath
         or args.forecast or args.preempt or args.journal or args.shard
-        or args.trace or args.cost
+        or args.trace or args.cost or args.multitenant
     ):
         ap.error(
             "--publish-baseline/--append-benchmarks only apply to "
             "--solver-service/--consolidate/--hotpath/--forecast/"
-            "--preempt/--journal/--shard/--trace/--cost (nothing would "
-            "be published otherwise)"
+            "--preempt/--journal/--shard/--trace/--cost/--multitenant "
+            "(nothing would be published otherwise)"
         )
 
     if args.shard:
@@ -749,6 +794,13 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
             f"reconcile tick p50 with reconcile tracing, "
             f"{args.trace_ticks} ticks (tracer ENABLED vs DISABLED + "
             f"raw span throughput)"
+        )
+    elif args.multitenant:
+        metric = (
+            f"multi-tenant aggregate decisions/sec, {args.tenants} "
+            f"tenant clusters x {args.tenant_rows} autoscalers "
+            f"(cross-tenant concatenated decide+cost vs sequential "
+            f"per-tenant loop; concat == independent parity pinned)"
         )
     elif args.cost:
         metric = (
@@ -1301,6 +1353,9 @@ def run(args, metric: str, note: str) -> None:  # lint: allow-complexity — ben
         return
     if args.trace:
         run_trace(args, metric, note)
+        return
+    if args.multitenant:
+        run_multitenant(args, metric, note)
         return
     if args.cost:
         run_cost(args, metric, note)
@@ -3253,6 +3308,233 @@ def run_e2e(args, metric: str, note: str = "") -> None:  # lint: allow-complexit
         p50,
         note=f"{note}; {extra}" if note else extra,
         against_baseline=not args.host_only,
+    )
+
+
+def build_multitenant_batch(args, seed: int):
+    """N tenant clusters' decide matrices for one lockstep tick — the
+    same seeded world `--simulate --multitenant` steps
+    (simulate.multitenant_fleet_inputs), so the bench times exactly the
+    matrices the simulator replays."""
+    from karpenter_tpu.simulate import (
+        multitenant_cost_inputs,
+        multitenant_fleet_inputs,
+    )
+
+    decide_batch = {}
+    cost_batch = {}
+    for i in range(args.tenants):
+        tid = f"t{i:04d}"
+        inputs = multitenant_fleet_inputs(
+            i, args.tenant_rows, args.tenant_metrics, seed,
+            tick=3, spec_replicas=np.full(args.tenant_rows, 2, np.int32),
+            now=1_000_000.0,
+        )
+        decide_batch[tid] = inputs
+        cost_batch[tid] = multitenant_cost_inputs(
+            inputs, np.full(args.tenant_rows, 5, np.int32)
+        )
+    return decide_batch, cost_batch
+
+
+def _multitenant_record(args, backend, batched, sequential) -> dict:
+    batched_p50 = float(np.percentile(batched, 50))
+    sequential_p50 = float(np.percentile(sequential, 50))
+    decisions = args.tenants * args.tenant_rows
+    return {
+        "config": f"{args.tenants} tenants x {args.tenant_rows} "
+                  "autoscalers multitenant",
+        "backend": backend,
+        "tenants": args.tenants,
+        "rows_per_tenant": args.tenant_rows,
+        "metrics_per_row": args.tenant_metrics,
+        "batched_p50_ms": round(batched_p50, 3),
+        "sequential_p50_ms": round(sequential_p50, 3),
+        "batched_dps": round(decisions * 1000.0 / batched_p50, 1),
+        "sequential_dps": round(decisions * 1000.0 / sequential_p50, 1),
+        "speedup": round(sequential_p50 / batched_p50, 2),
+    }
+
+
+def _append_multitenant_row(path: str, record: dict) -> None:
+    marker = "## Multi-tenant control plane (make bench-multitenant)"
+    header = (
+        f"\n{marker}\n\n"
+        "One lockstep tick over N simulated tenant clusters: every "
+        "tenant's decide + cost matrices concatenated into shared "
+        "dispatches by the MultiTenantScheduler "
+        "(docs/multitenancy.md) vs the same matrices dispatched one "
+        "tenant at a time through the same SolverService seam. "
+        "Cross-tenant slices == independent dispatches (device and "
+        "numpy paths) is asserted before timing. Decisions/sec counts "
+        "autoscaler rows decided+refined per wall second.\n\n"
+        "| Date | Backend | Config | Batched tick p50 (ms) | "
+        "Sequential tick p50 (ms) | Batched decisions/s | Sequential "
+        "decisions/s | Speedup |\n"
+        "|---|---|---|---|---|---|---|---|\n"
+    )
+    date = datetime.date.today().isoformat()
+    row = (
+        f"| {date} | {record['backend']} | {record['config']} "
+        f"| {record['batched_p50_ms']} | {record['sequential_p50_ms']} "
+        f"| {record['batched_dps']} | {record['sequential_dps']} "
+        f"| {record['speedup']}x |\n"
+    )
+    _append_table_row(path, marker, header, row)
+
+
+def _pin_multitenant_parity(scheduler, service, decide_batch, cost_batch,  # lint: allow-complexity — parity gate: one loop per family x path
+                            backend: str) -> None:
+    """The acceptance gate (docs/multitenancy.md): a subsample of
+    tenants' concatenated-slice outputs must be bit-identical to their
+    own independent dispatches — decide + cost on the requested device
+    backend AND cost on the numpy mirror path."""
+    import dataclasses
+
+    from karpenter_tpu.ops.cost import CostOutputs, cost_numpy
+    from karpenter_tpu.ops.decision import DecisionOutputs
+    from karpenter_tpu.tenancy import concat_cost_inputs, slice_cost_outputs
+
+    sample = sorted(decide_batch)[:: max(1, len(decide_batch) // 16)]
+    decided = scheduler.decide_all(decide_batch)
+    costed = scheduler.cost_all(cost_batch, backend=backend)
+    for tid in sample:
+        indep_d = service.decide(decide_batch[tid])
+        for f in dataclasses.fields(DecisionOutputs):
+            a = np.asarray(getattr(decided[tid], f.name))
+            b = np.asarray(getattr(indep_d, f.name))
+            if not np.array_equal(a, b):
+                raise AssertionError(
+                    f"decide concat parity violated for {tid}.{f.name}"
+                )
+        indep_c = service.cost(cost_batch[tid], backend=backend)
+        for f in dataclasses.fields(CostOutputs):
+            a = np.asarray(getattr(costed[tid], f.name))
+            b = np.asarray(getattr(indep_c, f.name))
+            if not np.array_equal(a, b):
+                raise AssertionError(
+                    f"cost concat parity violated for {tid}.{f.name} "
+                    f"({backend})"
+                )
+    # numpy-mirror path: the concatenated host program's slices must
+    # equal per-tenant host calls bit for bit too
+    sample_batch = {tid: cost_batch[tid] for tid in sample}
+    order = sorted(sample_batch)
+    stacked = concat_cost_inputs([sample_batch[t] for t in order])
+    host = cost_numpy(stacked)
+    offset = 0
+    for tid in order:
+        n = int(np.asarray(sample_batch[tid].base_desired).shape[0])
+        mine = slice_cost_outputs(host, offset, offset + n)
+        offset += n
+        indep = cost_numpy(sample_batch[tid])
+        for f in dataclasses.fields(CostOutputs):
+            a = np.asarray(getattr(mine, f.name))
+            b = np.asarray(getattr(indep, f.name))
+            if not np.array_equal(a, b):
+                raise AssertionError(
+                    f"cost concat parity violated for {tid}.{f.name} "
+                    f"(numpy)"
+                )
+
+
+def run_multitenant(args, metric: str, note: str) -> None:
+    """Aggregate decisions/sec at N tenants: the multi-tenant control
+    plane's one-dispatch claim (docs/multitenancy.md). Both paths run
+    the IDENTICAL kernels on identical per-tenant matrices through the
+    same SolverService seam; only the dispatch shape differs — shared
+    cross-tenant concatenated programs vs one decide + one cost
+    dispatch per tenant. Parity is pinned before timing."""
+    import jax
+
+    from karpenter_tpu.metrics.registry import GaugeRegistry
+    from karpenter_tpu.solver import SolverService
+    from karpenter_tpu.tenancy import (
+        MultiTenantScheduler,
+        TenantRegistry,
+        TenantSpec,
+    )
+
+    print(
+        f"backend={jax.default_backend()} devices={jax.devices()}",
+        file=sys.stderr,
+    )
+    decide_batch, cost_batch = build_multitenant_batch(args, args.seed)
+    service = SolverService(
+        backend=args.backend, registry=GaugeRegistry()
+    )
+    registry = TenantRegistry(
+        service=service, registry=GaugeRegistry(),
+        specs=[
+            TenantSpec(id=tid, weight=1.0 + (i % 3))
+            for i, tid in enumerate(sorted(decide_batch))
+        ],
+    )
+    scheduler = MultiTenantScheduler(
+        registry, service,
+        max_rows_per_round=args.tenants * args.tenant_rows,
+    )
+    try:
+        # parity pin FIRST (also warms every compiled shape both paths
+        # will time)
+        _pin_multitenant_parity(
+            scheduler, service, decide_batch, cost_batch, args.backend
+        )
+        print(
+            "parity pinned: cross-tenant slices == independent "
+            "dispatches (device + numpy)",
+            file=sys.stderr,
+        )
+
+        batched_times, sequential_times = [], []
+        for _ in range(args.iters):
+            t0 = time.perf_counter()
+            scheduler.decide_all(decide_batch)
+            scheduler.cost_all(cost_batch, backend=args.backend)
+            batched_times.append((time.perf_counter() - t0) * 1e3)
+        for _ in range(args.iters):
+            t0 = time.perf_counter()
+            for tid in decide_batch:
+                service.decide(decide_batch[tid])
+                service.cost(cost_batch[tid], backend=args.backend)
+            sequential_times.append((time.perf_counter() - t0) * 1e3)
+    finally:
+        service.close()
+
+    record = _multitenant_record(
+        args, jax.default_backend(), batched_times, sequential_times
+    )
+    record_evidence(
+        batched_iter_ms=[round(t, 4) for t in batched_times],
+        sequential_iter_ms=[round(t, 4) for t in sequential_times],
+        multitenant=record,
+        transport_floor=measure_transport_floor(),
+    )
+    print(
+        f"batched tick p50={record['batched_p50_ms']}ms "
+        f"({record['batched_dps']} decisions/s) | sequential "
+        f"p50={record['sequential_p50_ms']}ms "
+        f"({record['sequential_dps']} decisions/s) | "
+        f"speedup={record['speedup']}x",
+        file=sys.stderr,
+    )
+    if args.publish_baseline:
+        _publish_to_baseline(
+            f"{record['config']} ({record['backend']})", record
+        )
+    if args.append_benchmarks:
+        _append_multitenant_row(args.append_benchmarks, record)
+    extra = (
+        f"{record['batched_dps']} vs {record['sequential_dps']} "
+        f"decisions/sec batched vs sequential "
+        f"({record['speedup']}x); concat==independent parity pinned "
+        f"(device + numpy)"
+    )
+    emit(
+        f"{metric} ({jax.default_backend()})",
+        record["batched_p50_ms"],
+        note=f"{note}; {extra}" if note else extra,
+        against_baseline=False,
     )
 
 

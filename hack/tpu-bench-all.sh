@@ -26,6 +26,7 @@ for args in \
     "--decide 100000" \
     "--clusters 10 --types 30 --pods 100000" \
     "--pods 1000000 --iters 5" \
+    "--multitenant --tenants 1000 --tenant-rows 4 --iters 10" \
     ; do
   echo "=== bench.py $args ===" >&2
   # shellcheck disable=SC2086

@@ -280,6 +280,47 @@ def parse_args(argv=None) -> argparse.Namespace:
         "in the cost model (docs/cost.md)",
     )
     parser.add_argument(
+        "--pricing-file",
+        default=None,
+        metavar="FILE",
+        help="JSON/YAML instance-type pricing catalog, reloaded on "
+        "mtime change and consulted before the built-in catalog "
+        "(docs/cost.md 'Pricing feeds'); omit for the built-in "
+        "illustrative catalog",
+    )
+    parser.add_argument(
+        "--tenant-config",
+        default=None,
+        metavar="FILE",
+        help="JSON/YAML list of tenant specs ({id, weight, "
+        "pricingFile, ...}) enabling the multi-tenant control plane "
+        "(docs/multitenancy.md): per-tenant namespaced stacks over one "
+        "shared solver service; omit for the single-tenant wiring "
+        "(byte-identical to previous releases)",
+    )
+    parser.add_argument(
+        "--multitenant",
+        action="store_true",
+        help="with --simulate: step N seeded tenant clusters in "
+        "lockstep through one MultiTenantScheduler (cross-tenant "
+        "concatenated decide/cost dispatches) and report aggregate "
+        "decisions, dispatch counts, and per-tick digests "
+        "(docs/multitenancy.md)",
+    )
+    parser.add_argument(
+        "--tenants",
+        type=int,
+        default=16,
+        help="with --simulate --multitenant: simulated tenant count",
+    )
+    parser.add_argument(
+        "--tenant-id",
+        default=None,
+        help="this control plane's tenant id, stamped as gRPC metadata "
+        "on every --solver-uri RPC so a shared solver sidecar can "
+        "attribute traffic per tenant (docs/multitenancy.md)",
+    )
+    parser.add_argument(
         "--forecast",
         action="store_true",
         help="with --simulate: replay a synthetic diurnal ramp through "
@@ -342,6 +383,7 @@ def _run_simulation(args, store) -> int:  # lint: allow-complexity — simulatio
     if args.trace_export and not (
         args.forecast or args.restart_storm or args.preempt
         or args.consolidate or args.what_if or args.cost
+        or args.multitenant
     ):
         # the traced end-to-end replay (docs/observability.md): a seeded
         # consolidating world driven tick by tick, exporting a trace in
@@ -354,6 +396,19 @@ def _run_simulation(args, store) -> int:  # lint: allow-complexity — simulatio
         # count): clear the flag so main's exit-time _export_trace
         # doesn't rewrite the identical file
         args.trace_export = None
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return 0
+
+    if args.multitenant:
+        # self-contained replay (no store, no provider): N seeded
+        # tenant clusters stepped in lockstep through one
+        # MultiTenantScheduler (docs/multitenancy.md)
+        from karpenter_tpu.simulate import simulate_multitenant
+
+        report = simulate_multitenant(
+            tenants=args.tenants,
+            tenant_config=args.tenant_config,
+        )
         print(json.dumps(report, indent=2, sort_keys=True))
         return 0
 
@@ -409,14 +464,9 @@ def _run_simulation(args, store) -> int:  # lint: allow-complexity — simulatio
 
     what_if = None
     if args.what_if:
-        with open(args.what_if) as f:
-            text = f.read()
-        try:
-            what_if = json.loads(text)
-        except ValueError:
-            import yaml
+        from karpenter_tpu.utils.configfile import load_json_or_yaml
 
-            what_if = yaml.safe_load(text)
+        what_if = load_json_or_yaml(args.what_if)
         if not isinstance(what_if, list):
             print(
                 f"--what-if {args.what_if}: expected a LIST of group specs",
@@ -435,6 +485,7 @@ def _run_simulation(args, store) -> int:  # lint: allow-complexity — simulatio
             verbose=args.verbose,
             cost_default_hourly=args.cost_default_hourly,
             cost_spot_multiplier=args.cost_spot_multiplier,
+            pricing_file=args.pricing_file,
         ),
         store=store,
     )
@@ -637,6 +688,9 @@ def main(argv=None) -> int:
             stale_metric_max_age_s=args.stale_metric_max_age,
             cost_default_hourly=args.cost_default_hourly,
             cost_spot_multiplier=args.cost_spot_multiplier,
+            pricing_file=args.pricing_file,
+            tenant_config=args.tenant_config,
+            tenant_id=args.tenant_id,
         ),
         store=store,
     )

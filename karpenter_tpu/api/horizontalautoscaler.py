@@ -237,6 +237,30 @@ class ForecastSpec:
 
 
 @dataclass(slots=True)
+class SLOMetricTarget:
+    """Per-metric SLO target: one entry per index of spec.metrics.
+
+    The cost kernel already evaluates violation risk per metric and
+    takes the WORST CASE across them (ops/cost.py `max` over the metric
+    axis); this spec lets each metric declare its own per-replica
+    capacity instead of sharing the single spec-wide targetValue — a
+    queue-depth metric and a p99-latency proxy rarely mean the same
+    thing by "one replica's worth"."""
+
+    # per-replica capacity for the metric at the SAME INDEX in
+    # spec.metrics; None falls back to the spec-wide targetValue, then
+    # to the metric's own HPA target value
+    target_value: Optional[float] = None
+
+    def validate(self) -> None:
+        if self.target_value is not None and self.target_value <= 0:
+            raise ValueError(
+                f"slo metrics targetValue must be > 0, got "
+                f"{self.target_value}"
+            )
+
+
+@dataclass(slots=True)
 class SLOSpec:
     """Cost- and SLO-aware scaling behavior (docs/cost.md): opt a
     HorizontalAutoscaler into the fleet's multi-objective refinement —
@@ -261,6 +285,21 @@ class SLOSpec:
     # hard budget: candidates above floor(maxHourlyCost / unitCost)
     # replicas are trimmed (never below minReplicas); 0 = uncapped
     max_hourly_cost: float = 0.0
+    # OPTIONAL per-metric targets, positional against spec.metrics:
+    # entry j overrides targetValue for metric j (worst-case risk
+    # across metrics still feeds the kernel). Shorter lists leave the
+    # remaining metrics on the spec-wide fallback chain.
+    metrics: Optional[List[SLOMetricTarget]] = None
+
+    def target_for(self, metric_index: int) -> Optional[float]:
+        """The per-replica SLO capacity for one metric: its per-metric
+        entry when declared, else the spec-wide targetValue, else None
+        (the engine then falls back to the metric's own HPA target)."""
+        if self.metrics is not None and metric_index < len(self.metrics):
+            per_metric = self.metrics[metric_index].target_value
+            if per_metric is not None:
+                return per_metric
+        return self.target_value
 
     def validate(self) -> None:
         if self.target_value is not None and self.target_value <= 0:
@@ -277,6 +316,8 @@ class SLOSpec:
                 f"slo maxHourlyCost must be >= 0, got "
                 f"{self.max_hourly_cost}"
             )
+        for entry in self.metrics or []:
+            entry.validate()
 
 
 @dataclass(slots=True)

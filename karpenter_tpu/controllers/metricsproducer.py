@@ -13,6 +13,21 @@ from typing import Dict, List, Optional
 from karpenter_tpu.api.metricsproducer import MetricsProducer
 from karpenter_tpu.metrics.producers.pendingcapacity import solve_pending
 
+# every per-producer gauge SUBSYSTEM: the deletion hook below retires a
+# deleted producer's {name, namespace} series from every vec registered
+# under these — without this, a deleted queue's karpenter_queue_length
+# (and the whole resources x metric-types reserved_capacity family)
+# froze at its last value forever (the same frozen-series bug PR 10
+# fixed for karpenter_cost_*). Subsystem-wide removal
+# (GaugeRegistry.remove_series) so families added later are covered
+# without re-enumerating metric names here.
+_PRODUCER_SUBSYSTEMS = (
+    "queue",
+    "reserved_capacity",
+    "scheduled_capacity",
+    "pending_capacity",
+)
+
 
 class MetricsProducerController:
     def __init__(self, producer_factory):
@@ -23,6 +38,15 @@ class MetricsProducerController:
 
     def interval(self) -> float:
         return 5.0
+
+    def on_deleted(self, mp) -> None:
+        """Retire a deleted producer's gauge series (module constant):
+        series are keyed {name, namespace} per producer, so a deleted
+        object's last values must leave /metrics with it."""
+        for subsystem in _PRODUCER_SUBSYSTEMS:
+            self.factory.registry.remove_series(
+                subsystem, mp.metadata.name, mp.metadata.namespace
+            )
 
     def reconcile(self, mp) -> None:
         self.factory.for_producer(mp).reconcile()

@@ -12,6 +12,9 @@ Three pieces, composed by the runtime:
   * WarmPoolEngine (cost/warmpool.py) — forecast-risk-sized
     pre-provisioned headroom for spec.warmPool groups, actuated through
     the ScalableNodeGroup controller's fenced door.
+  * PricingSource (cost/pricing.py) — pluggable pricing feeds: the
+    mtime-reloading --pricing-file feed (and per-tenant feeds via the
+    tenant registry) consulted before the built-in catalog.
 """
 
 from karpenter_tpu.cost.engine import CostEngine
@@ -22,14 +25,24 @@ from karpenter_tpu.cost.model import (
     INSTANCE_TYPE_LABEL,
     CostModel,
 )
+from karpenter_tpu.cost.pricing import (
+    FilePricingSource,
+    PricingSource,
+    StaticPricingSource,
+    pricing_source_for,
+)
 from karpenter_tpu.cost.warmpool import WarmPoolEngine
 
 __all__ = [
     "CostEngine",
     "CostModel",
     "DEFAULT_CATALOG",
+    "FilePricingSource",
     "HOURLY_COST_ANNOTATION",
     "INSTANCE_TYPE_ANNOTATION",
     "INSTANCE_TYPE_LABEL",
+    "PricingSource",
+    "StaticPricingSource",
     "WarmPoolEngine",
+    "pricing_source_for",
 ]

@@ -234,11 +234,13 @@ class CostEngine:
             max_hourly[i] = slo.max_hourly_cost
             slo_valid[i] = True
             for j, (_spec, target, observed) in enumerate(row.observed):
-                per_replica = (
-                    slo.target_value
-                    if slo.target_value
-                    else target.target_value()
-                )
+                # per-metric SLO targets (spec.behavior.slo.metrics)
+                # outrank the spec-wide targetValue; the kernel's max
+                # over the metric axis keeps risk WORST-CASE across
+                # however many of them the row declares
+                per_replica = slo.target_for(j)
+                if not per_replica:
+                    per_replica = target.target_value()
                 if not per_replica or per_replica <= 0:
                     continue  # no capacity notion: metric carries no risk
                 mu, sigma, ok = self._demand(row, j, observed)

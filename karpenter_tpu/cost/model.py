@@ -56,9 +56,9 @@ DEFAULT_CATALOG: Dict[str, float] = {
 
 @dataclass
 class CostModel:
-    """Pricing policy (module docstring). One per runtime; the simulate
-    replays mutate `spot_multiplier` mid-run to model a spot-price
-    step."""
+    """Pricing policy (module docstring). One per runtime (or per
+    tenant — tenancy/registry.py); the simulate replays mutate
+    `spot_multiplier` mid-run to model a spot-price step."""
 
     catalog: Dict[str, float] = field(
         default_factory=lambda: dict(DEFAULT_CATALOG)
@@ -69,13 +69,30 @@ class CostModel:
     # spot/preemptible tier price as a fraction of on-demand (the
     # historical ~65% discount); composes with capacity_tier_of
     spot_multiplier: float = 0.35
+    # pluggable feed (cost/pricing.py, --pricing-file): consulted
+    # BEFORE the built-in catalog, and its spotMultiplier (when the
+    # feed carries one) outranks the knob above. None = catalog only.
+    pricing: Optional[object] = None
 
     def on_demand(self, instance_type: Optional[str]) -> float:
         if instance_type:
+            if self.pricing is not None:
+                price = self.pricing.price(instance_type)
+                if price is not None:
+                    return float(price)
             price = self.catalog.get(instance_type)
             if price is not None:
                 return float(price)
         return float(self.default_hourly)
+
+    def effective_spot_multiplier(self) -> float:
+        """The spot tier in force: the pricing feed's override when it
+        carries one, else the configured knob."""
+        if self.pricing is not None:
+            override = self.pricing.spot_multiplier()
+            if override is not None:
+                return float(override)
+        return float(self.spot_multiplier)
 
     def node_cost(self, labels) -> float:
         """Hourly cost of one node from its label set (the group-profile
@@ -84,7 +101,7 @@ class CostModel:
         get = labels.get if isinstance(labels, dict) else dict(labels).get
         price = self.on_demand(get(INSTANCE_TYPE_LABEL))
         if capacity_tier_of(labels) > 0:
-            price *= float(self.spot_multiplier)
+            price *= self.effective_spot_multiplier()
         return price
 
     def group_costs(self, profiles) -> np.ndarray:
@@ -117,5 +134,5 @@ class CostModel:
         preemptible = bool(getattr(spec, "preemptible", False))
         labels = dict(getattr(meta, "labels", None) or {})
         if preemptible or capacity_tier_of(labels) > 0:
-            price *= float(self.spot_multiplier)
+            price *= self.effective_spot_multiplier()
         return price

@@ -116,6 +116,12 @@ class FleetForecaster:
         # (ns, name) -> (active, reason, message) for the FORECASTING
         # condition, refreshed each forecast_rows pass
         self._verdicts: Dict[tuple, Tuple[bool, str, str]] = {}
+        # (ns, name) keys currently holding karpenter_forecast_* gauge
+        # series — so a row that DROPS spec.behavior.forecast retires
+        # its series (the frozen-series discipline karpenter_cost_*
+        # established) instead of pinning the last pre-opt-out skill on
+        # dashboards forever
+        self._gauged: set = set()
         self._g_skill = self._g_value = None
         self._c_blend = self._c_disabled = None
         if registry is not None:
@@ -199,6 +205,12 @@ class FleetForecaster:
         _drop_keys(
             self._dist, lambda k: k[0] == namespace and k[1] == name
         )
+        self._retire_gauges(namespace, name)
+
+    def _retire_gauges(self, namespace: str, name: str) -> None:
+        """Drop one HA's karpenter_forecast_* series (deletion AND
+        forecast-spec opt-out both land here)."""
+        self._gauged.discard((namespace, name))
         if self._g_skill is not None:
             self._g_skill.remove(name, namespace)
             self._g_value.remove(name, namespace)
@@ -279,6 +291,13 @@ class FleetForecaster:
                     self._mature(key, _ha_key(ha), now, float(value))
                     self.history.append(key, now, float(value))
             if fspec is None or getattr(row, "custom", False):
+                # a row that STOPPED opting in retires its gauge series
+                # — skill and pending scores are kept (earned knowledge
+                # a re-opt-in resumes from), only the exported series
+                # must not freeze at its pre-opt-out value
+                key = _ha_key(ha)
+                if key in self._gauged:
+                    self._retire_gauges(*key)
                 continue
             self._seed_from_queries(ha)
             eligible.extend(self._eligible_row(i, row, fspec))
@@ -414,6 +433,7 @@ class FleetForecaster:
             )
             observed = rows[i].observed[j][2]
             if self._g_skill is not None:
+                self._gauged.add((ns, name))
                 self._g_skill.set(name, ns, self.skill(ns, name))
                 if j == 0:
                     self._g_value.set(name, ns, point)

@@ -327,6 +327,18 @@ class GaugeRegistry:
         with self._lock:
             return self._gauges[subsystem][name]
 
+    def remove_series(self, subsystem: str, name: str, namespace: str) -> None:
+        """Drop one {name, namespace} series from EVERY vec registered
+        under `subsystem` — the per-object retirement hook deletion
+        paths call so a deleted object's series cannot freeze on
+        /metrics. Covers vecs added to the subsystem later without the
+        caller having to enumerate metric names (the reserved_capacity
+        family alone is resources x metric-types wide)."""
+        with self._lock:
+            vecs = list(self._gauges.get(subsystem, {}).values())
+        for vec in vecs:
+            vec.remove(name, namespace)
+
     def lookup_by_full_name(self, full_name: str):
         with self._lock:
             for sub in self._gauges.values():

@@ -108,6 +108,23 @@ class Options:
     # node group (spec.warmPool) — these size the shared pricing only.
     cost_default_hourly: float = 1.0
     cost_spot_multiplier: float = 0.35
+    # pluggable pricing feed (cost/pricing.py, docs/cost.md): a
+    # JSON/YAML catalog file reloaded on mtime change, consulted before
+    # the built-in catalog. None = built-in catalog only.
+    pricing_file: Optional[str] = None
+    # multi-tenant control plane (karpenter_tpu/tenancy,
+    # docs/multitenancy.md): path to a tenant-config file (--tenant-
+    # config). None = single-tenant, byte-identical to the pre-tenancy
+    # wiring; set, the runtime builds a TenantRegistry of namespaced
+    # per-cluster stacks and a MultiTenantScheduler batching
+    # cross-tenant work through the one shared SolverService.
+    tenant_config: Optional[str] = None
+    # this control plane's OWN tenant id (--tenant-id): stamped as gRPC
+    # metadata on every sidecar RPC so a SHARED solver sidecar can
+    # attribute traffic per tenant (the other multi-tenant topology:
+    # many control-plane processes, one solver service). None = no
+    # metadata, the single-tenant wire.
+    tenant_id: Optional[str] = None
 
 
 class KarpenterRuntime:
@@ -197,11 +214,17 @@ class KarpenterRuntime:
         # an SLO-free fleet pays one list comprehension per tick and
         # decisions stay bit-identical (the engine's zero-overhead
         # opt-out contract).
-        from karpenter_tpu.cost import CostEngine, CostModel, WarmPoolEngine
+        from karpenter_tpu.cost import (
+            CostEngine,
+            CostModel,
+            WarmPoolEngine,
+            pricing_source_for,
+        )
 
         self.cost_model = CostModel(
             default_hourly=options.cost_default_hourly,
             spot_multiplier=options.cost_spot_multiplier,
+            pricing=pricing_source_for(options.pricing_file),
         )
         self.cost_engine = CostEngine(
             store=self.store,
@@ -298,7 +321,36 @@ class KarpenterRuntime:
                 self.batch_autoscaler, solver_service=self.solver_service
             ),
         )
+        self._build_tenancy(options)
         self._finish_recovery_boot()
+
+    def _build_tenancy(self, options: Options) -> None:
+        """Multi-tenant control plane (docs/multitenancy.md): with a
+        tenant config, the registry namespaces per-cluster stacks and
+        the scheduler batches cross-tenant decide/cost/forecast through
+        THIS runtime's shared SolverService. Without one, nothing is
+        built and every existing path is byte-identical."""
+        self.tenancy = None
+        self.tenant_scheduler = None
+        if not options.tenant_config:
+            return
+        from karpenter_tpu.tenancy import (
+            MultiTenantScheduler,
+            TenantRegistry,
+            load_tenant_config,
+        )
+
+        specs = load_tenant_config(options.tenant_config)
+        self.tenancy = TenantRegistry(
+            service=self.solver_service,
+            registry=self.registry,
+            journal_dir=options.journal_dir,
+            clock=self.clock,
+            specs=specs,
+        )
+        self.tenant_scheduler = MultiTenantScheduler(
+            self.tenancy, self.solver_service
+        )
 
     @staticmethod
     def _open_store(options: Options):
@@ -333,7 +385,9 @@ class KarpenterRuntime:
             return None, None
         from karpenter_tpu.sidecar.client import SolverClient
 
-        self.solver_client = SolverClient(options.solver_uri)
+        self.solver_client = SolverClient(
+            options.solver_uri, tenant=options.tenant_id
+        )
         return self.solver_client.solve, self.solver_client.decide
 
     def _build_recovery(self, options: Options):
@@ -434,6 +488,9 @@ class KarpenterRuntime:
         self.manager.run(duration)
 
     def close(self) -> None:
+        if self.tenancy is not None:
+            self.tenancy.close()
+            self.tenancy = None
         if self.recovery is not None:
             self.recovery.close()
             self.recovery = None

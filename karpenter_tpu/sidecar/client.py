@@ -25,7 +25,7 @@ from typing import Any, Dict, Optional, Tuple
 
 from karpenter_tpu.faults import FaultInjected, inject
 from karpenter_tpu.sidecar import codec
-from karpenter_tpu.sidecar.server import SERVICE
+from karpenter_tpu.sidecar.server import SERVICE, TENANT_METADATA_KEY
 from karpenter_tpu.utils.log import logger
 
 DEFAULT_TIMEOUT_S = 30.0
@@ -57,6 +57,7 @@ class SolverClient:
         retries: int = DEFAULT_RETRIES,
         retry_jitter_s: float = DEFAULT_RETRY_JITTER_S,
         seed: int = 0,
+        tenant: Optional[str] = None,
     ):
         import grpc
 
@@ -67,6 +68,16 @@ class SolverClient:
         self.retries = retries
         self.retry_jitter_s = retry_jitter_s
         self._rng = random.Random(seed)
+        # tenant-scoped RPCs (docs/multitenancy.md): the tenant id rides
+        # every call as gRPC metadata, so a multi-tenant sidecar can
+        # attribute solver traffic per tenant (server-side the label is
+        # sanitized and series-capped — the value crosses a trust
+        # boundary). None = single-tenant wire, byte-identical to
+        # previous releases.
+        self.tenant = tenant
+        self._metadata = (
+            ((TENANT_METADATA_KEY, tenant),) if tenant else None
+        )
         self._channel = grpc.insecure_channel(target)
         self._solve = self._channel.unary_unary(f"/{SERVICE}/Solve")
         self._decide = self._channel.unary_unary(f"/{SERVICE}/Decide")
@@ -78,10 +89,16 @@ class SolverClient:
         point (faults/registry.py)."""
         deadline = timeout if timeout else self.timeout
         attempts = 1 + max(0, self.retries)
+        # the tenant metadata kwarg is only passed when a tenant is
+        # configured: the single-tenant call signature stays exactly
+        # rpc(request, timeout=...) — wire- and test-double-compatible
+        kwargs = (
+            {"metadata": self._metadata} if self._metadata else {}
+        )
         for attempt in range(attempts):
             try:
                 inject("sidecar.rpc")
-                return rpc(request, timeout=deadline)
+                return rpc(request, timeout=deadline, **kwargs)
             except Exception as e:  # noqa: BLE001 — classified below
                 if attempt + 1 >= attempts or not _retryable_rpc_error(e):
                     raise

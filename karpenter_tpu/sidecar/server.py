@@ -26,6 +26,12 @@ from karpenter_tpu.sidecar import codec
 
 SERVICE = "karpenter.solver.v1.Solver"
 
+# tenant-scoped RPCs (docs/multitenancy.md): a multi-tenant control
+# plane stamps its tenant id into this gRPC metadata key; the server
+# attributes solver traffic per tenant on /metrics
+# (karpenter_tenant_rpcs_total{name=<tenant>}).
+TENANT_METADATA_KEY = "x-karpenter-tenant"
+
 
 def _solve(request: bytes) -> bytes:
     from karpenter_tpu.ops.binpack import BinPackInputs
@@ -75,17 +81,62 @@ class SolverServer:
     start()."""
 
     def __init__(self, port: int = 9090, host: str = "0.0.0.0",
-                 max_workers: int = 4):
+                 max_workers: int = 4, registry=None):
+        from karpenter_tpu.metrics.registry import default_registry
+
         self.host = host
         self.port = port
         self.max_workers = max_workers
         self._server = None
+        registry = registry if registry is not None else default_registry()
+        # per-tenant RPC attribution (docs/multitenancy.md): counted by
+        # the tenant id the client stamped into TENANT_METADATA_KEY;
+        # single-tenant clients (no metadata) count nothing. The label
+        # value is CLIENT-SUPPLIED, so it is sanitized and the distinct
+        # series are CAPPED — an adversarial or misconfigured fleet
+        # stamping unbounded ids must not grow /metrics without bound;
+        # past the cap, traffic counts under the "_overflow" series.
+        self._c_tenant_rpcs = registry.register(
+            "tenant", "rpcs_total", kind="counter"
+        )
+        self._tenant_labels: set = set()
+
+    # distinct tenant label values one server will track; chosen well
+    # above any sane tenant fleet per sidecar, far below scrape pain
+    MAX_TENANT_SERIES = 1024
+
+    def _tenant_label(self, value: str):
+        """Sanitized, cardinality-capped label for a client-supplied
+        tenant id: printable, bounded length, no label-breaking
+        characters (the exposition escaper handles quoting, this bounds
+        SIZE); ids beyond the series cap collapse to "_overflow"."""
+        value = str(value)[:64]
+        if not value or not value.isprintable():
+            return None
+        if value in self._tenant_labels:
+            return value
+        if len(self._tenant_labels) >= self.MAX_TENANT_SERIES:
+            return "_overflow"
+        self._tenant_labels.add(value)
+        return value
+
+    def _count_tenant(self, context) -> None:
+        try:
+            for key, value in context.invocation_metadata() or ():
+                if key == TENANT_METADATA_KEY and value:
+                    label = self._tenant_label(value)
+                    if label is not None:
+                        self._c_tenant_rpcs.inc(label, "-")
+                    return
+        except Exception:  # noqa: BLE001 — attribution must never fail an RPC
+            pass
 
     def start(self) -> int:
         import grpc
 
         def wrap(fn):
             def handler(request: bytes, context) -> bytes:
+                self._count_tenant(context)
                 try:
                     return fn(request)
                 except Exception as e:  # noqa: BLE001 — errors go to the

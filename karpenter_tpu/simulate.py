@@ -1644,3 +1644,186 @@ def simulate_delta(
             - baseline["unschedulable_pods"],
         },
     }
+
+
+# -- multi-tenant lockstep replay (docs/multitenancy.md) ---------------------
+
+
+def multitenant_fleet_inputs(
+    tenant: int,
+    rows: int,
+    metrics: int,
+    seed: int,
+    tick: int,
+    spec_replicas: np.ndarray,
+    now: float,
+):
+    """One tenant cluster's DecisionInputs for one lockstep tick:
+    AverageValue metrics riding a seeded diurnal ramp whose phase and
+    amplitude differ per tenant (tenant fleets are NOT in phase — the
+    fairness and batching machinery must handle skewed demand), with
+    the previous tick's desired fed back as spec/status replicas.
+    Deterministic in (tenant, tick, seed); shared with `bench.py
+    --multitenant` so the bench times exactly the matrices the
+    simulator steps."""
+    import math as _math
+
+    from karpenter_tpu.ops import decision as D
+
+    rng = np.random.RandomState(seed * 100_003 + tenant * 1_009 + tick)
+    phase = (tenant % 7) / 7.0 * 2.0 * _math.pi
+    level = 40.0 + 30.0 * _math.sin(tick / 12.0 * 2.0 * _math.pi + phase)
+    values = np.maximum(
+        0.0, level + rng.normal(0.0, 2.0, (rows, metrics))
+    ).astype(np.float32)
+    spec = np.asarray(spec_replicas, np.int32)
+    return D.DecisionInputs(
+        metric_value=values,
+        target_value=np.full((rows, metrics), 4.0, np.float32),
+        target_type=np.full(
+            (rows, metrics), D.TYPE_AVERAGE_VALUE, np.int32
+        ),
+        metric_valid=np.ones((rows, metrics), bool),
+        spec_replicas=spec,
+        status_replicas=spec.copy(),
+        min_replicas=np.ones(rows, np.int32),
+        max_replicas=np.full(rows, 10_000, np.int32),
+        up_window=np.zeros(rows, np.int32),
+        down_window=np.zeros(rows, np.int32),
+        up_policy=np.full(rows, D.POLICY_MAX, np.int32),
+        down_policy=np.full(rows, D.POLICY_MAX, np.int32),
+        last_scale_time=np.zeros(rows, np.float32),
+        has_last_scale=np.zeros(rows, bool),
+        now=np.float32(now),
+        up_ptype=np.zeros((rows, 1), np.int32),
+        up_pvalue=np.zeros((rows, 1), np.int32),
+        up_pperiod=np.ones((rows, 1), np.int32),
+        up_pvalid=np.zeros((rows, 1), bool),
+        down_ptype=np.zeros((rows, 1), np.int32),
+        down_pvalue=np.zeros((rows, 1), np.int32),
+        down_pperiod=np.ones((rows, 1), np.int32),
+        down_pvalid=np.zeros((rows, 1), bool),
+    )
+
+
+def multitenant_cost_inputs(decide_inputs, desired: np.ndarray):
+    """The tenant's CostInputs for the same tick: every row SLO-opted,
+    demand = the observed metric values, a per-row unit-cost spread so
+    the budget/risk trade is live. Deterministic companion of
+    multitenant_fleet_inputs."""
+    from karpenter_tpu.ops.cost import CostInputs
+
+    rows = int(np.asarray(desired).shape[0])
+    values = np.asarray(decide_inputs.metric_value, np.float32)
+    unit = np.asarray(
+        [0.19 + 0.27 * (i % 4) for i in range(rows)], np.float32
+    )
+    return CostInputs(
+        base_desired=np.asarray(desired, np.int32),
+        min_replicas=np.asarray(decide_inputs.min_replicas, np.int32),
+        max_replicas=np.asarray(decide_inputs.max_replicas, np.int32),
+        unit_cost=unit,
+        slo_weight=np.full(rows, 50.0, np.float32),
+        max_hourly_cost=np.zeros(rows, np.float32),
+        slo_valid=np.ones(rows, bool),
+        slo_target=np.asarray(decide_inputs.target_value, np.float32),
+        demand_mu=values,
+        demand_sigma=np.full(values.shape, 1.5, np.float32),
+        demand_valid=np.ones(values.shape, bool),
+    )
+
+
+def simulate_multitenant(
+    tenants: int = 16,
+    ticks: int = 12,
+    rows: int = 4,
+    metrics: int = 2,
+    seed: int = 0,
+    backend: str = "xla",
+    tenant_config: Optional[str] = None,
+) -> dict:
+    """Step N seeded tenant clusters in LOCKSTEP through one
+    MultiTenantScheduler (docs/multitenancy.md): every tick, all
+    tenants' fleet matrices concatenate into shared decide + cost
+    dispatches, the refined desired feeds back as the next tick's
+    replicas, and the report quantifies the amortization — actual
+    shared dispatches vs the 2-per-tenant-per-tick a sequential loop
+    would pay — plus deterministic aggregate-replica digests the
+    regression tests pin. Self-contained: no store, no provider."""
+    from karpenter_tpu.metrics.registry import GaugeRegistry
+    from karpenter_tpu.solver import SolverService
+    from karpenter_tpu.tenancy import (
+        MultiTenantScheduler,
+        TenantRegistry,
+        TenantSpec,
+        load_tenant_config,
+    )
+
+    if tenant_config:
+        specs = load_tenant_config(tenant_config)
+        tenants = len(specs)
+    else:
+        specs = [
+            TenantSpec(id=f"t{i:04d}", weight=1.0 + (i % 3))
+            for i in range(tenants)
+        ]
+    service = SolverService(backend=backend, registry=GaugeRegistry())
+    registry = TenantRegistry(
+        service=service, registry=GaugeRegistry(), specs=specs
+    )
+    scheduler = MultiTenantScheduler(registry, service)
+    replicas = {
+        spec.id: np.full(rows, 2, np.int32) for spec in specs
+    }
+    digests = {}
+    try:
+        for tick in range(ticks):
+            now = 1_000_000.0 + tick * 10.0
+            batch = {
+                spec.id: multitenant_fleet_inputs(
+                    i, rows, metrics, seed, tick, replicas[spec.id], now
+                )
+                for i, spec in enumerate(specs)
+            }
+            decided = scheduler.decide_all(batch)
+            cost_batch = {
+                tid: multitenant_cost_inputs(
+                    batch[tid], decided[tid].desired
+                )
+                for tid in decided
+            }
+            refined = scheduler.cost_all(cost_batch, backend=backend)
+            for tid in refined:
+                replicas[tid] = np.asarray(refined[tid].desired, np.int32)
+            if tick in (0, ticks // 2, ticks - 1):
+                digests[f"tick_{tick}"] = int(
+                    sum(int(r.sum()) for r in replicas.values())
+                )
+    finally:
+        service.close()
+    stats = scheduler.stats
+    shared = stats.decide_dispatches + stats.cost_dispatches
+    isolated = stats.isolated_dispatches
+    sequential_equiv = tenants * ticks * 2
+    return {
+        "tenants": tenants,
+        "ticks": ticks,
+        "rows_per_tenant": rows,
+        "metrics_per_row": metrics,
+        "decisions": stats.decide_rows,
+        "decide_dispatches": stats.decide_dispatches,
+        "cost_dispatches": stats.cost_dispatches,
+        "isolated_dispatches": isolated,
+        "admission_rounds": stats.admission_rounds,
+        "mirror_served": stats.mirror_served,
+        "fallback_served": stats.fallback_served,
+        "sequential_equivalent_dispatches": sequential_equiv,
+        "dispatch_amortization": round(
+            sequential_equiv / max(shared + isolated, 1), 1
+        ),
+        "aggregate_replicas": digests,
+        "solver": {
+            "requests": service.stats.requests,
+            "dispatches": service.stats.dispatches,
+        },
+    }

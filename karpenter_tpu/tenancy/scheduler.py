@@ -1,0 +1,747 @@
+"""MultiTenantScheduler: cross-tenant batching over the one solve seam.
+
+The coalescing queue (solver/service.py) already proved the core move
+for one cluster: concurrent same-shape requests concatenate into ONE
+device program and the per-request cost of a decision collapses. This
+module adds the FLEET axis (docs/multitenancy.md): N tenant clusters'
+per-tick matrices — decide, cost, forecast — are concatenated along
+their row/series axis into single device programs, dispatched once, and
+scattered back per tenant. Every kernel involved is row-independent
+(ops/decision.py, ops/cost.py, forecast/models.py compute each row from
+that row's operands only), so a tenant's slice of the concatenated
+output is BIT-IDENTICAL to what its own independent dispatch would have
+produced — the parity contract tests/test_tenancy.py pins on both the
+device and numpy paths. Cross-tenant bin-packs need no new machinery at
+all: `solve_all` submits every tenant's problem through the existing
+coalescing queue, where same-bucket requests already ride one `lax.map`
+dispatch.
+
+Around the concatenation sit the two multi-tenant serving policies:
+
+  * FAIRNESS (tenancy/fairness.py) — each concatenated dispatch admits
+    tenants under a deficit-weighted round-robin row budget, so a noisy
+    tenant's giant matrix becomes its own round instead of starving the
+    queue; deferred tenants carry credit and converge to their weight
+    share.
+  * ISOLATION (tenancy/isolation.py) — per-tenant breakers: a tenant
+    whose gather/dispatch keeps failing is tripped OUT of the shared
+    batch and served from the family's bit-identical numpy mirror
+    (cost_numpy / forecast_numpy / binpack_numpy) while healthy tenants
+    stay on device; the decide family — the never-block kernel with no
+    host mirror — degrades to an ISOLATED per-tenant dispatch instead.
+    `tenancy.gather.<tenant id>` is the per-tenant fault-injection
+    point (faults/registry.py; glob `tenancy.gather.*` hits them all).
+
+Decide batches group by their `now` scalar: lockstep callers (the
+simulator, the bench, a tick-driven runtime) share one epoch and ride
+one program; callers at different epochs form separate groups rather
+than perturbing each other's stabilization-window math.
+
+Metrics ride the TenantMetrics face (tenancy/registry.py):
+karpenter_tenant_* series per tenant, retired with the tenant.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from karpenter_tpu.faults import inject
+from karpenter_tpu.ops import decision as D
+from karpenter_tpu.tenancy.fairness import WeightedAdmission
+from karpenter_tpu.tenancy.isolation import TenantBreakerBoard
+from karpenter_tpu.tenancy.registry import TenantRegistry
+from karpenter_tpu.utils.log import logger
+
+# per-tenant fault-injection point prefix (module docstring)
+GATHER_POINT = "tenancy.gather."
+
+# row bucket for concatenated dispatches: tenant-count jitter moves
+# along this ladder instead of recompiling per added tenant (the same
+# reason the decide pass buckets its fleet — ops/decision.pad_to)
+ROW_BUCKET = 64
+
+
+@dataclass
+class TenancyStatistics:
+    """Plain-int mirror of the scheduler counters (tests and the bench
+    read these; the registry carries the per-tenant series)."""
+
+    decide_calls: int = 0  # decide_all entries
+    decide_rows: int = 0  # tenant rows decided (across all tenants)
+    decide_dispatches: int = 0  # shared concatenated decide dispatches
+    cost_calls: int = 0
+    cost_rows: int = 0
+    cost_dispatches: int = 0
+    forecast_calls: int = 0
+    forecast_series: int = 0
+    forecast_dispatches: int = 0
+    solve_calls: int = 0
+    solve_requests: int = 0  # per-tenant bin-packs through the queue
+    admission_rounds: int = 0  # rounds across all shared dispatches
+    deferrals: int = 0  # tenant admissions pushed past round 1
+    isolated_dispatches: int = 0  # per-tenant dispatches outside a batch
+    mirror_served: int = 0  # tenant results served from a numpy mirror
+    fallback_served: int = 0  # results synthesized by the never-block floor
+    probes: int = 0  # isolated recovery attempts for open breakers
+    tenant_failures: int = 0  # per-tenant gather/dispatch failures
+    breaker_trips: int = 0
+    breaker_recoveries: int = 0
+
+
+class MultiTenantScheduler:
+    """One per process (module docstring). `registry` owns tenant
+    membership and the per-tenant stacks; `service` (defaulting to the
+    registry's) is the shared SolverService every dispatch rides."""
+
+    def __init__(
+        self,
+        registry: TenantRegistry,
+        service=None,
+        *,
+        max_rows_per_round: int = 4096,
+        breaker_threshold: int = 3,
+        breaker_reset_s: float = 30.0,
+        clock=None,
+    ):
+        import time as _time
+
+        self.registry = registry
+        self.service = service if service is not None else registry.service
+        if self.service is None:
+            raise ValueError(
+                "MultiTenantScheduler needs a SolverService (directly or "
+                "via the tenant registry)"
+            )
+        clock = clock or _time.monotonic
+        self.admission = WeightedAdmission(budget_rows=max_rows_per_round)
+        self.breakers = TenantBreakerBoard(
+            threshold=breaker_threshold, reset_s=breaker_reset_s,
+            clock=clock,
+        )
+        self.stats = TenancyStatistics()
+        self.metrics = registry.metrics
+        registry.on_removed(self._forget)
+
+    def _forget(self, tenant: str) -> None:
+        self.breakers.forget(tenant)
+        self.admission.forget(tenant)
+
+    # -- decide ------------------------------------------------------------
+
+    def decide_all(self, batch: Dict[str, D.DecisionInputs]):
+        """Concatenate every tenant's fleet DecisionInputs into shared
+        dispatches (grouped by `now`, admitted fairly, isolated per
+        tenant) and scatter DecisionOutputs back per tenant."""
+        self.stats.decide_calls += 1
+        results: Dict[str, D.DecisionOutputs] = {}
+        by_now: Dict[float, Dict[str, D.DecisionInputs]] = {}
+        for tenant, inputs in batch.items():
+            by_now.setdefault(
+                float(np.asarray(inputs.now)), {}
+            )[tenant] = inputs
+        for group in by_now.values():
+            results.update(
+                self._run_family(
+                    group,
+                    family="decide",
+                    rows_of=lambda i: int(
+                        np.asarray(i.spec_replicas).shape[0]
+                    ),
+                    concat=concat_decision_inputs,
+                    dispatch=self.service.decide,
+                    scatter=slice_decision_outputs,
+                    isolated=self.service.decide,
+                    mirror=None,  # no host mirror: isolate instead
+                    fallback=decide_hold,
+                )
+            )
+        return results
+
+    # -- cost --------------------------------------------------------------
+
+    def cost_all(self, batch, backend: Optional[str] = None):
+        """Concatenate every tenant's CostInputs into shared
+        SolverService.cost dispatches; a degraded tenant serves from the
+        bit-identical cost_numpy mirror alone."""
+        from karpenter_tpu.ops import cost as CK
+
+        self.stats.cost_calls += 1
+
+        def dispatch(inputs):
+            return self.service.cost(inputs, backend=backend)
+
+        return self._run_family(
+            batch,
+            family="cost",
+            rows_of=lambda i: int(np.asarray(i.base_desired).shape[0]),
+            concat=concat_cost_inputs,
+            dispatch=dispatch,
+            scatter=slice_cost_outputs,
+            isolated=dispatch,
+            mirror=CK.cost_numpy,
+            fallback=cost_blind,
+        )
+
+    # -- forecast ----------------------------------------------------------
+
+    def forecast_all(self, batch, backend: Optional[str] = None):
+        """Concatenate every tenant's ForecastInputs along the series
+        axis (grouped by history-length bucket) into shared
+        SolverService.forecast dispatches; a degraded tenant serves
+        from the bit-identical forecast_numpy mirror alone."""
+        from karpenter_tpu.forecast import models as FM
+        from karpenter_tpu.solver.service import FORECAST_T_FLOOR
+        from karpenter_tpu.solver.bucketing import bucket_up
+
+        self.stats.forecast_calls += 1
+        results = {}
+        by_t: Dict[int, Dict[str, object]] = {}
+        for tenant, inputs in batch.items():
+            t_bucket = bucket_up(
+                int(np.asarray(inputs.values).shape[1]), FORECAST_T_FLOOR
+            )
+            by_t.setdefault(t_bucket, {})[tenant] = inputs
+
+        def dispatch(inputs):
+            return self.service.forecast(inputs, backend=backend)
+
+        for t_bucket, group in by_t.items():
+            padded = {
+                tenant: FM.pad_forecast_inputs(inputs, t_bucket)
+                for tenant, inputs in group.items()
+            }
+            results.update(
+                self._run_family(
+                    padded,
+                    family="forecast",
+                    rows_of=lambda i: int(np.asarray(i.values).shape[0]),
+                    concat=concat_forecast_inputs,
+                    dispatch=dispatch,
+                    scatter=FM.slice_forecast_outputs,
+                    isolated=dispatch,
+                    mirror=FM.forecast_numpy,
+                    fallback=forecast_invalid,
+                )
+            )
+        return results
+
+    # -- solve (bin-pack) --------------------------------------------------
+
+    def solve_all(
+        self,
+        batch,
+        buckets: int = 32,
+        backend: Optional[str] = None,
+        timeout: Optional[float] = None,
+    ):
+        """Cross-tenant bin-packs through the EXISTING coalescing queue:
+        every healthy tenant's problem is submitted before any result is
+        awaited, so same-bucket problems concatenate into one `lax.map`
+        dispatch exactly like concurrent same-cluster callers do. A
+        degraded tenant's solve never enters the queue — it serves from
+        the numpy mirror inline (the same binpack_numpy every ladder
+        rung ends at)."""
+        from karpenter_tpu.ops.numpy_binpack import binpack_numpy
+
+        self.stats.solve_calls += 1
+        results: Dict[str, object] = {}
+        futures: List[Tuple[str, object]] = []
+        for tenant, inputs in sorted(batch.items()):
+            # "probe" needs no special-casing here: the solver
+            # service's own ladder answers each queued request from
+            # numpy on a device failure (per-request fallback), so a
+            # probing tenant cannot fail other riders' results
+            if self._admit_tenant(tenant) == "degraded":
+                results[tenant] = binpack_numpy(inputs, buckets=buckets)
+                self._served_mirror(tenant)
+                continue
+            try:
+                futures.append((tenant, self.service.submit(
+                    inputs, buckets=buckets, backend=backend,
+                    timeout=timeout,
+                )))
+                self.stats.solve_requests += 1
+            except Exception as error:  # noqa: BLE001 — per-tenant isolation
+                self._tenant_failed(tenant, error)
+                results[tenant] = binpack_numpy(inputs, buckets=buckets)
+                self._served_mirror(tenant)
+        for tenant, future in futures:
+            try:
+                results[tenant] = future.result(timeout)
+                self._tenant_ok(tenant)
+            except Exception as error:  # noqa: BLE001 — per-tenant isolation
+                self._tenant_failed(tenant, error)
+                results[tenant] = binpack_numpy(
+                    batch[tenant], buckets=buckets
+                )
+                self._served_mirror(tenant)
+        return results
+
+    # -- the shared fan-in/fan-out machinery -------------------------------
+
+    def _admit_tenant(self, tenant: str) -> str:
+        """Breaker gate + per-tenant fault point. Verdicts: "shared"
+        (ride the concatenated batch), "probe" (breaker open, probe due
+        — ONE isolated recovery dispatch, never the shared batch: the
+        failure that opened the breaker must not re-break healthy
+        tenants' rounds), or "degraded" (mirror/fallback only)."""
+        from karpenter_tpu.tenancy import isolation as I
+
+        state = self.breakers.gate(tenant)
+        if state == I.OPEN:
+            return "degraded"
+        try:
+            inject(GATHER_POINT + tenant)
+        except Exception as error:  # noqa: BLE001 — injected per-tenant fault
+            self._tenant_failed(tenant, error)
+            return "degraded"
+        return "probe" if state == I.PROBE else "shared"
+
+    def _tenant_failed(self, tenant: str, error: BaseException) -> None:
+        self.stats.tenant_failures += 1
+        tripped = self.breakers.record_failure(tenant)
+        if tripped:
+            self.stats.breaker_trips += 1
+            logger().warning(
+                "tenant %s breaker OPEN after repeated failures (%s: %s); "
+                "serving its rows from the mirror while others stay on "
+                "device",
+                tenant, type(error).__name__, error,
+            )
+        if self.metrics.enabled:
+            if tripped:
+                self.metrics.trips.inc(tenant, "-")
+            self.metrics.degraded.set(
+                tenant, "-", 1.0 if self.breakers.is_open(tenant) else 0.0
+            )
+
+    def _tenant_ok(self, tenant: str) -> None:
+        if self.breakers.record_success(tenant):
+            self.stats.breaker_recoveries += 1
+            logger().info(
+                "tenant %s breaker closed; rejoining the shared batch",
+                tenant,
+            )
+        if self.metrics.enabled:
+            self.metrics.degraded.set(tenant, "-", 0.0)
+
+    def _served_mirror(self, tenant: str) -> None:
+        self.stats.mirror_served += 1
+        if self.metrics.enabled:
+            self.metrics.mirror.inc(tenant, "-")
+
+    def _run_family(  # lint: allow-complexity — one family pass: gate + admit + rounds, one guard per policy
+        self, batch, *, family, rows_of, concat, dispatch, scatter,
+        isolated, mirror, fallback,
+    ) -> Dict[str, object]:
+        """One family pass: breaker-gate, fair-admit, concatenate,
+        dispatch shared rounds, scatter per tenant; degraded tenants
+        serve from `mirror` (or `isolated` when the family has no host
+        mirror, with `fallback` synthesizing the never-block answer if
+        even that fails). A shared-round failure falls back to
+        per-tenant isolated dispatches so one poisoned tenant cannot
+        take the round's healthy tenants down with it. Every tenant in
+        `batch` gets a real outputs object back — never an exception."""
+        results: Dict[str, object] = {}
+        healthy: Dict[str, object] = {}
+        for tenant, inputs in sorted(batch.items()):
+            n = rows_of(inputs)
+            self._count_rows(family, n)
+            if self.metrics.enabled:
+                self.metrics.backlog.set(tenant, "-", float(n))
+            verdict = self._admit_tenant(tenant)
+            if verdict == "shared":
+                healthy[tenant] = inputs
+            elif verdict == "probe":
+                results[tenant] = self._probe_tenant(
+                    tenant, inputs, isolated, mirror, fallback
+                )
+            else:
+                results[tenant] = self._serve_degraded(
+                    tenant, inputs, mirror, isolated, fallback
+                )
+        if healthy:
+            demand = {t: rows_of(i) for t, i in healthy.items()}
+            schedule = self.admission.rounds(
+                demand, self.registry.weights()
+            )
+            self.stats.admission_rounds += len(schedule)
+            if self.metrics.enabled:
+                self.metrics.rounds.set("-", "-", float(len(schedule)))
+            for round_index, admitted in enumerate(schedule):
+                if round_index > 0:
+                    self.stats.deferrals += len(admitted)
+                    if self.metrics.enabled:
+                        for tenant in admitted:
+                            self.metrics.deferrals.inc(tenant, "-")
+                self._dispatch_round(
+                    {t: healthy[t] for t in admitted},
+                    results, family=family, concat=concat,
+                    dispatch=dispatch, scatter=scatter,
+                    isolated=isolated, mirror=mirror, fallback=fallback,
+                    rows_of=rows_of,
+                )
+        if family == "decide" and self.metrics.enabled:
+            # karpenter_tenant_decisions_total counts DECIDE rows only
+            # (one per autoscaler per tick), on every serve path —
+            # shared scatter, lone round, mirror, and fallback alike
+            for tenant in results:
+                self.metrics.decisions.inc(
+                    tenant, "-", float(rows_of(batch[tenant]))
+                )
+        return results
+
+    def _count_rows(self, family: str, n: int) -> None:
+        if family == "decide":
+            self.stats.decide_rows += n
+        elif family == "cost":
+            self.stats.cost_rows += n
+        else:
+            self.stats.forecast_series += n
+
+    def _count_family_dispatch(self, family: str) -> None:
+        if family == "decide":
+            self.stats.decide_dispatches += 1
+        elif family == "cost":
+            self.stats.cost_dispatches += 1
+        else:
+            self.stats.forecast_dispatches += 1
+
+    def _probe_tenant(self, tenant, inputs, isolated, mirror, fallback):
+        """An open breaker's recovery probe: ONE isolated dispatch —
+        success closes the breaker (the tenant rejoins the shared batch
+        next round), failure keeps it open and this round serves from
+        the mirror/fallback like any other degraded round."""
+        self.stats.probes += 1
+        try:
+            self.stats.isolated_dispatches += 1
+            out = isolated(inputs)
+            self._tenant_ok(tenant)
+            return out
+        except Exception as error:  # noqa: BLE001 — tenant isolation
+            self._tenant_failed(tenant, error)
+            return self._mirror_or_fallback(
+                tenant, inputs, mirror, fallback
+            )
+
+    def _serve_degraded(self, tenant, inputs, mirror, isolated, fallback):
+        """A tenant outside the shared batch still gets a REAL answer:
+        the family's numpy mirror, or an isolated dispatch for
+        mirror-less families — and if even that rung fails, the
+        family's `fallback` synthesizes the never-block result (hold
+        current replicas / pass through cost-blind / invalid forecast)
+        so one sick tenant can never hand its caller an exception."""
+        if mirror is None:
+            try:
+                self.stats.isolated_dispatches += 1
+                return isolated(inputs)
+            except Exception as error:  # noqa: BLE001 — tenant isolation
+                self._tenant_failed(tenant, error)
+            return self._served_fallback(tenant, fallback, inputs)
+        return self._mirror_or_fallback(tenant, inputs, mirror, fallback)
+
+    def _mirror_or_fallback(self, tenant, inputs, mirror, fallback):
+        if mirror is not None:
+            try:
+                out = mirror(inputs)
+                self._served_mirror(tenant)
+                return out
+            except Exception as error:  # noqa: BLE001 — tenant isolation
+                self._tenant_failed(tenant, error)
+        return self._served_fallback(tenant, fallback, inputs)
+
+    def _served_fallback(self, tenant, fallback, inputs):
+        """Count a synthesized never-block result SEPARATELY from
+        mirror serves — a fallback answer is a do-nothing floor, not a
+        bit-identical mirror, and conflating them on /metrics would
+        mask how degraded a tenant really is."""
+        self.stats.fallback_served += 1
+        if self.metrics.enabled:
+            self.metrics.fallback.inc(tenant, "-")
+        return fallback(inputs)
+
+    def _dispatch_round(  # lint: allow-complexity — shared dispatch + per-tenant fallback ladder, one arm per rung
+        self, admitted, results, *, family, concat, dispatch, scatter,
+        isolated, mirror, fallback, rows_of,
+    ) -> None:
+        tenants = sorted(admitted)
+        if len(tenants) == 1:
+            # a lone tenant (oversized, or just a one-tenant fleet)
+            # needs no concatenation — its own matrix IS the program
+            tenant = tenants[0]
+            try:
+                self.stats.isolated_dispatches += 1
+                results[tenant] = isolated(admitted[tenant])
+                self._tenant_ok(tenant)
+            except Exception as error:  # noqa: BLE001 — tenant isolation
+                self._tenant_failed(tenant, error)
+                results[tenant] = self._serve_degraded(
+                    tenant, admitted[tenant], mirror, isolated, fallback
+                )
+            return
+        inputs_list = [admitted[t] for t in tenants]
+        sizes = [rows_of(i) for i in inputs_list]
+        stacked = concat(inputs_list)
+        try:
+            out = dispatch(stacked)
+        except Exception as error:  # noqa: BLE001 — shared-round failure
+            logger().warning(
+                "shared %d-tenant dispatch failed (%s: %s); retrying "
+                "each tenant in isolation",
+                len(tenants), type(error).__name__, error,
+            )
+            for tenant in tenants:
+                try:
+                    self.stats.isolated_dispatches += 1
+                    results[tenant] = isolated(admitted[tenant])
+                    self._tenant_ok(tenant)
+                except Exception as tenant_error:  # noqa: BLE001
+                    self._tenant_failed(tenant, tenant_error)
+                    results[tenant] = self._serve_degraded(
+                        tenant, admitted[tenant], mirror, isolated,
+                        fallback,
+                    )
+            return
+        self._count_family_dispatch(family)
+        offset = 0
+        for tenant, size in zip(tenants, sizes):
+            results[tenant] = scatter(out, offset, offset + size)
+            offset += size
+            self._tenant_ok(tenant)
+        if self.metrics.enabled:
+            self.metrics.dispatches.inc("-", "-")
+
+
+# -- last-resort fallbacks (the never-block floor of the tenant ladder) ------
+# Synthesized when a tenant's mirror/isolated rung ALSO fails: each
+# family's domain-safe "do nothing" answer, so a sick tenant's result is
+# always a real outputs object — never an exception for the caller to
+# trip over mid-batch.
+
+
+def decide_hold(inputs: D.DecisionInputs) -> D.DecisionOutputs:
+    """Hold current replicas: the decide family's never-block floor
+    (the same posture a failed metric query takes — no movement without
+    a trustworthy signal)."""
+    spec = np.asarray(inputs.spec_replicas, np.int32)
+    n = spec.shape[0]
+    return D.DecisionOutputs(
+        desired=spec.copy(),
+        recommendation=spec.copy(),
+        limited=spec.copy(),
+        able_to_scale=np.zeros(n, bool),
+        scaling_unbounded=np.ones(n, bool),
+        able_at=np.zeros(n, np.float32),
+        rate_limited=np.zeros(n, bool),
+        up_ceiling=spec.copy(),
+        down_floor=spec.copy(),
+    )
+
+
+def cost_blind(inputs) -> "object":
+    """Pass the base decision through unrefined: the cost family's
+    documented degradation (docs/cost.md — cost-blind, never moved)."""
+    from karpenter_tpu.ops import cost as CK
+
+    base = np.asarray(inputs.base_desired, np.int32)
+    n = base.shape[0]
+    return CK.CostOutputs(
+        desired=base.copy(),
+        expected_hourly=(
+            base.astype(np.float32)
+            * np.asarray(inputs.unit_cost, np.float32)
+        ),
+        violation_risk=np.zeros(n, np.float32),
+        headroom=np.zeros(n, np.int32),
+        cost_limited=np.zeros(n, bool),
+        slo_raised=np.zeros(n, bool),
+    )
+
+
+def forecast_invalid(inputs) -> "object":
+    """All-invalid forecasts (n_valid = 0): consumers gate on
+    n_valid >= min_samples, so the tick proceeds purely reactive —
+    the forecast subsystem's own never-block contract."""
+    from karpenter_tpu.forecast.models import ForecastOutputs
+
+    s = int(np.asarray(inputs.values).shape[0])
+    return ForecastOutputs(
+        point=np.zeros(s, np.float32),
+        sigma2=np.zeros(s, np.float32),
+        n_valid=np.zeros(s, np.int32),
+    )
+
+
+# -- concatenation / scatter helpers (module docstring parity contract) ------
+
+
+def _pad_cols(arr: np.ndarray, width: int, fill) -> np.ndarray:
+    """Pad a [N, M] operand's column axis to `width` with `fill` —
+    semantics-preserving because every kernel masks these columns by
+    their own *_valid operand."""
+    arr = np.asarray(arr)
+    if arr.shape[1] == width:
+        return arr
+    pad = np.full((arr.shape[0], width - arr.shape[1]), fill, arr.dtype)
+    return np.concatenate([arr, pad], axis=1)
+
+
+def concat_decision_inputs(
+    inputs_list: List[D.DecisionInputs], row_bucket: int = ROW_BUCKET,
+) -> D.DecisionInputs:
+    """Stack per-tenant fleet matrices along the row axis, padding the
+    metric (M) and policy-slot (K) axes to the group maximum with
+    masked-invalid columns and the row axis up the compile bucket with
+    inert rows. Every tenant must share the `now` epoch (decide math is
+    relative to it); decide_all groups by `now` before calling."""
+    nows = {float(np.asarray(i.now)) for i in inputs_list}
+    if len(nows) != 1:
+        raise ValueError(
+            f"cannot concatenate decide batches across differing now "
+            f"epochs: {sorted(nows)}"
+        )
+    m = max(int(np.asarray(i.metric_value).shape[1]) for i in inputs_list)
+    m = max(m, 1)
+    k = max(int(np.asarray(i.up_ptype).shape[1]) for i in inputs_list)
+    k = max(k, 1)
+    has_forecast = any(i.forecast_value is not None for i in inputs_list)
+    total = sum(
+        int(np.asarray(i.spec_replicas).shape[0]) for i in inputs_list
+    )
+    n_pad = D.pad_to(total, row_bucket) - total
+
+    def rows(name: str, width: Optional[int], fill):
+        parts = []
+        for i in inputs_list:
+            arr = getattr(i, name)
+            if arr is None:  # optional forecast operand, absent here
+                n = int(np.asarray(i.metric_value).shape[0])
+                arr = np.full((n, width), fill)
+            arr = np.asarray(arr)
+            parts.append(
+                _pad_cols(arr, width, fill) if width is not None else arr
+            )
+        out = np.concatenate(parts, axis=0)
+        if n_pad:
+            pad_shape = (n_pad,) + out.shape[1:]
+            out = np.concatenate(
+                [out, np.full(pad_shape, fill, out.dtype)], axis=0
+            )
+        return out
+
+    return D.DecisionInputs(
+        metric_value=rows("metric_value", m, np.float32(0)),
+        target_value=rows("target_value", m, np.float32(0)),
+        target_type=rows("target_type", m, np.int32(D.TYPE_UNKNOWN)),
+        metric_valid=rows("metric_valid", m, False),
+        spec_replicas=rows("spec_replicas", None, np.int32(0)),
+        status_replicas=rows("status_replicas", None, np.int32(0)),
+        min_replicas=rows("min_replicas", None, np.int32(0)),
+        max_replicas=rows("max_replicas", None, np.int32(0)),
+        up_window=rows("up_window", None, np.int32(0)),
+        down_window=rows("down_window", None, np.int32(0)),
+        up_policy=rows("up_policy", None, np.int32(D.POLICY_MAX)),
+        down_policy=rows("down_policy", None, np.int32(D.POLICY_MAX)),
+        last_scale_time=rows("last_scale_time", None, np.float32(0)),
+        has_last_scale=rows("has_last_scale", None, False),
+        now=inputs_list[0].now,
+        up_ptype=rows("up_ptype", k, np.int32(D.POLICY_TYPE_COUNT)),
+        up_pvalue=rows("up_pvalue", k, np.int32(0)),
+        up_pperiod=rows("up_pperiod", k, np.int32(0)),
+        up_pvalid=rows("up_pvalid", k, False),
+        down_ptype=rows("down_ptype", k, np.int32(D.POLICY_TYPE_COUNT)),
+        down_pvalue=rows("down_pvalue", k, np.int32(0)),
+        down_pperiod=rows("down_pperiod", k, np.int32(0)),
+        down_pvalid=rows("down_pvalid", k, False),
+        forecast_value=(
+            rows("forecast_value", m, np.float32(0))
+            if has_forecast else None
+        ),
+        forecast_valid=(
+            rows("forecast_valid", m, False) if has_forecast else None
+        ),
+    )
+
+
+def slice_decision_outputs(
+    out: D.DecisionOutputs, start: int, stop: int
+) -> D.DecisionOutputs:
+    return D.DecisionOutputs(
+        **{
+            f.name: np.asarray(getattr(out, f.name))[start:stop]
+            for f in dataclasses.fields(D.DecisionOutputs)
+        }
+    )
+
+
+def concat_cost_inputs(inputs_list, row_bucket: int = ROW_BUCKET):
+    """Stack per-tenant CostInputs along the row axis (metric axis
+    padded to the group maximum with demand_valid=False columns, rows
+    padded up the bucket with slo_valid=False pass-through rows)."""
+    from karpenter_tpu.ops import cost as CK
+
+    m = max(int(np.asarray(i.slo_target).shape[1]) for i in inputs_list)
+    m = max(m, 1)
+    total = sum(
+        int(np.asarray(i.base_desired).shape[0]) for i in inputs_list
+    )
+    n_pad = D.pad_to(total, row_bucket) - total
+
+    def rows(name: str, width: Optional[int], fill):
+        parts = [
+            _pad_cols(np.asarray(getattr(i, name)), width, fill)
+            if width is not None
+            else np.asarray(getattr(i, name))
+            for i in inputs_list
+        ]
+        out = np.concatenate(parts, axis=0)
+        if n_pad:
+            pad_shape = (n_pad,) + out.shape[1:]
+            out = np.concatenate(
+                [out, np.full(pad_shape, fill, out.dtype)], axis=0
+            )
+        return out
+
+    return CK.CostInputs(
+        base_desired=rows("base_desired", None, np.int32(0)),
+        min_replicas=rows("min_replicas", None, np.int32(0)),
+        max_replicas=rows("max_replicas", None, np.int32(0)),
+        unit_cost=rows("unit_cost", None, np.float32(0)),
+        slo_weight=rows("slo_weight", None, np.float32(0)),
+        max_hourly_cost=rows("max_hourly_cost", None, np.float32(0)),
+        slo_valid=rows("slo_valid", None, False),
+        slo_target=rows("slo_target", m, np.float32(1)),
+        demand_mu=rows("demand_mu", m, np.float32(0)),
+        demand_sigma=rows("demand_sigma", m, np.float32(0)),
+        demand_valid=rows("demand_valid", m, False),
+    )
+
+
+def slice_cost_outputs(out, start: int, stop: int):
+    from karpenter_tpu.ops import cost as CK
+
+    return CK.CostOutputs(
+        **{
+            f.name: np.asarray(getattr(out, f.name))[start:stop]
+            for f in dataclasses.fields(CK.CostOutputs)
+        }
+    )
+
+
+def concat_forecast_inputs(inputs_list):
+    """Stack per-tenant ForecastInputs along the series axis (the time
+    axis was already padded to a shared bucket by forecast_all). Reuses
+    the forecast model's own concat — the same code path the coalescing
+    queue runs for same-cluster concurrent forecasts."""
+    from karpenter_tpu.forecast import models as FM
+    from karpenter_tpu.solver.service import FORECAST_S_FLOOR
+    from karpenter_tpu.solver.bucketing import bucket_up
+
+    total = sum(int(np.asarray(i.values).shape[0]) for i in inputs_list)
+    return FM.concat_forecast_inputs(
+        inputs_list, bucket_up(total, FORECAST_S_FLOOR)
+    )
