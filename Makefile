@@ -94,6 +94,10 @@ bench-trace: ## Reconcile-tracing overhead on the hot path: tracer enabled vs di
 	$(PYTHON) bench.py --trace --trace-ticks 200 \
 		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
 
+bench-provenance: ## Decision-provenance ledger overhead on the reconcile hot path: ledger enabled vs disabled, interleaved over the shared churn world (target <=5% tick-latency regression); appends a BENCHMARKS row + publishes to BASELINE.json
+	$(PYTHON) bench.py --provenance --provenance-ticks 200 \
+		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
+
 bench-shard: ## Sharded fleet-scale solve (1M pods x 1k types through the SolverService seam on an 8-device mesh, 1/2/4/8 scaling + parity pins); appends a BENCHMARKS row + publishes to BASELINE.json
 	$(PYTHON) bench.py --shard --pods 1000000 --types 1000 \
 		--backend xla --iters 3 --shard-scaling 1,2,4,8 \
@@ -143,5 +147,5 @@ kind-smoke: ## Deploy smoke on kind: image -> apply -> pod Ready -> one HA end t
 .PHONY: help dev ci test test-chaos test-recovery battletest verify codegen \
 	docs native bench bench-solver bench-hotpath bench-consolidate \
 	bench-forecast bench-preempt bench-cost bench-journal bench-trace \
-	bench-shard bench-multitenant dryrun \
+	bench-provenance bench-shard bench-multitenant dryrun \
 	image publish apply delete kind-load conformance kind-smoke

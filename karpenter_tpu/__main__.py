@@ -123,7 +123,38 @@ def parse_args(argv=None) -> argparse.Namespace:
         "FILE at exit (docs/observability.md); with --simulate and no "
         "other scenario flag, replays a seeded end-to-end scenario "
         "(tick -> coalesced solver dispatch -> actuation) and exports "
-        "its trace",
+        "its trace. With --provenance, the decision ledger is dumped "
+        "next to it as FILE's .decisions.jsonl sibling",
+    )
+    parser.add_argument(
+        "--provenance",
+        action=argparse.BooleanOptionalAction,
+        default=False,
+        help="record decision provenance (docs/observability.md "
+        "'Decision provenance'): every HorizontalAutoscaler decision's "
+        "full input chain — observed metrics, forecast blend, cost "
+        "ladder + clamps, warm-pool headroom, solver rung, tenant/"
+        "admission round, trace id — into a bounded columnar ring "
+        "served at /debug/decisions and dumped as JSONL next to "
+        "--trace-export. Default off (byte-identical decisions either "
+        "way; ~zero cost when off)",
+    )
+    parser.add_argument(
+        "--selfslo-objective",
+        type=float,
+        default=1.0,
+        help="the control plane's own e2e-latency objective in seconds "
+        "(against karpenter_reconcile_e2e_seconds; pick a histogram "
+        "bucket bound) for the self-SLO burn-rate monitor "
+        "(docs/observability.md 'Self-SLO monitoring')",
+    )
+    parser.add_argument(
+        "--selfslo-target",
+        type=float,
+        default=0.99,
+        help="the self-SLO success-ratio target the multi-window burn "
+        "rates measure against (error budget = 1 - target); must be "
+        "strictly between 0 and 1",
     )
     parser.add_argument(
         "--duration",
@@ -355,7 +386,20 @@ def parse_args(argv=None) -> argparse.Namespace:
         help="seconds a history sample may stand in for a FAILED live "
         "metric query before the row errors instead (0 disables reuse)",
     )
-    return parser.parse_args(argv)
+    args = parser.parse_args(argv)
+    if not 0.0 < args.selfslo_target < 1.0:
+        # a clean usage error instead of a ValueError traceback from
+        # deep inside runtime construction (SelfSLOMonitor's guard)
+        parser.error(
+            f"--selfslo-target must be in (0, 1), got "
+            f"{args.selfslo_target}"
+        )
+    if args.selfslo_objective <= 0:
+        parser.error(
+            f"--selfslo-objective must be > 0 seconds, got "
+            f"{args.selfslo_objective}"
+        )
+    return args
 
 
 def _parse_mesh_shape(spec):
@@ -391,10 +435,39 @@ def _run_simulation(args, store) -> int:  # lint: allow-complexity — simulatio
         # request spans and the SNG actuation closes the e2e window
         from karpenter_tpu.simulate import simulate_trace
 
-        report = simulate_trace(export_path=args.trace_export)
+        if args.provenance:
+            # the replay's HA decides record into the ledger, and the
+            # decisions JSONL lands next to the trace (the
+            # --trace-export help's contract); the process default is
+            # restored afterwards — an enabled default leaking out
+            # would turn on provenance for a co-resident runtime that
+            # never opted in (the simulate replays take the same care)
+            from karpenter_tpu.observability import (
+                default_ledger,
+                reset_default_ledger,
+                set_default_ledger,
+            )
+
+            saved_ledger = default_ledger()
+            ledger = reset_default_ledger(enabled=True)
+        try:
+            report = simulate_trace(export_path=args.trace_export)
+            if args.provenance:
+                from karpenter_tpu.observability.provenance import (
+                    export_next_to_trace,
+                )
+
+                path, count = export_next_to_trace(
+                    ledger, args.trace_export
+                )
+                report["decisions_export"] = path
+                report["decision_records"] = count
+        finally:
+            if args.provenance:
+                set_default_ledger(saved_ledger)
         # simulate_trace already exported (the report pins the event
         # count): clear the flag so main's exit-time _export_trace
-        # doesn't rewrite the identical file
+        # doesn't rewrite the identical file (or the decisions sibling)
         args.trace_export = None
         print(json.dumps(report, indent=2, sort_keys=True))
         return 0
@@ -402,13 +475,20 @@ def _run_simulation(args, store) -> int:  # lint: allow-complexity — simulatio
     if args.multitenant:
         # self-contained replay (no store, no provider): N seeded
         # tenant clusters stepped in lockstep through one
-        # MultiTenantScheduler (docs/multitenancy.md)
+        # MultiTenantScheduler (docs/multitenancy.md); combines with
+        # --cost implicitly (every lockstep tick runs decide + cost),
+        # with --provenance (per-decision "why" records + ledger
+        # JSONL), and with --trace-export
         from karpenter_tpu.simulate import simulate_multitenant
 
         report = simulate_multitenant(
             tenants=args.tenants,
             tenant_config=args.tenant_config,
+            provenance=args.provenance,
+            trace_export=args.trace_export,
         )
+        # simulate_multitenant exported trace + decisions itself
+        args.trace_export = None
         print(json.dumps(report, indent=2, sort_keys=True))
         return 0
 
@@ -421,6 +501,7 @@ def _run_simulation(args, store) -> int:  # lint: allow-complexity — simulatio
             horizon_s=args.forecast_horizon,
             default_hourly=args.cost_default_hourly,
             spot_multiplier=args.cost_spot_multiplier,
+            provenance=args.provenance,
         )
         print(json.dumps(report, indent=2, sort_keys=True))
         return 0
@@ -522,16 +603,29 @@ def _run_simulation(args, store) -> int:  # lint: allow-complexity — simulatio
 
 def _export_trace(args) -> None:
     """Flush the reconcile-span ring as Chrome-trace JSONL when
-    --trace-export names a file (docs/observability.md)."""
+    --trace-export names a file (docs/observability.md), and the
+    decision-provenance ledger as its .decisions.jsonl sibling when
+    --provenance recorded any."""
     if not args.trace_export:
         return
-    from karpenter_tpu.observability import default_tracer
+    from karpenter_tpu.observability import default_ledger, default_tracer
 
     events = default_tracer().export_jsonl(args.trace_export)
     print(
         f"exported {events} trace event(s) to {args.trace_export}",
         file=sys.stderr,
     )
+    ledger = default_ledger()
+    if ledger.enabled:
+        from karpenter_tpu.observability.provenance import (
+            export_next_to_trace,
+        )
+
+        path, records = export_next_to_trace(ledger, args.trace_export)
+        print(
+            f"exported {records} decision record(s) to {path}",
+            file=sys.stderr,
+        )
 
 
 def _readiness(runtime):
@@ -691,6 +785,9 @@ def main(argv=None) -> int:
             pricing_file=args.pricing_file,
             tenant_config=args.tenant_config,
             tenant_id=args.tenant_id,
+            provenance=args.provenance,
+            selfslo_objective_s=args.selfslo_objective,
+            selfslo_target=args.selfslo_target,
         ),
         store=store,
     )
@@ -698,6 +795,8 @@ def main(argv=None) -> int:
         runtime.registry,
         port=args.metrics_port,
         readiness=_readiness(runtime),
+        ledger=runtime.decision_ledger,
+        selfslo=runtime.selfslo,
     )
     port = metrics_server.start()
     print(f"serving /metrics and /healthz on :{port}", file=sys.stderr)

@@ -83,11 +83,15 @@ class BatchAutoscaler:
 
     def __init__(
         self, metrics_client_factory, store: Store, clock=_time.time,
-        decider=None, forecaster=None, cost_engine=None,
+        decider=None, forecaster=None, cost_engine=None, tenant=None,
     ):
         self.metrics = metrics_client_factory
         self.store = store
         self.clock = clock
+        # this control plane's own tenant id (--tenant-id), stamped on
+        # provenance-ledger records so a shared /debug/decisions reader
+        # can tell fleets apart; "" single-tenant
+        self.tenant = tenant or ""
         self.decider = decider if decider is not None else D.decide_jit
         # predictive-scaling seam (forecast/, docs/forecasting.md): a
         # FleetForecaster owning metric history, the batched forecast
@@ -242,27 +246,83 @@ class BatchAutoscaler:
                 results[key(row.ha)] = row.error
 
         if live:
-            # the forecast pass: ingest this tick's observations into
-            # the history store and predict every eligible series in ONE
-            # batched dispatch; {} (no spec, warming up, skill-gated, or
-            # ANY failure) keeps the tick purely reactive
-            forecasts: Dict[tuple, float] = {}
-            if self.forecaster is not None:
-                forecasts = self.forecaster.forecast_rows(
-                    live, self.clock()
-                )
-            outputs = self._decide(live, forecasts)
-            if self.cost_engine is not None:
-                # the multi-objective pass (docs/cost.md): ONE batched
-                # refine of the whole fleet's desired counts; any
-                # failure returns the base outputs (never-block) and
-                # an SLO-free fleet returns the SAME object untouched
-                outputs = self.cost_engine.adjust(live, outputs)
+            outputs = self._evaluate_live(live)
             now = self.clock()
             for i, row in enumerate(live):
                 self._apply(row, outputs, i, now)
                 results[key(row.ha)] = None
         return results
+
+    def _evaluate_live(self, live: List[_Row]) -> D.DecisionOutputs:
+        """Forecast -> decide -> cost-refine the live rows, with the
+        provenance ledger batch (when enabled) annotated at each stage
+        and committed once the final counts are known."""
+        ledger_batch = self._begin_ledger(live)
+        # the forecast pass: ingest this tick's observations into
+        # the history store and predict every eligible series in ONE
+        # batched dispatch; {} (no spec, warming up, skill-gated, or
+        # ANY failure) keeps the tick purely reactive
+        forecasts: Dict[tuple, float] = {}
+        if self.forecaster is not None:
+            forecasts = self.forecaster.forecast_rows(live, self.clock())
+        outputs = self._decide(live, forecasts)
+        if ledger_batch is not None:
+            n = len(live)
+            ledger_batch.annotate(
+                base_desired=np.asarray(outputs.desired)[:n],
+                final_desired=np.asarray(outputs.desired)[:n],
+            )
+        if self.cost_engine is not None:
+            # the multi-objective pass (docs/cost.md): ONE batched
+            # refine of the whole fleet's desired counts; any
+            # failure returns the base outputs (never-block) and
+            # an SLO-free fleet returns the SAME object untouched
+            outputs = self.cost_engine.adjust(live, outputs)
+            if ledger_batch is not None:
+                ledger_batch.annotate(
+                    final_desired=np.asarray(
+                        outputs.desired
+                    )[:len(live)],
+                )
+        if ledger_batch is not None:
+            from karpenter_tpu.observability import default_ledger
+
+            default_ledger().commit(ledger_batch)
+        return outputs
+
+    def _begin_ledger(self, live: List[_Row]):
+        """Open the tick's provenance batch (observability/provenance):
+        one record per live HorizontalAutoscaler, annotated in place by
+        the forecast pass, the cost refinement, and the solver decide
+        as the batch flows through them. None (one attribute read) when
+        the ledger is disabled — the default posture."""
+        from karpenter_tpu.observability import default_ledger
+        from karpenter_tpu.observability.provenance import OBSERVED_WIDTH
+
+        ledger = default_ledger()
+        if not ledger.enabled:
+            return None
+        n = len(live)
+        observed = np.zeros((n, OBSERVED_WIDTH), np.float32)
+        observed_n = np.zeros(n, np.int16)
+        for i, row in enumerate(live):
+            m = min(len(row.values), OBSERVED_WIDTH)
+            observed[i, :m] = row.values[:m]
+            observed_n[i] = len(row.values)
+        return ledger.begin(
+            "ha",
+            n,
+            autosolver=True,
+            tenant=self.tenant,
+            namespace=[r.ha.metadata.namespace for r in live],
+            name=[r.ha.metadata.name for r in live],
+            group=[r.ha.spec.scale_target_ref.name for r in live],
+            observed=observed,
+            observed_n=observed_n,
+            prev_replicas=np.asarray(
+                [r.scale.status_replicas for r in live], np.int32
+            ),
+        )
 
     def _decide(
         self, rows: List[_Row], forecasts: Optional[Dict[tuple, float]] = None

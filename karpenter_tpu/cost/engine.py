@@ -153,11 +153,25 @@ class CostEngine:
                 "cost refinement failed (%s: %s); this tick scales "
                 "cost-blind", type(error).__name__, error,
             )
+            self._annotate_blind(slo_rows)
             for i in slo_rows:
                 ns, name = _ha_key(rows[i].ha)
                 if self._c_blind is not None:
                     self._c_blind.inc(name, ns)
             return outputs
+
+    @staticmethod
+    def _annotate_blind(slo_rows: List[int]) -> None:
+        """Provenance: a cost-blind tick is itself an answer to 'why is
+        the count what it is' — stamp the opted-in rows so the ledger
+        record names the degradation instead of looking unrefined."""
+        from karpenter_tpu.observability import default_ledger
+
+        batch = default_ledger().current()  # None when disabled
+        if batch is not None:
+            rows = [i for i in slo_rows if i < batch.n]
+            if rows:
+                batch.annotate_rows(rows, slo_opted=True, cost_blind=True)
 
     def _unit_cost(self, ha) -> float:
         """Hourly cost per replica of this HA's scale target: the
@@ -270,6 +284,7 @@ class CostEngine:
         hourly = np.asarray(out.expected_hourly, np.float32)
         risk = np.asarray(out.violation_risk, np.float32)
         headroom = np.asarray(out.headroom, np.int32)
+        self._annotate_ledger(rows, slo_rows, outputs, out)
         # every row in THIS batch re-establishes (or loses) its
         # contribution and gauges; rows outside the batch keep theirs
         # untouched
@@ -288,6 +303,76 @@ class CostEngine:
             ref = ha.spec.scale_target_ref
             self._contrib[(ns, name)] = ((ns, ref.name), int(headroom[i]))
         return replace(outputs, desired=desired)
+
+    def _annotate_ledger(  # lint: allow-complexity — provenance assembly: one guard per clamp direction
+        self, rows: List, slo_rows: List[int],
+        outputs: D.DecisionOutputs, out: CK.CostOutputs,
+    ) -> None:
+        """Provenance slice (observability/provenance.py): the cost
+        stage stamps the chosen ladder candidate with its risk/cost
+        score and WHICH bound clamped it — the hard budget
+        (cost_limited) or the decide kernel's per-tick movement bound
+        (the candidate landed exactly on an up_ceiling/down_floor that
+        is tighter than the spec's own [min, max]) — plus the one-sigma
+        warm-pool headroom the candidate implies. One attribute read
+        when the ledger is off."""
+        from karpenter_tpu.observability import default_ledger
+
+        batch = default_ledger().current()  # None when disabled
+        if batch is None:
+            return
+        idx = [i for i in slo_rows if i < batch.n]
+        if not idx:
+            return
+        desired = np.asarray(out.desired, np.int64)
+        base = np.asarray(outputs.desired, np.int64)
+        hourly = np.asarray(out.expected_hourly, np.float32)
+        risk = np.asarray(out.violation_risk, np.float32)
+        up_ceiling = np.asarray(outputs.up_ceiling, np.int64)
+        down_floor = np.asarray(outputs.down_floor, np.int64)
+        n = batch.n
+        movement = np.zeros(len(base), bool)
+        score = np.zeros(len(base), np.float32)
+        for i in idx:
+            slo = rows[i].ha.spec.behavior.slo
+            ha_min = rows[i].ha.spec.min_replicas
+            ha_max = rows[i].ha.spec.max_replicas
+            # the movement bound clamped iff the candidate sits ON the
+            # rate-limited ceiling/floor AND that bound is tighter than
+            # the spec bound it would otherwise have hit
+            movement[i] = bool(
+                (
+                    desired[i] > base[i]
+                    and up_ceiling[i] < ha_max
+                    and desired[i] == min(
+                        ha_max, max(int(up_ceiling[i]), ha_min)
+                    )
+                )
+                or (
+                    desired[i] < base[i]
+                    and down_floor[i] > ha_min
+                    and desired[i] == max(
+                        ha_min, min(int(down_floor[i]), ha_max)
+                    )
+                )
+            )
+            # the kernel's objective at the chosen candidate:
+            # violationCostWeight x risk + n x unitHourlyCost
+            score[i] = (
+                float(slo.violation_cost_weight) * float(risk[i])
+                + float(hourly[i])
+            )
+        batch.annotate_rows(
+            idx,
+            slo_opted=True,
+            cost_candidate=desired[:n].astype(np.int32),
+            cost_risk=risk[:n],
+            cost_hourly=hourly[:n],
+            cost_score=score[:n],
+            budget_clamped=np.asarray(out.cost_limited, bool)[:n],
+            movement_clamped=movement[:n],
+            warm_headroom=np.asarray(out.headroom, np.int32)[:n],
+        )
 
 
 def _ha_key(ha) -> Tuple[str, str]:

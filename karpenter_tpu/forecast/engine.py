@@ -389,9 +389,11 @@ class FleetForecaster:
         if self.journal is not None:
             self.journal.set(("skill",) + ha_key, self._skill[ha_key])
 
-    def _predict(
+    def _predict(  # lint: allow-complexity — one guard per per-series concern (gating, distribution, scoring, gauges, provenance)
         self, rows, eligible: List[tuple], now: float
     ) -> Dict[tuple, float]:
+        from karpenter_tpu.observability import default_ledger
+
         inputs = self._build_inputs(eligible, now)
         out = self.forecast_fn(inputs)
         points = np.asarray(out.point, np.float32)
@@ -399,6 +401,18 @@ class FleetForecaster:
         n_valid = np.asarray(out.n_valid)
         step_s = np.asarray(inputs.step_s)
         forecasts: Dict[tuple, float] = {}
+        # provenance slice (observability/provenance.py): the forecast
+        # stage annotates ITS columns of the tick's ledger batch — the
+        # predicted value, the skill that gated it, and whether the
+        # blend could raise the reactive recommendation (point above
+        # observed under an active blend). One record per ROW: the
+        # value/skill come from the row's first forecast-eligible
+        # metric, the blend flag ORs over ALL its metrics (a blend on
+        # metric 1 must not read as 'reactive' just because metric 0
+        # carries no forecast). current() is None when the ledger is
+        # disabled or no batch is staged.
+        ledger_batch = default_ledger().current()
+        ledger_rows: Dict[int, list] = {}
         for k, (i, j, key, fspec, blend) in enumerate(eligible):
             if n_valid[k] < max(int(fspec.min_samples), 2):
                 continue
@@ -432,6 +446,14 @@ class FleetForecaster:
                 (now + float(fspec.horizon_seconds), point, scale)
             )
             observed = rows[i].observed[j][2]
+            if ledger_batch is not None and i < ledger_batch.n:
+                entry = ledger_rows.setdefault(
+                    i, [point, self.skill(ns, name), blend, False]
+                )
+                entry[2] = entry[2] or blend
+                entry[3] = entry[3] or bool(
+                    blend and np.isfinite(observed) and point > observed
+                )
             if self._g_skill is not None:
                 self._gauged.add((ns, name))
                 self._g_skill.set(name, ns, self.skill(ns, name))
@@ -439,7 +461,34 @@ class FleetForecaster:
                     self._g_value.set(name, ns, point)
                 if blend and np.isfinite(observed) and point > observed:
                     self._c_blend.inc(name, ns)
+        if ledger_rows:
+            self._annotate_forecast_rows(ledger_batch, ledger_rows)
         return forecasts
+
+    @staticmethod
+    def _annotate_forecast_rows(
+        ledger_batch, ledger_rows: Dict[int, list]
+    ) -> None:
+        """The batch's forecast provenance in one scatter: per row, the
+        first eligible metric's predicted value + skill, whether ANY
+        metric blends (active), and whether any blend could RAISE the
+        reactive recommendation (the same point-above-observed
+        condition the blend counter uses)."""
+        idx = list(ledger_rows)
+        n = ledger_batch.n
+        value = np.full(n, np.nan, np.float32)
+        skill = np.full(n, np.nan, np.float32)
+        active = np.zeros(n, bool)
+        blend = np.zeros(n, bool)
+        for i, (v, s, a, b) in ledger_rows.items():
+            value[i], skill[i], active[i], blend[i] = v, s, a, b
+        ledger_batch.annotate_rows(
+            idx,
+            forecast_value=value,
+            forecast_skill=skill,
+            forecast_active=active,
+            forecast_blend=blend,
+        )
 
     def _build_inputs(
         self, eligible: List[tuple], now: float

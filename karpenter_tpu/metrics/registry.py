@@ -220,6 +220,21 @@ class HistogramVec:
             lower = upper
         return float(self.buckets[-1])
 
+    def le_totals(self, bound: float) -> Tuple[int, int]:
+        """(samples <= bound, total samples) summed across ALL series —
+        the self-SLO monitor's good/total pair (observability/selfslo).
+        `bound` should sit on a bucket boundary for exactness; an
+        off-ladder bound conservatively counts only the buckets wholly
+        at or below it (samples between the ladder rung and the bound
+        count as BAD, never silently as good)."""
+        idx = bisect.bisect_right(self.buckets, float(bound))
+        good = total = 0
+        with self._lock:
+            for counts in self._counts.values():
+                good += sum(counts[:idx])
+                total += sum(counts)
+        return good, total
+
     def remove(self, name: str, namespace: str) -> None:
         with self._lock:
             self._counts.pop((name, namespace), None)

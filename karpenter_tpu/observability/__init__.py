@@ -11,8 +11,17 @@ plane — docs/observability.md is the operator guide:
                      FSM trips, circuit opens, fence rejections, shard
                      fallbacks, journal compactions) with trace-ID
                      backlinks and crash-safe dumps into --journal-dir
+  provenance.py      the decision provenance ledger — a bounded
+                     columnar ring answering "why did this group scale
+                     to N this tick" (/debug/decisions, JSONL export
+                     next to --trace-export; default off, --provenance)
+  selfslo.py         the control plane's self-SLO monitor: multi-window
+                     burn rates over karpenter_reconcile_e2e_seconds +
+                     solver FSM + tenant breakers (/debug/selfslo,
+                     karpenter_selfslo_*, selfslo_burn auto-dump)
   server.py          /metrics, /healthz (liveness), /readyz (real
-                     readiness), /debug/traces, /debug/flightrecorder
+                     readiness), /debug/traces, /debug/flightrecorder,
+                     /debug/decisions, /debug/selfslo
   profiler.py        device-timeline annotations (solver_trace, probed
                      once) + the xprof profiler server
 
@@ -31,6 +40,13 @@ from karpenter_tpu.observability.profiler import (
     solver_trace,
     start_profiler_server,
 )
+from karpenter_tpu.observability.provenance import (
+    DecisionLedger,
+    default_ledger,
+    reset_default_ledger,
+    set_default_ledger,
+)
+from karpenter_tpu.observability.selfslo import SelfSLOMonitor
 from karpenter_tpu.observability.server import MetricsServer
 from karpenter_tpu.observability.tracing import (
     Tracer,
@@ -40,14 +56,19 @@ from karpenter_tpu.observability.tracing import (
 )
 
 __all__ = [
+    "DecisionLedger",
     "FlightRecorder",
     "MetricsServer",
+    "SelfSLOMonitor",
     "Tracer",
     "default_flight_recorder",
+    "default_ledger",
     "default_tracer",
     "reset_default_flight_recorder",
+    "reset_default_ledger",
     "reset_default_tracer",
     "set_default_flight_recorder",
+    "set_default_ledger",
     "set_default_tracer",
     "solver_trace",
     "start_profiler_server",

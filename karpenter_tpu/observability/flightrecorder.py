@@ -31,8 +31,12 @@ DUMP_PREFIX = "flightrecorder-"
 
 # event kinds that snapshot the ring to disk when they land: each marks
 # a degradation an operator will want the surrounding context for
+# (selfslo_burn: the self-SLO monitor's fast-burn trip —
+# observability/selfslo.py — whose whole point is arriving WITH the
+# ring of events that burned the budget)
 DUMP_KINDS = frozenset((
     "fsm_trip", "circuit_open", "fence_rejection", "watchdog_restart",
+    "selfslo_burn",
 ))
 
 

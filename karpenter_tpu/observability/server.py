@@ -15,11 +15,19 @@ come from the manager. Here the same server additionally serves:
                          health FSM is tripped (__main__.py wires it)
   /metrics               Prometheus text exposition (gauges, counters,
                          and native histograms — metrics/registry.py)
-  /debug/traces          recent reconcile spans as JSON (?limit=N),
-                         same records `--trace-export` writes as
-                         Chrome-trace JSONL (observability.tracing)
+  /debug/traces          recent reconcile spans as JSON (?limit=N;
+                         ?tenant=ID keeps the traces that touched that
+                         tenant), same records `--trace-export` writes
+                         as Chrome-trace JSONL (observability.tracing)
   /debug/flightrecorder  the flight-recorder event ring as JSON
-                         (?kind=fsm_trip filters)
+                         (?kind=fsm_trip and ?tenant=ID filter)
+  /debug/decisions       the decision provenance ledger as JSON
+                         (?kind=&tenant=&group=&name=&limit= filter) —
+                         observability.provenance, --provenance to
+                         enable recording
+  /debug/selfslo         the self-SLO scoreboard: per-window burn
+                         rates/budget + solver FSM + per-tenant breaker
+                         degradation (observability.selfslo)
 """
 
 from __future__ import annotations
@@ -34,6 +42,17 @@ from karpenter_tpu.metrics.registry import GaugeRegistry
 
 # readiness callable contract: () -> (ready, reason)
 ReadinessCheck = Callable[[], Tuple[bool, str]]
+
+
+def _parse_limit(query: dict) -> Optional[int]:
+    """?limit=N as an int, None when absent or malformed (a broken
+    limit serves everything rather than erroring a debug page)."""
+    try:
+        if "limit" in query:
+            return int(query["limit"][0])
+    except (ValueError, IndexError):
+        pass
+    return None
 
 
 class MetricsServer:
@@ -54,6 +73,8 @@ class MetricsServer:
         readiness: Optional[ReadinessCheck] = None,
         tracer=None,
         recorder=None,
+        ledger=None,
+        selfslo=None,
     ):
         self.registry = registry
         self.host = host
@@ -61,6 +82,8 @@ class MetricsServer:
         self.readiness = readiness
         self._tracer = tracer
         self._recorder = recorder
+        self._ledger = ledger
+        self._selfslo = selfslo
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -80,6 +103,13 @@ class MetricsServer:
 
         return default_flight_recorder()
 
+    def _ledger_or_default(self):
+        if self._ledger is not None:
+            return self._ledger
+        from karpenter_tpu.observability.provenance import default_ledger
+
+        return default_ledger()
+
     # -- responses ---------------------------------------------------------
 
     def _respond_ready(self) -> Tuple[int, bytes, str]:
@@ -94,26 +124,70 @@ class MetricsServer:
         return 503, reason.encode(), "text/plain"
 
     def _respond_traces(self, query: dict) -> Tuple[int, bytes, str]:
-        limit = None
-        try:
-            if "limit" in query:
-                limit = int(query["limit"][0])
-        except (ValueError, IndexError):
-            limit = None
+        limit = _parse_limit(query)
         tracer = self._tracer_or_default()
+        tenant = query.get("tenant", [None])[0]
+        if tenant is not None:
+            # per-tenant view (docs/multitenancy.md): keep whole TRACES
+            # that touched the tenant — any span stamped tenant=<id>
+            # (the tenancy serve spans, tenant-stamped solver requests)
+            # selects its trace, and every span of a selected trace is
+            # returned so the tick context around the tenant's work
+            # survives the filter. The limit applies AFTER filtering.
+            spans = tracer.snapshot()
+            traces = {
+                span["trace"] for span in spans
+                if span["args"].get("tenant") == tenant
+            }
+            spans = [s for s in spans if s["trace"] in traces]
+            if limit is not None and limit >= 0:
+                spans = spans[-limit:] if limit else []
+        else:
+            spans = tracer.snapshot(limit=limit)
         body = json.dumps({
             "epoch_unix": tracer.epoch_unix,
             "spans_total": tracer.spans_total,
             "spans_dropped": tracer.spans_dropped,
-            "spans": tracer.snapshot(limit=limit),
+            "spans": spans,
         }, sort_keys=True).encode()
         return 200, body, "application/json"
 
     def _respond_flightrecorder(self, query: dict) -> Tuple[int, bytes, str]:
         kind = query.get("kind", [None])[0]
+        tenant = query.get("tenant", [None])[0]
+        events = self._recorder_or_default().events(kind=kind)
+        if tenant is not None:
+            events = [e for e in events if e.get("tenant") == tenant]
         body = json.dumps({
-            "events": self._recorder_or_default().events(kind=kind),
+            "events": events,
         }, sort_keys=True).encode()
+        return 200, body, "application/json"
+
+    def _respond_decisions(self, query: dict) -> Tuple[int, bytes, str]:
+        limit = _parse_limit(query)
+        ledger = self._ledger_or_default()
+        body = json.dumps({
+            "enabled": ledger.enabled,
+            "records_total": ledger.records_total,
+            "records_dropped": ledger.records_dropped,
+            "decisions": ledger.query(
+                kind=query.get("kind", [None])[0],
+                tenant=query.get("tenant", [None])[0],
+                group=query.get("group", [None])[0],
+                name=query.get("name", [None])[0],
+                limit=limit,
+            ),
+        }, sort_keys=True).encode()
+        return 200, body, "application/json"
+
+    def _respond_selfslo(self) -> Tuple[int, bytes, str]:
+        if self._selfslo is None:
+            body = json.dumps({"enabled": False}).encode()
+        else:
+            body = json.dumps(
+                {"enabled": True, **self._selfslo.scoreboard()},
+                sort_keys=True,
+            ).encode()
         return 200, body, "application/json"
 
     def _route(self, path: str, query: dict) -> Optional[Tuple[int, bytes, str]]:
@@ -132,6 +206,10 @@ class MetricsServer:
             return self._respond_traces(query)
         if path == "/debug/flightrecorder":
             return self._respond_flightrecorder(query)
+        if path == "/debug/decisions":
+            return self._respond_decisions(query)
+        if path == "/debug/selfslo":
+            return self._respond_selfslo()
         return None
 
     # -- lifecycle ---------------------------------------------------------

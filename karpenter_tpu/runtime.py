@@ -125,6 +125,19 @@ class Options:
     # many control-plane processes, one solver service). None = no
     # metadata, the single-tenant wire.
     tenant_id: Optional[str] = None
+    # decision provenance ledger (observability/provenance.py,
+    # docs/observability.md "Decision provenance"): record the full
+    # input chain behind every HA decision into a bounded columnar ring
+    # (/debug/decisions, JSONL next to --trace-export). Default OFF,
+    # matching tracing's posture: provenance is telemetry, and the off
+    # path must stay mark-free (property-pinned byte-identical).
+    provenance: bool = False
+    # control-plane self-SLO monitor (observability/selfslo.py): the
+    # e2e-latency objective (seconds against the
+    # karpenter_reconcile_e2e_seconds histogram — pick a bucket bound)
+    # and the SLO target the multi-window burn rates measure against.
+    selfslo_objective_s: float = 1.0
+    selfslo_target: float = 0.99
 
 
 class KarpenterRuntime:
@@ -242,6 +255,7 @@ class KarpenterRuntime:
             decider=self.solver_service.decide,
             forecaster=self.forecaster,
             cost_engine=self.cost_engine,
+            tenant=options.tenant_id,
         )
         # consolidation engine (opt-in): plans batched node drains
         # through the shared solve service and actuates them through the
@@ -293,10 +307,12 @@ class KarpenterRuntime:
         # autoscaler decides — one tick moves a signal end to end (the
         # reference's produce→scrape→poll chain costs up to 20s of interval
         # latency; SURVEY.md §6).
-        tick_hook = backoff_journal = None
+        backoff_journal = None
         if self.recovery is not None:
-            tick_hook = self.recovery.on_tick
             backoff_journal = self.recovery.handle("backoff")
+        # the composed hook: recovery bookkeeping + the self-SLO
+        # evaluation, both once per manager tick (_on_tick)
+        tick_hook = self._on_tick
         self._sng_controller = ScalableNodeGroupController(
             self.cloud_provider, consolidator=self.consolidation,
             preemptor=self.preemption,
@@ -322,6 +338,7 @@ class KarpenterRuntime:
             ),
         )
         self._build_tenancy(options)
+        self._build_selfslo(options)
         self._finish_recovery_boot()
 
     def _build_tenancy(self, options: Options) -> None:
@@ -360,12 +377,16 @@ class KarpenterRuntime:
 
     def _bind_observability(self, options: Options) -> None:
         """Observability wiring (docs/observability.md): the process
-        tracer and flight recorder publish their counters + the
-        karpenter_reconcile_e2e_seconds histogram into THIS runtime's
-        registry, and trip-class recorder events dump crash-safely
-        into --journal-dir next to the recovery journal."""
+        tracer, flight recorder, and decision-provenance ledger publish
+        their counters + the karpenter_reconcile_e2e_seconds histogram
+        into THIS runtime's registry, and trip-class recorder events
+        dump crash-safely into --journal-dir next to the recovery
+        journal. The ledger is enabled only under --provenance (and
+        never force-disabled here — a test that enabled the process
+        default keeps it)."""
         from karpenter_tpu.observability import (
             default_flight_recorder,
+            default_ledger,
             default_tracer,
         )
 
@@ -375,6 +396,52 @@ class KarpenterRuntime:
         self.flight_recorder.bind_registry(self.registry)
         if options.journal_dir:
             self.flight_recorder.configure(dump_dir=options.journal_dir)
+        self.decision_ledger = default_ledger()
+        self.decision_ledger.bind_registry(self.registry)
+        if options.provenance:
+            self.decision_ledger.enabled = True
+
+    def _build_selfslo(self, options: Options) -> None:
+        """The control plane's self-SLO monitor (observability/selfslo):
+        multi-window burn rates over its OWN e2e-latency histogram plus
+        the solver backend FSM and (when multi-tenant) the per-tenant
+        breaker board; evaluated once per manager tick via the tick
+        hook, served at /debug/selfslo. Always built — one snapshot
+        tuple per tick."""
+        from karpenter_tpu.observability import SelfSLOMonitor
+
+        tenant_source = None
+        if self.tenant_scheduler is not None:
+            breakers = self.tenant_scheduler.breakers
+            registry = self.tenancy
+
+            def tenant_source():
+                return {
+                    tenant: breakers.is_open(tenant)
+                    for tenant in registry.tenants()
+                }
+
+        self.selfslo = SelfSLOMonitor(
+            registry=self.registry,
+            objective_s=options.selfslo_objective_s,
+            target=options.selfslo_target,
+            clock=self.clock,
+            histogram=self.registry.gauge("reconcile", "e2e_seconds"),
+            fsm_source=self.solver_service.backend_health,
+            tenant_source=tenant_source,
+            recorder=self.flight_recorder,
+        )
+
+    def _on_tick(self) -> None:
+        """Composed manager tick hook: recovery bookkeeping (warm-up
+        countdown, checkpoint cadence) then the self-SLO evaluation —
+        the monitor must observe the tick INCLUDING any degradation the
+        tick just hit."""
+        if self.recovery is not None:
+            self.recovery.on_tick()
+        selfslo = getattr(self, "selfslo", None)
+        if selfslo is not None:
+            selfslo.evaluate()
 
     def _build_solver_client(self, options: Options):
         """(device_solver, decider) seams for the gRPC process split:

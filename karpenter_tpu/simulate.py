@@ -1271,6 +1271,48 @@ def simulate_restart_storm(  # lint: allow-complexity — scenario assembly: cra
             shutil.rmtree(journal_dir, ignore_errors=True)
 
 
+def _why_report(ledger, sample: int = 8) -> dict:
+    """The WHY column of a provenance-recording replay
+    (docs/observability.md "Decision provenance"): stage totals over
+    every recorded decision plus compact rows — the first record of
+    each distinct winning stage and the last `sample` records — each
+    answering "why did this group scale to N" in one line."""
+    records = ledger.query()
+
+    def row(index: int, record: dict) -> dict:
+        return {
+            "tick_record": index,
+            "tenant": record["tenant"] or None,
+            "group": record["group"],
+            "why": record["winning_stage"],
+            "desired": record["final_desired"],
+            "base": record["base_desired"],
+            "observed": record["observed"],
+            "forecast": record["forecast_value"],
+            "rung": record["solver_rung"] or None,
+            "trace": record["trace"] or None,
+        }
+
+    by_stage: Dict[str, int] = {}
+    firsts: Dict[str, dict] = {}
+    for index, record in enumerate(records):
+        stage = record["winning_stage"]
+        by_stage[stage] = by_stage.get(stage, 0) + 1
+        if stage not in firsts:
+            firsts[stage] = row(index, record)
+    tail = [
+        row(len(records) - len(records[-sample:]) + i, record)
+        for i, record in enumerate(records[-sample:])
+    ]
+    return {
+        "records": len(records),
+        "dropped": ledger.records_dropped,
+        "by_stage": by_stage,
+        "first_by_stage": firsts,
+        "why": tail,
+    }
+
+
 # -- cost / warm-pool replay (--simulate --cost) ------------------------------
 
 
@@ -1428,6 +1470,7 @@ def simulate_cost(  # lint: allow-complexity — scenario assembly: two replays 
     backend: str = "xla",
     default_hourly: float = 1.0,
     spot_multiplier: float = 0.35,
+    provenance: bool = False,
 ) -> dict:
     """Seeded cost/warm-pool replay (docs/cost.md "Dry-running"): the
     same scripted load — flat overnight base, a diurnal morning ramp,
@@ -1440,7 +1483,14 @@ def simulate_cost(  # lint: allow-complexity — scenario assembly: two replays 
     removes (capacity-coverage milestones) at equal-or-lower
     SLO-violation count, plus the karpenter_reconcile_e2e_seconds
     p50/p99 each world measured. Self-contained and mutation-free
-    toward any real cluster (own stores, fake lagged provider)."""
+    toward any real cluster (own stores, fake lagged provider).
+
+    `provenance=True` additionally records the decision ledger
+    (observability/provenance.py) through the warm-on world and renders
+    the WHY column: per recorded tick, the winning stage (reactive /
+    forecast_blend / cost_raise / cost_clamp / ...), the chosen count,
+    and the solver rung — the operator-facing answer `/debug/decisions`
+    serves on a live process."""
     import math as _math
 
     from karpenter_tpu.observability import reset_default_tracer
@@ -1460,9 +1510,16 @@ def simulate_cost(  # lint: allow-complexity — scenario assembly: two replays 
     initial = max(1, int(_math.ceil(base / target)))
 
     def replay(warm_on: bool) -> dict:
+        from karpenter_tpu.observability import reset_default_ledger
         from karpenter_tpu.runtime import Options
 
         reset_default_tracer()
+        # the WHY column rides the warm-on world only (one ledger, one
+        # narrative); provenance=False never touches the ledger, so the
+        # replay stays byte-identical to previous releases
+        record_why = provenance and warm_on
+        if provenance:
+            reset_default_ledger(enabled=record_why)
         clock = {"now": 1_000_000.0}
         runtime, provider, gid = _cost_world(
             warm_on, initial, target, provision_lag, horizon_s,
@@ -1508,7 +1565,7 @@ def simulate_cost(  # lint: allow-complexity — scenario assembly: two replays 
                 "n": hist.count("ScalableNodeGroup", "-"),
             }
             stats = runtime.solver_service.stats
-            return {
+            report = {
                 "provisioned": provisioned_trail,
                 "mean_hourly_cost": round(
                     float(np.mean(hourly_trail)), 4
@@ -1519,11 +1576,32 @@ def simulate_cost(  # lint: allow-complexity — scenario assembly: two replays 
                 "cost_dispatches": stats.cost_dispatches,
                 "provider_writes": len(provider.writes),
             }
+            if record_why:
+                report["provenance"] = _why_report(
+                    runtime.decision_ledger
+                )
+            return report
         finally:
             runtime.close()
 
-    on = replay(True)
-    off = replay(False)
+    # restore the process-default ledger even if a replay raises: an
+    # ENABLED default leaking out would turn on provenance for a
+    # co-resident runtime that never opted in (simulate_multitenant
+    # takes the same care)
+    saved_ledger = None
+    if provenance:
+        from karpenter_tpu.observability import (
+            default_ledger,
+            set_default_ledger,
+        )
+
+        saved_ledger = default_ledger()
+    try:
+        on = replay(True)
+        off = replay(False)
+    finally:
+        if saved_ledger is not None:
+            set_default_ledger(saved_ledger)
 
     # capacity-coverage milestones: how many ticks after demand reached
     # a level did PROVISIONED capacity cover it — the end-to-end
@@ -1733,7 +1811,7 @@ def multitenant_cost_inputs(decide_inputs, desired: np.ndarray):
     )
 
 
-def simulate_multitenant(
+def simulate_multitenant(  # lint: allow-complexity — scenario assembly: lockstep replay + provenance/trace exports + report
     tenants: int = 16,
     ticks: int = 12,
     rows: int = 4,
@@ -1741,6 +1819,8 @@ def simulate_multitenant(
     seed: int = 0,
     backend: str = "xla",
     tenant_config: Optional[str] = None,
+    provenance: bool = False,
+    trace_export: Optional[str] = None,
 ) -> dict:
     """Step N seeded tenant clusters in LOCKSTEP through one
     MultiTenantScheduler (docs/multitenancy.md): every tick, all
@@ -1749,8 +1829,24 @@ def simulate_multitenant(
     replicas, and the report quantifies the amortization — actual
     shared dispatches vs the 2-per-tenant-per-tick a sequential loop
     would pay — plus deterministic aggregate-replica digests the
-    regression tests pin. Self-contained: no store, no provider."""
+    regression tests pin. Self-contained: no store, no provider.
+
+    `provenance=True` records the decision ledger through the replay
+    and adds the per-tenant WHY view (winning stage, cost ladder,
+    solver rung, admission round) for a pinned mid-run tick — the
+    `--simulate --cost --multitenant --provenance` acceptance surface.
+    `trace_export=FILE` additionally mints one reconcile trace per tick
+    (so ledger records carry trace-id backlinks), exporting the trace
+    JSONL to FILE and the decision JSONL next to it
+    (provenance.decisions_export_path)."""
     from karpenter_tpu.metrics.registry import GaugeRegistry
+    from karpenter_tpu.observability import (
+        default_ledger,
+        default_tracer,
+        reset_default_ledger,
+        reset_default_tracer,
+        set_default_ledger,
+    )
     from karpenter_tpu.solver import SolverService
     from karpenter_tpu.tenancy import (
         MultiTenantScheduler,
@@ -1767,6 +1863,16 @@ def simulate_multitenant(
             TenantSpec(id=f"t{i:04d}", weight=1.0 + (i % 3))
             for i in range(tenants)
         ]
+    # the replay records into its OWN ledger and restores the process
+    # default afterwards: an enabled default leaking out would turn on
+    # provenance for a co-resident runtime that never opted in
+    saved_ledger = None
+    ledger = None
+    if provenance:
+        saved_ledger = default_ledger()
+        ledger = reset_default_ledger(enabled=True)
+    if trace_export:
+        reset_default_tracer()
     service = SolverService(backend=backend, registry=GaugeRegistry())
     registry = TenantRegistry(
         service=service, registry=GaugeRegistry(), specs=specs
@@ -1776,6 +1882,8 @@ def simulate_multitenant(
         spec.id: np.full(rows, 2, np.int32) for spec in specs
     }
     digests = {}
+    pinned_tick = ticks // 2
+    pinned_records: List[dict] = []
     try:
         for tick in range(ticks):
             now = 1_000_000.0 + tick * 10.0
@@ -1785,27 +1893,37 @@ def simulate_multitenant(
                 )
                 for i, spec in enumerate(specs)
             }
-            decided = scheduler.decide_all(batch)
-            cost_batch = {
-                tid: multitenant_cost_inputs(
-                    batch[tid], decided[tid].desired
-                )
-                for tid in decided
-            }
-            refined = scheduler.cost_all(cost_batch, backend=backend)
+            with default_tracer().trace(
+                "simulate.multitenant.tick", tick=tick
+            ):
+                decided = scheduler.decide_all(batch)
+                cost_batch = {
+                    tid: multitenant_cost_inputs(
+                        batch[tid], decided[tid].desired
+                    )
+                    for tid in decided
+                }
+                refined = scheduler.cost_all(cost_batch, backend=backend)
             for tid in refined:
                 replicas[tid] = np.asarray(refined[tid].desired, np.int32)
             if tick in (0, ticks // 2, ticks - 1):
                 digests[f"tick_{tick}"] = int(
                     sum(int(r.sum()) for r in replicas.values())
                 )
+            if ledger is not None and tick == pinned_tick:
+                # the tick's records are exactly the newest commit
+                pinned_records = ledger.query(kind="tenant")[
+                    -(len(refined) * rows):
+                ]
     finally:
         service.close()
+        if saved_ledger is not None:
+            set_default_ledger(saved_ledger)
     stats = scheduler.stats
     shared = stats.decide_dispatches + stats.cost_dispatches
     isolated = stats.isolated_dispatches
     sequential_equiv = tenants * ticks * 2
-    return {
+    report = {
         "tenants": tenants,
         "ticks": ticks,
         "rows_per_tenant": rows,
@@ -1827,3 +1945,36 @@ def simulate_multitenant(
             "dispatches": service.stats.dispatches,
         },
     }
+    if ledger is not None:
+        why = _why_report(ledger)
+        why["pinned_tick"] = pinned_tick
+        why["pinned"] = [
+            {
+                "tenant": r["tenant"],
+                "row": r["name"],
+                "why": r["winning_stage"],
+                "desired": r["final_desired"],
+                "base": r["base_desired"],
+                "risk": r["cost_risk"],
+                "hourly": r["cost_hourly"],
+                "rung": r["solver_rung"] or None,
+                "admission_round": r["admission_round"],
+                "trace": r["trace"] or None,
+            }
+            for r in pinned_records
+        ]
+        report["provenance"] = why
+    if trace_export:
+        report["trace_export"] = trace_export
+        report["trace_events"] = default_tracer().export_jsonl(
+            trace_export
+        )
+        if ledger is not None:
+            from karpenter_tpu.observability.provenance import (
+                export_next_to_trace,
+            )
+
+            path, count = export_next_to_trace(ledger, trace_export)
+            report["decisions_export"] = path
+            report["decision_records"] = count
+    return report

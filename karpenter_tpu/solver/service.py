@@ -257,6 +257,9 @@ class _Request:
     # LINKS it, and the FSM-trip flight-recorder event backlinks its
     # trace id. None with tracing disabled.
     span: Optional[object] = None
+    # tenant id the submitter stamped (multi-tenant scheduler), carried
+    # into the span args so /debug/traces?tenant= finds the request
+    tenant: Optional[str] = None
     _finish_lock: threading.Lock = field(
         default_factory=threading.Lock, repr=False
     )
@@ -656,9 +659,13 @@ class SolverService:
         buckets: int = DEFAULT_BUCKETS,
         backend: Optional[str] = None,
         timeout: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> SolveFuture:
         """Enqueue one solve; raises SolverSaturated when the bounded
-        queue is full (solve() turns that into the numpy fallback)."""
+        queue is full (solve() turns that into the numpy fallback).
+        `tenant` stamps the request's trace span (the multi-tenant
+        scheduler passes it, so /debug/traces?tenant= finds the
+        request — docs/multitenancy.md)."""
         if self._closed:
             raise RuntimeError("solver service is closed")
         n_pods = inputs.pod_requests.shape[0]
@@ -681,6 +688,7 @@ class SolverService:
             n_groups=n_groups,
             deadline=(now + timeout) if timeout else None,
             enqueued_at=now,
+            tenant=tenant,
         )
         self._enqueue_one(request)
         return SolveFuture(request, self)
@@ -695,6 +703,7 @@ class SolverService:
         )
         request.span = default_tracer().begin(
             "solver.request", family=family, backend=request.backend,
+            tenant=request.tenant,
         )
 
     def _record_rejected_span(self, key, backend: str) -> None:
@@ -1112,7 +1121,9 @@ class SolverService:
                 # the REQUESTED backend, not a degradation: the
                 # bit-identical mirror, no fallback counting
                 with default_tracer().span("solver.cost", backend="numpy"):
-                    return CK.cost_numpy(inputs)
+                    out = CK.cost_numpy(inputs)
+                self._annotate_provenance("numpy", "numpy")
+                return out
             if not self._device_allowed():
                 raise CostUnavailable(
                     "solver backend degraded; scaling cost-blind until "
@@ -1135,6 +1146,7 @@ class SolverService:
             self._record_device_success()
             self.stats.cost_dispatches += 1
             self._count_dispatch()
+            self._annotate_provenance(resolved, "device")
             return CK.CostOutputs(
                 desired=np.asarray(out.desired),
                 expected_hourly=np.asarray(out.expected_hourly),
@@ -1149,6 +1161,22 @@ class SolverService:
         finally:
             self._record_stage("cost", _time.perf_counter() - t0)
 
+    def _annotate_provenance(self, backend: str, rung: str) -> None:
+        """Provenance slice (observability/provenance.py): stamp the
+        backend + degradation rung that actually served onto the
+        CURRENT ledger batch — only for batches whose owner opted into
+        service-side stamping (autosolver: the BatchAutoscaler flow;
+        the MultiTenantScheduler stamps rungs per tenant slice itself).
+        One attribute read when the ledger is off."""
+        from karpenter_tpu.observability import default_ledger
+
+        ledger = default_ledger()
+        if not ledger.enabled:
+            return
+        batch = ledger.current()
+        if batch is not None and batch.autosolver:
+            batch.annotate(solver_backend=backend, solver_rung=rung)
+
     def decide(self, inputs):
         """The HPA decision kernel through the service: same metrics
         surface and error accounting, no coalescing (the batch
@@ -1158,7 +1186,16 @@ class SolverService:
         try:
             with default_tracer().span("solver.decide"):
                 with solver_trace("solver.decide"):
-                    return self._decide_fn()(inputs)
+                    out = self._decide_fn()(inputs)
+            # the decide kernel has no numpy mirror: it is served by
+            # the in-process jitted program ("device": XLA on whatever
+            # backend jax resolved) or across the gRPC split
+            self._annotate_provenance(
+                "grpc" if self.device_solver is not None else "xla",
+                "sidecar" if self.device_solver is not None
+                else "device",
+            )
+            return out
         except Exception:
             self.stats.decide_errors += 1
             raise

@@ -64,6 +64,17 @@ GATHER_POINT = "tenancy.gather."
 # reason the decide pass buckets its fleet — ops/decision.pad_to)
 ROW_BUCKET = 64
 
+# interned "row<i>" labels for ledger records (the tenant simulator's
+# rows are synthetic autoscalers): strings are minted once per process,
+# so a per-tick ledger batch allocates no new name objects
+_ROW_NAMES: list = []
+
+
+def _row_names(n: int) -> list:
+    while len(_ROW_NAMES) < n:
+        _ROW_NAMES.append(f"row{len(_ROW_NAMES)}")
+    return _ROW_NAMES[:n]
+
 
 @dataclass
 class TenancyStatistics:
@@ -125,6 +136,20 @@ class MultiTenantScheduler:
         self.stats = TenancyStatistics()
         self.metrics = registry.metrics
         registry.on_removed(self._forget)
+        # per-family serve log: tenant -> {"rung", "round", "deferred"},
+        # reset by decide_all/cost_all — feeds the provenance ledger's
+        # tenancy slice (observability/provenance.py)
+        self._serving: Dict[str, dict] = {}
+        # the in-flight ledger batch spanning decide_all -> cost_all
+        # (one tick's records commit once the cost pass has annotated
+        # its slice; a decide-only tick commits on the next decide or
+        # via flush_provenance). _ledger_owner pins the ledger that
+        # STAGED the batch, so a default-ledger swap between ticks
+        # (the bench/simulate save-restore pattern) cannot commit a
+        # batch into a ledger that never staged it.
+        self._ledger_batch = None
+        self._ledger_owner = None
+        self._ledger_slices: Dict[str, Tuple[int, int]] = {}
 
     def _forget(self, tenant: str) -> None:
         self.breakers.forget(tenant)
@@ -137,6 +162,8 @@ class MultiTenantScheduler:
         dispatches (grouped by `now`, admitted fairly, isolated per
         tenant) and scatter DecisionOutputs back per tenant."""
         self.stats.decide_calls += 1
+        self._serving = {}
+        self._ledger_begin(batch)
         results: Dict[str, D.DecisionOutputs] = {}
         by_now: Dict[float, Dict[str, D.DecisionInputs]] = {}
         for tenant, inputs in batch.items():
@@ -159,6 +186,7 @@ class MultiTenantScheduler:
                     fallback=decide_hold,
                 )
             )
+        self._ledger_after_decide(results)
         return results
 
     # -- cost --------------------------------------------------------------
@@ -170,11 +198,12 @@ class MultiTenantScheduler:
         from karpenter_tpu.ops import cost as CK
 
         self.stats.cost_calls += 1
+        self._serving = {}
 
         def dispatch(inputs):
             return self.service.cost(inputs, backend=backend)
 
-        return self._run_family(
+        results = self._run_family(
             batch,
             family="cost",
             rows_of=lambda i: int(np.asarray(i.base_desired).shape[0]),
@@ -185,6 +214,8 @@ class MultiTenantScheduler:
             mirror=CK.cost_numpy,
             fallback=cost_blind,
         )
+        self._ledger_after_cost(batch, results)
+        return results
 
     # -- forecast ----------------------------------------------------------
 
@@ -262,7 +293,7 @@ class MultiTenantScheduler:
             try:
                 futures.append((tenant, self.service.submit(
                     inputs, buckets=buckets, backend=backend,
-                    timeout=timeout,
+                    timeout=timeout, tenant=tenant,
                 )))
                 self.stats.solve_requests += 1
             except Exception as error:  # noqa: BLE001 — per-tenant isolation
@@ -280,6 +311,171 @@ class MultiTenantScheduler:
                 )
                 self._served_mirror(tenant)
         return results
+
+    # -- decision provenance (observability/provenance.py) -----------------
+
+    def flush_provenance(self) -> None:
+        """Commit a pending decide-only batch (a caller that never runs
+        a cost pass flushes before reading /debug/decisions or
+        exporting; the next decide_all flushes automatically)."""
+        if self._ledger_batch is not None:
+            self._ledger_owner.commit(self._ledger_batch)
+            self._ledger_batch = None
+            self._ledger_owner = None
+            self._ledger_slices = {}
+
+    def _ledger_begin(self, batch: Dict[str, object]) -> None:
+        """Open the tick's ledger batch: one record per tenant row,
+        labeled tenant/group=<tenant id> and name=row<i>. Spans
+        decide_all -> cost_all (the cost pass annotates its slice and
+        commits); a decide-only caller's batch commits on the next tick
+        (or flush_provenance) instead of leaking. No-op (one attribute
+        read) when the ledger is disabled."""
+        from karpenter_tpu.observability import default_ledger
+        from karpenter_tpu.observability.provenance import OBSERVED_WIDTH
+
+        # the previous tick never ran a cost pass: its records are
+        # complete as decided — commit (into the ledger that staged
+        # them) rather than drop
+        self.flush_provenance()
+        ledger = default_ledger()
+        if not ledger.enabled:
+            return
+        tenants = sorted(batch)
+        sizes = [
+            int(np.asarray(batch[t].spec_replicas).shape[0])
+            for t in tenants
+        ]
+        total = sum(sizes)
+        if not total:
+            return
+        tenant_col = np.empty(total, object)
+        name_col = np.empty(total, object)
+        observed = np.zeros((total, OBSERVED_WIDTH), np.float32)
+        observed_n = np.zeros(total, np.int16)
+        prev = np.zeros(total, np.int32)
+        slices: Dict[str, Tuple[int, int]] = {}
+        offset = 0
+        for tenant, size in zip(tenants, sizes):
+            stop = offset + size
+            slices[tenant] = (offset, stop)
+            tenant_col[offset:stop] = tenant
+            name_col[offset:stop] = _row_names(size)
+            values = np.asarray(batch[tenant].metric_value, np.float32)
+            m = min(values.shape[1], OBSERVED_WIDTH)
+            observed[offset:stop, :m] = values[:, :m]
+            observed_n[offset:stop] = values.shape[1]
+            prev[offset:stop] = np.asarray(
+                batch[tenant].spec_replicas, np.int32
+            )
+            offset = stop
+        self._ledger_batch = ledger.begin(
+            "tenant",
+            total,
+            tenant=tenant_col,
+            namespace="-",
+            name=name_col,
+            group=tenant_col.copy(),
+            observed=observed,
+            observed_n=observed_n,
+            prev_replicas=prev,
+        )
+        self._ledger_owner = ledger if self._ledger_batch else None
+        self._ledger_slices = slices
+        # the batch outlives this call (cost_all annotates later): own
+        # it on the scheduler, not the begin() thread's TLS slot
+        ledger.abort(self._ledger_batch)
+
+    def _ledger_after_decide(self, results: Dict[str, object]) -> None:
+        batch = self._ledger_batch
+        if batch is None:
+            return
+        for tenant, (start, stop) in self._ledger_slices.items():
+            out = results.get(tenant)
+            if out is None:
+                continue
+            desired = np.asarray(out.desired, np.int32)[: stop - start]
+            serve = self._serving.get(tenant, {})
+            batch.annotate_slice(
+                start, stop,
+                base_desired=desired,
+                final_desired=desired,
+                solver_rung=serve.get("rung", "device"),
+                solver_backend=serve.get("backend", ""),
+                admission_round=np.int16(serve.get("round", 0)),
+                deferred=bool(serve.get("deferred", False)),
+            )
+
+    def _ledger_after_cost(
+        self, inputs: Dict[str, object], results: Dict[str, object]
+    ) -> None:
+        """The cost pass annotates its slice and COMMITS the tick's
+        records. Tenants absent from the decide batch (cost-only
+        callers) are skipped; a cost serve from the mirror/floor
+        updates the rung — the refine stage is the one that computed
+        the final number."""
+        batch = self._ledger_batch
+        if batch is None:
+            return
+        for tenant, (start, stop) in self._ledger_slices.items():
+            out = results.get(tenant)
+            if out is None or tenant not in inputs:
+                continue  # decide-only tenant: its record stands as decided
+            size = stop - start
+            desired = np.asarray(out.desired, np.int32)[:size]
+            serve = self._serving.get(tenant, {})
+            rung = serve.get("rung")
+            columns = dict(
+                final_desired=desired,
+                slo_opted=np.asarray(
+                    inputs[tenant].slo_valid, bool
+                )[:size],
+                cost_candidate=desired,
+                cost_risk=np.asarray(
+                    out.violation_risk, np.float32
+                )[:size],
+                cost_hourly=np.asarray(
+                    out.expected_hourly, np.float32
+                )[:size],
+                budget_clamped=np.asarray(
+                    out.cost_limited, bool
+                )[:size],
+                cost_blind=bool(rung == "floor"),
+            )
+            if rung:
+                columns["solver_rung"] = rung
+            batch.annotate_slice(start, stop, **columns)
+        # commit into the ledger that STAGED the batch — the process
+        # default may have been swapped since decide_all
+        owner = self._ledger_owner
+        self._ledger_batch = None
+        self._ledger_owner = None
+        self._ledger_slices = {}
+        owner.commit(batch)
+
+    def _record_serve(
+        self, tenant: str, rung: str, round_index: int = 0,
+        deferred: bool = False, backend: str = "",
+    ) -> None:
+        self._serving[tenant] = {
+            "rung": rung,
+            "round": round_index,
+            "deferred": deferred,
+            "backend": backend,
+        }
+        if rung != "device":
+            # tenant-stamped marker span for every off-the-shared-batch
+            # serve (isolated / mirror / floor): /debug/traces?tenant=
+            # surfaces exactly which ticks degraded this tenant and how
+            # — bounded by degraded tenants, so the healthy 1k-tenant
+            # shared round stays span-free
+            from karpenter_tpu.observability import default_tracer
+
+            span = default_tracer().begin(
+                "tenancy.serve", tenant=tenant, rung=rung,
+            )
+            if span is not None:
+                span.close()
 
     # -- the shared fan-in/fan-out machinery -------------------------------
 
@@ -311,6 +507,20 @@ class MultiTenantScheduler:
                 "serving its rows from the mirror while others stay on "
                 "device",
                 tenant, type(error).__name__, error,
+            )
+            # flight-recorder event with the tenant FIELD, so
+            # /debug/flightrecorder?tenant=<id> surfaces exactly this
+            # tenant's degradations (docs/observability.md); NOT a
+            # dump-class kind — one sick tenant in a 1k-tenant fleet is
+            # supervised degradation, not a control-plane incident
+            from karpenter_tpu.observability import (
+                default_flight_recorder,
+            )
+
+            default_flight_recorder().record(
+                "tenant_breaker_trip",
+                tenant=tenant,
+                error=f"{type(error).__name__}: {error}"[:200],
             )
         if self.metrics.enabled:
             if tripped:
@@ -383,7 +593,7 @@ class MultiTenantScheduler:
                     results, family=family, concat=concat,
                     dispatch=dispatch, scatter=scatter,
                     isolated=isolated, mirror=mirror, fallback=fallback,
-                    rows_of=rows_of,
+                    rows_of=rows_of, round_index=round_index,
                 )
         if family == "decide" and self.metrics.enabled:
             # karpenter_tenant_decisions_total counts DECIDE rows only
@@ -421,6 +631,7 @@ class MultiTenantScheduler:
             self.stats.isolated_dispatches += 1
             out = isolated(inputs)
             self._tenant_ok(tenant)
+            self._record_serve(tenant, "isolated")
             return out
         except Exception as error:  # noqa: BLE001 — tenant isolation
             self._tenant_failed(tenant, error)
@@ -438,7 +649,9 @@ class MultiTenantScheduler:
         if mirror is None:
             try:
                 self.stats.isolated_dispatches += 1
-                return isolated(inputs)
+                out = isolated(inputs)
+                self._record_serve(tenant, "isolated")
+                return out
             except Exception as error:  # noqa: BLE001 — tenant isolation
                 self._tenant_failed(tenant, error)
             return self._served_fallback(tenant, fallback, inputs)
@@ -449,6 +662,7 @@ class MultiTenantScheduler:
             try:
                 out = mirror(inputs)
                 self._served_mirror(tenant)
+                self._record_serve(tenant, "mirror", backend="numpy")
                 return out
             except Exception as error:  # noqa: BLE001 — tenant isolation
                 self._tenant_failed(tenant, error)
@@ -462,11 +676,12 @@ class MultiTenantScheduler:
         self.stats.fallback_served += 1
         if self.metrics.enabled:
             self.metrics.fallback.inc(tenant, "-")
+        self._record_serve(tenant, "floor")
         return fallback(inputs)
 
     def _dispatch_round(  # lint: allow-complexity — shared dispatch + per-tenant fallback ladder, one arm per rung
         self, admitted, results, *, family, concat, dispatch, scatter,
-        isolated, mirror, fallback, rows_of,
+        isolated, mirror, fallback, rows_of, round_index: int = 0,
     ) -> None:
         tenants = sorted(admitted)
         if len(tenants) == 1:
@@ -477,6 +692,10 @@ class MultiTenantScheduler:
                 self.stats.isolated_dispatches += 1
                 results[tenant] = isolated(admitted[tenant])
                 self._tenant_ok(tenant)
+                self._record_serve(
+                    tenant, "isolated", round_index,
+                    deferred=round_index > 0,
+                )
             except Exception as error:  # noqa: BLE001 — tenant isolation
                 self._tenant_failed(tenant, error)
                 results[tenant] = self._serve_degraded(
@@ -512,6 +731,10 @@ class MultiTenantScheduler:
             results[tenant] = scatter(out, offset, offset + size)
             offset += size
             self._tenant_ok(tenant)
+            self._record_serve(
+                tenant, "device", round_index,
+                deferred=round_index > 0,
+            )
         if self.metrics.enabled:
             self.metrics.dispatches.inc("-", "-")
 

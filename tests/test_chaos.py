@@ -723,3 +723,113 @@ class TestCostChaos:
         finally:
             faults.uninstall()
             runtime.close()
+
+
+class TestSelfSLOChaos:
+    """ISSUE 12 acceptance: a seeded chaos run at 100% solver faults
+    drives the self-SLO fast-burn window over threshold, emits the
+    `selfslo_burn` flight-recorder dump (trip-class machinery,
+    observability/selfslo.py), and RECOVERS budget after faults clear —
+    the control plane detecting its own degradation, not a human."""
+
+    def test_fast_burn_trips_dumps_and_recovers(self, tmp_path):
+        from karpenter_tpu.observability import (
+            default_flight_recorder,
+            reset_default_flight_recorder,
+            set_default_flight_recorder,
+        )
+
+        saved_recorder = default_flight_recorder()
+        reset_default_flight_recorder()
+        clock = FakeClock()
+        # plain FakeFactory: --journal-dir fences actuations with a
+        # token, which the recording subclass's narrower signature
+        # doesn't carry (actuation accounting isn't this scenario's
+        # concern)
+        provider = FakeFactory()
+        provider.node_replicas["g"] = 5
+        runtime = KarpenterRuntime(
+            Options(
+                solver_health_threshold=2,
+                solver_probe_interval_s=0.0,
+                journal_dir=str(tmp_path / "journal"),
+            ),
+            cloud_provider_factory=provider,
+            clock=clock,
+        )
+        runtime.solver_service.backend = "xla"
+        runtime.registry.register("queue", "length").set(
+            "q", "default", 41.0
+        )
+        runtime.store.create(sng_of("g", replicas=5))
+        runtime.store.create(
+            queue_ha("g", 'karpenter_queue_length{name="q"}')
+        )
+        pending_capacity_world(runtime.store)
+        monitor = runtime.selfslo
+        service = runtime.solver_service
+
+        def tick(n):
+            # cluster churn (TestChaosScenario.tick): a toggling pod
+            # defeats the encode memo so EVERY tick drives a real solve
+            # through the service — the surface the faults poison
+            for _ in range(n):
+                try:
+                    runtime.store.delete("Pod", "default", "churn-pod")
+                except KeyError:
+                    runtime.store.create(Pod(
+                        metadata=ObjectMeta(name="churn-pod"),
+                        spec=PodSpec(),
+                    ))
+                clock.advance(10.0)
+                runtime.manager.reconcile_all()
+
+        try:
+            # healthy warm-up: the budget starts full
+            tick(10)
+            assert not monitor.tripped
+            assert runtime.registry.gauge(
+                "selfslo", "budget_remaining"
+            ).get("5m", "-") == 1.0
+
+            registry = faults.install(FaultRegistry(seed=CHAOS_SEED))
+            registry.plan("solver.dispatch", probability=1.0)
+            tick(40)
+            assert service.backend_health() == "degraded"
+            assert monitor.tripped, (
+                "100% solver faults must drive the fast-burn pair "
+                "over threshold"
+            )
+            fast = monitor._last_eval["windows"]["5m"]
+            assert fast["burn_rate"] > 14.4
+            assert fast["budget_remaining"] < 1.0
+            burns = [
+                e for e in runtime.flight_recorder.events()
+                if e["kind"] == "selfslo_burn"
+            ]
+            assert len(burns) == 1, "one incident, one burn event"
+            dumps = [
+                p.name for p in (tmp_path / "journal").iterdir()
+                if p.name.startswith("flightrecorder-")
+                and "selfslo_burn" in p.name
+            ]
+            assert dumps, (
+                "the selfslo_burn trip must auto-dump the ring into "
+                "--journal-dir"
+            )
+
+            faults.uninstall()  # ---- faults clear ----
+            tick(60)
+            assert service.backend_health() == "healthy"
+            assert not monitor.tripped, "the trip must re-arm"
+            recovered = monitor._last_eval["windows"]["5m"]
+            assert recovered["burn_rate"] < 14.4
+            assert recovered["budget_remaining"] > 0.9, (
+                "budget must RECOVER once bad events age out of the "
+                "sliding window"
+            )
+            assert monitor.trips_total == 1
+        finally:
+            faults.uninstall()
+            runtime.close()
+            set_default_flight_recorder(saved_recorder)

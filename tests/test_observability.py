@@ -897,3 +897,120 @@ class TestTracingOverheadGuard:
             f"tracing overhead p50 {off * 1e3:.3f}ms -> "
             f"{on * 1e3:.3f}ms"
         )
+
+
+class TestTenantDebugFilters:
+    """Satellite (ISSUE 12): per-tenant filtering on the existing debug
+    surfaces — /debug/traces?tenant= keeps whole traces that touched
+    the tenant (tenant-stamped solver-request / tenancy-serve spans),
+    /debug/flightrecorder?tenant= keeps that tenant's events."""
+
+    def test_traces_tenant_filter_keeps_whole_traces(
+        self, fresh_tracer, fresh_recorder
+    ):
+        with fresh_tracer.trace("tick-a"):
+            with fresh_tracer.span("solver.request", tenant="t1"):
+                pass
+            with fresh_tracer.span("actuate"):
+                pass
+        with fresh_tracer.trace("tick-b"):
+            with fresh_tracer.span("solver.request", tenant="t2"):
+                pass
+        registry = GaugeRegistry()
+        server = MetricsServer(registry, port=0, host="127.0.0.1")
+        port = server.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            _status, _ctype, body = _get(f"{base}/debug/traces?tenant=t1")
+            spans = json.loads(body)["spans"]
+            names = sorted(s["name"] for s in spans)
+            # the WHOLE trace that touched t1 — including its untagged
+            # actuation span and root — but nothing of tick-b
+            assert names == ["actuate", "solver.request", "tick-a"]
+            _status, _c, body = _get(f"{base}/debug/traces?tenant=nope")
+            assert json.loads(body)["spans"] == []
+            # limit applies AFTER the filter
+            _status, _c, body = _get(
+                f"{base}/debug/traces?tenant=t1&limit=1"
+            )
+            assert len(json.loads(body)["spans"]) == 1
+        finally:
+            server.stop()
+
+    def test_flightrecorder_tenant_filter(self, fresh_recorder):
+        fresh_recorder.record(
+            "tenant_breaker_trip", tenant="t1", error="boom"
+        )
+        fresh_recorder.record(
+            "tenant_breaker_trip", tenant="t2", error="boom"
+        )
+        fresh_recorder.record("fsm_trip", subsystem="solver")
+        server = MetricsServer(GaugeRegistry(), port=0, host="127.0.0.1")
+        port = server.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            _s, _c, body = _get(
+                f"{base}/debug/flightrecorder?tenant=t1"
+            )
+            events = json.loads(body)["events"]
+            assert len(events) == 1
+            assert events[0]["tenant"] == "t1"
+            _s, _c, body = _get(
+                f"{base}/debug/flightrecorder"
+                f"?kind=tenant_breaker_trip&tenant=t2"
+            )
+            events = json.loads(body)["events"]
+            assert [e["tenant"] for e in events] == ["t2"]
+        finally:
+            server.stop()
+
+    def test_scheduler_breaker_trip_records_tenant_event(
+        self, fresh_recorder
+    ):
+        """The tenancy board's breaker trips land in the flight
+        recorder WITH the tenant field the filter keys on."""
+        from karpenter_tpu.metrics.registry import (
+            GaugeRegistry as Registry,
+        )
+        from karpenter_tpu.solver import SolverService
+        from karpenter_tpu.tenancy import (
+            MultiTenantScheduler,
+            TenantRegistry,
+            TenantSpec,
+        )
+
+        service = SolverService(registry=Registry())
+        registry = TenantRegistry(
+            service=service, registry=Registry(),
+            specs=[TenantSpec(id="bad"), TenantSpec(id="good")],
+        )
+        scheduler = MultiTenantScheduler(
+            registry, service, breaker_threshold=1
+        )
+        try:
+            from karpenter_tpu import faults
+            from karpenter_tpu.faults import FaultRegistry
+            from karpenter_tpu.simulate import (
+                multitenant_fleet_inputs,
+            )
+
+            fault_registry = faults.install(FaultRegistry(seed=7))
+            fault_registry.plan(
+                "tenancy.gather.bad", probability=1.0
+            )
+            batch = {
+                tenant: multitenant_fleet_inputs(
+                    i, 2, 1, 0, 0,
+                    __import__("numpy").full(2, 2, "int32"), 1e6,
+                )
+                for i, tenant in enumerate(("bad", "good"))
+            }
+            scheduler.decide_all(batch)
+            trips = [
+                e for e in fresh_recorder.events()
+                if e["kind"] == "tenant_breaker_trip"
+            ]
+            assert trips and trips[0]["tenant"] == "bad"
+        finally:
+            faults.uninstall()
+            service.close()

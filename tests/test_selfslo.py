@@ -1,0 +1,263 @@
+"""Control-plane self-SLO monitor (observability/selfslo.py).
+
+The acceptance pins (ISSUE 12 / docs/observability.md "Self-SLO
+monitoring"):
+
+  * multi-window burn rates over karpenter_reconcile_e2e_seconds
+    (via HistogramVec.le_totals) + solver FSM + tenant breakers;
+  * karpenter_selfslo_{burn_rate,budget_remaining,
+    window_violations_total} publish per window, tripped 0/1;
+  * a fast-burn trip records ONE selfslo_burn flight-recorder event per
+    incident (trip-class: auto-dump), with hysteresis and budget
+    recovery once bad events age out of the sliding windows;
+  * /debug/selfslo serves the per-tenant degradation scoreboard;
+  * the runtime evaluates once per manager tick (tick-hook wiring).
+
+The 100%-solver-fault chaos acceptance lives in tests/test_chaos.py
+(TestSelfSLOChaos) so it rides `make test-chaos`.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.metrics.registry import GaugeRegistry
+from karpenter_tpu.observability import MetricsServer, SelfSLOMonitor
+from karpenter_tpu.observability.flightrecorder import FlightRecorder
+from karpenter_tpu.observability.selfslo import BurnWindow
+
+
+class FakeClock:
+    def __init__(self, start=1_000_000.0):
+        self.now = start
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, seconds):
+        self.now += seconds
+
+
+def _hist(registry=None):
+    registry = registry or GaugeRegistry()
+    return registry.register(
+        "reconcile", "e2e_seconds", kind="histogram",
+        buckets=(0.1, 1.0, 10.0),
+    )
+
+
+class TestLeTotals:
+    def test_counts_at_or_below_bound_across_series(self):
+        hist = _hist()
+        hist.observe("a", "-", 0.05)
+        hist.observe("a", "-", 0.5)
+        hist.observe("b", "-", 5.0)
+        hist.observe("b", "-", 50.0)
+        assert hist.le_totals(1.0) == (2, 4)
+        assert hist.le_totals(10.0) == (3, 4)
+        # an off-ladder bound counts conservatively (only whole buckets
+        # at or below it): samples between the rung and the bound are
+        # BAD, never silently good
+        assert hist.le_totals(0.5) == (1, 4)
+
+
+class TestBurnMath:
+    def _monitor(self, **kw):
+        clock = FakeClock()
+        registry = GaugeRegistry()
+        hist = _hist(registry)
+        monitor = SelfSLOMonitor(
+            registry=registry, objective_s=1.0, target=0.99,
+            clock=clock, histogram=hist,
+            recorder=FlightRecorder(), **kw,
+        )
+        return monitor, hist, clock, registry
+
+    def test_healthy_stream_burns_nothing(self):
+        monitor, hist, clock, registry = self._monitor()
+        for _ in range(20):
+            hist.observe("SNG", "-", 0.05)
+            monitor.evaluate()
+            clock.advance(10.0)
+        windows = monitor._last_eval["windows"]
+        assert windows["5m"]["burn_rate"] == 0.0
+        assert windows["5m"]["budget_remaining"] == 1.0
+        assert not monitor.tripped
+        assert registry.gauge("selfslo", "burn_rate").get(
+            "5m", "-"
+        ) == 0.0
+        assert registry.gauge("selfslo", "tripped").get(
+            "-", "-"
+        ) == 0.0
+
+    def test_all_bad_stream_burns_and_publishes(self):
+        monitor, hist, clock, registry = self._monitor()
+        for _ in range(20):
+            hist.observe("SNG", "-", 5.0)  # over the 1s objective
+            monitor.evaluate()
+            clock.advance(10.0)
+        windows = monitor._last_eval["windows"]
+        # ratio 1.0 over a 1% error budget = burn 100x
+        assert windows["5m"]["burn_rate"] == pytest.approx(100.0)
+        assert windows["5m"]["budget_remaining"] == 0.0
+        assert registry.gauge(
+            "selfslo", "window_violations_total"
+        ).get("5m", "-") >= 1.0
+
+    def test_fsm_and_tenant_sources_feed_bad_events(self):
+        fsm = {"state": "degraded"}
+        tenants = {"t1": True, "t2": False}
+        monitor, hist, clock, _ = self._monitor(
+            fsm_source=lambda: fsm["state"],
+            tenant_source=lambda: tenants,
+        )
+        for _ in range(5):
+            monitor.evaluate()  # no e2e samples at all
+            clock.advance(10.0)
+        windows = monitor._last_eval["windows"]
+        # per evaluation: fsm bad + t1 bad + t2 good = 2 bad / 3 total
+        assert windows["5m"]["bad"] == 10
+        assert windows["5m"]["total"] == 15
+        assert windows["5m"]["burn_rate"] > 14.4
+
+    def test_source_failures_never_raise(self):
+        def broken():
+            raise RuntimeError("source down")
+
+        monitor, hist, clock, _ = self._monitor(
+            fsm_source=broken, tenant_source=broken
+        )
+        result = monitor.evaluate()
+        assert result["windows"]["5m"]["total"] == 0
+        board = monitor.scoreboard()
+        assert board["solver_backend"] == "unknown"
+        assert board["tenants"] == {}
+
+
+class TestTripLifecycle:
+    def test_trip_dump_hysteresis_and_recovery(self, tmp_path):
+        clock = FakeClock()
+        registry = GaugeRegistry()
+        hist = _hist(registry)
+        recorder = FlightRecorder(dump_dir=str(tmp_path))
+        fsm = {"state": "healthy"}
+        monitor = SelfSLOMonitor(
+            registry=registry, objective_s=1.0, target=0.99,
+            clock=clock, histogram=hist,
+            fsm_source=lambda: fsm["state"], recorder=recorder,
+        )
+        for _ in range(30):
+            hist.observe("SNG", "-", 0.05)
+            monitor.evaluate()
+            clock.advance(10.0)
+        assert not monitor.tripped
+        fsm["state"] = "degraded"
+        for _ in range(40):
+            monitor.evaluate()
+            clock.advance(10.0)
+        assert monitor.tripped
+        assert monitor.trips_total == 1
+        burns = [
+            e for e in recorder.events() if e["kind"] == "selfslo_burn"
+        ]
+        assert len(burns) == 1, "one incident, one burn event"
+        assert burns[0]["burn_fast"] > 14.4
+        # trip-class kind: the ring auto-dumped crash-safely
+        dumps = [
+            p.name for p in tmp_path.iterdir()
+            if "selfslo_burn" in p.name
+        ]
+        assert dumps, "selfslo_burn must auto-dump the ring"
+        assert registry.gauge("selfslo", "tripped").get(
+            "-", "-"
+        ) == 1.0
+        # faults clear: the fast window slides clean, budget recovers,
+        # the trip re-arms — and NO second event fired meanwhile
+        fsm["state"] = "healthy"
+        for _ in range(60):
+            hist.observe("SNG", "-", 0.05)
+            monitor.evaluate()
+            clock.advance(10.0)
+        assert not monitor.tripped
+        windows = monitor._last_eval["windows"]
+        assert windows["5m"]["burn_rate"] == 0.0
+        assert windows["5m"]["budget_remaining"] == 1.0
+        assert monitor.trips_total == 1
+
+    def test_custom_windows_and_bad_target_rejected(self):
+        with pytest.raises(ValueError):
+            SelfSLOMonitor(target=1.5)
+        monitor = SelfSLOMonitor(
+            windows=(
+                BurnWindow("1m", 60.0, 2.0),
+                BurnWindow("10m", 600.0, 2.0),
+            ),
+            clock=FakeClock(),
+        )
+        assert monitor.evaluate()["windows"].keys() == {"1m", "10m"}
+
+
+class TestScoreboardEndpoint:
+    def test_debug_selfslo_serves_scoreboard(self):
+        clock = FakeClock()
+        tenants = {"alpha": True, "beta": False}
+        monitor = SelfSLOMonitor(
+            clock=clock,
+            fsm_source=lambda: "healthy",
+            tenant_source=lambda: tenants,
+            recorder=FlightRecorder(),
+        )
+        monitor.evaluate()
+        server = MetricsServer(
+            GaugeRegistry(), port=0, host="127.0.0.1", selfslo=monitor
+        )
+        port = server.start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/selfslo", timeout=5
+            ) as resp:
+                body = json.loads(resp.read())
+            assert body["enabled"] is True
+            assert body["solver_backend"] == "healthy"
+            assert body["tenants"]["alpha"]["breaker_open"] is True
+            assert body["tenants"]["beta"]["degraded"] is False
+            assert "5m" in body["windows"]
+        finally:
+            server.stop()
+
+    def test_debug_selfslo_without_monitor(self):
+        server = MetricsServer(GaugeRegistry(), port=0, host="127.0.0.1")
+        port = server.start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/selfslo", timeout=5
+            ) as resp:
+                assert json.loads(resp.read()) == {"enabled": False}
+        finally:
+            server.stop()
+
+
+class TestRuntimeWiring:
+    def test_manager_tick_evaluates_monitor(self):
+        from karpenter_tpu.cloudprovider.fake import FakeFactory
+        from karpenter_tpu.runtime import KarpenterRuntime, Options
+
+        runtime = KarpenterRuntime(
+            Options(selfslo_objective_s=2.5, selfslo_target=0.95),
+            cloud_provider_factory=FakeFactory(),
+        )
+        try:
+            assert runtime.selfslo.objective_s == 2.5
+            assert runtime.selfslo.target == 0.95
+            runtime.manager.reconcile_all()
+            runtime.manager.reconcile_all()
+            # evaluated per tick: gauges live in THIS registry
+            assert runtime.registry.gauge("selfslo", "burn_rate").get(
+                "5m", "-"
+            ) is not None
+            board = runtime.selfslo.scoreboard()
+            assert board["solver_backend"] == "healthy"
+            assert board["at"] is not None
+        finally:
+            runtime.close()
