@@ -98,6 +98,11 @@ bench-provenance: ## Decision-provenance ledger overhead on the reconcile hot pa
 	$(PYTHON) bench.py --provenance --provenance-ticks 200 \
 		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
 
+bench-resident: ## Device-resident fleet state: churn-tick solve with resident scatter ON vs full re-upload OFF over one watch-fed world (shipped + forced-scatter arms, unchanged-tick column, parity pinned every tick); appends a BENCHMARKS row + publishes to BASELINE.json
+	$(PYTHON) bench.py --resident --pods 10000 --types 50 \
+		--backend xla --resident-ticks 60 \
+		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
+
 bench-shard: ## Sharded fleet-scale solve (1M pods x 1k types through the SolverService seam on an 8-device mesh, 1/2/4/8 scaling + parity pins); appends a BENCHMARKS row + publishes to BASELINE.json
 	$(PYTHON) bench.py --shard --pods 1000000 --types 1000 \
 		--backend xla --iters 3 --shard-scaling 1,2,4,8 \
@@ -147,5 +152,5 @@ kind-smoke: ## Deploy smoke on kind: image -> apply -> pod Ready -> one HA end t
 .PHONY: help dev ci test test-chaos test-recovery battletest verify codegen \
 	docs native bench bench-solver bench-hotpath bench-consolidate \
 	bench-forecast bench-preempt bench-cost bench-journal bench-trace \
-	bench-provenance bench-shard bench-multitenant dryrun \
+	bench-provenance bench-resident bench-shard bench-multitenant dryrun \
 	image publish apply delete kind-load conformance kind-smoke

@@ -236,6 +236,15 @@ def parse_args(argv=None) -> argparse.Namespace:
         "'8x1' (default: pods-major factorization of the device count)",
     )
     parser.add_argument(
+        "--no-resident",
+        action="store_true",
+        help="disable the device-resident fleet state (docs/"
+        "solver-service.md 'Device-resident fleet state'): every solve "
+        "dispatch re-uploads its full operand stack instead of serving "
+        "resident buffers with scatter updates; outputs are "
+        "bit-identical either way",
+    )
+    parser.add_argument(
         "--consolidate",
         action="store_true",
         help="enable the consolidation engine (batched node-drain "
@@ -328,6 +337,18 @@ def parse_args(argv=None) -> argparse.Namespace:
         "(docs/multitenancy.md): per-tenant namespaced stacks over one "
         "shared solver service; omit for the single-tenant wiring "
         "(byte-identical to previous releases)",
+    )
+    parser.add_argument(
+        "--tenant-deadline",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="tenant-weighted solve deadline (docs/multitenancy.md): "
+        "bounds a deferred tenant's wait behind earlier admission "
+        "rounds — the budget is SECONDS x weight / mean weight, and an "
+        "exhausted budget serves the tenant immediately from the "
+        "bit-identical mirror (counted in "
+        "karpenter_tenant_deferrals_total); omit for unbounded waits",
     )
     parser.add_argument(
         "--multitenant",
@@ -778,12 +799,14 @@ def main(argv=None) -> int:
             solver_shard_threshold=args.shard_threshold,
             solver_shard_devices=args.shard_devices,
             solver_shard_mesh=_parse_mesh_shape(args.shard_mesh),
+            solver_resident=not args.no_resident,
             forecast_history=args.forecast_history,
             stale_metric_max_age_s=args.stale_metric_max_age,
             cost_default_hourly=args.cost_default_hourly,
             cost_spot_multiplier=args.cost_spot_multiplier,
             pricing_file=args.pricing_file,
             tenant_config=args.tenant_config,
+            tenant_deadline_s=args.tenant_deadline,
             tenant_id=args.tenant_id,
             provenance=args.provenance,
             selfslo_objective_s=args.selfslo_objective,

@@ -5,7 +5,9 @@ states is pinned by the oracle suites."""
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+import threading
+import weakref
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -485,6 +487,75 @@ def _encode_full(snap, profiles, with_rows: bool = False, census=None):
 # -- incremental (delta) encoding --------------------------------------------
 
 
+class ResidentPlan:
+    """The changed-row map between two consecutive delta encodes — what
+    the device-resident fleet state (solver/resident.py) scatters
+    instead of re-uploading the full operand stack.
+
+    `prev` is the PREVIOUS tick's BinPackInputs (held strongly: the
+    plan is only useful while a resident buffer keyed on that identity
+    exists); `rows` are the positions whose spliced operand rows
+    (requests/valid/required/intolerant) differ from prev's, and
+    `weight_rows` the positions whose dedup multiplicity moved (a
+    scaled Deployment changes weights without changing any key). Both
+    are exact: a row is listed iff its bytes changed, so scattering
+    exactly these rows reproduces a cold full upload bit for bit."""
+
+    __slots__ = ("prev", "rows", "weight_rows")
+
+    def __init__(self, prev, rows, weight_rows):
+        self.prev = prev
+        self.rows = np.asarray(rows, np.int32)
+        self.weight_rows = np.asarray(weight_rows, np.int32)
+
+
+# id(inputs) -> (weakref-to-inputs, ResidentPlan), written by every
+# SnapshotDeltaCache instance and read by ResidentFleetState.
+# BinPackInputs is an eq-dataclass (unhashable), so the registry keys
+# on id() with a weakref guard: the stored ref must still resolve to
+# the SAME object, and a finalizer removes the entry on GC so a reused
+# id can never alias a dead plan. Registering a successor plan drops
+# the predecessor's entry, so prev-chains never grow past one hop.
+_plan_registry: Dict[int, tuple] = {}
+# RLock, not Lock: the GC can run a plan finalizer (_drop_plan) on
+# whatever thread triggered collection — including one that is already
+# inside _register_plan holding this lock
+_plan_lock = threading.RLock()
+
+
+def resident_plan(inputs) -> Optional["ResidentPlan"]:
+    """The changed-row plan for a delta-encoded inputs object, or None
+    (cold/full encode, or a non-delta caller)."""
+    with _plan_lock:
+        entry = _plan_registry.get(id(inputs))
+        if entry is None or entry[0]() is not inputs:
+            return None
+        return entry[1]
+
+
+def _drop_plan(key: int) -> None:
+    with _plan_lock:
+        _plan_registry.pop(key, None)
+
+
+def _register_plan(inputs, plan: "ResidentPlan") -> None:
+    with _plan_lock:
+        _plan_registry[id(inputs)] = (weakref.ref(inputs), plan)
+        # cap the identity chain: the predecessor's own plan (if any)
+        # is unreachable through a resident entry once this successor
+        # exists
+        _plan_registry.pop(id(plan.prev), None)
+    weakref.finalize(inputs, _drop_plan, id(inputs))
+
+
+def reset_resident_plans() -> None:
+    """Recovery-boot seam companion to SnapshotDeltaCache.reset: a plan
+    computed against pre-reset state must not splice into post-reset
+    resident buffers."""
+    with _plan_lock:
+        _plan_registry.clear()
+
+
 class _DeltaEntry:
     """One cached encode per (group-set, universe) key: the canonical
     sorted dedup keys, their row positions, the operand arrays those
@@ -573,9 +644,13 @@ class SnapshotDeltaCache:
         SAME BinPackInputs OBJECT for an unchanged dedup set — an
         identity contract downstream device-residency caches key on —
         so after a crash-recovery boot the pre-crash entries must not be
-        splice sources: the next encode of each key is a full pass."""
+        splice sources: the next encode of each key is a full pass.
+        Resident scatter plans (the device-residency companion) drop
+        with the entries — a plan against pre-reset state must never
+        splice into post-reset device buffers."""
         with self._lock:
             self._entries.clear()
+        reset_resident_plans()
 
     def encode(self, snap, profiles, with_rows: bool = False, census=None):
         if (
@@ -691,9 +766,17 @@ class SnapshotDeltaCache:
     def _apply_delta(self, entry, snap, row_idx, row_weight, keys, n_pods):
         """Row-level splice: copy rows whose canonical key survived from
         the cached arrays, gather only the fresh rows through the normal
-        _pod_arrays path, and reuse the group arrays untouched."""
+        _pod_arrays path, and reuse the group arrays untouched.
+
+        Also publishes the ResidentPlan for the new inputs: a row is
+        CHANGED unless its key matched AT THE SAME POSITION (same key
+        elsewhere means the byte-sorted order moved — the resident
+        buffer's row at that position holds different bytes either
+        way), and weight rows are diffed value-wise since multiplicity
+        is not part of the key."""
         hi = len(row_idx)
         matched_new, matched_old, fresh_new = [], [], []
+        in_place = []
         for i, key in enumerate(keys):
             j = entry.pos.get(key)
             if j is None:
@@ -701,6 +784,8 @@ class SnapshotDeltaCache:
             else:
                 matched_new.append(i)
                 matched_old.append(j)
+                if j == i:
+                    in_place.append(i)
 
         pod_requests = np.zeros((n_pods, entry.n_resources), np.float32)
         pod_valid = np.zeros(n_pods, bool)
@@ -752,6 +837,26 @@ class SnapshotDeltaCache:
             # (_live_constraints).
             group_tier=old.group_tier,
         )
+        if n_pods == entry.n_pods:
+            # the device-resident scatter plan (solver/resident.py):
+            # only meaningful when the padded extent held — a bucket
+            # crossing rebuilds the resident stack anyway
+            hi_old = len(entry.keys)
+            span = max(hi, hi_old)
+            changed = np.ones(span, bool)
+            if in_place:
+                changed[np.asarray(in_place, np.intp)] = False
+            w_new = np.zeros(span, np.int32)
+            w_new[:hi] = row_weight
+            w_old = np.asarray(old.pod_weight[:span], np.int32)
+            _register_plan(
+                inputs,
+                ResidentPlan(
+                    prev=old,
+                    rows=np.nonzero(changed)[0],
+                    weight_rows=np.nonzero(w_new != w_old)[0],
+                ),
+            )
         return entry.successor(keys, row_weight, n_pods, inputs)
 
 

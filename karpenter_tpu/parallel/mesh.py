@@ -209,6 +209,55 @@ def stacked_binpack_shardings(
     )
 
 
+def forecast_shardings(mesh: Mesh):
+    """ForecastInputs-shaped pytree of NamedShardings: the SERIES axis
+    S rides the mesh rows (every series' recurrence is independent —
+    the scans run over the replicated T axis per series, so the sharded
+    program is bitwise equal to the single-device one; the forecast
+    parity contract carries over unchanged)."""
+    from karpenter_tpu.forecast.models import ForecastInputs
+
+    s = lambda *spec: NamedSharding(mesh, P(*spec))
+    rows = _row_axes(mesh)
+    mat = s(rows, None)
+    vec = s(rows)
+    return ForecastInputs(
+        values=mat, valid=mat, times=mat, weights=mat,
+        horizon=vec, step_s=vec, model=vec, season=vec,
+        alpha=vec, beta=vec, gamma=vec,
+    )
+
+
+def preempt_shardings(mesh: Mesh):
+    """PreemptInputs-shaped pytree of NamedShardings: the CANDIDATE
+    axis C — the data-parallel one (ops/preempt.py plans candidates
+    independently) — rides the mesh rows; nodes and victims are
+    replicated so the within-node victim prefix scans stay local. The
+    only cross-candidate aggregate (`unplaceable`, an integer sum)
+    reduces exactly, so sharded == single-device == numpy bitwise."""
+    from karpenter_tpu.ops.preempt import PreemptInputs
+
+    s = lambda *spec: NamedSharding(mesh, P(*spec))
+    rows = _row_axes(mesh)
+    cand = s(rows)
+    cand2 = s(rows, None)
+    rep = s(None)
+    rep2 = s(None, None)
+    return PreemptInputs(
+        pod_requests=cand2,
+        pod_priority=cand,
+        pod_valid=cand,
+        pod_node_forbidden=cand2,
+        node_free=rep2,
+        node_tier=rep,
+        victim_requests=rep2,
+        victim_priority=rep,
+        victim_node=rep,
+        victim_valid=rep,
+        victim_evictable=rep,
+    )
+
+
 def decision_shardings(mesh: Mesh) -> DecisionInputs:
     """DecisionInputs-shaped pytree of NamedShardings: the autoscaler fleet
     axis N rides the "pods" mesh axis (the fleet is row-parallel; M metric
@@ -347,6 +396,107 @@ def pad_decision_inputs_for_mesh(
         return jnp.pad(x, widths)
 
     return jax.tree_util.tree_map(pad0, inputs)
+
+
+def pad_forecast_inputs_for_mesh(inputs, mesh: Mesh):
+    """Grow the series axis S to a multiple of the mesh rows. Padding
+    series are all-invalid (valid=False everywhere), so every recurrence
+    sees no samples and their output rows — sliced off by
+    sharded_forecast — are well-defined and inert."""
+    extent = mesh.shape[AXIS_PODS] * mesh.shape.get(AXIS_SLICE, 1)
+    S0 = int(np.asarray(inputs.values).shape[0])
+    S1 = _pad_dim(S0, extent)
+    if S1 == S0:
+        return inputs
+
+    def pad0(x):
+        x = jnp.asarray(x)
+        widths = [(0, S1 - S0)] + [(0, 0)] * (x.ndim - 1)
+        return jnp.pad(x, widths)
+
+    return jax.tree_util.tree_map(pad0, inputs)
+
+
+def pad_preempt_inputs_for_mesh(inputs, mesh: Mesh):
+    """Grow the candidate axis C to a multiple of the mesh rows.
+    Padding candidates are invalid (never counted unplaceable) and
+    forbidden on every node (never placed); victims/nodes are untouched
+    so the quantization scales — a pure function of the node and victim
+    maxima — are identical to the unpadded problem."""
+    import dataclasses
+
+    extent = mesh.shape[AXIS_PODS] * mesh.shape.get(AXIS_SLICE, 1)
+    C0 = int(np.asarray(inputs.pod_requests).shape[0])
+    C1 = _pad_dim(C0, extent)
+    if C1 == C0:
+        return inputs
+
+    def pad0(x, fill=0):
+        x = jnp.asarray(x)
+        widths = [(0, C1 - C0)] + [(0, 0)] * (x.ndim - 1)
+        return jnp.pad(x, widths, constant_values=fill)
+
+    return dataclasses.replace(
+        inputs,
+        pod_requests=pad0(inputs.pod_requests),
+        pod_priority=pad0(inputs.pod_priority),
+        pod_valid=pad0(inputs.pod_valid),
+        pod_node_forbidden=pad0(inputs.pod_node_forbidden, fill=True),
+    )
+
+
+def shard_forecast_inputs(mesh: Mesh, inputs):
+    return jax.device_put(
+        pad_forecast_inputs_for_mesh(inputs, mesh),
+        forecast_shardings(mesh),
+    )
+
+
+def shard_preempt_inputs(mesh: Mesh, inputs):
+    return jax.device_put(
+        pad_preempt_inputs_for_mesh(inputs, mesh),
+        preempt_shardings(mesh),
+    )
+
+
+_forecast_jit = None
+
+
+def sharded_forecast(mesh: Mesh, inputs):
+    """Run the batched forecast kernel with its series axis partitioned
+    over the mesh; outputs slice back to the caller's S. Bitwise equal
+    to the single-device kernel (and therefore to forecast_numpy — the
+    parity chain composes)."""
+    global _forecast_jit
+    from karpenter_tpu.forecast import models as FM
+
+    if _forecast_jit is None:
+        _forecast_jit = jax.jit(FM.forecast)
+    n = int(np.asarray(inputs.values).shape[0])
+    out = _forecast_jit(shard_forecast_inputs(mesh, inputs))
+    return FM.ForecastOutputs(
+        point=out.point[:n],
+        sigma2=out.sigma2[:n],
+        n_valid=out.n_valid[:n],
+    )
+
+
+def sharded_preempt(mesh: Mesh, inputs):
+    """Run the eviction-planning kernel with its candidate axis
+    partitioned over the mesh; outputs slice back to the caller's C.
+    Bitwise equal to the single-device kernel (integer capacity
+    arithmetic — ops/preempt.py parity contract)."""
+    from karpenter_tpu.ops.preempt import PreemptOutputs, preempt_plan
+
+    C0 = int(np.asarray(inputs.pod_requests).shape[0])
+    V = int(np.asarray(inputs.victim_requests).shape[0])
+    out = preempt_plan(shard_preempt_inputs(mesh, inputs))
+    return PreemptOutputs(
+        chosen_node=out.chosen_node[:C0],
+        evict_count=out.evict_count[:C0],
+        evict_mask=out.evict_mask[:C0, :V],
+        unplaceable=out.unplaceable,  # padding candidates are invalid
+    )
 
 
 def shard_binpack_inputs(mesh: Mesh, inputs: BinPackInputs) -> BinPackInputs:

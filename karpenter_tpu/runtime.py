@@ -57,6 +57,13 @@ class Options:
     solver_shard_threshold: int = 1 << 24
     solver_shard_devices: Optional[int] = None
     solver_shard_mesh: Optional[tuple] = None
+    # device-resident fleet state (docs/solver-service.md
+    # "Device-resident fleet state"): singleton solve dispatches keep
+    # their operand stack resident on device and churn applies as
+    # batched scatter updates — bit-identical to the re-upload path,
+    # so ON by default; False pins the upload-every-dispatch posture
+    # (the bench-resident OFF arm and an operator escape hatch).
+    solver_resident: bool = True
     # degradation-ladder tuning (docs/resilience.md):
     # engine requeue backoff under retryable failures — first retry in
     # ~[base, 3*base], monotone up to the cap
@@ -119,6 +126,13 @@ class Options:
     # per-cluster stacks and a MultiTenantScheduler batching
     # cross-tenant work through the one shared SolverService.
     tenant_config: Optional[str] = None
+    # tenant-weighted solve deadlines (docs/multitenancy.md): bounds a
+    # deferred tenant's wall-clock wait behind earlier admission
+    # rounds — each tenant's budget is this many seconds scaled by
+    # weight / mean weight; an exhausted budget serves the tenant
+    # immediately from the bit-identical mirror and counts a deferral.
+    # None = unbounded wait (fairness still bounds rows per round).
+    tenant_deadline_s: Optional[float] = None
     # this control plane's OWN tenant id (--tenant-id): stamped as gRPC
     # metadata on every sidecar RPC so a SHARED solver sidecar can
     # attribute traffic per tenant (the other multi-tenant topology:
@@ -196,6 +210,7 @@ class KarpenterRuntime:
             shard_threshold=options.solver_shard_threshold,
             shard_devices=options.solver_shard_devices,
             shard_mesh_shape=options.solver_shard_mesh,
+            resident=options.solver_resident,
         )
         self._reset_caches_for_recovery()
         self.producer_factory = ProducerFactory(
@@ -366,7 +381,8 @@ class KarpenterRuntime:
             specs=specs,
         )
         self.tenant_scheduler = MultiTenantScheduler(
-            self.tenancy, self.solver_service
+            self.tenancy, self.solver_service,
+            deadline_s=options.tenant_deadline_s,
         )
 
     @staticmethod
@@ -484,13 +500,16 @@ class KarpenterRuntime:
     def _reset_caches_for_recovery(self) -> None:
         """Recovery boot: identity-keyed PROCESS-LEVEL caches must
         rebuild cold — stale pre-crash entries (the encoder delta
-        layer's same-object fast path, compiled-program keys) must not
+        layer's same-object fast path + its resident scatter plans,
+        compiled-program keys, device-resident operand stacks) must not
         be silently reused against post-restart state. This runtime's
         OWN SolverService is freshly constructed (already cold); the
         state that actually survives an in-process restart is the
-        module-global encoder delta cache and the process-default
-        solver service (simulate/sidecar embedders share it across
-        runtime incarnations)."""
+        module-global encoder delta cache (reset_delta_cache also
+        clears the scatter plans) and the process-default solver
+        service (reset_caches also drops its ResidentFleetState)
+        shared by simulate/sidecar embedders across runtime
+        incarnations."""
         if self.recovery is None or not self.recovery.recovered:
             return
         from karpenter_tpu.metrics.producers.pendingcapacity import (

@@ -17,6 +17,7 @@ from karpenter_tpu.solver.bucketing import (
     mesh_aligned_shape,
     pad_to_bucket,
 )
+from karpenter_tpu.solver.resident import ResidentFleetState
 from karpenter_tpu.solver.service import (
     DEFAULT_SHARD_THRESHOLD,
     SUBSYSTEM,
@@ -31,6 +32,7 @@ from karpenter_tpu.solver.service import (
 
 __all__ = [
     "DEFAULT_SHARD_THRESHOLD",
+    "ResidentFleetState",
     "SUBSYSTEM",
     "SolveFuture",
     "SolverSaturated",

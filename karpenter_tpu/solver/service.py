@@ -69,9 +69,18 @@ family, `cost` (ops/cost.py — the fleet's multi-objective cost/SLO
 refinement in one dispatch), rides the same FSM with a deliberately
 different failure posture: cost-blind, not mirror-served (docs/cost.md).
 
-The service holds NO domain state — it is a pure function of each
-request — so callers keep their own caches (the encode memo, the
-device-residency memo) and their public APIs unchanged.
+The service holds no DOMAIN state — results are a pure function of each
+request — but it does own one derived cache: the DEVICE-RESIDENT fleet
+state (solver/resident.py, docs/solver-service.md "Device-resident
+fleet state"). Singleton solve dispatches keep their padded operand
+stack resident on device, keyed by the host inputs object's identity:
+an unchanged fleet re-dispatches with zero host encode and zero upload,
+and a delta-encoded successor (the encoder's SnapshotDeltaCache
+publishes the changed-row plan) applies as a batched scatter instead of
+a full re-upload. Residency is bit-identical to the cold path by
+construction, falls back to a full upload on any inconsistency, and is
+discarded wholesale by the degradation ladder and the recovery boot
+(reset_caches).
 """
 
 from __future__ import annotations
@@ -124,6 +133,11 @@ WINDOW_MS = "window_ms"
 PIPELINE_DEPTH = "pipeline_depth"
 UPLOAD_MS = "upload_ms"
 SHARD_DEVICES = "shard_devices"
+# device-resident fleet state (solver/resident.py)
+RESIDENT_BYTES = "resident_bytes"
+RESIDENT_ROWS = "resident_rows"
+RESIDENT_SCATTER_MS = "resident_scatter_ms"
+RESIDENT_REBUILDS = "resident_rebuilds_total"
 
 # Sharded dispatch (docs/solver-service.md "Sharded dispatch"): a request
 # whose pods x groups constraint matrix reaches this many cells routes
@@ -224,6 +238,11 @@ class SolverStatistics:
     shard_dispatches: int = 0  # batches answered by the mesh-sharded program
     shard_requests: int = 0  # requests routed onto the mesh at submit
     shard_fallbacks: int = 0  # shard-path failures retried single-device
+    # device-resident fleet state (solver/resident.py)
+    resident_hits: int = 0  # dispatches served from resident buffers as-is
+    resident_scatters: int = 0  # dispatches served via a changed-row scatter
+    resident_rebuilds: int = 0  # full uploads (re)establishing residency
+    resident_drops: int = 0  # wholesale discards (ladder / recovery boot)
     pipeline_splits: int = 0  # lone batches chunked so the pipeline overlaps
     # backend health FSM + watchdog (docs/resilience.md)
     device_failures: int = 0  # total device-path failures (any rung)
@@ -342,6 +361,7 @@ class SolverService:
         shard_threshold: int = DEFAULT_SHARD_THRESHOLD,
         shard_devices: Optional[int] = None,
         shard_mesh_shape: Optional[tuple] = None,
+        resident: bool = True,
     ):
         if on_timeout not in ("fallback", "raise"):
             raise ValueError(f"on_timeout must be fallback|raise, got {on_timeout!r}")
@@ -399,6 +419,18 @@ class SolverService:
         # (the single-device program keeps serving); reset_caches — the
         # recovery-boot seam — re-arms it
         self._shard_broken = False
+        # device-resident fleet state (solver/resident.py): singleton
+        # solve dispatches keep their operand stack on device and churn
+        # applies as batched scatters. `resident=False` pins the
+        # upload-every-dispatch path (the bench-resident OFF arm).
+        self.resident_enabled = resident
+        from karpenter_tpu.solver.resident import ResidentFleetState
+
+        self._resident = ResidentFleetState()
+        # whether the decide family was given an injected kernel (the
+        # gRPC split / tests): an injected decider owns its own device
+        # semantics, so the sharded decide route must stay out of it
+        self._decider_injected = decider is not None
         # backend health FSM (module docstring): trips wholesale to numpy
         # after K consecutive device failures, probes recovery
         self.health_failure_threshold = health_failure_threshold
@@ -459,6 +491,15 @@ class SolverService:
         # devices behind the sharded dispatch strategy (0 = single-device:
         # no mesh, below threshold traffic only, or shard path tripped)
         self._g_shard = reg(SUBSYSTEM, SHARD_DEVICES)
+        # device-resident fleet state (solver/resident.py): bytes/rows
+        # currently resident, the last scatter's wall time, and how
+        # often residency had to rebuild from a full upload
+        self._g_resident_bytes = reg(SUBSYSTEM, RESIDENT_BYTES)
+        self._g_resident_rows = reg(SUBSYSTEM, RESIDENT_ROWS)
+        self._g_resident_scatter = reg(SUBSYSTEM, RESIDENT_SCATTER_MS)
+        self._c_resident_rebuilds = reg(
+            SUBSYSTEM, RESIDENT_REBUILDS, kind="counter"
+        )
         # degradation-ladder surface (docs/resilience.md): FSM state
         # (0 healthy / 1 degraded) + transition and watchdog counters
         self._g_backend_state = reg("resilience", "solver_backend_state")
@@ -506,6 +547,12 @@ class SolverService:
         if self._mesh is not None and not self._shard_broken:
             n_shard = int(self._mesh.devices.size)
         self._g_shard.set("-", "-", float(n_shard))
+        self._g_resident_bytes.set(
+            "-", "-", float(self._resident.resident_bytes())
+        )
+        self._g_resident_rows.set(
+            "-", "-", float(self._resident.resident_rows())
+        )
         with self._stage_lock:
             snapshot = {k: list(v) for k, v in self._stages.items()}
         uploads = snapshot.get("upload")
@@ -553,6 +600,10 @@ class SolverService:
         # pre-crash shard failure shouldn't pin the successor single-
         # device forever (the ladder re-trips on the next failure)
         self._shard_broken = False
+        # and drops every device-resident operand stack: post-recovery
+        # encodes must not scatter into pre-crash buffers (the encoder
+        # clears its scatter plans through the same boot seam)
+        self._resident.drop_all()
 
     def stage_percentiles(self) -> Dict[str, Dict[str, float]]:
         """{stage: {"p50_ms", "p99_ms", "n"}} over the retained latency
@@ -992,11 +1043,21 @@ class SolverService:
         t_bucket = bucket_up(
             int(np.asarray(inputs.values).shape[1]), FORECAST_T_FLOOR
         )
+        # fleet-scale forecasts shard their SERIES axis over the mesh
+        # rows (cells = series x history slots, same threshold as
+        # bin-packs); below threshold the key is unchanged
+        resolved, extents = self._shard_extents(
+            resolved, n_series, t_bucket
+        )
+        key = ("forecast", t_bucket, resolved)
+        if extents is not None:
+            key += ("shard", extents)
+            self.stats.shard_requests += 1
         return _Request(
             inputs=pad_forecast_inputs(inputs, t_bucket),
             buckets=0,
             backend=resolved,
-            key=("forecast", t_bucket, resolved),
+            key=key,
             n_pods=n_series,
             n_groups=0,
             deadline=(now + timeout) if timeout else None,
@@ -1074,11 +1135,22 @@ class SolverService:
         elif resolved == "pallas":
             resolved = "xla"  # no Mosaic preempt kernel; XLA runs on TPU
         now = self._clock()
+        # fleet-scale eviction storms shard their CANDIDATE axis over
+        # the mesh rows (cells = candidates x victims — the dominant
+        # [C, V] evictability/prefix matrices — same threshold as
+        # bin-packs); below threshold the key is unchanged
+        resolved, extents = self._shard_extents(
+            resolved, n_candidates, max(n_victims, 1)
+        )
+        key = ("preempt", preempt_bucket_shape(inputs), resolved)
+        if extents is not None:
+            key += ("shard", extents)
+            self.stats.shard_requests += 1
         return _Request(
             inputs=inputs,
             buckets=0,
             backend=resolved,
-            key=("preempt", preempt_bucket_shape(inputs), resolved),
+            key=key,
             n_pods=n_candidates,
             n_groups=n_victims,
             deadline=(now + timeout) if timeout else None,
@@ -1180,13 +1252,17 @@ class SolverService:
     def decide(self, inputs):
         """The HPA decision kernel through the service: same metrics
         surface and error accounting, no coalescing (the batch
-        autoscaler already evaluates the whole fleet in one call)."""
+        autoscaler already evaluates the whole fleet in one call). A
+        fleet whose N x M cell count reaches shard_threshold rides the
+        mesh — the decision fleet axis shards over the mesh rows
+        (parallel/mesh.decision_shardings), with a single-device retry
+        on any mesh failure (the same ladder posture as bin-packs)."""
         self.stats.decide_calls += 1
         t0 = _time.perf_counter()
         try:
             with default_tracer().span("solver.decide"):
                 with solver_trace("solver.decide"):
-                    out = self._decide_fn()(inputs)
+                    out = self._decide_dispatch(inputs)
             # the decide kernel has no numpy mirror: it is served by
             # the in-process jitted program ("device": XLA on whatever
             # backend jax resolved) or across the gRPC split
@@ -1208,6 +1284,48 @@ class SolverService:
 
             self._decider = decide_jit
         return self._decider
+
+    def _decide_dispatch(self, inputs):
+        """Route one fleet decide: the sharded program above threshold
+        (in-process default kernel only — an injected decider or the
+        gRPC split owns its own device semantics), the single-device
+        jit otherwise. A mesh failure retries single-device inline and
+        trips the shard route, exactly like the bin-pack ladder —
+        decide stays the never-block kernel either way."""
+        fn = self._decide_fn()
+        if self._decider_injected:
+            return fn(inputs)
+        # the SAME routing guards every queue family takes
+        # (_shard_extents: threshold, shard-broken trip, device_solver,
+        # mesh availability) — decide's cells are fleet x metric columns
+        n = int(inputs.spec_replicas.shape[0])
+        m = int(inputs.metric_value.shape[1])
+        _, extents = self._shard_extents("xla", n, max(m, 1))
+        if extents is None:
+            return fn(inputs)
+        mesh = self._shard_mesh()
+        from karpenter_tpu.parallel.mesh import sharded_decide
+
+        self.stats.shard_requests += 1
+        try:
+            out = sharded_decide(mesh, inputs)
+            self.stats.shard_dispatches += 1
+            return out
+        except Exception as error:  # noqa: BLE001 — shard-rung failure
+            self.stats.shard_fallbacks += 1
+            self._shard_broken = True
+            logger().warning(
+                "sharded decide failed (%s: %s); retrying single-device "
+                "and disabling the shard route",
+                type(error).__name__, error,
+            )
+            default_flight_recorder().record(
+                "shard_fallback",
+                subsystem="solver",
+                error=type(error).__name__,
+                family="decide",
+            )
+            return fn(inputs)
 
     def close(self) -> None:
         with self._cond:
@@ -1247,6 +1365,11 @@ class SolverService:
             return False
 
     def _record_device_failure(self, requests: List[_Request] = ()) -> bool:
+        # the degradation ladder discards residency cleanly: after ANY
+        # device-path failure the resident buffers are suspect (a hung
+        # or faulted device may hold poisoned state), so the next
+        # healthy dispatch re-establishes them from a full upload
+        self._resident.drop_all()
         with self._health_lock:
             self.stats.device_failures += 1
             self._consec_device_failures += 1
@@ -1557,8 +1680,14 @@ class SolverService:
     @staticmethod
     def _shard_strategy(key: tuple) -> Optional[str]:
         """The shard strategy marker of a request key, or None for a
-        single-device key. Sharded keys: (shape, buckets, backend,
-        presence, "shard"|"vmap_shard", extents)."""
+        single-device key. Sharded bin-pack keys: (shape, buckets,
+        backend, presence, "shard"|"vmap_shard", extents). Sharded
+        forecast/preempt keys: ("forecast"|"preempt", shape-ish,
+        backend, "shard", extents)."""
+        if key[0] in ("forecast", "preempt"):
+            return (
+                "shard" if len(key) > 3 and key[3] == "shard" else None
+            )
         if len(key) > 5 and key[4] in ("shard", "vmap_shard"):
             return key[4]
         return None
@@ -1567,7 +1696,10 @@ class SolverService:
     def _single_device_key(key: tuple) -> tuple:
         """The single-device key a sharded group degrades to — same
         bucket shape/buckets/backend/presence, mesh routing stripped
-        ("vmap_shard" keeps the vectorized consolidate program)."""
+        ("vmap_shard" keeps the vectorized consolidate program;
+        forecast/preempt keys drop their trailing shard marker)."""
+        if key[0] in ("forecast", "preempt"):
+            return key[:3]
         if key[4] == "vmap_shard":
             return key[:4] + ("vmap",)
         return key[:4]
@@ -1676,11 +1808,14 @@ class SolverService:
     def _solve_group(
         self, key: tuple, live: List[_Request], lone: bool = False
     ) -> None:
-        # forecast and preempt requests are PINNED to the single-device
-        # path in this ladder: their kernels are not mesh-certified (no
-        # sharded parity pin), and their problem sizes — S series x T
-        # history, C candidates x N nodes — sit orders of magnitude
-        # below the bin-pack cell threshold anyway
+        # forecast and preempt ride the mesh too (PR 13 closed the
+        # PR 8 "no sharded parity pin" caveat): a request whose cell
+        # count reached shard_threshold carries a ("shard", extents)
+        # marker and its group dispatches mesh-partitioned — with the
+        # same shard -> single-device -> numpy ladder as bin-packs
+        # (parity pinned in tests/test_parallel.py). Below threshold —
+        # the common S series x T history / C candidates x V victims
+        # fleet — nothing changes.
         if key[0] == "forecast":
             self._forecast_group(key, live)
             return
@@ -1792,7 +1927,9 @@ class SolverService:
                 strategy=strategy,
             )
 
-    def _forecast_group(self, key: tuple, live: List[_Request]) -> None:
+    def _forecast_group(  # lint: allow-complexity — one guard per shard rung (route/pad/place/count), numpy short-circuit
+        self, key: tuple, live: List[_Request]
+    ) -> None:
         """One coalesced forecast dispatch: same-T-bucket requests are
         concatenated along the series axis, padded up the series ladder,
         and answered by ONE compiled program; results slice back per
@@ -1813,20 +1950,36 @@ class SolverService:
                 request.finish(result=FM.forecast_numpy(request.inputs))
                 self._record_stage("dispatch", _time.perf_counter() - t0)
             return
+        shard = self._shard_strategy(key) is not None
+        mesh = self._shard_mesh() if shard else None
+        if shard and mesh is None:
+            raise RuntimeError(
+                "shard mesh unavailable for a shard-routed forecast"
+            )
         t0 = _time.perf_counter()
         sizes = [request.n_pods for request in live]
         s_bucket = bucket_up(sum(sizes), FORECAST_S_FLOOR)
+        if shard:
+            # grow the series bucket to the mesh-row extent GSPMD
+            # requires; padding series are all-invalid and sliced off
+            from karpenter_tpu.utils.functional import pad_to_multiple
+
+            s_bucket = pad_to_multiple(s_bucket, key[4][0])
         stacked = FM.concat_forecast_inputs(
             [request.inputs for request in live], s_bucket
         )
         self._record_stage("pad", _time.perf_counter() - t0)
-        fn, fresh = self._forecast_compiled(
-            ("forecast", s_bucket, t_bucket, backend)
-        )
+        cache_key = ("forecast", s_bucket, t_bucket, backend)
+        if shard:
+            cache_key += ("shard", key[4])
+        fn, fresh = self._forecast_compiled(cache_key)
         import jax
 
         t0 = _time.perf_counter()
-        with self._dispatch_span("solver.dispatch.forecast", live):
+        with self._dispatch_span(
+            "solver.dispatch.forecast" + (".shard" if shard else ""),
+            live,
+        ):
             with self._device_section(
                 live, grace=COMPILE_GRACE_S if fresh else 0.0
             ):
@@ -1836,6 +1989,14 @@ class SolverService:
                     # plan exercises the numpy degradation + FSM, a hang
                     # plan the watchdog drain
                     inject("forecast.predict")
+                    if shard:
+                        from karpenter_tpu.parallel.mesh import (
+                            forecast_shardings,
+                        )
+
+                        stacked = self._upload(
+                            stacked, forecast_shardings(mesh)
+                        )
                     out = fn(stacked)
                     jax.block_until_ready(out)
         if self._stale():
@@ -1843,6 +2004,8 @@ class SolverService:
         self._record_stage("dispatch", _time.perf_counter() - t0)
         self._count_dispatch()
         self.stats.forecast_dispatches += 1
+        if shard:
+            self.stats.shard_dispatches += 1
         t0 = _time.perf_counter()
         offset = 0
         for request, size in zip(live, sizes):
@@ -1855,7 +2018,9 @@ class SolverService:
         self._record_stage("scatter", _time.perf_counter() - t0)
         self._record_device_success()
 
-    def _preempt_group(self, key: tuple, live: List[_Request]) -> None:
+    def _preempt_group(  # lint: allow-complexity — one guard per shard rung (route/pad/place/count), numpy short-circuit
+        self, key: tuple, live: List[_Request]
+    ) -> None:
         """Eviction-planning dispatches: each request is already a
         whole-fleet batched problem (the candidate axis IS the batch —
         ops/preempt.py plans candidates data-parallel), so same-key
@@ -1879,14 +2044,37 @@ class SolverService:
             return
         import jax
 
-        fresh = self._count_compile(("preempt", shape, backend))
+        shard = self._shard_strategy(key) is not None
+        mesh = self._shard_mesh() if shard else None
+        if shard and mesh is None:
+            raise RuntimeError(
+                "shard mesh unavailable for a shard-routed eviction plan"
+            )
+        shardings = None
+        if shard:
+            # grow the CANDIDATE axis (the data-parallel one the mesh
+            # rows shard) to the mesh extent; padding candidates are
+            # invalid + all-forbidden, cropped off below
+            from karpenter_tpu.parallel.mesh import preempt_shardings
+            from karpenter_tpu.utils.functional import pad_to_multiple
+
+            c, n, r, v = shape
+            shape = (pad_to_multiple(c, key[4][0]), n, r, v)
+            shardings = preempt_shardings(mesh)
+        cache_key = ("preempt", shape, backend)
+        if shard:
+            cache_key += ("shard", key[4])
+        fresh = self._count_compile(cache_key)
         grace = COMPILE_GRACE_S if fresh else 0.0
         for request in live:
             t0 = _time.perf_counter()
             padded = pad_preempt_inputs(request.inputs, shape)
             self._record_stage("pad", _time.perf_counter() - t0)
             t0 = _time.perf_counter()
-            with self._dispatch_span("solver.dispatch.preempt", [request]):
+            with self._dispatch_span(
+                "solver.dispatch.preempt" + (".shard" if shard else ""),
+                [request],
+            ):
                 with self._device_section([request], grace=grace):
                     with solver_trace("solver.preempt"):
                         # the preempt-path fault-injection point
@@ -1894,7 +2082,12 @@ class SolverService:
                         # error plan exercises the numpy degradation +
                         # FSM, a hang plan the watchdog drain
                         inject("preempt.plan")
-                        out = PK.preempt_plan(jax.device_put(padded))
+                        placed = (
+                            self._upload(padded, shardings)
+                            if shard
+                            else jax.device_put(padded)
+                        )
+                        out = PK.preempt_plan(placed)
                         jax.block_until_ready(out)
             grace = 0.0  # only the first dispatch of the batch compiles
             if self._stale():
@@ -1902,6 +2095,8 @@ class SolverService:
             self._record_stage("dispatch", _time.perf_counter() - t0)
             self._count_dispatch()
             self.stats.preempt_dispatches += 1
+            if shard:
+                self.stats.shard_dispatches += 1
             t0 = _time.perf_counter()
             host = PK.PreemptOutputs(
                 chosen_node=np.asarray(out.chosen_node),
@@ -1977,11 +2172,22 @@ class SolverService:
         donation support the batch buffers are reused instead of
         reallocated every dispatch; where donation is unimplemented it
         is a no-op with identical outputs (pinned by the donation-parity
-        test)."""
-        stacked, n_batch = self._stack_group(shape, live)
+        test).
+
+        Singleton map groups first consult the DEVICE-RESIDENT fleet
+        state (solver/resident.py): an identity hit or changed-row
+        scatter skips the pad/stack/upload entirely, and the dispatch
+        compiles the donate=False family so the resident buffers
+        survive the solve."""
+        resident = self._resident_stack(shape, live, strategy)
+        if resident is not None:
+            stacked, n_batch, donate = resident, 1, False
+        else:
+            stacked, n_batch = self._stack_group(shape, live)
+            donate = self._donation_supported()
         fn, fresh = self._compiled_for(
             ("xla", shape, n_batch, buckets, live[0].key[3], strategy),
-            donate=self._donation_supported(),
+            donate=donate,
         )
         t0 = _time.perf_counter()
         with self._dispatch_span(
@@ -1991,7 +2197,8 @@ class SolverService:
                 live, grace=COMPILE_GRACE_S if fresh else 0.0
             ):
                 with solver_trace("solver.dispatch"):
-                    stacked = self._upload(stacked)
+                    if resident is None:
+                        stacked = self._upload(stacked)
                     out = fn(stacked, buckets)
         if self._stale():
             # superseded by a watchdog restart while dispatching: the
@@ -2024,6 +2231,58 @@ class SolverService:
         stacked = _stack_inputs(padded)
         self._record_stage("pad", _time.perf_counter() - t0)
         return stacked, n_batch
+
+    def _resident_stack(
+        self, shape, live: List[_Request], strategy: str,
+        shardings=None, extents: Optional[tuple] = None,
+    ):
+        """The device-resident serve path for a SINGLETON map-strategy
+        group: returns the resident stacked operands (batch axis 1), or
+        None when residency does not apply — disabled, a coalesced
+        multi-request batch (those stacks are ephemeral by nature), the
+        vmap consolidate family, or an out-of-process device solver.
+
+        kind accounting: a "hit" records a 0.0 upload sample (nothing
+        crossed the link — the claim `make bench-hotpath`'s upload p50
+        verifies), a "scatter" records the scatter wall time under the
+        resident_scatter stage + gauge (its host->device traffic is the
+        changed-row blocks inside the jitted scatter), and a "rebuild"
+        billed its full upload through the normal _upload hook."""
+        if (
+            not self.resident_enabled
+            or strategy != "map"
+            or len(live) != 1
+            or self.device_solver is not None
+        ):
+            return None
+        request = live[0]
+        mode = ("single",) if extents is None else ("shard", extents)
+        t0 = _time.perf_counter()
+        try:
+            stacked, kind = self._resident.obtain(
+                request.inputs, shape, mode,
+                lambda tree: self._upload(tree, shardings),
+            )
+        except Exception as error:  # noqa: BLE001 — optimization layer
+            logger().warning(
+                "resident fleet state unavailable (%s: %s); "
+                "re-uploading the full operand stack",
+                type(error).__name__, error,
+            )
+            return None
+        if kind == "hit":
+            self.stats.resident_hits += 1
+            self._record_stage("upload", 0.0)
+        elif kind == "scatter":
+            self.stats.resident_scatters += 1
+            elapsed = _time.perf_counter() - t0
+            self._record_stage("resident_scatter", elapsed)
+            self._g_resident_scatter.set("-", "-", elapsed * 1e3)
+        else:
+            self.stats.resident_rebuilds += 1
+            self._c_resident_rebuilds.inc("-", "-")
+        self.stats.resident_drops = self._resident.drops
+        return stacked
 
     def _upload(self, stacked, shardings=None):
         """device_put the stack (with NamedShardings on the sharded
@@ -2078,13 +2337,25 @@ class SolverService:
         extents = key[5]
         strategy = "vmap" if key[4] == "vmap_shard" else "map"
         aligned = mesh_aligned_shape(shape, extents)
-        stacked, n_batch = self._stack_group(aligned, live)
+        shardings = stacked_binpack_shardings(mesh, key[3])
+        # sharded residency: the resident entry holds the NamedSharding-
+        # placed stack, so an unchanged/delta tick skips the full
+        # sharded upload too; a threshold crossing (either direction)
+        # misses on mode and rebuilds under the new placement
+        resident = self._resident_stack(
+            aligned, live, strategy, shardings=shardings, extents=extents
+        )
+        if resident is not None:
+            stacked, n_batch, donate = resident, 1, False
+        else:
+            stacked, n_batch = self._stack_group(aligned, live)
+            donate = self._donation_supported()
         fn, fresh = self._compiled_for(
             (
                 "xla", aligned, n_batch, buckets, key[3], strategy,
                 "shard", extents,
             ),
-            donate=self._donation_supported(),
+            donate=donate,
         )
         t0 = _time.perf_counter()
         with self._dispatch_span(
@@ -2095,9 +2366,8 @@ class SolverService:
                 live, grace=COMPILE_GRACE_S if fresh else 0.0
             ):
                 with solver_trace("solver.shard"):
-                    stacked = self._upload(
-                        stacked, stacked_binpack_shardings(mesh, key[3])
-                    )
+                    if resident is None:
+                        stacked = self._upload(stacked, shardings)
                     out = fn(stacked, buckets)
                     jax.block_until_ready(out)
         if self._stale():

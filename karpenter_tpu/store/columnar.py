@@ -23,6 +23,13 @@ events and maintain the solver's input arrays *incrementally*:
 - a pod is parsed ONCE at its lifecycle event (Quantity → float, selector →
   bitset), not once per tick; per-tick feed cost is O(changed pods), and
   snapshot() is a bulk numpy copy
+- downstream, the chain stays incremental all the way to the chip: the
+  encoder's delta layer (pendingcapacity/encoder.SnapshotDeltaCache)
+  splices only the changed rows and publishes a ResidentPlan, and the
+  solver's device-resident fleet state (solver/resident.py) applies it
+  as a batched scatter — an unchanged dedup set costs zero host encode
+  AND zero host->device upload (docs/solver-service.md
+  "Device-resident fleet state")
 
 Intolerance vs the (node-derived) taint universe cannot be cached here —
 taints belong to groups and change with nodes — so the cache stores each
@@ -467,7 +474,11 @@ class PendingPodCache:
         Memoized per generation: an unchanged arena returns the SAME
         snapshot object, so callers can key their own derived caches
         (encoded solver inputs, device-resident buffers) on identity or
-        on `snapshot.generation`."""
+        on `snapshot.generation`. This identity chain is load-bearing:
+        snapshot identity -> delta-cache hit -> same BinPackInputs
+        object -> ResidentFleetState identity hit (zero upload), so
+        snapshot() must never return equal-but-distinct objects for an
+        unchanged generation."""
         with self._lock:
             if self._needs_compaction():
                 self._compact()

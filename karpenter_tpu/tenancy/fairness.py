@@ -37,6 +37,16 @@ from typing import Dict, List
 _CREDIT_CAP_ROUNDS = 4.0
 
 
+def effective_weight(weights: Dict[str, float], tenant: str) -> float:
+    """One tenant's normalized admission weight: missing defaults to
+    1.0, zero/negative clamp to 1.0 (a weightless tenant must neither
+    divide by zero nor be starved outright). THE single definition —
+    round admission and the deadline budgets (scheduler._deadline_
+    budgets) must normalize identically, or a zero-weight tenant would
+    get one effective weight for rows and another for its wait bound."""
+    return max(float(weights.get(tenant, 1.0)), 0.0) or 1.0
+
+
 class WeightedAdmission:
     """Deficit-weighted round-robin admission (module docstring).
 
@@ -77,10 +87,10 @@ class WeightedAdmission:
         self, pending: Dict[str, int], weights: Dict[str, float]
     ) -> List[str]:
         total_weight = sum(
-            max(float(weights.get(t, 1.0)), 0.0) or 1.0 for t in pending
+            effective_weight(weights, t) for t in pending
         )
         for tenant in pending:
-            weight = max(float(weights.get(tenant, 1.0)), 0.0) or 1.0
+            weight = effective_weight(weights, tenant)
             share = self.budget_rows * weight / total_weight
             credit = self._credit.get(tenant, 0.0) + share
             self._credit[tenant] = min(credit, _CREDIT_CAP_ROUNDS * share)

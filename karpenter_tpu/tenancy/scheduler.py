@@ -81,6 +81,7 @@ class TenancyStatistics:
     """Plain-int mirror of the scheduler counters (tests and the bench
     read these; the registry carries the per-tenant series)."""
 
+    deadline_escapes: int = 0  # deferred tenants served early (budget out)
     decide_calls: int = 0  # decide_all entries
     decide_rows: int = 0  # tenant rows decided (across all tenants)
     decide_dispatches: int = 0  # shared concatenated decide dispatches
@@ -116,6 +117,7 @@ class MultiTenantScheduler:
         max_rows_per_round: int = 4096,
         breaker_threshold: int = 3,
         breaker_reset_s: float = 30.0,
+        deadline_s: Optional[float] = None,
         clock=None,
     ):
         import time as _time
@@ -128,6 +130,20 @@ class MultiTenantScheduler:
                 "via the tenant registry)"
             )
         clock = clock or _time.monotonic
+        self._clock = clock
+        # tenant-weighted solve deadlines (docs/multitenancy.md):
+        # fairness bounds ROWS per round, not how long a deferred
+        # tenant waits behind earlier rounds — deadline_s bounds that
+        # latency. Each tenant's budget scales with its configured
+        # weight (budget = deadline_s x weight / mean weight): a
+        # heavyweight tenant is entitled to keep its device slot
+        # through a long backlog, a lightweight one whose budget runs
+        # out mid-schedule stops waiting and serves IMMEDIATELY from
+        # the family's bit-identical mirror (or an isolated dispatch
+        # for mirror-less families) — the answer is the same answer,
+        # only the wait is bounded. None disables the bound (the
+        # pre-deadline posture).
+        self.deadline_s = deadline_s
         self.admission = WeightedAdmission(budget_rows=max_rows_per_round)
         self.breakers = TenantBreakerBoard(
             threshold=breaker_threshold, reset_s=breaker_reset_s,
@@ -262,7 +278,7 @@ class MultiTenantScheduler:
 
     # -- solve (bin-pack) --------------------------------------------------
 
-    def solve_all(
+    def solve_all(  # lint: allow-complexity — per-tenant isolation ladder + weighted-deadline classification, one guard each
         self,
         batch,
         buckets: int = 32,
@@ -277,10 +293,19 @@ class MultiTenantScheduler:
         the numpy mirror inline (the same binpack_numpy every ladder
         rung ends at)."""
         from karpenter_tpu.ops.numpy_binpack import binpack_numpy
+        from karpenter_tpu.solver.service import SolverTimeout
 
         self.stats.solve_calls += 1
         results: Dict[str, object] = {}
-        futures: List[Tuple[str, object]] = []
+        # (tenant, future, effective timeout, bounded-by-budget?)
+        futures: List[Tuple[str, object, Optional[float], bool]] = []
+        # tenant-weighted solve deadlines: each tenant's queue wait is
+        # bounded by its weighted budget (never loosened past the
+        # caller's own timeout) — an expiry serves the bit-identical
+        # numpy mirror and counts a deferral, not a breaker failure
+        budgets = self._deadline_budgets(
+            sorted(batch), self.registry.weights()
+        )
         for tenant, inputs in sorted(batch.items()):
             # "probe" needs no special-casing here: the solver
             # service's own ladder answers each queued request from
@@ -290,22 +315,43 @@ class MultiTenantScheduler:
                 results[tenant] = binpack_numpy(inputs, buckets=buckets)
                 self._served_mirror(tenant)
                 continue
+            budget = budgets.get(tenant)
+            t_eff = (
+                timeout
+                if budget is None
+                else (min(timeout, budget) if timeout else budget)
+            )
+            # a later expiry is only a DEADLINE escape when the
+            # weighted budget was the binding bound — an expiry at the
+            # caller's own (smaller) timeout is a device-path problem
+            # and must keep charging the breaker
+            bounded = budget is not None and (
+                not timeout or budget < timeout
+            )
             try:
                 futures.append((tenant, self.service.submit(
                     inputs, buckets=buckets, backend=backend,
-                    timeout=timeout, tenant=tenant,
-                )))
+                    timeout=t_eff, tenant=tenant,
+                ), t_eff, bounded))
                 self.stats.solve_requests += 1
             except Exception as error:  # noqa: BLE001 — per-tenant isolation
                 self._tenant_failed(tenant, error)
                 results[tenant] = binpack_numpy(inputs, buckets=buckets)
                 self._served_mirror(tenant)
-        for tenant, future in futures:
+        for tenant, future, t_eff, bounded in futures:
             try:
-                results[tenant] = future.result(timeout)
+                results[tenant] = future.result(t_eff)
                 self._tenant_ok(tenant)
             except Exception as error:  # noqa: BLE001 — per-tenant isolation
-                self._tenant_failed(tenant, error)
+                if isinstance(error, SolverTimeout) and bounded:
+                    # weighted-deadline expiry: bounded-wait serve, no
+                    # breaker charge (backlog, not tenant fault)
+                    self.stats.deadline_escapes += 1
+                    self.stats.deferrals += 1
+                    if self.metrics.enabled:
+                        self.metrics.deferrals.inc(tenant, "-")
+                else:
+                    self._tenant_failed(tenant, error)
                 results[tenant] = binpack_numpy(
                     batch[tenant], buckets=buckets
                 )
@@ -575,14 +621,33 @@ class MultiTenantScheduler:
                     tenant, inputs, mirror, isolated, fallback
                 )
         if healthy:
+            weights = self.registry.weights()
             demand = {t: rows_of(i) for t, i in healthy.items()}
-            schedule = self.admission.rounds(
-                demand, self.registry.weights()
-            )
+            schedule = self.admission.rounds(demand, weights)
             self.stats.admission_rounds += len(schedule)
             if self.metrics.enabled:
                 self.metrics.rounds.set("-", "-", float(len(schedule)))
+            budgets = self._deadline_budgets(list(healthy), weights)
+            t0 = self._clock()
             for round_index, admitted in enumerate(schedule):
+                if budgets and round_index > 0:
+                    # tenant-weighted solve deadlines: a deferred
+                    # tenant whose weighted budget the earlier rounds
+                    # already consumed stops waiting and serves NOW
+                    # from the bit-identical mirror (or an isolated
+                    # dispatch) — same answer, bounded wait
+                    elapsed = self._clock() - t0
+                    expired = {
+                        t for t in admitted if elapsed > budgets[t]
+                    }
+                    for tenant in sorted(expired):
+                        results[tenant] = self._serve_deadline_escape(
+                            tenant, healthy[tenant], mirror, isolated,
+                            fallback,
+                        )
+                    admitted = [t for t in admitted if t not in expired]
+                    if not admitted:
+                        continue
                 if round_index > 0:
                     self.stats.deferrals += len(admitted)
                     if self.metrics.enabled:
@@ -620,6 +685,44 @@ class MultiTenantScheduler:
             self.stats.cost_dispatches += 1
         else:
             self.stats.forecast_dispatches += 1
+
+    def _deadline_budgets(
+        self, tenants: List[str], weights: Dict[str, float]
+    ) -> Dict[str, float]:
+        """Per-tenant wall-time budgets under --tenant-deadline:
+        deadline_s scaled by weight / mean weight, so the fleet's mean
+        tenant gets exactly deadline_s and weights shift budget toward
+        the tenants an operator declared heavier. Empty when the bound
+        is disabled."""
+        from karpenter_tpu.tenancy.fairness import effective_weight
+
+        if self.deadline_s is None or not tenants:
+            return {}
+        w = {t: effective_weight(weights, t) for t in tenants}
+        mean = sum(w.values()) / len(w)
+        return {t: self.deadline_s * w[t] / mean for t in tenants}
+
+    def _serve_deadline_escape(
+        self, tenant, inputs, mirror, isolated, fallback
+    ):
+        """A deferred tenant whose weighted deadline budget ran out:
+        serve immediately from the family's mirror/isolated rung
+        instead of waiting out the remaining rounds. Counted as a
+        deferral (karpenter_tenant_deferrals_total — the fairness
+        ledger the operator already watches) plus deadline_escapes; the
+        breaker is NOT charged — backlog is the plane's condition, not
+        the tenant's fault."""
+        self.stats.deadline_escapes += 1
+        self.stats.deferrals += 1
+        if self.metrics.enabled:
+            self.metrics.deferrals.inc(tenant, "-")
+        out = self._serve_degraded(
+            tenant, inputs, mirror, isolated, fallback
+        )
+        serve = self._serving.get(tenant)
+        if serve is not None:
+            serve["deferred"] = True
+        return out
 
     def _probe_tenant(self, tenant, inputs, isolated, mirror, fallback):
         """An open breaker's recovery probe: ONE isolated dispatch —

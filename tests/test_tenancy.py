@@ -1175,3 +1175,156 @@ class TestRegressionGuard:
             )
         finally:
             service.close()
+
+
+class TestTenantWeightedDeadlines:
+    """PR 11 named follow-up, closed in PR 13: fairness bounds rows per
+    round, not per-tenant latency — deadline_s bounds the latter, with
+    each tenant's budget scaled by weight / mean weight. An exhausted
+    budget serves the tenant immediately from the bit-identical mirror
+    and counts a DEFERRAL (karpenter_tenant_deferrals_total), never a
+    breaker failure."""
+
+    def test_light_tenant_escapes_heavy_waits(self):
+        # one tenant's rows fill a round, so the schedule is 3 rounds;
+        # the ticking clock makes every round cost 'wall time', and the
+        # lightweight tenants' small budgets expire mid-schedule
+        ticks = {"now": 0.0}
+
+        def clock():
+            ticks["now"] += 1.0
+            return ticks["now"]
+
+        service, registry, scheduler = make_world(
+            n_tenants=3, weights=[10.0, 0.1, 0.1],
+            max_rows_per_round=6, deadline_s=10.0, clock=clock,
+        )
+        try:
+            # budgets: mean weight 3.4 -> heavy ~29.4s (never expires
+            # under the ticking clock), lights ~0.29s (expire by the
+            # first deferred round)
+            batch = {
+                f"t{i}": random_cost_inputs(seed=40 + i, n=6)
+                for i in range(3)
+            }
+            out = scheduler.cost_all(batch, backend="numpy")
+            assert scheduler.stats.deadline_escapes >= 1
+            assert scheduler.stats.deferrals >= (
+                scheduler.stats.deadline_escapes
+            )
+            # no breaker charge: backlog is the plane's condition, not
+            # the tenant's fault
+            assert scheduler.stats.tenant_failures == 0
+            for tid in batch:
+                assert not scheduler.breakers.is_open(tid)
+            # the escaped tenants' answers are the bit-identical mirror
+            for tid, inputs in batch.items():
+                assert_outputs_equal(
+                    CK.CostOutputs, out[tid], CK.cost_numpy(inputs),
+                    context=tid,
+                )
+        finally:
+            service.close()
+
+    def test_no_deadline_means_no_escapes(self):
+        ticks = {"now": 0.0}
+
+        def clock():
+            ticks["now"] += 1.0
+            return ticks["now"]
+
+        service, registry, scheduler = make_world(
+            n_tenants=3, weights=[10.0, 0.1, 0.1],
+            max_rows_per_round=6, clock=clock,
+        )
+        try:
+            batch = {
+                f"t{i}": random_cost_inputs(seed=50 + i, n=6)
+                for i in range(3)
+            }
+            out = scheduler.cost_all(batch, backend="numpy")
+            assert scheduler.stats.deadline_escapes == 0
+            for tid, inputs in batch.items():
+                assert_outputs_equal(
+                    CK.CostOutputs, out[tid], CK.cost_numpy(inputs),
+                    context=tid,
+                )
+        finally:
+            service.close()
+
+    def test_solve_all_weighted_timeouts(self):
+        """The bin-pack face: each tenant's queue deadline is its
+        weighted budget — an expiry serves binpack_numpy and counts a
+        deferral, not a breaker failure."""
+        import time as _time
+
+        from karpenter_tpu.ops.binpack import BinPackInputs
+        from karpenter_tpu.ops.numpy_binpack import binpack_numpy
+
+        def make_binpack_inputs(seed):
+            rng = np.random.RandomState(seed)
+            return BinPackInputs(
+                pod_requests=rng.uniform(0.1, 2.0, (8, 2)).astype(
+                    np.float32
+                ),
+                pod_valid=np.ones(8, bool),
+                pod_intolerant=np.zeros((8, 1), bool),
+                pod_required=np.zeros((8, 1), bool),
+                group_allocatable=rng.uniform(4.0, 16.0, (3, 2)).astype(
+                    np.float32
+                ),
+                group_taints=np.zeros((3, 1), bool),
+                group_labels=np.zeros((3, 1), bool),
+            )
+
+        def slow_solver(inputs, buckets=32, backend=None):
+            _time.sleep(0.25)
+            return binpack_numpy(inputs, buckets=buckets)
+
+        service = SolverService(
+            registry=GaugeRegistry(), device_solver=slow_solver,
+        )
+        metrics_registry = GaugeRegistry()
+        registry = TenantRegistry(
+            service=service, registry=metrics_registry,
+            specs=[
+                TenantSpec(id="heavy", weight=1.999),
+                TenantSpec(id="light", weight=0.001),
+            ],
+        )
+        scheduler = MultiTenantScheduler(
+            registry, service, deadline_s=10.0
+        )
+        try:
+            batch = {
+                "heavy": make_binpack_inputs(seed=3),
+                "light": make_binpack_inputs(seed=4),
+            }
+            out = scheduler.solve_all(batch, buckets=8)
+            # light's budget (10s x 0.001 / 1.0 = 10ms) expires inside
+            # the 250ms dispatch; heavy's (~20s) does not
+            assert scheduler.stats.deadline_escapes >= 1
+            assert scheduler.stats.tenant_failures == 0
+            assert not scheduler.breakers.is_open("light")
+            for tid, inputs in batch.items():
+                ref = binpack_numpy(inputs, buckets=8)
+                np.testing.assert_array_equal(
+                    np.asarray(out[tid].assigned),
+                    np.asarray(ref.assigned), err_msg=tid,
+                )
+        finally:
+            service.close()
+
+    def test_budgets_scale_with_weight(self):
+        service, registry, scheduler = make_world(
+            n_tenants=2, weights=[3.0, 1.0], deadline_s=8.0,
+        )
+        try:
+            budgets = scheduler._deadline_budgets(
+                ["t0", "t1"], registry.weights()
+            )
+            # mean weight 2.0: t0 = 8 * 3/2 = 12s, t1 = 8 * 1/2 = 4s
+            assert budgets["t0"] == pytest.approx(12.0)
+            assert budgets["t1"] == pytest.approx(4.0)
+        finally:
+            service.close()
