@@ -113,6 +113,11 @@ bench-multitenant: ## Aggregate decisions/sec at 1k simulated tenants: cross-ten
 		--backend xla --iters 10 \
 		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
 
+bench-eventloop: ## Event-driven reconcile: one seeded pod-arrival trace replayed tick-paced vs event-driven (e2e p50/p99 off karpenter_reconcile_e2e_seconds, solve amplification, 1k-event churn-storm coalescing); appends a BENCHMARKS row + publishes to BASELINE.json
+	$(PYTHON) bench.py --eventloop --eventloop-ticks 40 \
+		--eventloop-arrivals 60 --eventloop-storm 1000 \
+		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
+
 dryrun: ## Multi-chip sharding compile check on 8 virtual CPU devices
 	$(PYTHON) -c "import os; \
 		os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS','') + ' --xla_force_host_platform_device_count=8').strip(); \
@@ -152,5 +157,6 @@ kind-smoke: ## Deploy smoke on kind: image -> apply -> pod Ready -> one HA end t
 .PHONY: help dev ci test test-chaos test-recovery battletest verify codegen \
 	docs native bench bench-solver bench-hotpath bench-consolidate \
 	bench-forecast bench-preempt bench-cost bench-journal bench-trace \
-	bench-provenance bench-resident bench-shard bench-multitenant dryrun \
+	bench-provenance bench-resident bench-shard bench-multitenant \
+	bench-eventloop dryrun \
 	image publish apply delete kind-load conformance kind-smoke

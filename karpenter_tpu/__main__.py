@@ -140,6 +140,35 @@ def parse_args(argv=None) -> argparse.Namespace:
         "way; ~zero cost when off)",
     )
     parser.add_argument(
+        "--event-driven",
+        action=argparse.BooleanOptionalAction,
+        default=False,
+        help="watch events schedule debounced coalesced event passes "
+        "(sub-second reaction; docs/solver-service.md 'Event-driven "
+        "reconcile'), demoting the periodic tick to a resync backstop; "
+        "off (the default) keeps the tick-paced loop byte-identical to "
+        "previous releases",
+    )
+    parser.add_argument(
+        "--event-debounce",
+        type=float,
+        default=0.05,
+        metavar="SECONDS",
+        help="event-pass debounce window: watch events landing within "
+        "this window coalesce into ONE partial reconcile pass (bounds "
+        "solve amplification under churn storms); only meaningful with "
+        "--event-driven",
+    )
+    parser.add_argument(
+        "--prewarm-compile",
+        action="store_true",
+        help="compile the smallest bucket rungs of the always-on kernel "
+        "families (solve + decide) at boot, so a cold plane's first "
+        "event pass doesn't pay a first-touch jit compile "
+        "(docs/solver-service.md 'Compile pre-warm'); rungs the "
+        "compile cache already knows are skipped",
+    )
+    parser.add_argument(
         "--selfslo-objective",
         type=float,
         default=1.0,
@@ -277,6 +306,30 @@ def parse_args(argv=None) -> argparse.Namespace:
         help="priority assumed for pods naming an unknown "
         "PriorityClass (resolved spec.priority and the system classes "
         "always win; docs/preemption.md)",
+    )
+    parser.add_argument(
+        "--eventloop",
+        action="store_true",
+        help="with --simulate: replay a seeded pod-arrival trace "
+        "tick-paced vs event-driven and report e2e p50/p99 off the "
+        "karpenter_reconcile_e2e_seconds histogram, the solve-"
+        "amplification factor, and the churn-storm coalescing proof "
+        "(docs/solver-service.md 'Event-driven reconcile'); "
+        "--event-debounce tunes the replayed window",
+    )
+    parser.add_argument(
+        "--eventloop-arrivals",
+        type=int,
+        default=60,
+        help="with --simulate --eventloop: seeded pod arrivals in the "
+        "replayed trace",
+    )
+    parser.add_argument(
+        "--eventloop-storm",
+        type=int,
+        default=1000,
+        help="with --simulate --eventloop: churn-storm events injected "
+        "into one debounce window",
     )
     parser.add_argument(
         "--restart-storm",
@@ -420,6 +473,11 @@ def parse_args(argv=None) -> argparse.Namespace:
             f"--selfslo-objective must be > 0 seconds, got "
             f"{args.selfslo_objective}"
         )
+    if args.event_debounce < 0:
+        parser.error(
+            f"--event-debounce must be >= 0 seconds (0 = dispatch the "
+            f"pass immediately), got {args.event_debounce}"
+        )
     return args
 
 
@@ -448,7 +506,7 @@ def _run_simulation(args, store) -> int:  # lint: allow-complexity — simulatio
     if args.trace_export and not (
         args.forecast or args.restart_storm or args.preempt
         or args.consolidate or args.what_if or args.cost
-        or args.multitenant
+        or args.multitenant or args.eventloop
     ):
         # the traced end-to-end replay (docs/observability.md): a seeded
         # consolidating world driven tick by tick, exporting a trace in
@@ -490,6 +548,20 @@ def _run_simulation(args, store) -> int:  # lint: allow-complexity — simulatio
         # count): clear the flag so main's exit-time _export_trace
         # doesn't rewrite the identical file (or the decisions sibling)
         args.trace_export = None
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return 0
+
+    if args.eventloop:
+        # self-contained replay (own stores, fake provider, scripted
+        # clock): the same seeded pod-arrival trace tick-paced vs
+        # event-driven (docs/solver-service.md "Event-driven reconcile")
+        from karpenter_tpu.simulate import simulate_eventloop
+
+        report = simulate_eventloop(
+            arrivals=args.eventloop_arrivals,
+            storm_events=args.eventloop_storm,
+            debounce_s=args.event_debounce,
+        )
         print(json.dumps(report, indent=2, sort_keys=True))
         return 0
 
@@ -811,6 +883,9 @@ def main(argv=None) -> int:
             provenance=args.provenance,
             selfslo_objective_s=args.selfslo_objective,
             selfslo_target=args.selfslo_target,
+            event_driven=args.event_driven,
+            event_debounce_s=args.event_debounce,
+            prewarm_compile=args.prewarm_compile,
         ),
         store=store,
     )

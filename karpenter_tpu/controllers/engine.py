@@ -31,10 +31,42 @@ errors.is_retryable —
 
 A failed status patch itself backs off too (the store is a dependency
 like any other).
+
+EVENT-DRIVEN RECONCILE (docs/solver-service.md "Event-driven
+reconcile"): with `event_driven=True` a watch event no longer waits for
+the next tick. `_on_event` marks the key DIRTY and schedules a
+COALESCED EVENT PASS — after a short debounce window (so an event storm
+batches into one pass, not one pass per event) a partial reconcile_all
+runs over only the dirty keys that are actually due. The periodic tick
+is demoted to a RESYNC BACKSTOP: it still runs every interval for drift
+repair, interval-driven requeues, backoff/deactivation revival, the
+tick hook consumers (recovery warm-up counting, self-SLO evaluation)
+and gauge publication — none of which fire per event. Invariants:
+
+  * one pass at a time: ticks and event passes serialize on one lock,
+    and dueness is re-checked under it, so a key reconciled by the tick
+    (and requeued at now+interval) is skipped by a racing event pass —
+    never double-reconciled;
+  * the ladder holds: a key parked on retryable backoff or DEACTIVATED
+    is revived by a DIRECT watch event exactly as before (due=0), and a
+    failure inside an event pass walks the same _requeue ladder a tick
+    failure does;
+  * the watch callback thread never blocks: marking dirty is a set-add
+    + signal; the pass itself runs on the manager's event thread (or
+    whoever calls run_event_pass in simulated-time harnesses);
+  * controllers may additionally declare `event_routes() -> (kinds,)`:
+    events on those kinds mark the controller's OWN objects dirty
+    (a pending Pod wakes the pendingCapacity producers; a refreshed
+    producer wakes the autoscalers) — routed dirtying never revives
+    backoff/deactivated keys, only a direct event does.
+
+With event_driven=False (the default) none of this machinery is built
+and the loop is byte-identical to the tick-paced engine.
 """
 
 from __future__ import annotations
 
+import threading
 import time as _time
 from typing import Dict, List, Optional, Protocol
 
@@ -71,6 +103,9 @@ class Manager:
         backoff_seed: int = 0,
         tick_hook=None,
         recovery_journal=None,
+        event_driven: bool = False,
+        event_debounce_s: float = 0.05,
+        event_thread: bool = True,
     ):
         self.store = store
         self.clock = clock
@@ -101,12 +136,42 @@ class Manager:
             base_s=backoff_base_s, cap_s=backoff_cap_s, seed=backoff_seed
         )
         self._backoff_prev: Dict[tuple, float] = {}
+        # event-driven reconcile (module docstring): the dirty-key set
+        # feeding coalesced event passes, the lock serializing passes
+        # (tick AND event) and the debounce/scheduler state. All of it
+        # is inert when event_driven is False.
+        self.event_driven = event_driven
+        self.event_debounce_s = event_debounce_s
+        self._own_event_thread = event_thread
+        self._dirty: set = set()
+        self._dirty_lock = threading.Lock()
+        # mid-reconcile event race (event-driven mode only): a watch
+        # event landing while a pass is BETWEEN fetching a key's object
+        # and requeueing it acted on state the reconcile never saw —
+        # and the interval requeue would overwrite the event's due-now
+        # stamp, parking the key until the backstop tick. Each external
+        # event bumps the key's sequence; the pass snapshots it at
+        # collection time and a changed sequence at requeue time keeps
+        # the key due-now + dirty instead.
+        self._event_seq: Dict[tuple, int] = {}
+        self._pass_seq: Dict[tuple, int] = {}
+        self._dirty_since: Optional[float] = None
+        self._pass_lock = threading.Lock()
+        self._event_signal = threading.Event()
+        self._event_worker: Optional[threading.Thread] = None
+        self._closed = False
+        # the key whose status THIS thread is currently patching inside
+        # _finish: its own watch echo must not re-stamp a just-retired
+        # e2e mark (or schedule an event pass) — see _on_event
+        self._patching = threading.local()
         # self-observability (the reference gets controller-runtime's
         # metrics for free; here the manager publishes its own):
         # karpenter_runtime_{tick_seconds,reconciles_total,
         # reconcile_errors_total}{name=<kind>|manager}
         self._tick_gauge = self._count_gauge = self._error_gauge = None
         self._backoff_gauge = self._deactivated_gauge = None
+        self._event_pass_gauge = self._event_keys_gauge = None
+        self._event_debounce_gauge = None
         if registry is not None:
             self._tick_gauge = registry.register("runtime", "tick_seconds")
             self._count_gauge = registry.register(
@@ -114,6 +179,20 @@ class Manager:
             )
             self._error_gauge = registry.register(
                 "runtime", "reconcile_errors_total", kind="counter"
+            )
+            # event-pass observability (module docstring): passes that
+            # dispatched >= 1 due key, the keys they carried, and the
+            # last pass's measured debounce gather (first dirty mark ->
+            # pass start) — the coalescing signal an operator tunes
+            # --event-debounce against
+            self._event_pass_gauge = registry.register(
+                "runtime", "event_passes_total", kind="counter"
+            )
+            self._event_keys_gauge = registry.register(
+                "runtime", "event_pass_keys_total", kind="counter"
+            )
+            self._event_debounce_gauge = registry.register(
+                "runtime", "event_debounce_ms"
             )
             # ladder observability: the last requeue backoff per kind and
             # how many objects have been deactivated by non-retryable
@@ -138,13 +217,62 @@ class Manager:
             if getattr(controller, "acks_e2e", False):
                 self._e2e_kinds.add(controller.kind())
             self.store.watch(controller.kind(), self._on_event)
+            self._register_routes(controller)
         return self
+
+    def _register_routes(self, controller) -> None:
+        """Event-driven mode only: a controller's `event_routes()` names
+        EXTRA kinds whose events make the controller's own objects dirty
+        (module docstring). Tick-paced mode registers nothing — routed
+        kinds see zero new callbacks and behavior stays byte-identical."""
+        if not self.event_driven:
+            return
+        routes = getattr(controller, "event_routes", None)
+        if routes is None:
+            return
+        from functools import partial
+
+        for kind in routes():
+            self.store.watch(
+                kind, partial(self._on_routed_event, controller)
+            )
+
+    def _on_routed_event(self, controller, event: str, obj) -> None:
+        """A routed kind changed (a Pod appeared, a producer refreshed):
+        mark the controller's own objects dirty so the next event pass
+        re-evaluates them against the fresh signal. Routed dirtying is
+        WEAKER than a direct watch event: it only pulls due times
+        FORWARD for keys on the plain interval schedule — keys riding
+        the retryable-backoff ladder or DEACTIVATED stay parked (only a
+        direct event on the object itself revives, preserving the
+        failure ladder under routed churn). Deletes route too — a
+        removed pod frees capacity, which is as much a signal as a new
+        one. The ladder guard and the due-now stamp are ONE critical
+        section on the dirty lock — the ladder's own due writes
+        (_requeue_backoff, _deactivate) take the same lock, so this
+        check can never interleave with a parking write and erase it."""
+        keys = self.store.keys(controller.kind())
+        marked = False
+        with self._dirty_lock:
+            for key in keys:
+                if key in self._backoff_prev:
+                    continue  # parked on the retryable ladder
+                if self._due.get(key, 0.0) == _NEVER:
+                    continue  # deactivated
+                self._due[key] = 0.0
+                self._event_seq[key] = self._event_seq.get(key, 0) + 1
+                self._mark_dirty_locked(key)
+                marked = True
+        if marked:
+            self._wake_event_worker()
 
     def _on_event(self, event: str, obj) -> None:
         key = (obj.KIND, obj.metadata.namespace, obj.metadata.name)
         if event == "Deleted":
             self._due.pop(key, None)
             self._drop_backoff(key)
+            self._event_seq.pop(key, None)
+            self._pass_seq.pop(key, None)
             default_tracer().drop_observed(key)
             # controllers may keep per-object state of their own (the
             # SNG controller's circuit breakers + gauge series): give
@@ -153,6 +281,21 @@ class Manager:
                 hook = getattr(controller, "on_deleted", None)
                 if hook is not None and controller.kind() == obj.KIND:
                     hook(obj)
+        elif (
+            self.event_driven
+            and getattr(self._patching, "key", None) == key
+        ):
+            # the engine's OWN status-patch echo (fired synchronously
+            # from inside _finish, on this thread): _requeue — running
+            # immediately after — owns this key's due time, and
+            # re-stamping the e2e mark the reconcile just retired would
+            # measure the NEXT divergence from our own write instead of
+            # from its triggering event (the staleness that dominated
+            # sub-second event passes). External writes racing the
+            # patch arrive on other threads and are untouched. Gated on
+            # event_driven: tick-paced mode keeps the pre-PR echo
+            # semantics byte for byte (the wire-compat contract).
+            return
         else:
             # watch events trigger immediate reconcile on the next tick,
             # overriding any scheduled requeue (the reference's watch-driven
@@ -175,6 +318,122 @@ class Manager:
             # interval
             if obj.KIND in self._e2e_kinds:
                 default_tracer().mark_observed(key, overwrite=False)
+            # event-driven mode: schedule the coalesced event pass and
+            # bump the key's event sequence so a reconcile racing this
+            # event detects it at requeue time (_note_event and the
+            # _requeue re-check serialize on the dirty lock, so the
+            # bump and the due-now stamp are atomic vs the re-check)
+            if self.event_driven:
+                self._note_event(key)
+
+    # -- event passes (module docstring) -----------------------------------
+
+    def _mark_dirty_locked(self, key: tuple) -> None:
+        """Set-add only (caller holds the dirty lock): the writer
+        thread never waits on reconcile work."""
+        self._dirty.add(key)
+        if self._dirty_since is None:
+            self._dirty_since = self.clock()
+
+    def _wake_event_worker(self) -> None:
+        self._event_signal.set()
+        if self._own_event_thread:
+            self._ensure_event_worker()
+
+    def _note_event(self, key: tuple) -> None:
+        """Record one external event on `key`: due-now stamp, sequence
+        bump, dirty mark — all under the dirty lock, so the bump can
+        never land between _requeue's staleness comparison and its
+        interval due-write (which would let the interval overwrite the
+        event's due-now stamp and park the key until the backstop)."""
+        with self._dirty_lock:
+            self._due[key] = 0.0
+            self._event_seq[key] = self._event_seq.get(key, 0) + 1
+            self._mark_dirty_locked(key)
+        self._wake_event_worker()
+
+    def _ensure_event_worker(self) -> None:
+        if self._event_worker is not None or self._closed:
+            return
+        with self._dirty_lock:
+            if self._event_worker is not None or self._closed:
+                return
+            self._event_worker = threading.Thread(
+                target=self._event_loop,
+                name="manager-event-pass",
+                daemon=True,
+            )
+            self._event_worker.start()
+
+    def _event_loop(self) -> None:
+        """The debounced scheduler: wake on the first dirty mark, sleep
+        the debounce window out (events landing meanwhile join the same
+        pass), run ONE coalesced pass, repeat."""
+        while not self._closed:
+            self._event_signal.wait()
+            if self._closed:
+                return
+            self._event_signal.clear()
+            _time.sleep(self.event_debounce_s)
+            try:
+                self.run_event_pass()
+            except Exception:  # noqa: BLE001 — the backstop tick repairs
+                logger().exception("event pass failed; tick will resync")
+
+    def dirty_count(self) -> int:
+        """Keys awaiting an event pass (simulated-time harnesses poll
+        this to drive run_event_pass without the wall-clock thread)."""
+        with self._dirty_lock:
+            return len(self._dirty)
+
+    def run_event_pass(self) -> int:
+        """One coalesced event pass: swap out the dirty set, reconcile
+        the dirty keys that are DUE, return how many were dispatched.
+
+        Dueness is re-checked under the pass lock — a key the tick (or
+        a previous pass) just reconciled was requeued at now+interval
+        and is skipped here, which is the no-double-reconcile guarantee.
+        Tick consumers (tick_hook, solver gauge publication) explicitly
+        do NOT run: they stay on the tick cadence."""
+        with self._dirty_lock:
+            if not self._dirty:
+                return 0
+            dirty, self._dirty = self._dirty, set()
+            since, self._dirty_since = self._dirty_since, None
+        with self._pass_lock:
+            now = self.clock()
+            # a dirty key only ever becomes due via an event's due-now
+            # stamp — a MISSING entry means the object was deleted after
+            # dirtying (the Deleted handler pops _due), so it must not
+            # default to due-now and inflate the pass gauges
+            due = {
+                k for k in dirty
+                if (d := self._due.get(k)) is not None and d <= now
+            }
+            if not due:
+                return 0
+            if self._event_debounce_gauge is not None and since is not None:
+                self._event_debounce_gauge.set(
+                    "manager", "-", max(0.0, now - since) * 1e3
+                )
+            with default_tracer().trace(
+                "reconcile.event_pass", keys=len(due)
+            ):
+                for controller in self._controllers:
+                    self._reconcile_controller(controller, now, keys=due)
+        self._count(self._event_pass_gauge, "manager")
+        self._count(self._event_keys_gauge, "manager", float(len(due)))
+        return len(due)
+
+    def close(self) -> None:
+        """Stop the event-pass thread (idempotent; a tick-paced manager
+        has nothing to stop)."""
+        self._closed = True
+        self._event_signal.set()
+        worker = self._event_worker
+        if worker is not None:
+            worker.join(timeout=5.0)
+            self._event_worker = None
 
     # -- the generic workflow (reference: controller.go:67-97) -------------
 
@@ -201,6 +460,7 @@ class Manager:
         self._count(self._count_gauge, obj.KIND)
         if error is not None:
             self._count(self._error_gauge, obj.KIND)
+        self._patching.key = self._key_of(obj)
         try:
             patched = self.store.patch_status(obj)
         except KeyError:
@@ -218,6 +478,8 @@ class Manager:
             self._count(self._error_gauge, obj.KIND)
             self._requeue_backoff(self._key_of(obj))
             return
+        finally:
+            self._patching.key = None
         self._requeue(controller, self._key_of(obj), error, patched)
 
     @staticmethod
@@ -229,13 +491,41 @@ class Manager:
     ) -> None:
         """The supervised requeue ladder: interval on success, jittered
         backoff on retryable failure, deactivation on non-retryable."""
+        observed_seq = self._pass_seq.pop(key, None)
         if error is None:
             self._drop_backoff(key)
-            self._due[key] = self.clock() + controller.interval()
+            self._requeue_success(controller, key, observed_seq)
         elif is_retryable(error):
             self._requeue_backoff(key)
         else:
             self._deactivate(key, patched)
+
+    def _requeue_success(self, controller, key, observed_seq) -> None:
+        """Interval requeue after a successful reconcile — unless a
+        watch event raced it (landed after the object was fetched): the
+        state just acted on is already stale, so the key stays due-now
+        + dirty and the next pass re-reconciles, instead of the
+        interval requeue silently swallowing the event until the
+        backstop tick (the _deactivate resourceVersion re-check,
+        generalized to the success path). Comparison and due-write are
+        one critical section with _note_event: a bump can never land
+        between them unseen."""
+        if not self.event_driven:
+            self._due[key] = self.clock() + controller.interval()
+            return
+        with self._dirty_lock:
+            if (
+                observed_seq is not None
+                and self._event_seq.get(key, 0) != observed_seq
+            ):
+                self._due[key] = 0.0
+                self._mark_dirty_locked(key)
+                raced = True
+            else:
+                self._due[key] = self.clock() + controller.interval()
+                raced = False
+        if raced:
+            self._wake_event_worker()
 
     def _deactivate(self, key, patched) -> None:
         """DEACTIVATE: no requeue until a watch event revives the
@@ -261,9 +551,12 @@ class Manager:
         self._drop_backoff(key)
         # a deactivated object will not actuate until revived: retire
         # any pending e2e mark so the revival's actuation measures from
-        # the reviving edit, not from before the deactivation
+        # the reviving edit, not from before the deactivation. The due
+        # write takes the dirty lock so the routed-event guard cannot
+        # interleave and erase the inf stamp (_on_routed_event).
         default_tracer().drop_observed(key)
-        self._due[key] = _NEVER
+        with self._dirty_lock:
+            self._due[key] = _NEVER
         if self._deactivated_gauge is not None:
             self._deactivated_gauge.inc(key[0], "-")
 
@@ -278,8 +571,12 @@ class Manager:
 
     def _requeue_backoff(self, key) -> None:
         delay = self._backoff.next(self._backoff_prev.get(key, 0.0))
-        self._backoff_prev[key] = delay
-        self._due[key] = self.clock() + delay
+        # under the dirty lock: the routed-event guard reads the ladder
+        # (_on_routed_event) and must never interleave between these
+        # two writes — it would revive a key the ladder is parking
+        with self._dirty_lock:
+            self._backoff_prev[key] = delay
+            self._due[key] = self.clock() + delay
         if self._journal is not None:
             self._journal.set(
                 key, {"prev": delay, "due": self._due[key]}
@@ -331,6 +628,34 @@ class Manager:
                 "the journal", restored,
             )
 
+    def _due_objects(self, kind: str, now: float, keys) -> list:
+        """Due objects of `kind`: the full key sweep on a tick, the
+        dirty-key slice on an event pass. Dueness is decided on keys so
+        idle ticks never deep-copy the fleet; only due objects are
+        fetched."""
+        candidates = (
+            self.store.keys(kind)
+            if keys is None
+            else [k for k in keys if k[0] == kind]
+        )
+        due_objs = []
+        for key in candidates:
+            if self._due.get(key, 0.0) > now:
+                continue
+            if self.event_driven:
+                # snapshot the event sequence BEFORE fetching: an event
+                # landing after the snapshot (even mid-fetch) shows up
+                # as a seq change at requeue time and re-reconciles. The
+                # reverse order would fold a mid-collection event into
+                # the snapshot and let the interval requeue swallow it —
+                # spurious re-reconciles are safe, swallowed events are
+                # not (_requeue_success re-checks).
+                self._pass_seq[key] = self._event_seq.get(key, 0)
+            obj = self.store.try_get(*key)
+            if obj is not None:
+                due_objs.append(obj)
+        return due_objs
+
     def _validate(self, obj) -> Optional[Exception]:
         try:
             obj.validate()
@@ -338,18 +663,15 @@ class Manager:
         except Exception as e:  # noqa: BLE001
             return e
 
-    def _reconcile_controller(self, controller, now: float) -> None:
-        """One controller's slice of the tick: collect due objects,
-        validate, dispatch."""
+    def _reconcile_controller(
+        self, controller, now: float, keys=None
+    ) -> None:
+        """One controller's slice of the pass: collect due objects,
+        validate, dispatch. `keys=None` is the full tick sweep; an event
+        pass restricts the sweep to its dirty keys (already filtered for
+        dueness, re-filtered here for the kind)."""
         kind = controller.kind()
-        # dueness is decided on keys so idle ticks never deep-copy the
-        # fleet; only due objects are fetched
-        due_objs = [
-            obj
-            for key in self.store.keys(kind)
-            if self._due.get(key, 0.0) <= now
-            and (obj := self.store.try_get(*key)) is not None
-        ]
+        due_objs = self._due_objects(kind, now, keys)
         if not due_objs:
             return
 
@@ -410,10 +732,14 @@ class Manager:
         stack, so one trace connects a watch event to the coalesced
         dispatch to the provider write it caused."""
         start = _time.perf_counter()
-        now = self.clock()
-        with default_tracer().trace("reconcile.tick"):
-            for controller in self._controllers:
-                self._reconcile_controller(controller, now)
+        # one pass at a time: a tick and an event pass must never
+        # reconcile concurrently (run_event_pass holds the same lock);
+        # with event_driven off the lock is always uncontended
+        with self._pass_lock:
+            now = self.clock()
+            with default_tracer().trace("reconcile.tick"):
+                for controller in self._controllers:
+                    self._reconcile_controller(controller, now)
         if self._solver_service is not None:
             self._solver_service.publish_gauges()
         if self._tick_hook is not None:

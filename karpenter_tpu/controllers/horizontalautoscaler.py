@@ -34,6 +34,16 @@ class HorizontalAutoscalerController:
     def interval(self) -> float:
         return 10.0
 
+    @staticmethod
+    def event_routes() -> tuple:
+        """Event-driven mode (engine module docstring): autoscalers
+        decide off producer-published gauges, and producers run first in
+        tick order precisely so those signals are fresh — a refreshed
+        producer status is therefore the 'new signal available' edge
+        that should trigger a re-decide now, not at the next interval.
+        Tick-paced mode never registers this watch."""
+        return ("MetricsProducer",)
+
     def on_deleted(self, ha) -> None:
         """Engine pruning signal: drop the deleted autoscaler's metric
         history, skill state, and forecast gauges (forecast/engine.py) —

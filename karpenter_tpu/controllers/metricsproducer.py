@@ -39,6 +39,16 @@ class MetricsProducerController:
     def interval(self) -> float:
         return 5.0
 
+    @staticmethod
+    def event_routes() -> tuple:
+        """Event-driven mode (engine module docstring): a Pod appearing,
+        binding, or vanishing — and a Node joining or draining — changes
+        the very capacity picture pendingCapacity producers exist to
+        measure, so those events pull every producer due-now into the
+        next coalesced event pass instead of waiting out the interval.
+        Tick-paced mode never registers these watches."""
+        return ("Pod", "Node")
+
     def on_deleted(self, mp) -> None:
         """Retire a deleted producer's gauge series (module constant):
         series are keyed {name, namespace} per producer, so a deleted

@@ -152,6 +152,28 @@ class Options:
     # and the SLO target the multi-window burn rates measure against.
     selfslo_objective_s: float = 1.0
     selfslo_target: float = 0.99
+    # event-driven reconcile (docs/solver-service.md "Event-driven
+    # reconcile"): watch events schedule debounced coalesced event
+    # passes over the dirty keys, demoting the periodic tick to a
+    # resync backstop. Off by default — the tick-paced loop is
+    # byte-identical with the flag absent (--event-driven). The
+    # debounce window bounds solve amplification under event storms:
+    # everything landing inside one window rides ONE pass.
+    event_driven: bool = False
+    event_debounce_s: float = 0.05
+    # INTERNAL (simulate + tests): False runs NO debounce thread — the
+    # harness drives Manager.run_event_pass itself on the scripted
+    # clock, keeping replays deterministic. The CLI never sets this.
+    event_thread: bool = True
+    # boot-time compile-cache pre-warm (docs/solver-service.md
+    # "Compile pre-warm"): compile the smallest bucket rungs of the
+    # always-on kernel families (solve + decide) before the first
+    # event arrives, so a cold plane's first event pass doesn't eat a
+    # first-touch jit compile (hotpath BASELINE: idle p99 533 ms vs
+    # p50 30 ms). Skipped per rung when the compile cache already
+    # hits; the persistent cache (KARPENTER_COMPILE_CACHE) turns the
+    # remaining cost into a disk read.
+    prewarm_compile: bool = False
 
 
 class KarpenterRuntime:
@@ -345,6 +367,9 @@ class KarpenterRuntime:
             backoff_cap_s=options.backoff_cap_s,
             tick_hook=tick_hook,
             recovery_journal=backoff_journal,
+            event_driven=options.event_driven,
+            event_debounce_s=options.event_debounce_s,
+            event_thread=options.event_thread,
         ).register(
             MetricsProducerController(self.producer_factory),
             self._sng_controller,
@@ -355,6 +380,15 @@ class KarpenterRuntime:
         self._build_tenancy(options)
         self._build_selfslo(options)
         self._finish_recovery_boot()
+        self._maybe_prewarm(options)
+
+    def _maybe_prewarm(self, options: Options) -> None:
+        """Boot-time compile pre-warm (docs/solver-service.md "Compile
+        pre-warm"), run LAST: the warm-up drives real (tiny) dispatches
+        through the fully-wired service, so it must not race recovery
+        restore or observe a half-built runtime."""
+        if options.prewarm_compile:
+            self.solver_service.prewarm()
 
     def _build_tenancy(self, options: Options) -> None:
         """Multi-tenant control plane (docs/multitenancy.md): with a
@@ -574,6 +608,8 @@ class KarpenterRuntime:
         self.manager.run(duration)
 
     def close(self) -> None:
+        if self.manager is not None:
+            self.manager.close()
         if self.tenancy is not None:
             self.tenancy.close()
             self.tenancy = None

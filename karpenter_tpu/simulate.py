@@ -456,6 +456,308 @@ def simulate_trace(export_path: Optional[str] = None) -> dict:  # lint: allow-co
     return report
 
 
+def _recording_provider():
+    """FakeFactory that records every provider write as (group_id,
+    count) in `.writes` — the shared actuation ledger of the eventloop
+    and restart-storm replays (one definition, so the two replays'
+    write accounting can never drift apart)."""
+    from karpenter_tpu.cloudprovider.fake import (
+        FakeFactory, FakeNodeGroup,
+    )
+
+    class _RecordingGroup(FakeNodeGroup):
+        def set_replicas(self, count, token=None):
+            super().set_replicas(count, token=token)
+            self._factory.writes.append((self._id, count))
+
+    class _RecordingFactory(FakeFactory):
+        def __init__(self):
+            super().__init__()
+            self.writes = []
+
+        def node_group_for(self, spec):
+            return _RecordingGroup(self, spec.id)
+
+    return _RecordingFactory()
+
+
+def _eventloop_world(event_driven: bool, debounce_s: float, clock_fn):
+    """One seeded autoscaling world for the event-loop replay: a node
+    pool, a pendingCapacity producer, a queue-driven autoscaler, and a
+    fake provider. `event_thread=False` — the replay drives event
+    passes itself on the scripted clock, so both arms are wall-free."""
+    from karpenter_tpu.api.core import (
+        Node, NodeCondition, NodeSpec, NodeStatus, ObjectMeta,
+        resource_list,
+    )
+    from karpenter_tpu.api.horizontalautoscaler import (
+        CrossVersionObjectReference, HorizontalAutoscaler,
+        HorizontalAutoscalerSpec, Metric, MetricTarget,
+        PrometheusMetricSource,
+    )
+    from karpenter_tpu.api.metricsproducer import (
+        MetricsProducer, MetricsProducerSpec, PendingCapacitySpec,
+    )
+    from karpenter_tpu.api.scalablenodegroup import (
+        FAKE_NODE_GROUP, ScalableNodeGroup, ScalableNodeGroupSpec,
+    )
+    from karpenter_tpu.runtime import KarpenterRuntime, Options
+
+    provider = _recording_provider()
+    provider.node_replicas["grp-id"] = 3
+    runtime = KarpenterRuntime(
+        Options(
+            event_driven=event_driven,
+            event_debounce_s=debounce_s,
+            event_thread=False,
+        ),
+        cloud_provider_factory=provider,
+        clock=clock_fn,
+    )
+    store = runtime.store
+    store.create(Node(
+        metadata=ObjectMeta(name="n0", labels={"pool": "a"}),
+        spec=NodeSpec(),
+        status=NodeStatus(
+            allocatable=resource_list(cpu="8", memory="16Gi", pods="16"),
+            conditions=[NodeCondition("Ready", "True")],
+        ),
+    ))
+    store.create(MetricsProducer(
+        metadata=ObjectMeta(name="pending"),
+        spec=MetricsProducerSpec(
+            pending_capacity=PendingCapacitySpec(
+                node_selector={"pool": "a"}, node_group_ref="grp",
+            )
+        ),
+    ))
+    store.create(ScalableNodeGroup(
+        metadata=ObjectMeta(name="grp"),
+        spec=ScalableNodeGroupSpec(
+            replicas=3, type=FAKE_NODE_GROUP, id="grp-id",
+        ),
+    ))
+    store.create(HorizontalAutoscaler(
+        metadata=ObjectMeta(name="ha"),
+        spec=HorizontalAutoscalerSpec(
+            scale_target_ref=CrossVersionObjectReference(
+                kind="ScalableNodeGroup", name="grp"
+            ),
+            min_replicas=2, max_replicas=400,
+            metrics=[Metric(prometheus=PrometheusMetricSource(
+                query='karpenter_queue_length{name="q"}',
+                target=MetricTarget(type="AverageValue", value=4),
+            ))],
+        ),
+    ))
+    gauge = runtime.registry.register("queue", "length")
+    gauge.set("q", "default", 12.0)
+    return runtime, provider, gauge
+
+
+def simulate_eventloop(  # lint: allow-complexity — scenario assembly: two arms + churn-storm arm + report
+    ticks: int = 40,
+    interval_s: float = 10.0,
+    arrivals: int = 60,
+    storm_events: int = 1000,
+    debounce_s: float = 0.05,
+    demand_step: float = 4.0,
+    seed: int = 0,
+) -> dict:
+    """The event-driven-reconcile proof replay (docs/solver-service.md
+    "Event-driven reconcile"): ONE seeded pod-arrival trace — `arrivals`
+    pending pods at uniform-random times over `ticks` backstop
+    intervals, each bumping queue demand by `demand_step` — replayed
+    through two otherwise-identical worlds:
+
+      tick-paced    the pre-PR loop: watch events mark objects due-now
+                    but every reconcile waits for the next `interval_s`
+                    tick, so the karpenter_reconcile_e2e_seconds sample
+                    for each actuation is ~one full interval;
+      event-driven  watch events cascade through debounced coalesced
+                    event passes (pod -> producer solve -> autoscaler
+                    decide -> node-group actuation), each hop one
+                    `debounce_s` window — sub-second end to end.
+
+    Both arms read e2e p50/p99 off the SAME histogram the live plane
+    serves (HistogramVec.percentile — the number an operator's
+    histogram_quantile() shows), count their solver work (bin-pack
+    requests + fleet decides) for the amplification column, and must
+    land on the SAME fleet fixed point. The event world then takes a
+    CHURN STORM — `storm_events` pod events inside one debounce window —
+    which must coalesce into a handful of passes (not one per event)
+    with solve amplification bounded vs one backstop tick's work.
+
+    Wall-clock-free and fully deterministic under `seed`: scripted
+    clock, manual event passes (Options.event_thread=False), seeded
+    arrival times."""
+    from karpenter_tpu.api.core import ObjectMeta, Pod, PodSpec
+    from karpenter_tpu.observability import (
+        Tracer, reset_default_tracer, set_default_tracer,
+    )
+
+    rng = np.random.RandomState(seed)
+    times = np.sort(
+        rng.uniform(0.0, ticks * interval_s, size=arrivals)
+    ).tolist()
+    epoch = 1_000_000.0
+
+    def replay(event_driven: bool) -> dict:
+        clock = {"now": epoch}
+        # the e2e histogram must measure SIMULATED lead time (ticks are
+        # replayed far faster than the interval they model), so the
+        # tracer runs on the scripted clock for this arm
+        set_default_tracer(Tracer(clock=lambda: clock["now"]))
+        runtime, provider, gauge = _eventloop_world(
+            event_driven, debounce_s, lambda: clock["now"]
+        )
+        manager = runtime.manager
+        store = runtime.store
+        stats = runtime.solver_service.stats
+
+        def solves() -> int:
+            return stats.requests + stats.decide_calls
+
+        def passes() -> float:
+            value = runtime.registry.gauge(
+                "runtime", "event_passes_total"
+            ).get("manager", "-")
+            return float(value or 0.0)
+
+        def drain(limit: int = 6) -> None:
+            """The debounce thread's job, on the scripted clock: each
+            pending pass costs one debounce window of simulated time."""
+            for _ in range(limit):
+                if manager.dirty_count() == 0:
+                    return
+                clock["now"] += debounce_s
+                manager.run_event_pass()
+
+        demand = 12.0
+        next_arrival = 0
+        try:
+            for k in range(1, ticks + 1):
+                while (
+                    next_arrival < len(times)
+                    and times[next_arrival] < k * interval_s
+                ):
+                    clock["now"] = max(
+                        clock["now"], epoch + times[next_arrival]
+                    )
+                    demand += demand_step
+                    gauge.set("q", "default", demand)
+                    store.create(Pod(
+                        metadata=ObjectMeta(
+                            name=f"arrival-{next_arrival}"
+                        ),
+                        spec=PodSpec(),
+                    ))
+                    if event_driven:
+                        drain()
+                    next_arrival += 1
+                clock["now"] = max(clock["now"], epoch + k * interval_s)
+                manager.reconcile_all()
+                if event_driven:
+                    drain()
+            # settle: the trace's tail actuations need one more hop
+            for _ in range(3):
+                clock["now"] += interval_s
+                manager.reconcile_all()
+                if event_driven:
+                    drain()
+            trace_solves = solves()
+            hist = runtime.registry.gauge("reconcile", "e2e_seconds")
+            arm = {
+                "e2e_seconds": {
+                    "p50_s": hist.percentile(
+                        "ScalableNodeGroup", "-", 50
+                    ),
+                    "p99_s": hist.percentile(
+                        "ScalableNodeGroup", "-", 99
+                    ),
+                    "n": hist.count("ScalableNodeGroup", "-"),
+                },
+                "solves": trace_solves,
+                "replicas_after": provider.node_replicas["grp-id"],
+                "provider_writes": len(provider.writes),
+            }
+            if not event_driven:
+                return arm
+            arm["event_passes"] = passes()
+            # -- churn-storm arm: storm_events pod events, ONE window --
+            storm_t0 = clock["now"]
+            s0, p0 = solves(), passes()
+            for i in range(storm_events):
+                store.create(Pod(
+                    metadata=ObjectMeta(name=f"storm-{i}"),
+                    spec=PodSpec(),
+                ))
+            drain(limit=8)
+            storm_solves = solves() - s0
+            storm_passes = passes() - p0
+            # measured BEFORE the comparator tick advances the clock:
+            # this is the simulated time the storm's passes spanned
+            storm_window = round(clock["now"] - storm_t0, 3)
+            # the tick-paced comparator: ONE backstop tick over a
+            # freshly-churned world is the work a tick-paced loop would
+            # have spent reacting to the storm (the extra pod keeps the
+            # encoder's unchanged-cluster memo from eliding the tick's
+            # solve, which would flatter the storm ratio)
+            s1 = solves()
+            store.create(Pod(
+                metadata=ObjectMeta(name="storm-comparator"),
+                spec=PodSpec(),
+            ))
+            clock["now"] += interval_s
+            manager.reconcile_all()
+            tick_solves = max(1, solves() - s1)
+            arm["storm"] = {
+                "events": storm_events,
+                "passes": storm_passes,
+                "solves": storm_solves,
+                "window_s": storm_window,
+                "amplification": round(storm_solves / tick_solves, 2),
+            }
+            return arm
+        finally:
+            runtime.close()
+
+    try:
+        tick_arm = replay(False)
+        event_arm = replay(True)
+    finally:
+        # never leak a scripted-clock tracer into the process default
+        reset_default_tracer()
+
+    tick_p99 = tick_arm["e2e_seconds"]["p99_s"] or 0.0
+    event_p99 = event_arm["e2e_seconds"]["p99_s"] or 0.0
+    return {
+        "config": {
+            "ticks": ticks,
+            "interval_s": interval_s,
+            "arrivals": arrivals,
+            "storm_events": storm_events,
+            "debounce_s": debounce_s,
+            "demand_step": demand_step,
+            "seed": seed,
+        },
+        "tick_paced": tick_arm,
+        "event_driven": event_arm,
+        "e2e_p99_s": {
+            "tick_paced": tick_p99,
+            "event_driven": event_p99,
+            "speedup": round(tick_p99 / event_p99, 1)
+            if event_p99 else None,
+        },
+        "solve_amplification": round(
+            event_arm["solves"] / max(1, tick_arm["solves"]), 2
+        ),
+        "fixed_point_match": (
+            tick_arm["replicas_after"] == event_arm["replicas_after"]
+        ),
+    }
+
+
 def simulate_forecast(  # lint: allow-complexity — scenario assembly: world build + two replays + report
     ticks: int = 90,
     interval_s: float = 10.0,
@@ -1051,7 +1353,6 @@ def simulate_restart_storm(  # lint: allow-complexity — scenario assembly: cra
         ScalableNodeGroup,
         ScalableNodeGroupSpec,
     )
-    from karpenter_tpu.cloudprovider.fake import FakeFactory, FakeNodeGroup
     from karpenter_tpu.faults import (
         FaultRegistry,
         ProcessCrash,
@@ -1066,22 +1367,9 @@ def simulate_restart_storm(  # lint: allow-complexity — scenario assembly: cra
     own_dir = journal_dir is None
     journal_dir = journal_dir or tempfile.mkdtemp(prefix="karpenter-storm-")
 
-    class _RecordingGroup(FakeNodeGroup):
-        def set_replicas(self, count, token=None):
-            super().set_replicas(count, token=token)
-            self._factory.actuations.append((self._id, count))
-
-    class _RecordingFactory(FakeFactory):
-        def __init__(self):
-            super().__init__()
-            self.actuations = []
-
-        def node_group_for(self, spec):
-            return _RecordingGroup(self, spec.id)
-
     q = Quantity.parse
     store = Store()
-    provider = _RecordingFactory()
+    provider = _recording_provider()
     provider.node_replicas["grp-id"] = nodes
     clock = {"now": 1_000_000.0}
     store.create(
@@ -1244,14 +1532,14 @@ def simulate_restart_storm(  # lint: allow-complexity — scenario assembly: cra
             "fence_generation": fence_generation,
             "fence_rejections": provider.fence_validator.rejections,
             "stale_replay_applied": stale_applied,
-            "actuations": list(provider.actuations),
+            "actuations": list(provider.writes),
             # a duplicate is the SAME (group, count) write landing again
             # with no other transition in between — a replayed decision,
             # not a later legitimate return to a previous size
             "duplicate_actuations": sum(
                 1
                 for a, b in zip(
-                    provider.actuations, provider.actuations[1:]
+                    provider.writes, provider.writes[1:]
                 )
                 if a == b
             ),

@@ -725,6 +725,196 @@ class TestCostChaos:
             runtime.close()
 
 
+class TestEventStormChaos:
+    """ISSUE 14 acceptance: a seeded 1k-event churn storm inside one
+    debounce window coalesces into a handful of event passes (not one
+    per event), lands on the same fixed point as the tick-paced loop,
+    keeps the self-SLO fast windows under threshold — and at 100%
+    solver faults the event pass degrades through the same numpy
+    ladder without ever blocking the watch callback thread."""
+
+    FIXED_POINT = 11  # queue=41, AverageValue target=4 -> ceil(41/4)
+    STORM = 1000
+
+    def make_runtime(self, event_driven, event_thread=False):
+        clock = FakeClock()
+        provider = RecordingFactory()
+        provider.node_replicas["g"] = 5
+        runtime = KarpenterRuntime(
+            Options(
+                event_driven=event_driven,
+                event_debounce_s=0.01,
+                event_thread=event_thread,
+                solver_health_threshold=2,
+                solver_probe_interval_s=0.0,  # probe every dispatch
+            ),
+            cloud_provider_factory=provider,
+            clock=clock,
+        )
+        # pin the XLA device path so solver faults hit a real dispatch
+        runtime.solver_service.backend = "xla"
+        pending_capacity_world(runtime.store)
+        runtime.registry.register("queue", "length").set(
+            "q", "default", 41.0
+        )
+        runtime.store.create(sng_of("g", replicas=5))
+        runtime.store.create(
+            queue_ha("g", 'karpenter_queue_length{name="q"}')
+        )
+        return runtime, provider, clock
+
+    def drain(self, runtime, clock, limit=8):
+        """The debounce thread's job, driven deterministically."""
+        for _ in range(limit):
+            if runtime.manager.dirty_count() == 0:
+                return
+            clock.advance(0.01)
+            runtime.manager.run_event_pass()
+
+    def settle(self, runtime, clock, n):
+        for _ in range(n):
+            clock.advance(61.0)
+            runtime.manager.reconcile_all()
+
+    def _storm(self, runtime):
+        for i in range(self.STORM):
+            runtime.store.create(Pod(
+                metadata=ObjectMeta(name=f"storm-{i}"), spec=PodSpec()
+            ))
+
+    def test_storm_coalesces_matches_fixed_point_and_slo(self):
+        # tick-paced comparator: same world, same storm, ticks only
+        runtime, provider, clock = self.make_runtime(False)
+        try:
+            self._storm(runtime)
+            self.settle(runtime, clock, 6)
+            tick_fixed = provider.node_replicas["g"]
+        finally:
+            runtime.close()
+        assert tick_fixed == self.FIXED_POINT
+
+        runtime, provider, clock = self.make_runtime(True)
+        manager = runtime.manager
+        passes_gauge = runtime.registry.gauge(
+            "runtime", "event_passes_total"
+        )
+        try:
+            self.settle(runtime, clock, 2)
+            self.drain(runtime, clock)
+            before = passes_gauge.get("manager", "-") or 0.0
+            self._storm(runtime)  # 1k events, ONE debounce window
+            self.drain(runtime, clock)
+            coalesced = (passes_gauge.get("manager", "-") or 0.0) - before
+            assert 1 <= coalesced <= 4, (
+                f"a 1k-event storm must coalesce into a handful of "
+                f"passes (producer -> autoscaler -> node-group hops), "
+                f"got {coalesced}"
+            )
+            self.settle(runtime, clock, 4)
+            self.drain(runtime, clock)
+            assert provider.node_replicas["g"] == tick_fixed, (
+                "the event-driven fixed point must equal tick-paced"
+            )
+            # self-SLO fast windows under threshold: sub-second event
+            # passes are exactly what the objective grades
+            monitor = runtime.selfslo
+            assert not monitor.tripped
+            windows = monitor._last_eval["windows"]
+            fast = [windows[w.name] for w in monitor.windows[:2]]
+            assert not any(w["violating"] for w in fast), (
+                f"fast burn windows must stay under threshold: {fast}"
+            )
+        finally:
+            runtime.close()
+
+    def test_storm_is_deterministic(self):
+        """Same seed, same world -> identical pass/solve counts and
+        actuation history: the storm is a replay, not a dice roll."""
+
+        def run():
+            runtime, provider, clock = self.make_runtime(True)
+            try:
+                self.settle(runtime, clock, 2)
+                self.drain(runtime, clock)
+                self._storm(runtime)
+                self.drain(runtime, clock)
+                self.settle(runtime, clock, 2)
+                return (
+                    list(provider.actuations),
+                    runtime.registry.gauge(
+                        "runtime", "event_passes_total"
+                    ).get("manager", "-"),
+                    runtime.solver_service.stats.requests,
+                    provider.node_replicas["g"],
+                )
+            finally:
+                runtime.close()
+
+        assert run() == run()
+
+    def test_total_solver_faults_degrade_without_blocking_watch(self):
+        """100% device faults during the storm: the watch callback
+        thread only marks dirty (returns in microseconds per event —
+        no solve ever runs on it), the manager's REAL debounce thread
+        drains the storm through the numpy ladder, and the backend FSM
+        trips wholesale exactly as a tick-paced outage would."""
+        import time as _t
+
+        runtime, provider, clock = self.make_runtime(
+            True, event_thread=True
+        )
+        service = runtime.solver_service
+        try:
+            registry = faults.install(FaultRegistry(seed=CHAOS_SEED))
+            registry.plan(
+                "solver.dispatch", probability=1.0, code="DeviceFault"
+            )
+            t0 = _t.perf_counter()
+            self._storm(runtime)
+            callback_wall = _t.perf_counter() - t0
+            assert callback_wall < 5.0, (
+                f"1k watch callbacks took {callback_wall:.1f}s — the "
+                f"callback thread must never run (or wait on) a solve"
+            )
+            # the event thread owns the passes: wait for it to drain
+            # the storm through the degradation ladder
+            deadline = _t.monotonic() + 30.0
+            while _t.monotonic() < deadline:
+                if (
+                    runtime.manager.dirty_count() == 0
+                    and service.queue_depth() == 0
+                ):
+                    break
+                _t.sleep(0.02)
+            assert runtime.manager.dirty_count() == 0, (
+                "the debounce thread must drain the storm"
+            )
+            assert service.queue_depth() == 0
+            assert registry.injected.get("solver.dispatch", 0) >= 1
+            assert service.stats.fallbacks >= 1, (
+                "event passes must degrade through the numpy ladder"
+            )
+            # the whole 1k-event storm coalesced into ONE failed
+            # dispatch; follow-up event rounds accumulate the
+            # CONSECUTIVE failures the wholesale FSM trip needs
+            for n in range(4):
+                runtime.store.create(Pod(
+                    metadata=ObjectMeta(name=f"probe-{n}"),
+                    spec=PodSpec(),
+                ))
+                deadline = _t.monotonic() + 10.0
+                while _t.monotonic() < deadline:
+                    if runtime.manager.dirty_count() == 0:
+                        break
+                    _t.sleep(0.02)
+            assert service.backend_health() == "degraded", (
+                "consecutive device faults must trip the FSM wholesale"
+            )
+        finally:
+            faults.uninstall()
+            runtime.close()
+
+
 class TestSelfSLOChaos:
     """ISSUE 12 acceptance: a seeded chaos run at 100% solver faults
     drives the self-SLO fast-burn window over threshold, emits the

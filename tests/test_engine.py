@@ -1,0 +1,318 @@
+"""Event-driven reconcile engine tests (controllers/engine.py module
+docstring "EVENT-DRIVEN RECONCILE").
+
+The failure ladder (engine.py:18-33) predates event passes; this file
+pins that the ladder's contracts hold THROUGH the event-pass path too:
+
+  * a watch event on a DEACTIVATED key (due=inf) revives it through the
+    event pass, not just the tick;
+  * a non-retryable error raised INSIDE an event pass still deactivates
+    (and a retryable one still rides the jittered backoff ladder);
+  * a key the tick just reconciled is never double-reconciled by a
+    racing event pass (dueness re-checked under the pass lock);
+  * the resync backstop: with event PASSES suppressed entirely, the
+    tick alone still converges, still runs the tick-hook consumers, and
+    still picks up watch-revived keys;
+  * wire compat: event_driven=False builds none of the machinery and
+    marks nothing dirty.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import karpenter_tpu.cloudprovider.fake  # noqa: F401 — registers the FakeNodeGroup SNG type validator
+from karpenter_tpu.controllers import Manager
+from karpenter_tpu.controllers.errors import RetryableError
+from karpenter_tpu.metrics.registry import GaugeRegistry
+from karpenter_tpu.observability import default_tracer
+from karpenter_tpu.store import Store
+
+from test_faults import FakeClock, CountingController, _sng
+
+KEY = ("ScalableNodeGroup", "default", "g")
+_NEVER = float("inf")
+
+
+def make_manager(
+    error_factory=None, event_driven=True, registry=None, tick_hook=None
+):
+    """Manager in manual event-pass mode (event_thread=False): tests
+    drive run_event_pass on the fake clock, wall-free."""
+    clock = FakeClock()
+    store = Store()
+    controller = CountingController(error_factory)
+    manager = Manager(
+        store, clock=clock, registry=registry, tick_hook=tick_hook,
+        backoff_base_s=1.0, backoff_cap_s=30.0,
+        event_driven=event_driven, event_debounce_s=0.05,
+        event_thread=False,
+    ).register(controller)
+    store.create(_sng())
+    return manager, controller, store, clock
+
+
+def revive_patch(store):
+    """An EXTERNAL spec edit — the documented revival signal (a watch
+    event on the object itself, unlike the engine's own status echo)."""
+    sng = store.get(*KEY)
+    sng.spec.replicas = (sng.spec.replicas or 0) + 1
+    store.update(sng)
+
+
+class TestEventPassLadder:
+    def test_deactivated_key_revives_through_event_pass(self):
+        """engine.py ladder: due=inf is only exited by a watch event.
+        With event passes, the revival must flow through the PASS —
+        no tick involved."""
+        manager, controller, store, clock = make_manager(
+            lambda: ValueError("poisoned spec")  # non-retryable
+        )
+        clock.advance(10_000)
+        manager.reconcile_all()
+        assert controller.calls == 1
+        assert manager._due[KEY] == _NEVER, "non-retryable deactivates"
+
+        controller.error_factory = None  # the spec edit fixes it
+        revive_patch(store)
+        assert manager._due[KEY] == 0.0, "watch event revives due=inf"
+        assert manager.dirty_count() == 1
+        clock.advance(0.05)
+        assert manager.run_event_pass() == 1
+        assert controller.calls == 2, "revived THROUGH the event pass"
+        assert manager._due[KEY] == pytest.approx(clock.now + 60.0)
+
+    def test_non_retryable_error_in_event_pass_deactivates(self):
+        """A poisoned object hit by an event pass must deactivate
+        exactly as a tick would have — the pass is the same supervised
+        workflow, not a shortcut around the ladder."""
+        registry = GaugeRegistry()
+        manager, controller, store, clock = make_manager(
+            lambda: ValueError("poisoned"), registry=registry
+        )
+        revive_patch(store)
+        clock.advance(0.05)
+        assert manager.run_event_pass() == 1
+        assert controller.calls == 1
+        assert manager._due[KEY] == _NEVER
+        assert registry.gauge(
+            "resilience", "deactivated_total"
+        ).get("ScalableNodeGroup", "-") == 1.0
+        # deactivated: further passes have nothing due for it
+        revive_patch(store)  # revives again (external edit)...
+        controller.error_factory = None
+        clock.advance(0.05)
+        assert manager.run_event_pass() == 1  # ...and heals
+
+    def test_retryable_error_in_event_pass_rides_backoff(self):
+        """A retryable failure inside a pass lands on the jittered
+        ladder; the key is NOT re-dispatched by further passes until
+        the backoff expires (dirty keys respect the requeue ladder)."""
+        manager, controller, store, clock = make_manager(
+            lambda: RetryableError("throttled")
+        )
+        revive_patch(store)
+        clock.advance(0.05)
+        assert manager.run_event_pass() == 1
+        delay = manager._due[KEY] - clock.now
+        assert 0 < delay <= 30.0, "requeued on the backoff ladder"
+        # the engine's own status patch must not have scheduled another
+        # dispatchable pass for the key (it is not due)
+        assert manager.run_event_pass() == 0
+        assert controller.calls == 1
+
+    def test_tick_and_event_pass_never_double_reconcile(self):
+        """The race the pass lock + dueness re-check close: an event
+        lands, the TICK gets there first, the debounced pass must then
+        skip the key (it was requeued at now+interval)."""
+        manager, controller, store, clock = make_manager(None)
+        revive_patch(store)
+        assert manager.dirty_count() == 1
+        clock.advance(10_000)
+        manager.reconcile_all()  # the tick wins the race
+        assert controller.calls == 1
+        assert manager.run_event_pass() == 0, (
+            "the pass must re-check dueness and skip the key the tick "
+            "just reconciled"
+        )
+        assert controller.calls == 1
+
+    def test_event_racing_a_reconcile_is_not_swallowed(self):
+        """A watch event landing WHILE the pass is reconciling the same
+        key acted on state the reconcile never saw. The interval
+        requeue must not overwrite the event's due-now stamp — the key
+        stays due + dirty and the next pass re-reconciles, instead of
+        parking until the backstop tick (the sequence re-check in
+        _requeue)."""
+        manager, controller, store, clock = make_manager(None)
+
+        raced = {"done": False}
+        original = controller.reconcile
+
+        def reconcile_with_racing_event(obj):
+            original(obj)
+            if not raced["done"]:
+                raced["done"] = True
+                revive_patch(store)  # lands mid-reconcile
+
+        controller.reconcile = reconcile_with_racing_event
+        revive_patch(store)
+        clock.advance(0.05)
+        assert manager.run_event_pass() == 1
+        assert manager._due[KEY] == 0.0, (
+            "the raced event's due-now stamp must survive the requeue"
+        )
+        assert manager.dirty_count() == 1
+        clock.advance(0.05)
+        assert manager.run_event_pass() == 1, "the next pass re-reconciles"
+        assert controller.calls == 2
+        assert manager._due[KEY] == pytest.approx(clock.now + 60.0), (
+            "no further event: the normal interval requeue resumes"
+        )
+
+    def test_deleted_dirty_key_is_not_counted_due(self):
+        """A key deleted after dirtying (the Deleted handler pops its
+        due entry) must not default to due-now in the pass — an empty
+        pass would still inflate the event-pass gauges operators tune
+        --event-debounce against."""
+        registry = GaugeRegistry()
+        manager, controller, store, clock = make_manager(
+            None, registry=registry
+        )
+        revive_patch(store)
+        store.delete("ScalableNodeGroup", "default", "g")
+        assert manager.dirty_count() >= 1  # dirty survives the delete
+        clock.advance(0.05)
+        assert manager.run_event_pass() == 0
+        assert controller.calls == 0
+        assert registry.gauge(
+            "runtime", "event_passes_total"
+        ).get("manager", "-") is None, (
+            "an all-deleted pass must not count"
+        )
+
+    def test_storm_coalesces_into_one_pass(self):
+        """1k watch events inside one debounce window -> ONE pass, one
+        reconcile (the event-storm contract the chaos suite replays at
+        runtime scale)."""
+        registry = GaugeRegistry()
+        manager, controller, store, clock = make_manager(
+            None, registry=registry
+        )
+        for _ in range(1000):
+            revive_patch(store)
+        assert manager.dirty_count() == 1  # same key: a set, not a log
+        clock.advance(0.05)
+        assert manager.run_event_pass() == 1
+        assert controller.calls == 1
+        assert registry.gauge(
+            "runtime", "event_passes_total"
+        ).get("manager", "-") == 1.0
+        assert registry.gauge(
+            "runtime", "event_pass_keys_total"
+        ).get("manager", "-") == 1.0
+
+
+class TestResyncBackstop:
+    def test_tick_alone_converges_with_passes_suppressed(self):
+        """Acceptance: with event passes suppressed (the thread dead,
+        nobody calls run_event_pass), the tick must still pick up
+        watch-marked work, run the tick-hook consumers, and revive a
+        deactivated key — the backstop is a complete loop by itself."""
+        hook_calls = []
+        manager, controller, store, clock = make_manager(
+            lambda: ValueError("poisoned"),
+            tick_hook=lambda: hook_calls.append(1),
+        )
+        clock.advance(10_000)
+        manager.reconcile_all()
+        assert manager._due[KEY] == _NEVER
+        controller.error_factory = None
+        revive_patch(store)  # event marks due-now; NO pass ever runs
+        assert manager.dirty_count() == 1
+        manager.reconcile_all()  # the backstop tick handles it
+        assert controller.calls == 2
+        assert manager._due[KEY] == pytest.approx(clock.now + 60.0)
+        assert len(hook_calls) == 2, "tick consumers fire per tick"
+
+    def test_event_pass_skips_tick_consumers(self):
+        """tick_hook (recovery warm-up counting, self-SLO evaluation)
+        and gauge publication stay on the TICK cadence — an event storm
+        must not multiply them."""
+        hook_calls = []
+        manager, controller, store, clock = make_manager(
+            None, tick_hook=lambda: hook_calls.append(1)
+        )
+        revive_patch(store)
+        clock.advance(0.05)
+        assert manager.run_event_pass() == 1
+        assert hook_calls == [], "event passes must not run tick hooks"
+        manager.reconcile_all()
+        assert len(hook_calls) == 1
+
+    def test_event_pass_traces_distinctly(self):
+        """A trace must distinguish event passes from backstop ticks:
+        reconcile.event_pass vs reconcile.tick roots."""
+        tracer = default_tracer()
+        tracer.clear()
+        manager, controller, store, clock = make_manager(None)
+        revive_patch(store)
+        clock.advance(0.05)
+        manager.run_event_pass()
+        manager.reconcile_all()
+        names = {s["name"] for s in tracer.snapshot()}
+        assert "reconcile.event_pass" in names
+        assert "reconcile.tick" in names
+
+
+class TestWireCompat:
+    def test_off_by_default_builds_nothing(self):
+        manager, controller, store, clock = make_manager(
+            None, event_driven=False
+        )
+        revive_patch(store)
+        assert manager.dirty_count() == 0, (
+            "tick-paced mode must not track dirty keys"
+        )
+        assert manager.run_event_pass() == 0
+        assert manager._event_worker is None
+        # the watch event still marks due-now for the next tick (the
+        # pre-PR semantics, byte for byte)
+        assert manager._due[KEY] == 0.0
+
+    def test_close_is_idempotent_and_safe_without_thread(self):
+        manager, controller, store, clock = make_manager(None)
+        manager.close()
+        manager.close()
+        assert manager._event_worker is None
+
+
+class TestSelfPatchEcho:
+    def test_own_status_patch_echo_is_suppressed(self):
+        """The engine's own status patch fires a watch event for the
+        key it just reconciled (synchronously, on the patching thread).
+        That echo must neither re-stamp a just-retired e2e mark (it
+        would measure the NEXT divergence from our own write) nor touch
+        the due time nor mark the key dirty — while an identical event
+        from any OTHER writer does all three."""
+        manager, controller, store, clock = make_manager(None)
+        manager._e2e_kinds.add("ScalableNodeGroup")
+        tracer = default_tracer()
+        tracer.drop_observed(KEY)
+        with manager._dirty_lock:
+            manager._dirty.clear()
+        sng = store.get(*KEY)
+        manager._due[KEY] = 123.0
+
+        manager._patching.key = KEY  # what _finish sets around patch
+        manager._on_event("Modified", sng)
+        assert manager._due[KEY] == 123.0, "echo must not touch due"
+        assert manager.dirty_count() == 0, "echo must not mark dirty"
+        assert tracer.ack_observed(KEY) is None, "echo must not stamp"
+
+        manager._patching.key = None  # any other writer's event
+        manager._on_event("Modified", sng)
+        assert manager._due[KEY] == 0.0
+        assert manager.dirty_count() == 1
+        assert tracer.ack_observed(KEY) is not None
+        tracer.drop_observed(KEY)

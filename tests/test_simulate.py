@@ -417,6 +417,55 @@ class TestSimulateRestartStorm:
         assert run("a") == run("b")
 
 
+class TestSimulateEventloop:
+    """ISSUE 14 acceptance (non-slow regression guard): the seeded
+    pod-arrival replay must show tick-paced e2e p99 at interval scale
+    (multi-second), event-driven e2e p99 SUB-SECOND on the same
+    karpenter_reconcile_e2e_seconds histogram, the same fleet fixed
+    point in both arms, and churn-storm solve amplification <= 2x —
+    the `make bench-eventloop` contract at a fast scale."""
+
+    CONFIG = dict(ticks=12, arrivals=10, storm_events=200, seed=7)
+
+    def test_event_driven_is_sub_second_with_bounded_amplification(self):
+        from karpenter_tpu.simulate import simulate_eventloop
+
+        report = simulate_eventloop(**self.CONFIG)
+        assert report["fixed_point_match"], (
+            "event-driven and tick-paced arms must converge to the "
+            "same fleet"
+        )
+        tick = report["tick_paced"]["e2e_seconds"]
+        event = report["event_driven"]["e2e_seconds"]
+        assert tick["n"] >= 1 and event["n"] >= 1
+        assert tick["p99_s"] > 1.0, (
+            "tick pacing must dominate the tick-paced arm's lead time"
+        )
+        assert event["p99_s"] < 1.0, (
+            f"event passes must deliver sub-second e2e p99, got "
+            f"{event['p99_s']}s"
+        )
+        storm = report["event_driven"]["storm"]
+        assert storm["amplification"] <= 2.0, (
+            f"churn-storm solve amplification must stay bounded: "
+            f"{storm}"
+        )
+        assert storm["passes"] <= 4, (
+            f"{storm['events']} events in one debounce window must "
+            f"coalesce into a handful of passes, got {storm['passes']}"
+        )
+
+    def test_eventloop_replay_is_deterministic(self):
+        """Scripted clock + manual passes + seeded arrivals: the whole
+        report (latencies included) is a pure function of the seed."""
+        from karpenter_tpu.simulate import simulate_eventloop
+
+        assert (
+            simulate_eventloop(**self.CONFIG)
+            == simulate_eventloop(**self.CONFIG)
+        )
+
+
 class TestSimulateCost:
     """Satellite pin (docs/cost.md "Dry-running"): the --simulate --cost
     warm-pool replay must show a MEASURED provisioning lead-time

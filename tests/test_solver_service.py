@@ -986,3 +986,88 @@ class TestUploadStage:
             assert "karpenter_solver_upload_ms" in text
         finally:
             svc.close()
+
+
+class TestCompilePrewarm:
+    """Boot-time compile pre-warm (ISSUE 14 satellite,
+    docs/solver-service.md "Compile pre-warm"): one tiny real dispatch
+    per always-on family through the normal queue, counted in the
+    prewarm gauges, skipped once warmed, re-armed by reset_caches, and
+    never able to block boot."""
+
+    def test_warms_both_families_and_skips_on_rewarm(self):
+        registry = GaugeRegistry()
+        service = SolverService(registry=registry, backend="xla")
+        try:
+            report = service.prewarm()
+            assert set(report) == {"solve", "decide"}
+            for family in ("solve", "decide"):
+                assert report[family]["skipped"] is False
+                assert report[family]["ms"] >= 0.0
+                assert registry.gauge(
+                    "solver", "prewarm_compiles_total"
+                ).get(family, "-") == 1.0
+                assert registry.gauge(
+                    "solver", "prewarm_ms"
+                ).get(family, "-") is not None
+            # the solve family rides the queue's compile counters: a
+            # cold service's warm-up IS a fresh compile there; decide
+            # rides jax.jit's own cache, so the report must NOT claim
+            # a (meaningless) zero for it
+            assert report["solve"]["fresh_compiles"] >= 1
+            assert "fresh_compiles" not in report["decide"]
+
+            again = service.prewarm()
+            assert again == {
+                "solve": {"skipped": True},
+                "decide": {"skipped": True},
+            }
+            assert registry.gauge(
+                "solver", "prewarm_compiles_total"
+            ).get("solve", "-") == 1.0, "a skip must not re-count"
+        finally:
+            service.close()
+
+    def test_reset_caches_rearms_the_warmup(self):
+        service = SolverService(registry=GaugeRegistry(), backend="xla")
+        try:
+            service.prewarm()
+            service.reset_caches()  # the recovery-boot seam
+            report = service.prewarm(families=("solve",))
+            assert report["solve"]["skipped"] is False, (
+                "a reset plane must be able to re-warm"
+            )
+        finally:
+            service.close()
+
+    def test_unknown_family_degrades_never_raises(self):
+        service = SolverService(registry=GaugeRegistry())
+        try:
+            report = service.prewarm(families=("solve", "nope"))
+            assert report["nope"] == {
+                "skipped": False, "error": "ValueError",
+            }
+            assert report["solve"]["skipped"] is False, (
+                "one family's failure must not stop the rest"
+            )
+            # a failed family is retryable (not marked warmed)
+            assert "nope" not in service._prewarmed
+        finally:
+            service.close()
+
+    def test_runtime_wires_prewarm_compile_option(self):
+        from karpenter_tpu.cloudprovider.fake import FakeFactory
+        from karpenter_tpu.runtime import KarpenterRuntime, Options
+
+        runtime = KarpenterRuntime(
+            Options(prewarm_compile=True),
+            cloud_provider_factory=FakeFactory(),
+        )
+        try:
+            gauge = runtime.registry.gauge(
+                "solver", "prewarm_compiles_total"
+            )
+            assert gauge.get("solve", "-") == 1.0
+            assert gauge.get("decide", "-") == 1.0
+        finally:
+            runtime.close()

@@ -1328,3 +1328,78 @@ class TestTenantWeightedDeadlines:
             assert budgets["t1"] == pytest.approx(4.0)
         finally:
             service.close()
+
+
+class TestEventPassAdmission:
+    """ISSUE 14 tenancy pin: event passes are ordinary solver traffic.
+    A tenant runtime whose fleet-decide seam routes through the shared
+    MultiTenantScheduler keeps riding WeightedAdmission when the decide
+    is triggered by a coalesced EVENT PASS (engine event-driven mode)
+    instead of a tick — sub-second reaction must not become a fairness
+    bypass."""
+
+    def test_event_pass_decides_ride_weighted_admission(self):
+        from karpenter_tpu.cloudprovider.fake import FakeFactory
+        from karpenter_tpu.runtime import KarpenterRuntime, Options
+
+        from test_chaos import queue_ha, sng_of
+
+        service, _tenants, scheduler = make_world(2)
+        runtimes = []
+        try:
+            for tid in ("t0", "t1"):
+                clock = {"now": 1000.0}
+                provider = FakeFactory()
+                provider.node_replicas["g"] = 5
+                runtime = KarpenterRuntime(
+                    Options(
+                        event_driven=True,
+                        event_debounce_s=0.01,
+                        event_thread=False,
+                    ),
+                    cloud_provider_factory=provider,
+                    clock=(lambda c=clock: c["now"]),
+                )
+                # the tenant's decide seam: through the SHARED scheduler
+                # (concat + WeightedAdmission + per-tenant isolation),
+                # exactly how a live multi-tenant deployment fronts the
+                # one solver service
+                runtime.batch_autoscaler.decider = (
+                    lambda inputs, t=tid:
+                    scheduler.decide_all({t: inputs})[t]
+                )
+                runtime.registry.register("queue", "length").set(
+                    "q", "default", 41.0
+                )
+                runtime.store.create(sng_of("g", replicas=5))
+                runtime.store.create(
+                    queue_ha("g", 'karpenter_queue_length{name="q"}')
+                )
+                runtimes.append((runtime, provider, clock))
+
+            rounds_before = scheduler.stats.admission_rounds
+            decides_before = scheduler.stats.decide_calls
+            for runtime, provider, clock in runtimes:
+                # NO ticks: the create events alone must cascade the
+                # decide -> scale patch -> actuation through passes
+                for _ in range(6):
+                    if runtime.manager.dirty_count() == 0:
+                        break
+                    clock["now"] += 0.01
+                    runtime.manager.run_event_pass()
+                assert provider.node_replicas["g"] == 11, (
+                    "event passes must actuate the fleet decide "
+                    "(queue 41 / target 4 -> 11)"
+                )
+            assert scheduler.stats.decide_calls - decides_before >= 2, (
+                "each tenant's event-pass decide must flow through the "
+                "shared scheduler"
+            )
+            assert scheduler.stats.admission_rounds - rounds_before >= 2, (
+                "event-pass dispatches must take WeightedAdmission "
+                "rounds, not bypass fairness"
+            )
+        finally:
+            for runtime, _provider, _clock in runtimes:
+                runtime.close()
+            service.close()
