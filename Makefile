@@ -118,6 +118,10 @@ bench-eventloop: ## Event-driven reconcile: one seeded pod-arrival trace replaye
 		--eventloop-arrivals 60 --eventloop-storm 1000 \
 		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
 
+bench-introspect: ## Solver introspection-plane overhead on the reconcile hot path: compile ledger + device telemetry + XLA cost attribution enabled vs disabled, interleaved over the shared churn world (target <=2% tick-latency regression); appends a BENCHMARKS row + publishes to BASELINE.json
+	$(PYTHON) bench.py --introspect --introspect-ticks 200 \
+		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
+
 dryrun: ## Multi-chip sharding compile check on 8 virtual CPU devices
 	$(PYTHON) -c "import os; \
 		os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS','') + ' --xla_force_host_platform_device_count=8').strip(); \
@@ -158,5 +162,5 @@ kind-smoke: ## Deploy smoke on kind: image -> apply -> pod Ready -> one HA end t
 	docs native bench bench-solver bench-hotpath bench-consolidate \
 	bench-forecast bench-preempt bench-cost bench-journal bench-trace \
 	bench-provenance bench-resident bench-shard bench-multitenant \
-	bench-eventloop dryrun \
+	bench-eventloop bench-introspect dryrun \
 	image publish apply delete kind-load conformance kind-smoke

@@ -169,6 +169,30 @@ def parse_args(argv=None) -> argparse.Namespace:
         "compile cache already knows are skipped",
     )
     parser.add_argument(
+        "--introspect",
+        action=argparse.BooleanOptionalAction,
+        default=False,
+        help="enable the solver introspection plane "
+        "(docs/observability.md 'Device telemetry & introspection'): "
+        "a compile ledger recording every compile-cache miss with "
+        "rung/extents/wall time/trace ids + XLA flops/bytes "
+        "attribution (karpenter_solver_compile_seconds, compile_storm "
+        "flight-recorder trips), per-tick device memory telemetry "
+        "(karpenter_device_*, resident-LRU byte accounting, the "
+        "self-SLO memory source), and the /debug/solver posture "
+        "document. Default off (decisions byte-identical either way; "
+        "~zero cost when off)",
+    )
+    parser.add_argument(
+        "--introspect-storm-threshold",
+        type=int,
+        default=4,
+        help="compile-cache misses inside one tick window (after the "
+        "plane reached steady state) that count as a compile storm "
+        "and dump the flight-recorder ring; only meaningful with "
+        "--introspect",
+    )
+    parser.add_argument(
         "--selfslo-objective",
         type=float,
         default=1.0,
@@ -472,6 +496,11 @@ def parse_args(argv=None) -> argparse.Namespace:
         parser.error(
             f"--selfslo-objective must be > 0 seconds, got "
             f"{args.selfslo_objective}"
+        )
+    if args.introspect_storm_threshold < 1:
+        parser.error(
+            f"--introspect-storm-threshold must be >= 1, got "
+            f"{args.introspect_storm_threshold}"
         )
     if args.event_debounce < 0:
         parser.error(
@@ -881,6 +910,8 @@ def main(argv=None) -> int:
             tenant_deadline_s=args.tenant_deadline,
             tenant_id=args.tenant_id,
             provenance=args.provenance,
+            introspect=args.introspect,
+            introspect_storm_threshold=args.introspect_storm_threshold,
             selfslo_objective_s=args.selfslo_objective,
             selfslo_target=args.selfslo_target,
             event_driven=args.event_driven,
@@ -895,6 +926,10 @@ def main(argv=None) -> int:
         readiness=_readiness(runtime),
         ledger=runtime.decision_ledger,
         selfslo=runtime.selfslo,
+        introspection=runtime.solver_introspection,
+        # /debug/profile captures land next to the flight-recorder
+        # dumps (and the recovery journal) — one incident directory
+        profile_dir=args.journal_dir,
     )
     port = metrics_server.start()
     print(f"serving /metrics and /healthz on :{port}", file=sys.stderr)

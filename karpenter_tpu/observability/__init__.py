@@ -19,17 +19,31 @@ plane — docs/observability.md is the operator guide:
                      burn rates over karpenter_reconcile_e2e_seconds +
                      solver FSM + tenant breakers (/debug/selfslo,
                      karpenter_selfslo_*, selfslo_burn auto-dump)
+  devicetelemetry.py the solver introspection plane: compile ledger
+                     (every compile-cache miss with rung/extents/wall
+                     time/trace ids + XLA cost attribution,
+                     karpenter_solver_compile_seconds, compile_storm
+                     trip-class events), device memory telemetry
+                     (karpenter_device_*, resident-LRU byte accounting,
+                     the self-SLO memory source), /debug/solver
+                     (default off, --introspect)
   server.py          /metrics, /healthz (liveness), /readyz (real
                      readiness), /debug/traces, /debug/flightrecorder,
-                     /debug/decisions, /debug/selfslo
+                     /debug/decisions, /debug/selfslo, /debug/solver,
+                     /debug/profile
   profiler.py        device-timeline annotations (solver_trace, probed
-                     once) + the xprof profiler server
+                     once), the xprof profiler server, and the bounded
+                     single-flight on-demand capture (/debug/profile)
 
 The public names below are the pre-package import surface — existing
 importers (`from karpenter_tpu.observability import MetricsServer,
 solver_trace, start_profiler_server`) are unchanged.
 """
 
+from karpenter_tpu.observability.devicetelemetry import (
+    CompileLedger,
+    SolverIntrospection,
+)
 from karpenter_tpu.observability.flightrecorder import (
     FlightRecorder,
     default_flight_recorder,
@@ -56,10 +70,12 @@ from karpenter_tpu.observability.tracing import (
 )
 
 __all__ = [
+    "CompileLedger",
     "DecisionLedger",
     "FlightRecorder",
     "MetricsServer",
     "SelfSLOMonitor",
+    "SolverIntrospection",
     "Tracer",
     "default_flight_recorder",
     "default_ledger",

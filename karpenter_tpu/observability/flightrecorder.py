@@ -33,10 +33,13 @@ DUMP_PREFIX = "flightrecorder-"
 # a degradation an operator will want the surrounding context for
 # (selfslo_burn: the self-SLO monitor's fast-burn trip —
 # observability/selfslo.py — whose whole point is arriving WITH the
-# ring of events that burned the budget)
+# ring of events that burned the budget; compile_storm: the solver
+# introspection plane's steady-state compile-miss burst —
+# observability/devicetelemetry.py — the dump carries the ledger's
+# trace backlinks to the ticks that paid the compiles)
 DUMP_KINDS = frozenset((
     "fsm_trip", "circuit_open", "fence_rejection", "watchdog_restart",
-    "selfslo_burn",
+    "selfslo_burn", "compile_storm",
 ))
 
 

@@ -20,7 +20,12 @@ alerting shape) over the control plane's own health signals:
     complete;
   * per-tenant breakers (the MultiTenantScheduler board) — each OPEN
     breaker is a bad event per evaluation, each closed tenant a good
-    one, and the per-tenant view feeds the /debug/selfslo scoreboard.
+    one, and the per-tenant view feeds the /debug/selfslo scoreboard;
+  * the device-memory high watermark (the solver introspection plane,
+    observability/devicetelemetry.py, --introspect) — a tick whose
+    bytes_in_use/bytes_limit crossed the watermark on any device is a
+    bad event, a healthy poll a good one, and no telemetry (plane off,
+    or a backend without memory stats) contributes nothing.
 
 Each window (fast 5m/1h page pair + slow 6h/3d ladder) gets a BURN RATE
 — (bad/total over the window) / error budget — published as
@@ -86,6 +91,12 @@ class SelfSLOMonitor:
       fsm_source     () -> "healthy" | "degraded" (SolverService
                      .backend_health)
       tenant_source  () -> {tenant_id: breaker_open_bool}
+      memory_source  () -> Optional[bool] — the device-memory
+                     high-watermark trip from the solver introspection
+                     plane (observability/devicetelemetry.py): True =
+                     breached (bad event), False = healthy (good),
+                     None = no telemetry (disabled plane or a backend
+                     without memory stats) — contributes no event
       recorder       the flight recorder burn trips dump through
                      (default: the process default)
     """
@@ -99,6 +110,7 @@ class SelfSLOMonitor:
         histogram=None,
         fsm_source: Optional[Callable[[], str]] = None,
         tenant_source: Optional[Callable[[], Dict[str, bool]]] = None,
+        memory_source: Optional[Callable[[], Optional[bool]]] = None,
         recorder=None,
         windows: Sequence[BurnWindow] = DEFAULT_WINDOWS,
     ):
@@ -111,6 +123,7 @@ class SelfSLOMonitor:
         self.histogram = histogram
         self.fsm_source = fsm_source
         self.tenant_source = tenant_source
+        self.memory_source = memory_source
         self._recorder = recorder
         self.windows = tuple(windows)
         # cumulative snapshot series, one entry per evaluate(): parallel
@@ -148,39 +161,58 @@ class SelfSLOMonitor:
 
     # -- the per-tick evaluation -------------------------------------------
 
+    def _hist_events(self) -> Tuple[int, int]:
+        if self.histogram is None:
+            return 0, 0
+        le, total = self.histogram.le_totals(self.objective_s)
+        last_le, last_total = self._last_hist
+        d_total = max(0, total - last_total)
+        d_le = min(max(0, le - last_le), d_total)
+        self._last_hist = (le, total)
+        return d_le, d_total - d_le
+
+    def _fsm_events(self) -> Tuple[int, int]:
+        if self.fsm_source is None:
+            return 0, 0
+        if self.fsm_source() == "healthy":
+            return 1, 0
+        return 0, 1
+
+    def _tenant_events(self) -> Tuple[int, int]:
+        if self.tenant_source is None:
+            return 0, 0
+        opens = list(self.tenant_source().values())
+        bad = sum(1 for is_open in opens if is_open)
+        return len(opens) - bad, bad
+
+    def _memory_events(self) -> Tuple[int, int]:
+        """The FOURTH source (observability/devicetelemetry.py):
+        device HBM pressure burns budget like a degraded FSM — None
+        (no telemetry) stays quiet, contributing no event."""
+        if self.memory_source is None:
+            return 0, 0
+        high = self.memory_source()
+        if high is True:
+            return 0, 1
+        if high is False:
+            return 1, 0
+        return 0, 0
+
     def _collect(self) -> Tuple[int, int]:
-        """(good, bad) increments for THIS evaluation across the three
+        """(good, bad) increments for THIS evaluation across the four
         sources. Source failures degrade to 'no events', never raise —
         the monitor must not take the tick down with it."""
         good = bad = 0
-        if self.histogram is not None:
+        for source in (
+            self._hist_events, self._fsm_events,
+            self._tenant_events, self._memory_events,
+        ):
             try:
-                le, total = self.histogram.le_totals(self.objective_s)
-                last_le, last_total = self._last_hist
-                d_total = max(0, total - last_total)
-                d_le = min(max(0, le - last_le), d_total)
-                good += d_le
-                bad += d_total - d_le
-                self._last_hist = (le, total)
+                d_good, d_bad = source()
             except Exception:  # noqa: BLE001 — observation only
-                pass
-        if self.fsm_source is not None:
-            try:
-                if self.fsm_source() == "healthy":
-                    good += 1
-                else:
-                    bad += 1
-            except Exception:  # noqa: BLE001 — observation only
-                pass
-        if self.tenant_source is not None:
-            try:
-                for is_open in self.tenant_source().values():
-                    if is_open:
-                        bad += 1
-                    else:
-                        good += 1
-            except Exception:  # noqa: BLE001 — observation only
-                pass
+                continue
+            good += d_good
+            bad += d_bad
         return good, bad
 
     def evaluate(self, now: Optional[float] = None) -> dict:
@@ -285,10 +317,40 @@ class SelfSLOMonitor:
 
     # -- the debug surface -------------------------------------------------
 
+    def _board_solver_backend(self) -> str:
+        try:
+            return self.fsm_source()
+        except Exception:  # noqa: BLE001 — observation only
+            return "unknown"
+
+    def _board_device_memory(self) -> str:
+        try:
+            high = self.memory_source()
+        except Exception:  # noqa: BLE001 — observation only
+            return "unknown"
+        if high is None:
+            return "off"
+        return "high" if high else "ok"
+
+    def _board_tenants(self) -> Dict[str, dict]:
+        try:
+            return {
+                tenant: {
+                    "breaker_open": bool(is_open),
+                    "degraded": bool(is_open),
+                }
+                for tenant, is_open in sorted(
+                    self.tenant_source().items()
+                )
+            }
+        except Exception:  # noqa: BLE001 — observation only
+            return {}
+
     def scoreboard(self) -> dict:
         """/debug/selfslo: the last evaluation plus the per-tenant
-        degradation view (breaker state per tenant) and the solver FSM
-        — the 'how degraded is the control plane, and for whom' page."""
+        degradation view (breaker state per tenant), the solver FSM,
+        and the device-memory posture — the 'how degraded is the
+        control plane, and for whom' page."""
         board = dict(self._last_eval or {
             "at": None,
             "objective_s": self.objective_s,
@@ -298,21 +360,9 @@ class SelfSLOMonitor:
         })
         board["trips_total"] = self.trips_total
         if self.fsm_source is not None:
-            try:
-                board["solver_backend"] = self.fsm_source()
-            except Exception:  # noqa: BLE001 — observation only
-                board["solver_backend"] = "unknown"
+            board["solver_backend"] = self._board_solver_backend()
+        if self.memory_source is not None:
+            board["device_memory"] = self._board_device_memory()
         if self.tenant_source is not None:
-            try:
-                board["tenants"] = {
-                    tenant: {
-                        "breaker_open": bool(is_open),
-                        "degraded": bool(is_open),
-                    }
-                    for tenant, is_open in sorted(
-                        self.tenant_source().items()
-                    )
-                }
-            except Exception:  # noqa: BLE001 — observation only
-                board["tenants"] = {}
+            board["tenants"] = self._board_tenants()
         return board

@@ -28,6 +28,18 @@ come from the manager. Here the same server additionally serves:
   /debug/selfslo         the self-SLO scoreboard: per-window burn
                          rates/budget + solver FSM + per-tenant breaker
                          degradation (observability.selfslo)
+  /debug/solver          the full solver posture as ONE JSON document:
+                         compile-cache rungs + hit/miss + the compile
+                         ledger tail, resident LRU contents, shard
+                         route + extents, backend FSM, queue/pipeline
+                         depths (observability.devicetelemetry,
+                         --introspect; ?limit=N bounds the ledger tail)
+  /debug/profile?ms=N    one bounded single-flight jax.profiler capture
+                         written atomically into --journal-dir next to
+                         the flight-recorder dumps, stamped with the
+                         active trace id; 503 when the profiler probe
+                         failed, a capture is in flight, or no
+                         --journal-dir is configured
 """
 
 from __future__ import annotations
@@ -75,6 +87,8 @@ class MetricsServer:
         recorder=None,
         ledger=None,
         selfslo=None,
+        introspection=None,
+        profile_dir: Optional[str] = None,
     ):
         self.registry = registry
         self.host = host
@@ -84,6 +98,12 @@ class MetricsServer:
         self._recorder = recorder
         self._ledger = ledger
         self._selfslo = selfslo
+        # the solver introspection plane backing /debug/solver
+        # (observability.devicetelemetry; None = endpoint reports
+        # enabled: false) and the directory /debug/profile captures
+        # into (the runtime wires --journal-dir; None = 503)
+        self._introspection = introspection
+        self._profile_dir = profile_dir
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -180,6 +200,62 @@ class MetricsServer:
         }, sort_keys=True).encode()
         return 200, body, "application/json"
 
+    def _respond_solver(self, query: dict) -> Tuple[int, bytes, str]:
+        if self._introspection is None:
+            body = json.dumps({"enabled": False}).encode()
+            return 200, body, "application/json"
+        limit = _parse_limit(query)
+        snapshot = self._introspection.snapshot(
+            ledger_limit=limit if limit is not None else 32
+        )
+        body = json.dumps(snapshot, sort_keys=True).encode()
+        return 200, body, "application/json"
+
+    def _respond_profile(self, query: dict) -> Tuple[int, bytes, str]:
+        """One on-demand jax.profiler capture (observability.profiler
+        capture_profile): bounded, single-flight, written atomically
+        into the journal dir; every no-can-do answers 503 with the
+        reason so an operator's curl explains itself."""
+        from karpenter_tpu.observability.profiler import (
+            ProfileBusy,
+            ProfileUnavailable,
+            capture_profile,
+        )
+
+        if not self._profile_dir:
+            return (
+                503,
+                b"no --journal-dir configured: nowhere to write the "
+                b"capture",
+                "text/plain",
+            )
+        try:
+            ms = int(query.get("ms", ["100"])[0])
+        except (ValueError, IndexError):
+            return 400, b"?ms=N must be an integer", "text/plain"
+        tracer = self._tracer_or_default()
+        # the active trace id: the serving thread carries no span, so
+        # fall back to the newest recorded span's trace — the tick the
+        # operator is (almost certainly) asking about
+        trace_id = tracer.current_trace_id()
+        if trace_id is None:
+            newest = tracer.snapshot(limit=1)
+            trace_id = newest[0]["trace"] if newest else None
+        try:
+            report = capture_profile(
+                ms, self._profile_dir, trace_id=trace_id
+            )
+        except (ProfileUnavailable, ProfileBusy) as error:
+            return 503, str(error).encode(), "text/plain"
+        except Exception as error:  # noqa: BLE001 — capture must not 500-loop
+            return (
+                503,
+                f"profiler capture failed: {error}".encode(),
+                "text/plain",
+            )
+        body = json.dumps(report, sort_keys=True).encode()
+        return 200, body, "application/json"
+
     def _respond_selfslo(self) -> Tuple[int, bytes, str]:
         if self._selfslo is None:
             body = json.dumps({"enabled": False}).encode()
@@ -210,6 +286,10 @@ class MetricsServer:
             return self._respond_decisions(query)
         if path == "/debug/selfslo":
             return self._respond_selfslo()
+        if path == "/debug/solver":
+            return self._respond_solver(query)
+        if path == "/debug/profile":
+            return self._respond_profile(query)
         return None
 
     # -- lifecycle ---------------------------------------------------------

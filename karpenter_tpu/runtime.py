@@ -152,6 +152,18 @@ class Options:
     # and the SLO target the multi-window burn rates measure against.
     selfslo_objective_s: float = 1.0
     selfslo_target: float = 0.99
+    # solver introspection plane (observability/devicetelemetry.py,
+    # docs/observability.md "Device telemetry & introspection"):
+    # compile ledger + compile-storm trips, device memory telemetry +
+    # the self-SLO memory source, XLA cost attribution on dispatch
+    # spans, /debug/solver. Default OFF, matching tracing/provenance:
+    # the off path is property-pinned byte-identical and mark-free.
+    introspect: bool = False
+    # compile-cache misses inside ONE tick window (after steady state)
+    # that count as a compile storm, and the bytes_in_use/bytes_limit
+    # ratio that trips the device-memory high watermark
+    introspect_storm_threshold: int = 4
+    introspect_memory_watermark: float = 0.9
     # event-driven reconcile (docs/solver-service.md "Event-driven
     # reconcile"): watch events schedule debounced coalesced event
     # passes over the dirty keys, demoting the periodic tick to a
@@ -234,6 +246,21 @@ class KarpenterRuntime:
             shard_mesh_shape=options.solver_shard_mesh,
             resident=options.solver_resident,
         )
+        # the solver introspection plane (observability/devicetelemetry
+        # .py): ALWAYS built — a disabled plane is one attribute read
+        # per hook, the provenance posture — and enabled by
+        # --introspect. Attached to the service so dispatch sites note
+        # compile misses; evaluated once per manager tick (_on_tick).
+        from karpenter_tpu.observability import SolverIntrospection
+
+        self.solver_introspection = SolverIntrospection(
+            enabled=options.introspect,
+            registry=self.registry,
+            clock=self.clock,
+            recorder=self.flight_recorder,
+            storm_threshold=options.introspect_storm_threshold,
+            watermark=options.introspect_memory_watermark,
+        ).attach(self.solver_service)
         self._reset_caches_for_recovery()
         self.producer_factory = ProducerFactory(
             self.store, self.cloud_provider, registry=self.registry,
@@ -479,16 +506,27 @@ class KarpenterRuntime:
             histogram=self.registry.gauge("reconcile", "e2e_seconds"),
             fsm_source=self.solver_service.backend_health,
             tenant_source=tenant_source,
+            # the fourth source (observability/devicetelemetry.py):
+            # device-memory high watermark — quiet (None) while the
+            # introspection plane is off or the backend reports no
+            # memory stats
+            memory_source=self.solver_introspection.memory_source,
             recorder=self.flight_recorder,
         )
 
     def _on_tick(self) -> None:
         """Composed manager tick hook: recovery bookkeeping (warm-up
-        countdown, checkpoint cadence) then the self-SLO evaluation —
-        the monitor must observe the tick INCLUDING any degradation the
+        countdown, checkpoint cadence), then the solver introspection
+        pass (compile-storm window close + device memory poll — it
+        must run BEFORE the self-SLO evaluation so the memory source
+        reflects THIS tick), then the self-SLO evaluation — the
+        monitor must observe the tick INCLUDING any degradation the
         tick just hit."""
         if self.recovery is not None:
             self.recovery.on_tick()
+        introspection = getattr(self, "solver_introspection", None)
+        if introspection is not None:
+            introspection.on_tick()
         selfslo = getattr(self, "selfslo", None)
         if selfslo is not None:
             selfslo.evaluate()

@@ -107,6 +107,31 @@ def mesh_aligned_shape(
             r, k, l)
 
 
+def _rung_part(part) -> str:
+    """One compile-cache key element, compactly: bool tuples
+    (presence) as a 10-string, int tuples (shapes, extents) as
+    PxTx..., everything else via str()."""
+    if not isinstance(part, tuple) or not part:
+        return str(part)
+    if all(isinstance(x, bool) for x in part):
+        return "".join("1" if x else "0" for x in part)
+    if all(isinstance(x, int) for x in part):
+        return "x".join(str(x) for x in part)
+    return str(part)
+
+
+def rung_label(key: tuple) -> str:
+    """Human-readable rung of one compile-cache key, for telemetry —
+    the compile ledger and /debug/solver render cache keys through
+    this one formatter (observability/devicetelemetry.py). Unknown
+    key vocabularies degrade to repr() rather than raise: a telemetry
+    label must never break the dispatch it describes."""
+    try:
+        return "/".join(_rung_part(part) for part in key)
+    except Exception:  # noqa: BLE001 — labels are best-effort
+        return repr(key)
+
+
 def presence(inputs: BinPackInputs) -> Tuple[bool, ...]:
     """Which optional operands ride this request — the other half of the
     compile-cache key (an absent operand removes whole program stages)."""

@@ -81,17 +81,26 @@ _ROW_LEAVES = ("pod_requests", "pod_valid", "pod_required", "pod_intolerant")
 class _Entry:
     """One resident operand stack: the host inputs identity it mirrors,
     the (shape, mode) it was padded/stacked/placed for, and the device
-    pytree the dispatch consumes."""
+    pytree the dispatch consumes. `tenant`/`created_at` are telemetry
+    only (the introspection plane's per-entry byte accounting,
+    observability/devicetelemetry.py) — neither participates in
+    lookup."""
 
-    __slots__ = ("host", "shape", "mode", "stacked", "nbytes", "rows")
+    __slots__ = (
+        "host", "shape", "mode", "stacked", "nbytes", "rows",
+        "tenant", "created_at",
+    )
 
-    def __init__(self, host, shape, mode, stacked):
+    def __init__(self, host, shape, mode, stacked, tenant=None,
+                 created_at: float = 0.0):
         self.host = host
         self.shape = shape
         self.mode = mode
         self.stacked = stacked
         self.nbytes = _stack_bytes(stacked)
         self.rows = int(shape[0])
+        self.tenant = tenant
+        self.created_at = created_at
 
 
 def _stack_bytes(stacked: BinPackInputs) -> int:
@@ -191,6 +200,31 @@ class ResidentFleetState:
         with self._lock:
             return sum(e.rows for e in self._entries.values())
 
+    def entries(self, now: Optional[float] = None) -> list:
+        """Per-entry telemetry view of the LRU, oldest-use first — the
+        EXACT byte accounting the introspection plane publishes as
+        karpenter_solver_resident_entry_bytes and /debug/solver renders
+        (observability/devicetelemetry.py). `slot` is the LRU position
+        at snapshot time; `age_s` needs `now` on the same clock that
+        stamped created_at (the owning service's)."""
+        with self._lock:
+            snapshot = list(self._entries.values())
+        return [
+            {
+                "slot": f"entry{i}",
+                "bytes": entry.nbytes,
+                "rows": entry.rows,
+                "shape": tuple(entry.shape),
+                "mode": entry.mode[0],
+                "tenant": entry.tenant,
+                "age_s": (
+                    round(max(0.0, now - entry.created_at), 3)
+                    if now is not None else None
+                ),
+            }
+            for i, entry in enumerate(snapshot)
+        ]
+
     def _find(self, host, shape, mode) -> Optional[_Entry]:
         with self._lock:
             for key, entry in self._entries.items():
@@ -229,6 +263,8 @@ class ResidentFleetState:
         shape: Tuple[int, int, int, int, int],
         mode: tuple,
         put,
+        tenant=None,
+        now: float = 0.0,
     ) -> Tuple[BinPackInputs, str]:
         """(device-resident stacked operands, kind) for one singleton
         dispatch. kind is "hit" (identity match — zero encode, zero
@@ -266,7 +302,8 @@ class ResidentFleetState:
                         # bytes); single-device output is already home
                         stacked = put(stacked)
                     self._store(
-                        _Entry(inputs, shape, mode, stacked),
+                        _Entry(inputs, shape, mode, stacked,
+                               tenant=tenant, created_at=now),
                         generation, evict=plan.prev,
                     )
                     self.scatters += 1
@@ -276,7 +313,9 @@ class ResidentFleetState:
                     pass
         stacked = put(_stack_one(pad_to_bucket(inputs, shape)))
         self._store(
-            _Entry(inputs, shape, mode, stacked), generation,
+            _Entry(inputs, shape, mode, stacked,
+                   tenant=tenant, created_at=now),
+            generation,
             evict=plan.prev if plan is not None else None,
         )
         self.rebuilds += 1

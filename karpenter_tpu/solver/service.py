@@ -433,6 +433,11 @@ class SolverService:
         from karpenter_tpu.solver.resident import ResidentFleetState
 
         self._resident = ResidentFleetState()
+        # solver introspection plane (observability/devicetelemetry.py,
+        # --introspect): compile ledger + XLA cost attribution + device
+        # memory telemetry. None (the default) keeps every hot-path
+        # hook a single attribute read — the off-path pin.
+        self._introspect = None
         # whether the decide family was given an injected kernel (the
         # gRPC split / tests): an injected decider owns its own device
         # semantics, so the sharded decide route must stay out of it
@@ -617,6 +622,91 @@ class SolverService:
         self._resident.drop_all()
         # a reset plane may legitimately want a fresh warm-up
         self._prewarmed = set()
+
+    # -- introspection plane (observability/devicetelemetry.py) ------------
+
+    def attach_introspection(self, plane) -> None:
+        """Wire the solver introspection plane (--introspect): dispatch
+        sites note compile-cache misses into its ledger and dispatch
+        spans gain the XLA cost attribution captured at compile time.
+        Detached (the default), every hook below is one attribute
+        read."""
+        self._introspect = plane
+
+    def _note_compile(
+        self, family: str, key: tuple, seconds: float,
+        live: List[_Request] = (), extents=None, cost_fn=None,
+    ) -> None:
+        """One compile-cache miss into the introspection ledger: the
+        wall time the first dispatch paid (compile + dispatch for this
+        rung), the trace ids that paid for it, and — lazily, only with
+        the plane enabled — the lowered program's XLA cost analysis.
+        Never raises into the dispatch path."""
+        plane = self._introspect
+        if plane is None:
+            return
+        try:
+            plane.note_compile(
+                family, key, seconds,
+                trace_ids=self._trace_ids(list(live)),
+                extents=extents, cost_fn=cost_fn,
+            )
+        except Exception:  # noqa: BLE001 — telemetry must never break a solve
+            pass
+
+    def _fresh_cost_thunk(self, fresh: bool, fn, stacked, buckets: int):
+        """The lazy XLA cost-analysis thunk for a FRESH batched solve
+        dispatch, or None when nothing will consume it (cache hit, or
+        the introspection plane detached/disabled)."""
+        plane = self._introspect
+        if not fresh or plane is None or not plane.enabled:
+            return None
+        return self._cost_thunk(fn, (stacked,), {"buckets": buckets})
+
+    def _note_fresh_compile(
+        self, fresh: bool, family: str, key: tuple, t0: float,
+        live: List[_Request], cost_fn=None, extents=None,
+    ) -> None:
+        """Ledger the compile a FRESH dispatch just paid — the jit call
+        returns once tracing + compile are done (execution is what
+        stays async), so perf_counter() - t0 IS the compile wall time
+        this rung's first dispatch paid. No-op for cache hits and on a
+        watchdog-superseded worker."""
+        if not fresh or self._stale():
+            return
+        self._note_compile(
+            family, key, _time.perf_counter() - t0, live,
+            extents=extents, cost_fn=cost_fn,
+        )
+
+    @staticmethod
+    def _cost_thunk(fn, args: tuple, static: dict):
+        """Zero-arg thunk returning the XLA cost analysis of `fn`
+        lowered at `args`' shapes. Shapes are captured EAGERLY as
+        ShapeDtypeStructs (donated operands may be deleted by the time
+        the thunk runs) and the analysis runs on the LOWERED module —
+        jax.stages.Lowered.cost_analysis, the analytical model with no
+        second backend compile."""
+        import jax
+
+        shapes = jax.tree_util.tree_map(
+            lambda leaf: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype),
+            args,
+        )
+
+        def thunk():
+            return fn.lower(*shapes, **static).cost_analysis()
+
+        return thunk
+
+    def _span_cost_args(self, key: tuple) -> dict:
+        """{flops, bytes} args for this dispatch's span, {} when the
+        introspection plane is off or never attributed the key — the
+        off path adds nothing to any span."""
+        plane = self._introspect
+        if plane is None:
+            return {}
+        return plane.dispatch_cost_args(key)
 
     # -- boot-time compile pre-warm ----------------------------------------
 
@@ -2068,7 +2158,7 @@ class SolverService:
         t0 = _time.perf_counter()
         with self._dispatch_span(
             "solver.dispatch.forecast" + (".shard" if shard else ""),
-            live,
+            live, **self._span_cost_args(cache_key),
         ):
             with self._device_section(
                 live, grace=COMPILE_GRACE_S if fresh else 0.0
@@ -2091,6 +2181,16 @@ class SolverService:
                     jax.block_until_ready(out)
         if self._stale():
             return  # watchdog already answered these from numpy
+        if fresh:
+            self._note_compile(
+                "forecast", cache_key, _time.perf_counter() - t0, live,
+                extents=key[4] if shard else None,
+                cost_fn=(
+                    self._cost_thunk(fn, (stacked,), {})
+                    if self._introspect is not None
+                    and self._introspect.enabled else None
+                ),
+            )
         self._record_stage("dispatch", _time.perf_counter() - t0)
         self._count_dispatch()
         self.stats.forecast_dispatches += 1
@@ -2163,7 +2263,7 @@ class SolverService:
             t0 = _time.perf_counter()
             with self._dispatch_span(
                 "solver.dispatch.preempt" + (".shard" if shard else ""),
-                [request],
+                [request], **self._span_cost_args(cache_key),
             ):
                 with self._device_section([request], grace=grace):
                     with solver_trace("solver.preempt"):
@@ -2182,6 +2282,17 @@ class SolverService:
             grace = 0.0  # only the first dispatch of the batch compiles
             if self._stale():
                 return  # watchdog already answered these from numpy
+            if fresh:
+                fresh = False  # only the first dispatch paid the compile
+                self._note_compile(
+                    "preempt", cache_key, _time.perf_counter() - t0,
+                    [request], extents=key[4] if shard else None,
+                    cost_fn=(
+                        self._cost_thunk(PK.preempt_plan, (padded,), {})
+                        if self._introspect is not None
+                        and self._introspect.enabled else None
+                    ),
+                )
             self._record_stage("dispatch", _time.perf_counter() - t0)
             self._count_dispatch()
             self.stats.preempt_dispatches += 1
@@ -2220,9 +2331,8 @@ class SolverService:
 
         from karpenter_tpu.ops import binpack as B
 
-        fresh = self._count_compile(
-            ("pallas", shape, buckets, live[0].key[3])
-        )
+        cache_key = ("pallas", shape, buckets, live[0].key[3])
+        fresh = self._count_compile(cache_key)
         grace = COMPILE_GRACE_S if fresh else 0.0
         for request in live:
             padded = pad_to_bucket(request.inputs, shape)
@@ -2231,6 +2341,16 @@ class SolverService:
                 out = B.solve(padded, buckets=buckets, backend="pallas")
                 jax.block_until_ready(out)
             grace = 0.0  # only the first call of the batch compiles
+            if fresh:
+                # no jit handle to lower here (B.solve resolves the
+                # fused Mosaic kernel internally), so the ledger row
+                # carries the wall time without cost attribution; the
+                # helper's stale check keeps a watchdog-superseded
+                # worker's discarded dispatch out of the ledger
+                self._note_fresh_compile(
+                    fresh, "solve", cache_key, t0, [request]
+                )
+                fresh = False
             self._record_stage("dispatch", _time.perf_counter() - t0)
             self._count_dispatch()
             request.finish(result=self._crop_host(out, request))
@@ -2275,13 +2395,17 @@ class SolverService:
         else:
             stacked, n_batch = self._stack_group(shape, live)
             donate = self._donation_supported()
-        fn, fresh = self._compiled_for(
-            ("xla", shape, n_batch, buckets, live[0].key[3], strategy),
-            donate=donate,
+        cache_key = (
+            "xla", shape, n_batch, buckets, live[0].key[3], strategy,
         )
+        fn, fresh = self._compiled_for(cache_key, donate=donate)
+        # shape capture must precede the dispatch: donated operand
+        # buffers are deleted by the time the thunk could run
+        cost_fn = self._fresh_cost_thunk(fresh, fn, stacked, buckets)
         t0 = _time.perf_counter()
         with self._dispatch_span(
-            "solver.dispatch", live, strategy=strategy, batch=n_batch
+            "solver.dispatch", live, strategy=strategy, batch=n_batch,
+            **self._span_cost_args(cache_key),
         ):
             with self._device_section(
                 live, grace=COMPILE_GRACE_S if fresh else 0.0
@@ -2290,6 +2414,9 @@ class SolverService:
                     if resident is None:
                         stacked = self._upload(stacked)
                     out = fn(stacked, buckets)
+        self._note_fresh_compile(
+            fresh, "solve", cache_key, t0, live, cost_fn=cost_fn
+        )
         if self._stale():
             # superseded by a watchdog restart while dispatching: the
             # watchdog already answered these requests from numpy —
@@ -2352,6 +2479,8 @@ class SolverService:
             stacked, kind = self._resident.obtain(
                 request.inputs, shape, mode,
                 lambda tree: self._upload(tree, shardings),
+                tenant=request.tenant,
+                now=self._clock(),
             )
         except Exception as error:  # noqa: BLE001 — optimization layer
             logger().warning(
@@ -2440,17 +2569,17 @@ class SolverService:
         else:
             stacked, n_batch = self._stack_group(aligned, live)
             donate = self._donation_supported()
-        fn, fresh = self._compiled_for(
-            (
-                "xla", aligned, n_batch, buckets, key[3], strategy,
-                "shard", extents,
-            ),
-            donate=donate,
+        cache_key = (
+            "xla", aligned, n_batch, buckets, key[3], strategy,
+            "shard", extents,
         )
+        fn, fresh = self._compiled_for(cache_key, donate=donate)
+        cost_fn = self._fresh_cost_thunk(fresh, fn, stacked, buckets)
         t0 = _time.perf_counter()
         with self._dispatch_span(
             "solver.dispatch.shard", live,
             strategy=strategy, devices=int(mesh.devices.size),
+            **self._span_cost_args(cache_key),
         ):
             with self._device_section(
                 live, grace=COMPILE_GRACE_S if fresh else 0.0
@@ -2462,6 +2591,10 @@ class SolverService:
                     jax.block_until_ready(out)
         if self._stale():
             return  # watchdog already answered these from numpy
+        self._note_fresh_compile(
+            fresh, "solve", cache_key, t0, live,
+            extents=extents, cost_fn=cost_fn,
+        )
         self._record_stage("dispatch", _time.perf_counter() - t0)
         self._count_dispatch()
         self.stats.shard_dispatches += 1

@@ -1,0 +1,236 @@
+"""observability/profiler.py: the probe-once device-timeline hooks and
+the on-demand /debug/profile capture.
+
+Pins (ISSUE 15 satellites):
+
+  * jax absent/broken -> `solver_trace` returns the SHARED no-op
+    annotation, and the probe result is CACHED (one import attempt per
+    process, not one per dispatch);
+  * with jax.profiler present the TraceAnnotation class is actually
+    used, and a broken annotation SETUP is swallowed while exceptions
+    from the traced block itself propagate;
+  * `start_profiler_server` logs its failure reason instead of
+    returning False silently;
+  * `/debug/profile?ms=N` captures bounded + single-flight into the
+    journal dir (atomic rename, manifest stamped with the active trace
+    id) and answers 503 when the probe failed or nothing is wired.
+"""
+
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.metrics.registry import GaugeRegistry
+from karpenter_tpu.observability import MetricsServer
+from karpenter_tpu.observability import profiler as P
+
+
+@pytest.fixture
+def fresh_probe():
+    """Reset the probe cache around each test (module-global state)."""
+    P.reset_probe()
+    yield
+    P.reset_probe()
+
+
+class TestProbeOnce:
+    def test_broken_jax_profiler_yields_shared_noop(
+        self, fresh_probe, monkeypatch
+    ):
+        # sys.modules[name] = None makes `import jax.profiler` raise
+        # ImportError — the "jax absent/broken" environment
+        monkeypatch.setitem(sys.modules, "jax.profiler", None)
+        span = P.solver_trace("solver.dispatch")
+        assert span is P._NOOP_TRACE
+        # the probe is CACHED as unavailable: restoring the module does
+        # not resurrect annotations until reset_probe
+        monkeypatch.undo()
+        assert P._ANNOTATION_CLS is False
+        assert P.solver_trace("again") is P._NOOP_TRACE
+        # the no-op is a working context manager
+        with P.solver_trace("x"):
+            pass
+
+    def test_probe_caches_available_class(self, fresh_probe):
+        first = P.solver_trace("a")
+        assert isinstance(first, P._GuardedAnnotation)
+        cached = P._ANNOTATION_CLS
+        assert cached is not None and cached is not False
+        P.solver_trace("b")
+        assert P._ANNOTATION_CLS is cached  # no re-probe
+
+    def test_annotation_class_used_when_present(self, fresh_probe):
+        entered = []
+
+        class FakeAnnotation:
+            def __init__(self, name):
+                entered.append(name)
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                return False
+
+        P._ANNOTATION_CLS = FakeAnnotation
+        with P.solver_trace("solver.cost"):
+            pass
+        assert entered == ["solver.cost"]
+
+    def test_guarded_annotation_swallows_setup_failures(self):
+        class BrokenAnnotation:
+            def __init__(self, name):
+                raise RuntimeError("profiler backend fell over")
+
+        # setup failure is swallowed; the block still runs
+        ran = []
+        with P._GuardedAnnotation(BrokenAnnotation, "x"):
+            ran.append(True)
+        assert ran == [True]
+        # ...but an exception FROM the block propagates unchanged
+        class FineAnnotation:
+            def __init__(self, name):
+                pass
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                return False
+
+        with pytest.raises(ValueError):
+            with P._GuardedAnnotation(FineAnnotation, "x"):
+                raise ValueError("the solve's own error")
+
+
+class TestProfilerServer:
+    def test_failure_reason_is_logged(
+        self, fresh_probe, monkeypatch, caplog
+    ):
+        import logging
+
+        monkeypatch.setitem(sys.modules, "jax.profiler", None)
+        with caplog.at_level(logging.WARNING, logger="karpenter"):
+            assert P.start_profiler_server(port=59999) is False
+        assert "failed to start" in caplog.text
+
+
+class TestCaptureProfile:
+    def test_capture_writes_atomic_dir_with_manifest(
+        self, fresh_probe, tmp_path
+    ):
+        report = P.capture_profile(
+            ms=10, out_dir=str(tmp_path), trace_id="t00000a1"
+        )
+        assert os.path.isdir(report["path"])
+        assert not report["path"].endswith(".tmp")
+        assert os.path.basename(report["path"]).startswith(
+            P.PROFILE_PREFIX
+        )
+        manifest = json.load(
+            open(os.path.join(report["path"], "manifest.json"))
+        )
+        assert manifest["trace_id"] == "t00000a1"
+        assert manifest["ms_requested"] == 10
+        assert manifest["ms_captured"] >= 10
+        # no orphan tmp dirs on the happy path
+        assert not [
+            name for name in os.listdir(tmp_path)
+            if name.endswith(".tmp")
+        ]
+
+    def test_bounds_clamp(self, fresh_probe, tmp_path):
+        report = P.capture_profile(ms=-50, out_dir=str(tmp_path))
+        assert report["ms_requested"] == P.MIN_CAPTURE_MS
+
+    def test_single_flight(self, fresh_probe, tmp_path):
+        assert P._capture_lock.acquire(blocking=False)
+        try:
+            with pytest.raises(P.ProfileBusy):
+                P.capture_profile(ms=10, out_dir=str(tmp_path))
+        finally:
+            P._capture_lock.release()
+
+    def test_unavailable_probe_raises(
+        self, fresh_probe, monkeypatch, tmp_path
+    ):
+        monkeypatch.setitem(sys.modules, "jax.profiler", None)
+        with pytest.raises(P.ProfileUnavailable):
+            P.capture_profile(ms=10, out_dir=str(tmp_path))
+
+
+class TestDebugProfileEndpoint:
+    def _get(self, url):
+        # generous client timeout: stop_trace serializes the whole
+        # process profile, which in a full-suite process that compiled
+        # hundreds of XLA programs can take well over 10s
+        try:
+            with urllib.request.urlopen(url, timeout=120) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as error:
+            return error.code, error.read()
+
+    def test_capture_via_endpoint(self, fresh_probe, tmp_path):
+        server = MetricsServer(
+            GaugeRegistry(), port=0, host="127.0.0.1",
+            profile_dir=str(tmp_path),
+        )
+        port = server.start()
+        try:
+            status, body = self._get(
+                f"http://127.0.0.1:{port}/debug/profile?ms=10"
+            )
+            assert status == 200, body
+            report = json.loads(body)
+            assert os.path.isdir(report["path"])
+            assert report["ms_requested"] == 10
+        finally:
+            server.stop()
+
+    def test_no_journal_dir_is_503(self, fresh_probe):
+        server = MetricsServer(GaugeRegistry(), port=0, host="127.0.0.1")
+        port = server.start()
+        try:
+            status, body = self._get(
+                f"http://127.0.0.1:{port}/debug/profile?ms=10"
+            )
+            assert status == 503
+            assert b"journal-dir" in body
+        finally:
+            server.stop()
+
+    def test_failed_probe_is_503(
+        self, fresh_probe, monkeypatch, tmp_path
+    ):
+        monkeypatch.setitem(sys.modules, "jax.profiler", None)
+        server = MetricsServer(
+            GaugeRegistry(), port=0, host="127.0.0.1",
+            profile_dir=str(tmp_path),
+        )
+        port = server.start()
+        try:
+            status, body = self._get(
+                f"http://127.0.0.1:{port}/debug/profile?ms=10"
+            )
+            assert status == 503
+            assert b"unavailable" in body
+        finally:
+            server.stop()
+
+    def test_malformed_ms_is_400(self, fresh_probe, tmp_path):
+        server = MetricsServer(
+            GaugeRegistry(), port=0, host="127.0.0.1",
+            profile_dir=str(tmp_path),
+        )
+        port = server.start()
+        try:
+            status, _body = self._get(
+                f"http://127.0.0.1:{port}/debug/profile?ms=soon"
+            )
+            assert status == 400
+        finally:
+            server.stop()
