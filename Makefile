@@ -122,6 +122,11 @@ bench-introspect: ## Solver introspection-plane overhead on the reconcile hot pa
 	$(PYTHON) bench.py --introspect --introspect-ticks 200 \
 		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
 
+bench-constraints: ## Batched constrained solve (spread + reservation + anti-affinity + compact groups as masked integer operands, ONE dispatch) vs the per-group sequential loop, interleaved arms, parity pinned; appends a BENCHMARKS row + publishes to BASELINE.json
+	$(PYTHON) bench.py --constraints --pods 20000 --types 48 \
+		--constraint-groups 8 --backend xla --iters 10 \
+		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
+
 dryrun: ## Multi-chip sharding compile check on 8 virtual CPU devices
 	$(PYTHON) -c "import os; \
 		os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS','') + ' --xla_force_host_platform_device_count=8').strip(); \
@@ -162,5 +167,5 @@ kind-smoke: ## Deploy smoke on kind: image -> apply -> pod Ready -> one HA end t
 	docs native bench bench-solver bench-hotpath bench-consolidate \
 	bench-forecast bench-preempt bench-cost bench-journal bench-trace \
 	bench-provenance bench-resident bench-shard bench-multitenant \
-	bench-eventloop bench-introspect dryrun \
+	bench-eventloop bench-introspect bench-constraints dryrun \
 	image publish apply delete kind-load conformance kind-smoke

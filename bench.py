@@ -911,6 +911,23 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
         "single-device baseline through the same service seam",
     )
     ap.add_argument(
+        "--constraints",
+        action="store_true",
+        help="benchmark the declarative constraint plane "
+        "(docs/constraints.md): ONE batched constrained solve (spread "
+        "+ reservation + anti-affinity + compact groups as masked "
+        "integer operands) vs the per-group sequential loop a "
+        "constraint-naive integration would run, interleaved arms, "
+        "with per-group verdict parity pinned before timing",
+    )
+    ap.add_argument(
+        "--constraint-groups",
+        type=int,
+        default=8,
+        help="with --constraints: constraint group count (cycling "
+        "spread/reservation/anti/compact kinds)",
+    )
+    ap.add_argument(
         "--publish-baseline",
         action="store_true",
         help="with --solver-service: write the result into BASELINE.json's "
@@ -1220,22 +1237,43 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
             "--eventloop needs ticks >= 4, arrivals/storm >= 1, "
             "debounce > 0"
         )
+    if args.constraints and (
+        args.mesh or args.e2e or args.decide or args.clusters
+        or args.solver_service or args.hotpath or args.consolidate
+        or args.forecast or args.preempt or args.journal or args.trace
+        or args.shard or args.cost or args.multitenant
+        or args.provenance or args.resident or args.eventloop
+        or args.introspect
+    ):
+        ap.error(
+            "--constraints builds its own constrained workload; it "
+            "cannot combine with other modes"
+        )
+    if args.constraints and args.constraint_groups < 1:
+        ap.error("--constraint-groups must be >= 1")
     if (args.publish_baseline or args.append_benchmarks) and not (
         args.solver_service or args.consolidate or args.hotpath
         or args.forecast or args.preempt or args.journal or args.shard
         or args.trace or args.cost or args.multitenant
         or args.provenance or args.resident or args.eventloop
-        or args.introspect
+        or args.introspect or args.constraints
     ):
         ap.error(
             "--publish-baseline/--append-benchmarks only apply to "
             "--solver-service/--consolidate/--hotpath/--forecast/"
             "--preempt/--journal/--shard/--trace/--cost/--multitenant/"
-            "--provenance/--resident/--eventloop/--introspect (nothing "
-            "would be published otherwise)"
+            "--provenance/--resident/--eventloop/--introspect/"
+            "--constraints (nothing would be published otherwise)"
         )
 
-    if args.introspect:
+    if args.constraints:
+        metric = (
+            f"batched constrained solve p50, {args.pods} pods x "
+            f"{args.types} instance types x {args.constraint_groups} "
+            f"constraint groups (one masked-operand dispatch vs the "
+            f"per-group sequential loop, interleaved)"
+        )
+    elif args.introspect:
         metric = (
             f"reconcile tick p50 with the solver introspection plane, "
             f"{args.introspect_ticks} ticks (compile ledger + device "
@@ -2227,11 +2265,243 @@ def run_introspect(args, metric: str, note: str) -> None:
     )
 
 
+def _constraint_bench_world(args):
+    """The constrained workload: membership over cycling constraint
+    kinds compiled into masked operands on one BinPackInputs (the
+    compiler path, so the spread exactness contract holds)."""
+    import jax
+    import jax.numpy as jnp
+
+    from karpenter_tpu.api.core import RESERVATION_LABEL, ZONE_LABEL
+    from karpenter_tpu.constraints import ConstraintGroup, SpreadSpec
+    from karpenter_tpu.constraints.compiler import compile_rows
+
+    rng = np.random.default_rng(args.seed)
+    G = args.constraint_groups
+    alloc = {"cpu": 64.0, "memory": 256.0, "pods": 110.0}
+    zones = [f"z{i + 1}" for i in range(4)]
+    profiles = []
+    for t in range(args.types):
+        labels = {(ZONE_LABEL, zones[t % len(zones)])}
+        if t % 7 == 3:
+            labels = {(RESERVATION_LABEL, f"res{t % 3}")}
+        profiles.append((dict(alloc), labels, set()))
+    kinds = ["spread", "reservation", "anti", "compact"]
+    groups = []
+    for g in range(G):
+        kind = kinds[g % len(kinds)]
+        sel = {"team": f"t{g}"}
+        if kind == "spread":
+            groups.append(ConstraintGroup(
+                name=f"g{g}", pod_selector=sel, spread=SpreadSpec()
+            ))
+        elif kind == "reservation":
+            groups.append(ConstraintGroup(
+                name=f"g{g}", pod_selector=sel,
+                reservation=f"res{g % 3}",
+            ))
+        elif kind == "anti":
+            groups.append(ConstraintGroup(
+                name=f"g{g}", pod_selector=sel, anti_affinity=True
+            ))
+        else:
+            groups.append(ConstraintGroup(
+                name=f"g{g}", pod_selector=sel, compact=True
+            ))
+    P = args.pods
+    membership = rng.integers(0, G + 1, P).astype(np.int32)
+    weights = rng.integers(1, 4, P).astype(np.int32)
+    valid = np.ones(P, bool)
+    compiled = compile_rows(membership, weights, valid, profiles, groups)
+    P2 = len(compiled.rep)
+    requests = np.zeros((P2, 3), np.float32)
+    requests[:, 0] = rng.integers(1, 8, P2)  # cpu
+    requests[:, 1] = rng.integers(1, 16, P2)  # memory
+    requests[:, 2] = 1.0  # pods
+    group_allocatable = np.tile(
+        np.asarray([alloc["cpu"], alloc["memory"], alloc["pods"]],
+                   np.float32),
+        (args.types, 1),
+    )
+    from karpenter_tpu.ops.binpack import BinPackInputs
+
+    base = dict(
+        pod_requests=jnp.asarray(requests),
+        pod_valid=jnp.ones(P2, bool),
+        pod_intolerant=jnp.zeros((P2, 4), bool),
+        pod_required=jnp.zeros((P2, 4), bool),
+        group_allocatable=jnp.asarray(group_allocatable),
+        group_taints=jnp.zeros((args.types, 4), bool),
+        group_labels=jnp.zeros((args.types, 4), bool),
+        pod_weight=jnp.asarray(compiled.row_weight),
+    )
+    for name, value in (
+        ("pod_claim", compiled.claim),
+        ("group_reservation", compiled.group_reservation),
+        ("pod_pack_class", compiled.pack_class),
+        ("pod_spread_slot", compiled.spread_slot),
+        ("group_domain", compiled.group_domain),
+        ("spread_cap", compiled.spread_cap),
+        ("pod_exclusive", compiled.exclusive),
+    ):
+        if value is not None:
+            base[name] = jnp.asarray(value)
+    inputs = jax.device_put(BinPackInputs(**base))
+    jax.block_until_ready(inputs)
+    row_membership = membership[compiled.rep]
+    return inputs, row_membership, G
+
+
+def run_constraints(args, metric: str, note: str) -> None:  # lint: allow-complexity — bench arm: parity pin + interleaved timing + publish, linear
+    """The constraint-plane headline: ONE batched masked-operand solve
+    over every constraint group vs the per-group sequential loop a
+    constraint-naive integration would run (G+1 dispatches of the same
+    compiled program with the other groups' rows invalidated).
+    Interleaved arms; per-group verdict parity pinned before timing."""
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from karpenter_tpu.ops.binpack import binpack
+
+    print(
+        f"backend={jax.default_backend()} devices={jax.devices()}",
+        file=sys.stderr,
+    )
+    inputs, row_membership, G = _constraint_bench_world(args)
+
+    def solo(g):
+        rows = row_membership == g
+        return _dc.replace(
+            inputs,
+            pod_valid=jnp.asarray(
+                np.asarray(inputs.pod_valid) & rows
+            ),
+            pod_weight=jnp.asarray(np.where(
+                rows, np.asarray(inputs.pod_weight), 0
+            ).astype(np.int32)),
+        )
+    solos = [solo(g) for g in range(G + 1)]
+
+    def batched_arm():
+        return jax.block_until_ready(binpack(inputs, buckets=args.buckets))
+
+    def sequential_arm():
+        outs = []
+        for s in solos:
+            outs.append(
+                jax.block_until_ready(binpack(s, buckets=args.buckets))
+            )
+        return outs
+
+    # warm both programs, then pin parity: the batched verdicts on each
+    # group's rows must equal that group's independent solve
+    ref = batched_arm()
+    per_group = sequential_arm()
+    ref_assigned = np.asarray(ref.assigned)
+    for g, out in enumerate(per_group):
+        rows = row_membership == g
+        if not rows.any():
+            continue
+        if not np.array_equal(
+            np.asarray(out.assigned)[rows], ref_assigned[rows]
+        ):
+            emit(metric, None, error=(
+                f"parity failure: group {g} solo verdicts diverge "
+                f"from the batched solve"
+            ))
+            raise SystemExit(1)
+
+    batched_ms, sequential_ms = [], []
+    for i in range(args.iters):
+        arms = [("b", batched_arm), ("s", sequential_arm)]
+        if i % 2:  # interleave: flip arm order every iteration
+            arms.reverse()
+        for tag, fn in arms:
+            t0 = time.perf_counter()
+            fn()
+            dt = (time.perf_counter() - t0) * 1e3
+            (batched_ms if tag == "b" else sequential_ms).append(dt)
+
+    p50_b = float(np.percentile(batched_ms, 50))
+    p50_s = float(np.percentile(sequential_ms, 50))
+    record = {
+        "config": (
+            f"{args.pods} pods x {args.types} types x "
+            f"{G} constraint groups"
+        ),
+        "backend": jax.default_backend(),
+        "groups": G,
+        "batched_p50_ms": round(p50_b, 3),
+        "sequential_p50_ms": round(p50_s, 3),
+        "speedup": round(p50_s / p50_b, 2) if p50_b else 0.0,
+        "dispatches_batched": 1,
+        "dispatches_sequential": G + 1,
+    }
+    record_evidence(
+        batched_ms=[round(t, 4) for t in batched_ms],
+        sequential_ms=[round(t, 4) for t in sequential_ms],
+        constraints=record,
+    )
+    print(
+        f"batched p50={record['batched_p50_ms']}ms vs per-group "
+        f"p50={record['sequential_p50_ms']}ms "
+        f"({record['speedup']}x, {G + 1} dispatches -> 1)",
+        file=sys.stderr,
+    )
+    if args.publish_baseline:
+        _publish_to_baseline(
+            f"{record['config']} constrained solve "
+            f"({record['backend']})",
+            record,
+        )
+    if args.append_benchmarks:
+        _append_constraints_row(args.append_benchmarks, record)
+    emit(
+        f"{metric} ({jax.default_backend()})",
+        p50_b,
+        note=(
+            f"{note}; " if note else ""
+        ) + f"per-group sequential p50 {record['sequential_p50_ms']}ms "
+        f"({record['speedup']}x); parity pinned",
+        against_baseline=False,
+    )
+
+
+def _append_constraints_row(path: str, record: dict) -> None:
+    marker = "## Constraint plane (make bench-constraints)"
+    header = (
+        f"\n{marker}\n\n"
+        "One batched masked-operand solve carrying EVERY constraint "
+        "group (zone spread + reservation claims + anti-affinity + "
+        "compact placement compiled to integer operands; "
+        "docs/constraints.md) vs the per-group sequential loop a "
+        "constraint-naive integration would run (G+1 dispatches of the "
+        "same compiled program). Interleaved arms; per-group verdict "
+        "parity pinned before timing.\n\n"
+        "| Date | Backend | Problem | Batched p50 (ms) | "
+        "Per-group p50 (ms) | Speedup | Dispatches |\n"
+        "|---|---|---|---|---|---|---|\n"
+    )
+    date = datetime.date.today().isoformat()
+    row = (
+        f"| {date} | {record['backend']} | {record['config']} "
+        f"| {record['batched_p50_ms']} | {record['sequential_p50_ms']} "
+        f"| {record['speedup']}x "
+        f"| {record['dispatches_sequential']} -> 1 |\n"
+    )
+    _append_table_row(path, marker, header, row)
+
+
 def run(args, metric: str, note: str) -> None:  # lint: allow-complexity — bench mode dispatch, one arm per measured configuration
     import jax
 
     _warm_native_kernel(args)
 
+    if args.constraints:
+        run_constraints(args, metric, note)
+        return
     if args.introspect:
         run_introspect(args, metric, note)
         return

@@ -140,14 +140,24 @@ def parse_args(argv=None) -> argparse.Namespace:
         "way; ~zero cost when off)",
     )
     parser.add_argument(
+        "--profile",
+        choices=("production",),
+        default=None,
+        help="opinionated flag preset (docs/OPERATIONS.md 'Profiles'): "
+        "'production' turns on --event-driven and --prewarm-compile "
+        "and tightens the --selfslo-objective default to 0.5s (the "
+        "sub-second posture the event-driven plane is built to hold); "
+        "every explicit flag still wins over the preset",
+    )
+    parser.add_argument(
         "--event-driven",
         action=argparse.BooleanOptionalAction,
-        default=False,
+        default=None,
         help="watch events schedule debounced coalesced event passes "
         "(sub-second reaction; docs/solver-service.md 'Event-driven "
         "reconcile'), demoting the periodic tick to a resync backstop; "
-        "off (the default) keeps the tick-paced loop byte-identical to "
-        "previous releases",
+        "off (the default outside --profile production) keeps the "
+        "tick-paced loop byte-identical to previous releases",
     )
     parser.add_argument(
         "--event-debounce",
@@ -161,7 +171,8 @@ def parse_args(argv=None) -> argparse.Namespace:
     )
     parser.add_argument(
         "--prewarm-compile",
-        action="store_true",
+        action=argparse.BooleanOptionalAction,
+        default=None,
         help="compile the smallest bucket rungs of the always-on kernel "
         "families (solve + decide) at boot, so a cold plane's first "
         "event pass doesn't pay a first-touch jit compile "
@@ -195,11 +206,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     parser.add_argument(
         "--selfslo-objective",
         type=float,
-        default=1.0,
+        default=None,
         help="the control plane's own e2e-latency objective in seconds "
         "(against karpenter_reconcile_e2e_seconds; pick a histogram "
         "bucket bound) for the self-SLO burn-rate monitor "
-        "(docs/observability.md 'Self-SLO monitoring')",
+        "(docs/observability.md 'Self-SLO monitoring'); defaults to "
+        "1.0, or 0.5 under --profile production",
     )
     parser.add_argument(
         "--selfslo-target",
@@ -330,6 +342,14 @@ def parse_args(argv=None) -> argparse.Namespace:
         help="priority assumed for pods naming an unknown "
         "PriorityClass (resolved spec.priority and the system classes "
         "always win; docs/preemption.md)",
+    )
+    parser.add_argument(
+        "--constraints",
+        action="store_true",
+        help="with --simulate: replay a seeded spread-constrained "
+        "serving fleet with a gold reservation through a zonal outage "
+        "and report per-group spread skew and reservation fill "
+        "before/after (docs/constraints.md)",
     )
     parser.add_argument(
         "--eventloop",
@@ -485,6 +505,15 @@ def parse_args(argv=None) -> argparse.Namespace:
         "metric query before the row errors instead (0 disables reuse)",
     )
     args = parser.parse_args(argv)
+    # Resolve the --profile preset: flags parked on a None sentinel take
+    # the profile's value; anything the user typed explicitly wins.
+    production = args.profile == "production"
+    if args.event_driven is None:
+        args.event_driven = production
+    if args.prewarm_compile is None:
+        args.prewarm_compile = production
+    if args.selfslo_objective is None:
+        args.selfslo_objective = 0.5 if production else 1.0
     if not 0.0 < args.selfslo_target < 1.0:
         # a clean usage error instead of a ValueError traceback from
         # deep inside runtime construction (SelfSLOMonitor's guard)
@@ -577,6 +606,16 @@ def _run_simulation(args, store) -> int:  # lint: allow-complexity — simulatio
         # count): clear the flag so main's exit-time _export_trace
         # doesn't rewrite the identical file (or the decisions sibling)
         args.trace_export = None
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return 0
+
+    if args.constraints:
+        # self-contained replay (own store, fake provider, scripted
+        # clock): the constraint plane through a seeded zonal outage
+        # (docs/constraints.md)
+        from karpenter_tpu.simulate import simulate_constraints
+
+        report = simulate_constraints()
         print(json.dumps(report, indent=2, sort_keys=True))
         return 0
 

@@ -1065,6 +1065,35 @@ def capacity_tier_of(labels) -> int:
     )
 
 
+# Zone topology label (the well-known key kube schedulers spread on) and
+# the reservation label the constraint plane fences reserved capacity
+# with (same label-precedent family as karpenter.sh/capacity-type above).
+ZONE_LABEL = "topology.kubernetes.io/zone"
+RESERVATION_LABEL = "karpenter.sh/reservation"
+
+
+def zone_of(labels) -> str:
+    """Zone name from a node/group label set (a dict or an iterable of
+    (key, value) items — group profiles carry the latter); "" when the
+    group carries no zone label (capacity_tier_of idiom)."""
+    items = labels.items() if isinstance(labels, dict) else labels
+    for key, value in items:
+        if key == ZONE_LABEL:
+            return value
+    return ""
+
+
+def reservation_of(labels) -> str:
+    """Reservation name a node/group is fenced under ("" = unreserved),
+    from the karpenter.sh/reservation label (dict or (key, value)
+    items)."""
+    items = labels.items() if isinstance(labels, dict) else labels
+    for key, value in items:
+        if key == RESERVATION_LABEL:
+            return value
+    return ""
+
+
 def is_ready_and_schedulable(node: Node) -> bool:
     """reference: pkg/utils/node/predicates.go:18-25"""
     for condition in node.status.conditions:

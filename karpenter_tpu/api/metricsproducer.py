@@ -13,6 +13,10 @@ from typing import Dict, List, Optional
 
 from karpenter_tpu.api.conditions import ACTIVE, Condition, ConditionManager
 from karpenter_tpu.api.core import ObjectMeta
+from karpenter_tpu.constraints.spec import (
+    ConstraintGroup,
+    validate_constraints,
+)
 
 AWS_SQS_QUEUE_TYPE = "AWSSQSQueue"
 # TPU-native queue type: a pluggable in-cluster work queue (the reference's
@@ -40,9 +44,17 @@ class PendingCapacitySpec:
     # ScalableNodeGroup (same namespace). Live nodes always win —
     # observed truth over declared shape.
     node_group_ref: str = ""
+    # declarative constraint groups (karpenter_tpu/constraints): pod
+    # anti-affinity / compact placement / zone spread / reservation
+    # claims compiled into the batched solve's masked integer operands.
+    # Empty = today's unconstrained wire, byte-identical.
+    constraints: List[ConstraintGroup] = field(default_factory=list)
 
     def validate(self) -> None:
-        """reference: metricsproducer_validation.go:85-87 (no-op)."""
+        """reference: metricsproducer_validation.go:85-87, plus the
+        constraint-group rules (constraints/spec.py)."""
+        if self.constraints:
+            validate_constraints(self.constraints)
 
 
 @dataclass(slots=True)

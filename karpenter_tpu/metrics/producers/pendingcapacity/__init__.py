@@ -34,6 +34,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from karpenter_tpu import constraints as _constraints
 from karpenter_tpu.api.metricsproducer import PendingCapacityStatus
 from karpenter_tpu.metrics.registry import GaugeRegistry, default_registry
 from karpenter_tpu.observability import solver_trace
@@ -70,7 +71,9 @@ from .encoder import _group_profile as _group_profile_impl
 # time through this module's global namespace.
 
 
-def encode_snapshot(snap, profiles, with_rows: bool = False, census=None):
+def encode_snapshot(
+    snap, profiles, with_rows: bool = False, census=None, constraints=None
+):
     """PUBLIC encoding API: store snapshot -> fixed-shape solver inputs.
 
     The one encoder every solve path uses — runtime reconcile, HA
@@ -81,9 +84,12 @@ def encode_snapshot(snap, profiles, with_rows: bool = False, census=None):
     place of a full rebuild — output parity with a full re-encode is
     bit-identical (pinned by tests/test_encoder_delta.py). See
     encoder.py for the full contract (deduplicated weighted shape rows,
-    spread/anti expansion, padding)."""
+    spread/anti expansion, padding). `constraints` is the merged
+    declarative constraint-group list (karpenter_tpu/constraints);
+    None/empty encodes today's unconstrained wire byte for byte."""
     return _encoder._encode_from_cache(
-        snap, profiles, with_rows=with_rows, census=census
+        snap, profiles, with_rows=with_rows, census=census,
+        constraints=constraints,
     )
 
 
@@ -109,14 +115,14 @@ def register_gauges(registry: GaugeRegistry) -> None:
 
 def _solve_targets(store, feed, due_keys):
     """The group axis: (namespace, name, due-object-or-None, selector,
-    nodeGroupRef) in deterministic key order — from the feed's
-    watch-maintained producer index when present, else one store
-    listing. Due producers use the CALLER's object so status lands on
-    the instance the engine persists."""
+    nodeGroupRef, constraint-group tuple) in deterministic key order —
+    from the feed's watch-maintained producer index when present, else
+    one store listing. Due producers use the CALLER's object so status
+    lands on the instance the engine persists."""
     if feed is not None:
         return [
-            (key[0], key[1], due_keys.get(key), selector, ref)
-            for key, (selector, ref) in feed.producers.items()
+            (key[0], key[1], due_keys.get(key), selector, ref, cons)
+            for key, (selector, ref, cons) in feed.producers.items()
         ]
     targets = []
     for mp in sorted(
@@ -129,9 +135,40 @@ def _solve_targets(store, feed, due_keys):
         targets.append(
             (key[0], key[1], due_keys.get(key, mp),
              mp.spec.pending_capacity.node_selector,
-             getattr(mp.spec.pending_capacity, "node_group_ref", ""))
+             getattr(mp.spec.pending_capacity, "node_group_ref", ""),
+             tuple(
+                 getattr(mp.spec.pending_capacity, "constraints", None)
+                 or ()
+             ))
         )
     return targets
+
+
+def _gather_constraints(targets, errors):
+    """Merged constraint-group list across producers, in target order
+    (first-match-wins membership makes the order semantic). Validation
+    is row-isolated like every other per-producer failure: a producer
+    with a poisoned constraint spec drops ITS groups and records its
+    error; every other producer's groups still compile. Cross-producer
+    duplicate names keep the first occurrence."""
+    from karpenter_tpu.constraints import validate_constraints
+
+    merged: List = []
+    seen: set = set()
+    for namespace, name, _, _, _, cons in targets:
+        if not cons:
+            continue
+        try:
+            validate_constraints(list(cons))
+        except Exception as e:  # noqa: BLE001 — row-isolated failure
+            errors[(namespace, name)] = e
+            continue
+        for group in cons:
+            if group.name in seen:
+                continue
+            seen.add(group.name)
+            merged.append(group)
+    return merged
 
 
 def _target_profiles(targets, feed, nodes, template_resolver, errors):
@@ -143,7 +180,7 @@ def _target_profiles(targets, feed, nodes, template_resolver, errors):
     the watch-versioned store state the fingerprint otherwise covers."""
     profiles = []
     template_rows = []
-    for namespace, name, _, sel, ref in targets:
+    for namespace, name, _, sel, ref, _cons in targets:
         try:
             profile = (
                 feed.nodes.profile(sel)
@@ -224,11 +261,24 @@ def _feed_fingerprint(feed, snap, needs_census, namespace_state, targets,
                 if isinstance(sel, dict)
                 else repr(sel),
                 ref,
+                _canonical_cons(cons),
             )
-            for namespace, name, _, sel, ref in targets
+            for namespace, name, _, sel, ref, cons in targets
         ),
         tuple(template_rows),
     )
+
+
+def _canonical_cons(cons):
+    """Constraint groups are fingerprint identity (a spec edit must
+    re-encode), row-isolated like selectors: a malformed group falls
+    back to repr rather than poisoning the whole memo key."""
+    from karpenter_tpu.constraints import canonical_constraints
+
+    try:
+        return canonical_constraints(list(cons))
+    except Exception:  # noqa: BLE001 — fingerprint must never raise
+        return repr(cons)
 
 
 def solve_pending(
@@ -295,18 +345,33 @@ def solve_pending(
         store, feed, all_pods, nodes, snap
     )
 
+    # declarative constraint groups (karpenter_tpu/constraints): merged
+    # across producers in target order, validation row-isolated
+    constraints = _gather_constraints(targets, errors)
+    cmeta = (
+        _constraints.constraint_meta(constraints, profiles)
+        if constraints
+        else None
+    )
+
     if feed is not None:
         _solve_feed_path(
             feed, snap, profiles, census, needs_census, namespace_state,
             targets, template_rows, registry, solver, errors,
+            constraints=constraints, cmeta=cmeta,
         )
     else:
-        inputs = encode_snapshot(snap, profiles, census=census)
-        _dispatch_and_record(inputs, targets, registry, solver, errors)
+        inputs = encode_snapshot(
+            snap, profiles, census=census, constraints=constraints
+        )
+        _dispatch_and_record(
+            inputs, targets, registry, solver, errors, cmeta=cmeta
+        )
     _publish_census(registry, census)
+    _publish_constraints(registry, cmeta)
     return {
         (namespace, name): errors.get((namespace, name))
-        for namespace, name, _, _, _ in targets
+        for namespace, name, _, _, _, _ in targets
     }
 
 
@@ -350,6 +415,7 @@ def _occupancy_census(store, feed, all_pods, nodes, snap):
 def _solve_feed_path(
     feed, snap, profiles, census, needs_census, namespace_state,
     targets, template_rows, registry, solver, errors,
+    constraints=None, cmeta=None,
 ) -> None:
     """Encode memo (feed path only): inputs are a pure function of
     (pod arena generation, node set, producer selectors, occupancy).
@@ -361,6 +427,19 @@ def _solve_feed_path(
     fingerprint = _feed_fingerprint(
         feed, snap, needs_census, namespace_state, targets, template_rows
     )
+    if constraints:
+        # admission epoch: while the constraint compile is degraded
+        # (last admission fell back — fault / open breaker), the epoch
+        # tracks the fallback counter, so every tick's fingerprint
+        # differs and re-encodes (retrying admission) — the memo can
+        # never pin the never-block fallback past the fault clearing.
+        # A healthy compile pins the constant "ok" epoch, so the
+        # constrained steady state memoizes like the unconstrained one.
+        fingerprint = fingerprint + (
+            ("degraded", _encoder.constraint_stats["fallbacks"])
+            if _encoder.constraint_stats.get("degraded")
+            else ("ok",),
+        )
     memo = feed.encode_memo
     cached_outputs = None
     if memo is not None and memo[0] == fingerprint:
@@ -371,12 +450,14 @@ def _solve_feed_path(
         cached_outputs = memo[2]
         _count_cache(registry, "hit")
     else:
-        inputs = encode_snapshot(snap, profiles, census=census)
+        inputs = encode_snapshot(
+            snap, profiles, census=census, constraints=constraints
+        )
         feed.encode_memo = (fingerprint, inputs, None)
         _count_cache(registry, "miss")
     host = _dispatch_and_record(
         inputs, targets, registry, solver, errors,
-        cached_outputs=cached_outputs,
+        cached_outputs=cached_outputs, cmeta=cmeta,
     )
     feed.encode_memo = (fingerprint, inputs, host)
 
@@ -409,6 +490,66 @@ def _publish_census(registry: GaugeRegistry, census) -> None:
         census.evictions_published = evictions
 
 
+CONSTRAINTS_SUBSYSTEM = "constraints"
+SPREAD_SKEW = "spread_skew"
+RESERVATION_FILL = "reservation_fill"
+CONSTRAINT_FALLBACK_TOTAL = "fallback_total"
+CONSTRAINT_COMPILE_TOTAL = "compile_total"
+CONSTRAINT_BREAKER_STATE = "breaker_state"
+
+
+def _publish_verdicts(registry, inputs, assigned, cmeta) -> None:
+    """karpenter_constraints_spread_skew{name=<group>} and
+    karpenter_constraints_reservation_fill{name=<reservation>}: the
+    constraint plane's verdicts, recomputed host-side from the solve's
+    per-row assignment (constraints/compiler.py helpers)."""
+    registry.register(CONSTRAINTS_SUBSYSTEM, SPREAD_SKEW)
+    registry.register(CONSTRAINTS_SUBSYSTEM, RESERVATION_FILL)
+    for name, skew in _constraints.spread_skew(
+        inputs, assigned, cmeta
+    ).items():
+        registry.gauge(CONSTRAINTS_SUBSYSTEM, SPREAD_SKEW).set(
+            name, "-", float(skew)
+        )
+    for name, fill in _constraints.reservation_fill(
+        inputs, assigned, cmeta
+    ).items():
+        registry.gauge(CONSTRAINTS_SUBSYSTEM, RESERVATION_FILL).set(
+            name, "-", float(fill)
+        )
+
+
+def _publish_constraints(registry: GaugeRegistry, cmeta) -> None:
+    """Constraint-plane health: compile/fallback counters (delta-
+    published from encoder.constraint_stats so repeated solves don't
+    double-count) and the breaker state gauge (0 closed / 1 half-open /
+    2 open). Published only while constraint groups are live — the
+    unconstrained fleet's metrics surface is unchanged."""
+    stats = _encoder.constraint_stats
+    unpublished = (
+        stats["compiles"] != stats["published_compiles"]
+        or stats["fallbacks"] != stats["published_fallbacks"]
+    )
+    if cmeta is None and not unpublished:
+        return
+    delta = stats["compiles"] - stats["published_compiles"]
+    if delta:
+        registry.register(
+            CONSTRAINTS_SUBSYSTEM, CONSTRAINT_COMPILE_TOTAL, kind="counter"
+        ).inc("-", "-", delta)
+        stats["published_compiles"] = stats["compiles"]
+    delta = stats["fallbacks"] - stats["published_fallbacks"]
+    if delta:
+        registry.register(
+            CONSTRAINTS_SUBSYSTEM, CONSTRAINT_FALLBACK_TOTAL, kind="counter"
+        ).inc("-", "-", delta)
+        stats["published_fallbacks"] = stats["fallbacks"]
+    registry.register(CONSTRAINTS_SUBSYSTEM, CONSTRAINT_BREAKER_STATE)
+    registry.gauge(CONSTRAINTS_SUBSYSTEM, CONSTRAINT_BREAKER_STATE).set(
+        "-", "-", float(_encoder._constraint_breaker.state_value())
+    )
+
+
 def _count_cache(registry: GaugeRegistry, outcome: str) -> None:
     """karpenter_runtime_encode_cache_total{name=hit|miss}: how often the
     tick-collapse encode memo spares a re-encode + device re-upload."""
@@ -438,13 +579,18 @@ def _pack_outputs(assigned_count, nodes_needed, lp_bound, unschedulable):
     )
 
 
-def _dispatch_and_record(
-    inputs, targets, registry, solver, errors=None, cached_outputs=None
+def _dispatch_and_record(  # lint: allow-complexity — the dispatch seam: one guard per optional telemetry/constraint surface
+    inputs, targets, registry, solver, errors=None, cached_outputs=None,
+    cmeta=None,
 ):
     """Solve + one host fetch + status/gauge writes. Returns the host
     output tuple (assigned_count, nodes_needed, lp_bound, unschedulable)
     so callers can memoize it; `cached_outputs` short-circuits the solve
-    for identical inputs (the memo-hit path)."""
+    for identical inputs (the memo-hit path). `cmeta` (ConstraintMeta)
+    enables the constraint verdict gauges — published from the solve's
+    per-row assignment, skipped on the memo-hit path (identical inputs
+    republish identical verdicts, already on the registry)."""
+    out = None
     if cached_outputs is not None:
         assigned_count, nodes_needed, lp_bound, unschedulable = cached_outputs
     else:
@@ -484,6 +630,15 @@ def _dispatch_and_record(
                 np.asarray(out.lp_bound),
             )
             unschedulable = int(out.unschedulable)
+
+    if (
+        cmeta is not None
+        and out is not None
+        and B.has_constraint_operands(inputs)
+    ):
+        _publish_verdicts(
+            registry, inputs, np.asarray(out.assigned), cmeta
+        )
 
     register_gauges(registry)
     gauge = lambda g: registry.gauge(SUBSYSTEM, g)

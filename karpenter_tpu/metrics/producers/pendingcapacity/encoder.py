@@ -18,7 +18,9 @@ from karpenter_tpu.api.core import (
     matches_affinity_shape,
     matches_selector,
 )
+from karpenter_tpu.constraints import compiler as _cc
 from karpenter_tpu.ops import binpack as B
+from karpenter_tpu.resilience import CircuitBreaker
 from karpenter_tpu.store.columnar import RESOURCE_PODS
 from karpenter_tpu.utils.functional import pad_to_multiple
 
@@ -36,6 +38,61 @@ from .scoring import _score_rows
 from .spread import _expand_spread_rows
 
 _pad = pad_to_multiple
+
+# -- constraint plane (karpenter_tpu/constraints) ----------------------------
+# The declarative-constraint compile is gated by a circuit breaker plus
+# the constraints.mask fault point: a failing compile NEVER blocks the
+# solve — the tick falls back to the unconstrained-but-feasible encode
+# (counted below, breaker fed) and recovers to the constrained fixed
+# point once the fault clears (half-open probe succeeds -> closed).
+
+_constraint_breaker = CircuitBreaker(failure_threshold=3, reset_s=30.0)
+# degraded: the LAST constrained admission fell back — the feed memo's
+# admission epoch keys on it to keep retrying until the compile heals
+constraint_stats = {
+    "compiles": 0,
+    "fallbacks": 0,
+    "short_circuits": 0,
+    "degraded": False,
+    "published_compiles": 0,
+    "published_fallbacks": 0,
+}
+
+
+def reset_constraint_state() -> None:
+    """Test / recovery-boot seam: fresh breaker, zeroed counters."""
+    global _constraint_breaker
+    _constraint_breaker = CircuitBreaker(failure_threshold=3, reset_s=30.0)
+    constraint_stats.update(
+        compiles=0, fallbacks=0, short_circuits=0, degraded=False,
+        published_compiles=0, published_fallbacks=0,
+    )
+
+
+def _constraints_admitted() -> bool:
+    """One breaker-gated admission per constrained encode. False means
+    THIS tick encodes unconstrained (the never-block fallback); the
+    breaker turns a persistently failing compile into cheap
+    short-circuits and grants one probe per reset window, so clearing
+    the fault restores the constrained fixed point."""
+    from karpenter_tpu.faults import inject
+
+    if not _constraint_breaker.allow():
+        constraint_stats["short_circuits"] += 1
+        constraint_stats["fallbacks"] += 1
+        constraint_stats["degraded"] = True
+        return False
+    try:
+        inject("constraints.mask")
+    except Exception as e:
+        _constraint_breaker.record_failure(type(e).__name__)
+        constraint_stats["fallbacks"] += 1
+        constraint_stats["degraded"] = True
+        return False
+    _constraint_breaker.record_success()
+    constraint_stats["compiles"] += 1
+    constraint_stats["degraded"] = False
+    return True
 
 def _profile_candidates(nodes: List, selector: Dict[str, str]) -> List:
     """Ready+schedulable matching nodes, falling back to ANY matching
@@ -228,6 +285,32 @@ def _dedup_rows_keyed(snap):
     return snap.dedup_idx[order], snap.dedup_weight[order], keys
 
 
+def _dedup_rows_constrained(snap, membership):
+    """_dedup_rows with group membership appended to the row identity.
+
+    Pod labels are deliberately NOT part of the incremental dedup key
+    (store/columnar.dedup_key) — unconstrained fleets must not split
+    otherwise-identical rows on label noise. When constraint groups are
+    live, two spec-identical pods in DIFFERENT groups are no longer
+    interchangeable, so the constrained encode re-dedups over
+    (row bytes, membership) with np.unique here. O(N log N) over live
+    rows, paid only by constraint-carrying producers."""
+    hi = snap.requests.shape[0]
+    if hi == 0:
+        return np.zeros(0, np.intp), np.zeros(0, np.int32)
+    rows = _row_bytes(snap, slice(None))
+    keyed = np.empty(
+        hi,
+        dtype=[("k", rows.dtype["k"]), ("m", np.int32)],
+    )
+    keyed["k"] = rows["k"]
+    keyed["m"] = np.asarray(membership, np.int32)
+    _, idx, counts = np.unique(
+        keyed, return_index=True, return_counts=True
+    )
+    return idx, counts.astype(np.int32)
+
+
 
 
 def _resource_universe(snap, profiles):
@@ -361,7 +444,9 @@ def _priority_tier_operands(snap, profiles, row_idx, n_pods, n_groups):
     return pod_priority, group_tier
 
 
-def _encode_full(snap, profiles, with_rows: bool = False, census=None):
+def _encode_full(  # lint: allow-complexity — the encode spine: one arm per optional operand family (priority/tier/spread/constraints)
+    snap, profiles, with_rows: bool = False, census=None, constraints=None
+):
     """Snapshot (store/columnar.PendingSnapshot) -> solver inputs, with
     rows DEDUPLICATED into distinct pod shapes + multiplicities
     (pod_weight) — see _dedup_rows. Every solve path (feed, pod_cache,
@@ -384,7 +469,24 @@ def _encode_full(snap, profiles, with_rows: bool = False, census=None):
             )
         return label_dicts_box[0]
 
-    row_idx, row_weight = _dedup_rows(snap)
+    # declarative constraint groups (karpenter_tpu/constraints): gated
+    # through the breaker + fault point; denied admission encodes this
+    # tick unconstrained (never-block fallback)
+    membership = None
+    if constraints and _constraints_admitted():
+        if snap.labels_id is not None and snap.label_sets:
+            membership = _cc.compile_membership(
+                snap.label_sets, snap.labels_id, constraints
+            )
+        else:
+            membership = np.zeros(snap.requests.shape[0], np.int32)
+
+    if membership is not None and bool((membership != 0).any()):
+        # membership joins the row identity: spec-identical pods in
+        # different groups are no longer interchangeable
+        row_idx, row_weight = _dedup_rows_constrained(snap, membership)
+    else:
+        row_idx, row_weight = _dedup_rows(snap)
     # hard topology spread: constrained rows split into balanced
     # per-domain sub-rows (same source row gathered more than once, each
     # chunk masked to its domain's groups) — the device program is
@@ -402,6 +504,25 @@ def _encode_full(snap, profiles, with_rows: bool = False, census=None):
             group_label_dicts, census=census,
         )
     )
+
+    # compile the declarative groups over the final row set; the
+    # spread-quota pre-split (compiled.rep) regathers every per-row
+    # array built so far
+    compiled = None
+    if membership is not None:
+        compiled = _cc.compile_rows(
+            membership[row_idx],
+            row_weight,
+            snap.valid[row_idx],
+            profiles,
+            constraints,
+        )
+        row_idx = row_idx[compiled.rep]
+        row_weight = compiled.row_weight
+        if spread_forbidden is not None:
+            spread_forbidden = spread_forbidden[compiled.rep]
+        if row_exclusive is not None:
+            row_exclusive = row_exclusive[compiled.rep]
     hi = len(row_idx)
 
     resources, resource_index, pod_slot = _resource_universe(
@@ -441,6 +562,15 @@ def _encode_full(snap, profiles, with_rows: bool = False, census=None):
             pod_group_forbidden = np.zeros((n_pods, n_groups), bool)
         pod_group_forbidden[:hi, : len(profiles)] |= spread_forbidden
 
+    # declarative anti-affinity members take whole nodes too: OR into
+    # the same exclusivity rows the hostname self-anti path flags
+    if compiled is not None and compiled.exclusive is not None:
+        row_exclusive = (
+            compiled.exclusive
+            if row_exclusive is None
+            else (row_exclusive | compiled.exclusive)
+        )
+
     # hostname self-anti-affinity rows take a whole node each — absent
     # unless some live pod actually carries the constraint
     pod_exclusive = None
@@ -461,6 +591,34 @@ def _encode_full(snap, profiles, with_rows: bool = False, census=None):
         snap, profiles, row_idx, n_pods, n_groups
     )
 
+    # constraint-plane operands, padded to the bucketed extents (padding
+    # pod rows are invalid and weightless; padding groups are all-zero
+    # allocatable — both inert to every mask term). Each operand pair
+    # stays None unless the compile produced it, so constraint-free
+    # fleets ship today's wire byte for byte.
+    pod_claim = group_reservation = None
+    pod_pack_class = None
+    pod_spread_slot = group_domain = spread_cap = None
+    if compiled is not None:
+        if compiled.claim is not None:
+            pod_claim = np.zeros(n_pods, np.int32)
+            pod_claim[:hi] = compiled.claim
+            group_reservation = np.zeros(n_groups, np.int32)
+            group_reservation[: len(profiles)] = (
+                compiled.group_reservation
+            )
+        if compiled.pack_class is not None:
+            pod_pack_class = np.zeros(
+                (n_pods, compiled.pack_class.shape[1]), bool
+            )
+            pod_pack_class[:hi] = compiled.pack_class
+        if compiled.spread_slot is not None:
+            pod_spread_slot = np.zeros(n_pods, np.int32)
+            pod_spread_slot[:hi] = compiled.spread_slot
+            group_domain = np.zeros(n_groups, np.int32)
+            group_domain[: len(profiles)] = compiled.group_domain
+            spread_cap = compiled.spread_cap.copy()
+
     inputs = B.BinPackInputs(
         pod_requests=pod_requests,
         pod_valid=pod_valid,
@@ -475,6 +633,12 @@ def _encode_full(snap, profiles, with_rows: bool = False, census=None):
         pod_exclusive=pod_exclusive,
         pod_priority=pod_priority,
         group_tier=group_tier,
+        pod_claim=pod_claim,
+        group_reservation=group_reservation,
+        pod_pack_class=pod_pack_class,
+        pod_spread_slot=pod_spread_slot,
+        group_domain=group_domain,
+        spread_cap=spread_cap,
     )
     if with_rows:
         # the simulation API maps per-row solver outputs back to pods:
@@ -652,10 +816,21 @@ class SnapshotDeltaCache:
             self._entries.clear()
         reset_resident_plans()
 
-    def encode(self, snap, profiles, with_rows: bool = False, census=None):
+    def encode(
+        self,
+        snap,
+        profiles,
+        with_rows: bool = False,
+        census=None,
+        constraints=None,
+    ):
         if (
             with_rows
             or census is not None
+            # constraint groups re-key the dedup (membership joins the
+            # row identity) and attach operands the splice doesn't
+            # carry: always a full pass
+            or constraints
             # no incremental dedup (hand-built / oracle snapshots): bail
             # BEFORE the keyed dedup pass, or a 100k-row snapshot would
             # pay the O(N) np.unique row sort twice (here and inside
@@ -665,7 +840,8 @@ class SnapshotDeltaCache:
         ):
             self.fulls += 1
             return _encode_full(
-                snap, profiles, with_rows=with_rows, census=census
+                snap, profiles, with_rows=with_rows, census=census,
+                constraints=constraints,
             )
         row_idx, row_weight, keys = _dedup_rows_keyed(snap)
         if keys is None or self._live_constraints(snap, row_idx):
@@ -869,7 +1045,9 @@ def reset_delta_cache() -> None:
     _default_delta.reset()
 
 
-def _encode_from_cache(snap, profiles, with_rows: bool = False, census=None):
+def _encode_from_cache(
+    snap, profiles, with_rows: bool = False, census=None, constraints=None
+):
     """THE encode seam (public face: pendingcapacity.encode_snapshot):
     delta-accelerated when the process-default SnapshotDeltaCache has a
     matching entry, bit-identical to _encode_full always."""
@@ -880,7 +1058,8 @@ def _encode_from_cache(snap, profiles, with_rows: bool = False, census=None):
 
     inject("encoder.encode")
     return _default_delta.encode(
-        snap, profiles, with_rows=with_rows, census=census
+        snap, profiles, with_rows=with_rows, census=census,
+        constraints=constraints,
     )
 
 

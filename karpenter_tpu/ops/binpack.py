@@ -108,6 +108,44 @@ class BinPackInputs:
     # never under-counts. Feasibility/assignment are untouched. None =
     # no exclusive rows (the common case costs nothing).
     pod_exclusive: Optional[jax.Array] = None
+    # --- constraint plane (karpenter_tpu/constraints) ------------------
+    # All six operands are Optional; absent operands reproduce the
+    # pre-constraint outputs bit-identically (the PR 6 pattern). The
+    # constraint compiler (constraints/compiler.py) is the only producer.
+    #
+    # i32[P]: reservation claim id per row (0 = unclaimed). A row with
+    # claim c fits ONLY groups whose group_reservation == c; unclaimed
+    # rows fit only open (reservation-0) groups. One integer equality
+    # covers both reserved-capacity claims and the open-capacity fence.
+    pod_claim: Optional[jax.Array] = None
+    # i32[T]: reservation id carried by each group's nodes (0 = open
+    # capacity). Meaningful alone (fences reserved groups away from
+    # unclaimed pods) or with pod_claim.
+    group_reservation: Optional[jax.Array] = None
+    # bool[P, C]: one-hot pack class per row. Column 0 is the shared
+    # default class; columns 1+ are isolation classes (anti-affinity
+    # groups / compact placement) whose rows must not share a node with
+    # any other class. C rides the operand SHAPE (no static kwarg); the
+    # kernel folds rows with no bit set into class 0 in BOTH backends.
+    # Affects ONLY the packing stage: per-class shelf-BFD histograms
+    # [C*T, B] sum into nodes_needed — conservative (never under-counts)
+    # because the real scheduler could co-locate across classes only
+    # when no anti-affinity matches.
+    pod_pack_class: Optional[jax.Array] = None
+    # i32[P]: topology-spread slot per row (0 = unconstrained; s >= 1
+    # indexes spread_cap row s-1). Rows in slot s water-fill domains in
+    # index order under that slot's per-domain caps via an exclusive
+    # prefix-sum rank; EXACTNESS CONTRACT: the compiler pre-splits
+    # constrained rows at cap boundaries so no weighted row straddles a
+    # domain boundary. Rank >= total cap -> infeasible everywhere
+    # (conservative unschedulable).
+    pod_spread_slot: Optional[jax.Array] = None
+    # i32[T]: topology domain index per group (zone); domain D-1 is the
+    # no-zone sink with zero cap in every slot.
+    group_domain: Optional[jax.Array] = None
+    # i32[S, D]: per-slot per-domain pod-count caps (balanced allocation
+    # computed by the compiler so skew <= 1 <= any max_skew >= 1).
+    spread_cap: Optional[jax.Array] = None
 
 
 @jax.tree_util.register_dataclass
@@ -167,6 +205,76 @@ def steered_choice(feasible, score, steer, xp=np):
     return xp.argmax(xp.where(tie, score, neg_inf), axis=1)
 
 
+_CONSTRAINT_FIELDS = (
+    "pod_claim",
+    "group_reservation",
+    "pod_pack_class",
+    "pod_spread_slot",
+    "group_domain",
+    "spread_cap",
+)
+
+
+def has_constraint_operands(inputs: BinPackInputs) -> bool:
+    """True when any constraint-plane operand is present. The solver
+    service and the pallas fold both route constraint-carrying traffic
+    to the XLA family on this predicate (Mosaic has no constraint
+    entry — silently dropping an operand is the PR 8 bug class)."""
+    return any(getattr(inputs, f) is not None for f in _CONSTRAINT_FIELDS)
+
+
+def constraint_mask(
+    claim, reservation, slot, domain, caps, weight, valid, xp=np
+):
+    """Feasibility mask (broadcastable against [P, T]) for the
+    reservation-claim and topology-spread constraint operands, or None
+    when neither constraint is present.
+
+    Reservation is one integer equality: claim[p] == reservation[t]
+    (0 == 0 keeps unclaimed pods on open capacity; c == c keeps claimed
+    pods on their reservation). Either side absent substitutes zeros —
+    expressed through broadcasting so no zeros array is materialized.
+
+    Spread is an in-kernel rank-interval water-fill: rows in slot s
+    (s >= 1) take an exclusive weighted prefix-sum rank over their slot,
+    and each row targets the FIRST domain whose cumulative cap still has
+    room for its rank. The compiler pre-splits rows at cap boundaries
+    (see pod_spread_slot docstring) so the greedy fill is exact; a rank
+    past the total cap is infeasible everywhere (conservative
+    unschedulable). Integer-only arithmetic end to end, so the numpy
+    mirror (xp=np) is bitwise identical to the XLA program (xp=jnp)."""
+    mask = None
+    if claim is not None or reservation is not None:
+        if claim is None:
+            res_m = reservation[None, :] == 0  # [1, T]
+        elif reservation is None:
+            res_m = (claim == 0)[:, None]  # [P, 1]
+        else:
+            res_m = claim[:, None] == reservation[None, :]  # [P, T]
+        mask = res_m
+    if slot is not None and domain is not None and caps is not None:
+        n_slots = caps.shape[0]
+        valid_i = valid.astype(xp.int32)
+        w_eff = valid_i if weight is None else weight * valid_i  # i32[P]
+        onehot = (
+            slot[:, None]
+            == xp.arange(1, n_slots + 1, dtype=xp.int32)[None, :]
+        )  # bool[P, S]
+        contrib = w_eff[:, None] * onehot.astype(xp.int32)  # i32[P, S]
+        rank = xp.cumsum(contrib, axis=0) - contrib  # exclusive, per slot
+        rank_p = xp.sum(xp.where(onehot, rank, 0), axis=1)  # i32[P]
+        cumcap = xp.cumsum(caps, axis=1)  # i32[S, D]
+        row_caps = cumcap[xp.clip(slot - 1, 0, n_slots - 1)]  # i32[P, D]
+        fits_dom = rank_p[:, None] < row_caps  # bool[P, D]
+        target = xp.argmax(fits_dom, axis=1).astype(xp.int32)  # first fit
+        has_dom = xp.any(fits_dom, axis=1)
+        sp_m = (slot[:, None] <= 0) | (
+            (domain[None, :] == target[:, None]) & has_dom[:, None]
+        )  # [P, T]
+        mask = sp_m if mask is None else mask & sp_m
+    return mask
+
+
 def _feasibility(inputs: BinPackInputs) -> jax.Array:
     """bool[P, T]: pod p can run on a node of group t."""
     req = inputs.pod_requests  # [P, R]
@@ -201,6 +309,18 @@ def _feasibility(inputs: BinPackInputs) -> jax.Array:
     if inputs.pod_group_forbidden is not None:
         fits &= ~inputs.pod_group_forbidden
     fits &= inputs.pod_valid[:, None]
+    cmask = constraint_mask(
+        inputs.pod_claim,
+        inputs.group_reservation,
+        inputs.pod_spread_slot,
+        inputs.group_domain,
+        inputs.spread_cap,
+        inputs.pod_weight,
+        inputs.pod_valid,
+        xp=jnp,
+    )
+    if cmask is not None:
+        fits = fits & cmask
     return fits
 
 
@@ -295,7 +415,7 @@ def _shelf_bfd(histogram: jax.Array, buckets: int) -> jax.Array:
 
 
 @partial(jax.jit, static_argnames=("buckets",))
-def binpack(inputs: BinPackInputs, buckets: int = DEFAULT_BUCKETS) -> BinPackOutputs:
+def binpack(inputs: BinPackInputs, buckets: int = DEFAULT_BUCKETS) -> BinPackOutputs:  # lint: allow-complexity — kernel entry: one guard per optional operand
     feasible = _feasibility(inputs)  # [P, T]
     share = _dominant_share(inputs)  # [P, T]
 
@@ -345,19 +465,51 @@ def binpack(inputs: BinPackInputs, buckets: int = DEFAULT_BUCKETS) -> BinPackOut
         )
     # per-bucket reduction keeps peak memory at [P, T] (a [P, T, B] one-hot
     # would be ~1 GB at the 100k x 300 bench scale)
-    histogram = jnp.stack(
-        [
-            jnp.sum(
-                jnp.where(bucket_of == b, member_w, 0),
-                axis=0,
-                dtype=jnp.int32,
-            )
-            for b in range(1, buckets + 1)
-        ],
-        axis=1,
-    )  # [T, B]
+    pc = inputs.pod_pack_class
+    if pc is None:
+        histogram = jnp.stack(
+            [
+                jnp.sum(
+                    jnp.where(bucket_of == b, member_w, 0),
+                    axis=0,
+                    dtype=jnp.int32,
+                )
+                for b in range(1, buckets + 1)
+            ],
+            axis=1,
+        )  # [T, B]
 
-    nodes_needed = _shelf_bfd(histogram, buckets)
+        nodes_needed = _shelf_bfd(histogram, buckets)
+    else:
+        # isolation pack classes: rows of different classes must not
+        # share a node, so shelf-BFD runs on a per-class [T, B] histogram
+        # and nodes sum across classes (shelf rows are independent, so
+        # per-class-then-sum == the [C*T, B] stacked solve). Rows with no
+        # class bit fold to the shared class 0 (the safety rule both
+        # backends pin). Kept as C separate [T, B] solves rather than one
+        # concatenated [C*T, B]: GSPMD miscompiles a concat of
+        # separately-reduced pods-axis partial sums (the pending psum is
+        # applied per concat operand AND per shard, inflating counts by
+        # the pods-shard factor), while the [T, B] shape partitions
+        # correctly — pinned by the sharded-parity tests.
+        n_classes = pc.shape[1]
+        fold0 = pc[:, 0] | ~jnp.any(pc, axis=1)
+        nodes_needed = jnp.zeros((n_groups,), jnp.int32)
+        for c in range(n_classes):
+            cls = fold0 if c == 0 else pc[:, c]
+            member_c = member_w * cls[:, None].astype(jnp.int32)
+            hist_c = jnp.stack(
+                [
+                    jnp.sum(
+                        jnp.where(bucket_of == b, member_c, 0),
+                        axis=0,
+                        dtype=jnp.int32,
+                    )
+                    for b in range(1, buckets + 1)
+                ],
+                axis=1,
+            )  # [T, B]
+            nodes_needed = nodes_needed + _shelf_bfd(hist_c, buckets)
 
     # LP lower bound: per resource, total assigned demand / per-node
     # allocatable, ceil; max across resources
@@ -402,7 +554,12 @@ def _fold_for_pallas(inputs: BinPackInputs):
     express without magnitude limits — that rare combination routes to
     the XLA program instead (exact, still on-device). Everyone else
     passes through untouched; only priority fleets pay the host fold
-    (and forgo the identity device memo)."""
+    (and forgo the identity device memo). Constraint-plane operands
+    (has_constraint_operands) always route to XLA: Mosaic has no
+    constraint entry, and dropping an operand silently is the PR 8 bug
+    class."""
+    if has_constraint_operands(inputs):
+        return inputs, "xla"
     if inputs.pod_priority is None or inputs.group_tier is None:
         return inputs, "pallas"
     if inputs.pod_group_score is not None:

@@ -34,7 +34,12 @@ from __future__ import annotations
 
 import numpy as np
 
-from karpenter_tpu.ops.binpack import BinPackInputs, BinPackOutputs
+from karpenter_tpu.ops.binpack import (
+    BinPackInputs,
+    BinPackOutputs,
+    constraint_mask,
+    has_constraint_operands,
+)
 
 
 def _as_np(x, dtype=None):
@@ -321,18 +326,29 @@ def _shelf_bfd_np(histogram: np.ndarray, buckets: int) -> np.ndarray:
 def _assign_numpy(
     requests, valid, intolerant, required, alloc, taints, labels,
     forbidden, score, weight, exclusive, buckets, steer=None,
+    claim=None, reservation=None, slot=None, domain=None, caps=None,
+    pack_class=None,
 ):
     """The pure-numpy assignment pass (the fallback while the C kernel's
     background build finishes, and the only pass expressing the
-    two-stage lexicographic steer+score choice). Sparse layout:
-    everything after the argmax scatters over the ONE assigned group
-    per pod — O(P), where the dense XLA layout is O(P*T*(B|R))."""
+    two-stage lexicographic steer+score choice and the constraint
+    plane). Sparse layout: everything after the argmax scatters over
+    the ONE assigned group per pod — O(P), where the dense XLA layout
+    is O(P*T*(B|R))."""
     _, n_resources = requests.shape
     n_groups = alloc.shape[0]
     feasible = _feasibility_np(
         requests, valid, intolerant, required, alloc, taints, labels,
         forbidden,
     )
+    # reservation + spread mask: the shared integer-exact definition
+    # (ops/binpack.constraint_mask with xp=np) — bitwise identical to
+    # the XLA feasibility stage by construction
+    cmask = constraint_mask(
+        claim, reservation, slot, domain, caps, weight, valid, xp=np
+    )
+    if cmask is not None:
+        feasible = feasible & cmask
     any_feasible = feasible.any(axis=1)
     if score is None and steer is None:
         choice = np.argmax(feasible, axis=1)
@@ -379,11 +395,28 @@ def _assign_numpy(
     if exclusive is not None:
         # hostname self-anti-affinity: the pod takes a whole node
         bucket_of = np.where(exclusive[rows], buckets, bucket_of)
-    histogram = np.bincount(
-        groups_of.astype(np.int64) * buckets + (bucket_of - 1),
-        weights=w_of,
-        minlength=n_groups * buckets,
-    ).reshape(n_groups, buckets)
+    if pack_class is None:
+        histogram = np.bincount(
+            groups_of.astype(np.int64) * buckets + (bucket_of - 1),
+            weights=w_of,
+            minlength=n_groups * buckets,
+        ).reshape(n_groups, buckets)
+    else:
+        # per-class histograms [C*T, B], mirroring the XLA program's
+        # class-partitioned shelf exactly: rows with no class bit fold
+        # to the shared class 0, and a row counts in EVERY set class
+        # (one-hot by compiler contract, but the mirror pins the kernel
+        # semantics, not the contract)
+        n_classes = pack_class.shape[1]
+        pc = pack_class.copy()
+        pc[:, 0] |= ~pc.any(axis=1)
+        histogram = np.zeros((n_classes * n_groups, buckets), np.float64)
+        flat = groups_of.astype(np.int64) * buckets + (bucket_of - 1)
+        for c in range(n_classes):
+            m = pc[rows, c]
+            histogram[c * n_groups : (c + 1) * n_groups] = np.bincount(
+                flat[m], weights=w_of[m], minlength=n_groups * buckets
+            ).reshape(n_groups, buckets)
 
     # f64 demand accumulation in pod order — bitwise-identical to
     # the native kernel's accumulation
@@ -422,7 +455,7 @@ def _steered(inputs: BinPackInputs, score):
     return score, steer
 
 
-def binpack_numpy(
+def binpack_numpy(  # lint: allow-complexity — the bitwise numpy mirror: mirrors every optional-operand arm of the XLA kernel
     inputs: BinPackInputs, buckets: int = 32, use_native: bool = True
 ) -> BinPackOutputs:
     """use_native=True (default) routes the assignment pass through the
@@ -459,14 +492,48 @@ def binpack_numpy(
         else _as_np(inputs.pod_exclusive, bool)
     )
     score, steer = _steered(inputs, score)
+    constrained = has_constraint_operands(inputs)
+    claim = (
+        None
+        if inputs.pod_claim is None
+        else _as_np(inputs.pod_claim, np.int32)
+    )
+    reservation = (
+        None
+        if inputs.group_reservation is None
+        else _as_np(inputs.group_reservation, np.int32)
+    )
+    slot = (
+        None
+        if inputs.pod_spread_slot is None
+        else _as_np(inputs.pod_spread_slot, np.int32)
+    )
+    domain = (
+        None
+        if inputs.group_domain is None
+        else _as_np(inputs.group_domain, np.int32)
+    )
+    caps = (
+        None
+        if inputs.spread_cap is None
+        else _as_np(inputs.spread_cap, np.int32)
+    )
+    pack_class = (
+        None
+        if inputs.pod_pack_class is None
+        else _as_np(inputs.pod_pack_class, bool)
+    )
     n_pods, n_resources = requests.shape
     n_groups = alloc.shape[0]
 
     lib = None
     # steer != None means BOTH a preference score and tier steering are
     # live: the choice is two-stage (lexicographic) and the native
-    # kernel's single-score argmax can't express it — numpy stages only
-    if use_native and n_pods and steer is None:
+    # kernel's single-score argmax can't express it — numpy stages only.
+    # Constraint-plane operands route around the native pass the same
+    # way: its fixed C argument list predates them, and silently
+    # dropping an operand is the PR 8 bug class.
+    if use_native and n_pods and steer is None and not constrained:
         # never block a degraded-mode tick inside a cc subprocess: use
         # the kernel only once its background build has finished, and
         # run the numpy stages meanwhile (peek/ensure-async pattern,
@@ -499,9 +566,16 @@ def binpack_numpy(
         ) = _assign_numpy(
             requests, valid, intolerant, required, alloc, taints, labels,
             forbidden, score, weight, exclusive, buckets, steer=steer,
+            claim=claim, reservation=reservation, slot=slot,
+            domain=domain, caps=caps, pack_class=pack_class,
         )
 
     nodes_needed = _shelf_bfd(histogram, buckets, lib)
+    if pack_class is not None:
+        # class-partitioned shelf: [C*T] node counts sum across classes
+        nodes_needed = (
+            nodes_needed.reshape(-1, n_groups).sum(axis=0).astype(np.int32)
+        )
 
     # LP bound: f64-accumulated demand — strictly more accurate than the
     # XLA program's f32 einsum; at demand/allocatable ratios above ~84

@@ -143,7 +143,7 @@ def mesh_extents(mesh: Mesh) -> Tuple[int, int]:
 # ---------------------------------------------------------------------------
 
 
-def binpack_shardings(
+def binpack_shardings(  # lint: allow-complexity — one sharding rule per operand, optional operands included
     mesh: Mesh,
     with_weight: bool = False,
     with_forbidden: bool = False,
@@ -151,6 +151,12 @@ def binpack_shardings(
     with_exclusive: bool = False,
     with_priority: bool = False,
     with_tier: bool = False,
+    with_claim: bool = False,
+    with_reservation: bool = False,
+    with_pack_class: bool = False,
+    with_spread_slot: bool = False,
+    with_domain: bool = False,
+    with_spread_cap: bool = False,
     batch: bool = False,
 ) -> BinPackInputs:
     """A BinPackInputs-shaped pytree of NamedShardings.
@@ -187,6 +193,18 @@ def binpack_shardings(
         pod_exclusive=s(rows) if with_exclusive else None,
         pod_priority=s(rows) if with_priority else None,
         group_tier=s(AXIS_GROUPS) if with_tier else None,
+        # constraint-plane operands (PR 6 pattern): pod-side vectors ride
+        # the rows axis, group-side vectors the groups axis; the pack-
+        # class one-hot's C axis and the [S, D] cap table are constraint-
+        # universe-sized and replicate. The spread rank is an integer
+        # cumsum over the pods axis — exact under any GSPMD collective
+        # decomposition, so sharded == single-device stays bitwise.
+        pod_claim=s(rows) if with_claim else None,
+        group_reservation=s(AXIS_GROUPS) if with_reservation else None,
+        pod_pack_class=s(rows, None) if with_pack_class else None,
+        pod_spread_slot=s(rows) if with_spread_slot else None,
+        group_domain=s(AXIS_GROUPS) if with_domain else None,
+        spread_cap=s(None, None) if with_spread_cap else None,
     )
 
 
@@ -195,8 +213,9 @@ def stacked_binpack_shardings(
 ) -> BinPackInputs:
     """binpack_shardings for a coalesced batch stack, keyed by the
     solver service's operand-presence tuple (solver/bucketing.presence:
-    weight, forbidden, score, exclusive, priority, tier)."""
-    w, f, sc, e, pr, ti = presence
+    weight, forbidden, score, exclusive, priority, tier, claim,
+    reservation, pack_class, spread_slot, domain, spread_cap)."""
+    w, f, sc, e, pr, ti, cl, rv, pcls, ss, dom, cap = presence
     return binpack_shardings(
         mesh,
         with_weight=w,
@@ -205,6 +224,12 @@ def stacked_binpack_shardings(
         with_exclusive=e,
         with_priority=pr,
         with_tier=ti,
+        with_claim=cl,
+        with_reservation=rv,
+        with_pack_class=pcls,
+        with_spread_slot=ss,
+        with_domain=dom,
+        with_spread_cap=cap,
         batch=True,
     )
 
@@ -295,7 +320,7 @@ def decision_shardings(mesh: Mesh) -> DecisionInputs:
 
 
 
-def pad_binpack_inputs_for_mesh(
+def pad_binpack_inputs_for_mesh(  # lint: allow-complexity — one inert-padding rule per operand, optional operands included
     inputs: BinPackInputs, mesh: Mesh
 ) -> BinPackInputs:
     """Grow P to a multiple of the pods axis and T of the groups axis.
@@ -374,6 +399,38 @@ def pad_binpack_inputs_for_mesh(
             # tier 0 = on-demand; padded columns are zero-alloc/infeasible
             else pad0(inputs.group_tier, T1)
         ),
+        # constraint-plane operands — every one carried through (the PR 8
+        # silent-drop bug class): claim/slot pad 0 (unclaimed /
+        # unconstrained on invalid rows — zero spread-rank contribution),
+        # reservation/domain pad 0 on zero-alloc columns nothing fits,
+        # pack-class rows pad all-false (invalid, never histogrammed),
+        # and the [S, D] cap table has no pod/group axis to pad
+        pod_claim=(
+            None
+            if inputs.pod_claim is None
+            else pad0(inputs.pod_claim, P1)
+        ),
+        group_reservation=(
+            None
+            if inputs.group_reservation is None
+            else pad0(inputs.group_reservation, T1)
+        ),
+        pod_pack_class=(
+            None
+            if inputs.pod_pack_class is None
+            else pad0(inputs.pod_pack_class, P1)
+        ),
+        pod_spread_slot=(
+            None
+            if inputs.pod_spread_slot is None
+            else pad0(inputs.pod_spread_slot, P1)
+        ),
+        group_domain=(
+            None
+            if inputs.group_domain is None
+            else pad0(inputs.group_domain, T1)
+        ),
+        spread_cap=inputs.spread_cap,
     )
 
 
@@ -511,6 +568,12 @@ def shard_binpack_inputs(mesh: Mesh, inputs: BinPackInputs) -> BinPackInputs:
             with_exclusive=inputs.pod_exclusive is not None,
             with_priority=inputs.pod_priority is not None,
             with_tier=inputs.group_tier is not None,
+            with_claim=inputs.pod_claim is not None,
+            with_reservation=inputs.group_reservation is not None,
+            with_pack_class=inputs.pod_pack_class is not None,
+            with_spread_slot=inputs.pod_spread_slot is not None,
+            with_domain=inputs.group_domain is not None,
+            with_spread_cap=inputs.spread_cap is not None,
         ),
     )
 
@@ -668,6 +731,8 @@ def dryrun_fleet_step(n_devices: int) -> None:
     weights = np.ones(33, np.int32)
     weights[:4] = 5  # a few multiplied shape rows: 49 pods in 33 rows
     d_ref_in = example_decision_inputs(N=16, M=4)
+    pack_class = np.zeros((33, 3), bool)
+    pack_class[np.arange(33), rng.integers(0, 3, 33)] = True
     b_ref_in = dataclasses.replace(
         example_binpack_inputs(P_=33, T=8, K=8, L=8),
         pod_weight=jnp.asarray(weights),
@@ -676,6 +741,22 @@ def dryrun_fleet_step(n_devices: int) -> None:
             rng.integers(0, 100, (33, 8)).astype(np.float32)
         ),
         pod_exclusive=jnp.asarray(rng.random(33) < 0.25),
+        # constraint-plane operands (this PR's widest set): claims,
+        # isolation pack classes, and a spread slot with per-domain
+        # caps — the padding path that dropped any of them would break
+        # the bitwise equality below
+        pod_claim=jnp.asarray(rng.integers(0, 2, 33, dtype=np.int32)),
+        group_reservation=jnp.asarray(
+            rng.integers(0, 2, 8, dtype=np.int32)
+        ),
+        pod_pack_class=jnp.asarray(pack_class),
+        pod_spread_slot=jnp.asarray(
+            rng.integers(0, 3, 33, dtype=np.int32)
+        ),
+        group_domain=jnp.asarray(rng.integers(0, 2, 8, dtype=np.int32)),
+        spread_cap=jnp.asarray(
+            rng.integers(1, 30, (2, 2), dtype=np.int32)
+        ),
     )
     # single-device reference: same jitted program, no mesh
     d_ref, b_ref = jax.device_get(fleet_step(d_ref_in, b_ref_in, buckets=8))

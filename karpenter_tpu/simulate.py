@@ -2012,6 +2012,217 @@ def simulate_delta(
     }
 
 
+# -- constraint-plane replay (docs/constraints.md) ---------------------------
+
+
+def simulate_constraints(  # lint: allow-complexity — scenario assembly: world build + outage replay + before/after report
+    ticks: int = 3,
+    zones: int = 3,
+    nodes_per_zone: int = 2,
+    web_pods: int = 6,
+    gold_pods: int = 2,
+    plain_pods: int = 4,
+    seed: int = 7,
+) -> dict:
+    """The --simulate --constraints replay (docs/constraints.md): a
+    spread-constrained serving fleet with a gold reservation, driven
+    through the REAL producer/encoder/solver path, then hit with a
+    seeded zonal outage. The report shows per-group spread skew and
+    reservation fill BEFORE and AFTER the outage — the constrained
+    re-solve must rebalance onto the surviving zones without dropping
+    the reservation fence — plus deterministic per-phase digests the
+    acceptance test pins (tests/test_simulate.py).
+
+    Nothing here touches a live store or provider: the world is
+    self-contained (fake provider, scripted clock)."""
+    from karpenter_tpu.api.core import (
+        Container, Node, NodeCondition, NodeSpec, NodeStatus,
+        ObjectMeta, Pod, PodSpec, RESERVATION_LABEL, ZONE_LABEL,
+        resource_list,
+    )
+    from karpenter_tpu.api.metricsproducer import (
+        MetricsProducer, MetricsProducerSpec, PendingCapacitySpec,
+    )
+    from karpenter_tpu.cloudprovider.fake import FakeFactory
+    from karpenter_tpu.constraints import ConstraintGroup, SpreadSpec
+    from karpenter_tpu.metrics.producers.pendingcapacity import (
+        CONSTRAINTS_SUBSYSTEM, RESERVATION_FILL, SPREAD_SKEW,
+    )
+    from karpenter_tpu.metrics.producers.pendingcapacity import (
+        encoder as _pc_encoder,
+    )
+    from karpenter_tpu.runtime import KarpenterRuntime, Options
+
+    rng = np.random.default_rng(seed)
+    _pc_encoder.reset_constraint_state()
+    clock = {"now": 1_000_000.0}
+    runtime = KarpenterRuntime(
+        Options(),
+        cloud_provider_factory=FakeFactory(),
+        clock=lambda: clock["now"],
+    )
+    store = runtime.store
+    zone_names = [f"z{i + 1}" for i in range(zones)]
+    for z, zone in enumerate(zone_names):
+        for i in range(nodes_per_zone):
+            store.create(Node(
+                metadata=ObjectMeta(
+                    name=f"{zone}-n{i}",
+                    labels={"pool": "serving", ZONE_LABEL: zone},
+                ),
+                spec=NodeSpec(),
+                status=NodeStatus(
+                    allocatable=resource_list(
+                        cpu="8", memory="32Gi", pods="32"
+                    ),
+                    conditions=[NodeCondition("Ready", "True")],
+                ),
+            ))
+    store.create(Node(
+        metadata=ObjectMeta(
+            name="reserved-0",
+            labels={"pool": "reserved", RESERVATION_LABEL: "gold"},
+        ),
+        spec=NodeSpec(),
+        status=NodeStatus(
+            allocatable=resource_list(cpu="8", memory="32Gi", pods="32"),
+            conditions=[NodeCondition("Ready", "True")],
+        ),
+    ))
+    # one producer per zone plus the reserved pool — the group axis.
+    # A single producer spanning zones would profile as the label
+    # INTERSECTION of its nodes (encoder._group_profile) and lose the
+    # zone domain the spread constraint needs, exactly like real node
+    # groups that are zonal by construction. The constraint groups ride
+    # the first producer; solve_pending merges them across the axis.
+    constraints = [
+        ConstraintGroup(
+            name="web", pod_selector={"app": "web"}, spread=SpreadSpec()
+        ),
+        ConstraintGroup(
+            name="gold", pod_selector={"tier": "gold"},
+            reservation="gold",
+        ),
+    ]
+    for z, zone in enumerate(zone_names):
+        store.create(MetricsProducer(
+            metadata=ObjectMeta(name=f"serving-{zone}"),
+            spec=MetricsProducerSpec(
+                pending_capacity=PendingCapacitySpec(
+                    node_selector={
+                        "pool": "serving", ZONE_LABEL: zone
+                    },
+                    constraints=constraints if z == 0 else [],
+                )
+            ),
+        ))
+    store.create(MetricsProducer(
+        metadata=ObjectMeta(name="serving-reserved"),
+        spec=MetricsProducerSpec(
+            pending_capacity=PendingCapacitySpec(
+                node_selector={"pool": "reserved"},
+            )
+        ),
+    ))
+    specs = (
+        [("web", {"app": "web"})] * web_pods
+        + [("gold", {"tier": "gold"})] * gold_pods
+        + [("plain", {})] * plain_pods
+    )
+    for i, (kind, labels) in enumerate(specs):
+        store.create(Pod(
+            metadata=ObjectMeta(name=f"{kind}-{i}", labels=dict(labels)),
+            spec=PodSpec(
+                node_name="",
+                containers=[Container(requests=resource_list(
+                    cpu=str(int(rng.integers(1, 3))), memory="1Gi",
+                ))],
+            ),
+        ))
+
+    def _phase() -> dict:
+        skew = {}
+        fill = {}
+        for sub, name, metric in (
+            (CONSTRAINTS_SUBSYSTEM, SPREAD_SKEW, skew),
+            (CONSTRAINTS_SUBSYSTEM, RESERVATION_FILL, fill),
+        ):
+            # register() returns the existing vec (or an empty one if
+            # the solve never published — gauge() would KeyError)
+            vec = runtime.registry.register(sub, name)
+            for sample in vec.samples():
+                metric[sample.labels["name"]] = sample.value
+        groups = {}
+        unschedulable = -1
+        for mp in store.list("MetricsProducer", "default"):
+            status = mp.status.pending_capacity
+            if status is None:
+                continue
+            groups[mp.metadata.name] = {
+                "pending_pods": status.pending_pods,
+                "nodes_needed": status.additional_nodes_needed,
+            }
+            unschedulable = status.unschedulable_pods
+        return {
+            "spread_skew": skew,
+            "reservation_fill": fill,
+            "groups": groups,
+            "unschedulable": unschedulable,
+        }
+
+    def _digest(phase: dict) -> int:
+        # zlib.crc32 over canonical JSON, NOT hash(): str hashing is
+        # salted per process and the acceptance test pins these values
+        import json
+        import zlib
+
+        return zlib.crc32(
+            json.dumps(phase, sort_keys=True).encode()
+        )
+
+    try:
+        for _ in range(ticks):
+            clock["now"] += 10.0
+            runtime.manager.converge(1)
+        before = _phase()
+        # the seeded zonal outage: one zone's nodes disappear — its
+        # zone drops out of the spread domain universe and the
+        # constrained re-solve must rebalance the quotas over the
+        # survivors (NotReady alone wouldn't do it: an all-NotReady
+        # group still profiles via the scaled-to-zero fallback)
+        dead_zone = zone_names[int(rng.integers(0, zones))]
+        for i in range(nodes_per_zone):
+            store.delete("Node", "default", f"{dead_zone}-n{i}")
+        for _ in range(ticks):
+            clock["now"] += 10.0
+            runtime.manager.converge(1)
+        after = _phase()
+        stats = dict(_pc_encoder.constraint_stats)
+    finally:
+        runtime.close()
+
+    return {
+        "config": {
+            "ticks": ticks, "zones": zones,
+            "nodes_per_zone": nodes_per_zone, "web_pods": web_pods,
+            "gold_pods": gold_pods, "plain_pods": plain_pods,
+            "seed": seed,
+        },
+        "dead_zone": dead_zone,
+        "before": before,
+        "after": after,
+        "digests": {
+            "before": _digest(before),
+            "after": _digest(after),
+        },
+        "constraint_health": {
+            "compiles": stats["compiles"],
+            "fallbacks": stats["fallbacks"],
+            "degraded": stats["degraded"],
+        },
+    }
+
+
 # -- multi-tenant lockstep replay (docs/multitenancy.md) ---------------------
 
 

@@ -56,6 +56,13 @@ RESOURCE_FLOOR = 4
 # so the number of distinct batched programs stays logarithmic in the
 # coalesce cap.
 BATCH_FLOOR = 1
+# Constraint-plane axes (pack classes C, spread slots S, spread domains
+# D) ride their own tiny ladders: real fleets have a handful of
+# anti-affinity groups and zones, so a floor of 2 keeps the rung count
+# in the low single digits.
+CLASS_FLOOR = 2
+SLOT_FLOOR = 2
+DOMAIN_FLOOR = 2
 
 
 def bucket_up(n: int, floor: int) -> int:
@@ -86,6 +93,24 @@ def bucket_shape(inputs: BinPackInputs) -> Tuple[int, int, int, int, int]:
         bucket_up(k, TAINT_FLOOR),
         bucket_up(l, LABEL_FLOOR),
     )
+
+
+def constraint_shape(inputs: BinPackInputs) -> Tuple[int, ...]:
+    """(C, S, D) constraint-plane axes rounded up their ladders — joins
+    the compile-cache key beside bucket_shape. Returns () when no
+    shape-bearing constraint operand rides the request, so
+    constraint-free traffic keeps a compact key. Padding these axes is
+    inert by construction: all-false pack-class columns contribute empty
+    histograms, appended zero-cap domains never change the first-fit
+    target, and padded cap rows are never referenced (slot <= S_real)."""
+    pc = inputs.pod_pack_class
+    caps = inputs.spread_cap
+    if pc is None and caps is None:
+        return ()
+    c = 0 if pc is None else bucket_up(pc.shape[1], CLASS_FLOOR)
+    s = 0 if caps is None else bucket_up(caps.shape[0], SLOT_FLOOR)
+    d = 0 if caps is None else bucket_up(caps.shape[1], DOMAIN_FLOOR)
+    return (c, s, d)
 
 
 def mesh_aligned_shape(
@@ -142,6 +167,12 @@ def presence(inputs: BinPackInputs) -> Tuple[bool, ...]:
         inputs.pod_exclusive is not None,
         inputs.pod_priority is not None,
         inputs.group_tier is not None,
+        inputs.pod_claim is not None,
+        inputs.group_reservation is not None,
+        inputs.pod_pack_class is not None,
+        inputs.pod_spread_slot is not None,
+        inputs.group_domain is not None,
+        inputs.spread_cap is not None,
     )
 
 
@@ -169,11 +200,18 @@ def pad_to_bucket(  # lint: allow-complexity — one presence guard per optional
     """Pad every operand to the bucket `shape` (see module docstring for
     why this is exact). Returns `inputs` unchanged when already there."""
     p, t, r, k, l = shape
+    pc = inputs.pod_pack_class
+    caps = inputs.spread_cap
+    c_pad = None if pc is None else bucket_up(pc.shape[1], CLASS_FLOOR)
+    s_pad = None if caps is None else bucket_up(caps.shape[0], SLOT_FLOOR)
+    d_pad = None if caps is None else bucket_up(caps.shape[1], DOMAIN_FLOOR)
     if (
         inputs.pod_requests.shape == (p, r)
         and inputs.group_allocatable.shape == (t, r)
         and inputs.pod_intolerant.shape == (p, k)
         and inputs.pod_required.shape == (p, l)
+        and (pc is None or pc.shape == (p, c_pad))
+        and (caps is None or caps.shape == (s_pad, d_pad))
     ):
         return inputs
     # pod_weight: absent means "every row counts once", so padding an
@@ -201,6 +239,28 @@ def pad_to_bucket(  # lint: allow-complexity — one presence guard per optional
     tier = inputs.group_tier
     if tier is not None:
         tier = _pad2(tier, t)
+    # constraint-plane operands: claim/slot pad 0 (unclaimed /
+    # unconstrained — their rows are invalid anyway), reservation/domain
+    # pad 0 on zero-allocatable groups nothing fits, pack-class rows pad
+    # all-false (invalid rows never reach a histogram) and class/slot/
+    # domain axes pad up their own ladders (inert — see
+    # constraint_shape)
+    claim = inputs.pod_claim
+    if claim is not None:
+        claim = _pad2(claim, p)
+    reservation = inputs.group_reservation
+    if reservation is not None:
+        reservation = _pad2(reservation, t)
+    if pc is not None:
+        pc = _pad2(pc, p, c_pad)
+    slot = inputs.pod_spread_slot
+    if slot is not None:
+        slot = _pad2(slot, p)
+    domain = inputs.group_domain
+    if domain is not None:
+        domain = _pad2(domain, t)
+    if caps is not None:
+        caps = _pad2(caps, s_pad, d_pad)
     return BinPackInputs(
         pod_requests=_pad2(inputs.pod_requests, p, r),
         pod_valid=_pad2(inputs.pod_valid, p),
@@ -215,6 +275,12 @@ def pad_to_bucket(  # lint: allow-complexity — one presence guard per optional
         pod_exclusive=exclusive,
         pod_priority=priority,
         group_tier=tier,
+        pod_claim=claim,
+        group_reservation=reservation,
+        pod_pack_class=pc,
+        pod_spread_slot=slot,
+        group_domain=domain,
+        spread_cap=caps,
     )
 
 

@@ -101,10 +101,15 @@ from karpenter_tpu.observability import (
     default_tracer,
     solver_trace,
 )
-from karpenter_tpu.ops.binpack import DEFAULT_BUCKETS, BinPackInputs
+from karpenter_tpu.ops.binpack import (
+    DEFAULT_BUCKETS,
+    BinPackInputs,
+    has_constraint_operands,
+)
 from karpenter_tpu.solver.bucketing import (
     bucket_up,
     bucket_shape,
+    constraint_shape,
     crop_outputs,
     crop_preempt_outputs,
     pad_preempt_inputs,
@@ -237,6 +242,10 @@ class SolverStatistics:
     preempt_calls: int = 0  # preempt() entries
     preempt_candidates: int = 0  # total candidates submitted across calls
     preempt_dispatches: int = 0  # preempt device dispatches
+    # constraint plane (docs/constraints.md): pallas-resolved requests
+    # carrying constraint operands rerouted to the XLA family (Mosaic
+    # has no constraint entry — counted, never silently dropped)
+    constraint_reroutes: int = 0
     # sharded dispatch (docs/solver-service.md "Sharded dispatch")
     shard_dispatches: int = 0  # batches answered by the mesh-sharded program
     shard_requests: int = 0  # requests routed onto the mesh at submit
@@ -901,10 +910,19 @@ class SolverService:
             raise RuntimeError("solver service is closed")
         n_pods = inputs.pod_requests.shape[0]
         n_groups = inputs.group_allocatable.shape[0]
-        resolved, extents = self._shard_extents(
-            self._resolve_backend(backend), n_pods, n_groups
+        resolved = self._resolve_backend(backend)
+        if resolved == "pallas" and has_constraint_operands(inputs):
+            # the Mosaic kernel has no constraint entry; route to the
+            # XLA family (exact, still on-device) and COUNT it — the
+            # PR 8 silent-operand-drop bug class, closed at this third
+            # dispatch site
+            resolved = "xla"
+            self.stats.constraint_reroutes += 1
+        resolved, extents = self._shard_extents(resolved, n_pods, n_groups)
+        key = (
+            bucket_shape(inputs), buckets, resolved, presence(inputs),
+            constraint_shape(inputs),
         )
-        key = (bucket_shape(inputs), buckets, resolved, presence(inputs))
         if extents is not None:
             key += ("shard", extents)
             self.stats.shard_requests += 1
@@ -1088,6 +1106,10 @@ class SolverService:
         memory-bounded scan. Fleet-scale candidate evaluations
         additionally ride the mesh ("vmap_shard" + extents — the
         sharded dispatch strategy, same ladder as solve())."""
+        if resolved == "pallas" and has_constraint_operands(inputs):
+            # same reroute as submit(): Mosaic has no constraint entry
+            resolved = "xla"
+            self.stats.constraint_reroutes += 1
         backend_eff, extents = self._shard_extents(
             resolved,
             inputs.pod_requests.shape[0],
@@ -1096,12 +1118,13 @@ class SolverService:
         if extents is None:
             return (
                 bucket_shape(inputs), buckets, backend_eff,
-                presence(inputs), "vmap",
+                presence(inputs), constraint_shape(inputs), "vmap",
             ), backend_eff
         self.stats.shard_requests += 1
         return (
             bucket_shape(inputs), buckets, backend_eff,
-            presence(inputs), "vmap_shard", extents,
+            presence(inputs), constraint_shape(inputs), "vmap_shard",
+            extents,
         ), backend_eff
 
     def _enqueue_batch(
@@ -1861,15 +1884,15 @@ class SolverService:
     def _shard_strategy(key: tuple) -> Optional[str]:
         """The shard strategy marker of a request key, or None for a
         single-device key. Sharded bin-pack keys: (shape, buckets,
-        backend, presence, "shard"|"vmap_shard", extents). Sharded
-        forecast/preempt keys: ("forecast"|"preempt", shape-ish,
+        backend, presence, cshape, "shard"|"vmap_shard", extents).
+        Sharded forecast/preempt keys: ("forecast"|"preempt", shape-ish,
         backend, "shard", extents)."""
         if key[0] in ("forecast", "preempt"):
             return (
                 "shard" if len(key) > 3 and key[3] == "shard" else None
             )
-        if len(key) > 5 and key[4] in ("shard", "vmap_shard"):
-            return key[4]
+        if len(key) > 6 and key[5] in ("shard", "vmap_shard"):
+            return key[5]
         return None
 
     @staticmethod
@@ -1880,9 +1903,9 @@ class SolverService:
         forecast/preempt keys drop their trailing shard marker)."""
         if key[0] in ("forecast", "preempt"):
             return key[:3]
-        if key[4] == "vmap_shard":
-            return key[:4] + ("vmap",)
-        return key[:4]
+        if key[5] == "vmap_shard":
+            return key[:5] + ("vmap",)
+        return key[:5]
 
     def _dispatch_group(
         self, key: tuple, requests: List[_Request], lone: bool = False
@@ -2069,7 +2092,7 @@ class SolverService:
             return
         self._begin_pipelined_xla(
             shape, buckets, live,
-            strategy=key[4] if len(key) > 4 else "map", lone=lone,
+            strategy=key[5] if len(key) > 5 else "map", lone=lone,
         )
 
     def _begin_pipelined_xla(
@@ -2396,7 +2419,8 @@ class SolverService:
             stacked, n_batch = self._stack_group(shape, live)
             donate = self._donation_supported()
         cache_key = (
-            "xla", shape, n_batch, buckets, live[0].key[3], strategy,
+            "xla", shape, n_batch, buckets, live[0].key[3],
+            live[0].key[4], strategy,
         )
         fn, fresh = self._compiled_for(cache_key, donate=donate)
         # shape capture must precede the dispatch: donated operand
@@ -2553,8 +2577,8 @@ class SolverService:
             raise RuntimeError(
                 "shard mesh unavailable for a shard-routed batch"
             )
-        extents = key[5]
-        strategy = "vmap" if key[4] == "vmap_shard" else "map"
+        extents = key[6]
+        strategy = "vmap" if key[5] == "vmap_shard" else "map"
         aligned = mesh_aligned_shape(shape, extents)
         shardings = stacked_binpack_shardings(mesh, key[3])
         # sharded residency: the resident entry holds the NamedSharding-
@@ -2570,7 +2594,7 @@ class SolverService:
             stacked, n_batch = self._stack_group(aligned, live)
             donate = self._donation_supported()
         cache_key = (
-            "xla", aligned, n_batch, buckets, key[3], strategy,
+            "xla", aligned, n_batch, buckets, key[3], key[4], strategy,
             "shard", extents,
         )
         fn, fresh = self._compiled_for(cache_key, donate=donate)

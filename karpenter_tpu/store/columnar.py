@@ -116,6 +116,7 @@ class _SparsePod:
     anti: tuple = ()  # canonical self pod-(anti-)affinity shape
     soft_spread: tuple = ()  # canonical ScheduleAnyway spread shape
     soft_anti: tuple = ()  # canonical preferred self pod-(anti-)affinity
+    labels: tuple = ()  # sorted pod label items (constraint-group membership)
 
 
 class PendingPodCache:
@@ -174,6 +175,13 @@ class PendingPodCache:
         self._soft_spread_index: Dict[tuple, int] = {(): 0}
         self._soft_anti_shapes: List[tuple] = [()]
         self._soft_anti_index: Dict[tuple, int] = {(): 0}
+        # distinct pod label SETS (constraint-plane membership input;
+        # id 0 = unlabeled). NOT part of the dedup key: label churn on
+        # identical specs must not split rows for unconstrained fleets —
+        # constraint-active encodes re-dedup with membership appended
+        # (encoder._dedup_rows_constrained).
+        self._label_sets: List[tuple] = [()]
+        self._label_set_index: Dict[tuple, int] = {(): 0}
         # incremental shape-dedup: canonical pod key -> live slots with that
         # key. Maintained at event time so snapshot() emits (rep row,
         # multiplicity) pairs in O(distinct shapes) — the per-tick
@@ -194,6 +202,7 @@ class PendingPodCache:
         self._anti_id = np.zeros(capacity, np.int32)
         self._soft_spread_id = np.zeros(capacity, np.int32)
         self._soft_anti_id = np.zeros(capacity, np.int32)
+        self._labels_id = np.zeros(capacity, np.int32)
         self._valid = np.zeros(capacity, bool)
 
         self._slot: Dict[Tuple[str, str], int] = {}
@@ -227,6 +236,7 @@ class PendingPodCache:
         self._anti_id[slot] = 0
         self._soft_spread_id[slot] = 0
         self._soft_anti_id[slot] = 0
+        self._labels_id[slot] = 0
         self._sparse.pop(slot, None)
         self._dedup_discard(slot)
         self._free.append(slot)
@@ -287,6 +297,7 @@ class PendingPodCache:
             priority=effective_priority(
                 pod, default=self._default_priority
             ),
+            labels=tuple(sorted((pod.metadata.labels or {}).items())),
         )
         slot = self._slot.get(key)
         if slot is None:
@@ -335,6 +346,9 @@ class PendingPodCache:
             self._soft_anti_index,
             sparse.soft_anti,
         )
+        self._labels_id[slot] = _intern(
+            self._label_sets, self._label_set_index, sparse.labels
+        )
         self._priority[slot] = sparse.priority
         self._valid[slot] = True
         self._sparse[slot] = sparse
@@ -379,6 +393,7 @@ class PendingPodCache:
             (self._anti_shapes, self._anti_id),
             (self._soft_spread_shapes, self._soft_spread_id),
             (self._soft_anti_shapes, self._soft_anti_id),
+            (self._label_sets, self._labels_id),
         ):
             if len(registry) >= _COMPACT_FLOOR:
                 live_ids = len(
@@ -427,6 +442,7 @@ class PendingPodCache:
             self._anti_id = self._grow_rows(self._anti_id)
             self._soft_spread_id = self._grow_rows(self._soft_spread_id)
             self._soft_anti_id = self._grow_rows(self._soft_anti_id)
+            self._labels_id = self._grow_rows(self._labels_id)
             self._valid = self._grow_rows(self._valid)
         slot = self._hi
         self._hi += 1
@@ -529,6 +545,8 @@ class PendingPodCache:
                 soft_spread_shapes=list(self._soft_spread_shapes),
                 soft_anti_id=self._soft_anti_id[:hi].copy(),
                 soft_anti_shapes=list(self._soft_anti_shapes),
+                labels_id=self._labels_id[:hi].copy(),
+                label_sets=list(self._label_sets),
             )
             self._snap_memo = (self._generation, snap)
             return snap
@@ -897,26 +915,31 @@ def occupancy_from_pods(pods) -> ScheduledOccupancy:
 
 
 class ProducerSelectorIndex:
-    """Watch-maintained {key: (node_selector, node_group_ref)} of every
-    pendingCapacity MetricsProducer — the solve needs ONLY the selector
-    and scale-from-zero ref of non-due producers (their status writes
-    land on discarded copies anyway; gauges are keyed by name/namespace),
-    so listing + deep-copying every producer object per tick is
+    """Watch-maintained {key: (node_selector, node_group_ref,
+    constraint_groups)} of every pendingCapacity MetricsProducer — the
+    solve needs ONLY the selector, scale-from-zero ref, and declared
+    constraint groups of non-due producers (their status writes land on
+    discarded copies anyway; gauges are keyed by name/namespace), so
+    listing + deep-copying every producer object per tick is
     avoidable."""
 
     def __init__(self, store: Store):
         self._lock = threading.Lock()
         self._specs: Dict[
-            Tuple[str, str], Tuple[Dict[str, str], str]
+            Tuple[str, str], Tuple[Dict[str, str], str, tuple]
         ] = {}
         _adopt_and_watch(store, "MetricsProducer", self._on_event)
 
     def _on_event(self, event: str, mp) -> None:
         key = (mp.metadata.namespace, mp.metadata.name)
-        selector, ref = None, ""
+        selector, ref, constraints = None, "", ()
         if event != DELETED and mp.spec.pending_capacity is not None:
             selector = mp.spec.pending_capacity.node_selector
             ref = getattr(mp.spec.pending_capacity, "node_group_ref", "")
+            constraints = tuple(
+                getattr(mp.spec.pending_capacity, "constraints", None)
+                or ()
+            )
             try:
                 selector = dict(selector)
             except TypeError:
@@ -930,13 +953,14 @@ class ProducerSelectorIndex:
             if event == DELETED or mp.spec.pending_capacity is None:
                 self._specs.pop(key, None)
             else:
-                self._specs[key] = (selector, ref)
+                self._specs[key] = (selector, ref, constraints)
 
     def items(
         self,
-    ) -> List[Tuple[Tuple[str, str], Tuple[Dict[str, str], str]]]:
-        """(key, (selector, node_group_ref)) pairs in deterministic
-        (namespace, name) order — the group-axis order of the solve."""
+    ) -> List[Tuple[Tuple[str, str], Tuple[Dict[str, str], str, tuple]]]:
+        """(key, (selector, node_group_ref, constraint_groups)) in
+        deterministic (namespace, name) order — the group-axis order of
+        the solve."""
         with self._lock:
             return sorted(self._specs.items())
 
@@ -1034,3 +1058,8 @@ class PendingSnapshot:                        # no 100k-row reprs in logs
     soft_spread_shapes: Optional[List[tuple]] = None
     soft_anti_id: Optional[np.ndarray] = None
     soft_anti_shapes: Optional[List[tuple]] = None
+    # pod label sets (constraint-group membership): per-row id into
+    # label_sets (id 0 = unlabeled). None on hand-built snapshots = no
+    # membership data, constraint groups match nothing.
+    labels_id: Optional[np.ndarray] = None
+    label_sets: Optional[List[tuple]] = None

@@ -1023,3 +1023,216 @@ class TestSelfSLOChaos:
             faults.uninstall()
             runtime.close()
             set_default_flight_recorder(saved_recorder)
+
+
+class TestConstraintChaos:
+    """PR 16 satellite: 100% faults on `constraints.mask` must NEVER
+    block the signal — every tick degrades to the unconstrained-but-
+    feasible wire with the fallback counted and the breaker FSM fed
+    (closed -> open -> short-circuit), and clearing the faults recovers
+    the constrained fixed point."""
+
+    def make_runtime(self):
+        from karpenter_tpu.api.core import (
+            Container, RESERVATION_LABEL, ZONE_LABEL,
+        )
+        from karpenter_tpu.constraints import ConstraintGroup, SpreadSpec
+
+        clock = FakeClock()
+        runtime = KarpenterRuntime(
+            Options(),
+            cloud_provider_factory=FakeFactory(),
+            clock=clock,
+        )
+        store = runtime.store
+        for zone in ("z1", "z2"):
+            store.create(Node(
+                metadata=ObjectMeta(
+                    name=f"{zone}-n0",
+                    labels={"pool": "serving", ZONE_LABEL: zone},
+                ),
+                spec=NodeSpec(),
+                status=NodeStatus(
+                    allocatable=resource_list(
+                        cpu="8", memory="32Gi", pods="32"
+                    ),
+                    conditions=[NodeCondition("Ready", "True")],
+                ),
+            ))
+        store.create(Node(
+            metadata=ObjectMeta(
+                name="reserved-0",
+                labels={"pool": "reserved", RESERVATION_LABEL: "gold"},
+            ),
+            spec=NodeSpec(),
+            status=NodeStatus(
+                allocatable=resource_list(
+                    cpu="8", memory="32Gi", pods="32"
+                ),
+                conditions=[NodeCondition("Ready", "True")],
+            ),
+        ))
+        for zone, cons in (("z1", True), ("z2", False)):
+            store.create(MetricsProducer(
+                metadata=ObjectMeta(name=f"serving-{zone}"),
+                spec=MetricsProducerSpec(
+                    pending_capacity=PendingCapacitySpec(
+                        node_selector={
+                            "pool": "serving", ZONE_LABEL: zone
+                        },
+                        constraints=[
+                            ConstraintGroup(
+                                name="web",
+                                pod_selector={"app": "web"},
+                                spread=SpreadSpec(),
+                            ),
+                            ConstraintGroup(
+                                name="gold",
+                                pod_selector={"tier": "gold"},
+                                reservation="gold",
+                            ),
+                        ] if cons else [],
+                    )
+                ),
+            ))
+        store.create(MetricsProducer(
+            metadata=ObjectMeta(name="serving-reserved"),
+            spec=MetricsProducerSpec(
+                pending_capacity=PendingCapacitySpec(
+                    node_selector={"pool": "reserved"},
+                )
+            ),
+        ))
+        for i in range(4):
+            store.create(Pod(
+                metadata=ObjectMeta(
+                    name=f"web-{i}", labels={"app": "web"}
+                ),
+                spec=PodSpec(node_name="", containers=[Container(
+                    requests=resource_list(cpu="1", memory="1Gi")
+                )]),
+            ))
+        store.create(Pod(
+            metadata=ObjectMeta(name="gold-0", labels={"tier": "gold"}),
+            spec=PodSpec(node_name="", containers=[Container(
+                requests=resource_list(cpu="1", memory="1Gi")
+            )]),
+        ))
+        return runtime, clock
+
+    def tick(self, runtime, clock, n=1):
+        """Churned ticks: the producer memo rightly short-circuits an
+        unchanged cluster and a memo hit never reaches the encoder's
+        fault point, so each tick toggles a pod."""
+        for _ in range(n):
+            try:
+                runtime.store.delete("Pod", "default", "churn-pod")
+            except KeyError:
+                runtime.store.create(Pod(
+                    metadata=ObjectMeta(name="churn-pod"),
+                    spec=PodSpec(),
+                ))
+            clock.advance(61.0)
+            runtime.manager.reconcile_all()
+
+    def _pending(self, runtime, name):
+        status = runtime.store.get(
+            "MetricsProducer", "default", name
+        ).status.pending_capacity
+        return status.pending_pods if status else -1
+
+    def test_mask_faults_never_block_then_recover(self):
+        from karpenter_tpu.metrics.producers.pendingcapacity import (
+            encoder as E,
+        )
+        from karpenter_tpu.resilience import CircuitBreaker
+
+        runtime, clock = self.make_runtime()
+        saved_breaker = E._constraint_breaker
+        E.reset_constraint_state()
+        # the module breaker's reset window runs on REAL monotonic
+        # time; pin it to the scenario clock so the open window (and
+        # the recovery probe) replay deterministically
+        E._constraint_breaker = CircuitBreaker(
+            failure_threshold=3, reset_s=1000.0, clock=clock
+        )
+        try:
+            # ---- the constrained fixed point ----
+            self.tick(runtime, clock, 2)
+            stats = E.constraint_stats
+            assert stats["compiles"] >= 1
+            assert stats["fallbacks"] == 0
+            assert not stats["degraded"]
+            skew = runtime.registry.gauge(
+                "constraints", "spread_skew"
+            ).get("web", "-")
+            assert skew == 0.0  # 4 web pods spread 2/2
+            assert runtime.registry.gauge(
+                "constraints", "reservation_fill"
+            ).get("gold", "-") == 1.0
+            assert self._pending(runtime, "serving-reserved") == 1
+            fixed_point = {
+                name: self._pending(runtime, name)
+                for name in ("serving-z1", "serving-z2",
+                             "serving-reserved")
+            }
+
+            # ---- 100% mask faults ----
+            registry = faults.install(FaultRegistry(seed=CHAOS_SEED))
+            registry.plan(
+                "constraints.mask", mode="error", probability=1.0
+            )
+            self.tick(runtime, clock, 6)
+            stats = E.constraint_stats
+            assert stats["degraded"]
+            assert stats["fallbacks"] >= 6, (
+                "every churned tick must fall back, not block"
+            )
+            # the breaker FSM was fed: 3 failures trip it open and the
+            # remaining ticks short-circuit without re-probing the
+            # faulty compile path
+            assert stats["short_circuits"] >= 1
+            assert runtime.registry.gauge(
+                "constraints", "breaker_state"
+            ).get("-", "-") == 1.0
+            assert runtime.registry.gauge(
+                "constraints", "fallback_total"
+            ).get("-", "-") == float(stats["fallbacks"])
+            # never-block: the unconstrained-but-feasible wire keeps
+            # publishing a live signal for every producer
+            total = sum(
+                self._pending(runtime, name)
+                for name in ("serving-z1", "serving-z2",
+                             "serving-reserved")
+            )
+            assert total >= 5, "all pods still placed somewhere"
+            assert self._pending(runtime, "serving-z1") >= 0
+
+            # ---- faults clear ----
+            faults.uninstall()
+            clock.advance(1000.0)  # past the breaker's open window
+            self.tick(runtime, clock, 2)
+            stats = E.constraint_stats
+            assert not stats["degraded"], (
+                "the degraded-epoch fingerprint must retry the compile "
+                "and converge back"
+            )
+            assert runtime.registry.gauge(
+                "constraints", "breaker_state"
+            ).get("-", "-") == 0.0
+            recovered = {
+                name: self._pending(runtime, name)
+                for name in ("serving-z1", "serving-z2",
+                             "serving-reserved")
+            }
+            assert recovered == fixed_point, (
+                "clearing faults must restore the constrained verdicts"
+            )
+            assert runtime.registry.gauge(
+                "constraints", "reservation_fill"
+            ).get("gold", "-") == 1.0
+        finally:
+            faults.uninstall()
+            E._constraint_breaker = saved_breaker
+            E.reset_constraint_state()
+            runtime.close()

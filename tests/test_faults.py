@@ -236,6 +236,60 @@ class TestInjectionPoints:
             with pytest.raises(FaultInjected):
                 encode_snapshot(None, [])
 
+    def test_constraints_mask_falls_back_to_unconstrained(self):
+        """The `constraints.mask` point (docs/resilience.md): a compile
+        fault degrades that encode to the unconstrained-but-feasible
+        wire — operands stay None, the solve proceeds, the fallback is
+        counted and the breaker FSM is fed."""
+        from karpenter_tpu.api.core import (
+            Container, ObjectMeta, Pod, PodSpec, resource_list,
+        )
+        from karpenter_tpu.constraints import ConstraintGroup
+        from karpenter_tpu.metrics.producers.pendingcapacity import (
+            encode_snapshot,
+        )
+        from karpenter_tpu.metrics.producers.pendingcapacity import (
+            encoder as E,
+        )
+        from karpenter_tpu.ops import binpack as B
+        from karpenter_tpu.store.columnar import snapshot_from_pods
+
+        import numpy as np
+
+        pods = [Pod(
+            metadata=ObjectMeta(name="p0", labels={"t": "1"}),
+            spec=PodSpec(node_name="", containers=[Container(
+                requests=resource_list(cpu="1", memory="1Gi")
+            )]),
+        )]
+        profiles = [({"cpu": 8.0, "memory": 32.0, "pods": 32.0},
+                     set(), set())]
+        groups = [ConstraintGroup(
+            name="a", pod_selector={"t": "1"}, anti_affinity=True
+        )]
+        E.reset_constraint_state()
+        try:
+            with FaultRegistry(seed=1) as reg:
+                reg.plan("constraints.mask", mode="error")
+                inputs = encode_snapshot(
+                    snapshot_from_pods(pods), profiles,
+                    constraints=groups,
+                )
+            assert not B.has_constraint_operands(inputs)
+            assert E.constraint_stats["fallbacks"] == 1
+            assert E.constraint_stats["degraded"]
+            assert E._constraint_breaker.consecutive_failures == 1
+            # faults cleared: the next encode compiles the constraints
+            inputs = encode_snapshot(
+                snapshot_from_pods(pods), profiles, constraints=groups
+            )
+            assert np.asarray(inputs.pod_exclusive).any()
+            assert E.constraint_stats["compiles"] == 1
+            assert not E.constraint_stats["degraded"]
+            assert E._constraint_breaker.consecutive_failures == 0
+        finally:
+            E.reset_constraint_state()
+
     def test_solver_dispatch_falls_back_to_numpy(self):
         from karpenter_tpu.metrics.registry import GaugeRegistry
         from karpenter_tpu.ops.numpy_binpack import binpack_numpy

@@ -718,3 +718,140 @@ def test_sharded_forecast_failure_walks_the_ladder():
         assert not service._shard_broken
     finally:
         service.close()
+
+
+# ---------------------------------------------------------------------------
+# PR 16: constraint operands through the mesh — sharded == single ==
+# numpy BITWISE on compiler-generated constrained inputs, and the pad
+# helper carries all six new operands (the PR 8 silent-drop bug class).
+# ---------------------------------------------------------------------------
+
+
+def _constrained_inputs(seed: int):
+    """Compiler-generated constrained BinPackInputs (the exactness
+    contract only holds for compiler output: spread rows pre-split at
+    cap boundaries)."""
+    from karpenter_tpu.api.core import (
+        Container,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+        RESERVATION_LABEL,
+        ZONE_LABEL,
+        resource_list,
+    )
+    from karpenter_tpu.constraints import ConstraintGroup, SpreadSpec
+    from karpenter_tpu.metrics.producers.pendingcapacity import (
+        encode_snapshot,
+    )
+    from karpenter_tpu.store.columnar import snapshot_from_pods
+
+    rng = np.random.default_rng(seed)
+    pods = []
+    for p in range(int(rng.integers(16, 40))):
+        team = int(rng.integers(0, 6))
+        labels = {"team": f"t{team}"} if team < 4 else {}
+        pods.append(
+            Pod(
+                metadata=ObjectMeta(name=f"p{p}", labels=labels),
+                spec=PodSpec(
+                    node_name="",
+                    containers=[
+                        Container(
+                            requests=resource_list(
+                                cpu=str(int(rng.integers(1, 3))),
+                                memory="1Gi",
+                            )
+                        )
+                    ],
+                ),
+            )
+        )
+    alloc = {"cpu": 8.0, "memory": 32.0, "pods": 32.0}
+    profiles = [
+        (dict(alloc), {(ZONE_LABEL, "z1")}, set()),
+        (dict(alloc), {(ZONE_LABEL, "z2")}, set()),
+        (dict(alloc), {(ZONE_LABEL, "z3")}, set()),
+        (dict(alloc), {(RESERVATION_LABEL, "gold")}, set()),
+        (dict(alloc), set(), set()),
+    ]
+    groups = [
+        ConstraintGroup(
+            name="web", pod_selector={"team": "t0"}, spread=SpreadSpec()
+        ),
+        ConstraintGroup(
+            name="gold", pod_selector={"team": "t1"}, reservation="gold"
+        ),
+        ConstraintGroup(
+            name="solo", pod_selector={"team": "t2"}, anti_affinity=True
+        ),
+        ConstraintGroup(
+            name="tight", pod_selector={"team": "t3"}, compact=True
+        ),
+    ]
+    return encode_snapshot(
+        snapshot_from_pods(pods), profiles, constraints=groups
+    )
+
+
+@pytest.mark.parametrize("n_devices", [2, 8])
+def test_sharded_constrained_matches_unsharded_matches_numpy(n_devices):
+    """The PR 16 acceptance pin: with constraint operands present, the
+    sharded program == the single-device program == the numpy mirror,
+    bitwise on integer outputs."""
+    from karpenter_tpu.ops import binpack as B
+    from karpenter_tpu.ops.numpy_binpack import binpack_numpy
+
+    inputs = _constrained_inputs(seed=42)
+    assert B.has_constraint_operands(inputs)
+    ref = jax.device_get(binpack(inputs, buckets=8))
+    ref_np = binpack_numpy(inputs, buckets=8)
+    mesh = build_mesh(n_devices=n_devices)
+    out = jax.device_get(sharded_binpack(mesh, inputs, buckets=8))
+    for mirror, label in ((ref, "xla"), (ref_np, "numpy")):
+        np.testing.assert_array_equal(
+            out.assigned, np.asarray(mirror.assigned), err_msg=label
+        )
+        np.testing.assert_array_equal(
+            out.assigned_count, np.asarray(mirror.assigned_count),
+            err_msg=label,
+        )
+        np.testing.assert_array_equal(
+            out.nodes_needed, np.asarray(mirror.nodes_needed),
+            err_msg=label,
+        )
+        assert int(out.unschedulable) == int(mirror.unschedulable)
+
+
+def test_pad_for_mesh_carries_constraint_operands():
+    """Regression (the PR 8 silent-drop bug class): the pad helper must
+    rebuild the pytree WITH all six constraint operands, and padding
+    must be inert — claim 0 / slot 0 / class-0 rows, reservation 0 /
+    domain 0 columns, spread_cap untouched."""
+    inputs = _constrained_inputs(seed=43)
+    P_ = int(np.asarray(inputs.pod_valid).shape[0])
+    T = int(np.asarray(inputs.group_allocatable).shape[0])
+    mesh = build_mesh(n_devices=8)
+    padded = pad_binpack_inputs_for_mesh(inputs, mesh)
+    for name in (
+        "pod_claim", "group_reservation", "pod_pack_class",
+        "pod_spread_slot", "group_domain", "spread_cap",
+    ):
+        if getattr(inputs, name) is None:
+            continue
+        assert getattr(padded, name) is not None, name
+    if padded.pod_claim is not None:
+        assert np.all(np.asarray(padded.pod_claim)[P_:] == 0)
+    if padded.group_reservation is not None:
+        assert np.all(np.asarray(padded.group_reservation)[T:] == 0)
+    if padded.pod_spread_slot is not None:
+        assert np.all(np.asarray(padded.pod_spread_slot)[P_:] == 0)
+    if padded.group_domain is not None:
+        assert np.all(np.asarray(padded.group_domain)[T:] == 0)
+    if padded.pod_pack_class is not None:
+        assert not np.asarray(padded.pod_pack_class)[P_:].any()
+    if padded.spread_cap is not None:
+        np.testing.assert_array_equal(
+            np.asarray(padded.spread_cap),
+            np.asarray(inputs.spread_cap),
+        )

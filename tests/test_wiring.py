@@ -124,6 +124,45 @@ class TestCLI:
         assert args.leader_elect is True
         assert not args.verbose
 
+    def test_profile_production_preset(self):
+        # Without a profile the resolved defaults are the historical
+        # ones: tick-paced loop, cold first compile, 1s objective.
+        args = parse_args([])
+        assert args.profile is None
+        assert args.event_driven is False
+        assert args.prewarm_compile is False
+        assert args.selfslo_objective == 1.0
+
+        # The production profile flips the event-driven plane and the
+        # compile pre-warm on and tightens the self-SLO objective to
+        # the sub-second 0.5 histogram bucket bound.
+        args = parse_args(["--profile", "production"])
+        assert args.event_driven is True
+        assert args.prewarm_compile is True
+        assert args.selfslo_objective == 0.5
+
+    def test_profile_explicit_flags_win(self):
+        args = parse_args(["--profile", "production", "--no-event-driven"])
+        assert args.event_driven is False
+        assert args.prewarm_compile is True
+        assert args.selfslo_objective == 0.5
+
+        args = parse_args(
+            ["--profile", "production", "--selfslo-objective", "2.5"]
+        )
+        assert args.selfslo_objective == 2.5
+        assert args.event_driven is True
+
+        args = parse_args(["--profile", "production", "--no-prewarm-compile"])
+        assert args.prewarm_compile is False
+
+        # Explicit enablement without a profile still works and does
+        # not drag the other preset values along.
+        args = parse_args(["--event-driven"])
+        assert args.event_driven is True
+        assert args.prewarm_compile is False
+        assert args.selfslo_objective == 1.0
+
     def test_main_runs_and_exits(self, capsys):
         rc = cli_main(
             [
