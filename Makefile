@@ -127,6 +127,14 @@ bench-constraints: ## Batched constrained solve (spread + reservation + anti-aff
 		--constraint-groups 8 --backend xla --iters 10 \
 		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
 
+test-simlab: ## SimLab non-slow suite: gym/batched parity pins, scenario fuzz, policy search, catalog drift lint (docs/simulator.md)
+	$(PYTHON) -m pytest tests/test_simlab.py -q
+
+bench-simlab: ## SimLab batched cluster stepping: N seeded clusters as ONE vmapped sim_rollout dispatch vs the per-cluster sequential loop (batched == sequential == numpy pinned bitwise before timing); appends a BENCHMARKS row + publishes to BASELINE.json
+	$(PYTHON) bench.py --simlab --simlab-clusters 256 \
+		--simlab-ticks 64 --simlab-rows 8 --iters 10 \
+		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
+
 dryrun: ## Multi-chip sharding compile check on 8 virtual CPU devices
 	$(PYTHON) -c "import os; \
 		os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS','') + ' --xla_force_host_platform_device_count=8').strip(); \
@@ -167,5 +175,6 @@ kind-smoke: ## Deploy smoke on kind: image -> apply -> pod Ready -> one HA end t
 	docs native bench bench-solver bench-hotpath bench-consolidate \
 	bench-forecast bench-preempt bench-cost bench-journal bench-trace \
 	bench-provenance bench-resident bench-shard bench-multitenant \
-	bench-eventloop bench-introspect bench-constraints dryrun \
+	bench-eventloop bench-introspect bench-constraints test-simlab \
+	bench-simlab dryrun \
 	image publish apply delete kind-load conformance kind-smoke

@@ -1008,6 +1008,35 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
         "seconds",
     )
     ap.add_argument(
+        "--simlab",
+        action="store_true",
+        help="benchmark SimLab batched cluster stepping "
+        "(docs/simulator.md): N independently-seeded simulated "
+        "clusters advanced as ONE vmapped sim_rollout dispatch through "
+        "the SolverService seam vs the per-cluster sequential loop a "
+        "simulator-naive harness would run (N dispatches of the same "
+        "compiled program); batched == sequential == numpy parity "
+        "pinned bitwise before timing",
+    )
+    ap.add_argument(
+        "--simlab-clusters",
+        type=int,
+        default=256,
+        help="with --simlab: simulated clusters per batched dispatch",
+    )
+    ap.add_argument(
+        "--simlab-ticks",
+        type=int,
+        default=64,
+        help="with --simlab: episode length in ticks per cluster",
+    )
+    ap.add_argument(
+        "--simlab-rows",
+        type=int,
+        default=8,
+        help="with --simlab: HA rows (replica columns) per cluster",
+    )
+    ap.add_argument(
         "--e2e",
         action="store_true",
         help="headline the full reconcile tick (columnar-cache snapshot + "
@@ -1251,22 +1280,50 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
         )
     if args.constraints and args.constraint_groups < 1:
         ap.error("--constraint-groups must be >= 1")
+    if args.simlab and (
+        args.mesh or args.e2e or args.decide or args.clusters
+        or args.solver_service or args.hotpath or args.consolidate
+        or args.forecast or args.preempt or args.journal or args.trace
+        or args.shard or args.cost or args.multitenant
+        or args.provenance or args.resident or args.eventloop
+        or args.introspect or args.constraints
+    ):
+        ap.error(
+            "--simlab builds its own simulated-cluster workload; it "
+            "cannot combine with other modes"
+        )
+    if args.simlab and (
+        args.simlab_clusters < 2 or args.simlab_ticks < 4
+        or args.simlab_rows < 1
+    ):
+        ap.error(
+            "--simlab needs clusters >= 2, ticks >= 4, rows >= 1"
+        )
     if (args.publish_baseline or args.append_benchmarks) and not (
         args.solver_service or args.consolidate or args.hotpath
         or args.forecast or args.preempt or args.journal or args.shard
         or args.trace or args.cost or args.multitenant
         or args.provenance or args.resident or args.eventloop
-        or args.introspect or args.constraints
+        or args.introspect or args.constraints or args.simlab
     ):
         ap.error(
             "--publish-baseline/--append-benchmarks only apply to "
             "--solver-service/--consolidate/--hotpath/--forecast/"
             "--preempt/--journal/--shard/--trace/--cost/--multitenant/"
             "--provenance/--resident/--eventloop/--introspect/"
-            "--constraints (nothing would be published otherwise)"
+            "--constraints/--simlab (nothing would be published "
+            "otherwise)"
         )
 
-    if args.constraints:
+    if args.simlab:
+        metric = (
+            f"vmapped batched cluster-stepping p50, "
+            f"{args.simlab_clusters} clusters x {args.simlab_ticks} "
+            f"ticks x {args.simlab_rows} rows (one sim_rollout "
+            f"dispatch vs the per-cluster sequential loop; numpy "
+            f"parity pinned)"
+        )
+    elif args.constraints:
         metric = (
             f"batched constrained solve p50, {args.pods} pods x "
             f"{args.types} instance types x {args.constraint_groups} "
@@ -1977,6 +2034,188 @@ def run_eventloop(args, metric: str, note: str) -> None:
     )
 
 
+def run_simlab(args, metric: str, note: str) -> None:  # lint: allow-complexity — bench arm: parity pin + interleaved timing + publish, linear
+    """SimLab batched cluster stepping (ISSUE 17 acceptance): N
+    independently-seeded simulated clusters advanced as ONE vmapped
+    sim_rollout dispatch through the SolverService seam vs the
+    per-cluster sequential loop (N dispatches of the same compiled
+    program). Parity — batched == sequential == numpy mirror, bitwise
+    on every output field — is pinned BEFORE any timing; interleaved
+    arms so drift cancels."""
+    import jax
+
+    from karpenter_tpu.metrics.registry import GaugeRegistry
+    from karpenter_tpu.ops import simstep as SK
+    from karpenter_tpu.simlab import BatchedSimEnv
+    from karpenter_tpu.simlab.builtin import make_trails
+    from karpenter_tpu.simlab.policy import FROZEN_KNOBS
+    from karpenter_tpu.solver.service import SolverService
+
+    print(
+        f"backend={jax.default_backend()} devices={jax.devices()}",
+        file=sys.stderr,
+    )
+    clusters, ticks, rows = (
+        args.simlab_clusters, args.simlab_ticks, args.simlab_rows
+    )
+    svc = SolverService(registry=GaugeRegistry())
+    # the cost theme: diurnal demand + spot spikes + a seeded fault
+    # schedule, so the measured program carries every kernel feature
+    env = BatchedSimEnv(
+        lambda seed: make_trails(
+            seed, ticks=ticks, rows=rows, diurnal=True, amplitude=96.0,
+            price_spike=1.5, fault_probability=0.05,
+        ),
+        clusters=clusters,
+        seed=args.seed,
+        service=svc,
+        backend="xla",
+    )
+    batched_inputs = SK.SimRolloutInputs(
+        replicas0=np.asarray(env.trails.replicas0, np.float32),
+        streak0=np.zeros_like(
+            np.asarray(env.trails.replicas0, np.float32)
+        ),
+        demand=env.trails.demand, forecast=env.trails.forecast,
+        price=env.trails.price, fault=env.trails.fault,
+        knobs=np.broadcast_to(
+            FROZEN_KNOBS, (clusters, FROZEN_KNOBS.shape[0])
+        ).copy(),
+        cap=np.float32(env.params.cap),
+        hourly=np.float32(env.params.hourly),
+        step_limit=np.float32(env.params.step_limit),
+        min_replicas=np.float32(env.params.min_replicas),
+        max_replicas=np.float32(env.params.max_replicas),
+    )
+    slices = [
+        SK._cluster_slice(batched_inputs, b) for b in range(clusters)
+    ]
+
+    # parity pin BEFORE timing: batched == sequential == numpy, bitwise
+    batched = svc.sim_rollout(batched_inputs, backend="xla")
+    mirror = SK.sim_rollout_numpy(batched_inputs)
+    fields = ("replicas", "violation", "cost", "backlog", "target")
+    for field in fields:
+        if not (
+            np.asarray(getattr(batched, field))
+            == np.asarray(getattr(mirror, field))
+        ).all():
+            emit(metric, None, error=f"batched/numpy mismatch: {field}")
+            sys.exit(0)
+    for b in (0, clusters // 2, clusters - 1):
+        seq = svc.sim_rollout(slices[b], backend="xla")
+        for field in fields:
+            if not (
+                np.asarray(getattr(seq, field))
+                == np.asarray(getattr(batched, field))[b]
+            ).all():
+                emit(
+                    metric, None,
+                    error=f"batched/sequential mismatch: {field} "
+                    f"cluster {b}",
+                )
+                sys.exit(0)
+    if svc.stats.sim_mirror_serves:
+        emit(
+            metric, None,
+            error="device path unavailable (mirror served during "
+            "parity); the batched-vs-sequential comparison needs XLA",
+        )
+        sys.exit(0)
+    print("parity: batched == sequential == numpy (bitwise)",
+          file=sys.stderr)
+
+    # warm both compiled programs outside the timed region
+    jax.block_until_ready(SK.sim_rollout_vmapped(batched_inputs).replicas)
+    jax.block_until_ready(SK.sim_rollout_jit(slices[0]).replicas)
+
+    base_dispatches = svc.stats.sim_dispatches
+    batched_times, seq_times = [], []
+    for _ in range(args.iters):
+        t0 = time.perf_counter()
+        svc.sim_rollout(batched_inputs, backend="xla")
+        batched_times.append((time.perf_counter() - t0) * 1e3)
+        t0 = time.perf_counter()
+        for one in slices:
+            svc.sim_rollout(one, backend="xla")
+        seq_times.append((time.perf_counter() - t0) * 1e3)
+    if svc.stats.sim_mirror_serves:
+        emit(metric, None, error="mirror served during timing")
+        sys.exit(0)
+
+    batched_p50 = float(np.percentile(batched_times, 50))
+    seq_p50 = float(np.percentile(seq_times, 50))
+    speedup = seq_p50 / max(batched_p50, 1e-9)
+    # cluster-days per minute at a 10s simulated tick: the ROADMAP
+    # "thousands of cluster-days per minute" claim, measured
+    sim_days = clusters * ticks * 10.0 / 86_400.0
+    days_per_min = sim_days / (batched_p50 / 1e3) * 60.0
+    record = {
+        "config": f"{clusters} clusters x {ticks} ticks x {rows} rows",
+        "backend": jax.default_backend(),
+        "batched_p50_ms": round(batched_p50, 3),
+        "sequential_p50_ms": round(seq_p50, 3),
+        "speedup": round(speedup, 1),
+        "dispatches_sequential": clusters,
+        "cluster_days_per_min": round(days_per_min, 1),
+        "parity": "bitwise",
+    }
+    record_evidence(
+        simlab={
+            "batched_ms": [round(t, 4) for t in batched_times],
+            "sequential_ms": [round(t, 4) for t in seq_times],
+            "dispatches": svc.stats.sim_dispatches - base_dispatches,
+        }
+    )
+    print(
+        f"batched p50 {record['batched_p50_ms']}ms vs sequential "
+        f"{record['sequential_p50_ms']}ms ({record['speedup']}x); "
+        f"{record['cluster_days_per_min']} simulated cluster-days/min",
+        file=sys.stderr,
+    )
+    if args.publish_baseline:
+        _publish_to_baseline(
+            f"{record['config']} simlab ({record['backend']})", record
+        )
+    if args.append_benchmarks:
+        _append_simlab_row(args.append_benchmarks, record)
+    emit(
+        f"{metric} ({jax.default_backend()})",
+        record["batched_p50_ms"],
+        note=(
+            f"{note}; " if note else ""
+        ) + f"one vmapped dispatch {record['batched_p50_ms']}ms vs "
+        f"{clusters} sequential dispatches "
+        f"{record['sequential_p50_ms']}ms ({record['speedup']}x); "
+        f"{record['cluster_days_per_min']} cluster-days/min; parity "
+        f"pinned bitwise",
+        against_baseline=False,
+    )
+
+
+def _append_simlab_row(path: str, record: dict) -> None:
+    marker = "## SimLab batched cluster stepping (make bench-simlab)"
+    header = (
+        f"\n{marker}\n\n"
+        "N independently-seeded simulated clusters (docs/simulator.md) "
+        "advanced one whole episode as ONE vmapped sim_rollout "
+        "dispatch through the SolverService seam, vs the per-cluster "
+        "sequential loop (N dispatches of the same compiled program). "
+        "Batched == sequential == numpy mirror pinned bitwise before "
+        "timing; interleaved arms.\n\n"
+        "| Date | Backend | Problem | Batched p50 (ms) | "
+        "Sequential p50 (ms) | Speedup | Cluster-days/min |\n"
+        "|---|---|---|---|---|---|---|\n"
+    )
+    date = datetime.date.today().isoformat()
+    row = (
+        f"| {date} | {record['backend']} | {record['config']} "
+        f"| {record['batched_p50_ms']} | {record['sequential_p50_ms']} "
+        f"| {record['speedup']}x | {record['cluster_days_per_min']} |\n"
+    )
+    _append_table_row(path, marker, header, row)
+
+
 def _provenance_tick_times(args):
     """Per-tick wall times with the decision-provenance ledger ENABLED
     vs DISABLED, measured INTERLEAVED over the shared churn world (the
@@ -2499,6 +2738,9 @@ def run(args, metric: str, note: str) -> None:  # lint: allow-complexity — ben
 
     _warm_native_kernel(args)
 
+    if args.simlab:
+        run_simlab(args, metric, note)
+        return
     if args.constraints:
         run_constraints(args, metric, note)
         return

@@ -244,6 +244,23 @@ def parse_args(argv=None) -> argparse.Namespace:
         "the report then includes baseline vs what-if and the delta",
     )
     parser.add_argument(
+        "--sim-seed",
+        type=int,
+        default=None,
+        metavar="N",
+        help="with --simulate: one seed threaded through every SEEDED "
+        "scenario's RNG streams (docs/simulator.md); omit for each "
+        "scenario's pinned default seed, keeping published replay "
+        "digests byte-identical",
+    )
+    parser.add_argument(
+        "--list",
+        action="store_true",
+        help="with --simulate: print the registered scenario catalog "
+        "(name, selection flags, seedability, one-line description) "
+        "from the simlab registry and exit without running anything",
+    )
+    parser.add_argument(
         "--backoff-base",
         type=float,
         default=1.0,
@@ -556,210 +573,20 @@ def _parse_mesh_shape(spec):
     return shape
 
 
-def _run_simulation(args, store) -> int:  # lint: allow-complexity — simulation mode dispatch, one arm per replay flag
-    import json
+def _run_simulation(args, store) -> int:
+    """Registry-driven simulation dispatch (docs/simulator.md): the
+    SimLab scenario catalog (karpenter_tpu/simlab) owns both the
+    selection predicates — the old elif chain's precedence, preserved
+    exactly — and the replay runners, so `--simulate --list` and this
+    dispatch can never disagree about what a flag runs. `--sim-seed`
+    threads through every seeded scenario inside the runners; the
+    default reproduces each world's pinned digests byte-identically."""
+    from karpenter_tpu.simlab import catalog_text, select_for
 
-    from karpenter_tpu.simulate import simulate, simulate_delta
-
-    if args.trace_export and not (
-        args.forecast or args.restart_storm or args.preempt
-        or args.consolidate or args.what_if or args.cost
-        or args.multitenant or args.eventloop
-    ):
-        # the traced end-to-end replay (docs/observability.md): a seeded
-        # consolidating world driven tick by tick, exporting a trace in
-        # which the coalesced solver dispatch links the candidate
-        # request spans and the SNG actuation closes the e2e window
-        from karpenter_tpu.simulate import simulate_trace
-
-        if args.provenance:
-            # the replay's HA decides record into the ledger, and the
-            # decisions JSONL lands next to the trace (the
-            # --trace-export help's contract); the process default is
-            # restored afterwards — an enabled default leaking out
-            # would turn on provenance for a co-resident runtime that
-            # never opted in (the simulate replays take the same care)
-            from karpenter_tpu.observability import (
-                default_ledger,
-                reset_default_ledger,
-                set_default_ledger,
-            )
-
-            saved_ledger = default_ledger()
-            ledger = reset_default_ledger(enabled=True)
-        try:
-            report = simulate_trace(export_path=args.trace_export)
-            if args.provenance:
-                from karpenter_tpu.observability.provenance import (
-                    export_next_to_trace,
-                )
-
-                path, count = export_next_to_trace(
-                    ledger, args.trace_export
-                )
-                report["decisions_export"] = path
-                report["decision_records"] = count
-        finally:
-            if args.provenance:
-                set_default_ledger(saved_ledger)
-        # simulate_trace already exported (the report pins the event
-        # count): clear the flag so main's exit-time _export_trace
-        # doesn't rewrite the identical file (or the decisions sibling)
-        args.trace_export = None
-        print(json.dumps(report, indent=2, sort_keys=True))
+    if args.list:
+        print(catalog_text())
         return 0
-
-    if args.constraints:
-        # self-contained replay (own store, fake provider, scripted
-        # clock): the constraint plane through a seeded zonal outage
-        # (docs/constraints.md)
-        from karpenter_tpu.simulate import simulate_constraints
-
-        report = simulate_constraints()
-        print(json.dumps(report, indent=2, sort_keys=True))
-        return 0
-
-    if args.eventloop:
-        # self-contained replay (own stores, fake provider, scripted
-        # clock): the same seeded pod-arrival trace tick-paced vs
-        # event-driven (docs/solver-service.md "Event-driven reconcile")
-        from karpenter_tpu.simulate import simulate_eventloop
-
-        report = simulate_eventloop(
-            arrivals=args.eventloop_arrivals,
-            storm_events=args.eventloop_storm,
-            debounce_s=args.event_debounce,
-        )
-        print(json.dumps(report, indent=2, sort_keys=True))
-        return 0
-
-    if args.multitenant:
-        # self-contained replay (no store, no provider): N seeded
-        # tenant clusters stepped in lockstep through one
-        # MultiTenantScheduler (docs/multitenancy.md); combines with
-        # --cost implicitly (every lockstep tick runs decide + cost),
-        # with --provenance (per-decision "why" records + ledger
-        # JSONL), and with --trace-export
-        from karpenter_tpu.simulate import simulate_multitenant
-
-        report = simulate_multitenant(
-            tenants=args.tenants,
-            tenant_config=args.tenant_config,
-            provenance=args.provenance,
-            trace_export=args.trace_export,
-        )
-        # simulate_multitenant exported trace + decisions itself
-        args.trace_export = None
-        print(json.dumps(report, indent=2, sort_keys=True))
-        return 0
-
-    if args.cost:
-        # self-contained replay (own stores, lagged fake provider):
-        # warm pool on vs off through the cost-aware pipeline
-        from karpenter_tpu.simulate import simulate_cost
-
-        report = simulate_cost(
-            horizon_s=args.forecast_horizon,
-            default_hourly=args.cost_default_hourly,
-            spot_multiplier=args.cost_spot_multiplier,
-            provenance=args.provenance,
-        )
-        print(json.dumps(report, indent=2, sort_keys=True))
-        return 0
-
-    if args.forecast:
-        # self-contained replay (no store, no provider): proactive vs
-        # reactive on a scripted diurnal ramp
-        from karpenter_tpu.simulate import simulate_forecast
-
-        report = simulate_forecast(
-            horizon_s=args.forecast_horizon, model=args.forecast_model
-        )
-        print(json.dumps(report, indent=2, sort_keys=True))
-        return 0
-
-    if args.restart_storm:
-        # self-contained replay (own store/provider/journal dir): a
-        # seeded kill-and-restart storm pinning the crash-safety
-        # contract — exactly-once actuation, FSM resumption, fencing
-        from karpenter_tpu.simulate import simulate_restart_storm
-
-        report = simulate_restart_storm(
-            crashes=args.storm_crashes,
-            journal_dir=args.journal_dir,
-            warmup_ticks=args.recovery_warmup_ticks,
-        )
-        print(json.dumps(report, indent=2, sort_keys=True))
-        return 0
-
-    if args.preempt:
-        # self-contained replay (no live store, no provider): a seeded
-        # spot-reclaim storm over mixed on-demand/spot pools
-        from karpenter_tpu.simulate import simulate_preempt
-
-        report = simulate_preempt(
-            preempt_budget=args.preempt_budget,
-            default_priority=args.default_priority,
-        )
-        print(json.dumps(report, indent=2, sort_keys=True))
-        return 0
-
-    what_if = None
-    if args.what_if:
-        from karpenter_tpu.utils.configfile import load_json_or_yaml
-
-        what_if = load_json_or_yaml(args.what_if)
-        if not isinstance(what_if, list):
-            print(
-                f"--what-if {args.what_if}: expected a LIST of group specs",
-                file=sys.stderr,
-            )
-            return 2
-
-    # a runtime only to materialize the store the flags describe (WAL dir
-    # or live apiserver) and the optional solver sidecar; no controllers
-    # tick, nothing is mutated
-    runtime = KarpenterRuntime(
-        Options(
-            data_dir=args.data_dir,
-            solver_uri=args.solver_uri,
-            cloud_provider=args.cloud_provider,
-            verbose=args.verbose,
-            cost_default_hourly=args.cost_default_hourly,
-            cost_spot_multiplier=args.cost_spot_multiplier,
-            pricing_file=args.pricing_file,
-        ),
-        store=store,
-    )
-    # route through the runtime's shared solve service (not the raw
-    # sidecar client): the dry run gets the same queueing, deadlines,
-    # and numpy fallback the production tick gets
-    solver = runtime.solver_service.solve
-    # the scale-from-zero seam the production solve uses: without it,
-    # empty groups with a nodeGroupRef would simulate as infeasible
-    resolver = runtime.producer_factory.template_resolver()
-    try:
-        if args.consolidate:
-            from karpenter_tpu.simulate import simulate_consolidation
-
-            report = simulate_consolidation(
-                runtime.store, service=runtime.solver_service
-            )
-        elif what_if is not None:
-            report = simulate_delta(
-                runtime.store, what_if, solver=solver,
-                template_resolver=resolver,
-                cost_model=runtime.cost_model,
-            )
-        else:
-            report = simulate(
-                runtime.store, solver=solver, template_resolver=resolver,
-                cost_model=runtime.cost_model,
-            )
-        print(json.dumps(report, indent=2, sort_keys=True))
-    finally:
-        runtime.close()
-    return 0
+    return int(select_for(args).run(args, store) or 0)
 
 
 def _export_trace(args) -> None:

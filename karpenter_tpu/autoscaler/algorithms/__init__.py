@@ -112,6 +112,16 @@ from karpenter_tpu.autoscaler.algorithms.trend import Trend  # noqa: E402
 
 register_algorithm("trend", Trend)
 
+# simlab: the frozen search-tuned SimLab policy (docs/simulator.md)
+# behind the never-block contract — any tuned-path failure degrades
+# that decision to the plain reactive tick; same fresh-instance /
+# engine-memoized lifecycle as trend
+from karpenter_tpu.autoscaler.algorithms.simlab_policy import (  # noqa: E402
+    SimlabPolicy,
+)
+
+register_algorithm("simlab", SimlabPolicy)
+
 # admission wiring: the api layer exposes a hook registry (it cannot import
 # this package — that would invert the layering); importing the algorithms
 # package is what arms the annotation check, and every control-plane entry

@@ -67,6 +67,17 @@ STAGE_FORECAST_BLEND = "forecast_blend"
 STAGE_DEGRADED_FLOOR = "degraded_floor"
 STAGE_ADMISSION_DEFERRAL = "admission_deferral"
 STAGE_REACTIVE = "reactive"
+# the full vocabulary in precedence order — the stable label index
+# space consumers (simlab/labels.py label_stream) encode against
+STAGES = (
+    STAGE_COST_BLIND,
+    STAGE_COST_RAISE,
+    STAGE_COST_CLAMP,
+    STAGE_FORECAST_BLEND,
+    STAGE_DEGRADED_FLOOR,
+    STAGE_ADMISSION_DEFERRAL,
+    STAGE_REACTIVE,
+)
 
 # column schema: name -> (dtype, fill). Object columns hold interned
 # strings (names that already exist elsewhere); numeric fills mark

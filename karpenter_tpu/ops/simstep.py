@@ -1,0 +1,322 @@
+"""SimLab cluster-stepping kernels: one tick / one rollout as array programs.
+
+The simulator plane (karpenter_tpu/simlab, docs/simulator.md) advances a
+simulated cluster's columnar state — per-row replica counts under a
+seeded demand/price/fault trail — with the SAME batch-everything
+discipline as the decision kernels: the whole fleet of simulated
+clusters is ONE array program, `sim_rollout_vmapped` stacks N
+independently-seeded clusters behind a single vmapped dispatch, and
+`sim_*_numpy` are bit-identical host mirrors (pinned in
+tests/test_simlab.py).
+
+Two entry points:
+
+  sim_step     one tick, ACTION GIVEN (the gym `SimEnv.step` seam): the
+               caller's policy already chose per-row replica targets;
+               the kernel applies the actuation rate limit and the
+               fault gate, then scores the tick.
+  sim_rollout  a whole T-tick episode with the IN-KERNEL tuned policy
+               (parameterized by a per-cluster knob vector), so policy
+               search evaluates a full candidate population in one
+               device program (simlab/policy.py SearchTunedPolicy).
+
+Tick semantics (all f32, elementwise over the row axis R):
+
+  target   = clip(action, min, max)
+  delta    = clip(target - replicas, ±step_limit) * (1 - fault)
+  replicas'= clip(replicas + delta, min, max)         # fault holds state
+  violation= demand > replicas' * cap                 # SLO-violation tick
+  cost     = replicas' * hourly * price               # priced replica-ticks
+  backlog  = |target - replicas'|                     # reconcile lead debt
+
+The in-kernel policy (sim_rollout) is the 3-knob decision surface the
+search plane tunes — forecast blend floor, cost shed weight,
+scale-down stabilization window:
+
+  blend  = max(demand_prev, blend_floor * forecast_prev)
+  raw    = ceil(blend / cap)
+  shed   = floor(raw * cost_weight * max(price_prev - 1, 0))
+  tgt    = clip(raw - shed, min, max)
+  target = tgt held at current replicas while a scale-down streak is
+           younger than stab_window ticks
+
+knobs = (0, 0, 0) IS the reactive baseline (chase observed demand,
+price-blind, no hold), so tuned-vs-reactive comparisons share one
+program.
+
+Parity contract (pinned bit-for-bit by tests/test_simlab.py, the
+ops/cost.py discipline): every operation is IEEE-exact elementwise on
+both sides — mul, sub, div-into-ceil, clip, abs, compare, where — and
+the only multiply feeding an add (`replicas + delta * can_act`) has an
+EXACT multiplicand (can_act is 0.0 or 1.0), so XLA:CPU's FMA
+contraction cannot round differently from the two-op host form. No
+reductions happen in-kernel: per-tick per-row components come back
+whole and the composite reward is summed on host in float64, so
+batched, sequential, and numpy paths reduce in one order.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_ONE = np.float32(1.0)
+_ZERO = np.float32(0.0)
+
+# knob vector layout (simlab/policy.py builds/search-tunes these)
+KNOB_BLEND_FLOOR = 0
+KNOB_COST_WEIGHT = 1
+KNOB_STAB_WINDOW = 2
+KNOBS = 3
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class SimStepInputs:
+    """One tick's operands. Row arrays are f32[..., R]; `price` and
+    `fault` are per-cluster f32[...] (the kernel broadcasts them over
+    rows); the five scalars are f32[] shared across the batch."""
+
+    replicas: jax.Array  # f32[..., R] current replicas per HA row
+    target: jax.Array  # f32[..., R] the action: requested replicas
+    demand: jax.Array  # f32[..., R] this tick's observed demand
+    price: jax.Array  # f32[...] price multiplier (spot spike > 1)
+    fault: jax.Array  # f32[...] 1.0 = actuation blocked this tick
+    cap: jax.Array  # f32[] demand served per replica
+    hourly: jax.Array  # f32[] on-demand price per replica-tick
+    step_limit: jax.Array  # f32[] max replica movement per tick
+    min_replicas: jax.Array  # f32[]
+    max_replicas: jax.Array  # f32[]
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class SimStepOutputs:
+    replicas: jax.Array  # f32[..., R] post-actuation replicas
+    violation: jax.Array  # f32[..., R] 1.0 where demand outran capacity
+    cost: jax.Array  # f32[..., R] priced replica-ticks
+    backlog: jax.Array  # f32[..., R] |target - replicas'| lead debt
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class SimRolloutInputs:
+    """A whole episode's operands: time-major trails f32[..., T, R]
+    (f32[..., T] for the per-cluster price/fault schedules), the initial
+    cluster state, and the per-cluster policy knob vector f32[..., 3]."""
+
+    replicas0: jax.Array  # f32[..., R]
+    streak0: jax.Array  # f32[..., R] scale-down streak ages
+    demand: jax.Array  # f32[..., T, R]
+    forecast: jax.Array  # f32[..., T, R] preview of the NEXT demand
+    price: jax.Array  # f32[..., T]
+    fault: jax.Array  # f32[..., T]
+    knobs: jax.Array  # f32[..., KNOBS]
+    cap: jax.Array  # f32[]
+    hourly: jax.Array  # f32[]
+    step_limit: jax.Array  # f32[]
+    min_replicas: jax.Array  # f32[]
+    max_replicas: jax.Array  # f32[]
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class SimRolloutOutputs:
+    """Whole per-tick component trails (no in-kernel reductions — the
+    module docstring's parity contract) plus the final carry state."""
+
+    replicas: jax.Array  # f32[..., R] final replicas
+    streak: jax.Array  # f32[..., R] final scale-down streaks
+    violation: jax.Array  # f32[..., T, R]
+    cost: jax.Array  # f32[..., T, R]
+    backlog: jax.Array  # f32[..., T, R]
+    target: jax.Array  # f32[..., T, R] the actions the policy took
+
+
+def _step_math(m, replicas, target, demand, price, fault, inputs):
+    """The shared tick program (module docstring), generic over the
+    array module `m` (jnp on device, np on the mirror)."""
+    tgt = m.clip(target, inputs.min_replicas, inputs.max_replicas)
+    can_act = _ONE - fault  # exactly 0.0 or 1.0: FMA-safe multiplicand
+    delta = (
+        m.clip(tgt - replicas, -inputs.step_limit, inputs.step_limit)
+        * can_act[..., None]
+    )
+    new = m.clip(
+        replicas + delta, inputs.min_replicas, inputs.max_replicas
+    )
+    served = new * inputs.cap
+    violation = (demand > served).astype(np.float32)
+    cost = new * inputs.hourly * price[..., None]
+    backlog = m.abs(tgt - new)
+    return new, violation, cost, backlog
+
+
+def _policy_math(
+    m, knobs, demand_prev, forecast_prev, price_prev, replicas, streak,
+    inputs,
+):
+    """The in-kernel 3-knob tuned policy (module docstring), generic
+    over the array module. knobs[..., 0]=blend floor, [..., 1]=cost
+    shed weight, [..., 2]=stabilization window in ticks."""
+    blend_floor = knobs[..., 0:1]
+    cost_weight = knobs[..., 1:2]
+    stab_window = knobs[..., 2:3]
+    blend = m.maximum(demand_prev, blend_floor * forecast_prev)
+    raw = m.ceil(blend / inputs.cap)
+    spike = m.maximum(price_prev - _ONE, _ZERO)
+    shed = m.floor(raw * cost_weight * spike[..., None])
+    tgt = m.clip(
+        raw - shed, inputs.min_replicas, inputs.max_replicas
+    )
+    down = tgt < replicas
+    streak2 = m.where(down, streak + _ONE, _ZERO)
+    hold = down & (streak2 <= stab_window)
+    target = m.where(hold, replicas, tgt)
+    return target, streak2
+
+
+def sim_step(inputs: SimStepInputs) -> SimStepOutputs:
+    """One tick on device (elementwise: any leading batch shape rides
+    the same program)."""
+    new, violation, cost, backlog = _step_math(
+        jnp, inputs.replicas, inputs.target, inputs.demand,
+        inputs.price, inputs.fault, inputs,
+    )
+    return SimStepOutputs(
+        replicas=new, violation=violation, cost=cost, backlog=backlog
+    )
+
+
+sim_step_jit = jax.jit(sim_step)
+
+
+def sim_step_numpy(inputs: SimStepInputs) -> SimStepOutputs:
+    """Bit-identical host mirror of sim_step."""
+    new, violation, cost, backlog = _step_math(
+        np, np.asarray(inputs.replicas), np.asarray(inputs.target),
+        np.asarray(inputs.demand), np.asarray(inputs.price),
+        np.asarray(inputs.fault), inputs,
+    )
+    return SimStepOutputs(
+        replicas=new, violation=violation, cost=cost, backlog=backlog
+    )
+
+
+def sim_rollout(inputs: SimRolloutInputs) -> SimRolloutOutputs:
+    """One UNBATCHED episode (trails [T, R]) as a lax.scan device
+    program; `sim_rollout_vmapped` stacks clusters on a leading axis."""
+    rows = inputs.replicas0.shape[-1]
+    zeros = jnp.zeros((rows,), jnp.float32)
+
+    def tick(carry, xs):
+        replicas, streak, d_prev, f_prev, p_prev = carry
+        demand_t, forecast_t, price_t, fault_t = xs
+        target, streak2 = _policy_math(
+            jnp, inputs.knobs, d_prev, f_prev, p_prev, replicas,
+            streak, inputs,
+        )
+        new, violation, cost, backlog = _step_math(
+            jnp, replicas, target, demand_t, price_t, fault_t, inputs
+        )
+        carry2 = (new, streak2, demand_t, forecast_t, price_t)
+        return carry2, (violation, cost, backlog, target)
+
+    init = (inputs.replicas0, inputs.streak0, zeros, zeros, _ONE)
+    (replicas, streak, _d, _f, _p), (violation, cost, backlog, target) = (
+        jax.lax.scan(
+            tick, init,
+            (inputs.demand, inputs.forecast, inputs.price, inputs.fault),
+        )
+    )
+    return SimRolloutOutputs(
+        replicas=replicas, streak=streak, violation=violation,
+        cost=cost, backlog=backlog, target=target,
+    )
+
+
+sim_rollout_jit = jax.jit(sim_rollout)
+
+# the batched program: N clusters' trails/knobs stack on a leading axis
+# and advance as ONE vmapped dispatch; the five scalars broadcast
+_BATCH_AXES = SimRolloutInputs(
+    replicas0=0, streak0=0, demand=0, forecast=0, price=0, fault=0,
+    knobs=0, cap=None, hourly=None, step_limit=None, min_replicas=None,
+    max_replicas=None,
+)
+sim_rollout_vmapped = jax.jit(jax.vmap(sim_rollout, in_axes=(_BATCH_AXES,)))
+
+
+def _rollout_numpy_one(inputs: SimRolloutInputs) -> SimRolloutOutputs:
+    ticks, rows = inputs.demand.shape
+    replicas = np.asarray(inputs.replicas0, np.float32).copy()
+    streak = np.asarray(inputs.streak0, np.float32).copy()
+    d_prev = np.zeros(rows, np.float32)
+    f_prev = np.zeros(rows, np.float32)
+    # 0-d arrays, not numpy scalars: the kernels broadcast per-cluster
+    # price/fault over rows with `[..., None]`, which scalars reject
+    p_prev = np.asarray(_ONE)
+    violation = np.zeros((ticks, rows), np.float32)
+    cost = np.zeros((ticks, rows), np.float32)
+    backlog = np.zeros((ticks, rows), np.float32)
+    target = np.zeros((ticks, rows), np.float32)
+    for t in range(ticks):
+        tgt, streak = _policy_math(
+            np, inputs.knobs, d_prev, f_prev, p_prev, replicas, streak,
+            inputs,
+        )
+        replicas, violation[t], cost[t], backlog[t] = _step_math(
+            np, replicas, tgt, inputs.demand[t],
+            np.asarray(inputs.price[t]), np.asarray(inputs.fault[t]),
+            inputs,
+        )
+        target[t] = tgt
+        d_prev, f_prev, p_prev = (
+            inputs.demand[t], inputs.forecast[t],
+            np.asarray(inputs.price[t]),
+        )
+    return SimRolloutOutputs(
+        replicas=replicas, streak=streak, violation=violation,
+        cost=cost, backlog=backlog, target=target,
+    )
+
+
+def sim_rollout_numpy(inputs: SimRolloutInputs) -> SimRolloutOutputs:
+    """Bit-identical host mirror of sim_rollout/sim_rollout_vmapped:
+    unbatched trails run one episode loop; batched trails loop the
+    clusters (the sequential reference the property pins compare)."""
+    if np.asarray(inputs.replicas0).ndim == 1:
+        return _rollout_numpy_one(inputs)
+    outs = [
+        _rollout_numpy_one(_cluster_slice(inputs, b))
+        for b in range(np.asarray(inputs.replicas0).shape[0])
+    ]
+    return SimRolloutOutputs(
+        replicas=np.stack([o.replicas for o in outs]),
+        streak=np.stack([o.streak for o in outs]),
+        violation=np.stack([o.violation for o in outs]),
+        cost=np.stack([o.cost for o in outs]),
+        backlog=np.stack([o.backlog for o in outs]),
+        target=np.stack([o.target for o in outs]),
+    )
+
+
+def _cluster_slice(inputs: SimRolloutInputs, b: int) -> SimRolloutInputs:
+    """Cluster b's unbatched view of a batched SimRolloutInputs."""
+    return SimRolloutInputs(
+        replicas0=np.asarray(inputs.replicas0)[b],
+        streak0=np.asarray(inputs.streak0)[b],
+        demand=np.asarray(inputs.demand)[b],
+        forecast=np.asarray(inputs.forecast)[b],
+        price=np.asarray(inputs.price)[b],
+        fault=np.asarray(inputs.fault)[b],
+        knobs=np.asarray(inputs.knobs)[b],
+        cap=inputs.cap,
+        hourly=inputs.hourly,
+        step_limit=inputs.step_limit,
+        min_replicas=inputs.min_replicas,
+        max_replicas=inputs.max_replicas,
+    )

@@ -119,6 +119,10 @@ class Options:
     # JSON/YAML catalog file reloaded on mtime change, consulted before
     # the built-in catalog. None = built-in catalog only.
     pricing_file: Optional[str] = None
+    # simulation seed (--sim-seed, docs/simulator.md): one seed threaded
+    # through every SEEDED SimLab scenario's RNG streams. None = each
+    # scenario's pinned default, keeping replay digests byte-identical.
+    sim_seed: Optional[int] = None
     # multi-tenant control plane (karpenter_tpu/tenancy,
     # docs/multitenancy.md): path to a tenant-config file (--tenant-
     # config). None = single-tenant, byte-identical to the pre-tenancy

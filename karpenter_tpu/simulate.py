@@ -15,6 +15,15 @@ scale-up want to see the placement the signal is promising.
 
 Nothing here mutates the store or any status object: the solve runs on a
 detached snapshot, making it safe against a live cluster.
+
+Every `simulate_*` replay world in this module is registered as a
+SimLab scenario (karpenter_tpu/simlab/builtin.py, docs/simulator.md):
+the scenario registry owns the `--simulate` CLI dispatch (`--list`
+prints the catalog, `--sim-seed` threads a seed through the seeded
+worlds' RNG streams), and pairs each world with seeded trail generators
+for the gym-style simulator core. The functions here stay the library
+surface — call them directly for programmatic replays; their default
+seeds reproduce the digests the acceptance tests pin.
 """
 
 from __future__ import annotations

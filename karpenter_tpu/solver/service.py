@@ -246,6 +246,10 @@ class SolverStatistics:
     # carrying constraint operands rerouted to the XLA family (Mosaic
     # has no constraint entry — counted, never silently dropped)
     constraint_reroutes: int = 0
+    # simlab cluster-stepping seam (ops/simstep.py, docs/simulator.md)
+    sim_calls: int = 0  # sim_step() + sim_rollout() entries
+    sim_dispatches: int = 0  # sim device dispatches (1 per batched call)
+    sim_mirror_serves: int = 0  # sim calls served by the numpy mirror
     # sharded dispatch (docs/solver-service.md "Sharded dispatch")
     shard_dispatches: int = 0  # batches answered by the mesh-sharded program
     shard_requests: int = 0  # requests routed onto the mesh at submit
@@ -1435,6 +1439,71 @@ class SolverService:
             raise
         finally:
             self._record_stage("cost", _time.perf_counter() - t0)
+
+    def sim_step(self, inputs, backend: Optional[str] = None):
+        """One simulated-cluster tick through the service (ops/simstep.py,
+        docs/simulator.md): elementwise over any leading batch shape, so
+        a BatchedSimEnv's N clusters advance as ONE dispatch."""
+        from karpenter_tpu.ops import simstep as SK
+
+        return self._sim_dispatch(
+            "solver.sim_step", SK.sim_step_jit, SK.sim_step_numpy, inputs,
+            backend,
+        )
+
+    def sim_rollout(self, inputs, backend: Optional[str] = None):
+        """A whole simulated episode (in-kernel tuned policy) through
+        the service: batched trails ride the vmapped program — N
+        clusters x T ticks in one device dispatch (docs/simulator.md)."""
+        from karpenter_tpu.ops import simstep as SK
+
+        batched = np.asarray(inputs.replicas0).ndim > 1
+        return self._sim_dispatch(
+            "solver.sim_rollout",
+            SK.sim_rollout_vmapped if batched else SK.sim_rollout_jit,
+            SK.sim_rollout_numpy, inputs, backend,
+        )
+
+    def _sim_dispatch(self, span, device_fn, numpy_fn, inputs, backend):
+        """The simlab family's one door: tracing + stats + backend
+        resolution like cost(), but a NEVER-BLOCK degradation posture —
+        the numpy mirror is bit-identical (tests/test_simlab.py), so a
+        device failure serves the mirror instead of raising; failures
+        still feed the shared backend-health FSM. `simlab.step` is the
+        fault-injection point (faults/registry.py)."""
+        self.stats.sim_calls += 1
+        resolved = self._resolve_backend(backend)
+        if self.device_solver is not None:
+            resolved = "numpy"  # the gRPC wire carries bin-packs only
+        elif resolved == "pallas":
+            resolved = "xla"  # no Mosaic sim kernel; XLA runs on TPU
+        t0 = _time.perf_counter()
+        try:
+            if resolved != "numpy" and self._device_allowed():
+                try:
+                    import jax
+
+                    with default_tracer().span(span, backend=resolved):
+                        with solver_trace(span):
+                            inject("simlab.step")
+                            out = device_fn(inputs)
+                            jax.block_until_ready(out)
+                    self._record_device_success()
+                    self.stats.sim_dispatches += 1
+                    self._count_dispatch()
+                    return jax.tree_util.tree_map(np.asarray, out)
+                except Exception as error:  # noqa: BLE001 — never-block
+                    self._record_device_failure()
+                    logger().warning(
+                        "sim device dispatch failed (%s: %s); serving "
+                        "the bit-identical numpy mirror",
+                        type(error).__name__, error,
+                    )
+            with default_tracer().span(span, backend="numpy"):
+                self.stats.sim_mirror_serves += 1
+                return numpy_fn(inputs)
+        finally:
+            self._record_stage("sim", _time.perf_counter() - t0)
 
     def _annotate_provenance(self, backend: str, rung: str) -> None:
         """Provenance slice (observability/provenance.py): stamp the
