@@ -135,6 +135,12 @@ bench-simlab: ## SimLab batched cluster stepping: N seeded clusters as ONE vmapp
 		--simlab-ticks 64 --simlab-rows 8 --iters 10 \
 		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
 
+bench-fusedtick: ## Fused steady-state tick: the fleet batch's forecast -> decide -> cost ladder as ONE compiled program (--fused-tick) vs the chained per-stage wire (fused == chained == numpy pinned bitwise before timing), plus the dispatches-per-tick collapse over the shared churn world; appends a BENCHMARKS row + publishes to BASELINE.json
+	$(PYTHON) bench.py --fusedtick --fusedtick-rows 256 \
+		--fusedtick-metrics 3 --fusedtick-series 128 \
+		--fusedtick-samples 32 --fusedtick-ticks 40 --iters 20 \
+		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
+
 dryrun: ## Multi-chip sharding compile check on 8 virtual CPU devices
 	$(PYTHON) -c "import os; \
 		os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS','') + ' --xla_force_host_platform_device_count=8').strip(); \
@@ -176,5 +182,5 @@ kind-smoke: ## Deploy smoke on kind: image -> apply -> pod Ready -> one HA end t
 	bench-forecast bench-preempt bench-cost bench-journal bench-trace \
 	bench-provenance bench-resident bench-shard bench-multitenant \
 	bench-eventloop bench-introspect bench-constraints test-simlab \
-	bench-simlab dryrun \
+	bench-simlab bench-fusedtick dryrun \
 	image publish apply delete kind-load conformance kind-smoke

@@ -1037,6 +1037,50 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
         help="with --simlab: HA rows (replica columns) per cluster",
     )
     ap.add_argument(
+        "--fusedtick",
+        action="store_true",
+        help="benchmark the fused steady-state tick "
+        "(docs/solver-service.md 'Fused tick'): the whole fleet's "
+        "forecast -> decide -> cost ladder as ONE compiled program "
+        "through SolverService.fused_tick vs the chained per-stage "
+        "wire (one program per stage + host glue between); fused == "
+        "chained == numpy pinned bitwise before timing, plus the "
+        "--fused-tick dispatches-per-tick collapse over the shared "
+        "churn-runtime world",
+    )
+    ap.add_argument(
+        "--fusedtick-rows",
+        type=int,
+        default=256,
+        help="with --fusedtick: autoscaler rows per fleet batch",
+    )
+    ap.add_argument(
+        "--fusedtick-metrics",
+        type=int,
+        default=3,
+        help="with --fusedtick: metric columns per autoscaler row",
+    )
+    ap.add_argument(
+        "--fusedtick-series",
+        type=int,
+        default=128,
+        help="with --fusedtick: forecast series scattered into the "
+        "fleet grid",
+    )
+    ap.add_argument(
+        "--fusedtick-samples",
+        type=int,
+        default=32,
+        help="with --fusedtick: history samples per forecast series",
+    )
+    ap.add_argument(
+        "--fusedtick-ticks",
+        type=int,
+        default=40,
+        help="with --fusedtick: timed reconcile ticks per runtime arm "
+        "(the dispatches-per-tick observable)",
+    )
+    ap.add_argument(
         "--e2e",
         action="store_true",
         help="headline the full reconcile tick (columnar-cache snapshot + "
@@ -1299,23 +1343,53 @@ def main() -> None:  # lint: allow-complexity — bench config dispatch, one arm
         ap.error(
             "--simlab needs clusters >= 2, ticks >= 4, rows >= 1"
         )
+    if args.fusedtick and (
+        args.mesh or args.e2e or args.decide or args.clusters
+        or args.solver_service or args.hotpath or args.consolidate
+        or args.forecast or args.preempt or args.journal or args.trace
+        or args.shard or args.cost or args.multitenant
+        or args.provenance or args.resident or args.eventloop
+        or args.introspect or args.constraints or args.simlab
+    ):
+        ap.error(
+            "--fusedtick builds its own fleet-batch workload; it "
+            "cannot combine with other modes"
+        )
+    if args.fusedtick and (
+        args.fusedtick_rows < 2 or args.fusedtick_metrics < 1
+        or args.fusedtick_series < 1 or args.fusedtick_samples < 4
+        or args.fusedtick_ticks < 4
+    ):
+        ap.error(
+            "--fusedtick needs rows >= 2, metrics >= 1, series >= 1, "
+            "samples >= 4, ticks >= 4"
+        )
     if (args.publish_baseline or args.append_benchmarks) and not (
         args.solver_service or args.consolidate or args.hotpath
         or args.forecast or args.preempt or args.journal or args.shard
         or args.trace or args.cost or args.multitenant
         or args.provenance or args.resident or args.eventloop
         or args.introspect or args.constraints or args.simlab
+        or args.fusedtick
     ):
         ap.error(
             "--publish-baseline/--append-benchmarks only apply to "
             "--solver-service/--consolidate/--hotpath/--forecast/"
             "--preempt/--journal/--shard/--trace/--cost/--multitenant/"
             "--provenance/--resident/--eventloop/--introspect/"
-            "--constraints/--simlab (nothing would be published "
-            "otherwise)"
+            "--constraints/--simlab/--fusedtick (nothing would be "
+            "published otherwise)"
         )
 
-    if args.simlab:
+    if args.fusedtick:
+        metric = (
+            f"fused steady-state tick p50, {args.fusedtick_rows} "
+            f"autoscalers x {args.fusedtick_metrics} metrics x "
+            f"{args.fusedtick_series} forecast series (one fused "
+            f"forecast->decide->cost program vs the chained per-stage "
+            f"wire, interleaved; bitwise parity pinned)"
+        )
+    elif args.simlab:
         metric = (
             f"vmapped batched cluster-stepping p50, "
             f"{args.simlab_clusters} clusters x {args.simlab_ticks} "
@@ -1587,7 +1661,7 @@ def _journal_world(runtime):
     runtime.registry.register("queue", "length").set("q", "default", 12.0)
 
 
-def _churn_runtime(journal_dir=None):
+def _churn_runtime(journal_dir=None, **options_kw):
     """The seeded churn world both overhead benches (--journal and
     --trace-overhead) measure: a consolidating runtime over
     _journal_world with a tick() that toggles a churn pod so the encode
@@ -1602,8 +1676,10 @@ def _churn_runtime(journal_dir=None):
     clock = {"now": 1_000_000.0}
     provider = FakeFactory()
     provider.node_replicas["g"] = 3
+    opts = dict(consolidate=True, journal_dir=journal_dir)
+    opts.update(options_kw)
     runtime = KarpenterRuntime(
-        Options(consolidate=True, journal_dir=journal_dir),
+        Options(**opts),
         cloud_provider_factory=provider,
         clock=lambda: clock["now"],
     )
@@ -2216,6 +2292,291 @@ def _append_simlab_row(path: str, record: dict) -> None:
     _append_table_row(path, marker, header, row)
 
 
+def _fusedtick_inputs(seed, n, m, s, t):
+    """A seeded full-presence fleet batch: every stage of the fused
+    megakernel engaged (forecast series scattered over the grid, SLO
+    rows mostly valid) so the measured program carries the whole
+    forecast -> decide -> cost ladder."""
+    from karpenter_tpu.forecast import models as FM
+    from karpenter_tpu.ops import decision as DK
+    from karpenter_tpu.ops import fusedtick as FT
+
+    r = np.random.RandomState(seed)
+    k = 2
+    now = 1000.0
+    decision = DK.DecisionInputs(
+        metric_value=r.uniform(0, 100, (n, m)).astype(np.float32),
+        target_value=r.uniform(1, 80, (n, m)).astype(np.float32),
+        target_type=r.randint(0, 3, (n, m)).astype(np.int32),
+        metric_valid=r.rand(n, m) > 0.2,
+        spec_replicas=r.randint(1, 20, n).astype(np.int32),
+        status_replicas=r.randint(1, 20, n).astype(np.int32),
+        min_replicas=r.randint(0, 3, n).astype(np.int32),
+        max_replicas=r.randint(20, 40, n).astype(np.int32),
+        up_window=r.randint(0, 60, n).astype(np.int32),
+        down_window=r.randint(0, 120, n).astype(np.int32),
+        up_policy=r.randint(0, 2, n).astype(np.int32),
+        down_policy=r.randint(0, 2, n).astype(np.int32),
+        last_scale_time=(now - r.uniform(0, 300, n)).astype(np.float32),
+        has_last_scale=r.rand(n) > 0.3,
+        now=np.float32(now),
+        up_ptype=r.randint(0, 3, (n, k)).astype(np.int32),
+        up_pvalue=r.randint(1, 10, (n, k)).astype(np.int32),
+        up_pperiod=r.randint(15, 120, (n, k)).astype(np.int32),
+        up_pvalid=r.rand(n, k) > 0.4,
+        down_ptype=r.randint(0, 3, (n, k)).astype(np.int32),
+        down_pvalue=r.randint(1, 10, (n, k)).astype(np.int32),
+        down_pperiod=r.randint(15, 120, (n, k)).astype(np.int32),
+        down_pvalid=r.rand(n, k) > 0.4,
+    )
+    forecast = FM.ForecastInputs(
+        values=r.uniform(0, 100, (s, t)).astype(np.float32),
+        valid=r.rand(s, t) > 0.2,
+        times=np.cumsum(r.uniform(10, 20, (s, t)), 1).astype(np.float32),
+        weights=np.ones((s, t), np.float32),
+        horizon=np.full(s, 60.0, np.float32),
+        step_s=np.full(s, 15.0, np.float32),
+        model=r.randint(0, 2, s).astype(np.int32),
+        season=np.full(s, 4, np.int32),
+        alpha=np.full(s, 0.5, np.float32),
+        beta=np.full(s, 0.1, np.float32),
+        gamma=np.full(s, 0.1, np.float32),
+    )
+    return FT.FusedTickInputs(
+        decision=decision,
+        forecast=forecast,
+        series_row=r.randint(0, n, s).astype(np.int32),
+        series_col=r.randint(0, m, s).astype(np.int32),
+        series_need=np.full(s, 2, np.int32),
+        series_blend=r.rand(s) > 0.3,
+        ha_min=r.randint(0, 3, n).astype(np.int32),
+        ha_max=r.randint(20, 40, n).astype(np.int32),
+        unit_cost=r.uniform(0.1, 3.0, n).astype(np.float32),
+        slo_weight=r.uniform(0, 2, n).astype(np.float32),
+        max_hourly_cost=r.uniform(5, 50, n).astype(np.float32),
+        slo_valid=r.rand(n) > 0.4,
+        slo_target=r.uniform(1, 80, (n, m)).astype(np.float32),
+        observed=r.uniform(0, 100, (n, m)).astype(np.float32),
+        demand_base_valid=r.rand(n, m) > 0.3,
+        prior_point=r.uniform(0, 100, (n, m)).astype(np.float32),
+        prior_sigma2=r.uniform(0, 10, (n, m)).astype(np.float32),
+        prior_valid=r.rand(n, m) > 0.5,
+    )
+
+
+def _fusedtick_world_ticks(fused: bool, warmup: int, ticks: int):
+    """(per-tick wall times, dispatches-per-tick) over the shared
+    churn-runtime world with --fused-tick on/off: the HA plane's
+    forecast + SLO stages engaged so the chained arm pays one program
+    per stage while the fused arm pays ONE (the
+    karpenter_solver_dispatches_per_tick observable)."""
+    from karpenter_tpu.api.horizontalautoscaler import (
+        ForecastSpec, SLOSpec,
+    )
+
+    runtime, tick = _churn_runtime(
+        consolidate=False, fused_tick=fused,
+    )
+    times = []
+    try:
+        # the dispatch-count observable needs the compiled path ("auto"
+        # resolves to numpy on CPU; decisions are bit-identical)
+        runtime.solver_service.backend = "xla"
+        # the producer's pending-capacity solve would ride along in
+        # both arms; drop it so the gauge isolates the HA-plane ladder
+        runtime.store.delete("MetricsProducer", "default", "pending")
+        ha = runtime.store.get("HorizontalAutoscaler", "default", "ha")
+        ha.spec.behavior.forecast = ForecastSpec(
+            horizon_seconds=30.0, min_samples=3, model="linear",
+        )
+        ha.spec.behavior.slo = SLOSpec(
+            target_value=3.0, violation_cost_weight=25.0,
+        )
+        # store.get hands back a clone; write the engaged stages back
+        runtime.store.update(ha)
+        for _ in range(warmup):
+            tick()
+        for _ in range(ticks):
+            t0 = time.perf_counter()
+            tick()
+            times.append((time.perf_counter() - t0) * 1e3)
+        dispatches = (
+            runtime.solver_service.stats.last_dispatches_per_tick
+        )
+        stats = runtime.solver_service.stats
+        if fused and not stats.fused_dispatches:
+            raise RuntimeError(
+                "--fused-tick runtime arm never dispatched the fused "
+                "program"
+            )
+    finally:
+        runtime.close()
+    return times, dispatches
+
+
+def run_fusedtick(args, metric: str, note: str) -> None:  # lint: allow-complexity — bench arm: parity pin + interleaved timing + publish, linear
+    """The fused steady-state tick (ISSUE 18 acceptance): the whole
+    fleet batch's forecast -> decide -> cost ladder as ONE compiled
+    program through SolverService.fused_tick vs the chained per-stage
+    wire (one program per stage, numpy host glue between). Parity —
+    fused == chained == numpy mirror, bitwise on every output leaf —
+    is pinned BEFORE any timing; interleaved arms so drift cancels.
+    A second arm replays the shared churn-runtime world with
+    --fused-tick on/off and reads the dispatches-per-tick collapse
+    from the introspection stats."""
+    import jax
+
+    from karpenter_tpu.metrics.registry import GaugeRegistry
+    from karpenter_tpu.ops import fusedtick as FT
+    from karpenter_tpu.solver.service import SolverService
+
+    print(
+        f"backend={jax.default_backend()} devices={jax.devices()}",
+        file=sys.stderr,
+    )
+    n, m = args.fusedtick_rows, args.fusedtick_metrics
+    s, t = args.fusedtick_series, args.fusedtick_samples
+    inputs = _fusedtick_inputs(args.seed, n, m, s, t)
+    svc = SolverService(registry=GaugeRegistry(), backend="xla")
+
+    # parity pin BEFORE timing: fused == chained == numpy, bitwise
+    fused_out = svc.fused_tick(inputs)
+    chained_out = FT.fused_tick_chained(inputs)
+    mirror_out = FT.fused_tick_numpy(inputs)
+    if svc.stats.fused_mirror_serves or svc.stats.fused_chained_serves:
+        emit(
+            metric, None,
+            error="device path unavailable (fallback served during "
+            "parity); the fused-vs-chained comparison needs XLA",
+        )
+        sys.exit(0)
+    as_np = lambda out: jax.tree_util.tree_leaves(  # noqa: E731
+        jax.tree_util.tree_map(np.asarray, out)
+    )
+    for other, name in ((chained_out, "chained"), (mirror_out, "numpy")):
+        for i, (a, b) in enumerate(zip(as_np(fused_out), as_np(other))):
+            if a.tobytes() != b.tobytes():
+                emit(
+                    metric, None,
+                    error=f"fused/{name} mismatch: leaf {i}",
+                )
+                sys.exit(0)
+    print("parity: fused == chained == numpy (bitwise)", file=sys.stderr)
+
+    # kernel arm: interleaved fused vs chained dispatch, both timed at
+    # the ops seam (the parity pin above already exercised — and
+    # compiled — the full service ladder; timing the raw programs keeps
+    # the service-wrapper overhead out of BOTH arms symmetrically)
+    fused_times, chained_times = [], []
+    for _ in range(args.iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(FT.fused_tick_jit(inputs))
+        fused_times.append((time.perf_counter() - t0) * 1e3)
+        t0 = time.perf_counter()
+        FT.fused_tick_chained(inputs)
+        chained_times.append((time.perf_counter() - t0) * 1e3)
+    svc.close()
+
+    fused_p50 = float(np.percentile(fused_times, 50))
+    chained_p50 = float(np.percentile(chained_times, 50))
+    speedup = chained_p50 / max(fused_p50, 1e-9)
+    decisions_per_s = n / max(fused_p50 / 1e3, 1e-9)
+
+    # runtime arm: the shared churn world, fused on vs off
+    warmup = 6
+    chained_ticks, tick_d_chained = _fusedtick_world_ticks(
+        False, warmup, args.fusedtick_ticks
+    )
+    fused_ticks, tick_d_fused = _fusedtick_world_ticks(
+        True, warmup, args.fusedtick_ticks
+    )
+
+    record = {
+        "config": (
+            f"{n} autoscalers x {m} metrics x {s} series x "
+            f"{t} samples"
+        ),
+        "backend": jax.default_backend(),
+        "fused_p50_ms": round(fused_p50, 3),
+        "chained_p50_ms": round(chained_p50, 3),
+        "speedup": round(speedup, 2),
+        "decisions_per_s": int(decisions_per_s),
+        "programs_fused": 1,
+        "programs_chained": FT.programs(inputs),
+        "tick_p50_fused_ms": round(
+            float(np.percentile(fused_ticks, 50)), 3
+        ),
+        "tick_p50_chained_ms": round(
+            float(np.percentile(chained_ticks, 50)), 3
+        ),
+        "tick_dispatches_fused": tick_d_fused,
+        "tick_dispatches_chained": tick_d_chained,
+        "parity": "bitwise",
+    }
+    record_evidence(
+        fusedtick={
+            "fused_ms": [round(x, 4) for x in fused_times],
+            "chained_ms": [round(x, 4) for x in chained_times],
+            "tick_fused_ms": [round(x, 4) for x in fused_ticks],
+            "tick_chained_ms": [round(x, 4) for x in chained_ticks],
+        }
+    )
+    print(
+        f"fused p50 {record['fused_p50_ms']}ms vs chained "
+        f"{record['chained_p50_ms']}ms ({record['speedup']}x); "
+        f"{record['decisions_per_s']} decisions/sec; runtime tick "
+        f"dispatches {record['tick_dispatches_chained']} -> "
+        f"{record['tick_dispatches_fused']}",
+        file=sys.stderr,
+    )
+    if args.publish_baseline:
+        _publish_to_baseline(
+            f"{record['config']} fusedtick ({record['backend']})", record
+        )
+    if args.append_benchmarks:
+        _append_fusedtick_row(args.append_benchmarks, record)
+    emit(
+        f"{metric} ({jax.default_backend()})",
+        record["fused_p50_ms"],
+        note=(
+            f"{note}; " if note else ""
+        ) + f"one fused program {record['fused_p50_ms']}ms vs "
+        f"{record['programs_chained']}-program chained wire "
+        f"{record['chained_p50_ms']}ms ({record['speedup']}x); "
+        f"{record['decisions_per_s']} decisions/sec; runtime "
+        f"dispatches/tick {record['tick_dispatches_chained']} -> "
+        f"{record['tick_dispatches_fused']}; parity pinned bitwise",
+        against_baseline=False,
+    )
+
+
+def _append_fusedtick_row(path: str, record: dict) -> None:
+    marker = "## Fused steady-state tick (make bench-fusedtick)"
+    header = (
+        f"\n{marker}\n\n"
+        "The whole fleet batch's forecast -> decide -> cost ladder as "
+        "ONE compiled program (SolverService.fused_tick, --fused-tick) "
+        "vs the chained per-stage wire — one program per stage with "
+        "numpy host glue between. Fused == chained == numpy mirror "
+        "pinned bitwise before timing; interleaved arms. The runtime "
+        "columns replay the shared churn world and read the "
+        "karpenter_solver_dispatches_per_tick collapse.\n\n"
+        "| Date | Backend | Problem | Fused p50 (ms) | "
+        "Chained p50 (ms) | Speedup | Decisions/sec | "
+        "Dispatches/tick |\n"
+        "|---|---|---|---|---|---|---|---|\n"
+    )
+    date = datetime.date.today().isoformat()
+    row = (
+        f"| {date} | {record['backend']} | {record['config']} "
+        f"| {record['fused_p50_ms']} | {record['chained_p50_ms']} "
+        f"| {record['speedup']}x | {record['decisions_per_s']} "
+        f"| {record['tick_dispatches_chained']} -> "
+        f"{record['tick_dispatches_fused']} |\n"
+    )
+    _append_table_row(path, marker, header, row)
+
+
 def _provenance_tick_times(args):
     """Per-tick wall times with the decision-provenance ledger ENABLED
     vs DISABLED, measured INTERLEAVED over the shared churn world (the
@@ -2738,6 +3099,9 @@ def run(args, metric: str, note: str) -> None:  # lint: allow-complexity — ben
 
     _warm_native_kernel(args)
 
+    if args.fusedtick:
+        run_fusedtick(args, metric, note)
+        return
     if args.simlab:
         run_simlab(args, metric, note)
         return

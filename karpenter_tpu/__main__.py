@@ -144,10 +144,35 @@ def parse_args(argv=None) -> argparse.Namespace:
         choices=("production",),
         default=None,
         help="opinionated flag preset (docs/OPERATIONS.md 'Profiles'): "
-        "'production' turns on --event-driven and --prewarm-compile "
-        "and tightens the --selfslo-objective default to 0.5s (the "
-        "sub-second posture the event-driven plane is built to hold); "
-        "every explicit flag still wins over the preset",
+        "'production' turns on --event-driven, --prewarm-compile and "
+        "--fused-tick and tightens the --selfslo-objective default to "
+        "0.5s (the sub-second posture the event-driven plane is built "
+        "to hold); every explicit flag still wins over the preset",
+    )
+    parser.add_argument(
+        "--fused-tick",
+        action=argparse.BooleanOptionalAction,
+        default=None,
+        help="fuse the steady-state forecast -> decide -> cost chain "
+        "into ONE compiled program per tenant batch "
+        "(docs/solver-service.md 'Fused tick'): no host round-trips "
+        "between stages, 3+ dispatches per tick collapse to 1 "
+        "(karpenter_solver_dispatches_per_tick). Decisions are "
+        "property-pinned bitwise identical to the chained path; off "
+        "(the default outside --profile production) keeps the unfused "
+        "wire byte-identical",
+    )
+    parser.add_argument(
+        "--compile-cache-dir",
+        default=None,
+        metavar="DIR",
+        help="persistent jit compile cache directory (the first-class "
+        "form of the KARPENTER_COMPILE_CACHE env var, matching the "
+        "sidecar's flag): restarted processes reload compiled solver "
+        "programs from disk instead of recompiling — with "
+        "--prewarm-compile the boot warm-up becomes a disk read "
+        "(docs/solver-service.md 'Compile pre-warm'). The flag wins "
+        "over the env var when both are set",
     )
     parser.add_argument(
         "--event-driven",
@@ -529,6 +554,8 @@ def parse_args(argv=None) -> argparse.Namespace:
         args.event_driven = production
     if args.prewarm_compile is None:
         args.prewarm_compile = production
+    if args.fused_tick is None:
+        args.fused_tick = production
     if args.selfslo_objective is None:
         args.selfslo_objective = 0.5 if production else 1.0
     if not 0.0 < args.selfslo_target < 1.0:
@@ -717,8 +744,9 @@ def main(argv=None) -> int:
     # standalone mode compiles the decision kernel (and, without
     # --solver-uri, the bin-pack) in-process: honor the same persistent
     # compile cache the sidecar offers, so control-plane restarts skip
-    # recompiles too (flag on the sidecar, env here — the CLI stays the
-    # reference's flag surface)
+    # recompiles too. --compile-cache-dir is the first-class flag
+    # (matching the sidecar's), with KARPENTER_COMPILE_CACHE as the
+    # env fallback for existing deployments.
     import os as _os
 
     from karpenter_tpu.utils.backend import (
@@ -726,7 +754,10 @@ def main(argv=None) -> int:
         ensure_usable_backend,
     )
 
-    configure_compile_cache(_os.environ.get("KARPENTER_COMPILE_CACHE", ""))
+    configure_compile_cache(
+        args.compile_cache_dir
+        or _os.environ.get("KARPENTER_COMPILE_CACHE", "")
+    )
 
     # the batched HPA decision kernel ALWAYS runs in-process (only the
     # bin-pack is optionally routed to a sidecar), so an unreachable TPU
@@ -783,6 +814,10 @@ def main(argv=None) -> int:
             event_driven=args.event_driven,
             event_debounce_s=args.event_debounce,
             prewarm_compile=args.prewarm_compile,
+            fused_tick=args.fused_tick,
+            # already applied above (before the first compile); carried
+            # on Options so embedded runtimes resolve identically
+            compile_cache_dir=args.compile_cache_dir,
         ),
         store=store,
     )

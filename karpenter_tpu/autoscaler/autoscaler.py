@@ -84,6 +84,7 @@ class BatchAutoscaler:
     def __init__(
         self, metrics_client_factory, store: Store, clock=_time.time,
         decider=None, forecaster=None, cost_engine=None, tenant=None,
+        fused_tick_fn=None,
     ):
         self.metrics = metrics_client_factory
         self.store = store
@@ -103,6 +104,11 @@ class BatchAutoscaler:
         # view. None (or an SLO-free fleet) = cost-blind, bit-identical
         # decisions.
         self.cost_engine = cost_engine
+        # fused steady-state tick (--fused-tick, ops/fusedtick.py): the
+        # SolverService.fused_tick seam running forecast → decide →
+        # cost as ONE device program. None = the chained per-stage
+        # wire (bit-identical outputs; tests/test_fusedtick.py pins it).
+        self.fused_tick_fn = fused_tick_fn
         # Times enter the kernel as f32 seconds relative to this epoch so a
         # long-lived process never loses sub-second precision to f32.
         self.epoch = clock()
@@ -258,36 +264,103 @@ class BatchAutoscaler:
         provenance ledger batch (when enabled) annotated at each stage
         and committed once the final counts are known."""
         ledger_batch = self._begin_ledger(live)
-        # the forecast pass: ingest this tick's observations into
-        # the history store and predict every eligible series in ONE
-        # batched dispatch; {} (no spec, warming up, skill-gated, or
-        # ANY failure) keeps the tick purely reactive
-        forecasts: Dict[tuple, float] = {}
+        if self.fused_tick_fn is not None:
+            outputs = self._evaluate_fused(live, ledger_batch)
+        else:
+            # the forecast pass: ingest this tick's observations into
+            # the history store and predict every eligible series in ONE
+            # batched dispatch; {} (no spec, warming up, skill-gated, or
+            # ANY failure) keeps the tick purely reactive
+            forecasts: Dict[tuple, float] = {}
+            if self.forecaster is not None:
+                forecasts = self.forecaster.forecast_rows(
+                    live, self.clock()
+                )
+            outputs = self._decide(live, forecasts)
+            if ledger_batch is not None:
+                n = len(live)
+                ledger_batch.annotate(
+                    base_desired=np.asarray(outputs.desired)[:n],
+                    final_desired=np.asarray(outputs.desired)[:n],
+                )
+            if self.cost_engine is not None:
+                # the multi-objective pass (docs/cost.md): ONE batched
+                # refine of the whole fleet's desired counts; any
+                # failure returns the base outputs (never-block) and
+                # an SLO-free fleet returns the SAME object untouched
+                outputs = self.cost_engine.adjust(live, outputs)
+                if ledger_batch is not None:
+                    ledger_batch.annotate(
+                        final_desired=np.asarray(
+                            outputs.desired
+                        )[:len(live)],
+                    )
+        if ledger_batch is not None:
+            from karpenter_tpu.observability import default_ledger
+
+            default_ledger().commit(ledger_batch)
+        return outputs
+
+    def _evaluate_fused(self, live: List[_Row], ledger_batch):
+        """The fused steady-state tick (--fused-tick, ops/fusedtick.py):
+        forecast → decide → cost as ONE SolverService.fused_tick call,
+        with each engine's host bookkeeping split into plan/commit
+        halves around the single dispatch. Every seam keeps its own
+        never-block posture — fused_plan/fused_operands return None
+        instead of raising (the stage is then simply absent, exactly
+        the chained path's degradation), and the service ladder covers
+        device-side failures (fused → chained per-stage → numpy) — so
+        the fixed point matches the chained wire bit for bit
+        (tests/test_fusedtick.py)."""
+        from karpenter_tpu.ops import fusedtick as FT
+
+        now = self.clock()
+        plan = None
         if self.forecaster is not None:
-            forecasts = self.forecaster.forecast_rows(live, self.clock())
-        outputs = self._decide(live, forecasts)
+            plan = self.forecaster.fused_plan(live, now)
+        inputs = self._decision_inputs(live, None)
+        kw = {}
+        if plan is not None:
+            _eligible, finputs, row_map, col_map, need, blend = plan
+            kw.update(
+                forecast=finputs,
+                series_row=row_map,
+                series_col=col_map,
+                series_need=need,
+                series_blend=blend,
+            )
+        cost_plan = None
+        if self.cost_engine is not None:
+            cost_plan = self.cost_engine.fused_operands(
+                live,
+                int(inputs.spec_replicas.shape[0]),
+                int(inputs.metric_value.shape[1]),
+            )
+            if cost_plan is not None:
+                kw.update(cost_plan[1])
+        with solver_trace("autoscaler.fused_tick"):
+            out = self.fused_tick_fn(
+                FT.FusedTickInputs(decision=inputs, **kw)
+            )
+        if plan is not None and out.forecast is not None:
+            self.forecaster.fused_commit(plan[0], out.forecast, live, now)
+        outputs = out.decision
         if ledger_batch is not None:
             n = len(live)
             ledger_batch.annotate(
                 base_desired=np.asarray(outputs.desired)[:n],
                 final_desired=np.asarray(outputs.desired)[:n],
             )
-        if self.cost_engine is not None:
-            # the multi-objective pass (docs/cost.md): ONE batched
-            # refine of the whole fleet's desired counts; any
-            # failure returns the base outputs (never-block) and
-            # an SLO-free fleet returns the SAME object untouched
-            outputs = self.cost_engine.adjust(live, outputs)
+        if cost_plan is not None and out.cost is not None:
+            outputs = self.cost_engine.fused_commit(
+                live, cost_plan[0], outputs, out.cost
+            )
             if ledger_batch is not None:
                 ledger_batch.annotate(
                     final_desired=np.asarray(
                         outputs.desired
                     )[:len(live)],
                 )
-        if ledger_batch is not None:
-            from karpenter_tpu.observability import default_ledger
-
-            default_ledger().commit(ledger_batch)
         return outputs
 
     def _begin_ledger(self, live: List[_Row]):
@@ -327,6 +400,13 @@ class BatchAutoscaler:
     def _decide(
         self, rows: List[_Row], forecasts: Optional[Dict[tuple, float]] = None
     ) -> D.DecisionOutputs:
+        inputs = self._decision_inputs(rows, forecasts)
+        with solver_trace("autoscaler.decide"):
+            return self.decider(inputs)
+
+    def _decision_inputs(
+        self, rows: List[_Row], forecasts: Optional[Dict[tuple, float]] = None
+    ) -> D.DecisionInputs:
         n = D.pad_to(len(rows))
         m = max(1, max(len(r.values) for r in rows))
 
@@ -461,8 +541,7 @@ class BatchAutoscaler:
             forecast_value=forecast_value,
             forecast_valid=forecast_valid,
         )
-        with solver_trace("autoscaler.decide"):
-            return self.decider(inputs)
+        return inputs
 
     def _mark_forecast_condition(self, ha, mgr) -> None:
         """Predictive posture on status (docs/forecasting.md): True

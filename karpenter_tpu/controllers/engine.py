@@ -741,6 +741,11 @@ class Manager:
                 for controller in self._controllers:
                     self._reconcile_controller(controller, now)
         if self._solver_service is not None:
+            # per-tick dispatch accounting BEFORE the gauges publish:
+            # note_tick closes this tick's window (dispatches since the
+            # last tick -> karpenter_solver_dispatches_per_tick), the
+            # number the fused tick collapses from 3+ to 1
+            self._solver_service.note_tick()
             self._solver_service.publish_gauges()
         if self._tick_hook is not None:
             self._tick_hook()

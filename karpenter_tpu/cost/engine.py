@@ -160,6 +160,123 @@ class CostEngine:
                     self._c_blind.inc(name, ns)
             return outputs
 
+    def fused_operands(self, rows: List, n: int, m: int):
+        """Host half of the fused tick's cost stage (ops/fusedtick.py):
+        the _build_inputs surface SPLIT at the demand seam. Spec bounds
+        (ha_min/ha_max), pricing, and SLO targets assemble as before,
+        but the movement-bound clamp and the _demand() selection move
+        IN-DEVICE: the kernel clamps against the decide stage's fresh
+        up_ceiling/down_floor and overlays the in-device distribution
+        refresh over the PRIOR distribution read here — reproducing the
+        chained path's post-refresh read bit for bit. distribution() is
+        consulted under exactly _demand()'s gates (per-replica capacity
+        declared AND observed finite), so its expiry side effects match
+        the chained tick too. Returns (slo_rows, operands dict), or
+        None when no row opts in (adjust()'s retire semantics apply) or
+        the assembly fails (the cost-blind posture, already stamped)."""
+        slo_rows = [
+            i for i, row in enumerate(rows)
+            if getattr(row.ha.spec.behavior, "slo", None) is not None
+            and not getattr(row, "custom", False)
+        ]
+        if not slo_rows:
+            for row in rows:
+                self._retire(*_ha_key(row.ha))
+            return None
+        try:
+            return slo_rows, self._fused_operand_arrays(rows, slo_rows, n, m)
+        except Exception as error:  # noqa: BLE001 — never-block contract
+            logger().warning(
+                "cost operand assembly failed (%s: %s); this tick "
+                "scales cost-blind", type(error).__name__, error,
+            )
+            self._annotate_blind(slo_rows)
+            for i in slo_rows:
+                ns, name = _ha_key(rows[i].ha)
+                if self._c_blind is not None:
+                    self._c_blind.inc(name, ns)
+            return None
+
+    def _fused_operand_arrays(
+        self, rows: List, slo_rows: List[int], n: int, m: int
+    ) -> dict:
+        ha_min = np.zeros(n, np.int32)
+        ha_max = np.zeros(n, np.int32)
+        unit_cost = np.zeros(n, np.float32)
+        slo_weight = np.zeros(n, np.float32)
+        max_hourly = np.zeros(n, np.float32)
+        slo_valid = np.zeros(n, bool)
+        slo_target = np.ones((n, m), np.float32)
+        observed_arr = np.zeros((n, m), np.float32)
+        demand_base_valid = np.zeros((n, m), bool)
+        prior_point = np.zeros((n, m), np.float32)
+        prior_sigma2 = np.zeros((n, m), np.float32)
+        prior_valid = np.zeros((n, m), bool)
+        for i in slo_rows:
+            row = rows[i]
+            slo = row.ha.spec.behavior.slo
+            ns, name = _ha_key(row.ha)
+            ha_min[i] = row.ha.spec.min_replicas
+            ha_max[i] = row.ha.spec.max_replicas
+            unit_cost[i] = self._unit_cost(row.ha)
+            slo_weight[i] = slo.violation_cost_weight
+            max_hourly[i] = slo.max_hourly_cost
+            slo_valid[i] = True
+            for j, (_spec, target, observed) in enumerate(row.observed):
+                per_replica = slo.target_for(j)
+                if not per_replica:
+                    per_replica = target.target_value()
+                if not per_replica or per_replica <= 0:
+                    continue  # no capacity notion: metric carries no risk
+                slo_target[i, j] = per_replica
+                observed_arr[i, j] = observed
+                if not math.isfinite(observed):
+                    continue  # _demand()'s early return: no dist read
+                demand_base_valid[i, j] = True
+                if self.forecaster is None:
+                    continue
+                dist = self.forecaster.distribution(ns, name, j)
+                if dist is not None:
+                    prior_point[i, j] = dist[0]
+                    prior_sigma2[i, j] = dist[1]
+                    prior_valid[i, j] = True
+        return {
+            "ha_min": ha_min,
+            "ha_max": ha_max,
+            "unit_cost": unit_cost,
+            "slo_weight": slo_weight,
+            "max_hourly_cost": max_hourly,
+            "slo_valid": slo_valid,
+            "slo_target": slo_target,
+            "observed": observed_arr,
+            "demand_base_valid": demand_base_valid,
+            "prior_point": prior_point,
+            "prior_sigma2": prior_sigma2,
+            "prior_valid": prior_valid,
+        }
+
+    def fused_commit(
+        self, rows: List, slo_rows: List[int],
+        outputs: D.DecisionOutputs, out: CK.CostOutputs,
+    ) -> D.DecisionOutputs:
+        """Bookkeeping for a fused tick's cost stage: exactly adjust()'s
+        post-dispatch half — ledger provenance, gauge/contribution
+        refresh, the desired overlay — given the CostOutputs the fused
+        program returned. Same never-block posture as adjust()."""
+        try:
+            return self._apply(rows, slo_rows, outputs, out)
+        except Exception as error:  # noqa: BLE001 — never-block contract
+            logger().warning(
+                "cost refinement failed (%s: %s); this tick scales "
+                "cost-blind", type(error).__name__, error,
+            )
+            self._annotate_blind(slo_rows)
+            for i in slo_rows:
+                ns, name = _ha_key(rows[i].ha)
+                if self._c_blind is not None:
+                    self._c_blind.inc(name, ns)
+            return outputs
+
     @staticmethod
     def _annotate_blind(slo_rows: List[int]) -> None:
         """Provenance: a cost-blind tick is itself an answer to 'why is

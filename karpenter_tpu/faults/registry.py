@@ -35,6 +35,11 @@ Points instrumented across the stack (docs/resilience.md):
                       refinement (SolverService.cost) — failures make
                       the tick COST-BLIND, not mirror-served
                       (docs/cost.md degradation contract)
+  fused.tick          the fused steady-state megakernel
+                      (SolverService.fused_tick) — failures fall back
+                      to the chained per-stage path, then numpy, and
+                      feed the FSM (docs/solver-service.md "Fused
+                      tick")
   encoder.encode      snapshot -> solver-operand encode
   cloud.get_replicas  provider replica observation
   cloud.set_replicas  provider actuation

@@ -261,19 +261,66 @@ class FleetForecaster:
                 return {}
             return self._predict(rows, eligible, now)
         except Exception as error:  # noqa: BLE001 — never-block contract
-            logger().warning(
-                "forecast pass failed (%s: %s); this tick scales "
-                "reactive-only", type(error).__name__, error,
+            self._mark_unavailable(rows, error)
+            return {}
+
+    def _mark_unavailable(self, rows, error) -> None:
+        """The never-block failure posture: log once, stamp every
+        forecast-opted row's verdict REASON_UNAVAILABLE — this tick
+        scales reactive-only."""
+        logger().warning(
+            "forecast pass failed (%s: %s); this tick scales "
+            "reactive-only", type(error).__name__, error,
+        )
+        for row in rows:
+            if getattr(row.ha.spec.behavior, "forecast", None) is None:
+                continue
+            ns, name = _ha_key(row.ha)
+            self._verdicts[(ns, name)] = (
+                False, REASON_UNAVAILABLE, f"forecast failed: {error}"
             )
-            for row in rows:
-                if getattr(row.ha.spec.behavior, "forecast", None) is None:
-                    continue
-                ns, name = _ha_key(row.ha)
-                self._verdicts[(ns, name)] = (
-                    False, REASON_UNAVAILABLE, f"forecast failed: {error}"
-                )
-                if self._c_disabled is not None:
-                    self._c_disabled.inc(name, ns)
+            if self._c_disabled is not None:
+                self._c_disabled.inc(name, ns)
+
+    def fused_plan(self, rows, now: float):
+        """Host half 1 of the fused tick's forecast stage
+        (ops/fusedtick.py): ingest + operand assembly with NO dispatch
+        — the fused program runs the fit in-device and scatters the
+        points straight into the decide operands. Returns
+        (eligible, ForecastInputs, row/col/need/blend maps) or None
+        (nothing eligible, or the forecast_rows failure posture)."""
+        try:
+            eligible = self._ingest(rows, now)
+            if not eligible:
+                return None
+            inputs = self._build_inputs(eligible, now)
+        except Exception as error:  # noqa: BLE001 — never-block contract
+            self._mark_unavailable(rows, error)
+            return None
+        k = len(eligible)
+        row_map = np.zeros(k, np.int32)
+        col_map = np.zeros(k, np.int32)
+        need = np.zeros(k, np.int32)
+        blend = np.zeros(k, bool)
+        for idx, (i, j, _key, fspec, blend_flag) in enumerate(eligible):
+            row_map[idx] = i
+            col_map[idx] = j
+            # the same sample floor _predict gates on host-side — the
+            # kernel compares it against n_valid in-device
+            need[idx] = max(int(fspec.min_samples), 2)
+            blend[idx] = blend_flag
+        return eligible, inputs, row_map, col_map, need, blend
+
+    def fused_commit(self, eligible, out, rows, now: float):
+        """Host half 2: the bookkeeping _predict runs after its
+        dispatch — distribution refresh, pending scoring queue, skill
+        gauges, ledger provenance — given the ForecastOutputs the fused
+        program returned. The blend itself already happened in-device;
+        the returned dict is the same surface forecast_rows exposes."""
+        try:
+            return self._commit(rows, eligible, out, now)
+        except Exception as error:  # noqa: BLE001 — never-block contract
+            self._mark_unavailable(rows, error)
             return {}
 
     def _ingest(self, rows, now: float) -> List[tuple]:
@@ -389,17 +436,21 @@ class FleetForecaster:
         if self.journal is not None:
             self.journal.set(("skill",) + ha_key, self._skill[ha_key])
 
-    def _predict(  # lint: allow-complexity — one guard per per-series concern (gating, distribution, scoring, gauges, provenance)
+    def _predict(
         self, rows, eligible: List[tuple], now: float
+    ) -> Dict[tuple, float]:
+        inputs = self._build_inputs(eligible, now)
+        out = self.forecast_fn(inputs)
+        return self._commit(rows, eligible, out, now)
+
+    def _commit(  # lint: allow-complexity — one guard per per-series concern (gating, distribution, scoring, gauges, provenance)
+        self, rows, eligible: List[tuple], out, now: float
     ) -> Dict[tuple, float]:
         from karpenter_tpu.observability import default_ledger
 
-        inputs = self._build_inputs(eligible, now)
-        out = self.forecast_fn(inputs)
         points = np.asarray(out.point, np.float32)
         sigma2 = np.asarray(out.sigma2, np.float32)
         n_valid = np.asarray(out.n_valid)
-        step_s = np.asarray(inputs.step_s)
         forecasts: Dict[tuple, float] = {}
         # provenance slice (observability/provenance.py): the forecast
         # stage annotates ITS columns of the tick's ledger batch — the

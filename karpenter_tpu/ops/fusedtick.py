@@ -1,0 +1,385 @@
+"""Fused steady-state tick: forecast → decide → cost in ONE device program.
+
+The steady-state reconcile chain used to issue a separate compiled
+program per stage — forecast the eligible series, round-trip the points
+to the host, scatter them into the decide operands, dispatch decide,
+round-trip again, assemble the cost/SLO operands, dispatch the
+8-candidate ladder. Three host↔device transfers and 3+ dispatch spans
+per tick, and PR 15's XLA cost attribution shows the hot path is
+dominated by exactly that overhead, not flops.
+
+fused_tick() runs the whole chain as one program:
+
+    forecast (Holt-Winters / robust-linear, masked history)
+        │  point/sigma2/n_valid per series — stays on device
+        ▼  trash-row scatter into the fleet's [N, M] metric grid
+    decide (max(reactive, predicted) blend, stabilization, rate limits)
+        │  desired + movement bounds (up_ceiling / down_floor)
+        ▼
+    cost ladder (8 candidates around desired, budget cap, SLO risk)
+
+Stage seams reproduce the unfused wire bit for bit:
+
+- The forecast→decide seam scatters `point` into `forecast_value` /
+  `forecast_valid` exactly where the host loop would have filled the
+  dict: series with `n_valid >= need` AND an active (skill-gated)
+  blend. Pad series are routed to a trash row N of an (N+1, M) grid
+  that is sliced off, so padding can never clobber a live cell.
+- The decide→cost seam applies the engine's movement-bound clamp
+  (`max(ha_min, min(down_floor, ha_max))` / the mirror for max) and
+  overlays the FRESH in-device distribution (gate: `n_valid >= need`,
+  shadow series included — risk gates on its own spec, not the blend
+  verdict) over the host-read PRIOR distribution, which is what the
+  chained path's post-refresh `distribution()` read would return.
+- Absent stages are absent operands: `forecast=None` and
+  `slo_valid=None` drop the stage from the traced program, and the
+  masked rows of present stages (blend-gate all-False, slo_valid
+  False) pass through byte-identical to the unfused wire.
+
+Three entry points, one contract (property-pinned bitwise equal):
+
+    fused_tick / fused_tick_jit   one program, zero host round-trips
+    fused_tick_chained            stage-per-program with host glue —
+                                  the fallback rung and the bench's
+                                  comparison arm
+    fused_tick_numpy              pure-host floor (never-block ladder)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..forecast import models as M
+from . import cost as C
+from . import decision as D
+
+_I32_MAX = np.int32(np.iinfo(np.int32).max)
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class FusedTickInputs:
+    """Operands for the whole steady-state chain, host-assembled once.
+
+    `decision` is the standard decide() view of the fleet (N rows,
+    M metric columns, forecast operands None — the kernel fills them).
+    The forecast group carries S series plus the scatter map into the
+    [N, M] grid; the cost group carries the engine's _build_inputs
+    surface SPLIT at the demand seam: `observed` + the PRIOR
+    distribution as read on the host pre-dispatch, with the fresh
+    distribution overlaid in-device. Either group may be None — the
+    stage is then absent from the program.
+    """
+
+    decision: D.DecisionInputs
+    # -- forecast stage (None = absent) --
+    forecast: Optional[M.ForecastInputs] = None
+    series_row: Optional[jax.Array] = None  # i32[S] fleet row (N = trash)
+    series_col: Optional[jax.Array] = None  # i32[S] metric column
+    series_need: Optional[jax.Array] = None  # i32[S] min samples for the fit
+    series_blend: Optional[jax.Array] = None  # bool[S] skill gate verdict
+    # -- cost stage (None = absent; slo_valid is the presence sentinel) --
+    ha_min: Optional[jax.Array] = None  # i32[N] spec minReplicas
+    ha_max: Optional[jax.Array] = None  # i32[N] spec maxReplicas
+    unit_cost: Optional[jax.Array] = None  # f32[N]
+    slo_weight: Optional[jax.Array] = None  # f32[N]
+    max_hourly_cost: Optional[jax.Array] = None  # f32[N]
+    slo_valid: Optional[jax.Array] = None  # bool[N]
+    slo_target: Optional[jax.Array] = None  # f32[N, M] per-replica capacity
+    observed: Optional[jax.Array] = None  # f32[N, M] reactive demand
+    demand_base_valid: Optional[jax.Array] = None  # bool[N, M]
+    prior_point: Optional[jax.Array] = None  # f32[N, M] host dist read
+    prior_sigma2: Optional[jax.Array] = None  # f32[N, M]
+    prior_valid: Optional[jax.Array] = None  # bool[N, M]
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class FusedTickOutputs:
+    decision: D.DecisionOutputs
+    forecast: Optional[M.ForecastOutputs] = None
+    cost: Optional[C.CostOutputs] = None
+
+
+def programs(inputs: FusedTickInputs) -> int:
+    """Device programs the CHAINED path needs for these operands (the
+    fused path always needs exactly one)."""
+    return 1 + int(inputs.forecast is not None) + int(
+        inputs.slo_valid is not None
+    )
+
+
+# -- device kernel ------------------------------------------------------------
+
+
+def _scatter(n, m, rows, cols, vals):
+    """Scatter S series values into an (N+1, M) grid and slice the
+    trash row off: pad series carry row == N and land there, so no
+    bounds juggling is needed inside the traced program."""
+    grid = jnp.zeros((n + 1, m), vals.dtype)
+    return grid.at[rows, cols].set(vals)[:n]
+
+
+def _demand_overlay(inputs, dout, dist):
+    """The decide→cost seam: movement-bound clamps plus the engine's
+    _demand() selection, with the fresh in-device distribution
+    overlaid on the host-read prior."""
+    prior_point = inputs.prior_point
+    prior_sigma2 = inputs.prior_sigma2
+    have = inputs.prior_valid
+    if dist is not None:
+        dist_point, dist_sigma2, dist_ok = dist
+        prior_point = jnp.where(dist_ok, dist_point, prior_point)
+        prior_sigma2 = jnp.where(dist_ok, dist_sigma2, prior_sigma2)
+        have = dist_ok | have
+    observed = inputs.observed
+    mu = jnp.where(
+        have & jnp.isfinite(prior_point),
+        jnp.maximum(observed, prior_point),
+        observed,
+    )
+    sigma = jnp.where(
+        have & jnp.isfinite(prior_sigma2) & (prior_sigma2 > 0),
+        jnp.sqrt(prior_sigma2),
+        jnp.float32(0.0),
+    )
+    valid = inputs.demand_base_valid
+    mu = jnp.where(valid, mu, jnp.float32(0.0)).astype(jnp.float32)
+    sigma = jnp.where(valid, sigma, jnp.float32(0.0)).astype(jnp.float32)
+    slo = inputs.slo_valid
+    min_eff = jnp.where(
+        slo,
+        jnp.maximum(inputs.ha_min, jnp.minimum(dout.down_floor, inputs.ha_max)),
+        0,
+    ).astype(jnp.int32)
+    max_eff = jnp.where(
+        slo,
+        jnp.minimum(inputs.ha_max, jnp.maximum(dout.up_ceiling, inputs.ha_min)),
+        0,
+    ).astype(jnp.int32)
+    return C.CostInputs(
+        base_desired=dout.desired,
+        min_replicas=min_eff,
+        max_replicas=max_eff,
+        unit_cost=inputs.unit_cost,
+        slo_weight=inputs.slo_weight,
+        max_hourly_cost=inputs.max_hourly_cost,
+        slo_valid=slo,
+        slo_target=inputs.slo_target,
+        demand_mu=mu,
+        demand_sigma=sigma,
+        demand_valid=valid,
+    )
+
+
+def fused_tick(inputs: FusedTickInputs) -> FusedTickOutputs:
+    """The megakernel: forecast → decide → cost with every seam on
+    device. Traceable under jit; stage presence is pytree structure,
+    so each operand shape class compiles once."""
+    dec = inputs.decision
+    n = dec.spec_replicas.shape[0]
+    m = dec.metric_value.shape[1]
+    fout = None
+    dist = None
+    if inputs.forecast is not None:
+        fout = M.forecast(inputs.forecast)
+        rows = inputs.series_row
+        cols = inputs.series_col
+        dist_gate = fout.n_valid >= inputs.series_need
+        blend_gate = inputs.series_blend & dist_gate
+        zero = jnp.float32(0.0)
+        fv = _scatter(
+            n, m, rows, cols, jnp.where(blend_gate, fout.point, zero)
+        )
+        fvalid = _scatter(n, m, rows, cols, blend_gate)
+        dist = (
+            _scatter(
+                n, m, rows, cols, jnp.where(dist_gate, fout.point, zero)
+            ),
+            _scatter(
+                n, m, rows, cols, jnp.where(dist_gate, fout.sigma2, zero)
+            ),
+            _scatter(n, m, rows, cols, dist_gate),
+        )
+        dec = replace(dec, forecast_value=fv, forecast_valid=fvalid)
+    dout = D.decide(dec)
+    cout = None
+    if inputs.slo_valid is not None:
+        cout = C.cost_decide(_demand_overlay(inputs, dout, dist))
+    return FusedTickOutputs(decision=dout, forecast=fout, cost=cout)
+
+
+fused_tick_jit = jax.jit(fused_tick)
+
+
+# -- chained path (fallback rung + bench comparison arm) ----------------------
+# Same operands, one program PER STAGE with numpy host glue between —
+# the pre-fusion wire. The glue mirrors the kernel seams exactly
+# (boolean-index writes land on zero-initialised cells, identical to
+# the kernel's gate-masked scatter), so chained == fused bitwise.
+
+
+def _np_scatter(inputs, fout, n: int, m: int):
+    rows = np.asarray(inputs.series_row, np.int64)
+    cols = np.asarray(inputs.series_col, np.int64)
+    point = np.asarray(fout.point, np.float32)
+    sigma2 = np.asarray(fout.sigma2, np.float32)
+    live = rows < n
+    dist_gate = (
+        np.asarray(fout.n_valid, np.int32)
+        >= np.asarray(inputs.series_need, np.int32)
+    ) & live
+    blend_gate = np.asarray(inputs.series_blend, bool) & dist_gate
+    fv = np.zeros((n, m), np.float32)
+    fvalid = np.zeros((n, m), bool)
+    fv[rows[blend_gate], cols[blend_gate]] = point[blend_gate]
+    fvalid[rows[blend_gate], cols[blend_gate]] = True
+    dist_point = np.zeros((n, m), np.float32)
+    dist_sigma2 = np.zeros((n, m), np.float32)
+    dist_ok = np.zeros((n, m), bool)
+    dist_point[rows[dist_gate], cols[dist_gate]] = point[dist_gate]
+    dist_sigma2[rows[dist_gate], cols[dist_gate]] = sigma2[dist_gate]
+    dist_ok[rows[dist_gate], cols[dist_gate]] = True
+    return fv, fvalid, (dist_point, dist_sigma2, dist_ok)
+
+
+def _np_demand_overlay(inputs, dout, dist) -> C.CostInputs:
+    prior_point = np.asarray(inputs.prior_point, np.float32)
+    prior_sigma2 = np.asarray(inputs.prior_sigma2, np.float32)
+    have = np.asarray(inputs.prior_valid, bool)
+    if dist is not None:
+        dist_point, dist_sigma2, dist_ok = dist
+        prior_point = np.where(dist_ok, dist_point, prior_point)
+        prior_sigma2 = np.where(dist_ok, dist_sigma2, prior_sigma2)
+        have = dist_ok | have
+    observed = np.asarray(inputs.observed, np.float32)
+    with np.errstate(invalid="ignore"):
+        mu = np.where(
+            have & np.isfinite(prior_point),
+            np.maximum(observed, prior_point),
+            observed,
+        )
+        sigma = np.where(
+            have & np.isfinite(prior_sigma2) & (prior_sigma2 > 0),
+            np.sqrt(prior_sigma2),
+            np.float32(0.0),
+        )
+    valid = np.asarray(inputs.demand_base_valid, bool)
+    mu = np.where(valid, mu, np.float32(0.0)).astype(np.float32)
+    sigma = np.where(valid, sigma, np.float32(0.0)).astype(np.float32)
+    slo = np.asarray(inputs.slo_valid, bool)
+    ha_min = np.asarray(inputs.ha_min, np.int32)
+    ha_max = np.asarray(inputs.ha_max, np.int32)
+    down_floor = np.asarray(dout.down_floor, np.int32)
+    up_ceiling = np.asarray(dout.up_ceiling, np.int32)
+    min_eff = np.where(
+        slo, np.maximum(ha_min, np.minimum(down_floor, ha_max)), 0
+    ).astype(np.int32)
+    max_eff = np.where(
+        slo, np.minimum(ha_max, np.maximum(up_ceiling, ha_min)), 0
+    ).astype(np.int32)
+    return C.CostInputs(
+        base_desired=np.asarray(dout.desired, np.int32),
+        min_replicas=min_eff,
+        max_replicas=max_eff,
+        unit_cost=np.asarray(inputs.unit_cost, np.float32),
+        slo_weight=np.asarray(inputs.slo_weight, np.float32),
+        max_hourly_cost=np.asarray(inputs.max_hourly_cost, np.float32),
+        slo_valid=slo,
+        slo_target=np.asarray(inputs.slo_target, np.float32),
+        demand_mu=mu,
+        demand_sigma=sigma,
+        demand_valid=valid,
+    )
+
+
+def _to_host(out):
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), out)
+
+
+def fused_tick_chained(
+    inputs: FusedTickInputs,
+    forecast_fn: Optional[Callable] = None,
+    decide_fn: Optional[Callable] = None,
+    cost_fn: Optional[Callable] = None,
+) -> FusedTickOutputs:
+    """One program per stage, host round-trip between each — the
+    pre-fusion wire and the never-block fallback rung. np.asarray on
+    every stage output forces the transfer (and the device sync)."""
+    forecast_fn = forecast_fn or M.forecast_jit
+    decide_fn = decide_fn or D.decide_jit
+    cost_fn = cost_fn or C.cost_jit
+    dec = inputs.decision
+    n = int(np.asarray(dec.spec_replicas).shape[0])
+    m = int(np.asarray(dec.metric_value).shape[1])
+    fout = None
+    dist = None
+    if inputs.forecast is not None:
+        fout = _to_host(forecast_fn(inputs.forecast))
+        fv, fvalid, dist = _np_scatter(inputs, fout, n, m)
+        dec = replace(dec, forecast_value=fv, forecast_valid=fvalid)
+    dout = _to_host(decide_fn(dec))
+    cout = None
+    if inputs.slo_valid is not None:
+        cout = _to_host(cost_fn(_np_demand_overlay(inputs, dout, dist)))
+    return FusedTickOutputs(decision=dout, forecast=fout, cost=cout)
+
+
+def fused_tick_numpy(inputs: FusedTickInputs) -> FusedTickOutputs:
+    """Pure-host floor of the never-block ladder: the three stage
+    mirrors joined by the same glue. Bitwise equal to fused_tick."""
+    return fused_tick_chained(
+        inputs, M.forecast_numpy, D.decide_numpy, C.cost_numpy
+    )
+
+
+# -- padding ------------------------------------------------------------------
+
+
+def pad_series(inputs: FusedTickInputs, s_pad: int) -> FusedTickInputs:
+    """Pad the forecast group to `s_pad` series so fused compile keys
+    bucket on S like the standalone forecast family. Pad series carry
+    an impossible sample requirement, a False blend gate, and the
+    trash row N — they cannot touch a live cell on any path."""
+    if inputs.forecast is None:
+        return inputs
+    s = int(np.asarray(inputs.forecast.values).shape[0])
+    if s == s_pad:
+        return inputs
+    pad = s_pad - s
+    if pad < 0:
+        raise ValueError(f"cannot shrink {s} series to {s_pad}")
+    n = int(np.asarray(inputs.decision.spec_replicas).shape[0])
+    return replace(
+        inputs,
+        forecast=M.concat_forecast_inputs([inputs.forecast], s_pad),
+        series_row=np.concatenate(
+            [
+                np.asarray(inputs.series_row, np.int32),
+                np.full(pad, n, np.int32),
+            ]
+        ),
+        series_col=np.concatenate(
+            [
+                np.asarray(inputs.series_col, np.int32),
+                np.zeros(pad, np.int32),
+            ]
+        ),
+        series_need=np.concatenate(
+            [
+                np.asarray(inputs.series_need, np.int32),
+                np.full(pad, _I32_MAX, np.int32),
+            ]
+        ),
+        series_blend=np.concatenate(
+            [
+                np.asarray(inputs.series_blend, bool),
+                np.zeros(pad, bool),
+            ]
+        ),
+    )

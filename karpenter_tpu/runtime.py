@@ -190,6 +190,22 @@ class Options:
     # hits; the persistent cache (KARPENTER_COMPILE_CACHE) turns the
     # remaining cost into a disk read.
     prewarm_compile: bool = False
+    # fused steady-state tick (ops/fusedtick.py, docs/solver-service.md
+    # "Fused tick"): route the batched autoscaler's forecast -> decide
+    # -> cost chain through ONE compiled program per tenant batch
+    # (SolverService.fused_tick) instead of 3+ per-stage dispatches
+    # with host round-trips between them. Default OFF — the unfused
+    # wire stays byte-identical; --fused-tick (or --profile
+    # production) turns it on. Outputs are property-pinned bitwise
+    # equal to the chained path, so this is latency-only.
+    fused_tick: bool = False
+    # persistent compile cache directory (--compile-cache-dir): the
+    # first-class promotion of the KARPENTER_COMPILE_CACHE env var,
+    # matching the sidecar's flag of the same name. Set, jit compiles
+    # taking >=1s persist to disk and a restarted process reloads them
+    # instead of recompiling (utils/backend.configure_compile_cache).
+    # None = env var only (the pre-flag wire).
+    compile_cache_dir: Optional[str] = None
 
 
 class KarpenterRuntime:
@@ -209,6 +225,16 @@ class KarpenterRuntime:
         self._owns_store = store is None
         self.store = store if store is not None else self._open_store(options)
         self.registry = registry if registry is not None else GaugeRegistry()
+
+        # persistent compile cache, armed BEFORE anything can compile
+        # (the cache singleton latches at first compile): the embedded
+        # Options path mirrors __main__'s flag/env resolution so a
+        # runtime built in-process (tests, library use) gets the same
+        # restart-warm compiles as the CLI.
+        if options.compile_cache_dir:
+            from karpenter_tpu.utils.backend import configure_compile_cache
+
+            configure_compile_cache(options.compile_cache_dir)
 
         self._bind_observability(options)
 
@@ -324,6 +350,14 @@ class KarpenterRuntime:
             forecaster=self.forecaster,
             cost_engine=self.cost_engine,
             tenant=options.tenant_id,
+            # --fused-tick: the forecast -> decide -> cost chain rides
+            # ONE compiled program per batch through the service's
+            # fused seam (same FSM/ledger/never-block ladder). None
+            # keeps the chained per-stage wire byte-identical.
+            fused_tick_fn=(
+                self.solver_service.fused_tick
+                if options.fused_tick else None
+            ),
         )
         # consolidation engine (opt-in): plans batched node drains
         # through the shared solve service and actuates them through the
@@ -419,7 +453,12 @@ class KarpenterRuntime:
         through the fully-wired service, so it must not race recovery
         restore or observe a half-built runtime."""
         if options.prewarm_compile:
-            self.solver_service.prewarm()
+            families = ("solve", "decide")
+            if options.fused_tick:
+                # the fused megakernel joins the warm list only when
+                # the tick will actually dispatch it
+                families += ("fused",)
+            self.solver_service.prewarm(families)
 
     def _build_tenancy(self, options: Options) -> None:
         """Multi-tenant control plane (docs/multitenancy.md): with a

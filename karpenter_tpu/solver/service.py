@@ -146,6 +146,18 @@ RESIDENT_REBUILDS = "resident_rebuilds_total"
 # boot-time compile pre-warm (docs/solver-service.md "Compile pre-warm")
 PREWARM_COMPILES = "prewarm_compiles_total"
 PREWARM_MS = "prewarm_ms"
+# device programs the last reconcile tick paid (docs/solver-service.md
+# "Fused tick"): 3+ on the chained steady-state path, 1 once
+# --fused-tick engages — the production observable behind the bench's
+# dispatch-count claim
+DISPATCHES_PER_TICK = "dispatches_per_tick"
+
+# Fused-family compile keys the PROCESS has already paid for: the fused
+# program rides the module-level fused_tick_jit (process-global jit
+# cache, disk-global under --compile-cache-dir), so freshness — and the
+# compile-ledger rows it drives — is a process property, not a
+# per-service one (_count_fused_compile). reset_caches() re-arms.
+_FUSED_COMPILE_SEEN: set = set()
 
 # Sharded dispatch (docs/solver-service.md "Sharded dispatch"): a request
 # whose pods x groups constraint matrix reaches this many cells routes
@@ -250,6 +262,13 @@ class SolverStatistics:
     sim_calls: int = 0  # sim_step() + sim_rollout() entries
     sim_dispatches: int = 0  # sim device dispatches (1 per batched call)
     sim_mirror_serves: int = 0  # sim calls served by the numpy mirror
+    # fused steady-state tick (ops/fusedtick.py, docs/solver-service.md
+    # "Fused tick")
+    fused_calls: int = 0  # fused_tick() entries
+    fused_dispatches: int = 0  # ticks answered by the ONE fused program
+    fused_chained_serves: int = 0  # ticks served by the per-stage rung
+    fused_mirror_serves: int = 0  # ticks served by the numpy floor
+    last_dispatches_per_tick: int = 0  # note_tick() delta (the gauge)
     # sharded dispatch (docs/solver-service.md "Sharded dispatch")
     shard_dispatches: int = 0  # batches answered by the mesh-sharded program
     shard_requests: int = 0  # requests routed onto the mesh at submit
@@ -475,6 +494,9 @@ class SolverService:
         # dispatched live only here and must be drained too
         self._current_batch: List[_Request] = []
         self._tls = threading.local()
+        # per-tick dispatch accounting (note_tick): the gauge shows the
+        # delta of stats.dispatches between manager ticks
+        self._tick_dispatch_mark = 0
         self._register_metrics()
 
     # -- metrics ----------------------------------------------------------
@@ -529,6 +551,9 @@ class SolverService:
         # persistent compile cache (KARPENTER_COMPILE_CACHE) served it
         self._c_prewarm = reg(SUBSYSTEM, PREWARM_COMPILES, kind="counter")
         self._g_prewarm_ms = reg(SUBSYSTEM, PREWARM_MS)
+        # device programs per reconcile tick (note_tick): the fused-tick
+        # 3+ → 1 program-count claim as a production observable
+        self._g_dispatches_tick = reg(SUBSYSTEM, DISPATCHES_PER_TICK)
         # degradation-ladder surface (docs/resilience.md): FSM state
         # (0 healthy / 1 degraded) + transition and watchdog counters
         self._g_backend_state = reg("resilience", "solver_backend_state")
@@ -598,6 +623,19 @@ class SolverService:
                     stage, "-", float(np.percentile(samples, 99))
                 )
 
+    def note_tick(self) -> None:
+        """Per-tick dispatch accounting behind the
+        karpenter_solver_dispatches_per_tick gauge: the Manager calls
+        this once at the end of every reconcile tick; the gauge then
+        shows how many device programs that tick paid — 3+ on the
+        chained steady-state path (forecast + decide + cost), exactly 1
+        once --fused-tick engages (docs/solver-service.md "Fused
+        tick")."""
+        delta = self.stats.dispatches - self._tick_dispatch_mark
+        self._tick_dispatch_mark = self.stats.dispatches
+        self.stats.last_dispatches_per_tick = delta
+        self._g_dispatches_tick.set("-", "-", float(delta))
+
     @contextlib.contextmanager
     def track(self, stage: str):
         """Record an arbitrary caller stage (e.g. the HA controller's
@@ -625,6 +663,10 @@ class SolverService:
         with self._cond:
             self._compiled = {}
             self._compile_seen = set()
+        # the fused family tracks freshness process-globally (its
+        # program cache IS process-global — _count_fused_compile);
+        # a recovery boot re-arms it alongside the instance caches
+        _FUSED_COMPILE_SEEN.clear()
         # a recovery boot also re-arms the sharded dispatch strategy: a
         # pre-crash shard failure shouldn't pin the successor single-
         # device forever (the ladder re-trips on the next failure)
@@ -741,7 +783,11 @@ class SolverService:
                    (256 pods x 8 groups), weight operand present (the
                    encoder always carries pod_weight);
           decide — 1 autoscaler x 1 metric, padded to the decision
-                   kernel's row bucket (ops/decision.pad_to).
+                   kernel's row bucket (ops/decision.pad_to);
+          fused  — the --fused-tick megakernel with every stage
+                   present (forecast + decide + cost) at the smallest
+                   bucket rung; the runtime adds it to the warm list
+                   when the fused tick is enabled.
 
         A family already warmed this process lifetime is SKIPPED (the
         compile cache hits; reset_caches re-arms). With the persistent
@@ -777,12 +823,13 @@ class SolverService:
                 "skipped": False,
                 "ms": round(elapsed_ms, 3),
             }
-            if family == "solve":
-                # only the queue families count compiles in the
-                # service's cache counters; decide rides jax.jit's own
-                # cache, so claiming fresh_compiles=0 there would read
-                # as "cache-served" when the ms column IS a first-touch
-                # compile — report the counter only where it's real
+            if family in ("solve", "fused"):
+                # only families that count compiles in the service's
+                # cache counters report the number; decide rides
+                # jax.jit's own cache, so claiming fresh_compiles=0
+                # there would read as "cache-served" when the ms column
+                # IS a first-touch compile — report the counter only
+                # where it's real
                 report[family]["fresh_compiles"] = (
                     self.stats.compile_cache_misses - misses_before
                 )
@@ -795,6 +842,12 @@ class SolverService:
             return
         if family == "decide":
             self.decide(_prewarm_decide_inputs())
+            return
+        if family == "fused":
+            # the full-presence fused program (forecast + decide + cost
+            # all engaged) at the smallest bucket rung — the program a
+            # small fleet's first --fused-tick reconcile hits
+            self.fused_tick(_prewarm_fused_inputs())
             return
         raise ValueError(f"unknown pre-warm family {family!r}")
 
@@ -1504,6 +1557,165 @@ class SolverService:
                 return numpy_fn(inputs)
         finally:
             self._record_stage("sim", _time.perf_counter() - t0)
+
+    def fused_tick(self, inputs, backend: Optional[str] = None):
+        """The fused steady-state tick through the service
+        (ops/fusedtick.py, docs/solver-service.md "Fused tick"):
+        forecast → decide → cost as ONE compiled program, zero host
+        round-trips between stages — the whole fleet's reconcile math
+        in a single dispatch.
+
+        Degradation posture is the never-block ladder: a fused-program
+        failure falls back to the CHAINED per-stage path (the exact
+        pre-fusion wire, bit-identical outputs), a chained failure
+        serves the numpy floor — the tick always completes. Fused
+        failures feed the shared backend-health FSM; the chained rung
+        is a degraded serve and leaves the FSM counting, so a
+        persistently faulting fused program still trips wholesale to
+        numpy and probes recovery like every other family. Fleets whose
+        N x M cells reach shard_threshold take the chained rung by
+        design: its decide stage rides the mesh-sharded program (the
+        megakernel has no multi-chip entry). `fused.tick` is the
+        fault-injection point (faults/registry.py)."""
+        from karpenter_tpu.ops import fusedtick as FT
+
+        self.stats.fused_calls += 1
+        resolved = self._resolve_backend(backend)
+        if self.device_solver is not None:
+            resolved = "numpy"  # the gRPC wire carries bin-packs only
+        elif resolved == "pallas":
+            resolved = "xla"  # no Mosaic fused kernel; XLA runs on TPU
+        # pad the forecast group up the forecast family's shape ladders
+        # ONCE at the door — every rung (fused, chained, numpy) consumes
+        # the SAME padded operands, so the ladder can switch rungs
+        # mid-tick bit for bit and compile keys bucket like the
+        # standalone forecast family's
+        t_bucket = s_bucket = n_series = 0
+        if inputs.forecast is not None:
+            import dataclasses
+
+            from karpenter_tpu.forecast.models import pad_forecast_inputs
+
+            shape = np.asarray(inputs.forecast.values).shape
+            n_series = int(shape[0])
+            t_bucket = bucket_up(int(shape[1]), FORECAST_T_FLOOR)
+            s_bucket = bucket_up(n_series, FORECAST_S_FLOOR)
+            inputs = dataclasses.replace(
+                inputs,
+                forecast=pad_forecast_inputs(inputs.forecast, t_bucket),
+            )
+            inputs = FT.pad_series(inputs, s_bucket)
+        n = int(np.asarray(inputs.decision.spec_replicas).shape[0])
+        m = int(np.asarray(inputs.decision.metric_value).shape[1])
+        t0 = _time.perf_counter()
+        try:
+            if resolved != "numpy" and self._device_allowed():
+                out = self._fused_device(
+                    inputs, resolved, n, m, t_bucket, s_bucket, t0
+                )
+                if out is not None:
+                    return self._fused_slice(out, n_series)
+            with default_tracer().span(
+                "solver.fused_tick", backend="numpy"
+            ):
+                out = FT.fused_tick_numpy(inputs)
+            if resolved != "numpy":
+                self.stats.fused_mirror_serves += 1
+            self._annotate_provenance("numpy", "numpy")
+            return self._fused_slice(out, n_series)
+        finally:
+            self._record_stage("fused", _time.perf_counter() - t0)
+
+    def _fused_device(
+        self, inputs, resolved: str, n: int, m: int,
+        t_bucket: int, s_bucket: int, t0: float,
+    ):
+        """The fused + chained device rungs of fused_tick's ladder;
+        None = both failed (the caller serves the numpy floor)."""
+        import jax
+
+        from karpenter_tpu.ops import fusedtick as FT
+
+        _, extents = self._shard_extents("xla", n, max(m, 1))
+        if extents is None:
+            key = (
+                "fused", n, m, t_bucket, s_bucket,
+                inputs.forecast is not None,
+                inputs.slo_valid is not None,
+                resolved,
+            )
+            try:
+                fresh = self._count_fused_compile(key)
+                cost_fn = None
+                plane = self._introspect
+                if fresh and plane is not None and plane.enabled:
+                    cost_fn = self._cost_thunk(
+                        FT.fused_tick_jit, (inputs,), {}
+                    )
+                with default_tracer().span(
+                    "solver.fused_tick", backend=resolved,
+                    **self._span_cost_args(key),
+                ):
+                    with solver_trace("solver.fused_tick"):
+                        # the fused-path fault-injection point: an
+                        # error plan exercises the fused → chained →
+                        # numpy ladder + FSM trip (docs/resilience.md)
+                        inject("fused.tick")
+                        out = FT.fused_tick_jit(inputs)
+                        jax.block_until_ready(out)
+                self._note_fresh_compile(
+                    fresh, "fused", key, t0, [], cost_fn=cost_fn,
+                )
+                self._record_device_success()
+                self.stats.fused_dispatches += 1
+                self._count_dispatch()
+                self._annotate_provenance(resolved, "device")
+                return jax.tree_util.tree_map(np.asarray, out)
+            except Exception as error:  # noqa: BLE001 — never-block
+                self._record_device_failure()
+                logger().warning(
+                    "fused tick dispatch failed (%s: %s); falling back "
+                    "to the chained per-stage path",
+                    type(error).__name__, error,
+                )
+        try:
+            with default_tracer().span(
+                "solver.fused_tick", backend="chained"
+            ):
+                with solver_trace("solver.fused_tick.chained"):
+                    out = FT.fused_tick_chained(inputs)
+            # a degraded serve: stage dispatches are counted (the
+            # dispatches-per-tick gauge must show the real program
+            # count) but the FSM keeps counting fused failures — a
+            # persistently faulting megakernel must still trip
+            self.stats.fused_chained_serves += 1
+            for _ in range(FT.programs(inputs)):
+                self._count_dispatch()
+            self._annotate_provenance("xla", "device")
+            return out
+        except Exception as error:  # noqa: BLE001 — never-block
+            self._record_device_failure()
+            logger().warning(
+                "chained fused-tick fallback failed (%s: %s); serving "
+                "the bit-identical numpy floor",
+                type(error).__name__, error,
+            )
+            return None
+
+    @staticmethod
+    def _fused_slice(out, n_series: int):
+        """Slice the forecast outputs back to the caller's S (padding
+        series are service-internal, exactly like the queue family)."""
+        if out.forecast is None:
+            return out
+        import dataclasses
+
+        from karpenter_tpu.forecast.models import slice_forecast_outputs
+
+        return dataclasses.replace(
+            out,
+            forecast=slice_forecast_outputs(out.forecast, 0, n_series),
+        )
 
     def _annotate_provenance(self, backend: str, rung: str) -> None:
         """Provenance slice (observability/provenance.py): stamp the
@@ -2834,6 +3046,24 @@ class SolverService:
         self._c_misses.inc("-", "-")
         return True
 
+    def _count_fused_compile(self, cache_key: tuple) -> bool:
+        """Fused-family compile-cache lookup. Unlike the solve family
+        (whose compiled closures live on THIS service instance), the
+        fused program rides the module-level fused_tick_jit whose
+        compile cache is process-global — and disk-global under the
+        persistent compile cache — so freshness is tracked in the
+        module-level set: a rebooted service in a warm process pays no
+        compile and must not ledger one (the restart contract
+        --compile-cache-dir exists for). reset_caches() re-arms."""
+        if cache_key in _FUSED_COMPILE_SEEN:
+            self.stats.compile_cache_hits += 1
+            self._c_hits.inc("-", "-")
+            return False
+        _FUSED_COMPILE_SEEN.add(cache_key)
+        self.stats.compile_cache_misses += 1
+        self._c_misses.inc("-", "-")
+        return True
+
     def _count_dispatch(self) -> None:
         self.stats.dispatches += 1
         self._c_dispatch.inc("-", "-")
@@ -2973,6 +3203,51 @@ def _prewarm_decide_inputs():
         down_pvalue=col_i.copy(),
         down_pperiod=np.ones((n, 1), np.int32),
         down_pvalid=col_b.copy(),
+    )
+
+
+def _prewarm_fused_inputs():
+    """The full-presence fused tick (forecast + decide + cost engaged)
+    at the smallest rung of every shape ladder: 1 series x 1 sample
+    (padded to 8 x 16 inside fused_tick), the decide kernel's smallest
+    row bucket, 1 metric column — the program a small fleet's first
+    --fused-tick reconcile compiles."""
+    from karpenter_tpu.forecast.models import ForecastInputs
+    from karpenter_tpu.ops import fusedtick as FT
+
+    dec = _prewarm_decide_inputs()
+    n = int(dec.spec_replicas.shape[0])
+    return FT.FusedTickInputs(
+        decision=dec,
+        forecast=ForecastInputs(
+            values=np.zeros((1, 1), np.float32),
+            valid=np.zeros((1, 1), bool),
+            times=np.zeros((1, 1), np.float32),
+            weights=np.ones((1, 1), np.float32),
+            horizon=np.ones(1, np.float32),
+            step_s=np.ones(1, np.float32),
+            model=np.zeros(1, np.int32),
+            season=np.zeros(1, np.int32),
+            alpha=np.full(1, 0.5, np.float32),
+            beta=np.full(1, 0.1, np.float32),
+            gamma=np.full(1, 0.1, np.float32),
+        ),
+        series_row=np.zeros(1, np.int32),
+        series_col=np.zeros(1, np.int32),
+        series_need=np.full(1, 2, np.int32),
+        series_blend=np.zeros(1, bool),
+        ha_min=np.zeros(n, np.int32),
+        ha_max=np.ones(n, np.int32),
+        unit_cost=np.zeros(n, np.float32),
+        slo_weight=np.zeros(n, np.float32),
+        max_hourly_cost=np.zeros(n, np.float32),
+        slo_valid=np.zeros(n, bool),
+        slo_target=np.ones((n, 1), np.float32),
+        observed=np.zeros((n, 1), np.float32),
+        demand_base_valid=np.zeros((n, 1), bool),
+        prior_point=np.zeros((n, 1), np.float32),
+        prior_sigma2=np.zeros((n, 1), np.float32),
+        prior_valid=np.zeros((n, 1), bool),
     )
 
 

@@ -91,6 +91,9 @@ class TenancyStatistics:
     forecast_calls: int = 0
     forecast_series: int = 0
     forecast_dispatches: int = 0
+    fused_calls: int = 0  # fused_tick_all entries (--fused-tick)
+    fused_rows: int = 0  # tenant rows through the fused megakernel
+    fused_dispatches: int = 0  # shared concatenated fused dispatches
     solve_calls: int = 0
     solve_requests: int = 0  # per-tenant bin-packs through the queue
     admission_rounds: int = 0  # rounds across all shared dispatches
@@ -272,6 +275,75 @@ class MultiTenantScheduler:
                     isolated=dispatch,
                     mirror=FM.forecast_numpy,
                     fallback=forecast_invalid,
+                )
+            )
+        return results
+
+    # -- fused tick --------------------------------------------------------
+
+    def fused_tick_all(self, batch, backend: Optional[str] = None):
+        """Concatenate every tenant's FusedTickInputs into shared
+        fused-megakernel dispatches (docs/solver-service.md "Fused
+        tick"): one compiled forecast -> decide -> cost program covers
+        the whole tenant group, and each tenant's slice of the scattered
+        outputs is bit-identical to its own independent fused dispatch
+        (the same row/series-independence argument as decide_all /
+        forecast_all). Groups by (now epoch, forecast time bucket) so
+        concatenation never perturbs stabilization-window math or the
+        forecast compile rung; a degraded tenant serves from the
+        bit-identical fused_tick_numpy mirror alone."""
+        from karpenter_tpu.ops import fusedtick as FT
+        from karpenter_tpu.solver.bucketing import bucket_up
+        from karpenter_tpu.solver.service import FORECAST_T_FLOOR
+
+        self.stats.fused_calls += 1
+        self._serving = {}
+        results: Dict[str, object] = {}
+        groups: Dict[tuple, Dict[str, object]] = {}
+        for tenant, inputs in batch.items():
+            t_bucket = 0
+            if inputs.forecast is not None:
+                t_bucket = bucket_up(
+                    int(np.asarray(inputs.forecast.values).shape[1]),
+                    FORECAST_T_FLOOR,
+                )
+            key = (float(np.asarray(inputs.decision.now)), t_bucket)
+            groups.setdefault(key, {})[tenant] = inputs
+
+        def dispatch(inputs):
+            return self.service.fused_tick(inputs, backend=backend)
+
+        for group in groups.values():
+            # per-round tenant spans (series ranges + stage presence),
+            # written by concat and read back by scatter — the generic
+            # _run_family machinery only threads row offsets, and the
+            # fused outputs carry a second (series) axis
+            spans: Dict[int, dict] = {}
+
+            def concat(inputs_list, _spans=spans):
+                stacked, tenant_spans = concat_fused_inputs(inputs_list)
+                _spans.clear()
+                _spans.update(tenant_spans)
+                return stacked
+
+            def scatter(out, start, stop, _spans=spans):
+                return slice_fused_outputs(
+                    out, start, stop, _spans.get(start)
+                )
+
+            results.update(
+                self._run_family(
+                    group,
+                    family="fused",
+                    rows_of=lambda i: int(
+                        np.asarray(i.decision.spec_replicas).shape[0]
+                    ),
+                    concat=concat,
+                    dispatch=dispatch,
+                    scatter=scatter,
+                    isolated=dispatch,
+                    mirror=FT.fused_tick_numpy,
+                    fallback=fused_hold,
                 )
             )
         return results
@@ -660,10 +732,11 @@ class MultiTenantScheduler:
                     isolated=isolated, mirror=mirror, fallback=fallback,
                     rows_of=rows_of, round_index=round_index,
                 )
-        if family == "decide" and self.metrics.enabled:
+        if family in ("decide", "fused") and self.metrics.enabled:
             # karpenter_tenant_decisions_total counts DECIDE rows only
-            # (one per autoscaler per tick), on every serve path —
-            # shared scatter, lone round, mirror, and fallback alike
+            # (one per autoscaler per tick — the fused megakernel's
+            # rows are decisions too), on every serve path — shared
+            # scatter, lone round, mirror, and fallback alike
             for tenant in results:
                 self.metrics.decisions.inc(
                     tenant, "-", float(rows_of(batch[tenant]))
@@ -675,6 +748,8 @@ class MultiTenantScheduler:
             self.stats.decide_rows += n
         elif family == "cost":
             self.stats.cost_rows += n
+        elif family == "fused":
+            self.stats.fused_rows += n
         else:
             self.stats.forecast_series += n
 
@@ -683,6 +758,8 @@ class MultiTenantScheduler:
             self.stats.decide_dispatches += 1
         elif family == "cost":
             self.stats.cost_dispatches += 1
+        elif family == "fused":
+            self.stats.fused_dispatches += 1
         else:
             self.stats.forecast_dispatches += 1
 
@@ -902,6 +979,37 @@ def forecast_invalid(inputs) -> "object":
     )
 
 
+def fused_hold(inputs) -> "object":
+    """The fused family's never-block floor: hold replicas (decide
+    floor), all-invalid forecasts, and a cost-blind pass-through of the
+    held number — each stage's own documented degradation, composed."""
+    from karpenter_tpu.ops import cost as CK
+    from karpenter_tpu.ops import fusedtick as FT
+
+    decision = decide_hold(inputs.decision)
+    forecast = None
+    if inputs.forecast is not None:
+        forecast = forecast_invalid(inputs.forecast)
+    cost = None
+    if inputs.slo_valid is not None:
+        held = decision.desired
+        n = held.shape[0]
+        cost = CK.CostOutputs(
+            desired=held.copy(),
+            expected_hourly=(
+                held.astype(np.float32)
+                * np.asarray(inputs.unit_cost, np.float32)
+            ),
+            violation_risk=np.zeros(n, np.float32),
+            headroom=np.zeros(n, np.int32),
+            cost_limited=np.zeros(n, bool),
+            slo_raised=np.zeros(n, bool),
+        )
+    return FT.FusedTickOutputs(
+        decision=decision, forecast=forecast, cost=cost
+    )
+
+
 # -- concatenation / scatter helpers (module docstring parity contract) ------
 
 
@@ -1070,4 +1178,182 @@ def concat_forecast_inputs(inputs_list):
     total = sum(int(np.asarray(i.values).shape[0]) for i in inputs_list)
     return FM.concat_forecast_inputs(
         inputs_list, bucket_up(total, FORECAST_S_FLOOR)
+    )
+
+
+def concat_fused_inputs(
+    inputs_list, row_bucket: int = ROW_BUCKET
+) -> Tuple[object, Dict[int, dict]]:
+    """Stack per-tenant FusedTickInputs: decision matrices along the
+    row axis (concat_decision_inputs), forecast series along the series
+    axis with per-tenant ROW-OFFSET fixups on the scatter maps, and the
+    masked cost operands along the row axis. Returns (stacked, spans):
+    spans[row_offset] = {"series": (s0, s1) | None, "cost": bool} — the
+    aux geometry slice_fused_outputs needs to scatter the second
+    (series) axis back per tenant.
+
+    Trash-row fixup: each tenant's pad series point at its OWN grid's
+    trash row (row >= its N); after concatenation that index is a REAL
+    row of the next tenant, so those references are remapped to the
+    concatenated grid's trash row (the padded row count)."""
+    from karpenter_tpu.forecast import models as FM
+    from karpenter_tpu.ops import fusedtick as FT
+    from karpenter_tpu.solver.bucketing import bucket_up
+    from karpenter_tpu.solver.service import (
+        FORECAST_S_FLOOR,
+        FORECAST_T_FLOOR,
+    )
+
+    sizes = [
+        int(np.asarray(i.decision.spec_replicas).shape[0])
+        for i in inputs_list
+    ]
+    total = sum(sizes)
+    n_total = D.pad_to(total, row_bucket)
+    decision = concat_decision_inputs(
+        [i.decision for i in inputs_list], row_bucket
+    )
+    m = int(np.asarray(decision.metric_value).shape[1])
+
+    spans: Dict[int, dict] = {}
+    f_parts: List[object] = []
+    row_parts, col_parts, need_parts, blend_parts = [], [], [], []
+    t_bucket = max(
+        [
+            bucket_up(
+                int(np.asarray(i.forecast.values).shape[1]),
+                FORECAST_T_FLOOR,
+            )
+            for i in inputs_list
+            if i.forecast is not None
+        ],
+        default=0,
+    )
+    offset = 0
+    s_offset = 0
+    for inputs, size in zip(inputs_list, sizes):
+        span = {"series": None, "cost": inputs.slo_valid is not None}
+        if inputs.forecast is not None:
+            s = int(np.asarray(inputs.forecast.values).shape[0])
+            span["series"] = (s_offset, s_offset + s)
+            s_offset += s
+            # same left-aligned T padding the tenant's own isolated
+            # dispatch would get at the service door (fused_tick_all
+            # groups by t_bucket, so this is bit-preserving)
+            f_parts.append(
+                FM.pad_forecast_inputs(inputs.forecast, t_bucket)
+            )
+            rows = np.asarray(inputs.series_row, np.int64)
+            row_parts.append(
+                np.where(rows >= size, n_total, rows + offset).astype(
+                    np.int32
+                )
+            )
+            col_parts.append(np.asarray(inputs.series_col, np.int32))
+            need_parts.append(np.asarray(inputs.series_need, np.int32))
+            blend_parts.append(np.asarray(inputs.series_blend, bool))
+        spans[offset] = span
+        offset += size
+
+    kwargs: dict = {}
+    if f_parts:
+        s_pad = bucket_up(s_offset, FORECAST_S_FLOOR)
+        extra = s_pad - s_offset
+        kwargs["forecast"] = FM.concat_forecast_inputs(f_parts, s_pad)
+        # the concat's own pad series route to the shared trash row
+        # with an unreachable sample threshold — inert in every stage
+        kwargs["series_row"] = np.concatenate(
+            row_parts + [np.full(extra, n_total, np.int32)]
+        )
+        kwargs["series_col"] = np.concatenate(
+            col_parts + [np.zeros(extra, np.int32)]
+        )
+        kwargs["series_need"] = np.concatenate(
+            need_parts
+            + [np.full(extra, np.iinfo(np.int32).max, np.int32)]
+        )
+        kwargs["series_blend"] = np.concatenate(
+            blend_parts + [np.zeros(extra, bool)]
+        )
+    if any(i.slo_valid is not None for i in inputs_list):
+        kwargs.update(
+            _concat_fused_cost(inputs_list, sizes, n_total, m)
+        )
+    return FT.FusedTickInputs(decision=decision, **kwargs), spans
+
+
+def _concat_fused_cost(
+    inputs_list, sizes: List[int], n_total: int, m: int
+) -> dict:
+    """Row-axis concat of the fused cost operand group. Tenants without
+    an SLO opt-in contribute all-masked rows (slo_valid=False is the
+    kernel's pass-through), identical to the absent-group wire; the
+    metric axis pads to the decision grid's width with demand-invalid
+    columns and the row axis up the bucket with masked rows."""
+
+    def rows(name: str, width, fill, dtype):
+        parts = []
+        for inputs, size in zip(inputs_list, sizes):
+            arr = getattr(inputs, name)
+            if arr is None:
+                shape = (size,) if width is None else (size, width)
+                arr = np.full(shape, fill, dtype)
+            else:
+                arr = np.asarray(arr, dtype)
+                if width is not None:
+                    arr = _pad_cols(arr, width, fill)
+            parts.append(arr)
+        out = np.concatenate(parts, axis=0)
+        n_pad = n_total - out.shape[0]
+        if n_pad:
+            pad_shape = (n_pad,) + out.shape[1:]
+            out = np.concatenate(
+                [out, np.full(pad_shape, fill, out.dtype)], axis=0
+            )
+        return out
+
+    return dict(
+        ha_min=rows("ha_min", None, np.int32(0), np.int32),
+        ha_max=rows("ha_max", None, np.int32(0), np.int32),
+        unit_cost=rows("unit_cost", None, np.float32(0), np.float32),
+        slo_weight=rows("slo_weight", None, np.float32(0), np.float32),
+        max_hourly_cost=rows(
+            "max_hourly_cost", None, np.float32(0), np.float32
+        ),
+        slo_valid=rows("slo_valid", None, False, bool),
+        slo_target=rows("slo_target", m, np.float32(1), np.float32),
+        observed=rows("observed", m, np.float32(0), np.float32),
+        demand_base_valid=rows(
+            "demand_base_valid", m, False, bool
+        ),
+        prior_point=rows("prior_point", m, np.float32(0), np.float32),
+        prior_sigma2=rows(
+            "prior_sigma2", m, np.float32(0), np.float32
+        ),
+        prior_valid=rows("prior_valid", m, False, bool),
+    )
+
+
+def slice_fused_outputs(out, start: int, stop: int, span):
+    """One tenant's slice of a shared fused dispatch: decision/cost by
+    row range, forecast by the tenant's series range (from the concat's
+    span record). Stages the tenant never carried come back None —
+    byte-identical to its own independent dispatch."""
+    from karpenter_tpu.forecast import models as FM
+    from karpenter_tpu.ops import fusedtick as FT
+
+    forecast = None
+    cost = None
+    if span is not None and out.forecast is not None:
+        series = span.get("series")
+        if series is not None:
+            forecast = FM.slice_forecast_outputs(
+                out.forecast, series[0], series[1]
+            )
+    if span is not None and span.get("cost") and out.cost is not None:
+        cost = slice_cost_outputs(out.cost, start, stop)
+    return FT.FusedTickOutputs(
+        decision=slice_decision_outputs(out.decision, start, stop),
+        forecast=forecast,
+        cost=cost,
     )

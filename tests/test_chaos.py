@@ -429,6 +429,101 @@ class TestForecastChaos:
             runtime.close()
 
 
+class TestFusedTickChaos:
+    """Satellite pin (docs/solver-service.md "Fused tick"): 100%
+    fused-program faults walk the never-block ladder — chained
+    per-stage fallback first, the numpy floor once the FSM trips —
+    and the fleet still reaches the fixed point a never-fused run
+    reaches. reset_caches() re-arms the fused compile key."""
+
+    FIXED_POINT = 11  # queue=41, AverageValue target=4 -> ceil(41/4)
+
+    def _world(self, fused: bool):
+        clock = FakeClock()
+        provider = RecordingFactory()
+        provider.node_replicas["g"] = 5
+        runtime = KarpenterRuntime(
+            Options(fused_tick=fused,
+                    solver_health_threshold=2,
+                    solver_probe_interval_s=0.0),
+            cloud_provider_factory=provider,
+            clock=clock,
+        )
+        runtime.solver_service.backend = "xla"
+        runtime.registry.register("queue", "length").set(
+            "q", "default", 41.0
+        )
+        runtime.store.create(sng_of("g", replicas=5))
+        runtime.store.create(
+            queue_ha("g", 'karpenter_queue_length{name="q"}')
+        )
+        return runtime, provider, clock
+
+    def test_fused_faults_degrade_down_the_ladder(self):
+        # the never-fused reference: same world, no faults
+        baseline, base_provider, base_clock = self._world(fused=False)
+        try:
+            for _ in range(10):
+                base_clock.advance(61.0)
+                baseline.manager.reconcile_all()
+            assert base_provider.node_replicas["g"] == self.FIXED_POINT
+        finally:
+            baseline.close()
+
+        runtime, provider, clock = self._world(fused=True)
+        service = runtime.solver_service
+        try:
+            registry = faults.install(FaultRegistry(seed=CHAOS_SEED))
+            registry.plan("fused.tick", probability=1.0)
+            for _ in range(10):
+                clock.advance(61.0)
+                runtime.manager.reconcile_all()
+            assert registry.injected.get("fused.tick", 0) >= 1, (
+                "the scenario must actually have exercised fused faults"
+            )
+            # every faulted tick served from the CHAINED rung (probe
+            # interval 0 keeps the device attempt live), bit-identical
+            # to the never-fused wire: same fixed point
+            assert service.stats.fused_chained_serves >= 1
+            assert service.stats.fused_dispatches == 0
+            assert service.queue_depth() == 0
+            assert provider.node_replicas["g"] == self.FIXED_POINT
+            # the fused path feeds the SAME backend-health FSM
+            assert service.stats.fsm_trips >= 1
+
+            # park the probes: a DEGRADED plane short-circuits to the
+            # numpy floor without touching the device
+            service.health_probe_interval_s = 3600.0
+            mirrors_before = service.stats.fused_mirror_serves
+            for _ in range(4):
+                clock.advance(61.0)
+                runtime.manager.reconcile_all()
+            assert service.stats.fused_mirror_serves > mirrors_before
+            assert provider.node_replicas["g"] == self.FIXED_POINT
+
+            faults.uninstall()  # ---- faults clear ----
+            service.health_probe_interval_s = 0.0
+            service._next_probe = 0.0
+            for _ in range(3):
+                clock.advance(61.0)
+                runtime.manager.reconcile_all()
+            assert service.backend_health() == "healthy"
+            assert service.stats.fused_dispatches >= 1
+            assert provider.node_replicas["g"] == self.FIXED_POINT
+
+            # reset_caches re-arms the fused compile key: the next
+            # dispatch counts a fresh compile again
+            misses = service.stats.compile_cache_misses
+            service.reset_caches()
+            clock.advance(61.0)
+            runtime.manager.reconcile_all()
+            assert service.stats.compile_cache_misses == misses + 1
+            assert provider.node_replicas["g"] == self.FIXED_POINT
+        finally:
+            faults.uninstall()
+            runtime.close()
+
+
 class TestPreemptChaos:
     """Satellite pin (docs/preemption.md): eviction planning under
     device faults degrades to the BIT-IDENTICAL numpy mirror — plans

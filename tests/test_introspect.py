@@ -397,7 +397,8 @@ class TestResidentEntries:
 # -- the runtime worlds -------------------------------------------------------
 
 
-def _churn_world(tmp_path=None, introspect=True, storm_threshold=4):
+def _churn_world(tmp_path=None, introspect=True, storm_threshold=4,
+                 **options_kw):
     """A compact watch-fed churn world (the bench _churn_runtime
     shape): every tick toggles a churn pod so the encode memo misses
     and the tick pays a real solve through the service."""
@@ -441,6 +442,7 @@ def _churn_world(tmp_path=None, introspect=True, storm_threshold=4):
             introspect=introspect,
             introspect_storm_threshold=storm_threshold,
             journal_dir=str(tmp_path) if tmp_path else None,
+            **options_kw,
         ),
         cloud_provider_factory=provider,
         clock=lambda: clock["now"],
@@ -535,6 +537,38 @@ class TestSteadyStateCompileGuard:
             assert (
                 runtime.solver_service.stats.compile_cache_misses
                 == misses_before
+            )
+        finally:
+            runtime.close()
+
+    def test_zero_new_compiles_past_warmup_fused(self, fresh_recorder):
+        """The fused-family extension of the guard: the same churn
+        world with --fused-tick routes every steady-state tick through
+        the ONE fused program, and N ticks past warm-up still record
+        ZERO new compile-ledger rows — the fused compile key (shape
+        buckets + stage presence) holds steady under churn."""
+        runtime, _provider, tick = _churn_world(fused_tick=True)
+        try:
+            for _ in range(5):  # warm-up: compiles + first encodes
+                tick()
+            service = runtime.solver_service
+            assert service.stats.fused_dispatches > 0, (
+                "--fused-tick must actually route the tick through "
+                "the fused program"
+            )
+            plane = runtime.solver_introspection
+            before = plane.ledger.records_total
+            misses_before = service.stats.compile_cache_misses
+            dispatched = service.stats.fused_dispatches
+            for _ in range(8):
+                tick()
+            assert service.stats.fused_dispatches > dispatched
+            assert plane.ledger.records_total == before, (
+                "steady-state fused ticks must not compile: "
+                f"{plane.ledger.tail()}"
+            )
+            assert (
+                service.stats.compile_cache_misses == misses_before
             )
         finally:
             runtime.close()
