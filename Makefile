@@ -27,6 +27,9 @@ test-chaos: ## Seeded chaos suite: runtime + solver under injected faults (docs/
 test-recovery: ## Seeded kill-and-restart suite: crash-safe state, fencing, warm-up (docs/resilience.md "Crash recovery")
 	$(PYTHON) -m pytest tests/test_recovery.py tests/test_restart_chaos.py -q
 
+test-failover: ## Replicated control plane: leader-kill handoff, exactly-once actuation, split-brain fencing (docs/resilience.md "Replicated control plane")
+	$(PYTHON) -m pytest tests/test_failover.py -q
+
 battletest: ## Randomized order + scale + stress + coverage when available (reference: Makefile battletest)
 	@# coverage is opportunistic but NEVER silent: the gate says which
 	@# mode it runs in, and a failing test fails it in either mode
@@ -141,6 +144,12 @@ bench-fusedtick: ## Fused steady-state tick: the fleet batch's forecast -> decid
 		--fusedtick-samples 32 --fusedtick-ticks 40 --iters 20 \
 		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
 
+bench-failover: ## Replicated-control-plane leader kill at fleet scale (256 tenants x 4 replicas): handoff blackout p99 + exactly-once audit; appends a BENCHMARKS row + publishes to BASELINE.json
+	$(PYTHON) bench.py --failover --failover-tenants 256 \
+		--failover-replicas 4 --failover-partitions 16 \
+		--failover-ticks 40 \
+		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
+
 dryrun: ## Multi-chip sharding compile check on 8 virtual CPU devices
 	$(PYTHON) -c "import os; \
 		os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS','') + ' --xla_force_host_platform_device_count=8').strip(); \
@@ -177,10 +186,10 @@ conformance: ## Run the real-apiserver tier against a kind-booted apiserver (the
 kind-smoke: ## Deploy smoke on kind: image -> apply -> pod Ready -> one HA end to end
 	bash hack/kind-smoke.sh
 
-.PHONY: help dev ci test test-chaos test-recovery battletest verify codegen \
+.PHONY: help dev ci test test-chaos test-recovery test-failover battletest verify codegen \
 	docs native bench bench-solver bench-hotpath bench-consolidate \
 	bench-forecast bench-preempt bench-cost bench-journal bench-trace \
 	bench-provenance bench-resident bench-shard bench-multitenant \
 	bench-eventloop bench-introspect bench-constraints test-simlab \
-	bench-simlab bench-fusedtick dryrun \
+	bench-simlab bench-fusedtick bench-failover dryrun \
 	image publish apply delete kind-load conformance kind-smoke

@@ -110,6 +110,37 @@ def parse_args(argv=None) -> argparse.Namespace:
         default=True,
     )
     parser.add_argument(
+        "--partitions",
+        type=int,
+        default=0,
+        metavar="N",
+        help="replicated control plane (docs/resilience.md 'Replicated "
+        "control plane'): partition tenants across N CAS leases and "
+        "run this process as one leader-elected replica — rendezvous-"
+        "hash assignment, fenced tenant handoff, /debug/replicas "
+        "scoreboard. 0 (default) = single-replica wire, byte-identical "
+        "and lease-traffic-free; with N > 0 the global --leader-elect "
+        "gate is superseded by the per-partition leases",
+    )
+    parser.add_argument(
+        "--replica-id",
+        default=None,
+        metavar="ID",
+        help="this replica's identity on the lease plane (heartbeat "
+        "lease name, rendezvous ranking, /debug/replicas); default: a "
+        "generated karpenter-<hex> id — set it in real fleets so "
+        "scoreboards correlate across processes",
+    )
+    parser.add_argument(
+        "--lease-duration",
+        type=float,
+        default=15.0,
+        metavar="SECONDS",
+        help="partition/heartbeat lease duration: the failover "
+        "detection horizon — a dead replica's tenants become adoptable "
+        "one lease duration (plus skew tolerance) after its last renew",
+    )
+    parser.add_argument(
         "--profiler-port",
         type=int,
         default=0,
@@ -433,6 +464,23 @@ def parse_args(argv=None) -> argparse.Namespace:
         help="with --simulate --restart-storm: kill/reboot cycles",
     )
     parser.add_argument(
+        "--failover",
+        action="store_true",
+        help="with --simulate: replay a seeded leader-kill failover "
+        "across a replicated control plane (N tenants partitioned over "
+        "R replicas, the biggest owner SIGKILLed mid-storm) and report "
+        "handoff blackout, exactly-once actuation across the handoff, "
+        "the deposed replica's fence-rejected late write, and "
+        "reconvergence ticks (docs/resilience.md 'Replicated control "
+        "plane')",
+    )
+    parser.add_argument(
+        "--replicas",
+        type=int,
+        default=3,
+        help="with --simulate --failover: simulated replica count",
+    )
+    parser.add_argument(
         "--cost",
         action="store_true",
         help="with --simulate: replay a seeded diurnal ramp + spot-price "
@@ -737,16 +785,19 @@ def _run_loop(args, runtime, elector) -> None:
             signal.signal(signal.SIGTERM, previous_handler)
 
 
-def main(argv=None) -> int:
-    args = parse_args(argv)
-    log_setup(verbose=args.verbose)
+def _setup_backend(args) -> None:
+    """Compile cache + backend probe, before the first jit.
 
-    # standalone mode compiles the decision kernel (and, without
-    # --solver-uri, the bin-pack) in-process: honor the same persistent
-    # compile cache the sidecar offers, so control-plane restarts skip
-    # recompiles too. --compile-cache-dir is the first-class flag
-    # (matching the sidecar's), with KARPENTER_COMPILE_CACHE as the
-    # env fallback for existing deployments.
+    Standalone mode compiles the decision kernel (and, without
+    --solver-uri, the bin-pack) in-process: honor the same persistent
+    compile cache the sidecar offers, so control-plane restarts skip
+    recompiles too. --compile-cache-dir is the first-class flag
+    (matching the sidecar's), with KARPENTER_COMPILE_CACHE as the
+    env fallback for existing deployments. And the batched HPA decision
+    kernel ALWAYS runs in-process (only the bin-pack is optionally
+    routed to a sidecar), so an unreachable TPU must degrade to CPU
+    decisions unconditionally — not freeze the control plane at its
+    first jit (utils/backend.py rationale)."""
     import os as _os
 
     from karpenter_tpu.utils.backend import (
@@ -758,16 +809,15 @@ def main(argv=None) -> int:
         args.compile_cache_dir
         or _os.environ.get("KARPENTER_COMPILE_CACHE", "")
     )
-
-    # the batched HPA decision kernel ALWAYS runs in-process (only the
-    # bin-pack is optionally routed to a sidecar), so an unreachable TPU
-    # must degrade to CPU decisions unconditionally — not freeze the
-    # control plane at its first jit (utils/backend.py rationale)
-
     note = ensure_usable_backend()
     if note:
         print(f"decision backend: {note}", file=sys.stderr)
 
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    log_setup(verbose=args.verbose)
+    _setup_backend(args)
     store = _make_store(args)
     if args.simulate:
         try:
@@ -818,6 +868,9 @@ def main(argv=None) -> int:
             # already applied above (before the first compile); carried
             # on Options so embedded runtimes resolve identically
             compile_cache_dir=args.compile_cache_dir,
+            partitions=args.partitions,
+            replica_id=args.replica_id,
+            lease_duration_s=args.lease_duration,
         ),
         store=store,
     )
@@ -831,6 +884,7 @@ def main(argv=None) -> int:
         # /debug/profile captures land next to the flight-recorder
         # dumps (and the recovery journal) — one incident directory
         profile_dir=args.journal_dir,
+        replication=runtime.replication,
     )
     port = metrics_server.start()
     print(f"serving /metrics and /healthz on :{port}", file=sys.stderr)
@@ -841,9 +895,12 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
 
+    # with --partitions the per-partition lease plane IS the election:
+    # every replica must tick (each serves its owned partitions), so
+    # the global whole-process gate is superseded
     elector = (
         LeaderElector(runtime.store, clock=runtime.clock)
-        if args.leader_elect
+        if args.leader_elect and not args.partitions
         else None
     )
     try:

@@ -301,7 +301,7 @@ class BatchAutoscaler:
             default_ledger().commit(ledger_batch)
         return outputs
 
-    def _evaluate_fused(self, live: List[_Row], ledger_batch):
+    def _evaluate_fused(self, live: List[_Row], ledger_batch):  # lint: allow-complexity — three optional stages x plan/commit halves around ONE dispatch; splitting would scatter each stage's paired halves
         """The fused steady-state tick (--fused-tick, ops/fusedtick.py):
         forecast → decide → cost as ONE SolverService.fused_tick call,
         with each engine's host bookkeeping split into plan/commit

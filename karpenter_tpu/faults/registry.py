@@ -52,6 +52,16 @@ Points instrumented across the stack (docs/resilience.md):
                       (preemption mid-eviction-batch), .journal (the
                       recovery StateJournal, which flushes a REAL torn
                       half-record before dying)
+  lease.acquire.*     lease acquisition CAS, per elector identity —
+  lease.renew.*       error plans here are a partitioned/deposed
+                      replica that cannot reach the lease store
+                      (replication.chaos partition_plans builds the
+                      pair)
+  replica.crash.*     kill point at the top of a replica's tick
+                      (ReplicatedControlPlane.on_tick) — a crash plan
+                      here is that replica dying between lease rounds
+                      (replication.chaos crash_plan; the failover
+                      world's leader kill)
 
 Registries also export `karpenter_faults_{attempts,injected}_total`
 {name=<point>} when given a GaugeRegistry, so a chaos run's injection

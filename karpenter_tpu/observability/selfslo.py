@@ -97,6 +97,12 @@ class SelfSLOMonitor:
                      breached (bad event), False = healthy (good),
                      None = no telemetry (disabled plane or a backend
                      without memory stats) — contributes no event
+      replica_source () -> Optional[bool] — the replicated control
+                     plane's health (replication/plane.py slo_source):
+                     True = mid-failover (lease renew failures or
+                     tenants still warming; bad event), False =
+                     serving steadily (good), None = replication off
+                     or no lease round yet — contributes no event
       recorder       the flight recorder burn trips dump through
                      (default: the process default)
     """
@@ -111,6 +117,7 @@ class SelfSLOMonitor:
         fsm_source: Optional[Callable[[], str]] = None,
         tenant_source: Optional[Callable[[], Dict[str, bool]]] = None,
         memory_source: Optional[Callable[[], Optional[bool]]] = None,
+        replica_source: Optional[Callable[[], Optional[bool]]] = None,
         recorder=None,
         windows: Sequence[BurnWindow] = DEFAULT_WINDOWS,
     ):
@@ -124,6 +131,7 @@ class SelfSLOMonitor:
         self.fsm_source = fsm_source
         self.tenant_source = tenant_source
         self.memory_source = memory_source
+        self.replica_source = replica_source
         self._recorder = recorder
         self.windows = tuple(windows)
         # cumulative snapshot series, one entry per evaluate(): parallel
@@ -198,14 +206,30 @@ class SelfSLOMonitor:
             return 1, 0
         return 0, 0
 
+    def _replica_events(self) -> Tuple[int, int]:
+        """The FIFTH source (karpenter_tpu/replication, the /debug/
+        replicas scoreboard): a replica mid-failover — held-lease renew
+        failures or tenants still in handoff warm-up — burns budget
+        like a degraded FSM; None (replication off, or no lease round
+        yet) stays quiet."""
+        if self.replica_source is None:
+            return 0, 0
+        degraded = self.replica_source()
+        if degraded is True:
+            return 0, 1
+        if degraded is False:
+            return 1, 0
+        return 0, 0
+
     def _collect(self) -> Tuple[int, int]:
-        """(good, bad) increments for THIS evaluation across the four
+        """(good, bad) increments for THIS evaluation across the five
         sources. Source failures degrade to 'no events', never raise —
         the monitor must not take the tick down with it."""
         good = bad = 0
         for source in (
             self._hist_events, self._fsm_events,
             self._tenant_events, self._memory_events,
+            self._replica_events,
         ):
             try:
                 d_good, d_bad = source()

@@ -28,6 +28,11 @@ come from the manager. Here the same server additionally serves:
   /debug/selfslo         the self-SLO scoreboard: per-window burn
                          rates/budget + solver FSM + per-tenant breaker
                          degradation (observability.selfslo)
+  /debug/replicas        the replicated-control-plane scoreboard: this
+                         replica's identity, the live-replica set,
+                         per-partition lease holders, and per-tenant
+                         handoff state (replication/plane.py;
+                         enabled: false without --partitions)
   /debug/solver          the full solver posture as ONE JSON document:
                          compile-cache rungs + hit/miss + the compile
                          ledger tail, resident LRU contents, shard
@@ -89,6 +94,7 @@ class MetricsServer:
         selfslo=None,
         introspection=None,
         profile_dir: Optional[str] = None,
+        replication=None,
     ):
         self.registry = registry
         self.host = host
@@ -104,6 +110,10 @@ class MetricsServer:
         # into (the runtime wires --journal-dir; None = 503)
         self._introspection = introspection
         self._profile_dir = profile_dir
+        # the replicated control plane backing /debug/replicas
+        # (replication/plane.py scoreboard; None = endpoint reports
+        # enabled: false — the single-replica deployment)
+        self._replication = replication
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -266,31 +276,38 @@ class MetricsServer:
             ).encode()
         return 200, body, "application/json"
 
+    def _respond_replicas(self) -> Tuple[int, bytes, str]:
+        if self._replication is None:
+            body = json.dumps({"enabled": False}).encode()
+        else:
+            body = json.dumps(
+                {"enabled": True, **self._replication.scoreboard()},
+                sort_keys=True,
+            ).encode()
+        return 200, body, "application/json"
+
     def _route(self, path: str, query: dict) -> Optional[Tuple[int, bytes, str]]:
         """(status, body, content-type) or None for 404."""
         if path in ("", "/healthz"):
             return 200, b"ok", "text/plain"
-        if path == "/readyz":
-            return self._respond_ready()
         if path == "/metrics":
             return (
                 200,
                 self.registry.expose_text().encode(),
                 "text/plain; version=0.0.4",
             )
-        if path == "/debug/traces":
-            return self._respond_traces(query)
-        if path == "/debug/flightrecorder":
-            return self._respond_flightrecorder(query)
-        if path == "/debug/decisions":
-            return self._respond_decisions(query)
-        if path == "/debug/selfslo":
-            return self._respond_selfslo()
-        if path == "/debug/solver":
-            return self._respond_solver(query)
-        if path == "/debug/profile":
-            return self._respond_profile(query)
-        return None
+        handlers = {
+            "/readyz": lambda q: self._respond_ready(),
+            "/debug/traces": self._respond_traces,
+            "/debug/flightrecorder": self._respond_flightrecorder,
+            "/debug/decisions": self._respond_decisions,
+            "/debug/selfslo": lambda q: self._respond_selfslo(),
+            "/debug/replicas": lambda q: self._respond_replicas(),
+            "/debug/solver": self._respond_solver,
+            "/debug/profile": self._respond_profile,
+        }
+        handler = handlers.get(path)
+        return handler(query) if handler is not None else None
 
     # -- lifecycle ---------------------------------------------------------
 

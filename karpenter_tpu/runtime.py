@@ -206,6 +206,22 @@ class Options:
     # instead of recompiling (utils/backend.configure_compile_cache).
     # None = env var only (the pre-flag wire).
     compile_cache_dir: Optional[str] = None
+    # replicated control plane (karpenter_tpu/replication,
+    # docs/resilience.md "Replicated control plane"): partition tenants
+    # across N leader-elected replicas with fenced handoff. partitions=0
+    # (default) builds NOTHING — no lease objects, no lease traffic, no
+    # replica metrics: the single-replica wire is byte-identical, per
+    # the tracing/provenance/introspection off-path precedent.
+    partitions: int = 0
+    # this replica's identity on the lease plane (--replica-id); None =
+    # a generated karpenter-<hex> identity (fine for a single process,
+    # useless for operators correlating /debug/replicas across a fleet)
+    replica_id: Optional[str] = None
+    # partition/heartbeat lease duration in seconds (--lease-duration):
+    # the failover detection horizon — a dead replica's tenants are
+    # adoptable one lease duration (plus skew tolerance) after its last
+    # renew
+    lease_duration_s: float = 15.0
 
 
 class KarpenterRuntime:
@@ -359,10 +375,57 @@ class KarpenterRuntime:
                 if options.fused_tick else None
             ),
         )
-        # consolidation engine (opt-in): plans batched node drains
-        # through the shared solve service and actuates them through the
-        # ScalableNodeGroup controller below; its karpenter_consolidation_*
-        # gauges land in THIS runtime's registry
+        self._build_disruption_engines(options)
+        # Registration order = in-tick evaluation order. Producers run first
+        # so signals are fresh, then node groups observe, then the batched
+        # autoscaler decides — one tick moves a signal end to end (the
+        # reference's produce→scrape→poll chain costs up to 20s of interval
+        # latency; SURVEY.md §6).
+        backoff_journal = None
+        if self.recovery is not None:
+            backoff_journal = self.recovery.handle("backoff")
+        # the composed hook: recovery bookkeeping + the self-SLO
+        # evaluation, both once per manager tick (_on_tick)
+        tick_hook = self._on_tick
+        self._sng_controller = ScalableNodeGroupController(
+            self.cloud_provider, consolidator=self.consolidation,
+            preemptor=self.preemption,
+            warmpool=self.warmpool,
+            registry=self.registry,
+            circuit_failure_threshold=options.circuit_failure_threshold,
+            circuit_reset_s=options.circuit_reset_s,
+            clock=self.clock,
+            recovery=self.recovery,
+        )
+        self.manager = Manager(
+            self.store, clock=self.clock, registry=self.registry,
+            solver_service=self.solver_service,
+            backoff_base_s=options.backoff_base_s,
+            backoff_cap_s=options.backoff_cap_s,
+            tick_hook=tick_hook,
+            recovery_journal=backoff_journal,
+            event_driven=options.event_driven,
+            event_debounce_s=options.event_debounce_s,
+            event_thread=options.event_thread,
+        ).register(
+            MetricsProducerController(self.producer_factory),
+            self._sng_controller,
+            HorizontalAutoscalerController(
+                self.batch_autoscaler, solver_service=self.solver_service
+            ),
+        )
+        self._build_tenancy(options)
+        self._build_replication(options)
+        self._build_selfslo(options)
+        self._finish_recovery_boot()
+        self._maybe_prewarm(options)
+
+    def _build_disruption_engines(self, options: Options) -> None:
+        """The opt-in disruption engines (consolidation + preemption),
+        coordinated both ways: preemption skips consolidation's
+        in-flight nodes, and consolidation's candidate gate consults
+        preemption's holds (node_guard). Their gauges land in THIS
+        runtime's registry."""
         self.consolidation = None
         if options.consolidate:
             from karpenter_tpu.consolidation import ConsolidationEngine
@@ -404,48 +467,6 @@ class KarpenterRuntime:
                 self.consolidation.node_guard = (
                     self.preemption.active_nodes
                 )
-        # Registration order = in-tick evaluation order. Producers run first
-        # so signals are fresh, then node groups observe, then the batched
-        # autoscaler decides — one tick moves a signal end to end (the
-        # reference's produce→scrape→poll chain costs up to 20s of interval
-        # latency; SURVEY.md §6).
-        backoff_journal = None
-        if self.recovery is not None:
-            backoff_journal = self.recovery.handle("backoff")
-        # the composed hook: recovery bookkeeping + the self-SLO
-        # evaluation, both once per manager tick (_on_tick)
-        tick_hook = self._on_tick
-        self._sng_controller = ScalableNodeGroupController(
-            self.cloud_provider, consolidator=self.consolidation,
-            preemptor=self.preemption,
-            warmpool=self.warmpool,
-            registry=self.registry,
-            circuit_failure_threshold=options.circuit_failure_threshold,
-            circuit_reset_s=options.circuit_reset_s,
-            clock=self.clock,
-            recovery=self.recovery,
-        )
-        self.manager = Manager(
-            self.store, clock=self.clock, registry=self.registry,
-            solver_service=self.solver_service,
-            backoff_base_s=options.backoff_base_s,
-            backoff_cap_s=options.backoff_cap_s,
-            tick_hook=tick_hook,
-            recovery_journal=backoff_journal,
-            event_driven=options.event_driven,
-            event_debounce_s=options.event_debounce_s,
-            event_thread=options.event_thread,
-        ).register(
-            MetricsProducerController(self.producer_factory),
-            self._sng_controller,
-            HorizontalAutoscalerController(
-                self.batch_autoscaler, solver_service=self.solver_service
-            ),
-        )
-        self._build_tenancy(options)
-        self._build_selfslo(options)
-        self._finish_recovery_boot()
-        self._maybe_prewarm(options)
 
     def _maybe_prewarm(self, options: Options) -> None:
         """Boot-time compile pre-warm (docs/solver-service.md "Compile
@@ -487,6 +508,41 @@ class KarpenterRuntime:
         self.tenant_scheduler = MultiTenantScheduler(
             self.tenancy, self.solver_service,
             deadline_s=options.tenant_deadline_s,
+        )
+
+    def _build_replication(self, options: Options) -> None:
+        """Replicated control plane (docs/resilience.md "Replicated
+        control plane"): with --partitions, this process becomes one
+        leader-elected replica — per-partition CAS leases over the
+        store, rendezvous-hash tenant assignment, fenced tenant handoff
+        on the per-tenant journal dirs, all advanced once per manager
+        tick. partitions=0 (the default) builds nothing: no Lease
+        objects, no lease traffic, no karpenter_replica_* gauges — the
+        single-replica wire stays byte-identical."""
+        self.replication = None
+        if options.partitions <= 0:
+            return
+        from karpenter_tpu.replication import ReplicatedControlPlane
+
+        tenants_source = None
+        journal_dir_for = None
+        if self.tenancy is not None:
+            tenants_source = self.tenancy.tenants
+            journal_dir_for = self.tenancy.journal_dir_for
+        self.replication = ReplicatedControlPlane(
+            self.store,
+            replica_id=options.replica_id or None,
+            partitions=options.partitions,
+            lease_duration=options.lease_duration_s,
+            tenants_source=tenants_source,
+            journal_dir_for=journal_dir_for,
+            validator=getattr(
+                self.cloud_provider, "fence_validator", None
+            ),
+            warmup_ticks=options.recovery_warmup_ticks,
+            registry=self.registry,
+            clock=self.clock,
+            recorder=self.flight_recorder,
         )
 
     @staticmethod
@@ -554,6 +610,13 @@ class KarpenterRuntime:
             # introspection plane is off or the backend reports no
             # memory stats
             memory_source=self.solver_introspection.memory_source,
+            # the fifth source (replication/plane.py): lease renew
+            # failures / in-flight handoffs burn budget — quiet (None)
+            # in the single-replica deployment
+            replica_source=(
+                self.replication.slo_source
+                if self.replication is not None else None
+            ),
             recorder=self.flight_recorder,
         )
 
@@ -567,6 +630,11 @@ class KarpenterRuntime:
         tick just hit."""
         if self.recovery is not None:
             self.recovery.on_tick()
+        replication = getattr(self, "replication", None)
+        if replication is not None:
+            # the lease round + fenced handoffs run BEFORE the self-SLO
+            # evaluation so a mid-failover tick burns budget as one
+            replication.on_tick()
         introspection = getattr(self, "solver_introspection", None)
         if introspection is not None:
             introspection.on_tick()
@@ -691,6 +759,11 @@ class KarpenterRuntime:
     def close(self) -> None:
         if self.manager is not None:
             self.manager.close()
+        if getattr(self, "replication", None) is not None:
+            # surrender leases BEFORE the tenancy teardown: successors
+            # can start adopting while this process finishes closing
+            self.replication.close()
+            self.replication = None
         if self.tenancy is not None:
             self.tenancy.close()
             self.tenancy = None

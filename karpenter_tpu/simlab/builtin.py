@@ -280,6 +280,23 @@ def _run_restart_storm(args, store) -> int:
     return 0
 
 
+def _run_failover(args, store) -> int:
+    # self-contained replay (own store/planes/journal root): a seeded
+    # leader-kill failover pinning the replicated-control-plane
+    # contract — fenced handoff, exactly-once actuation across the
+    # handoff, reconvergence, stale-write rejection
+    from karpenter_tpu.simulate import simulate_failover
+
+    report = simulate_failover(
+        replicas=args.replicas,
+        seed=_resolved_seed(args, 0),
+        journal_dir=args.journal_dir,
+        warmup_ticks=args.recovery_warmup_ticks,
+    )
+    print(json.dumps(report, indent=2, sort_keys=True))
+    return 0
+
+
 def _run_preempt(args, store) -> int:
     # self-contained replay (no live store, no provider): a seeded
     # spot-reclaim storm over mixed on-demand/spot pools
@@ -369,9 +386,9 @@ def _run_karpenter(args, store) -> int:
 
 def _select_trace(args) -> bool:
     return bool(args.trace_export) and not (
-        args.forecast or args.restart_storm or args.preempt
-        or args.consolidate or args.what_if or args.cost
-        or args.multitenant or args.eventloop
+        args.forecast or args.restart_storm or args.failover
+        or args.preempt or args.consolidate or args.what_if
+        or args.cost or args.multitenant or args.eventloop
     )
 
 
@@ -455,6 +472,17 @@ register_scenario(Scenario(
     select=lambda args: bool(args.restart_storm),
     run=_run_restart_storm,
     trails=_trails_theme(spike=50.0, fault_probability=0.25),
+))
+
+register_scenario(Scenario(
+    name="failover",
+    description="seeded leader-kill over replicated solver replicas "
+    "(fenced handoff + reconvergence)",
+    flags="--failover",
+    order=72,
+    select=lambda args: bool(args.failover),
+    run=_run_failover,
+    trails=_trails_theme(spike=50.0, fault_probability=0.3),
 ))
 
 register_scenario(Scenario(

@@ -372,8 +372,8 @@ class TestPolicySearch:
 class TestScenarioRegistry:
     EXPECTED = (
         "trace", "constraints", "eventloop", "multitenant", "cost",
-        "forecast", "restart-storm", "preempt", "consolidate",
-        "what-if", "karpenter",
+        "forecast", "restart-storm", "failover", "preempt",
+        "consolidate", "what-if", "karpenter",
     )
 
     @staticmethod
@@ -381,8 +381,8 @@ class TestScenarioRegistry:
         base = dict(
             trace_export=None, constraints=False, eventloop=False,
             multitenant=False, cost=False, forecast=False,
-            restart_storm=False, preempt=False, consolidate=False,
-            what_if=None, sim_seed=None,
+            restart_storm=False, failover=False, preempt=False,
+            consolidate=False, what_if=None, sim_seed=None,
         )
         base.update(over)
         return Namespace(**base)
