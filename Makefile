@@ -89,6 +89,12 @@ bench-cost: ## Batched multi-objective cost/SLO refine vs per-HA sequential loop
 		--backend xla --iters 10 \
 		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
 
+bench-poolgroup: ## One batched joint pool-group dispatch vs the groups*pools per-pool cost dispatches it replaces (64 groups x 4 pools, numpy + cost-ladder parity pinned); appends a BENCHMARKS row + publishes to BASELINE.json
+	$(PYTHON) bench.py --poolgroup --poolgroup-groups 64 \
+		--poolgroup-pools 4 --poolgroup-metrics 3 \
+		--backend xla --iters 10 \
+		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
+
 bench-journal: ## Protective-state journal overhead on the reconcile hot path (target <5% tick-latency regression); appends a BENCHMARKS row + publishes to BASELINE.json
 	$(PYTHON) bench.py --journal --journal-ticks 40 \
 		--publish-baseline --append-benchmarks docs/BENCHMARKS.md
@@ -191,5 +197,5 @@ kind-smoke: ## Deploy smoke on kind: image -> apply -> pod Ready -> one HA end t
 	bench-forecast bench-preempt bench-cost bench-journal bench-trace \
 	bench-provenance bench-resident bench-shard bench-multitenant \
 	bench-eventloop bench-introspect bench-constraints test-simlab \
-	bench-simlab bench-fusedtick bench-failover dryrun \
+	bench-simlab bench-fusedtick bench-failover bench-poolgroup dryrun \
 	image publish apply delete kind-load conformance kind-smoke

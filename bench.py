@@ -865,6 +865,35 @@ def main() -> None:  # lint: allow-complexity â€” bench config dispatch, one arm
         help="with --cost: metrics per autoscaler row",
     )
     ap.add_argument(
+        "--poolgroup",
+        action="store_true",
+        help="benchmark the joint pool-group allocation "
+        "(ops/poolgroup.py via karpenter_tpu/poolgroups): "
+        "--poolgroup-groups groups' candidate ladders scored in ONE "
+        "batched dispatch vs the groups*pools per-pool cost dispatches "
+        "the joint plane replaces; pins XLA == numpy bit-parity on "
+        "every output leaf AND joint == per-pool cost ladder under "
+        "slack constraints before timing",
+    )
+    ap.add_argument(
+        "--poolgroup-groups",
+        type=int,
+        default=64,
+        help="with --poolgroup: pool groups in the fleet",
+    )
+    ap.add_argument(
+        "--poolgroup-pools",
+        type=int,
+        default=4,
+        help="with --poolgroup: member pools per group (2..4)",
+    )
+    ap.add_argument(
+        "--poolgroup-metrics",
+        type=int,
+        default=3,
+        help="with --poolgroup: metrics per member pool",
+    )
+    ap.add_argument(
         "--multitenant",
         action="store_true",
         help="benchmark the multi-tenant control plane "
@@ -1266,6 +1295,22 @@ def main() -> None:  # lint: allow-complexity â€” bench config dispatch, one arm
         ap.error("--cost-rows must be >= 2")
     if args.cost_metrics < 1:
         ap.error("--cost-metrics must be >= 1")
+    if args.poolgroup and (
+        args.mesh or args.e2e or args.decide or args.clusters
+        or args.solver_service or args.hotpath or args.consolidate
+        or args.forecast or args.preempt or args.journal or args.trace
+        or args.shard or args.provenance or args.cost
+    ):
+        ap.error(
+            "--poolgroup builds its own workload (a fleet of pool "
+            "groups); it cannot combine with other modes"
+        )
+    if args.poolgroup_groups < 1:
+        ap.error("--poolgroup-groups must be >= 1")
+    if not 2 <= args.poolgroup_pools <= 4:
+        ap.error("--poolgroup-pools must be in 2..4")
+    if args.poolgroup_metrics < 1:
+        ap.error("--poolgroup-metrics must be >= 1")
     if args.multitenant and (
         args.mesh or args.e2e or args.decide or args.clusters
         or args.solver_service or args.hotpath or args.consolidate
@@ -1402,7 +1447,8 @@ def main() -> None:  # lint: allow-complexity â€” bench config dispatch, one arm
     if (args.publish_baseline or args.append_benchmarks) and not (
         args.solver_service or args.consolidate or args.hotpath
         or args.forecast or args.preempt or args.journal or args.shard
-        or args.trace or args.cost or args.multitenant
+        or args.trace or args.cost or args.poolgroup
+        or args.multitenant
         or args.provenance or args.resident or args.eventloop
         or args.introspect or args.constraints or args.simlab
         or args.fusedtick or args.failover
@@ -1410,7 +1456,8 @@ def main() -> None:  # lint: allow-complexity â€” bench config dispatch, one arm
         ap.error(
             "--publish-baseline/--append-benchmarks only apply to "
             "--solver-service/--consolidate/--hotpath/--forecast/"
-            "--preempt/--journal/--shard/--trace/--cost/--multitenant/"
+            "--preempt/--journal/--shard/--trace/--cost/--poolgroup/"
+            "--multitenant/"
             "--provenance/--resident/--eventloop/--introspect/"
             "--constraints/--simlab/--fusedtick/--failover (nothing "
             "would be published otherwise)"
@@ -1499,6 +1546,14 @@ def main() -> None:  # lint: allow-complexity â€” bench config dispatch, one arm
             f"tenant clusters x {args.tenant_rows} autoscalers "
             f"(cross-tenant concatenated decide+cost vs sequential "
             f"per-tenant loop; concat == independent parity pinned)"
+        )
+    elif args.poolgroup:
+        metric = (
+            f"joint pool-group allocation p50, "
+            f"{args.poolgroup_groups} groups x {args.poolgroup_pools} "
+            f"pools (one batched dispatch vs the per-pool cost "
+            f"dispatches it replaces; numpy + cost-ladder parity "
+            f"pinned)"
         )
     elif args.cost:
         metric = (
@@ -3289,6 +3344,9 @@ def run(args, metric: str, note: str) -> None:  # lint: allow-complexity â€” ben
     if args.multitenant:
         run_multitenant(args, metric, note)
         return
+    if args.poolgroup:
+        run_poolgroup(args, metric, note)
+        return
     if args.cost:
         run_cost(args, metric, note)
         return
@@ -4523,6 +4581,240 @@ def run_cost(args, metric: str, note: str) -> None:  # lint: allow-complexity â€
     emit(
         f"{metric} ({jax.default_backend()})",
         record["batched_p50_ms"],
+        note=f"{note}; {extra}" if note else extra,
+        against_baseline=False,
+    )
+
+
+def build_poolgroup_inputs(groups: int, pools: int, metrics: int,
+                           seed: int):
+    """A fleet of pool groups with every member pool live and every
+    coupling SLACK (ratios/budget invalid, tier penalties zero): the
+    one configuration where the joint program is provably the per-pool
+    cost ladder bit for bit, so the timed comparison is the same math
+    in two dispatch shapes â€” the bench measures the dispatch collapse,
+    not a different algorithm. The masked constraint operands still
+    run inside the joint program, so its timing is honest for the
+    enforcing case too."""
+    from karpenter_tpu.ops.poolgroup import RATIO_SLOTS, PoolGroupInputs
+
+    rng = np.random.RandomState(seed)
+    G, P, M = groups, pools, metrics
+    base = rng.randint(1, 200, (G, P)).astype(np.int32)
+    ratio_a = rng.randint(0, P, (G, RATIO_SLOTS)).astype(np.int32)
+    return PoolGroupInputs(
+        base_desired=base,
+        min_replicas=np.maximum(base - 50, 0).astype(np.int32),
+        max_replicas=(base + rng.randint(50, 500, (G, P))).astype(
+            np.int32
+        ),
+        unit_cost=rng.choice([0.07, 0.19, 1.0, 4.8], (G, P)).astype(
+            np.float32
+        ),
+        slo_weight=rng.choice([0.0, 5.0, 50.0, 500.0], (G, P)).astype(
+            np.float32
+        ),
+        max_hourly_cost=rng.choice([0.0, 25.0, 250.0], (G, P)).astype(
+            np.float32
+        ),
+        tier_penalty=np.zeros((G, P), np.float32),
+        pool_valid=np.ones((G, P), bool),
+        slo_target=rng.uniform(0.5, 10, (G, P, M)).astype(np.float32),
+        demand_mu=rng.uniform(0, 1000, (G, P, M)).astype(np.float32),
+        demand_sigma=rng.choice([0.0, 5.0, 50.0], (G, P, M)).astype(
+            np.float32
+        ),
+        demand_valid=rng.rand(G, P, M) > 0.1,
+        ratio_a=ratio_a,
+        ratio_b=((ratio_a + 1) % P).astype(np.int32),
+        ratio_min_num=np.zeros((G, RATIO_SLOTS), np.int32),
+        ratio_min_den=np.ones((G, RATIO_SLOTS), np.int32),
+        ratio_max_num=np.zeros((G, RATIO_SLOTS), np.int32),
+        ratio_max_den=np.zeros((G, RATIO_SLOTS), np.int32),
+        ratio_valid=np.zeros((G, RATIO_SLOTS), bool),
+        group_budget=np.zeros(G, np.float32),
+        group_valid=np.zeros(G, bool),
+    )
+
+
+def _poolgroup_record(args, backend, joint, per_pool) -> dict:
+    joint_p50 = float(np.percentile(joint, 50))
+    loop_p50 = float(np.percentile(per_pool, 50))
+    n = args.poolgroup_groups * args.poolgroup_pools
+    return {
+        "config": f"{args.poolgroup_groups} pool groups x "
+                  f"{args.poolgroup_pools} pools x "
+                  f"{args.poolgroup_metrics} metrics joint allocation",
+        "backend": backend,
+        "groups": args.poolgroup_groups,
+        "pools": args.poolgroup_pools,
+        "metrics": args.poolgroup_metrics,
+        "joint_p50_ms": round(joint_p50, 3),
+        "per_pool_p50_ms": round(loop_p50, 3),
+        "joint_pools_ps": round(n * 1000.0 / joint_p50, 1),
+        "per_pool_pools_ps": round(n * 1000.0 / loop_p50, 1),
+        "speedup": round(loop_p50 / joint_p50, 2),
+        "dispatches_joint": 1,
+        "dispatches_per_pool": n,
+        "parity": "bitwise",
+    }
+
+
+def _append_poolgroup_row(path: str, record: dict) -> None:
+    marker = "## Pool-group joint allocation (make bench-poolgroup)"
+    header = (
+        f"\n{marker}\n\n"
+        "One batched joint pool-group dispatch (every group's "
+        "cross-product candidate ladder scored together, constraint "
+        "operands masked in-program) vs. the groups*pools per-pool "
+        "cost dispatches the joint plane replaces. Before timing, XLA "
+        "== numpy bit-parity is asserted on every output leaf AND the "
+        "joint selection under slack constraints is asserted "
+        "bit-identical to the per-pool cost ladder â€” same math, two "
+        "dispatch shapes.\n\n"
+        "| Date | Backend | Config | Joint p50 (ms) | Per-pool p50 "
+        "(ms) | Dispatches | Speedup |\n"
+        "|---|---|---|---|---|---|---|\n"
+    )
+    date = datetime.date.today().isoformat()
+    row = (
+        f"| {date} | {record['backend']} | {record['config']} "
+        f"| {record['joint_p50_ms']} | {record['per_pool_p50_ms']} "
+        f"| 1 vs {record['dispatches_per_pool']} "
+        f"| {record['speedup']}x |\n"
+    )
+    _append_table_row(path, marker, header, row)
+
+
+def run_poolgroup(args, metric: str, note: str) -> None:  # lint: allow-complexity â€” bench arm: two parity pins + two timed dispatch shapes inline
+    """One batched joint pool-group dispatch vs the per-pool cost
+    dispatches it replaces (docs/poolgroups.md). The workload keeps
+    every coupling slack so the joint program's selection is provably
+    the per-pool cost ladder bit for bit (the wire-compat property
+    tests/test_poolgroup.py pins); the timed gap is then pure dispatch
+    shape â€” one [G, P, ...] program vs G*P [1, ...] programs, the
+    second compiled once and reused."""
+    import dataclasses
+
+    import jax
+
+    from karpenter_tpu.ops.cost import CostInputs, cost_jit
+    from karpenter_tpu.ops.poolgroup import (
+        PoolGroupOutputs,
+        poolgroup_jit,
+        poolgroup_numpy,
+    )
+
+    print(
+        f"backend={jax.default_backend()} devices={jax.devices()}",
+        file=sys.stderr,
+    )
+    inputs = build_poolgroup_inputs(
+        args.poolgroup_groups, args.poolgroup_pools,
+        args.poolgroup_metrics, args.seed,
+    )
+    flat = CostInputs(
+        base_desired=inputs.base_desired.reshape(-1),
+        min_replicas=inputs.min_replicas.reshape(-1),
+        max_replicas=inputs.max_replicas.reshape(-1),
+        unit_cost=inputs.unit_cost.reshape(-1),
+        slo_weight=inputs.slo_weight.reshape(-1),
+        max_hourly_cost=inputs.max_hourly_cost.reshape(-1),
+        slo_valid=inputs.pool_valid.reshape(-1),
+        slo_target=inputs.slo_target.reshape(
+            -1, inputs.slo_target.shape[-1]
+        ),
+        demand_mu=inputs.demand_mu.reshape(
+            -1, inputs.demand_mu.shape[-1]
+        ),
+        demand_sigma=inputs.demand_sigma.reshape(
+            -1, inputs.demand_sigma.shape[-1]
+        ),
+        demand_valid=inputs.demand_valid.reshape(
+            -1, inputs.demand_valid.shape[-1]
+        ),
+    )
+    n = args.poolgroup_groups * args.poolgroup_pools
+    rows = [
+        dataclasses.replace(
+            flat,
+            **{
+                f.name: np.asarray(getattr(flat, f.name))[i: i + 1]
+                for f in dataclasses.fields(flat)
+            },
+        )
+        for i in range(n)
+    ]
+    # parity pin 1 (the bench's acceptance gate): joint device == numpy
+    # mirror, bit for bit, on every output leaf of the timed workload
+    joint_out = poolgroup_jit(inputs)
+    jax.block_until_ready(joint_out)
+    host_out = poolgroup_numpy(inputs)
+    for f in dataclasses.fields(PoolGroupOutputs):
+        a = np.asarray(getattr(joint_out, f.name))
+        b = np.asarray(getattr(host_out, f.name))
+        if not np.array_equal(a, b):
+            raise AssertionError(
+                f"poolgroup kernel parity violated on {f.name}: "
+                f"device != numpy mirror"
+            )
+    # parity pin 2 (the replaces claim): under slack couplings the joint
+    # selection IS the per-pool cost ladder â€” same math, so the timed
+    # comparison below measures dispatch shape and nothing else
+    flat_out = cost_jit(flat)
+    jax.block_until_ready(flat_out)
+    if not np.array_equal(
+        np.asarray(joint_out.desired).reshape(-1),
+        np.asarray(flat_out.desired),
+    ):
+        raise AssertionError(
+            "joint selection != per-pool cost ladder under slack "
+            "couplings â€” the dispatch comparison would be dishonest"
+        )
+    jax.block_until_ready(cost_jit(rows[0]))  # warm the per-pool shape
+
+    joint_times, per_pool_times = [], []
+    for _ in range(args.iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(poolgroup_jit(inputs))
+        joint_times.append((time.perf_counter() - t0) * 1e3)
+    for _ in range(args.iters):
+        t0 = time.perf_counter()
+        for row in rows:
+            jax.block_until_ready(cost_jit(row))
+        per_pool_times.append((time.perf_counter() - t0) * 1e3)
+
+    record = _poolgroup_record(
+        args, jax.default_backend(), joint_times, per_pool_times
+    )
+    record_evidence(
+        joint_iter_ms=[round(t, 4) for t in joint_times],
+        per_pool_iter_ms=[round(t, 4) for t in per_pool_times],
+        poolgroup=record,
+        transport_floor=measure_transport_floor(),
+    )
+    print(
+        f"joint p50={record['joint_p50_ms']}ms "
+        f"({record['joint_pools_ps']} pools/s, 1 dispatch) | per-pool "
+        f"p50={record['per_pool_p50_ms']}ms "
+        f"({record['per_pool_pools_ps']} pools/s, "
+        f"{record['dispatches_per_pool']} dispatches) | "
+        f"speedup={record['speedup']}x",
+        file=sys.stderr,
+    )
+    if args.publish_baseline:
+        _publish_to_baseline(
+            f"{record['config']} ({record['backend']})", record
+        )
+    if args.append_benchmarks:
+        _append_poolgroup_row(args.append_benchmarks, record)
+    extra = (
+        f"1 vs {record['dispatches_per_pool']} dispatches "
+        f"({record['speedup']}x); numpy + cost-ladder parity pinned"
+    )
+    emit(
+        f"{metric} ({jax.default_backend()})",
+        record["joint_p50_ms"],
         note=f"{note}; {extra}" if note else extra,
         against_baseline=False,
     )

@@ -194,6 +194,19 @@ def parse_args(argv=None) -> argparse.Namespace:
         "wire byte-identical",
     )
     parser.add_argument(
+        "--poolgroups",
+        action="store_true",
+        help="enable the joint pool-group allocator "
+        "(docs/poolgroups.md): PoolGroup CRDs name member autoscalers "
+        "with cross-pool ratio bands and a shared budget; members "
+        "leave the independent per-pool cost ladders and ride ONE "
+        "batched joint dispatch (SolverService.poolgroup). Off (the "
+        "default, or a fleet with no PoolGroup objects) keeps the "
+        "uncoordinated wire byte-identical. With --simulate: run the "
+        "seeded traffic-mix-shift world instead "
+        "(prefill/decode pools through a decode-heavy storm)",
+    )
+    parser.add_argument(
         "--compile-cache-dir",
         default=None,
         metavar="DIR",
@@ -865,6 +878,7 @@ def main(argv=None) -> int:
             event_debounce_s=args.event_debounce,
             prewarm_compile=args.prewarm_compile,
             fused_tick=args.fused_tick,
+            poolgroups=args.poolgroups,
             # already applied above (before the first compile); carried
             # on Options so embedded runtimes resolve identically
             compile_cache_dir=args.compile_cache_dir,

@@ -1072,15 +1072,24 @@ ZONE_LABEL = "topology.kubernetes.io/zone"
 RESERVATION_LABEL = "karpenter.sh/reservation"
 
 
-def zone_of(labels) -> str:
-    """Zone name from a node/group label set (a dict or an iterable of
-    (key, value) items — group profiles carry the latter); "" when the
-    group carries no zone label (capacity_tier_of idiom)."""
+def domain_of(labels, topology_key: str) -> str:
+    """Topology-domain name for an ARBITRARY node label axis — the value
+    of `topology_key` in a node/group label set (a dict or an iterable
+    of (key, value) items — group profiles carry the latter); "" when
+    the label is absent. The spread constraint plane balances over
+    whatever axis the spec names (zone, hostname, rack, ...); zone is
+    merely the default key."""
     items = labels.items() if isinstance(labels, dict) else labels
     for key, value in items:
-        if key == ZONE_LABEL:
+        if key == topology_key:
             return value
     return ""
+
+
+def zone_of(labels) -> str:
+    """Zone name from a node/group label set; "" when the group carries
+    no zone label (capacity_tier_of idiom)."""
+    return domain_of(labels, ZONE_LABEL)
 
 
 def reservation_of(labels) -> str:

@@ -32,6 +32,7 @@ from karpenter_tpu.api.core import (
 )
 from karpenter_tpu.api.horizontalautoscaler import HorizontalAutoscaler
 from karpenter_tpu.api.metricsproducer import MetricsProducer
+from karpenter_tpu.api.poolgroup import PoolGroup
 from karpenter_tpu.api.scalablenodegroup import ScalableNodeGroup
 from karpenter_tpu.utils.quantity import Quantity
 
@@ -40,12 +41,14 @@ CORE_API_VERSION = "v1"  # Node/Pod are core/v1 kinds
 AUTOSCALING_KINDS = (
     "HorizontalAutoscaler",
     "MetricsProducer",
+    "PoolGroup",
     "ScalableNodeGroup",
 )
 
 KINDS: Dict[str, type] = {
     "HorizontalAutoscaler": HorizontalAutoscaler,
     "MetricsProducer": MetricsProducer,
+    "PoolGroup": PoolGroup,
     "ScalableNodeGroup": ScalableNodeGroup,
     # core kinds so test fixtures can be manifests too
     "Node": Node,

@@ -84,7 +84,7 @@ class BatchAutoscaler:
     def __init__(
         self, metrics_client_factory, store: Store, clock=_time.time,
         decider=None, forecaster=None, cost_engine=None, tenant=None,
-        fused_tick_fn=None,
+        fused_tick_fn=None, pool_engine=None,
     ):
         self.metrics = metrics_client_factory
         self.store = store
@@ -109,6 +109,13 @@ class BatchAutoscaler:
         # cost as ONE device program. None = the chained per-stage
         # wire (bit-identical outputs; tests/test_fusedtick.py pins it).
         self.fused_tick_fn = fused_tick_fn
+        # joint pool-group allocation seam (--poolgroups, poolgroups/,
+        # docs/poolgroups.md): a PoolGroupEngine resolving PoolGroup
+        # membership per tick, EXCLUDING member rows from the cost
+        # engine's independent ladders and refining them in one joint
+        # dispatch instead. None (or a group-free fleet) = the
+        # uncoordinated wire, byte-identical.
+        self.pool_engine = pool_engine
         # Times enter the kernel as f32 seconds relative to this epoch so a
         # long-lived process never loses sub-second precision to f32.
         self.epoch = clock()
@@ -264,8 +271,14 @@ class BatchAutoscaler:
         provenance ledger batch (when enabled) annotated at each stage
         and committed once the final counts are known."""
         ledger_batch = self._begin_ledger(live)
+        # PoolGroup membership resolves ONCE per tick (store list +
+        # name matching); None = no group participates and every path
+        # below is byte-identical to the pre-subsystem wire
+        pg_plan = None
+        if self.pool_engine is not None:
+            pg_plan = self.pool_engine.plan(live)
         if self.fused_tick_fn is not None:
-            outputs = self._evaluate_fused(live, ledger_batch)
+            outputs = self._evaluate_fused(live, ledger_batch, pg_plan)
         else:
             # the forecast pass: ingest this tick's observations into
             # the history store and predict every eligible series in ONE
@@ -287,8 +300,25 @@ class BatchAutoscaler:
                 # the multi-objective pass (docs/cost.md): ONE batched
                 # refine of the whole fleet's desired counts; any
                 # failure returns the base outputs (never-block) and
-                # an SLO-free fleet returns the SAME object untouched
-                outputs = self.cost_engine.adjust(live, outputs)
+                # an SLO-free fleet returns the SAME object untouched.
+                # PoolGroup members skip the independent ladder — the
+                # joint pass below owns their counts this tick.
+                outputs = self.cost_engine.adjust(
+                    live, outputs,
+                    exclude=pg_plan.grouped if pg_plan is not None else None,
+                )
+                if ledger_batch is not None:
+                    ledger_batch.annotate(
+                        final_desired=np.asarray(
+                            outputs.desired
+                        )[:len(live)],
+                    )
+            if pg_plan is not None:
+                # the joint allocation (docs/poolgroups.md): every
+                # group's K^P candidate ladder in ONE batched dispatch,
+                # desired overlaid at the member rows; never-block —
+                # failure leaves the uncoordinated counts standing
+                outputs = self.pool_engine.refine(live, pg_plan, outputs)
                 if ledger_batch is not None:
                     ledger_batch.annotate(
                         final_desired=np.asarray(
@@ -301,7 +331,7 @@ class BatchAutoscaler:
             default_ledger().commit(ledger_batch)
         return outputs
 
-    def _evaluate_fused(self, live: List[_Row], ledger_batch):  # lint: allow-complexity — three optional stages x plan/commit halves around ONE dispatch; splitting would scatter each stage's paired halves
+    def _evaluate_fused(self, live: List[_Row], ledger_batch, pg_plan=None):  # lint: allow-complexity — four optional stages x plan/commit halves around ONE dispatch; splitting would scatter each stage's paired halves
         """The fused steady-state tick (--fused-tick, ops/fusedtick.py):
         forecast → decide → cost as ONE SolverService.fused_tick call,
         with each engine's host bookkeeping split into plan/commit
@@ -335,9 +365,19 @@ class BatchAutoscaler:
                 live,
                 int(inputs.spec_replicas.shape[0]),
                 int(inputs.metric_value.shape[1]),
+                exclude=pg_plan.grouped if pg_plan is not None else None,
             )
             if cost_plan is not None:
                 kw.update(cost_plan[1])
+        pg_ops = None
+        if pg_plan is not None:
+            pg_ops = self.pool_engine.fused_operands(
+                live, pg_plan,
+                int(inputs.spec_replicas.shape[0]),
+                int(inputs.metric_value.shape[1]),
+            )
+            if pg_ops is not None:
+                kw["poolgroup"] = pg_ops
         with solver_trace("autoscaler.fused_tick"):
             out = self.fused_tick_fn(
                 FT.FusedTickInputs(decision=inputs, **kw)
@@ -354,6 +394,16 @@ class BatchAutoscaler:
         if cost_plan is not None and out.cost is not None:
             outputs = self.cost_engine.fused_commit(
                 live, cost_plan[0], outputs, out.cost
+            )
+            if ledger_batch is not None:
+                ledger_batch.annotate(
+                    final_desired=np.asarray(
+                        outputs.desired
+                    )[:len(live)],
+                )
+        if pg_ops is not None and out.poolgroup is not None:
+            outputs = self.pool_engine.fused_commit(
+                live, pg_plan, outputs, out.poolgroup
             )
             if ledger_batch is not None:
                 ledger_batch.annotate(

@@ -24,6 +24,7 @@ import yaml
 
 from karpenter_tpu.api.horizontalautoscaler import HorizontalAutoscaler
 from karpenter_tpu.api.metricsproducer import MetricsProducer
+from karpenter_tpu.api.poolgroup import PoolGroup
 from karpenter_tpu.api.scalablenodegroup import ScalableNodeGroup
 from karpenter_tpu.api.serialization import _FIELD_TO_KEY, snake_to_camel
 from karpenter_tpu.utils.quantity import Quantity
@@ -70,6 +71,23 @@ CRD_KINDS = {
                 "name": "Ready",
                 "type": "string",
                 "jsonPath": '.status.conditions[?(@.type=="Ready")].status',
+            },
+        ],
+    },
+    "PoolGroup": {
+        "cls": PoolGroup,
+        "plural": "poolgroups",
+        "shortNames": ["pg"],
+        "printcolumns": [
+            {
+                "name": "Coordinated",
+                "type": "boolean",
+                "jsonPath": ".status.coordinated",
+            },
+            {
+                "name": "Hourly",
+                "type": "number",
+                "jsonPath": ".status.expectedHourly",
             },
         ],
     },

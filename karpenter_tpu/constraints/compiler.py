@@ -34,10 +34,12 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from karpenter_tpu.api.core import (
+    ZONE_LABEL,
+    domain_of,
     matches_selector,
     reservation_of,
-    zone_of,
 )
+from karpenter_tpu.constraints.spec import spread_topology_key
 
 
 def compile_membership(label_sets, labels_id, groups) -> np.ndarray:
@@ -61,9 +63,13 @@ class ConstraintMeta:
     device."""
 
     reservations: List[str]  # claim id c = 1 + index
-    zones: List[str]  # domain d = index; sink domain = len(zones)
+    zones: List[str]  # domain d = index; sink domain = len(zones) —
+    #                   domains of `topology_key` (zone only by default)
     spread_names: List[str]  # slot s = 1 + index
     compact_names: List[str]  # pack class = 1 + index
+    # the one label axis this set's spread groups balance over (the
+    # validated single-key invariant, constraints/spec.py)
+    topology_key: str = ZONE_LABEL
 
 
 def constraint_meta(groups, profiles) -> ConstraintMeta:
@@ -71,15 +77,17 @@ def constraint_meta(groups, profiles) -> ConstraintMeta:
         reservation_of(labels) for _, labels, _ in profiles
     }
     spec_claims = {g.reservation for g in groups if g.reservation}
+    key = spread_topology_key(groups)
     return ConstraintMeta(
         reservations=sorted(
             (spec_claims | group_reservations) - {""}
         ),
         zones=sorted(
-            {zone_of(labels) for _, labels, _ in profiles} - {""}
+            {domain_of(labels, key) for _, labels, _ in profiles} - {""}
         ),
         spread_names=[g.name for g in groups if g.spread is not None],
         compact_names=[g.name for g in groups if g.compact],
+        topology_key=key,
     )
 
 
@@ -224,7 +232,7 @@ def compile_rows(membership, weights, valid, profiles, groups):  # lint: allow-c
         group_domain = np.zeros(n_groups, np.int32)
         sink = len(meta.zones)
         for t, (_, labels, _) in enumerate(profiles):
-            zone = zone_of(labels)
+            zone = domain_of(labels, meta.topology_key)
             group_domain[t] = (
                 meta.zones.index(zone) if zone else sink
             )

@@ -34,20 +34,22 @@ from karpenter_tpu.api.core import ZONE_LABEL
 
 @dataclass(slots=True)
 class SpreadSpec:
-    """Topology-spread over zones. Only the zone topology key is
-    supported (the domain axis group profiles carry); maxSkew >= 1 is
+    """Topology-spread over the domains of ANY node label axis —
+    topologyKey defaults to the zone label but accepts hostname, rack,
+    or any custom key the fleet's groups carry. maxSkew >= 1 is
     accepted and always satisfied because the compiler emits BALANCED
-    per-domain quotas (skew <= 1)."""
+    per-domain quotas (skew <= 1).
+
+    One constraint set shares ONE topology key across all its spread
+    groups (the solver ships a single group->domain operand;
+    validate_constraints enforces the invariant at admission)."""
 
     topology_key: str = ZONE_LABEL
     max_skew: int = 1
 
     def validate(self) -> None:
-        if self.topology_key != ZONE_LABEL:
-            raise ValueError(
-                f"spread.topologyKey must be {ZONE_LABEL!r} "
-                f"(got {self.topology_key!r})"
-            )
+        if not self.topology_key:
+            raise ValueError("spread.topologyKey must be a non-empty label key")
         if self.max_skew < 1:
             raise ValueError("spread.maxSkew must be >= 1")
 
@@ -99,6 +101,21 @@ def validate_constraints(groups: List[ConstraintGroup]) -> None:
                 f"duplicate constraint group name {group.name!r}"
             )
         seen.add(group.name)
+    keys = {g.spread.topology_key for g in groups if g.spread is not None}
+    if len(keys) > 1:
+        raise ValueError(
+            "all spread groups in one constraint set must share a single "
+            f"topologyKey, got {sorted(keys)}"
+        )
+
+
+def spread_topology_key(groups) -> str:
+    """The single domain axis this constraint set spreads on (the
+    validated invariant above); the zone label when nothing spreads."""
+    for group in groups:
+        if group.spread is not None:
+            return group.spread.topology_key
+    return ZONE_LABEL
 
 
 def canonical_constraints(groups) -> tuple:

@@ -130,15 +130,20 @@ class CostEngine:
 
     # -- the per-tick pass -------------------------------------------------
 
-    def adjust(self, rows: List, outputs: D.DecisionOutputs):
+    def adjust(self, rows: List, outputs: D.DecisionOutputs, exclude=None):
         """The BatchAutoscaler's post-decide call: refine the fleet's
         desired counts in one batched dispatch. Returns `outputs`
         unchanged (the SAME object) when no row opts in; never raises
-        (module docstring never-block contract)."""
+        (module docstring never-block contract). `exclude` drops row
+        indices whose counts another refiner owns this tick (PoolGroup
+        members — docs/poolgroups.md): they skip the independent ladder
+        entirely, and _apply's retire diff drops their cost series the
+        moment they join a group."""
         slo_rows = [
             i for i, row in enumerate(rows)
             if getattr(row.ha.spec.behavior, "slo", None) is not None
             and not getattr(row, "custom", False)
+            and (exclude is None or i not in exclude)
         ]
         if not slo_rows:
             for row in rows:
@@ -160,7 +165,7 @@ class CostEngine:
                     self._c_blind.inc(name, ns)
             return outputs
 
-    def fused_operands(self, rows: List, n: int, m: int):
+    def fused_operands(self, rows: List, n: int, m: int, exclude=None):
         """Host half of the fused tick's cost stage (ops/fusedtick.py):
         the _build_inputs surface SPLIT at the demand seam. Spec bounds
         (ha_min/ha_max), pricing, and SLO targets assemble as before,
@@ -173,11 +178,14 @@ class CostEngine:
         declared AND observed finite), so its expiry side effects match
         the chained tick too. Returns (slo_rows, operands dict), or
         None when no row opts in (adjust()'s retire semantics apply) or
-        the assembly fails (the cost-blind posture, already stamped)."""
+        the assembly fails (the cost-blind posture, already stamped).
+        `exclude` mirrors adjust()'s: rows a PoolGroup owns this tick
+        skip the independent ladder."""
         slo_rows = [
             i for i, row in enumerate(rows)
             if getattr(row.ha.spec.behavior, "slo", None) is not None
             and not getattr(row, "custom", False)
+            and (exclude is None or i not in exclude)
         ]
         if not slo_rows:
             for row in rows:

@@ -40,6 +40,11 @@ Points instrumented across the stack (docs/resilience.md):
                       to the chained per-stage path, then numpy, and
                       feed the FSM (docs/solver-service.md "Fused
                       tick")
+  poolgroup.solve     device path of the joint pool-group allocation
+                      (SolverService.poolgroup) — failures degrade to
+                      INDEPENDENT per-pool ladders for the tick
+                      (ratios advisory, never-block) and feed the FSM
+                      (docs/poolgroups.md)
   encoder.encode      snapshot -> solver-operand encode
   cloud.get_replicas  provider replica observation
   cloud.set_replicas  provider actuation

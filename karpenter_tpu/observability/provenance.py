@@ -101,6 +101,8 @@ _NUMERIC_COLUMNS = (
     ("budget_clamped", bool, False),
     ("movement_clamped", bool, False),
     ("cost_blind", bool, False),
+    ("pool_grouped", bool, False),
+    ("pool_joint_repair", bool, False),
     ("warm_headroom", np.int32, -1),
     ("admission_round", np.int16, -1),
     ("deferred", bool, False),

@@ -57,8 +57,47 @@ import numpy as np
 from ..forecast import models as M
 from . import cost as C
 from . import decision as D
+from . import poolgroup as PG
 
 _I32_MAX = np.int32(np.iinfo(np.int32).max)
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class PoolGroupOperands:
+    """The fused tick's optional joint-allocation stage (ops/poolgroup.py):
+    G pool groups x P pools, each pool a fleet row. Everything the
+    standalone PoolGroupEngine assembles EXCEPT what only exists
+    post-decide — the base desired counts and the movement bounds are
+    gathered/derived IN-DEVICE from the decide stage's fresh outputs
+    (pgMin/pgMax here are the SPEC bounds: HA [min, max] intersected
+    with the member's own tightening), and the demand overlay mirrors
+    the cost stage's seam: fresh in-device distribution over the
+    host-read prior."""
+
+    member_row: jax.Array  # i32[G, P] fleet row per pool (pad slots: 0)
+    pg_min: jax.Array  # i32[G, P] spec-bound floor (pre movement clamp)
+    pg_max: jax.Array  # i32[G, P] spec-bound ceiling
+    unit_cost: jax.Array  # f32[G, P]
+    slo_weight: jax.Array  # f32[G, P]
+    max_hourly_cost: jax.Array  # f32[G, P] per-pool budget
+    tier_penalty: jax.Array  # f32[G, P]
+    pool_valid: jax.Array  # bool[G, P]
+    slo_target: jax.Array  # f32[G, P, M]
+    observed: jax.Array  # f32[G, P, M]
+    demand_base_valid: jax.Array  # bool[G, P, M]
+    prior_point: jax.Array  # f32[G, P, M]
+    prior_sigma2: jax.Array  # f32[G, P, M]
+    prior_valid: jax.Array  # bool[G, P, M]
+    ratio_a: jax.Array  # i32[G, R]
+    ratio_b: jax.Array  # i32[G, R]
+    ratio_min_num: jax.Array  # i32[G, R]
+    ratio_min_den: jax.Array  # i32[G, R]
+    ratio_max_num: jax.Array  # i32[G, R]
+    ratio_max_den: jax.Array  # i32[G, R]
+    ratio_valid: jax.Array  # bool[G, R]
+    group_budget: jax.Array  # f32[G]
+    group_valid: jax.Array  # bool[G]
 
 
 @jax.tree_util.register_dataclass
@@ -96,6 +135,8 @@ class FusedTickInputs:
     prior_point: Optional[jax.Array] = None  # f32[N, M] host dist read
     prior_sigma2: Optional[jax.Array] = None  # f32[N, M]
     prior_valid: Optional[jax.Array] = None  # bool[N, M]
+    # -- joint pool-group stage (None = absent; docs/poolgroups.md) --
+    poolgroup: Optional[PoolGroupOperands] = None
 
 
 @jax.tree_util.register_dataclass
@@ -104,13 +145,17 @@ class FusedTickOutputs:
     decision: D.DecisionOutputs
     forecast: Optional[M.ForecastOutputs] = None
     cost: Optional[C.CostOutputs] = None
+    poolgroup: Optional[PG.PoolGroupOutputs] = None
 
 
 def programs(inputs: FusedTickInputs) -> int:
     """Device programs the CHAINED path needs for these operands (the
     fused path always needs exactly one)."""
-    return 1 + int(inputs.forecast is not None) + int(
-        inputs.slo_valid is not None
+    return (
+        1
+        + int(inputs.forecast is not None)
+        + int(inputs.slo_valid is not None)
+        + int(inputs.poolgroup is not None)
     )
 
 
@@ -177,10 +222,87 @@ def _demand_overlay(inputs, dout, dist):
     )
 
 
+def _pg_overlay(pg: PoolGroupOperands, final_desired, dout, dist):
+    """The cost→poolgroup seam: gather each pool's base from the tick's
+    post-cost desired, derive movement-clamped bounds from the decide
+    stage's fresh up_ceiling/down_floor (the engine clamp order: spec
+    bounds outrank the rate bound), and run the cost stage's demand
+    overlay per pool — fresh in-device distribution over the host-read
+    prior, gathered at each pool's fleet row."""
+    n = final_desired.shape[0]
+    rows = jnp.clip(pg.member_row, 0, n - 1)
+    valid = pg.pool_valid
+    base = jnp.where(valid, jnp.take(final_desired, rows), 0).astype(
+        jnp.int32
+    )
+    down = jnp.take(dout.down_floor, rows)
+    up = jnp.take(dout.up_ceiling, rows)
+    min_eff = jnp.where(
+        valid,
+        jnp.maximum(pg.pg_min, jnp.minimum(down, pg.pg_max)),
+        0,
+    ).astype(jnp.int32)
+    max_eff = jnp.where(
+        valid,
+        jnp.minimum(pg.pg_max, jnp.maximum(up, pg.pg_min)),
+        0,
+    ).astype(jnp.int32)
+    prior_point = pg.prior_point
+    prior_sigma2 = pg.prior_sigma2
+    have = pg.prior_valid
+    if dist is not None:
+        dist_point, dist_sigma2, dist_ok = dist  # [N, M] grids
+        g_ok = jnp.take(dist_ok, rows, axis=0)  # [G, P, M]
+        prior_point = jnp.where(
+            g_ok, jnp.take(dist_point, rows, axis=0), prior_point
+        )
+        prior_sigma2 = jnp.where(
+            g_ok, jnp.take(dist_sigma2, rows, axis=0), prior_sigma2
+        )
+        have = g_ok | have
+    observed = pg.observed
+    mu = jnp.where(
+        have & jnp.isfinite(prior_point),
+        jnp.maximum(observed, prior_point),
+        observed,
+    )
+    sigma = jnp.where(
+        have & jnp.isfinite(prior_sigma2) & (prior_sigma2 > 0),
+        jnp.sqrt(prior_sigma2),
+        jnp.float32(0.0),
+    )
+    dvalid = pg.demand_base_valid
+    mu = jnp.where(dvalid, mu, jnp.float32(0.0)).astype(jnp.float32)
+    sigma = jnp.where(dvalid, sigma, jnp.float32(0.0)).astype(jnp.float32)
+    return PG.PoolGroupInputs(
+        base_desired=base,
+        min_replicas=min_eff,
+        max_replicas=max_eff,
+        unit_cost=pg.unit_cost,
+        slo_weight=pg.slo_weight,
+        max_hourly_cost=pg.max_hourly_cost,
+        tier_penalty=pg.tier_penalty,
+        pool_valid=valid,
+        slo_target=pg.slo_target,
+        demand_mu=mu,
+        demand_sigma=sigma,
+        demand_valid=dvalid,
+        ratio_a=pg.ratio_a,
+        ratio_b=pg.ratio_b,
+        ratio_min_num=pg.ratio_min_num,
+        ratio_min_den=pg.ratio_min_den,
+        ratio_max_num=pg.ratio_max_num,
+        ratio_max_den=pg.ratio_max_den,
+        ratio_valid=pg.ratio_valid,
+        group_budget=pg.group_budget,
+        group_valid=pg.group_valid,
+    )
+
+
 def fused_tick(inputs: FusedTickInputs) -> FusedTickOutputs:
-    """The megakernel: forecast → decide → cost with every seam on
-    device. Traceable under jit; stage presence is pytree structure,
-    so each operand shape class compiles once."""
+    """The megakernel: forecast → decide → cost → poolgroup with every
+    seam on device. Traceable under jit; stage presence is pytree
+    structure, so each operand shape class compiles once."""
     dec = inputs.decision
     n = dec.spec_replicas.shape[0]
     m = dec.metric_value.shape[1]
@@ -211,7 +333,15 @@ def fused_tick(inputs: FusedTickInputs) -> FusedTickOutputs:
     cout = None
     if inputs.slo_valid is not None:
         cout = C.cost_decide(_demand_overlay(inputs, dout, dist))
-    return FusedTickOutputs(decision=dout, forecast=fout, cost=cout)
+    pout = None
+    if inputs.poolgroup is not None:
+        final = cout.desired if cout is not None else dout.desired
+        pout = PG.poolgroup_decide(
+            _pg_overlay(inputs.poolgroup, final, dout, dist)
+        )
+    return FusedTickOutputs(
+        decision=dout, forecast=fout, cost=cout, poolgroup=pout
+    )
 
 
 fused_tick_jit = jax.jit(fused_tick)
@@ -298,6 +428,74 @@ def _np_demand_overlay(inputs, dout, dist) -> C.CostInputs:
     )
 
 
+def _np_pg_overlay(
+    pg: PoolGroupOperands, final_desired, dout, dist
+) -> PG.PoolGroupInputs:
+    """Host mirror of _pg_overlay (same gather + overlay, np ops)."""
+    final_desired = np.asarray(final_desired, np.int32)
+    n = final_desired.shape[0]
+    rows = np.clip(np.asarray(pg.member_row, np.int32), 0, n - 1)
+    valid = np.asarray(pg.pool_valid, bool)
+    base = np.where(valid, final_desired[rows], 0).astype(np.int32)
+    down = np.asarray(dout.down_floor, np.int32)[rows]
+    up = np.asarray(dout.up_ceiling, np.int32)[rows]
+    pg_min = np.asarray(pg.pg_min, np.int32)
+    pg_max = np.asarray(pg.pg_max, np.int32)
+    min_eff = np.where(
+        valid, np.maximum(pg_min, np.minimum(down, pg_max)), 0
+    ).astype(np.int32)
+    max_eff = np.where(
+        valid, np.minimum(pg_max, np.maximum(up, pg_min)), 0
+    ).astype(np.int32)
+    prior_point = np.asarray(pg.prior_point, np.float32)
+    prior_sigma2 = np.asarray(pg.prior_sigma2, np.float32)
+    have = np.asarray(pg.prior_valid, bool)
+    if dist is not None:
+        dist_point, dist_sigma2, dist_ok = dist  # [N, M] grids
+        g_ok = dist_ok[rows]
+        prior_point = np.where(g_ok, dist_point[rows], prior_point)
+        prior_sigma2 = np.where(g_ok, dist_sigma2[rows], prior_sigma2)
+        have = g_ok | have
+    observed = np.asarray(pg.observed, np.float32)
+    with np.errstate(invalid="ignore"):
+        mu = np.where(
+            have & np.isfinite(prior_point),
+            np.maximum(observed, prior_point),
+            observed,
+        )
+        sigma = np.where(
+            have & np.isfinite(prior_sigma2) & (prior_sigma2 > 0),
+            np.sqrt(prior_sigma2),
+            np.float32(0.0),
+        )
+    dvalid = np.asarray(pg.demand_base_valid, bool)
+    mu = np.where(dvalid, mu, np.float32(0.0)).astype(np.float32)
+    sigma = np.where(dvalid, sigma, np.float32(0.0)).astype(np.float32)
+    return PG.PoolGroupInputs(
+        base_desired=base,
+        min_replicas=min_eff,
+        max_replicas=max_eff,
+        unit_cost=np.asarray(pg.unit_cost, np.float32),
+        slo_weight=np.asarray(pg.slo_weight, np.float32),
+        max_hourly_cost=np.asarray(pg.max_hourly_cost, np.float32),
+        tier_penalty=np.asarray(pg.tier_penalty, np.float32),
+        pool_valid=valid,
+        slo_target=np.asarray(pg.slo_target, np.float32),
+        demand_mu=mu,
+        demand_sigma=sigma,
+        demand_valid=dvalid,
+        ratio_a=np.asarray(pg.ratio_a, np.int32),
+        ratio_b=np.asarray(pg.ratio_b, np.int32),
+        ratio_min_num=np.asarray(pg.ratio_min_num, np.int32),
+        ratio_min_den=np.asarray(pg.ratio_min_den, np.int32),
+        ratio_max_num=np.asarray(pg.ratio_max_num, np.int32),
+        ratio_max_den=np.asarray(pg.ratio_max_den, np.int32),
+        ratio_valid=np.asarray(pg.ratio_valid, bool),
+        group_budget=np.asarray(pg.group_budget, np.float32),
+        group_valid=np.asarray(pg.group_valid, bool),
+    )
+
+
 def _to_host(out):
     return jax.tree_util.tree_map(lambda x: np.asarray(x), out)
 
@@ -307,6 +505,7 @@ def fused_tick_chained(
     forecast_fn: Optional[Callable] = None,
     decide_fn: Optional[Callable] = None,
     cost_fn: Optional[Callable] = None,
+    poolgroup_fn: Optional[Callable] = None,
 ) -> FusedTickOutputs:
     """One program per stage, host round-trip between each — the
     pre-fusion wire and the never-block fallback rung. np.asarray on
@@ -314,6 +513,7 @@ def fused_tick_chained(
     forecast_fn = forecast_fn or M.forecast_jit
     decide_fn = decide_fn or D.decide_jit
     cost_fn = cost_fn or C.cost_jit
+    poolgroup_fn = poolgroup_fn or PG.poolgroup_jit
     dec = inputs.decision
     n = int(np.asarray(dec.spec_replicas).shape[0])
     m = int(np.asarray(dec.metric_value).shape[1])
@@ -327,14 +527,28 @@ def fused_tick_chained(
     cout = None
     if inputs.slo_valid is not None:
         cout = _to_host(cost_fn(_np_demand_overlay(inputs, dout, dist)))
-    return FusedTickOutputs(decision=dout, forecast=fout, cost=cout)
+    pout = None
+    if inputs.poolgroup is not None:
+        final = cout.desired if cout is not None else dout.desired
+        pout = _to_host(
+            poolgroup_fn(
+                _np_pg_overlay(inputs.poolgroup, final, dout, dist)
+            )
+        )
+    return FusedTickOutputs(
+        decision=dout, forecast=fout, cost=cout, poolgroup=pout
+    )
 
 
 def fused_tick_numpy(inputs: FusedTickInputs) -> FusedTickOutputs:
-    """Pure-host floor of the never-block ladder: the three stage
-    mirrors joined by the same glue. Bitwise equal to fused_tick."""
+    """Pure-host floor of the never-block ladder: the stage mirrors
+    joined by the same glue. Bitwise equal to fused_tick."""
     return fused_tick_chained(
-        inputs, M.forecast_numpy, D.decide_numpy, C.cost_numpy
+        inputs,
+        M.forecast_numpy,
+        D.decide_numpy,
+        C.cost_numpy,
+        PG.poolgroup_numpy,
     )
 
 

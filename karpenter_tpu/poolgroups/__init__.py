@@ -1,0 +1,12 @@
+"""PoolGroups: coordinated joint allocation for interdependent pools.
+
+The declarative surface is api/poolgroup.py (the PoolGroup CRD), the
+batched joint kernel is ops/poolgroup.py, the service seam is
+SolverService.poolgroup, and this package's PoolGroupEngine is the
+host-side orchestration riding the BatchAutoscaler tick — see
+docs/poolgroups.md.
+"""
+
+from karpenter_tpu.poolgroups.engine import PoolGroupEngine
+
+__all__ = ["PoolGroupEngine"]

@@ -206,6 +206,14 @@ class Options:
     # instead of recompiling (utils/backend.configure_compile_cache).
     # None = env var only (the pre-flag wire).
     compile_cache_dir: Optional[str] = None
+    # joint pool-group allocation (karpenter_tpu/poolgroups,
+    # docs/poolgroups.md): PoolGroup CRDs name member autoscalers with
+    # cross-pool ratio bands and shared budgets; the engine excludes
+    # members from the independent cost ladders and refines them in ONE
+    # joint dispatch (SolverService.poolgroup). Default OFF — with the
+    # flag absent (or no PoolGroup objects) the wire is byte-identical
+    # to the uncoordinated plane (--poolgroups).
+    poolgroups: bool = False
     # replicated control plane (karpenter_tpu/replication,
     # docs/resilience.md "Replicated control plane"): partition tenants
     # across N leader-elected replicas with fenced handoff. partitions=0
@@ -356,8 +364,30 @@ class KarpenterRuntime:
             forecaster=self.forecaster,
             registry=self.registry,
         )
+        # joint pool-group allocation (--poolgroups, poolgroups/,
+        # docs/poolgroups.md): built only under the flag — the absent
+        # engine keeps the autoscaler wire byte-identical
+        self.pool_engine = None
+        headroom_source = self.cost_engine.headroom
+        if options.poolgroups:
+            from karpenter_tpu.poolgroups import PoolGroupEngine
+
+            self.pool_engine = PoolGroupEngine(
+                store=self.store,
+                poolgroup_fn=self.solver_service.poolgroup,
+                model=self.cost_model,
+                forecaster=self.forecaster,
+                registry=self.registry,
+            )
+
+            def headroom_source(ns, name, _cost=self.cost_engine.headroom,
+                                _pool=self.pool_engine.headroom):
+                # warm pools size from the WORST risk either refiner
+                # sees for the target group
+                return max(_cost(ns, name), _pool(ns, name))
+
         self.warmpool = WarmPoolEngine(
-            headroom_source=self.cost_engine.headroom,
+            headroom_source=headroom_source,
             registry=self.registry,
         )
         self.batch_autoscaler = BatchAutoscaler(
@@ -365,6 +395,7 @@ class KarpenterRuntime:
             decider=self.solver_service.decide,
             forecaster=self.forecaster,
             cost_engine=self.cost_engine,
+            pool_engine=self.pool_engine,
             tenant=options.tenant_id,
             # --fused-tick: the forecast -> decide -> cost chain rides
             # ONE compiled program per batch through the service's

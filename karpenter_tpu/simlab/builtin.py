@@ -234,6 +234,18 @@ def _run_multitenant(args, store) -> int:
     return 0
 
 
+def _run_poolgroups(args, store) -> int:
+    # self-contained replay (own stores, fake provider): a decode-heavy
+    # traffic-mix storm through a prefill/decode PoolGroup, coordinated
+    # (--poolgroups joint allocator) vs uncoordinated per-pool loops
+    # (docs/poolgroups.md)
+    from karpenter_tpu.simulate import simulate_poolgroups
+
+    report = simulate_poolgroups(seed=_resolved_seed(args, 0))
+    print(json.dumps(report, indent=2, sort_keys=True))
+    return 0
+
+
 def _run_cost(args, store) -> int:
     # self-contained replay (own stores, lagged fake provider):
     # warm pool on vs off through the cost-aware pipeline
@@ -436,6 +448,20 @@ register_scenario(Scenario(
     select=lambda args: bool(args.multitenant),
     run=_run_multitenant,
     trails=_trails_theme(diurnal=True, amplitude=48.0),
+))
+
+register_scenario(Scenario(
+    name="poolgroups",
+    description="decode-heavy traffic-mix storm through a "
+    "prefill/decode PoolGroup, joint vs per-pool loops",
+    flags="--poolgroups",
+    order=45,
+    select=lambda args: bool(getattr(args, "poolgroups", False)),
+    run=_run_poolgroups,
+    trails=_trails_theme(
+        diurnal=True, amplitude=64.0, spike=48.0,
+        fault_probability=0.05,
+    ),
 ))
 
 register_scenario(Scenario(

@@ -2312,6 +2312,237 @@ def simulate_cost(  # lint: allow-complexity — scenario assembly: two replays 
     }
 
 
+def _poolgroup_world(grouped: bool, target: float, budget: float, clock,
+                     backend: str):
+    """One self-contained disaggregated-serving world: a prefill pool
+    and a decode pool (two SNG/HA pairs), one PoolGroup declaring the
+    decode:prefill ratio band [2:1, 4:1] and a shared hourly budget,
+    behind a full KarpenterRuntime. `grouped` toggles --poolgroups; the
+    PoolGroup object is created either way, so the uncoordinated arm is
+    the exact byte-identical ungrouped plane ignoring it. Returns
+    (runtime, ratio) where ratio is the declared band for the caller's
+    violation accounting."""
+    from karpenter_tpu.api.core import ObjectMeta
+    from karpenter_tpu.api.horizontalautoscaler import (
+        Behavior,
+        CrossVersionObjectReference,
+        HorizontalAutoscaler,
+        HorizontalAutoscalerSpec,
+        Metric,
+        MetricTarget,
+        PrometheusMetricSource,
+        SLOSpec,
+    )
+    from karpenter_tpu.api.poolgroup import (
+        PoolGroup,
+        PoolGroupSpec,
+        PoolMember,
+        RatioConstraint,
+    )
+    from karpenter_tpu.api.scalablenodegroup import (
+        ScalableNodeGroup,
+        ScalableNodeGroupSpec,
+    )
+    from karpenter_tpu.cloudprovider.fake import FakeFactory
+    from karpenter_tpu.runtime import KarpenterRuntime, Options
+    from karpenter_tpu.store import Store
+
+    store = Store()
+    provider = FakeFactory()
+    for name, queue, initial in (("prefill", "qp", 10), ("decode", "qd", 20)):
+        gid = f"g-{name}"
+        provider.node_replicas[gid] = initial
+        store.create(ScalableNodeGroup(
+            metadata=ObjectMeta(name=gid),
+            spec=ScalableNodeGroupSpec(
+                replicas=initial, type="FakeNodeGroup", id=gid,
+            ),
+        ))
+        store.create(HorizontalAutoscaler(
+            metadata=ObjectMeta(name=name),
+            spec=HorizontalAutoscalerSpec(
+                scale_target_ref=CrossVersionObjectReference(
+                    kind="ScalableNodeGroup", name=gid
+                ),
+                min_replicas=1,
+                max_replicas=10_000,
+                metrics=[Metric(prometheus=PrometheusMetricSource(
+                    query=f'karpenter_queue_length{{name="{queue}"}}',
+                    target=MetricTarget(type="AverageValue", value=target),
+                ))],
+                behavior=Behavior(slo=SLOSpec(violation_cost_weight=100.0)),
+            ),
+        ))
+    ratio = RatioConstraint(
+        numerator="decode", denominator="prefill",
+        min_numerator=2, min_denominator=1,
+        max_numerator=4, max_denominator=1,
+    )
+    store.create(PoolGroup(
+        metadata=ObjectMeta(name="serving"),
+        spec=PoolGroupSpec(
+            pools=[
+                PoolMember(name="prefill", role="prefill"),
+                PoolMember(name="decode", role="decode"),
+            ],
+            ratios=[ratio],
+            max_hourly_cost=budget,
+        ),
+    ))
+    runtime = KarpenterRuntime(
+        Options(poolgroups=grouped),
+        store=store, cloud_provider_factory=provider, clock=clock,
+    )
+    runtime.solver_service.backend = backend
+    return runtime, ratio
+
+
+def simulate_poolgroups(  # lint: allow-complexity — scenario assembly: two replays + band/budget accounting
+    ticks: int = 60,
+    interval_s: float = 10.0,
+    target: float = 4.0,
+    prefill_queue: float = 40.0,
+    decode_base: float = 80.0,
+    decode_peak: float = 240.0,
+    ramp_start: int = 15,
+    ramp_ticks: int = 20,
+    budget: float = 90.0,
+    seed: int = 0,
+    backend: str = "xla",
+) -> dict:
+    """Seeded traffic-mix-shift replay (docs/poolgroups.md): the same
+    scripted DECODE-HEAVY STORM — prefill demand flat, decode demand
+    ramping 3x over `ramp_ticks` ticks, the disaggregated-serving mix
+    shift "Taming the Chaos" studies — is driven through two otherwise
+    identical prefill/decode worlds, --poolgroups ON vs OFF. The
+    declared coupling is a decode:prefill ratio band [2:1, 4:1] plus a
+    shared hourly budget: the coordinated arm's joint allocator
+    rebalances pool-to-pool (raising prefill beyond what its own flat
+    queue asks, because decode's storm pulls the ratio toward the upper
+    bound) and must HOLD the band through the storm under the cap; the
+    uncoordinated arm scales each pool from its own queue alone and
+    violates the band for the storm's whole plateau. Violations are
+    counted by exact integer cross-multiplication on the actuated
+    per-tick replica counts — the same arithmetic the joint kernel
+    enforces on device. Self-contained and mutation-free toward any
+    real cluster (own stores, fake provider); the storm's per-tick
+    drift stays inside the joint candidate ladder's reach, so the
+    coordinated arm repairs within the tick the drift lands."""
+    import math as _math
+
+    rng = np.random.RandomState(seed)
+    noise_p = rng.normal(0.0, 0.25, size=ticks)
+    noise_d = rng.normal(0.0, 0.25, size=ticks)
+
+    def queues_at(tick: int):
+        progress = min(
+            max(tick - ramp_start, 0) / max(ramp_ticks, 1), 1.0
+        )
+        qd = decode_base + (decode_peak - decode_base) * 0.5 * (
+            1.0 - _math.cos(_math.pi * progress)
+        )
+        return (
+            max(0.0, prefill_queue + float(noise_p[tick])),
+            max(0.0, qd + float(noise_d[tick])),
+        )
+
+    def replay(grouped: bool) -> dict:
+        clock = {"now": 1_000_000.0}
+        runtime, ratio = _poolgroup_world(
+            grouped, target, budget, lambda: clock["now"], backend
+        )
+        gauge = runtime.registry.register("queue", "length")
+        trail_p, trail_d, spend_trail = [], [], []
+        violations = coordinated_ticks = 0
+        try:
+            for tick in range(ticks):
+                qp, qd = queues_at(tick)
+                gauge.set("qp", "default", qp)
+                gauge.set("qd", "default", qd)
+                runtime.manager._due = {
+                    k: 0.0 for k in runtime.manager._due
+                }
+                runtime.manager.reconcile_all()
+                clock["now"] += interval_s
+                p = runtime.store.get_scale(
+                    "ScalableNodeGroup", "default", "g-prefill"
+                ).spec_replicas
+                d = runtime.store.get_scale(
+                    "ScalableNodeGroup", "default", "g-decode"
+                ).spec_replicas
+                trail_p.append(p)
+                trail_d.append(d)
+                # exact integer band check, the kernel's arithmetic:
+                # min_num*p <= d*min_den and d*max_den <= max_num*p
+                if (
+                    d * ratio.min_denominator
+                    < ratio.min_numerator * p
+                    or d * ratio.max_denominator
+                    > ratio.max_numerator * p
+                ):
+                    violations += 1
+                spend_trail.append(float(p + d))  # default $1/replica-hour
+                group = runtime.store.get(
+                    "PoolGroup", "default", "serving"
+                )
+                if group.status.coordinated:
+                    coordinated_ticks += 1
+            stats = runtime.solver_service.stats
+            return {
+                "prefill": trail_p,
+                "decode": trail_d,
+                "ratio_violation_ticks": violations,
+                "coordinated_ticks": coordinated_ticks,
+                "max_hourly_spend": round(max(spend_trail), 2),
+                "mean_hourly_spend": round(
+                    float(np.mean(spend_trail)), 2
+                ),
+                "poolgroup_dispatches": stats.poolgroup_dispatches,
+                "cost_dispatches": stats.cost_dispatches,
+            }
+        finally:
+            runtime.close()
+
+    on = replay(True)
+    off = replay(False)
+    return {
+        "config": {
+            "ticks": ticks,
+            "interval_s": interval_s,
+            "target": target,
+            "prefill_queue": prefill_queue,
+            "decode_storm": f"{decode_base} -> {decode_peak} over ticks "
+                            f"[{ramp_start}, {ramp_start + ramp_ticks}]",
+            "ratio_band": "2:1 <= decode:prefill <= 4:1",
+            "max_hourly_cost": budget,
+            "seed": seed,
+        },
+        "runs": {"coordinated": on, "uncoordinated": off},
+        "band": {
+            "coordinated_violation_ticks": on["ratio_violation_ticks"],
+            "uncoordinated_violation_ticks": off[
+                "ratio_violation_ticks"
+            ],
+            "held_through_storm": on["ratio_violation_ticks"] == 0,
+        },
+        "budget": {
+            "declared_hourly": budget,
+            "coordinated_max_spend": on["max_hourly_spend"],
+            "under_cap": on["max_hourly_spend"] <= budget,
+        },
+        "dispatch_collapse": {
+            # grouped rows leave the per-pool cost ladder and ride ONE
+            # joint dispatch per tick (the acceptance criterion's
+            # karpenter_solver_dispatches_per_tick collapse)
+            "coordinated_poolgroup_dispatches": on[
+                "poolgroup_dispatches"
+            ],
+            "coordinated_cost_dispatches": on["cost_dispatches"],
+            "uncoordinated_cost_dispatches": off["cost_dispatches"],
+        },
+    }
+
+
 def simulate_delta(
     store, what_if_groups: List[dict], solver=None,
     template_resolver=None, cost_model=None,

@@ -244,6 +244,11 @@ class SolverStatistics:
     cost_calls: int = 0  # cost() entries
     cost_errors: int = 0  # cost() failures (the caller goes cost-blind)
     cost_dispatches: int = 0  # cost device dispatches
+    # joint pool-group allocation seam (poolgroups/, docs/poolgroups.md)
+    poolgroup_calls: int = 0  # poolgroup() entries
+    poolgroup_errors: int = 0  # poolgroup() failures (even the floor died)
+    poolgroup_dispatches: int = 0  # joint device dispatches
+    poolgroup_independent_serves: int = 0  # degraded independent-ladder serves
     consolidate_calls: int = 0
     consolidate_candidates: int = 0
     # forecast seam (forecast/, docs/forecasting.md)
@@ -1493,6 +1498,82 @@ class SolverService:
         finally:
             self._record_stage("cost", _time.perf_counter() - t0)
 
+    def poolgroup(self, inputs, backend: Optional[str] = None):
+        """The joint pool-group allocation through the service
+        (ops/poolgroup.py, docs/poolgroups.md): every PoolGroup's joint
+        candidate ladder scored in ONE batched dispatch — the grouped
+        HAs' replacement for N independent cost dispatches.
+
+        Degradation is the never-block ladder and it is SEMANTIC, not
+        just a backend swap: device joint kernel → INDEPENDENT per-pool
+        ladders (the numpy mirror with joint selection disabled — each
+        pool still refines exactly as the cost family would, but ratios
+        and the shared budget go advisory for the tick) → the caller's
+        own never-block contract. A numpy-resolved backend serves the
+        full JOINT mirror (bit-identical, the REQUESTED backend, like
+        cost()). Device failures feed the shared backend-health FSM; a
+        DEGRADED FSM short-circuits straight to the independent rung so
+        probes ride the normal recovery path. `poolgroup.solve` is the
+        fault-injection point (faults/registry.py)."""
+        from karpenter_tpu.ops import poolgroup as PGK
+
+        self.stats.poolgroup_calls += 1
+        resolved = self._resolve_backend(backend)
+        if self.device_solver is not None:
+            resolved = "numpy"  # the gRPC wire carries bin-packs only
+        elif resolved == "pallas":
+            resolved = "xla"  # no Mosaic poolgroup kernel; XLA runs on TPU
+        t0 = _time.perf_counter()
+        try:
+            if resolved == "numpy":
+                # the REQUESTED backend, not a degradation: the
+                # bit-identical joint mirror, constraints fully enforced
+                with default_tracer().span(
+                    "solver.poolgroup", backend="numpy"
+                ):
+                    out = PGK.poolgroup_numpy(inputs)
+                self._annotate_provenance("numpy", "numpy")
+                return out
+            if self._device_allowed():
+                try:
+                    import jax
+
+                    with default_tracer().span(
+                        "solver.poolgroup", backend=resolved
+                    ):
+                        with solver_trace("solver.poolgroup"):
+                            # the joint-path fault-injection point: an
+                            # error plan exercises the independent-
+                            # ladder degradation + FSM trip
+                            inject("poolgroup.solve")
+                            out = PGK.poolgroup_jit(inputs)
+                            jax.block_until_ready(out)
+                    self._record_device_success()
+                    self.stats.poolgroup_dispatches += 1
+                    self._count_dispatch()
+                    self._annotate_provenance(resolved, "device")
+                    return jax.tree_util.tree_map(np.asarray, out)
+                except Exception as error:  # noqa: BLE001 — never-block
+                    self._record_device_failure()
+                    logger().warning(
+                        "joint poolgroup dispatch failed (%s: %s); "
+                        "serving INDEPENDENT per-pool ladders this tick "
+                        "(ratios advisory)",
+                        type(error).__name__, error,
+                    )
+            with default_tracer().span(
+                "solver.poolgroup", backend="independent"
+            ):
+                self.stats.poolgroup_independent_serves += 1
+                out = PGK.poolgroup_numpy(inputs, enforce=False)
+            self._annotate_provenance("numpy", "numpy")
+            return out
+        except Exception:
+            self.stats.poolgroup_errors += 1
+            raise
+        finally:
+            self._record_stage("poolgroup", _time.perf_counter() - t0)
+
     def sim_step(self, inputs, backend: Optional[str] = None):
         """One simulated-cluster tick through the service (ops/simstep.py,
         docs/simulator.md): elementwise over any leading batch shape, so
@@ -1642,6 +1723,7 @@ class SolverService:
                 "fused", n, m, t_bucket, s_bucket,
                 inputs.forecast is not None,
                 inputs.slo_valid is not None,
+                inputs.poolgroup is not None,
                 resolved,
             )
             try:

@@ -45,6 +45,11 @@ class TenantSpec:
     # fair-admission weight (tenancy/fairness.py): a tenant's long-run
     # share of the shared dispatch budget is weight / sum(weights)
     weight: float = 1.0
+    # PoolGroup coalition id (tenancy/fairness.py): tenants declaring
+    # the same id host member pools of one PoolGroup and are admitted
+    # into the same batch round, so the joint allocator
+    # (ops/poolgroup.py) never sees a partial group; None = ungrouped
+    pool_group: Optional[str] = None
     # per-tenant pricing feed (cost/pricing.py): a JSON/YAML catalog
     # file reloaded on mtime change; None = the built-in catalog
     pricing_file: Optional[str] = None
@@ -68,6 +73,11 @@ class TenantSpec:
             raise ValueError(
                 f"tenant {self.id}: forecastHistory must be >= 2, got "
                 f"{self.forecast_history}"
+            )
+        if self.pool_group is not None and not self.pool_group:
+            raise ValueError(
+                f"tenant {self.id}: poolGroup must be a non-empty id "
+                f"or omitted"
             )
 
 
@@ -240,6 +250,17 @@ class TenantRegistry:
     def weights(self) -> Dict[str, float]:
         with self._lock:
             return {t: c.spec.weight for t, c in self._tenants.items()}
+
+    def pool_groups(self) -> Dict[str, str]:
+        """Tenant -> PoolGroup coalition id, grouped tenants only: the
+        admission policy coalesces these into indivisible rounds
+        (tenancy/fairness.py module docstring)."""
+        with self._lock:
+            return {
+                t: c.spec.pool_group
+                for t, c in self._tenants.items()
+                if c.spec.pool_group
+            }
 
     def journal_dir_for(self, tenant: str) -> Optional[str]:
         """`<journal_dir>/tenants/<id>`, created on first ask — the

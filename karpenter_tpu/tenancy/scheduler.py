@@ -695,7 +695,12 @@ class MultiTenantScheduler:
         if healthy:
             weights = self.registry.weights()
             demand = {t: rows_of(i) for t, i in healthy.items()}
-            schedule = self.admission.rounds(demand, weights)
+            # group-aware: tenants hosting one PoolGroup's member pools
+            # ride the same round, so the joint allocator never scores
+            # a partial group (fairness.py module docstring)
+            schedule = self.admission.rounds(
+                demand, weights, self.registry.pool_groups()
+            )
             self.stats.admission_rounds += len(schedule)
             if self.metrics.enabled:
                 self.metrics.rounds.set("-", "-", float(len(schedule)))

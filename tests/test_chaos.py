@@ -820,6 +820,128 @@ class TestCostChaos:
             runtime.close()
 
 
+class TestPoolGroupChaos:
+    """PR 20 satellite (docs/poolgroups.md degradation contract): at
+    100% `poolgroup.solve` faults the joint allocator degrades to
+    INDEPENDENT per-pool cost ladders — each pool still refines, the
+    declared ratio band goes advisory, the reconcile loop never blocks
+    — and the repeated device failures feed the SAME backend-health FSM
+    every other family rides; once faults clear, probes recover the
+    device path and the fleet converges to the JOINT fixed point."""
+
+    PREFILL = 11  # queue 41 / AverageValue target 4 -> ceil
+    DECODE_INDEPENDENT = 40  # queue 160 / 4: the per-pool ladder's point
+    DECODE_JOINT = 44  # min band decode:prefill >= 4:1 -> 4 * 11
+
+    def _world(self):
+        from karpenter_tpu.api.poolgroup import (
+            PoolGroup,
+            PoolGroupSpec,
+            PoolMember,
+            RatioConstraint,
+        )
+
+        clock = FakeClock()
+        provider = RecordingFactory()
+        provider.node_replicas["g-prefill"] = 5
+        provider.node_replicas["g-decode"] = 5
+        runtime = KarpenterRuntime(
+            Options(poolgroups=True, solver_health_threshold=2,
+                    solver_probe_interval_s=0.0),
+            cloud_provider_factory=provider,
+            clock=clock,
+        )
+        runtime.solver_service.backend = "xla"
+        queue = runtime.registry.register("queue", "length")
+        queue.set("qp", "default", 41.0)
+        queue.set("qd", "default", 160.0)
+        for name, q in (("prefill", "qp"), ("decode", "qd")):
+            runtime.store.create(sng_of(f"g-{name}", replicas=5))
+            ha = queue_ha(
+                f"g-{name}",
+                f'karpenter_queue_length{{name="{q}"}}',
+                min_replicas=1, max_replicas=1000,
+            )
+            ha.metadata.name = name
+            runtime.store.create(ha)
+        # a min-band out of reach of the independent points (decode 40
+        # < 4 x prefill 11), so the joint and degraded-independent
+        # fixed points are DISTINGUISHABLE (44 vs 40) and the
+        # degradation is observable on the wire
+        runtime.store.create(PoolGroup(
+            metadata=ObjectMeta(name="serving"),
+            spec=PoolGroupSpec(
+                pools=[PoolMember(name="prefill"),
+                       PoolMember(name="decode")],
+                ratios=[RatioConstraint(
+                    numerator="decode", denominator="prefill",
+                    min_numerator=4, min_denominator=1,
+                )],
+            ),
+        ))
+        return clock, provider, runtime
+
+    def test_joint_faults_degrade_to_independent_then_recover(self):
+        clock, provider, runtime = self._world()
+        service = runtime.solver_service
+        try:
+            registry = faults.install(FaultRegistry(seed=CHAOS_SEED))
+            registry.plan("poolgroup.solve", probability=1.0)
+            for _ in range(30):
+                clock.advance(61.0)
+                runtime.manager.reconcile_all()
+            assert registry.injected.get("poolgroup.solve", 0) >= 1, (
+                "the scenario must actually have exercised joint faults"
+            )
+            # every tick served the INDEPENDENT per-pool ladders (each
+            # pool at its own reactive point, the band advisory) and
+            # the loop never stalled
+            assert service.stats.poolgroup_independent_serves >= 1
+            assert service.queue_depth() == 0
+            assert provider.node_replicas["g-prefill"] == self.PREFILL
+            assert (
+                provider.node_replicas["g-decode"]
+                == self.DECODE_INDEPENDENT
+            )
+            # the degradation is visible on the group: uncoordinated
+            # status, ratio_ok gauge down, coordinated counter flat
+            group = runtime.store.get("PoolGroup", "default", "serving")
+            assert group.status.coordinated is False
+            assert runtime.registry.gauge("poolgroup", "ratio_ok").get(
+                "serving", "default"
+            ) == 0.0
+            assert not runtime.registry.gauge(
+                "poolgroup", "coordinated_total"
+            ).get("serving", "default")
+            # the repeated device faults tripped the shared FSM — the
+            # joint path feeds the SAME health ladder bin-packs do
+            assert service.stats.fsm_trips >= 1
+
+            faults.uninstall()  # ---- faults clear ----
+            for _ in range(5):
+                clock.advance(61.0)
+                runtime.manager.reconcile_all()
+            # probes re-arm the device path; the joint dispatch resumes
+            # and the fleet converges to the coordinated fixed point
+            assert service.backend_health() == "healthy"
+            assert service.stats.poolgroup_dispatches >= 1
+            assert provider.node_replicas["g-prefill"] == self.PREFILL
+            assert (
+                provider.node_replicas["g-decode"] == self.DECODE_JOINT
+            )
+            group = runtime.store.get("PoolGroup", "default", "serving")
+            assert group.status.coordinated is True
+            assert runtime.registry.gauge("poolgroup", "ratio_ok").get(
+                "serving", "default"
+            ) == 1.0
+            assert runtime.registry.gauge(
+                "poolgroup", "coordinated_total"
+            ).get("serving", "default") >= 1.0
+        finally:
+            faults.uninstall()
+            runtime.close()
+
+
 class TestEventStormChaos:
     """ISSUE 14 acceptance: a seeded 1k-event churn storm inside one
     debounce window coalesces into a handful of event passes (not one

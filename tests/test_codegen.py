@@ -179,7 +179,7 @@ class TestManifestTree:
     def test_release_manifest_pinned_and_fresh(self):
         docs = self._docs("releases/manifest.yaml")
         kinds = [d["kind"] for d in docs]
-        assert kinds.count("CustomResourceDefinition") == 3
+        assert kinds.count("CustomResourceDefinition") == 4
         assert "Deployment" in kinds and "ClusterRole" in kinds
         # the pinned CRDs must equal codegen output (same no-drift gate)
         crds = {

@@ -145,10 +145,23 @@ class TestSpec:
                 name="x", pod_selector={"a": "b"},
                 anti_affinity=True, compact=True,
             ).validate()
+        # any non-empty label key is a legal spread axis now
+        SpreadSpec(topology_key="kubernetes.io/hostname").validate()
         with pytest.raises(ValueError, match="topologyKey"):
-            SpreadSpec(topology_key="kubernetes.io/hostname").validate()
+            SpreadSpec(topology_key="").validate()
         with pytest.raises(ValueError, match="maxSkew"):
             SpreadSpec(max_skew=0).validate()
+        with pytest.raises(ValueError, match="single"):
+            validate_constraints([
+                ConstraintGroup(
+                    name="x", pod_selector={"a": "b"},
+                    spread=SpreadSpec(topology_key="rack"),
+                ),
+                ConstraintGroup(
+                    name="y", pod_selector={"c": "d"},
+                    spread=SpreadSpec(),
+                ),
+            ])
         with pytest.raises(ValueError, match="duplicate"):
             validate_constraints([
                 ConstraintGroup(
@@ -396,6 +409,66 @@ class TestKernelSemantics:
         assert out.assigned_count.tolist() == [2, 2]
         meta = compiled.meta
         assert spread_skew(inputs, out.assigned, meta) == {"s": 0}
+
+    def test_spread_custom_key_parity_with_zone(self):
+        # the balanced-spread pin, extended to an arbitrary topology
+        # axis: the SAME fleet labeled on a custom key compiles to
+        # byte-identical operands and the kernel balances identically
+        rack = "example.com/rack"
+        z_groups = [
+            ConstraintGroup(
+                name="s", pod_selector={"t": "1"}, spread=SpreadSpec()
+            )
+        ]
+        r_groups = [
+            ConstraintGroup(
+                name="s",
+                pod_selector={"t": "1"},
+                spread=SpreadSpec(topology_key=rack),
+            )
+        ]
+        validate_constraints(r_groups)
+        z_profiles = [_profile(zone="z1"), _profile(zone="z2")]
+        r_profiles = [
+            (
+                {"cpu": 8.0, "memory": 32.0, "pods": 32.0},
+                {(rack, z)},
+                set(),
+            )
+            for z in ("z1", "z2")
+        ]
+        membership = np.ones(4, np.int32)
+        compiled = []
+        for profiles, groups in (
+            (z_profiles, z_groups),
+            (r_profiles, r_groups),
+        ):
+            compiled.append(
+                compile_rows(
+                    membership,
+                    np.ones(4, np.int32),
+                    np.ones(4, bool),
+                    profiles,
+                    groups,
+                )
+            )
+        a, b = compiled
+        for name in (
+            "rep",
+            "row_weight",
+            "spread_slot",
+            "group_domain",
+            "spread_cap",
+        ):
+            assert np.array_equal(getattr(a, name), getattr(b, name))
+        assert b.meta.topology_key == rack
+        assert b.meta.zones == ["z1", "z2"]
+        inputs = _inputs_from_compiled(
+            [[1, 1]] * 4, [[8, 8], [8, 8]], b
+        )
+        out = jax.device_get(B.binpack(inputs, buckets=8))
+        assert out.assigned_count.tolist() == [2, 2]
+        assert spread_skew(inputs, out.assigned, b.meta) == {"s": 0}
 
     def test_compact_members_never_share_nodes(self):
         groups = [

@@ -371,18 +371,19 @@ class TestPolicySearch:
 
 class TestScenarioRegistry:
     EXPECTED = (
-        "trace", "constraints", "eventloop", "multitenant", "cost",
-        "forecast", "restart-storm", "failover", "preempt",
-        "consolidate", "what-if", "karpenter",
+        "trace", "constraints", "eventloop", "multitenant",
+        "poolgroups", "cost", "forecast", "restart-storm", "failover",
+        "preempt", "consolidate", "what-if", "karpenter",
     )
 
     @staticmethod
     def _args(**over):
         base = dict(
             trace_export=None, constraints=False, eventloop=False,
-            multitenant=False, cost=False, forecast=False,
-            restart_storm=False, failover=False, preempt=False,
-            consolidate=False, what_if=None, sim_seed=None,
+            multitenant=False, poolgroups=False, cost=False,
+            forecast=False, restart_storm=False, failover=False,
+            preempt=False, consolidate=False, what_if=None,
+            sim_seed=None,
         )
         base.update(over)
         return Namespace(**base)

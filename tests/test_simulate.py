@@ -531,6 +531,58 @@ class TestSimulateCost:
         assert self._deterministic_view(a) == self._deterministic_view(b)
 
 
+class TestSimulatePoolGroups:
+    """PR 20 satellite (docs/poolgroups.md "Dry-running"): the
+    --simulate --poolgroups decode-heavy storm must show the
+    coordinated arm HOLDING the declared decode:prefill band (under the
+    shared budget, through one joint dispatch per tick) while the
+    uncoordinated per-pool baseline violates it — the acceptance
+    headline — and the whole report is a pure function of the seed."""
+
+    def test_storm_holds_band_under_coordination_only(self):
+        from karpenter_tpu.simulate import simulate_poolgroups
+
+        report = simulate_poolgroups()
+        band = report["band"]
+        assert band["held_through_storm"] is True
+        assert band["coordinated_violation_ticks"] == 0
+        assert band["uncoordinated_violation_ticks"] > 0, (
+            "the uncoordinated baseline must violate the band — "
+            "otherwise the storm proves nothing"
+        )
+        # the joint point stayed coordinated every tick and spent under
+        # the declared shared budget
+        on = report["runs"]["coordinated"]
+        assert on["coordinated_ticks"] == report["config"]["ticks"]
+        assert report["budget"]["under_cap"] is True
+        # dispatch collapse: grouped rows leave the per-pool cost
+        # ladder (0 cost dispatches) and ride ONE joint dispatch per
+        # tick; the baseline keeps the N per-pool cost path
+        collapse = report["dispatch_collapse"]
+        assert collapse["coordinated_cost_dispatches"] == 0
+        assert (
+            collapse["coordinated_poolgroup_dispatches"]
+            == report["config"]["ticks"]
+        )
+        assert collapse["uncoordinated_cost_dispatches"] > 0
+
+    def test_replay_digest_is_pinned(self):
+        """crc32 of canonical JSON (the constraints-replay discipline):
+        the report is deterministic end to end — no wall-time fields —
+        so the WHOLE report digests to one pinned value."""
+        import json
+        import zlib
+
+        from karpenter_tpu.simulate import simulate_poolgroups
+
+        report = simulate_poolgroups()
+        canon = json.dumps(
+            report, sort_keys=True, separators=(",", ":")
+        )
+        assert zlib.crc32(canon.encode()) == 762078142
+        assert report == simulate_poolgroups()
+
+
 class TestSimulateConstraints:
     """PR 16 satellite (docs/constraints.md "Dry-running"): the
     --simulate --constraints zonal-outage replay runs the REAL
